@@ -1,0 +1,21 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with the capability
+surface of Apache MXNet v0.11 (reference at /root/reference), built on
+JAX/XLA/Pallas/pjit instead of mshadow/CUDA/NNVM/ps-lite.
+
+Typical use mirrors the reference:
+
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu(0))
+    net = mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=10)
+"""
+from . import base  # noqa: F401
+from . import ops  # noqa: F401  (populates the op table)
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from .base import MXNetError  # noqa: F401
+from .context import Context, cpu, current_context, gpu, num_gpus, num_tpus, tpu  # noqa: F401
+from .ndarray import NDArray  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,252 @@
+"""Imperative autograd: record scopes + tape + backward via per-op jax.vjp.
+
+Reference analogue: src/ndarray/autograd.{h,cc} (AutogradRuntime tape of
+AGNodes, replayed through a GraphExecutor) and python/mxnet/autograd.py
+(record/pause scopes, mark_variables, backward). The rebuild records a DAG of
+op applications with their record-time input values; backward walks the DAG in
+reverse topological order and linearizes each node with ``jax.vjp`` — the
+XLA-era equivalent of the reference building a symbolic executor over the tape
+(autograd.cc:244).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "AGNode",
+]
+
+_scope = threading.local()
+
+
+def _st():
+    if not hasattr(_scope, "recording"):
+        _scope.recording = False
+        _scope.training = False
+    return _scope
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(is_record: bool) -> bool:
+    st = _st()
+    prev, st.recording = st.recording, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    st = _st()
+    prev, st.training = st.training, train
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording=None, training=None):
+        self._recording = recording
+        self._training = training
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._recording is not None:
+            st.recording = self._recording
+        if self._training is not None:
+            st.training = self._training
+        return self
+
+    def __exit__(self, *args):
+        st = _st()
+        st.recording, st.training = self._prev
+        return False
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — start taping (reference autograd.py:record)."""
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _Scope(training=True)
+
+
+def predict_mode():
+    return _Scope(training=False)
+
+
+class AGNode:
+    """One taped op application (reference: AGNodeEntry, autograd.h)."""
+
+    __slots__ = ("opdef", "attrs", "rng", "inputs", "input_vals", "n_outputs",
+                 "out_arrays")
+
+    def __init__(self, opdef, attrs, rng, inputs, input_vals, n_outputs,
+                 out_arrays):
+        self.opdef = opdef
+        self.attrs = attrs          # parsed attrs (incl. _is_train if any)
+        self.rng = rng              # saved key for needs_rng ops
+        self.inputs = inputs        # list of NDArray (strong refs keep tape alive)
+        self.input_vals = input_vals  # record-time jax values
+        self.n_outputs = n_outputs
+        self.out_arrays = out_arrays  # record-time output jax values
+
+    def run(self, *vals):
+        args = (self.rng,) + vals if self.opdef.needs_rng else vals
+        out = self.opdef.fn(*args, **self.attrs)
+        return out if isinstance(out, tuple) else (out,)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference: MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._mark_variable(g, req)
+
+
+def _toposort(head_nodes: List[AGNode]) -> List[AGNode]:
+    order, seen = [], set()
+    stack = [(n, False) for n in head_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            child = getattr(inp, "_ag_node", None)
+            if child is not None and id(child) not in seen:
+                stack.append((child, False))
+    return order  # children before parents
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables.
+
+    Walks the tape in reverse topological order; each node contributes input
+    cotangents via jax.vjp on its saved input values.
+    """
+    from .ndarray import NDArray  # local import to avoid cycle
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent accumulators: (node id, out idx) -> val ; leaves: id(NDArray)
+    ct: Dict[Tuple[int, int], jax.Array] = {}
+    leaf_ct: Dict[int, jax.Array] = {}
+    leaf_arrays: Dict[int, "NDArray"] = {}
+
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        g = jnp.ones_like(h._data) if hg is None else hg._data
+        node = getattr(h, "_ag_node", None)
+        if node is None:
+            if getattr(h, "_grad_buf", None) is None:
+                raise MXNetError(
+                    "cannot differentiate a head that is neither recorded nor "
+                    "a marked variable"
+                )
+            leaf_ct[id(h)] = leaf_ct.get(id(h), 0) + g
+            leaf_arrays[id(h)] = h
+            continue
+        idx = h._ag_out_index
+        key = (id(node), idx)
+        ct[key] = ct.get(key, 0) + g
+        head_nodes.append(node)
+
+    order = _toposort(head_nodes)
+    for node in reversed(order):
+        out_cts = []
+        any_ct = False
+        for i in range(node.n_outputs):
+            c = ct.pop((id(node), i), None)
+            if c is None:
+                c = jnp.zeros_like(node.out_arrays[i])
+            else:
+                any_ct = True
+            out_cts.append(c)
+        if not any_ct:
+            continue
+
+        def fn_closed(*vals, _node=node):
+            return _node.run(*vals)
+
+        _, vjp_fn = jax.vjp(fn_closed, *node.input_vals)
+        in_cts = vjp_fn(tuple(out_cts))
+        for inp, c in zip(node.inputs, in_cts):
+            child = getattr(inp, "_ag_node", None)
+            if child is not None:
+                key = (id(child), inp._ag_out_index)
+                ct[key] = ct.get(key, 0) + c
+            elif getattr(inp, "_grad_buf", None) is not None:
+                leaf_ct[id(inp)] = leaf_ct.get(id(inp), 0) + c
+                leaf_arrays[id(inp)] = inp
+
+    for aid, c in leaf_ct.items():
+        arr = leaf_arrays[aid]
+        buf = arr._grad_buf
+        req = arr._grad_req
+        if req == "null" or buf is None:
+            continue
+        if req == "add":
+            buf._set_data(buf._data + c)
+        else:
+            buf._set_data(jnp.asarray(c, dtype=buf.dtype))
+
+    # tape nodes are garbage-collected once the head NDArrays drop their
+    # _ag_node references; nothing to free eagerly here
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient API (later-reference parity; returns new arrays)."""
+    from .ndarray import NDArray, array as _nd_array
+
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(v._grad_buf, v._grad_req) for v in variables]
+    try:
+        from .ndarray import zeros_like as _zl
+        for v in variables:
+            v._mark_variable(_zl(v), "write")
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 train_mode=train_mode)
+        outs = [v.grad.copy() for v in variables]
+    finally:
+        for v, (buf, req) in zip(variables, saved):
+            v._grad_buf, v._grad_req = buf, req
+    return outs[0] if single else outs

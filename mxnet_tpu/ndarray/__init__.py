@@ -1,0 +1,72 @@
+"""The ``nd`` namespace: NDArray plus op functions generated from the table.
+
+Reference analogue: python/mxnet/ndarray/op.py:51 ``_make_ndarray_function`` —
+the reference code-generates its NDArray op functions at import time from the
+C op registry; here they are generated from the declarative OP_TABLE.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..base import MXNetError
+from ..ops.registry import OP_TABLE, OpDef
+from .ndarray import (  # noqa: F401
+    NDArray,
+    arange,
+    array,
+    concatenate,
+    empty,
+    full,
+    imdecode,
+    imperative_invoke,
+    load,
+    moveaxis,
+    ones,
+    ones_like,
+    onehot_encode,
+    save,
+    waitall,
+    zeros,
+    zeros_like,
+)
+
+
+def _make_op_func(opdef: OpDef, name: str):
+    def op_func(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        inputs = list(args)
+        if opdef.input_names:
+            kw_inputs = {}
+            for i, n in enumerate(opdef.input_names):
+                if n in kwargs:
+                    kw_inputs[i] = kwargs.pop(n)
+            if kw_inputs:
+                hi = max(kw_inputs)
+                slots = inputs + [None] * max(0, hi + 1 - len(inputs))
+                for i, v in kw_inputs.items():
+                    if slots[i] is not None:
+                        raise MXNetError(
+                            f"input {opdef.input_names[i]} of {name} given "
+                            "both positionally and by keyword")
+                    slots[i] = v
+                inputs = [x for x in slots if x is not None]
+        res = imperative_invoke(opdef, inputs, kwargs, out=out)
+        if out is not None:
+            return out if not isinstance(out, (list, tuple)) else res
+        return res[0] if len(res) == 1 else res
+
+    op_func.__name__ = name
+    op_func.__doc__ = (opdef.fn.__doc__ or "") + (
+        f"\n\nParameters: {sorted(opdef.attr_spec.fields)}"
+        f"\nInputs: {opdef.input_names or ['data']}"
+    )
+    return op_func
+
+
+_mod = _sys.modules[__name__]
+for _name, _opdef in OP_TABLE.items():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_op_func(_opdef, _name))
+
+del _mod, _name, _opdef

@@ -1,0 +1,11 @@
+"""Operator library: one declarative table drives nd.* and sym.* namespaces.
+
+Importing this package populates the registry (reference analogue: static
+NNVM_REGISTER_OP initializers across src/operator/ executed at dlopen time).
+"""
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from .registry import OP_TABLE, OpDef, get_op, list_ops, register  # noqa: F401

@@ -1,0 +1,116 @@
+"""Declarative operator registry — the single op table for the framework.
+
+Reference analogue: NNVM op registration (``NNVM_REGISTER_OP`` + attribute
+functors FCompute/FInferShape/FInferType, include/mxnet/op_attr_types.h:109-240)
+and the 339 ``*REGISTER*`` sites under src/operator/. In the rebuild each op is
+one Python record whose ``fn`` is a jax-traceable computation:
+
+* shape/type inference  -> ``jax.eval_shape`` over ``fn`` (replaces
+  FInferShape/FInferType passes, src/executor/infer_graph_attr_pass.cc)
+* gradient              -> ``jax.vjp`` over ``fn`` (replaces FGradient graphs)
+* kernels               -> jnp/lax compositions, Pallas where fusion loses
+* the same table generates both the imperative ``nd.*`` namespace and the
+  symbolic ``sym.*`` namespace, mirroring the reference's import-time codegen
+  (python/mxnet/ndarray/op.py:51 ``_make_ndarray_function``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple, Union
+
+from ..base import AttrSpec, MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "OP_TABLE", "alias"]
+
+OP_TABLE: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """One operator.
+
+    fn(*inputs, **attrs) -> array or tuple of arrays. Must be jax-traceable in
+    the inputs (pure; no data-dependent python control flow). Ops that sample
+    randomness take a leading ``rng`` key argument and set ``needs_rng``; ops
+    whose semantics differ between train/eval read the ``_is_train`` attr
+    injected by the caller and set ``needs_is_train``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        attrs: Optional[AttrSpec] = None,
+        num_inputs: Optional[int] = None,
+        num_outputs: Union[int, Callable] = 1,
+        input_names: Optional[Sequence[str]] = None,
+        output_names: Optional[Sequence[str]] = None,
+        needs_rng: bool = False,
+        needs_is_train: bool = False,
+        differentiable: bool = True,
+        key_var_num_args: Optional[str] = None,
+        aux_update: Optional[Dict[int, int]] = None,
+        grad_fn: Optional[Callable] = None,
+    ):
+        self.name = name
+        self.fn = fn
+        self.attr_spec = attrs or AttrSpec()
+        self.num_inputs = num_inputs
+        self._num_outputs = num_outputs
+        self.input_names = list(input_names) if input_names else None
+        self.output_names = list(output_names) if output_names else ["output"]
+        self.needs_rng = needs_rng
+        self.needs_is_train = needs_is_train
+        self.differentiable = differentiable
+        # name of the attr holding the variadic input count (reference:
+        # key_var_num_args on ops like Concat/add_n — nnvm op registration)
+        self.key_var_num_args = key_var_num_args
+        # output idx -> input idx written back in imperative train mode
+        # (reference: auxiliary states, e.g. BatchNorm moving_mean/var)
+        self.aux_update = aux_update or {}
+        self.grad_fn = grad_fn
+
+    def num_outputs(self, attrs) -> int:
+        if callable(self._num_outputs):
+            return self._num_outputs(attrs)
+        return self._num_outputs
+
+    def parse_attrs(self, raw_attrs: Dict) -> Dict:
+        return self.attr_spec.parse(raw_attrs, self.name)
+
+    def arg_names(self, n_inputs: int):
+        if self.input_names and len(self.input_names) == n_inputs:
+            return list(self.input_names)
+        if n_inputs == 1:
+            return ["data"]
+        return [f"arg{i}" for i in range(n_inputs)]
+
+    def __repr__(self):
+        return f"<OpDef {self.name}>"
+
+
+def register(name: str, aliases: Sequence[str] = (), **kwargs):
+    """Register an operator. Usable as a decorator over its fn."""
+
+    def deco(fn):
+        op = OpDef(name, fn, **kwargs)
+        if name in OP_TABLE:
+            raise MXNetError(f"operator {name} registered twice")
+        OP_TABLE[name] = op
+        for a in aliases:
+            OP_TABLE[a] = op
+        return fn
+
+    return deco
+
+
+def alias(new_name: str, existing: str):
+    OP_TABLE[new_name] = OP_TABLE[existing]
+
+
+def get_op(name: str) -> OpDef:
+    if name not in OP_TABLE:
+        raise MXNetError(f"Unknown operator {name}")
+    return OP_TABLE[name]
+
+
+def list_ops():
+    return sorted(OP_TABLE)

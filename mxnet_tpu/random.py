@@ -1,0 +1,38 @@
+"""Global PRNG state for imperative sampling.
+
+Reference analogue: per-device random resources handed to ops by the
+ResourceManager (include/mxnet/resource.h:36-45, src/resource.cc) and
+``mx.random.seed`` (python/mxnet/random.py). Here the state is an explicit
+jax PRNG key chain; jitted executors thread per-step keys instead of using
+this global (functional purity under jit).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_key"]
+
+_state = threading.local()
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state: int):
+    """Seed the global imperative PRNG (reference: mx.random.seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    key = _get()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def current_key():
+    return _get()

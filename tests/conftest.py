@@ -1,0 +1,33 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's device-agnostic test strategy (SURVEY.md §4:
+``default_context()`` switchable, model-parallel tests on two CPU contexts) —
+multi-chip sharding is validated on virtual CPU devices; the real TPU chip is
+exercised by bench.py.
+"""
+import os
+
+# must be set before jax import anywhere in the test process; force (not
+# setdefault) — the surrounding environment may pin JAX_PLATFORMS to the
+# real accelerator
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# the env var alone is not enough under the axon TPU tunnel — force via config
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", jax.devices()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

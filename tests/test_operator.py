@@ -1,0 +1,319 @@
+"""Operator correctness tests (reference model: tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_unary_math():
+    x = nd.array(_rand(3, 4))
+    xn = x.asnumpy()
+    np.testing.assert_allclose(nd.relu(x).asnumpy(), np.maximum(xn, 0), rtol=1e-5)
+    np.testing.assert_allclose(nd.sigmoid(x).asnumpy(), 1 / (1 + np.exp(-xn)), rtol=1e-5)
+    np.testing.assert_allclose(nd.tanh(x).asnumpy(), np.tanh(xn), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(nd.exp(x).asnumpy(), np.exp(xn), rtol=1e-5)
+    np.testing.assert_allclose(nd.square(x).asnumpy(), xn ** 2, rtol=1e-5)
+    xp = nd.array(np.abs(_rand(3, 4)) + 0.5)
+    np.testing.assert_allclose(nd.log(xp).asnumpy(), np.log(xp.asnumpy()),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(nd.sqrt(xp).asnumpy(), np.sqrt(xp.asnumpy()), rtol=1e-5)
+    np.testing.assert_allclose(nd.rsqrt(xp).asnumpy(), 1 / np.sqrt(xp.asnumpy()), rtol=1e-4)
+
+
+def test_broadcast_binary():
+    a = nd.array(_rand(2, 1, 4))
+    b = nd.array(_rand(1, 3, 4))
+    np.testing.assert_allclose(nd.broadcast_add(a, b).asnumpy(),
+                               a.asnumpy() + b.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(nd.broadcast_mul(a, b).asnumpy(),
+                               a.asnumpy() * b.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(nd.broadcast_maximum(a, b).asnumpy(),
+                               np.maximum(a.asnumpy(), b.asnumpy()), rtol=1e-6)
+
+
+def test_add_n():
+    arrs = [nd.array(_rand(2, 3)) for _ in range(4)]
+    out = nd.add_n(*arrs)
+    np.testing.assert_allclose(out.asnumpy(), sum(a.asnumpy() for a in arrs),
+                               rtol=1e-6)
+
+
+def test_dot():
+    a = nd.array(_rand(3, 4))
+    b = nd.array(_rand(4, 5))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        nd.dot(a, b.T, transpose_b=True).asnumpy()[0],
+        (a.asnumpy() @ b.asnumpy())[0], rtol=1e-5, atol=1e-6)
+    c = nd.array(_rand(2, 3, 4))
+    d = nd.array(_rand(2, 4, 5))
+    np.testing.assert_allclose(nd.batch_dot(c, d).asnumpy(),
+                               np.matmul(c.asnumpy(), d.asnumpy()),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fully_connected():
+    x = nd.array(_rand(4, 10))
+    w = nd.array(_rand(6, 10))
+    b = nd.array(_rand(6))
+    out = nd.FullyConnected(x, w, b, num_hidden=6)
+    expect = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5, atol=1e-5)
+    out2 = nd.FullyConnected(x, w, num_hidden=6, no_bias=True)
+    np.testing.assert_allclose(out2.asnumpy(), x.asnumpy() @ w.asnumpy().T,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_convolution():
+    x = nd.array(_rand(2, 3, 8, 8))
+    w = nd.array(_rand(4, 3, 3, 3))
+    b = nd.array(_rand(4))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    out_pad = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), stride=(2, 2))
+    assert out_pad.shape == (2, 4, 4, 4)
+    # spot-check one output element against explicit correlation
+    xn, wn, bn = x.asnumpy(), w.asnumpy(), b.asnumpy()
+    o00 = (xn[0, :, 0:3, 0:3] * wn[1]).sum() + bn[1]
+    np.testing.assert_allclose(out.asnumpy()[0, 1, 0, 0], o00, rtol=1e-4)
+
+
+def test_deconvolution():
+    x = nd.array(_rand(1, 3, 5, 5))
+    w = nd.array(_rand(3, 4, 3, 3))  # (C_in, C_out, kh, kw)
+    out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=4, no_bias=True,
+                           stride=(2, 2))
+    assert out.shape == (1, 4, 11, 11)
+    out2 = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=4, no_bias=True,
+                            pad=(1, 1))
+    assert out2.shape == (1, 4, 5, 5)
+
+
+def test_pooling():
+    x = nd.array(_rand(2, 3, 8, 8))
+    out = nd.Pooling(x, kernel=(2, 2), pool_type="max", stride=(2, 2))
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(
+        out.asnumpy()[0, 0, 0, 0], x.asnumpy()[0, 0, 0:2, 0:2].max(), rtol=1e-6)
+    avg = nd.Pooling(x, kernel=(2, 2), pool_type="avg", stride=(2, 2))
+    np.testing.assert_allclose(
+        avg.asnumpy()[0, 0, 0, 0], x.asnumpy()[0, 0, 0:2, 0:2].mean(), rtol=1e-5)
+    gp = nd.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1))
+    assert gp.shape == (2, 3, 1, 1)
+    np.testing.assert_allclose(gp.asnumpy()[:, :, 0, 0],
+                               x.asnumpy().mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_batchnorm():
+    x = nd.array(_rand(4, 3, 5, 5))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mmean, mvar = nd.zeros((3,)), nd.ones((3,))
+    with mx.autograd.train_mode():
+        out = nd.BatchNorm(x, gamma, beta, mmean, mvar, fix_gamma=False,
+                           momentum=0.9)
+    xn = x.asnumpy()
+    mean = xn.mean(axis=(0, 2, 3))
+    var = xn.var(axis=(0, 2, 3))
+    expect = (xn - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-3)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-3, atol=1e-3)
+    # moving stats were updated in-place (aux semantics)
+    np.testing.assert_allclose(mmean.asnumpy(), 0.1 * mean, rtol=1e-3, atol=1e-4)
+    # eval mode uses moving stats
+    out_eval = nd.BatchNorm(x, gamma, beta, nd.zeros((3,)), nd.ones((3,)),
+                            fix_gamma=False)
+    np.testing.assert_allclose(out_eval.asnumpy(), xn / np.sqrt(1 + 1e-3),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_activation_layers():
+    x = nd.array(_rand(3, 4))
+    xn = x.asnumpy()
+    np.testing.assert_allclose(nd.Activation(x, act_type="relu").asnumpy(),
+                               np.maximum(xn, 0), rtol=1e-6)
+    np.testing.assert_allclose(nd.LeakyReLU(x, act_type="leaky", slope=0.1).asnumpy(),
+                               np.where(xn > 0, xn, 0.1 * xn), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.LeakyReLU(x, act_type="elu", slope=1.0).asnumpy(),
+        np.where(xn > 0, xn, np.expm1(xn)), rtol=1e-5)
+
+
+def test_softmax_ops():
+    x = nd.array(_rand(4, 10))
+    sm = nd.softmax(x).asnumpy()
+    np.testing.assert_allclose(sm.sum(axis=1), np.ones(4), rtol=1e-5)
+    lsm = nd.log_softmax(x).asnumpy()
+    np.testing.assert_allclose(np.exp(lsm), sm, rtol=1e-5)
+    label = nd.array(np.array([1, 3, 5, 7], dtype=np.float32))
+    out = nd.SoftmaxOutput(x, label)
+    np.testing.assert_allclose(out.asnumpy(), sm, rtol=1e-5)
+
+
+def test_shape_ops():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert nd.Reshape(x, shape=(6, 4)).shape == (6, 4)
+    assert nd.Reshape(x, shape=(0, -1)).shape == (2, 12)
+    assert nd.Reshape(x, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.Reshape(x, shape=(-3, 4)).shape == (6, 4)
+    assert nd.Flatten(x).shape == (2, 12)
+    assert nd.transpose(x).shape == (4, 3, 2)
+    assert nd.transpose(x, axes=(1, 0, 2)).shape == (3, 2, 4)
+    assert nd.expand_dims(x, axis=1).shape == (2, 1, 3, 4)
+    assert nd.slice_axis(x, axis=1, begin=1, end=3).shape == (2, 2, 4)
+    np.testing.assert_array_equal(
+        nd.slice(x, begin=(0, 1, 0), end=(1, 3, 2)).asnumpy(),
+        x.asnumpy()[0:1, 1:3, 0:2])
+    assert nd.repeat(x, repeats=2, axis=0).shape == (4, 3, 4)
+    assert nd.tile(x, reps=(2, 1, 1)).shape == (4, 3, 4)
+    assert nd.reverse(x, axis=(0,)).asnumpy()[0, 0, 0] == 12
+    assert nd.SwapAxis(x, dim1=0, dim2=2).shape == (4, 3, 2)
+
+
+def test_concat_stack_split_ops():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    assert nd.Concat(a, b, dim=0).shape == (4, 3)
+    assert nd.Concat(a, b, dim=1).shape == (2, 6)
+    assert nd.stack(a, b, axis=0).shape == (2, 2, 3)
+    parts = nd.SliceChannel(nd.ones((2, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    sq = nd.SliceChannel(nd.ones((2, 2, 3)), num_outputs=2, axis=1,
+                         squeeze_axis=True)
+    assert sq[0].shape == (2, 3)
+
+
+def test_embedding_take_onehot():
+    weight = nd.array(_rand(10, 4))
+    idx = nd.array(np.array([1, 3, 5], dtype=np.float32))
+    out = nd.Embedding(idx, weight, input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), weight.asnumpy()[[1, 3, 5]], rtol=1e-6)
+    t = nd.take(weight, idx)
+    np.testing.assert_allclose(t.asnumpy(), weight.asnumpy()[[1, 3, 5]], rtol=1e-6)
+    oh = nd.one_hot(idx, depth=10)
+    assert oh.shape == (3, 10)
+    assert oh.asnumpy()[0, 1] == 1 and oh.asnumpy()[0, 0] == 0
+
+
+def test_where():
+    cond = nd.array(np.array([1.0, 0.0, 1.0]))
+    x = nd.array(np.array([1.0, 2.0, 3.0]))
+    y = nd.array(np.array([10.0, 20.0, 30.0]))
+    np.testing.assert_array_equal(nd.where(cond, x, y).asnumpy(), [1, 20, 3])
+
+
+def test_ordering():
+    x = nd.array(np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]]))
+    np.testing.assert_array_equal(nd.sort(x, axis=1).asnumpy(),
+                                  [[1, 2, 3], [0, 4, 5]])
+    np.testing.assert_array_equal(nd.argsort(x, axis=1).asnumpy(),
+                                  [[1, 2, 0], [0, 2, 1]])
+    np.testing.assert_array_equal(nd.argmax(x, axis=1).asnumpy(), [0, 1])
+    topk = nd.topk(x, axis=1, k=2)
+    np.testing.assert_array_equal(topk.asnumpy(), [[0, 2], [1, 2]])
+    both = nd.topk(x, axis=1, k=1, ret_typ="both")
+    np.testing.assert_array_equal(both[0].asnumpy(), [[3], [5]])
+
+
+def test_reductions():
+    x = nd.array(_rand(2, 3, 4))
+    xn = x.asnumpy()
+    np.testing.assert_allclose(nd.sum(x, axis=(1, 2)).asnumpy(),
+                               xn.sum(axis=(1, 2)), rtol=1e-5)
+    np.testing.assert_allclose(nd.mean(x, axis=1, keepdims=True).asnumpy(),
+                               xn.mean(axis=1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(nd.max(x, axis=(0,)).asnumpy(), xn.max(axis=0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(nd.sum(x, axis=(0,), exclude=True).asnumpy(),
+                               xn.sum(axis=(1, 2)), rtol=1e-5)
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    u = nd.uniform(low=0, high=1, shape=(1000,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    assert abs(u.asnumpy().mean() - 0.5) < 0.05
+    n = nd.normal(loc=2.0, scale=0.5, shape=(2000,))
+    assert abs(n.asnumpy().mean() - 2.0) < 0.1
+    mx.random.seed(42)
+    u2 = nd.uniform(low=0, high=1, shape=(1000,))
+    np.testing.assert_allclose(u.asnumpy(), u2.asnumpy())  # reproducible
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    out_eval = nd.Dropout(x, p=0.5)
+    np.testing.assert_allclose(out_eval.asnumpy(), x.asnumpy())  # identity in eval
+    with mx.autograd.train_mode():
+        out_train = nd.Dropout(x, p=0.5)
+    frac_zero = (out_train.asnumpy() == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+
+
+def test_optimizer_update_ops():
+    w = nd.array(_rand(5, 5))
+    g = nd.array(_rand(5, 5))
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.0)
+    np.testing.assert_allclose(out.asnumpy(), w.asnumpy() - 0.1 * g.asnumpy(),
+                               rtol=1e-5)
+    mom = nd.zeros((5, 5))
+    new_w, new_mom = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(new_mom.asnumpy(), -0.1 * g.asnumpy(), rtol=1e-5)
+    mean, var = nd.zeros((5, 5)), nd.zeros((5, 5))
+    new_w, new_mean, new_var = nd.adam_update(w, g, mean, var, lr=0.01)
+    assert new_w.shape == (5, 5)
+
+
+def test_regression_outputs():
+    x = nd.array(_rand(4, 3))
+    label = nd.array(_rand(4, 3))
+    np.testing.assert_allclose(nd.LinearRegressionOutput(x, label).asnumpy(),
+                               x.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(nd.LogisticRegressionOutput(x, label).asnumpy(),
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-5)
+
+
+def test_pad():
+    x = nd.array(_rand(1, 1, 3, 3))
+    out = nd.Pad(x, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                 constant_value=0)
+    assert out.shape == (1, 1, 5, 5)
+    assert out.asnumpy()[0, 0, 0, 0] == 0
+
+
+def test_sequence_ops():
+    # (T, N, C) = (4, 2, 3)
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(4, 2, 3))
+    lengths = nd.array(np.array([2.0, 4.0]))
+    last = nd.SequenceLast(x, lengths, use_sequence_length=True)
+    np.testing.assert_array_equal(last.asnumpy()[0], x.asnumpy()[1, 0])
+    np.testing.assert_array_equal(last.asnumpy()[1], x.asnumpy()[3, 1])
+    masked = nd.SequenceMask(x, lengths, use_sequence_length=True, value=-1)
+    assert (masked.asnumpy()[2:, 0] == -1).all()
+    assert (masked.asnumpy()[:, 1] == x.asnumpy()[:, 1]).all()
+    rev = nd.SequenceReverse(x, lengths, use_sequence_length=True)
+    np.testing.assert_array_equal(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])
+    np.testing.assert_array_equal(rev.asnumpy()[0, 1], x.asnumpy()[3, 1])
+
+
+def test_clip_and_misc():
+    x = nd.array(np.array([-2.0, -0.5, 0.5, 2.0]))
+    np.testing.assert_allclose(nd.clip(x, a_min=-1, a_max=1).asnumpy(),
+                               [-1, -0.5, 0.5, 1])
+    np.testing.assert_array_equal(nd.sign(x).asnumpy(), [-1, -1, 1, 1])
+    np.testing.assert_allclose(nd.smooth_l1(x, scalar=1.0).asnumpy(),
+                               np.where(np.abs(x.asnumpy()) < 1,
+                                        0.5 * x.asnumpy() ** 2,
+                                        np.abs(x.asnumpy()) - 0.5), rtol=1e-6)
+
+
+def test_upsampling():
+    x = nd.array(_rand(1, 2, 3, 3))
+    out = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert out.shape == (1, 2, 6, 6)
+    np.testing.assert_allclose(out.asnumpy()[0, 0, 0, 0], x.asnumpy()[0, 0, 0, 0])
