@@ -14,6 +14,11 @@ from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from . import autograd  # noqa: F401
 from . import random  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from . import executor  # noqa: F401
+from .executor import Executor  # noqa: F401
+from .symbol import AttrScope, Symbol  # noqa: F401
 from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, current_context, gpu, num_gpus, num_tpus, tpu  # noqa: F401
 from .ndarray import NDArray  # noqa: F401

@@ -1,0 +1,236 @@
+"""Executor: a bound, XLA-compiled symbol graph.
+
+Reference analogue: include/mxnet/executor.h + src/executor/graph_executor.cc
+(Bind/SimpleBind/Forward/Backward). The reference compiles a Symbol into a
+memory-planned, device-placed sequence of engine ops (SURVEY.md §3.2); here
+the whole graph is traced once into a jax computation and jit-compiled —
+XLA does gradient construction (vjp), buffer assignment (PlanMemory), fusion
+(bulk exec) and scheduling. Forward and fused forward+backward are separate
+compiled programs; the fused path is what Module uses per training step.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd, random as _random
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray.ndarray import _as_jax
+
+__all__ = ["Executor", "build_graph_eval"]
+
+
+def build_graph_eval(symbol):
+    """Build eval_fn(arg_vals: dict, aux_vals: dict, rng, is_train)
+    -> (outputs: list, aux_updates: dict). Pure and jax-traceable."""
+    nodes = symbol._topo_nodes()
+    aux_ids = symbol._aux_node_ids()
+    # deterministic per-random-node key folding
+    random_nodes = [n for n in nodes
+                    if n.op is not None and n.op.needs_rng]
+    rng_index = {id(n): i for i, n in enumerate(random_nodes)}
+    out_entries = list(symbol._outputs)
+
+    def eval_fn(arg_vals: Dict, aux_vals: Dict, rng, is_train: bool):
+        values = {}
+        aux_updates = {}
+        for node in nodes:
+            if node.is_variable:
+                if id(node) in aux_ids:
+                    values[(id(node), 0)] = aux_vals[node.name]
+                else:
+                    values[(id(node), 0)] = arg_vals[node.name]
+                continue
+            ins = [values[(id(p), i)] for p, i in node.inputs]
+            call_attrs = dict(node.attrs)
+            if node.op.needs_is_train:
+                call_attrs["_is_train"] = is_train
+            if node.op.key_var_num_args and not call_attrs.get(node.op.key_var_num_args):
+                call_attrs[node.op.key_var_num_args] = len(ins)
+            if node.op.needs_rng:
+                key = jax.random.fold_in(rng, rng_index[id(node)])
+                out = node.op.fn(key, *ins, **call_attrs)
+            else:
+                out = node.op.fn(*ins, **call_attrs)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for i, o in enumerate(out):
+                values[(id(node), i)] = o
+            if is_train and node.op.aux_update:
+                for out_idx, in_idx in node.op.aux_update.items():
+                    if in_idx < len(node.inputs):
+                        p, _ = node.inputs[in_idx]
+                        if p.is_variable and id(p) in aux_ids:
+                            aux_updates[p.name] = out[out_idx]
+        outputs = [values[(id(n), i)] for n, i in out_entries]
+        return outputs, aux_updates
+
+    return eval_fn
+
+
+class Executor:
+    """A bound executor over one symbol (reference: graph_executor.h:57-66)."""
+
+    def __init__(self, symbol, ctx, args: Dict[str, NDArray],
+                 grads: Dict[str, NDArray], grad_req: Dict[str, str],
+                 aux: Dict[str, NDArray], shared_exec: Optional["Executor"] = None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = args
+        self.grad_dict = grads
+        self.aux_dict = aux
+        self._grad_req = grad_req
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self.outputs: List[NDArray] = []
+        self._diff_args = [n for n in self._arg_names
+                          if grad_req.get(n, "null") != "null"]
+        # share compiled programs across executors of the same graph
+        # (reference: shared_exec memory-pool reuse for bucketing,
+        # graph_executor.cc:879-881 — here we share the jit cache instead)
+        if shared_exec is not None and shared_exec._symbol is symbol:
+            self._fwd = shared_exec._fwd
+            self._fwd_bwd = shared_exec._fwd_bwd
+        else:
+            eval_fn = build_graph_eval(symbol)
+
+            def fwd(arg_vals, aux_vals, rng, is_train):
+                outs, aux_up = eval_fn(arg_vals, aux_vals, rng, is_train)
+                return outs, aux_up
+
+            def fwd_bwd(arg_vals, aux_vals, rng, head_grads):
+                diff = {n: arg_vals[n] for n in self._diff_args}
+
+                def f(diff_args):
+                    merged = dict(arg_vals)
+                    merged.update(diff_args)
+                    outs, aux_up = eval_fn(merged, aux_vals, rng, True)
+                    return outs, aux_up
+
+                (outs, aux_up), vjp_fn = jax.vjp(f, diff)
+                cts = [hg if hg is not None else jnp.ones_like(o)
+                       for o, hg in zip(outs, head_grads)]
+                zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+                (grads,) = vjp_fn((cts, zero_aux))
+                return outs, aux_up, grads
+
+            self._fwd = jax.jit(fwd, static_argnums=(3,))
+            self._fwd_bwd = jax.jit(fwd_bwd)
+        self._last = None  # (arg_vals, aux_vals, rng) of the last forward
+
+    # -- API ----------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    def forward(self, is_train=False, **kwargs):
+        for name, val in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError(f"unknown argument {name}")
+            self.arg_dict[name]._set_data(
+                _as_jax(val, dtype=self.arg_dict[name].dtype))
+        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
+        rng = _random.next_key()
+        outs, aux_up = self._fwd(arg_vals, aux_vals, rng, bool(is_train))
+        if is_train:
+            for name, val in aux_up.items():
+                self.aux_dict[name]._set_data(val)
+        self.outputs = [NDArray(o) for o in outs]
+        self._last = (arg_vals, aux_vals, rng)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Gradient pass. Recomputes forward inside the compiled vjp program
+        (XLA CSEs shared subexpressions); Module's fused step avoids the
+        double work by calling forward_backward."""
+        if self._last is None:
+            raise MXNetError("backward called before forward")
+        self._run_fwd_bwd(*self._last, out_grads)
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        for name, val in kwargs.items():
+            self.arg_dict[name]._set_data(
+                _as_jax(val, dtype=self.arg_dict[name].dtype))
+        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
+        rng = _random.next_key()
+        self._run_fwd_bwd(arg_vals, aux_vals, rng, out_grads)
+        return self.outputs
+
+    def _run_fwd_bwd(self, arg_vals, aux_vals, rng, out_grads):
+        if out_grads is None:
+            head_grads = [None] * len(self._output_names)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head_grads = [g._data if g is not None else None for g in out_grads]
+        outs, aux_up, grads = self._fwd_bwd(arg_vals, aux_vals, rng,
+                                            head_grads)
+        self.outputs = [NDArray(o) for o in outs]
+        for name, val in aux_up.items():
+            self.aux_dict[name]._set_data(val)
+        for name in self._diff_args:
+            g = grads[name]
+            buf = self.grad_dict.get(name)
+            if buf is None:
+                continue
+            if self._grad_req.get(name) == "add":
+                buf._set_data(buf._data + g)
+            else:
+                buf._set_data(g)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return an executor for new input shapes. Compilation is cached by
+        XLA per shape signature (reference: GraphExecutor::Reshape)."""
+        from .ndarray import zeros as nd_zeros
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            new_args[name] = (old if tuple(old.shape) == tuple(shape)
+                              else nd_zeros(shape, dtype=str(old.dtype)))
+        new_aux = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = (old if tuple(old.shape) == tuple(shape)
+                             else nd_zeros(shape, dtype=str(old.dtype)))
+        grads = {n: nd_zeros(new_args[n].shape, dtype=str(new_args[n].dtype))
+                 for n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        self._grad_req, new_aux, shared_exec=self)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, val in (arg_params or {}).items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    _as_jax(val, dtype=self.arg_dict[name].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown argument {name}")
+        for name, val in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._set_data(
+                    _as_jax(val, dtype=self.aux_dict[name].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {name}")
+
+    def debug_str(self):
+        return self._symbol.debug_str()

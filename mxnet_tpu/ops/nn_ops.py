@@ -26,8 +26,24 @@ from .registry import register
 # ---------------------------------------------------------------------------
 
 
+def _fc_param_shapes(attrs, shapes):
+    d = shapes[0]
+    nh = int(attrs["num_hidden"])
+    in_dim = 1
+    if attrs.get("flatten", True):
+        for s in d[1:]:
+            in_dim *= s
+    else:
+        in_dim = d[-1]
+    out = [d, (nh, in_dim)]
+    if len(shapes) > 2:
+        out.append((nh,))
+    return out
+
+
 @register("FullyConnected",
           num_inputs=None, input_names=["data", "weight", "bias"],
+          param_shapes=_fc_param_shapes,
           attrs=AttrSpec(num_hidden=("int",), no_bias=("bool", False),
                          flatten=("bool", True)))
 def _fully_connected(*args, num_hidden, no_bias=False, flatten=True):
@@ -70,8 +86,26 @@ def _norm_spatial(t, n, default):
     return t if len(t) == n else (default,) * n
 
 
+def _conv_param_shapes(attrs, shapes):
+    d = shapes[0]
+    nf = int(attrs["num_filter"])
+    g = int(attrs.get("num_group", 1) or 1)
+    kernel = attrs["kernel"]
+    layout = attrs.get("layout")
+    c_axis = 1 if (layout in (None, "None") or str(layout).startswith("NC")) else len(d) - 1
+    if str(layout).startswith("NC") or layout in (None, "None"):
+        w = (nf, d[c_axis] // g) + tuple(kernel)
+    else:
+        w = (nf,) + tuple(kernel) + (d[c_axis] // g,)
+    out = [d, w]
+    if len(shapes) > 2:
+        out.append((nf,))
+    return out
+
+
 @register("Convolution",
           num_inputs=None, input_names=["data", "weight", "bias"],
+          param_shapes=_conv_param_shapes,
           attrs=_CONV_SPEC)
 def _convolution(*args, kernel, stride=(), dilate=(), pad=(), num_filter=0,
                  num_group=1, workspace=1024, no_bias=False, cudnn_tune=None,
@@ -104,8 +138,19 @@ def _convolution(*args, kernel, stride=(), dilate=(), pad=(), num_filter=0,
     return out
 
 
+def _deconv_param_shapes(attrs, shapes):
+    d = shapes[0]
+    nf = int(attrs["num_filter"])
+    g = int(attrs.get("num_group", 1) or 1)
+    out = [d, (d[1], nf // g) + tuple(attrs["kernel"])]
+    if len(shapes) > 2:
+        out.append((nf,))
+    return out
+
+
 @register("Deconvolution",
           num_inputs=None, input_names=["data", "weight", "bias"],
+          param_shapes=_deconv_param_shapes,
           attrs=_CONV_SPEC)
 def _deconvolution(*args, kernel, stride=(), dilate=(), pad=(), num_filter=0,
                    num_group=1, workspace=1024, no_bias=False, cudnn_tune=None,
@@ -221,11 +266,20 @@ def _bn_nout(attrs):
     return 3 if attrs.get("output_mean_var") in (True, "True", "1") else 1
 
 
+def _bn_param_shapes(attrs, shapes):
+    d = shapes[0]
+    axis = int(attrs.get("axis", 1) or 1) % len(d)
+    c = (d[axis],)
+    return [d, c, c, c, c]
+
+
 @register("BatchNorm",
           num_inputs=5,
           input_names=["data", "gamma", "beta", "moving_mean", "moving_var"],
           num_outputs=_bn_nout,
           needs_is_train=True,
+          aux_inputs=(3, 4),
+          param_shapes=_bn_param_shapes,
           aux_update={1: 3, 2: 4},  # written back into moving_mean/var
           attrs=AttrSpec(eps=("float", 1e-3), momentum=("float", 0.9),
                          fix_gamma=("bool", True),
@@ -262,6 +316,8 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 
 @register("InstanceNorm",
           num_inputs=3, input_names=["data", "gamma", "beta"],
+          param_shapes=lambda attrs, shapes: [shapes[0], (shapes[0][1],),
+                                              (shapes[0][1],)],
           attrs=AttrSpec(eps=("float", 1e-3)))
 def _instance_norm(data, gamma, beta, eps=1e-3):
     axes = tuple(range(2, data.ndim))
@@ -305,8 +361,15 @@ def _activation(data, act_type):
     raise MXNetError(f"unknown act_type {act_type}")
 
 
+def _lrelu_param_shapes(attrs, shapes):
+    if len(shapes) == 1:
+        return list(shapes)
+    return [shapes[0], (shapes[0][1],)]
+
+
 @register("LeakyReLU",
           num_inputs=None, input_names=["data", "gamma"],
+          param_shapes=_lrelu_param_shapes,
           needs_rng=True, needs_is_train=True,
           attrs=AttrSpec(act_type=("str", "leaky"), slope=("float", 0.25),
                          lower_bound=("float", 0.125),
@@ -428,7 +491,19 @@ def _softmax_output_bwd(grad_scale, ignore_label, multi_output, use_ignore,
 _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
 
 
+def _softmax_out_label_shape(attrs, shapes):
+    d = shapes[0]
+    if attrs.get("multi_output"):
+        lab = (d[0],) + tuple(d[2:])
+    elif attrs.get("preserve_shape"):
+        lab = tuple(d[:-1])
+    else:
+        lab = (d[0],)
+    return [d, lab]
+
+
 @register("SoftmaxOutput", aliases=["Softmax"],
+          param_shapes=_softmax_out_label_shape,
           num_inputs=2, input_names=["data", "label"],
           attrs=AttrSpec(grad_scale=("float", 1.0), ignore_label=("float", -1.0),
                          multi_output=("bool", False), use_ignore=("bool", False),
@@ -460,6 +535,7 @@ def _make_regression_output(name, fwd, grad):
     core.defvjp(core_fwd, core_bwd)
 
     @register(name, num_inputs=2, input_names=["data", "label"],
+              param_shapes=lambda attrs, shapes: [shapes[0], shapes[0]],
               attrs=AttrSpec(grad_scale=("float", 1.0)))
     def op(data, label, grad_scale=1.0):
         return core(data, label, grad_scale)
@@ -475,7 +551,8 @@ _make_regression_output("LogisticRegressionOutput", jax.nn.sigmoid,
                         lambda o, l: o - l)
 
 
-@register("softmax_cross_entropy", num_inputs=2, input_names=["data", "label"])
+@register("softmax_cross_entropy", num_inputs=2, input_names=["data", "label"],
+          param_shapes=lambda attrs, shapes: [shapes[0], (shapes[0][0],)])
 def _softmax_cross_entropy(data, label):
     logp = jax.nn.log_softmax(data, axis=-1)
     picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
@@ -508,6 +585,7 @@ _svm_core.defvjp(_svm_fwd, _svm_bwd)
 
 
 @register("SVMOutput", num_inputs=2, input_names=["data", "label"],
+          param_shapes=lambda attrs, shapes: [shapes[0], (shapes[0][0],)],
           attrs=AttrSpec(margin=("float", 1.0),
                          regularization_coefficient=("float", 1.0),
                          use_linear=("bool", False)))

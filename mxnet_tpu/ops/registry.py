@@ -49,6 +49,8 @@ class OpDef:
         key_var_num_args: Optional[str] = None,
         aux_update: Optional[Dict[int, int]] = None,
         grad_fn: Optional[Callable] = None,
+        aux_inputs: Sequence[int] = (),
+        param_shapes: Optional[Callable] = None,
     ):
         self.name = name
         self.fn = fn
@@ -67,6 +69,14 @@ class OpDef:
         # (reference: auxiliary states, e.g. BatchNorm moving_mean/var)
         self.aux_update = aux_update or {}
         self.grad_fn = grad_fn
+        # input indices that are auxiliary states, not gradient-bearing args
+        # (reference: OperatorProperty::ListAuxiliaryStates)
+        self.aux_inputs = tuple(aux_inputs)
+        # param_shapes(attrs, input_shapes) -> full input-shape list with
+        # unknown parameter shapes filled in from the data shape + attrs;
+        # the simple_bind-side half of the reference's two-way InferShape
+        # (src/executor/infer_graph_attr_pass.cc)
+        self.param_shapes = param_shapes
 
     def num_outputs(self, attrs) -> int:
         if callable(self._num_outputs):

@@ -190,6 +190,8 @@ def _crop(*args, num_args=1, offset=(0, 0), h_w=(0, 0), center_crop=False):
 
 @register("Embedding",
           num_inputs=2, input_names=["data", "weight"],
+          param_shapes=lambda attrs, shapes: [
+              shapes[0], (int(attrs["input_dim"]), int(attrs["output_dim"]))],
           attrs=AttrSpec(input_dim=("int",), output_dim=("int",),
                          dtype=("str", "float32")))
 def _embedding(data, weight, input_dim, output_dim, dtype="float32"):

@@ -1,0 +1,73 @@
+"""The ``sym`` namespace: Symbol plus op constructors generated from the
+op table (reference: python/mxnet/symbol/op.py import-time codegen)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..base import MXNetError
+from ..ops.registry import OP_TABLE, OpDef
+from .symbol import (  # noqa: F401
+    AttrScope,
+    Group,
+    NameManager,
+    Symbol,
+    SymbolNode,
+    Variable,
+    load,
+    load_json,
+    symbol_invoke,
+    var,
+)
+
+
+def _make_sym_func(opdef: OpDef, name: str):
+    def sym_func(*args, **kwargs):
+        sym_name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        inputs = list(args)
+        if opdef.input_names:
+            kw_inputs = {}
+            for i, n in enumerate(opdef.input_names):
+                if n in kwargs and isinstance(kwargs[n], Symbol):
+                    kw_inputs[i] = kwargs.pop(n)
+            if kw_inputs:
+                hi = max(kw_inputs)
+                slots = inputs + [None] * max(0, hi + 1 - len(inputs))
+                for i, v in kw_inputs.items():
+                    if slots[i] is not None:
+                        raise MXNetError(
+                            f"input {opdef.input_names[i]} of {name} given "
+                            "both positionally and by keyword")
+                    slots[i] = v
+                inputs = [x for x in slots if x is not None]
+        if any(not isinstance(x, Symbol) for x in inputs):
+            raise MXNetError(f"{name}: symbolic inputs must be Symbols")
+        return symbol_invoke(opdef, inputs, kwargs, sym_name)
+
+    sym_func.__name__ = name
+    sym_func.__doc__ = (opdef.fn.__doc__ or "") + (
+        f"\n\nParameters: {sorted(opdef.attr_spec.fields)}"
+        f"\nInputs: {opdef.input_names or ['data']}"
+    )
+    return sym_func
+
+
+_mod = _sys.modules[__name__]
+for _name, _opdef in OP_TABLE.items():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_sym_func(_opdef, _name))
+
+del _mod, _name, _opdef
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _sys.modules[__name__]._zeros(shape=shape, dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _sys.modules[__name__]._ones(shape=shape, dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
+    return _sys.modules[__name__]._arange(start=start, stop=stop, step=step,
+                                          repeat=repeat, name=name, dtype=dtype)

@@ -1,0 +1,150 @@
+"""Autograd tests (reference model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array(np.array([1.0, 2.0, 3.0]))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_chain_and_shared_input():
+    x = nd.array(np.array([2.0]))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x  # dy/dx = 2x + 1
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [5.0], rtol=1e-5)
+
+
+def test_multi_variable():
+    a = nd.array(np.array([1.0, 2.0]))
+    b = nd.array(np.array([3.0, 4.0]))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = (a * b).sum()
+    y.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy())
+    np.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_head_grad():
+    x = nd.array(np.array([1.0, 2.0]))
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array(np.array([10.0, 100.0])))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_modes():
+    x = nd.array(np.array([1.0]))
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+    z = nd.array(np.array([1.0]))
+    z.attach_grad(grad_req="null")
+    with autograd.record():
+        y = 2 * z
+    y.backward()
+    np.testing.assert_allclose(z.grad.asnumpy(), [0.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array(np.array([3.0]))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [9.0])  # only d(det*x)/dx = y
+
+
+def test_stop_gradient_op():
+    x = nd.array(np.array([3.0]))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * x) + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_recording_scopes():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        assert autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert autograd.is_recording()
+        assert not autograd.is_training()
+
+
+def test_through_nn_ops():
+    x = nd.array(np.random.randn(4, 10).astype(np.float32))
+    w = nd.array(np.random.randn(3, 10).astype(np.float32) * 0.1)
+    b = nd.zeros((3,))
+    for v in (x, w, b):
+        v.attach_grad()
+    with autograd.record():
+        out = nd.FullyConnected(x, w, b, num_hidden=3)
+        loss = (out * out).mean()
+    loss.backward()
+    # numerical check on w
+    eps = 1e-3
+    wn = w.asnumpy().copy()
+    def f(wv):
+        o = x.asnumpy() @ wv.T + b.asnumpy()
+        return (o * o).mean()
+    num_grad = np.zeros_like(wn)
+    for i in range(3):
+        for j in range(3):  # subsample
+            wp = wn.copy(); wp[i, j] += eps
+            wm = wn.copy(); wm[i, j] -= eps
+            num_grad[i, j] = (f(wp) - f(wm)) / (2 * eps)
+    np.testing.assert_allclose(w.grad.asnumpy()[:3, :3], num_grad[:3, :3],
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_softmax_output_grad():
+    x = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array(np.array([0, 1, 2, 3], dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    sm = out.asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    np.testing.assert_allclose(x.grad.asnumpy(), sm - onehot, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_grad_function():
+    x = nd.array(np.array([2.0]))
+    with autograd.record():
+        y = x * x * x
+    g = autograd.grad(y, x)
+    np.testing.assert_allclose(g.asnumpy(), [12.0], rtol=1e-5)
+
+
+def test_mark_variables():
+    x = nd.array(np.array([1.0, 2.0]))
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(g.asnumpy(), [2.0, 4.0])

@@ -1,0 +1,170 @@
+"""Symbol & Executor tests (reference: tests/python/unittest/test_symbol.py,
+test_executor.py, test_infer_shape.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=10)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.list_auxiliary_states() == []
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    args = net.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (16, 100)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert out_shapes[0] == (32, 10)
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, name="conv1", kernel=(3, 3), num_filter=8,
+                           pad=(1, 1))
+    bn = sym.BatchNorm(conv, name="bn1")
+    pool = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(2, 3, 8, 8))
+    args = pool.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["conv1_bias"] == (8,)
+    assert d["bn1_gamma"] == (8,)
+    assert out_shapes[0] == (2, 8, 4, 4)
+    # BatchNorm moving stats are auxiliary, not arguments
+    assert pool.list_auxiliary_states() == ["bn1_moving_mean", "bn1_moving_var"]
+    assert aux_shapes == [(8,), (8,)]
+
+
+def test_symbol_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / b
+    ex = c.bind(args={"a": nd.array([4.0]), "b": nd.array([2.0])},
+                grad_req="null")
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [(4 + 2) * 2 - 2.0])
+
+
+def test_json_roundtrip(tmp_path):
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    fname = str(tmp_path / "net.json")
+    net.save(fname)
+    net3 = sym.load(fname)
+    _, out_shapes, _ = net3.infer_shape(data=(4, 20))
+    assert out_shapes[0] == (4, 10)
+
+
+def test_simple_bind_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(8, 20))
+    # init params
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = nd.array(np.random.uniform(-0.1, 0.1, arr.shape).astype(np.float32))
+    data = np.random.randn(8, 20).astype(np.float32)
+    label = np.arange(8, dtype=np.float32) % 10
+    out = ex.forward(is_train=True, data=data, softmax_label=label)[0]
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(8), rtol=1e-4)
+    ex.backward()
+    g = ex.grad_dict["fc2_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+    # grad equals softmax - onehot propagated; check data grad exists
+    assert np.abs(ex.grad_dict["fc1_weight"].asnumpy()).sum() > 0
+
+
+def test_executor_fused_and_grad_add():
+    x = sym.Variable("x")
+    y = (x * x)
+    ex = y.bind(args={"x": nd.array([3.0])}, grad_req="add")
+    ex.forward_backward()
+    ex.forward_backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [12.0])
+
+
+def test_group_and_internals():
+    a = sym.Variable("a")
+    b = a * 2
+    c = b + 1
+    g = sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    internals = c.get_internals()
+    assert any("a" == n for n in internals.list_outputs())
+    ex = g.bind(args={"a": nd.array([1.0])}, grad_req="null")
+    outs = ex.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [2.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [3.0])
+
+
+def test_getitem_by_name():
+    net = _mlp()
+    out = net["softmax_output"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_multi_output_ops():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=2, axis=1)
+    assert len(parts.list_outputs()) == 2
+    ex = parts.bind(args={"data": nd.ones((2, 4))}, grad_req="null")
+    outs = ex.forward()
+    assert outs[0].shape == (2, 2) and outs[1].shape == (2, 2)
+
+
+def test_attr_scope_ctx_group():
+    with sym.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        b = a * 2
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev1"
+
+
+def test_executor_reshape():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(8, 20))
+    ex2 = ex.reshape(data=(4, 20))
+    out = ex2.forward(is_train=False, data=np.zeros((4, 20), np.float32),
+                      softmax_label=np.zeros(4, np.float32))[0]
+    assert out.shape == (4, 10)
+
+
+def test_batchnorm_aux_update_in_executor():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", fix_gamma=False, momentum=0.5)
+    ex = bn.simple_bind(ctx=mx.cpu(), data=(16, 4))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.randn(16, 4).astype(np.float32) * 2 + 1
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-3, atol=1e-4)
+    # eval mode must not touch aux
+    before = ex.aux_dict["bn_moving_mean"].asnumpy()
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_array_equal(before, ex.aux_dict["bn_moving_mean"].asnumpy())
+
+
+def test_variable_dedup_name_manager():
+    sym.NameManager.reset()
+    fc = sym.FullyConnected(sym.Variable("d"), num_hidden=2)
+    assert fc.list_arguments()[1].endswith("_weight")
