@@ -19,6 +19,18 @@ from . import symbol as sym  # noqa: F401
 from . import executor  # noqa: F401
 from .executor import Executor  # noqa: F401
 from .symbol import AttrScope, Symbol  # noqa: F401
+from . import initializer  # noqa: F401
+from . import initializer as init  # noqa: F401
+from . import io  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import metric  # noqa: F401
+from . import model  # noqa: F401
+from . import module  # noqa: F401
+from . import module as mod  # noqa: F401
+from . import callback  # noqa: F401
+from . import optimizer  # noqa: F401
+from .io import DataBatch, DataIter  # noqa: F401
 from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, current_context, gpu, num_gpus, num_tpus, tpu  # noqa: F401
 from .ndarray import NDArray  # noqa: F401

@@ -102,8 +102,10 @@ class Executor:
                 outs, aux_up = eval_fn(arg_vals, aux_vals, rng, is_train)
                 return outs, aux_up
 
-            def fwd_bwd(arg_vals, aux_vals, rng, head_grads):
-                diff = {n: arg_vals[n] for n in self._diff_args}
+            def fwd_bwd(arg_vals, aux_vals, rng, head_grads, diff_names):
+                # diff_names is static: each executor passes its own grad_req
+                # selection even when the compiled program is shared
+                diff = {n: arg_vals[n] for n in diff_names}
 
                 def f(diff_args):
                     merged = dict(arg_vals)
@@ -119,7 +121,7 @@ class Executor:
                 return outs, aux_up, grads
 
             self._fwd = jax.jit(fwd, static_argnums=(3,))
-            self._fwd_bwd = jax.jit(fwd_bwd)
+            self._fwd_bwd = jax.jit(fwd_bwd, static_argnums=(4,))
         self._last = None  # (arg_vals, aux_vals, rng) of the last forward
 
     # -- API ----------------------------------------------------------------
@@ -182,7 +184,8 @@ class Executor:
                 out_grads = [out_grads]
             head_grads = [g._data if g is not None else None for g in out_grads]
         outs, aux_up, grads = self._fwd_bwd(arg_vals, aux_vals, rng,
-                                            head_grads)
+                                            head_grads,
+                                            tuple(self._diff_args))
         self.outputs = [NDArray(o) for o in outs]
         for name, val in aux_up.items():
             self.aux_dict[name]._set_data(val)

@@ -1,0 +1,163 @@
+"""KVStore: key-value parameter synchronization.
+
+Reference: include/mxnet/kvstore.h + src/kvstore/ (KVStoreLocal with
+CommCPU/CommDevice reduce, KVStoreDist over ps-lite) and python/mxnet/
+kvstore.py. TPU-native mapping (SURVEY.md §5.8): the local/device comm layer
+becomes array addition (XLA fuses it); the distributed worker/server/ZMQ
+stack collapses into SPMD collectives over the mesh — ``dist_sync`` push+pull
+is an allreduce (jax.lax.psum) executed by the sharded training step in
+parallel/. This module keeps the full KVStore *API* so reference scripts run
+unchanged; under a single process it aggregates device lists directly, and
+under `dist_*` types it reports rank/size from jax.distributed and lets the
+mesh collectives do the actual reduction.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Union
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .optimizer import Optimizer, get_updater
+
+__all__ = ["KVStore", "create"]
+
+
+def _key(k):
+    return str(k)
+
+
+class KVStore:
+    """Single-process key-value store (reference: KVStoreLocal,
+    src/kvstore/kvstore_local.h:60-168)."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store: Dict[str, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- core API -----------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            v0 = v[0] if isinstance(v, list) else v
+            self._store[k] = v0.copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate grads into the store; runs the updater if set
+        (reference: KVStoreLocal::Push + comm reduce, comm.h:90-434)."""
+        keys, values = self._normalize(key, value)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, list):
+                vlist = [vlist]
+            agg = vlist[0]
+            if len(vlist) > 1:
+                from .ndarray import add_n
+                agg = add_n(*vlist)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if self._updater is not None:
+                self._updater(self._str_to_int(k), agg, self._store[k])
+            else:
+                # no updater: store the merged value (reference
+                # kvstore_local.h:107 ``local = merged`` — init 1, push 8,
+                # pull yields 8, not 9)
+                self._store[k]._set_data(agg._data)
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = self._normalize(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if not isinstance(olist, list):
+                olist = [olist]
+            for o in olist:
+                o._set_data(self._store[k]._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense fallback: full pull (row_sparse storage arrives with sparse/)
+        self.pull(key, out, priority)
+
+    # -- updater / optimizer -------------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer: Optimizer):
+        """reference: kvstore.py set_optimizer — pickles the optimizer to the
+        servers when distributed; locally installs an Updater."""
+        if "dist" in self.type and self.rank != 0:
+            # non-root workers rely on the sharded-step collectives
+            return
+        self._optimizer = optimizer
+        self.set_updater(get_updater(optimizer))
+
+    # -- distributed topology ------------------------------------------------
+    @property
+    def rank(self) -> int:
+        if "dist" in self.type:
+            import jax
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        if "dist" in self.type:
+            import jax
+            return jax.process_count()
+        return 1
+
+    def barrier(self):
+        if "dist" in self.type:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            return [_key(k) for k in key], list(value)
+        return [_key(key)], [value]
+
+    @staticmethod
+    def _str_to_int(k: str) -> Union[int, str]:
+        try:
+            return int(k)
+        except ValueError:
+            return k
+
+
+def create(name="local") -> KVStore:
+    """Factory (reference: KVStore::Create string dispatch,
+    src/kvstore/kvstore.cc:34-61 — 'local'/'device'/'dist_sync'/
+    'dist_device_sync'/'dist_async')."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "local_allreduce_cpu", "local_allreduce_device",
+             "dist_sync", "dist_device_sync", "dist_async", "dist")
+    if name not in valid:
+        raise MXNetError(f"unknown kvstore type {name}")
+    if "dist_async" in name:
+        raise MXNetError(
+            "dist_async has no TPU analog (SPMD collectives are synchronous); "
+            "use dist_sync (SURVEY.md §5.8)")
+    return KVStore(name)

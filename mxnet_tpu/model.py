@@ -1,0 +1,126 @@
+"""Checkpointing helpers + kvstore wiring shared by the trainer APIs.
+
+Reference: python/mxnet/model.py — save_checkpoint:340 / load_checkpoint:370
+(prefix-symbol.json + prefix-%04d.params), _create_kvstore:57 (picks
+update_on_kvstore, disables kv for single device), _initialize_kvstore:96,
+_update_params_on_kvstore:105.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+from . import ndarray as nd
+from . import symbol as sym
+from .base import MXNetError
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+from .callback import BatchEndParam  # noqa: F401  (reference keeps it here)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write prefix-symbol.json + prefix-%04d.params (reference: model.py:340).
+
+    The params container keys use the reference's 'arg:'/'aux:' prefixes.
+    """
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix, epoch) -> Tuple:
+    """Load (symbol, arg_params, aux_params) (reference: model.py:370)."""
+    import os
+    symbol = None
+    if os.path.exists(f"{prefix}-symbol.json"):
+        symbol = sym.load(f"{prefix}-symbol.json")
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    if not os.path.exists(param_name) and os.path.exists(param_name + ".npz"):
+        param_name += ".npz"
+    save_dict = nd.load(param_name)
+    arg_params: Dict = {}
+    aux_params: Dict = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Pick (kvstore, update_on_kvstore) (reference: model.py:57-94)."""
+    from . import kvstore as kvs
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(int(_np_prod(p.shape)) for p in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, string or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= s
+    return out
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """Init kv weights from arg_params (reference: model.py:96)."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    """Push grads / pull weights (reference: model.py:105)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or (isinstance(grad_list, list)
+                                 and grad_list[0] is None):
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    """Local updater path (reference: model.py:117)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or (isinstance(grad_list, list)
+                                 and grad_list[0] is None):
+            continue
+        if not isinstance(arg_list, list):
+            arg_list, grad_list = [arg_list], [grad_list]
+        index_ = index
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, (w, g) in enumerate(zip(arg_list, grad_list)):
+            updater(index_ * num_device + k, g, w)

@@ -1,0 +1,3 @@
+"""Module trainer APIs (reference: python/mxnet/module/)."""
+from .base_module import BaseModule  # noqa: F401
+from .module import Module  # noqa: F401

@@ -9,7 +9,7 @@ from __future__ import annotations
 import sys as _sys
 
 from ..base import MXNetError
-from ..ops.registry import OP_TABLE, OpDef
+from ..ops.registry import OP_TABLE, OpDef, resolve_inputs
 from .ndarray import (  # noqa: F401
     NDArray,
     arange,
@@ -35,22 +35,7 @@ def _make_op_func(opdef: OpDef, name: str):
     def op_func(*args, **kwargs):
         out = kwargs.pop("out", None)
         kwargs.pop("name", None)
-        inputs = list(args)
-        if opdef.input_names:
-            kw_inputs = {}
-            for i, n in enumerate(opdef.input_names):
-                if n in kwargs:
-                    kw_inputs[i] = kwargs.pop(n)
-            if kw_inputs:
-                hi = max(kw_inputs)
-                slots = inputs + [None] * max(0, hi + 1 - len(inputs))
-                for i, v in kw_inputs.items():
-                    if slots[i] is not None:
-                        raise MXNetError(
-                            f"input {opdef.input_names[i]} of {name} given "
-                            "both positionally and by keyword")
-                    slots[i] = v
-                inputs = [x for x in slots if x is not None]
+        inputs = resolve_inputs(opdef, args, kwargs, name)
         res = imperative_invoke(opdef, inputs, kwargs, out=out)
         if out is not None:
             return out if not isinstance(out, (list, tuple)) else res

@@ -52,6 +52,10 @@ def _as_jax(value, dtype=None, ctx: Optional[Context] = None):
     return arr
 
 
+def _ndarray_from_numpy(npv):
+    return NDArray(jnp.asarray(npv))
+
+
 class NDArray:
     """Multi-dimensional array with MXNet semantics over immutable jax arrays."""
 
@@ -230,6 +234,11 @@ class NDArray:
 
     def __hash__(self):
         return id(self)
+
+    def __reduce__(self):
+        # pickle via numpy (used by optimizer-state checkpointing; reference:
+        # Updater.get_states pickling for kvstore servers)
+        return (_ndarray_from_numpy, (self.asnumpy(),))
 
     # -- arithmetic (dispatches through the op table so autograd tapes it) ---
     def _binop(self, other, op, scalar_op, reverse=False):

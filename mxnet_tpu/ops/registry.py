@@ -116,6 +116,37 @@ def alias(new_name: str, existing: str):
     OP_TABLE[new_name] = OP_TABLE[existing]
 
 
+def resolve_inputs(opdef: "OpDef", args, kwargs, name: str,
+                   is_input=None):
+    """Merge positional and keyword-passed op inputs into one ordered list.
+
+    Shared by the generated nd.* and sym.* wrappers (both accept inputs
+    positionally or by their declared names, reference ndarray/op.py
+    codegen). Mutates ``kwargs`` (consumed input names are popped).
+    NB: generated namespaces contain ops named 'max'/'min'/'sum' that shadow
+    builtins at module scope — use builtins explicitly here.
+    """
+    import builtins
+
+    inputs = list(args)
+    if opdef.input_names:
+        kw_inputs = {}
+        for i, n in enumerate(opdef.input_names):
+            if n in kwargs and (is_input is None or is_input(kwargs[n])):
+                kw_inputs[i] = kwargs.pop(n)
+        if kw_inputs:
+            hi = builtins.max(kw_inputs)
+            slots = inputs + [None] * builtins.max(0, hi + 1 - len(inputs))
+            for i, v in kw_inputs.items():
+                if slots[i] is not None:
+                    raise MXNetError(
+                        f"input {opdef.input_names[i]} of {name} given "
+                        "both positionally and by keyword")
+                slots[i] = v
+            inputs = [x for x in slots if x is not None]
+    return inputs
+
+
 def get_op(name: str) -> OpDef:
     if name not in OP_TABLE:
         raise MXNetError(f"Unknown operator {name}")

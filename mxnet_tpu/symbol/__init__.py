@@ -5,7 +5,7 @@ from __future__ import annotations
 import sys as _sys
 
 from ..base import MXNetError
-from ..ops.registry import OP_TABLE, OpDef
+from ..ops.registry import OP_TABLE, OpDef, resolve_inputs
 from .symbol import (  # noqa: F401
     AttrScope,
     Group,
@@ -24,22 +24,8 @@ def _make_sym_func(opdef: OpDef, name: str):
     def sym_func(*args, **kwargs):
         sym_name = kwargs.pop("name", None)
         kwargs.pop("attr", None)
-        inputs = list(args)
-        if opdef.input_names:
-            kw_inputs = {}
-            for i, n in enumerate(opdef.input_names):
-                if n in kwargs and isinstance(kwargs[n], Symbol):
-                    kw_inputs[i] = kwargs.pop(n)
-            if kw_inputs:
-                hi = max(kw_inputs)
-                slots = inputs + [None] * max(0, hi + 1 - len(inputs))
-                for i, v in kw_inputs.items():
-                    if slots[i] is not None:
-                        raise MXNetError(
-                            f"input {opdef.input_names[i]} of {name} given "
-                            "both positionally and by keyword")
-                    slots[i] = v
-                inputs = [x for x in slots if x is not None]
+        inputs = resolve_inputs(opdef, args, kwargs, name,
+                                is_input=lambda v: isinstance(v, Symbol))
         if any(not isinstance(x, Symbol) for x in inputs):
             raise MXNetError(f"{name}: symbolic inputs must be Symbols")
         return symbol_invoke(opdef, inputs, kwargs, sym_name)

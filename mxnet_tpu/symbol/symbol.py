@@ -203,6 +203,10 @@ class Symbol:
             d = dict(node.scope_attrs)
             if node.op is not None:
                 d.update(node.op.attr_spec.serialize(node.attrs))
+            else:
+                # variables keep __shape__/__lr_mult__/__wd_mult__/__init__
+                # directly in node.attrs (Variable() stores them there)
+                d.update({k: str(v) for k, v in node.attrs.items()})
             if d:
                 out[node.name] = d
         return out
@@ -468,9 +472,10 @@ class Symbol:
                 "name": node.name,
                 "inputs": [[nid[id(p)], i, 0] for p, i in node.inputs],
             }
-            attrs = {}
             if node.op is not None:
                 attrs = node.op.attr_spec.serialize(node.attrs)
+            else:
+                attrs = {k: str(v) for k, v in node.attrs.items()}
             if node.scope_attrs:
                 attrs.update(node.scope_attrs)
             if attrs:

@@ -1,0 +1,234 @@
+"""Module training tests — the end-to-end gate for the training stack
+(reference: tests/python/unittest/test_module.py + tests/python/train/test_mlp.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _make_blobs(n=400, nclass=4, dim=10, seed=0):
+    """Linearly separable synthetic classification data."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(nclass, dim) * 4
+    X = np.zeros((n, dim), np.float32)
+    y = np.zeros((n,), np.float32)
+    for i in range(n):
+        c = i % nclass
+        X[i] = centers[c] + rng.randn(dim) * 0.5
+        y[i] = c
+    return X, y
+
+
+def _mlp_sym(nclass=4):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=32)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=nclass)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_module_fit_converges():
+    X, y = _make_blobs()
+    train_iter = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    val_iter = mx.io.NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train_iter, eval_data=val_iter, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), num_epoch=5)
+    score = mod.score(val_iter, "acc")
+    assert score[0][1] > 0.95, f"accuracy too low: {score}"
+
+
+def test_module_predict_and_outputs():
+    X, y = _make_blobs(n=80)
+    train_iter = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params(mx.init.Xavier())
+    preds = mod.predict(train_iter)
+    assert preds.shape == (80, 4)
+    np.testing.assert_allclose(preds.asnumpy().sum(axis=1), np.ones(80),
+                               rtol=1e-4)
+
+
+def test_module_adam_and_momentum():
+    X, y = _make_blobs(n=200)
+    for optname, params in [("adam", {"learning_rate": 0.01}),
+                            ("sgd", {"learning_rate": 0.3, "momentum": 0.9})]:
+        train_iter = mx.io.NDArrayIter(X, y, batch_size=50, shuffle=True)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.fit(train_iter, optimizer=optname, optimizer_params=params,
+                initializer=mx.init.Xavier(), num_epoch=4)
+        score = mod.score(mx.io.NDArrayIter(X, y, batch_size=50), "acc")
+        assert score[0][1] > 0.9, f"{optname}: {score}"
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _make_blobs(n=80)
+    train_iter = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train_iter, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2}, num_epoch=2)
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True)
+    mod2.bind(data_shapes=train_iter.provide_data,
+              label_shapes=train_iter.provide_label)
+    mod2.init_params(None, *mod.get_params(), force_init=True)
+    p1 = mod.predict(train_iter).asnumpy()
+    p2 = mod2.predict(train_iter).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_module_set_get_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.One())
+    args, auxs = mod.get_params()
+    assert (args["fc1_weight"].asnumpy() == 1).all()
+    args["fc1_weight"][:] = 2.0
+    mod.set_params(args, auxs)
+    args2, _ = mod.get_params()
+    assert (args2["fc1_weight"].asnumpy() == 2).all()
+
+
+def test_module_input_grads():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[nd.ones((8, 10))],
+                            label=[nd.zeros((8,))])
+    mod.forward_backward(batch)
+    g = mod.get_input_grads()[0]
+    assert g.shape == (8, 10)
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_ndarray_iter_semantics():
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+    it2 = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+
+    it3 = mx.io.ResizeIter(mx.io.NDArrayIter(X, y, batch_size=5), 7)
+    assert len(list(it3)) == 7
+
+
+def test_prefetching_iter():
+    X = np.random.rand(20, 4).astype(np.float32)
+    y = np.zeros(20, np.float32)
+    base = mx.io.NDArrayIter(X, y, batch_size=5)
+    pf = mx.io.PrefetchingIter(base)
+    count = 0
+    for batch in pf:
+        assert batch.data[0].shape == (5, 4)
+        count += 1
+    assert count == 4
+
+
+def test_metrics():
+    acc = mx.metric.create("acc")
+    pred = nd.array(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]]))
+    label = nd.array(np.array([0.0, 1.0, 1.0]))
+    acc.update([label], [pred])
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+
+    topk = mx.metric.create("top_k_accuracy", top_k=2)
+    topk.update([label], [pred])
+    assert topk.get()[1] == 1.0
+
+    mse = mx.metric.create("mse")
+    mse.update([nd.array([1.0, 2.0])], [nd.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+
+    comp = mx.metric.create(["acc", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+    custom = mx.metric.np(lambda l, p: float((l == p.argmax(axis=1)).mean()),
+                          name="mycustom")
+    custom.update([label], [pred])
+    assert abs(custom.get()[1] - 2.0 / 3) < 1e-6
+
+
+def test_optimizers_step():
+    from mxnet_tpu.optimizer import create as create_opt
+    w0 = np.random.rand(4, 4).astype(np.float32)
+    g0 = np.random.rand(4, 4).astype(np.float32)
+    for name, kw in [("sgd", {"learning_rate": 0.1}),
+                     ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+                     ("adam", {}), ("rmsprop", {}),
+                     ("rmsprop", {"centered": True}),
+                     ("adagrad", {}), ("adadelta", {}), ("nag", {"momentum": 0.5}),
+                     ("ftrl", {})]:
+        o = create_opt(name, **kw)
+        w = nd.array(w0.copy())
+        g = nd.array(g0.copy())
+        state = o.create_state(0, w)
+        o.update(0, w, g, state)
+        assert not np.allclose(w.asnumpy(), w0), f"{name} did not update"
+        assert np.isfinite(w.asnumpy()).all(), f"{name} produced NaN/inf"
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(11) == 0.5
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1,
+                                             base_lr=1.0)
+    assert m(3) == 1.0
+    assert abs(m(7) - 0.1) < 1e-9
+    p = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert abs(p(50) - 0.5) < 1e-9
+
+
+def test_initializers():
+    arr = nd.zeros((64, 32))
+    mx.init.Xavier()(mx.init.InitDesc("fc_weight"), arr)
+    a = arr.asnumpy()
+    assert a.std() > 0
+    bound = np.sqrt(3.0 / ((64 + 32) / 2))
+    assert np.abs(a).max() <= bound + 1e-6
+
+    b = nd.ones((10,))
+    mx.init.Xavier()(mx.init.InitDesc("fc_bias"), b)
+    assert (b.asnumpy() == 0).all()
+
+    g = nd.zeros((10,))
+    mx.init.Xavier()(mx.init.InitDesc("bn_gamma"), g)
+    assert (g.asnumpy() == 1).all()
+
+
+def test_kvstore_local():
+    kv = mx.kvstore.create("local")
+    kv.init("w", nd.ones((2, 2)))
+    out = nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    assert (out.asnumpy() == 1).all()
+    # push aggregates a device list and stores the merged value (reference
+    # kvstore_local.h:107: local = merged)
+    kv.push("w", [nd.ones((2, 2)), nd.ones((2, 2))])
+    kv.pull("w", out=out)
+    assert (out.asnumpy() == 2).all()
+
+    # with updater (sgd)
+    kv2 = mx.kvstore.create("local")
+    kv2.init("3", nd.ones((2, 2)))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, rescale_grad=1.0)
+    kv2.set_optimizer(opt)
+    kv2.push("3", nd.ones((2, 2)))
+    out2 = nd.zeros((2, 2))
+    kv2.pull("3", out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), np.full((2, 2), 0.9), rtol=1e-5)
