@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+
+Baseline = 181.53 img/s, the reference's best published single-GPU
+ResNet-50 training number (P100, docs/how_to/perf.md:157-188; see
+BASELINE.md). Batch/iters overridable via BENCH_BATCH / BENCH_ITERS.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IPS = 181.53  # ResNet-50 train img/s, P100 (docs/how_to/perf.md)
+
+
+def main():
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    sym = models.get_symbol("resnet", num_layers=50, num_classes=1000,
+                            image_shape="224,224,3", dtype="bfloat16")
+    tr = SPMDTrainer(
+        sym, optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0 / batch),
+        mesh=mesh, compute_dtype="bfloat16")
+    tr.bind(data_shapes={"data": (batch, 224, 224, 3)},
+            label_shapes={"softmax_label": (batch,)})
+
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.rand(batch, 224, 224, 3).astype(np.float32),
+                       tr._in_shardings["data"])
+    y = jax.device_put(rng.randint(0, 1000, (batch,)).astype(np.float32),
+                       tr._in_shardings["softmax_label"])
+    feed = {"data": x, "softmax_label": y}
+
+    for _ in range(2):  # compile + settle
+        tr.step(feed)[0].block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        outs = tr.step(feed)
+    outs[0].block_until_ready()
+    dt = time.perf_counter() - t0
+
+    ips = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / BASELINE_IPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

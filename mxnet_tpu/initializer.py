@@ -155,13 +155,21 @@ class Xavier(Initializer):
 
     def _init_weight(self, desc, arr):
         shape = arr.shape
-        hw_scale = 1.0
         if len(shape) < 2:
             arr[:] = nd_array(_np.random.uniform(-0.07, 0.07, shape).astype("float32"))
             return
-        if len(shape) > 2:
-            hw_scale = float(_np.prod(shape[2:]))
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        layout = ""
+        if isinstance(desc, InitDesc):
+            layout = str(desc.attrs.get("__layout__", ""))
+        channel_last = layout.endswith("C") and not layout.startswith("NC")
+        if channel_last and len(shape) > 2:
+            # OHWI conv weight: fan_in = I*spatial, fan_out = O*spatial
+            spatial = float(_np.prod(shape[1:-1]))
+            fan_in, fan_out = shape[-1] * spatial, shape[0] * spatial
+        else:
+            # OIHW (reference layout) / plain (out, in) matrices
+            hw_scale = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
+            fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
         if self.factor_type == "avg":
             factor = (fan_in + fan_out) / 2.0
         elif self.factor_type == "in":

@@ -50,9 +50,13 @@ def _fully_connected(*args, num_hidden, no_bias=False, flatten=True):
     data, weight = args[0], args[1]
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
+    # compute in the activation dtype (mixed precision: bf16 activations
+    # keep the matmul on the MXU even when master weights are fp32)
+    if weight.dtype != data.dtype:
+        weight = weight.astype(data.dtype)
     out = jnp.dot(data, weight.T)
     if not no_bias:
-        out = out + args[2]
+        out = out + args[2].astype(data.dtype)
     return out
 
 
@@ -115,6 +119,8 @@ def _convolution(*args, kernel, stride=(), dilate=(), pad=(), num_filter=0,
     stride = _norm_spatial(stride, nsp, 1)
     dilate = _norm_spatial(dilate, nsp, 1)
     pad = _norm_spatial(pad, nsp, 0)
+    if weight.dtype != data.dtype:  # mixed precision: compute in act dtype
+        weight = weight.astype(data.dtype)
     lhs_spec, rhs_spec, out_spec = _conv_dims(nsp, layout)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     (lhs_spec, rhs_spec, out_spec))
@@ -125,12 +131,14 @@ def _convolution(*args, kernel, stride=(), dilate=(), pad=(), num_filter=0,
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+        # no preferred_element_type: the TPU MXU accumulates bf16 convs in
+        # fp32 natively, and an explicit fp32 output breaks the conv
+        # transpose rule under vjp (bf16 weight vs fp32 cotangent)
     )
     if out.dtype != data.dtype:
         out = out.astype(data.dtype)
     if not no_bias:
-        bias = args[2]
+        bias = args[2].astype(out.dtype)
         c_axis = out_spec.index("C")
         bshape = [1] * out.ndim
         bshape[c_axis] = bias.shape[0]
@@ -199,29 +207,40 @@ def _deconvolution(*args, kernel, stride=(), dilate=(), pad=(), num_filter=0,
                          global_pool=("bool", False),
                          pooling_convention=("str", "valid"),
                          stride=("tuple", ()), pad=("tuple", ()),
-                         cudnn_off=("bool", False)))
+                         cudnn_off=("bool", False), layout=("str", None)))
 def _pooling(data, kernel=(), pool_type="max", global_pool=False,
-             pooling_convention="valid", stride=(), pad=(), cudnn_off=False):
+             pooling_convention="valid", stride=(), pad=(), cudnn_off=False,
+             layout=None):
     nsp = data.ndim - 2
+    # channel-last layouts (NWC/NHWC/NDHWC) keep spatial dims at 1..ndim-2 —
+    # the TPU-native layout; default (None/NC*) matches the reference's NCHW
+    channel_last = layout is not None and str(layout).endswith("C") \
+        and not str(layout).startswith("NC")
+    sp0 = 1 if channel_last else 2
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = data.shape[sp0:sp0 + nsp]
         stride = (1,) * nsp
         pad = (0,) * nsp
     stride = _norm_spatial(stride, nsp, 1)
     pad = _norm_spatial(pad, nsp, 0)
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    padding = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if channel_last:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        padding = [(0, 0)] + [(p, p) for p in pad] + [(0, 0)]
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        padding = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     if pooling_convention == "full" and not global_pool:
         # reference 'full' uses ceil for the output size: pad extra on the
         # high side so VALID reduce_window produces the ceil size
         import math
         for i in range(nsp):
-            size = data.shape[2 + i] + 2 * pad[i]
+            size = data.shape[sp0 + i] + 2 * pad[i]
             out_full = int(math.ceil((size - kernel[i]) / stride[i])) + 1
             needed = (out_full - 1) * stride[i] + kernel[i] - size
-            lo, hi = padding[2 + i]
-            padding[2 + i] = (lo, hi + max(0, needed))
+            lo, hi = padding[sp0 + i]
+            padding[sp0 + i] = (lo, hi + max(0, needed))
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         out = lax.reduce_window(data, init, lax.max, window, strides, padding)
@@ -329,13 +348,17 @@ def _instance_norm(data, gamma, beta, eps=1e-3):
 
 
 @register("LRN", attrs=AttrSpec(alpha=("float", 1e-4), beta=("float", 0.75),
-                                knorm=("float", 2.0), nsize=("int",)))
-def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+                                knorm=("float", 2.0), nsize=("int",),
+                                axis=("int", 1)))
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, axis=1):
+    # ``axis`` is a TPU-build extension: the reference normalizes over the
+    # NCHW channel axis 1 only; NHWC models pass axis=-1
+    axis = axis % data.ndim
     sq = jnp.square(data)
     half = nsize // 2
-    pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    pad = [(half, half) if i == axis else (0, 0) for i in range(data.ndim)]
     sq = jnp.pad(sq, pad)
-    window = (1, nsize) + (1,) * (data.ndim - 2)
+    window = tuple(nsize if i == axis else 1 for i in range(data.ndim))
     ssum = lax.reduce_window(sq, 0.0, lax.add, window, (1,) * data.ndim,
                              [(0, 0)] * data.ndim)
     return data / jnp.power(knorm + alpha / nsize * ssum, beta)

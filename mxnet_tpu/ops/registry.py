@@ -129,21 +129,24 @@ def resolve_inputs(opdef: "OpDef", args, kwargs, name: str,
     import builtins
 
     inputs = list(args)
-    if opdef.input_names:
-        kw_inputs = {}
-        for i, n in enumerate(opdef.input_names):
-            if n in kwargs and (is_input is None or is_input(kwargs[n])):
-                kw_inputs[i] = kwargs.pop(n)
-        if kw_inputs:
-            hi = builtins.max(kw_inputs)
-            slots = inputs + [None] * builtins.max(0, hi + 1 - len(inputs))
-            for i, v in kw_inputs.items():
-                if slots[i] is not None:
-                    raise MXNetError(
-                        f"input {opdef.input_names[i]} of {name} given "
-                        "both positionally and by keyword")
-                slots[i] = v
-            inputs = [x for x in slots if x is not None]
+    # ops registered without explicit input_names still accept the
+    # conventional ``data=`` keyword (the reference's generated wrappers
+    # name the first input 'data' for every single-input op)
+    input_names = opdef.input_names or ["data"]
+    kw_inputs = {}
+    for i, n in enumerate(input_names):
+        if n in kwargs and (is_input is None or is_input(kwargs[n])):
+            kw_inputs[i] = kwargs.pop(n)
+    if kw_inputs:
+        hi = builtins.max(kw_inputs)
+        slots = inputs + [None] * builtins.max(0, hi + 1 - len(inputs))
+        for i, v in kw_inputs.items():
+            if slots[i] is not None:
+                raise MXNetError(
+                    f"input {input_names[i]} of {name} given "
+                    "both positionally and by keyword")
+            slots[i] = v
+        inputs = [x for x in slots if x is not None]
     return inputs
 
 

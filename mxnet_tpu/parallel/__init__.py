@@ -1,0 +1,18 @@
+"""TPU-native parallelism: device meshes, sharding rules, SPMD training.
+
+This subpackage replaces the reference's entire multi-device/multi-machine
+machinery with SPMD over a ``jax.sharding.Mesh``:
+
+* ``DataParallelExecutorGroup`` batch slicing (executor_group.py:233-262)
+  -> the batch is sharded over the mesh's ``data`` axis;
+* ``KVStoreLocal``/``CommDevice`` gradient reduce (src/kvstore/comm.h)
+  -> XLA inserts ``psum`` over ICI during the jitted step;
+* ``kvstore dist_sync`` + ps-lite worker/server/ZMQ (kvstore_dist.h)
+  -> multi-host SPMD over a DCN-connected mesh (jax.distributed);
+* ctx_group model parallelism + ``_CrossDeviceCopy`` (graph_executor.cc:386)
+  -> named-axis tensor sharding (``model`` axis) with resharding handled
+  by the XLA SPMD partitioner.
+"""
+from .mesh import make_mesh, local_mesh  # noqa: F401
+from .sharding import batch_pspec, param_pspec, shard_params  # noqa: F401
+from .trainer import SPMDTrainer  # noqa: F401

@@ -211,6 +211,25 @@ class Symbol:
                 out[node.name] = d
         return out
 
+    def _arg_layouts(self):
+        """Map weight-variable name -> consumer op's ``layout`` attr.
+
+        Lets initializers compute correct fan-in/fan-out for channel-last
+        (NHWC -> OHWI) conv weights; the reference never needed this because
+        it is NCHW-only (initializer.py Xavier assumes OIHW).
+        """
+        out = {}
+        for node in self._topo_nodes():
+            if node.op is None:
+                continue
+            layout = node.attrs.get("layout")
+            if not layout or str(layout) in ("None",):
+                continue
+            for p, _ in node.inputs:
+                if p.is_variable and p.name.endswith("weight"):
+                    out[p.name] = str(layout)
+        return out
+
     def _set_attr(self, **kwargs):
         for node, _ in self._outputs:
             node.scope_attrs.update({k: str(v) for k, v in kwargs.items()})
