@@ -29,6 +29,7 @@ from . import model  # noqa: F401
 from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import callback  # noqa: F401
+from . import gluon  # noqa: F401
 from . import optimizer  # noqa: F401
 from .io import DataBatch, DataIter  # noqa: F401
 from .base import MXNetError  # noqa: F401

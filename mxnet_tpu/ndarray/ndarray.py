@@ -60,7 +60,7 @@ class NDArray:
     """Multi-dimensional array with MXNet semantics over immutable jax arrays."""
 
     __slots__ = ("_data", "_ctx", "_grad_buf", "_grad_req", "_ag_node",
-                 "_ag_out_index", "__weakref__")
+                 "_ag_out_index", "_version", "__weakref__")
 
     # ensure ndarray <op> NDArray dispatches to us
     __array_priority__ = 100.0
@@ -128,7 +128,18 @@ class NDArray:
     wait_to_write = wait_to_read
 
     def _set_data(self, new_data):
+        # write-version counter: the python-level analogue of ThreadedVar's
+        # version list (threaded_engine.h:95-213); used e.g. for stale-grad
+        # detection in gluon.Trainer
         self._data = new_data
+        self._version = self.version + 1
+
+    @property
+    def version(self) -> int:
+        try:
+            return self._version
+        except AttributeError:
+            return 0
 
     # -- conversion ---------------------------------------------------------
     def asnumpy(self) -> _np.ndarray:
@@ -495,8 +506,11 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
     if autograd.is_recording() and opdef.differentiable:
         nd_inputs = [x if isinstance(x, NDArray) else NDArray(v)
                      for x, v in zip(inputs, vals)]
+        # record the FULL output list (incl. hidden aux outputs, e.g.
+        # BatchNorm moving stats) so backward's vjp cotangent structure
+        # matches fn's return; heads only ever index the visible prefix
         node = autograd.AGNode(opdef, call_attrs, rng, nd_inputs, vals,
-                               len(visible), [o._data for o in out_arrays])
+                               len(outputs), list(outputs))
         for i, o in enumerate(out_arrays):
             o._ag_node = node
             o._ag_out_index = i
@@ -602,15 +616,17 @@ def save(fname: str, data):
     container; the reference's binary container format is CUDA-era and is
     deliberately not reproduced."""
     if isinstance(data, NDArray):
-        _np.savez(fname, **{"0": data.asnumpy()})
-        return
-    if isinstance(data, (list, tuple)):
-        _np.savez(fname, **{str(i): d.asnumpy() for i, d in enumerate(data)})
-        return
-    if isinstance(data, dict):
-        _np.savez(fname, **{k: v.asnumpy() for k, v in data.items()})
-        return
-    raise MXNetError("save expects NDArray, list or dict")
+        arrays = {"0": data.asnumpy()}
+    elif isinstance(data, (list, tuple)):
+        arrays = {str(i): d.asnumpy() for i, d in enumerate(data)}
+    elif isinstance(data, dict):
+        arrays = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise MXNetError("save expects NDArray, list or dict")
+    # pass a file object so np.savez keeps the exact filename (it appends
+    # .npz to bare paths, breaking reference-style ``prefix-0000.params``)
+    with open(fname, "wb") as f:
+        _np.savez(f, **arrays)
 
 
 def load(fname: str):

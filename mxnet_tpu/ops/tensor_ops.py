@@ -216,6 +216,24 @@ def _batch_take(a, indices):
     ).squeeze(1)
 
 
+@register("pick", num_inputs=2, input_names=["data", "index"],
+          attrs=AttrSpec(axis=("int", -1), keepdims=("bool", False),
+                         mode=("str", "clip")))
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """Pick data[..., index, ...] along ``axis`` (reference
+    broadcast_reduce_op_index.cc:pick)."""
+    axis = axis % data.ndim
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = idx % data.shape[axis]
+    else:
+        idx = jnp.clip(idx, 0, data.shape[axis] - 1)
+    idx = jnp.expand_dims(idx.reshape(
+        data.shape[:axis] + data.shape[axis + 1:]), axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis)
+
+
 @register("one_hot",
           attrs=AttrSpec(depth=("int",), on_value=("float", 1.0),
                          off_value=("float", 0.0), dtype=("str", "float32")),

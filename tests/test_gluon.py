@@ -1,0 +1,303 @@
+"""Gluon API tests, mirroring the reference's tests/python/unittest/test_gluon.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert len(p.list_data()) == 1
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(mx.MXNetError):
+        p.data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_parameter_sharing():
+    class Net(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    net1 = Net(prefix="net1_")
+    net2 = Net(prefix="net2_", params=net1.collect_params())
+    net1.collect_params().initialize()
+    out1 = net1(mx.nd.zeros((3, 5)))
+    out2 = net2(mx.nd.zeros((3, 5)))
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy())
+
+
+def test_basic_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False)
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    out = model(inputs)
+    assert out.shape == (2, 3, 128)
+
+
+def test_dense_flatten():
+    model = nn.Dense(128, activation="relu", in_units=30)
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    assert model(inputs).shape == (2, 128)
+
+
+def test_sequential_and_training():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dropout(0.5))
+        net.add(nn.Dense(10))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(8, 16))
+    y = mx.nd.array(np.random.randint(0, 10, (8,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net(x)  # materialize deferred shapes
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    w0 = net[0].weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(8)
+    assert np.abs(net[0].weight.data().asnumpy() - w0).max() > 0
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 8))
+    out_imp = net(x).asnumpy()
+    net.hybridize()
+    out_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(out_imp, out_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grad_consistency():
+    def make():
+        net = nn.HybridSequential(prefix="ghc_")
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu", in_units=8),
+                    nn.Dense(4, in_units=16))
+        return net
+
+    net = make()
+    net.initialize(init="xavier")
+    x = mx.nd.array(np.random.rand(2, 8))
+    with mx.autograd.record():
+        out = net(x)
+    out.backward()
+    g_imp = net[0].weight.grad().asnumpy().copy()
+    net.hybridize()
+    net.collect_params().zero_grad()
+    with mx.autograd.record():
+        out = net(x)
+    out.backward()
+    g_hyb = net[0].weight.grad().asnumpy()
+    np.testing.assert_allclose(g_imp, g_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_deferred_init():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+                nn.BatchNorm(),
+                nn.MaxPool2D(),
+                nn.GlobalAvgPool2D(),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8))
+    out = net(x)
+    assert out.shape == (2, 10)
+    assert net[0].weight.shape == (8, 3, 3, 3)
+
+
+def test_batchnorm_stats_update():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(4, 3, 5, 5) + 2.0)
+    rm0 = net.running_mean.data().asnumpy().copy()
+    with mx.autograd.record():
+        net(x)
+    rm1 = net.running_mean.data().asnumpy()
+    assert np.abs(rm1 - rm0).max() > 0
+    # eval mode must NOT update stats
+    net(x)
+    np.testing.assert_allclose(net.running_mean.data().asnumpy(), rm1)
+
+
+def test_conv_layers_shapes():
+    x1 = mx.nd.zeros((1, 4, 10))
+    x2 = mx.nd.zeros((1, 4, 10, 10))
+    layers = [
+        (nn.Conv1D(6, 3), x1, (1, 6, 8)),
+        (nn.Conv2D(6, (3, 3), strides=2), x2, (1, 6, 4, 4)),
+        (nn.Conv1DTranspose(6, 3), x1, (1, 6, 12)),
+        (nn.Conv2DTranspose(6, (3, 3), strides=2, output_padding=1),
+         x2, (1, 6, 22, 22)),
+        (nn.MaxPool1D(2), x1, (1, 4, 5)),
+        (nn.AvgPool2D((2, 2)), x2, (1, 4, 5, 5)),
+        (nn.GlobalAvgPool2D(), x2, (1, 4, 1, 1)),
+    ]
+    for layer, x, want in layers:
+        layer.initialize()
+        got = layer(x).shape
+        assert got == want, f"{layer}: {got} != {want}"
+
+
+def test_pool_ceil_mode():
+    x = mx.nd.zeros((2, 2, 10, 10))
+    layer = nn.MaxPool2D(3, ceil_mode=False)
+    layer.initialize()
+    assert layer(x).shape == (2, 2, 3, 3)
+    layer = nn.MaxPool2D(3, ceil_mode=True)
+    layer.initialize()
+    assert layer(x).shape == (2, 2, 4, 4)
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 5)
+    layer.initialize()
+    x = mx.nd.array([2, 4, 6])
+    out = layer(x)
+    assert out.shape == (3, 5)
+    with mx.autograd.record():
+        out = layer(x)
+    out.backward()
+    assert layer.weight.grad().shape == (10, 5)
+
+
+def test_losses():
+    pred = mx.nd.array(np.random.rand(4, 10))
+    label_idx = mx.nd.array(np.random.randint(0, 10, (4,)))
+    label_dense = mx.nd.array(np.random.rand(4, 10))
+
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label_idx)
+    assert l.shape == (4,)
+    # manual check
+    logp = np.log(np.exp(pred.asnumpy()) /
+                  np.exp(pred.asnumpy()).sum(-1, keepdims=True))
+    want = -logp[np.arange(4), label_idx.asnumpy().astype(int)]
+    np.testing.assert_allclose(l.asnumpy(), want, rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, label_dense)
+    want = 0.5 * ((pred.asnumpy() - label_dense.asnumpy()) ** 2).mean(-1)
+    np.testing.assert_allclose(l2.asnumpy(), want, rtol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(pred, label_dense)
+    assert l1.shape == (4,)
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        pred, (label_dense > 0.5))
+    assert bce.shape == (4,)
+    kl = gluon.loss.KLDivLoss()(
+        mx.nd.log_softmax(pred), mx.nd.softmax(label_dense))
+    assert kl.shape == (4,)
+    hu = gluon.loss.HuberLoss()(pred, label_dense)
+    assert hu.shape == (4,)
+    hi = gluon.loss.HingeLoss()(pred, 2 * (label_dense > 0.5) - 1)
+    assert hi.shape == (4,)
+
+
+def test_block_attr_registration():
+    class Model(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.layers = []
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.weight = gluon.Parameter("extra", shape=(2, 2))
+
+        def forward(self, x):
+            return self.dense0(x)
+
+    m = Model()
+    params = m.collect_params()
+    assert any(k.endswith("extra") for k in params)
+    assert any(k.endswith("dense0_weight") for k in params)
+
+
+def test_save_load_params_roundtrip():
+    def make():
+        net = nn.HybridSequential(prefix="slp_")
+        with net.name_scope():
+            net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+        return net
+
+    net = make()
+    net.initialize(init="xavier")
+    x = mx.nd.array(np.random.rand(2, 8))
+    want = net(x).asnumpy()
+    net.save_params("/tmp/test_gluon_slp.params")
+    net2 = make()
+    net2.load_params("/tmp/test_gluon_slp.params")
+    np.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_split_and_load():
+    data = mx.nd.array(np.arange(24).reshape(6, 4))
+    splits = gluon.utils.split_data(data, 3)
+    assert len(splits) == 3
+    assert splits[1].shape == (2, 4)
+    loaded = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert loaded[0].shape == (6, 4)
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((3, 3)) * 2, mx.nd.ones((2,)) * 3]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = sum((a.asnumpy() ** 2).sum() for a in arrays)
+    assert abs(np.sqrt(total) - 1.0) < 1e-5
+    assert norm > 1.0
+
+
+def test_lambda_blocks():
+    net = nn.Sequential()
+    net.add(nn.Lambda("tanh"),
+            nn.HybridLambda(lambda F, x: F.relu(x)))
+    x = mx.nd.array(np.random.rand(2, 3) - 0.5)
+    out = net(x)
+    np.testing.assert_allclose(
+        out.asnumpy(), np.maximum(np.tanh(x.asnumpy()), 0), rtol=1e-6)
+
+
+def test_trainer_states_roundtrip():
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.ones((2, 4))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
+    tr.save_states("/tmp/test_gluon_tr.states")
+    tr.load_states("/tmp/test_gluon_tr.states")
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
