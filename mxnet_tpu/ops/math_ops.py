@@ -75,7 +75,7 @@ register("identity", aliases=["_copy"])(lambda x: x)
 
 # stop_gradient: reference BlockGrad (elemwise_unary_op.cc) / make_loss
 register("BlockGrad", aliases=["stop_gradient"])(jax.lax.stop_gradient)
-register("make_loss")(lambda x: x)
+register("make_loss", aliases=["MakeLoss"])(lambda x: x)
 
 
 @register(

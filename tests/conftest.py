@@ -21,6 +21,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", jax.devices()
 
+# XLA:CPU's default matmul precision is bf16-like (~2e-3 error) which breaks
+# finite-difference gradient checks; tests run at full precision (the bench
+# path explicitly opts into bfloat16 on the MXU instead)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+# float64 available in tests (reference numeric checks cross-validate against
+# fp64; NDArray still defaults new arrays to float32)
+jax.config.update("jax_enable_x64", True)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,86 @@
+"""The numeric-gradient harness itself (reference: every op test in
+tests/python/unittest/test_operator.py leans on test_utils; SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def test_assert_almost_equal():
+    tu.assert_almost_equal(np.ones(3), np.ones(3))
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal(np.ones(3), np.ones(3) + 0.1)
+
+
+def test_check_numeric_gradient_fc():
+    data = mx.sym.Variable("data")
+    s = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    tu.check_numeric_gradient(
+        s, {"data": np.random.rand(3, 5), "fc_weight": np.random.rand(4, 5),
+            "fc_bias": np.random.rand(4)})
+
+
+@pytest.mark.parametrize("op,dfdx", [
+    ("sqrt", lambda x: 0.5 / np.sqrt(x)),
+    ("exp", np.exp),
+    ("log", lambda x: 1.0 / x),
+    ("sigmoid", lambda x: (1 / (1 + np.exp(-x))) * (1 - 1 / (1 + np.exp(-x)))),
+    ("tanh", lambda x: 1 - np.tanh(x) ** 2),
+])
+def test_check_numeric_gradient_unary(op, dfdx):
+    data = mx.sym.Variable("data")
+    s = getattr(mx.sym, op)(data)
+    x = np.random.rand(4, 3) + 0.5
+    tu.check_numeric_gradient(s, {"data": x})
+    og = np.random.rand(4, 3)
+    tu.check_symbolic_backward(s, {"data": x}, [og], [og * dfdx(x)],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_check_symbolic_forward():
+    data = mx.sym.Variable("data")
+    x = np.array([4.0, 9.0], dtype=np.float32)
+    tu.check_symbolic_forward(mx.sym.sqrt(data), {"data": x},
+                              [np.sqrt(x)])
+
+
+def test_check_consistency_dtypes():
+    data = mx.sym.Variable("data")
+    s = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    tu.check_consistency(
+        s, [{"ctx": mx.cpu(0), "data": (4, 6)},
+            {"ctx": mx.cpu(1), "data": (4, 6),
+             "type_dict": {"data": "float64"}}])
+
+
+def test_np_reduce():
+    x = np.random.rand(3, 4, 5)
+    assert tu.np_reduce(x, (0, 2), True, np.sum).shape == (1, 4, 1)
+    tu.assert_almost_equal(tu.np_reduce(x, 1, False, np.max),
+                           x.max(axis=1), rtol=1e-6, atol=1e-6)
+
+
+def test_rand_shapes():
+    assert len(tu.rand_shape_2d()) == 2
+    assert len(tu.rand_shape_3d()) == 3
+    assert len(tu.rand_shape_nd(5)) == 5
+
+
+def test_simple_forward():
+    data = mx.sym.Variable("data")
+    out = tu.simple_forward(mx.sym.relu(data),
+                            data=np.array([-1.0, 2.0], dtype=np.float32))
+    tu.assert_almost_equal(out, np.array([0.0, 2.0]))
+
+
+def test_get_mnist_synthetic():
+    m = tu.get_mnist()
+    assert m["train_data"].shape[1:] == (1, 28, 28)
+    assert m["train_data"].shape[0] == m["train_label"].shape[0]
+    # learnable: same label -> similar images
+    labels = m["train_label"]
+    imgs = m["train_data"]
+    a = imgs[labels == 3].mean(axis=0)
+    b = imgs[labels == 7].mean(axis=0)
+    assert np.abs(a - b).max() > 0.5
