@@ -78,8 +78,45 @@ class KVStore:
                 o._set_data(self._store[k]._data)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense fallback: full pull (row_sparse storage arrives with sparse/)
-        self.pull(key, out, priority)
+        """Pull only the requested rows as row_sparse (reference:
+        kvstore.py row_sparse_pull → sparse_retain on the stored value)."""
+        if row_ids is None:
+            self.pull(key, out, priority)
+            return
+        import jax.numpy as jnp
+        import numpy as _np
+        from .ndarray import sparse as _sp
+        keys, outs = self._normalize(key, out)
+        # row_ids: one NDArray broadcast to every key/out, or a list
+        # parallel to the keys (reference: kvstore.py row_sparse_pull)
+        if isinstance(row_ids, list):
+            if len(row_ids) != len(keys):
+                raise MXNetError("row_ids list must match the key list")
+            ids_per_key = row_ids
+        else:
+            ids_per_key = [row_ids] * len(keys)
+        for k, olist, rid in zip(keys, outs, ids_per_key):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if not isinstance(olist, list):
+                olist = [olist]
+            stored = self._store[k]
+            if stored.stype == "row_sparse":
+                kept = _sp.sparse_retain(stored, rid)
+            else:
+                # dense-stored weight: gather the requested rows on
+                # device instead of densify-scan (embedding hot path)
+                ids_np = _np.unique(_np.asarray(
+                    rid.asnumpy() if hasattr(rid, "asnumpy") else rid)
+                    .astype(_np.int64).ravel())
+                kept = _sp.RowSparseNDArray(
+                    stored._data[jnp.asarray(ids_np)], ids_np, stored.shape)
+            for o in olist:
+                if o.stype == "row_sparse":
+                    o._d, o._i = kept._d, kept._i
+                    o._dense = None
+                else:
+                    o._set_data(kept._data)
 
     # -- updater / optimizer -------------------------------------------------
     def set_updater(self, updater):

@@ -49,6 +49,9 @@ def _make_op_func(opdef: OpDef, name: str):
     return op_func
 
 
+from . import sparse  # noqa: F401,E402
+from .sparse import CSRNDArray, RowSparseNDArray  # noqa: F401,E402
+
 _mod = _sys.modules[__name__]
 for _name, _opdef in OP_TABLE.items():
     if not hasattr(_mod, _name):

@@ -175,7 +175,7 @@ class NDArray:
     def tostype(self, stype):
         if stype == "default":
             return self
-        from ..sparse import cast_storage
+        from .sparse import cast_storage
         return cast_storage(self, stype)
 
     # -- autograd -----------------------------------------------------------

@@ -1,0 +1,257 @@
+"""Sparse NDArray tests.
+
+Mirrors the reference's tests/python/unittest/test_sparse_ndarray.py and
+test_sparse_operator.py: constructors, cast_storage round trips, dense
+fallback, CSR·dense dot, sparse_retain, lazy row-sparse optimizer updates.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense(shape, density=0.3, rng=None):
+    rng = rng or np.random.RandomState(0)
+    d = rng.randn(*shape).astype(np.float32)
+    mask = rng.rand(*shape) < density
+    return d * mask
+
+
+def test_csr_creation_and_roundtrip():
+    dense = _rand_dense((6, 5))
+    csr = sparse.csr_matrix(mx.nd.array(dense))
+    assert csr.stype == "csr"
+    assert csr.shape == (6, 5)
+    np.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+    back = csr.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_allclose(back.asnumpy(), dense, rtol=1e-6)
+    # component access
+    assert csr.data.shape[0] == csr.indices.shape[0]
+    assert csr.indptr.shape == (7,)
+
+
+def test_csr_from_components():
+    data = [1.0, 2.0, 3.0]
+    indices = [0, 2, 1]
+    indptr = [0, 2, 2, 3]
+    csr = sparse.csr_matrix((data, indices, indptr), shape=(3, 4))
+    expect = np.zeros((3, 4), np.float32)
+    expect[0, 0], expect[0, 2], expect[2, 1] = 1, 2, 3
+    np.testing.assert_allclose(csr.asnumpy(), expect)
+
+
+def test_csr_slice():
+    dense = _rand_dense((8, 4))
+    csr = sparse.csr_matrix(mx.nd.array(dense))
+    sub = csr[2:5]
+    assert sub.stype == "csr"
+    np.testing.assert_allclose(sub.asnumpy(), dense[2:5], rtol=1e-6)
+
+
+def test_rsp_creation_and_roundtrip():
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = [1, 2, 3]
+    dense[4] = [4, 5, 6]
+    rsp = sparse.row_sparse_array(mx.nd.array(dense))
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    assert list(np.asarray(rsp.indices.asnumpy())) == [1, 4]
+    assert rsp.data.shape == (2, 3)
+    back = rsp.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_rsp_from_components():
+    rsp = sparse.row_sparse_array(
+        ([[1.0, 2.0], [3.0, 4.0]], [0, 3]), shape=(5, 2))
+    expect = np.zeros((5, 2), np.float32)
+    expect[0] = [1, 2]
+    expect[3] = [3, 4]
+    np.testing.assert_allclose(rsp.asnumpy(), expect)
+
+
+def test_cast_storage_api():
+    dense = _rand_dense((5, 5))
+    nd = mx.nd.array(dense)
+    assert nd.tostype("csr").stype == "csr"
+    assert nd.tostype("row_sparse").stype == "row_sparse"
+    np.testing.assert_allclose(nd.tostype("csr").asnumpy(), dense, rtol=1e-6)
+    with pytest.raises(mx.MXNetError):
+        nd.tostype("csr").tostype("row_sparse")
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("csr", (3, 4))
+    assert z.stype == "csr" and z.nnz == 0
+    np.testing.assert_allclose(z.asnumpy(), np.zeros((3, 4)))
+    zr = sparse.zeros("row_sparse", (3, 4))
+    np.testing.assert_allclose(zr.asnumpy(), np.zeros((3, 4)))
+
+
+def test_dense_fallback_ops():
+    """Any dense operator accepts sparse inputs (reference:
+    StorageFallbackOpExecutor, attach_op_execs_pass.cc:47)."""
+    dense = _rand_dense((4, 4))
+    csr = sparse.csr_matrix(mx.nd.array(dense))
+    out = mx.nd.elemwise_add(csr, mx.nd.ones((4, 4)))
+    np.testing.assert_allclose(out.asnumpy(), dense + 1, rtol=1e-6)
+
+
+def test_dot_csr_dense():
+    rng = np.random.RandomState(3)
+    a = _rand_dense((8, 6), rng=rng)
+    b = rng.randn(6, 5).astype(np.float32)
+    csr = sparse.csr_matrix(mx.nd.array(a))
+    out = sparse.dot(csr, mx.nd.array(b))
+    assert out.stype == "default"
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_dot_csr_t_dense_gives_rsp():
+    rng = np.random.RandomState(4)
+    a = _rand_dense((8, 6), rng=rng)
+    b = rng.randn(8, 5).astype(np.float32)
+    csr = sparse.csr_matrix(mx.nd.array(a))
+    out = sparse.dot(csr, mx.nd.array(b), transpose_a=True)
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_retain():
+    dense = np.zeros((6, 2), np.float32)
+    for r in (0, 2, 4, 5):
+        dense[r] = r + 1
+    rsp = sparse.row_sparse_array(mx.nd.array(dense))
+    kept = sparse.sparse_retain(rsp, mx.nd.array([2, 5]))
+    expect = np.zeros_like(dense)
+    expect[2], expect[5] = dense[2], dense[5]
+    np.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_square_sum():
+    dense = np.zeros((5, 3), np.float32)
+    dense[1] = [1, 2, 3]
+    dense[3] = [2, 2, 2]
+    rsp = sparse.row_sparse_array(mx.nd.array(dense))
+    np.testing.assert_allclose(sparse._square_sum(rsp).asnumpy(),
+                               (dense ** 2).sum(), rtol=1e-6)
+    np.testing.assert_allclose(sparse._square_sum(rsp, axis=1).asnumpy(),
+                               (dense ** 2).sum(axis=1), rtol=1e-6)
+
+
+def test_rsp_add():
+    d1 = np.zeros((5, 2), np.float32)
+    d1[1] = 1
+    d1[3] = 2
+    d2 = np.zeros((5, 2), np.float32)
+    d2[3] = 5
+    d2[4] = 7
+    r = sparse.add(sparse.row_sparse_array(mx.nd.array(d1)),
+                   sparse.row_sparse_array(mx.nd.array(d2)))
+    assert r.stype == "row_sparse"
+    np.testing.assert_allclose(r.asnumpy(), d1 + d2)
+
+
+def test_sgd_lazy_update():
+    """Rows absent from the sparse grad must be untouched even with wd>0
+    (reference lazy-update semantics, optimizer_op.cc)."""
+    w0 = np.ones((6, 3), np.float32)
+    w = mx.nd.array(w0)
+    grad = sparse.row_sparse_array(
+        (np.full((2, 3), 0.5, np.float32), [1, 4]), shape=(6, 3))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, wd=0.01)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    out = w.asnumpy()
+    # untouched rows identical
+    for r in (0, 2, 3, 5):
+        np.testing.assert_allclose(out[r], w0[r])
+    # touched rows: w -= lr*(g + wd*w)
+    np.testing.assert_allclose(out[1], 1 - 0.1 * (0.5 + 0.01 * 1), rtol=1e-6)
+
+
+def test_sgd_momentum_sparse_matches_dense():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(6, 3).astype(np.float32)
+    g_dense = np.zeros((6, 3), np.float32)
+    g_dense[2] = rng.randn(3)
+    g_dense[5] = rng.randn(3)
+
+    w_s = mx.nd.array(w0)
+    opt_s = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.0)
+    st_s = opt_s.create_state(0, w_s)
+    w_d = mx.nd.array(w0)
+    opt_d = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.0)
+    st_d = opt_d.create_state(0, w_d)
+
+    for _ in range(3):
+        opt_s.update(0, w_s, sparse.row_sparse_array(mx.nd.array(g_dense)),
+                     st_s)
+        opt_d.update(0, w_d, mx.nd.array(g_dense), st_d)
+    np.testing.assert_allclose(w_s.asnumpy(), w_d.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_adam_sparse_rows_touched_only():
+    w0 = np.ones((5, 2), np.float32)
+    w = mx.nd.array(w0)
+    grad = sparse.row_sparse_array(
+        (np.full((1, 2), 1.0, np.float32), [2]), shape=(5, 2))
+    opt = mx.optimizer.create("adam", learning_rate=0.01)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    out = w.asnumpy()
+    for r in (0, 1, 3, 4):
+        np.testing.assert_allclose(out[r], 1.0)
+    assert not np.allclose(out[2], 1.0)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kvstore.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init("emb", mx.nd.array(w))
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([1, 3]))
+    expect = np.zeros_like(w)
+    expect[1], expect[3] = w[1], w[3]
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_sparse_pickle_roundtrip_dense_view():
+    dense = _rand_dense((4, 3))
+    csr = sparse.csr_matrix(mx.nd.array(dense))
+    nd = csr.todense()
+    np.testing.assert_allclose(nd.asnumpy(), dense, rtol=1e-6)
+
+
+def test_kvstore_row_sparse_pull_multi_key():
+    """Regression: each key must be pulled with its own row_ids."""
+    kv = mx.kvstore.create("local")
+    wa = np.arange(8, dtype=np.float32).reshape(4, 2)
+    wb = -np.arange(8, dtype=np.float32).reshape(4, 2)
+    kv.init("a", mx.nd.array(wa))
+    kv.init("b", mx.nd.array(wb))
+    oa = sparse.zeros("row_sparse", (4, 2))
+    ob = sparse.zeros("row_sparse", (4, 2))
+    kv.row_sparse_pull(["a", "b"], out=[oa, ob],
+                       row_ids=[mx.nd.array([1]), mx.nd.array([2])])
+    assert oa.asnumpy()[1, 0] == wa[1, 0] and oa.asnumpy()[2].sum() == 0
+    assert ob.asnumpy()[2, 0] == wb[2, 0] and ob.asnumpy()[1].sum() == 0
+
+
+def test_sparse_weight_update():
+    """Regression: optimizer update on a row_sparse-stored weight."""
+    dense = np.zeros((6, 2), np.float32)
+    dense[1] = 1.0
+    w = sparse.row_sparse_array(mx.nd.array(dense))
+    grad = sparse.row_sparse_array(
+        (np.full((2, 2), 0.5, np.float32), [1, 3]), shape=(6, 2))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    opt.update(0, w, grad, opt.create_state(0, w))
+    out = w.asnumpy()
+    assert w.stype == "row_sparse"
+    np.testing.assert_allclose(out[1], 1.0 - 0.05, rtol=1e-6)
+    np.testing.assert_allclose(out[3], -0.05, rtol=1e-6)
+    np.testing.assert_allclose(out[0], 0.0)
