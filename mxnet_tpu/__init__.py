@@ -22,6 +22,8 @@ from .symbol import AttrScope, Symbol  # noqa: F401
 from . import initializer  # noqa: F401
 from . import initializer as init  # noqa: F401
 from . import io  # noqa: F401
+from . import recordio  # noqa: F401
+from . import image  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401

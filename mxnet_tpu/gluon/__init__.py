@@ -4,6 +4,7 @@
 a block into one XLA program (the TPU-era CachedOp). ``Trainer`` applies
 optimizers to ``Parameter``s; ``loss`` and ``nn``/``rnn`` supply layers.
 """
+from . import data  # noqa: F401
 from . import loss  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401

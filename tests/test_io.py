@@ -1,0 +1,275 @@
+"""IO stack tests: recordio format, image pipeline, gluon.data, im2rec.
+
+Mirrors the reference's tests/python/unittest/test_recordio.py,
+test_image.py and test_gluon_data.py.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+from mxnet_tpu.gluon import data as gdata
+
+cv2 = pytest.importorskip("cv2")
+
+
+# -- recordio ---------------------------------------------------------------
+
+def test_recordio_roundtrip(tmp_path):
+    frec = str(tmp_path / "a.rec")
+    writer = recordio.MXRecordIO(frec, "w")
+    for i in range(10):
+        writer.write(bytes(str(i) * (i + 1), "ascii"))
+    writer.close()
+    reader = recordio.MXRecordIO(frec, "r")
+    for i in range(10):
+        assert reader.read() == bytes(str(i) * (i + 1), "ascii")
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    frec, fidx = str(tmp_path / "b.rec"), str(tmp_path / "b.idx")
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(7):
+        writer.write_idx(i, bytes(f"rec{i}", "ascii"))
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    assert reader.keys == list(range(7))
+    # random access, out of order
+    for i in (3, 0, 6, 2):
+        assert reader.read_idx(i) == bytes(f"rec{i}", "ascii")
+    reader.close()
+
+
+def test_recordio_magic_compat(tmp_path):
+    """The framing constant must match dmlc-core's kMagic so .rec files
+    interop with reference tooling."""
+    frec = str(tmp_path / "c.rec")
+    w = recordio.MXRecordIO(frec, "w")
+    w.write(b"xyzw")
+    w.close()
+    raw = open(frec, "rb").read()
+    assert raw[:4] == (0xCED7230A).to_bytes(4, "little")
+    assert len(raw) == 12  # 8 header + 4 payload, no pad needed
+
+
+def test_pack_unpack_scalar_label():
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, content = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 7
+    assert content == b"payload"
+
+
+def test_pack_unpack_vector_label():
+    label = np.array([1.0, 2.0, 5.0], np.float32)
+    s = recordio.pack(recordio.IRHeader(0, label, 1, 0), b"img")
+    h2, content = recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, label)
+    assert h2.flag == 3 and content == b"img"
+
+
+def test_pack_img_roundtrip():
+    img = (np.random.RandomState(0).rand(32, 32, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          quality=100, img_fmt=".png")
+    h, img2 = recordio.unpack_img(s)
+    assert h.label == 1.0
+    np.testing.assert_array_equal(img2, img)  # png is lossless
+
+
+# -- image ------------------------------------------------------------------
+
+def _fake_img(h=40, w=60):
+    rng = np.random.RandomState(1)
+    return (rng.rand(h, w, 3) * 255).astype(np.uint8)
+
+
+def test_imdecode_rgb():
+    img = _fake_img()
+    ok, buf = cv2.imencode(".png", img)
+    out = image.imdecode(buf.tobytes()).asnumpy()
+    np.testing.assert_array_equal(out, img[..., ::-1])  # BGR file -> RGB
+
+
+def test_resize_short():
+    out = image.resize_short(_fake_img(40, 60), 20).asnumpy()
+    assert out.shape == (20, 30, 3)
+
+
+def test_crops():
+    img = _fake_img(40, 60)
+    out, (x0, y0, w, h) = image.center_crop(img, (30, 30))
+    assert out.shape == (30, 30, 3) and (w, h) == (30, 30)
+    out, _ = image.random_crop(img, (20, 20))
+    assert out.shape == (20, 20, 3)
+    out = image.fixed_crop(img, 5, 5, 10, 10)
+    np.testing.assert_array_equal(out.asnumpy(), img[5:15, 5:15])
+
+
+def test_color_normalize():
+    img = _fake_img(8, 8).astype(np.float32)
+    mean = np.array([1.0, 2.0, 3.0], np.float32)
+    std = np.array([2.0, 2.0, 2.0], np.float32)
+    out = image.color_normalize(img, mean, std).asnumpy()
+    np.testing.assert_allclose(out, (img - mean) / std, rtol=1e-6)
+
+
+def test_create_augmenter_shapes():
+    augs = image.CreateAugmenter((3, 24, 24), resize=30, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1, pca_noise=0.1)
+    img = _fake_img(50, 70)
+    for aug in augs:
+        img = aug(img)
+    arr = img.asnumpy() if hasattr(img, "asnumpy") else np.asarray(img)
+    assert arr.shape == (24, 24, 3)
+    assert arr.dtype == np.float32
+
+
+def _write_rec_dataset(tmp_path, n=12, size=32):
+    """Pack n random images with labels into a .rec + .idx pair."""
+    frec, fidx = str(tmp_path / "data.rec"), str(tmp_path / "data.idx")
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    rng = np.random.RandomState(0)
+    labels = []
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        label = float(i % 3)
+        labels.append(label)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, img_fmt=".png"))
+    writer.close()
+    return frec, labels
+
+
+def test_image_iter_from_rec(tmp_path):
+    frec, labels = _write_rec_dataset(tmp_path)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                         path_imgrec=frec, rand_crop=False, rand_mirror=False)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 28, 28)
+    assert batch.label[0].shape == (4,)
+    np.testing.assert_allclose(batch.label[0].asnumpy(), labels[:4])
+    # full epoch then StopIteration
+    count = 1
+    try:
+        while True:
+            it.next()
+            count += 1
+    except StopIteration:
+        pass
+    assert count == 3
+    it.reset()
+    assert it.next().data[0].shape == (4, 3, 28, 28)
+
+
+def test_image_record_iter_wrapper(tmp_path):
+    frec, _ = _write_rec_dataset(tmp_path)
+    it = image.ImageRecordIter(path_imgrec=frec, data_shape=(3, 32, 32),
+                               batch_size=6, preprocess_threads=4,
+                               mean_r=123, mean_g=117, mean_b=104)
+    batch = it.next()
+    assert batch.data[0].shape == (6, 3, 32, 32)
+
+
+# -- gluon.data -------------------------------------------------------------
+
+def test_array_dataset_and_loader():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    ds = gdata.ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+    assert len(ds) == 10
+    loader = gdata.DataLoader(ds, batch_size=3, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (3, 4) and yb.shape == (3,)
+    np.testing.assert_allclose(xb.asnumpy(), X[:3])
+    # discard mode
+    assert len(list(gdata.DataLoader(ds, batch_size=3,
+                                     last_batch="discard"))) == 3
+
+
+def test_dataloader_shuffle_covers_all():
+    ds = gdata.ArrayDataset(np.arange(20, dtype=np.float32))
+    loader = gdata.DataLoader(ds, batch_size=5, shuffle=True)
+    seen = np.sort(np.concatenate([b.asnumpy() for b in loader]))
+    np.testing.assert_allclose(seen, np.arange(20))
+
+
+def test_batch_sampler_rollover():
+    s = gdata.BatchSampler(gdata.SequentialSampler(7), 3,
+                           last_batch="rollover")
+    ep1 = list(s)
+    assert [len(b) for b in ep1] == [3, 3]
+    ep2 = list(s)
+    # 1 rolled over + 7 new = 8 -> two full batches, 2 roll again
+    assert [len(b) for b in ep2] == [3, 3]
+
+
+def test_record_file_dataset(tmp_path):
+    frec, labels = _write_rec_dataset(tmp_path, n=5)
+    ds = gdata.vision.ImageRecordDataset(frec)
+    assert len(ds) == 5
+    img, label = ds[2]
+    assert img.shape == (32, 32, 3)
+    assert label == labels[2]
+    # with DataLoader
+    loader = gdata.DataLoader(ds.transform(
+        lambda im, lb: (im.asnumpy().astype(np.float32) / 255, np.float32(lb))),
+        batch_size=5)
+    xb, yb = next(iter(loader))
+    assert xb.shape == (5, 32, 32, 3)
+    np.testing.assert_allclose(yb.asnumpy(), labels)
+
+
+def test_image_folder_dataset(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            cv2.imwrite(str(d / f"{i}.png"), _fake_img(16, 16))
+    ds = gdata.vision.ImageFolderDataset(str(tmp_path / "imgs"))
+    assert len(ds) == 6
+    assert ds.synsets == ["cat", "dog"]
+    img, label = ds[4]
+    assert img.shape == (16, 16, 3) and label == 1
+
+
+def test_vision_dataset_missing_files_error(tmp_path):
+    with pytest.raises(mx.MXNetError, match="no network egress"):
+        gdata.vision.MNIST(root=str(tmp_path / "nope"))
+
+
+# -- im2rec tool ------------------------------------------------------------
+
+def test_im2rec_end_to_end(tmp_path):
+    # build an image folder
+    for cls in ("a", "b"):
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            cv2.imwrite(str(d / f"{i}.jpg"), _fake_img(20, 20))
+    sys.path.insert(0, "/root/repo/tools")
+    try:
+        import im2rec
+    finally:
+        sys.path.pop(0)
+    prefix = str(tmp_path / "ds")
+    im2rec.main([prefix, str(tmp_path / "root"), "--list", "--recursive"])
+    assert os.path.exists(prefix + ".lst")
+    im2rec.main([prefix, str(tmp_path / "root"), "--num-thread", "2"])
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+    # read it back through ImageIter
+    it = image.ImageIter(batch_size=8, data_shape=(3, 20, 20),
+                         path_imgrec=prefix + ".rec")
+    batch = it.next()
+    assert batch.data[0].shape == (8, 3, 20, 20)
+    assert set(batch.label[0].asnumpy()) == {0.0, 1.0}

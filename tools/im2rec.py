@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / list into a RecordIO dataset.
+
+Reference: tools/im2rec.py (list creation + multi-worker packing into
+``.rec`` + ``.idx``). Same CLI surface for the common flags; packing is
+thread-parallel (decode/encode releases the GIL in cv2).
+
+Usage:
+  python tools/im2rec.py PREFIX ROOT --list            # write PREFIX.lst
+  python tools/im2rec.py PREFIX ROOT                   # pack PREFIX.lst -> .rec
+"""
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) walking root (reference: im2rec.py
+    list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda x: x[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            line = [i.strip() for i in line.strip().split("\t")]
+            if len(line) < 3:
+                continue
+            yield (int(line[0]), line[-1], [float(i) for i in line[1:-1]])
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = "_%d" % i if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def _encode_one(args, item):
+    import cv2
+    import numpy as np
+    from mxnet_tpu import recordio
+
+    i, fname, labels = item
+    fullpath = os.path.join(args.root, fname)
+    header = recordio.IRHeader(
+        0, labels[0] if len(labels) == 1 else np.asarray(labels, np.float32),
+        i, 0)
+    if args.pass_through:
+        with open(fullpath, "rb") as f:
+            return i, recordio.pack(header, f.read())
+    img = cv2.imread(fullpath, args.color)
+    if img is None:
+        print(f"imread failed for {fullpath}", file=sys.stderr)
+        return i, None
+    if args.center_crop and img.shape[0] != img.shape[1]:
+        margin = abs(img.shape[0] - img.shape[1]) // 2
+        if img.shape[0] > img.shape[1]:
+            img = img[margin:margin + img.shape[1]]
+        else:
+            img = img[:, margin:margin + img.shape[0]]
+    if args.resize:
+        h, w = img.shape[:2]
+        if h > w:
+            size = (args.resize, int(h * args.resize / w))
+        else:
+            size = (int(w * args.resize / h), args.resize)
+        img = cv2.resize(img, size)
+    return i, recordio.pack_img(header, img, quality=args.quality,
+                                img_fmt=args.encoding)
+
+
+def im2rec(args, path_lst):
+    from mxnet_tpu import recordio
+
+    out_base = os.path.splitext(path_lst)[0]
+    record = recordio.MXIndexedRecordIO(out_base + ".idx",
+                                        out_base + ".rec", "w")
+    items = list(read_list(path_lst))
+    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        for i, buf in pool.map(lambda it: _encode_one(args, it), items):
+            if buf is not None:
+                record.write_idx(i, buf)
+    record.close()
+    print(f"packed {len(items)} records -> {out_base}.rec")
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO dataset")
+    parser.add_argument("prefix", help="prefix of the list/rec files")
+    parser.add_argument("root", help="image root folder")
+    cgroup = parser.add_argument_group("list creation")
+    cgroup.add_argument("--list", action="store_true")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true")
+    cgroup.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    rgroup = parser.add_argument_group("packing")
+    rgroup.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack raw bytes")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--num-thread", type=int, default=1)
+    rgroup.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    rgroup.add_argument("--encoding", default=".jpg",
+                        choices=[".jpg", ".png"])
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    args.prefix = os.path.abspath(args.prefix)
+    args.root = os.path.abspath(args.root)
+    if args.list:
+        make_list(args)
+        return
+    files = [os.path.join(os.path.dirname(args.prefix), f)
+             for f in os.listdir(os.path.dirname(args.prefix) or ".")
+             if f.startswith(os.path.basename(args.prefix))
+             and f.endswith(".lst")]
+    if not files:
+        print(f"no .lst files found for prefix {args.prefix}",
+              file=sys.stderr)
+        sys.exit(1)
+    for f in files:
+        print("creating", f)
+        im2rec(args, f)
+
+
+if __name__ == "__main__":
+    main()
