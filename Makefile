@@ -1,0 +1,24 @@
+# Native components of mxnet_tpu (reference analogue: the Makefile building
+# libmxnet.so; here the native surface is the IO/runtime layer — the compute
+# path is JAX/XLA).
+#
+#   make            build all native libs into mxnet_tpu/_lib/
+#   make clean
+
+CXX      ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
+LDFLAGS  ?= -shared -pthread
+
+LIBDIR   := mxnet_tpu/_lib
+IO_SRCS  := src/io/recordio.cc
+
+all: $(LIBDIR)/libmxtpu_io.so
+
+$(LIBDIR)/libmxtpu_io.so: $(IO_SRCS) src/io/mxtpu_io.h
+	@mkdir -p $(LIBDIR)
+	$(CXX) $(CXXFLAGS) $(IO_SRCS) $(LDFLAGS) -o $@
+
+clean:
+	rm -rf $(LIBDIR)
+
+.PHONY: all clean

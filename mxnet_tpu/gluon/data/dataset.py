@@ -82,15 +82,43 @@ class ArrayDataset(Dataset):
 
 class RecordFileDataset(Dataset):
     """Dataset over an indexed RecordIO file (reference: dataset.py
-    RecordFileDataset:67)."""
+    RecordFileDataset:67).
+
+    Prefers the native reader (src/io/recordio.cc via _native.py):
+    GIL-free pread, safe under DataLoader worker threads. Falls back to
+    the pure-python MXIndexedRecordIO."""
 
     def __init__(self, filename):
         from ... import recordio
         self.filename = filename
         idx_file = os.path.splitext(filename)[0] + ".idx"
         self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+        # native fast path: map each .idx entry's byte offset to its scan
+        # position, so subset/reordered index files keep their meaning
+        self._native = None
+        self._native_pos = None
+        try:
+            from ..._native import NativeRecordReader, NativeUnavailableError
+            try:
+                native = NativeRecordReader(filename)
+            except NativeUnavailableError:
+                native = None
+        except ImportError:
+            native = None
+        if native is not None:
+            off2pos = native.offsets()
+            try:
+                self._native_pos = [off2pos[self._record.idx[k]]
+                                    for k in self._record.keys]
+                self._native = native
+            except KeyError:
+                # .idx references offsets not present in the scan —
+                # corrupt index; let the python path surface the error
+                native.close()
 
     def __getitem__(self, idx):
+        if self._native is not None:
+            return self._native.read(self._native_pos[idx])
         return self._record.read_idx(self._record.keys[idx])
 
     def __len__(self):

@@ -1,0 +1,64 @@
+/*
+ * mxtpu_io: native RecordIO reader + threaded batch loader.
+ *
+ * TPU-native rebuild of the reference's native IO path (dmlc-core RecordIO
+ * reader + src/io/iter_prefetcher.h threaded pipeline). The host CPU feeds
+ * the chip; this library keeps bulk record IO off the Python GIL:
+ *   - pread-based record access (thread-safe, no shared file offset)
+ *   - full-file scan to build/verify the index
+ *   - batch reads fanned out over an internal thread pool
+ *
+ * C ABI only (consumed via ctypes from mxnet_tpu/_native.py).
+ */
+#ifndef MXTPU_IO_H_
+#define MXTPU_IO_H_
+
+#include <cstdint>
+
+extern "C" {
+
+typedef void* RecordReaderHandle;
+
+/* Open a .rec file and scan it, building an in-memory index of record
+ * offsets/lengths. Returns nullptr on failure. */
+RecordReaderHandle MXTRecordReaderOpen(const char* path);
+
+void MXTRecordReaderClose(RecordReaderHandle h);
+
+/* Number of records discovered by the scan. */
+int64_t MXTRecordReaderNumRecords(RecordReaderHandle h);
+
+/* Payload length of record i (excluding framing), or -1. */
+int64_t MXTRecordReaderRecordLen(RecordReaderHandle h, int64_t i);
+
+/* File offset of record i's framing header (the value .idx files store),
+ * or -1. */
+int64_t MXTRecordReaderRecordOffset(RecordReaderHandle h, int64_t i);
+
+/* Copy record i's payload into out (which must hold RecordLen(i) bytes).
+ * Thread-safe (pread). Returns bytes copied or -1. */
+int64_t MXTRecordReaderRead(RecordReaderHandle h, int64_t i, uint8_t* out);
+
+/* Total payload bytes of records idx[0..n), or -1 on a bad index. */
+int64_t MXTRecordReaderBatchLen(RecordReaderHandle h, const int64_t* idx,
+                                int64_t n);
+
+/* Read n records (indices idx[0..n)) into one contiguous buffer `out`;
+ * offsets[k] receives the start of record k in `out`, lens[k] its length.
+ * `out_capacity` guards the buffer. Reads run on `nthreads` workers.
+ * Returns total bytes written, or -1 (buffer too small / bad index). */
+int64_t MXTRecordReaderReadBatch(RecordReaderHandle h, const int64_t* idx,
+                                 int64_t n, uint8_t* out,
+                                 int64_t out_capacity, int64_t* offsets,
+                                 int64_t* lens, int nthreads);
+
+/* Write a tab-separated "key\toffset" index file compatible with
+ * MXIndexedRecordIO. Returns number of records, or -1. */
+int64_t MXTRecordReaderSaveIndex(RecordReaderHandle h, const char* idx_path);
+
+/* Last error message (thread-local). */
+const char* MXTGetLastError();
+
+}  /* extern "C" */
+
+#endif  /* MXTPU_IO_H_ */

@@ -1,0 +1,270 @@
+/*
+ * Native RecordIO reader (see mxtpu_io.h).
+ *
+ * Format (byte-compatible with dmlc-core RecordIO, the reference's .rec):
+ *   record := uint32 kMagic(0xced7230a) | uint32 lrec | payload | pad-to-4
+ *   lrec   := (cflag << 29) | length; cflag 0=whole 1=begin 2=middle 3=end
+ *
+ * The scan records, for each *logical* record, the list of its physical
+ * parts (split records are reassembled on read).
+ */
+#include "mxtpu_io.h"
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+struct Part {
+  int64_t offset;   // payload start in file
+  int64_t length;   // payload bytes
+};
+
+struct LogicalRecord {
+  int32_t first_part;  // index into parts
+  int32_t num_parts;
+  int64_t total_len;
+};
+
+struct RecordReader {
+  int fd = -1;
+  std::vector<Part> parts;
+  std::vector<LogicalRecord> records;
+
+  ~RecordReader() {
+    if (fd >= 0) close(fd);
+  }
+
+  bool Scan() {
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      SetError("fstat failed");
+      return false;
+    }
+    const int64_t file_size = st.st_size;
+    int64_t pos = 0;
+    int32_t pending_first = -1;  // first part of an open split record
+    while (pos + 8 <= file_size) {
+      uint32_t head[2];
+      if (pread(fd, head, 8, pos) != 8) {
+        SetError("short read of record header");
+        return false;
+      }
+      if (head[0] != kMagic) {
+        SetError("bad magic at offset " + std::to_string(pos));
+        return false;
+      }
+      const uint32_t cflag = head[1] >> 29;
+      const int64_t length = head[1] & ((1u << 29) - 1);
+      const int64_t payload = pos + 8;
+      if (payload + length > file_size) {
+        SetError("truncated record at offset " + std::to_string(pos));
+        return false;
+      }
+      parts.push_back({payload, length});
+      const int32_t part_idx = static_cast<int32_t>(parts.size()) - 1;
+      switch (cflag) {
+        case 0:
+          records.push_back({part_idx, 1, length});
+          break;
+        case 1:
+          pending_first = part_idx;
+          break;
+        case 2:
+          break;
+        case 3: {
+          if (pending_first < 0) {
+            SetError("split-record end without begin at offset " +
+                     std::to_string(pos));
+            return false;
+          }
+          int64_t total = 0;
+          for (int32_t p = pending_first; p <= part_idx; ++p)
+            total += parts[p].length;
+          records.push_back(
+              {pending_first, part_idx - pending_first + 1, total});
+          pending_first = -1;
+          break;
+        }
+      }
+      pos = payload + ((length + 3) / 4) * 4;  // pad to 4
+    }
+    if (pending_first >= 0) {
+      SetError("file ends inside a split record");
+      return false;
+    }
+    return true;
+  }
+
+  int64_t ReadRecord(int64_t i, uint8_t* out) const {
+    if (i < 0 || i >= static_cast<int64_t>(records.size())) {
+      SetError("record index out of range");
+      return -1;
+    }
+    const LogicalRecord& rec = records[i];
+    int64_t written = 0;
+    for (int32_t p = rec.first_part; p < rec.first_part + rec.num_parts;
+         ++p) {
+      int64_t remaining = parts[p].length;
+      int64_t off = parts[p].offset;
+      while (remaining > 0) {
+        const ssize_t got = pread(fd, out + written, remaining, off);
+        if (got <= 0) {
+          SetError("pread failed");
+          return -1;
+        }
+        written += got;
+        off += got;
+        remaining -= got;
+      }
+    }
+    return written;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+RecordReaderHandle MXTRecordReaderOpen(const char* path) {
+  auto* r = new RecordReader();
+  r->fd = open(path, O_RDONLY);
+  if (r->fd < 0) {
+    SetError(std::string("cannot open ") + path);
+    delete r;
+    return nullptr;
+  }
+  if (!r->Scan()) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+void MXTRecordReaderClose(RecordReaderHandle h) {
+  delete static_cast<RecordReader*>(h);
+}
+
+int64_t MXTRecordReaderNumRecords(RecordReaderHandle h) {
+  return static_cast<RecordReader*>(h)->records.size();
+}
+
+int64_t MXTRecordReaderRecordLen(RecordReaderHandle h, int64_t i) {
+  auto* r = static_cast<RecordReader*>(h);
+  if (i < 0 || i >= static_cast<int64_t>(r->records.size())) return -1;
+  return r->records[i].total_len;
+}
+
+int64_t MXTRecordReaderRecordOffset(RecordReaderHandle h, int64_t i) {
+  /* File offset of record i's framing header — the same value the
+   * python writer stores in the .idx sidecar, enabling offset->position
+   * mapping for subset/reordered index files. */
+  auto* r = static_cast<RecordReader*>(h);
+  if (i < 0 || i >= static_cast<int64_t>(r->records.size())) return -1;
+  return r->parts[r->records[i].first_part].offset - 8;
+}
+
+int64_t MXTRecordReaderRead(RecordReaderHandle h, int64_t i, uint8_t* out) {
+  return static_cast<RecordReader*>(h)->ReadRecord(i, out);
+}
+
+int64_t MXTRecordReaderBatchLen(RecordReaderHandle h, const int64_t* idx,
+                                int64_t n) {
+  int64_t total = 0;
+  for (int64_t k = 0; k < n; ++k) {
+    const int64_t len = MXTRecordReaderRecordLen(h, idx[k]);
+    if (len < 0) {
+      SetError("record index out of range in batch");
+      return -1;
+    }
+    total += len;
+  }
+  return total;
+}
+
+int64_t MXTRecordReaderReadBatch(RecordReaderHandle h, const int64_t* idx,
+                                 int64_t n, uint8_t* out,
+                                 int64_t out_capacity, int64_t* offsets,
+                                 int64_t* lens, int nthreads) {
+  auto* r = static_cast<RecordReader*>(h);
+  // layout pass
+  int64_t total = 0;
+  for (int64_t k = 0; k < n; ++k) {
+    const int64_t len = MXTRecordReaderRecordLen(h, idx[k]);
+    if (len < 0) {
+      SetError("record index out of range in batch");
+      return -1;
+    }
+    offsets[k] = total;
+    lens[k] = len;
+    total += len;
+  }
+  if (total > out_capacity) {
+    SetError("batch buffer too small: need " + std::to_string(total));
+    return -1;
+  }
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > n) nthreads = static_cast<int>(n);
+  std::atomic<int64_t> next(0);
+  std::atomic<bool> failed(false);
+  std::mutex err_mu;
+  std::string err_msg;
+  auto worker = [&]() {
+    for (;;) {
+      const int64_t k = next.fetch_add(1);
+      if (k >= n || failed.load()) return;
+      if (r->ReadRecord(idx[k], out + offsets[k]) < 0) {
+        // g_last_error is thread_local: copy it out so the caller's
+        // thread can surface the real diagnostic
+        std::lock_guard<std::mutex> g(err_mu);
+        err_msg = g_last_error;
+        failed.store(true);
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < nthreads; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& t : pool) t.join();
+  if (failed.load()) {
+    SetError(err_msg.empty() ? "batch read failed" : err_msg);
+    return -1;
+  }
+  return total;
+}
+
+int64_t MXTRecordReaderSaveIndex(RecordReaderHandle h, const char* idx_path) {
+  auto* r = static_cast<RecordReader*>(h);
+  FILE* f = fopen(idx_path, "w");
+  if (!f) {
+    SetError(std::string("cannot open ") + idx_path);
+    return -1;
+  }
+  for (size_t i = 0; i < r->records.size(); ++i) {
+    // offset of the framing header (payload - 8), matching python's
+    // write_idx which records the record start
+    const int64_t start = r->parts[r->records[i].first_part].offset - 8;
+    fprintf(f, "%zu\t%lld\n", i, static_cast<long long>(start));
+  }
+  fclose(f);
+  return r->records.size();
+}
+
+const char* MXTGetLastError() { return g_last_error.c_str(); }
+
+}  // extern "C"
