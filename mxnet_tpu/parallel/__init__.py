@@ -16,3 +16,5 @@ machinery with SPMD over a ``jax.sharding.Mesh``:
 from .mesh import make_mesh, local_mesh  # noqa: F401
 from .sharding import batch_pspec, param_pspec, shard_params  # noqa: F401
 from .trainer import SPMDTrainer  # noqa: F401
+from .sequence import (ring_attention, sequence_sharded_attention,  # noqa: F401
+                       ulysses_attention)
