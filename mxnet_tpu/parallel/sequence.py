@@ -37,6 +37,17 @@ __all__ = ["ring_attention", "ulysses_attention", "sequence_sharded_attention"]
 _NEG = -1e30
 
 
+def _check_seq_divides(q, k, mesh: Mesh, axis_name: str):
+    if axis_name not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    n = mesh.shape[axis_name]
+    for name, a in (("q", q), ("k/v", k)):
+        if a.shape[2] % n:
+            raise MXNetError(
+                f"{name} seq length {a.shape[2]} not divisible by mesh "
+                f"axis {axis_name!r} size {n}")
+
+
 def _block(q, k, v, kpos, qpos, scale, causal, carry):
     """One blockwise online-softmax accumulation step.
 
@@ -78,8 +89,17 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
         k_r, v_r, carry = acc
         src = (idx - r) % n  # who this block started on
         kpos = src * sk + jnp.arange(sk)
-        carry = _block(qf, k_r.astype(jnp.float32), v_r, kpos, qpos,
-                       scale, causal, carry)
+        if causal:
+            # with contiguous sharding a block from a later device is
+            # entirely masked (min kpos > max qpos) — skip its matmuls
+            carry = jax.lax.cond(
+                src <= idx,
+                lambda c: _block(qf, k_r.astype(jnp.float32), v_r, kpos,
+                                 qpos, scale, True, c),
+                lambda c: c, carry)
+        else:
+            carry = _block(qf, k_r.astype(jnp.float32), v_r, kpos, qpos,
+                           scale, False, carry)
         # rotate for the next step (the final rotate is dead but keeps the
         # loop body uniform; XLA overlaps it with the block compute)
         k_r = jax.lax.ppermute(k_r, axis_name, perm)
@@ -102,8 +122,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     wrapper shards seq over the mesh axis, each device keeps its Q block
     resident and K/V blocks rotate around the ring via ppermute.
     """
-    if axis_name not in mesh.axis_names:
-        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    _check_seq_divides(q, k, mesh, axis_name)
     spec = P(None, None, axis_name, None)
     fn = jax.shard_map(
         functools.partial(_ring_attn_local, axis_name=axis_name,
@@ -145,8 +164,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
 
     Requires heads % mesh.shape[axis_name] == 0. Inputs (B, H, S, D).
     """
-    if axis_name not in mesh.axis_names:
-        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    _check_seq_divides(q, k, mesh, axis_name)
     n = mesh.shape[axis_name]
     if q.shape[1] % n:
         raise MXNetError(

@@ -1,0 +1,132 @@
+"""Pipeline (GPipe) + expert-parallel MoE on the virtual 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.moe import moe_apply, top1_router
+from mxnet_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+
+def _stage(params, h):
+    return jnp.tanh(h @ params["w"] + params["b"])
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh({"pipe": 8})
+    rng = np.random.RandomState(0)
+    d = 16
+    stages = [{"w": jnp.asarray(rng.normal(0, 0.5, (d, d)).astype(np.float32)),
+               "b": jnp.asarray(rng.normal(0, 0.1, (d,)).astype(np.float32))}
+              for _ in range(8)]
+    x = jnp.asarray(rng.normal(0, 1, (32, d)).astype(np.float32))
+
+    expected = x
+    for p in stages:
+        expected = _stage(p, expected)
+
+    out = pipeline_apply(_stage, stack_stage_params(stages), x, mesh,
+                         n_microbatches=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_more_microbatches_and_grad():
+    mesh = make_mesh({"pipe": 4, "data": 2})
+    rng = np.random.RandomState(1)
+    d = 8
+    stages = [{"w": jnp.asarray(rng.normal(0, 0.5, (d, d)).astype(np.float32)),
+               "b": jnp.zeros((d,), jnp.float32)} for _ in range(4)]
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.normal(0, 1, (48, d)).astype(np.float32))
+
+    expected = x
+    for p in stages:
+        expected = _stage(p, expected)
+    out = pipeline_apply(_stage, stacked, x, mesh, n_microbatches=12)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+    @jax.jit
+    def loss(sp, x):
+        return pipeline_apply(_stage, sp, x, mesh, n_microbatches=6).sum()
+
+    g = jax.grad(loss)(stacked, x)
+    assert jax.tree.leaves(g)[0].shape[0] == 4
+    assert all(bool(jnp.all(jnp.isfinite(l))) for l in jax.tree.leaves(g))
+
+
+def _expert(params, tokens):
+    return jax.nn.relu(tokens @ params["w1"]) @ params["w2"]
+
+
+def test_moe_matches_dense_routing():
+    """With ample capacity, top-1 MoE == routing each token densely."""
+    mesh = make_mesh({"expert": 8})
+    rng = np.random.RandomState(2)
+    d, dh, n_experts, tokens = 16, 32, 8, 64
+    params = {
+        "w1": jnp.asarray(rng.normal(0, 0.3, (n_experts, d, dh))
+                          .astype(np.float32)),
+        "w2": jnp.asarray(rng.normal(0, 0.3, (n_experts, dh, d))
+                          .astype(np.float32)),
+    }
+    router_w = jnp.asarray(rng.normal(0, 1, (d, n_experts)).astype(np.float32))
+    x = jnp.asarray(rng.normal(0, 1, (tokens, d)).astype(np.float32))
+
+    out = moe_apply(x, router_w, params, _expert, mesh,
+                    capacity_factor=float(n_experts))  # capacity == T_loc
+
+    gate, idx = top1_router(x, router_w)
+    dense = np.stack([
+        np.asarray(gate)[t] * np.asarray(
+            _expert(jax.tree.map(lambda p, e=int(idx[t]): p[e], params),
+                    x[t:t + 1]))[0]
+        for t in range(tokens)])
+    np.testing.assert_allclose(np.asarray(out), dense, rtol=1e-4, atol=1e-4)
+
+
+def test_moe_capacity_overflow_drops_gracefully():
+    mesh = make_mesh({"expert": 8})
+    rng = np.random.RandomState(3)
+    d, n_experts, tokens = 8, 8, 64
+    params = {
+        "w1": jnp.asarray(rng.normal(0, 0.3, (n_experts, d, d))
+                          .astype(np.float32)),
+        "w2": jnp.asarray(rng.normal(0, 0.3, (n_experts, d, d))
+                          .astype(np.float32)),
+    }
+    # router heavily biased to expert 0 -> overflow at tight capacity
+    router_w = jnp.asarray(
+        np.concatenate([np.ones((d, 1)) * 3,
+                        rng.normal(0, 0.01, (d, n_experts - 1))],
+                       axis=1).astype(np.float32))
+    x = jnp.abs(jnp.asarray(rng.normal(0, 1, (tokens, d)).astype(np.float32)))
+    out = moe_apply(x, router_w, params, _expert, mesh, capacity_factor=1.0)
+    assert out.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(out)))
+    # multiple experts' devices saw zero-padded buffers; some rows dropped
+    # (zero output) is acceptable, NaN/inf is not
+
+
+def test_moe_multi_expert_per_device():
+    mesh = make_mesh({"expert": 4, "data": 2})
+    rng = np.random.RandomState(4)
+    d, n_experts, tokens = 8, 8, 32  # 2 experts per device
+    params = {
+        "w1": jnp.asarray(rng.normal(0, 0.3, (n_experts, d, d))
+                          .astype(np.float32)),
+        "w2": jnp.asarray(rng.normal(0, 0.3, (n_experts, d, d))
+                          .astype(np.float32)),
+    }
+    router_w = jnp.asarray(rng.normal(0, 1, (d, n_experts)).astype(np.float32))
+    x = jnp.asarray(rng.normal(0, 1, (tokens, d)).astype(np.float32))
+    out = moe_apply(x, router_w, params, _expert, mesh,
+                    capacity_factor=float(n_experts))
+    gate, idx = top1_router(x, router_w)
+    dense = np.stack([
+        np.asarray(gate)[t] * np.asarray(
+            _expert(jax.tree.map(lambda p, e=int(idx[t]): p[e], params),
+                    x[t:t + 1]))[0]
+        for t in range(tokens)])
+    np.testing.assert_allclose(np.asarray(out), dense, rtol=1e-4, atol=1e-4)
