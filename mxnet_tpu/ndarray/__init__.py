@@ -58,3 +58,5 @@ for _name, _opdef in OP_TABLE.items():
         setattr(_mod, _name, _make_op_func(_opdef, _name))
 
 del _mod, _name, _opdef
+
+from . import contrib  # noqa: F401,E402

@@ -3,10 +3,13 @@
 Importing this package populates the registry (reference analogue: static
 NNVM_REGISTER_OP initializers across src/operator/ executed at dlopen time).
 """
+from . import contrib_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
 from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import spatial_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from .registry import OP_TABLE, OpDef, get_op, list_ops, register  # noqa: F401

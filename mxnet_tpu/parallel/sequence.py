@@ -89,9 +89,11 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
         k_r, v_r, carry = acc
         src = (idx - r) % n  # who this block started on
         kpos = src * sk + jnp.arange(sk)
-        if causal:
-            # with contiguous sharding a block from a later device is
-            # entirely masked (min kpos > max qpos) — skip its matmuls
+        if causal and sq == sk:
+            # with contiguous equal-length sharding a block from a later
+            # device is entirely masked (min kpos > max qpos) — skip its
+            # matmuls; unequal q/k shard lengths fall through to the
+            # position mask below, which is always correct
             carry = jax.lax.cond(
                 src <= idx,
                 lambda c: _block(qf, k_r.astype(jnp.float32), v_r, kpos,
@@ -99,7 +101,7 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
                 lambda c: c, carry)
         else:
             carry = _block(qf, k_r.astype(jnp.float32), v_r, kpos, qpos,
-                           scale, False, carry)
+                           scale, causal, carry)
         # rotate for the next step (the final rotate is dead but keeps the
         # loop body uniform; XLA overlaps it with the block compute)
         k_r = jax.lax.ppermute(k_r, axis_name, perm)

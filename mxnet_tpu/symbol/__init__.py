@@ -45,6 +45,8 @@ for _name, _opdef in OP_TABLE.items():
 
 del _mod, _name, _opdef
 
+from . import contrib  # noqa: F401,E402
+
 
 def zeros(shape, dtype="float32", **kwargs):
     return _sys.modules[__name__]._zeros(shape=shape, dtype=dtype, **kwargs)

@@ -1,0 +1,14 @@
+"""``sym.contrib`` namespace: symbolic constructors for ``_contrib_`` ops.
+
+Reference analogue: python/mxnet/symbol/op.py contrib-module codegen.
+"""
+import sys as _sys
+
+from ..ops.registry import OP_TABLE
+
+_parent = _sys.modules[__name__.rsplit(".", 1)[0]]
+_mod = _sys.modules[__name__]
+for _name in list(OP_TABLE):
+    if _name.startswith("_contrib_"):
+        setattr(_mod, _name[len("_contrib_"):], getattr(_parent, _name))
+del _mod, _parent, _name

@@ -1,0 +1,290 @@
+"""Contrib + spatial operator tests (SSD multibox, ROI, proposal, CTC, fft,
+quantize, sketch, warping, correlation) against independent numpy refs."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+
+
+# --------------------------- MultiBox ---------------------------
+
+
+def test_multibox_prior_basic():
+    data = nd.zeros((1, 3, 4, 6))
+    out = nd.contrib.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1, 2))
+    a = _np(out)
+    assert a.shape == (1, 4 * 6 * 3, 4)
+    # first anchor at pixel (0,0): center ((0+.5)/6, (0+.5)/4), size .5
+    cx, cy = 0.5 / 6, 0.5 / 4
+    np.testing.assert_allclose(a[0, 0], [cx - .25, cy - .25, cx + .25,
+                                         cy + .25], rtol=1e-5)
+    # ratio-2 anchor: w = s*sqrt(2), h = s/sqrt(2)
+    w = 0.5 * np.sqrt(2) / 2
+    h = 0.5 / np.sqrt(2) / 2
+    np.testing.assert_allclose(a[0, 2], [cx - w, cy - h, cx + w, cy + h],
+                               rtol=1e-5)
+
+
+def test_multibox_target_matching():
+    # 4 anchors, 1 gt that overlaps anchor 0 perfectly
+    anchors = nd.array(np.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9],
+          [0.0, 0.0, 0.2, 0.2], [0.5, 0.1, 0.9, 0.5]]], np.float32))
+    label = nd.array(np.array([[[1, 0.1, 0.1, 0.4, 0.4]]], np.float32))
+    cls_pred = nd.zeros((1, 3, 4))
+    loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5)
+    assert _np(cls_t)[0, 0] == 2.0  # class 1 -> target 2 (0 is background)
+    assert _np(cls_t)[0, 1] == 0.0
+    m = _np(loc_mask).reshape(4, 4)
+    assert m[0].sum() == 4 and m[1].sum() == 0
+    # perfect match -> zero offsets
+    np.testing.assert_allclose(_np(loc_t).reshape(4, 4)[0], 0, atol=1e-5)
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = nd.array(np.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.12, 0.1, 0.42, 0.4],
+          [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    # class probs: anchor0/1 -> class 1, anchor2 -> class 2
+    cls_prob = nd.array(np.array([[
+        [0.1, 0.2, 0.1],    # background
+        [0.8, 0.7, 0.1],    # class 1
+        [0.1, 0.1, 0.8]]], np.float32))
+    loc_pred = nd.zeros((1, 12))
+    out = _np(nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                           nms_threshold=0.5))
+    assert out.shape == (1, 3, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    # anchor1 suppressed by anchor0 (same class, IOU > .5)
+    assert len(kept) == 2
+    cls_ids = sorted(kept[:, 0].tolist())
+    assert cls_ids == [0.0, 1.0]  # class ids shifted past background
+    row = kept[kept[:, 0] == 0.0][0]
+    np.testing.assert_allclose(row[2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+
+
+# --------------------------- ROI pooling ---------------------------
+
+
+def test_roi_pooling_matches_manual():
+    rng = np.random.RandomState(0)
+    data = rng.rand(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7], [0, 2, 2, 5, 5]], np.float32)
+    out = _np(nd.ROIPooling(nd.array(data), nd.array(rois),
+                            pooled_size=(2, 2), spatial_scale=1.0))
+    assert out.shape == (2, 2, 2, 2)
+    # roi 0 covers the full 8x8 map: 2x2 max pool over 4x4 quadrants
+    man = data[0, :, :, :].reshape(2, 2, 4, 2, 4).max(axis=(2, 4))
+    np.testing.assert_allclose(out[0], man, rtol=1e-6)
+
+
+def test_psroi_pooling_shape_and_average():
+    rng = np.random.RandomState(1)
+    p, od = 2, 3
+    data = rng.rand(1, od * p * p, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out = _np(nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                      spatial_scale=1.0, output_dim=od,
+                                      pooled_size=p))
+    assert out.shape == (1, od, p, p)
+    # bin (0,0) of output dim 0 averages channel group 0 over rows 0-2
+    exp = data[0, 0, 0:3, 0:3].mean()
+    np.testing.assert_allclose(out[0, 0, 0, 0], exp, rtol=1e-5)
+
+
+# --------------------------- Proposal ---------------------------
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(2)
+    a = 3  # 1 scale x 3 ratios
+    cls = rng.rand(1, 2 * a, 4, 4).astype(np.float32)
+    bbox = (rng.rand(1, 4 * a, 4, 4).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = _np(nd.contrib.Proposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        scales=(8,), ratios=(0.5, 1, 2), feature_stride=16,
+        rpn_pre_nms_top_n=20, rpn_post_nms_top_n=10, rpn_min_size=1))
+    assert rois.shape == (10, 5)
+    assert np.all(rois[:, 1:] >= 0) and np.all(rois[:, [1, 3]] <= 63)
+    assert np.all(rois[:, 3] >= rois[:, 1]) and np.all(rois[:, 4] >= rois[:, 2])
+
+
+# --------------------------- CTC loss ---------------------------
+
+
+def _ctc_ref(probs, labels):
+    """Brute-force CTC: sum over all alignments (tiny cases only).
+
+    probs (T, C) post-softmax; labels list of ints (no blanks)."""
+    import itertools
+    t = probs.shape[0]
+    total = 0.0
+    for path in itertools.product(range(probs.shape[1]), repeat=t):
+        # collapse path: remove repeats then blanks (blank=0)
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != 0]
+        if collapsed == list(labels):
+            p = 1.0
+            for ti, s in enumerate(path):
+                p *= probs[ti, s]
+            total += p
+    return -np.log(total)
+
+
+def test_ctc_loss_vs_bruteforce():
+    rng = np.random.RandomState(3)
+    t_len, n, c = 4, 2, 3
+    acts = rng.normal(0, 1, (t_len, n, c)).astype(np.float32)
+    labels = np.array([[1, 2], [2, 0]], np.float32)  # second: length 1
+    out = _np(nd.contrib.CTCLoss(nd.array(acts), nd.array(labels)))
+    probs = np.exp(acts) / np.exp(acts).sum(-1, keepdims=True)
+    exp0 = _ctc_ref(probs[:, 0], [1, 2])
+    exp1 = _ctc_ref(probs[:, 1], [2])
+    np.testing.assert_allclose(out, [exp0, exp1], rtol=1e-4)
+
+
+def test_ctc_loss_gradient_finite():
+    rng = np.random.RandomState(4)
+    x = mx.nd.array(rng.normal(0, 1, (5, 2, 4)).astype(np.float32))
+    x.attach_grad()
+    labels = mx.nd.array(np.array([[1, 3], [2, 0]], np.float32))
+    with mx.autograd.record():
+        loss = nd.contrib.CTCLoss(x, labels)
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0
+
+
+# --------------------------- fft / ifft ---------------------------
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(5)
+    x = rng.rand(3, 8).astype(np.float32)
+    f = _np(nd.contrib.fft(nd.array(x)))
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, atol=1e-4)
+    back = _np(nd.contrib.ifft(nd.array(f))) / 8  # unnormalized, as cuFFT
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+# --------------------------- count_sketch ---------------------------
+
+
+def test_count_sketch():
+    x = np.array([[1., 2., 3., 4.]], np.float32)
+    h = np.array([[0, 1, 0, 2]], np.float32)
+    s = np.array([[1, -1, 1, 1]], np.float32)
+    out = _np(nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                      out_dim=3))
+    np.testing.assert_allclose(out, [[4., -2., 4.]], rtol=1e-6)
+
+
+# --------------------------- quantize ---------------------------
+
+
+def test_quantize_int8_symmetric():
+    x = np.array([[-0.5, 0.0, 1.0]], np.float32)
+    q, mn, mx_ = nd.contrib.quantize(nd.array(x), nd.array([-0.5]),
+                                     nd.array([1.0]), out_type="int8")
+    qa = _np(q)
+    assert qa.dtype == np.int8
+    assert qa[0, 1] == 0  # zero maps to zero (symmetric scaling)
+    np.testing.assert_allclose(_np(mn), [-1.0])
+    back = _np(nd.contrib.dequantize(q, mn, mx_))
+    np.testing.assert_allclose(back, x, atol=1.0 / 127)
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(6)
+    x = (rng.rand(4, 5).astype(np.float32) - 0.3) * 10
+    q, mn, mx_ = nd.contrib.quantize(nd.array(x), nd.array([x.min()]),
+                                     nd.array([x.max()]))
+    assert _np(q).dtype == np.uint8
+    back = _np(nd.contrib.dequantize(q, mn, mx_))
+    step = (x.max() - x.min()) / 255
+    assert np.abs(back - x).max() <= step
+
+
+# --------------------------- warping ---------------------------
+
+
+def test_grid_generator_identity_affine():
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    g = _np(nd.GridGenerator(theta, transform_type="affine",
+                             target_shape=(3, 5)))
+    assert g.shape == (1, 2, 3, 5)
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 5), atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.RandomState(7)
+    data = rng.rand(1, 2, 4, 5).astype(np.float32)
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(4, 5))
+    out = _np(nd.BilinearSampler(nd.array(data), grid))
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_spatial_transformer_shift():
+    data = np.zeros((1, 1, 5, 5), np.float32)
+    data[0, 0, 2, 2] = 1.0
+    # x' = x + 0.5 in normalized coords -> sample from right half
+    theta = nd.array(np.array([[1, 0, 0.5, 0, 1, 0]], np.float32))
+    out = _np(nd.SpatialTransformer(nd.array(data), theta,
+                                    target_shape=(5, 5),
+                                    transform_type="affine",
+                                    sampler_type="bilinear"))
+    # source x = grid x + 1 pixel (0.5 * (5-1)/2 = 1): peak moves left
+    assert out[0, 0, 2, 1] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_correlation_zero_displacement_self():
+    rng = np.random.RandomState(8)
+    x = rng.rand(1, 3, 6, 6).astype(np.float32)
+    out = _np(nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                             max_displacement=0, stride1=1, stride2=1,
+                             pad_size=0, is_multiply=True))
+    assert out.shape == (1, 1, 6, 6)
+    np.testing.assert_allclose(out[0, 0], (x[0] ** 2).mean(0), rtol=1e-5)
+
+
+def test_correlation_displacement_grid():
+    rng = np.random.RandomState(9)
+    a = rng.rand(1, 2, 5, 5).astype(np.float32)
+    b = rng.rand(1, 2, 5, 5).astype(np.float32)
+    out = _np(nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                             max_displacement=1, stride1=1, stride2=1,
+                             pad_size=1, is_multiply=True))
+    assert out.shape == (1, 9, 5, 5)
+    # center displacement channel (index 4) == mean over C of a*b
+    np.testing.assert_allclose(out[0, 4, 1:4, 1:4],
+                               (a[0] * b[0]).mean(0)[1:4, 1:4], rtol=1e-5)
+
+
+# --------------------------- namespaces ---------------------------
+
+
+def test_contrib_symbol_namespace():
+    import mxnet_tpu.symbol as sym
+    d = sym.var("data")
+    s = sym.contrib.MultiBoxPrior(d, sizes=(0.5,), ratios=(1.0,))
+    _, out_shapes, _ = s.infer_shape(data=(1, 3, 4, 4))
+    assert out_shapes[0] == (1, 16, 4)

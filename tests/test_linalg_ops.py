@@ -1,0 +1,97 @@
+"""linalg_* operator tests vs numpy, incl. gradients via the test harness."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def _spd(rng, b, n):
+    a = rng.normal(0, 1, (b, n, n))
+    return (a @ a.transpose(0, 2, 1) + n * np.eye(n)).astype(np.float32)
+
+
+def test_gemm_and_gemm2():
+    rng = np.random.RandomState(0)
+    a = rng.rand(2, 3, 4).astype(np.float32)
+    b = rng.rand(2, 3, 5).astype(np.float32)
+    c = rng.rand(2, 4, 5).astype(np.float32)
+    out = _np(nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                             transpose_a=True, alpha=2.0, beta=0.5))
+    exp = 2.0 * a.transpose(0, 2, 1) @ b + 0.5 * c
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+    out2 = _np(nd.linalg_gemm2(nd.array(a), nd.array(b), transpose_a=True))
+    np.testing.assert_allclose(out2, a.transpose(0, 2, 1) @ b, rtol=1e-5)
+
+
+def test_potrf_potri_sumlogdiag():
+    rng = np.random.RandomState(1)
+    a = _spd(rng, 2, 4)
+    l = _np(nd.linalg_potrf(nd.array(a)))
+    np.testing.assert_allclose(l @ l.transpose(0, 2, 1), a, rtol=1e-4,
+                               atol=1e-4)
+    inv = _np(nd.linalg_potri(nd.array(l)))
+    np.testing.assert_allclose(inv, np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+    sld = _np(nd.linalg_sumlogdiag(nd.array(l)))
+    np.testing.assert_allclose(sld, np.log(np.diagonal(
+        l, axis1=1, axis2=2)).sum(-1), rtol=1e-5)
+    # logdet identity: 2*sumlogdiag(chol(A)) == logdet(A)
+    np.testing.assert_allclose(2 * sld, np.linalg.slogdet(a)[1], rtol=1e-4)
+
+
+def test_trmm_trsm_inverse_pair():
+    rng = np.random.RandomState(2)
+    l = np.tril(rng.rand(2, 4, 4) + np.eye(4)).astype(np.float32)
+    b = rng.rand(2, 4, 3).astype(np.float32)
+    prod = _np(nd.linalg_trmm(nd.array(l), nd.array(b)))
+    np.testing.assert_allclose(prod, l @ b, rtol=1e-5)
+    back = _np(nd.linalg_trsm(nd.array(l), nd.array(prod)))
+    np.testing.assert_allclose(back, b, rtol=1e-4, atol=1e-5)
+    # rightside + transpose
+    br = rng.rand(2, 3, 4).astype(np.float32)
+    pr = _np(nd.linalg_trmm(nd.array(l), nd.array(br), rightside=True,
+                            transpose=True))
+    np.testing.assert_allclose(pr, br @ l.transpose(0, 2, 1), rtol=1e-5)
+    bk = _np(nd.linalg_trsm(nd.array(l), nd.array(pr), rightside=True,
+                            transpose=True))
+    np.testing.assert_allclose(bk, br, rtol=1e-4, atol=1e-5)
+
+
+def test_trmm_ignores_upper_triangle():
+    rng = np.random.RandomState(5)
+    a = rng.rand(3, 3).astype(np.float32)  # full matrix, garbage upper
+    b = rng.rand(3, 2).astype(np.float32)
+    out = _np(nd.linalg_trmm(nd.array(a), nd.array(b)))
+    np.testing.assert_allclose(out, np.tril(a) @ b, rtol=1e-5)
+
+
+def test_gemm_gradient():
+    import mxnet_tpu.symbol as sym
+    a = sym.var("A")
+    b = sym.var("B")
+    c = sym.var("C")
+    s = sym.linalg_gemm(a, b, c, transpose_b=True)
+    rng = np.random.RandomState(3)
+    check_numeric_gradient(
+        s, [rng.rand(2, 3).astype(np.float64),
+            rng.rand(4, 3).astype(np.float64),
+            rng.rand(2, 4).astype(np.float64)],
+        numeric_eps=1e-4, rtol=1e-2, atol=1e-3)
+
+
+def test_potrf_gradient_finite():
+    rng = np.random.RandomState(4)
+    a = mx.nd.array(_spd(rng, 1, 3))
+    a.attach_grad()
+    with mx.autograd.record():
+        l = nd.linalg_potrf(a)
+        loss = nd.linalg_sumlogdiag(l)
+    loss.backward()
+    g = a.grad.asnumpy()
+    # d logdet(A)/dA = A^-1 (and our loss = 0.5 logdet A)
+    np.testing.assert_allclose(
+        g, 0.5 * np.linalg.inv(a.asnumpy()), rtol=1e-3, atol=1e-4)
