@@ -33,6 +33,7 @@ from . import module as mod  # noqa: F401
 from . import callback  # noqa: F401
 from . import gluon  # noqa: F401
 from . import rnn  # noqa: F401
+from . import operator  # noqa: F401
 from . import optimizer  # noqa: F401
 from .io import DataBatch, DataIter  # noqa: F401
 from .base import MXNetError  # noqa: F401

@@ -201,11 +201,19 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         if not any_ct:
             continue
 
-        def fn_closed(*vals, _node=node):
-            return _node.run(*vals)
+        if node.opdef.grad_fn is not None:
+            # op supplies its own tape gradient (e.g. Custom: runs the
+            # user's python backward directly, no retracing / host
+            # callbacks — reference FGradient + CustomOp.backward)
+            in_cts = node.opdef.grad_fn(
+                node.attrs, node.rng, node.input_vals, node.out_arrays,
+                tuple(out_cts))
+        else:
+            def fn_closed(*vals, _node=node):
+                return _node.run(*vals)
 
-        _, vjp_fn = jax.vjp(fn_closed, *node.input_vals)
-        in_cts = vjp_fn(tuple(out_cts))
+            _, vjp_fn = jax.vjp(fn_closed, *node.input_vals)
+            in_cts = vjp_fn(tuple(out_cts))
         for inp, c in zip(node.inputs, in_cts):
             child = getattr(inp, "_ag_node", None)
             if child is not None:

@@ -1,0 +1,107 @@
+"""Frontend custom operators: ``CustomOp`` / ``CustomOpProp`` / ``register``.
+
+Reference surface: python/mxnet/operator.py:36-243 (CustomOp, CustomOpProp,
+the ``register`` decorator and the ctypes callback plumbing into
+src/operator/custom/custom.cc). Here registration is a plain dict consumed
+by the ``Custom`` table op (ops/custom_op.py), which runs the callbacks via
+``jax.pure_callback`` — no ctypes trampoline needed.
+
+Usage, identical to the reference:
+
+    @mx.operator.register("softmax")
+    class SoftmaxProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+        def list_arguments(self): return ['data', 'label']
+        def list_outputs(self): return ['output']
+        def infer_shape(self, in_shape): ...
+        def create_operator(self, ctx, shapes, dtypes): return Softmax()
+
+    out = mx.nd.Custom(x, y, op_type='softmax')
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ops.custom_op import CUSTOM_OP_REGISTRY
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+
+class CustomOp:
+    """Base class for the runtime half of a custom operator."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad request
+        (reference operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"invalid req {req!r}")
+
+
+class CustomOpProp:
+    """Base class for the declarative half (shapes/types/IO names).
+
+    ``need_top_grad``: whether backward wants the head gradient (loss-style
+    ops set False — reference operator.py:160)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0] if in_type else np.float32
+        return ([t] * len(self.list_arguments()),
+                [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under ``reg_name``."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                f"{prop_cls} must subclass mx.operator.CustomOpProp")
+        CUSTOM_OP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return dict(CUSTOM_OP_REGISTRY)

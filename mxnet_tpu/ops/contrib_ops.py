@@ -397,6 +397,10 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     im_info (1, 3) [height, width, scale] -> rois (post_nms, 5)."""
     if iou_loss:
         raise MXNetError("Proposal: iou_loss=True not supported")
+    if cls_prob.shape[0] != 1:
+        raise MXNetError(
+            f"Proposal only supports batch size 1 (reference "
+            f"proposal-inl.h), got {cls_prob.shape[0]}")
     _, ca, fh, fw = cls_prob.shape
     a = ca // 2
     base = _base_anchors(feature_stride, scales, ratios)  # (A, 4)

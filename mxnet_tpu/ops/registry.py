@@ -150,6 +150,17 @@ def resolve_inputs(opdef: "OpDef", args, kwargs, name: str,
     return inputs
 
 
+def populate_contrib(parent_module, target_module):
+    """Fill a ``contrib`` namespace module: every ``_contrib_*`` table op
+    already generated on ``parent_module`` is re-exported on
+    ``target_module`` with the prefix stripped (reference:
+    python/mxnet/ndarray/op.py contrib-module routing)."""
+    for name in list(OP_TABLE):
+        if name.startswith("_contrib_"):
+            setattr(target_module, name[len("_contrib_"):],
+                    getattr(parent_module, name))
+
+
 def get_op(name: str) -> OpDef:
     if name not in OP_TABLE:
         raise MXNetError(f"Unknown operator {name}")

@@ -4,11 +4,7 @@ Reference analogue: python/mxnet/symbol/op.py contrib-module codegen.
 """
 import sys as _sys
 
-from ..ops.registry import OP_TABLE
+from ..ops.registry import populate_contrib
 
-_parent = _sys.modules[__name__.rsplit(".", 1)[0]]
-_mod = _sys.modules[__name__]
-for _name in list(OP_TABLE):
-    if _name.startswith("_contrib_"):
-        setattr(_mod, _name[len("_contrib_"):], getattr(_parent, _name))
-del _mod, _parent, _name
+populate_contrib(_sys.modules[__name__.rsplit(".", 1)[0]],
+                 _sys.modules[__name__])

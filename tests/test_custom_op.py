@@ -1,0 +1,174 @@
+"""Custom-op bridge tests (reference: tests/python/unittest/test_operator.py
+test_custom_op and python/mxnet/operator.py semantics)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+@mx.operator.register("add2")
+class Add2Prop(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Add2()
+
+
+class Add2(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] + in_data[1])
+        self.assign(out_data[1], req[1], in_data[0] - in_data[1])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] + out_grad[1])
+        self.assign(in_grad[1], req[1], out_grad[0] - out_grad[1])
+
+
+def test_custom_forward_imperative():
+    x = nd.array(np.array([[1., 2.], [3., 4.]], np.float32))
+    y = nd.Custom(x, op_type="sqr")
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_custom_backward_autograd():
+    xv = np.array([[1., -2.], [0.5, 3.]], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="sqr")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * xv, rtol=1e-5)
+
+
+def test_custom_multi_output():
+    a = nd.array(np.array([1., 2.], np.float32))
+    b = nd.array(np.array([10., 20.], np.float32))
+    s, d = nd.Custom(a, b, op_type="add2")
+    np.testing.assert_allclose(s.asnumpy(), [11., 22.])
+    np.testing.assert_allclose(d.asnumpy(), [-9., -18.])
+
+
+def test_custom_multi_output_grad():
+    a = nd.array(np.array([1., 2.], np.float32))
+    b = nd.array(np.array([10., 20.], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        s, d = nd.Custom(a, b, op_type="add2")
+        loss = (2 * s + d).sum()
+    loss.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [3., 3.])  # 2+1
+    np.testing.assert_allclose(b.grad.asnumpy(), [1., 1.])  # 2-1
+
+
+def test_custom_symbolic():
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data, op_type="sqr", name="sq")
+    ex = out.simple_bind(data=(2, 3))
+    xv = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+    (y,) = ex.forward(is_train=True, data=xv)
+    np.testing.assert_allclose(y.asnumpy(), xv ** 2, rtol=1e-6)
+    ex.backward(out_grads=nd.array(np.ones((2, 3), np.float32)))
+    np.testing.assert_allclose(ex.grad_arrays[0].asnumpy(), 2 * xv,
+                               rtol=1e-5)
+
+
+def test_custom_stateful_forward_to_backward():
+    """State stashed on self in forward must be visible in backward
+    (reference pattern: the operator instance is reused)."""
+
+    @mx.operator.register("stateful_sq")
+    class StatefulProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return StatefulSq()
+
+    class StatefulSq(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.saved = in_data[0]
+            self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], 2 * self.saved * out_grad[0])
+
+    xv = np.array([1., 3.], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="stateful_sq")
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * xv, rtol=1e-5)
+
+
+def test_proposal_rejects_batch():
+    with pytest.raises(mx.base.MXNetError):
+        nd.contrib.Proposal(nd.zeros((2, 6, 4, 4)), nd.zeros((2, 12, 4, 4)),
+                            nd.array(np.array([[32, 32, 1]] * 2, np.float32)))
+
+
+def test_custom_unknown_type_errors():
+    with pytest.raises(mx.base.MXNetError):
+        nd.Custom(nd.zeros((2, 2)), op_type="no_such_op")
+
+
+def test_custom_prop_kwargs_passed_as_strings():
+    seen = {}
+
+    @mx.operator.register("scaler")
+    class ScaleProp(mx.operator.CustomOpProp):
+        def __init__(self, factor="1"):
+            super().__init__()
+            seen["factor"] = factor
+            self.factor = float(factor)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            factor = self.factor
+
+            class Scale(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * factor)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * factor)
+
+            return Scale()
+
+    x = nd.array(np.array([1., 2.], np.float32))
+    y = nd.Custom(x, factor=2.5, op_type="scaler")
+    np.testing.assert_allclose(y.asnumpy(), [2.5, 5.0])
+    assert seen["factor"] == "2.5"  # kwargs reach the prop as strings
