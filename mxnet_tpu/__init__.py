@@ -33,8 +33,13 @@ from . import module as mod  # noqa: F401
 from . import callback  # noqa: F401
 from . import gluon  # noqa: F401
 from . import rnn  # noqa: F401
+from . import config  # noqa: F401
+from . import monitor  # noqa: F401
 from . import operator  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import visualization  # noqa: F401
+from .monitor import Monitor  # noqa: F401
 from .io import DataBatch, DataIter  # noqa: F401
 from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, current_context, gpu, num_gpus, num_tpus, tpu  # noqa: F401

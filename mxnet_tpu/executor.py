@@ -16,16 +16,19 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd, random as _random
-from .base import MXNetError
+from .base import MXNetError, getenv
 from .ndarray import NDArray
 from .ndarray.ndarray import _as_jax
 
 __all__ = ["Executor", "build_graph_eval"]
 
 
-def build_graph_eval(symbol):
+def build_graph_eval(symbol, collect_all=False):
     """Build eval_fn(arg_vals: dict, aux_vals: dict, rng, is_train)
-    -> (outputs: list, aux_updates: dict). Pure and jax-traceable."""
+    -> (outputs: list, aux_updates: dict). Pure and jax-traceable.
+
+    With ``collect_all`` the outputs list holds every op output in
+    topological order instead of just the symbol's outputs (Monitor)."""
     nodes = symbol._topo_nodes()
     aux_ids = symbol._aux_node_ids()
     # deterministic per-random-node key folding
@@ -65,7 +68,11 @@ def build_graph_eval(symbol):
                         p, _ = node.inputs[in_idx]
                         if p.is_variable and id(p) in aux_ids:
                             aux_updates[p.name] = out[out_idx]
-        outputs = [values[(id(n), i)] for n, i in out_entries]
+        if collect_all:
+            outputs = [values[(id(n), i)] for n in nodes
+                       if not n.is_variable for i in range(n.num_outputs())]
+        else:
+            outputs = [values[(id(n), i)] for n, i in out_entries]
         return outputs, aux_updates
 
     return eval_fn
@@ -113,6 +120,11 @@ class Executor:
                     outs, aux_up = eval_fn(merged, aux_vals, rng, True)
                     return outs, aux_up
 
+                if getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int):
+                    # trade FLOPs for memory: recompute activations in the
+                    # backward pass (reference MXNET_BACKWARD_DO_MIRROR /
+                    # memonger — here XLA rematerialization)
+                    f = jax.checkpoint(f)
                 (outs, aux_up), vjp_fn = jax.vjp(f, diff)
                 cts = [hg if hg is not None else jnp.ones_like(o)
                        for o, hg in zip(outs, head_grads)]
@@ -120,8 +132,14 @@ class Executor:
                 (grads,) = vjp_fn((cts, zero_aux))
                 return outs, aux_up, grads
 
-            self._fwd = jax.jit(fwd, static_argnums=(3,))
-            self._fwd_bwd = jax.jit(fwd_bwd, static_argnums=(4,))
+            if getenv("MXTPU_EXEC_EAGER", 0, int):
+                # debugging mode: run un-jitted, op by op (reference
+                # MXNET_ENGINE_TYPE=NaiveEngine — engine.cc:31-41)
+                self._fwd = fwd
+                self._fwd_bwd = fwd_bwd
+            else:
+                self._fwd = jax.jit(fwd, static_argnums=(3,))
+                self._fwd_bwd = jax.jit(fwd_bwd, static_argnums=(4,))
         self._last = None  # (arg_vals, aux_vals, rng) of the last forward
 
     # -- API ----------------------------------------------------------------
@@ -150,12 +168,15 @@ class Executor:
         arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
         rng = _random.next_key()
-        outs, aux_up = self._fwd(arg_vals, aux_vals, rng, bool(is_train))
+        from . import profiler as _profiler
+        with _profiler.profile_scope("Forward", "executor", "symbolic",
+                                     sync=lambda: outs):
+            outs, aux_up = self._fwd(arg_vals, aux_vals, rng, bool(is_train))
         if is_train:
             for name, val in aux_up.items():
                 self.aux_dict[name]._set_data(val)
         self.outputs = [NDArray(o) for o in outs]
-        self._last = (arg_vals, aux_vals, rng)
+        self._last = (arg_vals, aux_vals, rng, bool(is_train))
         return self.outputs
 
     def backward(self, out_grads=None):
@@ -164,7 +185,7 @@ class Executor:
         double work by calling forward_backward."""
         if self._last is None:
             raise MXNetError("backward called before forward")
-        self._run_fwd_bwd(*self._last, out_grads)
+        self._run_fwd_bwd(*self._last[:3], out_grads)
 
     def forward_backward(self, out_grads=None, **kwargs):
         for name, val in kwargs.items():
@@ -183,9 +204,13 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             head_grads = [g._data if g is not None else None for g in out_grads]
-        outs, aux_up, grads = self._fwd_bwd(arg_vals, aux_vals, rng,
-                                            head_grads,
-                                            tuple(self._diff_args))
+        from . import profiler as _profiler
+        with _profiler.profile_scope("ForwardBackward", "executor",
+                                     "symbolic", sync=lambda: grads):
+            outs, aux_up, grads = self._fwd_bwd(arg_vals, aux_vals, rng,
+                                                head_grads,
+                                                tuple(self._diff_args))
+        self._last = (arg_vals, aux_vals, rng, True)
         self.outputs = [NDArray(o) for o in outs]
         for name, val in aux_up.items():
             self.aux_dict[name]._set_data(val)
@@ -198,6 +223,39 @@ class Executor:
                 buf._set_data(buf._data + g)
             else:
                 buf._set_data(g)
+
+    def internal_outputs(self):
+        """Evaluate and return {entry_name: NDArray} for EVERY op output in
+        the graph, using the last forward's inputs.
+
+        Reference analogue: MXExecutorSetMonitorCallback firing the monitor
+        per op output (src/c_api/c_api_executor.cc); here the internals are
+        produced by one extra jitted evaluation (XLA shares subexpressions
+        with nothing — it is a debugging path, run on demand by Monitor)."""
+        if self._last is None:
+            raise MXNetError("internal_outputs called before forward")
+        if not hasattr(self, "_internals_fn"):
+            nodes = self._symbol._topo_nodes()
+            names = []
+            for node in nodes:
+                if node.is_variable:
+                    continue
+                for i in range(node.num_outputs()):
+                    if node.num_outputs() == 1:
+                        names.append(f"{node.name}_output")
+                    else:
+                        out_name = (node.op.output_names[i]
+                                    if i < len(node.op.output_names)
+                                    else str(i))
+                        names.append(f"{node.name}_{out_name}")
+            eval_fn = build_graph_eval(self._symbol, collect_all=True)
+            self._internals_fn = jax.jit(eval_fn, static_argnums=(3,))
+            self._internals_names = names
+        arg_vals, aux_vals, rng, is_train = self._last
+        # same rng + same is_train as the real pass: dropout masks and BN
+        # mode match what actually executed
+        vals, _ = self._internals_fn(arg_vals, aux_vals, rng, is_train)
+        return {n: NDArray(v) for n, v in zip(self._internals_names, vals)}
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Return an executor for new input shapes. Compilation is cached by

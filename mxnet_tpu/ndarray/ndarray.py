@@ -482,12 +482,17 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
     is_train = autograd.is_training()
     if opdef.needs_is_train:
         call_attrs["_is_train"] = is_train
+    if opdef.stateful:
+        call_attrs["_op_state"] = {}
     rng = None
-    if opdef.needs_rng:
-        rng = _random.next_key()
-        outputs = opdef.fn(rng, *vals, **call_attrs)
-    else:
-        outputs = opdef.fn(*vals, **call_attrs)
+    from .. import profiler as _profiler
+    with _profiler.profile_scope(opdef.name, "operator", "imperative",
+                                 sync=lambda: outputs):
+        if opdef.needs_rng:
+            rng = _random.next_key()
+            outputs = opdef.fn(rng, *vals, **call_attrs)
+        else:
+            outputs = opdef.fn(*vals, **call_attrs)
     if not isinstance(outputs, tuple):
         outputs = (outputs,)
 

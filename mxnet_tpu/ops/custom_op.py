@@ -29,33 +29,47 @@ def _as_ndarrays(np_arrays):
     return [nd.array(a) for a in np_arrays]
 
 
+_PROP_CACHE: Dict[tuple, object] = {}
+
+
 def _instantiate(op_type: str, kwargs):
     if op_type not in CUSTOM_OP_REGISTRY:
         raise MXNetError(
             f"Custom op type {op_type!r} not registered; known: "
             f"{sorted(CUSTOM_OP_REGISTRY)}")
-    # the reference passes all kwargs to the prop as strings
-    # (custom.cc stores them as key/value strings)
-    return CUSTOM_OP_REGISTRY[op_type](
-        **{k: str(v) for k, v in kwargs.items()})
-
-
-# one operator instance per (op_type, kwargs, shapes, dtypes) signature so
-# state stashed in forward() is visible to backward() — the reference
-# creates the operator once per bound executor node and reuses it
-_OPERATOR_CACHE: Dict[tuple, object] = {}
+    # the reference passes all kwargs to the prop as strings (custom.cc
+    # stores them as key/value strings); props are declarative, so one
+    # instance per (type, kwargs) signature is reused across calls
+    key = (op_type, tuple(sorted((k, str(v)) for k, v in kwargs.items())))
+    prop = _PROP_CACHE.get(key)
+    if prop is None or CUSTOM_OP_REGISTRY[op_type] is not type(prop):
+        prop = CUSTOM_OP_REGISTRY[op_type](
+            **{k: str(v) for k, v in kwargs.items()})
+        _PROP_CACHE[key] = prop
+    return prop
 
 
 class _CustomCall:
-    """Resolved shapes/types + the two numpy-level callbacks for one call."""
+    """Resolved shapes/types + the two numpy-level callbacks for one call.
 
-    def __init__(self, op_type, kwargs, in_shapes, in_types, is_train):
+    ``op_state``: a per-invocation holder dict (tape-carried for the
+    imperative path) in which the created operator instance lives, so
+    state stashed on ``self`` in forward() is visible in that same call's
+    backward() — the reference's OpStatePtr semantics. Without a holder the
+    instance is kept on this object (one per trace for the symbolic path).
+    """
+
+    def __init__(self, op_type, kwargs, in_shapes, in_types, is_train,
+                 op_state=None):
         self.prop = _instantiate(op_type, kwargs)
         self.op_type = op_type
-        self._cache_key = (op_type, tuple(sorted(
-            (k, str(v)) for k, v in kwargs.items())),
-            tuple(tuple(s) for s in in_shapes),
-            tuple(str(t) for t in in_types))
+        self.op_state = op_state if op_state is not None else {}
+        if self.prop.list_auxiliary_states():
+            raise MXNetError(
+                f"Custom({op_type}): auxiliary states "
+                f"({self.prop.list_auxiliary_states()}) are not supported "
+                "by the Custom bridge — keep state on the operator instance "
+                "or pass it as an explicit input")
         self.n_in = len(self.prop.list_arguments())
         self.n_out = len(self.prop.list_outputs())
         if len(in_shapes) != self.n_in:
@@ -71,11 +85,11 @@ class _CustomCall:
         self.is_train = bool(is_train)
 
     def _operator(self):
-        op = _OPERATOR_CACHE.get(self._cache_key)
+        op = self.op_state.get("op")
         if op is None:
             op = self.prop.create_operator(None, self.in_shapes,
                                            self.in_types)
-            _OPERATOR_CACHE[self._cache_key] = op
+            self.op_state["op"] = op
         return op
 
     def fwd_cb(self, *np_in):
@@ -104,13 +118,14 @@ class _CustomCall:
 
 def _split_attrs(attrs):
     kwargs = {k: v for k, v in attrs.items()
-              if k not in ("op_type", "_is_train")}
+              if k not in ("op_type", "_is_train", "_op_state")}
     return attrs["op_type"], kwargs, attrs.get("_is_train", False)
 
 
-def _custom_fn(*inputs, op_type, _is_train=False, **kwargs):
+def _custom_fn(*inputs, op_type, _is_train=False, _op_state=None, **kwargs):
     call = _CustomCall(op_type, kwargs, [x.shape for x in inputs],
-                       [x.dtype for x in inputs], _is_train)
+                       [x.dtype for x in inputs], _is_train,
+                       op_state=_op_state)
     n_out = call.n_out
     traced = any(isinstance(x, jax.core.Tracer) for x in inputs)
     if not traced:
@@ -154,7 +169,8 @@ def _custom_grad_fn(attrs, rng, input_vals, out_vals, out_cts):
     what lets Custom ops train on backends without host callbacks."""
     op_type, kwargs, is_train = _split_attrs(attrs)
     call = _CustomCall(op_type, kwargs, [x.shape for x in input_vals],
-                       [x.dtype for x in input_vals], is_train)
+                       [x.dtype for x in input_vals], is_train,
+                       op_state=attrs.get("_op_state"))
     arrs = [np.asarray(x) for x in (*input_vals, *out_vals, *out_cts)]
     return tuple(jnp.asarray(g) for g in call.bwd_cb(*arrs))
 
@@ -168,15 +184,14 @@ class _CustomOpDef(OpDef):
         return dict(raw_attrs)
 
     def num_outputs(self, attrs):
-        kwargs = {k: v for k, v in attrs.items()
-                  if k not in ("op_type", "_is_train")}
-        return len(_instantiate(attrs["op_type"], kwargs).list_outputs())
+        op_type, kwargs, _ = _split_attrs(attrs)
+        return len(_instantiate(op_type, kwargs).list_outputs())
 
 
 def _register_custom():
     op = _CustomOpDef(
         "Custom", _custom_fn, num_inputs=None, needs_is_train=True,
-        output_names=["output"], grad_fn=_custom_grad_fn)
+        output_names=["output"], grad_fn=_custom_grad_fn, stateful=True)
     OP_TABLE["Custom"] = op
 
 

@@ -51,6 +51,7 @@ class OpDef:
         grad_fn: Optional[Callable] = None,
         aux_inputs: Sequence[int] = (),
         param_shapes: Optional[Callable] = None,
+        stateful: bool = False,
     ):
         self.name = name
         self.fn = fn
@@ -77,6 +78,11 @@ class OpDef:
         # the simple_bind-side half of the reference's two-way InferShape
         # (src/executor/infer_graph_attr_pass.cc)
         self.param_shapes = param_shapes
+        # stateful ops get a per-invocation ``_op_state`` holder dict injected
+        # into their attrs on the imperative path; the autograd tape keeps it
+        # so forward-created state reaches backward (reference: stateful ops
+        # save an OpStatePtr on the tape — SURVEY.md §3.3)
+        self.stateful = stateful
 
     def num_outputs(self, attrs) -> int:
         if callable(self._num_outputs):

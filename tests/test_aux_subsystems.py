@@ -1,0 +1,175 @@
+"""Profiler / Monitor / visualization / config registry tests
+(reference: tests/python/unittest/test_profiler.py + monitor usage)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_profiler_imperative_trace(tmp_path):
+    fn = str(tmp_path / "trace.json")
+    mx.profiler.profiler_set_config(mode="imperative", filename=fn)
+    mx.profiler.profiler_set_state("run")
+    a = nd.ones((16, 16))
+    b = nd.dot(a, a)
+    (b + 1).asnumpy()
+    out = mx.profiler.dump_profile()
+    assert out == fn and os.path.exists(fn)
+    trace = json.load(open(fn))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "dot" in names
+    for e in trace["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] > 0
+
+
+def test_profiler_symbolic_trace(tmp_path):
+    fn = str(tmp_path / "strace.json")
+    mx.profiler.profiler_set_config(mode="symbolic", filename=fn)
+    mx.profiler.profiler_set_state("run")
+    sym = _mlp()
+    ex = sym.simple_bind(data=(4, 10))
+    ex.forward(is_train=False,
+               data=np.random.rand(4, 10).astype(np.float32))
+    ex.forward_backward(data=np.random.rand(4, 10).astype(np.float32),
+                        softmax_label=np.zeros(4, np.float32))
+    mx.profiler.dump_profile()
+    names = [e["name"] for e in json.load(open(fn))["traceEvents"]]
+    assert "Forward" in names and "ForwardBackward" in names
+
+
+def test_profiler_rejects_bad_args():
+    with pytest.raises(mx.base.MXNetError):
+        mx.profiler.profiler_set_config(mode="bogus")
+    with pytest.raises(mx.base.MXNetError):
+        mx.profiler.profiler_set_state("paused")
+
+
+def test_monitor_collects_matching_stats():
+    sym = _mlp()
+    ex = sym.simple_bind(data=(4, 10))
+    mon = mx.Monitor(interval=1, pattern="fc.*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True,
+               data=np.random.rand(4, 10).astype(np.float32),
+               softmax_label=np.zeros(4, np.float32))
+    stats = mon.toc()
+    names = {k for _, k, _ in stats}
+    assert "fc1_output" in names and "fc2_output" in names
+    assert not any(n.startswith("relu") for n in names)
+
+
+def test_monitor_interval_skips():
+    sym = _mlp()
+    ex = sym.simple_bind(data=(2, 10))
+    mon = mx.Monitor(interval=2)
+    mon.install(ex)
+    seen = []
+    for _ in range(4):
+        mon.tic()
+        ex.forward(is_train=False,
+                   data=np.random.rand(2, 10).astype(np.float32))
+        seen.append(len(mon.toc()) > 0)
+    assert seen == [True, False, True, False]
+
+
+def test_executor_internal_outputs_values():
+    data = mx.sym.var("data")
+    out = mx.sym.Activation(data, act_type="relu", name="r")
+    ex = out.simple_bind(data=(2, 3))
+    x = np.array([[-1, 0, 2], [3, -4, 5]], np.float32)
+    ex.forward(is_train=False, data=x)
+    vals = ex.internal_outputs()
+    np.testing.assert_allclose(vals["r_output"].asnumpy(),
+                               np.maximum(x, 0))
+
+
+def test_print_summary_counts_params(capsys):
+    sym = _mlp()
+    total = mx.visualization.print_summary(sym, shape={"data": (1, 10)})
+    # fc1: 10*8+8, fc2: 8*4+4
+    assert total == 10 * 8 + 8 + 8 * 4 + 4
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params" in out
+    assert "(1, 8)" in out  # fc1 output shape rendered
+
+
+def test_monitor_sees_current_batch_after_forward_backward():
+    sym = _mlp()
+    ex = sym.simple_bind(data=(2, 10))
+    mon = mx.Monitor(interval=1, pattern="fc1_output")
+    mon.install(ex)
+    x = np.full((2, 10), 2.0, np.float32)
+    mon.tic()
+    ex.forward_backward(data=x, softmax_label=np.zeros(2, np.float32))
+    stats = mon.toc()
+    assert stats, "monitor found nothing after forward_backward"
+    expected = ex.internal_outputs()["fc1_output"].asnumpy()
+    w = ex.arg_dict["fc1_weight"].asnumpy()
+    b = ex.arg_dict["fc1_bias"].asnumpy()
+    np.testing.assert_allclose(expected, x @ w.T + b, rtol=1e-5)
+
+
+def test_config_registry():
+    assert mx.config.get("MXTPU_PROFILER_AUTOSTART") == 0
+    os.environ["MXTPU_CPU_WORKER_NTHREADS"] = "7"
+    try:
+        assert mx.config.get("MXTPU_CPU_WORKER_NTHREADS") == 7
+    finally:
+        del os.environ["MXTPU_CPU_WORKER_NTHREADS"]
+    with pytest.raises(mx.base.MXNetError):
+        mx.config.get("MXTPU_NOT_A_KNOB")
+    desc = mx.config.describe()
+    assert "MXTPU_PROFILER_MODE" in desc
+
+
+def test_exec_eager_knob_matches_jit():
+    x = np.random.RandomState(0).rand(3, 5).astype(np.float32)
+    sym = _mlp()
+    ex = sym.simple_bind(data=(3, 5))
+    w = {n: a.asnumpy() for n, a in ex.arg_dict.items()}
+    ex.forward(is_train=False, data=x)
+    jit_out = ex.outputs[0].asnumpy()
+    os.environ["MXTPU_EXEC_EAGER"] = "1"
+    try:
+        ex2 = sym.simple_bind(data=(3, 5))
+        for n, a in ex2.arg_dict.items():
+            if n != "data":
+                a[:] = w[n]
+        ex2.forward(is_train=False, data=x)
+        np.testing.assert_allclose(ex2.outputs[0].asnumpy(), jit_out,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        del os.environ["MXTPU_EXEC_EAGER"]
+
+
+def test_backward_mirror_knob_same_grads():
+    x = np.random.RandomState(1).rand(4, 6).astype(np.float32)
+    y = np.zeros(4, np.float32)
+    sym = _mlp()
+    ex = sym.simple_bind(data=(4, 6))
+    w = {n: a.asnumpy() for n, a in ex.arg_dict.items()}
+    ex.forward_backward(data=x, softmax_label=y)
+    g1 = ex.grad_dict["fc1_weight"].asnumpy()
+    os.environ["MXTPU_BACKWARD_DO_MIRROR"] = "1"
+    try:
+        ex2 = sym.simple_bind(data=(4, 6))
+        for n, a in ex2.arg_dict.items():
+            a[:] = w[n]
+        ex2.forward_backward(data=x, softmax_label=y)
+        np.testing.assert_allclose(ex2.grad_dict["fc1_weight"].asnumpy(),
+                                   g1, rtol=1e-5, atol=1e-6)
+    finally:
+        del os.environ["MXTPU_BACKWARD_DO_MIRROR"]

@@ -134,6 +134,48 @@ def test_custom_stateful_forward_to_backward():
     np.testing.assert_allclose(x.grad.asnumpy(), 2 * xv, rtol=1e-5)
 
 
+def test_custom_stateful_interleaved_calls():
+    """Two overlapping applications must keep separate operator state."""
+
+    @mx.operator.register("stateful_sq2")
+    class StatefulProp2(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Op()
+
+    class Op(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.saved = in_data[0]
+            self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], 2 * self.saved * out_grad[0])
+
+    x1 = nd.array(np.array([1., 2.], np.float32))
+    x2 = nd.array(np.array([10., 20.], np.float32))
+    x1.attach_grad()
+    x2.attach_grad()
+    with mx.autograd.record():
+        y1 = nd.Custom(x1, op_type="stateful_sq2")
+        y2 = nd.Custom(x2, op_type="stateful_sq2")  # same shape/signature
+    y1.backward(retain_graph=True)
+    np.testing.assert_allclose(x1.grad.asnumpy(), [2., 4.])
+    y2.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), [20., 40.])
+
+
+def test_custom_aux_states_rejected():
+    @mx.operator.register("auxful")
+    class AuxProp(mx.operator.CustomOpProp):
+        def list_auxiliary_states(self):
+            return ["state"]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            raise AssertionError("should not get here")
+
+    with pytest.raises(mx.base.MXNetError):
+        nd.Custom(nd.zeros((2,)), op_type="auxful")
+
+
 def test_proposal_rejects_batch():
     with pytest.raises(mx.base.MXNetError):
         nd.contrib.Proposal(nd.zeros((2, 6, 4, 4)), nd.zeros((2, 12, 4, 4)),
