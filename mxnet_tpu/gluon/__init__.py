@@ -6,6 +6,7 @@ optimizers to ``Parameter``s; ``loss`` and ``nn``/``rnn`` supply layers.
 """
 from . import data  # noqa: F401
 from . import loss  # noqa: F401
+from . import model_zoo  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
 from . import utils  # noqa: F401

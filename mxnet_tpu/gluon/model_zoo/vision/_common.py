@@ -1,0 +1,10 @@
+"""Shared zoo-factory helpers."""
+from ....base import MXNetError
+
+
+def check_pretrained(pretrained):
+    """Every factory gates pretrained= here: no network egress in this
+    environment, so downloaded weights are unavailable by design."""
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network "
+                         "egress); use net.load_params(path)")
