@@ -45,7 +45,4 @@ def get_model(name, **kwargs):
         raise MXNetError(
             f"model {name!r} is not in the zoo; available: "
             f"{sorted(_models)}")
-    if kwargs.pop("pretrained", False):
-        raise MXNetError("pretrained weights unavailable (no network "
-                         "egress); use net.load_params(path)")
-    return _models[name](**kwargs)
+    return _models[name](**kwargs)  # factories gate pretrained= themselves

@@ -1,5 +1,6 @@
 """Shared zoo-factory helpers."""
 from ....base import MXNetError
+from ...block import HybridBlock
 
 
 def check_pretrained(pretrained):
@@ -8,3 +9,14 @@ def check_pretrained(pretrained):
     if pretrained:
         raise MXNetError("pretrained weights unavailable (no network "
                          "egress); use net.load_params(path)")
+
+
+class Concurrent(HybridBlock):
+    """Run child branches on the same input, concat along channels
+    (inception mixed blocks, fire expand, split 1x3/3x1 limbs)."""
+
+    def add(self, block):
+        self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[b(x) for b in self._children], dim=1)

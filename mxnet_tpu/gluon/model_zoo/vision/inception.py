@@ -2,7 +2,7 @@
 arch from Szegedy et al. 2015, 299x299 input)."""
 from ... import nn
 from ...block import HybridBlock
-from ._common import check_pretrained
+from ._common import Concurrent as _Concurrent, check_pretrained
 
 __all__ = ["Inception3", "inception_v3"]
 
@@ -14,17 +14,6 @@ def _conv2d(channels, kernel_size, strides=1, padding=0):
     out.add(nn.BatchNorm(epsilon=0.001))
     out.add(nn.Activation("relu"))
     return out
-
-
-class _Concurrent(HybridBlock):
-    """Run child branches on the same input and concat on channels."""
-
-    def add(self, block):
-        self.register_child(block)
-
-    def hybrid_forward(self, F, x):
-        outs = [b(x) for b in self._children]
-        return F.concat(*outs, dim=1)
 
 
 def _make_A(pool_features, prefix):
@@ -100,16 +89,12 @@ def _make_D(prefix):
     return out
 
 
-class _SplitConcat(HybridBlock):
+def _split_concat(channels):
     """1x3 / 3x1 split branches concatenated (inception E block limb)."""
-
-    def __init__(self, channels, **kwargs):
-        super().__init__(**kwargs)
-        self.a = _conv2d(channels, (1, 3), padding=(0, 1))
-        self.b = _conv2d(channels, (3, 1), padding=(1, 0))
-
-    def hybrid_forward(self, F, x):
-        return F.concat(self.a(x), self.b(x), dim=1)
+    out = _Concurrent(prefix="")
+    out.add(_conv2d(channels, (1, 3), padding=(0, 1)))
+    out.add(_conv2d(channels, (3, 1), padding=(1, 0)))
+    return out
 
 
 def _make_E(prefix):
@@ -118,12 +103,12 @@ def _make_E(prefix):
         out.add(_conv2d(320, 1))
         b3 = nn.HybridSequential(prefix="")
         b3.add(_conv2d(384, 1))
-        b3.add(_SplitConcat(384))
+        b3.add(_split_concat(384))
         out.add(b3)
         b33 = nn.HybridSequential(prefix="")
         b33.add(_conv2d(448, 1))
         b33.add(_conv2d(384, 3, padding=1))
-        b33.add(_SplitConcat(384))
+        b33.add(_split_concat(384))
         out.add(b33)
         bp = nn.HybridSequential(prefix="")
         bp.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))

@@ -46,13 +46,14 @@ class BottleneckV1(HybridBlock):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1,
-                                strides=stride))
+                                strides=stride, use_bias=False))
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
         self.body.add(_conv3x3(channels // 4, 1, channels // 4))
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
+        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
+                                use_bias=False))
         self.body.add(nn.BatchNorm())
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")

@@ -3,7 +3,7 @@ arch from Iandola et al. 2016)."""
 from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
-from ._common import check_pretrained
+from ._common import Concurrent as _Concurrent, check_pretrained
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -11,19 +11,13 @@ __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
     out = nn.HybridSequential(prefix="")
     out.add(nn.Conv2D(squeeze_channels, kernel_size=1, activation="relu"))
-    out.add(_FireExpand(expand1x1_channels, expand3x3_channels))
+    expand = _Concurrent(prefix="")
+    expand.add(nn.Conv2D(expand1x1_channels, kernel_size=1,
+                         activation="relu"))
+    expand.add(nn.Conv2D(expand3x3_channels, kernel_size=3, padding=1,
+                         activation="relu"))
+    out.add(expand)
     return out
-
-
-class _FireExpand(HybridBlock):
-    def __init__(self, e1, e3, **kwargs):
-        super().__init__(**kwargs)
-        self.conv1 = nn.Conv2D(e1, kernel_size=1, activation="relu")
-        self.conv3 = nn.Conv2D(e3, kernel_size=3, padding=1,
-                               activation="relu")
-
-    def hybrid_forward(self, F, x):
-        return F.concat(self.conv1(x), self.conv3(x), dim=1)
 
 
 class SqueezeNet(HybridBlock):

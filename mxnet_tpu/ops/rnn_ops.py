@@ -86,15 +86,13 @@ def _cell_step(mode, H):
     """Returns step(carry, gates_in) for one timestep given precomputed
     x-projection + biases; carry is h (and c for lstm)."""
     if mode == "lstm":
+        from .pallas.lstm import lstm_cell_fused
+
         def step(carry, xproj, w_h2h):
             h, c = carry
-            gates = xproj + h @ w_h2h.T
-            i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
-            f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
-            g = jnp.tanh(gates[:, 2 * H:3 * H])
-            o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
-            c_new = f * c + i * g
-            h_new = o * jnp.tanh(c_new)
+            # fused pallas cell on TPU (jnp elsewhere); custom VJP keeps
+            # the scan differentiable
+            h_new, c_new = lstm_cell_fused(xproj, h, c, w_h2h)
             return (h_new, c_new), h_new
         return step
     if mode == "gru":
