@@ -1,0 +1,38 @@
+"""Imperative linear regression with autograd (reference: imperative/gluon
+training style; autograd.record + backward + manual SGD)."""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=0.3)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    true_w = rng.normal(0, 1, (8, 1)).astype(np.float32)
+    x = rng.normal(0, 1, (256, 8)).astype(np.float32)
+    y = x @ true_w + 0.01 * rng.normal(0, 1, (256, 1)).astype(np.float32)
+    xs, ys = nd.array(x), nd.array(y)
+
+    w = nd.zeros((8, 1))
+    for i in range(args.iters):
+        w.attach_grad()
+        with mx.autograd.record():
+            loss = ((nd.dot(xs, w) - ys) ** 2).mean()
+        loss.backward()
+        w = nd.array(w.asnumpy() - args.lr * w.grad.asnumpy())
+        if i % 20 == 0:
+            print(f"iter {i:4d} loss {float(loss.asnumpy()):.6f}")
+    err = np.abs(w.asnumpy() - true_w).max()
+    print(f"weight error: {err:.4f}")
+    assert err < 0.05
+
+
+if __name__ == "__main__":
+    main()

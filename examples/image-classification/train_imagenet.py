@@ -1,0 +1,66 @@
+"""ImageNet-style training: symbolic ResNet over the SPMD mesh trainer.
+
+Reference analogue: example/image-classification/train_imagenet.py with
+its ``--benchmark 1`` mode (synthetic data, measures throughput). The
+multi-GPU `--gpus` flag becomes mesh axes: data parallelism over every
+visible device (and tensor parallelism via --model-parallel N).
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet")
+    ap.add_argument("--num-layers", type=int, default=50)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--image-shape", default="224,224,3")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--model-parallel", type=int, default=1,
+                    help="tensor-parallel degree (mesh 'model' axis)")
+    args = ap.parse_args()
+
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    n_dev = len(jax.devices())
+    dp = n_dev // args.model_parallel
+    mesh = make_mesh({"data": dp, "model": args.model_parallel})
+    print(f"devices: {n_dev} ({jax.devices()[0].platform}), "
+          f"mesh: data={dp} x model={args.model_parallel}")
+
+    sym = models.get_symbol(args.network, num_layers=args.num_layers,
+                            num_classes=args.num_classes,
+                            image_shape=args.image_shape, dtype=args.dtype)
+    h, w, c = (int(v) for v in args.image_shape.split(","))
+    tr = SPMDTrainer(sym, optimizer="sgd",
+                     optimizer_params={"learning_rate": args.lr,
+                                       "momentum": 0.9,
+                                       "rescale_grad": 1.0 / args.batch_size},
+                     mesh=mesh, compute_dtype=args.dtype)
+    tr.bind(data_shapes={"data": (args.batch_size, h, w, c)},
+            label_shapes={"softmax_label": (args.batch_size,)})
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(args.batch_size, h, w, c).astype(np.float32)
+    y = rng.randint(0, args.num_classes, args.batch_size).astype(np.float32)
+
+    tr.step({"data": x, "softmax_label": y})  # compile
+    tic = time.time()
+    for _ in range(args.iters):
+        out = tr.step({"data": x, "softmax_label": y})
+    jax.block_until_ready(out)
+    dt = (time.time() - tic) / args.iters
+    print(f"{args.network}-{args.num_layers} bs{args.batch_size}: "
+          f"{args.batch_size / dt:.1f} images/sec "
+          f"({args.batch_size / dt / n_dev:.1f}/chip)")
+
+
+if __name__ == "__main__":
+    main()

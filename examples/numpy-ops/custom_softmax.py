@@ -1,0 +1,68 @@
+"""Custom python operator: numpy softmax as a CustomOp.
+
+Reference analogue: example/numpy-ops/custom_softmax.py — the CustomOp /
+CustomOpProp registration pattern, trained through Module.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], mx.nd.array(e / e.sum(1, keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(int)
+        y = out_data[0].asnumpy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+        self.assign(in_grad[1], req[1], mx.nd.zeros(in_data[1].shape))
+
+
+@mx.operator.register("softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = rng.rand(512, 16).astype(np.float32)
+    w = rng.normal(0, 1, (16, 4))
+    y = (x @ w).argmax(1).astype(np.float32)
+
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.Custom(fc, label, op_type="softmax", name="softmax")
+
+    it = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    print(f"accuracy with custom softmax: {acc:.4f}")
+    assert acc > 0.9
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Profile a training run and dump a Chrome trace.
+
+Reference analogue: example/profiler/profiler_executor.py —
+profiler_set_config / set_state / dump_profile around a Module run; open
+the JSON in chrome://tracing or perfetto.dev.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filename", default="profile_training.json")
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    mx.profiler.profiler_set_config(mode="all", filename=args.filename)
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=256, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(data=(64, 128))
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 128).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.float32)
+
+    ex.forward(is_train=True, data=x, softmax_label=y)  # compile first
+    mx.profiler.profiler_set_state("run")
+    for _ in range(args.iters):
+        ex.forward_backward(data=x, softmax_label=y)
+    out = mx.profiler.dump_profile()
+    import json
+    n = len(json.load(open(out))["traceEvents"])
+    print(f"wrote {n} events to {out}")
+    assert n >= args.iters
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Toy single-shot detection with the MultiBox contrib ops.
+
+Reference analogue: example/ssd — MultiBoxPrior anchors, MultiBoxTarget
+matching/encoding, SmoothL1 + softmax losses, MultiBoxDetection decode.
+One conv backbone on synthetic images with one square object per image.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def make_scene(rng, size=32):
+    """Image with one bright square; returns (image CHW, box [cls,x1..y2])."""
+    img = rng.rand(3, size, size).astype(np.float32) * 0.2
+    w = rng.randint(12, 15)
+    x0 = rng.randint(0, size - w)
+    y0 = rng.randint(0, size - w)
+    img[:, y0:y0 + w, x0:x0 + w] += 0.8
+    box = np.array([0, x0 / size, y0 / size, (x0 + w) / size,
+                    (y0 + w) / size], np.float32)
+    return img, box
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=16)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+
+    num_cls = 1  # one foreground class
+    sizes, ratios = (0.3, 0.45), (1.0,)
+    n_anchor_sets = len(sizes) + len(ratios) - 1
+
+    net = mx.gluon.nn.HybridSequential()
+    with net.name_scope():
+        for ch in (16, 32, 32):
+            net.add(mx.gluon.nn.Conv2D(ch, 3, padding=1, activation="relu"))
+            net.add(mx.gluon.nn.MaxPool2D(2))
+    cls_head = mx.gluon.nn.Conv2D(n_anchor_sets * (num_cls + 1), 1)
+    loc_head = mx.gluon.nn.Conv2D(n_anchor_sets * 4, 1)
+    for b in (net, cls_head, loc_head):
+        b.initialize(init=mx.init.Xavier())
+    params = (list(net.collect_params().values())
+              + list(cls_head.collect_params().values())
+              + list(loc_head.collect_params().values()))
+    trainer = mx.gluon.Trainer(
+        {p.name: p for p in params}, "sgd", {"learning_rate": 0.5})
+
+    ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    for it in range(args.iters):
+        imgs, boxes = zip(*(make_scene(rng) for _ in range(args.batch_size)))
+        x = nd.array(np.stack(imgs))
+        labels = nd.array(np.stack(boxes)[:, None, :])  # (B, 1, 5)
+        with mx.autograd.record():
+            feat = net(x)  # (B, C, 4, 4)
+            anchors = nd.contrib.MultiBoxPrior(feat, sizes=sizes,
+                                               ratios=ratios)
+            cls_pred = cls_head(feat).reshape(
+                (args.batch_size, num_cls + 1, -1))
+            loc_pred = loc_head(feat).reshape((args.batch_size, -1))
+            # hard-negative mining keeps a 3:1 neg:pos ratio; the rest get
+            # ignore_label -1 and are masked out of the loss (standard SSD)
+            loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+                anchors, labels, cls_pred, negative_mining_ratio=3.0)
+            keep = cls_t >= 0
+            keep_w = keep.expand_dims(2)
+            cls_loss = ce(cls_pred.transpose((0, 2, 1)),
+                          nd.broadcast_maximum(cls_t, nd.zeros((1,))), keep_w)
+            cls_loss = cls_loss.sum() / nd.broadcast_maximum(
+                keep.sum(), nd.ones((1,)))
+            loc_loss = ((nd.smooth_l1(loc_pred - loc_t, scalar=1.0)
+                         * loc_m).sum()
+                        / nd.broadcast_maximum(loc_m.sum(), nd.ones((1,))))
+            loss = cls_loss + loc_loss
+        loss.backward()
+        trainer.step(args.batch_size)
+        if it % 30 == 0:
+            print(f"iter {it:4d} loss {float(loss.asnumpy()):.4f}")
+
+    # detect on a fresh scene and check IOU with the ground truth
+    img, box = make_scene(rng)
+    feat = net(nd.array(img[None]))
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=sizes, ratios=ratios)
+    cls_prob = nd.softmax(cls_head(feat).reshape((1, num_cls + 1, -1)),
+                          axis=1)
+    loc_pred = loc_head(feat).reshape((1, -1))
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       threshold=0.3).asnumpy()
+    kept = det[0][det[0, :, 0] >= 0]
+    assert len(kept), "no detections"
+
+    def iou_vs_gt(bx):
+        ix1, iy1 = max(bx[0], box[1]), max(bx[1], box[2])
+        ix2, iy2 = min(bx[2], box[3]), min(bx[3], box[4])
+        inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+        union = ((bx[2] - bx[0]) * (bx[3] - bx[1])
+                 + (box[3] - box[1]) * (box[4] - box[2]) - inter)
+        return inter / union
+
+    ious = [iou_vs_gt(k[2:]) for k in kept]
+    print(f"{len(kept)} detections; best score {kept[:, 1].max():.3f}, "
+          f"best IOU vs gt {max(ious):.3f}")
+    assert max(ious) > 0.4, "detector did not localize the object"
+
+
+if __name__ == "__main__":
+    main()

@@ -116,7 +116,7 @@ class NDArray:
 
     @property
     def T(self) -> "NDArray":
-        return NDArray(self._data.T)
+        return self.transpose()
 
     # -- engine bridge ------------------------------------------------------
     def wait_to_read(self):
@@ -143,7 +143,13 @@ class NDArray:
 
     # -- conversion ---------------------------------------------------------
     def asnumpy(self) -> _np.ndarray:
-        return _np.asarray(jax.device_get(self._data))
+        a = _np.asarray(jax.device_get(self._data))
+        if not a.flags.writeable:
+            # jax may hand back a read-only view of its host buffer; the
+            # reference's asnumpy always yields an owned, writable copy
+            # (callers mutate it, e.g. CustomOp backward)
+            a = a.copy()
+        return a
 
     def asscalar(self):
         if self.size != 1:
@@ -151,7 +157,8 @@ class NDArray:
         return self.asnumpy().reshape(()).item()
 
     def astype(self, dtype) -> "NDArray":
-        return NDArray(self._data.astype(jnp.dtype(dtype)))
+        return imperative_invoke("cast", [self],
+                                 {"dtype": _np.dtype(dtype).name})[0]
 
     def copy(self) -> "NDArray":
         return NDArray(self._data)
@@ -214,10 +221,71 @@ class NDArray:
         self._set_data(self._data.at[key].set(val))
 
     def __getitem__(self, key):
+        # route the common indexing forms through taped ops so gradients
+        # flow when indexing inside autograd.record() (reference: slicing
+        # is an op — slice/slice_axis/take — not a raw view); outside
+        # recording the raw jnp path is cheaper and bounds-checked the
+        # numpy way
         if isinstance(key, NDArray):
-            key = key._data.astype(jnp.int32)
-        out = self._data[key]
-        return NDArray(out)
+            if autograd.is_recording():
+                return imperative_invoke("take", [self, key], {"axis": 0})[0]
+            return NDArray(self._data[key._data.astype(jnp.int32)])
+        if autograd.is_recording() and 0 not in self.shape:
+            taped = self._getitem_taped(key)
+            if taped is not None:
+                return taped
+        return NDArray(self._data[key])  # fancy/stepped/eager: raw
+
+    def _index_axis(self, ax, k):
+        i = int(k)
+        n = self.shape[ax]
+        if i < -n or i >= n:
+            raise IndexError(
+                f"index {i} is out of bounds for axis {ax} with size {n}")
+        return i + (n if i < 0 else 0)
+
+    def _getitem_taped(self, key):
+        if isinstance(key, (int, _np.integer)):
+            i = self._index_axis(0, key)
+            out = imperative_invoke("slice_axis", [self],
+                                    {"axis": 0, "begin": i,
+                                     "end": i + 1})[0]
+            if self.ndim > 1:
+                return out.reshape(self.shape[1:])
+            # 1-D: scalar result; sum of the 1-element slice keeps the tape
+            return imperative_invoke("sum", [out], {})[0]
+        if isinstance(key, slice) and key.step in (None, 1):
+            b, e, _ = key.indices(self.shape[0])
+            return imperative_invoke("slice_axis", [self],
+                                     {"axis": 0, "begin": b, "end": e})[0]
+        if isinstance(key, tuple) and all(
+                (isinstance(k, (int, _np.integer))
+                 or (isinstance(k, slice) and k.step in (None, 1)))
+                for k in key) and len(key) <= self.ndim:
+            begin, end, drop = [], [], []
+            for ax, k in enumerate(key):
+                if isinstance(k, (int, _np.integer)):
+                    i = self._index_axis(ax, k)
+                    begin.append(i)
+                    end.append(i + 1)
+                    drop.append(ax)
+                else:
+                    b, e, _ = k.indices(self.shape[ax])
+                    begin.append(b)
+                    end.append(e)
+            out = imperative_invoke("slice", [self],
+                                    {"begin": tuple(begin),
+                                     "end": tuple(end)})[0]
+            if drop:
+                shape = [s for ax, s in enumerate(out.shape)
+                         if ax not in drop]
+                if not shape:
+                    # scalar: taped sum of the 1-element slice
+                    return imperative_invoke("sum", [out], {})[0]
+                out = imperative_invoke("reshape", [out],
+                                        {"shape": tuple(shape)})[0]
+            return out
+        return None
 
     # -- python protocol ----------------------------------------------------
     def __len__(self):
@@ -369,22 +437,25 @@ class NDArray:
             shape = (shape,) + args
         if isinstance(shape, int):
             shape = (shape,)
-        return NDArray(jnp.reshape(self._data, shape))
+        # route through the op so the autograd tape sees it
+        return imperative_invoke("reshape", [self], {"shape": shape})[0]
 
     def broadcast_to(self, shape):
         return imperative_invoke("broadcast_to", [self], {"shape": shape})[0]
 
     def transpose(self, axes=None):
-        return NDArray(jnp.transpose(self._data, axes))
+        return imperative_invoke("transpose", [self],
+                                 {"axes": tuple(axes) if axes else ()})[0]
 
     def swapaxes(self, dim1, dim2):
-        return NDArray(jnp.swapaxes(self._data, dim1, dim2))
+        return imperative_invoke("swapaxes", [self],
+                                 {"dim1": dim1, "dim2": dim2})[0]
 
     def flatten(self):
         return imperative_invoke("Flatten", [self], {})[0]
 
     def expand_dims(self, axis):
-        return NDArray(jnp.expand_dims(self._data, axis))
+        return imperative_invoke("expand_dims", [self], {"axis": axis})[0]
 
     def slice_axis(self, axis, begin, end):
         return imperative_invoke("slice_axis", [self],

@@ -428,18 +428,40 @@ class Symbol:
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
         type_dict = type_dict or {}
+
+        def _shared(pool_attr, name, shape, dtype):
+            # share same-name/shape/dtype arrays with the shared executor:
+            # bucketing executors must see ONE set of parameter/grad
+            # buffers (reference: shared data pool, graph_executor.cc:879)
+            if shared_exec is None:
+                return None
+            arr = getattr(shared_exec, pool_attr).get(name)
+            if arr is not None and tuple(arr.shape) == tuple(shape) \
+                    and str(arr.dtype) == str(jnp.dtype(dtype)):
+                return arr
+            return None
+
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
-            args[name] = nd_zeros(shape, dtype=type_dict.get(name, "float32"))
+            dt = type_dict.get(name, "float32")
+            arr = _shared("arg_dict", name, shape, dt)
+            args[name] = arr if arr is not None else nd_zeros(shape, dtype=dt)
         aux = {}
         for name, shape in zip(aux_names, aux_shapes):
-            aux[name] = nd_zeros(shape, dtype=type_dict.get(name, "float32"))
+            dt = type_dict.get(name, "float32")
+            arr = _shared("aux_dict", name, shape, dt)
+            aux[name] = arr if arr is not None else nd_zeros(shape, dtype=dt)
         if isinstance(grad_req, str):
             grad_req = {n: grad_req for n in arg_names}
         elif isinstance(grad_req, (list, tuple)):
             grad_req = dict(zip(arg_names, grad_req))
-        grads = {n: nd_zeros(args[n].shape, dtype=str(args[n].dtype))
-                 for n, r in grad_req.items() if r != "null"}
+        grads = {}
+        for n, r in grad_req.items():
+            if r == "null":
+                continue
+            arr = _shared("grad_dict", n, args[n].shape, str(args[n].dtype))
+            grads[n] = arr if arr is not None else nd_zeros(
+                args[n].shape, dtype=str(args[n].dtype))
         return Executor(self, ctx, args, grads, grad_req, aux,
                         shared_exec=shared_exec)
 

@@ -161,3 +161,44 @@ def test_broadcast():
     b = a.broadcast_to((2, 3))
     assert b.shape == (2, 3)
     np.testing.assert_allclose(b.asnumpy(), [[1, 1, 1], [2, 2, 2]])
+
+
+def test_getitem_bounds_checked_under_record():
+    import mxnet_tpu as mx
+    x = mx.nd.array(np.array([1., 2., 3.], np.float32))
+    with mx.autograd.record():
+        with pytest.raises(IndexError):
+            x[5]
+        with pytest.raises(IndexError):
+            x[-5]
+
+
+def test_scalar_tuple_index_grad():
+    import mxnet_tpu as mx
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x[0, 1] * 3
+    y.backward()
+    g = x.grad.asnumpy()
+    exp = np.zeros((2, 3), np.float32)
+    exp[0, 1] = 3
+    np.testing.assert_allclose(g, exp)
+
+
+def test_T_property_grad():
+    import mxnet_tpu as mx
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = (x.T * 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((2, 3), 2.0))
+
+
+def test_zero_size_indexing():
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((5, 0))
+    with mx.autograd.record():
+        y = x[2]
+    assert y.shape == (0,)
