@@ -9,7 +9,8 @@ units prefer; the reference's NCHW remains available via ``layout=``.
 from __future__ import annotations
 
 from ..base import MXNetError
-from . import alexnet, lenet, mlp, resnet, vgg  # noqa: F401
+from . import alexnet, lenet, mlp, resnet, transformer, vgg  # noqa: F401
+from .transformer import TransformerConfig, TransformerLM  # noqa: F401
 
 _MODELS = {
     "resnet": resnet.get_symbol,

@@ -245,6 +245,8 @@ class NDArray:
         return i + (n if i < 0 else 0)
 
     def _getitem_taped(self, key):
+        if isinstance(key, (bool, _np.bool_)):
+            return None  # bool adds an axis (numpy semantics): raw path
         if isinstance(key, (int, _np.integer)):
             i = self._index_axis(0, key)
             out = imperative_invoke("slice_axis", [self],
@@ -260,7 +262,8 @@ class NDArray:
                                      {"axis": 0, "begin": b, "end": e})[0]
         if isinstance(key, tuple) and all(
                 (isinstance(k, (int, _np.integer))
-                 or (isinstance(k, slice) and k.step in (None, 1)))
+                 and not isinstance(k, (bool, _np.bool_)))
+                or (isinstance(k, slice) and k.step in (None, 1))
                 for k in key) and len(key) <= self.ndim:
             begin, end, drop = [], [], []
             for ax, k in enumerate(key):
@@ -271,6 +274,8 @@ class NDArray:
                     drop.append(ax)
                 else:
                     b, e, _ = k.indices(self.shape[ax])
+                    if e <= b:
+                        return None  # empty slice: numpy-shaped raw path
                     begin.append(b)
                     end.append(e)
             out = imperative_invoke("slice", [self],

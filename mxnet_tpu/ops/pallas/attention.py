@@ -154,18 +154,84 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k, force_pallas):
     out = flash_attention(q, k, v, causal, scale, block_q, block_k,
                           force_pallas)
-    return out, (q, k, v)
+    return out, (q, k, v, out)
+
+
+def _blockwise_bwd(q, k, v, out, do, causal, scale, block_k):
+    """Flash-attention backward as a k-block scan: O(S*BK) temporaries
+    instead of the S x S score matrix (standard Dao et al. recurrence).
+
+    All (B, H, S, D). Two passes: (1) recompute row logsumexp; (2)
+    accumulate dq and per-block dk/dv with normalized probabilities.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk = _pick_block(sk, block_k)
+    n_k = sk // bk
+    qf = q.astype(jnp.float32) * scale
+    dof = do.astype(jnp.float32)
+    # delta_i = sum_j dO_ij O_ij  (rowwise) — the softmax-jacobian constant
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,H,S)
+    qpos = jnp.arange(sq)
+    kb = k.reshape(b, h, n_k, bk, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, n_k, bk, d).transpose(2, 0, 1, 3, 4)
+
+    def scores(k_blk, j):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            # same diagonal convention as the forward kernel:
+            # kpos <= qpos + (sk - sq)
+            kpos = j * bk + jnp.arange(bk)
+            mask = (kpos[None, None, None, :]
+                    <= qpos[None, None, :, None] + (sk - sq))
+            s = jnp.where(mask, s, _NEG)
+        return s
+
+    # pass 1: logsumexp over all key blocks
+    def lse_step(carry, inp):
+        m, l = carry
+        j, k_blk = inp
+        s = scores(k_blk, j)
+        m_cur = jnp.max(s, -1)
+        m_new = jnp.maximum(m, m_cur)
+        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[..., None]),
+                                             -1)
+        return (m_new, l), None
+
+    (m, l), _ = jax.lax.scan(
+        lse_step,
+        (jnp.full((b, h, sq), _NEG, jnp.float32),
+         jnp.zeros((b, h, sq), jnp.float32)),
+        (jnp.arange(n_k), kb))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+
+    # pass 2: gradient accumulation
+    def grad_step(dq, inp):
+        j, k_blk, v_blk = inp
+        s = scores(k_blk, j)
+        p = jnp.exp(s - lse[..., None])  # normalized probs (B,H,S,BK)
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof,
+                        v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             k_blk.astype(jnp.float32))
+        # ds folds the score scale; dk pairs with the UNscaled q
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+        return dq, (dk_b, dv_b)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        grad_step, jnp.zeros((b, h, sq, d), jnp.float32),
+        (jnp.arange(n_k), kb, vb))
+    dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, sk, d)
+    dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, sk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, force_pallas, res, ct):
-    # backward via the reference formulation (O(S^2) HBM on the grad pass;
-    # a blocked backward kernel is the follow-up) — numerics match the
-    # forward because both compute exact softmax attention
-    q, k, v = res
+    q, k, v, out = res
     s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    _, vjp = jax.vjp(lambda a, b, c: _attn_reference(a, b, c, causal, s),
-                     q, k, v)
-    return vjp(ct)
+    return _blockwise_bwd(q, k, v, out, ct, causal, s, block_k)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

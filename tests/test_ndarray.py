@@ -202,3 +202,13 @@ def test_zero_size_indexing():
     with mx.autograd.record():
         y = x[2]
     assert y.shape == (0,)
+
+
+def test_bool_and_empty_slice_indexing_under_record():
+    import mxnet_tpu as mx
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    with mx.autograd.record():
+        b = x[True]
+        e = x[0, 1:1]
+    assert b.shape == (1, 2, 3)  # numpy semantics: new leading axis
+    assert e.shape == (0,)
