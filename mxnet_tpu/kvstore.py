@@ -59,6 +59,13 @@ class KVStore:
                 agg = add_n(*vlist)
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
+            if "dist" in self.type and self.num_workers > 1:
+                # dist_sync: merge across every worker process before the
+                # update (reference: server-side MergeBuf across workers,
+                # kvstore_dist_server.h:211-359 — here one allreduce)
+                from .parallel import dist as _dist
+                from .ndarray import array as _nd_array
+                agg = _nd_array(_dist.allreduce(agg.asnumpy()))
             if self._updater is not None:
                 self._updater(self._str_to_int(k), agg, self._store[k])
             else:
@@ -125,11 +132,11 @@ class KVStore:
     _set_updater = set_updater
 
     def set_optimizer(self, optimizer: Optimizer):
-        """reference: kvstore.py set_optimizer — pickles the optimizer to the
-        servers when distributed; locally installs an Updater."""
-        if "dist" in self.type and self.rank != 0:
-            # non-root workers rely on the sharded-step collectives
-            return
+        """reference: kvstore.py set_optimizer — pickles the optimizer to
+        the servers when distributed. In SPMD there are no servers: EVERY
+        worker installs the updater and applies it to the allreduce-merged
+        gradient, so all replicas step identically (the server update,
+        replicated)."""
         self._optimizer = optimizer
         self.set_updater(get_updater(optimizer))
 

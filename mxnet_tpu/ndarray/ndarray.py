@@ -246,7 +246,11 @@ class NDArray:
 
     def _getitem_taped(self, key):
         if isinstance(key, (bool, _np.bool_)):
-            return None  # bool adds an axis (numpy semantics): raw path
+            if key:
+                # x[True] == x[None]: new leading axis, taped
+                return imperative_invoke("expand_dims", [self],
+                                         {"axis": 0})[0]
+            return None  # x[False]: empty result, raw path (no grads)
         if isinstance(key, (int, _np.integer)):
             i = self._index_axis(0, key)
             out = imperative_invoke("slice_axis", [self],

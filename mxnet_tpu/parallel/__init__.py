@@ -20,3 +20,4 @@ from .sequence import (ring_attention, sequence_sharded_attention,  # noqa: F401
                        ulysses_attention)
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from .moe import moe_apply, top1_router  # noqa: F401
+from . import dist  # noqa: F401

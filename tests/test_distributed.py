@@ -1,0 +1,85 @@
+"""Multi-process distributed tests via tools/launch.py --launcher local.
+
+Reference analogue: tests/nightly/dist_sync_kvstore.py run through
+``tools/launch.py -n N --launcher local`` (SURVEY.md §4: multi-node
+without a real cluster). Each worker is a separate process with its own
+CPU device joining one jax.distributed process group.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import sys; sys.path.insert(0, "__ROOT__")
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu.parallel import dist
+    dist.init_process_group()
+    r, n = dist.rank(), dist.size()
+    assert n == 2, n
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 4  # 2 procs x 2 local devices
+
+    # allreduce: sum of (rank+1) over ranks == 3
+    out = dist.allreduce(np.full((4,), float(r + 1), np.float32))
+    np.testing.assert_allclose(out, np.full((4,), 3.0))
+    dist.barrier()
+
+    # dist_sync kvstore semantics (reference nightly dist_sync_kvstore.py:
+    # every worker pushes, merged value visible to all)
+    import mxnet_tpu as mx
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == r and kv.num_workers == 2
+    kv.init("w", mx.nd.zeros((3,)))
+    kv.push("w", mx.nd.array(np.full((3,), float(r + 1), np.float32)))
+    out = mx.nd.zeros((3,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((3,), 3.0))
+
+    # global mesh spans both processes; a sharded psum sees every device
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = dist.global_mesh({"world": 4})
+    fn = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(x, "world"), mesh=mesh,
+        in_specs=P(), out_specs=P(), check_vma=False),
+        in_shardings=NamedSharding(mesh, P()),
+        out_shardings=NamedSharding(mesh, P()))
+    out = fn(np.ones((2,), np.float32))  # replicated ones, psum over 4 dev
+    local = np.asarray([s.data for s in out.addressable_shards][0])
+    np.testing.assert_allclose(local, np.full((2,), 4.0))
+    dist.barrier()
+    print("worker", r, "OK")
+""").replace("__ROOT__", ROOT)
+
+
+def test_two_process_group(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--", sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, (
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}")
+    assert "worker 0 OK" in res.stdout and "worker 1 OK" in res.stdout, \
+        res.stdout
+
+
+def test_launcher_propagates_failure(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--", sys.executable, str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode != 0

@@ -207,8 +207,12 @@ def test_zero_size_indexing():
 def test_bool_and_empty_slice_indexing_under_record():
     import mxnet_tpu as mx
     x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
     with mx.autograd.record():
         b = x[True]
         e = x[0, 1:1]
+        loss = (b * 2).sum()
     assert b.shape == (1, 2, 3)  # numpy semantics: new leading axis
     assert e.shape == (0,)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((2, 3), 2.0))

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Collective-bandwidth benchmark over the device mesh.
+
+Reference surface: tools/bandwidth/measure.py — measures the parameter
+push+pull cost of each kvstore type. TPU-native: the costs that matter are
+the mesh collectives (psum = the dist_sync round trip, all_gather,
+reduce_scatter, ppermute = the ring-attention hop), measured in GB/s of
+payload moved per device.
+
+    python tools/bandwidth/measure.py --size-mb 64 --iters 10
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=64,
+                    help="payload per device, MB (fp32)")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh({"x": n})
+    elems = int(args.size_mb * 1e6 / 4)
+    elems -= elems % max(n, 1)
+    x = jnp.zeros((elems,), jnp.float32) + 1.0
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    ops = [
+        # (name, fn, in_spec, out_spec)
+        ("psum (allreduce)", lambda v: jax.lax.psum(v, "x"), P(), P()),
+        ("all_gather", lambda v: jax.lax.all_gather(v, "x", tiled=True),
+         P("x"), P()),
+        ("psum_scatter", lambda v: jax.lax.psum_scatter(v, "x",
+                                                        tiled=True),
+         P(), P("x")),
+        ("ppermute (ring hop)",
+         lambda v: jax.lax.ppermute(v, "x", perm), P("x"), P("x")),
+    ]
+    print(f"{n} devices ({jax.devices()[0].platform}); payload "
+          f"{elems * 4 / 1e6:.1f} MB/device, {args.iters} iters")
+    for name, op, in_spec, out_spec in ops:
+        fn = jax.jit(jax.shard_map(op, mesh=mesh, in_specs=in_spec,
+                                   out_specs=out_spec, check_vma=False))
+        jax.block_until_ready(fn(x))  # compile
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        gbps = elems * 4 / dt / 1e9
+        print(f"  {name:22s} {dt * 1e3:8.2f} ms  {gbps:8.2f} GB/s/device")
+
+
+if __name__ == "__main__":
+    main()
