@@ -1,0 +1,159 @@
+"""Op-tail tests: scatter_nd, khatri_rao, KL sparse reg, deformable ops,
+MultiProposal."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_scatter_nd_inverse_of_gather_nd():
+    rng = np.random.RandomState(0)
+    data = rng.rand(2, 3).astype(np.float32)
+    idx = np.array([[0, 0, 1], [2, 1, 0]], np.float32)  # (2, N): (row, col)
+    vals = nd.gather_nd(nd.array(data), nd.array(idx))
+    np.testing.assert_allclose(vals.asnumpy(),
+                               [data[0, 2], data[0, 1], data[1, 0]])
+    back = nd.scatter_nd(vals, nd.array(idx), shape=(2, 3))
+    exp = np.zeros((2, 3), np.float32)
+    exp[0, 2], exp[0, 1], exp[1, 0] = data[0, 2], data[0, 1], data[1, 0]
+    np.testing.assert_allclose(back.asnumpy(), exp)
+
+
+def test_khatri_rao():
+    a = np.array([[1., 2.], [3., 4.]], np.float32)       # (2, 2)
+    b = np.array([[1., 0.], [0., 1.], [2., 2.]], np.float32)  # (3, 2)
+    out = nd.contrib.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    assert out.shape == (6, 2)
+    # column j = kron(a[:, j], b[:, j])
+    for j in range(2):
+        np.testing.assert_allclose(out[:, j], np.kron(a[:, j], b[:, j]))
+
+
+def test_identity_attach_kl_sparse_reg():
+    rng = np.random.RandomState(1)
+    act = rng.uniform(0.05, 0.95, (8, 4)).astype(np.float32)
+    x = nd.array(act)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                         penalty=0.01)
+        loss = y.sum()
+    np.testing.assert_allclose(y.asnumpy(), act)  # identity forward
+    loss.backward()
+    rho_hat = act.mean(axis=0, keepdims=True)
+    kl = 0.01 * (-(0.1 / rho_hat) + 0.9 / (1 - rho_hat))
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               1.0 + np.broadcast_to(kl, act.shape),
+                               rtol=1e-4)
+
+
+def test_deformable_conv_zero_offsets_matches_conv():
+    rng = np.random.RandomState(2)
+    data = rng.rand(1, 3, 8, 8).astype(np.float32)
+    weight = rng.normal(0, 0.3, (4, 3, 3, 3)).astype(np.float32)
+    bias = rng.normal(0, 0.1, (4,)).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    out_d = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight), nd.array(bias),
+        kernel=(3, 3), num_filter=4).asnumpy()
+    out_c = nd.Convolution(nd.array(data), nd.array(weight), nd.array(bias),
+                           kernel=(3, 3), num_filter=4).asnumpy()
+    np.testing.assert_allclose(out_d, out_c, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_integer_shift():
+    """Offsets of exactly +1 in x behave like sampling shifted input."""
+    rng = np.random.RandomState(3)
+    data = rng.rand(1, 1, 6, 6).astype(np.float32)
+    weight = np.ones((1, 1, 1, 1), np.float32)
+    offset = np.zeros((1, 2, 6, 6), np.float32)
+    offset[0, 1] = 1.0  # x offset +1 for the single 1x1 tap
+    out = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=(1, 1), num_filter=1, no_bias=True).asnumpy()
+    # each output pixel equals input one column right (zero at border)
+    exp = np.zeros_like(data)
+    exp[0, 0, :, :-1] = data[0, 0, :, 1:]
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_deformable_conv_gradients_flow():
+    rng = np.random.RandomState(4)
+    data = nd.array(rng.rand(1, 2, 6, 6).astype(np.float32))
+    offset = nd.array(rng.normal(0, 0.1, (1, 2 * 4, 5, 5))
+                      .astype(np.float32))
+    weight = nd.array(rng.normal(0, 0.3, (3, 2, 2, 2)).astype(np.float32))
+    for t in (data, offset, weight):
+        t.attach_grad()
+    with mx.autograd.record():
+        out = nd.contrib.DeformableConvolution(
+            data, offset, weight, kernel=(2, 2), num_filter=3,
+            no_bias=True)
+        loss = (out ** 2).sum()
+    loss.backward()
+    for t in (data, offset, weight):
+        g = t.grad.asnumpy()
+        assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0
+
+
+def test_deformable_psroi_no_trans_matches_avg():
+    rng = np.random.RandomState(5)
+    od, g = 2, 2
+    data = rng.rand(1, od * g * g, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0, output_dim=od,
+        group_size=g, pooled_size=g, sample_per_part=2,
+        no_trans=True).asnumpy()
+    assert out.shape == (1, od, g, g)
+    assert np.all(np.isfinite(out))
+
+
+def test_deformable_psroi_border_bins_not_attenuated():
+    """Constant input must pool to the constant everywhere, incl. border
+    bins (taps clamp into the image, not zero-pad)."""
+    od, g = 1, 4
+    data = np.ones((1, od * g * g, 8, 8), np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0, output_dim=od,
+        group_size=g, pooled_size=g, sample_per_part=4,
+        no_trans=True).asnumpy()
+    np.testing.assert_allclose(out, np.ones_like(out), rtol=1e-5)
+
+
+def test_deformable_psroi_trans_shifts_samples():
+    """Nonzero trans offsets shift where bins sample (the deformable
+    part); a horizontal-gradient image makes the shift visible."""
+    od, g = 1, 2
+    grad_img = np.tile(np.arange(16, dtype=np.float32), (16, 1))
+    data = np.broadcast_to(grad_img, (od * g * g, 16, 16))[None].copy()
+    rois = np.array([[0, 4, 4, 11, 11]], np.float32)
+    kw = dict(spatial_scale=1.0, output_dim=od, group_size=g,
+              pooled_size=g, sample_per_part=2, part_size=g)
+    base = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), no_trans=True, trans_std=0.0,
+        **kw).asnumpy()
+    # +x shift of 0.25 * roi_width via trans
+    trans = np.zeros((1, 2, g, g), np.float32)
+    trans[:, 1] = 1.0
+    shifted = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans), no_trans=False,
+        trans_std=0.25, **kw).asnumpy()
+    assert np.all(shifted > base + 0.5), (base, shifted)
+
+
+def test_multi_proposal_batched():
+    rng = np.random.RandomState(6)
+    a = 3
+    cls = rng.rand(2, 2 * a, 4, 4).astype(np.float32)
+    bbox = (rng.rand(2, 4 * a, 4, 4).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    rois = nd.contrib.MultiProposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        scales=(8,), ratios=(0.5, 1, 2), feature_stride=16,
+        rpn_pre_nms_top_n=12, rpn_post_nms_top_n=6,
+        rpn_min_size=1).asnumpy()
+    assert rois.shape == (12, 5)
+    assert set(rois[:, 0].tolist()) == {0.0, 1.0}  # both image indices
