@@ -1,0 +1,108 @@
+"""Initializer + RNG suites (reference: tests/python/unittest/test_init.py
+and test_random.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _init_array(init, name="weight", shape=(50, 100)):
+    arr = nd.zeros(shape)
+    desc = mx.init.InitDesc(name)
+    init(desc, arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert (_init_array(mx.init.Zero()) == 0).all()
+    assert (_init_array(mx.init.One()) == 1).all()
+    assert (_init_array(mx.init.Constant(2.5)) == 2.5).all()
+
+
+def test_uniform_normal_ranges():
+    u = _init_array(mx.init.Uniform(0.3))
+    assert np.abs(u).max() <= 0.3 and np.abs(u).std() > 0
+    n = _init_array(mx.init.Normal(2.0), shape=(200, 200))
+    assert abs(n.std() - 2.0) < 0.1
+
+
+def test_xavier_scales_with_fan():
+    x = _init_array(mx.init.Xavier(factor_type="avg", magnitude=3),
+                    shape=(100, 400))
+    bound = np.sqrt(3.0 / ((100 + 400) / 2))
+    assert np.abs(x).max() <= bound + 1e-6
+    # gaussian variant
+    g = _init_array(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2), shape=(300, 300))
+    assert abs(g.std() - np.sqrt(2.0 / 300)) < 0.01
+
+
+def test_name_based_defaults():
+    """Initializer dispatches on name suffix (reference __call__)."""
+    init = mx.init.Uniform(0.1)
+    bias = nd.zeros((10,))
+    init(mx.init.InitDesc("fc1_bias"), bias)
+    assert (bias.asnumpy() == 0).all()
+    gamma = nd.zeros((10,))
+    init(mx.init.InitDesc("bn_gamma"), gamma)
+    assert (gamma.asnumpy() == 1).all()
+    mean = nd.zeros((10,))
+    init(mx.init.InitDesc("bn_moving_mean"), mean)
+    assert (mean.asnumpy() == 0).all()
+    var = nd.zeros((10,))
+    init(mx.init.InitDesc("bn_moving_var"), var)
+    assert (var.asnumpy() == 1).all()
+
+
+def test_orthogonal_and_bilinear():
+    o = _init_array(mx.init.Orthogonal(), shape=(32, 64))
+    gram = o @ o.T
+    np.testing.assert_allclose(gram, np.eye(32) * gram[0, 0], atol=1e-3)
+    b = _init_array(mx.init.Bilinear(), shape=(1, 1, 4, 4))
+    assert b.max() <= 1.0 and b.min() >= 0.0
+
+
+def test_mixed_initializer():
+    mixed = mx.init.Mixed([".*bias", ".*"],
+                          [mx.init.Zero(), mx.init.One()])
+    b = nd.array(np.full((4,), 9, np.float32))
+    w = nd.array(np.full((4,), 9, np.float32))
+    mixed(mx.init.InitDesc("fc_bias"), b)
+    mixed(mx.init.InitDesc("fc_weight"), w)
+    assert (b.asnumpy() == 0).all() and (w.asnumpy() == 1).all()
+
+
+# ------------------------------ random -----------------------------------
+
+
+def test_seed_reproducibility():
+    mx.random.seed(42)
+    a = nd.random_normal(shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random_normal(shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = nd.random_normal(shape=(100,)).asnumpy()
+    assert np.abs(b - c).max() > 0
+
+
+@pytest.mark.parametrize("op,kw,mean,std", [
+    ("random_uniform", {"low": -1.0, "high": 1.0}, 0.0, 2 / np.sqrt(12)),
+    ("random_normal", {"loc": 2.0, "scale": 3.0}, 2.0, 3.0),
+    ("random_exponential", {"lam": 4.0}, 0.25, 0.25),
+    ("random_poisson", {"lam": 4.0}, 4.0, 2.0),
+    ("random_gamma", {"alpha": 9.0, "beta": 0.5}, 4.5, 1.5),
+])
+def test_sampler_moments(op, kw, mean, std):
+    mx.random.seed(0)
+    fn = getattr(nd, op)
+    x = fn(shape=(40000,), **kw).asnumpy()
+    assert abs(x.mean() - mean) < 5 * std / np.sqrt(len(x)) * 3 + 0.02
+    assert abs(x.std() - std) / std < 0.1
+
+
+def test_multinomial_distribution():
+    mx.random.seed(1)
+    probs = nd.array(np.array([[0.2, 0.8]], np.float32))
+    draws = nd.sample_multinomial(probs, shape=10000).asnumpy()
+    assert abs(draws.mean() - 0.8) < 0.02

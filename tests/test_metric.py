@@ -1,0 +1,92 @@
+"""Metric suite (reference: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_accuracy_and_topk():
+    pred = nd.array(np.array([[0.1, 0.9, 0.0],
+                              [0.8, 0.15, 0.05],
+                              [0.3, 0.25, 0.45]], np.float32))
+    label = nd.array(np.array([1, 1, 2], np.float32))
+    acc = mx.metric.Accuracy()
+    acc.update([label], [pred])
+    assert acc.get()[1] == pytest.approx(2 / 3)
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update([label], [pred])
+    assert topk.get()[1] == pytest.approx(1.0)
+
+
+def test_f1():
+    pred = nd.array(np.array([[0.9, 0.1], [0.2, 0.8],
+                              [0.3, 0.7], [0.6, 0.4]], np.float32))
+    label = nd.array(np.array([0, 1, 0, 1], np.float32))
+    f1 = mx.metric.F1()
+    f1.update([label], [pred])
+    # tp=1 (idx1), fp=1 (idx2), fn=1 (idx3) -> p=r=0.5 -> f1=0.5
+    assert f1.get()[1] == pytest.approx(0.5)
+
+
+def test_mae_mse_rmse():
+    pred = nd.array(np.array([[1.0], [3.0]], np.float32))
+    label = nd.array(np.array([[2.0], [1.0]], np.float32))
+    for cls, exp in ((mx.metric.MAE, 1.5), (mx.metric.MSE, 2.5),
+                     (mx.metric.RMSE, np.sqrt(2.5))):
+        m = cls()
+        m.update([label], [pred])
+        assert m.get()[1] == pytest.approx(exp, rel=1e-5)
+
+
+def test_perplexity_ignores_label():
+    pred = nd.array(np.array([[0.5, 0.5], [0.9, 0.1]], np.float32))
+    label = nd.array(np.array([0, 0], np.float32))
+    p_all = mx.metric.Perplexity(ignore_label=None)
+    p_all.update([label], [pred])
+    exp = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert p_all.get()[1] == pytest.approx(exp, rel=1e-5)
+
+
+def test_cross_entropy_and_loss():
+    pred = nd.array(np.array([[0.25, 0.75]], np.float32))
+    label = nd.array(np.array([1], np.float32))
+    ce = mx.metric.CrossEntropy()
+    ce.update([label], [pred])
+    assert ce.get()[1] == pytest.approx(-np.log(0.75), rel=1e-5)
+
+
+def test_composite_and_registry():
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.MAE())
+    pred = nd.array(np.array([[0.2, 0.8]], np.float32))
+    label = nd.array(np.array([1], np.float32))
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert "accuracy" in names and len(vals) == 2
+    # string / list creation (reference metric.create)
+    m = mx.metric.create("acc")
+    assert isinstance(m, mx.metric.Accuracy)
+    m2 = mx.metric.create(["acc", "mae"])
+    assert isinstance(m2, mx.metric.CompositeEvalMetric)
+
+
+def test_custom_metric_and_np():
+    def my_err(label, pred):
+        return float(np.abs(label - pred.argmax(axis=1)).mean())
+
+    m = mx.metric.CustomMetric(my_err, name="my_err")
+    pred = nd.array(np.array([[0.9, 0.1], [0.1, 0.9]], np.float32))
+    label = nd.array(np.array([1, 1], np.float32))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_metric_reset_and_get_name_value():
+    acc = mx.metric.Accuracy()
+    pred = nd.array(np.array([[0.9, 0.1]], np.float32))
+    acc.update([nd.array(np.array([0], np.float32))], [pred])
+    assert dict(acc.get_name_value())["accuracy"] == 1.0
+    acc.reset()
+    assert np.isnan(acc.get()[1]) or acc.get()[1] == 0.0
