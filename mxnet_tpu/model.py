@@ -124,3 +124,165 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             kvstore.pull(name, grad_list, priority=-index)
         for k, (w, g) in enumerate(zip(arg_list, grad_list)):
             updater(index_ * num_device + k, g, w)
+
+
+class FeedForward:
+    """Legacy single-input/single-output estimator API (reference:
+    python/mxnet/model.py:408 FeedForward — fit/predict/score/save/load,
+    sklearn-flavored). Deprecated in the reference in favor of Module;
+    provided here as a thin adapter over Module for script parity."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+        from .module import Module
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        if epoch_size is not None:
+            import logging
+            logging.warning("FeedForward: epoch_size is ignored (epochs "
+                            "are defined by the data iterator)")
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module_cls = Module
+        self._mod = None
+        self._pred_mod = None  # cached predict/score module (by shapes)
+        self._pred_key = None
+
+    # -- helpers -------------------------------------------------------------
+    def _init_iter(self, X, y, is_train):
+        from .io import DataIter, NDArrayIter
+        import numpy as _np
+
+        if isinstance(X, DataIter):
+            return X
+        X = _np.asarray(X)
+        if y is None and is_train:
+            raise MXNetError("y is required for training")
+        y = _np.asarray(y) if y is not None else _np.zeros(X.shape[0])
+        bs = min(self.numpy_batch_size, X.shape[0])
+        return NDArrayIter(X, y, bs, shuffle=is_train,
+                           label_name=self._label_name())
+
+    def _label_name(self):
+        labels = [n for n in self.symbol.list_arguments()
+                  if n.endswith("label")]
+        return labels[0] if labels else "softmax_label"
+
+    def _make_module(self, data_iter):
+        mod = self._module_cls(
+            self.symbol, data_names=[d.name for d in data_iter.provide_data],
+            label_names=[l.name for l in data_iter.provide_label],
+            context=self.ctx)
+        return mod
+
+    # -- API -----------------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        train = self._init_iter(X, y, is_train=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._init_iter(eval_data[0], eval_data[1], False)
+        self._mod = self._make_module(train)
+        self._mod.fit(train, eval_data=eval_data, eval_metric=eval_metric,
+                      epoch_end_callback=epoch_end_callback,
+                      batch_end_callback=batch_end_callback,
+                      eval_end_callback=eval_end_callback,
+                      eval_batch_end_callback=eval_batch_end_callback,
+                      kvstore=kvstore, optimizer=self.optimizer,
+                      optimizer_params=self.kwargs,
+                      initializer=self.initializer,
+                      arg_params=self.arg_params,
+                      aux_params=self.aux_params,
+                      allow_missing=self.allow_extra_params,
+                      begin_epoch=self.begin_epoch,
+                      num_epoch=self.num_epoch, monitor=monitor)
+        self.arg_params, self.aux_params = self._mod.get_params()
+        self._pred_mod = None  # params changed; invalidate predict cache
+        return self
+
+    def _bound_module(self, data_iter):
+        """Cached inference module, re-bound only when shapes change
+        (the reference caches its prediction executor the same way)."""
+        key = (tuple(map(tuple, (d.shape for d in data_iter.provide_data))),)
+        if self._pred_mod is None or self._pred_key != key:
+            mod = self._make_module(data_iter)
+            mod.bind(data_shapes=data_iter.provide_data,
+                     label_shapes=data_iter.provide_label,
+                     for_training=False)
+            mod.set_params(self.arg_params or {}, self.aux_params or {},
+                           allow_missing=False)
+            self._pred_mod, self._pred_key = mod, key
+        return self._pred_mod
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np
+
+        data_iter = self._init_iter(X, None, is_train=False)
+        if reset:
+            data_iter.reset()
+        mod = self._bound_module(data_iter)
+        outputs = []
+        for i, batch in enumerate(data_iter):
+            if num_batch is not None and i >= num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            out = mod.get_outputs()[0].asnumpy()
+            pad = getattr(batch, "pad", 0) or 0
+            outputs.append(out[:out.shape[0] - pad])
+        return _np.concatenate(outputs, axis=0)
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None,
+              reset=True):
+        from . import metric as metric_mod
+        from .io import DataIter
+
+        if not isinstance(X, DataIter) and y is None:
+            raise MXNetError(
+                "FeedForward.score needs labels: pass a labeled DataIter "
+                "or score(X, y)")
+        data_iter = X if isinstance(X, DataIter) \
+            else self._init_iter(X, y, is_train=False)
+        if reset:
+            data_iter.reset()
+        mod = self._bound_module(data_iter)
+        res = mod.score(data_iter, metric_mod.create(eval_metric),
+                        num_batch=num_batch)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, **kwargs):
+        """Train a new model from data (reference model.py:904)."""
+        fit_kwargs = {}
+        for k in ("eval_data", "eval_metric", "epoch_end_callback",
+                  "batch_end_callback", "kvstore", "logger",
+                  "work_load_list", "monitor", "eval_end_callback",
+                  "eval_batch_end_callback"):
+            if k in kwargs:
+                fit_kwargs[k] = kwargs.pop(k)
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, **kwargs)
+        model.fit(X, y, **fit_kwargs)
+        return model

@@ -1,0 +1,100 @@
+"""FeedForward legacy API + Rtc runtime kernels (reference: model.py
+FeedForward, rtc.py / tests gpu test_rtc.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _toy():
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (256, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax"), X, y
+
+
+def test_feedforward_fit_predict_score(tmp_path):
+    sym, X, y = _toy()
+    model = mx.model.FeedForward(sym, num_epoch=8, learning_rate=0.3,
+                                 initializer=mx.init.Xavier(),
+                                 numpy_batch_size=64)
+    model.fit(X, y)
+    acc = model.score(mx.io.NDArrayIter(X, y, 64,
+                                        label_name="softmax_label"))
+    assert acc > 0.85
+    pred = model.predict(X)
+    assert pred.shape == (256, 2)
+    assert ((pred.argmax(1) == y).mean()) > 0.85
+
+    # save / load round trip
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 3)
+    loaded = mx.model.FeedForward.load(prefix, 3)
+    pred2 = loaded.predict(X)
+    np.testing.assert_allclose(pred2, pred, rtol=1e-4, atol=1e-5)
+
+
+def test_feedforward_create():
+    sym, X, y = _toy()
+    model = mx.model.FeedForward.create(sym, X, y, num_epoch=6,
+                                        learning_rate=0.3,
+                                        initializer=mx.init.Xavier())
+    assert model.score(mx.io.NDArrayIter(X, y, 64,
+                                         label_name="softmax_label")) > 0.8
+
+
+def test_rtc_elementwise_kernel():
+    x = nd.array(np.arange(8, dtype=np.float32))
+    y = nd.array(np.ones(8, np.float32))
+    out = nd.zeros((8,))
+    rtc = mx.rtc.Rtc("axpy", [("x", x), ("y", y)], [("out", out)],
+                     "out[:] = x[:] * 2.0 + y[:]")
+    rtc.push([x, y], [out])
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.arange(8) * 2.0 + 1.0)
+    # reuse with new values (compiled once)
+    x2 = nd.array(np.full(8, 3.0, np.float32))
+    rtc.push([x2, y], [out])
+    np.testing.assert_allclose(out.asnumpy(), np.full(8, 7.0))
+
+
+def test_rtc_multi_output_and_errors():
+    x = nd.array(np.arange(4, dtype=np.float32))
+    a = nd.zeros((4,))
+    b = nd.zeros((4,))
+    rtc = mx.rtc.Rtc("split", [("x", x)], [("a", a), ("b", b)],
+                     """
+                     a[:] = x[:] + 1.0
+                     b[:] = x[:] * x[:]
+                     """)
+    rtc.push([x], [a, b])
+    np.testing.assert_allclose(a.asnumpy(), np.arange(4) + 1.0)
+    np.testing.assert_allclose(b.asnumpy(), np.arange(4) ** 2)
+    with pytest.raises(mx.base.MXNetError):
+        rtc.push([x, x], [a, b])  # wrong arity
+    with pytest.raises(mx.base.MXNetError):
+        mx.rtc.Rtc("bad", [("x", x)], [("a", a)], "a[:] = = x")
+
+
+def test_feedforward_score_requires_labels():
+    sym, X, y = _toy()
+    model = mx.model.FeedForward(sym, num_epoch=2, learning_rate=0.3,
+                                 initializer=mx.init.Xavier())
+    model.fit(X, y)
+    with pytest.raises(mx.base.MXNetError):
+        model.score(X)  # numpy without labels must not fabricate zeros
+    acc_xy = model.score(X, y)
+    assert 0.0 <= acc_xy <= 1.0
+
+
+def test_feedforward_create_accepts_fit_only_kwargs():
+    sym, X, y = _toy()
+    model = mx.model.FeedForward.create(sym, X, y, num_epoch=2,
+                                        learning_rate=0.3, monitor=None,
+                                        initializer=mx.init.Xavier())
+    assert model.arg_params
