@@ -41,12 +41,15 @@ def main():
                        tr._in_shardings["softmax_label"])
     feed = {"data": x, "softmax_label": y}
 
+    # NB: sync via host read, not block_until_ready — under the axon
+    # tunnel block_until_ready returns before the device queue drains,
+    # inflating throughput ~1.6x; a scalar device_get cannot lie
     for _ in range(2):  # compile + settle
-        tr.step(feed)[0].block_until_ready()
+        np.asarray(tr.step(feed)[0])
     t0 = time.perf_counter()
     for _ in range(iters):
         outs = tr.step(feed)
-    outs[0].block_until_ready()
+    float(np.asarray(outs[0]).ravel()[0])
     dt = time.perf_counter() - t0
 
     ips = batch * iters / dt

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Gluon LSTM language-model throughput (tokens/sec/chip).
+
+BASELINE.md north star #2: "Gluon LSTM tokens/sec" — no published
+reference number exists (the reference's CPU RNN was a stub and cuDNN
+numbers weren't published for 0.11), so this establishes the measured
+baseline. Runs the fused RNN op (Pallas LSTM cell on TPU) through a
+training step.
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--num-hidden", type=int, default=1024)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=10000)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import mxnet_tpu as mx
+
+    T, N, H, V = args.seq_len, args.batch_size, args.num_hidden, args.vocab
+    data = mx.sym.var("data")
+    embed = mx.sym.Embedding(data, input_dim=V, output_dim=H, name="embed")
+    embed = mx.sym.SwapAxis(embed, dim1=0, dim2=1)  # NTC -> TNC
+    stack = mx.rnn.FusedRNNCell(H, num_layers=args.num_layers, mode="lstm",
+                                prefix="lstm_")
+    out, _ = stack.unroll(T, inputs=embed, merge_outputs=True, layout="TNC")
+    pred = mx.sym.Reshape(out, shape=(-1, H))
+    pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+    label = mx.sym.Reshape(mx.sym.var("softmax_label"), shape=(-1,))
+    net = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    from mxnet_tpu.io import DataDesc, DataBatch
+    mod.bind(data_shapes=[DataDesc("data", (N, T))],
+             label_shapes=[DataDesc("softmax_label", (N, T))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    rng = np.random.RandomState(0)
+    batch = DataBatch(
+        data=[mx.nd.array(rng.randint(0, V, (N, T)).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, V, (N, T)).astype(np.float32))])
+
+    def step():
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+
+    def sync():
+        # host read = true device sync (tunnel block_until_ready lies)
+        return float(mod._exec.arg_dict["pred_weight"].asnumpy().ravel()[0])
+
+    step()  # compile
+    sync()
+    t0 = time.time()
+    for _ in range(args.iters):
+        step()
+    sync()
+    dt = (time.time() - t0) / args.iters
+    tps = N * T / dt
+    print(f"LSTM {args.num_layers}x{H} bs{N} T={T}: "
+          f"{dt * 1000:.1f} ms/step, {tps:,.0f} tokens/sec/chip")
+
+
+if __name__ == "__main__":
+    main()

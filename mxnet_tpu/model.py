@@ -174,6 +174,15 @@ class FeedForward:
         return NDArrayIter(X, y, bs, shuffle=is_train,
                            label_name=self._label_name())
 
+    def _filtered_arg_params(self):
+        """allow_extra_params=True drops arg_params keys the symbol does
+        not declare (reference FeedForward semantics); missing params
+        still error."""
+        if not self.arg_params or not self.allow_extra_params:
+            return self.arg_params
+        known = set(self.symbol.list_arguments())
+        return {k: v for k, v in self.arg_params.items() if k in known}
+
     def _label_name(self):
         labels = [n for n in self.symbol.list_arguments()
                   if n.endswith("label")]
@@ -203,9 +212,8 @@ class FeedForward:
                       kvstore=kvstore, optimizer=self.optimizer,
                       optimizer_params=self.kwargs,
                       initializer=self.initializer,
-                      arg_params=self.arg_params,
+                      arg_params=self._filtered_arg_params(),
                       aux_params=self.aux_params,
-                      allow_missing=self.allow_extra_params,
                       begin_epoch=self.begin_epoch,
                       num_epoch=self.num_epoch, monitor=monitor)
         self.arg_params, self.aux_params = self._mod.get_params()
@@ -214,15 +222,20 @@ class FeedForward:
 
     def _bound_module(self, data_iter):
         """Cached inference module, re-bound only when shapes change
-        (the reference caches its prediction executor the same way)."""
+        (the reference caches its prediction executor the same way).
+        When a trained module exists, the inference executor shares its
+        parameter arrays (shared_module) instead of copying them."""
         key = (tuple(map(tuple, (d.shape for d in data_iter.provide_data))),)
         if self._pred_mod is None or self._pred_key != key:
             mod = self._make_module(data_iter)
+            shared = self._mod if (self._mod is not None
+                                   and self._mod.binded) else None
             mod.bind(data_shapes=data_iter.provide_data,
                      label_shapes=data_iter.provide_label,
-                     for_training=False)
-            mod.set_params(self.arg_params or {}, self.aux_params or {},
-                           allow_missing=False)
+                     for_training=False, shared_module=shared)
+            if shared is None:
+                mod.set_params(self.arg_params or {}, self.aux_params or {},
+                               allow_missing=False)
             self._pred_mod, self._pred_key = mod, key
         return self._pred_mod
 
@@ -233,15 +246,23 @@ class FeedForward:
         if reset:
             data_iter.reset()
         mod = self._bound_module(data_iter)
-        outputs = []
+        outputs, datas, labels = [], [], []
         for i, batch in enumerate(data_iter):
             if num_batch is not None and i >= num_batch:
                 break
             mod.forward(batch, is_train=False)
             out = mod.get_outputs()[0].asnumpy()
             pad = getattr(batch, "pad", 0) or 0
-            outputs.append(out[:out.shape[0] - pad])
-        return _np.concatenate(outputs, axis=0)
+            n = out.shape[0] - pad
+            outputs.append(out[:n])
+            if return_data:
+                datas.append(batch.data[0].asnumpy()[:n])
+                labels.append(batch.label[0].asnumpy()[:n])
+        preds = _np.concatenate(outputs, axis=0)
+        if return_data:
+            return (preds, _np.concatenate(datas, axis=0),
+                    _np.concatenate(labels, axis=0))
+        return preds
 
     def score(self, X, y=None, eval_metric="acc", num_batch=None,
               reset=True):
@@ -258,7 +279,7 @@ class FeedForward:
             data_iter.reset()
         mod = self._bound_module(data_iter)
         res = mod.score(data_iter, metric_mod.create(eval_metric),
-                        num_batch=num_batch)
+                        num_batch=num_batch, reset=reset)
         return res[0][1]
 
     def save(self, prefix, epoch=None):

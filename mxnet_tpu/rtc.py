@@ -21,6 +21,7 @@ import textwrap
 from typing import List, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .base import MXNetError
@@ -39,14 +40,15 @@ class Rtc:
         self.output_names = [n for n, _ in outputs]
         if not self.output_names:
             raise MXNetError("Rtc needs at least one output")
+        self._in_templates = [(tuple(a.shape), np.dtype(str(a.dtype)))
+                              for _, a in inputs]
         self._out_templates = [(tuple(a.shape), np.dtype(str(a.dtype)))
                                for _, a in outputs]
         body = textwrap.dedent(kernel)
         args = ", ".join(self.input_names + self.output_names)
         src = (f"def _rtc_kernel({args}):\n"
                + textwrap.indent(body.strip() + "\n", "    "))
-        scope = {"jnp": __import__("jax.numpy", fromlist=["numpy"]),
-                 "jax": jax, "np": np}
+        scope = {"jnp": jnp, "jax": jax, "np": np}
         try:
             exec(compile(src, f"<rtc:{name}>", "exec"), scope)
         except SyntaxError as e:
@@ -73,6 +75,14 @@ class Rtc:
             raise MXNetError(
                 f"Rtc {self.name!r} expects {len(self.input_names)} inputs "
                 f"and {len(self.output_names)} outputs")
+        for name, x, (shape, dtype) in zip(self.input_names, inputs,
+                                           self._in_templates):
+            xs = tuple(x.shape)
+            xd = np.dtype(str(x.dtype))
+            if xs != shape or xd != dtype:
+                raise MXNetError(
+                    f"Rtc {self.name!r} input {name!r}: got {xs}/{xd}, "
+                    f"compiled for {shape}/{dtype}")
         if self._compiled is None:
             self._build()
         vals = [x._data if hasattr(x, "_data") else np.asarray(x)

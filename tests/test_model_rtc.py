@@ -98,3 +98,23 @@ def test_feedforward_create_accepts_fit_only_kwargs():
                                         learning_rate=0.3, monitor=None,
                                         initializer=mx.init.Xavier())
     assert model.arg_params
+
+
+def test_rtc_rejects_shape_mismatch():
+    x = nd.array(np.arange(8, dtype=np.float32))
+    out = nd.zeros((8,))
+    rtc = mx.rtc.Rtc("k", [("x", x)], [("out", out)], "out[:] = x[:]")
+    with pytest.raises(mx.base.MXNetError):
+        rtc.push([nd.zeros((1,))], [out])
+
+
+def test_feedforward_predict_return_data():
+    sym, X, y = _toy()
+    model = mx.model.FeedForward(sym, num_epoch=2, learning_rate=0.3,
+                                 initializer=mx.init.Xavier())
+    model.fit(X, y)
+    preds, data, labels = model.predict(
+        mx.io.NDArrayIter(X, y, 64, label_name="softmax_label"),
+        return_data=True)
+    assert preds.shape[0] == data.shape[0] == labels.shape[0] == 256
+    np.testing.assert_allclose(data, X, rtol=1e-6)
