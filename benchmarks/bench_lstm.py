@@ -57,8 +57,11 @@ def main():
         mod.update()
 
     def sync():
-        # host read = true device sync (tunnel block_until_ready lies)
-        return float(mod._exec.arg_dict["pred_weight"].asnumpy().ravel()[0])
+        # scalar host read = true device sync without a bulk transfer
+        # (tunnel block_until_ready lies; fetching the full weight would
+        # bill a ~40MB copy to the timed region)
+        w = mod._exec.arg_dict["pred_weight"]
+        return float(w[0:1, 0:1].asnumpy()[0, 0])
 
     step()  # compile
     sync()

@@ -24,6 +24,10 @@ from . import initializer as init  # noqa: F401
 from . import io  # noqa: F401
 from . import recordio  # noqa: F401
 from . import image  # noqa: F401
+from . import image_det  # noqa: F401
+for _n in image_det.__all__:  # reference exposes det under mx.image.*
+    setattr(image, _n, getattr(image_det, _n))
+del _n
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
 from . import lr_scheduler  # noqa: F401

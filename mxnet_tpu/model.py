@@ -151,6 +151,8 @@ class FeedForward:
         self.numpy_batch_size = numpy_batch_size
         self.arg_params = arg_params
         self.aux_params = aux_params
+        # accepted for reference-API parity; extra arg_params keys are
+        # always tolerated by init_params (it reads only declared names)
         self.allow_extra_params = allow_extra_params
         self.begin_epoch = begin_epoch
         self.kwargs = dict(kwargs)
@@ -173,15 +175,6 @@ class FeedForward:
         bs = min(self.numpy_batch_size, X.shape[0])
         return NDArrayIter(X, y, bs, shuffle=is_train,
                            label_name=self._label_name())
-
-    def _filtered_arg_params(self):
-        """allow_extra_params=True drops arg_params keys the symbol does
-        not declare (reference FeedForward semantics); missing params
-        still error."""
-        if not self.arg_params or not self.allow_extra_params:
-            return self.arg_params
-        known = set(self.symbol.list_arguments())
-        return {k: v for k, v in self.arg_params.items() if k in known}
 
     def _label_name(self):
         labels = [n for n in self.symbol.list_arguments()
@@ -212,7 +205,7 @@ class FeedForward:
                       kvstore=kvstore, optimizer=self.optimizer,
                       optimizer_params=self.kwargs,
                       initializer=self.initializer,
-                      arg_params=self._filtered_arg_params(),
+                      arg_params=self.arg_params,
                       aux_params=self.aux_params,
                       begin_epoch=self.begin_epoch,
                       num_epoch=self.num_epoch, monitor=monitor)
@@ -225,7 +218,8 @@ class FeedForward:
         (the reference caches its prediction executor the same way).
         When a trained module exists, the inference executor shares its
         parameter arrays (shared_module) instead of copying them."""
-        key = (tuple(map(tuple, (d.shape for d in data_iter.provide_data))),)
+        key = (tuple(map(tuple, (d.shape for d in data_iter.provide_data))),
+               id(self.arg_params), id(self.aux_params))
         if self._pred_mod is None or self._pred_key != key:
             mod = self._make_module(data_iter)
             shared = self._mod if (self._mod is not None
@@ -233,9 +227,11 @@ class FeedForward:
             mod.bind(data_shapes=data_iter.provide_data,
                      label_shapes=data_iter.provide_label,
                      for_training=False, shared_module=shared)
-            if shared is None:
-                mod.set_params(self.arg_params or {}, self.aux_params or {},
-                               allow_missing=False)
+            # always honor the CURRENT arg_params (a user may assign new
+            # weights after fit); with a shared module this writes into
+            # the shared arrays — both views stay consistent
+            mod.set_params(self.arg_params or {}, self.aux_params or {},
+                           allow_missing=False)
             self._pred_mod, self._pred_key = mod, key
         return self._pred_mod
 

@@ -75,6 +75,8 @@ class Rtc:
             raise MXNetError(
                 f"Rtc {self.name!r} expects {len(self.input_names)} inputs "
                 f"and {len(self.output_names)} outputs")
+        inputs = [x if hasattr(x, "shape") else np.asarray(x)
+                  for x in inputs]
         for name, x, (shape, dtype) in zip(self.input_names, inputs,
                                            self._in_templates):
             xs = tuple(x.shape)

@@ -1,0 +1,106 @@
+"""Detection image pipeline (reference: python/mxnet/image/detection.py,
+src/io/image_det_aug_default.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.image_det import (CreateDetAugmenter, DetHorizontalFlipAug,
+                                 DetRandomCropAug, DetRandomPadAug,
+                                 ImageDetIter)
+
+
+def _det_label(boxes, header=4):
+    """[A, B, pad..., (cls,x1,y1,x2,y2)*N] flat det label."""
+    flat = [header, 5] + [0.0] * (header - 2)
+    for b in boxes:
+        flat.extend(b)
+    return np.array(flat, np.float32)
+
+
+@pytest.fixture
+def det_dataset(tmp_path):
+    import cv2
+    rng = np.random.RandomState(0)
+    items = []
+    for i in range(6):
+        img = (rng.rand(40, 48, 3) * 255).astype(np.uint8)
+        path = str(tmp_path / f"img{i}.png")
+        cv2.imwrite(path, img)
+        n = 1 + i % 3
+        boxes = [[i % 4, 0.1 + 0.05 * j, 0.2, 0.5 + 0.05 * j, 0.8]
+                 for j in range(n)]
+        items.append((_det_label(boxes), path))
+    return items
+
+
+def test_image_det_iter_shapes_and_padding(det_dataset):
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                      imglist=det_dataset, path_root=".")
+    batch = next(iter(it))
+    data = batch.data[0].asnumpy()
+    label = batch.label[0].asnumpy()
+    assert data.shape == (4, 3, 32, 32)
+    assert label.shape[0] == 4 and label.shape[2] == 5
+    assert label.shape[1] >= 3  # max objects in dataset
+    # padding rows are -1
+    row_counts = (label[:, :, 0] >= 0).sum(axis=1)
+    assert row_counts.min() >= 1
+    assert (label[0][int(row_counts[0]):] == -1).all()
+
+
+def test_det_hflip_flips_boxes():
+    aug = DetHorizontalFlipAug(p=1.0)
+    img = np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3)
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.8]], np.float32)
+    out_img, out_label = aug(img, label)
+    np.testing.assert_allclose(out_label[0, 1:],
+                               [0.6, 0.2, 0.9, 0.8], rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out_img),
+                                  np.asarray(img)[:, ::-1])
+
+
+def test_det_random_crop_keeps_coverage():
+    import random as pyrandom
+    pyrandom.seed(0)
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.3, 0.9))
+    img = np.zeros((64, 64, 3), np.uint8)
+    label = np.array([[1, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    for _ in range(10):
+        out_img, out_label = aug(img, label)
+        if len(out_label):
+            assert (out_label[:, 1:] >= -1e-6).all()
+            assert (out_label[:, 1:] <= 1 + 1e-6).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    import random as pyrandom
+    pyrandom.seed(1)
+    aug = DetRandomPadAug(area_range=(2.0, 2.5))
+    img = np.full((32, 32, 3), 255, np.uint8)
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    out_img, out_label = aug(img, label)
+    oh, ow = np.asarray(out_img).shape[:2]
+    assert oh > 32 or ow > 32
+    w = out_label[0, 3] - out_label[0, 1]
+    h = out_label[0, 4] - out_label[0, 2]
+    assert w < 1.0 and h < 1.0  # box smaller in the padded canvas
+
+
+def test_create_det_augmenter_pipeline(det_dataset):
+    augs = CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True)
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      imglist=det_dataset, path_root=".", aug_list=augs)
+    for batch in it:
+        lab = batch.label[0].asnumpy()
+        valid = lab[lab[:, :, 0] >= 0]
+        if len(valid):
+            assert (valid[:, 1:] >= -1e-5).all()
+            assert (valid[:, 1:] <= 1 + 1e-5).all()
+    assert batch.data[0].shape == (2, 3, 32, 32)
+
+
+def test_mx_image_namespace_exposes_det():
+    assert hasattr(mx.image, "ImageDetIter")
+    assert hasattr(mx.image, "CreateDetAugmenter")
