@@ -73,16 +73,17 @@ class DetHorizontalFlipAug(DetAugmenter):
         return src, label
 
 
-def _box_iou_1(crop, boxes):
-    """IOU of one crop (4,) vs boxes (N, 4), normalized coords."""
+def _box_coverage(crop, boxes):
+    """Fraction of each box's area covered by the crop (N,), normalized
+    coords — the reference's constraint metric (intersection / box area,
+    NOT IOU: a crop containing a small object covers it fully)."""
     tl = np.maximum(crop[:2], boxes[:, :2])
     br = np.minimum(crop[2:], boxes[:, 2:])
     wh = np.clip(br - tl, 0, None)
     inter = wh[:, 0] * wh[:, 1]
     area_b = np.clip(boxes[:, 2] - boxes[:, 0], 0, None) * \
         np.clip(boxes[:, 3] - boxes[:, 1], 0, None)
-    area_c = (crop[2] - crop[0]) * (crop[3] - crop[1])
-    return inter / np.maximum(area_b + area_c - inter, 1e-12)
+    return inter / np.maximum(area_b, 1e-12)
 
 
 class DetRandomCropAug(DetAugmenter):
@@ -109,8 +110,16 @@ class DetRandomCropAug(DetAugmenter):
             y0 = pyrandom.uniform(0, 1 - ch)
             crop = np.array([x0, y0, x0 + cw, y0 + ch], np.float32)
             if len(label):
-                iou = _box_iou_1(crop, label[:, 1:5])
-                if iou.max() < self.min_object_covered:
+                cover = _box_coverage(crop, label[:, 1:5])
+                # every object the crop keeps (center inside) must clear
+                # the coverage constraint, and at least one must survive
+                cx = (label[:, 1] + label[:, 3]) / 2
+                cy = (label[:, 2] + label[:, 4]) / 2
+                inside = ((cx >= crop[0]) & (cx <= crop[2])
+                          & (cy >= crop[1]) & (cy <= crop[3]))
+                if not inside.any():
+                    continue
+                if cover[inside].min() < self.min_object_covered:
                     continue
             new_label = self._crop_boxes(label, crop)
             if len(label) and not len(new_label):
@@ -192,7 +201,11 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
                        area_range=(0.05, 3.0), min_eject_coverage=0.3,
                        max_attempts=50, pad_val=(127, 127, 127)):
     """Standard detection augmenter list (reference detection.py:482)."""
+    from .image import HueJitterAug, LightingAug, ResizeAug
+
     auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
     if rand_crop > 0:
         crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
                                 (area_range[0], min(1.0, area_range[1])),
@@ -207,10 +220,20 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         auglist.append(DetHorizontalFlipAug(0.5))
     # resize to the network shape AFTER the geometric augs
     auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2],
-                                                data_shape[1]))))
+                                                data_shape[1]),
+                                               inter_method)))
     if brightness or contrast or saturation:
         auglist.append(DetBorrowAug(
             ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        auglist.append(DetBorrowAug(LightingAug(
+            pca_noise,
+            np.asarray([55.46, 4.794, 1.148]),
+            np.asarray([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]]))))
     if rand_gray > 0:
         auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
     auglist.append(DetBorrowAug(CastAug()))
@@ -235,7 +258,10 @@ class ImageDetIter(ImageIter):
                  **kwargs):
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape, **kwargs)
-            kwargs = {}
+        elif kwargs:
+            raise MXNetError(
+                f"pass augmentation kwargs {sorted(kwargs)} OR an explicit "
+                "aug_list, not both")
         super().__init__(batch_size, data_shape, label_width=1,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, path_imgidx=path_imgidx,

@@ -218,8 +218,7 @@ class FeedForward:
         (the reference caches its prediction executor the same way).
         When a trained module exists, the inference executor shares its
         parameter arrays (shared_module) instead of copying them."""
-        key = (tuple(map(tuple, (d.shape for d in data_iter.provide_data))),
-               id(self.arg_params), id(self.aux_params))
+        key = tuple(map(tuple, (d.shape for d in data_iter.provide_data)))
         if self._pred_mod is None or self._pred_key != key:
             mod = self._make_module(data_iter)
             shared = self._mod if (self._mod is not None
@@ -227,12 +226,14 @@ class FeedForward:
             mod.bind(data_shapes=data_iter.provide_data,
                      label_shapes=data_iter.provide_label,
                      for_training=False, shared_module=shared)
-            # always honor the CURRENT arg_params (a user may assign new
-            # weights after fit); with a shared module this writes into
-            # the shared arrays — both views stay consistent
-            mod.set_params(self.arg_params or {}, self.aux_params or {},
-                           allow_missing=False)
             self._pred_mod, self._pred_key = mod, key
+        # set_params on EVERY call: honors reassigned or in-place-mutated
+        # arg_params (with a shared module this writes into the shared
+        # arrays, keeping trainer and predictor views consistent — the
+        # estimator owns one parameter set)
+        self._pred_mod.set_params(self.arg_params or {},
+                                  self.aux_params or {},
+                                  allow_missing=False)
         return self._pred_mod
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):

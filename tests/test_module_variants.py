@@ -1,0 +1,86 @@
+"""SequentialModule / PythonModule / LibSVMIter (reference:
+module/sequential_module.py, module/python_module.py, iter_libsvm.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter
+
+
+def test_sequential_with_python_loss():
+    """Net module chained into a python loss module (the reference's
+    canonical SequentialModule example)."""
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (256, 10)).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2, name="fc")
+    net = mx.sym.softmax(net, name="prob")
+    m1 = mx.mod.Module(net, data_names=["data"], label_names=[])
+    seq = mx.mod.SequentialModule()
+    seq.add(m1).add(mx.mod.PythonLossModule(data_names=("prob_output",)),
+                    take_labels=True, auto_wiring=True)
+    it = NDArrayIter(X, Y, 64, label_name="softmax_label")
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for _ in range(6):
+        it.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        seq.forward(batch, is_train=False)
+        p = seq.get_outputs()[0].asnumpy().argmax(1)
+        correct += (p == batch.label[0].asnumpy()).sum()
+        total += len(p)
+    assert correct / total > 0.85
+
+
+def test_sequential_meta_validation():
+    seq = mx.mod.SequentialModule()
+    with pytest.raises(mx.base.MXNetError):
+        seq.add(mx.mod.PythonLossModule(), bogus_meta=True)
+
+
+def test_python_loss_custom_grad():
+    calls = {}
+
+    def grad_func(scores, labels):
+        calls["n"] = calls.get("n", 0) + 1
+        return scores.asnumpy() * 0 + 2.0
+
+    m = mx.mod.PythonLossModule(grad_func=grad_func)
+    from mxnet_tpu.io import DataBatch, DataDesc
+    m.bind(data_shapes=[DataDesc("data", (4, 3))],
+           label_shapes=[DataDesc("softmax_label", (4,))])
+    m.init_params()
+    batch = DataBatch(data=[mx.nd.ones((4, 3))],
+                      label=[mx.nd.zeros((4,))])
+    m.forward(batch, is_train=True)
+    m.backward()
+    g = m.get_input_grads()[0].asnumpy()
+    np.testing.assert_allclose(g, np.full((4, 3), 2.0))
+    assert calls["n"] == 1
+
+
+def test_libsvm_iter(tmp_path):
+    path = tmp_path / "data.libsvm"
+    path.write_text(
+        "1 0:1.5 3:2.0\n"
+        "0 1:0.5\n"
+        "1 0:1.0 2:3.0 3:4.0\n")
+    it = mx.io.LibSVMIter(str(path), data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    dense = b0.data[0].asnumpy() if hasattr(b0.data[0], "asnumpy") else None
+    assert dense.shape == (2, 4)
+    np.testing.assert_allclose(dense[0], [1.5, 0, 0, 2.0])
+    np.testing.assert_allclose(dense[1], [0, 0.5, 0, 0])
+    np.testing.assert_allclose(b0.label[0].asnumpy(), [1, 0])
+    assert batches[1].pad == 1  # wrap-padded final batch
