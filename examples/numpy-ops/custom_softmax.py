@@ -43,6 +43,8 @@ class SoftmaxProp(mx.operator.CustomOpProp):
 
 
 def main():
+    np.random.seed(0)
+    mx.random.seed(0)
     rng = np.random.RandomState(0)
     x = rng.rand(512, 16).astype(np.float32)
     w = rng.normal(0, 1, (16, 4))
@@ -57,7 +59,7 @@ def main():
                            label_name="softmax_label")
     mod = mx.mod.Module(net, data_names=["data"],
                         label_names=["softmax_label"])
-    mod.fit(it, num_epoch=20, optimizer="sgd",
+    mod.fit(it, num_epoch=40, optimizer="sgd",
             optimizer_params={"learning_rate": 0.5})
     acc = mod.score(it, mx.metric.Accuracy())[0][1]
     print(f"accuracy with custom softmax: {acc:.4f}")

@@ -17,7 +17,14 @@ from . import random  # noqa: F401
 from . import symbol  # noqa: F401
 from . import symbol as sym  # noqa: F401
 from . import executor  # noqa: F401
+from . import executor_manager  # noqa: F401
 from .executor import Executor  # noqa: F401
+from . import name  # noqa: F401
+from . import attribute  # noqa: F401
+from . import registry  # noqa: F401
+from . import libinfo  # noqa: F401
+from . import log  # noqa: F401
+from . import misc  # noqa: F401
 from .symbol import AttrScope, Symbol  # noqa: F401
 from . import initializer  # noqa: F401
 from . import initializer as init  # noqa: F401
@@ -30,6 +37,9 @@ for _n in image_det.__all__:  # reference exposes det under mx.image.*
 del _n
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
+from . import kvstore_server  # noqa: F401
+from . import ndarray_doc  # noqa: F401
+from . import symbol_doc  # noqa: F401
 from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401
 from . import model  # noqa: F401
@@ -51,4 +61,4 @@ from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, current_context, gpu, num_gpus, num_tpus, tpu  # noqa: F401
 from .ndarray import NDArray  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = libinfo.__version__

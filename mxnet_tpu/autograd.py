@@ -30,6 +30,7 @@ __all__ = [
     "mark_variables",
     "backward",
     "grad",
+    "Function",
     "AGNode",
 ]
 
@@ -236,6 +237,97 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
     # tape nodes are garbage-collected once the head NDArrays drop their
     # _ag_node references; nothing to free eagerly here
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.py:291).
+
+    Defines both forward and backward for a custom computation; during
+    gradient computation the user's ``backward`` replaces the default
+    chain rule.  Example — a numerically stable sigmoid::
+
+        class sigmoid(mx.autograd.Function):
+            def forward(self, x):
+                y = 1 / (1 + mx.nd.exp(-x))
+                self.save_for_backward(y)
+                return y
+            def backward(self, dy):
+                y, = self.saved_tensors
+                return dy * y * (1 - y)
+
+    Taped as a single AGNode whose grad_fn invokes the user's ``backward``
+    (the reference's _CustomFunction / MXCustomFunctionRecord path).
+    """
+
+    def __init__(self):
+        self._used = False
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        """Takes as many inputs as forward's outputs; returns as many
+        NDArrays as forward's arguments."""
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        if self._used:
+            raise MXNetError(
+                "Each Function instance can only be called once. "
+                "Please create another instance.")
+        self._used = True
+
+        prev = set_recording(False)
+        try:
+            outputs = self.forward(*inputs)
+        finally:
+            set_recording(prev)
+        if not prev:
+            return outputs
+
+        single = isinstance(outputs, NDArray)
+        if single:
+            outputs = (outputs,)
+        # fresh result handles: forward may return an input (or any already
+        # taped array) unchanged; tagging that object in place would make
+        # the new node its own child and orphan the original producer
+        outputs = tuple(NDArray(o._data) for o in outputs)
+        ret_outputs = outputs[0] if single else outputs
+        func = self
+        n_in = len(inputs)
+
+        class _FunctionOpDef:
+            name = type(self).__name__
+            needs_rng = False
+            differentiable = True
+            fn = None
+
+            @staticmethod
+            def grad_fn(attrs, rng, input_vals, out_arrays, out_cts):
+                ograds = [NDArray(c) for c in out_cts]
+                rets = func.backward(*ograds)
+                if isinstance(rets, NDArray):
+                    rets = (rets,)
+                if len(rets) != n_in:
+                    raise MXNetError(
+                        f"{type(func).__name__}.backward must return exactly "
+                        f"as many NDArrays as forward's arguments "
+                        f"(expected {n_in}, got {len(rets)})")
+                return tuple(r._data for r in rets)
+
+        node = AGNode(_FunctionOpDef, {}, None, list(inputs),
+                      [x._data for x in inputs], len(outputs),
+                      [o._data for o in outputs])
+        for i, o in enumerate(outputs):
+            o._ag_node = node
+            o._ag_out_index = i
+        return ret_outputs
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

@@ -26,7 +26,8 @@ import numpy as np
 from .base import MXNetError
 from .ops.custom_op import CUSTOM_OP_REGISTRY
 
-__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "PythonOp", "NumpyOp", "NDArrayOp"]
 
 
 class CustomOp:
@@ -105,3 +106,137 @@ def register(reg_name):
 
 def get_all_registered():
     return dict(CUSTOM_OP_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Legacy python-op API (reference operator.py:36-243: PythonOp / NumpyOp /
+# NDArrayOp registered through symbol._internal._Native / _NDArray). Here
+# each get_symbol() auto-registers a one-off CustomOpProp adapter and
+# returns a Custom symbol, so the legacy classes ride the same bridge.
+# ---------------------------------------------------------------------------
+
+_legacy_counter = [0]
+
+
+class PythonOp:
+    """Base class for operators implemented in Python (deprecated in the
+    reference in favor of CustomOp; kept for API parity)."""
+
+    _ref_holder = []
+    _numpy_mode = True
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("Must override this")
+
+    def forward(self, in_data, out_data):
+        out_data[0][:] = in_data[0]
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = 1.0
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # -- adapter plumbing (not part of the reference surface) ---------------
+    def _make_symbol(self, *args, **kwargs):
+        from . import symbol as _sym
+        from . import ndarray as _nd
+
+        # one registry entry per op instance, however many symbols it builds
+        reg_name = getattr(self, "_reg_name", None)
+        if reg_name is not None:
+            return _sym.Custom(*args, op_type=reg_name, **kwargs)
+
+        py_op = self
+        numpy_mode = self._numpy_mode
+
+        class _AdapterOp(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                if numpy_mode:
+                    ins = [x.asnumpy() for x in in_data]
+                    outs = [x.asnumpy() for x in out_data]
+                    py_op.forward(in_data=ins, out_data=outs)
+                    for dst, r, src in zip(out_data, req, outs):
+                        self.assign(dst, r, _nd.array(src))
+                else:
+                    py_op.forward(in_data=list(in_data),
+                                  out_data=list(out_data))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                if numpy_mode:
+                    og = [x.asnumpy() for x in out_grad]
+                    ins = [x.asnumpy() for x in in_data]
+                    outs = [x.asnumpy() for x in out_data]
+                    igs = [x.asnumpy() for x in in_grad]
+                    py_op.backward(out_grad=og, in_data=ins, out_data=outs,
+                                   in_grad=igs)
+                    for dst, r, src in zip(in_grad, req, igs):
+                        self.assign(dst, r, _nd.array(src))
+                else:
+                    py_op.backward(out_grad=list(out_grad),
+                                   in_data=list(in_data),
+                                   out_data=list(out_data),
+                                   in_grad=list(in_grad))
+
+        class _AdapterProp(CustomOpProp):
+            def __init__(self, **_ignored):
+                super().__init__(need_top_grad=py_op.need_top_grad())
+
+            def list_arguments(self):
+                return py_op.list_arguments()
+
+            def list_outputs(self):
+                return py_op.list_outputs()
+
+            def infer_shape(self, in_shape):
+                ishape, oshape = py_op.infer_shape(
+                    [list(s) for s in in_shape])
+                return list(ishape), list(oshape), []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return _AdapterOp()
+
+        _legacy_counter[0] += 1
+        reg_name = (f"_legacy_{'numpy' if numpy_mode else 'ndarray'}"
+                    f"_op_{_legacy_counter[0]}")
+        CUSTOM_OP_REGISTRY[reg_name] = _AdapterProp
+        self._reg_name = reg_name
+        PythonOp._ref_holder.append(self)
+        return _sym.Custom(*args, op_type=reg_name, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy operator: forward/backward receive numpy arrays and
+    write results in place (reference operator.py NumpyOp via _Native)."""
+
+    _numpy_mode = True
+
+    def get_symbol(self, *args, **kwargs):
+        return self._make_symbol(*args, **kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray operator: forward/backward receive NDArrays
+    (reference operator.py NDArrayOp via _NDArray)."""
+
+    _numpy_mode = False
+
+    def get_symbol(self, *args, **kwargs):
+        return self._make_symbol(*args, **kwargs)

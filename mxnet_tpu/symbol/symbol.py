@@ -21,29 +21,73 @@ from ..base import MXNetError
 from ..ops.registry import OP_TABLE, OpDef, get_op
 
 __all__ = ["Symbol", "SymbolNode", "Variable", "var", "Group", "load",
-           "load_json", "symbol_invoke", "NameManager", "AttrScope"]
+           "load_json", "symbol_invoke", "NameManager", "Prefix", "AttrScope"]
 
 
-class NameManager:
-    """Auto-naming for anonymous symbols (reference: python/mxnet/name.py)."""
+class _NameManagerMeta(type):
+    """Makes ``NameManager.current`` thread-local while keeping the
+    reference's class-attribute spelling (each thread gets its own default
+    manager; scoped installs don't leak across threads)."""
 
-    _local = threading.local()
+    _tls = threading.local()
 
-    @classmethod
-    def get(cls, name: Optional[str], hint: str) -> str:
+    @property
+    def current(cls):
+        cur = getattr(cls._tls, "current", None)
+        if cur is None:
+            cur = cls._tls.current = NameManager()
+        return cur
+
+    @current.setter
+    def current(cls, value):
+        cls._tls.current = value
+
+
+class NameManager(metaclass=_NameManagerMeta):
+    """Auto-naming for anonymous symbols (reference: python/mxnet/name.py).
+
+    Scoped like the reference: ``NameManager.current`` is the active
+    manager; ``with NameManager():`` / ``with Prefix('net_'):`` installs a
+    new one for the block. Subclasses override the instance ``get``.
+    """
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name: Optional[str], hint: str) -> str:
         if name:
             return name
-        if not hasattr(cls._local, "counters"):
-            cls._local.counters = {}
-        c = cls._local.counters
         hint = hint.lower().lstrip("_")
-        idx = c.get(hint, 0)
-        c[hint] = idx + 1
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
         return f"{hint}{idx}"
+
+    def __enter__(self):
+        self._old_manager = NameManager.current
+        NameManager.current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager is not None
+        NameManager.current = self._old_manager
+        return False
 
     @classmethod
     def reset(cls):
-        cls._local.counters = {}
+        cls.current._counter = {}
+
+
+class Prefix(NameManager):
+    """Name manager that prepends a prefix to every auto/explicit name
+    (reference name.py:74)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        return self._prefix + super().get(name, hint)
 
 
 class AttrScope:
@@ -589,7 +633,7 @@ def symbol_invoke(opdef: OpDef, inputs: Sequence[Symbol], attrs: Dict,
     parameter inputs (reference: nnvm symbol composition — missing inputs
     become variables named '{node}_{input}', e.g. 'fc1_weight')."""
     parsed = opdef.parse_attrs(attrs or {})
-    name = NameManager.get(name, opdef.name)
+    name = NameManager.current.get(name, opdef.name)
     entries: List[Tuple[SymbolNode, int]] = []
     for s in inputs:
         if len(s._outputs) != 1:

@@ -1,0 +1,332 @@
+"""Frontend-parity modules: name/registry/log/libinfo/misc/executor_manager,
+autograd.Function, legacy NumpyOp/NDArrayOp.
+
+Reference analogues: python/mxnet/{name,registry,log,libinfo,misc,
+executor_manager,operator,autograd}.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_name_prefix():
+    data = mx.sym.var("data")
+    with mx.name.Prefix("mynet_"):
+        net = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    assert "mynet_fc1_weight" in net.list_arguments()
+    assert "mynet_fc1_bias" in net.list_arguments()
+
+
+def test_name_manager_scoped_counters():
+    with mx.name.NameManager():
+        a = mx.sym.FullyConnected(mx.sym.var("x"), num_hidden=2)
+        b = mx.sym.FullyConnected(mx.sym.var("y"), num_hidden=2)
+    assert a.name != b.name
+
+
+def test_attribute_module_alias():
+    assert mx.attribute.AttrScope is mx.AttrScope
+
+
+def test_registry_register_create():
+    class Base:
+        pass
+
+    reg = mx.registry.get_register_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+
+    @alias("foo", "myfoo")
+    class Foo(Base):
+        def __init__(self, a=1):
+            self.a = a
+
+    assert isinstance(create("foo"), Foo)
+    assert create("myfoo", a=3).a == 3
+    assert create('{"thing": "foo", "a": 5}').a == 5
+    assert create('["foo", {"a": 7}]').a == 7
+    inst = Foo()
+    assert create(inst) is inst
+    with pytest.raises(ValueError):
+        create("unregistered-name")
+
+
+def test_log_get_logger():
+    logger = mx.log.get_logger("parity_test_logger", level=mx.log.INFO)
+    assert logger.level == mx.log.INFO
+    assert logger.handlers  # got a handler attached exactly once
+    again = mx.log.get_logger("parity_test_logger")
+    assert again.handlers == logger.handlers
+
+
+def test_libinfo():
+    assert isinstance(mx.libinfo.find_lib_path(), list)
+    assert mx.__version__ == mx.libinfo.__version__
+
+
+def test_misc_factor_scheduler():
+    s = mx.misc.FactorScheduler(step=10, factor=0.5)
+    assert s(0) == pytest.approx(0.01)
+    assert s(10) == pytest.approx(0.005)
+    assert s(20) == pytest.approx(0.0025)
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=0)
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=1, factor=1.5)
+
+
+def test_kvstore_server_shim():
+    kv = mx.kvstore.create("local")
+    server = mx.kvstore_server.KVStoreServer(kv)
+    with pytest.raises(RuntimeError):
+        server.run()
+
+
+def test_split_input_slice():
+    from mxnet_tpu.executor_manager import _split_input_slice
+
+    assert _split_input_slice(10, [1, 1]) == [slice(0, 5), slice(5, 10)]
+    assert _split_input_slice(10, [1, 4]) == [slice(0, 2), slice(2, 10)]
+    with pytest.raises(ValueError):
+        _split_input_slice(2, [1, 1, 1, 1])
+
+
+def test_check_arguments_duplicates():
+    from mxnet_tpu.executor_manager import _check_arguments
+
+    x = mx.sym.var("x")
+    w = mx.sym.var("w")
+    good = mx.sym.FullyConnected(x, weight=w, num_hidden=2, no_bias=True)
+    _check_arguments(good)  # no raise
+    dup = mx.sym.elemwise_add(mx.sym.FullyConnected(x, weight=w, num_hidden=2,
+                                                    no_bias=True),
+                              mx.sym.FullyConnected(x, weight=w, num_hidden=2,
+                                                    no_bias=True))
+    _check_arguments(dup)  # shared weight appears once in list_arguments
+
+
+def test_executor_manager_trains():
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype(np.float32)
+    y = (x.sum(1) > 4).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2),
+        name="softmax")
+    arg_names = net.list_arguments()
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    mgr = DataParallelExecutorManager(
+        net, [mx.cpu(0), mx.cpu(1)], it, arg_names=arg_names,
+        param_names=param_names, aux_names=net.list_auxiliary_states())
+
+    # init params on the executors
+    arg_params = {n: mx.nd.array(rng.normal(0, 0.1, s))
+                  for n, s in zip(arg_names,
+                                  net.infer_shape(data=(8, 8),
+                                                  softmax_label=(8,))[0])
+                  if n in param_names}
+    mgr.set_params(arg_params, {})
+
+    batch = next(iter(it))
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    # every param has a grad in every executor
+    for block in mgr.grad_arrays:
+        assert len(block) == 2
+        for g in block:
+            assert g is not None
+    metric = mx.metric.Accuracy()
+    mgr.update_metric(metric, batch.label)
+    assert 0.0 <= metric.get()[1] <= 1.0
+    # copy_to averages across executors
+    out_params = {n: mx.nd.zeros(v.shape) for n, v in arg_params.items()}
+    mgr.copy_to(out_params, {})
+    for n in out_params:
+        assert out_params[n].shape == arg_params[n].shape
+
+
+def test_autograd_function():
+    class sigmoid(mx.autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        f = sigmoid()
+        y = f(x)
+    y.backward()
+    xn = x.asnumpy()
+    s = 1 / (1 + np.exp(-xn))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+    # single-use contract
+    with pytest.raises(mx.MXNetError):
+        with mx.autograd.record():
+            f(x)
+
+    # eager (unrecorded) path returns plain outputs
+    out = sigmoid()(mx.nd.ones((2, 2)))
+    assert out.shape == (2, 2)
+
+
+def test_autograd_function_multi_io():
+    class addmul(mx.autograd.Function):
+        def forward(self, a, b):
+            self.save_for_backward(a, b)
+            return a + b, a * b
+
+        def backward(self, dsum, dprod):
+            a, b = self.saved_tensors
+            return dsum + dprod * b, dsum + dprod * a
+
+    a = mx.nd.array(np.random.rand(3).astype(np.float32))
+    b = mx.nd.array(np.random.rand(3).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        f = addmul()
+        s, p = f(a, b)
+        total = s + p
+    total.backward()
+    an, bn = a.asnumpy(), b.asnumpy()
+    np.testing.assert_allclose(a.grad.asnumpy(), 1 + bn, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), 1 + an, rtol=1e-5)
+
+
+def test_autograd_function_passthrough_identity():
+    # forward returning its input unchanged must not orphan the input's
+    # producer node (fresh output handles)
+    class passthrough(mx.autograd.Function):
+        def forward(self, x):
+            return x
+
+        def backward(self, dy):
+            return dy
+
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        h = x * 3
+        y = passthrough()(h)
+        z = y * 2
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 6.0], rtol=1e-6)
+
+
+def test_autograd_function_forward_raise_restores_recording():
+    class bad(mx.autograd.Function):
+        def forward(self, x):
+            raise ValueError("boom")
+
+        def backward(self, dy):
+            return dy
+
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    with mx.autograd.record():
+        with pytest.raises(ValueError):
+            bad()(x)
+        assert mx.autograd.is_recording()
+        y = x * 4
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 4.0], rtol=1e-6)
+
+
+def test_legacy_op_symbol_reuse_single_registration():
+    class Double(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * 2
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * 2
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+    op = Double()
+    before = len(mx.operator.get_all_registered())
+    op(mx.sym.var("a"))
+    op(mx.sym.var("b"))
+    after = len(mx.operator.get_all_registered())
+    assert after == before + 1  # one registry entry per instance
+
+
+def test_legacy_numpy_op():
+    class NumpySoftmax(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]]
+
+        def forward(self, in_data, out_data):
+            x = in_data[0]
+            y = out_data[0]
+            y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+            y /= y.sum(axis=1, keepdims=True)
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            label = in_data[1].ravel().astype(int)
+            y = out_data[0]
+            dx = in_grad[0]
+            dx[:] = y
+            dx[np.arange(label.shape[0]), label] -= 1.0
+            in_grad[1][:] = 0
+
+    op = NumpySoftmax()
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    net = op(mx.sym.FullyConnected(data, num_hidden=4, name="fc"), label,
+             name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 16).astype(np.float32)
+    w = rng.normal(0, 1, (16, 4))
+    y = (x @ w).argmax(1).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(it, num_epoch=25, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    assert acc > 0.85
+
+
+def test_legacy_ndarray_op():
+    class Double(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * 2
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * 2
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+    op = Double()
+    s = op(mx.sym.var("data"), name="double")
+    ex = s.simple_bind(mx.cpu(), data=(2, 3), grad_req="write")
+    ex.arg_dict["data"][:] = mx.nd.ones((2, 3))
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), 2 * np.ones((2, 3)), rtol=1e-6)
+    ex.backward(mx.nd.ones((2, 3)))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               2 * np.ones((2, 3)), rtol=1e-6)
