@@ -12,19 +12,34 @@ from ..base import MXNetError
 from ..ops.registry import OP_TABLE, OpDef, resolve_inputs
 from .ndarray import (  # noqa: F401
     NDArray,
+    add,
     arange,
     array,
     concatenate,
+    divide,
     empty,
+    equal,
     full,
+    greater,
+    greater_equal,
     imdecode,
     imperative_invoke,
+    lesser,
+    lesser_equal,
     load,
+    maximum,
+    minimum,
+    modulo,
     moveaxis,
+    multiply,
+    not_equal,
     ones,
     ones_like,
     onehot_encode,
+    power,
     save,
+    subtract,
+    true_divide,
     waitall,
     zeros,
     zeros_like,
@@ -60,3 +75,38 @@ for _name, _opdef in OP_TABLE.items():
 del _mod, _name, _opdef
 
 from . import contrib  # noqa: F401,E402
+
+
+# -- host-side imaging + sparse conveniences (reference _internal cv ops and
+# sparse module-level functions) --------------------------------------------
+
+def _cvimdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer (reference src/io/image_io.cc
+    _cvimdecode; host-side, not jittable)."""
+    from .. import image as _image
+    return _image.imdecode(buf, flag=flag, to_rgb=to_rgb, out=out)
+
+
+def _cvimread(filename, flag=1, to_rgb=True):
+    """Read + decode an image file (reference image_io.cc _cvimread)."""
+    from .. import image as _image
+    return _image.imread(filename, flag=flag, to_rgb=to_rgb)
+
+
+def cast_storage(data, stype):
+    """Cast between dense/row_sparse/csr storage (reference
+    src/operator/tensor/cast_storage-inl.h; here a dispatch over the
+    sparse wrapper types)."""
+    return data.tostype(stype)
+
+
+def sparse_retain(data, indices):
+    """Retain the listed rows of a row_sparse array, zeroing the rest
+    (reference tensor/sparse_retain-inl.h)."""
+    if not hasattr(data, "retain"):
+        raise MXNetError(
+            f"sparse_retain expects a RowSparseNDArray, got {type(data)}")
+    return data.retain(indices)
+
+
+_sparse_retain = sparse_retain

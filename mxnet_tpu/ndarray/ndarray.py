@@ -12,6 +12,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import operator
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -720,3 +722,116 @@ def load(fname: str):
         if all(k.isdigit() for k in keys):
             return [array(f[k]) for k in sorted(keys, key=int)]
         return {k: array(f[k]) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# Module-level arithmetic helpers (reference ndarray.py: add/subtract/... via
+# _ufunc_helper — array·array dispatches to the broadcast op, array·scalar to
+# the scalar op, scalar·scalar to the python operator).
+# ---------------------------------------------------------------------------
+
+def _table_op(name):
+    from ..ops.registry import OP_TABLE
+    opdef = OP_TABLE[name]
+
+    def f(*args, **kw):
+        res = imperative_invoke(opdef, list(args), kw)
+        return res[0] if len(res) == 1 else res
+    return f
+
+
+def _ufunc_helper(lhs, rhs, fn_array, fn_scalar, lfn_scalar,
+                  rfn_scalar=None):
+    """Dispatch helper mirroring reference ndarray.py:_ufunc_helper."""
+    if isinstance(lhs, numeric_types):
+        if isinstance(rhs, numeric_types):
+            return fn_scalar(lhs, rhs)
+        if rfn_scalar is None:
+            # commutative
+            return _table_op(lfn_scalar)(rhs, scalar=float(lhs))
+        return _table_op(rfn_scalar)(rhs, scalar=float(lhs))
+    if isinstance(rhs, numeric_types):
+        return _table_op(lfn_scalar)(lhs, scalar=float(rhs))
+    if isinstance(rhs, NDArray):
+        return _table_op(fn_array)(lhs, rhs)
+    raise TypeError(f"type {type(rhs)} not supported")
+
+
+def add(lhs, rhs):
+    """Element-wise sum with broadcasting (reference ndarray.py add)."""
+    return _ufunc_helper(lhs, rhs, "broadcast_add", operator.add,
+                         "_plus_scalar")
+
+
+def subtract(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_sub", operator.sub,
+                         "_minus_scalar", "_rminus_scalar")
+
+
+def multiply(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_mul", operator.mul,
+                         "_mul_scalar")
+
+
+def divide(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_div", operator.truediv,
+                         "_div_scalar", "_rdiv_scalar")
+
+
+true_divide = divide
+
+
+def modulo(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_mod", operator.mod,
+                         "_mod_scalar", "_rmod_scalar")
+
+
+def power(base, exp):
+    return _ufunc_helper(base, exp, "broadcast_power", operator.pow,
+                         "_power_scalar", "_rpower_scalar")
+
+
+def maximum(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_maximum",
+                         lambda x, y: x if x > y else y, "_maximum_scalar")
+
+
+def minimum(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_minimum",
+                         lambda x, y: x if x < y else y, "_minimum_scalar")
+
+
+def equal(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_equal",
+                         lambda x, y: 1.0 if x == y else 0.0,
+                         "_equal_scalar")
+
+
+def not_equal(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_not_equal",
+                         lambda x, y: 1.0 if x != y else 0.0,
+                         "_not_equal_scalar")
+
+
+def greater(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_greater",
+                         lambda x, y: 1.0 if x > y else 0.0,
+                         "_greater_scalar", "_lesser_scalar")
+
+
+def greater_equal(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_greater_equal",
+                         lambda x, y: 1.0 if x >= y else 0.0,
+                         "_greater_equal_scalar", "_lesser_equal_scalar")
+
+
+def lesser(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_lesser",
+                         lambda x, y: 1.0 if x < y else 0.0,
+                         "_lesser_scalar", "_greater_scalar")
+
+
+def lesser_equal(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_lesser_equal",
+                         lambda x, y: 1.0 if x <= y else 0.0,
+                         "_lesser_equal_scalar", "_greater_equal_scalar")

@@ -59,3 +59,48 @@ def ones(shape, dtype="float32", **kwargs):
 def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
     return _sys.modules[__name__]._arange(start=start, stop=stop, step=step,
                                           repeat=repeat, name=name, dtype=dtype)
+
+
+def full(shape, val, dtype="float32", name=None):
+    """Symbol filled with ``val`` (reference symbol.py full)."""
+    return _sys.modules[__name__]._full(shape=shape, value=float(val),
+                                        dtype=dtype, name=name)
+
+
+def _sym_ufunc(lhs, rhs, fn_array, lfn_scalar, rfn_scalar, fn_scalar):
+    """Scalar/Symbol dispatch shared by pow/maximum/minimum/hypot
+    (reference symbol.py:pow — Symbol·Symbol broadcasts, Symbol·scalar uses
+    the scalar op, scalar·scalar degenerates to python)."""
+    import numbers
+    mod = _sys.modules[__name__]
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return getattr(mod, fn_array)(lhs, rhs)
+    if isinstance(lhs, Symbol) and isinstance(rhs, numbers.Number):
+        return getattr(mod, lfn_scalar)(lhs, scalar=float(rhs))
+    if isinstance(lhs, numbers.Number) and isinstance(rhs, Symbol):
+        return getattr(mod, rfn_scalar)(rhs, scalar=float(lhs))
+    if isinstance(lhs, numbers.Number) and isinstance(rhs, numbers.Number):
+        return fn_scalar(lhs, rhs)
+    raise TypeError(f"types ({type(lhs)}, {type(rhs)}) not supported")
+
+
+def pow(base, exp):
+    """base ** exp with Symbol/scalar dispatch (reference symbol.py pow)."""
+    return _sym_ufunc(base, exp, "broadcast_power", "_power_scalar",
+                      "_rpower_scalar", lambda a, b: a ** b)
+
+
+def maximum(left, right):
+    return _sym_ufunc(left, right, "broadcast_maximum", "_maximum_scalar",
+                      "_maximum_scalar", lambda a, b: a if a > b else b)
+
+
+def minimum(left, right):
+    return _sym_ufunc(left, right, "broadcast_minimum", "_minimum_scalar",
+                      "_minimum_scalar", lambda a, b: a if a < b else b)
+
+
+def hypot(left, right):
+    import math
+    return _sym_ufunc(left, right, "broadcast_hypot", "_hypot_scalar",
+                      "_hypot_scalar", math.hypot)

@@ -1,0 +1,220 @@
+"""Compatibility op tail: module-level arithmetic helpers, legacy *_v1
+aliases, WarpCTC, slice-assign ops, cv imaging ops, sparse conveniences.
+
+Reference analogues: python/mxnet/ndarray.py module functions,
+plugin/warpctc, src/operator/tensor/matrix_op.cc (_slice_assign),
+src/io/image_io.cc (_cvimresize et al.).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_nd_arith_helpers_array_array():
+    a = mx.nd.array(np.array([[1., 2.], [3., 4.]], np.float32))
+    b = mx.nd.array(np.array([[4., 3.], [2., 1.]], np.float32))
+    an, bn = a.asnumpy(), b.asnumpy()
+    np.testing.assert_allclose(mx.nd.add(a, b).asnumpy(), an + bn)
+    np.testing.assert_allclose(mx.nd.subtract(a, b).asnumpy(), an - bn)
+    np.testing.assert_allclose(mx.nd.multiply(a, b).asnumpy(), an * bn)
+    np.testing.assert_allclose(mx.nd.divide(a, b).asnumpy(), an / bn)
+    np.testing.assert_allclose(mx.nd.modulo(a, b).asnumpy(), an % bn)
+    np.testing.assert_allclose(mx.nd.power(a, b).asnumpy(), an ** bn,
+                               rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.maximum(a, b).asnumpy(),
+                               np.maximum(an, bn))
+    np.testing.assert_allclose(mx.nd.minimum(a, b).asnumpy(),
+                               np.minimum(an, bn))
+    np.testing.assert_allclose(mx.nd.equal(a, b).asnumpy(),
+                               (an == bn).astype(np.float32))
+    np.testing.assert_allclose(mx.nd.not_equal(a, b).asnumpy(),
+                               (an != bn).astype(np.float32))
+    np.testing.assert_allclose(mx.nd.greater(a, b).asnumpy(),
+                               (an > bn).astype(np.float32))
+    np.testing.assert_allclose(mx.nd.lesser_equal(a, b).asnumpy(),
+                               (an <= bn).astype(np.float32))
+    assert mx.nd.true_divide is mx.nd.divide
+
+
+def test_nd_arith_helpers_scalar_dispatch():
+    a = mx.nd.array(np.array([1., 2., 3.], np.float32))
+    an = a.asnumpy()
+    np.testing.assert_allclose(mx.nd.subtract(1.0, a).asnumpy(), 1.0 - an)
+    np.testing.assert_allclose(mx.nd.divide(6.0, a).asnumpy(), 6.0 / an)
+    np.testing.assert_allclose(mx.nd.power(2.0, a).asnumpy(), 2.0 ** an,
+                               rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.maximum(a, 2.0).asnumpy(),
+                               np.maximum(an, 2.0))
+    np.testing.assert_allclose(mx.nd.maximum(2.0, a).asnumpy(),
+                               np.maximum(an, 2.0))
+    np.testing.assert_allclose(mx.nd.greater(2.0, a).asnumpy(),
+                               (2.0 > an).astype(np.float32))
+    np.testing.assert_allclose(mx.nd.lesser(2.0, a).asnumpy(),
+                               (2.0 < an).astype(np.float32))
+    # scalar·scalar degenerates to python numbers
+    assert mx.nd.add(2, 3) == 5
+    assert mx.nd.maximum(2, 3) == 3
+    assert mx.nd.equal(2, 2) == 1.0
+
+
+def test_sym_helpers():
+    x = mx.sym.var("x")
+    y = mx.sym.var("y")
+    ex = mx.sym.pow(x, y).simple_bind(mx.cpu(), x=(2,), y=(2,))
+    ex.arg_dict["x"][:] = mx.nd.array(np.array([2., 3.], np.float32))
+    ex.arg_dict["y"][:] = mx.nd.array(np.array([3., 2.], np.float32))
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [8., 9.],
+                               rtol=1e-5)
+    ex = mx.sym.hypot(x, 4.0).simple_bind(mx.cpu(), x=(1,))
+    ex.arg_dict["x"][:] = mx.nd.array(np.array([3.], np.float32))
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [5.], rtol=1e-5)
+    assert mx.sym.pow(2, 3) == 8
+    full = mx.sym.full((2, 2), 3.5)
+    np.testing.assert_allclose(
+        full.simple_bind(mx.cpu()).forward()[0].asnumpy(),
+        np.full((2, 2), 3.5))
+
+
+def test_v1_aliases_run():
+    data = mx.sym.var("data")
+    out = mx.sym.Pooling_v1(data, kernel=(2, 2), stride=(2, 2),
+                            pool_type="max")
+    ex = out.simple_bind(mx.cpu(), data=(1, 1, 4, 4))
+    ex.arg_dict["data"][:] = mx.nd.array(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    res = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(res.ravel(), [5., 7., 13., 15.])
+    assert hasattr(mx.nd, "BatchNorm_v1")
+    assert hasattr(mx.nd, "Convolution_v1")
+
+
+def test_no_gradient_and_cross_device_copy():
+    x = mx.nd.array(np.array([1., 2.], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd._NoGradient(x) * 3 + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1., 1.])
+    np.testing.assert_allclose(mx.nd._CrossDeviceCopy(x).asnumpy(),
+                               x.asnumpy())
+
+
+def test_slice_assign_ops():
+    x = mx.nd.zeros((4, 4))
+    y = mx.nd._slice_assign(x, mx.nd.ones((2, 2)), begin=(1, 1), end=(3, 3))
+    expect = np.zeros((4, 4), np.float32)
+    expect[1:3, 1:3] = 1
+    np.testing.assert_allclose(y.asnumpy(), expect)
+    z = mx.nd._crop_assign_scalar(x, scalar=7.0, begin=(0, 0), end=(1, 4))
+    assert z.asnumpy()[0].sum() == 28
+    # gradient flows to both lhs (outside region) and rhs (inside)
+    lhs = mx.nd.ones((3, 3))
+    rhs = mx.nd.ones((1, 3))
+    lhs.attach_grad()
+    rhs.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd._slice_assign(lhs, rhs, begin=(0, 0), end=(1, 3))
+    out.backward()
+    np.testing.assert_allclose(rhs.grad.asnumpy(), np.ones((1, 3)))
+    g = lhs.grad.asnumpy()
+    np.testing.assert_allclose(g[0], np.zeros(3))
+    np.testing.assert_allclose(g[1:], np.ones((2, 3)))
+
+
+def test_identity_with_attr_like_rhs():
+    a = mx.nd.array(np.array([1., 2.], np.float32))
+    b = mx.nd.zeros((2,))
+    np.testing.assert_allclose(
+        mx.nd._identity_with_attr_like_rhs(a, b).asnumpy(), a.asnumpy())
+
+
+def test_warpctc_forward_softmax_and_grad():
+    T, N, C, L = 6, 2, 5, 3
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.randn(T * N, C).astype(np.float32))
+    label = mx.nd.array(np.array([1, 2, 0, 3, 1, 0], np.float32))
+    out = mx.nd.WarpCTC(data, label, label_length=L, input_length=T)
+    np.testing.assert_allclose(out.asnumpy().sum(1), np.ones(T * N),
+                               rtol=1e-5)
+    d = mx.nd.array(rng.randn(T * N, C).astype(np.float32))
+    d.attach_grad()
+    with mx.autograd.record():
+        o = mx.nd.WarpCTC(d, label, label_length=L, input_length=T)
+    o.backward()
+    g = d.grad.asnumpy()
+    assert g.shape == (T * N, C)
+    assert np.abs(g).sum() > 0
+    # CTC gradient sums to ~0 per row for rows with mass on real labels
+    assert np.abs(g.sum(1)).max() < 1e-3
+
+
+def test_warpctc_trains_down():
+    # a tiny repeat-label task: loss should decrease under SGD on the grads
+    T, N, C, L = 8, 4, 4, 2
+    rng = np.random.RandomState(1)
+    w = mx.nd.array(rng.normal(0, 0.1, (T * N, C)).astype(np.float32))
+    label = mx.nd.array(
+        np.tile(np.array([1, 2], np.float32), N))
+
+    def loss_of(dat):
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.contrib_ops import _ctc_forward
+        import jax
+        logp = jax.nn.log_softmax(
+            np.asarray(dat.asnumpy(), np.float32).reshape(T, N, C), axis=-1)
+        logp = np.transpose(logp, (1, 0, 2))
+        lab = label.asnumpy().reshape(N, L).astype(np.int32)
+        dl = np.full((N,), T, np.int32)
+        ll = (lab != 0).sum(1).astype(np.int32)
+        return float(np.sum(jax.vmap(_ctc_forward)(
+            jnp.asarray(logp), jnp.asarray(lab), jnp.asarray(dl),
+            jnp.asarray(ll))))
+
+    first = loss_of(w)
+    for _ in range(10):
+        w.attach_grad()
+        with mx.autograd.record():
+            out = mx.nd.WarpCTC(w, label, label_length=L, input_length=T)
+        out.backward()
+        w = mx.nd.array(w.asnumpy() - 1.0 * w.grad.asnumpy())
+    assert loss_of(w) < first
+
+
+def test_cv_ops():
+    img = mx.nd.array(
+        (np.random.RandomState(0).rand(8, 6, 3) * 255).astype(np.uint8))
+    r = mx.nd._cvimresize(img, w=12, h=16)
+    assert r.shape == (16, 12, 3) and r.dtype == np.uint8
+    r2 = mx.image.imresize(img, 3, 4, interp=0)
+    assert r2.shape == (4, 3, 3)
+    p = mx.nd._cvcopyMakeBorder(img, top=2, bot=1, left=3, right=0,
+                                type=0, value=9.0)
+    assert p.shape == (11, 9, 3)
+    assert (p.asnumpy()[:2] == 9).all()
+    pe = mx.image.copyMakeBorder(img, 1, 1, 1, 1, border_type=1)
+    np.testing.assert_array_equal(pe.asnumpy()[0, 1:-1], img.asnumpy()[0])
+
+
+def test_cv_decode_roundtrip():
+    cv2 = pytest.importorskip("cv2")
+    img = (np.random.RandomState(0).rand(10, 8, 3) * 255).astype(np.uint8)
+    ok, enc = cv2.imencode(".png", img)
+    assert ok
+    d = mx.nd._cvimdecode(enc.tobytes())
+    assert d.shape == (10, 8, 3)
+    # png is lossless; BGR->RGB flip relative to raw cv2
+    np.testing.assert_array_equal(d.asnumpy(), img[:, :, ::-1])
+
+
+def test_sparse_conveniences():
+    dense = mx.nd.array(np.array([[0, 1], [0, 0], [2, 0]], np.float32))
+    rsp = mx.nd.cast_storage(dense, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    back = mx.nd.cast_storage(rsp, "default")
+    np.testing.assert_allclose(back.asnumpy(), dense.asnumpy())
+    ret = mx.nd.sparse_retain(rsp, mx.nd.array(np.array([0], np.float32)))
+    np.testing.assert_allclose(ret.tostype("default").asnumpy(),
+                               [[0, 1], [0, 0], [0, 0]])
+    with pytest.raises(mx.MXNetError):
+        mx.nd.sparse_retain(dense, mx.nd.array(np.array([0], np.float32)))
