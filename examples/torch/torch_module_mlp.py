@@ -1,0 +1,54 @@
+"""Train an MLP whose layers are embedded torch nn modules.
+
+Reference analogue: example/torch/torch_module.py — mixing TorchModule
+layers into an MXNet symbolic network and training through Module.fit.
+Here the torch modules run host-side with torch autograd supplying the
+op's gradient (plugin/torch analog, ops/torch_ops.py).
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=25)
+    args = parser.parse_args()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.rand(512, 16).astype(np.float32)
+    w_true = rng.normal(0, 1, (16, 4))
+    y = (x @ w_true).argmax(1).astype(np.float32)
+
+    data = mx.sym.var("data")
+    w1 = mx.sym.var("t1_weight")
+    b1 = mx.sym.var("t1_bias")
+    h = mx.sym.TorchModule(data, w1, b1, lua_string="nn.Linear(16, 32)",
+                           num_data=1, num_params=2, num_outputs=1,
+                           name="t1")
+    h = mx.sym.Activation(h, act_type="relu")
+    w2 = mx.sym.var("t2_weight")
+    b2 = mx.sym.var("t2_bias")
+    h = mx.sym.TorchModule(h, w2, b2, lua_string="nn.Linear(32, 4)",
+                           num_data=1, num_params=2, num_outputs=1,
+                           name="t2")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    it = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3},
+            initializer=mx.init.Xavier())
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    print(f"accuracy with torch layers: {acc:.4f}")
+    assert acc > 0.9
+
+
+if __name__ == "__main__":
+    main()

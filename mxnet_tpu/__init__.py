@@ -54,6 +54,7 @@ from . import operator  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import rtc  # noqa: F401
+from . import torch as th  # noqa: F401
 from . import visualization  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from .io import DataBatch, DataIter  # noqa: F401

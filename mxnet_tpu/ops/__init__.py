@@ -14,6 +14,7 @@ from . import rnn_ops  # noqa: F401
 from . import spatial_ops  # noqa: F401
 from . import custom_op  # noqa: F401
 from . import compat_ops  # noqa: F401
+from . import torch_ops  # noqa: F401
 from . import pallas  # noqa: F401  (flash attention + fused LSTM cell)
 from . import tensor_ops  # noqa: F401
 from .registry import OP_TABLE, OpDef, get_op, list_ops, register  # noqa: F401

@@ -23,6 +23,7 @@ _CASES = [
       "--dtype", "float32"]),
     ("rnn/lstm_bucketing.py", ["--epochs", "6"]),
     ("numpy-ops/custom_softmax.py", []),
+    ("torch/torch_module_mlp.py", []),
     ("ssd/multibox_toy.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",

@@ -1,0 +1,132 @@
+"""Torch interop: mx.th function namespace + TorchModule/TorchCriterion ops.
+
+Reference analogues: python/mxnet/torch.py (generated _th_* wrappers),
+plugin/torch/{torch_module-inl.h, torch_criterion-inl.h}.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+torch = pytest.importorskip("torch")
+
+
+def test_th_unary_binary():
+    a = mx.nd.array(np.array([[1., 4.], [9., 16.]], np.float32))
+    np.testing.assert_allclose(mx.th.sqrt(a).asnumpy(),
+                               np.sqrt(a.asnumpy()))
+    np.testing.assert_allclose(mx.th.log1p(a).asnumpy(),
+                               np.log1p(a.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(mx.th.add(a, a).asnumpy(), 2 * a.asnumpy())
+    np.testing.assert_allclose(mx.th.mm(a, a).asnumpy(),
+                               a.asnumpy() @ a.asnumpy(), rtol=1e-6)
+    s = mx.th.sum(a)
+    np.testing.assert_allclose(s.asnumpy(), a.asnumpy().sum(), rtol=1e-6)
+    with pytest.raises(mx.MXNetError):
+        mx.th.__dict__["_make"]("definitely_not_a_torch_fn")(a)
+
+
+def test_torch_module_linear_matches_manual():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(5, 4).astype(np.float32))
+    w = mx.nd.array(rng.rand(2, 4).astype(np.float32))
+    b = mx.nd.array(rng.rand(2).astype(np.float32))
+    out = mx.nd.TorchModule(x, w, b, lua_string="nn.Linear(4, 2)",
+                            num_data=1, num_params=2, num_outputs=1)
+    np.testing.assert_allclose(
+        out.asnumpy(), x.asnumpy() @ w.asnumpy().T + b.asnumpy(), rtol=1e-5)
+
+
+def test_torch_module_tape_gradients():
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.rand(5, 4).astype(np.float32))
+    w = mx.nd.array(rng.rand(2, 4).astype(np.float32))
+    b = mx.nd.array(rng.rand(2).astype(np.float32))
+    for t in (x, w, b):
+        t.attach_grad()
+    with mx.autograd.record():
+        o = mx.nd.TorchModule(x, w, b, lua_string="nn.Linear(4, 2)",
+                              num_data=1, num_params=2, num_outputs=1)
+        loss = mx.nd.sum(o * o)
+    loss.backward()
+    on = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    np.testing.assert_allclose(b.grad.asnumpy(), 2 * on.sum(0), rtol=1e-4)
+    np.testing.assert_allclose(w.grad.asnumpy(), (2 * on).T @ x.asnumpy(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(x.grad.asnumpy(), (2 * on) @ w.asnumpy(),
+                               rtol=1e-4)
+
+
+def test_torch_module_symbolic_and_mlp():
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    b = mx.sym.var("b")
+    sym = mx.sym.TorchModule(data, w, b, lua_string="nn.Linear(4, 2)",
+                             num_data=1, num_params=2, num_outputs=1)
+    ex = sym.simple_bind(mx.cpu(), data=(5, 4), w=(2, 4), b=(2,),
+                         grad_req="write")
+    rng = np.random.RandomState(2)
+    ex.arg_dict["data"][:] = mx.nd.array(rng.rand(5, 4).astype(np.float32))
+    ex.arg_dict["w"][:] = mx.nd.array(rng.rand(2, 4).astype(np.float32))
+    ex.arg_dict["b"][:] = mx.nd.array(rng.rand(2).astype(np.float32))
+    out = ex.forward(is_train=True)[0]
+    expect = (ex.arg_dict["data"].asnumpy()
+              @ ex.arg_dict["w"].asnumpy().T + ex.arg_dict["b"].asnumpy())
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+    ex.backward(mx.nd.ones((5, 2)))
+    np.testing.assert_allclose(ex.grad_dict["b"].asnumpy(), 5 * np.ones(2),
+                               rtol=1e-4)
+
+
+def test_torch_module_param_mismatch_errors():
+    x = mx.nd.ones((2, 4))
+    with pytest.raises(mx.MXNetError):
+        mx.nd.TorchModule(x, lua_string="nn.Linear(4, 2)", num_data=1,
+                          num_params=0, num_outputs=1)
+
+
+def test_torch_criterion_mse():
+    rng = np.random.RandomState(3)
+    d = mx.nd.array(rng.rand(6, 3).astype(np.float32))
+    lab = mx.nd.array(rng.rand(6, 3).astype(np.float32))
+    loss = mx.nd.TorchCriterion(d, lab, lua_string="nn.MSELoss()")
+    np.testing.assert_allclose(
+        loss.asnumpy(), [np.mean((d.asnumpy() - lab.asnumpy()) ** 2)],
+        rtol=1e-5)
+    d.attach_grad()
+    with mx.autograd.record():
+        loss = mx.nd.TorchCriterion(d, lab, lua_string="nn.MSELoss()",
+                                    grad_scale=2.0)
+    loss.backward()
+    np.testing.assert_allclose(
+        d.grad.asnumpy(),
+        2.0 * 2 * (d.asnumpy() - lab.asnumpy()) / d.asnumpy().size,
+        rtol=1e-4)
+
+
+def test_torch_module_trains():
+    # train torch-embedded Linear on a separable problem via the tape
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 8).astype(np.float32)
+    y = (x.sum(1) > 4).astype(np.int64)
+    w = mx.nd.array(rng.normal(0, 0.1, (2, 8)).astype(np.float32))
+    b = mx.nd.zeros((2,))
+    for _ in range(60):
+        w.attach_grad()
+        b.attach_grad()
+        xb = mx.nd.array(x)
+        with mx.autograd.record():
+            logits = mx.nd.TorchModule(xb, w, b,
+                                       lua_string="nn.Linear(8, 2)",
+                                       num_data=1, num_params=2,
+                                       num_outputs=1)
+            loss = mx.nd.softmax_cross_entropy(
+                logits, mx.nd.array(y.astype(np.float32)))
+        loss.backward()
+        w = mx.nd.array(w.asnumpy() - 0.5 * w.grad.asnumpy() / 128)
+        b = mx.nd.array(b.asnumpy() - 0.5 * b.grad.asnumpy() / 128)
+    logits = mx.nd.TorchModule(mx.nd.array(x), w, b,
+                               lua_string="nn.Linear(8, 2)", num_data=1,
+                               num_params=2, num_outputs=1)
+    acc = (logits.asnumpy().argmax(1) == y).mean()
+    assert acc > 0.9
