@@ -12,11 +12,22 @@ LDFLAGS  ?= -shared -pthread
 LIBDIR   := mxnet_tpu/_lib
 IO_SRCS  := src/io/recordio.cc
 
-all: $(LIBDIR)/libmxtpu_io.so
+PY_INCLUDES := $(shell python3-config --includes)
+PY_LDFLAGS  := $(shell python3-config --ldflags) \
+               -lpython$(shell python3 -c 'import sys; print("%d.%d" % sys.version_info[:2])')
+
+all: $(LIBDIR)/libmxtpu_io.so $(LIBDIR)/libmxtpu_predict.so
 
 $(LIBDIR)/libmxtpu_io.so: $(IO_SRCS) src/io/mxtpu_io.h
 	@mkdir -p $(LIBDIR)
 	$(CXX) $(CXXFLAGS) $(IO_SRCS) $(LDFLAGS) -o $@
+
+# C predict ABI: embeds CPython and drives mxnet_tpu/c_predict.py
+# (reference analogue: src/c_api/c_predict_api.cc in libmxnet.so)
+$(LIBDIR)/libmxtpu_predict.so: src/capi/c_predict_api.cc src/capi/c_predict_api.h
+	@mkdir -p $(LIBDIR)
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) src/capi/c_predict_api.cc \
+	    $(LDFLAGS) $(PY_LDFLAGS) -o $@
 
 clean:
 	rm -rf $(LIBDIR)

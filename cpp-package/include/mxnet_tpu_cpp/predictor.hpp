@@ -1,0 +1,148 @@
+/*
+ * Header-only C++ predict API over the C ABI (libmxtpu_predict.so).
+ *
+ * Reference analogue: cpp-package/include/mxnet-cpp/ — the header-only
+ * C++ frontend binding the C ABI. The rebuild's C++ surface targets the
+ * deployment path (predict-only, like amalgamation/c_predict_api users):
+ * RAII Predictor + NDList over c_predict_api.h.
+ *
+ * Usage:
+ *   mxtpu::cpp::Predictor pred(symbol_json, param_bytes, {{"data", {1,8}}});
+ *   pred.SetInput("data", x.data(), x.size());
+ *   pred.Forward();
+ *   std::vector<float> out = pred.GetOutput(0);
+ */
+#ifndef MXTPU_CPP_PREDICTOR_HPP_
+#define MXTPU_CPP_PREDICTOR_HPP_
+
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../../../src/capi/c_predict_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+inline void Check(int ret) {
+  if (ret != 0) throw std::runtime_error(MXGetLastError());
+}
+
+class Predictor {
+ public:
+  using ShapeDict =
+      std::vector<std::pair<std::string, std::vector<mx_uint>>>;
+
+  Predictor(const std::string &symbol_json, const std::string &param_bytes,
+            const ShapeDict &input_shapes, int dev_type = 1, int dev_id = 0,
+            const std::vector<std::string> &output_keys = {}) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shape_data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shape_data.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shape_data.size()));
+    }
+    if (output_keys.empty()) {
+      Check(MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                         static_cast<int>(param_bytes.size()), dev_type,
+                         dev_id, static_cast<mx_uint>(keys.size()),
+                         keys.data(), indptr.data(), shape_data.data(),
+                         &handle_));
+    } else {
+      std::vector<const char *> outs;
+      for (const auto &k : output_keys) outs.push_back(k.c_str());
+      Check(MXPredCreatePartialOut(
+          symbol_json.c_str(), param_bytes.data(),
+          static_cast<int>(param_bytes.size()), dev_type, dev_id,
+          static_cast<mx_uint>(keys.size()), keys.data(), indptr.data(),
+          shape_data.data(), static_cast<mx_uint>(outs.size()),
+          outs.data(), &handle_));
+    }
+  }
+
+  ~Predictor() {
+    if (handle_) MXPredFree(handle_);
+  }
+
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+  Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+
+  void SetInput(const std::string &key, const float *data, size_t size) {
+    Check(MXPredSetInput(handle_, key.c_str(), data,
+                         static_cast<mx_uint>(size)));
+  }
+
+  void Forward() { Check(MXPredForward(handle_)); }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index) {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &shape, &ndim));
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+
+  std::vector<float> GetOutput(mx_uint index) {
+    std::vector<mx_uint> shape = GetOutputShape(index);
+    size_t size = 1;
+    for (mx_uint d : shape) size *= d;
+    std::vector<float> out(size);
+    Check(MXPredGetOutput(handle_, index, out.data(),
+                          static_cast<mx_uint>(size)));
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+class NDList {
+ public:
+  explicit NDList(const std::string &file_bytes) {
+    Check(MXNDListCreate(file_bytes.data(),
+                         static_cast<int>(file_bytes.size()), &handle_,
+                         &length_));
+  }
+
+  ~NDList() {
+    if (handle_) MXNDListFree(handle_);
+  }
+
+  NDList(const NDList &) = delete;
+  NDList &operator=(const NDList &) = delete;
+
+  mx_uint size() const { return length_; }
+
+  struct Entry {
+    std::string key;
+    std::vector<float> data;
+    std::vector<mx_uint> shape;
+  };
+
+  Entry Get(mx_uint index) const {
+    const char *key = nullptr;
+    const mx_float *data = nullptr;
+    const mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    Check(MXNDListGet(handle_, index, &key, &data, &shape, &ndim));
+    size_t size = 1;
+    std::vector<mx_uint> shp(shape, shape + ndim);
+    for (mx_uint d : shp) size *= d;
+    return Entry{key ? key : "", std::vector<float>(data, data + size),
+                 std::move(shp)};
+  }
+
+ private:
+  NDListHandle handle_ = nullptr;
+  mx_uint length_ = 0;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_PREDICTOR_HPP_

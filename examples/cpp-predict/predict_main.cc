@@ -1,0 +1,64 @@
+// End-to-end C++ inference through the C predict ABI.
+//
+// Reference analogue: example/image-classification/predict-cpp — a pure
+// C++ program using c_predict_api.h to load a checkpoint and classify.
+// Usage: predict_main <prefix> <epoch> <input_name> <d0,d1,...>
+// Reads float32 input from stdin, writes output 0 floats to stdout.
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../../cpp-package/include/mxnet_tpu_cpp/predictor.hpp"
+
+static std::string ReadFile(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    std::cerr << "usage: " << argv[0]
+              << " <prefix> <epoch> <input_name> <d0,d1,...>\n";
+    return 2;
+  }
+  std::string prefix = argv[1];
+  int epoch = std::atoi(argv[2]);
+  std::string input_name = argv[3];
+
+  std::vector<mx_uint> shape;
+  size_t total = 1;
+  {
+    std::stringstream ss(argv[4]);
+    std::string tok;
+    while (std::getline(ss, tok, ',')) {
+      shape.push_back(static_cast<mx_uint>(std::stoul(tok)));
+      total *= shape.back();
+    }
+  }
+
+  char buf[32];
+  std::snprintf(buf, sizeof(buf), "-%04d.params", epoch);
+  std::string symbol_json = ReadFile(prefix + "-symbol.json");
+  std::string params = ReadFile(prefix + buf);
+
+  mxtpu::cpp::Predictor pred(symbol_json, params,
+                             {{input_name, shape}});
+
+  std::vector<float> input(total);
+  if (std::fread(input.data(), sizeof(float), total, stdin) != total) {
+    std::cerr << "short read on stdin\n";
+    return 2;
+  }
+  pred.SetInput(input_name, input.data(), input.size());
+  pred.Forward();
+  std::vector<float> out = pred.GetOutput(0);
+  std::fwrite(out.data(), sizeof(float), out.size(), stdout);
+  return 0;
+}

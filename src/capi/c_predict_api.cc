@@ -1,0 +1,335 @@
+/*
+ * Implementation of the C predict ABI (see c_predict_api.h).
+ *
+ * Reference analogue: src/c_api/c_predict_api.cc:363 — there the API binds
+ * a GraphExecutor directly; here it embeds CPython and delegates to
+ * mxnet_tpu/c_predict.py (Predictor), which compiles the graph with XLA.
+ * The embedded interpreter is initialised once, lazily, and every entry
+ * point takes the GIL (PyGILState) so the ABI is callable from any thread.
+ */
+#include "c_predict_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string &msg) { g_last_error = msg; }
+
+void SetErrorFromPython() {
+  PyObject *ptype = nullptr, *pvalue = nullptr, *ptrace = nullptr;
+  PyErr_Fetch(&ptype, &pvalue, &ptrace);
+  PyErr_NormalizeException(&ptype, &pvalue, &ptrace);
+  std::string msg = "python error";
+  if (pvalue) {
+    PyObject *s = PyObject_Str(pvalue);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptrace);
+  SetError(msg);
+}
+
+std::once_flag g_init_flag;
+bool g_init_ok = false;
+
+/* Bootstrap: make the venv + repo importable inside the embedded
+ * interpreter (the default embedded sys.path lacks both), then import
+ * mxnet_tpu.c_predict. Controlled by MXTPU_REPO / VIRTUAL_ENV. */
+const char *kBootstrap = R"PY(
+import glob, os, sys
+repo = os.environ.get('MXTPU_REPO', os.getcwd())
+if repo not in sys.path:
+    sys.path.insert(0, repo)
+venv = os.environ.get('VIRTUAL_ENV', '/opt/venv')
+for sp in glob.glob(os.path.join(venv, 'lib', 'python3.*', 'site-packages')):
+    if sp not in sys.path:
+        sys.path.append(sp)
+plat = os.environ.get('MXTPU_PREDICT_PLATFORM')
+if plat:
+    import jax
+    jax.config.update('jax_platforms', plat)
+)PY";
+
+bool EnsurePython() {
+  std::call_once(g_init_flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization so PyGILState works
+      // from arbitrary threads below
+      PyEval_SaveThread();
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    g_init_ok = PyRun_SimpleString(kBootstrap) == 0;
+    if (!g_init_ok) SetError("failed to bootstrap embedded python");
+    PyGILState_Release(st);
+  });
+  return g_init_ok;
+}
+
+class GIL {
+ public:
+  GIL() : st_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(st_); }
+
+ private:
+  PyGILState_STATE st_;
+};
+
+struct PredRec {
+  PyObject *obj;                    // mxnet_tpu.c_predict.Predictor
+  std::vector<mx_uint> shape_buf;   // storage for MXPredGetOutputShape
+};
+
+struct NDListRec {
+  std::vector<std::string> keys;
+  std::vector<std::vector<float>> data;
+  std::vector<std::vector<mx_uint>> shapes;
+};
+
+PyObject *GetCPredictModule() {
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.c_predict");
+  if (!mod) SetErrorFromPython();
+  return mod;
+}
+
+int CreateImpl(const char *symbol_json_str, const void *param_bytes,
+               int param_size, int dev_type, int dev_id,
+               mx_uint num_input_nodes, const char **input_keys,
+               const mx_uint *input_shape_indptr,
+               const mx_uint *input_shape_data, mx_uint num_output_nodes,
+               const char **output_keys, PredictorHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *mod = GetCPredictModule();
+  if (!mod) return -1;
+
+  PyObject *shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo,
+                       PyLong_FromUnsignedLong(input_shape_data[j]));
+    PyDict_SetItemString(shapes, input_keys[i], shp);
+    Py_DECREF(shp);
+  }
+
+  PyObject *outputs = Py_None;
+  Py_INCREF(Py_None);
+  if (num_output_nodes > 0) {
+    Py_DECREF(outputs);
+    outputs = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i)
+      PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
+  }
+
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  PyObject *pred =
+      cls ? PyObject_CallFunction(cls, "sOiiOO", symbol_json_str, params,
+                                  dev_type, dev_id, shapes, outputs)
+          : nullptr;
+  Py_XDECREF(cls);
+  Py_DECREF(params);
+  Py_DECREF(shapes);
+  Py_DECREF(outputs);
+  Py_DECREF(mod);
+  if (!pred) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PredRec *rec = new PredRec{pred, {}};
+  *out = rec;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  return CreateImpl(symbol_json_str, param_bytes, param_size, dev_type,
+                    dev_id, num_input_nodes, input_keys, input_shape_indptr,
+                    input_shape_data, 0, nullptr, out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out) {
+  return CreateImpl(symbol_json_str, param_bytes, param_size, dev_type,
+                    dev_id, num_input_nodes, input_keys, input_shape_indptr,
+                    input_shape_data, num_output_nodes, output_keys, out);
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  PredRec *rec = static_cast<PredRec *>(handle);
+  GIL gil;
+  PyObject *shp =
+      PyObject_CallMethod(rec->obj, "output_shape", "I", index);
+  if (!shp) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(shp);
+  rec->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    rec->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i)));
+  Py_DECREF(shp);
+  *shape_data = rec->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  PredRec *rec = static_cast<PredRec *>(handle);
+  GIL gil;
+  // shape is recovered python-side from the bind-time shapes; pass flat
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float), PyBUF_READ);
+  PyObject *r = PyObject_CallMethod(rec->obj, "set_input_flat", "sO", key, mv);
+  Py_DECREF(mv);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  PredRec *rec = static_cast<PredRec *>(handle);
+  GIL gil;
+  PyObject *r = PyObject_CallMethod(rec->obj, "forward", nullptr);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left) {
+  (void)step;
+  if (MXPredForward(handle) != 0) return -1;
+  if (step_left) *step_left = 0;
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  PredRec *rec = static_cast<PredRec *>(handle);
+  GIL gil;
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float), PyBUF_WRITE);
+  PyObject *r = PyObject_CallMethod(rec->obj, "get_output", "IO", index, mv);
+  Py_DECREF(mv);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  PredRec *rec = static_cast<PredRec *>(handle);
+  if (!rec) return 0;
+  {
+    GIL gil;
+    Py_XDECREF(rec->obj);
+  }
+  delete rec;
+  return 0;
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *mod = GetCPredictModule();
+  if (!mod) return -1;
+  PyObject *bytes = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject *r = PyObject_CallMethod(mod, "load_ndarray_list_flat", "O",
+                                    bytes);
+  Py_DECREF(bytes);
+  Py_DECREF(mod);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  // r = list of (name, bytes(float32 data), shape tuple)
+  NDListRec *rec = new NDListRec;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *item = PyList_GET_ITEM(r, i);
+    const char *name = PyUnicode_AsUTF8(PyTuple_GET_ITEM(item, 0));
+    char *buf = nullptr;
+    Py_ssize_t blen = 0;
+    PyBytes_AsStringAndSize(PyTuple_GET_ITEM(item, 1), &buf, &blen);
+    PyObject *shp = PyTuple_GET_ITEM(item, 2);
+    rec->keys.emplace_back(name ? name : "");
+    rec->data.emplace_back(
+        reinterpret_cast<float *>(buf),
+        reinterpret_cast<float *>(buf) + blen / sizeof(float));
+    std::vector<mx_uint> shape;
+    for (Py_ssize_t j = 0; j < PyTuple_Size(shp); ++j)
+      shape.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, j))));
+    rec->shapes.push_back(std::move(shape));
+  }
+  Py_DECREF(r);
+  *out = rec;
+  *out_length = static_cast<mx_uint>(rec->keys.size());
+  return 0;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  NDListRec *rec = static_cast<NDListRec *>(handle);
+  if (index >= rec->keys.size()) {
+    SetError("NDList index out of range");
+    return -1;
+  }
+  *out_key = rec->keys[index].c_str();
+  *out_data = rec->data[index].data();
+  *out_shape = rec->shapes[index].data();
+  *out_ndim = static_cast<mx_uint>(rec->shapes[index].size());
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  delete static_cast<NDListRec *>(handle);
+  return 0;
+}
+
+}  // extern "C"

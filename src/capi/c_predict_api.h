@@ -1,0 +1,86 @@
+/*
+ * C predict ABI for mxnet_tpu.
+ *
+ * Reference surface: include/mxnet/c_predict_api.h (12 functions) — the
+ * deployment-facing, inference-only C API every reference frontend that
+ * only needs forward passes binds against. Here the implementation
+ * (c_predict_api.cc) embeds CPython and drives mxnet_tpu/c_predict.py,
+ * which binds an XLA-compiled executor; marshalling at this boundary is
+ * zero-copy memoryviews.
+ *
+ * All functions return 0 on success, -1 on failure; MXTPUGetLastError /
+ * MXGetLastError returns the failure message for this thread.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+const char *MXGetLastError();
+
+/* Create an inference predictor from a symbol JSON string and the raw
+ * bytes of a .params file. dev_type: 1 = cpu, 2 = accelerator (tpu).
+ * Input shapes arrive CSR-style: input_shape_indptr has
+ * num_input_nodes + 1 entries indexing into input_shape_data. */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/* Same, but only the listed internal outputs are produced. */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out);
+
+/* Shape of output `index`; pointers stay valid until the next call on
+ * this handle. */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/* Copy `size` floats into the named input. */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+int MXPredForward(PredictorHandle handle);
+
+/* The reference steps the graph executor node-by-node; an XLA program is
+ * one fused computation, so this runs the whole forward and reports
+ * *step_left = 0. */
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
+
+/* Copy output `index` into the caller's buffer of `size` floats. */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+int MXPredFree(PredictorHandle handle);
+
+/* Load an NDArray container file (in-memory bytes) as a named list. */
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+
+/* Borrow entry `index`: name, flat data pointer, shape. Valid until the
+ * list is freed. */
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+
+int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

@@ -1,0 +1,189 @@
+"""C predict ABI: build libmxtpu_predict.so, drive it from ctypes and from
+a compiled C++ program, and cross-check against the python executor.
+
+Reference analogues: include/mxnet/c_predict_api.h (12 fns),
+src/c_api/c_predict_api.cc, cpp-package/, example predict-cpp.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "mxnet_tpu", "_lib", "libmxtpu_predict.so")
+
+
+def _build_lib():
+    if not os.path.exists(LIB):
+        subprocess.run(["make", "-C", ROOT], check=True,
+                       capture_output=True)
+    return os.path.exists(LIB)
+
+
+def _make_checkpoint(tmp_path):
+    """Train-free checkpoint: random-param MLP, return prefix + a probe."""
+    rng = np.random.RandomState(0)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Activation(
+                mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=8,
+                                      name="fc1"),
+                act_type="relu"),
+            num_hidden=3, name="fc2"),
+        name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(2, 5), softmax_label=(2,))
+    args = {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 3, net, args, {})
+
+    x = rng.rand(2, 5).astype(np.float32)
+    ex = net.simple_bind(mx.cpu(), grad_req="null", data=(2, 5),
+                         softmax_label=(2,))
+    ex.copy_params_from(args)
+    ex.arg_dict["data"][:] = mx.nd.array(x)
+    expect = ex.forward(is_train=False)[0].asnumpy()
+    return prefix, x, expect
+
+
+@pytest.fixture(scope="module")
+def predict_lib():
+    if not _build_lib():
+        pytest.skip("native toolchain unavailable")
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _env():
+    env = dict(os.environ)
+    env["MXTPU_REPO"] = ROOT
+    env["MXTPU_PREDICT_PLATFORM"] = "cpu"
+    return env
+
+
+def test_c_predict_ctypes_roundtrip(predict_lib, tmp_path):
+    # drive the ABI in-subprocess via ctypes so the embedded interpreter
+    # doesn't collide with this pytest process's interpreter
+    prefix, x, expect = _make_checkpoint(tmp_path)
+    np.save(tmp_path / "x.npy", x)
+    np.save(tmp_path / "expect.npy", expect)
+    script = f"""
+import ctypes, numpy as np
+lib = ctypes.CDLL({LIB!r})
+lib.MXGetLastError.restype = ctypes.c_char_p
+prefix = {prefix!r}
+symbol_json = open(prefix + "-symbol.json").read().encode()
+params = open(prefix + "-0003.params", "rb").read()
+x = np.load({str(tmp_path / 'x.npy')!r})
+expect = np.load({str(tmp_path / 'expect.npy')!r})
+
+handle = ctypes.c_void_p()
+keys = (ctypes.c_char_p * 1)(b"data")
+indptr = (ctypes.c_uint * 2)(0, 2)
+shape = (ctypes.c_uint * 2)(2, 5)
+ret = lib.MXPredCreate(symbol_json, params, len(params), 1, 0, 1,
+                       keys, indptr, shape, ctypes.byref(handle))
+assert ret == 0, lib.MXGetLastError().decode()
+
+data = x.ravel().astype(np.float32)
+ret = lib.MXPredSetInput(handle, b"data",
+                         data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                         data.size)
+assert ret == 0, lib.MXGetLastError().decode()
+assert lib.MXPredForward(handle) == 0, lib.MXGetLastError().decode()
+
+sd = ctypes.POINTER(ctypes.c_uint)()
+nd_ = ctypes.c_uint()
+assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sd),
+                                ctypes.byref(nd_)) == 0
+oshape = tuple(sd[i] for i in range(nd_.value))
+assert oshape == expect.shape, (oshape, expect.shape)
+
+out = np.zeros(expect.size, np.float32)
+assert lib.MXPredGetOutput(handle, 0,
+                           out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                           out.size) == 0
+np.testing.assert_allclose(out.reshape(expect.shape), expect, rtol=1e-4)
+
+# step API reports completion
+left = ctypes.c_int(-1)
+assert lib.MXPredPartialForward(handle, 0, ctypes.byref(left)) == 0
+assert left.value == 0
+assert lib.MXPredFree(handle) == 0
+
+# NDList over the params file
+nl = ctypes.c_void_p(); n = ctypes.c_uint()
+assert lib.MXNDListCreate(params, len(params), ctypes.byref(nl),
+                          ctypes.byref(n)) == 0
+assert n.value >= 4
+key = ctypes.c_char_p(); dptr = ctypes.POINTER(ctypes.c_float)()
+shp = ctypes.POINTER(ctypes.c_uint)(); ndim = ctypes.c_uint()
+assert lib.MXNDListGet(nl, 0, ctypes.byref(key), ctypes.byref(dptr),
+                       ctypes.byref(shp), ctypes.byref(ndim)) == 0
+assert key.value
+assert lib.MXNDListFree(nl) == 0
+
+# error surface: bad input name
+h2 = ctypes.c_void_p()
+ret = lib.MXPredCreate(symbol_json, params, len(params), 1, 0, 1,
+                       keys, indptr, shape, ctypes.byref(h2))
+assert ret == 0
+ret = lib.MXPredSetInput(h2, b"not_an_input",
+                         data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                         data.size)
+assert ret == -1
+assert b"not_an_input" in lib.MXGetLastError()
+lib.MXPredFree(h2)
+print("CTYPES_OK")
+"""
+    res = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, env=_env(),
+                         timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CTYPES_OK" in res.stdout
+
+
+def test_cpp_package_program(predict_lib, tmp_path):
+    prefix, x, expect = _make_checkpoint(tmp_path)
+    exe = str(tmp_path / "predict_main")
+    src = os.path.join(ROOT, "examples", "cpp-predict", "predict_main.cc")
+    py_ver = f"{sys.version_info[0]}.{sys.version_info[1]}"
+    compile_cmd = [
+        "g++", "-O2", "-std=c++17", src, "-o", exe,
+        "-L", os.path.dirname(LIB), "-lmxtpu_predict",
+        f"-Wl,-rpath,{os.path.dirname(LIB)}",
+    ]
+    res = subprocess.run(compile_cmd, capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    res = subprocess.run(
+        [exe, prefix, "3", "data", "2,5"],
+        input=x.astype(np.float32).tobytes(),
+        capture_output=True, env=_env(), timeout=600)
+    assert res.returncode == 0, res.stderr.decode()
+    out = np.frombuffer(res.stdout, np.float32).reshape(expect.shape)
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+def test_partial_out_python_side(tmp_path):
+    # PartialOut path exercised via the python Predictor directly
+    prefix, x, _ = _make_checkpoint(tmp_path)
+    from mxnet_tpu.c_predict import Predictor
+
+    symbol_json = open(prefix + "-symbol.json").read()
+    params = open(prefix + "-0003.params", "rb").read()
+    pred = Predictor(symbol_json, params, 1, 0, {"data": (2, 5)},
+                     output_keys=["fc1"])
+    buf = memoryview(x.ravel().astype(np.float32).tobytes())
+    pred.set_input_flat("data", buf)
+    pred.forward()
+    assert pred.output_shape(0) == (2, 8)
+    out = np.zeros(16, np.float32)
+    pred.get_output(0, memoryview(out))
+    assert np.abs(out).sum() > 0
