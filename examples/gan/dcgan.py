@@ -1,0 +1,111 @@
+"""DCGAN on synthetic 16x16 'blob' images (Gluon, imperative).
+
+Reference analogue: example/gan/dcgan.py — generator of fractional-stride
+convs vs conv discriminator, alternating SGD on the adversarial losses.
+Scaled to a synthetic dataset so it runs in seconds; asserts the classic
+GAN health signals rather than image quality: D loss stays finite, G
+fools D on a growing fraction of samples.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def make_real_batch(rng, n):
+    """Blobby images: a bright gaussian bump at a random position."""
+    yy, xx = np.mgrid[0:16, 0:16].astype(np.float32)
+    cx = rng.uniform(4, 12, size=(n, 1, 1))
+    cy = rng.uniform(4, 12, size=(n, 1, 1))
+    img = np.exp(-((xx - cx) ** 2 + (yy - cy) ** 2) / 8.0)
+    return (img[:, None] * 2 - 1).astype(np.float32)  # NCHW in [-1, 1]
+
+
+def build_nets():
+    gen = nn.HybridSequential()
+    gen.add(nn.Dense(4 * 4 * 32, activation="relu"),
+            _Reshape((-1, 32, 4, 4)),
+            nn.Conv2DTranspose(16, 4, strides=2, padding=1,
+                               activation="relu"),  # 8x8
+            nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                               activation="tanh"))  # 16x16
+    disc = nn.HybridSequential()
+    disc.add(nn.Conv2D(16, 4, strides=2, padding=1),
+             nn.LeakyReLU(0.2),
+             nn.Conv2D(32, 4, strides=2, padding=1),
+             nn.LeakyReLU(0.2),
+             nn.Flatten(),
+             nn.Dense(1))
+    return gen, disc
+
+
+class _Reshape(gluon.HybridBlock):
+    def __init__(self, shape):
+        super().__init__()
+        self._shape = shape
+
+    def hybrid_forward(self, F, x):
+        return F.Reshape(x, shape=self._shape)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--iters", type=int, default=120)
+    parser.add_argument("--batch-size", type=int, default=32)
+    args = parser.parse_args()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+
+    gen, disc = build_nets()
+    gen.initialize(mx.init.Normal(0.02))
+    disc.initialize(mx.init.Normal(0.02))
+    g_tr = gluon.Trainer(gen.collect_params(), "adam",
+                         {"learning_rate": 2e-3, "beta1": 0.5})
+    d_tr = gluon.Trainer(disc.collect_params(), "adam",
+                         {"learning_rate": 2e-3, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    bs = args.batch_size
+    fooled = []
+    for it in range(args.iters):
+        real = mx.nd.array(make_real_batch(rng, bs))
+        z = mx.nd.array(rng.randn(bs, 16).astype(np.float32))
+        ones = mx.nd.ones((bs,))
+        zeros = mx.nd.zeros((bs,))
+
+        # D step
+        with mx.autograd.record():
+            fake = gen(z)
+            d_loss = (loss_fn(disc(real), ones)
+                      + loss_fn(disc(fake.detach()), zeros))
+        d_loss.backward()
+        d_tr.step(bs)
+
+        # G step
+        with mx.autograd.record():
+            fake = gen(z)
+            g_loss = loss_fn(disc(fake), ones)
+        g_loss.backward()
+        g_tr.step(bs)
+
+        if it >= 20:
+            fooled.append(float(
+                (disc(gen(z)).asnumpy().ravel() > 0).mean()))
+
+    d_final = float(d_loss.asnumpy().mean())
+    fool_avg = float(np.mean(fooled))
+    print(f"D loss {d_final:.3f}; G fools D on {fool_avg:.2%} of "
+          f"post-warmup samples")
+    assert np.isfinite(d_final)
+    # an untrained G fools a trained D ~0% of the time; a healthy
+    # adversarial game oscillates around a substantial fool rate
+    assert fool_avg > 0.15
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Matrix-factorization recommender on a synthetic ratings matrix.
+
+Reference analogue: example/recommenders/ (and example/module's
+matrix-factorization demo) — user/item Embedding, dot-product score,
+LinearRegressionOutput; asserts RMSE drops far below the ratings' spread.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build(num_users, num_items, k):
+    user = mx.sym.var("user")
+    item = mx.sym.var("item")
+    score = mx.sym.var("score")
+    u = mx.sym.Embedding(user, input_dim=num_users, output_dim=k,
+                         name="user_embed")
+    v = mx.sym.Embedding(item, input_dim=num_items, output_dim=k,
+                         name="item_embed")
+    pred = mx.sym.sum(u * v, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, score, name="lro")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=30)
+    parser.add_argument("--users", type=int, default=64)
+    parser.add_argument("--items", type=int, default=48)
+    args = parser.parse_args()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    k_true = 3
+    pu = rng.normal(0, 1, (args.users, k_true))
+    qi = rng.normal(0, 1, (args.items, k_true))
+    users = rng.randint(0, args.users, 4096)
+    items = rng.randint(0, args.items, 4096)
+    scores = (pu[users] * qi[items]).sum(1).astype(np.float32)
+
+    it = mx.io.NDArrayIter(
+        {"user": users.astype(np.float32),
+         "item": items.astype(np.float32)},
+        {"score": scores}, batch_size=256, shuffle=True)
+    net = build(args.users, args.items, 8)
+    mod = mx.mod.Module(net, data_names=["user", "item"],
+                        label_names=["score"])
+    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-2},
+            initializer=mx.init.Normal(0.1))
+
+    it.reset()
+    se, n = 0.0, 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().ravel()
+        lab = batch.label[0].asnumpy().ravel()
+        se += float(((pred - lab) ** 2).sum())
+        n += lab.size
+    rmse = np.sqrt(se / n)
+    print(f"rmse {rmse:.4f} (ratings std {scores.std():.3f})")
+    assert rmse < 0.35 * scores.std()
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,17 @@ _CASES = [
     ("rnn/lstm_bucketing.py", ["--epochs", "6"]),
     ("numpy-ops/custom_softmax.py", []),
     ("torch/torch_module_mlp.py", []),
+    ("gan/dcgan.py", ["--iters", "120"]),
+    ("autoencoder/autoencoder.py", []),
+    ("recommenders/matrix_fact.py", []),
+    ("multi-task/multitask_mlp.py", []),
+    ("adversary/fgsm.py", []),
+    ("svm/svm_toy.py", []),
+    ("rnn/bi_lstm_sort.py", []),
+    ("cnn_text/cnn_text_classification.py", []),
+    ("nce-loss/nce_word.py", []),
+    ("warpctc/lstm_ocr_toy.py", []),
+    ("reinforcement-learning/reinforce_chain.py", []),
     ("ssd/multibox_toy.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
