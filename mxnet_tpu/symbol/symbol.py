@@ -669,10 +669,17 @@ def _expected_inputs(opdef: OpDef, attrs: Dict) -> int:
 
 
 def load_json(json_str: str) -> Symbol:
+    """Parse a symbol JSON string, accepting both this package's output and
+    the reference's on-disk formats: post-NNVM v0.11 ("attrs") and the
+    pre-NNVM legacy layout ("param" for op params + "attr" for user attrs,
+    upgraded there by src/nnvm/legacy_json_util.cc:203 LoadLegacyJSON;
+    fixture: tests/python/unittest/save_000800.json)."""
     graph = json.loads(json_str)
     nodes: List[SymbolNode] = []
     for entry in graph["nodes"]:
-        attrs = dict(entry.get("attrs", entry.get("param", {})) or {})
+        attrs = dict(entry.get("attrs") or entry.get("param") or {})
+        # legacy user attrs (ctx_group, lr_mult, ...) ride separately
+        attrs.update(entry.get("attr") or {})
         if entry["op"] == "null":
             node = SymbolNode(None, entry["name"], attrs, [])
         else:

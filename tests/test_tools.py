@@ -1,0 +1,169 @@
+"""Tools tier: parse_log, kill_jobs, caffe prototxt converter, coreml gate,
+legacy symbol-JSON loading.
+
+Reference analogues: tools/{parse_log.py,kill-mxnet.py,caffe_converter,
+coreml}, src/nnvm/legacy_json_util.cc (LoadLegacyJSON).
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Train-accuracy=0.52\n"
+        "INFO Epoch[0] Time cost=3.14\n"
+        "INFO Epoch[0] Validation-accuracy=0.49\n"
+        "INFO Epoch[1] Train-accuracy=0.81\n"
+        "INFO Epoch[1] Time cost=3.02\n"
+        "INFO Epoch[1] Validation-accuracy=0.78\n")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         str(log)], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    assert "| 0 | 0.52 | 0.49 | 3.14 |" in res.stdout
+    assert "| 1 | 0.81 | 0.78 | 3.02 |" in res.stdout
+
+
+LENET_PROTOTXT = """
+name: "LeNet"
+input: "data"
+input_dim: 1
+input_dim: 1
+input_dim: 28
+input_dim: 28
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 stride: 1 }
+}
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "pool1" top: "pool1" }
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "ip1"
+  inner_product_param { num_output: 10 }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+def test_caffe_converter_lenet(tmp_path):
+    conv = _load(os.path.join(ROOT, "tools", "caffe_converter",
+                              "convert_symbol.py"), "convert_symbol")
+    proto = tmp_path / "lenet.prototxt"
+    proto.write_text(LENET_PROTOTXT)
+    sym, input_name, input_dim = conv.convert_symbol(str(proto))
+    assert input_name == "data"
+    assert input_dim == [1, 1, 28, 28]
+    ex = sym.simple_bind(mx.cpu(), data=(1, 1, 28, 28), prob_label=(1,))
+    rng = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        a[:] = mx.nd.array(rng.normal(0, 0.1, a.shape).astype(np.float32))
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (1, 10)
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-5)
+
+
+def test_caffe_converter_unsupported_layer(tmp_path):
+    conv = _load(os.path.join(ROOT, "tools", "caffe_converter",
+                              "convert_symbol.py"), "convert_symbol")
+    proto = tmp_path / "bad.prototxt"
+    proto.write_text("""
+input: "data"
+input_dim: 1
+input_dim: 3
+layer { name: "x" type: "SPP" bottom: "data" top: "x" }
+""")
+    with pytest.raises(ValueError, match="SPP"):
+        conv.convert_symbol(str(proto))
+
+
+def test_caffe_converter_model_weights_gated(tmp_path):
+    conv = _load(os.path.join(ROOT, "tools", "caffe_converter",
+                              "convert_symbol.py"), "convert_symbol")
+    with pytest.raises(NotImplementedError, match="caffe"):
+        conv.convert_model("a.prototxt", "b.caffemodel")
+
+
+def test_coreml_converter_gated(tmp_path):
+    coreml = _load(os.path.join(ROOT, "tools", "coreml", "converter.py"),
+                   "coreml_converter")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2),
+        name="softmax")
+    args = {n: mx.nd.ones(s) for n, s in zip(
+        net.list_arguments(),
+        net.infer_shape(data=(1, 4), softmax_label=(1,))[0])
+        if n not in ("data", "softmax_label")}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 0, net, args, {})
+    with pytest.raises(NotImplementedError, match="coremltools"):
+        coreml.convert(prefix, 0, str(tmp_path / "out.mlmodel"))
+
+
+def test_legacy_json_loads_and_runs():
+    legacy = {
+        "nodes": [
+            {"op": "null", "param": {}, "name": "data", "inputs": [],
+             "backward_source_id": -1, "attr": {"ctx_group": "stage1"}},
+            {"op": "null", "param": {}, "name": "fc1_weight", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "fc1_bias", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "FullyConnected",
+             "param": {"no_bias": "False", "num_hidden": "8"},
+             "name": "fc1", "inputs": [[0, 0], [1, 0], [2, 0]],
+             "backward_source_id": -1},
+            {"op": "Activation", "param": {"act_type": "relu"},
+             "name": "relu1", "inputs": [[3, 0]],
+             "backward_source_id": -1},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[4, 0]],
+    }
+    sym = mx.sym.load_json(json.dumps(legacy))
+    assert sym.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+    # legacy user attrs survive into attr_dict
+    assert sym.attr_dict().get("data", {}).get("ctx_group") == "stage1"
+    ex = sym.simple_bind(mx.cpu(), data=(2, 4))
+    for n, a in ex.arg_dict.items():
+        a[:] = mx.nd.ones(a.shape)
+    out = ex.forward()[0]
+    assert out.shape == (2, 8)
+
+
+def test_kill_jobs_no_match():
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "kill_jobs.py"),
+         "definitely-not-a-running-process-pattern-xyz"],
+        capture_output=True, text=True)
+    assert res.returncode == 0
+    assert "no processes" in res.stdout

@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Convert a Caffe ``.prototxt`` network definition into a Symbol.
+
+Reference analogue: tools/caffe_converter/convert_symbol.py — there it
+parses the prototxt with caffe's protobuf bindings; this environment has
+no caffe, so a small text-format protobuf parser (prototxt is protobuf
+text format) feeds the same layer→op conversion table. Weight conversion
+(.caffemodel, binary protobuf) requires caffe and is gated with a clear
+error.
+
+Usage: python convert_symbol.py model.prototxt out-symbol.json
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf text-format parser: returns dict with repeated fields as
+# lists; nested messages as dicts
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(r"""
+    (?P<open>\{)|(?P<close>\})|
+    (?P<key>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?|
+    (?P<str>"(?:[^"\\]|\\.)*")|
+    (?P<num>-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)
+""", re.X)
+
+
+def _tokens(text):
+    text = re.sub(r"#[^\n]*", "", text)  # strip comments
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m:
+            if text[pos].isspace():
+                pos += 1
+                continue
+            raise ValueError(f"prototxt parse error at {text[pos:pos+30]!r}")
+        pos = m.end()
+        yield m
+
+
+def parse_prototxt(text):
+    """Parse protobuf text format into nested dicts / lists."""
+    root = {}
+    stack = [root]
+    key = None
+    for tok in _tokens(text):
+        if tok.group("open"):
+            msg = {}
+            _insert(stack[-1], key, msg)
+            stack.append(msg)
+            key = None
+        elif tok.group("close"):
+            stack.pop()
+        elif tok.group("key"):
+            if key is not None and not tok.group("colon"):
+                # bare enum value (e.g. `pool: MAX`) already handled below
+                pass
+            key = tok.group("key")
+            if not tok.group("colon"):
+                # message field without colon: `layer { ... }`
+                continue
+        elif tok.group("str") is not None:
+            _insert(stack[-1], key, tok.group("str")[1:-1])
+            key = None
+        elif tok.group("num") is not None:
+            v = float(tok.group("num"))
+            _insert(stack[-1], key, int(v) if v == int(v) else v)
+            key = None
+    return root
+
+
+def _insert(msg, key, value):
+    if key is None:
+        raise ValueError("value without a key in prototxt")
+    if key in msg:
+        if not isinstance(msg[key], list):
+            msg[key] = [msg[key]]
+        msg[key].append(value)
+    else:
+        msg[key] = value
+
+
+_ENUM_FIX = re.compile(r":\s*([A-Z][A-Z_0-9]*)\b")
+
+
+def _quote_enums(text):
+    """Bare enum values (pool: MAX) become strings for the parser."""
+    return _ENUM_FIX.sub(lambda m: f': "{m.group(1)}"', text)
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# layer conversion (mirrors the reference's conversion table)
+# ---------------------------------------------------------------------------
+
+def _conv_attrs(p):
+    k = _as_list(p.get("kernel_size")) or [p.get("kernel_h", 1)]
+    kernel = ((p.get("kernel_h"), p.get("kernel_w"))
+              if "kernel_h" in p else (k[0], k[0]))
+    s = _as_list(p.get("stride")) or [1]
+    pd = _as_list(p.get("pad")) or [0]
+    pad = ((p.get("pad_h", 0), p.get("pad_w", 0))
+           if "pad_h" in p or "pad_w" in p else (pd[0], pd[0]))
+    attrs = dict(num_filter=int(p["num_output"]), kernel=kernel,
+                 stride=(s[0], s[0]), pad=pad)
+    if "dilation" in p:
+        d = _as_list(p["dilation"])[0]
+        attrs["dilate"] = (d, d)
+    if "group" in p:
+        attrs["num_group"] = int(p["group"])
+    if p.get("bias_term") in (0, "false", False):
+        attrs["no_bias"] = True
+    return attrs
+
+
+def _pool_attrs(p):
+    k = p.get("kernel_size", 1)
+    attrs = dict(kernel=(k, k),
+                 stride=(p.get("stride", 1), p.get("stride", 1)),
+                 pad=(p.get("pad", 0), p.get("pad", 0)),
+                 pool_type={"MAX": "max", "AVE": "avg",
+                            "STOCHASTIC": "max"}.get(p.get("pool", "MAX"),
+                                                     "max"))
+    if p.get("global_pooling") in (1, True, "true"):
+        attrs["global_pool"] = True
+        attrs["kernel"] = (1, 1)
+    else:
+        # caffe pooling rounds up; mirror the reference's full-convention
+        attrs["pooling_convention"] = "full"
+    return attrs
+
+
+def convert_symbol(prototxt_fname):
+    """Returns (Symbol, input_name, input_dim) for the prototxt network."""
+    import mxnet_tpu as mx
+
+    with open(prototxt_fname) as f:
+        proto = parse_prototxt(_quote_enums(f.read()))
+
+    layers = _as_list(proto.get("layer") or proto.get("layers"))
+    if not layers:
+        raise ValueError("no layer/layers entries in prototxt")
+
+    # input declaration: top-level input/input_dim, input_shape, or an
+    # Input layer (reference convert_symbol.py:_get_input)
+    input_name = proto.get("input", "data")
+    if "input_dim" in proto:
+        input_dim = _as_list(proto["input_dim"])
+    elif "input_shape" in proto:
+        input_dim = _as_list(proto["input_shape"]["dim"])
+    elif layers[0].get("type") == "Input":
+        input_name = _as_list(layers[0]["top"])[0]
+        input_dim = _as_list(layers[0]["input_param"]["shape"]["dim"])
+        layers = layers[1:]
+    else:
+        raise ValueError("cannot find input size in prototxt")
+
+    blobs = {input_name: mx.sym.var(input_name)}
+
+    def bottom(layer):
+        names = _as_list(layer.get("bottom"))
+        return [blobs[n] for n in names]
+
+    for layer in layers:
+        ltype = layer.get("type")
+        name = layer.get("name", ltype)
+        tops = _as_list(layer.get("top"))
+        ins = bottom(layer)
+        if ltype in ("Data", "ImageData", "HDF5Data"):
+            continue
+        elif ltype == "Convolution":
+            out = mx.sym.Convolution(
+                ins[0], name=name,
+                **_conv_attrs(layer.get("convolution_param", {})))
+        elif ltype == "Deconvolution":
+            out = mx.sym.Deconvolution(
+                ins[0], name=name,
+                **_conv_attrs(layer.get("convolution_param", {})))
+        elif ltype == "Pooling":
+            out = mx.sym.Pooling(
+                ins[0], name=name,
+                **_pool_attrs(layer.get("pooling_param", {})))
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(ins[0], name=name,
+                                        num_hidden=int(p["num_output"]),
+                                        no_bias=p.get("bias_term") in
+                                        (0, False, "false"))
+        elif ltype == "ReLU":
+            out = mx.sym.Activation(ins[0], act_type="relu", name=name)
+        elif ltype == "Sigmoid":
+            out = mx.sym.Activation(ins[0], act_type="sigmoid", name=name)
+        elif ltype == "TanH":
+            out = mx.sym.Activation(ins[0], act_type="tanh", name=name)
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = mx.sym.Dropout(ins[0], p=p.get("dropout_ratio", 0.5),
+                                 name=name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            out = mx.sym.SoftmaxOutput(ins[0], name=name)
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = mx.sym.LRN(ins[0], alpha=p.get("alpha", 1e-4),
+                             beta=p.get("beta", 0.75),
+                             knorm=p.get("k", 2),
+                             nsize=p.get("local_size", 5), name=name)
+        elif ltype == "BatchNorm":
+            p = layer.get("batch_norm_param", {})
+            out = mx.sym.BatchNorm(ins[0], name=name,
+                                   eps=p.get("eps", 1e-5),
+                                   use_global_stats=p.get(
+                                       "use_global_stats") in
+                                   (1, True, "true"))
+        elif ltype == "Scale":
+            # caffe pairs BatchNorm with a Scale layer; BatchNorm here
+            # already has gamma/beta, so Scale is identity
+            out = ins[0]
+        elif ltype == "Concat":
+            p = layer.get("concat_param", {})
+            out = mx.sym.Concat(*ins, dim=p.get("axis", 1), name=name)
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = p.get("operation", "SUM")
+            out = ins[0]
+            for other in ins[1:]:
+                if op == "SUM":
+                    out = out + other
+                elif op == "PROD":
+                    out = out * other
+                elif op == "MAX":
+                    out = mx.sym.maximum(out, other)
+        elif ltype == "Flatten":
+            out = mx.sym.Flatten(ins[0], name=name)
+        elif ltype == "Accuracy":
+            continue
+        else:
+            raise ValueError(
+                f"caffe layer type {ltype!r} is not supported by the "
+                "converter (reference parity list: Convolution, Pooling, "
+                "InnerProduct, activations, Dropout, Softmax, LRN, "
+                "BatchNorm/Scale, Concat, Eltwise, Flatten)")
+        for t in tops:
+            blobs[t] = out
+
+    return out, input_name, input_dim
+
+
+def convert_model(prototxt_fname, caffemodel_fname, output_prefix=None):
+    """Reference tools/caffe_converter/convert_model.py; weights live in
+    binary protobuf, which needs the caffe python package."""
+    raise NotImplementedError(
+        "converting .caffemodel weights requires the caffe python package "
+        "(not available in this environment); convert_symbol() handles the "
+        "network definition")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Convert caffe prototxt to symbol json")
+    parser.add_argument("prototxt")
+    parser.add_argument("output")
+    args = parser.parse_args()
+    sym, input_name, input_dim = convert_symbol(args.prototxt)
+    sym.save(args.output)
+    print(f"input {input_name} dim {input_dim} -> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

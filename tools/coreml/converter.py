@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""CoreML model converter (reference analogue: tools/coreml/).
+
+Converting to Apple CoreML requires the ``coremltools`` package, which is
+not available in this environment; the entry point exists for CLI parity
+and fails with an actionable message. The checkpoint-loading half
+(symbol + params via mx.model.load_checkpoint) is shared and testable.
+"""
+import argparse
+import sys
+
+
+def load_model(prefix, epoch):
+    import mxnet_tpu as mx
+    return mx.model.load_checkpoint(prefix, epoch)
+
+
+def convert(prefix, epoch, output):
+    sym, arg_params, aux_params = load_model(prefix, epoch)
+    try:
+        import coremltools  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "coremltools is not installed in this environment; the "
+            "checkpoint loaded fine "
+            f"({len(arg_params)} arg / {len(aux_params)} aux tensors) but "
+            "CoreML serialization needs `pip install coremltools` on a "
+            "machine with network access")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Convert a checkpoint to CoreML")
+    parser.add_argument("prefix")
+    parser.add_argument("epoch", type=int)
+    parser.add_argument("output")
+    args = parser.parse_args()
+    convert(args.prefix, args.epoch, args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

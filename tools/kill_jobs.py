@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Kill stray distributed training processes on this host.
+
+Reference analogue: tools/kill-mxnet.py — pkills the python processes a
+crashed `launch.py` run left behind. Matches processes whose command line
+contains the given program name (default: any process launched through
+tools/launch.py, identified by the MXTPU_LAUNCHER marker env/argv).
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def find_pids(pattern):
+    out = subprocess.run(["pgrep", "-f", pattern], capture_output=True,
+                         text=True)
+    pids = [int(p) for p in out.stdout.split() if p.strip()]
+    return [p for p in pids if p != os.getpid()]
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Kill leftover distributed training processes")
+    parser.add_argument("prog", nargs="?", default="tools/launch.py",
+                        help="command-line substring to match")
+    parser.add_argument("--signal", type=int, default=signal.SIGTERM)
+    args = parser.parse_args()
+
+    pids = find_pids(args.prog)
+    if not pids:
+        print(f"no processes matching {args.prog!r}")
+        return 0
+    for pid in pids:
+        try:
+            os.kill(pid, args.signal)
+            print(f"killed {pid}")
+        except ProcessLookupError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown (or TSV) table.
+
+Reference analogue: tools/parse_log.py — scrapes the ``Epoch[N] ...=V``
+lines that Module.fit/Speedometer emit (train metric, validation metric,
+epoch time) and tabulates them per epoch.
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    patterns = {
+        "train": re.compile(r".*Epoch\[(\d+)\] Train.*=([.\d]+)"),
+        "valid": re.compile(r".*Epoch\[(\d+)\] Valid.*=([.\d]+)"),
+        "time": re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)"),
+    }
+    table = {}
+    for line in lines:
+        for col, pat in patterns.items():
+            m = pat.match(line)
+            if m:
+                epoch = int(m.groups()[0])
+                table.setdefault(epoch, {})[col] = float(m.groups()[1])
+    return table
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Parse training log into a table")
+    parser.add_argument("logfile", nargs=1, type=str)
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none"])
+    args = parser.parse_args()
+
+    with open(args.logfile[0]) as f:
+        table = parse(f.readlines())
+
+    if args.format == "markdown":
+        print("| epoch | train | valid | time |")
+        print("| --- | --- | --- | --- |")
+        fmt = "| {} | {} | {} | {} |"
+    else:
+        fmt = "{}\t{}\t{}\t{}"
+    for epoch in sorted(table):
+        row = table[epoch]
+        print(fmt.format(epoch, row.get("train", ""), row.get("valid", ""),
+                         row.get("time", "")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
