@@ -20,7 +20,7 @@ from .base import MXNetError, getenv
 from .ndarray import NDArray
 from .ndarray.ndarray import _as_jax
 
-__all__ = ["Executor", "build_graph_eval"]
+__all__ = ["Executor", "build_graph_eval", "build_placed_graph_eval"]
 
 
 def build_graph_eval(symbol, collect_all=False):
@@ -78,12 +78,157 @@ def build_graph_eval(symbol, collect_all=False):
     return eval_fn
 
 
+def build_placed_graph_eval(symbol, group2dev):
+    """Device-placed eval for ctx_group model parallelism.
+
+    Reference analogue: nnvm::pass::PlaceDevice + ``_CrossDeviceCopy``
+    insertion (graph_executor.cc:386-398) driven by ``__ctx_group__``
+    attrs, with the engine overlapping stages. Here: nodes are assigned
+    devices (explicit ``ctx_group`` wins, otherwise inherited from the
+    first placed input), contiguous same-device runs are jit-compiled
+    onto their device, boundary values are ``jax.device_put`` transfers,
+    and jax's async dispatch provides the cross-stage overlap.
+
+    Returns eval_fn with the same signature/contract as
+    :func:`build_graph_eval`; outputs stay on their producing devices.
+    """
+    nodes = symbol._topo_nodes()
+    aux_ids = symbol._aux_node_ids()
+    random_nodes = [n for n in nodes
+                    if n.op is not None and n.op.needs_rng]
+    rng_index = {id(n): i for i, n in enumerate(random_nodes)}
+    out_entries = list(symbol._outputs)
+    default_dev = next(iter(group2dev.values()))
+
+    # -- PlaceDevice: explicit group attr, else inherit from first input --
+    dev_of = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        grp = node.scope_attrs.get("ctx_group")
+        dev = group2dev.get(grp) if grp is not None else None
+        if dev is None:
+            for parent, _ in node.inputs:
+                if id(parent) in dev_of:
+                    dev = dev_of[id(parent)]
+                    break
+        dev_of[id(node)] = dev or default_dev
+    var_dev = {}
+    for node in nodes:
+        if node.is_variable:
+            grp = node.scope_attrs.get("ctx_group")
+            if grp is not None and grp in group2dev:
+                var_dev[id(node)] = group2dev[grp]
+    for node in nodes:
+        if node.is_variable:
+            continue
+        for parent, _ in node.inputs:
+            if parent.is_variable and id(parent) not in var_dev:
+                var_dev[id(parent)] = dev_of[id(node)]
+
+    # -- segment contiguous same-device op runs (bulk-exec analog) --------
+    segments = []  # (device, [nodes])
+    for node in nodes:
+        if node.is_variable:
+            continue
+        dev = dev_of[id(node)]
+        if segments and segments[-1][0] is dev:
+            segments[-1][1].append(node)
+        else:
+            segments.append((dev, [node]))
+
+    def _seg_io(seg_nodes):
+        produced = {(id(n), i) for n in seg_nodes
+                    for i in range(n.num_outputs())}
+        needed = []
+        for n in seg_nodes:
+            for parent, i in n.inputs:
+                key = (id(parent), i)
+                if key not in produced and key not in needed:
+                    needed.append(key)
+        return produced, needed
+
+    seg_meta = []
+    all_later_needs = [set() for _ in segments]
+    # keys each segment must export: used by later segments or final outputs
+    for si, (dev, seg_nodes) in enumerate(segments):
+        produced, needed = _seg_io(seg_nodes)
+        for key in needed:
+            for sj in range(si):
+                if key in seg_meta[sj][0]:
+                    all_later_needs[sj].add(key)
+        seg_meta.append((produced, needed))
+    final_keys = {(id(n), i) for n, i in out_entries}
+    for si, (produced, _) in enumerate(seg_meta):
+        all_later_needs[si] |= (produced & final_keys)
+
+    compiled = []
+    for si, (dev, seg_nodes) in enumerate(segments):
+        produced, needed = seg_meta[si]
+        exports = sorted(all_later_needs[si])
+
+        def seg_fn(is_train, rng, in_vals, _seg_nodes=seg_nodes,
+                   _needed=tuple(needed), _exports=tuple(exports)):
+            values = dict(zip(_needed, in_vals))
+            aux_updates = {}
+            for node in _seg_nodes:
+                ins = [values[(id(p), i)] for p, i in node.inputs]
+                call_attrs = dict(node.attrs)
+                if node.op.needs_is_train:
+                    call_attrs["_is_train"] = is_train
+                if node.op.key_var_num_args and not call_attrs.get(
+                        node.op.key_var_num_args):
+                    call_attrs[node.op.key_var_num_args] = len(ins)
+                if node.op.needs_rng:
+                    key = jax.random.fold_in(rng, rng_index[id(node)])
+                    out = node.op.fn(key, *ins, **call_attrs)
+                else:
+                    out = node.op.fn(*ins, **call_attrs)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                for i, o in enumerate(out):
+                    values[(id(node), i)] = o
+                if is_train and node.op.aux_update:
+                    for out_idx, in_idx in node.op.aux_update.items():
+                        if in_idx < len(node.inputs):
+                            p, _ = node.inputs[in_idx]
+                            if p.is_variable and id(p) in aux_ids:
+                                aux_updates[p.name] = out[out_idx]
+            return [values[k] for k in _exports], aux_updates
+
+        compiled.append((dev, jax.jit(seg_fn, static_argnums=(0,)),
+                         tuple(needed), tuple(exports)))
+
+    def eval_fn(arg_vals: Dict, aux_vals: Dict, rng, is_train: bool):
+        values = {}
+        for node in nodes:
+            if not node.is_variable:
+                continue
+            src = (aux_vals if id(node) in aux_ids else arg_vals)[node.name]
+            dev = var_dev.get(id(node), default_dev)
+            values[(id(node), 0)] = jax.device_put(src, dev)
+        aux_updates = {}
+        for dev, seg_jit, needed, exports in compiled:
+            # _CrossDeviceCopy: move boundary values onto this segment's
+            # device (no-op when already there)
+            in_vals = [jax.device_put(values[k], dev) for k in needed]
+            seg_rng = jax.device_put(rng, dev)
+            outs, aux_up = seg_jit(bool(is_train), seg_rng, in_vals)
+            values.update(zip(exports, outs))
+            aux_updates.update(aux_up)
+        outputs = [values[(id(n), i)] for n, i in out_entries]
+        return outputs, aux_updates
+
+    return eval_fn
+
+
 class Executor:
     """A bound executor over one symbol (reference: graph_executor.h:57-66)."""
 
     def __init__(self, symbol, ctx, args: Dict[str, NDArray],
                  grads: Dict[str, NDArray], grad_req: Dict[str, str],
-                 aux: Dict[str, NDArray], shared_exec: Optional["Executor"] = None):
+                 aux: Dict[str, NDArray], shared_exec: Optional["Executor"] = None,
+                 group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
         self.arg_dict = args
@@ -99,9 +244,46 @@ class Executor:
         # share compiled programs across executors of the same graph
         # (reference: shared_exec memory-pool reuse for bucketing,
         # graph_executor.cc:879-881 — here we share the jit cache instead)
+        placed_devs = {}
+        if group2ctx:
+            for grp, c in group2ctx.items():
+                dev = getattr(c, "jax_device", c)  # Context property or raw Device
+                if callable(dev):
+                    dev = dev()
+                if dev is not None:
+                    placed_devs[grp] = dev
         if shared_exec is not None and shared_exec._symbol is symbol:
             self._fwd = shared_exec._fwd
             self._fwd_bwd = shared_exec._fwd_bwd
+        elif len(set(placed_devs.values())) >= 2:
+            # ctx_group model parallelism: per-group device placement with
+            # internally jitted segments; no outer jit (it would collapse
+            # everything back onto one device)
+            eval_fn = build_placed_graph_eval(symbol, placed_devs)
+
+            def fwd_placed(arg_vals, aux_vals, rng, is_train):
+                return eval_fn(arg_vals, aux_vals, rng, is_train)
+
+            def fwd_bwd_placed(arg_vals, aux_vals, rng, head_grads,
+                               diff_names):
+                diff = {n: arg_vals[n] for n in diff_names}
+
+                def f(diff_args):
+                    merged = dict(arg_vals)
+                    merged.update(diff_args)
+                    return eval_fn(merged, aux_vals, rng, True)
+
+                (outs, aux_up), vjp_fn = jax.vjp(f, diff)
+                cts = [hg if hg is not None else jnp.ones_like(o)
+                       for o, hg in zip(outs, head_grads)]
+                zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+                (grads,) = vjp_fn((cts, zero_aux))
+                return outs, aux_up, grads
+
+            self._fwd = fwd_placed
+            self._fwd_bwd = fwd_bwd_placed
+            self._last = None
+            return
         else:
             eval_fn = build_graph_eval(symbol)
 

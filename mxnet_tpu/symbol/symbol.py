@@ -461,7 +461,8 @@ class Symbol:
 
     # -- binding -------------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    shared_exec=None, shared_buffer=None, **kwargs):
+                    shared_exec=None, shared_buffer=None, group2ctx=None,
+                    **kwargs):
         """Infer shapes, allocate arrays, return a bound Executor
         (reference: symbol.py:1250 → MXExecutorSimpleBind →
         GraphExecutor::Init, graph_executor.cc:934)."""
@@ -507,7 +508,7 @@ class Symbol:
             grads[n] = arr if arr is not None else nd_zeros(
                 args[n].shape, dtype=str(args[n].dtype))
         return Executor(self, ctx, args, grads, grad_req, aux,
-                        shared_exec=shared_exec)
+                        shared_exec=shared_exec, group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -539,7 +540,7 @@ class Symbol:
             if n not in aux_states:
                 raise MXNetError(f"bind missing auxiliary state {n}")
         return Executor(self, ctx, args, args_grad, grad_req, aux_states,
-                        shared_exec=shared_exec)
+                        shared_exec=shared_exec, group2ctx=group2ctx)
 
     # -- gradient graph ------------------------------------------------------
     def gradient(self, wrt: Sequence[str]) -> "Symbol":

@@ -35,6 +35,7 @@ _CASES = [
     ("nce-loss/nce_word.py", []),
     ("warpctc/lstm_ocr_toy.py", []),
     ("reinforcement-learning/reinforce_chain.py", []),
+    ("model-parallel-lstm/model_parallel_lstm.py", ["--iters", "120"]),
     ("ssd/multibox_toy.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
