@@ -221,3 +221,23 @@ def test_group2ctx_trains():
             opt.update(i, a, ex.grad_dict[n], states[n])
     acc = (ex.outputs[0].asnumpy().argmax(1) == y).mean()
     assert acc > 0.9
+
+
+def test_module_group2ctxs():
+    # reference Module(..., group2ctxs=...) — module-level placement
+    net = _two_stage_net()
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 10).astype(np.float32)
+    y = (x @ rng.normal(0, 1, (10, 4))).argmax(1).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"],
+                        group2ctxs={"stage1": mx.Context("cpu", 0),
+                                    "stage2": mx.Context("cpu", 1)})
+    mod.fit(it, num_epoch=40, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-2},
+            initializer=mx.init.Xavier())
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    assert acc > 0.85
+    out_dev = mod._exec.outputs[0]._data.device
+    assert out_dev == mx.Context("cpu", 1).jax_device
