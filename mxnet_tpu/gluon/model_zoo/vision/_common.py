@@ -4,11 +4,25 @@ from ...block import HybridBlock
 
 
 def check_pretrained(pretrained):
-    """Every factory gates pretrained= here: no network egress in this
-    environment, so downloaded weights are unavailable by design."""
+    """Legacy gate kept for compatibility; see load_pretrained."""
     if pretrained:
         raise MXNetError("pretrained weights unavailable (no network "
                          "egress); use net.load_params(path)")
+
+
+def load_pretrained(net, name, pretrained):
+    """Load cached pretrained weights into ``net`` when requested.
+
+    Reference: each factory calls model_store.get_model_file then
+    load_params (gluon/model_zoo/vision/resnet.py et al.). No egress here:
+    get_model_file serves only from the local cache and raises with
+    seeding instructions when the file is absent.
+    """
+    if not pretrained:
+        return net
+    from ..model_store import get_model_file
+    net.load_params(get_model_file(name))
+    return net
 
 
 class Concurrent(HybridBlock):

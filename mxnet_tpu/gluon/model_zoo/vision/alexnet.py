@@ -2,7 +2,7 @@
 Krizhevsky et al. 2012, the one-column variant)."""
 from ... import nn
 from ...block import HybridBlock
-from ._common import check_pretrained
+from ._common import load_pretrained
 
 __all__ = ["AlexNet", "alexnet"]
 
@@ -40,5 +40,4 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return AlexNet(**kwargs)
+    return load_pretrained(AlexNet(**kwargs), "alexnet", pretrained)

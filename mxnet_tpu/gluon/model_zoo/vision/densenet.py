@@ -2,7 +2,7 @@
 arch from Huang et al. 2016)."""
 from ... import nn
 from ...block import HybridBlock
-from ._common import check_pretrained
+from ._common import load_pretrained
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
@@ -80,9 +80,9 @@ _spec = {121: (64, 32, [6, 12, 24, 16]),
 
 
 def _get(num_layers, pretrained=False, **kwargs):
-    check_pretrained(pretrained)
     init, growth, cfg = _spec[num_layers]
-    return DenseNet(init, growth, cfg, **kwargs)
+    return load_pretrained(DenseNet(init, growth, cfg, **kwargs),
+                           f"densenet{num_layers}", pretrained)
 
 
 def densenet121(**kw): return _get(121, **kw)

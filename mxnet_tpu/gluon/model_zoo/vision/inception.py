@@ -2,7 +2,7 @@
 arch from Szegedy et al. 2015, 299x299 input)."""
 from ... import nn
 from ...block import HybridBlock
-from ._common import Concurrent as _Concurrent, check_pretrained
+from ._common import Concurrent as _Concurrent, load_pretrained
 
 __all__ = ["Inception3", "inception_v3"]
 
@@ -150,5 +150,4 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return Inception3(**kwargs)
+    return load_pretrained(Inception3(**kwargs), "inceptionv3", pretrained)

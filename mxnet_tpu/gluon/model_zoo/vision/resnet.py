@@ -3,7 +3,7 @@ arch from He et al. 2015 / 2016 pre-activation)."""
 from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
-from ._common import check_pretrained
+from ._common import load_pretrained
 
 __all__ = ["ResNetV1", "ResNetV2", "get_resnet",
            "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
@@ -226,10 +226,11 @@ def get_resnet(version, num_layers, pretrained=False, **kwargs):
     if num_layers not in _spec:
         raise MXNetError(f"invalid resnet depth {num_layers}; "
                          f"options: {sorted(_spec)}")
-    check_pretrained(pretrained)
     block_type, layers, channels = _spec[num_layers]
     cls, blocks = _versions[version]
-    return cls(blocks[block_type], layers, channels, **kwargs)
+    return load_pretrained(cls(blocks[block_type], layers, channels,
+                               **kwargs),
+                           f"resnet{num_layers}_v{version}", pretrained)
 
 
 def resnet18_v1(**kw): return get_resnet(1, 18, **kw)

@@ -3,7 +3,7 @@ arch from Iandola et al. 2016)."""
 from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
-from ._common import Concurrent as _Concurrent, check_pretrained
+from ._common import Concurrent as _Concurrent, load_pretrained
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -67,10 +67,10 @@ class SqueezeNet(HybridBlock):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return SqueezeNet("1.0", **kwargs)
+    return load_pretrained(SqueezeNet("1.0", **kwargs), "squeezenet1.0",
+                           pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return SqueezeNet("1.1", **kwargs)
+    return load_pretrained(SqueezeNet("1.1", **kwargs), "squeezenet1.1",
+                           pretrained)

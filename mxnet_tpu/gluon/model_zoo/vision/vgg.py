@@ -2,7 +2,7 @@
 arch from Simonyan & Zisserman 2014)."""
 from ... import nn
 from ...block import HybridBlock
-from ._common import check_pretrained
+from ._common import load_pretrained
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn", "vgg13_bn",
            "vgg16_bn", "vgg19_bn"]
@@ -46,9 +46,10 @@ _spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
 
 
 def get_vgg(num_layers, pretrained=False, **kwargs):
-    check_pretrained(pretrained)
     layers, filters = _spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    bn = "_bn" if kwargs.get("batch_norm") else ""
+    return load_pretrained(VGG(layers, filters, **kwargs),
+                           f"vgg{num_layers}{bn}", pretrained)
 
 
 def vgg11(**kw): return get_vgg(11, **kw)

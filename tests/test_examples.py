@@ -36,6 +36,7 @@ _CASES = [
     ("warpctc/lstm_ocr_toy.py", []),
     ("reinforcement-learning/reinforce_chain.py", []),
     ("model-parallel-lstm/model_parallel_lstm.py", ["--iters", "120"]),
+    ("stochastic-depth/sd_resnet.py", ["--epochs", "30"]),
     ("ssd/multibox_toy.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",

@@ -45,10 +45,13 @@ def test_zoo_deeper_variants_build():
         assert net is not None
 
 
-def test_zoo_unknown_and_pretrained_errors():
+def test_zoo_unknown_and_pretrained_errors(tmp_path, monkeypatch):
     with pytest.raises(mx.base.MXNetError):
         vision.get_model("resnet20_v9")
-    with pytest.raises(mx.base.MXNetError):
+    # pretrained= now serves from the local weight cache (model_store);
+    # an empty cache raises FileNotFoundError with seeding instructions
+    monkeypatch.setenv("MXTPU_MODEL_ZOO_DIR", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="resnet18_v1"):
         vision.get_model("resnet18_v1", pretrained=True)
 
 
@@ -71,3 +74,28 @@ def test_zoo_trains_one_step():
     after = p.data().asnumpy()
     assert np.all(np.isfinite(after))
     assert np.abs(after - before).max() > 0  # a parameter actually moved
+
+
+def test_model_store_cache_roundtrip(tmp_path, monkeypatch):
+    # reference model_store.get_model_file: serve pinned weights from the
+    # local cache; egress-free here, so seeding the cache is the contract
+    import numpy as np
+    from mxnet_tpu.gluon import model_zoo
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    monkeypatch.setenv("MXTPU_MODEL_ZOO_DIR", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="alexnet"):
+        model_zoo.vision.alexnet(pretrained=True)
+
+    net = model_zoo.vision.alexnet(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(1, 3, 64, 64).astype(np.float32))
+    _ = net(x)
+    net.save_params(str(tmp_path / "alexnet.params"))
+
+    net2 = model_zoo.vision.alexnet(pretrained=True, classes=10)
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(),
+                               rtol=1e-5)
+    # purge empties the cache
+    model_store.purge()
+    assert not list(tmp_path.glob("*.params"))
