@@ -1,0 +1,95 @@
+"""Neural style transfer, miniature: optimize an image by input gradients.
+
+Reference analogue: example/neural-style/neuralstyle.py — content + gram
+style losses over convnet features, minimized w.r.t. the *image* (not the
+weights) with autograd. Scaled down: a small fixed random convnet supplies
+the feature maps (random convnets are standard texture-feature extractors)
+and 64x64 synthetic content/style images; asserts both losses drop
+substantially.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def make_extractor(rng):
+    net = nn.Sequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.Conv2D(16, 3, strides=2, padding=1, activation="relu"),
+            nn.Conv2D(16, 3, padding=1, activation="relu"))
+    net.initialize(mx.init.Normal(0.2))
+    _ = net(mx.nd.zeros((1, 3, 64, 64)))  # materialize
+    return net
+
+
+def features(net, x):
+    feats = []
+    h = x
+    for blk in net._children:
+        h = blk(h)
+        feats.append(h)
+    return feats
+
+
+def gram(f):
+    n, c = f.shape[0], f.shape[1]
+    flat = mx.nd.Reshape(f, shape=(n, c, -1))
+    g = mx.nd.batch_dot(flat, flat, transpose_b=True)
+    return g / float(f.shape[2] * f.shape[3])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--iters", type=int, default=120)
+    args = parser.parse_args()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+
+    yy, xx = np.mgrid[0:64, 0:64].astype(np.float32) / 64.0
+    content = np.stack([np.exp(-((xx - .5) ** 2 + (yy - .5) ** 2) * 8)] * 3)
+    style = np.stack([np.sin(xx * 25), np.cos(yy * 25),
+                      np.sin((xx + yy) * 18)]) * 0.5 + 0.5
+    content_img = mx.nd.array(content[None])
+    style_img = mx.nd.array(style[None])
+
+    net = make_extractor(rng)
+    with mx.autograd.pause():
+        content_feats = features(net, content_img)
+        style_grams = [gram(f) for f in features(net, style_img)]
+
+    img = mx.nd.array(rng.rand(1, 3, 64, 64).astype(np.float32))
+
+    def losses(im):
+        feats = features(net, im)
+        c_loss = mx.nd.mean((feats[-1] - content_feats[-1]) ** 2)
+        s_loss = sum(mx.nd.mean((gram(f) - g) ** 2)
+                     for f, g in zip(feats, style_grams))
+        return c_loss, s_loss
+
+    c0, s0 = (float(v.asnumpy()) for v in losses(img))
+
+    lr = 0.05
+    for it in range(args.iters):
+        img.attach_grad()
+        with mx.autograd.record():
+            c_loss, s_loss = losses(img)
+            total = c_loss + 30.0 * s_loss
+        total.backward()
+        g = img.grad
+        img = mx.nd.clip(img - lr * g / (mx.nd.norm(g) + 1e-8) * 64,
+                         a_min=0, a_max=1)
+
+    c1, s1 = (float(v.asnumpy()) for v in losses(img))
+    print(f"content loss {c0:.4f}->{c1:.4f}, style loss {s0:.4f}->{s1:.4f}")
+    assert c1 < 0.6 * c0
+    assert s1 < 0.2 * s0
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,8 @@ _CASES = [
     ("reinforcement-learning/reinforce_chain.py", []),
     ("model-parallel-lstm/model_parallel_lstm.py", ["--iters", "120"]),
     ("stochastic-depth/sd_resnet.py", ["--epochs", "30"]),
+    ("neural-style/neural_style_toy.py", []),
+    ("dec/dec_toy.py", []),
     ("ssd/multibox_toy.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
