@@ -135,6 +135,33 @@ def resolve_inputs(opdef: "OpDef", args, kwargs, name: str,
     import builtins
 
     inputs = list(args)
+    # positional parameters after the tensor inputs (reference codegen
+    # signatures: ``clip(data, a_min, a_max)`` — params fill in declared
+    # order). Peel non-tensor trailing args onto unconsumed attr fields.
+    if opdef.attr_spec.fields:
+        def _tensorish(v):
+            if is_input is not None:
+                return is_input(v)
+            return (hasattr(v, "shape") and hasattr(v, "dtype")
+                    and not isinstance(v, (tuple, list)))
+
+        n_peel = 0
+        while (n_peel < builtins.len(inputs)
+               and not _tensorish(inputs[-1 - n_peel])):
+            n_peel += 1
+        if n_peel:
+            # the variadic-count field is auto-filled, never positional
+            fields = [k for k in opdef.attr_spec.fields
+                      if k not in kwargs and k != opdef.key_var_num_args]
+            if n_peel > builtins.len(fields):
+                raise MXNetError(
+                    f"{name}: {n_peel} positional parameters given but "
+                    f"only {builtins.len(fields)} declared parameters "
+                    f"remain ({fields}); valid: "
+                    f"{builtins.sorted(opdef.attr_spec.fields)}")
+            extra = inputs[builtins.len(inputs) - n_peel:]
+            inputs = inputs[:builtins.len(inputs) - n_peel]
+            kwargs.update(builtins.zip(fields, extra))
     # ops registered without explicit input_names still accept the
     # conventional ``data=`` keyword (the reference's generated wrappers
     # name the first input 'data' for every single-input op)

@@ -218,3 +218,26 @@ def test_sparse_conveniences():
                                [[0, 1], [0, 0], [0, 0]])
     with pytest.raises(mx.MXNetError):
         mx.nd.sparse_retain(dense, mx.nd.array(np.array([0], np.float32)))
+
+
+def test_positional_parameters_after_inputs():
+    # reference codegen signatures fill declared params positionally:
+    # clip(data, a_min, a_max), expand_dims(data, axis), ...
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(mx.nd.clip(x, 1.0, 4.0).asnumpy(),
+                               np.clip(x.asnumpy(), 1, 4))
+    assert mx.nd.expand_dims(x, 1).shape == (2, 1, 3)
+    np.testing.assert_allclose(mx.nd.slice_axis(x, 1, 0, 2).asnumpy(),
+                               x.asnumpy()[:, :2])
+    assert mx.nd.transpose(x, (1, 0)).shape == (3, 2)
+    # mixed positional + keyword
+    np.testing.assert_allclose(mx.nd.clip(x, 1.0, a_max=4.0).asnumpy(),
+                               np.clip(x.asnumpy(), 1, 4))
+    # symbolic namespace too
+    s = mx.sym.clip(mx.sym.var("a"), 0.0, 1.0)
+    ex = s.simple_bind(mx.cpu(), a=(2,))
+    ex.arg_dict["a"][:] = mx.nd.array(np.array([-1.0, 2.0], np.float32))
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [0.0, 1.0])
+    # too many positionals still errors
+    with pytest.raises(mx.MXNetError):
+        mx.nd.clip(x, 1.0, 4.0, 9.0)
