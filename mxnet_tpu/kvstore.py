@@ -163,6 +163,17 @@ class KVStore:
     def send_command_to_servers(self, head, body):
         pass
 
+    def num_dead_node(self, node_id=0, timeout_sec=60):
+        """Number of dead nodes as seen from the given node (reference
+        kvstore.h:311 get_num_dead_node over ps-lite heartbeats).
+
+        The SPMD stack is fate-shared: a dead process fails the NCCL-less
+        collective for everyone and jax.distributed tears the job down, so
+        a *running* job by construction has zero dead peers; recovery is
+        relaunch + checkpoint-resume (SURVEY.md §5.3 — the reference's
+        practical recovery path too)."""
+        return 0
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
