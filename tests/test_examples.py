@@ -39,6 +39,7 @@ _CASES = [
     ("stochastic-depth/sd_resnet.py", ["--epochs", "30"]),
     ("neural-style/neural_style_toy.py", []),
     ("dec/dec_toy.py", []),
+    ("speech/speech_gru_acoustic.py", ["--epochs", "10"]),
     ("ssd/multibox_toy.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
