@@ -36,6 +36,8 @@ class KVStore:
         self._store: Dict[str, NDArray] = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None        # {'type': '2bit', 'threshold': t}
+        self._residuals: Dict = {}      # error-feedback state per key/slot
 
     # -- core API -----------------------------------------------------------
     def init(self, key, value):
@@ -46,6 +48,39 @@ class KVStore:
             v0 = v[0] if isinstance(v, list) else v
             self._store[k] = v0.copy()
 
+    def set_gradient_compression(self, compression_params):
+        """Enable gradient compression on pushes (2-bit sign-threshold
+        quantization with error feedback — beyond the 0.11 reference;
+        matches the later mxnet `kv.set_gradient_compression(
+        {'type': '2bit', 'threshold': t})` API). Each pushed gradient is
+        quantized to {-t, 0, +t} per element; the quantization error is
+        kept per (key, device-slot) and added to the next push, so the
+        update is unbiased over time while the communicated tensor holds
+        ~2 bits/element of information."""
+        params = dict(compression_params or {})
+        ctype = params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError(
+                f"unsupported gradient compression type {ctype!r}; "
+                "supported: '2bit'")
+        threshold = float(params.get("threshold", 0.5))
+        if threshold <= 0:
+            raise MXNetError("compression threshold must be positive")
+        self._compression = {"type": ctype, "threshold": threshold}
+        self._residuals.clear()
+
+    def _compress(self, k, slot, v):
+        import jax.numpy as jnp
+        t = self._compression["threshold"]
+        res = self._residuals.get((k, slot))
+        acc = v._data + (res if res is not None else 0)
+        q = jnp.where(acc >= t, jnp.asarray(t, acc.dtype),
+                      jnp.where(acc <= -t, jnp.asarray(-t, acc.dtype),
+                                jnp.zeros((), acc.dtype)))
+        self._residuals[(k, slot)] = acc - q
+        from .ndarray import NDArray as _ND
+        return _ND(q)
+
     def push(self, key, value, priority=0):
         """Aggregate grads into the store; runs the updater if set
         (reference: KVStoreLocal::Push + comm reduce, comm.h:90-434)."""
@@ -53,6 +88,10 @@ class KVStore:
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, list):
                 vlist = [vlist]
+            if self._compression is not None and vlist and \
+                    getattr(vlist[0], "stype", "default") == "default":
+                vlist = [self._compress(k, i, v)
+                         for i, v in enumerate(vlist)]
             agg = vlist[0]
             if len(vlist) > 1:
                 from .ndarray import add_n

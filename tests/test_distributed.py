@@ -7,6 +7,8 @@ CPU device joining one jax.distributed process group.
 """
 import os
 import subprocess
+
+import pytest
 import sys
 import textwrap
 
@@ -88,3 +90,61 @@ def test_launcher_propagates_failure(tmp_path):
          "-n", "2", "--", sys.executable, str(bad)],
         capture_output=True, text=True, timeout=120)
     assert res.returncode != 0
+
+
+# ---------------------------------------------------------------------------
+# gradient compression (beyond the 0.11 reference; matches the later
+# kv.set_gradient_compression({'type': '2bit', 'threshold': t}) API)
+# ---------------------------------------------------------------------------
+
+def test_gradient_compression_quantization_and_error_feedback():
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kvstore.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((4,)))
+
+    g = mx.nd.array(np.array([0.7, -0.9, 0.2, 0.0], np.float32))
+    out = mx.nd.zeros((4,))
+    kv.push("w", g)
+    kv.pull("w", out)
+    # values quantized to {-t, 0, +t}
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+
+    # error feedback: elem2 accumulates 0.2/push and fires on the 3rd
+    kv.push("w", g)
+    kv.pull("w", out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    kv.push("w", g)
+    kv.pull("w", out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.5, 0.0])
+
+
+def test_gradient_compression_validation():
+    import mxnet_tpu as mx
+    kv = mx.kvstore.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "1bit"})
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": -1})
+
+
+def test_gradient_compression_converges():
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(256, 10).astype(np.float32)
+    w_true = rng.normal(0, 1, (10, 1)).astype(np.float32)
+    y = x @ w_true
+    w = mx.nd.zeros((10, 1))
+    kv = mx.kvstore.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.2})
+    kv.init("0", w)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.2))
+    for _ in range(800):
+        grad = x.T @ (x @ w.asnumpy() - y) / len(x)
+        kv.push("0", mx.nd.array(grad))
+        kv.pull("0", w)
+    assert float(np.abs(w.asnumpy() - w_true).max()) < 0.1
