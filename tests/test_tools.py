@@ -167,3 +167,55 @@ def test_kill_jobs_no_match():
         capture_output=True, text=True)
     assert res.returncode == 0
     assert "no processes" in res.stdout
+
+
+def test_accnn_fc_decomposition(tmp_path):
+    # reference tools/accnn/acc_fc.py: SVD split preserves outputs at
+    # full rank and approximates them at reduced rank with fewer FLOPs
+    accnn = _load(os.path.join(ROOT, "tools", "accnn", "acc_fc.py"),
+                  "acc_fc")
+    rng = np.random.RandomState(0)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Activation(
+                mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=32,
+                                      name="fc1"),
+                act_type="relu"),
+            num_hidden=8, name="fc2"),
+        name="softmax")
+    shapes = net.infer_shape(data=(4, 16), softmax_label=(4,))[0]
+    args = {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(net.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+    x = rng.rand(4, 16).astype(np.float32)
+
+    def run(sym, params):
+        ex = sym.simple_bind(mx.cpu(), grad_req="null", data=(4, 16),
+                             softmax_label=(4,))
+        ex.copy_params_from(params)
+        ex.arg_dict["data"][:] = mx.nd.array(x)
+        return ex.forward(is_train=False)[0].asnumpy()
+
+    base = run(net, args)
+
+    # full rank: numerically identical outputs
+    sym_full, args_full = accnn.fc_decomposition(net, args, "fc1", 32)
+    assert "fc1_weight" not in sym_full.list_arguments()
+    assert "fc1_red_weight" in sym_full.list_arguments()
+    np.testing.assert_allclose(run(sym_full, args_full), base, rtol=1e-4,
+                               atol=1e-5)
+
+    # reduced rank: close outputs
+    sym_lr, args_lr = accnn.fc_decomposition(net, args, "fc1", 12)
+    assert args_lr["fc1_red_weight"].shape == (12, 16)
+    assert args_lr["fc1_rec_weight"].shape == (32, 12)
+    np.testing.assert_allclose(run(sym_lr, args_lr), base, atol=0.15)
+
+    # checkpoint round trip through the CLI-facing API
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 0, net, args, {})
+    sym2, arg2, _ = mx.model.load_checkpoint(prefix, 0)
+    sym_d, args_d = accnn.fc_decomposition(sym2, arg2, "fc2", 8)
+    np.testing.assert_allclose(run(sym_d, args_d), base, rtol=1e-4,
+                               atol=1e-5)

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Accelerate a network by low-rank factorizing FullyConnected layers.
+
+Reference analogue: tools/accnn/acc_fc.py — SVD-split one FC layer
+``W (out, in)`` into ``W2 (K, in)`` then ``W1 (out, K)`` (rank K), cutting
+FLOPs from out*in to K*(out+in) while approximately preserving outputs.
+Operates on a (symbol, arg_params, aux_params) checkpoint triple.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def fc_decompose_params(weight, bias, rank):
+    """SVD split: returns (w_red (K, in), w_rec (out, K), bias)."""
+    w = np.asarray(weight, np.float32)
+    out_dim = w.shape[0]
+    w2d = w.reshape(out_dim, -1)
+    u, s, v = np.linalg.svd(w2d, full_matrices=False)
+    rank = int(min(rank, len(s)))
+    w_red = (np.diag(s[:rank]) @ v[:rank]).astype(np.float32)   # (K, in)
+    w_rec = u[:, :rank].astype(np.float32)                      # (out, K)
+    return w_red, w_rec, (None if bias is None
+                          else np.asarray(bias, np.float32))
+
+
+def fc_decomposition(sym, arg_params, layer, rank):
+    """Rewrite the graph JSON, replacing FC node ``layer`` with
+    ``layer_red`` (rank-K, no bias) → ``layer_rec`` (original out, bias).
+
+    Returns (new_symbol, new_arg_params).
+    """
+    import mxnet_tpu as mx
+
+    graph = json.loads(sym.tojson())
+    nodes = graph["nodes"]
+    target = None
+    for i, node in enumerate(nodes):
+        if node.get("op") == "FullyConnected" and node["name"] == layer:
+            target = i
+            break
+    if target is None:
+        raise ValueError(f"no FullyConnected node named {layer!r}")
+    node = nodes[target]
+    attrs = node.get("attrs") or node.get("param") or {}
+    no_bias = str(attrs.get("no_bias", "False")).lower() in ("true", "1")
+    num_hidden = int(attrs["num_hidden"])
+
+    w = arg_params[f"{layer}_weight"].asnumpy()
+    b = None if no_bias else arg_params[f"{layer}_bias"].asnumpy()
+    w_red, w_rec, b = fc_decompose_params(w, b, rank)
+    rank = w_red.shape[0]
+
+    # splice replacement nodes in place of the old FC node so the graph
+    # JSON stays topologically ordered (the loader is single-pass)
+    data_in = list(node["inputs"][0])
+    red_w_id = target
+    red_id = target + 1
+    rec_w_id = target + 2
+    rec_b_id = target + 3
+    inserted = [
+        {"op": "null", "name": f"{layer}_red_weight", "inputs": []},
+        {"op": "FullyConnected", "name": f"{layer}_red",
+         "attrs": {"num_hidden": str(rank), "no_bias": "True"},
+         "inputs": [data_in, [red_w_id, 0, 0]]},
+        {"op": "null", "name": f"{layer}_rec_weight", "inputs": []},
+    ]
+    rec_inputs = [[red_id, 0, 0], [rec_w_id, 0, 0]]
+    if not no_bias:
+        inserted.append({"op": "null", "name": f"{layer}_rec_bias",
+                         "inputs": []})
+        rec_inputs.append([rec_b_id, 0, 0])
+    rec_id = target + len(inserted)
+    inserted.append({"op": "FullyConnected", "name": f"{layer}_rec",
+                     "attrs": {"num_hidden": str(num_hidden),
+                               "no_bias": str(no_bias)},
+                     "inputs": rec_inputs})
+    shift = len(inserted) - 1
+
+    def remap(i):
+        if i < target:
+            return i
+        if i == target:
+            return rec_id
+        return i + shift
+
+    tail = nodes[target + 1:]
+    for other in tail:
+        for inp in other.get("inputs", []):
+            inp[0] = remap(inp[0])
+    graph["nodes"] = nodes[:target] + inserted + tail
+    for head in graph["heads"]:
+        head[0] = remap(head[0])
+    graph.pop("arg_nodes", None)
+    graph.pop("node_row_ptr", None)
+
+    new_sym = mx.sym.load_json(json.dumps(graph))
+    new_args = {k: v for k, v in arg_params.items()
+                if not k.startswith(f"{layer}_")}
+    new_args[f"{layer}_red_weight"] = mx.nd.array(w_red)
+    new_args[f"{layer}_rec_weight"] = mx.nd.array(w_rec)
+    if b is not None:
+        new_args[f"{layer}_rec_bias"] = mx.nd.array(b)
+    return new_sym, new_args
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="SVD-decompose an FC layer of a checkpoint")
+    parser.add_argument("prefix")
+    parser.add_argument("epoch", type=int)
+    parser.add_argument("--layer", required=True)
+    parser.add_argument("-K", type=int, required=True, help="rank")
+    parser.add_argument("--out-prefix", default=None)
+    args = parser.parse_args()
+
+    import mxnet_tpu as mx
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.prefix, args.epoch)
+    new_sym, new_args = fc_decomposition(sym, arg_params, args.layer,
+                                         args.K)
+    out = args.out_prefix or (args.prefix + "_acc")
+    mx.model.save_checkpoint(out, args.epoch, new_sym, new_args,
+                             aux_params)
+    print(f"wrote {out}-symbol.json / {out}-{args.epoch:04d}.params")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
