@@ -182,9 +182,16 @@ class FeedForward:
         return labels[0] if labels else "softmax_label"
 
     def _make_module(self, data_iter):
+        label_names = [l.name for l in data_iter.provide_label]
+        if not label_names:
+            # label-less prediction iterator: the graph's label arguments
+            # are still inputs, not parameters (the reference predictor
+            # binds them to zeros — c_predict_api.cc / simple_bind)
+            label_names = [n for n in self.symbol.list_arguments()
+                           if n.endswith("label")]
         mod = self._module_cls(
             self.symbol, data_names=[d.name for d in data_iter.provide_data],
-            label_names=[l.name for l in data_iter.provide_label],
+            label_names=label_names,
             context=self.ctx)
         return mod
 

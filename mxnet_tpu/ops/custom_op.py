@@ -187,11 +187,35 @@ class _CustomOpDef(OpDef):
         op_type, kwargs, _ = _split_attrs(attrs)
         return len(_instantiate(op_type, kwargs).list_outputs())
 
+    def dynamic_input_names(self, attrs):
+        """Input arity/names come from the registered prop — lets symbol
+        composition auto-create missing inputs (reference: the composer
+        creates e.g. 'softmax_label' for Custom loss layers)."""
+        op_type, kwargs, _ = _split_attrs(attrs)
+        return list(_instantiate(op_type, kwargs).list_arguments())
+
+
+def _custom_param_shapes(attrs, shapes):
+    """Fill auto-created input shapes (e.g. the label of a loss-style
+    Custom op) from the prop's infer_shape — the symbol-side half of the
+    reference's two-way InferShape for Custom (custom-inl.h)."""
+    op_type, kwargs, _ = _split_attrs(attrs)
+    prop = _instantiate(op_type, kwargs)
+    known = [s for s in shapes if s is not None]
+    if not known:
+        return shapes
+    probe = [tuple(s) if s is not None else tuple(known[0])
+             for s in shapes]
+    in_shapes = prop.infer_shape(probe)[0]
+    return [tuple(s) if s is not None else tuple(in_shapes[i])
+            for i, s in enumerate(shapes)]
+
 
 def _register_custom():
     op = _CustomOpDef(
         "Custom", _custom_fn, num_inputs=None, needs_is_train=True,
-        output_names=["output"], grad_fn=_custom_grad_fn, stateful=True)
+        output_names=["output"], grad_fn=_custom_grad_fn, stateful=True,
+        param_shapes=_custom_param_shapes)
     OP_TABLE["Custom"] = op
 
 

@@ -642,13 +642,19 @@ def symbol_invoke(opdef: OpDef, inputs: Sequence[Symbol], attrs: Dict,
                 f"cannot compose {opdef.name} with a grouped symbol input")
         entries.append(s._outputs[0])
 
-    if opdef.input_names and not opdef.key_var_num_args:
-        n_expected = len(opdef.input_names)
-        if opdef.num_inputs is None:
+    input_names = opdef.input_names
+    if input_names is None:
+        # ops with attr-dependent arity (Custom: prop.list_arguments)
+        dyn = getattr(opdef, "dynamic_input_names", None)
+        if dyn is not None:
+            input_names = dyn(parsed)
+    if input_names and not opdef.key_var_num_args:
+        n_expected = len(input_names)
+        if opdef.num_inputs is None and opdef.input_names is not None:
             # variadic by attrs (e.g. no_bias drops bias; prelu adds gamma)
             n_expected = _expected_inputs(opdef, parsed)
         while len(entries) < n_expected:
-            in_name = opdef.input_names[len(entries)]
+            in_name = input_names[len(entries)]
             v = Variable(f"{name}_{in_name}")
             entries.append(v._outputs[0])
     if opdef.key_var_num_args and not parsed.get(opdef.key_var_num_args):

@@ -1,0 +1,124 @@
+"""`import mxnet` alias package: reference-era scripts run unmodified.
+
+Reference analogue: the python package name itself — user code written as
+``import mxnet as mx`` / ``from mxnet import gluon`` binds to mxnet_tpu.
+"""
+import numpy as np
+
+
+def test_import_mxnet_alias_full_loop():
+    import mxnet as mx
+    from mxnet import autograd, gluon, nd
+    from mxnet.gluon import nn
+    import mxnet.ndarray as ndm
+
+    assert ndm.zeros((2,)).shape == (2,)
+    assert mx.__version__
+
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = rng.rand(256, 8).astype(np.float32)
+    y = (x.sum(1) > 4).astype(np.float32)
+    for _ in range(60):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(x)), nd.array(y))
+        loss.backward()
+        trainer.step(256)
+    acc = (net(nd.array(x)).asnumpy().argmax(1) == y).mean()
+    assert acc > 0.9
+
+
+def test_alias_symbol_module_metric():
+    import mxnet as mx
+
+    data = mx.symbol.Variable("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2),
+                               name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 6).astype(np.float32)
+    y = (x.sum(1) > 3).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    assert acc > 0.85
+
+
+def test_alias_late_submodule_import():
+    import mxnet
+
+    # submodules not yet touched resolve via PEP-562 __getattr__
+    from mxnet import recordio  # noqa: F401
+    import mxnet.test_utils as tu
+    assert hasattr(tu, "assert_almost_equal")
+    assert hasattr(mxnet.image, "imresize")
+
+
+def test_reference_idiom_custom_feedforward_predict():
+    # reference example/numpy-ops/custom_softmax.py shape: Custom op with
+    # an AUTO-CREATED label argument (the composer makes 'softmax_label'),
+    # trained through FeedForward, then label-less predict
+    import mxnet as mx
+
+    class Softmax(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0].asnumpy()
+            y = np.exp(x - x.max(axis=1).reshape((x.shape[0], 1)))
+            y /= y.sum(axis=1).reshape((x.shape[0], 1))
+            self.assign(out_data[0], req[0], mx.nd.array(y))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            lab = in_data[1].asnumpy().ravel().astype(int)
+            y = out_data[0].asnumpy()
+            y[np.arange(lab.shape[0]), lab] -= 1.0
+            self.assign(in_grad[0], req[0], mx.nd.array(y))
+            self.assign(in_grad[1], req[1], mx.nd.zeros(in_data[1].shape))
+
+    @mx.operator.register("softmax_autolabel_test")
+    class SoftmaxProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Softmax()
+
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=32)
+    act1 = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc3 = mx.symbol.FullyConnected(data=act1, name="fc3", num_hidden=10)
+    mlp = mx.symbol.Custom(data=fc3, name="softmax",
+                           op_type="softmax_autolabel_test")
+    # the composer auto-created the label variable, reference-style
+    assert mlp.list_arguments()[-1] == "softmax_label"
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(400, 20).astype(np.float32)
+    w = rng.normal(0, 1, (20, 10))
+    y = (x @ w).argmax(1).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=100,
+                              label_name="softmax_label")
+    model = mx.model.FeedForward(ctx=mx.cpu(0), symbol=mlp, num_epoch=40,
+                                 learning_rate=0.3, momentum=0.9,
+                                 wd=0.00001)
+    model.fit(X=train,
+              batch_end_callback=mx.callback.Speedometer(100, 100))
+    pred = model.predict(mx.io.NDArrayIter(x, batch_size=100))
+    acc = (pred.argmax(1) == y).mean()
+    assert acc > 0.85
