@@ -72,6 +72,14 @@ class Predictor {
   Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
     other.handle_ = nullptr;
   }
+  Predictor &operator=(Predictor &&other) noexcept {
+    if (this != &other) {
+      if (handle_) MXPredFree(handle_);
+      handle_ = other.handle_;
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
 
   void SetInput(const std::string &key, const float *data, size_t size) {
     Check(MXPredSetInput(handle_, key.c_str(), data,

@@ -33,6 +33,11 @@ __version__ = _base.__version__
 
 def __getattr__(name):  # late-imported submodules (PEP 562)
     import importlib
-    mod = importlib.import_module(f"mxnet_tpu.{name}")
+    try:
+        mod = importlib.import_module(f"mxnet_tpu.{name}")
+    except ImportError:
+        # PEP 562: unknown attributes must raise AttributeError so
+        # hasattr()/getattr(..., default) feature probes keep working
+        raise AttributeError(f"module 'mxnet' has no attribute {name!r}")
     _sys.modules[f"mxnet.{name}"] = mod
     return mod

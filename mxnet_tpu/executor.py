@@ -273,6 +273,10 @@ class Executor:
                     merged.update(diff_args)
                     return eval_fn(merged, aux_vals, rng, True)
 
+                if getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int):
+                    # same remat knob as the single-device path — most
+                    # relevant here, where the model already didn't fit
+                    f = jax.checkpoint(f)
                 (outs, aux_up), vjp_fn = jax.vjp(f, diff)
                 cts = [hg if hg is not None else jnp.ones_like(o)
                        for o, hg in zip(outs, head_grads)]

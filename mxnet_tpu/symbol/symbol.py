@@ -573,7 +573,7 @@ class Symbol:
             "node_row_ptr": list(range(len(nodes) + 1)),
             "heads": [[nid[id(n)], i, 0] for n, i in self._outputs],
             "attrs": {"mxnet_version": ["int", 1100],
-                      "mxnet_tpu_version": ["str", "0.1.0"]},
+                      "mxnet_tpu_version": ["str", _libinfo_version()]},
         }
         return json.dumps(graph, indent=2)
 
@@ -626,6 +626,11 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     for s in symbols:
         outputs.extend(s._outputs)
     return Symbol(outputs)
+
+
+def _libinfo_version() -> str:
+    from ..libinfo import __version__ as v
+    return v
 
 
 def symbol_invoke(opdef: OpDef, inputs: Sequence[Symbol], attrs: Dict,
@@ -688,7 +693,15 @@ def load_json(json_str: str) -> Symbol:
         # legacy user attrs (ctx_group, lr_mult, ...) ride separately
         attrs.update(entry.get("attr") or {})
         if entry["op"] == "null":
-            node = SymbolNode(None, entry["name"], attrs, [])
+            # variables: dunder keys (__dtype__ etc.) are structural
+            # attrs; everything else (ctx_group, lr_mult) is a user attr
+            # read from scope_attrs (e.g. by PlaceDevice) — keep the
+            # split symmetric with the op-node branch below
+            node_attrs = {k: v for k, v in attrs.items()
+                          if k.startswith("__")}
+            node = SymbolNode(None, entry["name"], node_attrs, [])
+            node.scope_attrs.update(
+                {k: v for k, v in attrs.items() if not k.startswith("__")})
         else:
             opdef = get_op(entry["op"])
             known = {k: v for k, v in attrs.items()

@@ -241,3 +241,22 @@ def test_module_group2ctxs():
     assert acc > 0.85
     out_dev = mod._exec.outputs[0]._data.device
     assert out_dev == mx.Context("cpu", 1).jax_device
+
+
+def test_group2ctx_survives_json_roundtrip():
+    # ctx_group attrs on variables AND ops must round-trip through JSON
+    # (PlaceDevice reads scope_attrs on the reloaded graph)
+    net = _two_stage_net()
+    reloaded = mx.sym.load_json(net.tojson())
+    attrs = reloaded.attr_dict()
+    assert attrs.get("mp_fc1", {}).get("ctx_group") == "stage1"
+    assert attrs.get("mp_fc2", {}).get("ctx_group") == "stage2"
+
+    g2c = {"stage1": mx.Context("cpu", 0), "stage2": mx.Context("cpu", 1)}
+    ex = reloaded.simple_bind(mx.cpu(), grad_req="null", group2ctx=g2c,
+                              data=(4, 10), softmax_label=(4,))
+    rng = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        a[:] = mx.nd.array(rng.normal(0, 0.1, a.shape).astype(np.float32))
+    out = ex.forward(is_train=False)[0]
+    assert out._data.device == g2c["stage2"].jax_device

@@ -122,3 +122,11 @@ def test_reference_idiom_custom_feedforward_predict():
     pred = model.predict(mx.io.NDArrayIter(x, batch_size=100))
     acc = (pred.argmax(1) == y).mean()
     assert acc > 0.85
+
+
+def test_alias_hasattr_feature_probe():
+    import mxnet
+
+    # PEP 562: unknown attributes raise AttributeError, so probes work
+    assert not hasattr(mxnet, "definitely_not_a_module_xyz")
+    assert getattr(mxnet, "definitely_not_a_module_xyz", None) is None

@@ -219,3 +219,45 @@ def test_accnn_fc_decomposition(tmp_path):
     sym_d, args_d = accnn.fc_decomposition(sym2, arg2, "fc2", 8)
     np.testing.assert_allclose(run(sym_d, args_d), base, rtol=1e-4,
                                atol=1e-5)
+
+
+def test_caffe_converter_lowercase_booleans(tmp_path):
+    conv = _load(os.path.join(ROOT, "tools", "caffe_converter",
+                              "convert_symbol.py"), "convert_symbol")
+    parsed = conv.parse_prototxt(conv._quote_enums("""
+convolution_param { num_output: 20 kernel_size: 3 bias_term: false }
+pooling_param { pool: MAX global_pooling: true }
+"""))
+    assert parsed["convolution_param"]["bias_term"] == "false"
+    assert parsed["pooling_param"]["global_pooling"] == "true"
+
+    proto = tmp_path / "nb.prototxt"
+    proto.write_text("""
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 8
+input_dim: 8
+layer {
+  name: "c"
+  type: "Convolution"
+  bottom: "data"
+  top: "c"
+  convolution_param { num_output: 4 kernel_size: 3 bias_term: false }
+}
+layer {
+  name: "gp"
+  type: "Pooling"
+  bottom: "c"
+  top: "gp"
+  pooling_param { pool: AVE global_pooling: true }
+}
+""")
+    sym, _, _ = conv.convert_symbol(str(proto))
+    args = sym.list_arguments()
+    assert "c_bias" not in args          # bias_term: false honored
+    ex = sym.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
+    ex.arg_dict["c_weight"][:] = mx.nd.ones((4, 3, 3, 3))
+    ex.arg_dict["data"][:] = mx.nd.ones((1, 3, 8, 8))
+    out = ex.forward()[0]
+    assert out.shape[2:] == (1, 1)       # global pooling honored

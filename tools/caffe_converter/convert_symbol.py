@@ -86,11 +86,12 @@ def _insert(msg, key, value):
         msg[key] = value
 
 
-_ENUM_FIX = re.compile(r":\s*([A-Z][A-Z_0-9]*)\b")
+_ENUM_FIX = re.compile(r":\s*([A-Za-z_][A-Za-z0-9_]*)\b")
 
 
 def _quote_enums(text):
-    """Bare enum values (pool: MAX) become strings for the parser."""
+    """Bare word values (pool: MAX, bias_term: false) become strings for
+    the parser; the conversion table accepts 'true'/'false' strings."""
     return _ENUM_FIX.sub(lambda m: f': "{m.group(1)}"', text)
 
 
