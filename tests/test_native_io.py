@@ -137,3 +137,26 @@ def test_read_batch_noncontiguous_indices(tmp_path):
     strided = np.arange(10, dtype=np.int64)[::2]  # non-contiguous view
     out = r.read_batch(strided)
     assert out == [payloads[i] for i in (0, 2, 4, 6, 8)]
+
+
+def test_cpp_unit_recordio(tmp_path):
+    """Build + run the standalone C++ unit test (reference tests/cpp tier)."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(root, "tests", "cpp", "recordio_test.cc")
+    lib = os.path.join(root, "mxnet_tpu", "_lib", "libmxtpu_io.so")
+    if not os.path.exists(lib):
+        subprocess.run(["make", "-C", root], check=True,
+                       capture_output=True)
+    exe = str(tmp_path / "recordio_test")
+    res = subprocess.run(
+        ["g++", "-O2", "-std=c++17", src, "-o", exe,
+         "-L", os.path.dirname(lib), "-lmxtpu_io",
+         f"-Wl,-rpath,{os.path.dirname(lib)}"],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    res = subprocess.run([exe], capture_output=True, text=True,
+                         timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "recordio_test OK" in res.stdout
