@@ -14,6 +14,7 @@ from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from . import autograd  # noqa: F401
 from . import random  # noqa: F401
+from . import random as rnd  # noqa: F401
 from . import symbol  # noqa: F401
 from . import symbol as sym  # noqa: F401
 from . import executor  # noqa: F401
@@ -31,6 +32,7 @@ from . import initializer as init  # noqa: F401
 from . import io  # noqa: F401
 from . import recordio  # noqa: F401
 from . import image  # noqa: F401
+from . import image as img  # noqa: F401
 from . import image_det  # noqa: F401
 for _n in image_det.__all__:  # reference exposes det under mx.image.*
     setattr(image, _n, getattr(image_det, _n))
@@ -50,12 +52,17 @@ from . import gluon  # noqa: F401
 from . import rnn  # noqa: F401
 from . import config  # noqa: F401
 from . import monitor  # noqa: F401
+from . import monitor as mon  # noqa: F401
 from . import operator  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import rtc  # noqa: F401
 from . import torch as th  # noqa: F401
+from . import test_utils  # noqa: F401
+from . import contrib  # noqa: F401
+from . import notebook  # noqa: F401
 from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from .io import DataBatch, DataIter  # noqa: F401
 from .base import MXNetError  # noqa: F401

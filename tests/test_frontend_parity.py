@@ -330,3 +330,74 @@ def test_legacy_ndarray_op():
     ex.backward(mx.nd.ones((2, 3)))
     np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
                                2 * np.ones((2, 3)), rtol=1e-6)
+
+
+def test_top_level_aliases():
+    assert mx.viz is mx.visualization
+    assert mx.mon is mx.monitor
+    assert mx.img is mx.image
+    assert mx.rnd is mx.random
+    assert hasattr(mx.test_utils, "assert_almost_equal")
+
+
+def test_contrib_autograd_old_api():
+    x = mx.nd.array(np.array([1., 2., 3.], np.float32))
+
+    def f(a):
+        return mx.nd.sum(a * a)
+
+    grads = mx.contrib.autograd.grad(f)(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), 2 * x.asnumpy())
+    grads, loss = mx.contrib.autograd.grad_and_loss(f)(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), 2 * x.asnumpy())
+    np.testing.assert_allclose(loss.asnumpy(), float((x.asnumpy()**2).sum()),
+                               rtol=1e-6)
+    # train/test section scopes restore state
+    assert not mx.autograd.is_recording()
+    with mx.contrib.autograd.train_section():
+        assert mx.autograd.is_recording()
+        assert mx.autograd.is_training()
+        with mx.contrib.autograd.test_section():
+            assert not mx.autograd.is_training()
+        assert mx.autograd.is_training()
+    assert not mx.autograd.is_recording()
+    # contrib op namespaces re-exported
+    assert hasattr(mx.contrib.nd, "CTCLoss") or hasattr(
+        mx.contrib.nd, "ctc_loss")
+    assert hasattr(mx.contrib.sym, "fft")
+
+
+def test_notebook_pandas_logger():
+    logger = mx.notebook.callback.PandasLogger(frequent=1)
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 6).astype(np.float32)
+    y = (x.sum(1) > 3).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            batch_end_callback=logger.train_cb,
+            epoch_end_callback=logger.epoch_cb)
+    assert len(logger.train_df) > 0
+    assert "accuracy" in logger.train_df.columns
+    assert len(logger.epoch_df) == 3
+    with pytest.raises(ImportError, match="bokeh"):
+        mx.notebook.callback.LiveLearningCurve()
+
+
+def test_contrib_tensorboard_callback(tmp_path):
+    cb = mx.contrib.tensorboard.LogMetricsCallback(str(tmp_path),
+                                                   prefix="train")
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([0., 1.])],
+                  [mx.nd.array(np.array([[0.9, 0.1], [0.2, 0.8]],
+                                        np.float32))])
+
+    class P:
+        eval_metric = metric
+
+    cb(P())
+    assert list(tmp_path.iterdir())  # an event file was written
