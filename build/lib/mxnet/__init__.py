@@ -1,0 +1,43 @@
+"""``import mxnet`` compatibility alias.
+
+Reference user code does ``import mxnet as mx`` / ``from mxnet import
+gluon`` / ``import mxnet.ndarray``; this package makes all of those
+resolve to :mod:`mxnet_tpu`, so unmodified reference-era scripts run
+against the TPU-native rebuild.
+"""
+import importlib as _importlib
+import pkgutil as _pkgutil
+import sys as _sys
+
+import mxnet_tpu as _base
+
+# eagerly import the lazy top-level submodules so `import mxnet.x` works
+# for every module, then alias the full loaded tree as mxnet.*
+for _info in _pkgutil.iter_modules(_base.__path__):
+    if f"mxnet_tpu.{_info.name}" not in _sys.modules:
+        try:
+            _importlib.import_module(f"mxnet_tpu.{_info.name}")
+        except Exception:  # optional/native modules may be ungated here
+            pass
+for _name, _mod in list(_sys.modules.items()):
+    if _name == "mxnet_tpu" or _name.startswith("mxnet_tpu."):
+        _sys.modules.setdefault("mxnet" + _name[len("mxnet_tpu"):], _mod)
+
+_this = _sys.modules[__name__]
+for _attr in dir(_base):
+    if not _attr.startswith("__"):
+        setattr(_this, _attr, getattr(_base, _attr))
+
+__version__ = _base.__version__
+
+
+def __getattr__(name):  # late-imported submodules (PEP 562)
+    import importlib
+    try:
+        mod = importlib.import_module(f"mxnet_tpu.{name}")
+    except ImportError:
+        # PEP 562: unknown attributes must raise AttributeError so
+        # hasattr()/getattr(..., default) feature probes keep working
+        raise AttributeError(f"module 'mxnet' has no attribute {name!r}")
+    _sys.modules[f"mxnet.{name}"] = mod
+    return mod

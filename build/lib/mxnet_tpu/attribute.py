@@ -1,0 +1,8 @@
+"""Attribute scoping (reference: python/mxnet/attribute.py).
+
+``AttrScope`` lives in symbol/symbol.py; this module mirrors the
+reference's import location so ``mx.attribute.AttrScope`` works.
+"""
+from .symbol.symbol import AttrScope
+
+__all__ = ["AttrScope"]

@@ -1,0 +1,352 @@
+"""Imperative autograd: record scopes + tape + backward via per-op jax.vjp.
+
+Reference analogue: src/ndarray/autograd.{h,cc} (AutogradRuntime tape of
+AGNodes, replayed through a GraphExecutor) and python/mxnet/autograd.py
+(record/pause scopes, mark_variables, backward). The rebuild records a DAG of
+op applications with their record-time input values; backward walks the DAG in
+reverse topological order and linearizes each node with ``jax.vjp`` — the
+XLA-era equivalent of the reference building a symbolic executor over the tape
+(autograd.cc:244).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "Function",
+    "AGNode",
+]
+
+_scope = threading.local()
+
+
+def _st():
+    if not hasattr(_scope, "recording"):
+        _scope.recording = False
+        _scope.training = False
+    return _scope
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(is_record: bool) -> bool:
+    st = _st()
+    prev, st.recording = st.recording, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    st = _st()
+    prev, st.training = st.training, train
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording=None, training=None):
+        self._recording = recording
+        self._training = training
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._recording is not None:
+            st.recording = self._recording
+        if self._training is not None:
+            st.training = self._training
+        return self
+
+    def __exit__(self, *args):
+        st = _st()
+        st.recording, st.training = self._prev
+        return False
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — start taping (reference autograd.py:record)."""
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _Scope(training=True)
+
+
+def predict_mode():
+    return _Scope(training=False)
+
+
+class AGNode:
+    """One taped op application (reference: AGNodeEntry, autograd.h)."""
+
+    __slots__ = ("opdef", "attrs", "rng", "inputs", "input_vals", "n_outputs",
+                 "out_arrays")
+
+    def __init__(self, opdef, attrs, rng, inputs, input_vals, n_outputs,
+                 out_arrays):
+        self.opdef = opdef
+        self.attrs = attrs          # parsed attrs (incl. _is_train if any)
+        self.rng = rng              # saved key for needs_rng ops
+        self.inputs = inputs        # list of NDArray (strong refs keep tape alive)
+        self.input_vals = input_vals  # record-time jax values
+        self.n_outputs = n_outputs
+        self.out_arrays = out_arrays  # record-time output jax values
+
+    def run(self, *vals):
+        args = (self.rng,) + vals if self.opdef.needs_rng else vals
+        out = self.opdef.fn(*args, **self.attrs)
+        return out if isinstance(out, tuple) else (out,)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference: MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._mark_variable(g, req)
+
+
+def _toposort(head_nodes: List[AGNode]) -> List[AGNode]:
+    order, seen = [], set()
+    stack = [(n, False) for n in head_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            child = getattr(inp, "_ag_node", None)
+            if child is not None and id(child) not in seen:
+                stack.append((child, False))
+    return order  # children before parents
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables.
+
+    Walks the tape in reverse topological order; each node contributes input
+    cotangents via jax.vjp on its saved input values.
+    """
+    from .ndarray import NDArray  # local import to avoid cycle
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent accumulators: (node id, out idx) -> val ; leaves: id(NDArray)
+    ct: Dict[Tuple[int, int], jax.Array] = {}
+    leaf_ct: Dict[int, jax.Array] = {}
+    leaf_arrays: Dict[int, "NDArray"] = {}
+
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        g = jnp.ones_like(h._data) if hg is None else hg._data
+        node = getattr(h, "_ag_node", None)
+        if node is None:
+            if getattr(h, "_grad_buf", None) is None:
+                raise MXNetError(
+                    "cannot differentiate a head that is neither recorded nor "
+                    "a marked variable"
+                )
+            leaf_ct[id(h)] = leaf_ct.get(id(h), 0) + g
+            leaf_arrays[id(h)] = h
+            continue
+        idx = h._ag_out_index
+        key = (id(node), idx)
+        ct[key] = ct.get(key, 0) + g
+        head_nodes.append(node)
+
+    order = _toposort(head_nodes)
+    for node in reversed(order):
+        out_cts = []
+        any_ct = False
+        for i in range(node.n_outputs):
+            c = ct.pop((id(node), i), None)
+            if c is None:
+                c = jnp.zeros_like(node.out_arrays[i])
+            else:
+                any_ct = True
+            out_cts.append(c)
+        if not any_ct:
+            continue
+
+        if node.opdef.grad_fn is not None:
+            # op supplies its own tape gradient (e.g. Custom: runs the
+            # user's python backward directly, no retracing / host
+            # callbacks — reference FGradient + CustomOp.backward)
+            in_cts = node.opdef.grad_fn(
+                node.attrs, node.rng, node.input_vals, node.out_arrays,
+                tuple(out_cts))
+        else:
+            def fn_closed(*vals, _node=node):
+                return _node.run(*vals)
+
+            _, vjp_fn = jax.vjp(fn_closed, *node.input_vals)
+            in_cts = vjp_fn(tuple(out_cts))
+        for inp, c in zip(node.inputs, in_cts):
+            child = getattr(inp, "_ag_node", None)
+            if child is not None:
+                key = (id(child), inp._ag_out_index)
+                ct[key] = ct.get(key, 0) + c
+            elif getattr(inp, "_grad_buf", None) is not None:
+                leaf_ct[id(inp)] = leaf_ct.get(id(inp), 0) + c
+                leaf_arrays[id(inp)] = inp
+
+    for aid, c in leaf_ct.items():
+        arr = leaf_arrays[aid]
+        buf = arr._grad_buf
+        req = arr._grad_req
+        if req == "null" or buf is None:
+            continue
+        if req == "add":
+            buf._set_data(buf._data + c)
+        else:
+            buf._set_data(jnp.asarray(c, dtype=buf.dtype))
+
+    # tape nodes are garbage-collected once the head NDArrays drop their
+    # _ag_node references; nothing to free eagerly here
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.py:291).
+
+    Defines both forward and backward for a custom computation; during
+    gradient computation the user's ``backward`` replaces the default
+    chain rule.  Example — a numerically stable sigmoid::
+
+        class sigmoid(mx.autograd.Function):
+            def forward(self, x):
+                y = 1 / (1 + mx.nd.exp(-x))
+                self.save_for_backward(y)
+                return y
+            def backward(self, dy):
+                y, = self.saved_tensors
+                return dy * y * (1 - y)
+
+    Taped as a single AGNode whose grad_fn invokes the user's ``backward``
+    (the reference's _CustomFunction / MXCustomFunctionRecord path).
+    """
+
+    def __init__(self):
+        self._used = False
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        """Takes as many inputs as forward's outputs; returns as many
+        NDArrays as forward's arguments."""
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        if self._used:
+            raise MXNetError(
+                "Each Function instance can only be called once. "
+                "Please create another instance.")
+        self._used = True
+
+        prev = set_recording(False)
+        try:
+            outputs = self.forward(*inputs)
+        finally:
+            set_recording(prev)
+        if not prev:
+            return outputs
+
+        single = isinstance(outputs, NDArray)
+        if single:
+            outputs = (outputs,)
+        # fresh result handles: forward may return an input (or any already
+        # taped array) unchanged; tagging that object in place would make
+        # the new node its own child and orphan the original producer
+        outputs = tuple(NDArray(o._data) for o in outputs)
+        ret_outputs = outputs[0] if single else outputs
+        func = self
+        n_in = len(inputs)
+
+        class _FunctionOpDef:
+            name = type(self).__name__
+            needs_rng = False
+            differentiable = True
+            fn = None
+
+            @staticmethod
+            def grad_fn(attrs, rng, input_vals, out_arrays, out_cts):
+                ograds = [NDArray(c) for c in out_cts]
+                rets = func.backward(*ograds)
+                if isinstance(rets, NDArray):
+                    rets = (rets,)
+                if len(rets) != n_in:
+                    raise MXNetError(
+                        f"{type(func).__name__}.backward must return exactly "
+                        f"as many NDArrays as forward's arguments "
+                        f"(expected {n_in}, got {len(rets)})")
+                return tuple(r._data for r in rets)
+
+        node = AGNode(_FunctionOpDef, {}, None, list(inputs),
+                      [x._data for x in inputs], len(outputs),
+                      [o._data for o in outputs])
+        for i, o in enumerate(outputs):
+            o._ag_node = node
+            o._ag_out_index = i
+        return ret_outputs
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient API (later-reference parity; returns new arrays)."""
+    from .ndarray import NDArray, array as _nd_array
+
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(v._grad_buf, v._grad_req) for v in variables]
+    try:
+        from .ndarray import zeros_like as _zl
+        for v in variables:
+            v._mark_variable(_zl(v), "write")
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 train_mode=train_mode)
+        outs = [v.grad.copy() for v in variables]
+    finally:
+        for v, (buf, req) in zip(variables, saved):
+            v._grad_buf, v._grad_req = buf, req
+    return outs[0] if single else outs

@@ -1,0 +1,164 @@
+"""Base utilities: errors, attribute parsing, registries, env config.
+
+TPU-native rebuild of the roles played by dmlc-core in the reference
+(/root/reference/dmlc-core: logging/CHECK macros, dmlc::Parameter config
+structs, registries, dmlc::GetEnv) — reimplemented in Python, with the
+parameter-struct machinery collapsed into declarative attr specs on each
+registered op (see ops/registry.py).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "MXNetError",
+    "getenv",
+    "AttrSpec",
+    "string_types",
+    "numeric_types",
+]
+
+string_types = (str,)
+numeric_types = (float, int)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: python/mxnet/base.py MXNetError)."""
+
+
+def getenv(name: str, default: Any = None, typ: Callable = str) -> Any:
+    """Read a runtime config knob (reference: dmlc::GetEnv; docs/how_to/env_var.md).
+
+    All knobs use the ``MXTPU_`` prefix; the reference's ``MXNET_`` prefix is
+    accepted as a fallback for familiarity.
+    """
+    for prefix_name in (name, name.replace("MXTPU_", "MXNET_")):
+        val = os.environ.get(prefix_name)
+        if val is not None:
+            if typ is bool:
+                return val not in ("0", "false", "False", "")
+            return typ(val)
+    return default
+
+
+def _parse_tuple(s):
+    if isinstance(s, (tuple, list)):
+        return tuple(s)
+    if isinstance(s, (int, float)):
+        return (s,)
+    s = s.strip()
+    if s.startswith("(") or s.startswith("["):
+        return tuple(ast.literal_eval(s.replace("L", "")))
+    return tuple(ast.literal_eval("(" + s + ",)"))
+
+
+def _parse_bool(s):
+    if isinstance(s, bool):
+        return s
+    if isinstance(s, (int, float)):
+        return bool(s)
+    return s.strip() in ("1", "true", "True", "yes")
+
+
+class AttrSpec:
+    """Declarative per-op parameter spec.
+
+    Plays the role of ``dmlc::Parameter<T>`` + ``DMLC_REGISTER_PARAMETER`` in
+    the reference (e.g. FullyConnectedParam at
+    src/operator/fully_connected.cc:74): declared fields with types and
+    defaults, parsed from python values or strings (strings arrive from
+    Symbol JSON round-trips).
+    """
+
+    _REQUIRED = object()
+
+    PARSERS: Dict[str, Callable] = {
+        "int": int,
+        "float": float,
+        "bool": _parse_bool,
+        "str": str,
+        "tuple": _parse_tuple,
+        "any": lambda x: x,
+    }
+
+    def __init__(self, **fields):
+        # fields: name -> (typename, default) or (typename,) for required
+        self.fields = {}
+        for k, v in fields.items():
+            if isinstance(v, tuple) and len(v) == 2:
+                typ, default = v
+            else:
+                typ, default = v[0], AttrSpec._REQUIRED
+            self.fields[k] = (typ, default)
+
+    def parse(self, attrs: Dict[str, Any], op_name: str = "") -> Dict[str, Any]:
+        out = {}
+        for k, (typ, default) in self.fields.items():
+            if k in attrs:
+                raw = attrs[k]
+                if raw is None:
+                    out[k] = None
+                else:
+                    out[k] = self.PARSERS[typ](raw)
+            elif default is AttrSpec._REQUIRED:
+                raise MXNetError(
+                    f"Required parameter {k} of operator {op_name} is missing"
+                )
+            else:
+                out[k] = default
+        unknown = set(attrs) - set(self.fields)
+        if unknown:
+            raise MXNetError(
+                f"Unknown parameters {sorted(unknown)} for operator {op_name}; "
+                f"valid: {sorted(self.fields)}"
+            )
+        return out
+
+    def serialize(self, attrs: Dict[str, Any]) -> Dict[str, str]:
+        """Stringify parsed attrs for Symbol JSON (reference stores all attrs
+        as strings in the graph JSON — src/c_api/c_api_symbolic.cc)."""
+        out = {}
+        for k, v in attrs.items():
+            if v is None:
+                continue
+            out[k] = str(v)
+        return out
+
+
+class Registry:
+    """Generic name->object registry with alias support.
+
+    Reference: dmlc registry pattern (python/mxnet/registry.py:158) used for
+    optimizers, metrics, initializers, io iterators.
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._map: Dict[str, Any] = {}
+
+    def register(self, obj=None, name: Optional[str] = None):
+        def do(o):
+            key = (name or getattr(o, "__name__", None) or str(o)).lower()
+            self._map[key] = o
+            return o
+
+        if obj is None:
+            return do
+        return do(obj)
+
+    def alias(self, name, target):
+        self._map[name.lower()] = self._map[target.lower()]
+
+    def get(self, name: str):
+        key = name.lower()
+        if key not in self._map:
+            raise MXNetError(f"Unknown {self.kind}: {name}. Known: {sorted(self._map)}")
+        return self._map[key]
+
+    def find(self, name: str):
+        return self._map.get(name.lower())
+
+    def keys(self):
+        return list(self._map)

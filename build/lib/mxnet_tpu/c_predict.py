@@ -1,0 +1,152 @@
+"""Python half of the C predict ABI (reference:
+include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc:363).
+
+``libmxtpu_predict.so`` (src/capi/c_predict_api.cc) embeds CPython and
+drives this module: a :class:`Predictor` binds a loaded symbol + params
+once and then serves ``set_input``/``forward``/``get_output`` calls with
+zero-copy ``memoryview`` marshalling at the C boundary. The reference's
+equivalent code path is MXPredCreate → Symbol JSON load + NDArray-file
+parse + SimpleBind (c_predict_api.cc:83-217).
+"""
+from __future__ import annotations
+
+import io as _io
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from . import symbol as _sym_mod
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+
+def _params_from_bytes(param_bytes: bytes):
+    """Parse an in-memory .params (npz container with arg:/aux: keys)."""
+    arg_params, aux_params = {}, {}
+    if not param_bytes:
+        return arg_params, aux_params
+    with np.load(_io.BytesIO(param_bytes)) as f:
+        for k in f.keys():
+            if ":" in k:
+                tp, name = k.split(":", 1)
+            else:
+                tp, name = "arg", k
+            (arg_params if tp == "arg" else aux_params)[name] = f[k]
+    return arg_params, aux_params
+
+
+def load_ndarray_file(nd_bytes: bytes):
+    """MXNDListCreate's loader: returns (keys, arrays) from file bytes."""
+    with np.load(_io.BytesIO(nd_bytes)) as f:
+        keys = list(f.keys())
+        if all(k.isdigit() for k in keys):
+            keys_sorted = sorted(keys, key=int)
+            return [""] * len(keys_sorted), [f[k] for k in keys_sorted]
+        arrays = [f[k] for k in keys]
+        names = [k.split(":", 1)[1] if ":" in k else k for k in keys]
+        return names, arrays
+
+
+def load_ndarray_list_flat(nd_bytes: bytes):
+    """C-boundary variant: [(name, float32 bytes, shape), ...]."""
+    names, arrays = load_ndarray_file(bytes(nd_bytes))
+    out = []
+    for name, arr in zip(names, arrays):
+        a = np.ascontiguousarray(arr, np.float32)
+        out.append((name, a.tobytes(), tuple(int(d) for d in a.shape)))
+    return out
+
+
+class Predictor:
+    """A bound, inference-only executor (reference c_predict_api.cc:83).
+
+    Parameters: symbol JSON string, raw .params bytes, device spec
+    (dev_type 1=cpu, 2=gpu→tpu here), and the input shapes dict.
+    ``output_keys`` selects internal outputs (MXPredCreatePartialOut).
+    """
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 dev_type: int, dev_id: int,
+                 input_shapes: Dict[str, Sequence[int]],
+                 output_keys: Optional[List[str]] = None):
+        if dev_type == 1:
+            # dev_type 1 = cpu (c_predict_api.h); best-effort — the
+            # platform is process-global and fixed after first device use
+            try:
+                import jax
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        sym = _sym_mod.load_json(symbol_json)
+        if output_keys:
+            internals = sym.get_internals()
+            out_names = internals.list_outputs()
+            picked = []
+            for key in output_keys:
+                for cand in (key, key + "_output"):
+                    if cand in out_names:
+                        picked.append(internals[cand])
+                        break
+                else:
+                    raise MXNetError(
+                        f"output {key!r} not found in graph; have "
+                        f"{out_names[:20]}...")
+            sym = _sym_mod.Group(picked)
+        self._symbol = sym
+        arg_params, aux_params = _params_from_bytes(param_bytes)
+
+        self._input_names = list(input_shapes.keys())
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in input_shapes.items()}
+        self._exec = sym.simple_bind(None, grad_req="null", **shapes)
+        for name, arr in self._exec.arg_dict.items():
+            if name in shapes:
+                continue
+            if name in arg_params:
+                arr[:] = np.asarray(arg_params[name], arr.dtype)
+        for name, arr in self._exec.aux_dict.items():
+            if name in aux_params:
+                arr[:] = np.asarray(aux_params[name], arr.dtype)
+        self._outputs: List[np.ndarray] = []
+        # warm the compile cache so the first Forward isn't a surprise
+        self._exec.forward(is_train=False)
+        self._outputs = [np.ascontiguousarray(o.asnumpy(), np.float32)
+                         for o in self._exec.outputs]
+
+    # -- C-boundary methods -------------------------------------------------
+    def num_outputs(self) -> int:
+        return len(self._exec.outputs)
+
+    def output_shape(self, index: int):
+        return tuple(int(d) for d in self._outputs[index].shape)
+
+    def set_input(self, key: str, data: memoryview, shape):
+        if key not in self._exec.arg_dict:
+            raise MXNetError(
+                f"unknown input {key!r}; inputs: {self._input_names}")
+        arr = np.frombuffer(data, dtype=np.float32).reshape(
+            tuple(int(d) for d in shape))
+        self._exec.arg_dict[key][:] = arr
+
+    def set_input_flat(self, key: str, data: memoryview):
+        """MXPredSetInput: flat float32 buffer, shape = the bind shape."""
+        if key not in self._exec.arg_dict:
+            raise MXNetError(
+                f"unknown input {key!r}; inputs: {self._input_names}")
+        shape = self._exec.arg_dict[key].shape
+        self.set_input(key, data, shape)
+
+    def forward(self):
+        self._exec.forward(is_train=False)
+        self._outputs = [np.ascontiguousarray(o.asnumpy(), np.float32)
+                         for o in self._exec.outputs]
+
+    def get_output(self, index: int, out: memoryview):
+        src = self._outputs[index]
+        flat = src.reshape(-1)
+        dst = np.frombuffer(out, dtype=np.float32)
+        if dst.size != flat.size:
+            raise MXNetError(
+                f"output buffer size {dst.size} != output size {flat.size}")
+        np.copyto(dst, flat)

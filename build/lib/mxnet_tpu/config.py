@@ -1,0 +1,78 @@
+"""Runtime environment-variable config registry.
+
+Reference surface: docs/how_to/env_var.md — 28 documented ``MXNET_*`` knobs
+read via ``dmlc::GetEnv`` at point of use. Here every knob is declared in
+one registry with type, default, and doc; readers call ``config.get(name)``
+(or ``base.getenv`` directly for hot paths). ``MXTPU_`` is the canonical
+prefix; a matching ``MXNET_`` spelling is accepted for familiarity
+(base.py getenv).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+from .base import MXNetError, getenv
+
+__all__ = ["register_knob", "get", "describe", "KNOBS"]
+
+
+class Knob(NamedTuple):
+    name: str
+    typ: type
+    default: Any
+    doc: str
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def register_knob(name: str, typ, default, doc: str):
+    KNOBS[name] = Knob(name, typ, default, doc)
+    return KNOBS[name]
+
+
+def get(name: str):
+    """Read a declared knob from the environment (typed, defaulted)."""
+    if name not in KNOBS:
+        raise MXNetError(f"unknown config knob {name}; see config.describe()")
+    k = KNOBS[name]
+    return getenv(k.name, k.default, k.typ)
+
+
+def describe() -> str:
+    """Human-readable table of every knob (env_var.md analogue)."""
+    lines = ["{:<36} {:<8} {:<12} {}".format("name", "type", "default",
+                                             "doc")]
+    for k in sorted(KNOBS.values()):
+        lines.append("{:<36} {:<8} {:<12} {}".format(
+            k.name, k.typ.__name__, repr(k.default), k.doc))
+    return "\n".join(lines)
+
+
+# -- declared knobs ---------------------------------------------------------
+# (reference mapping noted per knob; engine/memory knobs that XLA subsumes
+# are deliberately absent — buffer assignment, bulk exec, workspace sizes)
+
+register_knob("MXTPU_PROFILER_AUTOSTART", int, 0,
+              "start the profiler at import (ref MXNET_PROFILER_AUTOSTART)")
+register_knob("MXTPU_PROFILER_MODE", str, "all",
+              "profiler mode: symbolic|imperative|api|all "
+              "(ref MXNET_PROFILER_MODE)")
+register_knob("MXTPU_NO_NATIVE", int, 0,
+              "disable the native C++ IO library, pure-python fallback")
+register_knob("MXTPU_DEFAULT_DTYPE", str, "float32",
+              "dtype of newly created NDArrays")
+register_knob("MXTPU_COMPUTE_DTYPE", str, "bfloat16",
+              "matmul/conv compute dtype on TPU (bf16 keeps the MXU fed)")
+register_knob("MXTPU_EXEC_EAGER", int, 0,
+              "run symbol executors un-jitted for debugging "
+              "(ref MXNET_ENGINE_TYPE=NaiveEngine)")
+register_knob("MXTPU_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+              "array size above which dist push/pull shards over hosts "
+              "(ref MXNET_KVSTORE_BIGARRAY_BOUND)")
+register_knob("MXTPU_CPU_WORKER_NTHREADS", int, 4,
+              "worker threads for the host IO/augment pipeline "
+              "(ref MXNET_CPU_WORKER_NTHREADS)")
+register_knob("MXTPU_BACKWARD_DO_MIRROR", int, 0,
+              "trade FLOPs for memory via jax.checkpoint rematerialization "
+              "in executor backward (ref MXNET_BACKWARD_DO_MIRROR)")

@@ -1,0 +1,129 @@
+"""Device contexts mapped onto jax devices.
+
+Reference: include/mxnet/base.h:141 ``Context`` (devtype/devid) and
+python/mxnet/context.py (ctx scope :206). In the rebuild a Context names a
+jax.Device; ``tpu`` is the first-class accelerator and ``gpu`` is accepted as
+an alias for it so reference example scripts run unchanged.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+
+_ACCEL_KINDS = ("tpu", "gpu", "cuda", "rocm", "axon")
+
+
+def _jax_devices(device_type: str):
+    devs = jax.devices()
+    if device_type == "cpu":
+        sel = [d for d in devs if d.platform == "cpu"]
+        if not sel:
+            # Accelerator-only runtime: host-staged arrays still live somewhere;
+            # fall back to whatever exists so mx.cpu() code keeps working.
+            sel = devs
+        return sel
+    sel = [d for d in devs if d.platform != "cpu"]
+    return sel
+
+
+class Context:
+    """A device context. ``with Context('tpu', 0):`` sets the default."""
+
+    _default = threading.local()
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "cpu_shared", 5: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 4, "tpu": 5}
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if device_type not in Context.devstr2type:
+            raise MXNetError(f"unknown device type {device_type}")
+        self.device_type = device_type
+        self.device_id = device_id
+        self._old = None
+
+    @property
+    def device_typeid(self) -> int:
+        return Context.devstr2type[self.device_type]
+
+    @property
+    def jax_device(self) -> Optional[jax.Device]:
+        kind = self.device_type
+        if kind in ("gpu", "tpu"):
+            devs = _jax_devices("tpu")
+            if not devs:
+                # No accelerator present (e.g. CPU-only test run): degrade to
+                # cpu devices so ctx lists like [mx.gpu(i) for i in range(8)]
+                # still map onto the virtual-device mesh.
+                devs = _jax_devices("cpu")
+        else:
+            devs = _jax_devices("cpu")
+        if not devs:
+            return None
+        return devs[self.device_id % len(devs)]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    def __enter__(self):
+        self._old = getattr(Context._default, "ctx", None)
+        Context._default.ctx = self
+        return self
+
+    def __exit__(self, *args):
+        Context._default.ctx = self._old
+        return False
+
+    def empty_cache(self):
+        """Reference: Storage pool release (src/storage/); XLA owns HBM here."""
+        return None
+
+    @staticmethod
+    def default_ctx() -> "Context":
+        ctx = getattr(Context._default, "ctx", None)
+        if ctx is not None:
+            return ctx
+        return tpu(0) if num_tpus() > 0 else cpu(0)
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Accelerator context. Alias of tpu for reference-script compatibility."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+def num_gpus() -> int:
+    return len(_jax_devices("tpu"))
+
+
+def num_tpus() -> int:
+    return len(_jax_devices("tpu"))

@@ -1,0 +1,7 @@
+"""Experimental contributions (reference: python/mxnet/contrib/)."""
+from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from . import tensorboard  # noqa: F401

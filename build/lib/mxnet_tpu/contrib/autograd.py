@@ -1,0 +1,96 @@
+"""Experimental autograd API (reference: python/mxnet/contrib/autograd.py
+— the pre-`mx.autograd` interface: train_section/test_section scopes,
+compute_gradient, grad_and_loss/grad decorators)."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..base import MXNetError
+from ..ndarray import NDArray, zeros_like
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Set training+recording mode (the old API fused the two flags)."""
+    prev = _ag.set_recording(bool(is_train))
+    _ag.set_training(bool(is_train))
+    return prev
+
+
+class TrainingStateScope:
+    def __init__(self, enter_state):
+        self._state = enter_state
+        self._prev_rec = None
+        self._prev_train = None
+
+    def __enter__(self):
+        self._prev_rec = _ag.set_recording(self._state)
+        self._prev_train = _ag.set_training(self._state)
+        return self
+
+    def __exit__(self, *args):
+        _ag.set_recording(self._prev_rec)
+        _ag.set_training(self._prev_train)
+        return False
+
+
+def train_section():
+    """``with autograd.train_section():`` — record for training."""
+    return TrainingStateScope(True)
+
+
+def test_section():
+    """Inference scope inside a train_section."""
+    return TrainingStateScope(False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    return _ag.backward(outputs, out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated alias of backward (reference :166)."""
+    return backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient of ``func`` w.r.t its
+    arguments and the loss value (reference :171)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for x in variables:
+            if not isinstance(x, NDArray):
+                raise MXNetError(
+                    "type of autograd input should be NDArray")
+        grads = [zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        backward([outputs] if isinstance(outputs, NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Return a function computing only the gradient (reference :203)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

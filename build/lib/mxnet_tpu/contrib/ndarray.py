@@ -1,0 +1,6 @@
+"""contrib NDArray ops (reference: python/mxnet/contrib/ndarray.py —
+the `_contrib_*` registered op namespace)."""
+from ..ndarray.contrib import *  # noqa: F401,F403
+from ..ndarray import contrib as _c
+
+__all__ = [n for n in dir(_c) if not n.startswith("_")]

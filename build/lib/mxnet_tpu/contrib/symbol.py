@@ -1,0 +1,5 @@
+"""contrib Symbol ops (reference: python/mxnet/contrib/symbol.py)."""
+from ..symbol.contrib import *  # noqa: F401,F403
+from ..symbol import contrib as _c
+
+__all__ = [n for n in dir(_c) if not n.startswith("_")]

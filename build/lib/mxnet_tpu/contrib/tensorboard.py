@@ -1,0 +1,40 @@
+"""TensorBoard logging callback (reference:
+python/mxnet/contrib/tensorboard.py — LogMetricsCallback wrapping a
+summary writer).
+
+Here the writer is torch.utils.tensorboard.SummaryWriter (baked in);
+scalars land under ``<prefix>-<metric>`` exactly like the reference.
+"""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming eval metrics to TensorBoard.
+
+    Usage (as in the reference docstring)::
+
+        cb = mx.contrib.tensorboard.LogMetricsCallback('logs/train')
+        mod.fit(..., batch_end_callback=cb)
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            raise ImportError(
+                "LogMetricsCallback requires a tensorboard SummaryWriter "
+                "(torch.utils.tensorboard or the tensorboardX package)")
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -1,0 +1,483 @@
+"""Executor: a bound, XLA-compiled symbol graph.
+
+Reference analogue: include/mxnet/executor.h + src/executor/graph_executor.cc
+(Bind/SimpleBind/Forward/Backward). The reference compiles a Symbol into a
+memory-planned, device-placed sequence of engine ops (SURVEY.md §3.2); here
+the whole graph is traced once into a jax computation and jit-compiled —
+XLA does gradient construction (vjp), buffer assignment (PlanMemory), fusion
+(bulk exec) and scheduling. Forward and fused forward+backward are separate
+compiled programs; the fused path is what Module uses per training step.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd, random as _random
+from .base import MXNetError, getenv
+from .ndarray import NDArray
+from .ndarray.ndarray import _as_jax
+
+__all__ = ["Executor", "build_graph_eval", "build_placed_graph_eval"]
+
+
+def build_graph_eval(symbol, collect_all=False):
+    """Build eval_fn(arg_vals: dict, aux_vals: dict, rng, is_train)
+    -> (outputs: list, aux_updates: dict). Pure and jax-traceable.
+
+    With ``collect_all`` the outputs list holds every op output in
+    topological order instead of just the symbol's outputs (Monitor)."""
+    nodes = symbol._topo_nodes()
+    aux_ids = symbol._aux_node_ids()
+    # deterministic per-random-node key folding
+    random_nodes = [n for n in nodes
+                    if n.op is not None and n.op.needs_rng]
+    rng_index = {id(n): i for i, n in enumerate(random_nodes)}
+    out_entries = list(symbol._outputs)
+
+    def eval_fn(arg_vals: Dict, aux_vals: Dict, rng, is_train: bool):
+        values = {}
+        aux_updates = {}
+        for node in nodes:
+            if node.is_variable:
+                if id(node) in aux_ids:
+                    values[(id(node), 0)] = aux_vals[node.name]
+                else:
+                    values[(id(node), 0)] = arg_vals[node.name]
+                continue
+            ins = [values[(id(p), i)] for p, i in node.inputs]
+            call_attrs = dict(node.attrs)
+            if node.op.needs_is_train:
+                call_attrs["_is_train"] = is_train
+            if node.op.key_var_num_args and not call_attrs.get(node.op.key_var_num_args):
+                call_attrs[node.op.key_var_num_args] = len(ins)
+            if node.op.needs_rng:
+                key = jax.random.fold_in(rng, rng_index[id(node)])
+                out = node.op.fn(key, *ins, **call_attrs)
+            else:
+                out = node.op.fn(*ins, **call_attrs)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for i, o in enumerate(out):
+                values[(id(node), i)] = o
+            if is_train and node.op.aux_update:
+                for out_idx, in_idx in node.op.aux_update.items():
+                    if in_idx < len(node.inputs):
+                        p, _ = node.inputs[in_idx]
+                        if p.is_variable and id(p) in aux_ids:
+                            aux_updates[p.name] = out[out_idx]
+        if collect_all:
+            outputs = [values[(id(n), i)] for n in nodes
+                       if not n.is_variable for i in range(n.num_outputs())]
+        else:
+            outputs = [values[(id(n), i)] for n, i in out_entries]
+        return outputs, aux_updates
+
+    return eval_fn
+
+
+def build_placed_graph_eval(symbol, group2dev):
+    """Device-placed eval for ctx_group model parallelism.
+
+    Reference analogue: nnvm::pass::PlaceDevice + ``_CrossDeviceCopy``
+    insertion (graph_executor.cc:386-398) driven by ``__ctx_group__``
+    attrs, with the engine overlapping stages. Here: nodes are assigned
+    devices (explicit ``ctx_group`` wins, otherwise inherited from the
+    first placed input), contiguous same-device runs are jit-compiled
+    onto their device, boundary values are ``jax.device_put`` transfers,
+    and jax's async dispatch provides the cross-stage overlap.
+
+    Returns eval_fn with the same signature/contract as
+    :func:`build_graph_eval`; outputs stay on their producing devices.
+    """
+    nodes = symbol._topo_nodes()
+    aux_ids = symbol._aux_node_ids()
+    random_nodes = [n for n in nodes
+                    if n.op is not None and n.op.needs_rng]
+    rng_index = {id(n): i for i, n in enumerate(random_nodes)}
+    out_entries = list(symbol._outputs)
+    default_dev = next(iter(group2dev.values()))
+
+    # -- PlaceDevice: explicit group attr, else inherit from first input --
+    dev_of = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        grp = node.scope_attrs.get("ctx_group")
+        dev = group2dev.get(grp) if grp is not None else None
+        if dev is None:
+            for parent, _ in node.inputs:
+                if id(parent) in dev_of:
+                    dev = dev_of[id(parent)]
+                    break
+        dev_of[id(node)] = dev or default_dev
+    var_dev = {}
+    for node in nodes:
+        if node.is_variable:
+            grp = node.scope_attrs.get("ctx_group")
+            if grp is not None and grp in group2dev:
+                var_dev[id(node)] = group2dev[grp]
+    for node in nodes:
+        if node.is_variable:
+            continue
+        for parent, _ in node.inputs:
+            if parent.is_variable and id(parent) not in var_dev:
+                var_dev[id(parent)] = dev_of[id(node)]
+
+    # -- segment contiguous same-device op runs (bulk-exec analog) --------
+    segments = []  # (device, [nodes])
+    for node in nodes:
+        if node.is_variable:
+            continue
+        dev = dev_of[id(node)]
+        if segments and segments[-1][0] is dev:
+            segments[-1][1].append(node)
+        else:
+            segments.append((dev, [node]))
+
+    def _seg_io(seg_nodes):
+        produced = {(id(n), i) for n in seg_nodes
+                    for i in range(n.num_outputs())}
+        needed = []
+        for n in seg_nodes:
+            for parent, i in n.inputs:
+                key = (id(parent), i)
+                if key not in produced and key not in needed:
+                    needed.append(key)
+        return produced, needed
+
+    seg_meta = []
+    all_later_needs = [set() for _ in segments]
+    # keys each segment must export: used by later segments or final outputs
+    for si, (dev, seg_nodes) in enumerate(segments):
+        produced, needed = _seg_io(seg_nodes)
+        for key in needed:
+            for sj in range(si):
+                if key in seg_meta[sj][0]:
+                    all_later_needs[sj].add(key)
+        seg_meta.append((produced, needed))
+    final_keys = {(id(n), i) for n, i in out_entries}
+    for si, (produced, _) in enumerate(seg_meta):
+        all_later_needs[si] |= (produced & final_keys)
+
+    compiled = []
+    for si, (dev, seg_nodes) in enumerate(segments):
+        produced, needed = seg_meta[si]
+        exports = sorted(all_later_needs[si])
+
+        def seg_fn(is_train, rng, in_vals, _seg_nodes=seg_nodes,
+                   _needed=tuple(needed), _exports=tuple(exports)):
+            values = dict(zip(_needed, in_vals))
+            aux_updates = {}
+            for node in _seg_nodes:
+                ins = [values[(id(p), i)] for p, i in node.inputs]
+                call_attrs = dict(node.attrs)
+                if node.op.needs_is_train:
+                    call_attrs["_is_train"] = is_train
+                if node.op.key_var_num_args and not call_attrs.get(
+                        node.op.key_var_num_args):
+                    call_attrs[node.op.key_var_num_args] = len(ins)
+                if node.op.needs_rng:
+                    key = jax.random.fold_in(rng, rng_index[id(node)])
+                    out = node.op.fn(key, *ins, **call_attrs)
+                else:
+                    out = node.op.fn(*ins, **call_attrs)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                for i, o in enumerate(out):
+                    values[(id(node), i)] = o
+                if is_train and node.op.aux_update:
+                    for out_idx, in_idx in node.op.aux_update.items():
+                        if in_idx < len(node.inputs):
+                            p, _ = node.inputs[in_idx]
+                            if p.is_variable and id(p) in aux_ids:
+                                aux_updates[p.name] = out[out_idx]
+            return [values[k] for k in _exports], aux_updates
+
+        compiled.append((dev, jax.jit(seg_fn, static_argnums=(0,)),
+                         tuple(needed), tuple(exports)))
+
+    def eval_fn(arg_vals: Dict, aux_vals: Dict, rng, is_train: bool):
+        values = {}
+        for node in nodes:
+            if not node.is_variable:
+                continue
+            src = (aux_vals if id(node) in aux_ids else arg_vals)[node.name]
+            dev = var_dev.get(id(node), default_dev)
+            values[(id(node), 0)] = jax.device_put(src, dev)
+        aux_updates = {}
+        for dev, seg_jit, needed, exports in compiled:
+            # _CrossDeviceCopy: move boundary values onto this segment's
+            # device (no-op when already there)
+            in_vals = [jax.device_put(values[k], dev) for k in needed]
+            seg_rng = jax.device_put(rng, dev)
+            outs, aux_up = seg_jit(bool(is_train), seg_rng, in_vals)
+            values.update(zip(exports, outs))
+            aux_updates.update(aux_up)
+        outputs = [values[(id(n), i)] for n, i in out_entries]
+        return outputs, aux_updates
+
+    return eval_fn
+
+
+class Executor:
+    """A bound executor over one symbol (reference: graph_executor.h:57-66)."""
+
+    def __init__(self, symbol, ctx, args: Dict[str, NDArray],
+                 grads: Dict[str, NDArray], grad_req: Dict[str, str],
+                 aux: Dict[str, NDArray], shared_exec: Optional["Executor"] = None,
+                 group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = args
+        self.grad_dict = grads
+        self.aux_dict = aux
+        self._grad_req = grad_req
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self.outputs: List[NDArray] = []
+        self._diff_args = [n for n in self._arg_names
+                          if grad_req.get(n, "null") != "null"]
+        # share compiled programs across executors of the same graph
+        # (reference: shared_exec memory-pool reuse for bucketing,
+        # graph_executor.cc:879-881 — here we share the jit cache instead)
+        placed_devs = {}
+        if group2ctx:
+            for grp, c in group2ctx.items():
+                dev = getattr(c, "jax_device", c)  # Context property or raw Device
+                if callable(dev):
+                    dev = dev()
+                if dev is not None:
+                    placed_devs[grp] = dev
+        if shared_exec is not None and shared_exec._symbol is symbol:
+            self._fwd = shared_exec._fwd
+            self._fwd_bwd = shared_exec._fwd_bwd
+        elif len(set(placed_devs.values())) >= 2:
+            # ctx_group model parallelism: per-group device placement with
+            # internally jitted segments; no outer jit (it would collapse
+            # everything back onto one device)
+            eval_fn = build_placed_graph_eval(symbol, placed_devs)
+
+            def fwd_placed(arg_vals, aux_vals, rng, is_train):
+                return eval_fn(arg_vals, aux_vals, rng, is_train)
+
+            def fwd_bwd_placed(arg_vals, aux_vals, rng, head_grads,
+                               diff_names):
+                diff = {n: arg_vals[n] for n in diff_names}
+
+                def f(diff_args):
+                    merged = dict(arg_vals)
+                    merged.update(diff_args)
+                    return eval_fn(merged, aux_vals, rng, True)
+
+                if getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int):
+                    # same remat knob as the single-device path — most
+                    # relevant here, where the model already didn't fit
+                    f = jax.checkpoint(f)
+                (outs, aux_up), vjp_fn = jax.vjp(f, diff)
+                cts = [hg if hg is not None else jnp.ones_like(o)
+                       for o, hg in zip(outs, head_grads)]
+                zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+                (grads,) = vjp_fn((cts, zero_aux))
+                return outs, aux_up, grads
+
+            self._fwd = fwd_placed
+            self._fwd_bwd = fwd_bwd_placed
+            self._last = None
+            return
+        else:
+            eval_fn = build_graph_eval(symbol)
+
+            def fwd(arg_vals, aux_vals, rng, is_train):
+                outs, aux_up = eval_fn(arg_vals, aux_vals, rng, is_train)
+                return outs, aux_up
+
+            def fwd_bwd(arg_vals, aux_vals, rng, head_grads, diff_names):
+                # diff_names is static: each executor passes its own grad_req
+                # selection even when the compiled program is shared
+                diff = {n: arg_vals[n] for n in diff_names}
+
+                def f(diff_args):
+                    merged = dict(arg_vals)
+                    merged.update(diff_args)
+                    outs, aux_up = eval_fn(merged, aux_vals, rng, True)
+                    return outs, aux_up
+
+                if getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int):
+                    # trade FLOPs for memory: recompute activations in the
+                    # backward pass (reference MXNET_BACKWARD_DO_MIRROR /
+                    # memonger — here XLA rematerialization)
+                    f = jax.checkpoint(f)
+                (outs, aux_up), vjp_fn = jax.vjp(f, diff)
+                cts = [hg if hg is not None else jnp.ones_like(o)
+                       for o, hg in zip(outs, head_grads)]
+                zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+                (grads,) = vjp_fn((cts, zero_aux))
+                return outs, aux_up, grads
+
+            if getenv("MXTPU_EXEC_EAGER", 0, int):
+                # debugging mode: run un-jitted, op by op (reference
+                # MXNET_ENGINE_TYPE=NaiveEngine — engine.cc:31-41)
+                self._fwd = fwd
+                self._fwd_bwd = fwd_bwd
+            else:
+                self._fwd = jax.jit(fwd, static_argnums=(3,))
+                self._fwd_bwd = jax.jit(fwd_bwd, static_argnums=(4,))
+        self._last = None  # (arg_vals, aux_vals, rng) of the last forward
+
+    # -- API ----------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    def forward(self, is_train=False, **kwargs):
+        for name, val in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError(f"unknown argument {name}")
+            self.arg_dict[name]._set_data(
+                _as_jax(val, dtype=self.arg_dict[name].dtype))
+        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
+        rng = _random.next_key()
+        from . import profiler as _profiler
+        with _profiler.profile_scope("Forward", "executor", "symbolic",
+                                     sync=lambda: outs):
+            outs, aux_up = self._fwd(arg_vals, aux_vals, rng, bool(is_train))
+        if is_train:
+            for name, val in aux_up.items():
+                self.aux_dict[name]._set_data(val)
+        self.outputs = [NDArray(o) for o in outs]
+        self._last = (arg_vals, aux_vals, rng, bool(is_train))
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Gradient pass. Recomputes forward inside the compiled vjp program
+        (XLA CSEs shared subexpressions); Module's fused step avoids the
+        double work by calling forward_backward."""
+        if self._last is None:
+            raise MXNetError("backward called before forward")
+        self._run_fwd_bwd(*self._last[:3], out_grads)
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        for name, val in kwargs.items():
+            self.arg_dict[name]._set_data(
+                _as_jax(val, dtype=self.arg_dict[name].dtype))
+        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
+        rng = _random.next_key()
+        self._run_fwd_bwd(arg_vals, aux_vals, rng, out_grads)
+        return self.outputs
+
+    def _run_fwd_bwd(self, arg_vals, aux_vals, rng, out_grads):
+        if out_grads is None:
+            head_grads = [None] * len(self._output_names)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head_grads = [g._data if g is not None else None for g in out_grads]
+        from . import profiler as _profiler
+        with _profiler.profile_scope("ForwardBackward", "executor",
+                                     "symbolic", sync=lambda: grads):
+            outs, aux_up, grads = self._fwd_bwd(arg_vals, aux_vals, rng,
+                                                head_grads,
+                                                tuple(self._diff_args))
+        self._last = (arg_vals, aux_vals, rng, True)
+        self.outputs = [NDArray(o) for o in outs]
+        for name, val in aux_up.items():
+            self.aux_dict[name]._set_data(val)
+        for name in self._diff_args:
+            g = grads[name]
+            buf = self.grad_dict.get(name)
+            if buf is None:
+                continue
+            if self._grad_req.get(name) == "add":
+                buf._set_data(buf._data + g)
+            else:
+                buf._set_data(g)
+
+    def internal_outputs(self):
+        """Evaluate and return {entry_name: NDArray} for EVERY op output in
+        the graph, using the last forward's inputs.
+
+        Reference analogue: MXExecutorSetMonitorCallback firing the monitor
+        per op output (src/c_api/c_api_executor.cc); here the internals are
+        produced by one extra jitted evaluation (XLA shares subexpressions
+        with nothing — it is a debugging path, run on demand by Monitor)."""
+        if self._last is None:
+            raise MXNetError("internal_outputs called before forward")
+        if not hasattr(self, "_internals_fn"):
+            nodes = self._symbol._topo_nodes()
+            names = []
+            for node in nodes:
+                if node.is_variable:
+                    continue
+                for i in range(node.num_outputs()):
+                    if node.num_outputs() == 1:
+                        names.append(f"{node.name}_output")
+                    else:
+                        out_name = (node.op.output_names[i]
+                                    if i < len(node.op.output_names)
+                                    else str(i))
+                        names.append(f"{node.name}_{out_name}")
+            eval_fn = build_graph_eval(self._symbol, collect_all=True)
+            self._internals_fn = jax.jit(eval_fn, static_argnums=(3,))
+            self._internals_names = names
+        arg_vals, aux_vals, rng, is_train = self._last
+        # same rng + same is_train as the real pass: dropout masks and BN
+        # mode match what actually executed
+        vals, _ = self._internals_fn(arg_vals, aux_vals, rng, is_train)
+        return {n: NDArray(v) for n, v in zip(self._internals_names, vals)}
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return an executor for new input shapes. Compilation is cached by
+        XLA per shape signature (reference: GraphExecutor::Reshape)."""
+        from .ndarray import zeros as nd_zeros
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            new_args[name] = (old if tuple(old.shape) == tuple(shape)
+                              else nd_zeros(shape, dtype=str(old.dtype)))
+        new_aux = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = (old if tuple(old.shape) == tuple(shape)
+                             else nd_zeros(shape, dtype=str(old.dtype)))
+        grads = {n: nd_zeros(new_args[n].shape, dtype=str(new_args[n].dtype))
+                 for n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        self._grad_req, new_aux, shared_exec=self)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, val in (arg_params or {}).items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    _as_jax(val, dtype=self.arg_dict[name].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown argument {name}")
+        for name, val in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._set_data(
+                    _as_jax(val, dtype=self.aux_dict[name].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {name}")
+
+    def debug_str(self):
+        return self._symbol.debug_str()

@@ -1,0 +1,244 @@
+"""Legacy executor manager for data parallelism (reference:
+python/mxnet/executor_manager.py — the pre-Module machinery that
+FeedForward uses: workload slicing, per-device executors, metric update).
+
+The rebuild keeps the exact API (``_split_input_slice``,
+``DataParallelExecutorGroup``, ``DataParallelExecutorManager``) but each
+"device executor" is an XLA-compiled Executor; with a single TPU chip the
+group degenerates to one executor, and real multi-chip data parallelism is
+the in-graph `psum` path (parallel/trainer.py). This module exists for
+API-compatibility with reference-era scripts.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base import MXNetError
+from .io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup", "DataParallelExecutorManager",
+           "_split_input_slice", "_check_arguments", "_load_data",
+           "_load_label", "_load_general"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Split ``batch_size`` into per-device slices proportional to the
+    work loads (reference executor_manager.py:31)."""
+    total = sum(work_load_list)
+    batch_num_list = [round(w * batch_size / total) for w in work_load_list]
+    diff = batch_size - sum(batch_num_list)
+    if diff > 0:
+        batch_num_list[-1] += diff
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _check_arguments(symbol):
+    """Reject duplicated argument / aux names (reference :68)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        dup = [n for n in arg_names if arg_names.count(n) > 1]
+        raise ValueError(
+            f'Find duplicated argument name "{dup[0]}", please make the '
+            f"weight name non-duplicated (using name arguments), "
+            f"arguments are {arg_names}")
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        dup = [n for n in aux_names if aux_names.count(n) > 1]
+        raise ValueError(
+            f'Find duplicated auxiliary param name "{dup[0]}"; '
+            f"auxiliary params are {aux_names}")
+
+
+def _load_general(data, targets):
+    """Load a list of arrays into arrays / (slice, array) target lists."""
+    from . import ndarray as nd
+
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, nd.NDArray):
+            d_src.copyto(d_targets)
+        else:
+            if d_targets[-1][0].stop != d_src.shape[0]:
+                raise MXNetError(
+                    f"Batch size mismatch. Expected {d_targets[-1][0].stop},"
+                    f" got {d_src.shape[0]}")
+            for slice_idx, d_dst in d_targets:
+                d_src[slice_idx].copyto(d_dst)
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+class DataParallelExecutorGroup:
+    """A group of executors, one per device, each bound to a batch slice
+    (reference executor_manager.py:204)."""
+
+    def __init__(self, sym, arg_names, param_names, ctx, slices, train_data,
+                 shared_group=None):
+        _check_arguments(sym)
+
+        self.data_names = [x[0] for x in train_data.provide_data]
+        self.label_names = [x[0] for x in train_data.provide_label]
+        self.aux_names = sym.list_auxiliary_states()
+        self.param_idx = [i for i in range(len(arg_names))
+                          if arg_names[i] in param_names]
+        self.param_names = [arg_names[i] for i in self.param_idx]
+
+        grad_req = {}
+        for name in arg_names:
+            grad_req[name] = "write" if name in param_names else "null"
+
+        self.train_execs = []
+        for i, ctxi in enumerate(ctx):
+            data_shapes = {}
+            data_types = {}
+            for x in train_data.provide_data + train_data.provide_label:
+                data_shapes[x[0]] = tuple(
+                    [slices[i].stop - slices[i].start] + list(x[1][1:]))
+                if isinstance(x, DataDesc):
+                    data_types[x.name] = x.dtype
+            shared_exec = (None if shared_group is None
+                           else shared_group.train_execs[i])
+            train_exec = sym.simple_bind(
+                ctxi, grad_req=grad_req, type_dict=data_types,
+                shared_exec=shared_exec, **data_shapes)
+            self.train_execs.append(train_exec)
+
+        self.data_arrays = [
+            [(slices[i], e.arg_dict[name])
+             for i, e in enumerate(self.train_execs)]
+            for name in self.data_names]
+        self.label_arrays = [
+            [(slices[i], e.arg_dict[name])
+             for i, e in enumerate(self.train_execs)]
+            for name in self.label_names]
+
+        self.param_arrays = [[e.arg_arrays[i] for e in self.train_execs]
+                             for i in self.param_idx]
+        self.grad_arrays = [[e.grad_arrays[i] for e in self.train_execs]
+                            for i in self.param_idx]
+        self.aux_arrays = [[e.aux_arrays[i] for e in self.train_execs]
+                           for i in range(len(self.aux_names))]
+
+        self.slices = slices
+
+    def load_data_batch(self, data_batch):
+        _load_data(data_batch, self.data_arrays)
+        _load_label(data_batch, self.label_arrays)
+
+    def forward(self, is_train=False):
+        for texec in self.train_execs:
+            texec.forward(is_train=is_train)
+
+    def backward(self):
+        for texec in self.train_execs:
+            texec.backward()
+
+    def update_metric(self, metric, labels):
+        for texec, islice in zip(self.train_execs, self.slices):
+            labels_slice = [label[islice] for label in labels]
+            metric.update(labels_slice, texec.outputs)
+
+
+class DataParallelExecutorManager:
+    """Manage multiple executors for data parallelism, with optional
+    bucketing via ``sym_gen`` (reference executor_manager.py:295)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names, param_names,
+                 aux_names, work_load_list=None, logger=None, sym_gen=None):
+        if logger is None:
+            logger = logging
+        num_device = len(ctx)
+        logger.info("Start training with %s", str(ctx))
+
+        if work_load_list is None:
+            work_load_list = [1] * num_device
+        if (not isinstance(work_load_list, list)
+                or len(work_load_list) != num_device):
+            raise ValueError("Invalid settings for work load.")
+
+        self.slices = _split_input_slice(train_data.batch_size,
+                                         work_load_list)
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.ctx = ctx
+
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, self.arg_names, self.param_names, self.ctx, self.slices,
+            train_data)
+        self.symbol = symbol
+        self.sym_gen = sym_gen
+        self.curr_execgrp = None
+        if self.sym_gen is not None:
+            self.execgrp_bucket = {
+                train_data.default_bucket_key: self.execgrp}
+
+    def install_monitor(self, monitor):
+        if self.sym_gen is not None:
+            raise NotImplementedError(
+                "Monitoring is not implemented for bucketing")
+        for train_exec in self.execgrp.train_execs:
+            monitor.install(train_exec)
+
+    def set_params(self, arg_params, aux_params):
+        for texec in self.execgrp.train_execs:
+            texec.copy_params_from(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        """Average parameters across executors into the given dicts."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.asnumpy() for w in block) / len(block)
+            arg_params[name][:] = weight.astype(
+                arg_params[name].dtype, copy=False)
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.asnumpy() for w in block) / len(block)
+            aux_params[name][:] = weight.astype(
+                aux_params[name].dtype, copy=False)
+
+    @property
+    def param_arrays(self):
+        return self.execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.execgrp.aux_arrays
+
+    def load_data_batch(self, data_batch):
+        if self.sym_gen is not None:
+            key = data_batch.bucket_key
+            if key not in self.execgrp_bucket:
+                symbol = self.sym_gen(key)
+                execgrp = DataParallelExecutorGroup(
+                    symbol, self.arg_names, self.param_names, self.ctx,
+                    self.slices, data_batch, shared_group=self.execgrp)
+                self.execgrp_bucket[key] = execgrp
+            self.curr_execgrp = self.execgrp_bucket[key]
+        else:
+            self.curr_execgrp = self.execgrp
+        self.curr_execgrp.load_data_batch(data_batch)
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.curr_execgrp.update_metric(metric, labels)

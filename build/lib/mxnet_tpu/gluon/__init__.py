@@ -1,0 +1,15 @@
+"""Gluon: the imperative-first high-level API (reference: python/mxnet/gluon/).
+
+``Block``/``HybridBlock`` define models imperatively; ``hybridize()`` compiles
+a block into one XLA program (the TPU-era CachedOp). ``Trainer`` applies
+optimizers to ``Parameter``s; ``loss`` and ``nn``/``rnn`` supply layers.
+"""
+from . import data  # noqa: F401
+from . import loss  # noqa: F401
+from . import model_zoo  # noqa: F401
+from . import nn  # noqa: F401
+from . import rnn  # noqa: F401
+from . import utils  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import Constant, Parameter, ParameterDict  # noqa: F401
+from .trainer import Trainer  # noqa: F401

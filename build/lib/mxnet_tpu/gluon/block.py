@@ -1,0 +1,454 @@
+"""Gluon Blocks: imperative-first modules with optional XLA compilation.
+
+Reference analogue: python/mxnet/gluon/block.py — ``Block`` (:115),
+``HybridBlock`` (:283, ``hybridize`` :254, ``_build_cache`` :361 building a
+``CachedOp``), ``SymbolBlock`` (:493). The reference's CachedOp skips python
+graph re-construction but still dispatches op-by-op through the engine; here
+``hybridize()`` goes further — the whole block becomes ONE ``jax.jit``-compiled
+XLA program (shape/dtype/mode-keyed cache), which is the TPU-idiomatic
+replacement for both CachedOp and bulk-exec segments
+(src/executor/graph_executor.cc:1320).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import jax
+
+from .. import autograd, ndarray, random as _random
+from .. import symbol as _symbol
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ops.registry import OpDef
+from ..symbol import Symbol
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name manager for automatic prefixes (reference block.py:34)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Create prefix and params for a new Block."""
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _global_count(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            # param names follow the params-dict prefix (which tracks the
+            # SHARED dict when one was passed), and the shared link flows
+            # down so descendants resolve shared weights by name
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        _BlockScope._current.value = self._old_scope
+        return False
+
+
+_GLOBAL_NAME_COUNTS = {}
+
+
+def _global_count(hint):
+    count = _GLOBAL_NAME_COUNTS.get(hint, 0)
+    _GLOBAL_NAME_COUNTS[hint] = count + 1
+    return f"{hint}{count}"
+
+
+def _flatten_nd(args):
+    """Flatten nested lists/tuples of arrays into a flat list + structure."""
+    if isinstance(args, (NDArray, Symbol)):
+        return [args], 0
+    if isinstance(args, (list, tuple)):
+        flat, fmts = [], []
+        for a in args:
+            f, fmt = _flatten_nd(a)
+            flat.extend(f)
+            fmts.append(fmt)
+        return flat, fmts
+    return [args], -1
+
+
+def _regroup_nd(flat, fmt):
+    if fmt == 0 or fmt == -1:
+        return flat[0], flat[1:]
+    out = []
+    for f in fmt:
+        res, flat = _regroup_nd(flat, f)
+        out.append(res)
+    return out, flat
+
+
+class Block:
+    """Base class for all neural-network layers and models
+    (reference gluon/block.py:115)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = []
+        self._reg_params = {}
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)" if self._children else "{name}()"
+        modstr = "\n".join(
+            f"  ({i}): " + repr(c).replace("\n", "\n  ")
+            for i, c in enumerate(self._children))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, "_children") and isinstance(value, Block):
+            old = getattr(self, name, None)
+            if isinstance(old, Block) and old in self._children:
+                # re-assignment replaces the old child in place, otherwise
+                # the orphan's params would linger in collect_params()
+                self._children[self._children.index(old)] = value
+            else:
+                self.register_child(value)
+        elif hasattr(self, "_reg_params") and isinstance(value, Parameter):
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        """``with self.name_scope():`` children get prefixed names."""
+        return self._scope
+
+    def collect_params(self, select=None):
+        """Gather this block's and all descendants' parameters
+        (reference block.py:186); ``select`` is a regex on names."""
+        ret = ParameterDict(self._params.prefix)
+        # both the scoped dict (params.get) and directly-assigned Parameter
+        # attributes (__setattr__ → _reg_params)
+        own = dict(self.params.items())
+        own.update({p.name: p for p in self._reg_params.values()})
+        if select is None:
+            ret.update(own)
+        else:
+            import re
+            pat = re.compile(select)
+            ret.update({k: v for k, v in own.items() if pat.match(k)})
+        for child in self._children:
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True):
+        for child in self._children:
+            child.hybridize(active)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def save_params(self, filename):
+        """reference gluon/block.py:216"""
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing,
+                                   ignore_extra, restore_prefix=self.prefix)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class HybridBlock(Block):
+    """A Block whose forward can be traced and XLA-compiled
+    (reference gluon/block.py:283)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_ops = {}  # (shapes, dtypes, is_train) -> (OpDef, meta)
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise MXNetError(
+                "Children of HybridBlock must also be HybridBlock, but "
+                f"{block} is a {type(block).__name__}. Use Block instead if "
+                "you need non-hybridizable children")
+        super().register_child(block)
+        self._cached_ops = {}
+
+    def hybridize(self, active=True):
+        self._active = active
+        self._cached_ops = {}
+        super().hybridize(active)
+
+    def cast(self, dtype):
+        self._cached_ops = {}
+        super().cast(dtype)
+
+    # -- deferred shape inference ------------------------------------------
+    def infer_shape(self, *args):
+        """Fix deferred parameter shapes by running symbolic shape inference
+        over the traced graph (the jax-era analogue of reference
+        block.py _deferred_infer_shape)."""
+        flat_args, fmt = _flatten_nd(args)
+        inputs = [_symbol.Variable(f"data{i}" if i else "data")
+                  for i in range(len(flat_args))]
+        params = {name: p.var() for name, p in self._reg_params.items()}
+        regrouped, _ = _regroup_nd(list(inputs), fmt)  # fmt is the top-level
+        with self.name_scope():                        # args-tuple structure
+            out = self.hybrid_forward(_symbol, *regrouped, **params)
+        flat_out, _ = _flatten_nd(out)
+        grouped = _symbol.Group(flat_out) if len(flat_out) > 1 else flat_out[0]
+        shape_kwargs = {}
+        for s, a in zip(inputs, flat_args):
+            if isinstance(a, NDArray):
+                shape_kwargs[s.name] = a.shape
+        arg_shapes, _, aux_shapes = grouped.infer_shape(**shape_kwargs)
+        shapes = dict(zip(grouped.list_arguments(), arg_shapes))
+        shapes.update(zip(grouped.list_auxiliary_states(), aux_shapes))
+        for _, param in self.collect_params().items():
+            if param.name in shapes:
+                param.shape = tuple(shapes[param.name])
+                param._finish_deferred_init()
+
+    # -- compiled path ------------------------------------------------------
+    def _all_params(self):
+        """Ordered (name, Parameter) pairs of this block and descendants'
+        registered params, as consumed by the traced function."""
+        seen = OrderedDict()
+
+        def visit(b):
+            for n, p in b._reg_params.items():
+                seen.setdefault(p.name, p)
+            for c in b._children:
+                visit(c)
+
+        visit(self)
+        return list(seen.items())
+
+    def _build_cached_op(self, flat_args, is_train):
+        params = self._all_params()
+        param_data = [p.data() for _, p in params]
+        n_in = len(flat_args)
+        fmt = _flatten_nd(tuple(flat_args))[1]
+        outer = self
+
+        out_meta = {}
+
+        def fn(rng, *vals):
+            in_vals = vals[:n_in]
+            p_vals = vals[n_in:]
+            wrappers = [NDArray(v) for v in in_vals]
+            p_wrap = [NDArray(v) for v in p_vals]
+            by_block = {name: w for (name, _), w in zip(params, p_wrap)}
+            old_rec = autograd.set_recording(False)
+            old_train = autograd.set_training(is_train)
+            old_key = _random.swap_key(rng)
+            try:
+                args, _ = _regroup_nd(wrappers, fmt)
+                out = outer._hybrid_call(
+                    args if isinstance(args, list) else [args], by_block)
+            finally:
+                _random.swap_key(old_key)
+                autograd.set_training(old_train)
+                autograd.set_recording(old_rec)
+            flat_out, out_fmt = _flatten_nd(out)
+            out_meta["fmt"] = out_fmt
+            out_meta["n_visible"] = len(flat_out)
+            results = [o._data for o in flat_out]
+            # aux states written in-place during the trace (BatchNorm moving
+            # stats) become extra outputs, written back by aux_update
+            aux_updates = {}
+            for j, ((name, _), w, v0) in enumerate(zip(params, p_wrap,
+                                                       p_vals)):
+                if w._data is not v0:
+                    aux_updates[len(results)] = n_in + j
+                    results.append(w._data)
+            out_meta["aux_update"] = aux_updates
+            return tuple(results)
+
+        # trace once eagerly (cheap — abstract eval) to learn output count
+        jax.eval_shape(fn, jax.random.PRNGKey(0),
+                       *[a._data for a in flat_args],
+                       *[p._data for p in param_data])
+        opdef = OpDef(
+            name=f"_cached_{self.name}",
+            fn=jax.jit(fn),
+            num_inputs=n_in + len(params),
+            num_outputs=out_meta["n_visible"],
+            needs_rng=True,
+            aux_update=out_meta["aux_update"],
+        )
+        return opdef, out_meta["fmt"]
+
+    def _call_cached_op(self, *args):
+        flat_args, _ = _flatten_nd(args)
+        is_train = autograd.is_training()
+        key = (tuple((a.shape, str(a.dtype)) for a in flat_args), is_train)
+        entry = self._cached_ops.get(key)
+        if entry is None:
+            entry = self._build_cached_op(flat_args, is_train)
+            self._cached_ops[key] = entry
+        opdef, out_fmt = entry
+        param_data = [p.data() for _, p in self._all_params()]
+        outs = ndarray.imperative_invoke(
+            opdef, list(flat_args) + param_data, {})
+        out, _ = _regroup_nd(list(outs), out_fmt)
+        return out
+
+    def _hybrid_call(self, args, param_wrappers):
+        """Run hybrid_forward with this block's params taken from
+        ``param_wrappers`` (name -> NDArray), recursing via children's own
+        forward()."""
+        token = _ParamOverride.push(param_wrappers)
+        try:
+            return self.hybrid_forward(ndarray, *args, **{
+                n: param_wrappers[p.name]
+                for n, p in self._reg_params.items()})
+        finally:
+            _ParamOverride.pop(token)
+
+    def forward(self, x, *args):
+        """Dispatch: Symbol input → symbolic compose; hybridized → cached
+        XLA program; otherwise imperative op-by-op."""
+        if isinstance(x, Symbol):
+            params = {name: p.var()
+                      for name, p in self._reg_params.items()}
+            with self.name_scope():
+                return self.hybrid_forward(_symbol, x, *args, **params)
+        override = _ParamOverride.current()
+        try:
+            if override is not None:
+                kwargs = {n: override[p.name]
+                          for n, p in self._reg_params.items()}
+                return self.hybrid_forward(ndarray, x, *args, **kwargs)
+            if self._active:
+                return self._call_cached_op(x, *args)
+            kwargs = {n: p.data() for n, p in self._reg_params.items()}
+            return self.hybrid_forward(ndarray, x, *args, **kwargs)
+        except DeferredInitializationError:
+            self.infer_shape(x, *args)  # finalizes every inferable param
+            for name, p in self.collect_params().items():
+                if p._deferred_init is not None:
+                    raise MXNetError(
+                        f"shape of Parameter {name} could not be inferred "
+                        f"from the inputs (still {p.shape}); pass explicit "
+                        "in_units/in_channels or a complete shape")
+            return self.forward(x, *args)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class _ParamOverride:
+    """Thread-local stack mapping param name → traced value during a
+    CachedOp trace, so nested children resolve their params from the trace
+    inputs rather than concrete data."""
+
+    _tls = threading.local()
+
+    @classmethod
+    def push(cls, mapping):
+        stack = getattr(cls._tls, "stack", None)
+        if stack is None:
+            stack = cls._tls.stack = []
+        stack.append(mapping)
+        return len(stack)
+
+    @classmethod
+    def pop(cls, token):
+        cls._tls.stack.pop()
+
+    @classmethod
+    def current(cls):
+        stack = getattr(cls._tls, "stack", None)
+        return stack[-1] if stack else None
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol graph as a callable Block (reference block.py:493)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        if isinstance(inputs, Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)):
+            outputs = _symbol.Group(list(outputs))
+        self._in_names = [i.name for i in inputs]
+        self._out_sym = outputs
+        arg_names = set(outputs.list_arguments())
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in sorted(arg_names | aux_names):
+            if name not in self._in_names:
+                self.params.get(name, shape=None, allow_deferred_init=True,
+                                grad_req="null" if name in aux_names
+                                else "write")
+
+    def forward(self, x, *args):
+        if isinstance(x, Symbol):
+            return self._out_sym
+        inputs = dict(zip(self._in_names, (x,) + args))
+        from ..executor import build_graph_eval
+        eval_fn = build_graph_eval(self._out_sym)
+        merged = {name: p.data()._data
+                  for name, p in self.collect_params().items()}
+        merged.update({k: v._data for k, v in inputs.items()})
+        outs, _ = eval_fn(merged, {}, _random.next_key(),
+                          autograd.is_training())
+        outs = [NDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,8 @@
+"""Gluon data API (reference: python/mxnet/gluon/data/)."""
+from . import dataset  # noqa: F401
+from . import sampler  # noqa: F401
+from . import dataloader  # noqa: F401
+from . import vision  # noqa: F401
+from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset  # noqa: F401
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler  # noqa: F401
+from .dataloader import DataLoader  # noqa: F401

@@ -1,0 +1,125 @@
+"""Datasets (reference: python/mxnet/gluon/data/dataset.py:25-90 —
+Dataset, ArrayDataset, RecordFileDataset)."""
+from __future__ import annotations
+
+import os
+
+from ...ndarray import NDArray
+
+__all__ = ["Dataset", "ArrayDataset", "RecordFileDataset", "SimpleDataset"]
+
+
+class Dataset:
+    """Abstract dataset: __getitem__ + __len__."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def transform(self, fn, lazy=True):
+        return SimpleDataset(self, fn)
+
+    def transform_first(self, fn, lazy=True):
+        return self.transform(_TransformFirst(fn), lazy)
+
+
+class _TransformFirst:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *args):
+        if args:
+            return (self._fn(x),) + args
+        return self._fn(x)
+
+
+class SimpleDataset(Dataset):
+    """Wrap any indexable, with an optional per-item transform."""
+
+    def __init__(self, data, transform=None):
+        self._data = data
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if self._transform is None:
+            return item
+        if isinstance(item, tuple):
+            return self._transform(*item)
+        return self._transform(item)
+
+    def transform(self, fn, lazy=True):
+        return SimpleDataset(self, fn)
+
+
+class ArrayDataset(Dataset):
+    """Zip of equal-length arrays (reference: dataset.py ArrayDataset:40)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for i, data in enumerate(args):
+            assert len(data) == self._length, \
+                f"All arrays must have the same length; arg {i} differs"
+            if isinstance(data, NDArray) and data.ndim == 1:
+                data = data.asnumpy()
+            self._data.append(data)
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+    def __len__(self):
+        return self._length
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over an indexed RecordIO file (reference: dataset.py
+    RecordFileDataset:67).
+
+    Prefers the native reader (src/io/recordio.cc via _native.py):
+    GIL-free pread, safe under DataLoader worker threads. Falls back to
+    the pure-python MXIndexedRecordIO."""
+
+    def __init__(self, filename):
+        from ... import recordio
+        self.filename = filename
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+        # native fast path: map each .idx entry's byte offset to its scan
+        # position, so subset/reordered index files keep their meaning
+        self._native = None
+        self._native_pos = None
+        try:
+            from ..._native import NativeRecordReader, NativeUnavailableError
+            try:
+                native = NativeRecordReader(filename)
+            except NativeUnavailableError:
+                native = None
+        except ImportError:
+            native = None
+        if native is not None:
+            off2pos = native.offsets()
+            try:
+                self._native_pos = [off2pos[self._record.idx[k]]
+                                    for k in self._record.keys]
+                self._native = native
+            except KeyError:
+                # .idx references offsets not present in the scan —
+                # corrupt index; let the python path surface the error
+                native.close()
+
+    def __getitem__(self, idx):
+        if self._native is not None:
+            return self._native.read(self._native_pos[idx])
+        return self._record.read_idx(self._record.keys[idx])
+
+    def __len__(self):
+        return len(self._record.keys)

@@ -1,0 +1,188 @@
+"""Vision datasets (reference: python/mxnet/gluon/data/vision.py — MNIST:59,
+FashionMNIST:112, CIFAR10:144, ImageRecordDataset:202,
+ImageFolderDataset:233).
+
+This environment has no network egress: datasets read from ``root`` if the
+files are already present and raise a clear error otherwise (the
+reference's auto-download is deliberately gated off)."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import warnings
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import array as nd_array
+from . import dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "ImageRecordDataset",
+           "ImageFolderDataset"]
+
+
+class _DownloadedDataset(dataset.Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _require(self, *fnames):
+        paths = [os.path.join(self._root, f) for f in fnames]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            raise MXNetError(
+                f"{type(self).__name__}: dataset files not found: {missing}. "
+                "This build has no network egress — place the files under "
+                f"{self._root} manually.")
+        return paths
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx-format files (reference: vision.py MNIST:59)."""
+
+    _train_files = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+    _test_files = ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        # accept both gzipped and unpacked idx files
+        avail = []
+        for f in files:
+            p = os.path.join(self._root, f)
+            if not os.path.exists(p) and os.path.exists(p[:-3]):
+                f = f[:-3]
+            avail.append(f)
+        data_path, label_path = self._require(*avail)
+        with self._open(label_path) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+        with self._open(data_path) as fin:
+            _, num, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._data = nd_array(data.astype(np.float32) / 255.0)
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    """Same idx format, different files (reference: vision.py:112)."""
+
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle batches (reference: vision.py:144)."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            batch = pickle.load(fin, encoding="latin1")
+        data = batch["data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data, np.asarray(batch["labels"], np.int32)
+
+    def _get_data(self):
+        base = os.path.join(self._root, "cifar-10-batches-py")
+        if self._train:
+            names = [os.path.join(base, f"data_batch_{i}")
+                     for i in range(1, 6)]
+        else:
+            names = [os.path.join(base, "test_batch")]
+        missing = [p for p in names if not os.path.exists(p)]
+        if missing:
+            raise MXNetError(
+                f"CIFAR10: dataset files not found: {missing}. This build "
+                "has no network egress — unpack cifar-10-python.tar.gz "
+                f"under {self._root} manually.")
+        data, label = zip(*(self._read_batch(n) for n in names))
+        self._data = nd_array(
+            np.concatenate(data).astype(np.float32) / 255.0)
+        self._label = np.concatenate(label)
+
+
+class ImageRecordDataset(dataset.RecordFileDataset):
+    """Images + labels from a .rec file (reference: vision.py:202)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ... import image, recordio
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        label = header.label
+        img = image.imdecode(img, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(dataset.Dataset):
+    """root/category/image.jpg layout (reference: vision.py:233)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = (".jpg", ".jpeg", ".png")
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                warnings.warn(f"Ignoring {path}: not a directory")
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() not in self._exts:
+                    warnings.warn(
+                        f"Ignoring {filename}: unsupported extension")
+                    continue
+                self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ... import image
+        img = image.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,76 @@
+"""Pretrained-weight cache (reference: gluon/model_zoo/model_store.py).
+
+The reference downloads sha1-pinned param files into ``~/.mxnet/models``.
+This environment has no network egress, so ``get_model_file`` serves only
+from the local cache (or a directory named in ``MXTPU_MODEL_ZOO_DIR``) and
+raises with instructions otherwise; the cache/verify logic itself is fully
+functional so pre-seeded weights work.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+# name -> sha1 of the param file; populated as released models are added.
+# (the reference pins hashes the same way, model_store.py:_model_sha1)
+_model_sha1: dict = {}
+
+
+def short_hash(name):
+    if name not in _model_sha1:
+        raise ValueError(f"Pretrained model for {name} is not available.")
+    return _model_sha1[name][:8]
+
+
+def _default_root():
+    return os.environ.get(
+        "MXTPU_MODEL_ZOO_DIR",
+        os.path.join(os.path.expanduser("~"), ".mxnet", "models"))
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def get_model_file(name, root=None):
+    """Return the path of a cached pretrained param file.
+
+    Looks for ``<root>/<name>-<hash8>.params`` (reference naming) or a
+    plain ``<root>/<name>.params``; never downloads (no egress here).
+    """
+    root = os.path.expanduser(root or _default_root())
+    if name in _model_sha1:
+        file_name = f"{name}-{short_hash(name)}.params"
+        file_path = os.path.join(root, file_name)
+        if os.path.exists(file_path):
+            if check_sha1(file_path, _model_sha1[name]):
+                return file_path
+            raise ValueError(
+                f"cached file {file_path} has a mismatched sha1; delete it "
+                "and re-seed the cache")
+    plain = os.path.join(root, f"{name}.params")
+    if os.path.exists(plain):
+        return plain
+    raise FileNotFoundError(
+        f"No cached weights for {name!r} under {root}. This environment "
+        "has no network egress: seed the cache by copying a .params file "
+        f"to {plain} (or set MXTPU_MODEL_ZOO_DIR).")
+
+
+def purge(root=None):
+    """Remove all cached model files (reference model_store.py purge)."""
+    root = os.path.expanduser(root or _default_root())
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

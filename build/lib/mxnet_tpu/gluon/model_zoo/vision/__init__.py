@@ -1,0 +1,48 @@
+"""Vision model zoo: classic convnet families as HybridBlocks.
+
+Reference surface: python/mxnet/gluon/model_zoo/vision/ — alexnet,
+densenet(121/161/169/201), inception_v3, resnet v1+v2 (18/34/50/101/152),
+squeezenet(1.0/1.1), vgg(11/13/16/19, ±bn) and the ``get_model`` name
+registry. Architectures are the standard public ones, built fresh on this
+framework's gluon API; ``pretrained=`` weight download is gated off (no
+network egress) — load weights explicitly via ``load_params``.
+"""
+from ....base import MXNetError
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .densenet import (DenseNet, densenet121, densenet161,  # noqa: F401
+                       densenet169, densenet201)
+from .inception import Inception3, inception_v3  # noqa: F401
+from .resnet import (ResNetV1, ResNetV2, get_resnet,  # noqa: F401
+                     resnet18_v1, resnet18_v2, resnet34_v1, resnet34_v2,
+                     resnet50_v1, resnet50_v2, resnet101_v1, resnet101_v2,
+                     resnet152_v1, resnet152_v2)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .vgg import (VGG, vgg11, vgg11_bn, vgg13, vgg13_bn,  # noqa: F401
+                  vgg16, vgg16_bn, vgg19, vgg19_bn)
+
+_models = {
+    "alexnet": alexnet,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+    "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+    "resnet152_v1": resnet152_v1,
+    "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,
+    "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,
+    "resnet152_v2": resnet152_v2,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+    "vgg19_bn": vgg19_bn,
+}
+
+
+def get_model(name, **kwargs):
+    """Build a model by registry name (reference vision/__init__.py)."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} is not in the zoo; available: "
+            f"{sorted(_models)}")
+    return _models[name](**kwargs)  # factories gate pretrained= themselves

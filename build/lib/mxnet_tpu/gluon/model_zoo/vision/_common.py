@@ -1,0 +1,36 @@
+"""Shared zoo-factory helpers."""
+from ....base import MXNetError
+from ...block import HybridBlock
+
+
+def check_pretrained(pretrained):
+    """Legacy gate kept for compatibility; see load_pretrained."""
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network "
+                         "egress); use net.load_params(path)")
+
+
+def load_pretrained(net, name, pretrained):
+    """Load cached pretrained weights into ``net`` when requested.
+
+    Reference: each factory calls model_store.get_model_file then
+    load_params (gluon/model_zoo/vision/resnet.py et al.). No egress here:
+    get_model_file serves only from the local cache and raises with
+    seeding instructions when the file is absent.
+    """
+    if not pretrained:
+        return net
+    from ..model_store import get_model_file
+    net.load_params(get_model_file(name))
+    return net
+
+
+class Concurrent(HybridBlock):
+    """Run child branches on the same input, concat along channels
+    (inception mixed blocks, fire expand, split 1x3/3x1 limbs)."""
+
+    def add(self, block):
+        self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[b(x) for b in self._children], dim=1)

@@ -1,0 +1,43 @@
+"""AlexNet (reference: gluon/model_zoo/vision/alexnet.py; arch from
+Krizhevsky et al. 2012, the one-column variant)."""
+from ... import nn
+from ...block import HybridBlock
+from ._common import load_pretrained
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            with self.features.name_scope():
+                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
+                                            padding=2, activation="relu"))
+                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
+                                            activation="relu"))
+                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
+                                            activation="relu"))
+                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
+                                            activation="relu"))
+                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
+                                            activation="relu"))
+                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                self.features.add(nn.Flatten())
+            self.classifier = nn.HybridSequential(prefix="")
+            with self.classifier.name_scope():
+                self.classifier.add(nn.Dense(4096, activation="relu"))
+                self.classifier.add(nn.Dropout(0.5))
+                self.classifier.add(nn.Dense(4096, activation="relu"))
+                self.classifier.add(nn.Dropout(0.5))
+                self.classifier.add(nn.Dense(classes))
+
+    def hybrid_forward(self, F, x):
+        return self.classifier(self.features(x))
+
+
+def alexnet(pretrained=False, **kwargs):
+    return load_pretrained(AlexNet(**kwargs), "alexnet", pretrained)

@@ -1,0 +1,153 @@
+"""Inception v3 (reference: gluon/model_zoo/vision/inception.py;
+arch from Szegedy et al. 2015, 299x299 input)."""
+from ... import nn
+from ...block import HybridBlock
+from ._common import Concurrent as _Concurrent, load_pretrained
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv2d(channels, kernel_size, strides=1, padding=0):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(channels, kernel_size=kernel_size, strides=strides,
+                      padding=padding, use_bias=False))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _make_A(pool_features, prefix):
+    out = _Concurrent(prefix=prefix)
+    with out.name_scope():
+        out.add(_conv2d(64, 1))
+        b5 = nn.HybridSequential(prefix="")
+        b5.add(_conv2d(48, 1))
+        b5.add(_conv2d(64, 5, padding=2))
+        out.add(b5)
+        b3 = nn.HybridSequential(prefix="")
+        b3.add(_conv2d(64, 1))
+        b3.add(_conv2d(96, 3, padding=1))
+        b3.add(_conv2d(96, 3, padding=1))
+        out.add(b3)
+        bp = nn.HybridSequential(prefix="")
+        bp.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        bp.add(_conv2d(pool_features, 1))
+        out.add(bp)
+    return out
+
+
+def _make_B(prefix):
+    out = _Concurrent(prefix=prefix)
+    with out.name_scope():
+        out.add(_conv2d(384, 3, strides=2))
+        b3 = nn.HybridSequential(prefix="")
+        b3.add(_conv2d(64, 1))
+        b3.add(_conv2d(96, 3, padding=1))
+        b3.add(_conv2d(96, 3, strides=2))
+        out.add(b3)
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    return out
+
+
+def _make_C(channels_7x7, prefix):
+    out = _Concurrent(prefix=prefix)
+    with out.name_scope():
+        out.add(_conv2d(192, 1))
+        b7 = nn.HybridSequential(prefix="")
+        b7.add(_conv2d(channels_7x7, 1))
+        b7.add(_conv2d(channels_7x7, (1, 7), padding=(0, 3)))
+        b7.add(_conv2d(192, (7, 1), padding=(3, 0)))
+        out.add(b7)
+        b77 = nn.HybridSequential(prefix="")
+        b77.add(_conv2d(channels_7x7, 1))
+        b77.add(_conv2d(channels_7x7, (7, 1), padding=(3, 0)))
+        b77.add(_conv2d(channels_7x7, (1, 7), padding=(0, 3)))
+        b77.add(_conv2d(channels_7x7, (7, 1), padding=(3, 0)))
+        b77.add(_conv2d(192, (1, 7), padding=(0, 3)))
+        out.add(b77)
+        bp = nn.HybridSequential(prefix="")
+        bp.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        bp.add(_conv2d(192, 1))
+        out.add(bp)
+    return out
+
+
+def _make_D(prefix):
+    out = _Concurrent(prefix=prefix)
+    with out.name_scope():
+        b3 = nn.HybridSequential(prefix="")
+        b3.add(_conv2d(192, 1))
+        b3.add(_conv2d(320, 3, strides=2))
+        out.add(b3)
+        b7 = nn.HybridSequential(prefix="")
+        b7.add(_conv2d(192, 1))
+        b7.add(_conv2d(192, (1, 7), padding=(0, 3)))
+        b7.add(_conv2d(192, (7, 1), padding=(3, 0)))
+        b7.add(_conv2d(192, 3, strides=2))
+        out.add(b7)
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    return out
+
+
+def _split_concat(channels):
+    """1x3 / 3x1 split branches concatenated (inception E block limb)."""
+    out = _Concurrent(prefix="")
+    out.add(_conv2d(channels, (1, 3), padding=(0, 1)))
+    out.add(_conv2d(channels, (3, 1), padding=(1, 0)))
+    return out
+
+
+def _make_E(prefix):
+    out = _Concurrent(prefix=prefix)
+    with out.name_scope():
+        out.add(_conv2d(320, 1))
+        b3 = nn.HybridSequential(prefix="")
+        b3.add(_conv2d(384, 1))
+        b3.add(_split_concat(384))
+        out.add(b3)
+        b33 = nn.HybridSequential(prefix="")
+        b33.add(_conv2d(448, 1))
+        b33.add(_conv2d(384, 3, padding=1))
+        b33.add(_split_concat(384))
+        out.add(b33)
+        bp = nn.HybridSequential(prefix="")
+        bp.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        bp.add(_conv2d(192, 1))
+        out.add(bp)
+    return out
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(_conv2d(32, 3, strides=2))
+            self.features.add(_conv2d(32, 3))
+            self.features.add(_conv2d(64, 3, padding=1))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+            self.features.add(_conv2d(80, 1))
+            self.features.add(_conv2d(192, 3))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+            self.features.add(_make_A(32, "A1_"))
+            self.features.add(_make_A(64, "A2_"))
+            self.features.add(_make_A(64, "A3_"))
+            self.features.add(_make_B("B_"))
+            self.features.add(_make_C(128, "C1_"))
+            self.features.add(_make_C(160, "C2_"))
+            self.features.add(_make_C(160, "C3_"))
+            self.features.add(_make_C(192, "C4_"))
+            self.features.add(_make_D("D_"))
+            self.features.add(_make_E("E1_"))
+            self.features.add(_make_E("E2_"))
+            self.features.add(nn.AvgPool2D(pool_size=8))
+            self.features.add(nn.Dropout(0.5))
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return load_pretrained(Inception3(**kwargs), "inceptionv3", pretrained)

@@ -1,0 +1,123 @@
+"""Gluon Trainer: applies an Optimizer to a set of Parameters.
+
+Reference analogue: python/mxnet/gluon/trainer.py (:26 — ``_init_kvstore``
+:95 picks update_on_kvstore, ``step`` :116 pushes grads and pulls weights).
+On TPU the kvstore push/pull collapses into (optionally mesh-wide psum-ed)
+in-place optimizer updates on the single logical copy of each parameter;
+``kvstore='dist_sync'`` flavors mean-reduce gradients across the data-parallel
+axis before updating.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}")
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}")
+            self._params.append(param)
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        # last-seen grad-buffer versions, for stale-grad detection
+        self._grad_versions = [None] * len(self._params)
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be empty if optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer,
+                                         param_idx2name={
+                                             i: p.name for i, p in
+                                             enumerate(self._params)},
+                                         **optimizer_params)
+        self._states = [None] * len(self._params)
+        self._states_created = [False] * len(self._params)
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimizer update using each parameter's current grad
+        (reference trainer.py:step). A parameter whose grad buffer has not
+        been rewritten since the previous step is stale; as in the reference
+        this raises unless ``ignore_stale_grad``."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            grad = param.grad()
+            if not ignore_stale_grad:
+                if self._grad_versions[i] == grad.version:
+                    raise MXNetError(
+                        f"Gradient of Parameter `{param.name}` has not been "
+                        "updated by backward since last `step`. This could "
+                        "mean a bug in your model that made it only use a "
+                        "subset of the Parameters for this iteration. If you "
+                        "are intentionally only using a subset, call step "
+                        "with ignore_stale_grad=True")
+                self._grad_versions[i] = grad.version
+            if not self._states_created[i]:
+                self._states[i] = self._optimizer.create_state(
+                    i, param.data())
+                self._states_created[i] = True
+            self._optimizer.update(i, param.data(), grad, self._states[i])
+
+    def save_states(self, fname):
+        """Serialize optimizer states (reference trainer.py:save_states)."""
+        import pickle
+        with open(fname, "wb") as f:
+            states = [
+                None if s is None else
+                (s.asnumpy() if hasattr(s, "asnumpy") else
+                 [x.asnumpy() if hasattr(x, "asnumpy") else x for x in s]
+                 if isinstance(s, (list, tuple)) else s)
+                for s in self._states]
+            pickle.dump({"states": states,
+                         "optimizer": self._optimizer.__class__.__name__},
+                        f)
+
+    def load_states(self, fname):
+        import pickle
+        from .. import ndarray
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        states = []
+        for s in blob["states"]:
+            if s is None:
+                states.append(None)
+            elif isinstance(s, list):
+                states.append([ndarray.array(x) if hasattr(x, "shape")
+                               else x for x in s])
+            elif hasattr(s, "shape"):
+                states.append(ndarray.array(s))
+            else:
+                states.append(s)
+        self._states = states
+        self._states_created = [s is not None for s in states]

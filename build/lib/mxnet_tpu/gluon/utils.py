@@ -1,0 +1,67 @@
+"""Gluon utilities.
+
+Reference analogue: python/mxnet/gluon/utils.py — ``split_data`` /
+``split_and_load`` (per-device batch slicing for data parallelism) and
+``clip_global_norm``. On TPU, multi-device data parallelism is expressed by
+sharding one global batch over the mesh; ``split_and_load`` keeps the
+reference API for scripts that iterate contexts explicitly.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import ndarray
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an array along ``batch_axis`` into ``num_slice`` pieces
+    (reference gluon/utils.py:split_data)."""
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise MXNetError(
+            f"Too many slices ({num_slice}) for data with shape "
+            f"{data.shape} along axis {batch_axis}")
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}; set "
+            "even_split=False to allow uneven partitioning")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split ``data`` into len(ctx_list) slices, one per context
+    (reference gluon/utils.py:split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = ndarray.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale arrays so their joint L2 norm is at most ``max_norm``
+    (reference gluon/utils.py:clip_global_norm)."""
+    if not arrays:
+        raise MXNetError("arrays must not be empty")
+    total = 0.0
+    for arr in arrays:
+        n = ndarray.norm(arr)
+        total = total + (n * n).asscalar()
+    total_norm = _np.sqrt(total)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm

@@ -1,0 +1,631 @@
+"""Image loading + augmentation pipeline.
+
+Reference surface: python/mxnet/image.py (~975 LoC — imdecode, resize/crop
+helpers, the Augmenter class zoo, CreateAugmenter:861, ImageIter:975) and
+the C++ augmenters in src/io/image_aug_default.cc:360.
+
+TPU-native split: decode + augmentation run host-side on numpy/cv2 (the
+host CPU feeds the chip; augmentation never belongs on the MXU), batches
+land on device once per step via a single ``mx.nd.array`` upload. Arrays
+are HWC, RGB, matching the reference's ``mx.image`` convention.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random as pyrandom
+
+import numpy as np
+
+from .base import MXNetError
+from . import io as _io
+from . import recordio
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["imread", "imdecode", "imresize", "copyMakeBorder",
+           "scale_down", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+           "RandomOrderAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def _to_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return np.asarray(img)
+
+
+def _wrap(img):
+    return nd_array(np.ascontiguousarray(img))
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an HWC NDArray (reference:
+    image.py imdecode:85 — returns RGB by default, unlike raw cv2)."""
+    cv2 = _cv2()
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8),
+                       cv2.IMREAD_COLOR if flag else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("failed to decode image")
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return _wrap(img)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file (reference: image.py imread:44)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize an HWC image to (h, w) (reference image.py imresize →
+    _internal._cvimresize, src/io/image_io.cc)."""
+    from . import ndarray as nd
+    return nd._cvimresize(src if isinstance(src, NDArray)
+                          else nd_array(_to_np(src)), w=w, h=h,
+                          interp=interp)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0.0):
+    """Pad an image with a border (reference _internal._cvcopyMakeBorder,
+    src/io/image_io.cc)."""
+    from . import ndarray as nd
+    return nd._cvcopyMakeBorder(src if isinstance(src, NDArray)
+                                else nd_array(_to_np(src)), top=top,
+                                bot=bot, left=left, right=right,
+                                type=border_type, value=value)
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit src_size keeping aspect (reference:
+    image.py scale_down:139)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def _interp(interp, sizes=()):
+    cv2 = _cv2()
+    if interp == 9:  # auto: area for shrink, cubic for enlarge
+        if sizes:
+            oh, ow, nh, nw = sizes
+            if nh > oh and nw > ow:
+                return cv2.INTER_CUBIC
+            if nh < oh and nw < ow:
+                return cv2.INTER_AREA
+        return cv2.INTER_LINEAR
+    if interp == 10:
+        return pyrandom.randint(0, 4)
+    if interp not in (0, 1, 2, 3, 4):
+        raise MXNetError(f"unknown interp method {interp}")
+    return interp
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge is ``size`` (reference: image.py
+    resize_short:229)."""
+    cv2 = _cv2()
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return _wrap(cv2.resize(img, (new_w, new_h),
+                            interpolation=_interp(interp, (h, w, new_h, new_w))))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop a fixed region, optionally resize (reference: image.py
+    fixed_crop:291)."""
+    img = _to_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        cv2 = _cv2()
+        img = cv2.resize(img, size,
+                         interpolation=_interp(interp, (h, w, size[1], size[0])))
+    return _wrap(img)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of exactly ``size`` (reference: image.py random_crop:323).
+    Returns (cropped, (x0, y0, w, h))."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop (reference: image.py center_crop:362)."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(x - mean) / std (reference: image.py color_normalize:411)."""
+    img = _to_np(src).astype(np.float32)
+    if mean is not None:
+        img = img - _to_np(mean)
+    if std is not None:
+        img = img / _to_np(std)
+    return _wrap(img)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect crop (inception-style; reference: image.py
+    random_size_crop:435)."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = pyrandom.uniform(min_area, 1.0) * area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(img, size, interp)
+
+
+# ---------------------------------------------------------------------------
+# augmenter classes (reference: image.py:482-860)
+# ---------------------------------------------------------------------------
+
+
+class Augmenter:
+    """Image augmenter base (reference: image.py Augmenter:482)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        cv2 = _cv2()
+        img = _to_np(src)
+        sizes = (img.shape[0], img.shape[1], self.size[1], self.size[0])
+        return _wrap(cv2.resize(img, tuple(self.size),
+                                interpolation=_interp(self.interp, sizes)))
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return _wrap(_to_np(src).astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _to_np(src).astype(np.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        mean = (1.0 - alpha) * gray.mean()
+        return _wrap(img * alpha + mean)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _to_np(src).astype(np.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (img * self._coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return _wrap(img * alpha + gray)
+
+
+class HueJitterAug(Augmenter):
+    """Random hue rotation in YIQ space (reference: image.py
+    HueJitterAug:706)."""
+    _tyiq = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], np.float32)
+    _ityiq = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        img = _to_np(src).astype(np.float32)
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w_ = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w_], [0.0, w_, u]],
+                      np.float32)
+        t = self._ityiq @ bt @ self._tyiq
+        return _wrap(img @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-noise lighting (reference: image.py LightingAug:763)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return _wrap(_to_np(src).astype(np.float32) + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _mat = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return _wrap(_to_np(src).astype(np.float32) @ self._mat)
+        return src if isinstance(src, NDArray) else _wrap(src)
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return _wrap(_to_np(src)[:, ::-1])
+        return src if isinstance(src, NDArray) else _wrap(src)
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _wrap(_to_np(src).astype(self.typ))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference: image.py
+    CreateAugmenter:861). data_shape is CHW like the reference."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter (reference: image.py ImageIter:975; C++ twin ImageRecordIter,
+# src/io/iter_image_recordio_2.cc)
+# ---------------------------------------------------------------------------
+
+
+class ImageIter(_io.DataIter):
+    """Image iterator over .rec files or image lists, with augmentation.
+
+    Yields NCHW float32 batches (channels from HWC decode are transposed
+    at batch build; the device-side model may transpose back to NHWC —
+    XLA folds the pair away).
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        if not path_imgrec and not path_imglist and imglist is None:
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist or "
+                             "imglist")
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise MXNetError("data_shape must be (3, H, W)")
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+
+        if path_imgrec:
+            logging.info("ImageIter: loading recordio %s...", path_imgrec)
+            if path_imgidx is None and os.path.exists(path_imgrec[:-4] + ".idx"):
+                path_imgidx = path_imgrec[:-4] + ".idx"
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx,
+                                                         path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        elif path_imglist:
+            logging.info("ImageIter: loading image list %s...", path_imglist)
+            with open(path_imglist) as fin:
+                imglist = {}
+                for line in fin:
+                    line = line.strip().split("\t")
+                    label = np.array(line[1:-1], dtype=np.float32)
+                    imglist[int(line[0])] = (label, line[-1])
+            self.imglist = imglist
+            self.seq = list(imglist.keys())
+        elif isinstance(imglist, list):
+            result = {}
+            for index, img in enumerate(imglist):
+                label = np.asarray(img[0], dtype=np.float32).reshape(-1)
+                result[index] = (label, img[1])
+            self.imglist = result
+            self.seq = list(result.keys())
+        self.path_root = path_root or "."
+
+        if num_parts > 1 and self.seq is not None:
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self._data_name = data_name
+        self._label_name = label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [_io.DataDesc(self._data_name,
+                             (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [_io.DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Return (label, decoded HWC image) for the next sample."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, imdecode(img)
+            label, fname = self.imglist[idx]
+            return label, imread(os.path.join(self.path_root, fname))
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, imdecode(img)
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width), np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, img = self.next_sample()
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = _to_np(img)
+                if arr.shape[:2] != (h, w):
+                    raise MXNetError(
+                        f"augmented image {arr.shape} != data_shape {(h, w)}")
+                batch_data[i] = arr
+                batch_label[i] = np.asarray(label, np.float32).reshape(-1)[
+                    :self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        data = nd_array(batch_data.transpose(0, 3, 1, 2))
+        label = nd_array(batch_label[:, 0] if self.label_width == 1
+                         else batch_label)
+        return _io.DataBatch([data], [label], pad=pad,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
+                    shuffle=False, rand_crop=False, rand_mirror=False,
+                    mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                    resize=0, **kwargs):
+    """C++-API-parity wrapper (reference: ImageRecordIter registration,
+    src/io/iter_image_recordio_2.cc) over ImageIter."""
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    std = None
+    if (std_r, std_g, std_b) != (1, 1, 1):
+        std = np.array([std_r, std_g, std_b], np.float32)
+    # drop C++-pipeline tuning knobs that have no host-numpy analogue
+    # (num_parts/part_index pass through — ImageIter shards the sequence)
+    for k in ("preprocess_threads", "prefetch_buffer", "seed"):
+        kwargs.pop(k, None)
+    return ImageIter(batch_size, data_shape, label_width=label_width,
+                     path_imgrec=path_imgrec, shuffle=shuffle,
+                     rand_crop=rand_crop, rand_mirror=rand_mirror,
+                     mean=mean, std=std, resize=resize, **kwargs)

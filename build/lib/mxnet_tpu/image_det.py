@@ -1,0 +1,343 @@
+"""Detection image pipeline: box-aware augmenters + ImageDetIter.
+
+Reference surface: python/mxnet/image/detection.py (DetAugmenter zoo,
+CreateDetAugmenter, ImageDetIter) over src/io/image_det_aug_default.cc.
+Labels are object lists: each record is
+``[header_width A, object_width B, <A-2 header pads>, obj0(B), obj1(B)…]``
+with objects ``(cls, xmin, ymin, xmax, ymax, …)`` in image-normalized
+coordinates; batches pad the object dim with -1 rows.
+
+Implementation is host-side numpy (augmentation is IO-bound preprocessing
+that overlaps the accelerator step), written fresh against the documented
+behavior.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as np
+
+from . import io as _io
+from .base import MXNetError
+from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
+                    ForceResizeAug, ImageIter, RandomGrayAug, _to_np)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Base detection augmenter: __call__(src HWC, label (N, B)) ->
+    (src, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter; boxes pass through unchanged
+    (resize/color ops that keep normalized coords valid)."""
+
+    def __init__(self, augmenter: Augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one augmenter from a list (or skip)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = _to_np(src)[:, ::-1]
+            label = label.copy()
+            tmp = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - label[:, 1]
+            label[:, 1] = tmp
+        return src, label
+
+
+def _box_coverage(crop, boxes):
+    """Fraction of each box's area covered by the crop (N,), normalized
+    coords — the reference's constraint metric (intersection / box area,
+    NOT IOU: a crop containing a small object covers it fully)."""
+    tl = np.maximum(crop[:2], boxes[:, :2])
+    br = np.minimum(crop[2:], boxes[:, 2:])
+    wh = np.clip(br - tl, 0, None)
+    inter = wh[:, 0] * wh[:, 1]
+    area_b = np.clip(boxes[:, 2] - boxes[:, 0], 0, None) * \
+        np.clip(boxes[:, 3] - boxes[:, 1], 0, None)
+    return inter / np.maximum(area_b, 1e-12)
+
+
+class DetRandomCropAug(DetAugmenter):
+    """SSD-style random crop with a minimum object-coverage constraint."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        src = _to_np(src)
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, np.sqrt(area * ratio))
+            ch = min(1.0, np.sqrt(area / ratio))
+            x0 = pyrandom.uniform(0, 1 - cw)
+            y0 = pyrandom.uniform(0, 1 - ch)
+            crop = np.array([x0, y0, x0 + cw, y0 + ch], np.float32)
+            if len(label):
+                cover = _box_coverage(crop, label[:, 1:5])
+                # every object the crop keeps (center inside) must clear
+                # the coverage constraint, and at least one must survive
+                cx = (label[:, 1] + label[:, 3]) / 2
+                cy = (label[:, 2] + label[:, 4]) / 2
+                inside = ((cx >= crop[0]) & (cx <= crop[2])
+                          & (cy >= crop[1]) & (cy <= crop[3]))
+                if not inside.any():
+                    continue
+                if cover[inside].min() < self.min_object_covered:
+                    continue
+            new_label = self._crop_boxes(label, crop)
+            if len(label) and not len(new_label):
+                continue
+            xi0, yi0 = int(x0 * w), int(y0 * h)
+            xi1, yi1 = int((x0 + cw) * w), int((y0 + ch) * h)
+            return src[yi0:yi1, xi0:xi1], new_label
+        return src, label
+
+    def _crop_boxes(self, label, crop):
+        if not len(label):
+            return label
+        boxes = label[:, 1:5]
+        # keep objects whose center lies in the crop and coverage clears
+        cx = (boxes[:, 0] + boxes[:, 2]) / 2
+        cy = (boxes[:, 1] + boxes[:, 3]) / 2
+        inside = ((cx >= crop[0]) & (cx <= crop[2])
+                  & (cy >= crop[1]) & (cy <= crop[3]))
+        clipped = boxes.copy()
+        clipped[:, 0::2] = np.clip(clipped[:, 0::2], crop[0], crop[2])
+        clipped[:, 1::2] = np.clip(clipped[:, 1::2], crop[1], crop[3])
+        area = np.clip(clipped[:, 2] - clipped[:, 0], 0, None) * \
+            np.clip(clipped[:, 3] - clipped[:, 1], 0, None)
+        orig = np.clip(boxes[:, 2] - boxes[:, 0], 0, None) * \
+            np.clip(boxes[:, 3] - boxes[:, 1], 0, None)
+        cover = area / np.maximum(orig, 1e-12)
+        keep = inside & (cover >= self.min_eject_coverage)
+        if not keep.any():
+            return label[:0]
+        out = label[keep].copy()
+        cw, chh = crop[2] - crop[0], crop[3] - crop[1]
+        out[:, 1] = (np.clip(out[:, 1], crop[0], crop[2]) - crop[0]) / cw
+        out[:, 3] = (np.clip(out[:, 3], crop[0], crop[2]) - crop[0]) / cw
+        out[:, 2] = (np.clip(out[:, 2], crop[1], crop[3]) - crop[1]) / chh
+        out[:, 4] = (np.clip(out[:, 4], crop[1], crop[3]) - crop[1]) / chh
+        return out
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom out: place the image on a larger canvas, rescaling boxes."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        src = _to_np(src)
+        h, w = src.shape[:2]
+        area = pyrandom.uniform(*self.area_range)
+        if area <= 1.0:
+            return src, label
+        ratio = pyrandom.uniform(*self.aspect_ratio_range)
+        nw = int(w * min(4.0, np.sqrt(area * ratio)))
+        nh = int(h * min(4.0, np.sqrt(area / ratio)))
+        nw, nh = max(nw, w), max(nh, h)
+        x0 = pyrandom.randint(0, nw - w)
+        y0 = pyrandom.randint(0, nh - h)
+        canvas = np.empty((nh, nw, src.shape[2]), src.dtype)
+        canvas[:] = np.asarray(self.pad_val, src.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = src
+        if len(label):
+            label = label.copy()
+            label[:, 1] = (label[:, 1] * w + x0) / nw
+            label[:, 3] = (label[:, 3] * w + x0) / nw
+            label[:, 2] = (label[:, 2] * h + y0) / nh
+            label[:, 4] = (label[:, 4] * h + y0) / nh
+        return canvas, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmenter list (reference detection.py:482)."""
+    from .image import HueJitterAug, LightingAug, ResizeAug
+
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])), max_attempts,
+                              pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # resize to the network shape AFTER the geometric augs
+    auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2],
+                                                data_shape[1]),
+                                               inter_method)))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        auglist.append(DetBorrowAug(LightingAug(
+            pca_noise,
+            np.asarray([55.46, 4.794, 1.148]),
+            np.asarray([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]]))))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = np.array([58.395, 57.12, 57.375])
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: batches are (data (B,3,H,W),
+    label (B, max_objects, obj_width)) with -1 padding rows
+    (reference detection.py:624)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        elif kwargs:
+            raise MXNetError(
+                f"pass augmentation kwargs {sorted(kwargs)} OR an explicit "
+                "aug_list, not both")
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=[],
+                         imglist=imglist, data_name=data_name,
+                         label_name=label_name)
+        self.det_auglist = aug_list
+        self._label_shape = self._estimate_label_shape()
+
+    @staticmethod
+    def _parse_label(raw):
+        """Flat label -> (N, B) object array (reference _parse_label)."""
+        raw = np.asarray(raw, np.float32).ravel()
+        if raw.size < 2:
+            raise MXNetError(f"label is too short: {raw}")
+        a, b = int(raw[0]), int(raw[1])
+        if b < 5:
+            raise MXNetError(f"object width {b} must be >= 5")
+        body = raw[a:]
+        n = body.size // b
+        if n < 1:
+            return np.zeros((0, b), np.float32)
+        return body[:n * b].reshape(n, b)
+
+    def _estimate_label_shape(self):
+        max_count = 0
+        obj_width = 5
+        try:
+            self.reset()
+            while True:
+                label, _ = self.next_sample()
+                obj = self._parse_label(label)
+                max_count = max(max_count, obj.shape[0])
+                obj_width = obj.shape[1] if obj.size else obj_width
+        except StopIteration:
+            pass
+        self.reset()
+        return (max(max_count, 1), obj_width)
+
+    @property
+    def provide_label(self):
+        return [_io.DataDesc(self._label_name,
+                             (self.batch_size,) + self._label_shape)]
+
+    def next(self):
+        from .ndarray import array as nd_array
+
+        c, h, w = self.data_shape
+        n_obj, obj_w = self._label_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
+        batch_label = np.full((self.batch_size, n_obj, obj_w), -1.0,
+                              np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                raw_label, img = self.next_sample()
+                objs = self._parse_label(raw_label)
+                img = _to_np(img)
+                for aug in self.det_auglist:
+                    img, objs = aug(img, objs)
+                arr = _to_np(img)
+                if arr.shape[:2] != (h, w):
+                    raise MXNetError(
+                        f"augmented image {arr.shape} != {(h, w)}")
+                batch_data[i] = arr
+                k = min(len(objs), n_obj)
+                if k:
+                    batch_label[i, :k] = objs[:k, :obj_w]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        return _io.DataBatch(
+            data=[nd_array(batch_data.transpose(0, 3, 1, 2))],
+            label=[nd_array(batch_label)], pad=pad,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)

@@ -1,0 +1,285 @@
+"""Weight initializers.
+
+Reference: python/mxnet/initializer.py — Initializer base dispatching on the
+parameter name (weight/bias/gamma/beta/moving_*), registry, Uniform/Normal/
+Xavier/MSRAPrelu/Bilinear/Constant/Mixed/One/Zero/LSTMBias.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as _np
+
+from .base import Registry
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["Initializer", "InitDesc", "register", "create", "Uniform",
+           "Normal", "Xavier", "MSRAPrelu", "Zero", "One", "Constant",
+           "Orthogonal", "Bilinear", "Mixed", "Load", "LSTMBias"]
+
+_REG = Registry("initializer")
+register = _REG.register
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint (reference: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr: NDArray):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init = desc.attrs.get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            create(klass, **kwargs)._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- leaf inits ---------------------------------------------------------
+    def _init_zero(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError()
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 0.0
+
+
+_REG._map["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 1.0
+
+
+_REG._map["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        arr[:] = nd_array(_np.random.uniform(-self.scale, self.scale,
+                                             arr.shape).astype("float32"))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        arr[:] = nd_array(_np.random.normal(0, self.sigma,
+                                            arr.shape).astype("float32"))
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference: initializer.py Xavier — rnd_type uniform/
+    gaussian, factor_type avg/in/out, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        if len(shape) < 2:
+            arr[:] = nd_array(_np.random.uniform(-0.07, 0.07, shape).astype("float32"))
+            return
+        layout = ""
+        if isinstance(desc, InitDesc):
+            layout = str(desc.attrs.get("__layout__", ""))
+        channel_last = layout.endswith("C") and not layout.startswith("NC")
+        if channel_last and len(shape) > 2:
+            # OHWI conv weight: fan_in = I*spatial, fan_out = O*spatial
+            spatial = float(_np.prod(shape[1:-1]))
+            fan_in, fan_out = shape[-1] * spatial, shape[0] * spatial
+        else:
+            # OIHW (reference layout) / plain (out, in) matrices
+            hw_scale = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
+            fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = _np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            w = _np.random.uniform(-scale, scale, shape)
+        else:
+            w = _np.random.normal(0, scale, shape)
+        arr[:] = nd_array(w.astype("float32"))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = nd_array((self.scale * q.reshape(arr.shape)).astype("float32"))
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernels (reference: used with Deconvolution
+    UpSampling weights)."""
+
+    def _init_weight(self, desc, arr):
+        weight = _np.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = nd_array(weight)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = _np.zeros(arr.shape, dtype="float32")
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias  # i, f, g, o order
+        arr[:] = nd_array(b)
+
+    _init_bias = _init_weight
+
+
+@register
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, desc, arr):
+        for pat, init in self.map:
+            if pat.match(str(desc)):
+                init(desc, arr)
+                return
+        raise ValueError(f"parameter {desc} did not match any pattern")
+
+
+@register
+class Load:
+    """Init from a saved param dict, fall back to default_init."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            arr[:] = self.param[name]
+        else:
+            if self.default_init is None:
+                raise ValueError(f"no init pattern for {name}")
+            self.default_init(name, arr)
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _REG.get(name)(**kwargs)

@@ -1,0 +1,522 @@
+"""Data iterators.
+
+Reference: python/mxnet/io.py (DataIter/DataBatch/DataDesc:41-175,
+NDArrayIter:515, ResizeIter:277, PrefetchingIter:342) and the C++ iterators
+under src/io/ (MNISTIter, CSVIter). The C-backed pipeline (RecordIO/image
+decode) lives in io_record.py / the native lib; this module is the pure
+python-facing iterator API.
+"""
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+from typing import List, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MXDataIter", "MNISTIter", "CSVIter", "LibSVMIter",
+           "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name+shape(+dtype/layout) of one input (reference: io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, tuple(shape))
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (reference: io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError()
+
+    def getdata(self):
+        raise NotImplementedError()
+
+    def getlabel(self):
+        raise NotImplementedError()
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError()
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize to list of (name, NDArray) (reference: io.py _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them "
+                        "or dict with them as values")
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            try:
+                v = nd_array(_np.asarray(v, dtype=v.dtype if hasattr(v, "dtype")
+                                         else _np.float32))
+            except Exception as e:
+                raise TypeError(f"Invalid type '{type(v)}' for {k}") from e
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.py:515)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        if shuffle:
+            _np.random.shuffle(self.idx)
+        self._shuffle = shuffle
+
+        if last_batch_handle == "discard":
+            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
+            self.idx = self.idx[:new_n]
+
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        # one host copy per source up front; per-batch slicing then stays
+        # O(batch) instead of a whole-array device->host copy per batch
+        self._np_cache = {id(x): x.asnumpy()
+                          for _, x in self.data + self.label}
+        self.num_source = len(self.data_list)
+        self.num_data = len(self.idx)
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size"
+        self.cursor = -batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype) for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self._shuffle:
+            _np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) \
+                % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            sel = self.idx[self.cursor:self.cursor + self.batch_size]
+        else:
+            pad = self.batch_size - self.num_data + self.cursor
+            sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+        return [nd_array(self._np_cache[id(x)][sel]) for _, x in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches per epoch
+    (reference: io.py:277)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-prefetching wrapper (reference: io.py:342 — the python analog
+    of src/io/iter_prefetcher.h). The host thread stages the next batch while
+    the device computes on the current one."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            for i in self.next_batch:
+                assert i is None, "Number of entry mismatches between iterators"
+            return False
+        for batch in self.next_batch:
+            assert batch.pad == self.next_batch[0].pad, \
+                "Different pad number in all iterators"
+        self.current_batch = DataBatch(
+            sum([batch.data for batch in self.next_batch], []),
+            sum([(batch.label or []) for batch in self.next_batch], []),
+            self.next_batch[0].pad, self.next_batch[0].index,
+            provide_data=self.provide_data, provide_label=self.provide_label)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+def _load_mnist_images(path):
+    import gzip
+    import struct
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise MXNetError(f"bad MNIST image file {path}")
+        data = _np.frombuffer(f.read(), dtype=_np.uint8)
+        return data.reshape(num, rows, cols)
+
+
+def _load_mnist_labels(path):
+    import gzip
+    import struct
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise MXNetError(f"bad MNIST label file {path}")
+        return _np.frombuffer(f.read(), dtype=_np.uint8)
+
+
+def MNISTIter(image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+              batch_size=128, shuffle=True, flat=False, silent=False,
+              data_name="data", label_name="softmax_label", input_shape=None,
+              **kwargs):
+    """MNIST idx-format iterator (reference: src/io/iter_mnist.cc).
+
+    Reads the standard idx(.gz) files and serves them through NDArrayIter.
+    """
+    import os
+    for p in (image, label):
+        if not os.path.exists(p):
+            raise MXNetError(f"MNIST file not found: {p}")
+    images = _load_mnist_images(image).astype(_np.float32) / 255.0
+    labels = _load_mnist_labels(label).astype(_np.float32)
+    if flat:
+        images = images.reshape(len(images), -1)
+    else:
+        images = images.reshape(len(images), 1, 28, 28)
+    if input_shape is not None:
+        images = images.reshape((len(images),) + tuple(input_shape))
+    return NDArrayIter(images, labels, batch_size=batch_size, shuffle=shuffle,
+                       data_name=data_name, label_name=label_name)
+
+
+def CSVIter(data_csv, data_shape, label_csv=None, label_shape=(1,),
+            batch_size=128, round_batch=True, **kwargs):
+    """CSV iterator (reference: src/io/iter_csv.cc)."""
+    data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+    data = data.reshape((-1,) + tuple(data_shape))
+    label = None
+    if label_csv is not None:
+        label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+        label = label.reshape((-1,) + tuple(label_shape))
+        if label.shape[-1] == 1:
+            label = label.reshape(label.shape[:-1])
+    return NDArrayIter(data, label, batch_size=batch_size,
+                       last_batch_handle="pad" if round_batch else "discard")
+
+
+def LibSVMIter(data_libsvm, data_shape, label_shape=(1,), batch_size=128,
+               round_batch=True, **kwargs):
+    """LibSVM-format iterator yielding CSR data batches (reference:
+    src/io/iter_libsvm.cc — 'label idx:val idx:val …' per line; feature
+    indices are 0-based as in the reference's docs). Only scalar labels
+    are supported (the reference's multi-label mode reads a second
+    label_libsvm file; pass label_shape=(1,))."""
+    from .ndarray import sparse as _sparse
+
+    lw = 1
+    for v in label_shape:
+        lw *= int(v)
+    if lw != 1:
+        raise MXNetError(
+            "LibSVMIter: only scalar labels are supported "
+            "(label_shape=(1,)); multi-dim labels need a label_libsvm "
+            "file, which is not implemented")
+    num_features = 1
+    for s in data_shape:
+        num_features *= int(s)
+    labels, indptr, indices, values = [], [0], [], []
+    with open(data_libsvm) as fin:
+        for line in fin:
+            parts = line.split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                idx, _, val = tok.partition(":")
+                indices.append(int(idx))
+                values.append(float(val))
+            indptr.append(len(indices))
+    n = len(labels)
+    label_arr = _np.asarray(labels, _np.float32)
+    values = _np.asarray(values, _np.float32)
+    indices = _np.asarray(indices, _np.int64)
+    indptr = _np.asarray(indptr, _np.int64)
+
+    class _LibSVMIter(DataIter):
+        def __init__(self):
+            super().__init__(batch_size)
+            self.cur = 0
+
+        @property
+        def provide_data(self):
+            return [DataDesc("data", (batch_size, num_features))]
+
+        @property
+        def provide_label(self):
+            return [DataDesc("label", (batch_size,))]
+
+        def reset(self):
+            self.cur = 0
+
+        def next(self):
+            if self.cur >= n:
+                raise StopIteration
+            i0 = self.cur
+            i1 = min(i0 + batch_size, n)
+            pad = batch_size - (i1 - i0)
+            if pad and not round_batch:
+                raise StopIteration
+            rows = list(range(i0, i1)) + [i0] * pad  # wrap-pad like the ref
+            ptr = [0]
+            ind, val = [], []
+            lab = _np.zeros((batch_size,), _np.float32)
+            for k, r in enumerate(rows):
+                ind.extend(indices[indptr[r]:indptr[r + 1]])
+                val.extend(values[indptr[r]:indptr[r + 1]])
+                ptr.append(len(ind))
+                lab[k] = label_arr[r]
+            data = _sparse.csr_matrix(
+                (_np.asarray(val, _np.float32),
+                 _np.asarray(ind, _np.int64),
+                 _np.asarray(ptr, _np.int64)),
+                shape=(batch_size, num_features))
+            self.cur = i1
+            return DataBatch(data=[data], label=[nd_array(lab)], pad=pad,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+
+    return _LibSVMIter()
+
+
+def ImageRecordIter(*args, **kwargs):
+    """C-registry alias: the image pipeline lives in mx.image (reference
+    exposes ImageRecordIter under mx.io as well)."""
+    from .image import ImageRecordIter as _iri
+    return _iri(*args, **kwargs)
+
+
+class MXDataIter(DataIter):
+    """Wrapper type for backend-registered iterators (reference io.py:721
+    wraps a C iterator handle). The rebuild's registered iterators
+    (MNISTIter/CSVIter/LibSVMIter/ImageRecordIter) construct python-native
+    DataIters directly, so this class exists for isinstance/import
+    compatibility."""

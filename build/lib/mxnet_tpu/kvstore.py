@@ -1,0 +1,257 @@
+"""KVStore: key-value parameter synchronization.
+
+Reference: include/mxnet/kvstore.h + src/kvstore/ (KVStoreLocal with
+CommCPU/CommDevice reduce, KVStoreDist over ps-lite) and python/mxnet/
+kvstore.py. TPU-native mapping (SURVEY.md §5.8): the local/device comm layer
+becomes array addition (XLA fuses it); the distributed worker/server/ZMQ
+stack collapses into SPMD collectives over the mesh — ``dist_sync`` push+pull
+is an allreduce (jax.lax.psum) executed by the sharded training step in
+parallel/. This module keeps the full KVStore *API* so reference scripts run
+unchanged; under a single process it aggregates device lists directly, and
+under `dist_*` types it reports rank/size from jax.distributed and lets the
+mesh collectives do the actual reduction.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Union
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .optimizer import Optimizer, get_updater
+
+__all__ = ["KVStore", "create"]
+
+
+def _key(k):
+    return str(k)
+
+
+class KVStore:
+    """Single-process key-value store (reference: KVStoreLocal,
+    src/kvstore/kvstore_local.h:60-168)."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store: Dict[str, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None        # {'type': '2bit', 'threshold': t}
+        self._residuals: Dict = {}      # error-feedback state per key/slot
+
+    # -- core API -----------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            v0 = v[0] if isinstance(v, list) else v
+            self._store[k] = v0.copy()
+
+    def set_gradient_compression(self, compression_params):
+        """Enable gradient compression on pushes (2-bit sign-threshold
+        quantization with error feedback — beyond the 0.11 reference;
+        matches the later mxnet `kv.set_gradient_compression(
+        {'type': '2bit', 'threshold': t})` API). Each pushed gradient is
+        quantized to {-t, 0, +t} per element; the quantization error is
+        kept per (key, device-slot) and added to the next push, so the
+        update is unbiased over time while the communicated tensor holds
+        ~2 bits/element of information."""
+        params = dict(compression_params or {})
+        ctype = params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError(
+                f"unsupported gradient compression type {ctype!r}; "
+                "supported: '2bit'")
+        threshold = float(params.get("threshold", 0.5))
+        if threshold <= 0:
+            raise MXNetError("compression threshold must be positive")
+        self._compression = {"type": ctype, "threshold": threshold}
+        self._residuals.clear()
+
+    def _compress(self, k, slot, v):
+        import jax.numpy as jnp
+        t = self._compression["threshold"]
+        res = self._residuals.get((k, slot))
+        acc = v._data + (res if res is not None else 0)
+        q = jnp.where(acc >= t, jnp.asarray(t, acc.dtype),
+                      jnp.where(acc <= -t, jnp.asarray(-t, acc.dtype),
+                                jnp.zeros((), acc.dtype)))
+        self._residuals[(k, slot)] = acc - q
+        from .ndarray import NDArray as _ND
+        return _ND(q)
+
+    def push(self, key, value, priority=0):
+        """Aggregate grads into the store; runs the updater if set
+        (reference: KVStoreLocal::Push + comm reduce, comm.h:90-434)."""
+        keys, values = self._normalize(key, value)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, list):
+                vlist = [vlist]
+            if self._compression is not None and vlist and \
+                    getattr(vlist[0], "stype", "default") == "default":
+                vlist = [self._compress(k, i, v)
+                         for i, v in enumerate(vlist)]
+            agg = vlist[0]
+            if len(vlist) > 1:
+                from .ndarray import add_n
+                agg = add_n(*vlist)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if "dist" in self.type and self.num_workers > 1:
+                # dist_sync: merge across every worker process before the
+                # update (reference: server-side MergeBuf across workers,
+                # kvstore_dist_server.h:211-359 — here one allreduce)
+                from .parallel import dist as _dist
+                from .ndarray import array as _nd_array
+                agg = _nd_array(_dist.allreduce(agg.asnumpy()))
+            if self._updater is not None:
+                self._updater(self._str_to_int(k), agg, self._store[k])
+            else:
+                # no updater: store the merged value (reference
+                # kvstore_local.h:107 ``local = merged`` — init 1, push 8,
+                # pull yields 8, not 9)
+                self._store[k]._set_data(agg._data)
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = self._normalize(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if not isinstance(olist, list):
+                olist = [olist]
+            for o in olist:
+                o._set_data(self._store[k]._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows as row_sparse (reference:
+        kvstore.py row_sparse_pull → sparse_retain on the stored value)."""
+        if row_ids is None:
+            self.pull(key, out, priority)
+            return
+        import jax.numpy as jnp
+        import numpy as _np
+        from .ndarray import sparse as _sp
+        keys, outs = self._normalize(key, out)
+        # row_ids: one NDArray broadcast to every key/out, or a list
+        # parallel to the keys (reference: kvstore.py row_sparse_pull)
+        if isinstance(row_ids, list):
+            if len(row_ids) != len(keys):
+                raise MXNetError("row_ids list must match the key list")
+            ids_per_key = row_ids
+        else:
+            ids_per_key = [row_ids] * len(keys)
+        for k, olist, rid in zip(keys, outs, ids_per_key):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if not isinstance(olist, list):
+                olist = [olist]
+            stored = self._store[k]
+            if stored.stype == "row_sparse":
+                kept = _sp.sparse_retain(stored, rid)
+            else:
+                # dense-stored weight: gather the requested rows on
+                # device instead of densify-scan (embedding hot path)
+                ids_np = _np.unique(_np.asarray(
+                    rid.asnumpy() if hasattr(rid, "asnumpy") else rid)
+                    .astype(_np.int64).ravel())
+                kept = _sp.RowSparseNDArray(
+                    stored._data[jnp.asarray(ids_np)], ids_np, stored.shape)
+            for o in olist:
+                if o.stype == "row_sparse":
+                    o._d, o._i = kept._d, kept._i
+                    o._dense = None
+                else:
+                    o._set_data(kept._data)
+
+    # -- updater / optimizer -------------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer: Optimizer):
+        """reference: kvstore.py set_optimizer — pickles the optimizer to
+        the servers when distributed. In SPMD there are no servers: EVERY
+        worker installs the updater and applies it to the allreduce-merged
+        gradient, so all replicas step identically (the server update,
+        replicated)."""
+        self._optimizer = optimizer
+        self.set_updater(get_updater(optimizer))
+
+    # -- distributed topology ------------------------------------------------
+    @property
+    def rank(self) -> int:
+        if "dist" in self.type:
+            import jax
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        if "dist" in self.type:
+            import jax
+            return jax.process_count()
+        return 1
+
+    def barrier(self):
+        if "dist" in self.type:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def num_dead_node(self, node_id=0, timeout_sec=60):
+        """Number of dead nodes as seen from the given node (reference
+        kvstore.h:311 get_num_dead_node over ps-lite heartbeats).
+
+        The SPMD stack is fate-shared: a dead process fails the NCCL-less
+        collective for everyone and jax.distributed tears the job down, so
+        a *running* job by construction has zero dead peers; recovery is
+        relaunch + checkpoint-resume (SURVEY.md §5.3 — the reference's
+        practical recovery path too)."""
+        return 0
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            return [_key(k) for k in key], list(value)
+        return [_key(key)], [value]
+
+    @staticmethod
+    def _str_to_int(k: str) -> Union[int, str]:
+        try:
+            return int(k)
+        except ValueError:
+            return k
+
+
+def create(name="local") -> KVStore:
+    """Factory (reference: KVStore::Create string dispatch,
+    src/kvstore/kvstore.cc:34-61 — 'local'/'device'/'dist_sync'/
+    'dist_device_sync'/'dist_async')."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "local_allreduce_cpu", "local_allreduce_device",
+             "dist_sync", "dist_device_sync", "dist_async", "dist")
+    if name not in valid:
+        raise MXNetError(f"unknown kvstore type {name}")
+    if "dist_async" in name:
+        raise MXNetError(
+            "dist_async has no TPU analog (SPMD collectives are synchronous); "
+            "use dist_sync (SURVEY.md §5.8)")
+    return KVStore(name)

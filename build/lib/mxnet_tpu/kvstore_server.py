@@ -1,0 +1,56 @@
+"""KVStore server bootstrap (reference: python/mxnet/kvstore_server.py).
+
+The reference's ``dist`` kvstore runs dedicated server processes that
+receive pickled optimizers over ps-lite and apply updates server-side. In
+the SPMD rebuild there is **no server role**: every process is a worker
+participating in `psum` collectives, and the `update_on_kvstore` analog is
+sharded optimizer state (SURVEY.md §5.8). This module keeps the API shape
+so launch scripts importing it keep working: ``_init_kvstore_server_module``
+is a no-op (DMLC_ROLE is always effectively "worker"), and
+``KVStoreServer.run`` raises with an explanation rather than hanging.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer:
+    """API-parity shim for the reference's parameter-server process."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def _controller(self):
+        def server_controller(cmd_id, cmd_body, _):
+            if cmd_id == 0:
+                import pickle
+                self.kvstore.set_optimizer(pickle.loads(cmd_body))
+        return server_controller
+
+    def run(self):
+        raise RuntimeError(
+            "There are no parameter-server processes in the TPU-native "
+            "distributed stack: gradients are reduced in-graph with "
+            "jax.lax.psum over the ICI/DCN mesh and 'server-side' "
+            "optimizer state is sharded across workers. Launch all "
+            "processes as workers (tools/launch.py).")
+
+
+def _init_kvstore_server_module():
+    """Reference: blocks forever as a server when DMLC_ROLE says so.
+
+    Every process is a worker here; warn if a launcher still exports a
+    server/scheduler role.
+    """
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role not in ("worker", ""):
+        import logging
+        logging.getLogger(__name__).warning(
+            "DMLC_ROLE=%s ignored: the TPU-native distributed stack has "
+            "no %s role (all processes are SPMD workers)", role, role)
+
+
+_init_kvstore_server_module()

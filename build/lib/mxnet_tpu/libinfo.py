@@ -1,0 +1,27 @@
+"""Library info (reference: python/mxnet/libinfo.py).
+
+The reference locates ``libmxnet.so``; the TPU rebuild's only native
+library is the IO runtime (``libmxtpu_io.so``, built by ``make``) — the
+compute path is XLA and needs no shared library.
+"""
+import os
+
+__all__ = ["find_lib_path", "__version__"]
+
+__version__ = "0.11.0"
+
+
+def find_lib_path():
+    """Return the paths of the native libraries that exist on disk.
+
+    Unlike the reference (which raises if libmxnet.so is missing), an empty
+    list is valid here: everything except the C++ RecordIO fast path works
+    without native code.
+    """
+    pkg_dir = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    candidates = [
+        os.path.join(pkg_dir, "_lib", "libmxtpu_io.so"),
+        os.path.join(os.path.dirname(pkg_dir), "src", "io",
+                     "libmxtpu_io.so"),
+    ]
+    return [p for p in candidates if os.path.exists(p)]

@@ -1,0 +1,90 @@
+"""Logging utilities (reference: python/mxnet/log.py).
+
+``get_logger`` attaches a color-capable formatter whose level tag renders
+as ``X:name:message`` (single-letter level) with ANSI colors on TTYs.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+PY3 = sys.version_info[0] == 3
+
+
+class _Formatter(logging.Formatter):
+    """Per-level colored single-letter formatter (reference log.py:37)."""
+
+    def __init__(self, colored=True):
+        self.colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def _get_color(self, level):
+        if level >= ERROR:
+            return "\x1b[31m"
+        if level >= WARNING:
+            return "\x1b[33m"
+        return "\x1b[32m"
+
+    def _get_label(self, level):
+        if level == logging.CRITICAL:
+            return "C"
+        if level == ERROR:
+            return "E"
+        if level == WARNING:
+            return "W"
+        if level == INFO:
+            return "I"
+        if level == DEBUG:
+            return "D"
+        return "U"
+
+    def format(self, record):
+        fmt = ""
+        if self.colored:
+            fmt = self._get_color(record.levelno)
+        fmt += self._get_label(record.levelno)
+        if self.colored:
+            fmt += "\x1b[0m"
+        fmt += "%(asctime)s %(process)d %(pathname)s:%(funcName)s:" \
+               "%(lineno)d"
+        if self.colored:
+            fmt += "\x1b[0m"
+        fmt += " %(message)s"
+        self._style._fmt = fmt
+        return super().format(record)
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated alias of :func:`get_logger` (reference log.py:80)."""
+    import warnings
+    warnings.warn("getLogger is deprecated, use get_logger instead",
+                  DeprecationWarning)
+    return get_logger(name, filename, filemode, level)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Get a customized logger with a colored console (or file) handler."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", None):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+        else:
+            hdlr = logging.StreamHandler()
+            # the colored one only makes sense on a tty
+        colored = not filename and getattr(sys.stderr, "isatty",
+                                           lambda: False)()
+        hdlr.setFormatter(_Formatter(colored=colored))
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger

@@ -1,0 +1,313 @@
+"""Checkpointing helpers + kvstore wiring shared by the trainer APIs.
+
+Reference: python/mxnet/model.py — save_checkpoint:340 / load_checkpoint:370
+(prefix-symbol.json + prefix-%04d.params), _create_kvstore:57 (picks
+update_on_kvstore, disables kv for single device), _initialize_kvstore:96,
+_update_params_on_kvstore:105.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+from . import ndarray as nd
+from . import symbol as sym
+from .base import MXNetError
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+from .callback import BatchEndParam  # noqa: F401  (reference keeps it here)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write prefix-symbol.json + prefix-%04d.params (reference: model.py:340).
+
+    The params container keys use the reference's 'arg:'/'aux:' prefixes.
+    """
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix, epoch) -> Tuple:
+    """Load (symbol, arg_params, aux_params) (reference: model.py:370)."""
+    import os
+    symbol = None
+    if os.path.exists(f"{prefix}-symbol.json"):
+        symbol = sym.load(f"{prefix}-symbol.json")
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    if not os.path.exists(param_name) and os.path.exists(param_name + ".npz"):
+        param_name += ".npz"
+    save_dict = nd.load(param_name)
+    arg_params: Dict = {}
+    aux_params: Dict = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Pick (kvstore, update_on_kvstore) (reference: model.py:57-94)."""
+    from . import kvstore as kvs
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(int(_np_prod(p.shape)) for p in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, string or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= s
+    return out
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """Init kv weights from arg_params (reference: model.py:96)."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    """Push grads / pull weights (reference: model.py:105)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or (isinstance(grad_list, list)
+                                 and grad_list[0] is None):
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    """Local updater path (reference: model.py:117)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or (isinstance(grad_list, list)
+                                 and grad_list[0] is None):
+            continue
+        if not isinstance(arg_list, list):
+            arg_list, grad_list = [arg_list], [grad_list]
+        index_ = index
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, (w, g) in enumerate(zip(arg_list, grad_list)):
+            updater(index_ * num_device + k, g, w)
+
+
+class FeedForward:
+    """Legacy single-input/single-output estimator API (reference:
+    python/mxnet/model.py:408 FeedForward — fit/predict/score/save/load,
+    sklearn-flavored). Deprecated in the reference in favor of Module;
+    provided here as a thin adapter over Module for script parity."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+        from .module import Module
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        if epoch_size is not None:
+            import logging
+            logging.warning("FeedForward: epoch_size is ignored (epochs "
+                            "are defined by the data iterator)")
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        # accepted for reference-API parity; extra arg_params keys are
+        # always tolerated by init_params (it reads only declared names)
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module_cls = Module
+        self._mod = None
+        self._pred_mod = None  # cached predict/score module (by shapes)
+        self._pred_key = None
+
+    # -- helpers -------------------------------------------------------------
+    def _init_iter(self, X, y, is_train):
+        from .io import DataIter, NDArrayIter
+        import numpy as _np
+
+        if isinstance(X, DataIter):
+            return X
+        X = _np.asarray(X)
+        if y is None and is_train:
+            raise MXNetError("y is required for training")
+        y = _np.asarray(y) if y is not None else _np.zeros(X.shape[0])
+        bs = min(self.numpy_batch_size, X.shape[0])
+        return NDArrayIter(X, y, bs, shuffle=is_train,
+                           label_name=self._label_name())
+
+    def _label_name(self):
+        labels = [n for n in self.symbol.list_arguments()
+                  if n.endswith("label")]
+        return labels[0] if labels else "softmax_label"
+
+    def _make_module(self, data_iter):
+        label_names = [l.name for l in data_iter.provide_label]
+        if not label_names:
+            # label-less prediction iterator: the graph's label arguments
+            # are still inputs, not parameters (the reference predictor
+            # binds them to zeros — c_predict_api.cc / simple_bind)
+            label_names = [n for n in self.symbol.list_arguments()
+                           if n.endswith("label")]
+        mod = self._module_cls(
+            self.symbol, data_names=[d.name for d in data_iter.provide_data],
+            label_names=label_names,
+            context=self.ctx)
+        return mod
+
+    # -- API -----------------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        train = self._init_iter(X, y, is_train=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._init_iter(eval_data[0], eval_data[1], False)
+        self._mod = self._make_module(train)
+        self._mod.fit(train, eval_data=eval_data, eval_metric=eval_metric,
+                      epoch_end_callback=epoch_end_callback,
+                      batch_end_callback=batch_end_callback,
+                      eval_end_callback=eval_end_callback,
+                      eval_batch_end_callback=eval_batch_end_callback,
+                      kvstore=kvstore, optimizer=self.optimizer,
+                      optimizer_params=self.kwargs,
+                      initializer=self.initializer,
+                      arg_params=self.arg_params,
+                      aux_params=self.aux_params,
+                      begin_epoch=self.begin_epoch,
+                      num_epoch=self.num_epoch, monitor=monitor)
+        self.arg_params, self.aux_params = self._mod.get_params()
+        self._pred_mod = None  # params changed; invalidate predict cache
+        return self
+
+    def _bound_module(self, data_iter):
+        """Cached inference module, re-bound only when shapes change
+        (the reference caches its prediction executor the same way).
+        When a trained module exists, the inference executor shares its
+        parameter arrays (shared_module) instead of copying them."""
+        key = tuple(map(tuple, (d.shape for d in data_iter.provide_data)))
+        if self._pred_mod is None or self._pred_key != key:
+            mod = self._make_module(data_iter)
+            shared = self._mod if (self._mod is not None
+                                   and self._mod.binded) else None
+            mod.bind(data_shapes=data_iter.provide_data,
+                     label_shapes=data_iter.provide_label,
+                     for_training=False, shared_module=shared)
+            self._pred_mod, self._pred_key = mod, key
+        # set_params on EVERY call: honors reassigned or in-place-mutated
+        # arg_params (with a shared module this writes into the shared
+        # arrays, keeping trainer and predictor views consistent — the
+        # estimator owns one parameter set)
+        self._pred_mod.set_params(self.arg_params or {},
+                                  self.aux_params or {},
+                                  allow_missing=False)
+        return self._pred_mod
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np
+
+        data_iter = self._init_iter(X, None, is_train=False)
+        if reset:
+            data_iter.reset()
+        mod = self._bound_module(data_iter)
+        outputs, datas, labels = [], [], []
+        for i, batch in enumerate(data_iter):
+            if num_batch is not None and i >= num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            out = mod.get_outputs()[0].asnumpy()
+            pad = getattr(batch, "pad", 0) or 0
+            n = out.shape[0] - pad
+            outputs.append(out[:n])
+            if return_data:
+                datas.append(batch.data[0].asnumpy()[:n])
+                labels.append(batch.label[0].asnumpy()[:n])
+        preds = _np.concatenate(outputs, axis=0)
+        if return_data:
+            return (preds, _np.concatenate(datas, axis=0),
+                    _np.concatenate(labels, axis=0))
+        return preds
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None,
+              reset=True):
+        from . import metric as metric_mod
+        from .io import DataIter
+
+        if not isinstance(X, DataIter) and y is None:
+            raise MXNetError(
+                "FeedForward.score needs labels: pass a labeled DataIter "
+                "or score(X, y)")
+        data_iter = X if isinstance(X, DataIter) \
+            else self._init_iter(X, y, is_train=False)
+        if reset:
+            data_iter.reset()
+        mod = self._bound_module(data_iter)
+        res = mod.score(data_iter, metric_mod.create(eval_metric),
+                        num_batch=num_batch, reset=reset)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, **kwargs):
+        """Train a new model from data (reference model.py:904)."""
+        fit_kwargs = {}
+        for k in ("eval_data", "eval_metric", "epoch_end_callback",
+                  "batch_end_callback", "kvstore", "logger",
+                  "work_load_list", "monitor", "eval_end_callback",
+                  "eval_batch_end_callback"):
+            if k in kwargs:
+                fit_kwargs[k] = kwargs.pop(k)
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, **kwargs)
+        model.fit(X, y, **fit_kwargs)
+        return model

@@ -1,0 +1,190 @@
+"""Decoder-only transformer LM — the long-context flagship model family.
+
+Beyond-reference (the 2017 reference predates transformers; its sequence
+story was bucketed LSTMs — SURVEY.md §5.7). Built TPU-first as a pure
+functional model over a parameter pytree:
+
+* attention runs the Pallas flash kernel on-chip (ops/pallas/attention.py)
+  — O(S·D) HBM, MXU-blocked;
+* with a mesh axis, the sequence dimension shards across devices and
+  attention becomes ring (ppermute KV rotation) or Ulysses (all_to_all) —
+  parallel/sequence.py — so context length scales with the mesh;
+* everything else (QKV/MLP matmuls) is mesh-agnostic jnp: under pjit the
+  XLA SPMD partitioner handles dp/tp sharding from the input/param specs.
+
+RoPE positions, pre-norm blocks, SwiGLU MLP — the standard public LM
+recipe (GPT-NeoX/LLaMA family), written fresh for this framework.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["TransformerConfig", "init_params", "forward", "lm_loss",
+           "TransformerLM"]
+
+
+class TransformerConfig:
+    def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
+                 d_model=512, d_ff=None, max_seq_len=2048,
+                 dtype="bfloat16", rope_theta=10000.0):
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.d_model = d_model
+        self.d_ff = d_ff or 4 * d_model
+        self.head_dim = d_model // num_heads
+        self.max_seq_len = max_seq_len
+        self.dtype = jnp.dtype(dtype)
+        self.rope_theta = rope_theta
+        if d_model % num_heads:
+            raise MXNetError(f"d_model {d_model} % num_heads {num_heads}")
+
+
+def init_params(rng_or_seed, cfg: TransformerConfig):
+    """Parameter pytree; layers stacked on a leading dim (scan-friendly,
+    and pipeline_apply-ready)."""
+    rng = (np.random.RandomState(rng_or_seed)
+           if isinstance(rng_or_seed, int) else rng_or_seed)
+    d, h, f, L = cfg.d_model, cfg.head_dim, cfg.d_ff, cfg.num_layers
+
+    def w(*shape, scale=None):
+        scale = scale if scale is not None else (2.0 / (shape[-2] + shape[-1])) ** 0.5
+        return jnp.asarray(
+            rng.normal(0, scale, shape).astype(np.float32))
+
+    return {
+        "embed": w(cfg.vocab_size, d, scale=0.02),
+        "blocks": {
+            "ln1": jnp.ones((L, d), jnp.float32),
+            "ln2": jnp.ones((L, d), jnp.float32),
+            "wq": w(L, d, d),
+            "wk": w(L, d, d),
+            "wv": w(L, d, d),
+            "wo": w(L, d, d),
+            "w_gate": w(L, d, f),
+            "w_up": w(L, d, f),
+            "w_down": w(L, f, d),
+        },
+        "ln_f": jnp.ones((d,), jnp.float32),
+        # LLaMA-style untied head
+        "head": w(d, cfg.vocab_size, scale=0.02),
+    }
+
+
+def _rmsnorm(x, g):
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * inv * g).astype(x.dtype)
+
+
+def _rope(x, theta, offset=0):
+    """Rotary embedding over (B, H, S, D_head)."""
+    b, h, s, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.arange(s, dtype=jnp.float32) + offset
+    ang = pos[:, None] * freqs[None, :]  # (S, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, cfg, mesh, seq_axis, seq_mode):
+    if mesh is not None and seq_axis is not None:
+        from ..parallel.sequence import sequence_sharded_attention
+        return sequence_sharded_attention(q, k, v, mesh, seq_axis,
+                                          causal=True, mode=seq_mode)
+    from ..ops.pallas.attention import flash_attention
+    return flash_attention(q, k, v, causal=True)
+
+
+def forward(params, tokens, cfg: TransformerConfig, mesh=None,
+            seq_axis: Optional[str] = None, seq_mode: str = "auto"):
+    """tokens (B, S) int32 -> logits (B, S, vocab).
+
+    With ``mesh``+``seq_axis``, attention runs sequence-parallel; shard
+    the token batch's S dim over that axis via with_sharding_constraint
+    outside, or let pjit propagate.
+    """
+    b, s = tokens.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    x = params["embed"][tokens].astype(cfg.dtype)  # (B, S, D)
+
+    def block(x, layer):
+        h = _rmsnorm(x, layer["ln1"])
+        q = (h @ layer["wq"].astype(cfg.dtype))
+        k = (h @ layer["wk"].astype(cfg.dtype))
+        v = (h @ layer["wv"].astype(cfg.dtype))
+
+        def heads(t):
+            return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        q = _rope(q, cfg.rope_theta)
+        k = _rope(k, cfg.rope_theta)
+        att = _attention(q, k, v, cfg, mesh, seq_axis, seq_mode)
+        att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        x = x + (att @ layer["wo"].astype(cfg.dtype))
+        h2 = _rmsnorm(x, layer["ln2"])
+        gate = jax.nn.silu(h2 @ layer["w_gate"].astype(cfg.dtype))
+        up = h2 @ layer["w_up"].astype(cfg.dtype)
+        x = x + ((gate * up) @ layer["w_down"].astype(cfg.dtype))
+        return x, None
+
+    # python loop over stacked layers: XLA unrolls; L is small and static.
+    # (lax.scan over layers conflicts with shard_map'd collectives inside.)
+    for i in range(cfg.num_layers):
+        layer = jax.tree.map(lambda p: p[i], params["blocks"])
+        x, _ = block(x, layer)
+    x = _rmsnorm(x, params["ln_f"])
+    return (x.astype(jnp.float32) @ params["head"])
+
+
+def lm_loss(params, tokens, cfg, mesh=None, seq_axis=None,
+            seq_mode="auto"):
+    """Next-token cross entropy; tokens (B, S+1)."""
+    logits = forward(params, tokens[:, :-1], cfg, mesh, seq_axis, seq_mode)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+class TransformerLM:
+    """Convenience wrapper: init / train_step / logits over the
+    functional model."""
+
+    def __init__(self, cfg: TransformerConfig, mesh=None, seq_axis=None,
+                 seq_mode="auto", seed=0):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+        self.seq_mode = seq_mode
+        self.params = init_params(seed, cfg)
+        self._loss_and_grad = jax.jit(jax.value_and_grad(
+            lambda p, t: lm_loss(p, t, cfg, mesh, seq_axis, seq_mode)))
+        self._fwd = jax.jit(
+            lambda p, t: forward(p, t, cfg, mesh, seq_axis, seq_mode))
+
+    def loss(self, tokens):
+        return lm_loss(self.params, jnp.asarray(tokens), self.cfg,
+                       self.mesh, self.seq_axis, self.seq_mode)
+
+    def train_step(self, tokens, lr=1e-3):
+        """Plain-SGD step (optimizers from mx.optimizer compose for real
+        training; this keeps the flagship self-contained)."""
+        loss, grads = self._loss_and_grad(self.params, jnp.asarray(tokens))
+        self.params = jax.tree.map(lambda p, g: p - lr * g, self.params,
+                                   grads)
+        return float(loss)
+
+    def logits(self, tokens):
+        return self._fwd(self.params, jnp.asarray(tokens))

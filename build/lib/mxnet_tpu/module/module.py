@@ -1,0 +1,420 @@
+"""Module: executor-backed trainer over one Symbol.
+
+Reference: python/mxnet/module/module.py — bind:351 (builds a
+DataParallelExecutorGroup), init_optimizer:460 with kvstore wiring
+:486-531, forward:556 / backward:598 / update:615. TPU-native shape: the
+executor-group-of-one-executor-per-device collapses into a single
+XLA-compiled executor; multi-device data parallelism is a sharded training
+step over the mesh (parallel/), not N executors (SURVEY.md §7.1 KVStore row).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..initializer import InitDesc, Uniform
+from ..io import DataDesc
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
+from ..ndarray.ndarray import _as_jax
+from .base_module import BaseModule, _check_input_names
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging, context=None,
+                 work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None):
+        super().__init__(logger=logger)
+        from ..context import current_context
+        if context is None:
+            context = current_context()
+        if isinstance(context, (list, tuple)):
+            self._context = list(context)
+        else:
+            self._context = [context]
+        self._symbol = symbol
+        # ctx_group -> Context placement map (reference Module group2ctxs;
+        # a list of per-device dicts there — one mesh-wide dict here)
+        if isinstance(group2ctxs, (list, tuple)):
+            group2ctxs = group2ctxs[0] if group2ctxs else None
+        self._group2ctxs = group2ctxs
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = (list(fixed_param_names)
+                             if fixed_param_names is not None else [])
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._monitor = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """reference: module.py Module.load"""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """reference: module.py:152 — adds .states with updater state."""
+        self._sync_params_from_devices()
+        save_checkpoint(prefix, epoch, self.symbol, *self.get_params())
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+
+    # -- shapes --------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        # shape inference, not execution: valid immediately after bind
+        # (reference reads the executor's inferred output shapes)
+        from ..io import DataDesc
+        shape_kwargs = {d.name: d.shape
+                        for d in self._data_shapes + self._label_shapes}
+        _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
+        return [DataDesc(n, tuple(s))
+                for n, s in zip(self._output_names, out_shapes)]
+
+    # -- params --------------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def _sync_params_from_devices(self):
+        if self._exec is None:
+            return
+        self._arg_params = {n: self._exec.arg_dict[n].copy()
+                            for n in self._param_names}
+        self._aux_params = {n: self._exec.aux_dict[n].copy()
+                            for n in self._aux_names}
+        self._params_dirty = False
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """reference: module.py:246"""
+        if self.params_initialized and not force_init:
+            logging.warning("Parameters already initialized and force_init=False. "
+                            "init_params call ignored.")
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        attrs = self._symbol.attr_dict()
+        for pname, layout in self._symbol._arg_layouts().items():
+            attrs.setdefault(pname, {})["__layout__"] = layout
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    cache_arr.copyto(arr)
+            else:
+                if not allow_missing:
+                    if initializer is None:
+                        raise RuntimeError(f"init failed: no initializer and "
+                                           f"param {name} missing")
+                    initializer(InitDesc(name, attrs.get(name)), arr)
+                elif initializer is not None:
+                    initializer(InitDesc(name, attrs.get(name)), arr)
+
+        for name in self._param_names:
+            _impl(name, self._exec.arg_dict[name], arg_params)
+        for name in self._aux_names:
+            _impl(name, self._exec.aux_dict[name], aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+
+    # -- bind ----------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """reference: module.py:351"""
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                       for x in data_shapes]
+        if label_shapes is not None:
+            label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                            for x in label_shapes]
+        else:
+            label_shapes = []
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+
+        shape_kwargs = {d.name: d.shape for d in data_shapes + label_shapes}
+        req = {}
+        for name in self._symbol.list_arguments():
+            if name in self._data_names:
+                req[name] = "write" if inputs_need_grad else "null"
+            elif name in self._label_names or name in self._state_names:
+                req[name] = "null"
+            elif name in self._fixed_param_names or not for_training:
+                req[name] = "null"
+            else:
+                req[name] = grad_req
+        shared_exec = shared_module._exec if shared_module is not None else None
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context[0], grad_req=req,
+            shared_exec=shared_exec, group2ctx=self._group2ctxs,
+            **shape_kwargs)
+        self.binded = True
+
+        if shared_module is not None and shared_module.params_initialized:
+            self.set_params(*shared_module.get_params())
+
+    # -- optimizer ------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """reference: module.py:460 (kvstore wiring :486-531)"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+        if self._params_dirty:
+            self._sync_params_from_devices()
+
+        arg_dict = {n: self._exec.arg_dict[n] for n in self._param_names}
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), arg_dict)
+        batch_size = self._data_shapes[0].shape[0]
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name, **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+            if optimizer.rescale_grad != rescale_grad:
+                self.logger.warning(
+                    "Optimizer created manually outside Module but rescale_grad "
+                    f"is not normalized to 1.0/batch_size/num_workers "
+                    f"({optimizer.rescale_grad} vs. {rescale_grad}). Is this "
+                    "intended?")
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore:
+            # copy initialized weights into the store
+            param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
+            _initialize_kvstore(kvstore=kvstore, param_arrays=param_arrays,
+                                arg_params=self._arg_params or
+                                {n: self._exec.arg_dict[n]
+                                 for n in self._param_names},
+                                param_names=self._param_names,
+                                update_on_kvstore=update_on_kvstore)
+        if update_on_kvstore:
+            kvstore.set_optimizer(self._optimizer)
+        else:
+            self._updater = opt.get_updater(optimizer)
+
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """Share another Module's optimizer/updater/kvstore (reference
+        module.py:borrow_optimizer — used by BucketingModule so all buckets
+        update through one optimizer state)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    # -- compute -------------------------------------------------------------
+    def _input_dict(self, data_batch):
+        inputs = {}
+        data = data_batch.data
+        if not isinstance(data, (list, tuple)):
+            data = [data]
+        for name, arr in zip(self._data_names, data):
+            inputs[name] = arr
+        label = data_batch.label
+        if label is not None and self._label_names:
+            if not isinstance(label, (list, tuple)):
+                label = [label]
+            for name, arr in zip(self._label_names, label):
+                inputs[name] = arr
+        return inputs
+
+    def forward(self, data_batch, is_train=None):
+        """reference: module.py:556"""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._exec.forward(is_train=is_train, **self._input_dict(data_batch))
+
+    def backward(self, out_grads=None):
+        """reference: module.py:598"""
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def forward_backward(self, data_batch):
+        """Fused path: one XLA program for fwd+bwd (avoids the recompute the
+        separate backward() entry pays)."""
+        assert self.binded and self.params_initialized
+        self._exec.forward_backward(**self._input_dict(data_batch))
+
+    def update(self):
+        """reference: module.py:615"""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._params_dirty = True
+        param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
+        grad_arrays = [self._exec.grad_dict.get(n) for n in self._param_names]
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(param_arrays, grad_arrays, self._kvstore,
+                                      self._param_names)
+        else:
+            _update_params(param_arrays, grad_arrays, updater=self._updater,
+                           num_device=len(self._context),
+                           kvstore=self._kvstore,
+                           param_names=self._param_names)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        if states is not None:
+            for name, s in zip(self._state_names, states):
+                self._exec.arg_dict[name]._set_data(
+                    _as_jax(s, dtype=self._exec.arg_dict[name].dtype))
+        else:
+            for name in self._state_names:
+                self._exec.arg_dict[name][:] = value
+
+    def update_metric(self, eval_metric, labels):
+        """reference: base_module.py:895 — metric consumes outputs lazily."""
+        if labels is None:
+            labels = []
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        assert self.binded
+        self._monitor = mon
+        mon.install(self._exec)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        # dump_optimizer=True also persists per-index update counts
+        # (Adam/rmsprop bias correction), so resumed training follows the
+        # uninterrupted trajectory — the reference loses these (its
+        # .states holds only the state arrays)
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states(dump_optimizer=True))
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                       for x in data_shapes]
+        if label_shapes is not None:
+            label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                            for x in label_shapes]
+        else:
+            label_shapes = []
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        shape_kwargs = {d.name: d.shape for d in data_shapes + label_shapes}
+        self._exec = self._exec.reshape(**shape_kwargs)

@@ -1,0 +1,77 @@
+"""Monitor: per-node output statistics during training, for debugging.
+
+Reference surface: python/mxnet/monitor.py — ``Monitor(interval, stat_func,
+pattern, sort)``, ``install(exe)``, ``tic/toc/toc_print``. The reference
+installs a C callback fired on every op output; here ``toc`` pulls every
+graph-internal output from the executor's compiled internals program
+(Executor.internal_outputs) and applies the stat function to names
+matching ``pattern`` — same observable surface, sampled at toc time.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import List
+
+from .base import MXNetError
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):  # reference default: mean |x|
+                return x.abs().mean() if hasattr(x, "abs") else abs(x).mean()
+        self.interval = interval
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.exes: List = []
+        self.activated = False
+        self.step = 0
+        self.queue = []
+
+    def install(self, exe):
+        """Attach to an executor (reference: exe.set_monitor_callback)."""
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval has elapsed."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Collect stats from all installed executors; returns
+        [(step, name, stat_str)]."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            try:
+                internals = exe.internal_outputs()
+            except MXNetError:
+                continue  # executor not yet run
+            for name, arr in internals.items():
+                if self.re_pattern.match(name):
+                    self.queue.append(
+                        (self.step, name, self.stat_func(arr)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if not isinstance(v_list, (list, tuple)):
+                v_list = [v_list]
+            for v in v_list:
+                res.append((n, k, str(v)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and log the stats (reference: logging.info per stat)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)
+        return res

@@ -1,0 +1,9 @@
+"""Automatic naming for symbols (reference: python/mxnet/name.py).
+
+``NameManager``/``Prefix`` live in symbol/symbol.py (they are load-bearing
+for symbol creation); this module mirrors the reference's import location
+so ``mx.name.Prefix('net_')`` works as documented.
+"""
+from .symbol.symbol import NameManager, Prefix
+
+__all__ = ["NameManager", "Prefix"]

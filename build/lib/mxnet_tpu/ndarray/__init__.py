@@ -1,0 +1,112 @@
+"""The ``nd`` namespace: NDArray plus op functions generated from the table.
+
+Reference analogue: python/mxnet/ndarray/op.py:51 ``_make_ndarray_function`` —
+the reference code-generates its NDArray op functions at import time from the
+C op registry; here they are generated from the declarative OP_TABLE.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..base import MXNetError
+from ..ops.registry import OP_TABLE, OpDef, resolve_inputs
+from .ndarray import (  # noqa: F401
+    NDArray,
+    add,
+    arange,
+    array,
+    concatenate,
+    divide,
+    empty,
+    equal,
+    full,
+    greater,
+    greater_equal,
+    imdecode,
+    imperative_invoke,
+    lesser,
+    lesser_equal,
+    load,
+    maximum,
+    minimum,
+    modulo,
+    moveaxis,
+    multiply,
+    not_equal,
+    ones,
+    ones_like,
+    onehot_encode,
+    power,
+    save,
+    subtract,
+    true_divide,
+    waitall,
+    zeros,
+    zeros_like,
+)
+
+
+def _make_op_func(opdef: OpDef, name: str):
+    def op_func(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        inputs = resolve_inputs(opdef, args, kwargs, name)
+        res = imperative_invoke(opdef, inputs, kwargs, out=out)
+        if out is not None:
+            return out if not isinstance(out, (list, tuple)) else res
+        return res[0] if len(res) == 1 else res
+
+    op_func.__name__ = name
+    op_func.__doc__ = (opdef.fn.__doc__ or "") + (
+        f"\n\nParameters: {sorted(opdef.attr_spec.fields)}"
+        f"\nInputs: {opdef.input_names or ['data']}"
+    )
+    return op_func
+
+
+from . import sparse  # noqa: F401,E402
+from .sparse import CSRNDArray, RowSparseNDArray  # noqa: F401,E402
+
+_mod = _sys.modules[__name__]
+for _name, _opdef in OP_TABLE.items():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_op_func(_opdef, _name))
+
+del _mod, _name, _opdef
+
+from . import contrib  # noqa: F401,E402
+
+
+# -- host-side imaging + sparse conveniences (reference _internal cv ops and
+# sparse module-level functions) --------------------------------------------
+
+def _cvimdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer (reference src/io/image_io.cc
+    _cvimdecode; host-side, not jittable)."""
+    from .. import image as _image
+    return _image.imdecode(buf, flag=flag, to_rgb=to_rgb, out=out)
+
+
+def _cvimread(filename, flag=1, to_rgb=True):
+    """Read + decode an image file (reference image_io.cc _cvimread)."""
+    from .. import image as _image
+    return _image.imread(filename, flag=flag, to_rgb=to_rgb)
+
+
+def cast_storage(data, stype):
+    """Cast between dense/row_sparse/csr storage (reference
+    src/operator/tensor/cast_storage-inl.h; here a dispatch over the
+    sparse wrapper types)."""
+    return data.tostype(stype)
+
+
+def sparse_retain(data, indices):
+    """Retain the listed rows of a row_sparse array, zeroing the rest
+    (reference tensor/sparse_retain-inl.h)."""
+    if not hasattr(data, "retain"):
+        raise MXNetError(
+            f"sparse_retain expects a RowSparseNDArray, got {type(data)}")
+    return data.retain(indices)
+
+
+_sparse_retain = sparse_retain

@@ -1,0 +1,11 @@
+"""``nd.contrib`` namespace: ops registered with a ``_contrib_`` prefix.
+
+Reference analogue: python/mxnet/ndarray/op.py routes C-registry ops whose
+name starts with ``_contrib_`` into the ``mxnet.ndarray.contrib`` module.
+"""
+import sys as _sys
+
+from ..ops.registry import populate_contrib
+
+populate_contrib(_sys.modules[__name__.rsplit(".", 1)[0]],
+                 _sys.modules[__name__])

@@ -1,0 +1,600 @@
+"""Sparse NDArrays: CSR and row-sparse storage on TPU.
+
+Reference surface: python/mxnet/ndarray/sparse.py (CSRNDArray,
+RowSparseNDArray, 923 LoC) over the C++ storage types
+(include/mxnet/ndarray.h:82-87 kDefaultStorage/kRowSparseStorage/
+kCSRStorage) and the sparse kernels in src/operator/tensor/
+(cast_storage-inl.h, sparse_retain, dot-inl.h CSR·dense, square_sum-inl.h).
+
+TPU-native design, NOT a port of the CUDA kernels:
+
+* storage = plain jax arrays per component (``data``/``indices``/``indptr``),
+  so the values participate in XLA fusion like any other array;
+* index-structure manipulation (union of row sets, sorting, dedup) runs
+  host-side in numpy — this is the eager API, structure is data-dependent
+  and tiny next to the values;
+* every dense operator works on sparse inputs through densification —
+  the rebuild of the reference's dense-fallback executor
+  (src/executor/attach_op_execs_pass.cc:47 StorageFallbackOpExecutor);
+* the sparse-critical kernels (CSR·dense dot, sparse_retain, lazy
+  row-sparse optimizer updates) get real sparse fast paths built on
+  gather + ``jax.ops.segment_sum``, which XLA lowers well on TPU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from .ndarray import NDArray, array as _dense_array, imperative_invoke
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "array", "zeros", "empty",
+           "cast_storage", "sparse_retain", "dot", "add", "retain",
+           "sgd_update", "sgd_mom_update", "adam_update", "adagrad_update",
+           "ftrl_update", "_square_sum", "elemwise_add", "todense"]
+
+_STYPES = ("default", "row_sparse", "csr")
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base of CSRNDArray / RowSparseNDArray.
+
+    Reference: sparse.py BaseSparseNDArray. Dense-view is materialised
+    lazily (``_dense``); generic ops consume it via the inherited ``_data``
+    protocol, which is exactly the reference's storage-fallback behavior.
+    """
+
+    __slots__ = ("_sp_shape", "_sp_dtype", "_dense")
+
+    def __init__(self, shape, dtype):
+        self._sp_shape = tuple(int(s) for s in shape)
+        self._sp_dtype = _np.dtype(dtype)
+        self._dense = None
+        # init NDArray slots without touching _data (which we shadow)
+        self._ctx = None
+        self._grad_buf = None
+        self._grad_req = "null"
+        self._ag_node = None
+        self._ag_out_index = 0
+
+    # _data shadows the parent slot: reading densifies (fallback path)
+    @property
+    def _data(self):
+        if self._dense is None:
+            self._dense = self._make_dense()
+        return self._dense
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return self._sp_dtype
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._sp_shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._sp_shape)
+
+    def _set_data(self, new_data):
+        raise MXNetError(f"in-place assignment to a {self.stype} NDArray is "
+                         "not supported; cast to dense first (tostype)")
+
+    def __setitem__(self, key, value):
+        raise MXNetError(f"{type(self).__name__} does not support "
+                         "item assignment")
+
+    def todense(self) -> NDArray:
+        return NDArray(self._data)
+
+    def asnumpy(self):
+        return _np.asarray(jax.device_get(self._data))
+
+    def wait_to_read(self):
+        for c in self._components():
+            c.block_until_ready()
+        return self
+
+    def copy(self):
+        return self
+
+    def as_in_context(self, ctx: Context):
+        return self
+
+    def _make_dense(self):
+        raise NotImplementedError
+
+    def _components(self):
+        raise NotImplementedError
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed-sparse-row array (reference: sparse.py CSRNDArray).
+
+    Components: ``data`` (nnz,), ``indices`` (nnz,) column ids,
+    ``indptr`` (rows+1,).
+    """
+
+    __slots__ = ("_d", "_i", "_p")
+
+    def __init__(self, data, indices, indptr, shape, dtype=None):
+        data = jnp.asarray(data)
+        if dtype is not None:
+            data = data.astype(jnp.dtype(dtype))
+        super().__init__(shape, str(data.dtype))
+        if len(shape) != 2:
+            raise MXNetError("csr storage is 2-D only")
+        self._d = data
+        self._i = jnp.asarray(indices, dtype=jnp.int32)
+        self._p = jnp.asarray(indptr, dtype=jnp.int32)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._d)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._i)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._p)
+
+    def _components(self):
+        return (self._d, self._i, self._p)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._d.shape[0])
+
+    def _row_ids(self):
+        """Expand indptr to a per-nonzero row id vector (host side)."""
+        indptr = _np.asarray(self._p)
+        counts = _np.diff(indptr)
+        return _np.repeat(_np.arange(self.shape[0], dtype=_np.int64), counts)
+
+    def _make_dense(self):
+        rows = jnp.asarray(self._row_ids())
+        out = jnp.zeros(self.shape, dtype=self._d.dtype)
+        return out.at[rows, self._i].add(self._d)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError("cast_storage from csr to row_sparse is not "
+                         "supported (same restriction as the reference, "
+                         "src/operator/tensor/cast_storage.cc)")
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self.shape[0])
+            if step != 1:
+                raise MXNetError("csr slicing supports step=1 only")
+            indptr = _np.asarray(self._p)
+            lo, hi = int(indptr[start]), int(indptr[stop])
+            new_ptr = indptr[start:stop + 1] - indptr[start]
+            return CSRNDArray(self._d[lo:hi], self._i[lo:hi], new_ptr,
+                              (stop - start, self.shape[1]))
+        if isinstance(key, int):
+            return self[key:key + 1]
+        raise MXNetError("csr indexing supports int/slice only")
+
+    def __repr__(self):
+        return (f"<CSRNDArray {self.shape[0]}x{self.shape[1]} "
+                f"nnz={self.nnz} @{self.context}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: a subset of rows is stored (reference: sparse.py
+    RowSparseNDArray — the storage type of embedding gradients).
+
+    Components: ``indices`` (nrows_nz,) sorted unique row ids, ``data``
+    (nrows_nz, *row_shape).
+    """
+
+    __slots__ = ("_d", "_i")
+
+    def __init__(self, data, indices, shape, dtype=None):
+        data = jnp.asarray(data)
+        if dtype is not None:
+            data = data.astype(jnp.dtype(dtype))
+        super().__init__(shape, str(data.dtype))
+        self._d = data
+        self._i = jnp.asarray(indices, dtype=jnp.int32)
+        if self._i.ndim != 1:
+            raise MXNetError("row_sparse indices must be 1-D")
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._d)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._i)
+
+    def _components(self):
+        return (self._d, self._i)
+
+    def _make_dense(self):
+        out = jnp.zeros(self.shape, dtype=self._d.dtype)
+        if self._i.shape[0] == 0:
+            return out
+        return out.at[self._i].add(self._d)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError("cast_storage from row_sparse to csr is not "
+                         "supported")
+
+    def retain(self, row_ids):
+        return sparse_retain(self, row_ids)
+
+    def __repr__(self):
+        return (f"<RowSparseNDArray {'x'.join(map(str, self.shape))} "
+                f"rows={int(self._i.shape[0])} @{self.context}>")
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference: sparse.py csr_matrix:?, row_sparse_array, zeros)
+# ---------------------------------------------------------------------------
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """Create a CSRNDArray from (data, indices, indptr), a dense source, or
+    another CSRNDArray (reference: sparse.py csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+        if dtype is None:
+            dtype = data.dtype if data.dtype != _np.float64 else _np.float32
+        indices = (indices.asnumpy() if isinstance(indices, NDArray)
+                   else _np.asarray(indices))
+        indptr = (indptr.asnumpy() if isinstance(indptr, NDArray)
+                  else _np.asarray(indptr))
+        if shape is None:
+            ncols = int(indices.max()) + 1 if indices.size else 0
+            shape = (len(indptr) - 1, ncols)
+        return CSRNDArray(data, indices, indptr, shape, dtype=dtype)
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2:  # (M, N) empty
+        return zeros("csr", arg1, ctx=ctx, dtype=dtype)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return _dense_to_csr(dense, dtype=dtype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    """Create a RowSparseNDArray from (data, indices), a dense source, or
+    another RowSparseNDArray (reference: sparse.py row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not hasattr(arg1[0], "ndim") \
+            and isinstance(arg1[0], int):
+        return zeros("row_sparse", arg1, ctx=ctx, dtype=dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+        if dtype is None:
+            dtype = data.dtype if data.dtype != _np.float64 else _np.float32
+        indices = (indices.asnumpy() if isinstance(indices, NDArray)
+                   else _np.asarray(indices, dtype=_np.int64))
+        if shape is None:
+            nrows = int(indices.max()) + 1 if indices.size else 0
+            shape = (nrows,) + tuple(data.shape[1:])
+        return RowSparseNDArray(data, indices, shape, dtype=dtype)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return _dense_to_rsp(dense, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, CSRNDArray):
+        return source_array
+    if isinstance(source_array, RowSparseNDArray):
+        return source_array
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(source_array):
+            csr = source_array.tocsr()
+            return CSRNDArray(csr.data, csr.indices, csr.indptr, csr.shape,
+                              dtype=dtype or csr.dtype)
+    except ImportError:
+        pass
+    raise MXNetError("sparse.array expects a sparse input; use "
+                     "csr_matrix/row_sparse_array for dense sources")
+
+
+def zeros(stype, shape, ctx=None, dtype=None, **kw):
+    dtype = dtype or "float32"
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), dtype), _np.zeros((0,), _np.int64),
+                          _np.zeros((shape[0] + 1,), _np.int64), shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            _np.zeros((0,) + tuple(shape[1:]), dtype),
+            _np.zeros((0,), _np.int64), shape)
+    if stype == "default":
+        from . import ndarray as _nd
+        return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown storage type {stype}")
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def _dense_to_csr(dense: _np.ndarray, dtype=None) -> CSRNDArray:
+    if dense.ndim != 2:
+        raise MXNetError("csr storage is 2-D only")
+    if dtype is None:
+        dtype = dense.dtype if dense.dtype != _np.float64 else _np.float32
+    mask = dense != 0
+    indptr = _np.concatenate([[0], _np.cumsum(mask.sum(axis=1))]).astype(_np.int64)
+    rows, cols = _np.nonzero(mask)
+    return CSRNDArray(dense[rows, cols].astype(dtype), cols, indptr,
+                      dense.shape)
+
+
+def _dense_to_rsp(dense: _np.ndarray, dtype=None) -> RowSparseNDArray:
+    if dtype is None:
+        dtype = dense.dtype if dense.dtype != _np.float64 else _np.float32
+    flat = dense.reshape(dense.shape[0], -1)
+    nz_rows = _np.nonzero((flat != 0).any(axis=1))[0].astype(_np.int64)
+    return RowSparseNDArray(dense[nz_rows].astype(dtype), nz_rows, dense.shape)
+
+
+# ---------------------------------------------------------------------------
+# sparse operators
+# ---------------------------------------------------------------------------
+
+
+def cast_storage(arr: NDArray, stype: str) -> NDArray:
+    """Convert between storage types (reference:
+    src/operator/tensor/cast_storage-inl.h)."""
+    if stype not in _STYPES:
+        raise MXNetError(f"unknown storage type {stype}")
+    if arr.stype == stype:
+        return arr
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    dense = arr.asnumpy()
+    if stype == "csr":
+        return _dense_to_csr(dense, dtype=arr.dtype)
+    if stype == "row_sparse":
+        return _dense_to_rsp(dense, dtype=arr.dtype)
+    return arr
+
+
+def todense(arr) -> NDArray:
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.todense()
+    return arr
+
+
+def sparse_retain(rsp: RowSparseNDArray, row_ids) -> RowSparseNDArray:
+    """Keep only the requested rows (reference:
+    src/operator/tensor/sparse_retain.cc) — the kernel behind
+    kvstore row_sparse_pull."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects a RowSparseNDArray")
+    ids = (row_ids.asnumpy() if isinstance(row_ids, NDArray)
+           else _np.asarray(row_ids)).astype(_np.int64).ravel()
+    ids = _np.unique(ids)
+    have = _np.asarray(rsp._i)
+    keep_mask = _np.isin(have, ids)
+    keep = _np.nonzero(keep_mask)[0]
+    return RowSparseNDArray(rsp._d[jnp.asarray(keep)], have[keep], rsp.shape)
+
+
+def _square_sum(rsp: RowSparseNDArray, axis=None, keepdims=False) -> NDArray:
+    """sum(rsp**2) touching only stored rows (reference:
+    src/operator/tensor/square_sum-inl.h)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        return imperative_invoke("sum", [NDArray(rsp._data ** 2)],
+                                 {"axis": axis, "keepdims": keepdims})[0]
+    sq = rsp._d * rsp._d
+    if axis is None:
+        return NDArray(jnp.sum(sq))
+    if axis in (1, (1,)):
+        out = jnp.zeros((rsp.shape[0],) + (() if not keepdims else (1,)),
+                        dtype=rsp._d.dtype)
+        red = jnp.sum(sq.reshape(sq.shape[0], -1), axis=1)
+        if keepdims:
+            red = red[:, None]
+        return NDArray(out.at[rsp._i].set(red))
+    return NDArray(jnp.sum(jnp.zeros(rsp.shape, rsp._d.dtype).at[rsp._i]
+                           .set(sq), axis=axis, keepdims=keepdims))
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: src/operator/tensor/dot-inl.h).
+
+    Fast paths:
+      dot(csr, dense)    -> dense, via gather + segment_sum over nonzeros
+      dot(csr.T, dense)  -> row_sparse (rows = touched columns of the csr)
+    Everything else falls back to dense dot — same policy as the
+    reference's storage-fallback.
+    """
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray) \
+            and not transpose_b:
+        rows = jnp.asarray(lhs._row_ids())
+        gathered = rhs._data[lhs._i]           # (nnz, N)
+        contrib = lhs._d[:, None] * gathered
+        if not transpose_a:
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=lhs.shape[0])
+            return NDArray(out)
+        # dot(csr.T, dense): scatter contributions of dense rows into
+        # the csr's column space; emit row_sparse like the reference
+        contrib_t = lhs._d[:, None] * rhs._data[rows]
+        out = jax.ops.segment_sum(contrib_t, lhs._i.astype(jnp.int32),
+                                  num_segments=lhs.shape[1])
+        nz = _np.unique(_np.asarray(lhs._i))
+        return RowSparseNDArray(out[jnp.asarray(nz)], nz,
+                                (lhs.shape[1], rhs.shape[1]))
+    a = lhs._data if isinstance(lhs, NDArray) else jnp.asarray(lhs)
+    b = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    return NDArray(jnp.dot(a, b))
+
+
+def _merge_rsp(a: RowSparseNDArray, b: RowSparseNDArray) -> RowSparseNDArray:
+    """rsp + rsp -> rsp over the union of row sets."""
+    ia, ib = _np.asarray(a._i), _np.asarray(b._i)
+    union = _np.union1d(ia, ib)
+    pos = {int(r): k for k, r in enumerate(union)}
+    pa = jnp.asarray(_np.array([pos[int(r)] for r in ia], dtype=_np.int32))
+    pb = jnp.asarray(_np.array([pos[int(r)] for r in ib], dtype=_np.int32))
+    out = jnp.zeros((len(union),) + tuple(a.shape[1:]), dtype=a._d.dtype)
+    out = out.at[pa].add(a._d).at[pb].add(b._d)
+    return RowSparseNDArray(out, union, a.shape)
+
+
+def elemwise_add(lhs, rhs):
+    """add with storage-type dispatch (reference: elemwise_binary_op_basic.cc
+    FComputeEx rsp+rsp)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray) \
+            and lhs.shape == rhs.shape:
+        return _merge_rsp(lhs, rhs)
+    return imperative_invoke("elemwise_add",
+                             [todense(lhs), todense(rhs)], {})[0]
+
+
+add = elemwise_add
+retain = sparse_retain
+
+
+# ---------------------------------------------------------------------------
+# lazy row-sparse optimizer updates (reference: src/operator/optimizer_op.cc
+# SGDUpdateRspRspImpl etc. — "lazy update": only rows present in the sparse
+# gradient are touched, including their momentum/state rows)
+# ---------------------------------------------------------------------------
+
+
+def _prep_grad(grad: RowSparseNDArray, rescale_grad, clip_gradient):
+    g = grad._d * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g, grad._i
+
+
+def _write_rows(tgt, dense_view, rows, new_rows):
+    """Write updated rows back into ``tgt``.
+
+    Dense target: scatter into the full array. RowSparse target (sparse-
+    stored weights/states, the reference's primary rsp use case): merge
+    the rows into the component storage without materialising dense.
+    """
+    if isinstance(tgt, RowSparseNDArray):
+        have = _np.asarray(tgt._i)
+        upd = _np.asarray(rows)
+        union = _np.union1d(have, upd)
+        pos = {int(r): k for k, r in enumerate(union)}
+        out = jnp.zeros((len(union),) + tuple(tgt.shape[1:]),
+                        dtype=tgt._d.dtype)
+        if have.size:
+            p_have = jnp.asarray(
+                _np.array([pos[int(r)] for r in have], _np.int32))
+            out = out.at[p_have].set(tgt._d)
+        p_upd = jnp.asarray(_np.array([pos[int(r)] for r in upd], _np.int32))
+        out = out.at[p_upd].set(new_rows.astype(tgt._d.dtype))
+        tgt._d, tgt._i = out, jnp.asarray(union, dtype=jnp.int32)
+        tgt._dense = None
+        return tgt
+    tgt._set_data(dense_view.at[rows].set(new_rows.astype(dense_view.dtype)))
+    return tgt
+
+
+def sgd_update(weight: NDArray, grad: RowSparseNDArray, lr, wd=0.0,
+               rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    g, rows = _prep_grad(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    wr = w[rows]
+    new_rows = wr - lr * (g + wd * wr)
+    return _write_rows(out if out is not None else weight, w, rows, new_rows)
+
+
+def sgd_mom_update(weight: NDArray, grad: RowSparseNDArray, mom: NDArray,
+                   lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None):
+    g, rows = _prep_grad(grad, rescale_grad, clip_gradient)
+    w, m = weight._data, mom._data
+    wr, mr = w[rows], m[rows]
+    new_m = momentum * mr - lr * (g + wd * wr)
+    _write_rows(mom, m, rows, new_m)
+    return _write_rows(out if out is not None else weight, w, rows,
+                       wr + new_m)
+
+
+def adam_update(weight: NDArray, grad: RowSparseNDArray, mean: NDArray,
+                var: NDArray, lr, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    g, rows = _prep_grad(grad, rescale_grad, clip_gradient)
+    w, m, v = weight._data, mean._data, var._data
+    wr = w[rows]
+    g = g + wd * wr
+    new_m = beta1 * m[rows] + (1 - beta1) * g
+    new_v = beta2 * v[rows] + (1 - beta2) * g * g
+    new_w = wr - lr * new_m / (jnp.sqrt(new_v) + epsilon)
+    _write_rows(mean, m, rows, new_m)
+    _write_rows(var, v, rows, new_v)
+    return _write_rows(out if out is not None else weight, w, rows, new_w)
+
+
+def adagrad_update(weight: NDArray, grad: RowSparseNDArray, history: NDArray,
+                   lr, epsilon=1e-7, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None):
+    g, rows = _prep_grad(grad, rescale_grad, clip_gradient)
+    w, h = weight._data, history._data
+    new_h = h[rows] + g * g
+    new_w = w[rows] - lr * (g / jnp.sqrt(new_h + epsilon) + wd * w[rows])
+    _write_rows(history, h, rows, new_h)
+    return _write_rows(out if out is not None else weight, w, rows, new_w)
+
+
+def ftrl_update(weight: NDArray, grad: RowSparseNDArray, z: NDArray,
+                n: NDArray, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    g, rows = _prep_grad(grad, rescale_grad, clip_gradient)
+    wv, zv, nv = weight._data, z._data, n._data
+    nr = nv[rows]
+    new_n = nr + g * g
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(nr)) / lr
+    new_z = zv[rows] + g - sigma * wv[rows]
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(new_z),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    _write_rows(z, zv, rows, new_z)
+    _write_rows(n, nv, rows, new_n)
+    return _write_rows(out if out is not None else weight, wv, rows, new_w)

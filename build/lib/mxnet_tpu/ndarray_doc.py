@@ -1,0 +1,42 @@
+"""Extra docstrings for NDArray ops (reference: python/mxnet/ndarray_doc.py).
+
+The reference attaches hand-written example sections to generated op
+functions; here op docs come from the declarative OP_TABLE, and
+``_build_doc`` composes the same final format.
+"""
+from __future__ import annotations
+
+__all__ = ["NDArrayDoc", "_build_doc"]
+
+
+class NDArrayDoc:
+    """Subclass and name the class ``<op>Doc`` to attach extra examples to
+    op ``<op>``'s docstring."""
+
+
+def _extra_doc(func_name):
+    for cls in NDArrayDoc.__subclasses__():
+        if cls.__name__ == f"{func_name}Doc" and cls.__doc__:
+            return cls.__doc__
+    return ""
+
+
+def _build_doc(func_name, desc, arg_names, arg_types, arg_desc,
+               key_var_num_args=None, ret_type=None):
+    """Build a numpy-style docstring for a generated op function."""
+    lines = [desc or func_name, "", "Parameters", "----------"]
+    for name, typ, adesc in zip(arg_names, arg_types, arg_desc):
+        lines.append(f"{name} : {typ}")
+        if adesc:
+            lines.append(f"    {adesc}")
+    if key_var_num_args:
+        lines.append(f"{key_var_num_args} : int")
+        lines.append("    Number of variadic positional inputs.")
+    lines += ["out : NDArray, optional", "    The output NDArray to hold "
+              "the result.", "", "Returns", "-------",
+              f"out : {ret_type or 'NDArray or list of NDArrays'}",
+              "    The output of this function."]
+    extra = _extra_doc(func_name)
+    if extra:
+        lines += ["", extra]
+    return "\n".join(lines)

@@ -1,0 +1,2 @@
+"""Jupyter-notebook helpers (reference: python/mxnet/notebook/)."""
+from . import callback  # noqa: F401

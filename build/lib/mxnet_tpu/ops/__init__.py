@@ -1,0 +1,20 @@
+"""Operator library: one declarative table drives nd.* and sym.* namespaces.
+
+Importing this package populates the registry (reference analogue: static
+NNVM_REGISTER_OP initializers across src/operator/ executed at dlopen time).
+"""
+from . import contrib_ops  # noqa: F401
+from . import contrib_tail_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
+from . import spatial_ops  # noqa: F401
+from . import custom_op  # noqa: F401
+from . import compat_ops  # noqa: F401
+from . import torch_ops  # noqa: F401
+from . import pallas  # noqa: F401  (flash attention + fused LSTM cell)
+from . import tensor_ops  # noqa: F401
+from .registry import OP_TABLE, OpDef, get_op, list_ops, register  # noqa: F401

@@ -1,0 +1,157 @@
+"""Compatibility tail ops: legacy *_v1 names, plugin ops, internal helpers.
+
+Reference surfaces covered here:
+- ``*_v1`` legacy op generations (src/operator/batch_norm_v1.cc,
+  convolution_v1? — in v0.11 these are the pre-refactor registrations kept
+  for old graphs; same math, fewer options) → aliases of the current ops.
+- ``WarpCTC`` (plugin/warpctc/warpctc-inl.h) — softmax forward, CTC
+  gradient backward with fixed ``input_length``/``label_length`` and
+  blank=0.
+- ``_slice_assign`` / ``_slice_assign_scalar`` (+ ``_crop_assign*``
+  aliases, src/operator/tensor/matrix_op.cc) — the ops behind sliced
+  ``x[a:b] = v`` writes.
+- ``_identity_with_attr_like_rhs`` (tensor/elemwise_unary_op.cc) — identity
+  on lhs used by sparse gradient plumbing.
+- ``_NoGradient`` / ``_CrossDeviceCopy`` — graph-internal nodes; gradient
+  stop is BlockGrad's jax.lax.stop_gradient, device copy is a no-op under
+  XLA (sharding constraints handle placement).
+- ``_cvimresize`` / ``_cvcopyMakeBorder`` (src/io/image_io.cc:405) — the
+  OpenCV-backed imaging ops, here jax.image.resize / jnp.pad.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base import AttrSpec
+from .contrib_ops import _ctc_forward
+from .registry import alias, register
+
+# -- legacy generations (same kernels; old graphs keep loading) -------------
+alias("BatchNorm_v1", "BatchNorm")
+alias("Convolution_v1", "Convolution")
+alias("Pooling_v1", "Pooling")
+alias("_NoGradient", "BlockGrad")
+alias("_CrossDeviceCopy", "identity")
+
+
+# -- WarpCTC (plugin/warpctc) ----------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _warpctc_core(data, label, label_length, input_length):
+    return jax.nn.softmax(data.astype(jnp.float32), axis=-1)
+
+
+def _warpctc_fwd(data, label, label_length, input_length):
+    out = jax.nn.softmax(data.astype(jnp.float32), axis=-1)
+    return out, (data, label)
+
+
+def _warpctc_bwd(label_length, input_length, res, g):
+    # like the plugin: the CTC gradient replaces chain-rule backprop
+    # (loss-style op; incoming cotangent is ignored — warpctc-inl.h Backward)
+    data, label = res
+    t = int(input_length)
+    n = data.shape[0] // t
+    c = data.shape[1]
+    lab = label.reshape(n, int(label_length)).astype(jnp.int32)
+
+    def total_loss(acts):
+        logp = jax.nn.log_softmax(
+            acts.astype(jnp.float32).reshape(t, n, c), axis=-1)
+        logp = jnp.transpose(logp, (1, 0, 2))  # (N, T, C)
+        data_len = jnp.full((n,), t, jnp.int32)
+        # label length = number of non-blank entries (blank=0), as the
+        # plugin's labelLengths()
+        label_len = jnp.sum(lab != 0, axis=1).astype(jnp.int32)
+        # compact non-blank labels to the front (removeBlank)
+        order = jnp.argsort(lab == 0, axis=1, stable=True)
+        compact = jnp.take_along_axis(lab, order, axis=1)
+        return jnp.sum(jax.vmap(_ctc_forward)(logp, compact, data_len,
+                                              label_len))
+
+    grad = jax.grad(total_loss)(data).reshape(data.shape)
+    return grad.astype(data.dtype), jnp.zeros_like(label)
+
+
+_warpctc_core.defvjp(_warpctc_fwd, _warpctc_bwd)
+
+
+@register("WarpCTC", num_inputs=2, input_names=["data", "label"],
+          attrs=AttrSpec(label_length=("int", 0), input_length=("int", 0)))
+def _warpctc(data, label, label_length=0, input_length=0):
+    """WarpCTC loss layer (plugin/warpctc/warpctc-inl.h): data ((T*N), C)
+    time-major flattened activations, label (N*label_length,) with blank=0
+    padding. Forward emits softmax; backward the CTC gradient."""
+    return _warpctc_core(data, label, label_length, input_length)
+
+
+# -- sliced assignment (matrix_op.cc _slice_assign family) ------------------
+
+def _assign_index(shape, begin, end):
+    idx = tuple(
+        slice(b if b is not None else 0,
+              e if e is not None else shape[i])
+        for i, (b, e) in enumerate(zip(begin, end)))
+    return idx
+
+
+@register("_slice_assign", aliases=["_crop_assign"], num_inputs=2,
+          input_names=["lhs", "rhs"],
+          attrs=AttrSpec(begin=("tuple",), end=("tuple",)))
+def _slice_assign(lhs, rhs, begin, end):
+    """Return lhs with lhs[begin:end] = rhs (the op behind sliced
+    ``__setitem__``, matrix_op.cc)."""
+    return lhs.at[_assign_index(lhs.shape, begin, end)].set(
+        rhs.astype(lhs.dtype))
+
+
+@register("_slice_assign_scalar", aliases=["_crop_assign_scalar"],
+          num_inputs=1, input_names=["data"],
+          attrs=AttrSpec(scalar=("float", 0.0), begin=("tuple",),
+                         end=("tuple",)))
+def _slice_assign_scalar(data, scalar, begin, end):
+    return data.at[_assign_index(data.shape, begin, end)].set(scalar)
+
+
+@register("_identity_with_attr_like_rhs", num_inputs=2,
+          input_names=["lhs", "rhs"])
+def _identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs carrying rhs's storage attrs (sparse plumbing,
+    elemwise_unary_op.cc); dense-on-XLA this is lhs."""
+    return lhs
+
+
+# -- imaging ops (image_io.cc — OpenCV there, XLA here) ---------------------
+
+@register("_cvimresize", aliases=["imresize"], num_inputs=1,
+          input_names=["src"],
+          attrs=AttrSpec(w=("int",), h=("int",), interp=("int", 1)))
+def _cvimresize(src, w, h, interp=1):
+    """Resize an HWC uint8/float image (image_io.cc imresize). interp
+    follows cv2 codes: 0 nearest, 1 bilinear, 2 bicubic (area/lanczos fall
+    back to bilinear)."""
+    method = {0: "nearest", 1: "linear", 2: "cubic"}.get(int(interp),
+                                                         "linear")
+    out = jax.image.resize(src.astype(jnp.float32),
+                           (h, w) + tuple(src.shape[2:]), method=method)
+    if jnp.issubdtype(src.dtype, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return out.astype(src.dtype)
+
+
+@register("_cvcopyMakeBorder", aliases=["copyMakeBorder"], num_inputs=1,
+          input_names=["src"],
+          attrs=AttrSpec(top=("int",), bot=("int",), left=("int",),
+                         right=("int",), type=("int", 0),
+                         value=("float", 0.0)))
+def _cv_copy_make_border(src, top, bot, left, right, type=0, value=0.0):
+    """Pad an HWC image (image_io.cc copyMakeBorder). type 0 = constant
+    (cv2.BORDER_CONSTANT); other border types fall back to edge-replicate."""
+    pad = [(top, bot), (left, right)] + [(0, 0)] * (src.ndim - 2)
+    if int(type) == 0:
+        return jnp.pad(src, pad, mode="constant",
+                       constant_values=jnp.asarray(value, src.dtype))
+    return jnp.pad(src, pad, mode="edge")

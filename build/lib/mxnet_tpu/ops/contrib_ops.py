@@ -1,0 +1,622 @@
+"""Contrib operators: SSD multibox, RCNN proposal/ROI, CTC, fft, sketch,
+quantization.
+
+Reference surface: src/operator/contrib/ — multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc, proposal.cc, psroi_pooling.cc,
+ctc_loss.cc, fft.cc, ifft.cc, count_sketch.cc, quantize.cc, dequantize.cc —
+plus src/operator/roi_pooling.cc. Rebuilt as static-shape jnp/lax programs:
+matching/NMS loops become masked fori_loops (no data-dependent shapes, so
+XLA can compile them once), CTC's alpha recursion is a ``lax.scan`` in log
+space (autodiff supplies the gradient the reference hand-rolled in
+warpctc), and ROI pooling is a vmapped masked reduction.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import AttrSpec, MXNetError
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# box helpers (shared by multibox + proposal)
+# ---------------------------------------------------------------------------
+
+
+def _box_iou(a, b):
+    """IOU of (..., 4) corner boxes a (N,4) vs b (M,4) -> (N, M)."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _corner_to_center(boxes):
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    return (boxes[..., 0] + w / 2, boxes[..., 1] + h / 2, w, h)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior (contrib/multibox_prior.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior"],
+          num_inputs=1, input_names=["data"],
+          attrs=AttrSpec(sizes=("tuple", (1.0,)), ratios=("tuple", (1.0,)),
+                         clip=("bool", False), steps=("tuple", (-1.0, -1.0)),
+                         offsets=("tuple", (0.5, 0.5))),
+          differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    h, w = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+    # anchor set: (size_i, ratio_0) for all sizes, then (size_0, ratio_j>0)
+    ws, hs = [], []
+    for i, s in enumerate(sizes):
+        r = ratios[0]
+        ws.append(s * np.sqrt(r))
+        hs.append(s / np.sqrt(r))
+    for r in ratios[1:]:
+        ws.append(sizes[0] * np.sqrt(r))
+        hs.append(sizes[0] / np.sqrt(r))
+    ws = jnp.asarray(ws, jnp.float32) / 2
+    hs = jnp.asarray(hs, jnp.float32) / 2
+    cx = cx[..., None]
+    cy = cy[..., None]
+    boxes = jnp.stack([cx - ws, cy - hs, cx + ws, cy + hs], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.reshape(1, -1, 4)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (contrib/multibox_target.cc)
+# ---------------------------------------------------------------------------
+
+_MBT_SPEC = AttrSpec(
+    overlap_threshold=("float", 0.5), ignore_label=("float", -1.0),
+    negative_mining_ratio=("float", -1.0),
+    negative_mining_thresh=("float", 0.5), minimum_negative_samples=("int", 0),
+    variances=("tuple", (0.1, 0.1, 0.2, 0.2)))
+
+
+def _encode_loc(anchors, gt, variances):
+    ax, ay, aw, ah = _corner_to_center(anchors)
+    gx, gy, gw, gh = _corner_to_center(gt)
+    eps = 1e-8
+    tx = (gx - ax) / jnp.maximum(aw, eps) / variances[0]
+    ty = (gy - ay) / jnp.maximum(ah, eps) / variances[1]
+    tw = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)) / variances[2]
+    th = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)) / variances[3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+def _match_one(anchors, label, cls_pred, overlap_threshold, ignore_label,
+               negative_mining_ratio, negative_mining_thresh,
+               minimum_negative_samples, variances):
+    """Per-sample anchor<->gt matching. anchors (N,4); label (G,5)."""
+    n = anchors.shape[0]
+    g = label.shape[0]
+    valid_gt = label[:, 0] >= 0  # class -1 rows are padding
+    gt_boxes = label[:, 1:5]
+    iou = _box_iou(anchors, gt_boxes) * valid_gt[None, :]  # (N, G)
+
+    # bipartite stage: greedily give each gt its best anchor
+    match = jnp.full((n,), -1, jnp.int32)
+
+    def bip_step(_, carry):
+        match, iou_m = carry
+        flat = jnp.argmax(iou_m)
+        a, gt = flat // g, flat % g
+        best = iou_m[a, gt]
+        take = best > 1e-12
+        match = jnp.where(take, match.at[a].set(gt.astype(jnp.int32)), match)
+        # knock out the row and column
+        iou_m = jnp.where(take, iou_m.at[a, :].set(-1.0).at[:, gt].set(-1.0),
+                          iou_m)
+        return match, iou_m
+
+    match, _ = lax.fori_loop(0, g, bip_step, (match, iou))
+    # threshold stage: unmatched anchors take their best gt if IOU clears
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    best_iou = jnp.max(iou, axis=1)
+    match = jnp.where((match < 0) & (best_iou >= overlap_threshold),
+                      best_gt, match)
+
+    matched = match >= 0
+    safe = jnp.maximum(match, 0)
+    cls_target = jnp.where(matched, label[safe, 0] + 1.0, 0.0)
+    loc_t = _encode_loc(anchors, gt_boxes[safe], jnp.asarray(variances))
+    loc_target = jnp.where(matched[:, None], loc_t, 0.0)
+    loc_mask = jnp.where(matched[:, None], 1.0, 0.0)
+    loc_mask = jnp.broadcast_to(loc_mask, (n, 4))
+
+    if negative_mining_ratio > 0:
+        # rank negatives by their max non-background confidence; keep the
+        # hardest ratio*num_pos (reference: multibox_target.cc forward)
+        num_pos = jnp.sum(matched)
+        max_neg = jnp.maximum(
+            jnp.round(negative_mining_ratio * num_pos),
+            float(minimum_negative_samples))
+        neg_ok = (~matched) & (best_iou < negative_mining_thresh)
+        conf = jnp.max(cls_pred[1:, :], axis=0)  # (N,) skip background row
+        score = jnp.where(neg_ok, conf, -jnp.inf)
+        order = jnp.argsort(-score)
+        rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+        keep_neg = neg_ok & (rank < max_neg)
+        cls_target = jnp.where(matched, cls_target,
+                               jnp.where(keep_neg, 0.0, ignore_label))
+    return loc_target.reshape(-1), loc_mask.reshape(-1), cls_target
+
+
+@register("_contrib_MultiBoxTarget", aliases=["MultiBoxTarget"],
+          num_inputs=3, input_names=["anchor", "label", "cls_pred"],
+          num_outputs=3,
+          output_names=["loc_target", "loc_mask", "cls_target"],
+          attrs=_MBT_SPEC, differentiable=False)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    anchors = anchor.reshape(-1, 4)
+    fn = jax.vmap(lambda lb, cp: _match_one(
+        anchors, lb, cp, overlap_threshold, ignore_label,
+        negative_mining_ratio, negative_mining_thresh,
+        minimum_negative_samples, variances))
+    loc_target, loc_mask, cls_target = fn(label, cls_pred)
+    return loc_target, loc_mask, cls_target
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (contrib/multibox_detection.cc)
+# ---------------------------------------------------------------------------
+
+_MBD_SPEC = AttrSpec(
+    clip=("bool", True), threshold=("float", 0.01), background_id=("int", 0),
+    nms_threshold=("float", 0.5), force_suppress=("bool", False),
+    variances=("tuple", (0.1, 0.1, 0.2, 0.2)), nms_topk=("int", -1))
+
+
+def _decode_loc(anchors, deltas, variances):
+    ax, ay, aw, ah = _corner_to_center(anchors)
+    dx, dy, dw, dh = (deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3])
+    cx = dx * variances[0] * aw + ax
+    cy = dy * variances[1] * ah + ay
+    w = jnp.exp(dw * variances[2]) * aw
+    h = jnp.exp(dh * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def _nms_mask(boxes, scores, class_ids, nms_threshold, force_suppress):
+    """Greedy NMS over all boxes (score desc); returns keep mask."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_o = boxes[order]
+    cls_o = class_ids[order]
+    valid_o = scores[order] > 0
+    iou = _box_iou(boxes_o, boxes_o)
+    same = (cls_o[:, None] == cls_o[None, :]) | force_suppress
+    sup = (iou > nms_threshold) & same  # candidate suppression, i over j
+
+    def step(i, keep):
+        k_i = keep[i] & valid_o[i]
+        kill = sup[i] & (jnp.arange(n) > i) & k_i
+        return keep & ~kill
+
+    keep_o = lax.fori_loop(0, n, step, jnp.ones((n,), bool)) & valid_o
+    keep = jnp.zeros((n,), bool).at[order].set(keep_o)
+    return keep
+
+
+@register("_contrib_MultiBoxDetection", aliases=["MultiBoxDetection"],
+          num_inputs=3, input_names=["cls_prob", "loc_pred", "anchor"],
+          attrs=_MBD_SPEC, differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """cls_prob (B, num_cls+1, N); loc_pred (B, N*4); anchor (1, N, 4) ->
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], suppressed rows -1."""
+    anchors = anchor.reshape(-1, 4)
+    variances = jnp.asarray(variances)
+
+    def one(cp, lp):
+        n = anchors.shape[0]
+        deltas = lp.reshape(n, 4)
+        boxes = _decode_loc(anchors, deltas, variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        masked = cp.at[background_id, :].set(-jnp.inf)
+        cls = jnp.argmax(masked, axis=0)
+        score = jnp.max(masked, axis=0)
+        cls_id = (cls - (cls > background_id).astype(jnp.int32)
+                  ).astype(jnp.float32)  # reference re-indexes past bg
+        ok = score > threshold
+        score = jnp.where(ok, score, 0.0)
+        keep = _nms_mask(boxes, score, cls, nms_threshold, force_suppress)
+        if nms_topk > 0:
+            order = jnp.argsort(-score)
+            rank = jnp.zeros((n,), jnp.int32).at[order].set(
+                jnp.arange(n, dtype=jnp.int32))
+            keep = keep & (rank < nms_topk)
+        out_cls = jnp.where(keep, cls_id, -1.0)
+        out = jnp.concatenate(
+            [out_cls[:, None], score[:, None], boxes], axis=1)
+        return out
+
+    return jax.vmap(one)(cls_prob, loc_pred.reshape(cls_prob.shape[0], -1))
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling (src/operator/roi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("ROIPooling", num_inputs=2, input_names=["data", "rois"],
+          attrs=AttrSpec(pooled_size=("tuple",), spatial_scale=("float",)))
+def _roi_pooling(data, rois, pooled_size, spatial_scale):
+    """data (B, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2] in image
+    coords. Max-pool each roi into pooled_size bins (Fast-RCNN binning)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    b, c, h, w = data.shape
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bidx]  # (C, H, W)
+        i = jnp.arange(ph, dtype=jnp.float32)
+        j = jnp.arange(pw, dtype=jnp.float32)
+        # bin [hstart, hend) x [wstart, wend) per output cell
+        hstart = jnp.clip(jnp.floor(i * bin_h) + y1, 0, h)  # (ph,)
+        hend = jnp.clip(jnp.ceil((i + 1) * bin_h) + y1, 0, h)
+        wstart = jnp.clip(jnp.floor(j * bin_w) + x1, 0, w)
+        wend = jnp.clip(jnp.ceil((j + 1) * bin_w) + x1, 0, w)
+        hmask = (ys[None, :] >= hstart[:, None]) & (ys[None, :] < hend[:, None])
+        wmask = (xs[None, :] >= wstart[:, None]) & (xs[None, :] < wend[:, None])
+        m = hmask[:, None, :, None] & wmask[None, :, None, :]  # (ph,pw,H,W)
+        vals = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(-2, -1))  # (C, ph, pw)
+        empty = ~jnp.any(m, axis=(-2, -1))  # (ph, pw)
+        return jnp.where(empty[None], 0.0, out)
+
+    return jax.vmap(one)(rois)
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (contrib/psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_PSROIPooling", aliases=["PSROIPooling"],
+          num_inputs=2, input_names=["data", "rois"],
+          attrs=AttrSpec(spatial_scale=("float",), output_dim=("int",),
+                         pooled_size=("int",), group_size=("int", 0)))
+def _psroi_pooling(data, rois, spatial_scale, output_dim, pooled_size,
+                   group_size=0):
+    """Position-sensitive ROI average pooling (R-FCN). data channel layout
+    is output_dim * group^2, group == pooled_size by default."""
+    group = group_size or pooled_size
+    p = int(pooled_size)
+    b, c, h, w = data.shape
+    if c != output_dim * group * group:
+        raise MXNetError(
+            f"PSROIPooling: channels {c} != output_dim*group^2 "
+            f"({output_dim}*{group}^2)")
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / p
+        bin_w = rw / p
+        img = data[bidx].reshape(output_dim, group * group, h, w)
+        i = jnp.arange(p, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(i * bin_h + y1), 0, h)
+        hend = jnp.clip(jnp.ceil((i + 1) * bin_h + y1), 0, h)
+        wstart = jnp.clip(jnp.floor(i * bin_w + x1), 0, w)
+        wend = jnp.clip(jnp.ceil((i + 1) * bin_w + x1), 0, w)
+        hmask = (ys[None, :] >= hstart[:, None]) & (ys[None, :] < hend[:, None])
+        wmask = (xs[None, :] >= wstart[:, None]) & (xs[None, :] < wend[:, None])
+        m = hmask[:, None, :, None] & wmask[None, :, None, :]  # (p,p,H,W)
+        cnt = jnp.maximum(jnp.sum(m, axis=(-2, -1)), 1)  # (p,p)
+        # position-sensitive: output bin (i,j) reads channel group i*g+j
+        gi = (i * group // p).astype(jnp.int32)
+        gidx = gi[:, None] * group + gi[None, :]  # (p, p)
+        chan = img[:, gidx]  # (output_dim, p, p, H, W)
+        s = jnp.sum(jnp.where(m[None], chan, 0.0), axis=(-2, -1))
+        return s / cnt[None]
+
+    return jax.vmap(one)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Proposal (contrib/proposal.cc)
+# ---------------------------------------------------------------------------
+
+_PROP_SPEC = AttrSpec(
+    rpn_pre_nms_top_n=("int", 6000), rpn_post_nms_top_n=("int", 300),
+    threshold=("float", 0.7), rpn_min_size=("int", 16),
+    scales=("tuple", (4.0, 8.0, 16.0, 32.0)), ratios=("tuple", (0.5, 1.0, 2.0)),
+    feature_stride=("int", 16), output_score=("bool", False),
+    iou_loss=("bool", False))
+
+
+def _base_anchors(base_size, scales, ratios):
+    """Anchor windows around a base_size square at the origin."""
+    out = []
+    cx = cy = (base_size - 1) / 2.0
+    area = base_size * base_size
+    for r in ratios:
+        w = np.round(np.sqrt(area / r))
+        h = np.round(w * r)
+        for s in scales:
+            ws, hs = w * s, h * s
+            out.append([cx - (ws - 1) / 2, cy - (hs - 1) / 2,
+                        cx + (ws - 1) / 2, cy + (hs - 1) / 2])
+    return jnp.asarray(out, jnp.float32)
+
+
+@register("_contrib_Proposal", aliases=["Proposal"],
+          num_inputs=3, input_names=["cls_prob", "bbox_pred", "im_info"],
+          attrs=_PROP_SPEC, differentiable=False,
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposals. cls_prob (1, 2*A, H, W), bbox_pred (1, 4*A, H, W),
+    im_info (1, 3) [height, width, scale] -> rois (post_nms, 5)."""
+    if iou_loss:
+        raise MXNetError("Proposal: iou_loss=True not supported")
+    if cls_prob.shape[0] != 1:
+        raise MXNetError(
+            f"Proposal only supports batch size 1 (reference "
+            f"proposal-inl.h), got {cls_prob.shape[0]}")
+    _, ca, fh, fw = cls_prob.shape
+    a = ca // 2
+    base = _base_anchors(feature_stride, scales, ratios)  # (A, 4)
+    sy = jnp.arange(fh, dtype=jnp.float32) * feature_stride
+    sx = jnp.arange(fw, dtype=jnp.float32) * feature_stride
+    shift = jnp.stack(
+        jnp.meshgrid(sx, sy, indexing="xy"), -1)  # (fh, fw, 2) via xy
+    shift = jnp.concatenate([shift, shift], -1)  # (fh, fw, 4) x1y1x2y2
+    anchors = (base[None, None] + shift[:, :, None]).reshape(-1, 4)
+
+    scores = cls_prob[0, a:].transpose(1, 2, 0).reshape(-1)  # fg scores
+    deltas = (bbox_pred[0].reshape(a, 4, fh, fw)
+              .transpose(2, 3, 0, 1).reshape(-1, 4))
+    # RCNN delta decoding uses the +1 pixel-extent convention
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * (aw - 1.0)
+    acy = anchors[:, 1] + 0.5 * (ah - 1.0)
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    pw = jnp.exp(deltas[:, 2]) * aw
+    ph = jnp.exp(deltas[:, 3]) * ah
+    boxes = jnp.stack([cx - 0.5 * (pw - 1), cy - 0.5 * (ph - 1),
+                       cx + 0.5 * (pw - 1), cy + 0.5 * (ph - 1)], -1)
+    imh, imw, imscale = im_info[0, 0], im_info[0, 1], im_info[0, 2]
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, imw - 1),
+                       jnp.clip(boxes[:, 1], 0, imh - 1),
+                       jnp.clip(boxes[:, 2], 0, imw - 1),
+                       jnp.clip(boxes[:, 3], 0, imh - 1)], -1)
+    min_size = rpn_min_size * imscale
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    valid = (ws >= min_size) & (hs >= min_size)
+    scores = jnp.where(valid, scores, -jnp.inf)
+
+    n = scores.shape[0]
+    pre = min(rpn_pre_nms_top_n, n)
+    top_scores, top_idx = lax.top_k(scores, pre)
+    top_boxes = boxes[top_idx]
+    keep = _nms_mask(top_boxes, jnp.maximum(top_scores, 1e-12),
+                     jnp.zeros((pre,), jnp.int32), threshold, True)
+    keep = keep & jnp.isfinite(top_scores)
+    # stable-sort kept boxes first, pad with the top box (reference pads
+    # output to post_nms_top_n by repeating)
+    order = jnp.argsort(~keep)  # kept first
+    post = rpn_post_nms_top_n
+    sel = order[:post]
+    sel_valid = keep[sel]
+    out_boxes = jnp.where(sel_valid[:, None], top_boxes[sel], top_boxes[0])
+    out_scores = jnp.where(sel_valid, top_scores[sel], top_scores[0])
+    rois = jnp.concatenate(
+        [jnp.zeros((post, 1), jnp.float32), out_boxes], axis=1)
+    if output_score:
+        return rois, out_scores[:, None]
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# CTCLoss (contrib/ctc_loss.cc — blank label 0, data (T, N, C))
+# ---------------------------------------------------------------------------
+
+
+def _ctc_forward(log_probs, labels, data_len, label_len):
+    """Log-space alpha recursion for one sample.
+
+    log_probs (T, C) log-softmax activations; labels (L,) int; lengths
+    static-shape with dynamic validity. Returns -log p(labels)."""
+    t_max, _ = log_probs.shape
+    l_max = labels.shape[0]
+    s = 2 * l_max + 1
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.zeros((s,), jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    neg = jnp.float32(-1e30)
+    # can we skip from s-2 to s (distinct consecutive non-blank labels)?
+    skip_ok = jnp.zeros((s,), bool)
+    skip_ok = skip_ok.at[2:].set((ext[2:] != ext[:-2]) & (ext[2:] != 0))
+
+    alpha0 = jnp.full((s,), neg)
+    alpha0 = alpha0.at[0].set(log_probs[0, 0])
+    alpha0 = alpha0.at[1].set(jnp.where(label_len > 0,
+                                        log_probs[0, ext[1]], neg))
+
+    def step(alpha, t):
+        lp = log_probs[t]
+        a_prev = jnp.concatenate([jnp.array([neg]), alpha[:-1]])
+        a_skip = jnp.concatenate([jnp.full((2,), neg), alpha[:-2]])
+        a_skip = jnp.where(skip_ok, a_skip, neg)
+        merged = jnp.logaddexp(alpha, jnp.logaddexp(a_prev, a_skip))
+        new = merged + lp[ext]
+        # outside data_len the alphas freeze (sequence already ended)
+        new = jnp.where(t < data_len, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, t_max))
+    end = 2 * label_len  # index of final blank
+    tot = jnp.logaddexp(alpha[end],
+                        jnp.where(label_len > 0, alpha[end - 1], neg))
+    return -tot
+
+
+@register("_contrib_CTCLoss", aliases=["CTCLoss", "ctc_loss"],
+          num_inputs=None,
+          input_names=["data", "label", "data_lengths", "label_lengths"],
+          attrs=AttrSpec(use_data_lengths=("bool", False),
+                         use_label_lengths=("bool", False),
+                         padding_mask=("int", 0)))
+def _ctc_loss(*args, use_data_lengths=False, use_label_lengths=False,
+              padding_mask=0):
+    """data (T, N, C) activations (softmax applied internally, blank=0);
+    label (N, L). Returns per-sample negative log-likelihood (N,)."""
+    data, label = args[0], args[1]
+    idx = 2
+    t_max, n, _ = data.shape
+    if use_data_lengths:
+        data_len = args[idx].astype(jnp.int32)
+        idx += 1
+    else:
+        data_len = jnp.full((n,), t_max, jnp.int32)
+    if use_label_lengths:
+        label_len = args[idx].astype(jnp.int32)
+    else:
+        if padding_mask is None:
+            label_len = jnp.full((n,), label.shape[1], jnp.int32)
+        else:
+            is_pad = label == padding_mask
+            # length = first occurrence of padding_mask (or L)
+            label_len = jnp.where(
+                jnp.any(is_pad, 1),
+                jnp.argmax(is_pad, 1), label.shape[1]).astype(jnp.int32)
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    logp = jnp.transpose(logp, (1, 0, 2))  # (N, T, C)
+    return jax.vmap(_ctc_forward)(logp, label.astype(jnp.int32),
+                                  data_len, label_len)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft (contrib/fft.cc, ifft.cc — interleaved re/im last dim)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_fft", aliases=["fft"], num_inputs=1,
+          attrs=AttrSpec(compute_size=("int", 128)))
+def _fft(data, compute_size=128):
+    """Last-dim FFT; real input (…, d) -> interleaved re/im (…, 2d)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        jnp.float32)
+
+
+@register("_contrib_ifft", aliases=["ifft"], num_inputs=1,
+          attrs=AttrSpec(compute_size=("int", 128)))
+def _ifft(data, compute_size=128):
+    """Inverse of _contrib_fft: interleaved (…, 2d) -> real (…, d).
+
+    Unnormalized, matching the reference's cuFFT C2C inverse (the caller
+    divides by d, as the reference tests do)."""
+    d = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (d, 2))
+    comp = c[..., 0] + 1j * c[..., 1]
+    return (jnp.fft.ifft(comp, axis=-1).real * d).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (contrib/count_sketch.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_count_sketch", aliases=["count_sketch"],
+          num_inputs=3, input_names=["data", "h", "s"],
+          attrs=AttrSpec(out_dim=("int",), processing_batch_size=("int", 32)))
+def _count_sketch(data, h, s, out_dim, processing_batch_size=32):
+    """Count-sketch projection: out[n, h[i]] += s[i] * data[n, i]."""
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    contrib = data * ss[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, hh].add(contrib)
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize (contrib/quantize.cc, dequantize.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_quantize", aliases=["quantize"],
+          num_inputs=3, input_names=["data", "min_range", "max_range"],
+          num_outputs=3, output_names=["output", "min_output", "max_output"],
+          attrs=AttrSpec(out_type=("str", "uint8")), differentiable=False)
+def _quantize(data, min_range, max_range, out_type="uint8"):
+    mn = jnp.min(min_range)
+    mx = jnp.max(max_range)
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx - mn, 1e-12)
+        q = jnp.clip(jnp.round((data - mn) * scale), 0.0, 255.0)
+        return q.astype(jnp.uint8), mn.reshape(1), mx.reshape(1)
+    if out_type == "int8":
+        # symmetric signed quantization (reference quantize.cc): scale by
+        # 127/max|range| so that 0.0 maps to 0
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        scale = 127.0 / jnp.maximum(amax, 1e-12)
+        q = jnp.clip(jnp.round(data * scale), -127.0, 127.0)
+        return q.astype(jnp.int8), (-amax).reshape(1), amax.reshape(1)
+    raise MXNetError(f"quantize: unsupported out_type {out_type}")
+
+
+@register("_contrib_dequantize", aliases=["dequantize"],
+          num_inputs=3, input_names=["data", "min_range", "max_range"],
+          attrs=AttrSpec(out_type=("str", "float32")), differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    mn = jnp.min(min_range)
+    mx = jnp.max(max_range)
+    if data.dtype == jnp.uint8:
+        scale = jnp.maximum(mx - mn, 1e-12) / 255.0
+        return (data.astype(jnp.float32) * scale + mn).astype(jnp.float32)
+    # int8: symmetric, matching _quantize
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return (data.astype(jnp.float32) * amax / 127.0).astype(jnp.float32)

@@ -1,0 +1,308 @@
+"""Contrib op tail: deformable ops, MultiProposal, khatri-rao, scatter_nd,
+KL sparsity regularizer.
+
+Reference surface: src/operator/contrib/{deformable_convolution.cc,
+deformable_psroi_pooling.cc, multi_proposal.cc, krprod.h},
+src/operator/tensor/indexing_op.cc (scatter_nd),
+src/operator/identity_attach_KL_sparse_reg.cc. Deformable sampling is
+built on the same gather-based bilinear taps as BilinearSampler
+(spatial_ops.py) — autodiff supplies the atomic-add backward the
+reference hand-wrote in CUDA.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base import AttrSpec, MXNetError
+from .registry import register
+from .spatial_ops import _bilinear_sample
+
+# ---------------------------------------------------------------------------
+# scatter_nd (tensor/indexing_op.cc) — inverse of gather_nd
+# ---------------------------------------------------------------------------
+
+
+@register("scatter_nd", num_inputs=2, input_names=["data", "indices"],
+          attrs=AttrSpec(shape=("tuple",)))
+def _scatter_nd(data, indices, shape):
+    idx = tuple(indices.astype(jnp.int32)[i]
+                for i in range(indices.shape[0]))
+    out = jnp.zeros(tuple(shape), data.dtype)
+    return out.at[idx].set(data)
+
+
+# ---------------------------------------------------------------------------
+# khatri_rao (contrib/krprod.h row_wise_kronecker / khatri_rao)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_khatri_rao", aliases=["khatri_rao"], num_inputs=None,
+          key_var_num_args="num_args",
+          attrs=AttrSpec(num_args=("int", 0)))
+def _khatri_rao(*mats, num_args=0):
+    """Column-wise Khatri-Rao product: inputs (n_i, k) -> (prod n_i, k)."""
+    if not mats:
+        raise MXNetError("khatri_rao needs at least one matrix")
+    k = mats[0].shape[1]
+    for m in mats:
+        if m.ndim != 2 or m.shape[1] != k:
+            raise MXNetError("khatri_rao inputs must be 2-D with equal "
+                             "column counts")
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg (identity_attach_KL_sparse_reg.cc)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _kl_sparse_identity(data, sparseness_target, penalty):
+    return data
+
+
+def _kl_fwd(data, sparseness_target, penalty):
+    return data, data
+
+
+def _kl_bwd(sparseness_target, penalty, data, ct):
+    # rho_hat: mean activation per hidden unit over the batch (data is a
+    # post-sigmoid activation in (0, 1)); KL sparsity gradient
+    rho = sparseness_target
+    rho_hat = jnp.clip(jnp.mean(data, axis=0, keepdims=True), 1e-6,
+                       1 - 1e-6)
+    kl_grad = penalty * (-(rho / rho_hat) + (1 - rho) / (1 - rho_hat))
+    return (ct + kl_grad.astype(ct.dtype),)
+
+
+_kl_sparse_identity.defvjp(_kl_fwd, _kl_bwd)
+
+
+@register("IdentityAttachKLSparseReg", num_inputs=1, input_names=["data"],
+          attrs=AttrSpec(sparseness_target=("float", 0.1),
+                         penalty=("float", 0.001),
+                         momentum=("float", 0.9)))
+def _identity_attach_kl(data, sparseness_target=0.1, penalty=0.001,
+                        momentum=0.9):
+    """Identity forward; backward adds the KL sparsity penalty gradient
+    (sparse autoencoders). The reference keeps a momentum-averaged rho_hat
+    in an aux state; this build computes rho_hat per batch (momentum=0
+    semantics)."""
+    return _kl_sparse_identity(data, float(sparseness_target),
+                               float(penalty))
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (contrib/deformable_convolution.cc)
+# ---------------------------------------------------------------------------
+
+_DEFORM_SPEC = AttrSpec(
+    kernel=("tuple",), stride=("tuple", (1, 1)), dilate=("tuple", (1, 1)),
+    pad=("tuple", (0, 0)), num_filter=("int",), num_group=("int", 1),
+    num_deformable_group=("int", 1), workspace=("int", 1024),
+    no_bias=("bool", False), layout=("str", None))
+
+
+def _deform_conv_param_shapes(attrs, shapes):
+    d = shapes[0]
+    nf = int(attrs["num_filter"])
+    kernel = tuple(attrs["kernel"])
+    out = [d, shapes[1], (nf, d[1]) + kernel]
+    if len(shapes) > 3:
+        out.append((nf,))
+    return out
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=["DeformableConvolution"], num_inputs=None,
+          input_names=["data", "offset", "weight", "bias"],
+          param_shapes=_deform_conv_param_shapes,
+          attrs=_DEFORM_SPEC)
+def _deformable_convolution(*inputs, kernel, stride=(1, 1), dilate=(1, 1),
+                            pad=(0, 0), num_filter=0, num_group=1,
+                            num_deformable_group=1, workspace=1024,
+                            no_bias=False, layout=None):
+    """2-D deformable conv: each kernel tap samples the input at its
+    integer grid position PLUS a learned fractional offset (bilinear
+    taps). offset (B, 2*kh*kw*dg, Ho, Wo) with per-tap (y, x) pairs."""
+    data, offset, weight = inputs[0], inputs[1], inputs[2]
+    bias = None if no_bias else inputs[3]
+    if num_group != 1:
+        raise MXNetError("DeformableConvolution: num_group > 1 not "
+                         "supported yet")
+    kh, kw = kernel
+    sh, sw = stride if len(stride) == 2 else (1, 1)
+    dh, dw = dilate if len(dilate) == 2 else (1, 1)
+    ph, pw = pad if len(pad) == 2 else (0, 0)
+    b, c, h, w = data.shape
+    dg = num_deformable_group
+    if c % dg:
+        raise MXNetError("channels not divisible by num_deformable_group")
+    ho = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    padded = jnp.pad(data.astype(jnp.float32),
+                     [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    # base sampling grid per tap: (kh*kw, Ho, Wo)
+    oy = jnp.arange(ho) * sh
+    ox = jnp.arange(wo) * sw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = oy[None, :, None] + ky.repeat(kw)[:, None, None]  # (K,Ho,1)
+    base_x = ox[None, None, :] + jnp.tile(kx, kh)[:, None, None]
+    base_y = jnp.broadcast_to(base_y, (kh * kw, ho, wo))
+    base_x = jnp.broadcast_to(base_x, (kh * kw, ho, wo))
+
+    off = offset.astype(jnp.float32).reshape(b, dg, kh * kw, 2, ho, wo)
+
+    def one(img, off_i):  # img (C, H+2p, W+2p); off_i (dg, K, 2, Ho, Wo)
+        cg = c // dg
+        groups = img.reshape(dg, cg, *img.shape[1:])
+
+        def per_group(gimg, goff):
+            # sample every tap: (K, cg, Ho, Wo)
+            def per_tap(k):
+                gy = base_y[k] + goff[k, 0]
+                gx = base_x[k] + goff[k, 1]
+                return _bilinear_sample(gimg, gx, gy)
+
+            return jax.vmap(per_tap)(jnp.arange(kh * kw))
+
+        sampled = jax.vmap(per_group)(groups, goff=off_i)  # (dg,K,cg,Ho,Wo)
+        return sampled.transpose(0, 2, 1, 3, 4).reshape(c * kh * kw, ho, wo)
+
+    cols = jax.vmap(one)(padded, off)  # (B, C*K, Ho, Wo)
+    wmat = weight.astype(jnp.float32).reshape(num_filter, c * kh * kw)
+    out = jnp.einsum("fk,bkhw->bfhw", wmat, cols)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)[None, :, None, None]
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling (contrib/deformable_psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=["DeformablePSROIPooling"], num_inputs=None,
+          input_names=["data", "rois", "trans"],
+          attrs=AttrSpec(spatial_scale=("float",), output_dim=("int",),
+                         group_size=("int",), pooled_size=("int",),
+                         part_size=("int", 0), sample_per_part=("int", 1),
+                         trans_std=("float", 0.0), no_trans=("bool", False)))
+def _deformable_psroi_pooling(*inputs, spatial_scale, output_dim,
+                              group_size, pooled_size, part_size=0,
+                              sample_per_part=1, trans_std=0.0,
+                              no_trans=False):
+    """Position-sensitive ROI pooling with learned per-part offsets
+    (Deformable R-FCN). With no_trans=True it reduces to average PSROI
+    pooling over sample_per_part^2 bilinear taps per bin."""
+    data, rois = inputs[0], inputs[1]
+    trans = None if no_trans or len(inputs) < 3 else inputs[2]
+    p = pooled_size
+    part = part_size or p
+    b, c, h, w = data.shape
+    if c != output_dim * group_size * group_size:
+        raise MXNetError("DeformablePSROIPooling: channel/output_dim "
+                         "mismatch")
+    sp = sample_per_part
+
+    def one(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        # reference rounds ROI corners before scaling
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale - 0.5
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / p
+        bin_h = rh / p
+        img = data[bidx].reshape(output_dim, group_size * group_size, h, w)
+        i = jnp.arange(p, dtype=jnp.float32)
+        # per-bin learned offset (scaled by roi size and trans_std)
+        if tr is not None:
+            ty = tr[0] * trans_std * rh  # (p, p) after resize below
+            tx = tr[1] * trans_std * rw
+        else:
+            ty = jnp.zeros((p, p), jnp.float32)
+            tx = jnp.zeros((p, p), jnp.float32)
+        # sample grid inside each bin: sp x sp taps
+        s = (jnp.arange(sp, dtype=jnp.float32) + 0.5) / sp
+        gy = (y1 + i[:, None, None, None] * bin_h
+              + s[None, None, :, None] * bin_h + ty[:, :, None, None])
+        gx = (x1 + i[None, :, None, None] * bin_w
+              + s[None, None, None, :] * bin_w + tx[:, :, None, None])
+        gy = jnp.broadcast_to(gy, (p, p, sp, sp))
+        gx = jnp.broadcast_to(gx, (p, p, sp, sp))
+        gy = gy.reshape(p, p, sp * sp).transpose(2, 0, 1)  # (sp^2, p, p)
+        gx = gx.reshape(p, p, sp * sp).transpose(2, 0, 1)
+        # clamp samples into the image (reference clamps and averages all
+        # sp^2 taps; no zero-padding attenuation at borders)
+        gy = jnp.clip(gy, 0.0, h - 1.0)
+        gx = jnp.clip(gx, 0.0, w - 1.0)
+        gi = (i * group_size / p).astype(jnp.int32)
+        gidx = gi[:, None] * group_size + gi[None, :]  # (p, p) in [0, g^2)
+
+        flat = img.reshape(output_dim * group_size * group_size, h, w)
+
+        def tap(k):
+            # one bilinear gather for every channel at this tap's grid,
+            # then pick each bin's position-sensitive channel
+            samp = _bilinear_sample(flat, gx[k], gy[k])  # (od*g^2, p, p)
+            samp = samp.reshape(output_dim, group_size * group_size, p, p)
+            sel = jnp.take_along_axis(
+                samp, gidx[None, None, :, :], axis=1)
+            return sel[:, 0]  # (od, p, p)
+
+        vals = jax.vmap(tap)(jnp.arange(sp * sp))  # (sp^2, od, p, p)
+        return jnp.mean(vals, axis=0)
+
+    n = rois.shape[0]
+    if trans is not None:
+        # trans (R, 2*output? ) reference: (num_rois, 2, part, part) — use
+        # per-bin means resized to (p, p)
+        tr = trans.astype(jnp.float32)
+        if tr.ndim == 4 and tr.shape[2:] == (part, part) and part != p:
+            tr = jax.image.resize(tr, (n, 2, p, p), "nearest")
+        trans_pairs = tr
+        return jax.vmap(lambda r, t: one(r, (t[0], t[1])))(
+            rois, trans_pairs)
+    return jax.vmap(lambda r: one(r, None))(rois)
+
+
+# ---------------------------------------------------------------------------
+# MultiProposal (contrib/multi_proposal.cc) — batched Proposal
+# ---------------------------------------------------------------------------
+
+from .contrib_ops import _PROP_SPEC, _proposal  # noqa: E402
+
+
+@register("_contrib_MultiProposal", aliases=["MultiProposal"],
+          num_inputs=3, input_names=["cls_prob", "bbox_pred", "im_info"],
+          attrs=_PROP_SPEC, differentiable=False,
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1)
+def _multi_proposal(cls_prob, bbox_pred, im_info, **attrs):
+    """Per-image RPN proposals for a whole batch; rois column 0 carries
+    the image index (reference multi_proposal.cc)."""
+    n = cls_prob.shape[0]
+    outs = []
+    scores = []
+    for i in range(n):
+        r = _proposal(cls_prob[i:i + 1], bbox_pred[i:i + 1],
+                      im_info[i:i + 1], **attrs)
+        if attrs.get("output_score"):
+            r, s = r
+            scores.append(s)
+        outs.append(r.at[:, 0].set(float(i)))
+    rois = jnp.concatenate(outs, axis=0)
+    if attrs.get("output_score"):
+        return rois, jnp.concatenate(scores, axis=0)
+    return rois

@@ -1,0 +1,85 @@
+"""Linear-algebra operators (batched BLAS3/LAPACK surface).
+
+Reference surface: src/operator/tensor/la_op.cc — linalg_gemm (MAC:
+C = alpha*op(A)op(B) + beta*C), linalg_gemm2, linalg_potrf, linalg_potri,
+linalg_trmm, linalg_trsm, linalg_sumlogdiag — all operating on the last two
+dims with arbitrary batch dims. Rebuilt over jnp.linalg / lax.linalg (XLA
+ships native Cholesky/triangular-solve that lower to MXU-friendly blocked
+kernels; no LAPACK glue like the reference's c_lapack_api.h needed).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import AttrSpec
+from .registry import register
+
+_GEMM_SPEC = AttrSpec(transpose_a=("bool", False), transpose_b=("bool", False),
+                      alpha=("float", 1.0), beta=("float", 1.0))
+_GEMM2_SPEC = AttrSpec(transpose_a=("bool", False),
+                       transpose_b=("bool", False), alpha=("float", 1.0))
+_TRI_SPEC = AttrSpec(transpose=("bool", False), rightside=("bool", False),
+                     alpha=("float", 1.0))
+
+
+def _t(x, flag):
+    return jnp.swapaxes(x, -1, -2) if flag else x
+
+
+@register("linalg_gemm", aliases=["_linalg_gemm"], num_inputs=3,
+          input_names=["A", "B", "C"], attrs=_GEMM_SPEC)
+def _linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0):
+    return alpha * jnp.matmul(_t(a, transpose_a), _t(b, transpose_b)) \
+        + beta * c
+
+
+@register("linalg_gemm2", aliases=["_linalg_gemm2"], num_inputs=2,
+          input_names=["A", "B"], attrs=_GEMM2_SPEC)
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    return alpha * jnp.matmul(_t(a, transpose_a), _t(b, transpose_b))
+
+
+@register("linalg_potrf", aliases=["_linalg_potrf"], num_inputs=1,
+          input_names=["A"], attrs=AttrSpec())
+def _linalg_potrf(a):
+    """Lower Cholesky factor of a symmetric positive-definite matrix."""
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_potri", aliases=["_linalg_potri"], num_inputs=1,
+          input_names=["A"], attrs=AttrSpec())
+def _linalg_potri(a):
+    """Inverse from a Cholesky factor: given L, compute (L L^T)^-1."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = lax.linalg.triangular_solve(a, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_trmm", aliases=["_linalg_trmm"], num_inputs=2,
+          input_names=["A", "B"], attrs=_TRI_SPEC)
+def _linalg_trmm(a, b, transpose=False, rightside=False, alpha=1.0):
+    """Triangular matrix multiply: out = alpha * op(L) B (or B op(L)).
+
+    Only the lower triangle of A is read (BLAS trmm semantics)."""
+    la = _t(jnp.tril(a), transpose)
+    return alpha * (jnp.matmul(b, la) if rightside else jnp.matmul(la, b))
+
+
+@register("linalg_trsm", aliases=["_linalg_trsm"], num_inputs=2,
+          input_names=["A", "B"], attrs=_TRI_SPEC)
+def _linalg_trsm(a, b, transpose=False, rightside=False, alpha=1.0):
+    """Triangular solve: out = alpha * op(L)^-1 B (or B op(L)^-1)."""
+    sol = lax.linalg.triangular_solve(
+        a, alpha * b, left_side=not rightside, lower=True,
+        transpose_a=transpose)
+    return sol
+
+
+@register("linalg_sumlogdiag", aliases=["_linalg_sumlogdiag"], num_inputs=1,
+          input_names=["A"], attrs=AttrSpec())
+def _linalg_sumlogdiag(a):
+    """Sum of log of the diagonal (per batch matrix)."""
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)

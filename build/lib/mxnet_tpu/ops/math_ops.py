@@ -1,0 +1,327 @@
+"""Elementwise / scalar / broadcast / reduction / dot operators.
+
+Reference surface: src/operator/tensor/elemwise_unary_op.cc (~50 unary ops),
+elemwise_binary_op_*.cc, elemwise_binary_broadcast_op_*.cc, elemwise_sum.cc,
+broadcast_reduce_op_*.cc, dot-inl.h, and the scalar functor zoo in
+src/operator/mshadow_op.h. Here every op is a jnp/lax composition — XLA fuses
+the elementwise chains the reference hand-wrote per-op, and matmuls land on
+the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..base import AttrSpec
+from .registry import alias, register
+
+_f = jnp.asarray
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise (reference: elemwise_unary_op.cc, mshadow_op.h functors)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": lambda x: x / (1 + jnp.abs(x)),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "square": jnp.square,
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "gamma": lambda x: jnp.exp(jsp.gammaln(x)),
+    "gammaln": jsp.gammaln,
+    "erf": jsp.erf,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _impl in _UNARY.items():
+    register(_name)( (lambda impl: (lambda x: impl(x)))(_impl) )
+
+register("identity", aliases=["_copy"])(lambda x: x)
+
+# stop_gradient: reference BlockGrad (elemwise_unary_op.cc) / make_loss
+register("BlockGrad", aliases=["stop_gradient"])(jax.lax.stop_gradient)
+register("make_loss", aliases=["MakeLoss"])(lambda x: x)
+
+
+@register(
+    "Cast",
+    aliases=["cast"],
+    attrs=AttrSpec(dtype=("str",)),
+)
+def _cast(x, dtype):
+    return x.astype(jnp.dtype(dtype))
+
+
+@register("clip", attrs=AttrSpec(a_min=("float",), a_max=("float",)))
+def _clip(x, a_min, a_max):
+    return jnp.clip(x, a_min, a_max)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise + broadcast (elemwise_binary_op_*.cc,
+# elemwise_binary_broadcast_op_*.cc). jnp broadcasts natively, so the
+# same-shape and broadcast families share one implementation.
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+}
+_BINARY_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+}
+
+for _name, _impl in _BINARY.items():
+    register("elemwise_" + _name if _name in ("add", "sub", "mul", "div") else "_" + _name,
+             num_inputs=2, input_names=["lhs", "rhs"])(
+        (lambda impl: (lambda a, b: impl(a, b)))(_impl)
+    )
+    register("broadcast_" + _name, num_inputs=2, input_names=["lhs", "rhs"])(
+        (lambda impl: (lambda a, b: impl(a, b)))(_impl)
+    )
+for _name, _impl in _BINARY_CMP.items():
+    register("_" + _name, num_inputs=2, input_names=["lhs", "rhs"],
+             differentiable=False)(
+        (lambda impl: (lambda a, b: impl(a, b).astype(a.dtype)))(_impl)
+    )
+    register("broadcast_" + _name, num_inputs=2, input_names=["lhs", "rhs"],
+             differentiable=False)(
+        (lambda impl: (lambda a, b: impl(a, b).astype(a.dtype)))(_impl)
+    )
+
+for _a, _b in [("_plus", "elemwise_add"), ("_add", "elemwise_add"),
+               ("_minus", "elemwise_sub"), ("_sub", "elemwise_sub"),
+               ("_mul", "elemwise_mul"), ("_div", "elemwise_div"),
+               ("_grad_add", "elemwise_add"), ("_mod", "broadcast_mod"),
+               ("_Power", "_power"), ("_Maximum", "_maximum"),
+               ("_Minimum", "_minimum")]:
+    alias(_a, _b)
+
+
+# scalar variants (reference: *_scalar ops). scalar arrives as a float attr.
+def _scalar_op(impl, reverse=False):
+    if reverse:
+        return lambda x, scalar: impl(jnp.asarray(scalar, dtype=x.dtype), x)
+    return lambda x, scalar: impl(x, jnp.asarray(scalar, dtype=x.dtype))
+
+
+_SCALAR_SPEC = AttrSpec(scalar=("float",))
+for _name, _impl, _rev in [
+    ("_plus_scalar", jnp.add, False),
+    ("_minus_scalar", jnp.subtract, False),
+    ("_rminus_scalar", jnp.subtract, True),
+    ("_mul_scalar", jnp.multiply, False),
+    ("_div_scalar", jnp.divide, False),
+    ("_rdiv_scalar", jnp.divide, True),
+    ("_mod_scalar", jnp.mod, False),
+    ("_rmod_scalar", jnp.mod, True),
+    ("_power_scalar", jnp.power, False),
+    ("_rpower_scalar", jnp.power, True),
+    ("_maximum_scalar", jnp.maximum, False),
+    ("_minimum_scalar", jnp.minimum, False),
+    ("_hypot_scalar", jnp.hypot, False),
+]:
+    register(_name, attrs=_SCALAR_SPEC)(_scalar_op(_impl, _rev))
+for _name, _impl in [
+    ("_equal_scalar", jnp.equal),
+    ("_not_equal_scalar", jnp.not_equal),
+    ("_greater_scalar", jnp.greater),
+    ("_greater_equal_scalar", jnp.greater_equal),
+    ("_lesser_scalar", jnp.less),
+    ("_lesser_equal_scalar", jnp.less_equal),
+]:
+    register(_name, attrs=_SCALAR_SPEC, differentiable=False)(
+        (lambda impl: (lambda x, scalar: impl(x, scalar).astype(x.dtype)))(_impl)
+    )
+
+
+@register("smooth_l1", attrs=AttrSpec(scalar=("float", 1.0)))
+def _smooth_l1(x, scalar):
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+# n-ary sum (reference: elemwise_sum.cc ElementWiseSum / add_n)
+@register("add_n", aliases=["ElementWiseSum", "_sum"], key_var_num_args="num_args",
+          attrs=AttrSpec(num_args=("int", 0)))
+def _add_n(*args, num_args=0):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reductions (broadcast_reduce_op_*.cc): sum/mean/prod/nansum/nanprod/max/min/
+# norm, argmax/argmin. XLA's fused reducers replace the 2-phase GPU reduce.
+# ---------------------------------------------------------------------------
+
+_REDUCE_SPEC = AttrSpec(axis=("tuple", None), keepdims=("bool", False),
+                        exclude=("bool", False))
+
+
+def _norm_axes(axis, ndim, exclude):
+    if axis is None:
+        return None
+    axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(i for i in range(ndim) if i not in axes)
+    return axes
+
+
+def _reduce_op(impl):
+    def f(x, axis=None, keepdims=False, exclude=False):
+        axes = _norm_axes(axis, x.ndim, exclude)
+        return impl(x, axis=axes, keepdims=keepdims)
+    return f
+
+
+for _name, _impl in [
+    ("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+    ("nansum", jnp.nansum), ("nanprod", jnp.nanprod),
+    ("max", jnp.max), ("min", jnp.min),
+]:
+    register(_name, attrs=_REDUCE_SPEC)(_reduce_op(_impl))
+alias("sum_axis", "sum")
+alias("max_axis", "max")
+alias("min_axis", "min")
+
+
+@register("norm")
+def _norm(x):
+    # reference norm flattens and takes the L2 norm (broadcast_reduce_op_value.cc)
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)))).astype(x.dtype)
+
+
+_ARG_SPEC = AttrSpec(axis=("any", None), keepdims=("bool", False))
+
+
+def _arg_reduce(impl):
+    def f(x, axis=None, keepdims=False):
+        if axis is None:
+            out = impl(x.reshape(-1), axis=0)
+            if keepdims:
+                out = out.reshape((1,) * x.ndim)
+            return out.astype(jnp.float32)
+        axis_i = int(axis)
+        out = impl(x, axis=axis_i)
+        if keepdims:
+            out = jnp.expand_dims(out, axis_i)
+        return out.astype(jnp.float32)
+    return f
+
+
+register("argmax", attrs=_ARG_SPEC, differentiable=False)(_arg_reduce(jnp.argmax))
+register("argmin", attrs=_ARG_SPEC, differentiable=False)(_arg_reduce(jnp.argmin))
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=-1).astype(jnp.float32)
+
+
+# broadcast_to / broadcast_axis (broadcast_reduce_op_value.cc)
+@register("broadcast_to", attrs=AttrSpec(shape=("tuple",)))
+def _broadcast_to(x, shape):
+    target = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, target)
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"],
+          attrs=AttrSpec(axis=("tuple", ()), size=("tuple", ())))
+def _broadcast_axis(x, axis, size):
+    target = list(x.shape)
+    for a, s in zip(axis, size):
+        target[a % x.ndim] = s
+    return jnp.broadcast_to(x, tuple(target))
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot (dot-inl.h) — straight onto the MXU.
+# ---------------------------------------------------------------------------
+
+_DOT_SPEC = AttrSpec(transpose_a=("bool", False), transpose_b=("bool", False))
+
+
+@register("dot", num_inputs=2, input_names=["lhs", "rhs"], attrs=_DOT_SPEC)
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    if transpose_a:
+        a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+    # reference semantics: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot", num_inputs=2, input_names=["lhs", "rhs"], attrs=_DOT_SPEC)
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("L2Normalization",
+          attrs=AttrSpec(eps=("float", 1e-10), mode=("str", "instance")))
+def _l2_normalization(x, eps, mode):
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise ValueError(f"unknown L2Normalization mode {mode}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm

@@ -1,0 +1,113 @@
+"""Optimizer update operators.
+
+Reference surface: src/operator/optimizer_op.cc:36-221 — sgd_update,
+sgd_mom_update, mp_sgd* (fp16 master-weight), adam_update, rmsprop_update,
+rmspropalex_update. Pure functional here: each returns the new weight (and
+new state tensors); the Optimizer/Updater layer writes them back into the
+parameter NDArrays, which is the XLA-donation-friendly shape of the
+reference's in-place kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import AttrSpec
+from .registry import register
+
+_COMMON = dict(lr=("float",), wd=("float", 0.0), rescale_grad=("float", 1.0),
+               clip_gradient=("float", -1.0))
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update", num_inputs=2, input_names=["weight", "grad"],
+          differentiable=False, attrs=AttrSpec(**_COMMON))
+def _sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", num_inputs=3, input_names=["weight", "grad", "mom"],
+          differentiable=False, num_outputs=2,
+          attrs=AttrSpec(momentum=("float", 0.0), **_COMMON))
+def _sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", num_inputs=3,
+          input_names=["weight", "grad", "weight32"],
+          differentiable=False, num_outputs=2, attrs=AttrSpec(**_COMMON))
+def _mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", num_inputs=4,
+          input_names=["weight", "grad", "mom", "weight32"],
+          differentiable=False, num_outputs=3,
+          attrs=AttrSpec(momentum=("float", 0.0), **_COMMON))
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", num_inputs=4,
+          input_names=["weight", "grad", "mean", "var"],
+          differentiable=False, num_outputs=3,
+          attrs=AttrSpec(beta1=("float", 0.9), beta2=("float", 0.999),
+                         epsilon=("float", 1e-8), **_COMMON))
+def _adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", num_inputs=3, input_names=["weight", "grad", "n"],
+          differentiable=False, num_outputs=2,
+          attrs=AttrSpec(gamma1=("float", 0.95), epsilon=("float", 1e-8),
+                         clip_weights=("float", -1.0), **_COMMON))
+def _rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8,
+                    clip_weights=-1.0, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_w = weight - lr * g * lax.rsqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", num_inputs=5,
+          input_names=["weight", "grad", "n", "g", "delta"],
+          differentiable=False, num_outputs=4,
+          attrs=AttrSpec(gamma1=("float", 0.95), gamma2=("float", 0.9),
+                         epsilon=("float", 1e-8), clip_weights=("float", -1.0),
+                         **_COMMON))
+def _rmspropalex_update(weight, grad, n, g_state, delta, lr, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, clip_weights=-1.0, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_state + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g * lax.rsqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for ops where hand-fusion beats XLA's defaults.
+
+SURVEY.md §7.1: "Pallas kernels only where fusion loses (e.g. fused LSTM
+cell, …)". Flash attention keeps the S×S score matrix out of HBM entirely
+(VMEM-blocked online softmax — the whole point on long sequences); the
+fused LSTM cell collapses the per-step gate arithmetic into one VPU pass.
+Every kernel has a pure-jnp fallback used on non-TPU backends (the CPU
+test mesh) and for verification.
+"""
+from .attention import flash_attention  # noqa: F401
+from .lstm import lstm_cell_fused  # noqa: F401

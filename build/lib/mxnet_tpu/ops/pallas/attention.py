@@ -1,0 +1,251 @@
+"""Flash attention: VMEM-blocked online-softmax attention kernel.
+
+The jnp path (and the reference's Softmax-based attention compositions)
+materialize the (S, S) score matrix in HBM; this kernel streams K/V blocks
+through VMEM with the standard online-softmax recurrence, so HBM traffic is
+O(S·D) and the MXU sees back-to-back (BQ, D)x(D, BK) matmuls. Public
+pattern: Dao et al. 2022 + the Pallas guide's blocked-matmul recipe.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _attn_reference(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2:]
+        mask = (jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
+                + (sk - sq))
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  causal, scale, seq_k, seq_q):
+    """Grid (BH, n_q, n_k), n_k innermost+sequential. Blocks live in VMEM:
+    q (1, BQ, D), k/v (1, BK, D) — only one K/V tile resident at a time, so
+    VMEM use is O(BQ*D + BK*D) regardless of S. m/l/acc scratch carries the
+    online-softmax state across the n_k loop."""
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_off = qi * bq + (seq_k - seq_q)  # causal diagonal offset
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # a K block strictly above the causal diagonal contributes nothing
+    live = (ki * bk <= q_off + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BQ, BK)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (ki * bk + cols) <= (q_off + rows)
+            s = jnp.where(mask, s, _NEG)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new[:, None] + jnp.zeros_like(m_ref)
+        l_ref[:] = l_new[:, None] + jnp.zeros_like(l_ref)
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+try:  # pallas import is deferred-safe: CPU-only installs still work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _pick_block(s, target):
+    b = min(target, s)
+    while s % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "force_pallas"))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=512, force_pallas=False):
+    """Attention over (B, H, S, D) inputs; exact, memory-efficient.
+
+    Uses the Pallas TPU kernel on TPU backends (or when force_pallas, via
+    the interpreter — tests), and the jnp reference elsewhere.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if causal and sq > sk:
+        # rows past the KV length would have an empty causal window —
+        # an ill-defined softmax the paths disagree on; reject loudly
+        raise ValueError(
+            f"flash_attention(causal=True) requires seq_q <= seq_k, got "
+            f"{sq} > {sk}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    on_tpu = jax.default_backend() == "tpu"
+    if not _HAVE_PALLAS or (not on_tpu and not force_pallas):
+        return _attn_reference(q, k, v, causal, scale)
+
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
+                               seq_k=sk, seq_q=sq)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max m
+            pltpu.VMEM((bq, 128), jnp.float32),  # running normalizer l
+            pltpu.VMEM((bq, d), jnp.float32),    # unnormalized output
+        ],
+        interpret=not on_tpu,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k, force_pallas):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k,
+                          force_pallas)
+    return out, (q, k, v, out)
+
+
+def _blockwise_bwd(q, k, v, out, do, causal, scale, block_k):
+    """Flash-attention backward as a k-block scan: O(S*BK) temporaries
+    instead of the S x S score matrix (standard Dao et al. recurrence).
+
+    All (B, H, S, D). Two passes: (1) recompute row logsumexp; (2)
+    accumulate dq and per-block dk/dv with normalized probabilities.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk = _pick_block(sk, block_k)
+    n_k = sk // bk
+    qf = q.astype(jnp.float32) * scale
+    dof = do.astype(jnp.float32)
+    # delta_i = sum_j dO_ij O_ij  (rowwise) — the softmax-jacobian constant
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,H,S)
+    qpos = jnp.arange(sq)
+    kb = k.reshape(b, h, n_k, bk, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, n_k, bk, d).transpose(2, 0, 1, 3, 4)
+
+    def scores(k_blk, j):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            # same diagonal convention as the forward kernel:
+            # kpos <= qpos + (sk - sq)
+            kpos = j * bk + jnp.arange(bk)
+            mask = (kpos[None, None, None, :]
+                    <= qpos[None, None, :, None] + (sk - sq))
+            s = jnp.where(mask, s, _NEG)
+        return s
+
+    # pass 1: logsumexp over all key blocks
+    def lse_step(carry, inp):
+        m, l = carry
+        j, k_blk = inp
+        s = scores(k_blk, j)
+        m_cur = jnp.max(s, -1)
+        m_new = jnp.maximum(m, m_cur)
+        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[..., None]),
+                                             -1)
+        return (m_new, l), None
+
+    (m, l), _ = jax.lax.scan(
+        lse_step,
+        (jnp.full((b, h, sq), _NEG, jnp.float32),
+         jnp.zeros((b, h, sq), jnp.float32)),
+        (jnp.arange(n_k), kb))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+
+    # pass 2: gradient accumulation
+    def grad_step(dq, inp):
+        j, k_blk, v_blk = inp
+        s = scores(k_blk, j)
+        p = jnp.exp(s - lse[..., None])  # normalized probs (B,H,S,BK)
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof,
+                        v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             k_blk.astype(jnp.float32))
+        # ds folds the score scale; dk pairs with the UNscaled q
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+        return dq, (dk_b, dv_b)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        grad_step, jnp.zeros((b, h, sq, d), jnp.float32),
+        (jnp.arange(n_k), kb, vb))
+    dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, sk, d)
+    dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, sk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, force_pallas, res, ct):
+    q, k, v, out = res
+    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _blockwise_bwd(q, k, v, out, ct, causal, s, block_k)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+from ..registry import register  # noqa: E402
+from ...base import AttrSpec  # noqa: E402
+
+
+@register("_contrib_flash_attention", aliases=["flash_attention_op"],
+          num_inputs=3, input_names=["query", "key", "value"],
+          attrs=AttrSpec(causal=("bool", False), scale=("any", None)))
+def _flash_attention_op(q, k, v, causal=False, scale=None):
+    """Memory-efficient exact attention over (B, H, S, D) inputs
+    (beyond-reference op: the 2017 reference predates attention kernels)."""
+    return flash_attention(q, k, v, causal,
+                           None if scale is None else float(scale))

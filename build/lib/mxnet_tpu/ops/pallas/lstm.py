@@ -1,0 +1,109 @@
+"""Fused LSTM cell: one Pallas kernel per scan step.
+
+The jnp cell (rnn_ops._cell_step) emits a matmul plus ~10 pointwise ops
+per step that XLA fuses only partially across the scan boundary; this
+kernel does the h-projection on the MXU and all four gate nonlinearities +
+state update in a single VPU pass over VMEM-resident blocks. Backward is a
+hand-written VJP (the standard LSTM cell adjoints, computed in jnp — they
+are one matmul + pointwise, and autodiff can't see through pallas_call).
+Gate order i,f,g,o matches the RNN op's cuDNN packing (rnn_ops.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _gates(xproj, h, w_h2h):
+    g = xproj.astype(jnp.float32) + jax.lax.dot_general(
+        h.astype(jnp.float32), w_h2h.astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    H = h.shape[-1]
+    return (jax.nn.sigmoid(g[:, 0 * H:1 * H]),
+            jax.nn.sigmoid(g[:, 1 * H:2 * H]),
+            jnp.tanh(g[:, 2 * H:3 * H]),
+            jax.nn.sigmoid(g[:, 3 * H:4 * H]))
+
+
+def _cell_jnp(xproj, h, c, w_h2h):
+    i, f, g, o = _gates(xproj, h, w_h2h)
+    c_new = f * c.astype(jnp.float32) + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new.astype(h.dtype), c_new.astype(c.dtype)
+
+
+def _lstm_kernel(xproj_ref, h_ref, c_ref, w_ref, hn_ref, cn_ref):
+    i, f, g, o = _gates(xproj_ref[:], h_ref[:], w_ref[:])
+    c_new = f * c_ref[:].astype(jnp.float32) + i * g
+    h_new = o * jnp.tanh(c_new)
+    hn_ref[:] = h_new.astype(hn_ref.dtype)
+    cn_ref[:] = c_new.astype(cn_ref.dtype)
+
+
+def _cell_pallas(xproj, h, c, w_h2h, interpret):
+    if not _HAVE_PALLAS:
+        from ...base import MXNetError
+        raise MXNetError("pallas is unavailable in this jax install; use "
+                         "lstm_cell_fused(..., impl='jnp')")
+    n, hdim = h.shape
+    return pl.pallas_call(
+        _lstm_kernel,
+        out_shape=(jax.ShapeDtypeStruct((n, hdim), h.dtype),
+                   jax.ShapeDtypeStruct((n, hdim), c.dtype)),
+        interpret=interpret,
+    )(xproj, h, c, w_h2h)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _cell(xproj, h, c, w_h2h, impl):
+    if impl == "jnp":
+        return _cell_jnp(xproj, h, c, w_h2h)
+    return _cell_pallas(xproj, h, c, w_h2h, interpret=(impl == "interpret"))
+
+
+def _cell_fwd(xproj, h, c, w_h2h, impl):
+    out = _cell(xproj, h, c, w_h2h, impl)
+    return out, (xproj, h, c, w_h2h)
+
+
+def _cell_bwd(impl, res, cts):
+    xproj, h, c, w_h2h = res
+    dh_new, dc_new = cts
+    i, f, g, o = _gates(xproj, h, w_h2h)  # rematerialize (cheap pointwise)
+    cf = c.astype(jnp.float32)
+    c_new = f * cf + i * g
+    tc = jnp.tanh(c_new)
+    dh32 = dh_new.astype(jnp.float32)
+    dc = dc_new.astype(jnp.float32) + dh32 * o * (1 - tc * tc)
+    d_i = dc * g * i * (1 - i)
+    d_f = dc * cf * f * (1 - f)
+    d_g = dc * i * (1 - g * g)
+    d_o = dh32 * tc * o * (1 - o)
+    dgates = jnp.concatenate([d_i, d_f, d_g, d_o], axis=-1)
+    dxproj = dgates.astype(xproj.dtype)
+    dh = (dgates @ w_h2h.astype(jnp.float32)).astype(h.dtype)
+    dc_prev = (dc * f).astype(c.dtype)
+    dw = jax.lax.dot_general(dgates, h.astype(jnp.float32),
+                             (((0,), (0,)), ((), ()))).astype(w_h2h.dtype)
+    return dxproj, dh, dc_prev, dw
+
+
+_cell.defvjp(_cell_fwd, _cell_bwd)
+
+
+def lstm_cell_fused(xproj, h, c, w_h2h, impl=None):
+    """One LSTM step: (xproj (N,4H), h (N,H), c (N,H), w_h2h (4H,H)) ->
+    (h', c'). impl: None = auto (pallas on TPU, jnp elsewhere),
+    'pallas' | 'interpret' | 'jnp' to force."""
+    if impl is None:
+        impl = "pallas" if (_HAVE_PALLAS
+                            and jax.default_backend() == "tpu") else "jnp"
+    return _cell(xproj, h, c, w_h2h, impl)

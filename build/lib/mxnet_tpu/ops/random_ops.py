@@ -1,0 +1,168 @@
+"""Random sampling operators.
+
+Reference surface: src/operator/random/{sample_op.cc, multisample_op.cc} —
+uniform/normal/gamma/exponential/poisson/negative-binomial samplers plus
+per-row multisample variants and sample_multinomial. Rebuilt on jax.random
+with explicit key threading: imperative calls draw from the global seed state
+(mxnet_tpu.random), jitted graphs get a per-step key from the executor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import AttrSpec
+from .registry import register
+
+_SAMPLE_SPEC = lambda **extra: AttrSpec(  # noqa: E731
+    shape=("tuple", ()), ctx=("str", ""), dtype=("str", "float32"), **extra
+)
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype if dtype not in ("None", None, "") else "float32")
+
+
+@register("_random_uniform", aliases=["uniform", "random_uniform"],
+          num_inputs=0, needs_rng=True, differentiable=False,
+          attrs=_SAMPLE_SPEC(low=("float", 0.0), high=("float", 1.0)))
+def _random_uniform(rng, shape=(), ctx="", dtype="float32", low=0.0, high=1.0):
+    return jax.random.uniform(rng, shape, _dt(dtype), low, high)
+
+
+@register("_random_normal", aliases=["normal", "random_normal"],
+          num_inputs=0, needs_rng=True, differentiable=False,
+          attrs=_SAMPLE_SPEC(loc=("float", 0.0), scale=("float", 1.0)))
+def _random_normal(rng, shape=(), ctx="", dtype="float32", loc=0.0, scale=1.0):
+    return loc + scale * jax.random.normal(rng, shape, _dt(dtype))
+
+
+@register("_random_gamma", aliases=["random_gamma"],
+          num_inputs=0, needs_rng=True, differentiable=False,
+          attrs=_SAMPLE_SPEC(alpha=("float", 1.0), beta=("float", 1.0)))
+def _random_gamma(rng, shape=(), ctx="", dtype="float32", alpha=1.0, beta=1.0):
+    return jax.random.gamma(rng, alpha, shape, _dt(dtype)) * beta
+
+
+@register("_random_exponential", aliases=["random_exponential"],
+          num_inputs=0, needs_rng=True, differentiable=False,
+          attrs=_SAMPLE_SPEC(lam=("float", 1.0)))
+def _random_exponential(rng, shape=(), ctx="", dtype="float32", lam=1.0):
+    return jax.random.exponential(rng, shape, _dt(dtype)) / lam
+
+
+@register("_random_poisson", aliases=["random_poisson"],
+          num_inputs=0, needs_rng=True, differentiable=False,
+          attrs=_SAMPLE_SPEC(lam=("float", 1.0)))
+def _random_poisson(rng, shape=(), ctx="", dtype="float32", lam=1.0):
+    return jax.random.poisson(rng, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", aliases=["random_negative_binomial"],
+          num_inputs=0, needs_rng=True, differentiable=False,
+          attrs=_SAMPLE_SPEC(k=("int", 1), p=("float", 1.0)))
+def _random_negative_binomial(rng, shape=(), ctx="", dtype="float32", k=1, p=1.0):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    kg, kp = jax.random.split(rng)
+    lam = jax.random.gamma(kg, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(kp, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=["random_generalized_negative_binomial"],
+          num_inputs=0, needs_rng=True, differentiable=False,
+          attrs=_SAMPLE_SPEC(mu=("float", 1.0), alpha=("float", 1.0)))
+def _random_gnb(rng, shape=(), ctx="", dtype="float32", mu=1.0, alpha=1.0):
+    kg, kp = jax.random.split(rng)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(kg, r, shape) * (mu * alpha)
+    return jax.random.poisson(kp, lam, shape).astype(_dt(dtype))
+
+
+# --- per-row multisample variants (multisample_op.cc): params are arrays ----
+
+_MULTI_SPEC = AttrSpec(shape=("tuple", ()), dtype=("str", "float32"))
+
+
+def _msample_shape(param, shape):
+    return param.shape + tuple(shape)
+
+
+@register("_sample_uniform", aliases=["sample_uniform"], num_inputs=2,
+          input_names=["low", "high"], needs_rng=True, differentiable=False,
+          attrs=_MULTI_SPEC)
+def _sample_uniform(rng, low, high, shape=(), dtype="float32"):
+    s = _msample_shape(low, shape)
+    u = jax.random.uniform(rng, s, _dt(dtype))
+    bshape = low.shape + (1,) * (len(s) - low.ndim)
+    lo, hi = low.reshape(bshape), high.reshape(bshape)
+    return lo + u * (hi - lo)
+
+
+@register("_sample_normal", aliases=["sample_normal"], num_inputs=2,
+          input_names=["mu", "sigma"], needs_rng=True, differentiable=False,
+          attrs=_MULTI_SPEC)
+def _sample_normal(rng, mu, sigma, shape=(), dtype="float32"):
+    s = _msample_shape(mu, shape)
+    z = jax.random.normal(rng, s, _dt(dtype))
+    bshape = mu.shape + (1,) * (len(s) - mu.ndim)
+    return mu.reshape(bshape) + sigma.reshape(bshape) * z
+
+
+@register("_sample_gamma", aliases=["sample_gamma"], num_inputs=2,
+          input_names=["alpha", "beta"], needs_rng=True, differentiable=False,
+          attrs=_MULTI_SPEC)
+def _sample_gamma(rng, alpha, beta, shape=(), dtype="float32"):
+    s = _msample_shape(alpha, shape)
+    bshape = alpha.shape + (1,) * (len(s) - alpha.ndim)
+    g = jax.random.gamma(rng, jnp.broadcast_to(alpha.reshape(bshape), s), dtype=_dt(dtype))
+    return g * beta.reshape(bshape)
+
+
+@register("_sample_exponential", aliases=["sample_exponential"], num_inputs=1,
+          input_names=["lam"], needs_rng=True, differentiable=False,
+          attrs=_MULTI_SPEC)
+def _sample_exponential(rng, lam, shape=(), dtype="float32"):
+    s = _msample_shape(lam, shape)
+    bshape = lam.shape + (1,) * (len(s) - lam.ndim)
+    return jax.random.exponential(rng, s, _dt(dtype)) / lam.reshape(bshape)
+
+
+@register("_sample_poisson", aliases=["sample_poisson"], num_inputs=1,
+          input_names=["lam"], needs_rng=True, differentiable=False,
+          attrs=_MULTI_SPEC)
+def _sample_poisson(rng, lam, shape=(), dtype="float32"):
+    s = _msample_shape(lam, shape)
+    bshape = lam.shape + (1,) * (len(s) - lam.ndim)
+    return jax.random.poisson(rng, jnp.broadcast_to(lam.reshape(bshape), s)).astype(_dt(dtype))
+
+
+def _multinomial_nout(attrs):
+    return 2 if attrs.get("get_prob") in (True, "True", "1") else 1
+
+
+@register("_sample_multinomial", aliases=["sample_multinomial"],
+          num_inputs=1, input_names=["data"], needs_rng=True,
+          differentiable=False, num_outputs=_multinomial_nout,
+          attrs=AttrSpec(shape=("tuple", ()), get_prob=("bool", False),
+                         dtype=("str", "int32")))
+def _sample_multinomial(rng, data, shape=(), get_prob=False, dtype="int32"):
+    n = 1
+    for s in shape:
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    samp = jax.random.categorical(rng, logits, axis=-1,
+                                  shape=(max(n, 1),) + data.shape[:-1])
+    # move the sample axis behind the batch axes: (batch..., n)
+    samp = jnp.moveaxis(samp, 0, -1)
+    out_shape = data.shape[:-1] + tuple(shape) if shape else data.shape[:-1]
+    samp = samp.reshape(out_shape).astype(_dt(dtype))
+    if get_prob:
+        logp = jnp.log(jnp.maximum(data, 1e-30))
+        picked = jnp.take_along_axis(
+            logp.reshape(-1, data.shape[-1]),
+            samp.reshape(len(logp.reshape(-1, data.shape[-1])), -1).astype(jnp.int32),
+            axis=-1,
+        ).reshape(samp.shape)
+        return samp, picked
+    return samp

@@ -1,0 +1,167 @@
+"""Spatial warping / correlation operators.
+
+Reference surface: src/operator/grid_generator.cc, bilinear_sampler.cc,
+spatial_transformer.cc, correlation.cc. Rebuilt as gather-based jnp
+programs: bilinear sampling is four gathers + lerp (differentiable through
+jax autodiff — the reference hand-wrote atomic-add backward kernels);
+Correlation unrolls its static displacement grid into shifted products
+reduced by a box filter, which XLA fuses far better than the reference's
+per-displacement CUDA kernel loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import AttrSpec, MXNetError
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# GridGenerator (grid_generator.cc)
+# ---------------------------------------------------------------------------
+
+
+def _identity_grid(h, w):
+    """Normalized [-1,1] target coords, x then y, shape (2, H, W)."""
+    ys = jnp.linspace(-1.0, 1.0, h) if h > 1 else jnp.zeros((1,))
+    xs = jnp.linspace(-1.0, 1.0, w) if w > 1 else jnp.zeros((1,))
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    return jnp.stack([gx, gy])
+
+
+@register("GridGenerator", num_inputs=1, input_names=["data"],
+          attrs=AttrSpec(transform_type=("str",),
+                         target_shape=("tuple", (0, 0))))
+def _grid_generator(data, transform_type, target_shape=(0, 0)):
+    """affine: data (N, 6) -> grid (N, 2, H, W) of source coords in [-1,1].
+    warp: data (N, 2, H, W) pixel flow added to the identity grid."""
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        if h <= 0 or w <= 0:
+            raise MXNetError("GridGenerator(affine) needs target_shape")
+        grid = _identity_grid(h, w)  # (2, H, W)
+        ones = jnp.ones((1, h, w), grid.dtype)
+        tgt = jnp.concatenate([grid, ones]).reshape(3, -1)  # (3, H*W)
+        theta = data.reshape(-1, 2, 3).astype(jnp.float32)
+        src = jnp.einsum("nij,jk->nik", theta, tgt)  # (N, 2, H*W)
+        return src.reshape(-1, 2, h, w)
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        grid = _identity_grid(h, w)[None]
+        # pixel-unit flow -> normalized offsets
+        norm = jnp.asarray([max(w - 1, 1) / 2.0, max(h - 1, 1) / 2.0],
+                           jnp.float32).reshape(1, 2, 1, 1)
+        return grid + data / norm
+    raise MXNetError(f"GridGenerator: unknown transform_type "
+                     f"{transform_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler (bilinear_sampler.cc)
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_sample(img, gx, gy):
+    """img (C, H, W); gx, gy (Ho, Wo) in source pixel coords. Zero padding
+    outside the image (reference: between -1 and 1 then zero-pad)."""
+    _, h, w = img.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def tap(xi, yi):
+        inb = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # (C, Ho, Wo)
+        return jnp.where(inb[None], v, 0.0)
+
+    v00 = tap(x0, y0)
+    v01 = tap(x0 + 1, y0)
+    v10 = tap(x0, y0 + 1)
+    v11 = tap(x0 + 1, y0 + 1)
+    top = v00 * (1 - wx)[None] + v01 * wx[None]
+    bot = v10 * (1 - wx)[None] + v11 * wx[None]
+    return top * (1 - wy)[None] + bot * wy[None]
+
+
+@register("BilinearSampler", num_inputs=2, input_names=["data", "grid"],
+          attrs=AttrSpec())
+def _bilinear_sampler(data, grid):
+    """data (N, C, H, W); grid (N, 2, Ho, Wo) normalized [-1,1] (x, y)."""
+    _, _, h, w = data.shape
+
+    def one(img, g):
+        gx = (g[0] + 1.0) * (w - 1) / 2.0
+        gy = (g[1] + 1.0) * (h - 1) / 2.0
+        return _bilinear_sample(img.astype(jnp.float32), gx, gy)
+
+    return jax.vmap(one)(data, grid.astype(jnp.float32)).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer (spatial_transformer.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("SpatialTransformer", num_inputs=2, input_names=["data", "loc"],
+          attrs=AttrSpec(target_shape=("tuple", (0, 0)),
+                         transform_type=("str", "affine"),
+                         sampler_type=("str", "bilinear")))
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear"):
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine/bilinear only")
+    grid = _grid_generator(loc, "affine", target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (correlation.cc — FlowNet correlation layer)
+# ---------------------------------------------------------------------------
+
+
+@register("Correlation", num_inputs=2, input_names=["data1", "data2"],
+          attrs=AttrSpec(kernel_size=("int", 1), max_displacement=("int", 1),
+                         stride1=("int", 1), stride2=("int", 1),
+                         pad_size=("int", 0), is_multiply=("bool", True)))
+def _correlation(data1, data2, kernel_size=1, max_displacement=1,
+                 stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """(N,C,H,W) x2 -> (N, D*D, Hout, Wout); D = 2*(max_disp//stride2)+1.
+
+    For each displacement (dy,dx) on the stride2 grid: mean over channels
+    and the kernel window of data1[p] * data2[p+d] (or |a-b| when
+    is_multiply=False), evaluated at stride1 output positions.
+    """
+    n, c, h, w = data1.shape
+    k = kernel_size
+    br = k // 2
+    d_rad = max_displacement // stride2
+    pad = [(0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)]
+    p1 = jnp.pad(data1.astype(jnp.float32), pad)
+    p2 = jnp.pad(data2.astype(jnp.float32), pad)
+    ph, pw = h + 2 * pad_size, w + 2 * pad_size
+    out_h = -(-(ph - 2 * br - 2 * max_displacement) // stride1)
+    out_w = -(-(pw - 2 * br - 2 * max_displacement) // stride1)
+    if out_h <= 0 or out_w <= 0:
+        raise MXNetError("Correlation: non-positive output size")
+    kern = jnp.ones((1, 1, k, k), jnp.float32) / (k * k * c)
+    maps = []
+    for dy in range(-d_rad, d_rad + 1):
+        for dx in range(-d_rad, d_rad + 1):
+            sy, sx = dy * stride2, dx * stride2
+            shifted = jnp.roll(p2, (-sy, -sx), axis=(2, 3))
+            prod = (p1 * shifted if is_multiply
+                    else jnp.abs(p1 - shifted))
+            summed = jnp.sum(prod, axis=1, keepdims=True)
+            filt = lax.conv_general_dilated(
+                summed, kern, window_strides=(1, 1), padding="VALID")
+            # filt[y, x] = window mean centered at padded pos (y+br, x+br);
+            # outputs start at center max_displacement+br, step stride1
+            off = max_displacement
+            m = filt[:, 0, off:off + out_h * stride1:stride1,
+                     off:off + out_w * stride1:stride1]
+            maps.append(m)
+    return jnp.stack(maps, axis=1)

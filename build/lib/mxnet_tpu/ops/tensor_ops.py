@@ -1,0 +1,413 @@
+"""Shape-manipulation, indexing, creation, ordering and control-flow ops.
+
+Reference surface: src/operator/tensor/matrix_op-inl.h (reshape/transpose/
+slice/…), indexing_op.cc (Embedding/take/one_hot), init_op.cc, ordering_op.cc
+(sort/argsort/topk via mshadow/cub), control_flow_op.cc (where), plus legacy
+layer-style ops Concat/SliceChannel/Pad/SwapAxis/Flatten/Crop
+(src/operator/{concat,slice_channel,pad,swapaxis,flatten,crop}*).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import AttrSpec, MXNetError
+from .registry import alias, register
+
+# ---------------------------------------------------------------------------
+# reshape family (matrix_op-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _infer_reshape(data_shape, target):
+    """Implements the reference's special reshape codes 0/-1/-2/-3/-4
+    (matrix_op-inl.h ReshapeParam docs)."""
+    out = []
+    src = list(data_shape)
+    i = 0  # index into src
+    j = 0  # index into target
+    while j < len(target):
+        t = target[j]
+        if t == 0:
+            out.append(src[i]); i += 1
+        elif t == -1:
+            out.append(-1); i += 1
+        elif t == -2:
+            out.extend(src[i:]); i = len(src)
+        elif t == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif t == -4:
+            d1, d2 = target[j + 1], target[j + 2]
+            cur = src[i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(t); i += 1
+        j += 1
+    return tuple(out)
+
+
+@register("Reshape", aliases=["reshape"],
+          attrs=AttrSpec(shape=("tuple", ()), reverse=("bool", False),
+                         target_shape=("tuple", ()), keep_highest=("bool", False)))
+def _reshape(x, shape=(), reverse=False, target_shape=(), keep_highest=False):
+    if not shape and target_shape:  # legacy args
+        shape = target_shape
+    if reverse:
+        inferred = _infer_reshape(x.shape[::-1], tuple(shape)[::-1])[::-1]
+    else:
+        inferred = _infer_reshape(x.shape, tuple(shape))
+    return jnp.reshape(x, inferred)
+
+
+@register("Flatten", aliases=["flatten"])
+def _flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose", attrs=AttrSpec(axes=("tuple", ())))
+def _transpose(x, axes=()):
+    return jnp.transpose(x, axes or None)
+
+
+@register("expand_dims", attrs=AttrSpec(axis=("int",)))
+def _expand_dims(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register("SwapAxis", aliases=["swapaxes"],
+          attrs=AttrSpec(dim1=("int", 0), dim2=("int", 0)))
+def _swapaxes(x, dim1, dim2):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("slice", aliases=["crop"],
+          attrs=AttrSpec(begin=("tuple",), end=("tuple",)))
+def _slice(x, begin, end):
+    idx = tuple(
+        slice(b if b is not None else 0, e if e is not None else x.shape[i])
+        for i, (b, e) in enumerate(zip(begin, end))
+    )
+    return x[idx]
+
+
+@register("slice_axis",
+          attrs=AttrSpec(axis=("int",), begin=("int", 0), end=("any", None)))
+def _slice_axis(x, axis, begin, end):
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    end = n if end in (None, "None") else int(end)
+    if end < 0:
+        end += n
+    if begin < 0:
+        begin += n
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("reverse", aliases=["flip"], attrs=AttrSpec(axis=("tuple",)))
+def _reverse(x, axis):
+    return jnp.flip(x, axis)
+
+
+@register("repeat", attrs=AttrSpec(repeats=("int",), axis=("any", None)))
+def _repeat(x, repeats, axis=None):
+    axis_i = None if axis in (None, "None") else int(axis)
+    return jnp.repeat(x, repeats, axis=axis_i)
+
+
+@register("tile", attrs=AttrSpec(reps=("tuple",)))
+def _tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+@register("Pad", aliases=["pad"],
+          attrs=AttrSpec(mode=("str",), pad_width=("tuple",),
+                         constant_value=("float", 0.0)))
+def _pad(x, mode, pad_width, constant_value):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(x.ndim)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise MXNetError(f"unknown pad mode {mode}")
+
+
+@register("Concat", aliases=["concat"], key_var_num_args="num_args",
+          attrs=AttrSpec(num_args=("int", 0), dim=("int", 1)))
+def _concat(*args, num_args=0, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack", key_var_num_args="num_args",
+          attrs=AttrSpec(num_args=("int", 0), axis=("int", 0)))
+def _stack(*args, num_args=0, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+def _slice_channel_nout(attrs):
+    return int(attrs.get("num_outputs", 1))
+
+
+@register("SliceChannel", aliases=["split"],
+          num_outputs=_slice_channel_nout,
+          attrs=AttrSpec(num_outputs=("int",), axis=("int", 1),
+                         squeeze_axis=("bool", False)))
+def _slice_channel(x, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("Crop", key_var_num_args="num_args",
+          attrs=AttrSpec(num_args=("int", 1), offset=("tuple", (0, 0)),
+                         h_w=("tuple", (0, 0)), center_crop=("bool", False)))
+def _crop(*args, num_args=1, offset=(0, 0), h_w=(0, 0), center_crop=False):
+    x = args[0]
+    if len(args) == 2:
+        h, w = args[1].shape[2], args[1].shape[3]
+    else:
+        h, w = h_w
+    if center_crop:
+        oy = (x.shape[2] - h) // 2
+        ox = (x.shape[3] - w) // 2
+    else:
+        oy, ox = offset
+    return x[:, :, oy:oy + h, ox:ox + w]
+
+
+# ---------------------------------------------------------------------------
+# indexing (indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("Embedding",
+          num_inputs=2, input_names=["data", "weight"],
+          param_shapes=lambda attrs, shapes: [
+              shapes[0], (int(attrs["input_dim"]), int(attrs["output_dim"]))],
+          attrs=AttrSpec(input_dim=("int",), output_dim=("int",),
+                         dtype=("str", "float32")))
+def _embedding(data, weight, input_dim, output_dim, dtype="float32"):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("take", num_inputs=2, input_names=["a", "indices"],
+          attrs=AttrSpec(axis=("int", 0), mode=("str", "clip")))
+def _take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take", num_inputs=2, input_names=["a", "indices"])
+def _batch_take(a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1
+    ).squeeze(1)
+
+
+@register("pick", num_inputs=2, input_names=["data", "index"],
+          attrs=AttrSpec(axis=("int", -1), keepdims=("bool", False),
+                         mode=("str", "clip")))
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """Pick data[..., index, ...] along ``axis`` (reference
+    broadcast_reduce_op_index.cc:pick)."""
+    axis = axis % data.ndim
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = idx % data.shape[axis]
+    else:
+        idx = jnp.clip(idx, 0, data.shape[axis] - 1)
+    idx = jnp.expand_dims(idx.reshape(
+        data.shape[:axis] + data.shape[axis + 1:]), axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis)
+
+
+@register("one_hot",
+          attrs=AttrSpec(depth=("int",), on_value=("float", 1.0),
+                         off_value=("float", 0.0), dtype=("str", "float32")),
+          differentiable=False)
+def _one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("gather_nd", num_inputs=2, input_names=["data", "indices"])
+def _gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("where", num_inputs=3, input_names=["condition", "x", "y"])
+def _where(condition, x, y):
+    if condition.ndim == 1 and x.ndim > 1:
+        condition = condition.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(condition != 0, x, y)
+
+
+# ---------------------------------------------------------------------------
+# creation (init_op.cc). These are zero-input ops: attrs fully determine the
+# output, so they are trivially jit-constant-folded.
+# ---------------------------------------------------------------------------
+
+_INIT_SPEC = AttrSpec(shape=("tuple", ()), ctx=("str", ""), dtype=("str", "float32"))
+
+
+@register("_zeros", num_inputs=0, attrs=_INIT_SPEC, differentiable=False)
+def _zeros(shape=(), ctx="", dtype="float32"):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+@register("_ones", num_inputs=0, attrs=_INIT_SPEC, differentiable=False)
+def _ones(shape=(), ctx="", dtype="float32"):
+    return jnp.ones(shape, dtype=jnp.dtype(dtype))
+
+
+@register("_full", num_inputs=0, differentiable=False,
+          attrs=AttrSpec(shape=("tuple", ()), ctx=("str", ""),
+                         dtype=("str", "float32"), value=("float",)))
+def _full(shape=(), ctx="", dtype="float32", value=0.0):
+    return jnp.full(shape, value, dtype=jnp.dtype(dtype))
+
+
+@register("_arange", num_inputs=0, differentiable=False,
+          attrs=AttrSpec(start=("float", 0.0), stop=("any", None),
+                         step=("float", 1.0), repeat=("int", 1),
+                         ctx=("str", ""), dtype=("str", "float32")))
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, ctx="", dtype="float32"):
+    if stop in (None, "None"):
+        start, stop = 0.0, start
+    out = jnp.arange(start, float(stop), step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("zeros_like", differentiable=False)
+def _zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like", differentiable=False)
+def _ones_like(x):
+    return jnp.ones_like(x)
+
+
+# ---------------------------------------------------------------------------
+# ordering (ordering_op.cc — sort/argsort/topk)
+# ---------------------------------------------------------------------------
+
+
+@register("sort", attrs=AttrSpec(axis=("any", -1), is_ascend=("bool", True)))
+def _sort(x, axis=-1, is_ascend=True):
+    if axis in (None, "None"):
+        x, axis = x.reshape(-1), -1
+    axis = int(axis)
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", differentiable=False,
+          attrs=AttrSpec(axis=("any", -1), is_ascend=("bool", True),
+                         dtype=("str", "float32")))
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    if axis in (None, "None"):
+        x, axis = x.reshape(-1), -1
+    axis = int(axis)
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+def _topk_nout(attrs):
+    rt = attrs.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register("topk", num_outputs=_topk_nout, differentiable=False,
+          attrs=AttrSpec(axis=("any", -1), k=("int", 1),
+                         ret_typ=("str", "indices"), is_ascend=("bool", False),
+                         dtype=("str", "float32")))
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    if axis in (None, "None"):
+        x, axis = x.reshape(-1), -1
+    axis = int(axis) % x.ndim
+    xs = jnp.moveaxis(x, axis, -1)
+    vals = -xs if not is_ascend else xs
+    sort_idx = jnp.argsort(vals, axis=-1)[..., :k]
+    top_vals = jnp.take_along_axis(xs, sort_idx, axis=-1)
+    idx_out = jnp.moveaxis(sort_idx, -1, axis).astype(jnp.dtype(dtype))
+    val_out = jnp.moveaxis(top_vals, -1, axis)
+    if ret_typ == "indices":
+        return idx_out
+    if ret_typ == "value":
+        return val_out
+    if ret_typ == "both":
+        return (val_out, idx_out)
+    if ret_typ == "mask":
+        mask = jnp.zeros_like(xs)
+        mask = jnp.put_along_axis(mask, sort_idx, 1.0, axis=-1, inplace=False)
+        return jnp.moveaxis(mask, -1, axis)
+    raise MXNetError(f"unknown topk ret_typ {ret_typ}")
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (src/operator/sequence_{last,mask,reverse}*.cc) — inputs are
+# time-major (T, N, ...) like the reference.
+# ---------------------------------------------------------------------------
+
+_SEQ_SPEC = AttrSpec(use_sequence_length=("bool", False), axis=("int", 0))
+
+
+def _seq_len_or_full(args, use_sequence_length, T, N):
+    if use_sequence_length and len(args) > 1:
+        return args[1].astype(jnp.int32)
+    return jnp.full((N,), T, dtype=jnp.int32)
+
+
+@register("SequenceLast", key_var_num_args=None, num_inputs=None,
+          input_names=["data", "sequence_length"], attrs=_SEQ_SPEC)
+def _sequence_last(*args, use_sequence_length=False, axis=0):
+    data = args[0]
+    T, N = data.shape[0], data.shape[1]
+    lengths = _seq_len_or_full(args, use_sequence_length, T, N)
+    idx = jnp.clip(lengths - 1, 0, T - 1)
+    return data[idx, jnp.arange(N)]
+
+
+@register("SequenceMask", num_inputs=None,
+          input_names=["data", "sequence_length"],
+          attrs=AttrSpec(use_sequence_length=("bool", False),
+                         value=("float", 0.0), axis=("int", 0)))
+def _sequence_mask(*args, use_sequence_length=False, value=0.0, axis=0):
+    data = args[0]
+    T, N = data.shape[0], data.shape[1]
+    lengths = _seq_len_or_full(args, use_sequence_length, T, N)
+    mask = jnp.arange(T)[:, None] < lengths[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceReverse", num_inputs=None,
+          input_names=["data", "sequence_length"], attrs=_SEQ_SPEC)
+def _sequence_reverse(*args, use_sequence_length=False, axis=0):
+    data = args[0]
+    T, N = data.shape[0], data.shape[1]
+    lengths = _seq_len_or_full(args, use_sequence_length, T, N)
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < lengths[None, :], lengths[None, :] - 1 - t, t)
+    return data[src, jnp.arange(N)[None, :]]

@@ -1,0 +1,251 @@
+"""Torch interop ops: ``TorchModule`` and ``TorchCriterion``.
+
+Reference surface: plugin/torch/{torch_module-inl.h, torch_criterion-inl.h}
+— graph nodes that embed a Torch nn module / criterion, with the module
+constructed from a user string (``lua_string``, executed against the lua
+``nn`` namespace there) and its parameters exposed as extra op inputs so
+the surrounding framework trains them.
+
+Here the spec string is evaluated against PyTorch's ``torch``/``torch.nn``
+namespaces (same contract, python syntax): ``TorchModule(data, w, b,
+lua_string='nn.Linear(4, 2)', num_data=1, num_params=2, num_outputs=1)``.
+Forward copies the param inputs into the torch module and runs it on host
+CPU; gradients come from torch autograd via the tape grad hook (and a
+``jax.pure_callback`` pair under tracing), mirroring how the plugin defers
+both passes to the embedded runtime.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import AttrSpec, MXNetError
+from .registry import register
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is baked in
+        raise MXNetError(
+            "TorchModule/TorchCriterion require pytorch") from e
+    return torch
+
+
+_MODULE_CACHE = {}
+
+
+def _get_module(spec: str):
+    mod = _MODULE_CACHE.get(spec)
+    if mod is None:
+        torch = _torch()
+        ns = {"torch": torch, "nn": torch.nn, "F": torch.nn.functional}
+        try:
+            mod = eval(spec, ns)  # the reference executes lua_string the
+            # same way against lua's nn (torch_module-inl.h:75)
+        except Exception as e:
+            raise MXNetError(f"TorchModule: cannot construct {spec!r}: {e}")
+        if not isinstance(mod, torch.nn.Module):
+            raise MXNetError(
+                f"TorchModule: {spec!r} did not evaluate to a torch.nn."
+                f"Module (got {type(mod)})")
+        mod = mod.to(torch.float32).cpu()
+        _MODULE_CACHE[spec] = mod
+    return mod
+
+
+def _load_params(mod, param_vals):
+    torch = _torch()
+    params = list(mod.parameters())
+    if len(params) != len(param_vals):
+        raise MXNetError(
+            f"TorchModule: num_params mismatch — module has {len(params)} "
+            f"parameters, got {len(param_vals)} param inputs "
+            "(plugin/torch checks the same, torch_module-inl.h:92)")
+    with torch.no_grad():
+        for p, v in zip(params, param_vals):
+            arr = np.asarray(v, dtype=np.float32)
+            if tuple(p.shape) != arr.shape:
+                raise MXNetError(
+                    f"TorchModule: param shape {arr.shape} != module "
+                    f"param shape {tuple(p.shape)}")
+            p.copy_(torch.from_numpy(arr.copy()))
+
+
+def _module_fwd_np(spec, num_data, inputs):
+    torch = _torch()
+    mod = _get_module(spec)
+    data = inputs[:num_data]
+    _load_params(mod, inputs[num_data:])
+    with torch.no_grad():
+        outs = mod(*[torch.from_numpy(np.asarray(d, np.float32).copy())
+                     for d in data])
+    if isinstance(outs, (tuple, list)):
+        return tuple(o.detach().numpy() for o in outs)
+    return (outs.detach().numpy(),)
+
+
+def _module_bwd_np(spec, num_data, inputs, cotangents):
+    """Torch-autograd VJP: returns grads for data then params."""
+    torch = _torch()
+    mod = _get_module(spec)
+    data = [torch.from_numpy(np.asarray(d, np.float32).copy())
+            .requires_grad_(True) for d in inputs[:num_data]]
+    _load_params(mod, inputs[num_data:])
+    params = list(mod.parameters())
+    for p in params:
+        p.requires_grad_(True)
+        if p.grad is not None:
+            p.grad = None
+    outs = mod(*data)
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    torch.autograd.backward(
+        list(outs),
+        [torch.from_numpy(np.asarray(c, np.float32).copy())
+         for c in cotangents])
+    grads = [d.grad for d in data] + [p.grad for p in params]
+    return tuple(np.zeros_like(np.asarray(i, np.float32)) if g is None
+                 else g.detach().numpy() for g, i in zip(grads, inputs))
+
+
+def _out_struct(spec, num_data, num_outputs, in_shapes):
+    """Output shapes/dtypes by a dummy host run (trace-time only)."""
+    dummy = [np.zeros(s, np.float32) for s in in_shapes]
+    outs = _module_fwd_np(spec, num_data, dummy)
+    if len(outs) != num_outputs:
+        raise MXNetError(
+            f"TorchModule: module produced {len(outs)} outputs, "
+            f"num_outputs={num_outputs}")
+    return tuple(jax.ShapeDtypeStruct(o.shape, jnp.float32) for o in outs)
+
+
+def _torch_module_grad(attrs, rng, input_vals, out_vals, out_cts):
+    spec = attrs["lua_string"]
+    nd_ = int(attrs["num_data"])
+    n_out = int(attrs["num_outputs"])
+    gin = _module_bwd_np(spec, nd_, [np.asarray(v) for v in input_vals],
+                         [np.asarray(c) for c in out_cts[:n_out]])
+    return tuple(jnp.asarray(g) for g in gin)
+
+
+def _torch_module_param_shapes(attrs, shapes):
+    """Fill unknown parameter-input shapes from the torch module itself
+    (the framework half of the reference's two-way InferShape)."""
+    nd_ = int(attrs["num_data"])
+    mod = _get_module(attrs["lua_string"])
+    pshapes = [tuple(p.shape) for p in mod.parameters()]
+    return list(shapes[:nd_]) + pshapes
+
+
+@register("TorchModule",
+          attrs=AttrSpec(lua_string=("str",), num_data=("int", 1),
+                         num_params=("int", 0), num_outputs=("int", 1)),
+          num_inputs=None, grad_fn=_torch_module_grad,
+          param_shapes=_torch_module_param_shapes,
+          output_names=["output"])
+def _torch_module(*inputs, lua_string, num_data=1, num_params=0,
+                  num_outputs=1):
+    """Embed a torch nn module (plugin/torch/torch_module-inl.h). Inputs:
+    ``num_data`` data arrays then ``num_params`` parameter arrays."""
+    if len(inputs) != num_data + num_params:
+        raise MXNetError(
+            f"TorchModule expects num_data+num_params="
+            f"{num_data + num_params} inputs, got {len(inputs)}")
+    traced = any(isinstance(x, jax.core.Tracer) for x in inputs)
+    if not traced:
+        outs = tuple(jnp.asarray(o) for o in _module_fwd_np(
+            lua_string, num_data, [np.asarray(x) for x in inputs]))
+        return outs if num_outputs > 1 else outs[0]
+
+    out_sds = _out_struct(lua_string, num_data, num_outputs,
+                          [x.shape for x in inputs])
+    in_sds = tuple(jax.ShapeDtypeStruct(x.shape, jnp.float32)
+                   for x in inputs)
+
+    @jax.custom_vjp
+    def run(*xs):
+        return jax.pure_callback(
+            lambda *a: _module_fwd_np(lua_string, num_data, a),
+            out_sds, *xs)
+
+    def run_fwd(*xs):
+        return run(*xs), xs
+
+    def run_bwd(xs, gouts):
+        gin = jax.pure_callback(
+            lambda *a: _module_bwd_np(lua_string, num_data,
+                                      a[:len(xs)], a[len(xs):]),
+            in_sds, *xs, *gouts)
+        return tuple(gin)
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*inputs)
+    return outs if num_outputs > 1 else outs[0]
+
+
+def _criterion_fwd_np(spec, data, label):
+    torch = _torch()
+    crit = _get_module(spec)
+    with torch.no_grad():
+        loss = crit(torch.from_numpy(np.asarray(data, np.float32).copy()),
+                    torch.from_numpy(np.asarray(label, np.float32).copy()))
+    return np.asarray(loss.detach().numpy(), np.float32).reshape(1)
+
+
+def _criterion_bwd_np(spec, data, label, grad_scale):
+    torch = _torch()
+    crit = _get_module(spec)
+    d = torch.from_numpy(np.asarray(data, np.float32).copy())
+    d.requires_grad_(True)
+    loss = crit(d, torch.from_numpy(np.asarray(label, np.float32).copy()))
+    loss.backward()
+    return (d.grad.detach().numpy() * np.float32(grad_scale),
+            np.zeros_like(np.asarray(label, np.float32)))
+
+
+def _torch_criterion_grad(attrs, rng, input_vals, out_vals, out_cts):
+    gd, gl = _criterion_bwd_np(attrs["lua_string"],
+                               np.asarray(input_vals[0]),
+                               np.asarray(input_vals[1]),
+                               attrs["grad_scale"])
+    return jnp.asarray(gd), jnp.asarray(gl)
+
+
+@register("TorchCriterion", num_inputs=2, input_names=["data", "label"],
+          attrs=AttrSpec(lua_string=("str",), grad_scale=("float", 1.0)),
+          grad_fn=_torch_criterion_grad, output_names=["output"])
+def _torch_criterion(data, label, lua_string, grad_scale=1.0):
+    """Embed a torch criterion (plugin/torch/torch_criterion-inl.h):
+    out = loss(data, label) as shape (1,); backward scales the torch
+    gradient by ``grad_scale`` and sends zero to the label."""
+    traced = (isinstance(data, jax.core.Tracer)
+              or isinstance(label, jax.core.Tracer))
+    if not traced:
+        return jnp.asarray(
+            _criterion_fwd_np(lua_string, np.asarray(data),
+                              np.asarray(label)))
+
+    out_sd = jax.ShapeDtypeStruct((1,), jnp.float32)
+    in_sds = (jax.ShapeDtypeStruct(data.shape, jnp.float32),
+              jax.ShapeDtypeStruct(label.shape, jnp.float32))
+
+    @jax.custom_vjp
+    def run(d, l):
+        return jax.pure_callback(
+            lambda a, b: _criterion_fwd_np(lua_string, a, b), out_sd, d, l)
+
+    def run_fwd(d, l):
+        return run(d, l), (d, l)
+
+    def run_bwd(res, g):
+        d, l = res
+        gd, gl = jax.pure_callback(
+            lambda a, b: _criterion_bwd_np(lua_string, a, b, grad_scale),
+            in_sds, d, l)
+        return gd, gl
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(data, label)

@@ -1,0 +1,23 @@
+"""TPU-native parallelism: device meshes, sharding rules, SPMD training.
+
+This subpackage replaces the reference's entire multi-device/multi-machine
+machinery with SPMD over a ``jax.sharding.Mesh``:
+
+* ``DataParallelExecutorGroup`` batch slicing (executor_group.py:233-262)
+  -> the batch is sharded over the mesh's ``data`` axis;
+* ``KVStoreLocal``/``CommDevice`` gradient reduce (src/kvstore/comm.h)
+  -> XLA inserts ``psum`` over ICI during the jitted step;
+* ``kvstore dist_sync`` + ps-lite worker/server/ZMQ (kvstore_dist.h)
+  -> multi-host SPMD over a DCN-connected mesh (jax.distributed);
+* ctx_group model parallelism + ``_CrossDeviceCopy`` (graph_executor.cc:386)
+  -> named-axis tensor sharding (``model`` axis) with resharding handled
+  by the XLA SPMD partitioner.
+"""
+from .mesh import make_mesh, local_mesh  # noqa: F401
+from .sharding import batch_pspec, param_pspec, shard_params  # noqa: F401
+from .trainer import SPMDTrainer  # noqa: F401
+from .sequence import (ring_attention, sequence_sharded_attention,  # noqa: F401
+                       ulysses_attention)
+from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .moe import moe_apply, top1_router  # noqa: F401
+from . import dist  # noqa: F401

@@ -1,0 +1,108 @@
+"""Multi-process (multi-host) process group: the ps-lite replacement.
+
+Reference surface: ps-lite worker/server/scheduler roles wired by env vars
+(``DMLC_ROLE``, ``DMLC_PS_ROOT_URI``, ``DMLC_NUM_WORKER`` …) that
+tools/launch.py exports (SURVEY.md §3.5, §5.8). Here the whole topology
+collapses into a single SPMD process group: every process calls
+``init_process_group()`` (env ``MXTPU_*`` set by tools/launch.py), which
+runs ``jax.distributed.initialize`` — after that, ``jax.devices()`` spans
+every host and the usual mesh collectives ride ICI/DCN. There are no
+server processes: the "server side" of dist_sync IS the psum inside the
+jitted step.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError, getenv
+
+__all__ = ["init_process_group", "is_initialized", "rank", "size",
+           "barrier", "allreduce", "global_mesh", "finalize"]
+
+_STATE = {"initialized": False, "rank": 0, "size": 1}
+
+
+def init_process_group(coordinator: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None):
+    """Join the process group. Arguments default to the env vars exported
+    by tools/launch.py (reference: the dmlc tracker's DMLC_* env)."""
+    import jax
+
+    if _STATE["initialized"]:
+        return
+    coordinator = coordinator or getenv("MXTPU_COORDINATOR", None, str)
+    num_processes = num_processes or getenv("MXTPU_NUM_PROCS", None, int)
+    process_id = (process_id if process_id is not None
+                  else getenv("MXTPU_PROC_ID", None, int))
+    if coordinator is None or num_processes is None or process_id is None:
+        raise MXNetError(
+            "process group env missing: launch with tools/launch.py or set "
+            "MXTPU_COORDINATOR / MXTPU_NUM_PROCS / MXTPU_PROC_ID")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=int(num_processes),
+                               process_id=int(process_id))
+    _STATE.update(initialized=True, rank=int(process_id),
+                  size=int(num_processes))
+
+
+def is_initialized() -> bool:
+    """True when a process group is active — whether it was formed by
+    init_process_group or by a direct/auto jax.distributed.initialize
+    (Cloud TPU pods)."""
+    if _STATE["initialized"]:
+        return True
+    import jax
+    return jax.process_count() > 1
+
+
+def rank() -> int:
+    import jax
+    return jax.process_index() if is_initialized() else _STATE["rank"]
+
+
+def size() -> int:
+    import jax
+    return jax.process_count() if is_initialized() else _STATE["size"]
+
+
+def global_mesh(axes: Optional[Dict[str, int]] = None):
+    """Mesh over EVERY device in the process group (local + remote)."""
+    import jax
+    from .mesh import make_mesh
+    return make_mesh(axes, devices=jax.devices())
+
+
+def allreduce(value):
+    """Sum an array across all processes (reference: dist_sync push+pull
+    round trip). Works on numpy or jax input; returns numpy.
+
+    NB: this is the *API-compatibility* path (kvstore.push) and moves
+    O(N·size) bytes via allgather + host sum; throughput training should
+    use the SPMD step (parallel.SPMDTrainer), where gradient reduction is
+    a single in-graph psum over the mesh."""
+    from jax.experimental import multihost_utils
+
+    if not is_initialized():
+        return np.asarray(value)
+    gathered = multihost_utils.process_allgather(
+        np.asarray(value))  # (num_processes, ...)
+    return np.asarray(gathered).sum(axis=0)
+
+
+def barrier():
+    """Block until every process arrives (reference: ps::Postoffice
+    Barrier via kvstore.cc)."""
+    from jax.experimental import multihost_utils
+    if is_initialized():
+        multihost_utils.sync_global_devices("mxtpu_barrier")
+
+
+def finalize():
+    import jax
+    if _STATE["initialized"]:
+        jax.distributed.shutdown()
+        _STATE.update(initialized=False, rank=0, size=1)

@@ -1,0 +1,52 @@
+"""Device-mesh construction.
+
+Reference analogue: context lists (``ctx=[mx.gpu(0), mx.gpu(1)]``) plus the
+worker/server rank topology of ps-lite. Here the device topology is a named
+``jax.sharding.Mesh``; axis names are load-bearing: ``data`` carries
+data-parallel batch sharding, ``model`` tensor-parallel weight sharding,
+``seq`` sequence/context parallelism, ``pipe`` pipeline stages, ``expert``
+MoE experts.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "local_mesh"]
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named mesh over ``devices`` (default: all visible devices).
+
+    ``axes`` maps axis name -> size; sizes must multiply to the device
+    count. Default: a 1-axis data-parallel mesh over everything.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if axes is None:
+        axes = {"data": len(devices)}
+    names = tuple(axes)
+    sizes = tuple(int(axes[n]) for n in names)
+    if math.prod(sizes) != len(devices):
+        raise MXNetError(
+            f"mesh axes {axes} require {math.prod(sizes)} devices, "
+            f"got {len(devices)}")
+    return Mesh(np.asarray(devices).reshape(sizes), names)
+
+
+def local_mesh(data: int = 0, model: int = 1) -> Mesh:
+    """Convenience: dp×tp mesh over local devices; data=0 means 'the rest'."""
+    n = len(jax.devices())
+    if data == 0:
+        if n % model:
+            raise MXNetError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    return make_mesh({"data": data, "model": model})

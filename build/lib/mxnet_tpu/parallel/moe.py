@@ -1,0 +1,114 @@
+"""Expert parallelism: Switch-style top-k MoE with all_to_all dispatch.
+
+Absent from the reference entirely (SURVEY.md §2.5: expert parallelism ❌);
+built TPU-first: experts are sharded over a named ``expert`` mesh axis,
+token->expert routing builds dispatch/combine one-hots, and two
+``jax.lax.all_to_all`` hops move token blocks to their experts' devices and
+back over ICI. Dense einsum dispatch keeps everything static-shaped for XLA
+(no data-dependent gather shapes), with a capacity_factor bound exactly like
+the public Switch/GShard recipe.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["moe_apply", "top1_router"]
+
+
+def top1_router(x, router_w):
+    """Softmax router; returns (gate, expert_index) per token."""
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    return gate, idx
+
+
+def _dispatch_tensors(gate, idx, n_experts: int, capacity: int):
+    """Build dispatch one-hot (T,E,C) and combine weights (T,E,C)."""
+    onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)  # (T,E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per token
+    keep = (pos > 0) & (pos <= capacity)
+    slot = jax.nn.one_hot((pos - 1).astype(jnp.int32), capacity,
+                          dtype=jnp.float32)  # (T,E,C)
+    dispatch = slot * keep[..., None]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def _moe_local(x, router_w, expert_params, expert_fn, axis_name,
+               capacity_factor):
+    """Per-device body: route local tokens, a2a to experts, a2a back.
+
+    x: (T_loc, D) local tokens; expert_params: pytree with leading dim
+    E_loc (this device's experts).
+    """
+    n = jax.lax.axis_size(axis_name)
+    t_loc, d = x.shape
+    e_loc = jax.tree.leaves(expert_params)[0].shape[0]
+    n_experts = e_loc * n
+    capacity = max(1, int(capacity_factor * t_loc / n_experts))
+
+    gate, idx = top1_router(x, router_w)
+    dispatch, combine = _dispatch_tensors(gate, idx, n_experts, capacity)
+    # (T,E,C),(T,D) -> (E,C,D): per-expert token buffers, expert index
+    # e = owner_device * e_loc + local_expert
+    xin = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # split by owner device and trade blocks; split==concat axis keeps the
+    # shape and just transposes blocks across devices: dim 0 becomes the
+    # *source* device after the a2a
+    xin = xin.reshape(n, e_loc, capacity, d)
+    xin = jax.lax.all_to_all(xin, axis_name, split_axis=0, concat_axis=0,
+                             tiled=True)
+    # per local expert, one token stream holding every source's block
+    xin = xin.transpose(1, 0, 2, 3).reshape(e_loc, n * capacity, d)
+    yout = jax.vmap(expert_fn)(expert_params, xin)  # (e_loc, n*C, d)
+    # return trip: regroup by source device and a2a home
+    yout = yout.reshape(e_loc, n, capacity, d).transpose(1, 0, 2, 3)
+    yout = jax.lax.all_to_all(yout, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)  # dim 0: expert-owner device
+    yout = yout.reshape(n_experts, capacity, d)
+    out = jnp.einsum("tec,ecd->td", combine, yout)
+    return out.astype(x.dtype)
+
+
+def moe_apply(x, router_w, expert_params, expert_fn: Callable, mesh: Mesh,
+              axis_name: str = "expert", capacity_factor: float = 2.0):
+    """Apply an expert-parallel MoE layer to tokens ``x``.
+
+    x: (tokens, d_model), sharded over ``axis_name`` (tokens and experts
+    share the axis, EP=DP style). expert_params: pytree with leading dim
+    n_experts (divisible by the axis size); ``expert_fn(params_e, (t, d))``
+    -> (t, d) is vmapped over local experts. Top-1 routing with a static
+    per-expert ``capacity`` bound keeps shapes XLA-friendly; overflow
+    tokens pass through with weight 0 (standard Switch behavior).
+    """
+    if axis_name not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    n = mesh.shape[axis_name]
+    n_experts = jax.tree.leaves(expert_params)[0].shape[0]
+    if n_experts % n:
+        raise MXNetError(f"n_experts {n_experts} not divisible by mesh axis "
+                         f"{axis_name!r} size {n}")
+    if x.shape[0] % n:
+        raise MXNetError(f"tokens {x.shape[0]} not divisible by mesh axis "
+                         f"size {n}")
+    if router_w.shape[-1] != n_experts:
+        raise MXNetError(
+            f"router_w routes to {router_w.shape[-1]} experts but "
+            f"expert_params holds {n_experts}")
+    e_spec = jax.tree.map(lambda _: P(axis_name), expert_params)
+    fn = jax.shard_map(
+        functools.partial(_moe_local, expert_fn=expert_fn,
+                          axis_name=axis_name,
+                          capacity_factor=capacity_factor),
+        mesh=mesh, in_specs=(P(axis_name), P(), e_spec),
+        out_specs=P(axis_name), check_vma=False)
+    return fn(x, router_w, expert_params)

@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+The reference's only pipeline-ish facility is manual ctx_group layer
+placement (`mx.AttrScope(ctx_group=...)` + `group2ctx`, SURVEY.md §2.5) with
+whatever overlap the dependency engine finds — no microbatch schedule. This
+is the TPU-native upgrade: stages are sharded over a named ``pipe`` mesh
+axis, activations hop stage-to-stage with ``jax.lax.ppermute`` (ICI
+neighbor traffic), and a GPipe fill/drain loop keeps all stages busy on
+different microbatches.
+
+Design (SPMD, homogeneous stages): a stack of per-stage parameter pytrees
+with a leading ``n_stages`` dim is sharded over the pipe axis so each device
+holds exactly its stage's weights; inside ``jax.shard_map`` a fori_loop of
+``n_micro + n_stages - 1`` ticks runs stage_fn on every device each tick.
+This is the standard XLA pipeline pattern — compare the scaling-book
+recipe — not a port of any reference scheduler.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage parameter pytrees along a new leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, 0), *param_list)
+
+
+def _pipe_local(params, x, fn: Callable, axis_name: str, n_micro: int):
+    """Per-device body. params: this stage's pytree (leading dim squeezed);
+    x: (n_micro, mb, ...) replicated microbatch inputs."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    mb_shape = x.shape[1:]
+
+    def tick(t, carry):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clipped; stale ingests are ignored
+        # because their results drain past the output window)
+        inp = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        state = jnp.where(idx == 0, inp, state)
+        out = fn(params, state)
+        # the last stage finishes microbatch (t - n + 1) at tick t
+        m = t - (n - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, out, jnp.clip(m, 0, n_micro - 1), 0)
+        outputs = jnp.where((m >= 0) & (idx == n - 1), updated, outputs)
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return state, outputs
+
+    init = (jnp.zeros(mb_shape, x.dtype),
+            jnp.zeros((n_micro,) + mb_shape, x.dtype))
+    _, outputs = jax.lax.fori_loop(0, n_micro + n - 1, tick, init)
+    # out_specs stacks per-device buffers along a leading pipe dim; only
+    # the last stage's buffer holds the real outputs — caller slices [-1]
+    return outputs[None]
+
+
+def pipeline_apply(fn: Callable, stacked_params, x, mesh: Mesh,
+                   axis_name: str = "pipe", n_microbatches: int = None):
+    """Run ``x`` through ``n_stages`` copies of ``fn`` pipelined over the mesh.
+
+    fn(stage_params, h) -> h with h.shape preserved; ``stacked_params`` has a
+    leading n_stages dim (see ``stack_stage_params``) which must equal the
+    pipe-axis size. ``x`` is (batch, ...); it is split into
+    ``n_microbatches`` equal microbatches along axis 0.
+    """
+    if axis_name not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    n = mesh.shape[axis_name]
+    leaves = jax.tree.leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != n:
+        raise MXNetError(
+            f"stacked_params leading dim {leaves[0].shape[0]} != pipe axis "
+            f"size {n}")
+    n_micro = n_microbatches or n
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise MXNetError(f"batch {batch} not divisible by "
+                         f"n_microbatches {n_micro}")
+    xm = x.reshape((n_micro, batch // n_micro) + x.shape[1:])
+
+    p_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    out = jax.shard_map(
+        functools.partial(_pipe_local, fn=fn, axis_name=axis_name,
+                          n_micro=n_micro),
+        mesh=mesh, in_specs=(p_spec, P()), out_specs=P(axis_name),
+        check_vma=False)(stacked_params, xm)
+    return out[-1].reshape((batch,) + x.shape[1:])

@@ -1,0 +1,60 @@
+"""Sharding rules: parameter and batch PartitionSpecs over a named mesh.
+
+Reference analogue: the *implicit* placement rules of the reference —
+parameters replicated per device (executor_group.py), batch split along
+axis 0 (``_split_input_slice``), ctx_group manual placement. Here placement
+is explicit NamedShardings; the XLA SPMD partitioner inserts the
+collectives the reference's Comm/ps-lite layers performed by hand.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["param_pspec", "batch_pspec", "shard_params"]
+
+
+def param_pspec(name: str, shape, mesh: Mesh, model_axis: str = "model") -> P:
+    """Tensor-parallel rule for one parameter.
+
+    2-D+ weights get their largest mesh-divisible dim sharded over the
+    ``model`` axis (Megatron-style column/row split — the MXU keeps each
+    shard's matmul dense); everything else (biases, BN stats, embeddings
+    smaller than the axis) is replicated. With no ``model`` axis this
+    degenerates to fully-replicated data parallelism, matching the
+    reference's per-device parameter copies.
+    """
+    if model_axis not in mesh.axis_names:
+        return P()
+    m = mesh.shape[model_axis]
+    if m == 1 or len(shape) < 2:
+        return P()
+    # prefer the output-channel dim: FC weight is (out, in); conv weight is
+    # (O, *spatial, I) in NHWC or (O, I, *spatial) in NCHW — axis 0 either way
+    order = [0, len(shape) - 1] + list(range(1, len(shape) - 1))
+    for ax in order:
+        if shape[ax] % m == 0 and shape[ax] // m >= 8:
+            spec = [None] * len(shape)
+            spec[ax] = model_axis
+            return P(*spec)
+    return P()
+
+
+def batch_pspec(mesh: Mesh, ndim: int = 1, data_axis: str = "data") -> P:
+    """Batch rule: axis 0 sharded over ``data`` (+ nothing else)."""
+    if data_axis not in mesh.axis_names:
+        return P()
+    return P(data_axis, *([None] * (ndim - 1)))
+
+
+def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
+                 rules=None, model_axis: str = "model"):
+    """device_put every param with its rule's NamedSharding."""
+    rules = rules or param_pspec
+    out = {}
+    for name, v in params.items():
+        spec = rules(name, v.shape, mesh, model_axis)
+        out[name] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out

@@ -1,0 +1,280 @@
+"""SPMDTrainer: one jit-compiled, mesh-sharded training step.
+
+Reference analogue: the whole update path of stack §3.1 —
+``ExecutorGroup.forward/backward`` per device + kvstore push/pull +
+``Updater`` (module.py:556-615, model.py:105-132, comm.h reduce) — fused
+into a single XLA program: forward, backward (vjp), cross-device gradient
+reduction (psum inserted by the SPMD partitioner), and the optimizer
+update, with parameter/optimizer-state buffers donated in place.
+
+BatchNorm note: batch statistics are computed over the *global* sharded
+batch (XLA lowers the mean/var to cross-replica collectives), i.e.
+sync-BN — stronger than the reference's per-device statistics.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import initializer as _init_mod, optimizer as _opt_mod
+from ..base import MXNetError
+from ..executor import build_graph_eval
+from ..ndarray import NDArray
+from ..ops.registry import OP_TABLE
+from .mesh import make_mesh
+from .sharding import batch_pspec, param_pspec
+
+__all__ = ["SPMDTrainer"]
+
+
+def _functional_update(opt):
+    """Map an Optimizer instance to (init_state, update) pure functions.
+
+    The reference runs optimizer ops imperatively per weight
+    (optimizer.py SGD.update → sgd_mom_update op); here the same registered
+    op *functions* are traced into the step program.
+    update(w, g, state, lr, wd, t) -> (new_w, new_state); t is the traced
+    update count (for Adam bias correction, reference optimizer.py:539).
+    """
+    kind = type(opt).__name__.lower()
+    rescale = float(opt.rescale_grad)
+    clip = float(opt.clip_gradient) if opt.clip_gradient else -1.0
+    common = dict(rescale_grad=rescale, clip_gradient=clip)
+
+    if kind == "sgd":
+        momentum = float(getattr(opt, "momentum", 0.0))
+
+        def init_state(w):
+            return jnp.zeros_like(w) if momentum else ()
+
+        def update(w, g, s, lr, wd, t):
+            if momentum:
+                new_w, new_m = OP_TABLE["sgd_mom_update"].fn(
+                    w, g, s, lr=lr, momentum=momentum, wd=wd, **common)
+                return new_w, new_m
+            return OP_TABLE["sgd_update"].fn(w, g, lr=lr, wd=wd, **common), ()
+
+        return init_state, update
+
+    if kind == "nag":
+        momentum = float(getattr(opt, "momentum", 0.0))
+
+        def init_state(w):
+            return jnp.zeros_like(w) if momentum else ()
+
+        def update(w, g, s, lr, wd, t):
+            # Nesterov lookahead, mirroring optimizer.py NAG.update
+            g = g * rescale
+            if clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd * w
+            if momentum:
+                new_s = momentum * s + g
+                return w - lr * (g + momentum * new_s), new_s
+            return w - lr * g, ()
+
+        return init_state, update
+
+    if kind == "adam":
+        b1, b2, eps = float(opt.beta1), float(opt.beta2), float(opt.epsilon)
+
+        def init_state(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, s, lr, wd, t):
+            mean, var = s
+            coef = jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+            new_w, new_mean, new_var = OP_TABLE["adam_update"].fn(
+                w, g, mean, var, lr=lr * coef, beta1=b1, beta2=b2,
+                epsilon=eps, wd=wd, **common)
+            return new_w, (new_mean, new_var)
+
+        return init_state, update
+
+    if kind == "rmsprop":
+        g1, eps = float(opt.gamma1), float(opt.epsilon)
+
+        def init_state(w):
+            return jnp.zeros_like(w)
+
+        def update(w, g, s, lr, wd, t):
+            new_w, new_n = OP_TABLE["rmsprop_update"].fn(
+                w, g, s, lr=lr, gamma1=g1, epsilon=eps, wd=wd, **common)
+            return new_w, new_n
+
+        return init_state, update
+
+    raise MXNetError(
+        f"SPMDTrainer has no functional rule for optimizer {kind!r}; "
+        "use sgd/nag/adam/rmsprop or Module's imperative update path")
+
+
+class SPMDTrainer:
+    """Train a symbol SPMD over a named mesh (dp via ``data`` axis, tp via
+    ``model`` axis; further axes compose through custom param rules)."""
+
+    def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
+                 mesh=None, data_names: Sequence[str] = ("data",),
+                 label_names: Sequence[str] = ("softmax_label",),
+                 param_rules=None, dtype="float32", compute_dtype=None):
+        self._symbol = symbol
+        self._mesh = mesh if mesh is not None else make_mesh()
+        self._data_names = list(data_names)
+        self._label_names = list(label_names)
+        self._param_rules = param_rules or param_pspec
+        self._dtype = dtype
+        # mixed precision: master weights stay fp32, 2D+ weights are cast to
+        # compute_dtype inside the step (reference analogue: mp_sgd_update's
+        # fp32 master weights, optimizer_op.cc:114 — here the cast is traced
+        # so XLA feeds the MXU bf16 operands directly)
+        self._compute_dtype = compute_dtype
+        if isinstance(optimizer, str):
+            optimizer = _opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._eval_fn = build_graph_eval(symbol)
+        self.params: Dict[str, jax.Array] = {}
+        self.states: Dict[str, object] = {}
+        self.aux: Dict[str, jax.Array] = {}
+        self._num_update = 0
+        self._step_fn = None
+        self._rng = jax.random.PRNGKey(0)
+
+    # -- initialization ----------------------------------------------------
+
+    def bind(self, data_shapes, label_shapes=None,
+             initializer=None, arg_params=None, aux_params=None):
+        """Infer shapes, initialize + shard parameters, compile the step."""
+        initializer = initializer or _init_mod.Xavier(magnitude=2.0)
+        known = dict(data_shapes)
+        known.update(label_shapes or {})
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**known)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        io_names = set(self._data_names) | set(self._label_names)
+        param_names = [n for n in arg_names if n not in io_names]
+        shapes = dict(zip(arg_names, arg_shapes))
+
+        mesh = self._mesh
+        layouts = self._symbol._arg_layouts()
+        params = {}
+        for name in param_names:
+            if arg_params and name in arg_params:
+                host = np.asarray(arg_params[name].asnumpy()
+                                  if isinstance(arg_params[name], NDArray)
+                                  else arg_params[name])
+            else:
+                arr = NDArray(np.zeros(shapes[name], dtype=self._dtype))
+                attrs = ({"__layout__": layouts[name]}
+                         if name in layouts else None)
+                initializer(_init_mod.InitDesc(name, attrs), arr)
+                host = arr.asnumpy()
+            spec = self._param_rules(name, host.shape, mesh)
+            params[name] = jax.device_put(host, NamedSharding(mesh, spec))
+        aux = {}
+        for name, shp in zip(aux_names, aux_shapes):
+            if aux_params and name in aux_params:
+                host = np.asarray(aux_params[name].asnumpy()
+                                  if isinstance(aux_params[name], NDArray)
+                                  else aux_params[name])
+            else:
+                arr = NDArray(np.zeros(shp, dtype=self._dtype))
+                initializer(_init_mod.InitDesc(name), arr)
+                host = arr.asnumpy()
+            aux[name] = jax.device_put(host, NamedSharding(mesh, P()))
+
+        init_state, update = _functional_update(self._optimizer)
+        states = {n: init_state(w) for n, w in params.items()}
+        self.params, self.states, self.aux = params, states, aux
+
+        # static per-param wd (lr multipliers fold into the dynamic lr input);
+        # recompute multipliers now that idx2name is known so biases/BN
+        # params get wd_mult=0 (reference: optimizer.py set_wd_mult)
+        self._optimizer.idx2name = dict(enumerate(param_names))
+        self._optimizer.set_wd_mult(dict(self._optimizer.wd_mult))
+        self._optimizer.set_lr_mult(dict(self._optimizer.lr_mult))
+        wd_by_name = {n: float(self._optimizer.wd
+                               * self._optimizer.wd_mult.get(n, 1.0))
+                      for n in param_names}
+        lr_mult = {n: float(self._optimizer.lr_mult.get(n, 1.0))
+                   for n in param_names}
+        eval_fn = self._eval_fn
+        param_sh = {n: params[n].sharding for n in params}
+        aux_sh = {n: NamedSharding(mesh, P()) for n in aux}
+
+        compute_dtype = (jnp.dtype(self._compute_dtype)
+                         if self._compute_dtype else None)
+
+        def step(params, states, aux, inputs, rng, lr, t):
+            def loss_f(p):
+                merged = dict(inputs)
+                if compute_dtype is not None:
+                    p = {n: (v.astype(compute_dtype)
+                             if v.ndim >= 2 and v.dtype == jnp.float32 else v)
+                         for n, v in p.items()}
+                merged.update(p)
+                outs, aux_up = eval_fn(merged, aux, rng, True)
+                return outs, aux_up
+
+            (outs, aux_up), vjp_fn = jax.vjp(loss_f, params)
+            cts = [jnp.ones_like(o) for o in outs]
+            zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+            (grads,) = vjp_fn((cts, zero_aux))
+            new_params, new_states = {}, {}
+            for n in params:
+                new_params[n], new_states[n] = update(
+                    params[n], grads[n], states[n],
+                    lr * lr_mult[n], wd_by_name[n], t)
+            new_aux = dict(aux)
+            new_aux.update(aux_up)
+            # pin steady-state shardings: without this GSPMD may pick new
+            # layouts for the donated outputs, forcing a recompile on the
+            # next step when the re-fed params carry different shardings
+            new_params = {n: jax.lax.with_sharding_constraint(v, param_sh[n])
+                          for n, v in new_params.items()}
+            new_states = {n: jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(x, param_sh[n]),
+                new_states[n]) for n in new_states}
+            new_aux = {n: jax.lax.with_sharding_constraint(v, aux_sh[n])
+                       for n, v in new_aux.items()}
+            return new_params, new_states, new_aux, outs
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._in_shardings = {
+            n: NamedSharding(mesh, batch_pspec(mesh, len(known[n])))
+            for n in list(self._data_names) + list(self._label_names)
+            if n in known}
+        return self
+
+    # -- stepping ----------------------------------------------------------
+
+    def step(self, batch: Dict[str, np.ndarray]):
+        """Run one optimizer step on a global batch; returns outputs."""
+        if self._step_fn is None:
+            raise MXNetError("call bind() before step()")
+        inputs = {}
+        for n, v in batch.items():
+            if isinstance(v, NDArray):
+                v = v.asnumpy()
+            elif not isinstance(v, jax.Array):
+                v = np.asarray(v)
+            # no-op when v is already device-resident with this sharding
+            inputs[n] = jax.device_put(v, self._in_shardings[n])
+        self._num_update += 1
+        self._rng, sub = jax.random.split(self._rng)
+        lr = jnp.float32(self._optimizer.lr
+                         if self._optimizer.lr_scheduler is None
+                         else self._optimizer.lr_scheduler(self._num_update))
+        t = jnp.float32(self._num_update)
+        self.params, self.states, self.aux, outs = self._step_fn(
+            self.params, self.states, self.aux, inputs, sub, lr, t)
+        return outs
+
+    def get_params(self):
+        """Gather (host) copies, reference Module.get_params."""
+        arg = {n: NDArray(np.asarray(v)) for n, v in self.params.items()}
+        aux = {n: NDArray(np.asarray(v)) for n, v in self.aux.items()}
+        return arg, aux

@@ -1,0 +1,159 @@
+"""Profiler: per-op / per-phase timing exported as Chrome trace JSON.
+
+Reference surface: python/mxnet/profiler.py (profiler_set_config,
+profiler_set_state, dump_profile) over src/engine/profiler.{h,cc}, which
+stamps operator start/end in ThreadedEngine::ExecuteOprBlock and dumps
+Chrome tracing JSON (profiler.h:106-124). Env controls
+MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE (docs/how_to/env_var.md).
+
+TPU-native rebuild: the phases we own (imperative op dispatch, executor
+forward/backward, io) are timed on the host — timing forces
+``block_until_ready`` so durations cover device execution, exactly like
+the reference's per-op engine stamps. For instruction-level device detail
+``start_xla_trace``/``stop_xla_trace`` wrap ``jax.profiler`` (XPlane/
+TensorBoard), which subsumes the reference's per-kernel visibility.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List
+
+from .base import MXNetError, getenv
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "start_xla_trace", "stop_xla_trace", "record_event", "is_running",
+           "profile_scope"]
+
+_MODES = ("symbolic", "imperative", "all")
+
+
+class _Profiler:
+    def __init__(self):
+        self.mode = "symbolic"
+        self.filename = "profile.json"
+        self.running = False
+        self.events: List[dict] = []
+        self.lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def now_us(self):
+        return (time.perf_counter() - self._t0) * 1e6
+
+
+_PROF = _Profiler()
+
+
+def profiler_set_config(mode: str = "symbolic",
+                        filename: str = "profile.json"):
+    """Configure what is recorded and where the trace is written.
+
+    mode: 'symbolic' (executor phases), 'imperative' (nd.* op calls),
+    'all' (both; reference mode2int maps symbolic=0, all=1)."""
+    if mode not in _MODES:
+        raise MXNetError(f"profiler mode must be one of {_MODES}")
+    _PROF.mode = mode
+    _PROF.filename = filename
+
+
+def profiler_set_state(state: str = "stop"):
+    """'run' starts collecting events, 'stop' halts collection."""
+    if state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    _PROF.running = state == "run"
+
+
+def is_running(kind: str = "symbolic") -> bool:
+    """Internal: should events of this kind be recorded now?"""
+    return _PROF.running and (_PROF.mode == "all" or _PROF.mode == kind)
+
+
+def record_event(name: str, cat: str, start_us: float, end_us: float,
+                 tid: int = 0, args=None):
+    ev = {"name": name, "cat": cat, "ph": "X", "ts": start_us,
+          "dur": max(end_us - start_us, 0.01), "pid": 0, "tid": tid}
+    if args:
+        ev["args"] = args
+    with _PROF.lock:
+        _PROF.events.append(ev)
+
+
+class profile_scope:
+    """Context manager timing one phase into the trace (and forcing device
+    completion so the duration is real, not dispatch latency)."""
+
+    def __init__(self, name: str, cat: str = "operator", kind: str = "symbolic",
+                 sync=None):
+        self.name = name
+        self.cat = cat
+        self.kind = kind
+        self.sync = sync
+        self.active = False
+
+    def __enter__(self):
+        self.active = is_running(self.kind)
+        if self.active:
+            self.start = _PROF.now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if self.active:
+            if self.sync is not None:
+                try:
+                    import jax
+                    jax.block_until_ready(self.sync() if callable(self.sync)
+                                          else self.sync)
+                except Exception:  # sync is best-effort; timing still lands
+                    pass
+            record_event(self.name, self.cat, self.start, _PROF.now_us())
+        return False
+
+
+def dump_profile():
+    """Write the Chrome trace JSON (chrome://tracing / perfetto format) and
+    stop the profiler (reference MXDumpProfile semantics)."""
+    profiler_set_state("stop")
+    with _PROF.lock:
+        events = list(_PROF.events)
+        _PROF.events.clear()
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(_PROF.filename, "w") as f:
+        json.dump(trace, f)
+    return _PROF.filename
+
+
+# -- deep device traces (TPU-native extra) ---------------------------------
+
+_XLA_TRACE_DIR = None
+
+
+def start_xla_trace(logdir: str = "/tmp/mxtpu_xla_trace"):
+    """Start a jax/XLA device trace (XPlane, viewable in TensorBoard or
+    xprof) — instruction-level TPU detail beyond the reference."""
+    global _XLA_TRACE_DIR
+    import jax
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    _XLA_TRACE_DIR = logdir
+    return logdir
+
+
+def stop_xla_trace():
+    global _XLA_TRACE_DIR
+    import jax
+    jax.profiler.stop_trace()
+    d, _XLA_TRACE_DIR = _XLA_TRACE_DIR, None
+    return d
+
+
+# reference parity: env-var autostart (docs/how_to/env_var.md:101-108;
+# the reference's MODE is 0/1 — accept both spellings)
+if getenv("MXTPU_PROFILER_AUTOSTART", 0, int):
+    _m = getenv("MXTPU_PROFILER_MODE", "all", str)
+    if _m not in _MODES:
+        _m = "symbolic" if _m == "0" else "all"
+    profiler_set_config(_m)
+    profiler_set_state("run")
+    del _m

@@ -1,0 +1,58 @@
+"""Global PRNG state for imperative sampling.
+
+Reference analogue: per-device random resources handed to ops by the
+ResourceManager (include/mxnet/resource.h:36-45, src/resource.cc) and
+``mx.random.seed`` (python/mxnet/random.py). Here the state is an explicit
+jax PRNG key chain; jitted executors thread per-step keys instead of using
+this global (functional purity under jit).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_key", "swap_key"]
+
+_state = threading.local()
+
+
+def _make_key(seed_state: int):
+    # ensure_compile_time_eval: the key chain may be first touched inside a
+    # jit/eval_shape trace (gluon CachedOp build); without escaping the trace
+    # PRNGKey would return a tracer that leaks into this thread-local
+    with jax.ensure_compile_time_eval():
+        return jax.random.PRNGKey(seed_state)
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = _make_key(0)
+    return _state.key
+
+
+def seed(seed_state: int):
+    """Seed the global imperative PRNG (reference: mx.random.seed)."""
+    _state.key = _make_key(int(seed_state))
+
+
+def next_key():
+    key = _get()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def current_key():
+    return _get()
+
+
+def swap_key(key):
+    """Swap in a new key chain, returning the old one.
+
+    Used by jit-traced callers (gluon CachedOp) to thread an explicit key
+    through ops that draw from the global chain; the caller must restore the
+    returned key after tracing so no tracer leaks into global state.
+    """
+    old = _get()
+    _state.key = key
+    return old

@@ -1,0 +1,280 @@
+"""RecordIO: the framework's packed binary dataset container.
+
+Reference surface: python/mxnet/recordio.py (MXRecordIO:36,
+MXIndexedRecordIO:170, IRHeader:291, pack/unpack/pack_img/unpack_img) over
+dmlc-core's C++ RecordIO writer/reader. The on-disk format here is
+byte-compatible with the reference so ``.rec`` files pack on either side
+read on the other:
+
+  record  := uint32 kMagic | uint32 lrec | payload | pad-to-4
+  kMagic  = 0xced7230a
+  lrec    = (cflag << 29) | length        cflag: 0 whole, 1 begin,
+                                          2 middle, 3 end (split records)
+  IRHeader:= uint32 flag | float32 label | uint64 id | uint64 id2
+             (flag > 0 -> flag float32 labels follow the header)
+
+The pure-python implementation is the portable path; the native C++ reader
+(src/ in this repo) accelerates bulk scanning for the data pipeline.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import struct
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_kMagic = 0xCED7230A
+_MAGIC_BYTES = struct.pack("<I", _kMagic)
+
+
+def _encode_lrec(cflag: int, length: int) -> int:
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec: int):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (reference: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.is_open = False
+        # serializes seek+read pairs (DataLoader workers share the handle)
+        self._lock = threading.Lock()
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"Invalid flag {self.flag}")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.record.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        del d["record"]
+        del d["_lock"]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+        is_open = d["is_open"]
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def write(self, buf: bytes):
+        """Append one record (whole, cflag=0)."""
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        self.record.write(_MAGIC_BYTES)
+        self.record.write(struct.pack("<I", _encode_lrec(0, len(buf))))
+        self.record.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def read(self):
+        """Read the next record, None at EOF. Reassembles split records."""
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        parts = []
+        while True:
+            head = self.record.read(8)
+            if len(head) < 8:
+                if parts:
+                    raise MXNetError(
+                        f"truncated split record at EOF in {self.uri}")
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _kMagic:
+                raise MXNetError(f"invalid record magic {magic:#x} in "
+                                 f"{self.uri}")
+            cflag, length = _decode_lrec(lrec)
+            payload = self.record.read(length)
+            if len(payload) < length:
+                raise MXNetError(f"truncated record in {self.uri}")
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.record.read(pad)
+            if cflag == 0:
+                return payload
+            parts.append(payload)
+            if cflag == 3:  # end of a split record
+                return b"".join(parts)
+
+    def tell(self):
+        return self.record.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access .rec via a sidecar .idx of ``key\\toffset`` lines
+    (reference: recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = None
+            if not os.path.exists(self.idx_path):
+                raise MXNetError(
+                    f"index file {self.idx_path} not found for "
+                    f"{self.uri}; regenerate it (e.g. tools/im2rec.py) or "
+                    "use MXRecordIO for sequential access")
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("fidx", None)
+        return d
+
+    def seek(self, idx):
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        with self._lock:
+            self.seek(idx)
+            return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.record.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# ---------------------------------------------------------------------------
+# image record packing (reference: recordio.py:291-470)
+# ---------------------------------------------------------------------------
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack an IRHeader + raw bytes (reference: recordio.py pack:309)."""
+    header = IRHeader(*header)
+    if not isinstance(header.label, numbers.Number):
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, int(header.flag), float(header.label),
+                       int(header.id), int(header.id2)) + s
+
+
+def unpack(s: bytes):
+    """Inverse of pack (reference: recordio.py unpack:344)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg") -> bytes:
+    """Encode an image array and pack it (reference: recordio.py
+    pack_img:417). Uses cv2 when available, PIL otherwise."""
+    try:
+        import cv2
+        if img_fmt in (".jpg", ".jpeg"):
+            params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+        elif img_fmt == ".png":
+            # png compression is 0-9 (jpeg-style 0-100 qualities are clamped)
+            params = [cv2.IMWRITE_PNG_COMPRESSION, min(quality, 9)]
+        else:
+            params = None
+        ok, buf = cv2.imencode(img_fmt, img, params)
+        if not ok:
+            raise MXNetError("failed to encode image")
+        return pack(header, buf.tobytes())
+    except ImportError:
+        import io as _io
+
+        from PIL import Image
+        arr = np.asarray(img)
+        if arr.ndim == 3:
+            arr = arr[..., ::-1]  # BGR->RGB (channel axis only)
+        im = Image.fromarray(arr)
+        bio = _io.BytesIO()
+        im.save(bio, format="JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG",
+                quality=quality)
+        return pack(header, bio.getvalue())
+
+
+def unpack_img(s: bytes, iscolor=-1):
+    """Unpack to (header, BGR image array) (reference: recordio.py
+    unpack_img:374)."""
+    header, s = unpack(s)
+    img = np.frombuffer(s, dtype=np.uint8)
+    try:
+        import cv2
+        img = cv2.imdecode(img, iscolor)
+    except ImportError:
+        import io as _io
+
+        from PIL import Image
+        im = Image.open(_io.BytesIO(s))
+        img = np.asarray(im.convert("RGB"))[..., ::-1]  # RGB->BGR like cv2
+    return header, img

@@ -1,0 +1,97 @@
+"""Generic class registry helpers (reference: python/mxnet/registry.py).
+
+Factory factories: ``get_register_func`` / ``get_alias_func`` /
+``get_create_func`` build per-base-class registries with string, dict and
+JSON-config creation — used by optimizer/initializer/metric style
+registries and available for user extension.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRY = {}
+
+
+def get_register_func(base_class, nickname):
+    """Return a ``register(klass, name=None)`` function for ``base_class``."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise TypeError(
+                f"Can only register subclass of {base_class.__name__}")
+        if name is None:
+            name = klass.__name__.lower()
+        name = name.lower()
+        if name in registry and registry[name] is not klass:
+            warnings.warn(
+                f"New {nickname} {klass.__module__}.{klass.__name__} "
+                f"registered with name {name} is overriding existing "
+                f"{nickname} {registry[name].__module__}."
+                f"{registry[name].__name__}", UserWarning, stacklevel=2)
+        registry[name] = klass
+        return klass
+
+    register.__doc__ = f"Register {nickname} to the {nickname} factory"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Return an ``alias(*names)`` class decorator for ``base_class``."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Return a ``create(name_or_instance, **kwargs)`` factory accepting a
+    registered name, an instance, a dict, or a JSON config string."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if len(args):
+            name = args[0]
+            args = args[1:]
+        else:
+            name = kwargs.pop(nickname)
+
+        if isinstance(name, base_class):
+            if args or kwargs:
+                raise ValueError(
+                    f"{nickname} is already an instance. "
+                    "Additional arguments are invalid")
+            return name
+
+        if isinstance(name, dict):
+            return create(**name)
+
+        if not isinstance(name, str):
+            raise TypeError(f"{nickname} must be of string type")
+
+        if name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            assert not args and not kwargs
+            kwargs = json.loads(name)
+            return create(**kwargs)
+
+        name = name.lower()
+        if name not in registry:
+            raise ValueError(
+                f"{name} is not registered. Please register with "
+                f"{nickname}.register first")
+        return registry[name](*args, **kwargs)
+
+    create.__doc__ = f"Create a {nickname} instance from config."
+    return create

@@ -1,0 +1,680 @@
+"""Symbolic RNN cell toolkit.
+
+Reference analogue: python/mxnet/rnn/rnn_cell.py (BaseRNNCell.unroll :295,
+RNN/LSTM/GRU cells :362-535, FusedRNNCell :536, Bidirectional/Residual/
+Zoneout/Dropout modifiers). Cells compose Symbols; an unrolled graph compiles
+to one XLA program, so the reference's fused-vs-unfused performance split
+disappears — ``FusedRNNCell`` here simply emits the one-op ``RNN`` symbol
+(which lowers to the lax.scan kernel in ops/rnn_ops.py).
+"""
+from __future__ import annotations
+
+from .. import ndarray, symbol
+from ..base import MXNetError
+from ..ops.rnn_ops import _GATES, _unpack, rnn_param_size
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]
+
+
+class RNNParams:
+    """Container for cell weights (reference rnn_cell.py:RNNParams)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract cell: ``output, states = cell(input, states)``
+    (reference rnn_cell.py:BaseRNNCell)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [ele["shape"] for ele in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        """Initial state symbols (reference rnn_cell.py:begin_state)."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called "\
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if info is not None:
+                info = dict(info, **kwargs)
+            else:
+                info = dict(kwargs)
+            info = {k: v for k, v in info.items()
+                    if not k.startswith("__")}  # drop __layout__ etc.
+            state = func(name=f"{self._prefix}begin_state_"
+                         f"{self._init_counter}", **info)
+            states.append(state)
+        return states
+
+    def _auto_begin_state(self, ref, batch_axis=0):
+        """Default zero begin states sized from the input symbol's batch dim
+        (the XLA-era replacement for the reference's bidirectional shape
+        inference of zeros(shape=(0, H)) states)."""
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            states.append(getattr(symbol, "_begin_state_zeros")(
+                ref, shape=info["shape"], batch_axis=batch_axis,
+                name=f"{self._prefix}begin_state_{self._init_counter}"))
+        return states
+
+    def unpack_weights(self, args):
+        """Split fused parameter blobs into per-gate arrays
+        (reference rnn_cell.py:unpack_weights)."""
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        h = self._num_hidden
+        for group_name in ("i2h", "h2h"):
+            weight = args.pop(f"{self._prefix}{group_name}_weight")
+            bias = args.pop(f"{self._prefix}{group_name}_bias")
+            for j, gate in enumerate(self._gate_names):
+                wname = f"{self._prefix}{group_name}{gate}_weight"
+                args[wname] = weight[j * h:(j + 1) * h].copy()
+                bname = f"{self._prefix}{group_name}{gate}_bias"
+                args[bname] = bias[j * h:(j + 1) * h].copy()
+        return args
+
+    def pack_weights(self, args):
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        for group_name in ("i2h", "h2h"):
+            weight = []
+            bias = []
+            for gate in self._gate_names:
+                weight.append(args.pop(
+                    f"{self._prefix}{group_name}{gate}_weight"))
+                bias.append(args.pop(
+                    f"{self._prefix}{group_name}{gate}_bias"))
+            args[f"{self._prefix}{group_name}_weight"] = \
+                ndarray.concatenate(weight)
+            args[f"{self._prefix}{group_name}_bias"] = \
+                ndarray.concatenate(bias)
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll the cell for ``length`` steps (reference :295)."""
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self._auto_begin_state(inputs[0])
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _format_sequence(length, outputs, layout, merge_outputs)
+        return outputs, states
+
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """inputs → list of per-step symbols (reference rnn_cell.py helpers)."""
+    axis = layout.find("T")
+    if isinstance(inputs, symbol.Symbol):
+        in_axis = (in_layout or layout).find("T")
+        if len(inputs.list_outputs()) == 1:
+            # one symbol carrying the whole sequence: split on time axis
+            inputs = symbol.split(inputs, axis=in_axis, num_outputs=length,
+                                  squeeze_axis=1)
+            inputs = list(inputs) if length > 1 else [inputs]
+        else:
+            inputs = list(inputs)
+    if len(inputs) != length:
+        raise MXNetError(
+            f"got a sequence of length {len(inputs)}, expected {length}")
+    return inputs, axis
+
+
+def _format_sequence(length, outputs, layout, merge):
+    axis = layout.find("T")
+    if merge:
+        outputs = [symbol.expand_dims(o, axis=axis) for o in outputs]
+        outputs = symbol.Concat(*outputs, dim=axis)
+    return outputs, axis
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell (reference rnn_cell.py:362)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden,
+                                    name=f"{name}i2h")
+        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
+                                    num_hidden=self._num_hidden,
+                                    name=f"{name}h2h")
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name=f"{name}out")
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell, gate order i,f,g,o (reference rnn_cell.py:410)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        from ..initializer import LSTMBias
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias",
+                                   init=LSTMBias(forget_bias=forget_bias))
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name=f"{name}i2h")
+        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name=f"{name}h2h")
+        gates = i2h + h2h
+        slices = symbol.SliceChannel(gates, num_outputs=4,
+                                     name=f"{name}slice")
+        in_gate = symbol.Activation(slices[0], act_type="sigmoid")
+        forget_gate = symbol.Activation(slices[1], act_type="sigmoid")
+        in_transform = symbol.Activation(slices[2], act_type="tanh")
+        out_gate = symbol.Activation(slices[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell, gate order r,z,n (reference rnn_cell.py:478)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        prev_h = states[0]
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name=f"{name}i2h")
+        h2h = symbol.FullyConnected(prev_h, self._hW, self._hB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name=f"{name}h2h")
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(
+            i2h, num_outputs=3, name=f"{name}i2h_slice")
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(
+            h2h, num_outputs=3, name=f"{name}h2h_slice")
+        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h,
+                                       act_type="tanh")
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Multi-layer fused cell emitting the one-op RNN symbol
+    (reference rnn_cell.py:536)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = f"{mode}_"
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._parameter = self.params.get("parameters")
+        self._directions = ["l", "r"] if bidirectional else ["l"]
+
+    @property
+    def state_info(self):
+        D = 2 if self._bidirectional else 1
+        b = {"shape": (D * self._num_layers, 0, self._num_hidden),
+             "__layout__": "LNC"}
+        return [b] * (2 if self._mode == "lstm" else 1)
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": ("",), "rnn_tanh": ("",),
+                "lstm": ("_i", "_f", "_c", "_o"),
+                "gru": ("_r", "_z", "_o")}[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def _slice_weights(self, arr, li, lh):
+        """Split a packed ndarray into the reference's per-layer names
+        (l0_i2h_weight, r0_h2h_bias, ...)."""
+        pieces = _unpack(arr._data, self._num_layers, li, lh, self._mode,
+                         self._bidirectional)
+        args = {}
+        for layer in range(self._num_layers):
+            for d, dname in enumerate(self._directions):
+                w_i2h, w_h2h, b_i2h, b_h2h = pieces[layer][d]
+                base = f"{self._prefix}{dname}{layer}_"
+                args[f"{base}i2h_weight"] = ndarray.NDArray(w_i2h)
+                args[f"{base}h2h_weight"] = ndarray.NDArray(w_h2h)
+                args[f"{base}i2h_bias"] = ndarray.NDArray(b_i2h)
+                args[f"{base}h2h_bias"] = ndarray.NDArray(b_h2h)
+        return args
+
+    def unpack_weights(self, args):
+        args = dict(args)
+        arr = args.pop(self._parameter.name)
+        b = self._num_gates * self._num_hidden
+        m = arr.size
+        li = (m // b - (self._num_layers - 1) *
+              (self._num_hidden * (1 + len(self._directions)) + 2 *
+               len(self._directions)) - self._num_hidden - 2) \
+            // len(self._directions) if False else None
+        # solve input size from total param count
+        input_size = self._infer_input_size(arr.size)
+        args.update(self._slice_weights(arr, input_size, self._num_hidden))
+        return args
+
+    def _infer_input_size(self, total):
+        H, L = self._num_hidden, self._num_layers
+        mode, bi = self._mode, self._bidirectional
+        # closed form is messy; scan plausible sizes
+        for input_size in range(1, 65536):
+            if rnn_param_size(L, input_size, H, mode, bi) == total:
+                return input_size
+        raise MXNetError("cannot infer input size from parameter length")
+
+    def pack_weights(self, args):
+        import numpy as np
+        args = dict(args)
+        H = self._num_hidden
+        flat = []
+        b0 = args[f"{self._prefix}l0_i2h_weight"]
+        input_size = b0.shape[1]
+        in_size = input_size
+        biases = []
+        for layer in range(self._num_layers):
+            for dname in self._directions:
+                base = f"{self._prefix}{dname}{layer}_"
+                flat.append(args.pop(f"{base}i2h_weight").asnumpy().ravel())
+                flat.append(args.pop(f"{base}h2h_weight").asnumpy().ravel())
+                biases.append(args.pop(f"{base}i2h_bias").asnumpy().ravel())
+                biases.append(args.pop(f"{base}h2h_bias").asnumpy().ravel())
+            in_size = H * len(self._directions)
+        args[self._parameter.name] = ndarray.array(
+            np.concatenate(flat + biases))
+        return args
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "FusedRNNCell cannot be stepped. Please use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, True)
+        # fused op consumes TNC: stack per-step inputs on a leading T axis
+        stacked = symbol.Concat(
+            *[symbol.expand_dims(x, axis=0) for x in inputs], dim=0) \
+            if isinstance(inputs, list) else inputs
+        if begin_state is None:
+            begin_state = self._auto_begin_state(stacked, batch_axis=1)
+        states = list(begin_state)
+        rnn_inputs = [stacked, self._parameter] + states
+        rnn = symbol.RNN(*rnn_inputs, state_size=self._num_hidden,
+                         num_layers=self._num_layers, mode=self._mode,
+                         bidirectional=self._bidirectional, p=self._dropout,
+                         state_outputs=self._get_next_state,
+                         name=f"{self._prefix}rnn")
+        if not self._get_next_state:
+            outputs, states = rnn, []
+        elif self._mode == "lstm":
+            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            outputs, states = rnn[0], [rnn[1]]
+        if merge_outputs is False:
+            outputs = list(symbol.split(outputs, axis=0, num_outputs=length,
+                                        squeeze_axis=1))
+        elif layout == "NTC":
+            outputs = symbol.swapaxes(outputs, dim1=0, dim2=1)
+        return outputs, states
+
+    def unfuse(self):
+        """Equivalent stack of unfused cells (reference :780)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden,
+                                          activation="relu", prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden,
+                                          activation="tanh", prefix=p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
+            "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell(f"{self._prefix}l{i}_"),
+                    get_cell(f"{self._prefix}r{i}_"),
+                    output_prefix=f"{self._prefix}bi_l{i}_"))
+            else:
+                stack.add(get_cell(f"{self._prefix}l{i}_"))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix=f"{self._prefix}_dropout{i}_"))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack cells layer-over-layer (reference rnn_cell.py:698)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+        self._override_cell_params = params is not None
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        num_cells = len(self._cells)
+        p = 0
+        outputs = inputs
+        states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            cell_begin = None if begin_state is None \
+                else begin_state[p:p + n]
+            outputs, st = cell.unroll(
+                length, outputs, begin_state=cell_begin, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            p += n
+            states.extend(st)
+        return outputs, states
+
+
+class DropoutCell(BaseRNNCell):
+    """Apply dropout on input (reference rnn_cell.py:772)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(inputs, p=self.dropout)
+        return inputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells wrapping another cell (reference rnn_cell.py:800)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference rnn_cell.py:851)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), \
+            "FusedRNNCell does not support zoneout; unfuse first"
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+        mask = lambda p, like: symbol.Dropout(  # noqa: E731
+            symbol.ones_like(like), p=p)
+        prev_output = self.prev_output if self.prev_output is not None \
+            else symbol.zeros_like(next_output)
+        output = symbol.where(mask(self.zoneout_outputs, next_output),
+                              next_output, prev_output) \
+            if self.zoneout_outputs > 0.0 else next_output
+        states = [symbol.where(mask(self.zoneout_states, new_s), new_s,
+                               old_s)
+                  for new_s, old_s in zip(next_states, states)] \
+            if self.zoneout_states > 0.0 else next_states
+        self.prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the output (reference rnn_cell.py:906)."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=False)
+        self.base_cell._modified = True
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        outputs = [o + i for o, i in zip(outputs, inputs)]
+        outputs, _ = _format_sequence(length, outputs, layout, merge_outputs)
+        return outputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Run two cells over opposite directions, concat outputs
+    (reference rnn_cell.py:823)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(prefix="", params=params)
+        self._output_prefix = output_prefix
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        raise MXNetError("Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = sum(
+                (c._auto_begin_state(inputs[0]) for c in self._cells), [])
+        states = begin_state
+        l_cell, r_cell = self._cells
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[:len(l_cell.state_info)],
+            layout=layout, merge_outputs=False)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[len(l_cell.state_info):],
+            layout=layout, merge_outputs=False)
+        outputs = [symbol.Concat(l_o, r_o, dim=1,
+                                 name=f"{self._output_prefix}t{i}")
+                   for i, (l_o, r_o) in enumerate(
+                       zip(l_outputs, reversed(r_outputs)))]
+        outputs, _ = _format_sequence(length, outputs, layout, merge_outputs)
+        states = l_states + r_states
+        return outputs, states

@@ -1,0 +1,97 @@
+"""Runtime-compiled kernels (reference: src/common/mxrtc.cc + python
+rtc.py — CUDA C strings compiled via NVRTC and pushed with grid/block
+dims).
+
+TPU-native redesign: there is no runtime C compiler on the chip, but the
+same capability — *user-supplied kernel source compiled at runtime and run
+on device* — maps to Pallas: the source string is the body of a Pallas
+kernel operating on input/output Refs; ``push`` compiles it (cached) with
+``pl.pallas_call`` and runs it on the device arrays. On non-TPU backends
+the kernel runs through the Pallas interpreter, so the same source works
+everywhere (unlike the reference, whose rtc was CUDA-only).
+
+    rtc = mx.rtc.Rtc('axpy', [('x', x), ('y', y)], [('out', out)], '''
+    out[:] = x[:] * 2.0 + y[:]
+    ''')
+    rtc.push([x, y], [out])
+"""
+from __future__ import annotations
+
+import textwrap
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Rtc"]
+
+
+class Rtc:
+    def __init__(self, name: str, inputs: Sequence[Tuple[str, object]],
+                 outputs: Sequence[Tuple[str, object]], kernel: str):
+        """name: kernel name; inputs/outputs: (name, NDArray) pairs fixing
+        the argument names, shapes and dtypes; kernel: python source whose
+        statements read/write the named Refs (``x[:]``-style)."""
+        self.name = name
+        self.input_names = [n for n, _ in inputs]
+        self.output_names = [n for n, _ in outputs]
+        if not self.output_names:
+            raise MXNetError("Rtc needs at least one output")
+        self._in_templates = [(tuple(a.shape), np.dtype(str(a.dtype)))
+                              for _, a in inputs]
+        self._out_templates = [(tuple(a.shape), np.dtype(str(a.dtype)))
+                               for _, a in outputs]
+        body = textwrap.dedent(kernel)
+        args = ", ".join(self.input_names + self.output_names)
+        src = (f"def _rtc_kernel({args}):\n"
+               + textwrap.indent(body.strip() + "\n", "    "))
+        scope = {"jnp": jnp, "jax": jax, "np": np}
+        try:
+            exec(compile(src, f"<rtc:{name}>", "exec"), scope)
+        except SyntaxError as e:
+            raise MXNetError(f"Rtc kernel {name!r} failed to parse: {e}")
+        self._kernel = scope["_rtc_kernel"]
+        self._compiled = None
+
+    def _build(self):
+        from jax.experimental import pallas as pl
+
+        out_shapes = tuple(jax.ShapeDtypeStruct(s, d)
+                           for s, d in self._out_templates)
+        on_tpu = jax.default_backend() == "tpu"
+        call = pl.pallas_call(self._kernel, out_shape=out_shapes,
+                              interpret=not on_tpu)
+        self._compiled = jax.jit(call)
+
+    def push(self, inputs: List, outputs: List, grid_dims=None,
+             block_dims=None):
+        """Run the kernel. grid/block dims are accepted for reference-API
+        parity and ignored (Pallas/XLA choose the schedule)."""
+        if len(inputs) != len(self.input_names) or \
+                len(outputs) != len(self.output_names):
+            raise MXNetError(
+                f"Rtc {self.name!r} expects {len(self.input_names)} inputs "
+                f"and {len(self.output_names)} outputs")
+        inputs = [x if hasattr(x, "shape") else np.asarray(x)
+                  for x in inputs]
+        for name, x, (shape, dtype) in zip(self.input_names, inputs,
+                                           self._in_templates):
+            xs = tuple(x.shape)
+            xd = np.dtype(str(x.dtype))
+            if xs != shape or xd != dtype:
+                raise MXNetError(
+                    f"Rtc {self.name!r} input {name!r}: got {xs}/{xd}, "
+                    f"compiled for {shape}/{dtype}")
+        if self._compiled is None:
+            self._build()
+        vals = [x._data if hasattr(x, "_data") else np.asarray(x)
+                for x in inputs]
+        res = self._compiled(*vals)
+        if not isinstance(res, tuple):
+            res = (res,)
+        for o, r in zip(outputs, res):
+            o._set_data(r)
+        return outputs

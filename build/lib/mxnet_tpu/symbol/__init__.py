@@ -1,0 +1,106 @@
+"""The ``sym`` namespace: Symbol plus op constructors generated from the
+op table (reference: python/mxnet/symbol/op.py import-time codegen)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..base import MXNetError
+from ..ops.registry import OP_TABLE, OpDef, resolve_inputs
+from .symbol import (  # noqa: F401
+    AttrScope,
+    Group,
+    NameManager,
+    Symbol,
+    SymbolNode,
+    Variable,
+    load,
+    load_json,
+    symbol_invoke,
+    var,
+)
+
+
+def _make_sym_func(opdef: OpDef, name: str):
+    def sym_func(*args, **kwargs):
+        sym_name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        inputs = resolve_inputs(opdef, args, kwargs, name,
+                                is_input=lambda v: isinstance(v, Symbol))
+        if any(not isinstance(x, Symbol) for x in inputs):
+            raise MXNetError(f"{name}: symbolic inputs must be Symbols")
+        return symbol_invoke(opdef, inputs, kwargs, sym_name)
+
+    sym_func.__name__ = name
+    sym_func.__doc__ = (opdef.fn.__doc__ or "") + (
+        f"\n\nParameters: {sorted(opdef.attr_spec.fields)}"
+        f"\nInputs: {opdef.input_names or ['data']}"
+    )
+    return sym_func
+
+
+_mod = _sys.modules[__name__]
+for _name, _opdef in OP_TABLE.items():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_sym_func(_opdef, _name))
+
+del _mod, _name, _opdef
+
+from . import contrib  # noqa: F401,E402
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _sys.modules[__name__]._zeros(shape=shape, dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _sys.modules[__name__]._ones(shape=shape, dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
+    return _sys.modules[__name__]._arange(start=start, stop=stop, step=step,
+                                          repeat=repeat, name=name, dtype=dtype)
+
+
+def full(shape, val, dtype="float32", name=None):
+    """Symbol filled with ``val`` (reference symbol.py full)."""
+    return _sys.modules[__name__]._full(shape=shape, value=float(val),
+                                        dtype=dtype, name=name)
+
+
+def _sym_ufunc(lhs, rhs, fn_array, lfn_scalar, rfn_scalar, fn_scalar):
+    """Scalar/Symbol dispatch shared by pow/maximum/minimum/hypot
+    (reference symbol.py:pow — Symbol·Symbol broadcasts, Symbol·scalar uses
+    the scalar op, scalar·scalar degenerates to python)."""
+    import numbers
+    mod = _sys.modules[__name__]
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return getattr(mod, fn_array)(lhs, rhs)
+    if isinstance(lhs, Symbol) and isinstance(rhs, numbers.Number):
+        return getattr(mod, lfn_scalar)(lhs, scalar=float(rhs))
+    if isinstance(lhs, numbers.Number) and isinstance(rhs, Symbol):
+        return getattr(mod, rfn_scalar)(rhs, scalar=float(lhs))
+    if isinstance(lhs, numbers.Number) and isinstance(rhs, numbers.Number):
+        return fn_scalar(lhs, rhs)
+    raise TypeError(f"types ({type(lhs)}, {type(rhs)}) not supported")
+
+
+def pow(base, exp):
+    """base ** exp with Symbol/scalar dispatch (reference symbol.py pow)."""
+    return _sym_ufunc(base, exp, "broadcast_power", "_power_scalar",
+                      "_rpower_scalar", lambda a, b: a ** b)
+
+
+def maximum(left, right):
+    return _sym_ufunc(left, right, "broadcast_maximum", "_maximum_scalar",
+                      "_maximum_scalar", lambda a, b: a if a > b else b)
+
+
+def minimum(left, right):
+    return _sym_ufunc(left, right, "broadcast_minimum", "_minimum_scalar",
+                      "_minimum_scalar", lambda a, b: a if a < b else b)
+
+
+def hypot(left, right):
+    import math
+    return _sym_ufunc(left, right, "broadcast_hypot", "_hypot_scalar",
+                      "_hypot_scalar", math.hypot)

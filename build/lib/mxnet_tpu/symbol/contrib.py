@@ -1,0 +1,10 @@
+"""``sym.contrib`` namespace: symbolic constructors for ``_contrib_`` ops.
+
+Reference analogue: python/mxnet/symbol/op.py contrib-module codegen.
+"""
+import sys as _sys
+
+from ..ops.registry import populate_contrib
+
+populate_contrib(_sys.modules[__name__.rsplit(".", 1)[0]],
+                 _sys.modules[__name__])

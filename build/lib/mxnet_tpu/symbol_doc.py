@@ -1,0 +1,48 @@
+"""Extra docstrings for Symbol ops (reference: python/mxnet/symbol_doc.py).
+
+Same mechanism as :mod:`ndarray_doc` but for the symbolic namespace; also
+hosts ``SymbolDoc.get_output_shape``, the shape-inspection helper the
+reference documents for debugging.
+"""
+from __future__ import annotations
+
+__all__ = ["SymbolDoc", "_build_doc"]
+
+
+class SymbolDoc:
+    """Subclass and name the class ``<op>Doc`` to attach extra examples to
+    symbol op ``<op>``'s docstring."""
+
+    @staticmethod
+    def get_output_shape(sym, **input_shapes):
+        """Infer and return a dict of output name -> shape."""
+        _, s_outputs, _ = sym.infer_shape(**input_shapes)
+        return dict(zip(sym.list_outputs(), s_outputs))
+
+
+def _extra_doc(func_name):
+    for cls in SymbolDoc.__subclasses__():
+        if cls.__name__ == f"{func_name}Doc" and cls.__doc__:
+            return cls.__doc__
+    return ""
+
+
+def _build_doc(func_name, desc, arg_names, arg_types, arg_desc,
+               key_var_num_args=None, ret_type=None):
+    """Build a numpy-style docstring for a generated symbol function."""
+    lines = [desc or func_name, "", "Parameters", "----------"]
+    for name, typ, adesc in zip(arg_names, arg_types, arg_desc):
+        lines.append(f"{name} : {typ}")
+        if adesc:
+            lines.append(f"    {adesc}")
+    if key_var_num_args:
+        lines.append(f"{key_var_num_args} : int")
+        lines.append("    Number of variadic positional inputs.")
+    lines += ["name : string, optional.", "    Name of the resulting "
+              "symbol.", "", "Returns", "-------",
+              f"output : {ret_type or 'Symbol'}",
+              "    The resulting symbol."]
+    extra = _extra_doc(func_name)
+    if extra:
+        lines += ["", extra]
+    return "\n".join(lines)

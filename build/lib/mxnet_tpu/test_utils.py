@@ -1,0 +1,680 @@
+"""Testing utilities: numeric-gradient and consistency harness.
+
+Reference analogue: python/mxnet/test_utils.py — ``check_numeric_gradient``
+(:620), ``check_symbolic_forward``/``backward`` (:744/:809),
+``assert_almost_equal`` (:328), ``check_consistency`` (:987),
+``default_context`` (:49). The CPU↔GPU consistency pattern becomes
+eager-vs-jit / dtype cross-checks (SURVEY.md §4 "TPU translation").
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+from . import ndarray as nd
+from .ndarray import NDArray
+from .symbol import Symbol
+
+_rng = np.random
+
+default_dtype = lambda: np.float32  # noqa: E731
+
+
+def default_context() -> Context:
+    """The context test suites run on; switchable via MXNET_TEST_DEVICE
+    (reference: test_utils.py:49-56, env-switchable default ctx)."""
+    dev = os.environ.get("MXNET_TEST_DEVICE", "")
+    if dev:
+        name, _, idx = dev.partition(":")
+        return Context(name, int(idx or 0))
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    Context._default.ctx = ctx
+
+
+def get_atol(atol=None):
+    return 1e-20 if atol is None else atol
+
+
+def get_rtol(rtol=None):
+    return 1e-5 if rtol is None else rtol
+
+
+# -- random data -------------------------------------------------------------
+
+
+def random_arrays(*shapes):
+    """Random float32 numpy arrays (reference :81)."""
+    arrays = [np.array(_rng.randn(), dtype=default_dtype()) if len(s) == 0
+              else _rng.randn(*s).astype(default_dtype()) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def random_sample(population, k):
+    """Sample without replacement (reference :90)."""
+    population_copy = population[:]
+    np.random.shuffle(population_copy)
+    return population_copy[0:k]
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return _rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1)
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
+            _rng.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(n, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=n))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 distribution=None):
+    """Random NDArray of the given storage type (reference :247)."""
+    if stype == "default":
+        return nd.array(random_arrays(shape), dtype=dtype)
+    arr, _ = rand_sparse_ndarray(shape, stype, density=density, dtype=dtype,
+                                 distribution=distribution)
+    return arr
+
+
+def rand_sparse_ndarray(shape, stype, density=None, distribution=None,
+                        dtype=None):
+    """Random sparse NDArray + its dense numpy value (reference :184)."""
+    from .ndarray import sparse
+    density = _rng.rand() if density is None else density
+    dtype = default_dtype() if dtype is None else dtype
+    if stype == "row_sparse":
+        num_rows = shape[0]
+        idx_sample = _rng.rand(num_rows)
+        indices = np.argwhere(idx_sample < density).reshape(-1)
+        if indices.shape[0] == 0:
+            return sparse.zeros("row_sparse", shape, dtype=dtype), \
+                np.zeros(shape, dtype=dtype)
+        val = _rng.rand(indices.shape[0], *shape[1:]).astype(dtype)
+        arr = sparse.row_sparse_array((val, indices), shape=shape, dtype=dtype)
+        return arr, arr.asnumpy()
+    if stype == "csr":
+        assert len(shape) == 2
+        dense = _rng.rand(*shape).astype(dtype)
+        dense[_rng.rand(*shape) >= density] = 0
+        arr = sparse.csr_matrix(dense)
+        return arr, dense
+    raise ValueError(f"unknown storage type {stype}")
+
+
+# -- comparison --------------------------------------------------------------
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Apply a numpy reduction with MXNet axis/keepdims semantics
+    (reference :268)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def _as_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    rtol, atol = get_rtol(rtol), get_atol(atol)
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = np.unravel_index(np.argmax(violation), violation.shape)
+    return loc, np.max(violation)
+
+
+def same(a, b):
+    return np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    return np.allclose(_as_np(a), _as_np(b), rtol=get_rtol(rtol),
+                       atol=get_atol(atol))
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a, b = _as_np(a), _as_np(b)
+    rtol, atol = get_rtol(rtol), get_atol(atol)
+    if almost_equal(a, b, rtol, atol):
+        return
+    index, rel = find_max_violation(a, b, rtol, atol)
+    raise AssertionError(
+        "Error %f exceeds tolerance rtol=%f, atol=%f. "
+        " Location of maximum error:%s, %s=%f, %s=%f"
+        % (rel, rtol, atol, str(index), names[0], a[index], names[1], b[index]))
+
+
+def _zero_nans(a, b):
+    a, b = _as_np(a).copy(), _as_np(b).copy()
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    return a, b
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    return almost_equal(*_zero_nans(a, b), rtol, atol)
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=("a", "b")):
+    a, b = _zero_nans(a, b)
+    assert_almost_equal(a, b, rtol, atol, names)
+
+
+def same_array(array1, array2):
+    """Check two NDArrays share the same handle (reference :1247)."""
+    array1[:] = array1.asnumpy() + 1
+    if not same(array1.asnumpy(), array2.asnumpy()):
+        return False
+    array1[:] = array1.asnumpy() - 1
+    return same(array1.asnumpy(), array2.asnumpy())
+
+
+def retry(n):
+    """Retry a flaky (random) test up to n times (reference :403)."""
+    assert n > 0
+
+    def decorate(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError as e:
+                    if i == n - 1:
+                        raise e
+                    np.random.seed(int(time.time() * 1e6) % (1 << 30))
+        return wrapper
+    return decorate
+
+
+# -- symbolic checking -------------------------------------------------------
+
+
+def _parse_location(sym: Symbol, location, ctx, dtype=None):
+    """kwargs-or-list → {arg_name: NDArray} (reference :450)."""
+    assert isinstance(location, (dict, list, tuple))
+    arg_names = sym.list_arguments()
+    if isinstance(location, dict):
+        if set(location.keys()) != set(arg_names):
+            raise ValueError(
+                "Symbol arguments and keys of the given location do not match."
+                f"symbol args:{arg_names}, location.keys():{list(location)}")
+    else:
+        location = dict(zip(arg_names, location))
+    return {k: v if isinstance(v, NDArray) else nd.array(v, ctx=ctx, dtype=dtype)
+            for k, v in location.items()}
+
+
+def _parse_aux_states(sym: Symbol, aux_states, ctx, dtype=None):
+    if aux_states is None:
+        return {}
+    if isinstance(aux_states, (list, tuple)):
+        aux_states = dict(zip(sym.list_auxiliary_states(), aux_states))
+    return {k: v if isinstance(v, NDArray) else nd.array(v, ctx=ctx, dtype=dtype)
+            for k, v in aux_states.items()}
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """One-shot forward returning numpy outputs (reference :422)."""
+    executor = sym.simple_bind(ctx=ctx, grad_req="null",
+                               **{k: v.shape for k, v in inputs.items()})
+    for k, v in inputs.items():
+        executor.arg_dict[k][:] = v
+    executor.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in executor.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences of sum(outputs[0]) wrt each arg
+    (reference :560). ``location`` is {name: numpy array}."""
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    # asnumpy() can hand back read-only buffers; finite differencing
+    # perturbs entries in place, so take writable copies
+    location = {k: np.array(v, copy=True) for k, v in location.items()}
+    approx_grads = {k: np.zeros(v.shape, dtype=v.dtype)
+                    for k, v in location.items()}
+
+    for k, v in location.items():
+        old_value = v.copy()
+        for i in range(int(np.prod(v.shape)) if v.shape else 1):
+            # forward at x+eps/2 and x-eps/2
+            v.reshape(-1)[i] = old_value.reshape(-1)[i] + eps / 2.0
+            executor.arg_dict[k][:] = v
+            if aux_states is not None:
+                for key, val in aux_states.items():
+                    executor.aux_dict[key][:] = val
+            executor.forward(is_train=use_forward_train)
+            f_peps = executor.outputs[0].asnumpy().astype(np.float64).sum()
+
+            v.reshape(-1)[i] = old_value.reshape(-1)[i] - eps / 2.0
+            executor.arg_dict[k][:] = v
+            if aux_states is not None:
+                for key, val in aux_states.items():
+                    executor.aux_dict[key][:] = val
+            executor.forward(is_train=use_forward_train)
+            f_neps = executor.outputs[0].asnumpy().astype(np.float64).sum()
+
+            approx_grads[k].reshape(-1)[i] = (f_peps - f_neps) / eps
+            v.reshape(-1)[i] = old_value.reshape(-1)[i]
+        # copy back the original value
+        executor.arg_dict[k][:] = old_value
+    return approx_grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None, dtype=np.float32):
+    """Verify symbolic gradients against finite differences on a random
+    projection of the outputs (reference :620).
+
+    Unlike the reference's 1e-20 default, ``atol`` defaults to the fp32
+    finite-difference noise floor (~2·ulp(loss)/eps): a central difference of
+    a float32 forward cannot resolve gradients smaller than that, and a
+    purely relative check fails spuriously on near-zero entries.
+    """
+    ctx = ctx or default_context()
+    if atol is None:
+        # noise floor scales with the forward's ulp: ~2·ulp(loss)/eps
+        atol = 2e-3 if np.dtype(dtype).itemsize <= 4 else 1e-8
+
+    def random_projection(shape):
+        # random_projection should not have elements too small,
+        # otherwise too much precision is lost in numerical gradient
+        plain = _rng.rand(*shape) + 0.1
+        return plain
+
+    location = _parse_location(sym, location, ctx, dtype=dtype)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    aux_states = _parse_aux_states(sym, aux_states, ctx, dtype=dtype)
+    aux_npy = {k: v.asnumpy() for k, v in aux_states.items()}
+
+    if grad_nodes is None:
+        grad_nodes = sym.list_arguments()
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, (list, tuple)):
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, dict):
+        grad_req = grad_nodes.copy()
+        grad_nodes = list(grad_nodes.keys())
+    else:
+        raise ValueError(f"Invalid grad_nodes {grad_nodes}")
+
+    input_shape = {k: v.shape for k, v in location.items()}
+    _, out_shape, _ = sym.infer_shape(**input_shape)
+    from . import sym as _sym_ns
+    proj = _sym_ns.Variable("__random_proj")
+    out = _sym_ns.sum(sym[0] * proj)
+    out = _sym_ns.MakeLoss(out)
+
+    location = dict(location)
+    location["__random_proj"] = nd.array(random_projection(out_shape[0]),
+                                         ctx=ctx, dtype=dtype)
+    args_grad_npy = {k: _rng.normal(0, 0.01, size=location[k].shape)
+                     for k in grad_nodes}
+    args_grad_npy["__random_proj"] = _rng.normal(0, 0.01, size=out_shape[0])
+    args_grad = {k: nd.array(v, ctx=ctx, dtype=dtype)
+                 for k, v in args_grad_npy.items()}
+    grad_req = dict(grad_req)
+    grad_req["__random_proj"] = "write"
+
+    executor = out.bind(ctx, args=location, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    executor.forward(is_train=True)
+    assert len(executor.outputs) == 1
+    executor.backward()
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    numeric_gradients = numeric_grad(
+        executor, {**location_npy,
+                   "__random_proj": location["__random_proj"].asnumpy()},
+        aux_npy, eps=numeric_eps, use_forward_train=use_forward_train)
+
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        if grad_req[name] == "write":
+            assert_almost_equal(fd_grad, sym_grad, rtol, atol,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "add":
+            assert_almost_equal(fd_grad, sym_grad - args_grad_npy[name],
+                                rtol, atol,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "null":
+            assert_almost_equal(args_grad_npy[name], sym_grad, rtol, atol,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        else:
+            raise ValueError(f"Invalid grad_req {grad_req[name]} for {name}")
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None, dtype=np.float32):
+    """Compare executor forward outputs against expected numpy values
+    (reference :744)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype=dtype)
+    aux_states = _parse_aux_states(sym, aux_states, ctx, dtype=dtype)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    executor = sym.bind(ctx, args=location, grad_req="null",
+                        aux_states=aux_states)
+    executor.forward(is_train=False)
+    outputs = [x.asnumpy() for x in executor.outputs]
+    for output_name, expect, output in zip(sym.list_outputs(), expected,
+                                           outputs):
+        assert_almost_equal(expect, output, rtol, atol,
+                            ("EXPECTED_%s" % output_name,
+                             "FORWARD_%s" % output_name))
+    return executor.outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, dtype=np.float32):
+    """Compare executor backward grads against expected numpy values
+    (reference :809)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype=dtype)
+    aux_states = _parse_aux_states(sym, aux_states, ctx, dtype=dtype)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    args_grad_npy = {k: _rng.normal(size=v.shape)
+                     for k, v in expected.items()}
+    args_grad_data = {k: nd.array(v, ctx=ctx, dtype=dtype)
+                      for k, v in args_grad_npy.items()}
+    if isinstance(grad_req, str):
+        grad_req = {k: grad_req for k in sym.list_arguments()}
+    elif isinstance(grad_req, (list, tuple)):
+        grad_req = dict(zip(sym.list_arguments(), grad_req))
+
+    executor = sym.bind(ctx, args=location, args_grad=args_grad_data,
+                        grad_req=grad_req, aux_states=aux_states)
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (tuple, list)):
+        out_grads = [nd.array(v, ctx=ctx, dtype=dtype) for v in out_grads]
+    elif isinstance(out_grads, dict):
+        out_grads = [nd.array(out_grads[k], ctx=ctx, dtype=dtype)
+                     for k in sym.list_outputs()]
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items()}
+    for name in expected:
+        if grad_req[name] == "write":
+            assert_almost_equal(expected[name], grads[name], rtol, atol,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "add":
+            assert_almost_equal(expected[name],
+                                grads[name] - args_grad_npy[name], rtol, atol,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "null":
+            assert_almost_equal(args_grad_npy[name], grads[name], rtol, atol,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
+        else:
+            raise ValueError(f"Invalid grad_req {grad_req[name]} for {name}")
+    return executor.grad_arrays
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None):
+    """Run the same symbol under every spec and cross-check fwd/bwd.
+
+    Reference :987 runs cpu-vs-gpu-vs-fp16; the TPU translation runs
+    eager-vs-jit and/or multiple dtypes (SURVEY.md §4). Each ctx spec is a
+    dict like {'ctx': mx.cpu(), 'data': shape, 'type_dict': {...}}.
+    """
+    if tol is None:
+        tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+               np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
+               np.dtype(np.int32): 0}
+    elif isinstance(tol, (float, int)):
+        tol = {np.dtype(np.float16): tol, np.dtype(np.float32): tol,
+               np.dtype(np.float64): tol, np.dtype(np.uint8): tol,
+               np.dtype(np.int32): tol}
+
+    assert len(ctx_list) > 1
+    if isinstance(sym, Symbol):
+        sym = [sym] * len(ctx_list)
+    else:
+        assert len(sym) == len(ctx_list)
+
+    output_names = sym[0].list_outputs()
+    arg_names = sym[0].list_arguments()
+    exe_list = []
+    for s, ctx in zip(sym, ctx_list):
+        assert s.list_arguments() == arg_names
+        assert s.list_outputs() == output_names
+        kwargs = {k: v for k, v in ctx.items()
+                  if k not in ("ctx", "type_dict")}
+        exe_list.append(s.simple_bind(ctx["ctx"], grad_req=grad_req,
+                                      type_dict=ctx.get("type_dict"),
+                                      **kwargs))
+
+    arg_params = {} if arg_params is None else arg_params
+    aux_params = {} if aux_params is None else aux_params
+    for n, arr in exe_list[0].arg_dict.items():
+        if n not in arg_params:
+            arg_params[n] = np.random.normal(
+                size=arr.shape, scale=scale).astype(np.float64)
+    for n, arr in exe_list[0].aux_dict.items():
+        if n not in aux_params:
+            aux_params[n] = 0
+    for exe in exe_list:
+        for name, arr in exe.arg_dict.items():
+            arr[:] = arg_params[name].astype(str(arr.dtype))
+        for name, arr in exe.aux_dict.items():
+            arr[:] = aux_params[name]
+
+    gt = ground_truth
+
+    # forward
+    for exe in exe_list:
+        exe.forward(is_train=(grad_req != "null"))
+    dtypes = [np.dtype(str(exe.outputs[0].dtype)) for exe in exe_list]
+    max_idx = int(np.argmax([dt.itemsize for dt in dtypes]))
+    if gt is None:
+        gt = {n: v.asnumpy() for n, v in
+              zip(output_names, exe_list[max_idx].outputs)}
+    for i, exe in enumerate(exe_list):
+        if i == max_idx and ground_truth is None:
+            continue
+        rtol = atol = tol[dtypes[i]]
+        for name, arr in zip(output_names, exe.outputs):
+            try:
+                assert_almost_equal(arr.asnumpy(), gt[name], rtol=rtol,
+                                    atol=atol)
+            except AssertionError as e:
+                print(f"Predict Err: ctx {i} vs ctx {max_idx} at {name}")
+                print(e)
+                if raise_on_err:
+                    raise
+
+    # backward
+    if grad_req != "null":
+        out_grads_npy = [np.random.normal(size=gt[n].shape)
+                         for n in output_names]
+        for exe, ctx in zip(exe_list, ctx_list):
+            exe.backward([nd.array(g, ctx=ctx["ctx"], dtype=str(o.dtype))
+                          for g, o in zip(out_grads_npy, exe.outputs)])
+        gt_grad = {n: v.asnumpy() for n, v in
+                   zip(arg_names, exe_list[max_idx].grad_arrays) if v is not None}
+        for i, exe in enumerate(exe_list):
+            if i == max_idx:
+                continue
+            rtol = atol = tol[dtypes[i]]
+            for name, arr in zip(arg_names, exe.grad_arrays):
+                if arr is None:
+                    continue
+                try:
+                    assert_almost_equal(arr.asnumpy(), gt_grad[name],
+                                        rtol=rtol, atol=atol)
+                except AssertionError as e:
+                    print(f"Train Err: ctx {i} vs ctx {max_idx} at {name}")
+                    print(e)
+                    if raise_on_err:
+                        raise
+    return gt
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
+                typ="whole", **kwargs):
+    """Time forward(+backward) throughput of a symbol (reference :913)."""
+    ctx = ctx or default_context()
+    if grad_req is None:
+        grad_req = "write"
+    if location is None:
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx, **kwargs)
+        location = {k: np.random.normal(size=arr.shape, scale=1.0)
+                    for k, arr in exe.arg_dict.items()}
+    else:
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx,
+                              **{k: v.shape for k, v in location.items()})
+    for name, iarr in location.items():
+        exe.arg_dict[name][:] = iarr.astype(str(exe.arg_dict[name].dtype))
+
+    if typ == "whole":
+        exe.forward(is_train=True)
+        exe.backward(out_grads=exe.outputs)
+        for output in exe.outputs:
+            output.wait_to_read()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=True)
+            exe.backward(out_grads=exe.outputs)
+        for output in exe.outputs:
+            output.wait_to_read()
+        return (time.time() - tic) / N
+    elif typ == "forward":
+        exe.forward(is_train=False)
+        for output in exe.outputs:
+            output.wait_to_read()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=False)
+        for output in exe.outputs:
+            output.wait_to_read()
+        return (time.time() - tic) / N
+    raise ValueError(f"typ can only be 'whole' or 'forward', got {typ}")
+
+
+# -- datasets ----------------------------------------------------------------
+
+
+def get_mnist(path=None):
+    """Load MNIST from a local directory, or synthesize a deterministic
+    stand-in when the files are absent (zero-egress environment; reference
+    :1197 downloads from the web)."""
+    path = path or os.environ.get("MXNET_TPU_MNIST", "data/mnist")
+    import gzip
+    import struct
+
+    def read_data(label_path, image_path):
+        with gzip.open(label_path) as flbl:
+            struct.unpack(">II", flbl.read(8))
+            label = np.frombuffer(flbl.read(), dtype=np.int8)
+        with gzip.open(image_path, "rb") as fimg:
+            _, _, rows, cols = struct.unpack(">IIII", fimg.read(16))
+            image = np.frombuffer(
+                fimg.read(), dtype=np.uint8).reshape(len(label), rows, cols)
+            image = image.reshape(
+                image.shape[0], 1, 28, 28).astype(np.float32) / 255
+        return label, image
+
+    files = ["train-labels-idx1-ubyte.gz", "train-images-idx3-ubyte.gz",
+             "t10k-labels-idx1-ubyte.gz", "t10k-images-idx3-ubyte.gz"]
+    if all(os.path.exists(os.path.join(path, f)) for f in files):
+        train_lbl, train_img = read_data(os.path.join(path, files[0]),
+                                         os.path.join(path, files[1]))
+        test_lbl, test_img = read_data(os.path.join(path, files[2]),
+                                       os.path.join(path, files[3]))
+    else:
+        train_lbl, train_img = synthetic_mnist(6000, seed=42)
+        test_lbl, test_img = synthetic_mnist(1000, seed=43)
+    return {"train_data": train_img, "train_label": train_lbl,
+            "test_data": test_img, "test_label": test_lbl}
+
+
+def synthetic_mnist(n, seed=42):
+    """Deterministic learnable digit-like dataset: each class is a fixed
+    template plus noise, so MLP/LeNet convergence tests are meaningful."""
+    rng = np.random.RandomState(seed)
+    templates = np.random.RandomState(7).rand(10, 1, 28, 28) > 0.6
+    labels = rng.randint(0, 10, size=n).astype(np.int8)
+    imgs = templates[labels].astype(np.float32)
+    imgs += rng.randn(n, 1, 28, 28).astype(np.float32) * 0.25
+    return labels, np.clip(imgs, 0, 1).astype(np.float32)
+
+
+def list_gpus():
+    """Reference :1126 — GPUs don't exist here; report TPU count instead."""
+    import jax
+    return list(range(len([d for d in jax.devices()
+                           if d.platform == "tpu"])))
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """Reference :1144. Zero-egress environment: only serves files already
+    present on disk; raises otherwise."""
+    fname = fname or url.split("/")[-1]
+    if dirname is not None:
+        fname = os.path.join(dirname, fname)
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    raise IOError(
+        f"download({url}): no network egress in this environment and "
+        f"{fname} is not present locally")
+
+
+def set_env_var(key, val, default_val=""):
+    prev_val = os.environ.get(key, default_val)
+    os.environ[key] = val
+    return prev_val
+
+
+@contextlib.contextmanager
+def discard_stderr():
+    """Discard stderr for tests that intentionally provoke warnings
+    (reference :1271)."""
+    stderr_fileno = sys.stderr.fileno()
+    old_stderr = os.dup(stderr_fileno)
+    try:
+        with open(os.devnull, "w") as bit_bucket:
+            os.dup2(bit_bucket.fileno(), stderr_fileno)
+            yield
+    finally:
+        os.dup2(old_stderr, stderr_fileno)
+        os.close(old_stderr)

@@ -1,0 +1,80 @@
+"""``mx.th`` — torch tensor-function interop (reference:
+python/mxnet/torch.py, which code-generates ``_th_*`` TH tensor math
+wrappers when built with USE_TORCH=1; plugin/torch).
+
+Here each wrapper converts NDArray inputs to host torch tensors, applies
+the torch function, and wraps the result back — handy for porting scripts
+that mixed ``mx.th.*`` calls into their pipelines. These run host-side
+(outside XLA); for performance-critical graph code use the native ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array as _nd_array
+
+__all__ = ["function_names"]
+
+
+def _torch():
+    import torch
+    return torch
+
+
+def _to_torch(x):
+    torch = _torch()
+    if isinstance(x, NDArray):
+        return torch.from_numpy(np.ascontiguousarray(x.asnumpy()))
+    if isinstance(x, np.ndarray):
+        return torch.from_numpy(np.ascontiguousarray(x))
+    return x  # scalar
+
+
+def _from_torch(r):
+    torch = _torch()
+    if isinstance(r, torch.Tensor):
+        return _nd_array(r.detach().cpu().numpy())
+    if isinstance(r, (tuple, list)):
+        return type(r)(_from_torch(v) for v in r)
+    return r
+
+
+# TH tensor math exposed by the reference's generated _th_* wrappers
+# (curated to the stable torch functional names)
+_FUNCS = [
+    "abs", "acos", "asin", "atan", "atan2", "ceil", "clamp", "cos",
+    "cosh", "exp", "floor", "fmod", "log", "log1p", "neg", "pow",
+    "round", "rsqrt", "sigmoid", "sign", "sin", "sinh", "sqrt", "tan",
+    "tanh", "trunc", "add", "sub", "mul", "div", "dot", "mm", "mv",
+    "bmm", "matmul", "min", "max", "sum", "prod", "mean", "std", "var",
+    "norm", "cumsum", "cumprod", "sort", "topk", "squeeze", "unsqueeze",
+    "cat", "chunk", "t", "diag", "tril", "triu", "ger", "inverse",
+    "ones", "zeros", "eye", "rand", "randn",
+]
+
+function_names = list(_FUNCS)
+
+
+def _make(fname):
+    def f(*args, **kwargs):
+        torch = _torch()
+        fn = getattr(torch, fname, None)
+        if fn is None:
+            raise MXNetError(f"torch has no function {fname}")
+        targs = [[_to_torch(v) for v in a] if isinstance(a, (list, tuple))
+                 and fname == "cat" else _to_torch(a) for a in args]
+        return _from_torch(fn(*targs, **kwargs))
+
+    f.__name__ = fname
+    f.__doc__ = (f"torch.{fname} applied to NDArrays (reference mx.th "
+                 f"generated wrapper, python/mxnet/torch.py)")
+    return f
+
+
+import sys as _sys  # noqa: E402
+
+_mod = _sys.modules[__name__]
+for _f in _FUNCS:
+    setattr(_mod, _f, _make(_f))
+del _mod, _f

@@ -1,0 +1,150 @@
+"""Network visualization: layer summary table + graphviz plotting.
+
+Reference surface: python/mxnet/visualization.py — ``print_summary(symbol,
+shape)`` (Keras-style table with per-layer output shapes and param counts)
+and ``plot_network`` (graphviz digraph). Both consume only the Symbol JSON
+graph, so they port structurally; plot_network degrades with a clear error
+when the optional graphviz package is absent.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_label(node):
+    op = node["op"]
+    name = node["name"]
+    attrs = node.get("attrs", {}) or {}
+    if op == "null":
+        return name
+    if op == "Convolution":
+        return (f"Convolution\n{attrs.get('kernel', '?')}/"
+                f"{attrs.get('stride', '')}, {attrs.get('num_filter', '?')}")
+    if op == "FullyConnected":
+        return f"FullyConnected\n{attrs.get('num_hidden', '?')}"
+    if op == "Activation" or op == "LeakyReLU":
+        return f"{op}\n{attrs.get('act_type', '')}"
+    if op == "Pooling":
+        return (f"Pooling\n{attrs.get('pool_type', '?')}, "
+                f"{attrs.get('kernel', '?')}")
+    return op
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Print a layer-by-layer summary table; returns total param count.
+
+    ``shape``: dict of input name -> shape for output-shape inference
+    (reference visualization.py:47)."""
+    arg_shape_map = {}
+    out_shape_map = {}
+    if shape is not None:
+        arg_names = symbol.list_arguments()
+        arg_shapes, _, _ = symbol.infer_shape(**shape)
+        arg_shape_map = dict(zip(arg_names, arg_shapes))
+        try:
+            internals = symbol.get_internals()
+            _, int_shapes, _ = internals.infer_shape(**shape)
+            out_shape_map = dict(zip(internals.list_outputs(), int_shapes))
+        except MXNetError:
+            pass  # partial shapes: leave the column empty
+
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {t[0] for t in conf.get("heads", [])}
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line += str(f)
+            line = line[:pos]
+            line += " " * (pos - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
+    print("=" * line_length)
+
+    total_params = 0
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        if op == "null" and i not in heads:
+            continue
+        name = node["name"]
+        inputs = [nodes[int(e[0])]["name"] for e in node["inputs"]
+                  if nodes[int(e[0])]["op"] != "null"
+                  or nodes[int(e[0])]["name"] in arg_shape_map]
+        # param count: sum of sizes of this node's weight/bias/gamma inputs
+        params = 0
+        for e in node["inputs"]:
+            src = nodes[int(e[0])]
+            if src["op"] == "null" and src["name"] in arg_shape_map \
+                    and src["name"] != name:
+                s = arg_shape_map[src["name"]]
+                n = 1
+                for d in s:
+                    n *= d
+                if any(src["name"].endswith(suf) for suf in
+                       ("weight", "bias", "gamma", "beta")):
+                    params += n
+        total_params += params
+        oshape = out_shape_map.get(f"{name}_output",
+                                   arg_shape_map.get(name, ""))
+        print_row([f"{name} ({_node_label(node).splitlines()[0]})",
+                   oshape, params, ", ".join(inputs[:2])])
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz digraph of the network (reference
+    visualization.py:192). Requires the optional ``graphviz`` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the 'graphviz' python package") from e
+    node_attrs = node_attrs or {}
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    default_attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    default_attrs.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    palette = ("#8dd3c7", "#fb8072", "#80b1d3", "#fdb462", "#b3de69",
+               "#fccde5", "#ffffb3", "#bebada")
+
+    def is_weight(name):
+        return any(name.endswith(s) for s in
+                   ("weight", "bias", "gamma", "beta", "moving_mean",
+                    "moving_var", "running_mean", "running_var"))
+
+    drawn = set()
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and is_weight(name):
+                continue
+            dot.node(name, label=name, fillcolor=palette[0],
+                     **default_attrs)
+        else:
+            color = palette[hash(op) % len(palette)]
+            dot.node(name, label=_node_label(node), fillcolor=color,
+                     **default_attrs)
+        drawn.add(name)
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for e in node["inputs"]:
+            src = nodes[int(e[0])]["name"]
+            if src in drawn:
+                dot.edge(src, node["name"])
+    return dot
