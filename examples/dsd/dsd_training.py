@@ -1,0 +1,85 @@
+"""Dense-Sparse-Dense training (Han et al. 2016).
+
+Reference analogue: example/dsd/ — train dense, prune the smallest
+weights to a sparsity mask and retrain sparse (regularization), then
+remove the mask and retrain dense from the sparse solution. Asserts the
+final dense model is at least as accurate as the first dense pass.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def accuracy(net, x, y):
+    return float((net(mx.nd.array(x)).asnumpy().argmax(1) == y).mean())
+
+
+def train(net, trainer, loss_fn, x, y, epochs, masks=None):
+    for _ in range(epochs):
+        for i in range(0, len(x), 64):
+            xb = mx.nd.array(x[i:i + 64])
+            yb = mx.nd.array(y[i:i + 64])
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(64)
+            if masks:
+                # sparse phase: keep pruned weights at zero
+                for p, m in masks.items():
+                    p.set_data(p.data() * m)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=12)
+    parser.add_argument("--sparsity", type=float, default=0.5)
+    args = parser.parse_args()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.rand(512, 16).astype(np.float32)
+    w_true = rng.normal(0, 1, (16, 4))
+    y = (x @ w_true).argmax(1).astype(np.float32)
+
+    net = nn.Sequential()
+    net.add(nn.Dense(48, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # phase 1: dense
+    train(net, trainer, loss_fn, x, y, args.epochs)
+    acc_dense = accuracy(net, x, y)
+
+    # phase 2: prune smallest |w| per weight matrix, retrain sparse
+    masks = {}
+    for name, p in net.collect_params().items():
+        if name.endswith("weight"):
+            w = p.data().asnumpy()
+            thresh = np.quantile(np.abs(w), args.sparsity)
+            m = mx.nd.array((np.abs(w) > thresh).astype(np.float32))
+            masks[p] = m
+            p.set_data(p.data() * m)
+    train(net, trainer, loss_fn, x, y, args.epochs, masks=masks)
+    acc_sparse = accuracy(net, x, y)
+    kept = float(np.mean([m.asnumpy().mean() for m in masks.values()]))
+
+    # phase 3: re-dense (drop masks, lower lr)
+    trainer.set_learning_rate(1e-3)
+    train(net, trainer, loss_fn, x, y, args.epochs)
+    acc_final = accuracy(net, x, y)
+
+    print(f"dense {acc_dense:.3f} -> sparse({1-kept:.0%} pruned) "
+          f"{acc_sparse:.3f} -> re-dense {acc_final:.3f}")
+    assert acc_sparse > 0.8          # pruned net still works
+    assert acc_final >= max(0.9, acc_dense - 0.02)
+
+
+if __name__ == "__main__":
+    main()

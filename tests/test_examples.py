@@ -40,6 +40,11 @@ _CASES = [
     ("neural-style/neural_style_toy.py", []),
     ("dec/dec_toy.py", []),
     ("speech/speech_gru_acoustic.py", ["--epochs", "10"]),
+    ("bayesian-methods/sgld_regression.py", ["--iters", "6000"]),
+    ("dsd/dsd_training.py", []),
+    ("sparse/linear_classification.py", []),
+    ("rcnn/proposal_demo.py", []),
+    ("memcost/inception_memcost.py", ["--batch-size", "1024"]),
     ("ssd/multibox_toy.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
