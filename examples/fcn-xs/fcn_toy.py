@@ -1,0 +1,97 @@
+"""Fully-convolutional semantic segmentation, miniature.
+
+Reference analogue: example/fcn-xs/ — per-pixel classification with a
+conv trunk, deconvolution upsampling, and the multi_output SoftmaxOutput
+(one softmax per pixel). Synthetic task: segment bright blobs from
+background; asserts per-pixel accuracy and that the multi_output loss
+path (class axis 1) trains.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_batch(rng, n, size):
+    imgs = np.zeros((n, 1, size, size), np.float32)
+    masks = np.zeros((n, size, size), np.float32)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    for i in range(n):
+        cx, cy = rng.uniform(6, size - 6, 2)
+        r = rng.uniform(3, 5)
+        blob = ((xx - cx) ** 2 + (yy - cy) ** 2) < r ** 2
+        imgs[i, 0][blob] = 1.0
+        masks[i][blob] = 1.0
+    imgs += rng.normal(0, 0.3, imgs.shape)
+    return imgs.astype(np.float32), masks
+
+
+def build():
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    h = mx.sym.Activation(
+        mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                           name="c1"), act_type="relu")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.Activation(
+        mx.sym.Convolution(h, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                           name="c2"), act_type="relu")
+    # fcn upsampling back to full resolution
+    h = mx.sym.Deconvolution(h, num_filter=8, kernel=(4, 4), stride=(2, 2),
+                             pad=(1, 1), name="up")
+    h = mx.sym.Activation(h, act_type="relu")
+    score = mx.sym.Convolution(h, num_filter=2, kernel=(1, 1), name="score")
+    return mx.sym.SoftmaxOutput(score, label, multi_output=True,
+                                name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--iters", type=int, default=120)
+    args = parser.parse_args()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    size, bs = 24, 16
+
+    net = build()
+    ex = net.simple_bind(mx.cpu(), grad_req="write",
+                         data=(bs, 1, size, size),
+                         softmax_label=(bs, size, size))
+    ri = np.random.RandomState(42)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = mx.nd.array(
+                ri.normal(0, 0.1, arr.shape).astype(np.float32))
+    opt = mx.optimizer.Adam(learning_rate=5e-3)
+    states = {n: opt.create_state(i, ex.arg_dict[n])
+              for i, n in enumerate(ex.arg_dict)
+              if n not in ("data", "softmax_label")}
+
+    for it in range(args.iters):
+        imgs, masks = make_batch(rng, bs, size)
+        ex.arg_dict["data"][:] = mx.nd.array(imgs)
+        ex.arg_dict["softmax_label"][:] = mx.nd.array(masks)
+        ex.forward(is_train=True)
+        ex.backward()
+        for i, (name, arr) in enumerate(ex.arg_dict.items()):
+            if name in ("data", "softmax_label"):
+                continue
+            opt.update(i, arr, ex.grad_dict[name], states[name])
+
+    imgs, masks = make_batch(rng, bs, size)
+    ex.arg_dict["data"][:] = mx.nd.array(imgs)
+    prob = ex.forward(is_train=False)[0].asnumpy()  # (N, 2, H, W)
+    pred = prob.argmax(1)
+    acc = (pred == masks).mean()
+    iou = ((pred == 1) & (masks == 1)).sum() / max(
+        ((pred == 1) | (masks == 1)).sum(), 1)
+    print(f"pixel accuracy {acc:.3f}, blob IoU {iou:.3f}")
+    assert acc > 0.95
+    assert iou > 0.5
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,7 @@ _CASES = [
     ("sparse/linear_classification.py", []),
     ("rcnn/proposal_demo.py", []),
     ("memcost/inception_memcost.py", ["--batch-size", "1024"]),
+    ("fcn-xs/fcn_toy.py", []),
     ("ssd/multibox_toy.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
