@@ -1,0 +1,208 @@
+"""Cross-check core NN op numerics (forward AND gradients) against torch.
+
+Reference analogue: the CPU<->GPU check_consistency tier (SURVEY.md §4) —
+two independent implementations of the same math compared bit-for-bit-ish.
+Here the second implementation is pytorch (cpu): same inputs through our
+op + tape backward vs torch.nn.functional + torch.autograd.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+RTOL, ATOL = 2e-4, 1e-5
+
+
+def _grad_pair(mx_fn, torch_fn, np_inputs):
+    """Run both frameworks: returns (mx_out, torch_out, mx_grads,
+    torch_grads) with upstream cotangent = ones."""
+    mx_in = [mx.nd.array(a) for a in np_inputs]
+    for x in mx_in:
+        x.attach_grad()
+    with mx.autograd.record():
+        out = mx_fn(*mx_in)
+    out.backward()
+    t_in = [torch.from_numpy(a.copy()).requires_grad_(True)
+            for a in np_inputs]
+    t_out = torch_fn(*t_in)
+    t_out.backward(torch.ones_like(t_out))
+    return (out.asnumpy(), t_out.detach().numpy(),
+            [x.grad.asnumpy() for x in mx_in],
+            [t.grad.numpy() for t in t_in])
+
+
+def _check(mx_fn, torch_fn, np_inputs):
+    o, to, g, tg = _grad_pair(mx_fn, torch_fn, np_inputs)
+    np.testing.assert_allclose(o, to, rtol=RTOL, atol=ATOL)
+    for a, b in zip(g, tg):
+        np.testing.assert_allclose(a, b, rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("stride,pad,dilate,groups", [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 1), (2, 2), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+])
+def test_convolution_vs_torch(stride, pad, dilate, groups):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 10, 10).astype(np.float32)
+    w = rng.randn(6, 4 // groups, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+
+    _check(
+        lambda xx, ww, bb: mx.nd.Convolution(
+            xx, ww, bb, num_filter=6, kernel=(3, 3), stride=stride,
+            pad=pad, dilate=dilate, num_group=groups),
+        lambda xx, ww, bb: F.conv2d(xx, ww, bb, stride=stride,
+                                    padding=pad, dilation=dilate,
+                                    groups=groups),
+        [x, w, b])
+
+
+def test_deconvolution_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    w = rng.randn(4, 5, 4, 4).astype(np.float32)
+
+    _check(
+        lambda xx, ww: mx.nd.Deconvolution(
+            xx, ww, num_filter=5, kernel=(4, 4), stride=(2, 2),
+            pad=(1, 1), no_bias=True),
+        lambda xx, ww: F.conv_transpose2d(xx, ww, stride=2, padding=1),
+        [x, w])
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pooling_vs_torch(pool_type):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+
+    def t_pool(xx):
+        if pool_type == "max":
+            return F.max_pool2d(xx, 2, 2)
+        return F.avg_pool2d(xx, 2, 2)
+
+    _check(
+        lambda xx: mx.nd.Pooling(xx, kernel=(2, 2), stride=(2, 2),
+                                 pool_type=pool_type),
+        t_pool, [x])
+
+
+def test_fully_connected_vs_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(5, 8).astype(np.float32)
+    w = rng.randn(3, 8).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    _check(
+        lambda xx, ww, bb: mx.nd.FullyConnected(xx, ww, bb, num_hidden=3),
+        lambda xx, ww, bb: F.linear(xx, ww, bb),
+        [x, w, b])
+
+
+def test_batchnorm_train_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 3, 6, 6).astype(np.float32)
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+
+    def mx_bn(xx, gg, bb):
+        return mx.nd.BatchNorm(xx, gg, bb, mx.nd.zeros((3,)),
+                               mx.nd.ones((3,)), fix_gamma=False,
+                               eps=1e-5)
+
+    def t_bn(xx, gg, bb):
+        return F.batch_norm(xx, torch.zeros(3), torch.ones(3), gg, bb,
+                            training=True, eps=1e-5)
+
+    mx_in = [mx.nd.array(a) for a in (x, gamma, beta)]
+    for v in mx_in:
+        v.attach_grad()
+    with mx.autograd.record():
+        out = mx_bn(*mx_in)
+    out.backward()
+    t_in = [torch.from_numpy(a.copy()).requires_grad_(True)
+            for a in (x, gamma, beta)]
+    t_out = t_bn(*t_in)
+    t_out.backward(torch.ones_like(t_out))
+    np.testing.assert_allclose(out.asnumpy(), t_out.detach().numpy(),
+                               rtol=1e-3, atol=1e-4)
+    for a, b in zip(mx_in, t_in):
+        np.testing.assert_allclose(a.grad.asnumpy(), b.grad.numpy(),
+                                   rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("act,tfn", [
+    ("relu", F.relu),
+    ("sigmoid", torch.sigmoid),
+    ("tanh", torch.tanh),
+    ("softrelu", F.softplus),
+])
+def test_activation_vs_torch(act, tfn):
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 7).astype(np.float32)
+    _check(lambda xx: mx.nd.Activation(xx, act_type=act), tfn, [x])
+
+
+def test_softmax_logsoftmax_vs_torch():
+    rng = np.random.RandomState(6)
+    x = rng.randn(4, 9).astype(np.float32)
+    _check(lambda xx: mx.nd.softmax(xx, axis=-1),
+           lambda xx: F.softmax(xx, dim=-1), [x])
+    _check(lambda xx: mx.nd.log_softmax(xx, axis=-1),
+           lambda xx: F.log_softmax(xx, dim=-1), [x])
+
+
+def test_lrn_vs_torch():
+    rng = np.random.RandomState(7)
+    x = rng.rand(2, 8, 5, 5).astype(np.float32)
+    _check(
+        lambda xx: mx.nd.LRN(xx, nsize=5, alpha=1e-3, beta=0.75, knorm=2),
+        lambda xx: F.local_response_norm(xx, 5, alpha=1e-3, beta=0.75,
+                                         k=2.0),
+        [x])
+
+
+def test_embedding_vs_torch():
+    rng = np.random.RandomState(8)
+    idx = rng.randint(0, 10, (4, 6)).astype(np.float32)
+    w = rng.randn(10, 5).astype(np.float32)
+
+    mx_w = mx.nd.array(w)
+    mx_w.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.Embedding(mx.nd.array(idx), mx_w, input_dim=10,
+                              output_dim=5)
+    out.backward()
+    t_w = torch.from_numpy(w.copy()).requires_grad_(True)
+    t_out = F.embedding(torch.from_numpy(idx.astype(np.int64)), t_w)
+    t_out.backward(torch.ones_like(t_out))
+    np.testing.assert_allclose(out.asnumpy(), t_out.detach().numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(mx_w.grad.asnumpy(), t_w.grad.numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_rnn_lstm_vs_torch():
+    rng = np.random.RandomState(9)
+    T, N, I, H = 5, 3, 4, 6
+    x = rng.randn(T, N, I).astype(np.float32)
+
+    t_lstm = torch.nn.LSTM(I, H, num_layers=1)
+    flat = []
+    # torch params: w_ih (4H, I), w_hh (4H, H), b_ih, b_hh — our fused RNN
+    # op takes the same concatenation order (i, f, g?) — mxnet gate order
+    # is i, f, g, o; torch is i, f, g, o as well
+    for p in t_lstm.parameters():
+        flat.append(p.detach().numpy().ravel())
+    params = np.concatenate(flat)
+
+    out = mx.nd.RNN(mx.nd.array(x), mx.nd.array(params),
+                    mx.nd.zeros((1, N, H)), mx.nd.zeros((1, N, H)),
+                    state_size=H, num_layers=1, mode="lstm")
+    t_out, _ = t_lstm(torch.from_numpy(x.copy()))
+    np.testing.assert_allclose(out.asnumpy(), t_out.detach().numpy(),
+                               rtol=1e-3, atol=1e-4)
