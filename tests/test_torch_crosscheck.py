@@ -206,3 +206,63 @@ def test_rnn_lstm_vs_torch():
     t_out, _ = t_lstm(torch.from_numpy(x.copy()))
     np.testing.assert_allclose(out.asnumpy(), t_out.detach().numpy(),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_bilinear_sampler_vs_torch_grid_sample():
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    # mxnet BilinearSampler grid: (N, 2, H, W) in [-1, 1] (x, y);
+    # torch grid_sample grid: (N, H, W, 2), align_corners=True matches
+    grid = rng.uniform(-0.9, 0.9, (2, 2, 5, 5)).astype(np.float32)
+
+    mx_x = mx.nd.array(x)
+    mx_x.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.BilinearSampler(mx_x, mx.nd.array(grid))
+    out.backward()
+
+    t_x = torch.from_numpy(x.copy()).requires_grad_(True)
+    t_grid = torch.from_numpy(np.transpose(grid, (0, 2, 3, 1)).copy())
+    t_out = F.grid_sample(t_x, t_grid, mode="bilinear",
+                          padding_mode="zeros", align_corners=True)
+    t_out.backward(torch.ones_like(t_out))
+    np.testing.assert_allclose(out.asnumpy(), t_out.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mx_x.grad.asnumpy(), t_x.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_grid_generator_plus_sampler_identity():
+    # GridGenerator(affine identity) + BilinearSampler == identity warp,
+    # cross-checked against torch affine_grid + grid_sample
+    rng = np.random.RandomState(11)
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+
+    grid = mx.nd.GridGenerator(mx.nd.array(theta),
+                               transform_type="affine",
+                               target_shape=(7, 7))
+    out = mx.nd.BilinearSampler(mx.nd.array(x), grid)
+
+    t_theta = torch.from_numpy(theta.reshape(2, 2, 3).copy())
+    t_grid = F.affine_grid(t_theta, (2, 3, 7, 7), align_corners=True)
+    t_out = F.grid_sample(torch.from_numpy(x.copy()), t_grid,
+                          align_corners=True)
+    np.testing.assert_allclose(out.asnumpy(), t_out.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_ctc_loss_vs_torch():
+    rng = np.random.RandomState(12)
+    T, N, C, L = 10, 4, 6, 3
+    acts = rng.randn(T, N, C).astype(np.float32)
+    labels = rng.randint(1, C, (N, L)).astype(np.float32)
+
+    ours = mx.nd.ctc_loss(mx.nd.array(acts), mx.nd.array(labels))
+    t = F.ctc_loss(torch.from_numpy(acts.copy()).log_softmax(-1),
+                   torch.from_numpy(labels.astype(np.int64)),
+                   torch.full((N,), T, dtype=torch.long),
+                   torch.full((N,), L, dtype=torch.long),
+                   blank=0, reduction="none")
+    np.testing.assert_allclose(ours.asnumpy(), t.numpy(), rtol=1e-4,
+                               atol=1e-4)
