@@ -260,3 +260,48 @@ def test_group2ctx_survives_json_roundtrip():
         a[:] = mx.nd.array(rng.normal(0, 0.1, a.shape).astype(np.float32))
     out = ex.forward(is_train=False)[0]
     assert out._data.device == g2c["stage2"].jax_device
+
+
+def test_spmd_trainer_sharded_checkpoint_exact_resume(tmp_path):
+    # SURVEY §5.4 TPU equivalent: orbax-style sharded pytree checkpoints;
+    # resume must be EXACT (params + momentum + update counter + rng)
+    import jax
+
+    def make_trainer():
+        mesh = make_mesh({"data": 2, "model": 2},
+                         devices=jax.devices()[:4])
+        sym = models.get_symbol("mlp")
+        tr = SPMDTrainer(sym, optimizer="sgd",
+                         optimizer_params=dict(learning_rate=0.1,
+                                               momentum=0.9),
+                         mesh=mesh)
+        tr.bind(data_shapes={"data": (16, 784)},
+                label_shapes={"softmax_label": (16,)})
+        return tr
+
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.rand(16, 784).astype(np.float32),
+             "softmax_label": rng.randint(0, 10, 16).astype(np.float32)}
+
+    tr = make_trainer()
+    for _ in range(3):
+        tr.step(batch)
+    tr.save_checkpoint(str(tmp_path), step=3)
+    for _ in range(2):
+        tr.step(batch)
+    ref_params, ref_aux = tr.get_params()
+
+    tr2 = make_trainer()
+    tr2.restore_checkpoint(str(tmp_path), step=3)
+    assert tr2._num_update == 3
+    for _ in range(2):
+        tr2.step(batch)
+    new_params, new_aux = tr2.get_params()
+    for n in ref_params:
+        np.testing.assert_allclose(ref_params[n].asnumpy(),
+                                   new_params[n].asnumpy(),
+                                   rtol=1e-6, atol=1e-7)
+    for n in ref_aux:
+        np.testing.assert_allclose(ref_aux[n].asnumpy(),
+                                   new_aux[n].asnumpy(),
+                                   rtol=1e-6, atol=1e-7)
