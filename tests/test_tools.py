@@ -261,3 +261,42 @@ layer {
     ex.arg_dict["data"][:] = mx.nd.ones((1, 3, 8, 8))
     out = ex.forward()[0]
     assert out.shape[2:] == (1, 1)       # global pooling honored
+
+
+def test_accnn_conv_vh_decomposition():
+    # reference tools/accnn/acc_conv.py: full-rank V-H split preserves the
+    # conv exactly; reduced rank approximates it
+    accnn = _load(os.path.join(ROOT, "tools", "accnn", "acc_conv.py"),
+                  "acc_conv")
+    rng = np.random.RandomState(0)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.Flatten(mx.sym.Activation(
+            mx.sym.Convolution(mx.sym.var("data"), num_filter=6,
+                               kernel=(3, 3), pad=(1, 1), name="conv1"),
+            act_type="relu")),
+        name="softmax")
+    shapes = net.infer_shape(data=(2, 3, 8, 8), softmax_label=(2,))[0]
+    args = {n: mx.nd.array(rng.normal(0, 0.3, s).astype(np.float32))
+            for n, s in zip(net.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+
+    def run(sym, params):
+        ex = sym.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 8, 8),
+                             softmax_label=(2,))
+        ex.copy_params_from(params)
+        ex.arg_dict["data"][:] = mx.nd.array(x)
+        return ex.forward(is_train=False)[0].asnumpy()
+
+    base = run(net, args)
+    # full rank (min(C*ky, N*kx) = 9): exact
+    sym_f, args_f = accnn.conv_vh_decomposition(net, args, "conv1", 9)
+    assert "conv1_v_weight" in sym_f.list_arguments()
+    assert "conv1_weight" not in sym_f.list_arguments()
+    np.testing.assert_allclose(run(sym_f, args_f), base, rtol=1e-4,
+                               atol=1e-5)
+    # reduced rank: still close on a smooth input
+    sym_r, args_r = accnn.conv_vh_decomposition(net, args, "conv1", 5)
+    assert args_r["conv1_v_weight"].shape == (5, 3, 3, 1)
+    assert args_r["conv1_h_weight"].shape == (6, 5, 1, 3)
+    np.testing.assert_allclose(run(sym_r, args_r), base, atol=0.2)

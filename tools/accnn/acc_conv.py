@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Accelerate a convolution by vertical/horizontal low-rank decomposition.
+
+Reference analogue: tools/accnn/acc_conv.py (Jaderberg et al. 2014) —
+SVD-split a k_y x k_x convolution ``W (N, C, ky, kx)`` into a vertical
+conv ``V (K, C, ky, 1)`` followed by a horizontal conv ``H (N, K, 1,
+kx)``, cutting FLOPs from N*C*ky*kx to K*(C*ky + N*kx) per output pixel.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import sys
+
+import numpy as np
+
+try:
+    from .graph_edit import node_attrs, splice_replace
+except ImportError:  # CLI / by-path execution
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+    from graph_edit import node_attrs, splice_replace
+
+
+def conv_vh_decompose_params(weight, rank):
+    """W (N,C,ky,kx) -> V (K,C,ky,1), H (N,K,1,kx)."""
+    w = np.asarray(weight, np.float32)
+    n, c, ky, kx = w.shape
+    m = w.transpose(1, 2, 0, 3).reshape(c * ky, n * kx)
+    u, s, q = np.linalg.svd(m, full_matrices=False)
+    rank = int(min(rank, len(s)))
+    sqrt_s = np.sqrt(s[:rank])
+    v = (u[:, :rank] * sqrt_s)            # (C*ky, K)
+    h = (q[:rank].T * sqrt_s)             # (N*kx, K)
+    v = v.T.reshape(rank, c, ky, 1).astype(np.float32)
+    h = h.reshape(n, kx, 1, rank).transpose(0, 3, 2, 1).astype(np.float32)
+    return v, h
+
+
+def conv_vh_decomposition(sym, arg_params, layer, rank):
+    """Returns (new_symbol, new_arg_params) with ``layer`` split into
+    ``layer_v`` + ``layer_h``."""
+    import mxnet_tpu as mx
+
+    w = arg_params[f"{layer}_weight"].asnumpy()
+    n, c, ky, kx = w.shape
+    v, h = conv_vh_decompose_params(w, rank)
+    rank = v.shape[0]
+
+    def make_nodes(node, data_in, base):
+        attrs = node_attrs(node)
+
+        def tup(key, default):
+            v = ast.literal_eval(str(attrs.get(key, default)))
+            return v if v else default  # "()" serializes the op default
+
+        kernel = ast.literal_eval(str(attrs.get("kernel")))
+        pad = tup("pad", (0, 0))
+        stride = tup("stride", (1, 1))
+        no_bias = str(attrs.get("no_bias", "False")).lower() in ("true",
+                                                                 "1")
+        nodes = [
+            {"op": "null", "name": f"{layer}_v_weight", "inputs": []},
+            {"op": "Convolution", "name": f"{layer}_v",
+             "attrs": {"num_filter": str(rank),
+                       "kernel": str((kernel[0], 1)),
+                       "pad": str((pad[0], 0)),
+                       "stride": str((stride[0], 1)),
+                       "no_bias": "True"},
+             "inputs": [data_in, [base, 0, 0]]},
+            {"op": "null", "name": f"{layer}_h_weight", "inputs": []},
+        ]
+        h_inputs = [[base + 1, 0, 0], [base + 2, 0, 0]]
+        if not no_bias:
+            nodes.append({"op": "null", "name": f"{layer}_h_bias",
+                          "inputs": []})
+            h_inputs.append([base + 3, 0, 0])
+        nodes.append({"op": "Convolution", "name": f"{layer}_h",
+                      "attrs": {"num_filter": str(n),
+                                "kernel": str((1, kernel[1])),
+                                "pad": str((0, pad[1])),
+                                "stride": str((1, stride[1])),
+                                "no_bias": str(no_bias)},
+                      "inputs": h_inputs})
+        return nodes
+
+    new_sym = splice_replace(sym, layer, "Convolution", make_nodes)
+    new_args = {k: p for k, p in arg_params.items()
+                if not k.startswith(f"{layer}_")}
+    new_args[f"{layer}_v_weight"] = mx.nd.array(v)
+    new_args[f"{layer}_h_weight"] = mx.nd.array(h)
+    if f"{layer}_bias" in arg_params:
+        new_args[f"{layer}_h_bias"] = arg_params[f"{layer}_bias"]
+    return new_sym, new_args
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="V-H decompose a Convolution layer of a checkpoint")
+    parser.add_argument("prefix")
+    parser.add_argument("epoch", type=int)
+    parser.add_argument("--layer", required=True)
+    parser.add_argument("-K", type=int, required=True)
+    parser.add_argument("--out-prefix", default=None)
+    args = parser.parse_args()
+
+    import mxnet_tpu as mx
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.prefix, args.epoch)
+    new_sym, new_args = conv_vh_decomposition(sym, arg_params, args.layer,
+                                              args.K)
+    out = args.out_prefix or (args.prefix + "_acc")
+    mx.model.save_checkpoint(out, args.epoch, new_sym, new_args,
+                             aux_params)
+    print(f"wrote {out}-symbol.json / {out}-{args.epoch:04d}.params")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Shared graph-splice machinery for the accnn decomposition tools.
+
+Replaces one node of a symbol's JSON graph with a chain of new nodes,
+keeping the node list topologically ordered (the JSON loader is
+single-pass) and remapping all downstream references.
+"""
+from __future__ import annotations
+
+import json
+
+
+def splice_replace(sym, layer_name, op_name, make_nodes):
+    """Replace node ``layer_name`` (op ``op_name``) in ``sym``'s graph.
+
+    ``make_nodes(node, data_in, base)`` receives the old node dict, its
+    first input reference, and the index the first inserted node will
+    get; it returns the replacement node list (last node = new output).
+    Returns the new Symbol.
+    """
+    import mxnet_tpu as mx
+
+    graph = json.loads(sym.tojson())
+    nodes = graph["nodes"]
+    target = None
+    for i, node in enumerate(nodes):
+        if node.get("op") == op_name and node["name"] == layer_name:
+            target = i
+            break
+    if target is None:
+        raise ValueError(f"no {op_name} node named {layer_name!r}")
+    node = nodes[target]
+
+    inserted = make_nodes(node, list(node["inputs"][0]), target)
+    rec_id = target + len(inserted) - 1
+    shift = len(inserted) - 1
+
+    def remap(i):
+        if i < target:
+            return i
+        if i == target:
+            return rec_id
+        return i + shift
+
+    tail = nodes[target + 1:]
+    for other in tail:
+        for inp in other.get("inputs", []):
+            inp[0] = remap(inp[0])
+    graph["nodes"] = nodes[:target] + inserted + tail
+    for head in graph["heads"]:
+        head[0] = remap(head[0])
+    graph.pop("arg_nodes", None)
+    graph.pop("node_row_ptr", None)
+    return mx.sym.load_json(json.dumps(graph))
+
+
+def node_attrs(node):
+    return node.get("attrs") or node.get("param") or {}
