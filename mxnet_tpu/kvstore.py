@@ -47,6 +47,11 @@ class KVStore:
                 raise MXNetError(f"key {k} already initialized")
             v0 = v[0] if isinstance(v, list) else v
             self._store[k] = v0.copy()
+            # Error-feedback state must start fresh with the key: a stale
+            # residual from a prior run of this key would be silently
+            # added to its first compressed push.
+            for rk in [r for r in self._residuals if r[0] == k]:
+                del self._residuals[rk]
 
     def set_gradient_compression(self, compression_params):
         """Enable gradient compression on pushes (2-bit sign-threshold

@@ -16,6 +16,9 @@ both passes to the embedded runtime.
 """
 from __future__ import annotations
 
+import ast
+import threading
+
 import numpy as np
 
 import jax
@@ -35,25 +38,113 @@ def _torch():
 
 
 _MODULE_CACHE = {}
+# One module instance is shared per spec string; param-load + forward must
+# be atomic or two nodes with the same spec can interleave and silently
+# produce wrong outputs (host callbacks may run concurrently).
+_TORCH_LOCK = threading.RLock()
+
+
+def _resolve_ctor(node, torch, spec):
+    """Resolve an AST callee to a public callable under torch.nn
+    (accepts the ``nn.`` / ``torch.nn.`` / ``F.`` spellings only)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        raise MXNetError(
+            f"TorchModule: unsupported callee in {spec!r}")
+    parts.append(node.id)
+    parts.reverse()
+    if parts[0] == "nn":
+        obj, path = torch.nn, parts[1:]
+    elif parts[0] == "F":
+        obj, path = torch.nn.functional, parts[1:]
+    elif parts[0] == "torch" and len(parts) >= 2 and parts[1] == "nn":
+        obj, path = torch.nn, parts[2:]
+    else:
+        raise MXNetError(
+            f"TorchModule: {'.'.join(parts)!r} is outside the allowed "
+            f"torch.nn namespace (spec {spec!r})")
+    import types
+    for p in path:
+        if p.startswith("_"):
+            raise MXNetError(
+                f"TorchModule: private attribute {p!r} not allowed "
+                f"in {spec!r}")
+        obj = getattr(obj, p)
+        # torch.nn submodules publicly re-export the whole torch module
+        # (e.g. F.torch, nn.functional.torch) — refuse any module hop
+        # that leaves the torch.nn tree, or the spec reaches torch.load/
+        # torch.hub with literal args.
+        if isinstance(obj, types.ModuleType) and not (
+                obj.__name__ == "torch.nn"
+                or obj.__name__.startswith("torch.nn.")):
+            raise MXNetError(
+                f"TorchModule: module {obj.__name__!r} is outside "
+                f"torch.nn (spec {spec!r})")
+    mod_name = getattr(obj, "__module__", "") or ""
+    if not isinstance(obj, types.ModuleType) and not (
+            mod_name == "torch.nn" or mod_name.startswith("torch.nn.")):
+        raise MXNetError(
+            f"TorchModule: {mod_name!r}.{getattr(obj, '__name__', obj)!r} "
+            f"is not defined under torch.nn (spec {spec!r})")
+    return obj
+
+
+def _construct(node, torch, spec):
+    """Evaluate a restricted constructor expression: nested calls to
+    public torch.nn names with literal (ast.literal_eval) arguments.
+
+    The reference executed ``lua_string`` against a sandboxed lua ``nn``
+    namespace (plugin/torch/torch_module-inl.h:75); a bare ``eval`` here
+    would instead hand checkpoint JSON arbitrary python (torch.load,
+    torch.hub, ...), so specs are parsed, not eval'ed."""
+    if isinstance(node, ast.Call):
+        fn = _resolve_ctor(node.func, torch, spec)
+        args = [_construct(a, torch, spec) for a in node.args]
+        kwargs = {k.arg: _construct(k.value, torch, spec)
+                  for k in node.keywords if k.arg is not None}
+        if len(kwargs) != len(node.keywords):
+            raise MXNetError(f"TorchModule: **kwargs not allowed in {spec!r}")
+        return fn(*args, **kwargs)
+    if isinstance(node, ast.Attribute):  # e.g. nn.ReLU passed uncalled
+        return _resolve_ctor(node, torch, spec)
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError) as e:
+        raise MXNetError(
+            f"TorchModule: only torch.nn constructor calls and literal "
+            f"arguments are allowed, got {ast.dump(node)} in {spec!r}") \
+            from e
 
 
 def _get_module(spec: str):
-    mod = _MODULE_CACHE.get(spec)
-    if mod is None:
+    with _TORCH_LOCK:
+        mod = _MODULE_CACHE.get(spec)
+        if mod is not None:
+            return mod
         torch = _torch()
-        ns = {"torch": torch, "nn": torch.nn, "F": torch.nn.functional}
         try:
-            mod = eval(spec, ns)  # the reference executes lua_string the
-            # same way against lua's nn (torch_module-inl.h:75)
+            tree = ast.parse(spec.strip(), mode="eval")
+            mod = _construct(tree.body, torch, spec)
+        except MXNetError:
+            raise
         except Exception as e:
             raise MXNetError(f"TorchModule: cannot construct {spec!r}: {e}")
         if not isinstance(mod, torch.nn.Module):
             raise MXNetError(
                 f"TorchModule: {spec!r} did not evaluate to a torch.nn."
                 f"Module (got {type(mod)})")
-        mod = mod.to(torch.float32).cpu()
+        # eval() permanently: the backward pass re-runs the forward, so
+        # stochastic layers (Dropout) would otherwise draw a fresh mask
+        # and return the gradient of a different function than the one
+        # whose outputs were used, and BatchNorm would update running
+        # stats twice per step. Deterministic eval-mode keeps fwd/bwd
+        # consistent and the cached module stateless across graphs.
+        mod = mod.to(torch.float32).cpu().eval()
         _MODULE_CACHE[spec] = mod
-    return mod
+        return mod
 
 
 def _load_params(mod, param_vals):
@@ -76,39 +167,41 @@ def _load_params(mod, param_vals):
 
 def _module_fwd_np(spec, num_data, inputs):
     torch = _torch()
-    mod = _get_module(spec)
-    data = inputs[:num_data]
-    _load_params(mod, inputs[num_data:])
-    with torch.no_grad():
-        outs = mod(*[torch.from_numpy(np.asarray(d, np.float32).copy())
-                     for d in data])
-    if isinstance(outs, (tuple, list)):
-        return tuple(o.detach().numpy() for o in outs)
-    return (outs.detach().numpy(),)
+    with _TORCH_LOCK:
+        mod = _get_module(spec)
+        data = inputs[:num_data]
+        _load_params(mod, inputs[num_data:])
+        with torch.no_grad():
+            outs = mod(*[torch.from_numpy(np.asarray(d, np.float32).copy())
+                         for d in data])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o.detach().numpy() for o in outs)
+        return (outs.detach().numpy(),)
 
 
 def _module_bwd_np(spec, num_data, inputs, cotangents):
     """Torch-autograd VJP: returns grads for data then params."""
     torch = _torch()
-    mod = _get_module(spec)
-    data = [torch.from_numpy(np.asarray(d, np.float32).copy())
-            .requires_grad_(True) for d in inputs[:num_data]]
-    _load_params(mod, inputs[num_data:])
-    params = list(mod.parameters())
-    for p in params:
-        p.requires_grad_(True)
-        if p.grad is not None:
-            p.grad = None
-    outs = mod(*data)
-    if not isinstance(outs, (tuple, list)):
-        outs = (outs,)
-    torch.autograd.backward(
-        list(outs),
-        [torch.from_numpy(np.asarray(c, np.float32).copy())
-         for c in cotangents])
-    grads = [d.grad for d in data] + [p.grad for p in params]
-    return tuple(np.zeros_like(np.asarray(i, np.float32)) if g is None
-                 else g.detach().numpy() for g, i in zip(grads, inputs))
+    with _TORCH_LOCK:
+        mod = _get_module(spec)
+        data = [torch.from_numpy(np.asarray(d, np.float32).copy())
+                .requires_grad_(True) for d in inputs[:num_data]]
+        _load_params(mod, inputs[num_data:])
+        params = list(mod.parameters())
+        for p in params:
+            p.requires_grad_(True)
+            if p.grad is not None:
+                p.grad = None
+        outs = mod(*data)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        torch.autograd.backward(
+            list(outs),
+            [torch.from_numpy(np.asarray(c, np.float32).copy())
+             for c in cotangents])
+        grads = [d.grad for d in data] + [p.grad for p in params]
+        return tuple(np.zeros_like(np.asarray(i, np.float32)) if g is None
+                     else g.detach().numpy() for g, i in zip(grads, inputs))
 
 
 def _out_struct(spec, num_data, num_outputs, in_shapes):
@@ -188,22 +281,26 @@ def _torch_module(*inputs, lua_string, num_data=1, num_params=0,
 
 def _criterion_fwd_np(spec, data, label):
     torch = _torch()
-    crit = _get_module(spec)
-    with torch.no_grad():
-        loss = crit(torch.from_numpy(np.asarray(data, np.float32).copy()),
-                    torch.from_numpy(np.asarray(label, np.float32).copy()))
-    return np.asarray(loss.detach().numpy(), np.float32).reshape(1)
+    with _TORCH_LOCK:
+        crit = _get_module(spec)
+        with torch.no_grad():
+            loss = crit(
+                torch.from_numpy(np.asarray(data, np.float32).copy()),
+                torch.from_numpy(np.asarray(label, np.float32).copy()))
+        return np.asarray(loss.detach().numpy(), np.float32).reshape(1)
 
 
 def _criterion_bwd_np(spec, data, label, grad_scale):
     torch = _torch()
-    crit = _get_module(spec)
-    d = torch.from_numpy(np.asarray(data, np.float32).copy())
-    d.requires_grad_(True)
-    loss = crit(d, torch.from_numpy(np.asarray(label, np.float32).copy()))
-    loss.backward()
-    return (d.grad.detach().numpy() * np.float32(grad_scale),
-            np.zeros_like(np.asarray(label, np.float32)))
+    with _TORCH_LOCK:
+        crit = _get_module(spec)
+        d = torch.from_numpy(np.asarray(data, np.float32).copy())
+        d.requires_grad_(True)
+        loss = crit(d, torch.from_numpy(
+            np.asarray(label, np.float32).copy()))
+        loss.backward()
+        return (d.grad.detach().numpy() * np.float32(grad_scale),
+                np.zeros_like(np.asarray(label, np.float32)))
 
 
 def _torch_criterion_grad(attrs, rng, input_vals, out_vals, out_cts):
@@ -211,7 +308,12 @@ def _torch_criterion_grad(attrs, rng, input_vals, out_vals, out_cts):
                                np.asarray(input_vals[0]),
                                np.asarray(input_vals[1]),
                                attrs["grad_scale"])
-    return jnp.asarray(gd), jnp.asarray(gl)
+    # Chain-rule: scale by the incoming head cotangent (shape (1,)) so
+    # e.g. grad of 2*loss is twice the torch gradient. The reference
+    # plugin ignored the head grad (loss-head convention); under a tape
+    # users expect vjp semantics.
+    ct = np.asarray(out_cts[0], np.float32).reshape(())
+    return jnp.asarray(gd) * ct, jnp.asarray(gl)
 
 
 @register("TorchCriterion", num_inputs=2, input_names=["data", "label"],
@@ -220,7 +322,8 @@ def _torch_criterion_grad(attrs, rng, input_vals, out_vals, out_cts):
 def _torch_criterion(data, label, lua_string, grad_scale=1.0):
     """Embed a torch criterion (plugin/torch/torch_criterion-inl.h):
     out = loss(data, label) as shape (1,); backward scales the torch
-    gradient by ``grad_scale`` and sends zero to the label."""
+    gradient by ``grad_scale`` times the incoming cotangent (chain rule)
+    and sends zero to the label."""
     traced = (isinstance(data, jax.core.Tracer)
               or isinstance(label, jax.core.Tracer))
     if not traced:
@@ -245,7 +348,8 @@ def _torch_criterion(data, label, lua_string, grad_scale=1.0):
         gd, gl = jax.pure_callback(
             lambda a, b: _criterion_bwd_np(lua_string, a, b, grad_scale),
             in_sds, d, l)
-        return gd, gl
+        ct = jnp.reshape(g, ())  # chain rule on the (1,)-shaped head
+        return gd * ct, gl
 
     run.defvjp(run_fwd, run_bwd)
     return run(data, label)

@@ -130,3 +130,33 @@ def test_torch_module_trains():
                                num_params=2, num_outputs=1)
     acc = (logits.asnumpy().argmax(1) == y).mean()
     assert acc > 0.9
+
+
+def test_torch_module_spec_is_sandboxed():
+    # lua_string comes from symbol JSON (untrusted checkpoints): only
+    # nested public torch.nn constructor calls with literal args may run.
+    import pytest
+    bad = [
+        "__import__('os').system('true')",
+        "torch.load('/tmp/x.pt')",
+        "nn.Linear.__init__.__globals__",
+        "torch.hub.load('x', 'y')",
+        "nn.Sequential(*[torch.load('x')])",
+        "(lambda: 1)()",
+        # escapes via torch.nn submodules re-exporting the torch module
+        "F.torch.load('/tmp/evil.pt')",
+        "nn.functional.torch.hub.load('a', 'b')",
+        "torch.nn.functional.torch.serialization.load('x')",
+    ]
+    for spec in bad:
+        with pytest.raises(mx.MXNetError):
+            mx.nd.TorchModule(mx.nd.zeros((1, 4)), lua_string=spec,
+                              num_data=1, num_params=0, num_outputs=1)
+    # the allowed grammar still covers nested containers + kwargs
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(2, 4).astype(np.float32))
+    out = mx.nd.TorchModule(
+        x, lua_string="nn.Sequential(nn.ReLU(), nn.Dropout(p=0.0))",
+        num_data=1, num_params=0, num_outputs=1)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.maximum(x.asnumpy(), 0), rtol=1e-6)
