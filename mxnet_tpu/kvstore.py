@@ -91,6 +91,10 @@ class KVStore:
         (reference: KVStoreLocal::Push + comm reduce, comm.h:90-434)."""
         keys, values = self._normalize(key, value)
         for k, vlist in zip(keys, values):
+            if k not in self._store:
+                # validate before compression so no error-feedback residual
+                # is ever recorded for an uninitialized key
+                raise MXNetError(f"key {k} not initialized")
             if not isinstance(vlist, list):
                 vlist = [vlist]
             if self._compression is not None and vlist and \
@@ -101,8 +105,6 @@ class KVStore:
             if len(vlist) > 1:
                 from .ndarray import add_n
                 agg = add_n(*vlist)
-            if k not in self._store:
-                raise MXNetError(f"key {k} not initialized")
             if "dist" in self.type and self.num_workers > 1:
                 # dist_sync: merge across every worker process before the
                 # update (reference: server-side MergeBuf across workers,

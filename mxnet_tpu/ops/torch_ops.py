@@ -42,6 +42,15 @@ _MODULE_CACHE = {}
 # be atomic or two nodes with the same spec can interleave and silently
 # produce wrong outputs (host callbacks may run concurrently).
 _TORCH_LOCK = threading.RLock()
+# Modules run in train() mode like the reference plugin (lua `training()`),
+# but the backward here *re-runs* the forward. To make the re-run compute
+# the gradient of the same function the forward evaluated (same dropout
+# masks), the forward snapshots the torch RNG state per spec and the
+# backward restores it; BatchNorm-style buffers are snapshotted around the
+# backward re-run so running stats advance exactly once per step. With two
+# live nodes sharing one spec in fwdA/fwdB/bwdB/bwdA order the replayed
+# RNG state is approximate (last forward wins).
+_FWD_RNG = {}
 
 
 def _resolve_ctor(node, torch, spec):
@@ -110,13 +119,30 @@ def _construct(node, torch, spec):
         return fn(*args, **kwargs)
     if isinstance(node, ast.Attribute):  # e.g. nn.ReLU passed uncalled
         return _resolve_ctor(node, torch, spec)
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv,
+                      ast.Pow, ast.Mod)):
+        # const-fold numeric arithmetic (the common `nn.Linear(28*28, 10)`)
+        lhs = _construct(node.left, torch, spec)
+        rhs = _construct(node.right, torch, spec)
+        if not (isinstance(lhs, (int, float)) and isinstance(rhs, (int, float))):
+            raise MXNetError(
+                f"TorchModule: arithmetic on non-numbers in {spec!r}")
+        if isinstance(node.op, ast.Pow) and abs(rhs) > 64:
+            raise MXNetError(
+                f"TorchModule: exponent too large in {spec!r}")
+        ops = {ast.Add: lambda a, b: a + b, ast.Sub: lambda a, b: a - b,
+               ast.Mult: lambda a, b: a * b, ast.Div: lambda a, b: a / b,
+               ast.FloorDiv: lambda a, b: a // b,
+               ast.Pow: lambda a, b: a ** b, ast.Mod: lambda a, b: a % b}
+        return ops[type(node.op)](lhs, rhs)
     try:
         return ast.literal_eval(node)
     except (ValueError, SyntaxError) as e:
         raise MXNetError(
-            f"TorchModule: only torch.nn constructor calls and literal "
-            f"arguments are allowed, got {ast.dump(node)} in {spec!r}") \
-            from e
+            f"TorchModule: only torch.nn constructor calls, literal "
+            f"arguments, and numeric arithmetic are allowed, "
+            f"got {ast.dump(node)} in {spec!r}") from e
 
 
 def _get_module(spec: str):
@@ -136,13 +162,7 @@ def _get_module(spec: str):
             raise MXNetError(
                 f"TorchModule: {spec!r} did not evaluate to a torch.nn."
                 f"Module (got {type(mod)})")
-        # eval() permanently: the backward pass re-runs the forward, so
-        # stochastic layers (Dropout) would otherwise draw a fresh mask
-        # and return the gradient of a different function than the one
-        # whose outputs were used, and BatchNorm would update running
-        # stats twice per step. Deterministic eval-mode keeps fwd/bwd
-        # consistent and the cached module stateless across graphs.
-        mod = mod.to(torch.float32).cpu().eval()
+        mod = mod.to(torch.float32).cpu()
         _MODULE_CACHE[spec] = mod
         return mod
 
@@ -168,9 +188,10 @@ def _load_params(mod, param_vals):
 def _module_fwd_np(spec, num_data, inputs):
     torch = _torch()
     with _TORCH_LOCK:
-        mod = _get_module(spec)
+        mod = _get_module(spec).train()
         data = inputs[:num_data]
         _load_params(mod, inputs[num_data:])
+        _FWD_RNG[spec] = torch.get_rng_state()
         with torch.no_grad():
             outs = mod(*[torch.from_numpy(np.asarray(d, np.float32).copy())
                          for d in data])
@@ -183,7 +204,7 @@ def _module_bwd_np(spec, num_data, inputs, cotangents):
     """Torch-autograd VJP: returns grads for data then params."""
     torch = _torch()
     with _TORCH_LOCK:
-        mod = _get_module(spec)
+        mod = _get_module(spec).train()
         data = [torch.from_numpy(np.asarray(d, np.float32).copy())
                 .requires_grad_(True) for d in inputs[:num_data]]
         _load_params(mod, inputs[num_data:])
@@ -192,6 +213,12 @@ def _module_bwd_np(spec, num_data, inputs, cotangents):
             p.requires_grad_(True)
             if p.grad is not None:
                 p.grad = None
+        # replay the matching forward exactly: same RNG (dropout masks),
+        # and undo the duplicate buffer update afterwards
+        saved_bufs = [b.detach().clone() for b in mod.buffers()]
+        rng_state = _FWD_RNG.get(spec)
+        if rng_state is not None:
+            torch.set_rng_state(rng_state)
         outs = mod(*data)
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
@@ -199,6 +226,9 @@ def _module_bwd_np(spec, num_data, inputs, cotangents):
             list(outs),
             [torch.from_numpy(np.asarray(c, np.float32).copy())
              for c in cotangents])
+        with torch.no_grad():
+            for b, s in zip(mod.buffers(), saved_bufs):
+                b.copy_(s)
         grads = [d.grad for d in data] + [p.grad for p in params]
         return tuple(np.zeros_like(np.asarray(i, np.float32)) if g is None
                      else g.detach().numpy() for g, i in zip(grads, inputs))

@@ -120,13 +120,25 @@ class SPMDTrainer:
     def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_names: Sequence[str] = ("data",),
                  label_names: Sequence[str] = ("softmax_label",),
-                 param_rules=None, dtype="float32", compute_dtype=None):
+                 param_rules=None, dtype="float32", compute_dtype=None,
+                 shard_optimizer_state=False):
         self._symbol = symbol
         self._mesh = mesh if mesh is not None else make_mesh()
         self._data_names = list(data_names)
         self._label_names = list(label_names)
         self._param_rules = param_rules or param_pspec
         self._dtype = dtype
+        # ZeRO-style update_on_kvstore analog (reference: the dist server
+        # runs the optimizer on its 1/num_servers key shard,
+        # kvstore_dist_server.h:175-186; SURVEY §5.8 psum_scatter):
+        # optimizer state is additionally sharded over the *data* axis, so
+        # each data-parallel device holds and updates only a 1/N slice.
+        # Under GSPMD this turns the gradient allreduce into a
+        # reduce_scatter feeding the sharded update, followed by an
+        # all_gather of the updated params — halving comm exactly like the
+        # reference's server-side update, and shrinking per-device
+        # optimizer-state memory ~N x.
+        self._shard_opt = bool(shard_optimizer_state)
         # mixed precision: master weights stay fp32, 2D+ weights are cast to
         # compute_dtype inside the step (reference analogue: mp_sgd_update's
         # fp32 master weights, optimizer_op.cc:114 — here the cast is traced
@@ -186,8 +198,35 @@ class SPMDTrainer:
                 host = arr.asnumpy()
             aux[name] = jax.device_put(host, NamedSharding(mesh, P()))
 
+        # optimizer-state sharding: param spec, plus (if enabled) the first
+        # mesh-divisible unsharded dim split over the data axis
+        def state_spec(name, shape):
+            base = self._param_rules(name, shape, mesh)
+            if not self._shard_opt:
+                return base
+            dsize = mesh.shape.get("data", 1)
+            if dsize <= 1 or not shape:
+                return base
+            entries = list(base) + [None] * (len(shape) - len(base))
+            used = {a for e in entries if e is not None
+                    for a in (e if isinstance(e, tuple) else (e,))}
+            if "data" in used:  # custom rule already spent the data axis
+                return base
+            for i, dim in enumerate(shape):
+                if entries[i] is None and dim % dsize == 0 and dim >= dsize:
+                    entries[i] = "data"
+                    return P(*entries)
+            return base
+
+        state_specs = {n: state_spec(n, shapes[n]) for n in param_names}
+        state_sh = {n: NamedSharding(mesh, state_specs[n])
+                    for n in param_names}
         init_state, update = _functional_update(self._optimizer)
-        states = {n: init_state(w) for n, w in params.items()}
+        states = {}
+        for n, w in params.items():
+            states[n] = jax.tree_util.tree_map(
+                lambda x, _sh=state_sh[n]: jax.device_put(x, _sh),
+                init_state(w))
         self.params, self.states, self.aux = params, states, aux
 
         # static per-param wd (lr multipliers fold into the dynamic lr input);
@@ -207,6 +246,7 @@ class SPMDTrainer:
 
         compute_dtype = (jnp.dtype(self._compute_dtype)
                          if self._compute_dtype else None)
+        shard_opt = self._shard_opt
 
         def step(params, states, aux, inputs, rng, lr, t):
             def loss_f(p):
@@ -225,18 +265,27 @@ class SPMDTrainer:
             (grads,) = vjp_fn((cts, zero_aux))
             new_params, new_states = {}, {}
             for n in params:
+                g = grads[n]
+                if shard_opt:
+                    # pin the grad to the state sharding: GSPMD then lowers
+                    # the batch-axis gradient reduction to a reduce_scatter
+                    # and each device runs the update on its 1/N slice only
+                    g = jax.lax.with_sharding_constraint(g, state_sh[n])
                 new_params[n], new_states[n] = update(
-                    params[n], grads[n], states[n],
+                    params[n], g, states[n],
                     lr * lr_mult[n], wd_by_name[n], t)
             new_aux = dict(aux)
             new_aux.update(aux_up)
             # pin steady-state shardings: without this GSPMD may pick new
             # layouts for the donated outputs, forcing a recompile on the
-            # next step when the re-fed params carry different shardings
+            # next step when the re-fed params carry different shardings.
+            # Under shard_opt the param constraint is the all_gather that
+            # rebuilds full params from the updated 1/N slices.
             new_params = {n: jax.lax.with_sharding_constraint(v, param_sh[n])
                           for n, v in new_params.items()}
             new_states = {n: jax.tree_util.tree_map(
-                lambda x: jax.lax.with_sharding_constraint(x, param_sh[n]),
+                lambda x, _sh=state_sh[n]:
+                    jax.lax.with_sharding_constraint(x, _sh),
                 new_states[n]) for n in new_states}
             new_aux = {n: jax.lax.with_sharding_constraint(v, aux_sh[n])
                        for n, v in new_aux.items()}

@@ -160,3 +160,35 @@ def test_torch_module_spec_is_sandboxed():
         num_data=1, num_params=0, num_outputs=1)
     np.testing.assert_allclose(out.asnumpy(),
                                np.maximum(x.asnumpy(), 0), rtol=1e-6)
+
+
+def test_torch_module_dropout_fwd_bwd_consistent():
+    # backward re-runs the forward with the snapshotted RNG state, so the
+    # gradient must reflect the SAME dropout mask the forward applied:
+    # y = x * m / (1-p)  =>  dy/dx = m / (1-p), i.e. 2.0 exactly where
+    # the forward output was nonzero (p=0.5), 0 elsewhere.
+    rng = np.random.RandomState(3)
+    x = mx.nd.array(rng.rand(64, 32).astype(np.float32) + 0.5)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.TorchModule(x, lua_string="nn.Dropout(p=0.5)",
+                              num_data=1, num_params=0, num_outputs=1)
+    mask = (y.asnumpy() != 0)
+    assert 0.2 < mask.mean() < 0.8  # train mode: dropout actually drops
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), mask * 2.0, rtol=1e-6)
+
+
+def test_torch_module_arithmetic_args():
+    # const-folded arithmetic in specs (the common nn.Linear(28*28, ...))
+    out = mx.nd.TorchModule(
+        mx.nd.zeros((2, 784)),
+        mx.nd.zeros((16, 784)), mx.nd.zeros((16,)),
+        lua_string="nn.Linear(28*28, 2**4)",
+        num_data=1, num_params=2, num_outputs=1)
+    assert out.shape == (2, 16)
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        mx.nd.TorchModule(mx.nd.zeros((1, 4)),
+                          lua_string="nn.Linear(10**10**10, 1)",
+                          num_data=1, num_params=0, num_outputs=1)

@@ -1,0 +1,110 @@
+"""ZeRO-style sharded optimizer state in SPMDTrainer.
+
+The update_on_kvstore analog (reference: the dist server runs the
+optimizer on its key shard, kvstore_dist_server.h:175-186; SURVEY §5.8):
+optimizer state lives sharded over the data axis, gradients reach the
+update as reduce-scattered slices, updated params are all_gathered.
+Checks: exactness vs the replicated path, and the ~N x per-device
+optimizer-state memory shrink.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+
+def _feed(rng, n=32):
+    return {"data": rng.randn(n, 784).astype("float32"),
+            "softmax_label": rng.randint(0, 10, (n,)).astype("float32")}
+
+
+def _make(shard, opt="sgd", opt_params=None, mesh_axes=None):
+    mesh = make_mesh(mesh_axes or {"data": 8})
+    s = models.get_symbol("mlp", num_classes=10)
+    tr = SPMDTrainer(
+        s, optimizer=opt,
+        optimizer_params=opt_params or dict(learning_rate=0.5, momentum=0.9,
+                                            rescale_grad=1.0 / 32),
+        mesh=mesh, shard_optimizer_state=shard)
+    np.random.seed(42)  # identical init across compared runs
+    tr.bind(data_shapes={"data": (32, 784)},
+            label_shapes={"softmax_label": (32,)},
+            initializer=mx.init.Xavier(rnd_type="gaussian"))
+    return tr
+
+
+def test_zero_matches_replicated_sgd_momentum():
+    rng = np.random.RandomState(0)
+    feeds = [_feed(np.random.RandomState(i)) for i in range(4)]
+    del rng
+    outs = {}
+    for shard in (False, True):
+        tr = _make(shard)
+        for f in feeds:
+            tr.step(f)
+        arg, _ = tr.get_params()
+        outs[shard] = {n: v.asnumpy() for n, v in arg.items()}
+    for n in outs[False]:
+        np.testing.assert_allclose(outs[True][n], outs[False][n],
+                                   rtol=2e-5, atol=2e-5, err_msg=n)
+
+
+def test_zero_matches_replicated_adam():
+    feeds = [_feed(np.random.RandomState(i)) for i in range(3)]
+    outs = {}
+    for shard in (False, True):
+        tr = _make(shard, opt="adam",
+                   opt_params=dict(learning_rate=1e-3,
+                                   rescale_grad=1.0 / 32))
+        for f in feeds:
+            tr.step(f)
+        arg, _ = tr.get_params()
+        outs[shard] = {n: v.asnumpy() for n, v in arg.items()}
+    for n in outs[False]:
+        np.testing.assert_allclose(outs[True][n], outs[False][n],
+                                   rtol=2e-5, atol=2e-5, err_msg=n)
+
+
+def test_zero_state_memory_shrinks_nx():
+    """Per-device optimizer-state bytes must shrink ~N x for shardable
+    params (dim divisible by the 8-way data axis)."""
+    def device_state_bytes(tr):
+        total = 0
+        for st in tr.states.values():
+            for leaf in __import__("jax").tree_util.tree_leaves(st):
+                total += leaf.addressable_shards[0].data.nbytes
+        return total
+
+    tr_rep = _make(False)
+    tr_sh = _make(True)
+    b_rep = device_state_bytes(tr_rep)
+    b_sh = device_state_bytes(tr_sh)
+    # mlp params: fc{1,2,3} weights (128,784),(64,128),(10,64) + biases.
+    # weights dominate; all three have dim0 divisible by 8 -> ~8x shrink
+    assert b_sh < b_rep / 4, (b_rep, b_sh)
+
+    # the big weight's momentum is actually laid out 1/8 per device
+    import jax
+    w_state = tr_sh.states["fc1_weight"]
+    leaf = jax.tree_util.tree_leaves(w_state)[0]
+    assert leaf.shape == (128, 784)
+    assert leaf.addressable_shards[0].data.shape == (16, 784)
+
+
+def test_zero_composes_with_tensor_parallel():
+    """dp=4 x tp=2: model-sharded dims stay model-sharded; the state picks
+    up an extra data split on another dim, and training still converges."""
+    rng = np.random.RandomState(0)
+    tr = _make(True, mesh_axes={"data": 4, "model": 2})
+    f = _feed(rng)
+    y = f["softmax_label"].astype(int)
+
+    def loss():
+        p = np.asarray(tr.step(f)[0])
+        return -np.log(p[np.arange(32), y] + 1e-9).mean()
+
+    l0 = loss()
+    for _ in range(25):
+        tr.step(f)
+    assert loss() < l0 * 0.5
