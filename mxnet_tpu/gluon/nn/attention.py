@@ -1,0 +1,71 @@
+"""Gluon multi-head attention layer (mesh-aware, sequence-parallel ready).
+
+Beyond-reference (SURVEY.md §5.7: the 2017 reference's only long-sequence
+tools are bucketing and ctx_group placement). This layer is the user-facing
+handle on the TPU-native sequence-parallel attention kernels: give it a
+``seq_axis`` mesh-axis name and, when the model runs under a mesh carrying
+that axis (e.g. inside ``SPMDTrainer``), attention shards the sequence over
+it — ring (ppermute KV rotation) or Ulysses (head<->seq all_to_all) — and
+composes with batch ('data') and tensor-parallel ('model') axes. Without a
+mesh the same layer is ordinary full attention, so model code is written
+once and scales from one chip to a 4-D mesh.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["MultiHeadAttention"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self/cross multi-head attention over (batch, seq, d_model) inputs.
+
+    Projects query/key/value with learned weights, applies (optionally
+    causal) scaled-dot-product attention via the ``MultiHeadAttention``
+    op, and projects the output. Call with one input (self-attention) or
+    three (query, key, value).
+    """
+
+    def __init__(self, d_model, num_heads, causal=False, seq_axis="",
+                 seq_mode="auto", use_bias=True, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._d_model = d_model
+        self._num_heads = num_heads
+        self._causal = causal
+        self._seq_axis = seq_axis
+        self._seq_mode = seq_mode
+        self._use_bias = use_bias
+        with self.name_scope():
+            for proj in ("query", "key", "value", "out"):
+                setattr(self, f"{proj}_weight", self.params.get(
+                    f"{proj}_weight", shape=(d_model, d_model),
+                    dtype=dtype, init=weight_initializer,
+                    allow_deferred_init=True))
+                if use_bias:
+                    setattr(self, f"{proj}_bias", self.params.get(
+                        f"{proj}_bias", shape=(d_model,), dtype=dtype,
+                        init="zeros", allow_deferred_init=True))
+
+    def hybrid_forward(self, F, query, key=None, value=None, **params):
+        key = query if key is None else key
+        value = key if value is None else value
+
+        def proj(x, name):
+            kw = dict(num_hidden=self._d_model, flatten=False)
+            if self._use_bias:
+                return F.FullyConnected(x, params[f"{name}_weight"],
+                                        params[f"{name}_bias"], **kw)
+            return F.FullyConnected(x, params[f"{name}_weight"],
+                                    no_bias=True, **kw)
+
+        out = F.MultiHeadAttention(
+            proj(query, "query"), proj(key, "key"), proj(value, "value"),
+            num_heads=self._num_heads, causal=self._causal,
+            seq_axis=self._seq_axis, seq_mode=self._seq_mode)
+        return proj(out, "out")
+
+    def __repr__(self):
+        return (f"MultiHeadAttention(d_model={self._d_model}, "
+                f"num_heads={self._num_heads}, causal={self._causal}, "
+                f"seq_axis={self._seq_axis!r})")

@@ -9,7 +9,8 @@ units prefer; the reference's NCHW remains available via ``layout=``.
 from __future__ import annotations
 
 from ..base import MXNetError
-from . import alexnet, lenet, mlp, resnet, transformer, vgg  # noqa: F401
+from . import (alexnet, lenet, mlp, resnet, transformer,  # noqa: F401
+               transformer_sym, vgg)
 from .transformer import TransformerConfig, TransformerLM  # noqa: F401
 
 _MODELS = {
@@ -18,6 +19,7 @@ _MODELS = {
     "vgg": vgg.get_symbol,
     "lenet": lenet.get_symbol,
     "mlp": mlp.get_symbol,
+    "transformer_lm": transformer_sym.get_symbol,
 }
 
 

@@ -3,6 +3,7 @@
 Importing this package populates the registry (reference analogue: static
 NNVM_REGISTER_OP initializers across src/operator/ executed at dlopen time).
 """
+from . import attention_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import contrib_tail_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401

@@ -1,0 +1,73 @@
+"""Attention operators: mesh-aware multi-head attention for sym/nd/gluon.
+
+Beyond-reference (the 2017 reference has no attention op; its long-sequence
+tools are bucketing + ctx_group placement, SURVEY.md §5.7). This op makes
+the TPU-native sequence-parallel kernels (`parallel/sequence.py` ring /
+Ulysses attention) reachable from the *user-facing graph languages*: a
+Symbol/NDArray op whose ``seq_axis`` attr names a mesh axis. When an
+ambient mesh (``parallel.mesh_scope`` — entered automatically by
+SPMDTrainer) carries that axis, attention runs sequence-parallel over it,
+composing with ``data`` (batch) and ``model`` (heads) axes; otherwise it
+falls back to ordinary full softmax attention, so the same graph runs
+anywhere from one chip to a 4-D mesh.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import AttrSpec, MXNetError
+from .registry import register
+
+
+def _split_heads(x, num_heads):
+    b, s, e = x.shape
+    if e % num_heads:
+        raise MXNetError(
+            f"MultiHeadAttention: embed dim {e} not divisible by "
+            f"num_heads {num_heads}")
+    return x.reshape(b, s, num_heads, e // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, s, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+@register("MultiHeadAttention",
+          attrs=AttrSpec(num_heads=("int",), causal=("bool", False),
+                         seq_axis=("str", ""), seq_mode=("str", "auto"),
+                         batch_axis=("str", "data"),
+                         head_axis=("str", "model")),
+          num_inputs=3, input_names=["query", "key", "value"],
+          output_names=["output"])
+def _multi_head_attention(query, key, value, num_heads, causal=False,
+                          seq_axis="", seq_mode="auto", batch_axis="data",
+                          head_axis="model"):
+    """Scaled-dot-product multi-head attention over (B, S, E) inputs.
+
+    ``seq_axis``: name of a mesh axis to shard the sequence over. Looked
+    up on the ambient :func:`parallel.current_mesh` at trace time; absent
+    mesh/axis (or axis size 1) falls back to full local attention with
+    identical numerics. ``seq_mode``: 'ring' (ppermute KV rotation),
+    'ulysses' (head<->seq all_to_all), or 'auto'.
+    """
+    q = _split_heads(query, num_heads)
+    k = _split_heads(key, num_heads)
+    v = _split_heads(value, num_heads)
+    mesh = None
+    if seq_axis:
+        from ..parallel.mesh import current_mesh
+        m = current_mesh()
+        if (m is not None and seq_axis in m.axis_names
+                and m.shape[seq_axis] > 1 and q.shape[2] % m.shape[seq_axis] == 0):
+            mesh = m
+    if mesh is not None:
+        from ..parallel.sequence import sequence_sharded_attention
+        out = sequence_sharded_attention(
+            q, k, v, mesh, axis_name=seq_axis, causal=causal,
+            mode=seq_mode, batch_axis=batch_axis or None,
+            head_axis=head_axis or None)
+    else:
+        from ..parallel.sequence import _full_attn
+        out = _full_attn(q, k, v, causal, None)
+    return _merge_heads(out).astype(query.dtype)

@@ -13,11 +13,13 @@ machinery with SPMD over a ``jax.sharding.Mesh``:
   -> named-axis tensor sharding (``model`` axis) with resharding handled
   by the XLA SPMD partitioner.
 """
-from .mesh import make_mesh, local_mesh  # noqa: F401
+from .mesh import (make_mesh, local_mesh, mesh_scope,  # noqa: F401
+                   current_mesh)
 from .sharding import batch_pspec, param_pspec, shard_params  # noqa: F401
 from .trainer import SPMDTrainer  # noqa: F401
 from .sequence import (ring_attention, sequence_sharded_attention,  # noqa: F401
                        ulysses_attention)
-from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .pipeline import (pipeline_apply, stack_stage_params,  # noqa: F401
+                       pipeline_from_symbol)
 from .moe import moe_apply, top1_router  # noqa: F401
 from . import dist  # noqa: F401

@@ -18,7 +18,39 @@ from jax.sharding import Mesh
 
 from ..base import MXNetError
 
-__all__ = ["make_mesh", "local_mesh"]
+__all__ = ["make_mesh", "local_mesh", "mesh_scope", "current_mesh"]
+
+_MESH_STACK = []
+
+
+class mesh_scope:
+    """Make ``mesh`` the ambient mesh for ops that are mesh-aware.
+
+    Mesh-aware ops (``MultiHeadAttention`` with a ``seq_axis``, pipeline
+    stages) consult :func:`current_mesh` at trace time, so graph code can
+    express parallelism by *axis name* only and stays mesh-agnostic —
+    the reference analogue is ``group2ctx`` supplying the actual devices
+    for symbolic ``ctx_group`` labels at bind time. ``SPMDTrainer.step``
+    enters this scope automatically; to run a mesh-aware graph through a
+    plain Executor or gluon block, wrap the calls in ``mesh_scope(mesh)``
+    yourself.
+    """
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _MESH_STACK.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _MESH_STACK.pop()
+        return False
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The innermost active :class:`mesh_scope` mesh, or None."""
+    return _MESH_STACK[-1] if _MESH_STACK else None
 
 
 def make_mesh(axes: Optional[Dict[str, int]] = None,

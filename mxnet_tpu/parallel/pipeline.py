@@ -26,7 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import MXNetError
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+__all__ = ["pipeline_apply", "stack_stage_params", "pipeline_from_symbol"]
 
 
 def stack_stage_params(param_list):
@@ -98,3 +98,212 @@ def pipeline_apply(fn: Callable, stacked_params, x, mesh: Mesh,
         mesh=mesh, in_specs=(p_spec, P()), out_specs=P(axis_name),
         check_vma=False)(stacked_params, xm)
     return out[-1].reshape((batch,) + x.shape[1:])
+
+
+def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
+                         n_microbatches: int = None,
+                         data_name: str = "data"):
+    """Drive the GPipe schedule from ctx_group stage annotations on a Symbol.
+
+    The reference expressed layer placement with ``mx.AttrScope(
+    ctx_group='stageK')`` + ``group2ctx`` and got only the dependency
+    engine's implicit overlap (SURVEY.md §2.5, graph_executor.cc:386-398).
+    Here the same annotations drive a real microbatch pipeline: nodes
+    labelled ``stage0..stage{n-1}`` become SPMD pipeline stages sharded
+    over the ``axis_name`` mesh axis, activations hop stages via ppermute.
+
+    Constraints (checked): stages must be isomorphic (same op sequence,
+    same parameter shapes — the natural shape of a repeated-block model),
+    connected by exactly one same-shaped activation tensor, with no rng
+    ops and no auxiliary states; weights may not be shared across stages.
+
+    Returns ``apply(arg_dict, x, n_microbatches=...) -> out`` where
+    ``arg_dict`` maps every non-data variable name to its array. The
+    function is jax-differentiable — wrap it in a loss and ``jax.grad``
+    to train, or pass it anywhere an eval function is expected.
+    """
+    from ..base import MXNetError as _Err
+
+    n = mesh.shape.get(axis_name)
+    if not n:
+        raise _Err(f"mesh has no axis {axis_name!r}")
+
+    nodes = symbol._topo_nodes()
+    if symbol._aux_node_ids():
+        raise _Err("pipeline_from_symbol: auxiliary states (BatchNorm "
+                   "moving stats) are not supported inside pipeline stages")
+
+    # -- stage assignment: explicit ctx_group attr, else inherit ---------
+    stage_of = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        grp = node.scope_attrs.get("ctx_group")
+        st = None
+        if grp is not None:
+            if not grp.startswith("stage"):
+                raise _Err(f"ctx_group {grp!r} is not a pipeline stage "
+                           "label (want 'stage<k>')")
+            try:
+                st = int(grp[len("stage"):])
+            except ValueError:
+                raise _Err(f"ctx_group {grp!r} is not a pipeline stage "
+                           "label (want 'stage<k>' with integer k)")
+        else:
+            for parent, _ in node.inputs:
+                if id(parent) in stage_of:
+                    st = stage_of[id(parent)]
+                    break
+        if st is None:
+            raise _Err(f"node {node.name} has no stage (annotate with "
+                       "AttrScope(ctx_group='stage0'...))")
+        stage_of[id(node)] = st
+        if node.op.needs_rng:
+            raise _Err(f"pipeline stages cannot contain rng op "
+                       f"{node.op.name} ({node.name})")
+
+    stages = [[] for _ in range(n)]
+    seen_max = -1
+    for node in nodes:
+        if node.is_variable:
+            continue
+        st = stage_of[id(node)]
+        if not 0 <= st < n:
+            raise _Err(f"stage{st} out of range for pipe axis size {n}")
+        if st < seen_max:
+            raise _Err("stage labels must be topologically non-decreasing")
+        seen_max = max(seen_max, st)
+        stages[st].append(node)
+    if any(not s for s in stages):
+        raise _Err(f"need exactly {n} populated stages "
+                   f"(pipe axis size), got {sum(1 for s in stages if s)}")
+
+    # -- per-stage io: one activation in, one out, own variables ---------
+    out_entries = list(symbol._outputs)
+    if len(out_entries) != 1:
+        raise _Err("pipeline symbol must have exactly one output")
+
+    def stage_io(st_nodes, si):
+        produced = {(id(m), i) for m in st_nodes
+                    for i in range(m.num_outputs())}
+        act_in, var_names = None, []
+        for m in st_nodes:
+            for parent, i in m.inputs:
+                key = (id(parent), i)
+                if key in produced:
+                    continue
+                if parent.is_variable:
+                    if parent.name == data_name:
+                        if si != 0:
+                            raise _Err(f"{data_name} consumed by stage{si}"
+                                       " (only stage0 may read the input)")
+                        act_in = key
+                    else:
+                        owner = stage_of.get(id(m))
+                        for other in nodes:
+                            if (not other.is_variable and
+                                    stage_of[id(other)] != owner and
+                                    any(p is parent for p, _ in other.inputs)):
+                                raise _Err(
+                                    f"variable {parent.name} shared across "
+                                    "stages — unsupported in the SPMD "
+                                    "pipeline (stack per-stage copies)")
+                        if parent.name not in var_names:
+                            var_names.append(parent.name)
+                else:
+                    if act_in is not None and act_in != key:
+                        raise _Err(f"stage{si} consumes more than one "
+                                   "cross-stage tensor")
+                    act_in = key
+        # the activation leaving this stage
+        if si == n - 1:
+            act_out = (id(out_entries[0][0]), out_entries[0][1])
+        else:
+            nxt = stages[si + 1]
+            nxt_prod = {(id(m), i) for m in nxt for i in range(m.num_outputs())}
+            outs = set()
+            for m in nxt:
+                for parent, i in m.inputs:
+                    key = (id(parent), i)
+                    if key in produced and key not in nxt_prod:
+                        outs.add(key)
+            if len(outs) != 1:
+                raise _Err(f"stage{si}->stage{si + 1} boundary must be "
+                           f"exactly one tensor, got {len(outs)}")
+            act_out = outs.pop()
+        if act_in is None:
+            raise _Err(f"stage{si} has no incoming activation")
+        return act_in, act_out, var_names
+
+    ios = [stage_io(s, i) for i, s in enumerate(stages)]
+
+    # -- isomorphism check + stage0 fn -----------------------------------
+    sig0 = [(m.op.name, tuple(sorted((k, str(v)) for k, v in m.attrs.items())))
+            for m in stages[0]]
+    for si in range(1, n):
+        sig = [(m.op.name,
+                tuple(sorted((k, str(v)) for k, v in m.attrs.items())))
+               for m in stages[si]]
+        if sig != sig0:
+            raise _Err(
+                f"stage{si} is not isomorphic to stage0 (op/attr sequence "
+                "differs); the SPMD pipeline runs one program on all "
+                "stages")
+
+    st0_nodes = stages[0]
+    act_in0, act_out0, vars0 = ios[0]
+    var_order0 = list(vars0)
+
+    def make_stage_fn(is_train):
+        def stage_fn(stage_params, h):
+            values = {act_in0: h}
+            name_to_val = dict(zip(var_order0, stage_params))
+            for m in st0_nodes:
+                ins = []
+                for parent, i in m.inputs:
+                    key = (id(parent), i)
+                    if key in values:
+                        ins.append(values[key])
+                    else:  # a variable of this stage, mapped by position
+                        ins.append(name_to_val[parent.name])
+                call_attrs = dict(m.attrs)
+                if m.op.needs_is_train:
+                    call_attrs["_is_train"] = is_train
+                if m.op.key_var_num_args and not call_attrs.get(
+                        m.op.key_var_num_args):
+                    call_attrs[m.op.key_var_num_args] = len(ins)
+                out = m.op.fn(*ins, **call_attrs)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                for i, o in enumerate(out):
+                    values[(id(m), i)] = o
+            return values[act_out0]
+        return stage_fn
+
+    # rename map: stage i's k-th variable corresponds to stage0's k-th
+    per_stage_vars = [ios[si][2] for si in range(n)]
+    for si, vs in enumerate(per_stage_vars):
+        if len(vs) != len(var_order0):
+            raise _Err(f"stage{si} has {len(vs)} parameters, stage0 has "
+                       f"{len(var_order0)} — stages must be isomorphic")
+
+    def apply(arg_dict, x, n_microbatches=n_microbatches, is_train=True):
+        stage_params = []
+        for si in range(n):
+            try:
+                stage_params.append(tuple(arg_dict[v]
+                                          for v in per_stage_vars[si]))
+            except KeyError as e:
+                raise _Err(f"missing pipeline parameter {e}")
+        try:
+            stacked = stack_stage_params(stage_params)
+        except Exception as e:
+            raise _Err(f"per-stage parameter shapes differ — stages must "
+                       f"be isomorphic: {e}")
+        return pipeline_apply(make_stage_fn(bool(is_train)), stacked, x,
+                              mesh, axis_name=axis_name,
+                              n_microbatches=n_microbatches)
+
+    apply.stage_param_names = per_stage_vars
+    apply.stage_fn = make_stage_fn(True)
+    return apply

@@ -116,16 +116,35 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
     return out.astype(q.dtype)
 
 
+def _bh_axes(q, mesh: Mesh, seq_axis: str, batch_axis: Optional[str],
+             head_axis: Optional[str]):
+    """Batch/head partition entries for the shard_map specs, so sequence
+    parallelism composes with dp (batch over ``data``) and tp (heads over
+    ``model``) in one 3-D/4-D mesh."""
+    b_ax = (batch_axis if batch_axis and batch_axis != seq_axis
+            and batch_axis in mesh.axis_names
+            and q.shape[0] % mesh.shape[batch_axis] == 0 else None)
+    h_ax = (head_axis if head_axis and head_axis != seq_axis
+            and head_axis in mesh.axis_names
+            and q.shape[1] % mesh.shape[head_axis] == 0 else None)
+    return b_ax, h_ax
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = "data",
+                   head_axis: Optional[str] = "model"):
     """Exact attention with the sequence axis sharded over ``axis_name``.
 
     Inputs are (batch, heads, seq, head_dim), logically full-length; the
     wrapper shards seq over the mesh axis, each device keeps its Q block
-    resident and K/V blocks rotate around the ring via ppermute.
+    resident and K/V blocks rotate around the ring via ppermute. When the
+    mesh also has ``batch_axis``/``head_axis`` axes, batch and heads are
+    partitioned over them (dp x tp x sp composition).
     """
     _check_seq_divides(q, k, mesh, axis_name)
-    spec = P(None, None, axis_name, None)
+    b_ax, h_ax = _bh_axes(q, mesh, axis_name, batch_axis, head_axis)
+    spec = P(b_ax, h_ax, axis_name, None)
     fn = jax.shard_map(
         functools.partial(_ring_attn_local, axis_name=axis_name,
                           causal=causal, scale=scale),
@@ -161,18 +180,24 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool,
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                      causal: bool = False, scale: Optional[float] = None):
+                      causal: bool = False, scale: Optional[float] = None,
+                      batch_axis: Optional[str] = "data",
+                      head_axis: Optional[str] = "model"):
     """Exact attention via head<->sequence all_to_all reshard (Ulysses).
 
-    Requires heads % mesh.shape[axis_name] == 0. Inputs (B, H, S, D).
+    Requires (per-``head_axis``-shard) heads % mesh.shape[axis_name] == 0.
+    Inputs (B, H, S, D). Batch/heads partition over ``batch_axis``/
+    ``head_axis`` when those mesh axes exist (dp x tp x sp composition).
     """
     _check_seq_divides(q, k, mesh, axis_name)
     n = mesh.shape[axis_name]
-    if q.shape[1] % n:
+    b_ax, h_ax = _bh_axes(q, mesh, axis_name, batch_axis, head_axis)
+    local_heads = q.shape[1] // (mesh.shape[h_ax] if h_ax else 1)
+    if local_heads % n:
         raise MXNetError(
-            f"ulysses needs heads ({q.shape[1]}) divisible by mesh axis "
-            f"{axis_name!r} ({n})")
-    spec = P(None, None, axis_name, None)
+            f"ulysses needs local heads ({local_heads}) divisible by mesh "
+            f"axis {axis_name!r} ({n})")
+    spec = P(b_ax, h_ax, axis_name, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,
                           causal=causal, scale=scale),
@@ -184,15 +209,21 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
 def sequence_sharded_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
                                causal: bool = False,
                                scale: Optional[float] = None,
-                               mode: str = "auto"):
+                               mode: str = "auto",
+                               batch_axis: Optional[str] = "data",
+                               head_axis: Optional[str] = "model"):
     """Dispatch: 'ring', 'ulysses', or 'auto' (ulysses when heads divide)."""
     if axis_name not in mesh.axis_names:
         raise MXNetError(f"mesh has no axis {axis_name!r}")
     if mode == "auto":
         n = mesh.shape[axis_name]
-        mode = "ulysses" if q.shape[1] % n == 0 else "ring"
+        _, h_ax = _bh_axes(q, mesh, axis_name, batch_axis, head_axis)
+        local_heads = q.shape[1] // (mesh.shape[h_ax] if h_ax else 1)
+        mode = "ulysses" if local_heads % n == 0 else "ring"
     if mode == "ring":
-        return ring_attention(q, k, v, mesh, axis_name, causal, scale)
+        return ring_attention(q, k, v, mesh, axis_name, causal, scale,
+                              batch_axis, head_axis)
     if mode == "ulysses":
-        return ulysses_attention(q, k, v, mesh, axis_name, causal, scale)
+        return ulysses_attention(q, k, v, mesh, axis_name, causal, scale,
+                                 batch_axis, head_axis)
     raise MXNetError(f"unknown sequence-parallel mode {mode!r}")

@@ -292,10 +292,20 @@ class SPMDTrainer:
             return new_params, new_states, new_aux, outs
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
-        self._in_shardings = {
-            n: NamedSharding(mesh, batch_pspec(mesh, len(known[n])))
-            for n in list(self._data_names) + list(self._label_names)
-            if n in known}
+        self._in_shardings = {}
+        for n in list(self._data_names) + list(self._label_names):
+            if n not in known:
+                continue
+            shp = tuple(known[n])
+            spec = list(batch_pspec(mesh, len(shp)))
+            # sequence parallelism: dim 1 (the sequence dim of token
+            # inputs) shards over a 'seq' mesh axis when present
+            spec += [None] * (len(shp) - len(spec))
+            if (len(shp) >= 2 and "seq" in mesh.axis_names
+                    and mesh.shape["seq"] > 1 and spec[1] is None
+                    and shp[1] % mesh.shape["seq"] == 0):
+                spec[1] = "seq"
+            self._in_shardings[n] = NamedSharding(mesh, P(*spec))
         return self
 
     # -- stepping ----------------------------------------------------------
@@ -318,8 +328,12 @@ class SPMDTrainer:
                          if self._optimizer.lr_scheduler is None
                          else self._optimizer.lr_scheduler(self._num_update))
         t = jnp.float32(self._num_update)
-        self.params, self.states, self.aux, outs = self._step_fn(
-            self.params, self.states, self.aux, inputs, sub, lr, t)
+        # mesh-aware ops (MultiHeadAttention seq_axis, ...) consult the
+        # ambient mesh while the step traces (first call compiles)
+        from .mesh import mesh_scope
+        with mesh_scope(self._mesh):
+            self.params, self.states, self.aux, outs = self._step_fn(
+                self.params, self.states, self.aux, inputs, sub, lr, t)
         return outs
 
     def get_params(self):

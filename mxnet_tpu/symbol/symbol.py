@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, _parse_tuple
 from ..ops.registry import OP_TABLE, OpDef, get_op
 
 __all__ = ["Symbol", "SymbolNode", "Variable", "var", "Group", "load",
@@ -395,6 +395,12 @@ class Symbol:
             shape = known_shapes.get(node.name)
             if shape is None and node.name in var_structs:
                 shape = var_structs[node.name]
+            if shape is None and "__shape__" in node.attrs:
+                # declared shape on the Variable itself participates in
+                # inference (reference: mx.sym.var(shape=...) feeds the
+                # InferShape pass)
+                shape = tuple(int(x)
+                              for x in _parse_tuple(node.attrs["__shape__"]))
             if shape is None:
                 return None
             dt = dtypes.get(node.name, node.attrs.get("__dtype__", "float32"))

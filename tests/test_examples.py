@@ -50,6 +50,9 @@ _CASES = [
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
      ["--seq-len", "512", "--heads", "8", "--head-dim", "16"]),
+    ("parallel/transformer_4d.py",
+     ["--seq-len", "16", "--batch", "8", "--vocab", "64",
+      "--d-model", "32", "--heads", "4", "--iters", "40"]),
 ]
 
 

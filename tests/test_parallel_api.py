@@ -1,0 +1,178 @@
+"""User-facing parallelism APIs (VERDICT r1 #5).
+
+TP/PP/SP compose through the public surfaces — the ``MultiHeadAttention``
+sym/nd op + gluon layer (seq_axis mesh-axis attr), ``SPMDTrainer`` over a
+multi-axis mesh, and ``pipeline_from_symbol`` driving the GPipe schedule
+from ctx_group stage annotations — with no ``parallel/*`` internals in
+user code.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, models
+from mxnet_tpu.parallel import (SPMDTrainer, make_mesh, mesh_scope,
+                                pipeline_from_symbol)
+
+
+def _manual_attention(q, k, v, num_heads, causal):
+    B, S, E = q.shape
+    H, D = num_heads, E // num_heads
+
+    def split(x):
+        return x.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+    s = np.einsum("bhqd,bhkd->bhqk", split(q), split(k)) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    out = np.einsum("bhqk,bhkd->bhqd", p, split(v))
+    return out.transpose(0, 2, 1, 3).reshape(B, S, E)
+
+
+def test_mha_op_matches_manual():
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(2, 16, 32).astype(np.float32) for _ in range(3))
+    for causal in (False, True):
+        out = mx.nd.MultiHeadAttention(
+            mx.nd.array(q), mx.nd.array(k), mx.nd.array(v),
+            num_heads=4, causal=causal).asnumpy()
+        np.testing.assert_allclose(
+            out, _manual_attention(q, k, v, 4, causal),
+            rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_mha_op_sequence_parallel_matches_full(mode):
+    rng = np.random.RandomState(1)
+    q, k, v = (rng.randn(2, 16, 32).astype(np.float32) for _ in range(3))
+    args = [mx.nd.array(a) for a in (q, k, v)]
+    ref = mx.nd.MultiHeadAttention(*args, num_heads=4, causal=True).asnumpy()
+    mesh = make_mesh({"data": 2, "seq": 4})
+    with mesh_scope(mesh):
+        out = mx.nd.MultiHeadAttention(
+            *args, num_heads=4, causal=True, seq_axis="seq",
+            seq_mode=mode).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gluon_mha_layer_mesh_transparent():
+    rng = np.random.RandomState(2)
+    x = mx.nd.array(rng.randn(2, 16, 32).astype(np.float32))
+    attn = gluon.nn.MultiHeadAttention(32, 4, causal=True, seq_axis="seq")
+    attn.collect_params().initialize(mx.init.Xavier())
+    ref = attn(x).asnumpy()
+    mesh = make_mesh({"data": 2, "seq": 4})
+    with mesh_scope(mesh):
+        out = attn(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    attn.hybridize()
+    with mesh_scope(mesh):
+        out_h = attn(x).asnumpy()
+    np.testing.assert_allclose(out_h, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_lm_4d_training_converges():
+    """dp=2 x tp=2 x sp=2 + ZeRO optimizer sharding, all via public API."""
+    B, S, V = 8, 16, 64
+    mesh = make_mesh({"data": 2, "model": 2, "seq": 2})
+    sym = models.get_symbol("transformer_lm", vocab_size=V, seq_len=S,
+                            num_layers=2, num_heads=4, d_model=32,
+                            seq_axis="seq", seq_mode="ring")
+    tr = SPMDTrainer(sym, optimizer="adam",
+                     optimizer_params=dict(learning_rate=3e-3,
+                                           rescale_grad=1.0 / (B * S)),
+                     mesh=mesh, shard_optimizer_state=True)
+    tr.bind(data_shapes={"data": (B, S)},
+            label_shapes={"softmax_label": (B, S)})
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, V, (B, S + 1))
+    feed = {"data": toks[:, :-1].astype(np.float32),
+            "softmax_label": toks[:, 1:].astype(np.float32)}
+    lab = toks[:, 1:]
+
+    def nll():
+        p = np.asarray(tr.step(feed)[0])
+        return -np.log(p[np.arange(B)[:, None], np.arange(S)[None, :],
+                         lab] + 1e-9).mean()
+
+    l0 = nll()
+    for _ in range(40):
+        tr.step(feed)
+    assert nll() < l0 * 0.5
+    # tp actually sharded the FFN weight over 'model'
+    spec = tr.params["l0_ffn1_weight"].sharding.spec
+    assert "model" in tuple(spec)
+    # sp actually sharded the token input over 'seq' (dim 1)
+    assert tuple(tr._in_shardings["data"].spec) == ("data", "seq")
+
+
+def _staged_mlp(n_stages, d):
+    data = mx.sym.var("data")
+    h = data
+    for i in range(n_stages):
+        with mx.AttrScope(ctx_group=f"stage{i}"):
+            h = mx.sym.FullyConnected(h, name=f"fc{i}", num_hidden=d,
+                                      flatten=False)
+            h = mx.sym.Activation(h, act_type="tanh", name=f"act{i}")
+    return h
+
+
+def test_pipeline_from_symbol_matches_executor():
+    d, n = 16, 4
+    sym = _staged_mlp(n, d)
+    mesh = make_mesh({"pipe": n}, devices=jax.devices()[:n])
+    apply_fn = pipeline_from_symbol(sym, mesh, n_microbatches=n)
+    rng = np.random.RandomState(0)
+    args = {}
+    for i in range(n):
+        args[f"fc{i}_weight"] = jnp.asarray(
+            rng.normal(0, .4, (d, d)).astype(np.float32))
+        args[f"fc{i}_bias"] = jnp.asarray(
+            rng.normal(0, .1, (d,)).astype(np.float32))
+    x = jnp.asarray(rng.normal(0, 1, (8, d)).astype(np.float32))
+    out_pipe = np.asarray(apply_fn(args, x))
+
+    ex = sym.simple_bind(mx.cpu(), data=(8, d), grad_req="null")
+    for name, v in args.items():
+        ex.arg_dict[name][:] = mx.nd.array(np.asarray(v))
+    out_ref = ex.forward(is_train=False, data=np.asarray(x))[0].asnumpy()
+    np.testing.assert_allclose(out_pipe, out_ref, rtol=1e-4, atol=1e-5)
+
+    # differentiable end-to-end: train the pipelined model a few steps
+    y = jnp.asarray(rng.normal(0, 1, (8, d)).astype(np.float32))
+
+    @jax.jit
+    def loss(args, x, y):
+        return jnp.mean((apply_fn(args, x) - y) ** 2)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss))
+    l0, _ = grad_fn(args, x, y)
+    for _ in range(60):
+        l, g = grad_fn(args, x, y)
+        args = jax.tree.map(lambda p, gi: p - 0.2 * gi, args, g)
+    l1, _ = grad_fn(args, x, y)
+    assert float(l1) < float(l0) * 0.5
+
+
+def test_pipeline_from_symbol_rejects_bad_graphs():
+    d = 16
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    # non-isomorphic stages (different hidden sizes)
+    data = mx.sym.var("data")
+    h = data
+    for i, hid in enumerate([d, d, 2 * d, d]):
+        with mx.AttrScope(ctx_group=f"stage{i}"):
+            h = mx.sym.FullyConnected(h, name=f"fc{i}", num_hidden=hid,
+                                      flatten=False)
+    with pytest.raises(mx.MXNetError):
+        pipeline_from_symbol(h, mesh)
+    # missing stage annotations entirely
+    plain = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=d,
+                                  name="fc", flatten=False)
+    with pytest.raises(mx.MXNetError):
+        pipeline_from_symbol(plain, mesh)
