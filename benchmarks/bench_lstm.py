@@ -8,9 +8,14 @@ baseline. Runs the fused RNN op (Pallas LSTM cell on TPU) through a
 training step.
 """
 import argparse
+import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -71,8 +76,18 @@ def main():
     sync()
     dt = (time.time() - t0) / args.iters
     tps = N * T / dt
+    # fwd flops/token: 8H^2 per LSTM layer (4 gates x two HxH matmuls)
+    # + 2HV head + 0 embedding (gather); train step ~ 3x fwd
+    flops_tok = 3 * (8 * H * H * args.num_layers + 2 * H * V)
     print(f"LSTM {args.num_layers}x{H} bs{N} T={T}: "
           f"{dt * 1000:.1f} ms/step, {tps:,.0f} tokens/sec/chip")
+    print(json.dumps({
+        "metric": "lstm_train_throughput",
+        "value": round(tps, 0),
+        "unit": "tokens/sec/chip",
+        "config": f"{args.num_layers}x{H} bs{N} T={T} V={V}",
+        "effective_tflops": round(tps * flops_tok / 1e12, 1),
+    }))
 
 
 if __name__ == "__main__":

@@ -19,18 +19,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from profile_resnet import resnet50_convs, conv_flops, _sync
+from profile_resnet import (resnet50_convs, conv_flops,  # noqa: F401
+                            _sync, timed)
 
 
-def timed(fn, *args, reps=3):
-    fn(*args)  # compile
-    _sync(fn(*args))
-    best = 1e9
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _sync(fn(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best
 
 
 def conv_loop(h, w, cin, cout, k, s, B, K, bwd=False):

@@ -33,6 +33,19 @@ def _sync(out):
     float(np.asarray(_scalar(first)))
 
 
+def timed(fn, *args, reps=3):
+    """Best-of-reps wall time of one fn(*args) with a 4-byte sync —
+    the shared discipline for the in-graph-loop benchmarks (convs/gemm/
+    roofline import this; keep the sync semantics in one place)."""
+    _sync(fn(*args))  # compile + settle
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def timeit(fn, *args, iters=10, warmup=2):
     for _ in range(warmup):
         out = fn(*args)

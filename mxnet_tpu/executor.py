@@ -23,12 +23,19 @@ from .ndarray.ndarray import _as_jax
 __all__ = ["Executor", "build_graph_eval", "build_placed_graph_eval"]
 
 
-def build_graph_eval(symbol, collect_all=False):
+def build_graph_eval(symbol, collect_all=False, proxies=None):
     """Build eval_fn(arg_vals: dict, aux_vals: dict, rng, is_train)
     -> (outputs: list, aux_updates: dict). Pure and jax-traceable.
 
     With ``collect_all`` the outputs list holds every op output in
-    topological order instead of just the symbol's outputs (Monitor)."""
+    topological order instead of just the symbol's outputs (Monitor).
+
+    ``proxies`` maps node id -> extra input name: that node's first
+    output gets the named arg added to it when present in ``arg_vals``.
+    Fed zeros it changes nothing, but its vjp cotangent is exactly the
+    gradient at that op's output — the hook the sparse-grad Embedding
+    path uses to obtain d(out) without differentiating through the
+    (vocab, dim) gather (see Executor)."""
     nodes = symbol._topo_nodes()
     aux_ids = symbol._aux_node_ids()
     # deterministic per-random-node key folding
@@ -36,6 +43,7 @@ def build_graph_eval(symbol, collect_all=False):
                     if n.op is not None and n.op.needs_rng]
     rng_index = {id(n): i for i, n in enumerate(random_nodes)}
     out_entries = list(symbol._outputs)
+    proxies = proxies or {}
 
     def eval_fn(arg_vals: Dict, aux_vals: Dict, rng, is_train: bool):
         values = {}
@@ -60,6 +68,9 @@ def build_graph_eval(symbol, collect_all=False):
                 out = node.op.fn(*ins, **call_attrs)
             if not isinstance(out, tuple):
                 out = (out,)
+            pname = proxies.get(id(node))
+            if pname is not None and pname in arg_vals:
+                out = (out[0] + arg_vals[pname],) + out[1:]
             for i, o in enumerate(out):
                 values[(id(node), i)] = o
             if is_train and node.op.aux_update:
@@ -222,6 +233,50 @@ def build_placed_graph_eval(symbol, group2dev):
     return eval_fn
 
 
+def _sparse_grad_specs(symbol, grad_req):
+    """Embedding nodes whose weight gradient stays row_sparse.
+
+    Conditions (reference: the sparse-embedding FComputeEx path): the op
+    carries ``sparse_grad=True``, its weight is a trainable variable and
+    its indices input is a graph input variable. grad_req='add' is
+    rejected like the reference rejects kAddTo for sparse outputs.
+    """
+    nodes = symbol._topo_nodes()
+    consumers = {}  # variable id -> number of consuming input slots
+    for n in nodes:
+        if n.is_variable:
+            continue
+        for p, _ in n.inputs:
+            if p.is_variable:
+                consumers[id(p)] = consumers.get(id(p), 0) + 1
+    specs = []
+    for node in nodes:
+        if node.is_variable or node.op.name != "Embedding":
+            continue
+        if not node.attrs.get("sparse_grad"):
+            continue
+        data_p, w_p = node.inputs[0][0], node.inputs[1][0]
+        if not (w_p.is_variable and data_p.is_variable):
+            continue
+        if consumers.get(id(w_p), 0) != 1:
+            # tied weights (lm head, second embedding, ...): the proxy
+            # would capture only this node's contribution — fall back to
+            # the ordinary dense gradient, which is always correct
+            continue
+        req = grad_req.get(w_p.name, "null")
+        if req == "null":
+            continue
+        if req == "add":
+            raise MXNetError(
+                "grad_req='add' is not supported for sparse_grad "
+                "Embedding weights (reference: kAddTo unsupported for "
+                "sparse outputs)")
+        specs.append({"nid": id(node), "w": w_p.name, "d": data_p.name,
+                      "dim": int(node.attrs["output_dim"]),
+                      "proxy": f"_sgproxy{len(specs)}"})
+    return specs
+
+
 class Executor:
     """A bound executor over one symbol (reference: graph_executor.h:57-66)."""
 
@@ -255,6 +310,7 @@ class Executor:
         if shared_exec is not None and shared_exec._symbol is symbol:
             self._fwd = shared_exec._fwd
             self._fwd_bwd = shared_exec._fwd_bwd
+            self._sparse_specs = shared_exec._sparse_specs
         elif len(set(placed_devs.values())) >= 2:
             # ctx_group model parallelism: per-group device placement with
             # internally jitted segments; no outer jit (it would collapse
@@ -282,14 +338,18 @@ class Executor:
                        for o, hg in zip(outs, head_grads)]
                 zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
                 (grads,) = vjp_fn((cts, zero_aux))
-                return outs, aux_up, grads
+                return outs, aux_up, grads, {}
 
+            self._sparse_specs = []  # placed path: dense gradients only
             self._fwd = fwd_placed
             self._fwd_bwd = fwd_bwd_placed
             self._last = None
             return
         else:
-            eval_fn = build_graph_eval(symbol)
+            self._sparse_specs = _sparse_grad_specs(symbol, grad_req)
+            specs = self._sparse_specs
+            eval_fn = build_graph_eval(
+                symbol, proxies={s["nid"]: s["proxy"] for s in specs})
 
             def fwd(arg_vals, aux_vals, rng, is_train):
                 outs, aux_up = eval_fn(arg_vals, aux_vals, rng, is_train)
@@ -299,10 +359,20 @@ class Executor:
                 # diff_names is static: each executor passes its own grad_req
                 # selection even when the compiled program is shared
                 diff = {n: arg_vals[n] for n in diff_names}
+                # zero proxies on each sparse-grad Embedding output: the
+                # vjp cotangent w.r.t. a proxy is d(emb_out), from which
+                # the row_sparse weight grad is assembled host-side
+                # without ever materializing the dense (vocab, dim) grad
+                proxy_vals = {
+                    s["proxy"]: jnp.zeros(
+                        tuple(arg_vals[s["d"]].shape) + (s["dim"],),
+                        arg_vals[s["w"]].dtype)
+                    for s in specs}
 
-                def f(diff_args):
+                def f(diff_args, proxy_args):
                     merged = dict(arg_vals)
                     merged.update(diff_args)
+                    merged.update(proxy_args)
                     outs, aux_up = eval_fn(merged, aux_vals, rng, True)
                     return outs, aux_up
 
@@ -311,12 +381,12 @@ class Executor:
                     # backward pass (reference MXNET_BACKWARD_DO_MIRROR /
                     # memonger — here XLA rematerialization)
                     f = jax.checkpoint(f)
-                (outs, aux_up), vjp_fn = jax.vjp(f, diff)
+                (outs, aux_up), vjp_fn = jax.vjp(f, diff, proxy_vals)
                 cts = [hg if hg is not None else jnp.ones_like(o)
                        for o, hg in zip(outs, head_grads)]
                 zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
-                (grads,) = vjp_fn((cts, zero_aux))
-                return outs, aux_up, grads
+                grads, proxy_grads = vjp_fn((cts, zero_aux))
+                return outs, aux_up, grads, proxy_grads
 
             if getenv("MXTPU_EXEC_EAGER", 0, int):
                 # debugging mode: run un-jitted, op by op (reference
@@ -345,13 +415,23 @@ class Executor:
     def output_dict(self):
         return dict(zip(self._output_names, self.outputs))
 
+    def _arg_val(self, name):
+        """Value handed to the traced graph: dense jax array, or a BCOO
+        pytree for CSR arguments (symbolic sparse execution — the csr
+        never densifies; ops like ``dot`` dispatch on BCOO)."""
+        v = self.arg_dict[name]
+        from .ndarray.sparse import CSRNDArray
+        if isinstance(v, CSRNDArray):
+            return v._to_bcoo()
+        return v._data
+
     def forward(self, is_train=False, **kwargs):
         for name, val in kwargs.items():
             if name not in self.arg_dict:
                 raise MXNetError(f"unknown argument {name}")
             self.arg_dict[name]._set_data(
                 _as_jax(val, dtype=self.arg_dict[name].dtype))
-        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        arg_vals = {n: self._arg_val(n) for n in self._arg_names}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
         rng = _random.next_key()
         from . import profiler as _profiler
@@ -377,7 +457,7 @@ class Executor:
         for name, val in kwargs.items():
             self.arg_dict[name]._set_data(
                 _as_jax(val, dtype=self.arg_dict[name].dtype))
-        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        arg_vals = {n: self._arg_val(n) for n in self._arg_names}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
         rng = _random.next_key()
         self._run_fwd_bwd(arg_vals, aux_vals, rng, out_grads)
@@ -390,17 +470,18 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             head_grads = [g._data if g is not None else None for g in out_grads]
+        sparse_w = {s["w"] for s in self._sparse_specs}
+        dense_diff = tuple(n for n in self._diff_args if n not in sparse_w)
         from . import profiler as _profiler
         with _profiler.profile_scope("ForwardBackward", "executor",
                                      "symbolic", sync=lambda: grads):
-            outs, aux_up, grads = self._fwd_bwd(arg_vals, aux_vals, rng,
-                                                head_grads,
-                                                tuple(self._diff_args))
+            outs, aux_up, grads, proxy_grads = self._fwd_bwd(
+                arg_vals, aux_vals, rng, head_grads, dense_diff)
         self._last = (arg_vals, aux_vals, rng, True)
         self.outputs = [NDArray(o) for o in outs]
         for name, val in aux_up.items():
             self.aux_dict[name]._set_data(val)
-        for name in self._diff_args:
+        for name in dense_diff:
             g = grads[name]
             buf = self.grad_dict.get(name)
             if buf is None:
@@ -409,6 +490,31 @@ class Executor:
                 buf._set_data(buf._data + g)
             else:
                 buf._set_data(g)
+        if self._sparse_specs:
+            self._store_sparse_grads(arg_vals, proxy_grads)
+
+    def _store_sparse_grads(self, arg_vals, proxy_grads):
+        """Assemble row_sparse weight grads from the proxy cotangents.
+
+        d(emb_out) is (batch..., dim); the rsp grad holds one row per
+        *unique* index with duplicate contributions summed (reference:
+        the sparse embedding backward's unique+sum kernel). The dense
+        (vocab, dim) gradient is never allocated.
+        """
+        import numpy as np
+
+        from .ndarray.sparse import RowSparseNDArray
+
+        for s in self._sparse_specs:
+            idx = np.asarray(
+                jax.device_get(arg_vals[s["d"]])).astype(np.int64).ravel()
+            g = np.asarray(jax.device_get(proxy_grads[s["proxy"]]))
+            g = g.reshape(idx.size, -1)
+            rows, inv = np.unique(idx, return_inverse=True)
+            vals = np.zeros((rows.size, g.shape[1]), g.dtype)
+            np.add.at(vals, inv, g)
+            self.grad_dict[s["w"]] = RowSparseNDArray(
+                vals, rows, tuple(self.arg_dict[s["w"]].shape))
 
     def internal_outputs(self):
         """Evaluate and return {entry_name: NDArray} for EVERY op output in

@@ -1,13 +1,14 @@
-"""Legacy executor manager for data parallelism (reference:
-python/mxnet/executor_manager.py — the pre-Module machinery that
-FeedForward uses: workload slicing, per-device executors, metric update).
+"""Legacy executor manager for data parallelism.
 
-The rebuild keeps the exact API (``_split_input_slice``,
-``DataParallelExecutorGroup``, ``DataParallelExecutorManager``) but each
-"device executor" is an XLA-compiled Executor; with a single TPU chip the
-group degenerates to one executor, and real multi-chip data parallelism is
-the in-graph `psum` path (parallel/trainer.py). This module exists for
-API-compatibility with reference-era scripts.
+Reference surface: python/mxnet/executor_manager.py — the pre-Module
+machinery FeedForward drives (workload slicing, per-device executors,
+metric update). The attribute surface (``train_execs``, ``param_arrays``,
+``slices``, ...) is load-bearing API for reference-era scripts, so it is
+preserved exactly; internally each "device executor" is one XLA-compiled
+Executor, built here by a single ``_bind_one`` helper and indexed with a
+shared column-collector. With one TPU chip the group degenerates to a
+single executor; real multi-chip data parallelism is the in-graph psum
+path (parallel/trainer.py).
 """
 from __future__ import annotations
 
@@ -22,55 +23,65 @@ __all__ = ["DataParallelExecutorGroup", "DataParallelExecutorManager",
 
 
 def _split_input_slice(batch_size, work_load_list):
-    """Split ``batch_size`` into per-device slices proportional to the
-    work loads (reference executor_manager.py:31)."""
-    total = sum(work_load_list)
-    batch_num_list = [round(w * batch_size / total) for w in work_load_list]
-    diff = batch_size - sum(batch_num_list)
-    if diff > 0:
-        batch_num_list[-1] += diff
-    slices = []
-    end = 0
-    for batch_num in batch_num_list:
-        begin = int(min(end, batch_size))
-        end = int(min(begin + batch_num, batch_size))
-        if begin >= end:
-            raise ValueError("Too many slices. Some splits are empty.")
-        slices.append(slice(begin, end))
-    return slices
+    """Partition ``batch_size`` rows proportionally to the work loads.
+
+    Returns one ``slice`` per device; any rounding remainder lands on the
+    last device. An empty share raises (too many devices for the batch).
+    """
+    total = float(sum(work_load_list))
+    shares = [round(w * batch_size / total) for w in work_load_list]
+    shares[-1] += batch_size - sum(shares)
+    bounds, acc = [0], 0
+    for s in shares:
+        acc = min(acc + s, batch_size)
+        bounds.append(acc)
+    out = [slice(lo, hi) for lo, hi in zip(bounds, bounds[1:])]
+    if any(s.start >= s.stop for s in out):
+        raise ValueError("Too many slices. Some splits are empty.")
+    return out
+
+
+def _dup_of(names):
+    seen = set()
+    for n in names:
+        if n in seen:
+            return n
+        seen.add(n)
+    return None
 
 
 def _check_arguments(symbol):
-    """Reject duplicated argument / aux names (reference :68)."""
-    arg_names = symbol.list_arguments()
-    if len(set(arg_names)) != len(arg_names):
-        dup = [n for n in arg_names if arg_names.count(n) > 1]
+    """Reject duplicated argument / aux names."""
+    args = symbol.list_arguments()
+    dup = _dup_of(args)
+    if dup is not None:
         raise ValueError(
-            f'Find duplicated argument name "{dup[0]}", please make the '
+            f'Find duplicated argument name "{dup}", please make the '
             f"weight name non-duplicated (using name arguments), "
-            f"arguments are {arg_names}")
-    aux_names = symbol.list_auxiliary_states()
-    if len(set(aux_names)) != len(aux_names):
-        dup = [n for n in aux_names if aux_names.count(n) > 1]
+            f"arguments are {args}")
+    aux = symbol.list_auxiliary_states()
+    dup = _dup_of(aux)
+    if dup is not None:
         raise ValueError(
-            f'Find duplicated auxiliary param name "{dup[0]}"; '
-            f"auxiliary params are {aux_names}")
+            f'Find duplicated auxiliary param name "{dup}"; '
+            f"auxiliary params are {aux}")
 
 
 def _load_general(data, targets):
-    """Load a list of arrays into arrays / (slice, array) target lists."""
+    """Copy source arrays into whole-array or (slice, array) targets."""
     from . import ndarray as nd
 
-    for d_src, d_targets in zip(data, targets):
-        if isinstance(d_targets, nd.NDArray):
-            d_src.copyto(d_targets)
-        else:
-            if d_targets[-1][0].stop != d_src.shape[0]:
-                raise MXNetError(
-                    f"Batch size mismatch. Expected {d_targets[-1][0].stop},"
-                    f" got {d_src.shape[0]}")
-            for slice_idx, d_dst in d_targets:
-                d_src[slice_idx].copyto(d_dst)
+    for src, dst in zip(data, targets):
+        if isinstance(dst, nd.NDArray):
+            src.copyto(dst)
+            continue
+        expect = dst[-1][0].stop
+        if expect != src.shape[0]:
+            raise MXNetError(
+                f"Batch size mismatch. Expected {expect}, "
+                f"got {src.shape[0]}")
+        for rows, buf in dst:
+            src[rows].copyto(buf)
 
 
 def _load_data(batch, targets):
@@ -82,57 +93,54 @@ def _load_label(batch, targets):
 
 
 class DataParallelExecutorGroup:
-    """A group of executors, one per device, each bound to a batch slice
-    (reference executor_manager.py:204)."""
+    """One executor per device, each bound to its batch slice."""
 
     def __init__(self, sym, arg_names, param_names, ctx, slices, train_data,
                  shared_group=None):
         _check_arguments(sym)
-
-        self.data_names = [x[0] for x in train_data.provide_data]
-        self.label_names = [x[0] for x in train_data.provide_label]
+        descs = list(train_data.provide_data) + list(train_data.provide_label)
+        self.data_names = [d[0] for d in train_data.provide_data]
+        self.label_names = [d[0] for d in train_data.provide_label]
         self.aux_names = sym.list_auxiliary_states()
-        self.param_idx = [i for i in range(len(arg_names))
-                          if arg_names[i] in param_names]
+        self.param_idx = [i for i, n in enumerate(arg_names)
+                          if n in param_names]
         self.param_names = [arg_names[i] for i in self.param_idx]
+        self.slices = slices
 
-        grad_req = {}
-        for name in arg_names:
-            grad_req[name] = "write" if name in param_names else "null"
+        grad_req = {n: ("write" if n in param_names else "null")
+                    for n in arg_names}
 
-        self.train_execs = []
-        for i, ctxi in enumerate(ctx):
-            data_shapes = {}
-            data_types = {}
-            for x in train_data.provide_data + train_data.provide_label:
-                data_shapes[x[0]] = tuple(
-                    [slices[i].stop - slices[i].start] + list(x[1][1:]))
-                if isinstance(x, DataDesc):
-                    data_types[x.name] = x.dtype
-            shared_exec = (None if shared_group is None
-                           else shared_group.train_execs[i])
-            train_exec = sym.simple_bind(
-                ctxi, grad_req=grad_req, type_dict=data_types,
-                shared_exec=shared_exec, **data_shapes)
-            self.train_execs.append(train_exec)
+        def bind_one(i):
+            rows = slices[i].stop - slices[i].start
+            shapes = {d[0]: (rows,) + tuple(d[1][1:]) for d in descs}
+            dtypes = {d.name: d.dtype for d in descs
+                      if isinstance(d, DataDesc)}
+            shared = (shared_group.train_execs[i]
+                      if shared_group is not None else None)
+            return sym.simple_bind(ctx[i], grad_req=grad_req,
+                                   type_dict=dtypes, shared_exec=shared,
+                                   **shapes)
 
-        self.data_arrays = [
-            [(slices[i], e.arg_dict[name])
-             for i, e in enumerate(self.train_execs)]
-            for name in self.data_names]
-        self.label_arrays = [
-            [(slices[i], e.arg_dict[name])
-             for i, e in enumerate(self.train_execs)]
-            for name in self.label_names]
+        self.train_execs = [bind_one(i) for i in range(len(ctx))]
 
+        def sliced_column(name):
+            return [(slices[i], e.arg_dict[name])
+                    for i, e in enumerate(self.train_execs)]
+
+        self.data_arrays = [sliced_column(n) for n in self.data_names]
+        self.label_arrays = [sliced_column(n) for n in self.label_names]
         self.param_arrays = [[e.arg_arrays[i] for e in self.train_execs]
                              for i in self.param_idx]
-        self.grad_arrays = [[e.grad_arrays[i] for e in self.train_execs]
-                            for i in self.param_idx]
         self.aux_arrays = [[e.aux_arrays[i] for e in self.train_execs]
                            for i in range(len(self.aux_names))]
 
-        self.slices = slices
+    @property
+    def grad_arrays(self):
+        """Read live from the executors: the sparse-grad path rebinds
+        grad_dict entries (RowSparseNDArray per backward) rather than
+        writing buffers in place, so bind-time snapshots would go stale."""
+        return [[e.grad_arrays[i] for e in self.train_execs]
+                for i in self.param_idx]
 
     def load_data_batch(self, data_batch):
         _load_data(data_batch, self.data_arrays)
@@ -147,26 +155,20 @@ class DataParallelExecutorGroup:
             texec.backward()
 
     def update_metric(self, metric, labels):
-        for texec, islice in zip(self.train_execs, self.slices):
-            labels_slice = [label[islice] for label in labels]
-            metric.update(labels_slice, texec.outputs)
+        for texec, rows in zip(self.train_execs, self.slices):
+            metric.update([label[rows] for label in labels], texec.outputs)
 
 
 class DataParallelExecutorManager:
-    """Manage multiple executors for data parallelism, with optional
-    bucketing via ``sym_gen`` (reference executor_manager.py:295)."""
+    """Drive a DataParallelExecutorGroup (plus per-bucket groups when a
+    ``sym_gen`` is supplied) over a device list."""
 
     def __init__(self, symbol, ctx, train_data, arg_names, param_names,
                  aux_names, work_load_list=None, logger=None, sym_gen=None):
-        if logger is None:
-            logger = logging
-        num_device = len(ctx)
-        logger.info("Start training with %s", str(ctx))
-
-        if work_load_list is None:
-            work_load_list = [1] * num_device
+        (logger or logging).info("Start training with %s", str(ctx))
+        work_load_list = work_load_list or [1] * len(ctx)
         if (not isinstance(work_load_list, list)
-                or len(work_load_list) != num_device):
+                or len(work_load_list) != len(ctx)):
             raise ValueError("Invalid settings for work load.")
 
         self.slices = _split_input_slice(train_data.batch_size,
@@ -175,14 +177,12 @@ class DataParallelExecutorManager:
         self.param_names = param_names
         self.aux_names = aux_names
         self.ctx = ctx
-
-        self.execgrp = DataParallelExecutorGroup(
-            symbol, self.arg_names, self.param_names, self.ctx, self.slices,
-            train_data)
         self.symbol = symbol
         self.sym_gen = sym_gen
         self.curr_execgrp = None
-        if self.sym_gen is not None:
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, arg_names, param_names, ctx, self.slices, train_data)
+        if sym_gen is not None:
             self.execgrp_bucket = {
                 train_data.default_bucket_key: self.execgrp}
 
@@ -199,14 +199,13 @@ class DataParallelExecutorManager:
 
     def copy_to(self, arg_params, aux_params):
         """Average parameters across executors into the given dicts."""
-        for name, block in zip(self.param_names, self.param_arrays):
-            weight = sum(w.asnumpy() for w in block) / len(block)
-            arg_params[name][:] = weight.astype(
-                arg_params[name].dtype, copy=False)
-        for name, block in zip(self.aux_names, self.aux_arrays):
-            weight = sum(w.asnumpy() for w in block) / len(block)
-            aux_params[name][:] = weight.astype(
-                aux_params[name].dtype, copy=False)
+        def mean_into(names, columns, dst):
+            for name, column in zip(names, columns):
+                avg = sum(w.asnumpy() for w in column) / len(column)
+                dst[name][:] = avg.astype(dst[name].dtype, copy=False)
+
+        mean_into(self.param_names, self.param_arrays, arg_params)
+        mean_into(self.aux_names, self.aux_arrays, aux_params)
 
     @property
     def param_arrays(self):
@@ -221,18 +220,17 @@ class DataParallelExecutorManager:
         return self.execgrp.aux_arrays
 
     def load_data_batch(self, data_batch):
+        group = self.execgrp
         if self.sym_gen is not None:
             key = data_batch.bucket_key
             if key not in self.execgrp_bucket:
-                symbol = self.sym_gen(key)
-                execgrp = DataParallelExecutorGroup(
-                    symbol, self.arg_names, self.param_names, self.ctx,
-                    self.slices, data_batch, shared_group=self.execgrp)
-                self.execgrp_bucket[key] = execgrp
-            self.curr_execgrp = self.execgrp_bucket[key]
-        else:
-            self.curr_execgrp = self.execgrp
-        self.curr_execgrp.load_data_batch(data_batch)
+                self.execgrp_bucket[key] = DataParallelExecutorGroup(
+                    self.sym_gen(key), self.arg_names, self.param_names,
+                    self.ctx, self.slices, data_batch,
+                    shared_group=self.execgrp)
+            group = self.execgrp_bucket[key]
+        self.curr_execgrp = group
+        group.load_data_batch(data_batch)
 
     def forward(self, is_train=False):
         self.curr_execgrp.forward(is_train=is_train)

@@ -174,6 +174,15 @@ class CSRNDArray(BaseSparseNDArray):
         out = jnp.zeros(self.shape, dtype=self._d.dtype)
         return out.at[rows, self._i].add(self._d)
 
+    def _to_bcoo(self):
+        """jax.experimental.sparse.BCOO view for symbolic sparse execution
+        (the executor passes this pytree into the jitted graph; ops
+        dispatch on it — never densified)."""
+        from jax.experimental import sparse as jsparse
+        rows = jnp.asarray(self._row_ids(), dtype=jnp.int32)
+        idx = jnp.stack([rows, self._i.astype(jnp.int32)], axis=1)
+        return jsparse.BCOO((self._d, idx), shape=self.shape)
+
     def tostype(self, stype):
         if stype == "csr":
             return self

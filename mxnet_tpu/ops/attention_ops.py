@@ -59,7 +59,9 @@ def _multi_head_attention(query, key, value, num_heads, causal=False,
         from ..parallel.mesh import current_mesh
         m = current_mesh()
         if (m is not None and seq_axis in m.axis_names
-                and m.shape[seq_axis] > 1 and q.shape[2] % m.shape[seq_axis] == 0):
+                and m.shape[seq_axis] > 1
+                and q.shape[2] % m.shape[seq_axis] == 0
+                and k.shape[2] % m.shape[seq_axis] == 0):
             mesh = m
     if mesh is not None:
         from ..parallel.sequence import sequence_sharded_attention

@@ -141,7 +141,11 @@ for _a, _b in [("_plus", "elemwise_add"), ("_add", "elemwise_add"),
                ("_mul", "elemwise_mul"), ("_div", "elemwise_div"),
                ("_grad_add", "elemwise_add"), ("_mod", "broadcast_mod"),
                ("_Power", "_power"), ("_Maximum", "_maximum"),
-               ("_Minimum", "_minimum")]:
+               ("_Minimum", "_minimum"),
+               # legacy spellings (reference elemwise_binary_broadcast_
+               # op_basic.cc registers plus/minus as aliases of add/sub)
+               ("broadcast_plus", "broadcast_add"),
+               ("broadcast_minus", "broadcast_sub")]:
     alias(_a, _b)
 
 
@@ -235,6 +239,16 @@ alias("max_axis", "max")
 alias("min_axis", "min")
 
 
+@register("_square_sum", attrs=_REDUCE_SPEC)
+def _square_sum_op(data, axis=None, keepdims=False, exclude=False):
+    """sum(data**2) over axes (reference square_sum-inl.h — fused there to
+    skip materializing the square for row-sparse inputs; XLA fuses the
+    square into the reduction here, and `ndarray/sparse.py:_square_sum`
+    keeps the rsp fast path at the NDArray level)."""
+    axes = _norm_axes(axis, data.ndim, exclude)
+    return jnp.sum(data * data, axis=axes, keepdims=keepdims)
+
+
 @register("norm")
 def _norm(x):
     # reference norm flattens and takes the L2 norm (broadcast_reduce_op_value.cc)
@@ -293,6 +307,16 @@ _DOT_SPEC = AttrSpec(transpose_a=("bool", False), transpose_b=("bool", False))
 
 @register("dot", num_inputs=2, input_names=["lhs", "rhs"], attrs=_DOT_SPEC)
 def _dot(a, b, transpose_a=False, transpose_b=False):
+    from jax.experimental import sparse as jsparse
+    if isinstance(a, jsparse.BCOO):
+        # symbolic CSR·dense dot (reference dot-inl.h FComputeEx): the
+        # csr argument reaches the jitted graph as a BCOO pytree, never
+        # densified; XLA lowers bcoo_dot_general to gather/scatter
+        if transpose_a:
+            a = a.transpose()
+        return jsparse.bcoo_dot_general(
+            a, jnp.moveaxis(b, -1, 0) if transpose_b and b.ndim > 1 else b,
+            dimension_numbers=(([a.ndim - 1], [0]), ([], [])))
     if a.ndim == 1 and b.ndim == 1:
         return jnp.dot(a, b)
     if transpose_a:

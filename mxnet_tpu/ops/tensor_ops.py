@@ -193,9 +193,35 @@ def _crop(*args, num_args=1, offset=(0, 0), h_w=(0, 0), center_crop=False):
           param_shapes=lambda attrs, shapes: [
               shapes[0], (int(attrs["input_dim"]), int(attrs["output_dim"]))],
           attrs=AttrSpec(input_dim=("int",), output_dim=("int",),
-                         dtype=("str", "float32")))
-def _embedding(data, weight, input_dim, output_dim, dtype="float32"):
+                         dtype=("str", "float32"),
+                         sparse_grad=("bool", False)))
+def _embedding(data, weight, input_dim, output_dim, dtype="float32",
+               sparse_grad=False):
+    """Table lookup. ``sparse_grad=True`` marks the weight gradient as
+    row_sparse: the symbolic executor then produces a RowSparseNDArray
+    holding only the touched rows instead of a dense (input_dim,
+    output_dim) buffer (reference: FInferStorageType of the sparse
+    embedding path; the later mxnet Embedding(sparse_grad=True) API)."""
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("cast_storage", attrs=AttrSpec(stype=("str",)))
+def _cast_storage_op(data, stype):
+    """Storage cast inside a traced graph (reference cast_storage-inl.h).
+
+    'default' densifies a BCOO input; 'csr'/'row_sparse' yield a BCOO
+    (jax's sparse pytree — the jit-compatible representation both map
+    to; the CSR/RSP component view lives at the NDArray level,
+    ndarray/sparse.py cast_storage). nse is bounded by size under
+    tracing, so this is a semantic cast, not a compression pass."""
+    from jax.experimental import sparse as jsparse
+    if stype == "default":
+        return data.todense() if isinstance(data, jsparse.BCOO) else data
+    if stype not in ("csr", "row_sparse"):
+        raise MXNetError(f"cast_storage: unknown stype {stype!r}")
+    if isinstance(data, jsparse.BCOO):
+        return data
+    return jsparse.bcoo_fromdense(data, nse=data.size)
 
 
 @register("take", num_inputs=2, input_names=["a", "indices"],

@@ -20,7 +20,17 @@ from ..base import MXNetError
 
 __all__ = ["make_mesh", "local_mesh", "mesh_scope", "current_mesh"]
 
-_MESH_STACK = []
+import threading as _threading
+
+
+class _MeshTLS(_threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+# thread-local like AttrScope (symbol.py): concurrent trainers on
+# different threads must not pop each other's ambient mesh mid-trace
+_MESH_TLS = _MeshTLS()
 
 
 class mesh_scope:
@@ -40,17 +50,18 @@ class mesh_scope:
         self.mesh = mesh
 
     def __enter__(self):
-        _MESH_STACK.append(self.mesh)
+        _MESH_TLS.stack.append(self.mesh)
         return self.mesh
 
     def __exit__(self, *exc):
-        _MESH_STACK.pop()
+        _MESH_TLS.stack.pop()
         return False
 
 
 def current_mesh() -> Optional[Mesh]:
-    """The innermost active :class:`mesh_scope` mesh, or None."""
-    return _MESH_STACK[-1] if _MESH_STACK else None
+    """The innermost active :class:`mesh_scope` mesh on this thread."""
+    stack = _MESH_TLS.stack
+    return stack[-1] if stack else None
 
 
 def make_mesh(axes: Optional[Dict[str, int]] = None,

@@ -292,19 +292,28 @@ class SPMDTrainer:
             return new_params, new_states, new_aux, outs
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        # sequence parallelism: shard the sequence dim (dim 1) of token
+        # inputs over the axis the graph's attention ops actually name —
+        # not a hardcoded literal — so inputs arrive pre-sharded for the
+        # shard_map and non-sequence models never get a spurious split
+        seq_axis = None
+        for node in self._symbol._topo_nodes():
+            if node.is_variable or node.op.name != "MultiHeadAttention":
+                continue
+            ax = node.attrs.get("seq_axis")
+            if ax and ax in mesh.axis_names and mesh.shape[ax] > 1:
+                seq_axis = ax
+                break
         self._in_shardings = {}
         for n in list(self._data_names) + list(self._label_names):
             if n not in known:
                 continue
             shp = tuple(known[n])
             spec = list(batch_pspec(mesh, len(shp)))
-            # sequence parallelism: dim 1 (the sequence dim of token
-            # inputs) shards over a 'seq' mesh axis when present
             spec += [None] * (len(shp) - len(spec))
-            if (len(shp) >= 2 and "seq" in mesh.axis_names
-                    and mesh.shape["seq"] > 1 and spec[1] is None
-                    and shp[1] % mesh.shape["seq"] == 0):
-                spec[1] = "seq"
+            if (seq_axis is not None and len(shp) >= 2 and spec[1] is None
+                    and shp[1] % mesh.shape[seq_axis] == 0):
+                spec[1] = seq_axis
             self._in_shardings[n] = NamedSharding(mesh, P(*spec))
         return self
 

@@ -378,6 +378,50 @@ class Symbol:
         aux_types = [_np.dtype("float32")] * len(self.list_auxiliary_states())
         return arg_types, out_types, aux_types
 
+    def infer_storage_type(self, **kwargs):
+        """Forward storage-type inference over the graph.
+
+        Rebuild of the InferStorageType pass
+        (src/executor/infer_graph_attr_pass.cc:356 + per-op
+        FInferStorageType): variables get stypes from ``kwargs``
+        (``name='csr'``), their ``stype=`` declaration, or 'default';
+        op outputs follow the rule table below, with the reference's
+        dense-fallback semantics (any un-ruled op treats sparse inputs
+        as densified and produces dense outputs). Returns
+        (arg_stypes, out_stypes, aux_stypes).
+        """
+        def out_rule(node, ins):
+            op = node.op.name
+            if op == "cast_storage":
+                return [node.attrs.get("stype", "default")]
+            if op == "sparse_retain":
+                return ["row_sparse"]
+            if op in ("elemwise_add", "ElementWiseSum", "add_n"):
+                if ins and all(s == "row_sparse" for s in ins):
+                    return ["row_sparse"] * node.num_outputs()
+            # dot(csr, dense) and every other op: dense out (fallback)
+            return ["default"] * node.num_outputs()
+
+        stypes: Dict[Tuple[int, int], str] = {}
+        arg_stypes, aux_stypes = [], []
+        aux_ids = self._aux_node_ids()
+        for node in self._topo_nodes():
+            if node.is_variable:
+                st = kwargs.get(node.name,
+                                node.attrs.get("__storage_type__", "default"))
+                stypes[(id(node), 0)] = st
+                (aux_stypes if id(node) in aux_ids
+                 else arg_stypes).append((node.name, st))
+                continue
+            ins = [stypes[(id(p), i)] for p, i in node.inputs]
+            for i, st in enumerate(out_rule(node, ins)):
+                stypes[(id(node), i)] = st
+        arg_order = self.list_arguments()
+        arg_map = dict(arg_stypes)
+        out_stypes = [stypes[(id(n), i)] for n, i in self._outputs]
+        return ([arg_map.get(n, "default") for n in arg_order], out_stypes,
+                [st for _, st in aux_stypes])
+
     def _infer_structs(self, known_shapes: Dict[str, tuple], partial=False,
                        dtypes: Optional[Dict[str, str]] = None):
         """Forward shape propagation with param-shape completion.
@@ -398,9 +442,12 @@ class Symbol:
             if shape is None and "__shape__" in node.attrs:
                 # declared shape on the Variable itself participates in
                 # inference (reference: mx.sym.var(shape=...) feeds the
-                # InferShape pass)
-                shape = tuple(int(x)
-                              for x in _parse_tuple(node.attrs["__shape__"]))
+                # InferShape pass) — but only when complete: dim 0 means
+                # "unknown, infer me" (gluon deferred init passes these)
+                declared = tuple(int(x)
+                                 for x in _parse_tuple(node.attrs["__shape__"]))
+                if declared and all(d > 0 for d in declared):
+                    shape = declared
             if shape is None:
                 return None
             dt = dtypes.get(node.name, node.attrs.get("__dtype__", "float32"))
@@ -617,6 +664,8 @@ def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
         attrs["__wd_mult__"] = str(wd_mult)
     if init is not None:
         attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    if stype is not None:
+        attrs["__storage_type__"] = str(stype)
     node = SymbolNode(None, name, attrs, [])
     if attr:
         node.scope_attrs.update({k: str(v) for k, v in attr.items()})

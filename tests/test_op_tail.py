@@ -157,3 +157,32 @@ def test_multi_proposal_batched():
         rpn_min_size=1).asnumpy()
     assert rois.shape == (12, 5)
     assert set(rois[:, 0].tolist()) == {0.0, 1.0}  # both image indices
+
+
+def test_square_sum_op_dense_and_grad():
+    # reference square_sum-inl.h: fused sum of squares over axes
+    rng = np.random.RandomState(0)
+    x = rng.randn(5, 7).astype(np.float32)
+    nd_x = mx.nd.array(x)
+    np.testing.assert_allclose(
+        mx.nd._square_sum(nd_x, axis=(1,), keepdims=True).asnumpy(),
+        (x * x).sum(1, keepdims=True), rtol=1e-5)
+    # symbolic + gradient: d/dx sum(x^2) = 2x
+    v = mx.sym.var("data")
+    s = mx.sym._square_sum(v)
+    ex = s.simple_bind(mx.cpu(), data=(5, 7), grad_req="write")
+    ex.arg_dict["data"][:] = nd_x
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), (x * x).sum(), rtol=1e-5)
+    ex.backward(mx.nd.ones(out.shape))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-5)
+
+
+def test_broadcast_plus_minus_aliases():
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = mx.nd.array(np.ones((1, 3), np.float32))
+    np.testing.assert_allclose(mx.nd.broadcast_plus(a, b).asnumpy(),
+                               a.asnumpy() + b.asnumpy())
+    np.testing.assert_allclose(mx.nd.broadcast_minus(a, b).asnumpy(),
+                               a.asnumpy() - b.asnumpy())

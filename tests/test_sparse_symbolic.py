@@ -1,0 +1,230 @@
+"""Symbolic sparse execution (VERDICT r1 #6).
+
+Storage-type inference over Symbol graphs + the flagship sparse path:
+Embedding(sparse_grad=True) produces RowSparseNDArray weight gradients
+through the symbolic executor and Module.fit — the dense (vocab, dim)
+gradient is never materialized — and the update stays sparse through the
+optimizer's lazy row update and the kvstore's server-side-optimizer
+analog. CSR inputs flow through jitted graphs as BCOO (dot never
+densifies). Reference: infer_graph_attr_pass.cc:356,
+attach_op_execs_pass.cc:47-200, the sparse embedding FComputeEx path.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+
+def _embed_net(V, D, C):
+    data = mx.sym.var("data")
+    w = mx.sym.var("embed_weight", stype="row_sparse")
+    emb = mx.sym.Embedding(data, w, input_dim=V, output_dim=D,
+                           sparse_grad=True, name="embed")
+    fc = mx.sym.FullyConnected(emb, num_hidden=C, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def test_embedding_sparse_grad_rows_and_values():
+    V, D, B = 1000, 16, 8
+    data = mx.sym.var("data")
+    w = mx.sym.var("embed_weight", stype="row_sparse")
+    emb = mx.sym.Embedding(data, w, input_dim=V, output_dim=D,
+                           sparse_grad=True, name="embed")
+    out = mx.sym.sum(emb)
+    ex = out.simple_bind(mx.cpu(), data=(B,),
+                         grad_req={"embed_weight": "write", "data": "null"})
+    ex.arg_dict["embed_weight"][:] = mx.nd.array(
+        np.random.RandomState(0).randn(V, D).astype(np.float32))
+    idx = np.array([3, 5, 3, 999, 0, 5, 5, 42], np.float32)
+    ex.forward(is_train=True, data=idx)
+    ex.backward()
+    g = ex.grad_dict["embed_weight"]
+    assert isinstance(g, RowSparseNDArray)      # never densified
+    assert g.data.shape == (5, D)               # unique rows only
+    assert list(g.indices.asnumpy()) == [0, 3, 5, 42, 999]
+    counts = {0: 1, 3: 2, 5: 3, 42: 1, 999: 1}
+    for r, v in zip(g.indices.asnumpy(), g.data.asnumpy()):
+        np.testing.assert_allclose(v, counts[int(r)] * np.ones(D),
+                                   rtol=1e-6)
+
+
+def test_sparse_grad_matches_dense_grad():
+    """The rsp grad, densified, must equal the ordinary dense grad."""
+    V, D, C, B = 50, 8, 4, 16
+    rng = np.random.RandomState(1)
+    idx = rng.randint(0, V, (B,)).astype(np.float32)
+    lab = rng.randint(0, C, (B,)).astype(np.float32)
+    W = rng.randn(V, D).astype(np.float32)
+    fcw = rng.randn(C, D).astype(np.float32)
+    grads = {}
+    for sparse in (True, False):
+        data = mx.sym.var("data")
+        w = mx.sym.var("embed_weight")
+        emb = mx.sym.Embedding(data, w, input_dim=V, output_dim=D,
+                               sparse_grad=sparse, name="embed")
+        fc = mx.sym.FullyConnected(emb, num_hidden=C, name="fc")
+        net = mx.sym.SoftmaxOutput(fc, name="softmax")
+        ex = net.simple_bind(mx.cpu(), data=(B,), softmax_label=(B,),
+                             grad_req={"embed_weight": "write",
+                                       "fc_weight": "write",
+                                       "fc_bias": "null", "data": "null",
+                                       "softmax_label": "null"})
+        ex.arg_dict["embed_weight"][:] = mx.nd.array(W)
+        ex.arg_dict["fc_weight"][:] = mx.nd.array(fcw)
+        ex.forward(is_train=True, data=idx, softmax_label=lab)
+        ex.backward()
+        g = ex.grad_dict["embed_weight"]
+        grads[sparse] = (g.todense().asnumpy()
+                         if isinstance(g, RowSparseNDArray) else g.asnumpy())
+        if sparse:
+            assert isinstance(g, RowSparseNDArray)
+            assert g.data.shape[0] == len(np.unique(idx))
+    np.testing.assert_allclose(grads[True], grads[False],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_module_fit_sparse_embedding_stays_sparse():
+    """Flagship: Module.fit on an embedding classifier; every step's
+    weight grad is row_sparse and training converges."""
+    from mxnet_tpu.io import NDArrayIter
+
+    V, D, C, B = 200, 16, 4, 32
+    rng = np.random.RandomState(2)
+    tokens = rng.randint(0, V, (256,)).astype(np.float32)
+    labels = (tokens.astype(int) % C).astype(np.float32)
+    it = NDArrayIter(tokens, labels, batch_size=B, shuffle=False,
+                     label_name="softmax_label")
+    mod = mx.mod.Module(_embed_net(V, D, C), data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 1.0},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc")
+    g = mod._exec.grad_dict["embed_weight"]
+    assert isinstance(g, RowSparseNDArray)
+    assert g.data.shape[0] <= B < V    # rows bounded by batch, not vocab
+    score = mod.score(it, mx.metric.Accuracy())
+    it.reset()
+    assert dict(score)["accuracy"] > 0.95
+
+
+def test_update_on_kvstore_row_sparse():
+    """The server-side-optimizer analog with rsp grads: push a row_sparse
+    gradient, let the store-side updater apply it lazily, row_sparse_pull
+    only the touched rows."""
+    V, D = 100, 8
+    kv = mx.kv.create("local")
+    opt = mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0)
+    kv.set_optimizer(opt)
+    w0 = np.ones((V, D), np.float32)
+    kv.init("w", mx.nd.array(w0))
+    rows = np.array([3, 7], np.int64)
+    vals = np.full((2, D), 2.0, np.float32)
+    kv.push("w", sp.RowSparseNDArray(vals, rows, (V, D)))
+    out = mx.nd.zeros((V, D))
+    kv.pull("w", out=out)
+    got = out.asnumpy()
+    expect = w0.copy()
+    expect[rows] -= 0.5 * 2.0       # only touched rows updated
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+    # row_sparse_pull fills just the requested rows
+    pulled = sp.zeros("row_sparse", (V, D))
+    kv.row_sparse_pull("w", out=pulled, row_ids=mx.nd.array([3.0, 50.0]))
+    assert isinstance(pulled, RowSparseNDArray)
+    assert pulled.data.shape[0] == 2            # only the asked-for rows
+    np.testing.assert_allclose(pulled.todense().asnumpy()[3],
+                               expect[3], rtol=1e-5)
+
+
+def test_infer_storage_type_rules():
+    x = mx.sym.var("x", stype="csr")
+    w = mx.sym.var("w")
+    y = mx.sym.dot(x, w)
+    arg_st, out_st, _ = y.infer_storage_type()
+    assert dict(zip(y.list_arguments(), arg_st)) == {"x": "csr",
+                                                     "w": "default"}
+    assert out_st == ["default"]        # dot(csr, dense) -> dense out
+
+    a = mx.sym.var("a", stype="row_sparse")
+    b = mx.sym.var("b", stype="row_sparse")
+    s = mx.sym.elemwise_add(a, b)
+    assert s.infer_storage_type()[1] == ["row_sparse"]
+    # dense fallback: rsp through an un-ruled op densifies
+    t = mx.sym.Activation(a, act_type="relu")
+    assert t.infer_storage_type()[1] == ["default"]
+    c = mx.sym.cast_storage(mx.sym.var("d"), stype="csr")
+    assert c.infer_storage_type()[1] == ["csr"]
+
+
+def test_symbolic_csr_dot_never_densifies():
+    x = mx.sym.var("x", stype="csr")
+    w = mx.sym.var("w")
+    y = mx.sym.dot(x, w)
+    ex = y.simple_bind(mx.cpu(), x=(4, 6), w=(6, 3), grad_req="null")
+    dense = np.zeros((4, 6), np.float32)
+    dense[0, 1], dense[2, 4] = 2.0, 3.0
+    ex.arg_dict["x"] = sp.csr_matrix(dense)
+    wv = np.random.RandomState(3).randn(6, 3).astype(np.float32)
+    ex.arg_dict["w"][:] = mx.nd.array(wv)
+    out = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, dense @ wv, rtol=1e-5)
+
+
+def test_sparse_grad_add_req_rejected():
+    net = _embed_net(50, 8, 4)
+    with pytest.raises(mx.MXNetError):
+        net.simple_bind(mx.cpu(), data=(4,), softmax_label=(4,),
+                        grad_req={"embed_weight": "add", "fc_weight": "write",
+                                  "fc_bias": "write", "data": "null",
+                                  "softmax_label": "null"})
+
+
+def test_reshape_executor_backward_works():
+    # regression: reshaped (shared_exec) executors must keep working
+    # through backward, including when the symbol has no sparse nodes
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3, name="fc"),
+        name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,),
+                         grad_req="write")
+    ex2 = ex.reshape(data=(8, 6), softmax_label=(8,))
+    rng = np.random.RandomState(0)
+    ex2.forward(is_train=True, data=rng.rand(8, 6).astype(np.float32),
+                softmax_label=np.zeros(8, np.float32))
+    ex2.backward()
+    assert np.isfinite(ex2.grad_dict["fc_weight"].asnumpy()).all()
+
+
+def test_tied_sparse_embedding_falls_back_dense():
+    # weight consumed twice (embedding + tied lm head): sparse-grad path
+    # must fall back to the always-correct dense gradient
+    V, D, B = 30, 8, 4
+    data = mx.sym.var("data")
+    w = mx.sym.var("embed_weight")
+    emb = mx.sym.Embedding(data, w, input_dim=V, output_dim=D,
+                           sparse_grad=True, name="embed")
+    pooled = mx.sym.mean(emb, axis=(1,)) if False else emb
+    logits = mx.sym.dot(pooled, w, transpose_b=True)
+    out = mx.sym.sum(logits)
+    ex = out.simple_bind(mx.cpu(), data=(B,),
+                         grad_req={"embed_weight": "write", "data": "null"})
+    rng = np.random.RandomState(0)
+    W = rng.randn(V, D).astype(np.float32)
+    ex.arg_dict["embed_weight"][:] = mx.nd.array(W)
+    idx = np.array([1, 2, 1, 5], np.float32)
+    ex.forward(is_train=True, data=idx)
+    ex.backward()
+    g = ex.grad_dict["embed_weight"]
+    assert not isinstance(g, RowSparseNDArray)   # dense fallback
+    # numeric check vs autodiff-free formula: out = sum(E @ W^T),
+    # dE = sum_cols(W) rows scattered; dW via both paths
+    import jax.numpy as jnp
+    def f(Wj):
+        E = jnp.take(Wj, jnp.asarray(idx, jnp.int32), axis=0)
+        return jnp.sum(E @ Wj.T)
+    import jax
+    expect = jax.grad(f)(jnp.asarray(W))
+    np.testing.assert_allclose(g.asnumpy(), np.asarray(expect),
+                               rtol=1e-4, atol=1e-5)
