@@ -16,7 +16,8 @@ PY_INCLUDES := $(shell python3-config --includes)
 PY_LDFLAGS  := $(shell python3-config --ldflags) \
                -lpython$(shell python3 -c 'import sys; print("%d.%d" % sys.version_info[:2])')
 
-all: $(LIBDIR)/libmxtpu_io.so $(LIBDIR)/libmxtpu_predict.so
+all: $(LIBDIR)/libmxtpu_io.so $(LIBDIR)/libmxtpu_predict.so \
+     $(LIBDIR)/libmxtpu.so
 
 $(LIBDIR)/libmxtpu_io.so: $(IO_SRCS) src/io/mxtpu_io.h
 	@mkdir -p $(LIBDIR)
@@ -27,6 +28,15 @@ $(LIBDIR)/libmxtpu_io.so: $(IO_SRCS) src/io/mxtpu_io.h
 $(LIBDIR)/libmxtpu_predict.so: src/capi/c_predict_api.cc src/capi/c_predict_api.h
 	@mkdir -p $(LIBDIR)
 	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) src/capi/c_predict_api.cc \
+	    $(LDFLAGS) $(PY_LDFLAGS) -o $@
+
+# Training C ABI: NDArray/Symbol/Executor/KVStore core (c_api.h);
+# embeds CPython and drives mxnet_tpu/c_api.py (reference analogue:
+# src/c_api/{c_api.cc,c_api_ndarray.cc,c_api_symbolic.cc,...})
+$(LIBDIR)/libmxtpu.so: src/capi/c_api.cc src/capi/c_api.h \
+                       src/capi/embed_common.h
+	@mkdir -p $(LIBDIR)
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) src/capi/c_api.cc \
 	    $(LDFLAGS) $(PY_LDFLAGS) -o $@
 
 clean:

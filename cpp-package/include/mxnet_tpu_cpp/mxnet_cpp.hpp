@@ -1,0 +1,399 @@
+/*
+ * Header-only C++ training API over the training C ABI (libmxtpu.so).
+ *
+ * Reference analogue: cpp-package/include/mxnet-cpp/MxNetCpp.h — the
+ * header-only C++ frontend binding c_api.h (NDArray/Symbol/Executor/
+ * Optimizer/KVStore). RAII wrappers; float32 at the boundary; errors
+ * surface as std::runtime_error carrying MXTrainGetLastError().
+ *
+ * Usage sketch (see examples/cpp-train/train_mlp.cc):
+ *   auto data = Symbol::Variable("data");
+ *   auto fc   = Symbol::Create("FullyConnected", {{"num_hidden","64"}})
+ *                   .Compose("fc1", {data});
+ *   Executor exec(net, args, grads, reqs, aux);
+ *   exec.Forward(true); exec.Backward();
+ *   SGDOptimizer opt(0.1f); opt.Update(args[i], grads[i]);
+ */
+#ifndef MXTPU_CPP_MXNET_CPP_HPP_
+#define MXTPU_CPP_MXNET_CPP_HPP_
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../../../src/capi/c_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+inline void TCheck(int ret) {
+  if (ret != 0) throw std::runtime_error(MXTrainGetLastError());
+}
+
+using KWArgs = std::vector<std::pair<std::string, std::string>>;
+
+/* RAII NDArray (float32). Copy semantics: shared handle via shared_ptr,
+ * like the reference cpp-package NDArray. */
+class NDArray {
+ public:
+  NDArray() = default;
+
+  explicit NDArray(const std::vector<mx_uint> &shape, int dev_type = 1,
+                   int dev_id = 0) {
+    NDArrayHandle h = nullptr;
+    TCheck(MXNDArrayCreate(shape.data(),
+                           static_cast<mx_uint>(shape.size()), dev_type,
+                           dev_id, 0, &h));
+    reset(h);
+  }
+
+  static NDArray FromData(const std::vector<mx_uint> &shape,
+                          const float *data, int dev_type = 1,
+                          int dev_id = 0) {
+    NDArray a(shape, dev_type, dev_id);
+    a.SyncCopyFromCPU(data, a.Size());
+    return a;
+  }
+
+  void SyncCopyFromCPU(const float *data, size_t size) {
+    TCheck(MXNDArraySyncCopyFromCPU(handle(), data, size));
+  }
+
+  std::vector<float> SyncCopyToCPU() const {
+    std::vector<float> out(Size());
+    TCheck(MXNDArraySyncCopyToCPU(handle(), out.data(), out.size()));
+    return out;
+  }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *shp = nullptr;
+    TCheck(MXNDArrayGetShape(handle(), &ndim, &shp));
+    return std::vector<mx_uint>(shp, shp + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+
+  NDArrayHandle handle() const { return h_ ? h_->h : nullptr; }
+
+  /* wrap a handle produced by the ABI (takes ownership) */
+  static NDArray Own(NDArrayHandle h) {
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+
+ private:
+  struct Holder {
+    explicit Holder(NDArrayHandle hh) : h(hh) {}
+    Holder(const Holder &) = delete;
+    Holder &operator=(const Holder &) = delete;
+    ~Holder() { MXNDArrayFree(h); }
+    NDArrayHandle h;
+  };
+  void reset(NDArrayHandle h) { h_ = std::make_shared<Holder>(h); }
+  std::shared_ptr<Holder> h_;
+};
+
+/* Invoke a registered operator imperatively by name. */
+inline std::vector<NDArray> InvokeOp(const std::string &op,
+                                     const std::vector<NDArray> &inputs,
+                                     const KWArgs &params = {}) {
+  std::vector<NDArrayHandle> in;
+  for (const auto &a : inputs) in.push_back(a.handle());
+  std::vector<const char *> keys, vals;
+  for (const auto &kv : params) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int n_out = 0;
+  NDArrayHandle *outs = nullptr;
+  TCheck(MXImperativeInvokeByName(
+      op.c_str(), static_cast<int>(in.size()), in.data(), &n_out, &outs,
+      static_cast<int>(keys.size()), keys.data(), vals.data()));
+  std::vector<NDArray> result;
+  for (int i = 0; i < n_out; ++i) result.push_back(NDArray::Own(outs[i]));
+  return result;
+}
+
+class Symbol {
+ public:
+  Symbol() = default;
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    TCheck(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  /* atomic op symbol: compose with inputs to form the graph node */
+  static Symbol Create(const std::string &op, const KWArgs &params = {}) {
+    mx_uint n = 0;
+    AtomicSymbolCreator *creators = nullptr;
+    TCheck(MXSymbolListAtomicSymbolCreators(&n, &creators));
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *name = nullptr;
+      TCheck(MXSymbolGetAtomicSymbolName(creators[i], &name));
+      if (op == name) {
+        std::vector<const char *> keys, vals;
+        for (const auto &kv : params) {
+          keys.push_back(kv.first.c_str());
+          vals.push_back(kv.second.c_str());
+        }
+        SymbolHandle h = nullptr;
+        TCheck(MXSymbolCreateAtomicSymbol(
+            creators[i], static_cast<mx_uint>(keys.size()), keys.data(),
+            vals.data(), &h));
+        return Symbol(h);
+      }
+    }
+    throw std::runtime_error("unknown operator " + op);
+  }
+
+  Symbol Compose(const std::string &name,
+                 const std::vector<Symbol> &args) const {
+    std::vector<SymbolHandle> hs;
+    for (const auto &a : args) hs.push_back(a.handle());
+    TCheck(MXSymbolCompose(handle(), name.c_str(),
+                           static_cast<mx_uint>(hs.size()), nullptr,
+                           hs.data()));
+    return *this;
+  }
+
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    TCheck(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+
+  std::string ToJSON() const {
+    const char *js = nullptr;
+    TCheck(MXSymbolSaveToJSON(handle(), &js));
+    return js;
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return StrQuery(MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrQuery(MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrQuery(MXSymbolListAuxiliaryStates);
+  }
+
+  /* arg name -> shape for the given inputs; also fills out/aux shapes */
+  void InferShape(
+      const std::map<std::string, std::vector<mx_uint>> &known,
+      std::vector<std::vector<mx_uint>> *arg_shapes,
+      std::vector<std::vector<mx_uint>> *out_shapes = nullptr,
+      std::vector<std::vector<mx_uint>> *aux_shapes = nullptr) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0}, data;
+    for (const auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) data.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_n = 0, out_n = 0, aux_n = 0;
+    const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+    const mx_uint **in_d = nullptr, **out_d = nullptr, **aux_d = nullptr;
+    int complete = 0;
+    TCheck(MXSymbolInferShape(handle(),
+                              static_cast<mx_uint>(keys.size()),
+                              keys.data(), indptr.data(), data.data(),
+                              &in_n, &in_nd, &in_d, &out_n, &out_nd,
+                              &out_d, &aux_n, &aux_nd, &aux_d, &complete));
+    auto fill = [](mx_uint n, const mx_uint *nd, const mx_uint **d,
+                   std::vector<std::vector<mx_uint>> *out) {
+      if (!out) return;
+      out->clear();
+      for (mx_uint i = 0; i < n; ++i)
+        out->emplace_back(d[i], d[i] + nd[i]);
+    };
+    fill(in_n, in_nd, in_d, arg_shapes);
+    fill(out_n, out_nd, out_d, out_shapes);
+    fill(aux_n, aux_nd, aux_d, aux_shapes);
+  }
+
+  SymbolHandle handle() const { return h_ ? h_->h : nullptr; }
+
+ private:
+  explicit Symbol(SymbolHandle h) { h_ = std::make_shared<Holder>(h); }
+  struct Holder {
+    explicit Holder(SymbolHandle hh) : h(hh) {}
+    Holder(const Holder &) = delete;
+    Holder &operator=(const Holder &) = delete;
+    ~Holder() { MXSymbolFree(h); }
+    SymbolHandle h;
+  };
+  std::shared_ptr<Holder> h_;
+
+  template <typename Fn>
+  std::vector<std::string> StrQuery(Fn fn) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    TCheck(fn(handle(), &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+};
+
+enum class GradReq : mx_uint { kNull = 0, kWrite = 1, kAdd = 3 };
+
+class Executor {
+ public:
+  Executor(const Symbol &sym, const std::vector<NDArray> &args,
+           const std::vector<NDArray> &arg_grads,
+           const std::vector<GradReq> &reqs,
+           const std::vector<NDArray> &aux, int dev_type = 1,
+           int dev_id = 0)
+      : sym_(sym) {
+    std::vector<NDArrayHandle> a, g, x;
+    std::vector<mx_uint> r;
+    for (const auto &v : args) a.push_back(v.handle());
+    for (const auto &v : arg_grads) g.push_back(v.handle());
+    for (const auto &q : reqs) r.push_back(static_cast<mx_uint>(q));
+    for (const auto &v : aux) x.push_back(v.handle());
+    ExecutorHandle h = nullptr;
+    TCheck(MXExecutorBindEX(sym.handle(), dev_type, dev_id,
+                            static_cast<mx_uint>(a.size()), a.data(),
+                            g.data(), r.data(),
+                            static_cast<mx_uint>(x.size()), x.data(), &h));
+    h_ = std::make_shared<Holder>(h);
+  }
+
+  void Forward(bool is_train) {
+    TCheck(MXExecutorForward(h_->h, is_train ? 1 : 0));
+  }
+
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (const auto &v : head_grads) hg.push_back(v.handle());
+    TCheck(MXExecutorBackward(h_->h, static_cast<mx_uint>(hg.size()),
+                              hg.empty() ? nullptr : hg.data()));
+  }
+
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle *outs = nullptr;
+    TCheck(MXExecutorOutputs(h_->h, &n, &outs));
+    std::vector<NDArray> result;
+    /* handles are caller-owned (c_api.h) — NDArray::Own frees them */
+    for (mx_uint i = 0; i < n; ++i)
+      result.push_back(NDArray::Own(outs[i]));
+    return result;
+  }
+
+ private:
+  struct Holder {
+    explicit Holder(ExecutorHandle hh) : h(hh) {}
+    Holder(const Holder &) = delete;
+    Holder &operator=(const Holder &) = delete;
+    ~Holder() { MXExecutorFree(h); }
+    ExecutorHandle h;
+  };
+  Symbol sym_;  /* keep the graph alive as long as the executor */
+  std::shared_ptr<Holder> h_;
+};
+
+/* Optimizers run through the registered update ops (the reference
+ * cpp-package does the same: optimizer.cpp invokes sgd_update /
+ * sgd_mom_update through the op ABI). */
+class SGDOptimizer {
+ public:
+  explicit SGDOptimizer(float lr, float momentum = 0.0f, float wd = 0.0f,
+                        float rescale_grad = 1.0f)
+      : lr_(lr), momentum_(momentum), wd_(wd), rescale_(rescale_grad) {}
+
+  void Update(NDArray *weight, const NDArray &grad) {
+    KWArgs kw{{"lr", std::to_string(lr_)},
+              {"wd", std::to_string(wd_)},
+              {"rescale_grad", std::to_string(rescale_)}};
+    std::vector<NDArray> outs;
+    if (momentum_ != 0.0f) {
+      auto it = states_.find(weight->handle());
+      if (it == states_.end()) {
+        NDArray m(weight->Shape());
+        it = states_.emplace(weight->handle(), m).first;
+      }
+      kw.push_back({"momentum", std::to_string(momentum_)});
+      outs = InvokeOp("sgd_mom_update", {*weight, grad, it->second}, kw);
+      it->second = outs[1];
+    } else {
+      outs = InvokeOp("sgd_update", {*weight, grad}, kw);
+    }
+    /* functional update: copy the new value into the executor-visible
+     * buffer device-to-device (no host round trip) */
+    TCheck(MXNDArrayAssign(weight->handle(), outs[0].handle()));
+  }
+
+ private:
+  float lr_, momentum_, wd_, rescale_;
+  std::map<NDArrayHandle, NDArray> states_;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    KVStoreHandle h = nullptr;
+    TCheck(MXKVStoreCreate(type.c_str(), &h));
+    h_ = std::make_shared<Holder>(h);
+  }
+
+  std::string Type() const {
+    const char *t = nullptr;
+    TCheck(MXKVStoreGetType(h_->h, &t));
+    return t;
+  }
+
+  void Init(const std::string &key, const NDArray &val) {
+    const char *k = key.c_str();
+    NDArrayHandle v = val.handle();
+    TCheck(MXKVStoreInitEx(h_->h, 1, &k, &v));
+  }
+
+  void Push(const std::string &key, const NDArray &val, int priority = 0) {
+    const char *k = key.c_str();
+    NDArrayHandle v = val.handle();
+    TCheck(MXKVStorePushEx(h_->h, 1, &k, &v, priority));
+  }
+
+  void Pull(const std::string &key, NDArray *out, int priority = 0) {
+    const char *k = key.c_str();
+    NDArrayHandle v = out->handle();
+    TCheck(MXKVStorePullEx(h_->h, 1, &k, &v, priority));
+  }
+
+  void SetOptimizer(const std::string &name, const KWArgs &params) {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    TCheck(MXKVStoreSetOptimizer(h_->h, name.c_str(),
+                                 static_cast<mx_uint>(keys.size()),
+                                 keys.data(), vals.data()));
+  }
+
+ private:
+  struct Holder {
+    explicit Holder(KVStoreHandle hh) : h(hh) {}
+    Holder(const Holder &) = delete;
+    Holder &operator=(const Holder &) = delete;
+    ~Holder() { MXKVStoreFree(h); }
+    KVStoreHandle h;
+  };
+  std::shared_ptr<Holder> h_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  /* MXTPU_CPP_MXNET_CPP_HPP_ */

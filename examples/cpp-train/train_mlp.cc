@@ -1,0 +1,124 @@
+/*
+ * Train an MLP classifier in pure C++ over the training C ABI.
+ *
+ * Reference analogue: cpp-package/example/mlp.cpp — build the symbol
+ * graph with Symbol::Create/Compose, bind an Executor with
+ * caller-provided NDArrays, run forward/backward per batch, update with
+ * the SGD optimizer (registered update ops via the imperative ABI).
+ *
+ * Build + run (from the repo root, after `make`):
+ *   g++ -O2 -std=c++17 examples/cpp-train/train_mlp.cc \
+ *       -Lmxnet_tpu/_lib -lmxtpu -Wl,-rpath,$PWD/mxnet_tpu/_lib \
+ *       -o /tmp/train_mlp
+ *   MXTPU_REPO=$PWD MXTPU_PREDICT_PLATFORM=cpu /tmp/train_mlp
+ *
+ * Prints final accuracy and exits 0 iff it exceeds 0.9 (used as a CI
+ * convergence assertion by tests/test_c_api_train.py).
+ */
+#include <cmath>
+#include <cstdio>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "../../cpp-package/include/mxnet_tpu_cpp/mxnet_cpp.hpp"
+
+using mxtpu::cpp::Executor;
+using mxtpu::cpp::GradReq;
+using mxtpu::cpp::KVStore;
+using mxtpu::cpp::NDArray;
+using mxtpu::cpp::SGDOptimizer;
+using mxtpu::cpp::Symbol;
+
+int main() {
+  const mx_uint kBatch = 32, kDim = 16, kHidden = 32, kClasses = 2;
+  const int kSamples = 256, kEpochs = 12;
+
+  /* two-blob synthetic dataset: class = (sum(x) > 0) */
+  std::mt19937 rng(0);
+  std::normal_distribution<float> dist(0.f, 1.f);
+  std::vector<float> xs(kSamples * kDim);
+  std::vector<float> ys(kSamples);
+  for (int i = 0; i < kSamples; ++i) {
+    float s = 0.f;
+    for (mx_uint j = 0; j < kDim; ++j) {
+      xs[i * kDim + j] = dist(rng);
+      s += xs[i * kDim + j];
+    }
+    ys[i] = s > 0.f ? 1.f : 0.f;
+  }
+
+  /* symbol graph: data -> FC -> relu -> FC -> SoftmaxOutput */
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("softmax_label");
+  Symbol fc1 = Symbol::Create(
+      "FullyConnected", {{"num_hidden", std::to_string(kHidden)}})
+      .Compose("fc1", {data});
+  Symbol act = Symbol::Create("Activation", {{"act_type", "relu"}})
+      .Compose("relu1", {fc1});
+  Symbol fc2 = Symbol::Create(
+      "FullyConnected", {{"num_hidden", std::to_string(kClasses)}})
+      .Compose("fc2", {act});
+  Symbol net = Symbol::Create("SoftmaxOutput", {}).Compose(
+      "softmax", {fc2, label});
+
+  /* shapes + buffers */
+  std::vector<std::vector<mx_uint>> arg_shapes;
+  net.InferShape({{"data", {kBatch, kDim}}, {"softmax_label", {kBatch}}},
+                 &arg_shapes);
+  std::vector<std::string> arg_names = net.ListArguments();
+  std::vector<NDArray> args, grads;
+  std::vector<GradReq> reqs;
+  std::uniform_real_distribution<float> init(-0.1f, 0.1f);
+  for (size_t i = 0; i < arg_names.size(); ++i) {
+    NDArray a(arg_shapes[i]);
+    size_t n = a.Size();
+    std::vector<float> host(n, 0.f);
+    bool is_param = arg_names[i] != "data" &&
+                    arg_names[i] != "softmax_label";
+    if (is_param)
+      for (auto &v : host) v = init(rng);
+    a.SyncCopyFromCPU(host.data(), n);
+    args.push_back(a);
+    grads.push_back(NDArray(arg_shapes[i]));
+    reqs.push_back(is_param ? GradReq::kWrite : GradReq::kNull);
+  }
+
+  Executor exec(net, args, grads, reqs, {});
+  SGDOptimizer opt(0.5f, 0.9f, 0.f, 1.0f / kBatch);
+
+  int data_idx = -1, label_idx = -1;
+  for (size_t i = 0; i < arg_names.size(); ++i) {
+    if (arg_names[i] == "data") data_idx = static_cast<int>(i);
+    if (arg_names[i] == "softmax_label") label_idx = static_cast<int>(i);
+  }
+
+  for (int epoch = 0; epoch < kEpochs; ++epoch) {
+    for (int b = 0; b + static_cast<int>(kBatch) <= kSamples;
+         b += kBatch) {
+      args[data_idx].SyncCopyFromCPU(&xs[b * kDim], kBatch * kDim);
+      args[label_idx].SyncCopyFromCPU(&ys[b], kBatch);
+      exec.Forward(true);
+      exec.Backward();
+      for (size_t i = 0; i < args.size(); ++i)
+        if (reqs[i] == GradReq::kWrite) opt.Update(&args[i], grads[i]);
+    }
+  }
+
+  /* evaluate */
+  int correct = 0, total = 0;
+  for (int b = 0; b + static_cast<int>(kBatch) <= kSamples; b += kBatch) {
+    args[data_idx].SyncCopyFromCPU(&xs[b * kDim], kBatch * kDim);
+    exec.Forward(false);
+    std::vector<NDArray> outs = exec.Outputs();
+    std::vector<float> prob = outs[0].SyncCopyToCPU();
+    for (mx_uint i = 0; i < kBatch; ++i) {
+      int pred = prob[i * kClasses] > prob[i * kClasses + 1] ? 0 : 1;
+      correct += pred == static_cast<int>(ys[b + i]);
+      ++total;
+    }
+  }
+  float acc = static_cast<float>(correct) / total;
+  std::printf("cpp-train accuracy: %.3f (%d/%d)\n", acc, correct, total);
+  return acc > 0.9f ? 0 : 1;
+}

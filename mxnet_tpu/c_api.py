@@ -1,0 +1,246 @@
+"""Python half of the training C ABI.
+
+Reference surface: include/mxnet/c_api.h (146 flat functions; the
+NDArray / imperative-invoke / Symbol / Executor / KVStore groups are the
+training core every non-Python frontend binds — cpp-package/include/
+mxnet-cpp/MxNetCpp.h, the scala/R/perl bindings). ``libmxtpu.so``
+(src/capi/c_api.cc) embeds CPython and drives this module: the C layer
+holds PyObject handles to the objects returned here and marshals
+float32 buffers / strings / shape vectors at the boundary.
+
+Design: same embedding pattern as the predict ABI (src/capi/
+c_predict_api.cc) — one function here per C entry point group, shaped
+so the C side stays thin. dtype at the C boundary is float32
+(mx_float), matching the reference's predict/cpp-package practice.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import ndarray as nd
+from . import optimizer as _opt_mod
+from . import symbol as _sym_mod
+from .base import MXNetError
+from .context import Context
+from .kvstore import create as _kv_create
+from .ndarray import NDArray
+from .ops.registry import OP_TABLE
+
+__all__ = [
+    "nd_create", "nd_copy_from", "nd_copy_to", "nd_shape", "nd_save",
+    "nd_load", "nd_wait", "nd_assign", "list_op_names",
+    "imperative_invoke",
+    "sym_create_variable", "sym_create_atomic", "sym_compose",
+    "sym_from_json", "sym_to_json", "sym_list_arguments",
+    "sym_list_outputs", "sym_list_aux", "sym_infer_shape", "executor_bind",
+    "executor_forward", "executor_backward", "executor_outputs",
+    "kv_create", "kv_init", "kv_push", "kv_pull", "kv_type",
+    "kv_set_optimizer", "random_seed",
+]
+
+
+def _ctx(dev_type: int, dev_id: int) -> Context:
+    # reference dev_type codes: 1 = cpu, 2 = gpu (here: the accelerator)
+    return Context("cpu" if dev_type == 1 else "tpu", dev_id)
+
+
+# -- NDArray group ---------------------------------------------------------
+
+def nd_create(shape: Sequence[int], dev_type: int, dev_id: int) -> NDArray:
+    return nd.zeros(tuple(int(s) for s in shape),
+                    ctx=_ctx(dev_type, dev_id), dtype="float32")
+
+
+def nd_copy_from(arr: NDArray, buf) -> None:
+    """MXNDArraySyncCopyFromCPU: overwrite from a host float32 buffer.
+
+    Goes through the standard write path (``arr[:] =``) so the value is
+    device-placed exactly like every other mutation (a raw numpy store
+    into ``_data`` would break wait_to_read and TPU placement)."""
+    host = np.frombuffer(buf, np.float32).reshape(arr.shape)
+    arr[:] = np.array(host)
+
+
+def nd_assign(dst: NDArray, src: NDArray) -> None:
+    """MXNDArrayAssign: device-to-device value copy (no host hop)."""
+    dst._set_data(src._data.astype(dst._data.dtype))
+
+
+def nd_copy_to(arr: NDArray) -> bytes:
+    """MXNDArraySyncCopyToCPU: float32 bytes (this is the WaitToRead
+    sync point — a host read forces completion)."""
+    return np.ascontiguousarray(arr.asnumpy(), np.float32).tobytes()
+
+
+def nd_shape(arr: NDArray) -> Tuple[int, ...]:
+    return tuple(int(s) for s in arr.shape)
+
+
+def nd_wait(arr: Optional[NDArray] = None) -> None:
+    """MXNDArrayWaitToRead / MXNDArrayWaitAll."""
+    if arr is not None:
+        arr.wait_to_read()
+
+
+def nd_save(fname: str, arrays: List[NDArray], keys: List[str]) -> None:
+    nd.save(fname, dict(zip(keys, arrays)) if keys else list(arrays))
+
+
+def nd_load(fname: str):
+    """-> (keys, arrays); keys are '' for list-style files."""
+    loaded = nd.load(fname)
+    if isinstance(loaded, dict):
+        ks = list(loaded)
+        return ks, [loaded[k] for k in ks]
+    return [""] * len(loaded), list(loaded)
+
+
+# -- imperative invoke (MXImperativeInvoke) --------------------------------
+
+def list_op_names() -> List[str]:
+    return sorted(OP_TABLE)
+
+
+def imperative_invoke(op_name: str, inputs: List[NDArray],
+                      keys: List[str], vals: List[str]) -> List[NDArray]:
+    """Invoke a registered op by name with string-form parameters
+    (reference: MXImperativeInvoke, c_api_ndarray.cc:553 — parameters
+    always cross the C boundary as strings and are parsed by the op's
+    declared parameter struct; AttrSpec plays that role here)."""
+    fn = getattr(nd, op_name, None)
+    if fn is None:
+        raise MXNetError(f"unknown operator {op_name!r}")
+    out = fn(*inputs, **dict(zip(keys, vals)))
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# -- Symbol group ----------------------------------------------------------
+
+class AtomicSymbol:
+    """An op creator before composition (reference:
+    MXSymbolCreateAtomicSymbol's AtomicSymbolCreator + the stored
+    kwargs; composed into a graph node by MXSymbolCompose)."""
+
+    def __init__(self, op_name: str, keys: List[str], vals: List[str]):
+        if op_name not in OP_TABLE and not hasattr(_sym_mod, op_name):
+            raise MXNetError(f"unknown operator {op_name!r}")
+        self.op_name = op_name
+        self.attrs = dict(zip(keys, vals))
+
+
+def sym_create_variable(name: str):
+    return _sym_mod.Variable(name)
+
+
+def sym_create_atomic(op_name: str, keys: List[str], vals: List[str]):
+    return AtomicSymbol(op_name, keys, vals)
+
+
+def sym_compose(atomic: AtomicSymbol, name: str, arg_names: List[str],
+                args: list):
+    fn = getattr(_sym_mod, atomic.op_name)
+    kwargs = dict(atomic.attrs)
+    if name:
+        kwargs["name"] = name
+    if arg_names and any(arg_names):
+        for n, a in zip(arg_names, args):
+            kwargs[n] = a
+        return fn(**kwargs)
+    return fn(*args, **kwargs)
+
+
+def sym_from_json(json_str: str):
+    return _sym_mod.load_json(json_str)
+
+
+def sym_to_json(sym) -> str:
+    return sym.tojson()
+
+
+def sym_list_arguments(sym) -> List[str]:
+    return list(sym.list_arguments())
+
+
+def sym_list_outputs(sym) -> List[str]:
+    return list(sym.list_outputs())
+
+
+def sym_list_aux(sym) -> List[str]:
+    return list(sym.list_auxiliary_states())
+
+
+def sym_infer_shape(sym, names: List[str], shapes: List[Sequence[int]]):
+    """-> (arg_shapes, out_shapes, aux_shapes), each a list of tuples."""
+    known = {n: tuple(int(x) for x in s) for n, s in zip(names, shapes)}
+    arg, out, aux = sym.infer_shape(**known)
+    return ([tuple(s) for s in arg], [tuple(s) for s in out],
+            [tuple(s) for s in aux])
+
+
+# -- Executor group --------------------------------------------------------
+
+def executor_bind(sym, dev_type: int, dev_id: int, args: List[NDArray],
+                  arg_grads: List[Optional[NDArray]],
+                  grad_reqs: List[str], aux: List[NDArray]):
+    """MXExecutorBindEX: caller-provided arrays, positional in
+    list_arguments / list_auxiliary_states order."""
+    grads = {n: g for n, g in zip(sym.list_arguments(), arg_grads)
+             if g is not None}
+    return sym.bind(ctx=_ctx(dev_type, dev_id), args=list(args),
+                    args_grad=grads, grad_req=list(grad_reqs),
+                    aux_states=list(aux))
+
+
+def executor_forward(ex, is_train: int) -> None:
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, head_grads: List[NDArray]) -> None:
+    ex.backward(out_grads=list(head_grads) if head_grads else None)
+
+
+def executor_outputs(ex) -> List[NDArray]:
+    return list(ex.outputs)
+
+
+# -- KVStore group ---------------------------------------------------------
+
+def kv_create(kv_type: str):
+    return _kv_create(kv_type)
+
+
+def kv_type(kv) -> str:
+    return kv.type
+
+
+def kv_init(kv, keys: List[str], vals: List[NDArray]) -> None:
+    kv.init(list(keys), list(vals))
+
+
+def kv_push(kv, keys: List[str], vals: List[NDArray], priority: int) -> None:
+    kv.push(list(keys), list(vals), priority=priority)
+
+
+def kv_pull(kv, keys: List[str], outs: List[NDArray], priority: int) -> None:
+    kv.pull(list(keys), out=list(outs), priority=priority)
+
+
+def kv_set_optimizer(kv, opt_name: str, keys: List[str],
+                     vals: List[str]) -> None:
+    """MXKVStoreSetOptimizer analog: create a registered optimizer from
+    string params and install it store-side (the reference pickles the
+    optimizer to the servers; here the store runs it directly)."""
+    params = {}
+    for k, v in zip(keys, vals):
+        try:
+            params[k] = float(v) if "." in v or "e" in v.lower() else int(v)
+        except ValueError:
+            params[k] = v
+    kv.set_optimizer(_opt_mod.create(opt_name, **params))
+
+
+def random_seed(seed: int) -> None:
+    from . import random as _random
+    _random.seed(seed)

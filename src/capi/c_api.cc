@@ -1,0 +1,736 @@
+/*
+ * Implementation of the training-surface C ABI (see c_api.h).
+ *
+ * Reference analogue: src/c_api/{c_api.cc, c_api_ndarray.cc,
+ * c_api_symbolic.cc, c_api_executor.cc} — there the ABI calls the C++
+ * core directly; here it embeds CPython and delegates to
+ * mxnet_tpu/c_api.py, sharing the XLA-compiled compute path with the
+ * Python frontend. Handles wrap PyObject pointers plus per-handle
+ * scratch storage for returned views (valid until the next call on the
+ * same handle, matching the reference's convention).
+ */
+#include "c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "embed_common.h"
+
+using mxtpu_embed::EnsurePython;
+using mxtpu_embed::GIL;
+using mxtpu_embed::LastError;
+using mxtpu_embed::SetError;
+using mxtpu_embed::SetErrorFromPython;
+
+namespace {
+
+struct NDRec {
+  PyObject *obj;
+  std::vector<mx_uint> shape;
+};
+
+struct StrList {
+  std::vector<std::string> store;
+  std::vector<const char *> ptrs;
+
+  void assign(std::vector<std::string> v) {
+    store = std::move(v);
+    ptrs.clear();
+    for (auto &s : store) ptrs.push_back(s.c_str());
+  }
+};
+
+struct ShapeGroup {
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<mx_uint> ndims;
+  std::vector<const mx_uint *> data_ptrs;
+
+  void assign(std::vector<std::vector<mx_uint>> v) {
+    shapes = std::move(v);
+    ndims.clear();
+    data_ptrs.clear();
+    for (auto &s : shapes) {
+      ndims.push_back(static_cast<mx_uint>(s.size()));
+      data_ptrs.push_back(s.data());
+    }
+  }
+};
+
+struct SymRec {
+  PyObject *obj;
+  std::string json;
+  StrList args, outs, aux;
+  ShapeGroup in_shapes, out_shapes, aux_shapes;
+};
+
+struct ExecRec {
+  PyObject *obj;
+  /* scratch for the handle array returned by MXExecutorOutputs; the
+   * handles themselves are owned by the CALLER (freed with
+   * MXNDArrayFree), matching MXImperativeInvokeByName's convention */
+  std::vector<NDArrayHandle> outputs;
+};
+
+struct KVRec {
+  PyObject *obj;
+  std::string type;
+};
+
+PyObject *ApiModule() {
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.c_api");
+  if (!mod) SetErrorFromPython();
+  return mod;
+}
+
+/* Call mxnet_tpu.c_api.<fn>(...) with a pre-built argument tuple. */
+PyObject *CallApi(const char *fn, PyObject *argtuple) {
+  PyObject *mod = ApiModule();
+  if (!mod) {
+    Py_XDECREF(argtuple);
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) {
+    SetErrorFromPython();
+    Py_XDECREF(argtuple);
+    return nullptr;
+  }
+  PyObject *res = PyObject_CallObject(f, argtuple);
+  Py_DECREF(f);
+  Py_XDECREF(argtuple);
+  if (!res) SetErrorFromPython();
+  return res;
+}
+
+PyObject *StrListToPy(mx_uint n, const char **strs) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(strs ? strs[i] : ""));
+  return l;
+}
+
+PyObject *NDListToPy(mx_uint n, NDArrayHandle *arr) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    /* a NULL array (e.g. arg_grad_store on an inference-only bind) or
+     * NULL element maps to None */
+    PyObject *o = (arr && arr[i]) ? static_cast<NDRec *>(arr[i])->obj
+                                  : Py_None;
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+bool PyToStrList(PyObject *seq, StrList *out) {
+  std::vector<std::string> v;
+  Py_ssize_t n = PySequence_Size(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(seq, i);
+    const char *c = it ? PyUnicode_AsUTF8(it) : nullptr;
+    if (!c) {
+      Py_XDECREF(it);
+      SetErrorFromPython();
+      return false;
+    }
+    v.emplace_back(c);
+    Py_DECREF(it);
+  }
+  out->assign(std::move(v));
+  return true;
+}
+
+bool PyShapeToVec(PyObject *shp, std::vector<mx_uint> *out) {
+  Py_ssize_t n = PySequence_Size(shp);
+  if (n < 0) {
+    SetErrorFromPython();
+    return false;
+  }
+  out->clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(shp, i);
+    unsigned long v = it ? PyLong_AsUnsignedLong(it) : 0;
+    Py_XDECREF(it);
+    if (PyErr_Occurred()) {
+      SetErrorFromPython();
+      return false;
+    }
+    out->push_back(static_cast<mx_uint>(v));
+  }
+  return true;
+}
+
+bool PyToShapeGroup(PyObject *seq, ShapeGroup *out) {
+  std::vector<std::vector<mx_uint>> v;
+  Py_ssize_t n = PySequence_Size(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(seq, i);
+    std::vector<mx_uint> s;
+    bool ok = it && PyShapeToVec(it, &s);
+    Py_XDECREF(it);
+    if (!ok) return false;
+    v.push_back(std::move(s));
+  }
+  out->assign(std::move(v));
+  return true;
+}
+
+/* global op-name storage for MXListAllOpNames / creators */
+StrList &OpNames() {
+  static StrList names;
+  return names;
+}
+
+bool EnsureOpNames() {
+  if (!OpNames().store.empty()) return true;
+  PyObject *res = CallApi("list_op_names", PyTuple_New(0));
+  if (!res) return false;
+  bool ok = PyToStrList(res, &OpNames());
+  Py_DECREF(res);
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTrainGetLastError() { return LastError().c_str(); }
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int /*delay_alloc*/, NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject *res =
+      CallApi("nd_create", Py_BuildValue("(Nii)", shp, dev_type, dev_id));
+  if (!res) return -1;
+  *out = new NDRec{res, {}};
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (!handle) return 0;
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  Py_XDECREF(rec->obj);
+  delete rec;
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_shape) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_shape", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  bool ok = PyShapeToVec(res, &rec->shape);
+  Py_DECREF(res);
+  if (!ok) return -1;
+  *out_ndim = static_cast<mx_uint>(rec->shape.size());
+  *out_shape = rec->shape.data();
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *mv = PyMemoryView_FromMemory(
+      const_cast<char *>(static_cast<const char *>(data)),
+      size * sizeof(mx_float), PyBUF_READ);
+  PyObject *res =
+      CallApi("nd_copy_from", Py_BuildValue("(ON)", rec->obj, mv));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_copy_to", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  if (static_cast<size_t>(len) != size * sizeof(mx_float)) {
+    SetError("MXNDArraySyncCopyToCPU: size mismatch");
+    Py_DECREF(res);
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayAssign(NDArrayHandle dst, NDArrayHandle src) {
+  GIL gil;
+  PyObject *res = CallApi(
+      "nd_assign",
+      Py_BuildValue("(OO)", static_cast<NDRec *>(dst)->obj,
+                    static_cast<NDRec *>(src)->obj));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_wait", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  if (!EnsurePython()) return -1;
+  return 0;  /* XLA dispatch is synchronized per-array at host reads */
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  GIL gil;
+  PyObject *res = CallApi(
+      "nd_save", Py_BuildValue("(sNN)", fname, NDListToPy(num_args, args),
+                               StrListToPy(keys ? num_args : 0, keys)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  static thread_local std::vector<NDArrayHandle> arrs;
+  static thread_local StrList names;
+  PyObject *res = CallApi("nd_load", Py_BuildValue("(s)", fname));
+  if (!res) return -1;
+  PyObject *pkeys = PyTuple_GetItem(res, 0);
+  PyObject *pvals = PyTuple_GetItem(res, 1);
+  if (!pkeys || !pvals || !PyToStrList(pkeys, &names)) {
+    Py_DECREF(res);
+    return -1;
+  }
+  arrs.clear();
+  Py_ssize_t n = PySequence_Size(pvals);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(pvals, i);
+    arrs.push_back(new NDRec{it, {}});
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(arrs.size());
+  *out_arr = arrs.data();
+  bool named = false;
+  for (auto &s : names.store) named |= !s.empty();
+  *out_name_size = named ? *out_size : 0;
+  *out_names = names.ptrs.data();
+  return 0;
+}
+
+/* ---- imperative ops --------------------------------------------------- */
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  if (!EnsureOpNames()) return -1;
+  *out_size = static_cast<mx_uint>(OpNames().ptrs.size());
+  *out_array = OpNames().ptrs.data();
+  return 0;
+}
+
+int MXImperativeInvokeByName(const char *op_name, int num_inputs,
+                             NDArrayHandle *inputs, int *num_outputs,
+                             NDArrayHandle **outputs, int num_params,
+                             const char **param_keys,
+                             const char **param_vals) {
+  if (!EnsurePython()) return -1;
+  if (num_outputs && *num_outputs != 0) {
+    SetError("MXImperativeInvokeByName: preallocated outputs are not "
+             "supported — pass *num_outputs = 0 and free the returned "
+             "handles with MXNDArrayFree");
+    return -1;
+  }
+  GIL gil;
+  static thread_local std::vector<NDArrayHandle> outs;
+  PyObject *res = CallApi(
+      "imperative_invoke",
+      Py_BuildValue("(sNNN)", op_name, NDListToPy(num_inputs, inputs),
+                    StrListToPy(num_params, param_keys),
+                    StrListToPy(num_params, param_vals)));
+  if (!res) return -1;
+  outs.clear();
+  Py_ssize_t n = PySequence_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    outs.push_back(new NDRec{PySequence_GetItem(res, i), {}});
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(outs.size());
+  *outputs = outs.data();
+  return 0;
+}
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  if (!EnsureOpNames()) return -1;
+  static std::vector<AtomicSymbolCreator> creators;
+  if (creators.empty())
+    for (auto &s : OpNames().store)
+      creators.push_back(const_cast<std::string *>(&s));
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  *name = static_cast<std::string *>(creator)->c_str();
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  const std::string *opname = static_cast<std::string *>(creator);
+  PyObject *res = CallApi(
+      "sym_create_atomic",
+      Py_BuildValue("(sNN)", opname->c_str(), StrListToPy(num_param, keys),
+                    StrListToPy(num_param, vals)));
+  if (!res) return -1;
+  *out = new SymRec{res, {}, {}, {}, {}, {}, {}, {}};
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *arglist = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *o = static_cast<SymRec *>(args[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(arglist, i, o);
+  }
+  PyObject *res = CallApi(
+      "sym_compose",
+      Py_BuildValue("(OsNN)", rec->obj, name ? name : "",
+                    StrListToPy(keys ? num_args : 0, keys), arglist));
+  if (!res) return -1;
+  Py_DECREF(rec->obj);
+  rec->obj = res;  /* composed in place, like the reference */
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("sym_create_variable", Py_BuildValue("(s)", name));
+  if (!res) return -1;
+  *out = new SymRec{res, {}, {}, {}, {}, {}, {}, {}};
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("sym_from_json", Py_BuildValue("(s)", json));
+  if (!res) return -1;
+  *out = new SymRec{res, {}, {}, {}, {}, {}, {}, {}};
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res = CallApi("sym_to_json", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  const char *c = PyUnicode_AsUTF8(res);
+  if (!c) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  rec->json = c;
+  Py_DECREF(res);
+  *out_json = rec->json.c_str();
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle sym) {
+  if (!sym) return 0;
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  Py_XDECREF(rec->obj);
+  delete rec;
+  return 0;
+}
+
+static int SymStrListQuery(SymbolHandle sym, const char *fn, StrList *slot,
+                           mx_uint *out_size, const char ***out_array) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res = CallApi(fn, Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  bool ok = PyToStrList(res, slot);
+  Py_DECREF(res);
+  if (!ok) return -1;
+  *out_size = static_cast<mx_uint>(slot->ptrs.size());
+  *out_array = slot->ptrs.data();
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_array) {
+  return SymStrListQuery(sym, "sym_list_arguments",
+                         &static_cast<SymRec *>(sym)->args, out_size,
+                         out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_array) {
+  return SymStrListQuery(sym, "sym_list_outputs",
+                         &static_cast<SymRec *>(sym)->outs, out_size,
+                         out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_array) {
+  return SymStrListQuery(sym, "sym_list_aux",
+                         &static_cast<SymRec *>(sym)->aux, out_size,
+                         out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  PyObject *res = CallApi(
+      "sym_infer_shape",
+      Py_BuildValue("(ONN)", rec->obj, StrListToPy(num_args, keys), shapes));
+  if (!res) return -1;
+  ShapeGroup *groups[3] = {&rec->in_shapes, &rec->out_shapes,
+                           &rec->aux_shapes};
+  for (int g = 0; g < 3; ++g) {
+    PyObject *item = PyTuple_GetItem(res, g);
+    if (!item || !PyToShapeGroup(item, groups[g])) {
+      Py_DECREF(res);
+      return -1;
+    }
+  }
+  Py_DECREF(res);
+  *in_shape_size = static_cast<mx_uint>(rec->in_shapes.shapes.size());
+  *in_shape_ndim = rec->in_shapes.ndims.data();
+  *in_shape_data = rec->in_shapes.data_ptrs.data();
+  *out_shape_size = static_cast<mx_uint>(rec->out_shapes.shapes.size());
+  *out_shape_ndim = rec->out_shapes.ndims.data();
+  *out_shape_data = rec->out_shapes.data_ptrs.data();
+  *aux_shape_size = static_cast<mx_uint>(rec->aux_shapes.shapes.size());
+  *aux_shape_ndim = rec->aux_shapes.ndims.data();
+  *aux_shape_data = rec->aux_shapes.data_ptrs.data();
+  *complete = 1;
+  return 0;
+}
+
+/* ---- Executor --------------------------------------------------------- */
+
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store,
+                     mx_uint *grad_req_type, mx_uint aux_states_len,
+                     NDArrayHandle *aux_states, ExecutorHandle *out) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  static const char *kReq[] = {"null", "write", "inplace", "add"};
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    mx_uint r = grad_req_type ? grad_req_type[i] : 0;
+    PyList_SET_ITEM(reqs, i, PyUnicode_FromString(r < 4 ? kReq[r] : "null"));
+  }
+  PyObject *res = CallApi(
+      "executor_bind",
+      Py_BuildValue("(OiiNNNN)", rec->obj, dev_type, dev_id,
+                    NDListToPy(len, in_args),
+                    NDListToPy(len, arg_grad_store), reqs,
+                    NDListToPy(aux_states_len, aux_states)));
+  if (!res) return -1;
+  *out = new ExecRec{res, {}};
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *res =
+      CallApi("executor_forward", Py_BuildValue("(Oi)", rec->obj, is_train));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *res = CallApi(
+      "executor_backward",
+      Py_BuildValue("(ON)", rec->obj, NDListToPy(len, head_grads)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *res =
+      CallApi("executor_outputs", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  rec->outputs.clear();
+  Py_ssize_t n = PySequence_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    rec->outputs.push_back(new NDRec{PySequence_GetItem(res, i), {}});
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(rec->outputs.size());
+  *out = rec->outputs.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  if (!handle) return 0;
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  Py_XDECREF(rec->obj);
+  delete rec;
+  return 0;
+}
+
+/* ---- KVStore ---------------------------------------------------------- */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("kv_create", Py_BuildValue("(s)", type));
+  if (!res) return -1;
+  *out = new KVRec{res, {}};
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  if (!handle) return 0;
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  Py_XDECREF(rec->obj);
+  delete rec;
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *res = CallApi("kv_type", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  const char *c = PyUnicode_AsUTF8(res);
+  rec->type = c ? c : "";
+  Py_DECREF(res);
+  *type = rec->type.c_str();
+  return 0;
+}
+
+static int KVOp(KVStoreHandle handle, const char *fn, mx_uint num,
+                const char **keys, NDArrayHandle *vals, int priority,
+                bool with_priority) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *args =
+      with_priority
+          ? Py_BuildValue("(ONNi)", rec->obj, StrListToPy(num, keys),
+                          NDListToPy(num, vals), priority)
+          : Py_BuildValue("(ONN)", rec->obj, StrListToPy(num, keys),
+                          NDListToPy(num, vals));
+  PyObject *res = CallApi(fn, args);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals) {
+  return KVOp(handle, "kv_init", num, keys, vals, 0, false);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  return KVOp(handle, "kv_push", num, keys, vals, priority, true);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  return KVOp(handle, "kv_pull", num, keys, vals, priority, true);
+}
+
+int MXKVStoreSetOptimizer(KVStoreHandle handle, const char *opt_name,
+                          mx_uint num_param, const char **keys,
+                          const char **vals) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *res = CallApi(
+      "kv_set_optimizer",
+      Py_BuildValue("(OsNN)", rec->obj, opt_name,
+                    StrListToPy(num_param, keys),
+                    StrListToPy(num_param, vals)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- misc ------------------------------------------------------------- */
+
+int MXRandomSeed(int seed) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("random_seed", Py_BuildValue("(i)", seed));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,146 @@
+/*
+ * Training-surface C ABI for mxnet_tpu.
+ *
+ * Reference surface: include/mxnet/c_api.h — the 146-function flat ABI
+ * every non-Python frontend binds (cpp-package, scala, R, perl). This
+ * implements the training core (~36 functions): NDArray, imperative op
+ * invocation, Symbol construction/composition, Executor bind/forward/
+ * backward, KVStore, random. The implementation (c_api.cc) embeds
+ * CPython and drives mxnet_tpu/c_api.py, the same architecture as the
+ * predict ABI (c_predict_api.cc) — the XLA-compiled compute path is
+ * shared with the Python frontend, the ABI is the binding surface.
+ *
+ * Conventions (match the reference):
+ *   - every function returns 0 on success, -1 on failure;
+ *     MXTrainGetLastError() returns the message for this thread;
+ *   - handles are opaque pointers freed with their MX*Free function;
+ *   - returned const char** / mx_uint* views stay valid until the next
+ *     call on the same handle (or library, for global lists);
+ *   - data buffers at the boundary are float32 (mx_float), row-major;
+ *   - dev_type: 1 = cpu, 2 = accelerator (tpu).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *AtomicSymbolCreator;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+
+const char *MXTrainGetLastError();
+
+/* ---- NDArray ---------------------------------------------------------- */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_shape);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+/* Device-to-device value copy dst <- src (no host round trip). */
+int MXNDArrayAssign(NDArrayHandle dst, NDArrayHandle src);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll();
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+
+/* ---- imperative ops --------------------------------------------------- */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+/* Invoke an op by name. *num_outputs/outputs: pass *num_outputs = 0 to
+ * let the op allocate its outputs (the common case); the handles in
+ * *outputs stay valid until freed. */
+int MXImperativeInvokeByName(const char *op_name, int num_inputs,
+                             NDArrayHandle *inputs, int *num_outputs,
+                             NDArrayHandle **outputs, int num_params,
+                             const char **param_keys,
+                             const char **param_vals);
+
+/* ---- Symbol ----------------------------------------------------------- */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out);
+/* Compose: attach args (by name when keys != NULL) to an atomic symbol,
+ * producing the graph node. */
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+int MXSymbolFree(SymbolHandle sym);
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_array);
+/* CSR-style shape query (same layout as MXPredCreate's inputs). */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+
+/* ---- Executor --------------------------------------------------------- */
+/* grad_req codes (reference enum): 0 = null, 1 = write, 3 = add. */
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store,
+                     mx_uint *grad_req_type, mx_uint aux_states_len,
+                     NDArrayHandle *aux_states, ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads);
+/* Output handles are owned by the caller (free with MXNDArrayFree);
+ * the pointer array stays valid until the next call on this handle. */
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* ---- KVStore ---------------------------------------------------------- */
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+/* Store-side optimizer from string params (the reference ships a
+ * pickled python optimizer to the servers; same contract). */
+int MXKVStoreSetOptimizer(KVStoreHandle handle, const char *opt_name,
+                          mx_uint num_param, const char **keys,
+                          const char **vals);
+
+/* ---- misc ------------------------------------------------------------- */
+int MXRandomSeed(int seed);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

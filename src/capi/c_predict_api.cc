@@ -16,76 +16,15 @@
 #include <string>
 #include <vector>
 
+#include "embed_common.h"
+
+using mxtpu_embed::EnsurePython;
+using mxtpu_embed::SetError;
+using mxtpu_embed::SetErrorFromPython;
+
 namespace {
 
-thread_local std::string g_last_error;
-
-void SetError(const std::string &msg) { g_last_error = msg; }
-
-void SetErrorFromPython() {
-  PyObject *ptype = nullptr, *pvalue = nullptr, *ptrace = nullptr;
-  PyErr_Fetch(&ptype, &pvalue, &ptrace);
-  PyErr_NormalizeException(&ptype, &pvalue, &ptrace);
-  std::string msg = "python error";
-  if (pvalue) {
-    PyObject *s = PyObject_Str(pvalue);
-    if (s) {
-      const char *c = PyUnicode_AsUTF8(s);
-      if (c) msg = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(ptype);
-  Py_XDECREF(pvalue);
-  Py_XDECREF(ptrace);
-  SetError(msg);
-}
-
-std::once_flag g_init_flag;
-bool g_init_ok = false;
-
-/* Bootstrap: make the venv + repo importable inside the embedded
- * interpreter (the default embedded sys.path lacks both), then import
- * mxnet_tpu.c_predict. Controlled by MXTPU_REPO / VIRTUAL_ENV. */
-const char *kBootstrap = R"PY(
-import glob, os, sys
-repo = os.environ.get('MXTPU_REPO', os.getcwd())
-if repo not in sys.path:
-    sys.path.insert(0, repo)
-venv = os.environ.get('VIRTUAL_ENV', '/opt/venv')
-for sp in glob.glob(os.path.join(venv, 'lib', 'python3.*', 'site-packages')):
-    if sp not in sys.path:
-        sys.path.append(sp)
-plat = os.environ.get('MXTPU_PREDICT_PLATFORM')
-if plat:
-    import jax
-    jax.config.update('jax_platforms', plat)
-)PY";
-
-bool EnsurePython() {
-  std::call_once(g_init_flag, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // release the GIL acquired by initialization so PyGILState works
-      // from arbitrary threads below
-      PyEval_SaveThread();
-    }
-    PyGILState_STATE st = PyGILState_Ensure();
-    g_init_ok = PyRun_SimpleString(kBootstrap) == 0;
-    if (!g_init_ok) SetError("failed to bootstrap embedded python");
-    PyGILState_Release(st);
-  });
-  return g_init_ok;
-}
-
-class GIL {
- public:
-  GIL() : st_(PyGILState_Ensure()) {}
-  ~GIL() { PyGILState_Release(st_); }
-
- private:
-  PyGILState_STATE st_;
-};
+using mxtpu_embed::GIL;
 
 struct PredRec {
   PyObject *obj;                    // mxnet_tpu.c_predict.Predictor
@@ -160,7 +99,7 @@ int CreateImpl(const char *symbol_json_str, const void *param_bytes,
 
 extern "C" {
 
-const char *MXGetLastError() { return g_last_error.c_str(); }
+const char *MXGetLastError() { return mxtpu_embed::LastError().c_str(); }
 
 int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  int param_size, int dev_type, int dev_id,

@@ -1,0 +1,127 @@
+/*
+ * Shared CPython-embedding plumbing for the C ABIs (predict + training).
+ *
+ * Both libmxtpu_predict.so and libmxtpu.so embed the interpreter the
+ * same way: lazy one-time init, sys.path bootstrap from MXTPU_REPO /
+ * VIRTUAL_ENV, per-thread error strings, and a scoped GIL guard.
+ * Header-only so each shared library carries its own copy (they are
+ * independently loadable).
+ */
+#ifndef MXTPU_EMBED_COMMON_H_
+#define MXTPU_EMBED_COMMON_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu_embed {
+
+inline std::string &LastError() {
+  thread_local std::string err;
+  return err;
+}
+
+inline void SetError(const std::string &msg) { LastError() = msg; }
+
+inline void SetErrorFromPython() {
+  PyObject *ptype = nullptr, *pvalue = nullptr, *ptrace = nullptr;
+  PyErr_Fetch(&ptype, &pvalue, &ptrace);
+  PyErr_NormalizeException(&ptype, &pvalue, &ptrace);
+  std::string msg = "python error";
+  if (pvalue) {
+    PyObject *s = PyObject_Str(pvalue);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptrace);
+  SetError(msg);
+}
+
+/* Bootstrap: make the venv + repo importable inside the embedded
+ * interpreter. Controlled by MXTPU_REPO / VIRTUAL_ENV; an optional
+ * platform override (MXTPU_PREDICT_PLATFORM) pins the jax backend
+ * before first device use. */
+inline const char *BootstrapScript() {
+  return R"PY(
+import glob, os, sys
+repo = os.environ.get('MXTPU_REPO', os.getcwd())
+if repo not in sys.path:
+    sys.path.insert(0, repo)
+venv = os.environ.get('VIRTUAL_ENV', '/opt/venv')
+for sp in glob.glob(os.path.join(venv, 'lib', 'python3.*', 'site-packages')):
+    if sp not in sys.path:
+        sys.path.append(sp)
+plat = os.environ.get('MXTPU_PREDICT_PLATFORM')
+if plat:
+    import jax
+    jax.config.update('jax_platforms', plat)
+)PY";
+}
+
+#ifdef MXTPU_EMBEDDED_PKG
+/* Provided by the amalgamation-generated translation unit: base64 of a
+ * zip holding the whole mxnet_tpu python package. Staged onto sys.path
+ * (zipimport) before the normal bootstrap, so the single .so runs
+ * without a repo checkout. */
+extern "C" const char *mxtpu_embedded_pkg_b64(void);
+#endif
+
+inline bool EnsurePython() {
+  static std::once_flag flag;
+  static bool ok = false;
+  std::call_once(flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      /* release the GIL acquired by initialization so PyGILState works
+       * from arbitrary threads below */
+      PyEval_SaveThread();
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    ok = true;
+#ifdef MXTPU_EMBEDDED_PKG
+    {
+      PyObject *main = PyImport_AddModule("__main__");
+      PyObject *g = main ? PyModule_GetDict(main) : nullptr;
+      PyObject *b64 =
+          g ? PyUnicode_FromString(mxtpu_embedded_pkg_b64()) : nullptr;
+      ok = b64 && PyDict_SetItemString(g, "_MXTPU_PKG_B64", b64) == 0;
+      Py_XDECREF(b64);
+      ok = ok && PyRun_SimpleString(R"PY(
+import base64 as _b64, os as _os, sys as _sys, tempfile as _tf
+_d = _tf.mkdtemp(prefix='mxtpu_amalgam_')
+_zp = _os.path.join(_d, 'mxtpu_pkg.zip')
+with open(_zp, 'wb') as _f:
+    _f.write(_b64.b64decode(_MXTPU_PKG_B64))
+del _MXTPU_PKG_B64
+_sys.path.insert(0, _zp)
+_os.environ['MXTPU_REPO'] = _zp
+)PY") == 0;
+    }
+#endif
+    ok = ok && PyRun_SimpleString(BootstrapScript()) == 0;
+    if (!ok) SetError("failed to bootstrap embedded python");
+    PyGILState_Release(st);
+  });
+  return ok;
+}
+
+class GIL {
+ public:
+  GIL() : st_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(st_); }
+  GIL(const GIL &) = delete;
+  GIL &operator=(const GIL &) = delete;
+
+ private:
+  PyGILState_STATE st_;
+};
+
+}  // namespace mxtpu_embed
+
+#endif  /* MXTPU_EMBED_COMMON_H_ */

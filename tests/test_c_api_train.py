@@ -1,0 +1,185 @@
+"""Training C ABI (libmxtpu.so): ctypes round trips + the compiled C++
+training example.
+
+Reference analogues: include/mxnet/c_api.h (NDArray/Symbol/Executor/
+KVStore groups), cpp-package/include/mxnet-cpp/MxNetCpp.h,
+cpp-package/example/mlp.cpp.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "mxnet_tpu", "_lib", "libmxtpu.so")
+
+vp = ctypes.c_void_p
+u = ctypes.c_uint
+
+
+def _build_lib():
+    if not os.path.exists(LIB):
+        subprocess.run(["make", "-C", ROOT], check=True, capture_output=True)
+    return os.path.exists(LIB)
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not _build_lib():
+        pytest.skip("libmxtpu.so not built")
+    # load in a SUBPROCESS-free way: this process already runs jax on the
+    # test platform; the embedded interpreter is the same process, so the
+    # bootstrap's sys.path insert is a no-op and the platform matches.
+    os.environ.setdefault("MXTPU_REPO", ROOT)
+    lb = ctypes.CDLL(LIB)
+    lb.MXTrainGetLastError.restype = ctypes.c_char_p
+    return lb
+
+
+def _ck(lib, r):
+    if r != 0:
+        raise RuntimeError(lib.MXTrainGetLastError().decode())
+
+
+def test_ndarray_roundtrip_and_invoke(lib):
+    h = vp()
+    _ck(lib, lib.MXNDArrayCreate((u * 2)(2, 3), 2, 1, 0, 0,
+                                 ctypes.byref(h)))
+    nd2 = u()
+    shp = ctypes.POINTER(u)()
+    _ck(lib, lib.MXNDArrayGetShape(h, ctypes.byref(nd2), ctypes.byref(shp)))
+    assert [shp[i] for i in range(nd2.value)] == [2, 3]
+
+    data = np.array([-1, 2, -3, 4, 5, -6], np.float32)
+    _ck(lib, lib.MXNDArraySyncCopyFromCPU(h, data.ctypes.data_as(vp), 6))
+    out = np.zeros(6, np.float32)
+    _ck(lib, lib.MXNDArraySyncCopyToCPU(h, out.ctypes.data_as(vp), 6))
+    np.testing.assert_array_equal(out, data)
+
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXImperativeInvokeByName(
+        b"relu", 1, (vp * 1)(h), ctypes.byref(n_out), ctypes.byref(outs),
+        0, None, None))
+    assert n_out.value == 1
+    res = np.zeros(6, np.float32)
+    _ck(lib, lib.MXNDArraySyncCopyToCPU(vp(outs[0]),
+                                        res.ctypes.data_as(vp), 6))
+    np.testing.assert_allclose(res, np.maximum(data, 0))
+    _ck(lib, lib.MXNDArrayFree(vp(outs[0])))
+    _ck(lib, lib.MXNDArrayFree(h))
+
+
+def test_symbol_compose_json_infer(lib):
+    sv = vp()
+    _ck(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(sv)))
+    nc = u()
+    creators = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(nc),
+                                                  ctypes.byref(creators)))
+    assert nc.value > 250
+    name = ctypes.c_char_p()
+    fcc = None
+    for i in range(nc.value):
+        _ck(lib, lib.MXSymbolGetAtomicSymbolName(vp(creators[i]),
+                                                 ctypes.byref(name)))
+        if name.value == b"FullyConnected":
+            fcc = vp(creators[i])
+    fc = vp()
+    _ck(lib, lib.MXSymbolCreateAtomicSymbol(
+        fcc, 1, (ctypes.c_char_p * 1)(b"num_hidden"),
+        (ctypes.c_char_p * 1)(b"4"), ctypes.byref(fc)))
+    _ck(lib, lib.MXSymbolCompose(fc, b"fc1", 1, None, (vp * 1)(sv)))
+
+    ns = u()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _ck(lib, lib.MXSymbolListArguments(fc, ctypes.byref(ns),
+                                       ctypes.byref(arr)))
+    assert [arr[i] for i in range(ns.value)] == [b"data", b"fc1_weight",
+                                                 b"fc1_bias"]
+    js = ctypes.c_char_p()
+    _ck(lib, lib.MXSymbolSaveToJSON(fc, ctypes.byref(js)))
+    # JSON round trip through MXSymbolCreateFromJSON
+    back = vp()
+    _ck(lib, lib.MXSymbolCreateFromJSON(js.value, ctypes.byref(back)))
+    _ck(lib, lib.MXSymbolListArguments(back, ctypes.byref(ns),
+                                       ctypes.byref(arr)))
+    assert ns.value == 3
+
+    # infer shape: data (8, 16) -> fc1_weight (4, 16)
+    indptr = (u * 2)(0, 2)
+    shapes = (u * 2)(8, 16)
+    in_n, out_n, aux_n = u(), u(), u()
+    in_nd = ctypes.POINTER(u)()
+    out_nd = ctypes.POINTER(u)()
+    aux_nd = ctypes.POINTER(u)()
+    in_d = ctypes.POINTER(ctypes.POINTER(u))()
+    out_d = ctypes.POINTER(ctypes.POINTER(u))()
+    aux_d = ctypes.POINTER(ctypes.POINTER(u))()
+    comp = ctypes.c_int()
+    _ck(lib, lib.MXSymbolInferShape(
+        fc, 1, (ctypes.c_char_p * 1)(b"data"), indptr, shapes,
+        ctypes.byref(in_n), ctypes.byref(in_nd), ctypes.byref(in_d),
+        ctypes.byref(out_n), ctypes.byref(out_nd), ctypes.byref(out_d),
+        ctypes.byref(aux_n), ctypes.byref(aux_nd), ctypes.byref(aux_d),
+        ctypes.byref(comp)))
+    assert in_n.value == 3
+    wshape = [in_d[1][j] for j in range(in_nd[1])]
+    assert wshape == [4, 16]
+    assert [out_d[0][j] for j in range(out_nd[0])] == [8, 4]
+    for s in (fc, sv, back):
+        _ck(lib, lib.MXSymbolFree(s))
+
+
+def test_kvstore_through_abi(lib):
+    kv = vp()
+    _ck(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    t = ctypes.c_char_p()
+    _ck(lib, lib.MXKVStoreGetType(kv, ctypes.byref(t)))
+    assert t.value == b"local"
+    h = vp()
+    _ck(lib, lib.MXNDArrayCreate((u * 1)(4), 1, 1, 0, 0, ctypes.byref(h)))
+    w = np.ones(4, np.float32)
+    _ck(lib, lib.MXNDArraySyncCopyFromCPU(h, w.ctypes.data_as(vp), 4))
+    key = (ctypes.c_char_p * 1)(b"w")
+    _ck(lib, lib.MXKVStoreInitEx(kv, 1, key, (vp * 1)(h)))
+    _ck(lib, lib.MXKVStoreSetOptimizer(
+        kv, b"sgd", 2, (ctypes.c_char_p * 2)(b"learning_rate",
+                                             b"rescale_grad"),
+        (ctypes.c_char_p * 2)(b"0.5", b"1.0")))
+    g = vp()
+    _ck(lib, lib.MXNDArrayCreate((u * 1)(4), 1, 1, 0, 0, ctypes.byref(g)))
+    gv = np.full(4, 2.0, np.float32)
+    _ck(lib, lib.MXNDArraySyncCopyFromCPU(g, gv.ctypes.data_as(vp), 4))
+    _ck(lib, lib.MXKVStorePushEx(kv, 1, key, (vp * 1)(g), 0))
+    _ck(lib, lib.MXKVStorePullEx(kv, 1, key, (vp * 1)(h), 0))
+    out = np.zeros(4, np.float32)
+    _ck(lib, lib.MXNDArraySyncCopyToCPU(h, out.ctypes.data_as(vp), 4))
+    np.testing.assert_allclose(out, np.zeros(4))  # 1 - 0.5*2 = 0
+    for x in (h, g):
+        _ck(lib, lib.MXNDArrayFree(x))
+    _ck(lib, lib.MXKVStoreFree(kv))
+
+
+def test_cpp_training_example_converges(tmp_path):
+    """Compile + run examples/cpp-train/train_mlp.cc; exit 0 asserts
+    accuracy > 0.9 (the CI convergence gate VERDICT r1 #7 asked for)."""
+    if not _build_lib():
+        pytest.skip("libmxtpu.so not built")
+    binpath = tmp_path / "train_mlp"
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         os.path.join(ROOT, "examples", "cpp-train", "train_mlp.cc"),
+         "-L" + os.path.join(ROOT, "mxnet_tpu", "_lib"), "-lmxtpu",
+         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu", "_lib"),
+         "-o", str(binpath)],
+        check=True, capture_output=True)
+    env = dict(os.environ, MXTPU_REPO=ROOT, MXTPU_PREDICT_PLATFORM="cpu")
+    env.pop("PYTHONPATH", None)
+    proc = subprocess.run([str(binpath)], env=env, capture_output=True,
+                          text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "accuracy" in proc.stdout
