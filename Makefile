@@ -25,7 +25,9 @@ $(LIBDIR)/libmxtpu_io.so: $(IO_SRCS) src/io/mxtpu_io.h
 
 # C predict ABI: embeds CPython and drives mxnet_tpu/c_predict.py
 # (reference analogue: src/c_api/c_predict_api.cc in libmxnet.so)
-$(LIBDIR)/libmxtpu_predict.so: src/capi/c_predict_api.cc src/capi/c_predict_api.h
+$(LIBDIR)/libmxtpu_predict.so: src/capi/c_predict_api.cc \
+                               src/capi/c_predict_api.h \
+                               src/capi/embed_common.h
 	@mkdir -p $(LIBDIR)
 	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) src/capi/c_predict_api.cc \
 	    $(LDFLAGS) $(PY_LDFLAGS) -o $@

@@ -8,6 +8,20 @@ Typical use mirrors the reference:
     x = mx.nd.zeros((2, 3), ctx=mx.tpu(0))
     net = mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=10)
 """
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Honor the standard JAX_PLATFORMS env var by force: plugin platforms
+    # (the axon TPU tunnel) win backend auto-selection even when the env
+    # asks for cpu, so subprocesses (example tests, tools/launch.py
+    # workers) would silently land on the real chip. config.update before
+    # first device use is the only switch the plugin respects.
+    try:
+        import jax as _jax
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # jax already initialized: leave the chosen backend
+        pass
+
 from . import base  # noqa: F401
 from . import ops  # noqa: F401  (populates the op table)
 from . import ndarray  # noqa: F401
@@ -60,6 +74,7 @@ from . import rtc  # noqa: F401
 from . import torch as th  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401
+from . import parallel  # noqa: F401
 from . import notebook  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401

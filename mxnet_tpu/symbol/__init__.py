@@ -38,12 +38,12 @@ def _make_sym_func(opdef: OpDef, name: str):
     return sym_func
 
 
-_mod = _sys.modules[__name__]
+_this_module = _sys.modules[__name__]
 for _name, _opdef in OP_TABLE.items():
-    if not hasattr(_mod, _name):
-        setattr(_mod, _name, _make_sym_func(_opdef, _name))
+    if not hasattr(_this_module, _name):
+        setattr(_this_module, _name, _make_sym_func(_opdef, _name))
 
-del _mod, _name, _opdef
+del _this_module, _name, _opdef
 
 from . import contrib  # noqa: F401,E402
 
