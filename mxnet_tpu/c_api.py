@@ -232,13 +232,25 @@ def kv_set_optimizer(kv, opt_name: str, keys: List[str],
     """MXKVStoreSetOptimizer analog: create a registered optimizer from
     string params and install it store-side (the reference pickles the
     optimizer to the servers; here the store runs it directly)."""
-    params = {}
-    for k, v in zip(keys, vals):
-        try:
-            params[k] = float(v) if "." in v or "e" in v.lower() else int(v)
-        except ValueError:
-            params[k] = v
+    params = {k: _parse_param_str(v) for k, v in zip(keys, vals)}
     kv.set_optimizer(_opt_mod.create(opt_name, **params))
+
+
+def _parse_param_str(v: str):
+    """String → typed optimizer param (reference: dmlc::Parameter typed
+    field parsing). Booleans must be handled before the numeric guess —
+    "False" is truthy as a string."""
+    low = v.strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v
 
 
 def random_seed(seed: int) -> None:

@@ -23,6 +23,36 @@ from .ndarray.ndarray import _as_jax
 __all__ = ["Executor", "build_graph_eval", "build_placed_graph_eval"]
 
 
+def _ambient_mesh_key():
+    """Hashable identity of the ambient mesh_scope mesh (or None).
+
+    Mesh-aware ops resolve the mesh at trace time, so compiled executor
+    programs are keyed on it — entering/leaving mesh_scope between calls
+    forces a retrace instead of silently reusing a program traced under
+    the other sharding regime."""
+    from .parallel.mesh import current_mesh
+    return current_mesh()
+
+
+def _resolve_group_devs(group2ctx):
+    """group2ctx {name: Context|Device} -> {name: jax Device}."""
+    devs = {}
+    for grp, c in (group2ctx or {}).items():
+        dev = getattr(c, "jax_device", c)  # Context property or raw Device
+        if callable(dev):
+            dev = dev()
+        if dev is not None:
+            devs[grp] = dev
+    return devs
+
+
+def _is_placed(group2ctx):
+    """True when the bind takes the multi-device placed path (>=2 distinct
+    group devices) — the one predicate shared by Symbol.simple_bind/bind
+    grad allocation and Executor.__init__'s branch."""
+    return len(set(_resolve_group_devs(group2ctx).values())) >= 2
+
+
 def build_graph_eval(symbol, collect_all=False, proxies=None):
     """Build eval_fn(arg_vals: dict, aux_vals: dict, rng, is_train)
     -> (outputs: list, aux_updates: dict). Pure and jax-traceable.
@@ -283,7 +313,7 @@ class Executor:
     def __init__(self, symbol, ctx, args: Dict[str, NDArray],
                  grads: Dict[str, NDArray], grad_req: Dict[str, str],
                  aux: Dict[str, NDArray], shared_exec: Optional["Executor"] = None,
-                 group2ctx=None):
+                 group2ctx=None, sparse_specs=None):
         self._symbol = symbol
         self._ctx = ctx
         self.arg_dict = args
@@ -299,14 +329,8 @@ class Executor:
         # share compiled programs across executors of the same graph
         # (reference: shared_exec memory-pool reuse for bucketing,
         # graph_executor.cc:879-881 — here we share the jit cache instead)
-        placed_devs = {}
-        if group2ctx:
-            for grp, c in group2ctx.items():
-                dev = getattr(c, "jax_device", c)  # Context property or raw Device
-                if callable(dev):
-                    dev = dev()
-                if dev is not None:
-                    placed_devs[grp] = dev
+        placed_devs = _resolve_group_devs(group2ctx) if _is_placed(group2ctx) \
+            else {}
         if shared_exec is not None and shared_exec._symbol is symbol:
             self._fwd = shared_exec._fwd
             self._fwd_bwd = shared_exec._fwd_bwd
@@ -314,14 +338,26 @@ class Executor:
         elif len(set(placed_devs.values())) >= 2:
             # ctx_group model parallelism: per-group device placement with
             # internally jitted segments; no outer jit (it would collapse
-            # everything back onto one device)
-            eval_fn = build_placed_graph_eval(symbol, placed_devs)
+            # everything back onto one device). The segment jits are built
+            # per ambient mesh: mesh-aware ops resolve the mesh at trace
+            # time, so a mesh change must produce fresh segment programs
+            # (same staleness rule as the single-device jit cache).
+            placed_evals = {}
 
-            def fwd_placed(arg_vals, aux_vals, rng, is_train):
-                return eval_fn(arg_vals, aux_vals, rng, is_train)
+            def _placed_eval(mesh_key):
+                fn = placed_evals.get(mesh_key)
+                if fn is None:
+                    fn = build_placed_graph_eval(symbol, placed_devs)
+                    placed_evals[mesh_key] = fn
+                return fn
+
+            def fwd_placed(arg_vals, aux_vals, rng, is_train, mesh_key=None):
+                return _placed_eval(mesh_key)(arg_vals, aux_vals, rng,
+                                              is_train)
 
             def fwd_bwd_placed(arg_vals, aux_vals, rng, head_grads,
-                               diff_names):
+                               diff_names, mesh_key=None):
+                eval_fn = _placed_eval(mesh_key)
                 diff = {n: arg_vals[n] for n in diff_names}
 
                 def f(diff_args):
@@ -346,16 +382,23 @@ class Executor:
             self._last = None
             return
         else:
-            self._sparse_specs = _sparse_grad_specs(symbol, grad_req)
+            self._sparse_specs = (sparse_specs if sparse_specs is not None
+                                  else _sparse_grad_specs(symbol, grad_req))
             specs = self._sparse_specs
             eval_fn = build_graph_eval(
                 symbol, proxies={s["nid"]: s["proxy"] for s in specs})
 
-            def fwd(arg_vals, aux_vals, rng, is_train):
+            # mesh_key is a pure cache key: mesh-aware ops (attention
+            # seq_axis) consult the ambient mesh at TRACE time, so the
+            # compiled program must be keyed on it — otherwise a program
+            # first traced outside mesh_scope would silently keep running
+            # unsharded under a later mesh (and vice versa)
+            def fwd(arg_vals, aux_vals, rng, is_train, mesh_key=None):
                 outs, aux_up = eval_fn(arg_vals, aux_vals, rng, is_train)
                 return outs, aux_up
 
-            def fwd_bwd(arg_vals, aux_vals, rng, head_grads, diff_names):
+            def fwd_bwd(arg_vals, aux_vals, rng, head_grads, diff_names,
+                        mesh_key=None):
                 # diff_names is static: each executor passes its own grad_req
                 # selection even when the compiled program is shared
                 diff = {n: arg_vals[n] for n in diff_names}
@@ -394,8 +437,8 @@ class Executor:
                 self._fwd = fwd
                 self._fwd_bwd = fwd_bwd
             else:
-                self._fwd = jax.jit(fwd, static_argnums=(3,))
-                self._fwd_bwd = jax.jit(fwd_bwd, static_argnums=(4,))
+                self._fwd = jax.jit(fwd, static_argnums=(3, 4))
+                self._fwd_bwd = jax.jit(fwd_bwd, static_argnums=(4, 5))
         self._last = None  # (arg_vals, aux_vals, rng) of the last forward
 
     # -- API ----------------------------------------------------------------
@@ -437,7 +480,8 @@ class Executor:
         from . import profiler as _profiler
         with _profiler.profile_scope("Forward", "executor", "symbolic",
                                      sync=lambda: outs):
-            outs, aux_up = self._fwd(arg_vals, aux_vals, rng, bool(is_train))
+            outs, aux_up = self._fwd(arg_vals, aux_vals, rng, bool(is_train),
+                                     _ambient_mesh_key())
         if is_train:
             for name, val in aux_up.items():
                 self.aux_dict[name]._set_data(val)
@@ -476,7 +520,8 @@ class Executor:
         with _profiler.profile_scope("ForwardBackward", "executor",
                                      "symbolic", sync=lambda: grads):
             outs, aux_up, grads, proxy_grads = self._fwd_bwd(
-                arg_vals, aux_vals, rng, head_grads, dense_diff)
+                arg_vals, aux_vals, rng, head_grads, dense_diff,
+                _ambient_mesh_key())
         self._last = (arg_vals, aux_vals, rng, True)
         self.outputs = [NDArray(o) for o in outs]
         for name, val in aux_up.items():
@@ -500,6 +545,13 @@ class Executor:
         *unique* index with duplicate contributions summed (reference:
         the sparse embedding backward's unique+sum kernel). The dense
         (vocab, dim) gradient is never allocated.
+
+        The result is written THROUGH the array the caller bound via
+        ``args_grad`` (reference bind contract: gradients land in the
+        caller's NDArrays, c_api callers read them via their own handle):
+        a bound RowSparseNDArray has its components swapped in place, a
+        bound dense array gets the scattered rows. Only when no grad
+        array was bound do we publish a fresh rsp array under the name.
         """
         import numpy as np
 
@@ -513,8 +565,16 @@ class Executor:
             rows, inv = np.unique(idx, return_inverse=True)
             vals = np.zeros((rows.size, g.shape[1]), g.dtype)
             np.add.at(vals, inv, g)
-            self.grad_dict[s["w"]] = RowSparseNDArray(
-                vals, rows, tuple(self.arg_dict[s["w"]].shape))
+            w_shape = tuple(self.arg_dict[s["w"]].shape)
+            bound = self.grad_dict.get(s["w"])
+            if isinstance(bound, RowSparseNDArray):
+                bound._replace_components(vals, rows)
+            elif bound is not None:
+                bound._set_data(
+                    jnp.zeros(w_shape, bound.dtype).at[rows].add(vals))
+            else:
+                self.grad_dict[s["w"]] = RowSparseNDArray(
+                    vals, rows, w_shape)
 
     def internal_outputs(self):
         """Evaluate and return {entry_name: NDArray} for EVERY op output in
@@ -540,13 +600,20 @@ class Executor:
                                     if i < len(node.op.output_names)
                                     else str(i))
                         names.append(f"{node.name}_{out_name}")
-            eval_fn = build_graph_eval(self._symbol, collect_all=True)
-            self._internals_fn = jax.jit(eval_fn, static_argnums=(3,))
+            raw_eval = build_graph_eval(self._symbol, collect_all=True)
+
+            def internals_eval(arg_vals, aux_vals, rng, is_train,
+                               mesh_key=None):
+                return raw_eval(arg_vals, aux_vals, rng, is_train)
+
+            self._internals_fn = jax.jit(internals_eval,
+                                         static_argnums=(3, 4))
             self._internals_names = names
         arg_vals, aux_vals, rng, is_train = self._last
         # same rng + same is_train as the real pass: dropout masks and BN
         # mode match what actually executed
-        vals, _ = self._internals_fn(arg_vals, aux_vals, rng, is_train)
+        vals, _ = self._internals_fn(arg_vals, aux_vals, rng, is_train,
+                                     _ambient_mesh_key())
         return {n: NDArray(v) for n, v in zip(self._internals_names, vals)}
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
@@ -565,8 +632,17 @@ class Executor:
             old = self.aux_dict[name]
             new_aux[name] = (old if tuple(old.shape) == tuple(shape)
                              else nd_zeros(shape, dtype=str(old.dtype)))
-        grads = {n: nd_zeros(new_args[n].shape, dtype=str(new_args[n].dtype))
-                 for n in self.grad_dict}
+        from .ndarray import sparse as _sparse
+        from .ndarray.sparse import RowSparseNDArray as _Rsp
+        grads = {}
+        for n, old_g in self.grad_dict.items():
+            if isinstance(old_g, _Rsp):
+                grads[n] = _sparse.zeros("row_sparse",
+                                         tuple(new_args[n].shape),
+                                         dtype=str(old_g.dtype))
+            else:
+                grads[n] = nd_zeros(new_args[n].shape,
+                                    dtype=str(new_args[n].dtype))
         return Executor(self._symbol, self._ctx, new_args, grads,
                         self._grad_req, new_aux, shared_exec=self)
 

@@ -260,6 +260,16 @@ class RowSparseNDArray(BaseSparseNDArray):
         raise MXNetError("cast_storage from row_sparse to csr is not "
                          "supported")
 
+    def _replace_components(self, data, indices):
+        """Swap in new (data, indices) IN PLACE, preserving identity.
+
+        Used by the executor's sparse-grad write-through (bind contract:
+        gradients land in the caller's array). Casts to this array's
+        dtype and invalidates the cached dense view."""
+        self._d = jnp.asarray(data).astype(self._sp_dtype)
+        self._i = jnp.asarray(indices, dtype=jnp.int32)
+        self._dense = None
+
     def retain(self, row_ids):
         return sparse_retain(self, row_ids)
 

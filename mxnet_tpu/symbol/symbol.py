@@ -553,15 +553,33 @@ class Symbol:
             grad_req = {n: grad_req for n in arg_names}
         elif isinstance(grad_req, (list, tuple)):
             grad_req = dict(zip(arg_names, grad_req))
+        # storage-type inference for gradients: sparse_grad Embedding
+        # weights get a row_sparse grad array up front, so the executor
+        # writes through the bound array without changing its stype
+        # (reference: MXExecutorSimpleBind infers grad stypes before
+        # allocating, c_api_executor.cc:219)
+        from ..executor import _is_placed, _sparse_grad_specs
+        # the multi-device placed path keeps every gradient dense
+        sparse_specs = ([] if _is_placed(group2ctx)
+                        else _sparse_grad_specs(self, grad_req))
+        rsp_grad_names = {s["w"] for s in sparse_specs}
         grads = {}
         for n, r in grad_req.items():
             if r == "null":
                 continue
             arr = _shared("grad_dict", n, args[n].shape, str(args[n].dtype))
-            grads[n] = arr if arr is not None else nd_zeros(
-                args[n].shape, dtype=str(args[n].dtype))
+            if arr is not None:
+                grads[n] = arr
+            elif n in rsp_grad_names:
+                from ..ndarray import sparse as _sparse
+                grads[n] = _sparse.zeros("row_sparse", tuple(args[n].shape),
+                                         dtype=str(args[n].dtype))
+            else:
+                grads[n] = nd_zeros(
+                    args[n].shape, dtype=str(args[n].dtype))
         return Executor(self, ctx, args, grads, grad_req, aux,
-                        shared_exec=shared_exec, group2ctx=group2ctx)
+                        shared_exec=shared_exec, group2ctx=group2ctx,
+                        sparse_specs=sparse_specs)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -586,8 +604,23 @@ class Symbol:
         elif isinstance(grad_req, (list, tuple)):
             grad_req = dict(zip(arg_names, grad_req))
         if args_grad is None:
-            args_grad = {n: nd_zeros(args[n].shape, dtype=str(args[n].dtype))
-                         for n, r in grad_req.items() if r != "null"}
+            # auto-allocated grads follow inferred storage types, like
+            # simple_bind: sparse_grad Embedding weights get rsp arrays
+            from ..executor import _is_placed, _sparse_grad_specs
+            from ..ndarray import sparse as _sparse
+            rsp_names = set() if _is_placed(group2ctx) else {
+                s["w"] for s in _sparse_grad_specs(self, grad_req)}
+            args_grad = {}
+            for n, r in grad_req.items():
+                if r == "null":
+                    continue
+                if n in rsp_names:
+                    args_grad[n] = _sparse.zeros(
+                        "row_sparse", tuple(args[n].shape),
+                        dtype=str(args[n].dtype))
+                else:
+                    args_grad[n] = nd_zeros(args[n].shape,
+                                            dtype=str(args[n].dtype))
         aux_states = dict(aux_states or {})
         for n in aux_names:
             if n not in aux_states:

@@ -87,6 +87,12 @@ PyObject *ApiModule() {
 
 /* Call mxnet_tpu.c_api.<fn>(...) with a pre-built argument tuple. */
 PyObject *CallApi(const char *fn, PyObject *argtuple) {
+  if (!argtuple) {
+    /* a Py_BuildValue/list-conversion failure upstream: capture the
+     * pending exception instead of calling with a live one */
+    SetErrorFromPython();
+    return nullptr;
+  }
   PyObject *mod = ApiModule();
   if (!mod) {
     Py_XDECREF(argtuple);
@@ -108,13 +114,21 @@ PyObject *CallApi(const char *fn, PyObject *argtuple) {
 
 PyObject *StrListToPy(mx_uint n, const char **strs) {
   PyObject *l = PyList_New(n);
-  for (mx_uint i = 0; i < n; ++i)
-    PyList_SET_ITEM(l, i, PyUnicode_FromString(strs ? strs[i] : ""));
+  if (!l) return nullptr;  /* caller's Py_BuildValue("N",...) handles NULL */
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject *s = PyUnicode_FromString(strs ? strs[i] : "");
+    if (!s) {
+      Py_DECREF(l);
+      return nullptr;
+    }
+    PyList_SET_ITEM(l, i, s);
+  }
   return l;
 }
 
 PyObject *NDListToPy(mx_uint n, NDArrayHandle *arr) {
   PyObject *l = PyList_New(n);
+  if (!l) return nullptr;
   for (mx_uint i = 0; i < n; ++i) {
     /* a NULL array (e.g. arg_grad_store on an inference-only bind) or
      * NULL element maps to None */
@@ -129,6 +143,12 @@ PyObject *NDListToPy(mx_uint n, NDArrayHandle *arr) {
 bool PyToStrList(PyObject *seq, StrList *out) {
   std::vector<std::string> v;
   Py_ssize_t n = PySequence_Size(seq);
+  if (n < 0) {
+    /* non-sequence: report instead of silently producing an empty list
+     * with a live Python exception corrupting the next embedded call */
+    SetErrorFromPython();
+    return false;
+  }
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject *it = PySequence_GetItem(seq, i);
     const char *c = it ? PyUnicode_AsUTF8(it) : nullptr;
@@ -167,6 +187,10 @@ bool PyShapeToVec(PyObject *shp, std::vector<mx_uint> *out) {
 bool PyToShapeGroup(PyObject *seq, ShapeGroup *out) {
   std::vector<std::vector<mx_uint>> v;
   Py_ssize_t n = PySequence_Size(seq);
+  if (n < 0) {
+    SetErrorFromPython();
+    return false;
+  }
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject *it = PySequence_GetItem(seq, i);
     std::vector<mx_uint> s;

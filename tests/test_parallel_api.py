@@ -176,3 +176,42 @@ def test_pipeline_from_symbol_rejects_bad_graphs():
                                   name="fc", flatten=False)
     with pytest.raises(mx.MXNetError):
         pipeline_from_symbol(plain, mesh)
+
+
+def test_executor_retraces_on_mesh_change():
+    """ADVICE r2: the executor's compiled program is keyed on the ambient
+    mesh. A graph first run OUTSIDE mesh_scope must not keep running the
+    unsharded program when later invoked under a mesh (and vice versa)."""
+    import mxnet_tpu.parallel.sequence as seq_mod
+
+    q = mx.sym.var("q")
+    out = mx.sym.MultiHeadAttention(q, q, q, num_heads=4, causal=True,
+                                    seq_axis="seq", name="attn")
+    ex = out.simple_bind(mx.cpu(), q=(2, 16, 32), grad_req="null")
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 16, 32).astype(np.float32)
+
+    calls = []
+    orig = seq_mod.sequence_sharded_attention
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    seq_mod.sequence_sharded_attention = counting
+    try:
+        ref = ex.forward(is_train=False, q=x)[0].asnumpy()   # no mesh
+        assert not calls
+        mesh = make_mesh({"data": 2, "seq": 4})
+        with mesh_scope(mesh):
+            sharded = ex.forward(is_train=False, q=x)[0].asnumpy()
+        assert calls, "mesh_scope did not force a retrace onto the " \
+                      "sequence-parallel path"
+        np.testing.assert_allclose(sharded, ref, rtol=1e-4, atol=1e-5)
+        # and back out of the mesh: cached unsharded program, same result
+        n = len(calls)
+        again = ex.forward(is_train=False, q=x)[0].asnumpy()
+        assert len(calls) == n
+        np.testing.assert_allclose(again, ref, rtol=1e-4, atol=1e-5)
+    finally:
+        seq_mod.sequence_sharded_attention = orig

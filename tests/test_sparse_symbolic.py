@@ -228,3 +228,53 @@ def test_tied_sparse_embedding_falls_back_dense():
     expect = jax.grad(f)(jnp.asarray(W))
     np.testing.assert_allclose(g.asnumpy(), np.asarray(expect),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_grad_writes_through_bound_arrays():
+    """bind(args_grad=...) contract: the gradient lands IN the arrays the
+    caller supplied (reference: GraphExecutor writes grads into the bound
+    NDArrays; C-ABI callers read them via the handle they passed in).
+    A bound rsp array is updated in place; a bound dense array receives
+    the scattered rows."""
+    V, D, B = 40, 4, 6
+    data = mx.sym.var("data")
+    w = mx.sym.var("embed_weight", stype="row_sparse")
+    emb = mx.sym.Embedding(data, w, input_dim=V, output_dim=D,
+                           sparse_grad=True, name="embed")
+    out = mx.sym.sum(emb)
+    rng = np.random.RandomState(3)
+    W = rng.randn(V, D).astype(np.float32)
+    idx = np.array([7, 2, 7, 11, 0, 2], np.float32)
+
+    # caller-bound row_sparse gradient array: same object, new contents
+    g_rsp = RowSparseNDArray(np.zeros((0, D), np.float32),
+                             np.zeros((0,), np.int32), (V, D))
+    ex = out.bind(mx.cpu(),
+                  args={"data": mx.nd.array(idx),
+                        "embed_weight": mx.nd.array(W)},
+                  args_grad={"embed_weight": g_rsp},
+                  grad_req={"embed_weight": "write", "data": "null"})
+    _ = g_rsp.asnumpy()        # populate the cached dense view pre-backward
+    ex.forward(is_train=True)
+    ex.backward()
+    assert ex.grad_dict["embed_weight"] is g_rsp
+    assert list(g_rsp.indices.asnumpy()) == [0, 2, 7, 11]
+    # the in-place component swap must invalidate the cached dense view
+    dense_after = g_rsp.asnumpy()
+    expect_rsp = np.zeros((V, D), np.float32)
+    np.add.at(expect_rsp, idx.astype(np.int64), np.ones((B, D), np.float32))
+    np.testing.assert_allclose(dense_after, expect_rsp, rtol=1e-6)
+
+    # caller-bound dense gradient array: written through, not rebound
+    g_dense = mx.nd.zeros((V, D))
+    ex2 = out.bind(mx.cpu(),
+                   args={"data": mx.nd.array(idx),
+                         "embed_weight": mx.nd.array(W)},
+                   args_grad={"embed_weight": g_dense},
+                   grad_req={"embed_weight": "write", "data": "null"})
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert ex2.grad_dict["embed_weight"] is g_dense
+    expect = np.zeros((V, D), np.float32)
+    np.add.at(expect, idx.astype(np.int64), np.ones((B, D), np.float32))
+    np.testing.assert_allclose(g_dense.asnumpy(), expect, rtol=1e-6)
