@@ -256,3 +256,518 @@ def _parse_param_str(v: str):
 def random_seed(seed: int) -> None:
     from . import random as _random
     _random.seed(seed)
+
+
+# =========================================================================
+# Round-3 surface: autograd, CachedOp, DataIter, sparse NDArray, RecordIO,
+# and the NDArray/Symbol/Executor/KVStore query tails — the groups every
+# reference frontend binds (reference: c_api.h:717-760 autograd,
+# :764-797 CachedOp, :1402-1461 DataIter, :298 sparse).
+# =========================================================================
+
+from . import autograd as _ag
+
+# reference dtype codes (mshadow/base.h type enum, mirrored by every
+# frontend's DType mapping)
+_DTYPE_TO_CODE = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                  "int32": 4, "int8": 5, "int64": 6}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+# reference storage-type codes (python/mxnet/ndarray/ndarray.py
+# _STORAGE_TYPE_STR_TO_ID)
+_STYPE_TO_CODE = {"default": 0, "row_sparse": 1, "csr": 2}
+
+
+def version() -> int:
+    """MXGetVersion: MAJOR*10000 + MINOR*100 + PATCH."""
+    from . import __version__
+    parts = (__version__.split(".") + ["0", "0"])[:3]
+    nums = [int("".join(c for c in p if c.isdigit()) or 0) for p in parts]
+    return nums[0] * 10000 + nums[1] * 100 + nums[2]
+
+
+# -- NDArray query/view tail ----------------------------------------------
+
+def nd_dtype(arr: NDArray) -> int:
+    return _DTYPE_TO_CODE[str(np.dtype(arr.dtype))]
+
+
+def nd_context(arr: NDArray) -> Tuple[int, int]:
+    ctx = arr.context
+    return (1 if ctx.device_type == "cpu" else 2), ctx.device_id
+
+
+def nd_reshape(arr: NDArray, shape: Sequence[int]) -> NDArray:
+    return arr.reshape(tuple(int(s) for s in shape))
+
+
+def nd_slice(arr: NDArray, start: int, stop: int) -> NDArray:
+    return arr[int(start):int(stop)]
+
+
+def nd_at(arr: NDArray, idx: int) -> NDArray:
+    return arr[int(idx)]
+
+
+def nd_get_grad(arr: NDArray) -> NDArray:
+    g = arr.grad
+    if g is None:
+        raise MXNetError("NDArray has no gradient buffer: call "
+                         "MXAutogradMarkVariables first")
+    return g
+
+
+def nd_detach(arr: NDArray) -> NDArray:
+    return arr.detach()
+
+
+def nd_to_bytes(arr: NDArray) -> bytes:
+    """MXNDArraySaveRawBytes. Opaque round-trip format: little-endian
+    header (ndim, dims..., dtype code) + raw buffer."""
+    a = arr.asnumpy()
+    code = _DTYPE_TO_CODE[str(a.dtype)]
+    head = np.array([a.ndim] + list(a.shape) + [code], np.int64)
+    return head.tobytes() + np.ascontiguousarray(a).tobytes()
+
+
+def nd_from_bytes(buf) -> NDArray:
+    raw = bytes(buf)
+    ndim = int(np.frombuffer(raw[:8], np.int64)[0])
+    head = np.frombuffer(raw[: 8 * (ndim + 2)], np.int64)
+    shape = tuple(int(s) for s in head[1:1 + ndim])
+    dtype = _CODE_TO_DTYPE[int(head[ndim + 1])]
+    data = np.frombuffer(raw[8 * (ndim + 2):], dtype).reshape(shape)
+    return nd.array(np.array(data), dtype=dtype)
+
+
+# -- sparse NDArray group -------------------------------------------------
+
+def nd_create_sparse(storage_type: int, shape: Sequence[int], dev_type: int,
+                     dev_id: int, dtype: int,
+                     aux_shapes: List[Sequence[int]]) -> NDArray:
+    """MXNDArrayCreateSparseEx: an empty sparse array whose components are
+    sized by ``aux_shapes`` (filled via nd_sync_copy_from_nd, the same
+    create-then-fill flow the reference python frontend uses)."""
+    from .ndarray import sparse as _sp
+    dt = _CODE_TO_DTYPE[int(dtype)]
+    shape = tuple(int(s) for s in shape)
+    if storage_type == _STYPE_TO_CODE["row_sparse"]:
+        nnz = int(aux_shapes[0][0]) if aux_shapes else 0
+        return _sp.RowSparseNDArray(
+            np.zeros((nnz,) + shape[1:], dt), np.zeros((nnz,), np.int64),
+            shape)
+    if storage_type == _STYPE_TO_CODE["csr"]:
+        # aux order matches the reference: 0 = indptr, 1 = indices
+        nnz = int(aux_shapes[1][0]) if len(aux_shapes) > 1 else 0
+        return _sp.CSRNDArray(np.zeros((nnz,), dt),
+                              np.zeros((nnz,), np.int64),
+                              np.zeros((shape[0] + 1,), np.int64), shape)
+    raise MXNetError(f"unknown sparse storage type code {storage_type}")
+
+
+def nd_storage_type(arr: NDArray) -> int:
+    return _STYPE_TO_CODE[getattr(arr, "stype", "default")]
+
+
+def nd_data_component(arr: NDArray) -> NDArray:
+    if nd_storage_type(arr) == 0:
+        raise MXNetError("dense NDArray has no data component handle")
+    return arr.data
+
+
+def nd_aux_component(arr: NDArray, i: int) -> NDArray:
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(arr, RowSparseNDArray):
+        if i != 0:
+            raise MXNetError("row_sparse has one aux array (0 = indices)")
+        return arr.indices
+    if isinstance(arr, CSRNDArray):
+        if i == 0:
+            return arr.indptr
+        if i == 1:
+            return arr.indices
+        raise MXNetError("csr aux arrays: 0 = indptr, 1 = indices")
+    raise MXNetError("dense NDArray has no aux components")
+
+
+def nd_sync_copy_from_nd(dst: NDArray, src: NDArray, i: int) -> None:
+    """MXNDArraySyncCopyFromNDArray: fill dst's data (i == -1) or aux
+    component i from a dense src array."""
+    import jax.numpy as jnp
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    val = src._data
+    if isinstance(dst, RowSparseNDArray):
+        if i == -1:
+            dst._d = jnp.asarray(val).astype(dst._sp_dtype)
+        elif i == 0:
+            dst._i = jnp.asarray(val, dtype=jnp.int32)
+        else:
+            raise MXNetError("row_sparse aux index must be 0")
+        dst._dense = None
+        return
+    if isinstance(dst, CSRNDArray):
+        if i == -1:
+            dst._d = jnp.asarray(val).astype(dst._sp_dtype)
+        elif i == 0:
+            dst._p = jnp.asarray(val, dtype=jnp.int32)
+        elif i == 1:
+            dst._i = jnp.asarray(val, dtype=jnp.int32)
+        else:
+            raise MXNetError("csr aux index must be 0 (indptr) or 1")
+        dst._dense = None
+        return
+    if i != -1:
+        raise MXNetError("dense NDArray has no aux components")
+    nd_assign(dst, src)
+
+
+# -- autograd group -------------------------------------------------------
+
+_GRAD_REQ_CODES = {0: "null", 1: "write", 2: "inplace", 3: "add"}
+
+
+def autograd_set_recording(flag: int) -> int:
+    return int(_ag.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag: int) -> int:
+    return int(_ag.set_training(bool(flag)))
+
+
+def autograd_is_recording() -> int:
+    return int(_ag.is_recording())
+
+
+def autograd_is_training() -> int:
+    return int(_ag.is_training())
+
+
+def autograd_mark_variables(variables: List[NDArray], reqs: List[int],
+                            grads: List[NDArray]) -> None:
+    _ag.mark_variables(variables, grads,
+                       [_GRAD_REQ_CODES.get(int(r), "write") for r in reqs])
+
+
+def autograd_backward(heads: List[NDArray], head_grads: List[NDArray],
+                      retain_graph: int, is_train: int) -> None:
+    hg = list(head_grads) if any(g is not None for g in head_grads) else None
+    _ag.backward(list(heads), hg, retain_graph=bool(retain_graph),
+                 train_mode=bool(is_train))
+
+
+# -- CachedOp group -------------------------------------------------------
+
+class CachedOp:
+    """Reference: MXCreateCachedOp / MXInvokeCachedOp (c_api.h:764-797) —
+    the per-block compiled graph behind gluon's hybridize. Here the symbol
+    is traced once into one XLA program (jit cache keyed on input shapes
+    by jax); inputs arrive positionally in list_arguments + aux order.
+
+    Differentiable through the imperative tape: when autograd is
+    recording, the invocation is taped as a single AGNode whose vjp is
+    the whole compiled graph's vjp (the reference tapes each internal op;
+    one fused node is the XLA-era equivalent)."""
+
+    def __init__(self, sym):
+        import jax as _jax
+        from .executor import _ambient_mesh_key, build_graph_eval
+        self.sym = sym
+        self.arg_names = sym.list_arguments()
+        self.aux_names = sym.list_auxiliary_states()
+        self.n_outputs = len(sym.list_outputs())
+        raw = build_graph_eval(sym)
+
+        def eval_outputs(arg_vals, aux_vals, rng, is_train, mesh_key=None):
+            outs, _aux = raw(arg_vals, aux_vals, rng, is_train)
+            return outs
+
+        self._fn = _jax.jit(eval_outputs, static_argnums=(3, 4))
+        self._mesh_key = _ambient_mesh_key
+
+    def _run(self, flat_vals, is_train, rng):
+        n = len(self.arg_names)
+        arg_vals = dict(zip(self.arg_names, flat_vals[:n]))
+        aux_vals = dict(zip(self.aux_names, flat_vals[n:]))
+        return self._fn(arg_vals, aux_vals, rng, bool(is_train),
+                        self._mesh_key())
+
+    def __call__(self, inputs: List[NDArray]) -> List[NDArray]:
+        expected = len(self.arg_names) + len(self.aux_names)
+        if len(inputs) != expected:
+            raise MXNetError(
+                f"CachedOp expects {expected} inputs "
+                f"({len(self.arg_names)} args + {len(self.aux_names)} aux), "
+                f"got {len(inputs)}")
+        is_train = _ag.is_training()
+        vals = [x._data for x in inputs]
+        from . import random as _random
+        rng = _random.next_key()
+        outs = self._run(vals, is_train, rng)
+        arrays = [NDArray(o) for o in outs]
+        if _ag.is_recording():
+            op = self
+
+            class _CachedOpDef:
+                name = "CachedOp"
+                # the backward replay must see the SAME key the forward
+                # used (dropout masks etc.); AGNode saves it because
+                # needs_rng is set
+                needs_rng = True
+                differentiable = True
+                grad_fn = None
+
+                @staticmethod
+                def fn(rng_key, *flat_vals):
+                    return tuple(op._run(list(flat_vals), is_train,
+                                         rng_key))
+
+            node = _ag.AGNode(_CachedOpDef, {}, rng, list(inputs),
+                              vals, len(arrays), [a._data for a in arrays])
+            for i, a in enumerate(arrays):
+                a._ag_node = node
+                a._ag_out_index = i
+        return arrays
+
+
+def cached_op_create(sym) -> CachedOp:
+    return CachedOp(sym)
+
+
+def cached_op_invoke(op: CachedOp, inputs: List[NDArray]) -> List[NDArray]:
+    return op(list(inputs))
+
+
+# -- DataIter group -------------------------------------------------------
+
+def _parse_iter_param(v: str):
+    s = v.strip()
+    if s.startswith("(") or s.startswith("["):
+        from .base import AttrSpec
+        return AttrSpec.PARSERS["tuple"](s)
+    return _parse_param_str(s)
+
+
+# name -> (factory, description). The reference's MXListDataIters surfaces
+# the C++-registered iterators (MXNET_REGISTER_IO_ITER); these are the
+# same user-facing set.
+def _iter_registry():
+    from . import io as _io
+    return {
+        "MNISTIter": (_io.MNISTIter, "MNIST ubyte-file iterator"),
+        "CSVIter": (_io.CSVIter, "CSV file iterator"),
+        "LibSVMIter": (_io.LibSVMIter, "LibSVM sparse-format iterator"),
+        "ImageRecordIter": (_io.ImageRecordIter,
+                            "RecordIO image iterator with augmentation"),
+    }
+
+
+def list_data_iters() -> List[str]:
+    return sorted(_iter_registry())
+
+
+def data_iter_info(name: str):
+    import inspect
+    fac, desc = _iter_registry()[name]
+    params = inspect.signature(fac).parameters
+    names, types, descs = [], [], []
+    for p in params.values():
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        names.append(p.name)
+        default = "" if p.default is p.empty else f", default={p.default!r}"
+        types.append(f"any{default}")
+        descs.append("")
+    return name, desc, names, types, descs
+
+
+class _CIter:
+    """C-side iterator state: the underlying DataIter + current batch."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def data_iter_create(name: str, keys: List[str], vals: List[str]) -> _CIter:
+    fac, _ = _iter_registry()[name]
+    params = {k: _parse_iter_param(v) for k, v in zip(keys, vals)}
+    return _CIter(fac(**params))
+
+
+def data_iter_next(ci: _CIter) -> int:
+    try:
+        ci.batch = ci.it.next()
+        return 1
+    except StopIteration:
+        ci.batch = None
+        return 0
+
+
+def data_iter_reset(ci: _CIter) -> None:
+    ci.it.reset()
+    ci.batch = None
+
+
+def _current_batch(ci: _CIter):
+    if ci.batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first")
+    return ci.batch
+
+
+def data_iter_data(ci: _CIter) -> NDArray:
+    return _current_batch(ci).data[0]
+
+
+def data_iter_label(ci: _CIter) -> NDArray:
+    return _current_batch(ci).label[0]
+
+
+def data_iter_pad(ci: _CIter) -> int:
+    return int(_current_batch(ci).pad or 0)
+
+
+def data_iter_index(ci: _CIter) -> List[int]:
+    idx = _current_batch(ci).index
+    return [int(i) for i in idx] if idx is not None else []
+
+
+# -- RecordIO group -------------------------------------------------------
+
+def recordio_writer_create(uri: str):
+    from .recordio import MXRecordIO
+    return MXRecordIO(uri, "w")
+
+
+def recordio_reader_create(uri: str):
+    from .recordio import MXRecordIO
+    return MXRecordIO(uri, "r")
+
+
+def recordio_close(rec) -> None:
+    rec.close()
+
+
+def recordio_write(rec, buf) -> None:
+    rec.write(bytes(buf))
+
+
+def recordio_tell(rec) -> int:
+    return int(rec.tell())
+
+
+def recordio_read(rec):
+    """-> bytes or None at EOF."""
+    return rec.read()
+
+
+def recordio_seek(rec, pos: int) -> None:
+    rec.record.seek(int(pos))
+
+
+# -- Symbol query tail ----------------------------------------------------
+
+def sym_op_info(op_name: str):
+    """MXSymbolGetAtomicSymbolInfo: (name, description, arg_names,
+    arg_type_infos, arg_descriptions, key_var_num_args, return_type) —
+    the metadata frontends use to code-generate their op namespaces
+    (reference: every binding's op generator reads this)."""
+    op = OP_TABLE.get(op_name)
+    if op is None:
+        raise MXNetError(f"unknown operator {op_name!r}")
+    names, types, descs = [], [], []
+    for k, (typ, default) in op.attr_spec.fields.items():
+        names.append(k)
+        from .base import AttrSpec
+        if default is AttrSpec._REQUIRED:
+            types.append(f"{typ}, required")
+        else:
+            types.append(f"{typ}, optional, default={default!r}")
+        descs.append("")
+    doc = (op.fn.__doc__ or "").strip().split("\n")[0]
+    return (op_name, doc, names, types, descs,
+            op.key_var_num_args or "", "NDArray-or-Symbol")
+
+
+def sym_copy(sym):
+    return sym.__copy__() if hasattr(sym, "__copy__") else _copy_sym(sym)
+
+
+def _copy_sym(sym):
+    return _sym_mod.load_json(sym.tojson())
+
+
+def sym_get_name(sym) -> str:
+    return sym.name or ""
+
+
+def sym_get_attr(sym, key: str) -> Optional[str]:
+    v = sym.attr(key)
+    return None if v is None else str(v)
+
+
+def sym_set_attr(sym, key: str, value: str) -> None:
+    sym._set_attr(**{key: value})
+
+
+def sym_list_attr(sym) -> List[str]:
+    """Flattened [k0, v0, k1, v1, ...] of the output node's attributes
+    (scope attrs + serialized op params, like the reference's
+    MXSymbolListAttrShallow)."""
+    node = sym._outputs[0][0]
+    d = dict(node.scope_attrs)
+    if node.op is not None:
+        d.update(node.op.attr_spec.serialize(node.attrs))
+    else:
+        d.update({k: str(v) for k, v in node.attrs.items()})
+    flat = []
+    for k, v in sorted(d.items()):
+        flat.extend([str(k), str(v)])
+    return flat
+
+
+def sym_get_internals(sym):
+    return sym.get_internals()
+
+
+def sym_get_output(sym, index: int):
+    return sym[int(index)]
+
+
+def sym_group(syms: list):
+    return _sym_mod.Group(list(syms))
+
+
+def sym_infer_type(sym, names: List[str], type_codes: List[int]):
+    """-> (arg_codes, out_codes, aux_codes)."""
+    known = {n: _CODE_TO_DTYPE[int(c)] for n, c in zip(names, type_codes)}
+    arg, out, aux = sym.infer_type(**known)
+    to_code = lambda ts: [_DTYPE_TO_CODE[str(np.dtype(t))] for t in ts]
+    return to_code(arg), to_code(out), to_code(aux)
+
+
+# -- Executor / KVStore tails ---------------------------------------------
+
+def executor_print(ex) -> str:
+    return ex.debug_str()
+
+
+def kv_barrier(kv) -> None:
+    kv.barrier()
+
+
+def kv_rank(kv) -> int:
+    return int(kv.rank)
+
+
+def kv_group_size(kv) -> int:
+    return int(kv.num_workers)
+
+
+def kv_num_dead_node(kv, node_id: int, timeout_sec: int) -> int:
+    return int(kv.num_dead_node(node_id, timeout_sec))
+
+
+def kv_pull_row_sparse(kv, keys: List[str], outs: List[NDArray],
+                       row_id_arrays: List[NDArray], priority: int) -> None:
+    for k, out, rid in zip(keys, outs, row_id_arrays):
+        kv.row_sparse_pull(k, out=out, priority=priority, row_ids=rid)

@@ -329,19 +329,18 @@ class Executor:
         # share compiled programs across executors of the same graph
         # (reference: shared_exec memory-pool reuse for bucketing,
         # graph_executor.cc:879-881 — here we share the jit cache instead)
-        placed_devs = _resolve_group_devs(group2ctx) if _is_placed(group2ctx) \
-            else {}
         if shared_exec is not None and shared_exec._symbol is symbol:
             self._fwd = shared_exec._fwd
             self._fwd_bwd = shared_exec._fwd_bwd
             self._sparse_specs = shared_exec._sparse_specs
-        elif len(set(placed_devs.values())) >= 2:
+        elif _is_placed(group2ctx):
             # ctx_group model parallelism: per-group device placement with
             # internally jitted segments; no outer jit (it would collapse
             # everything back onto one device). The segment jits are built
             # per ambient mesh: mesh-aware ops resolve the mesh at trace
             # time, so a mesh change must produce fresh segment programs
             # (same staleness rule as the single-device jit cache).
+            placed_devs = _resolve_group_devs(group2ctx)
             placed_evals = {}
 
             def _placed_eval(mesh_key):

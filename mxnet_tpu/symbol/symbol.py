@@ -367,15 +367,42 @@ class Symbol:
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, *args, **kwargs):
+        """Forward dtype inference (reference: InferType pass,
+        infer_graph_attr_pass.cc). Variables take their declared dtype
+        (positional in list_arguments order, or by keyword), defaulting
+        to float32; op outputs carry the numpy-promoted dtype of their
+        inputs, with ``Cast``'s declared dtype overriding."""
         arg_names = self.list_arguments()
-        dtypes = [None] * len(arg_names)
+        known = {}
         for i, a in enumerate(args):
-            dtypes[i] = a
-        # default: everything float32 (reference default_dtype)
-        arg_types = [_np.dtype(d) if d is not None else _np.dtype("float32")
-                     for d in dtypes]
-        out_types = [_np.dtype("float32")] * len(self._outputs)
-        aux_types = [_np.dtype("float32")] * len(self.list_auxiliary_states())
+            if a is not None:
+                known[arg_names[i]] = _np.dtype(a)
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = _np.dtype(v)
+        aux_ids = self._aux_node_ids()
+        dtypes: Dict[Tuple[int, int], _np.dtype] = {}
+        f32 = _np.dtype("float32")
+        for node in self._topo_nodes():
+            if node.is_variable:
+                dtypes[(id(node), 0)] = known.get(node.name, f32)
+                continue
+            ins = [dtypes[(id(p), i)] for p, i in node.inputs]
+            if node.op.name in ("Cast", "cast") and "dtype" in node.attrs:
+                out = _np.dtype(node.attrs["dtype"])
+            elif ins:
+                out = ins[0]
+                for d in ins[1:]:
+                    out = _np.promote_types(out, d)
+            else:
+                out = f32
+            for i in range(node.num_outputs()):
+                dtypes[(id(node), i)] = out
+        name_dt = {n.name: dtypes[(id(n), 0)]
+                   for n in self._topo_nodes() if n.is_variable}
+        arg_types = [name_dt[n] for n in arg_names]
+        aux_types = [name_dt[n] for n in self.list_auxiliary_states()]
+        out_types = [dtypes[(id(n), i)] for n, i in self._outputs]
         return arg_types, out_types, aux_types
 
     def infer_storage_type(self, **kwargs):

@@ -30,6 +30,7 @@ namespace {
 struct NDRec {
   PyObject *obj;
   std::vector<mx_uint> shape;
+  std::string bytes;  /* scratch for MXNDArraySaveRawBytes */
 };
 
 struct StrList {
@@ -72,11 +73,35 @@ struct ExecRec {
    * handles themselves are owned by the CALLER (freed with
    * MXNDArrayFree), matching MXImperativeInvokeByName's convention */
   std::vector<NDArrayHandle> outputs;
+  std::string debug;
 };
 
 struct KVRec {
   PyObject *obj;
   std::string type;
+};
+
+struct CachedRec {
+  PyObject *obj;  /* mxnet_tpu.c_api.CachedOp */
+  std::vector<NDArrayHandle> outputs;
+};
+
+struct IterRec {
+  PyObject *obj;  /* mxnet_tpu.c_api._CIter */
+  std::vector<mx_uint64> index;
+};
+
+struct RecIORec {
+  PyObject *obj;  /* mxnet_tpu.recordio.MXRecordIO */
+  std::string buf;
+};
+
+/* Per-creator metadata scratch for MXDataIterGetIterInfo /
+ * MXSymbolGetAtomicSymbolInfo (views stay valid for the library
+ * lifetime, keyed by creator). */
+struct InfoRec {
+  std::string name, desc, kv_num_args, ret_type;
+  StrList arg_names, arg_types, arg_descs;
 };
 
 PyObject *ApiModule() {
@@ -236,7 +261,7 @@ int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
   PyObject *res =
       CallApi("nd_create", Py_BuildValue("(Nii)", shp, dev_type, dev_id));
   if (!res) return -1;
-  *out = new NDRec{res, {}};
+  *out = new NDRec{res, {}, {}};
   return 0;
 }
 
@@ -354,7 +379,7 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
   Py_ssize_t n = PySequence_Size(pvals);
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject *it = PySequence_GetItem(pvals, i);
-    arrs.push_back(new NDRec{it, {}});
+    arrs.push_back(new NDRec{it, {}, {}});
   }
   Py_DECREF(res);
   *out_size = static_cast<mx_uint>(arrs.size());
@@ -400,7 +425,7 @@ int MXImperativeInvokeByName(const char *op_name, int num_inputs,
   outs.clear();
   Py_ssize_t n = PySequence_Size(res);
   for (Py_ssize_t i = 0; i < n; ++i)
-    outs.push_back(new NDRec{PySequence_GetItem(res, i), {}});
+    outs.push_back(new NDRec{PySequence_GetItem(res, i), {}, {}});
   Py_DECREF(res);
   *num_outputs = static_cast<int>(outs.size());
   *outputs = outs.data();
@@ -615,7 +640,7 @@ int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
                     NDListToPy(len, arg_grad_store), reqs,
                     NDListToPy(aux_states_len, aux_states)));
   if (!res) return -1;
-  *out = new ExecRec{res, {}};
+  *out = new ExecRec{res, {}, {}};
   return 0;
 }
 
@@ -651,7 +676,7 @@ int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
   rec->outputs.clear();
   Py_ssize_t n = PySequence_Size(res);
   for (Py_ssize_t i = 0; i < n; ++i)
-    rec->outputs.push_back(new NDRec{PySequence_GetItem(res, i), {}});
+    rec->outputs.push_back(new NDRec{PySequence_GetItem(res, i), {}, {}});
   Py_DECREF(res);
   *out_size = static_cast<mx_uint>(rec->outputs.size());
   *out = rec->outputs.data();
@@ -743,6 +768,854 @@ int MXKVStoreSetOptimizer(KVStoreHandle handle, const char *opt_name,
                     StrListToPy(num_param, vals)));
   if (!res) return -1;
   Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  GIL gil;
+  PyObject *res = CallApi(
+      "kv_barrier", Py_BuildValue("(O)", static_cast<KVRec *>(handle)->obj));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* Call an api fn returning one int. Caller must hold the GIL (it built
+ * the arg tuple). */
+static int IntQuery(const char *fn, PyObject *args, int *out) {
+  PyObject *res = CallApi(fn, args);
+  if (!res) return -1;
+  long v = PyLong_AsLong(res);
+  Py_DECREF(res);
+  if (v == -1 && PyErr_Occurred()) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out = static_cast<int>(v);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank) {
+  GIL gil;
+  return IntQuery("kv_rank",
+                  Py_BuildValue("(O)", static_cast<KVRec *>(handle)->obj),
+                  rank);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
+  GIL gil;
+  return IntQuery("kv_group_size",
+                  Py_BuildValue("(O)", static_cast<KVRec *>(handle)->obj),
+                  size);
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number,
+                            int timeout_sec) {
+  GIL gil;
+  return IntQuery(
+      "kv_num_dead_node",
+      Py_BuildValue("(Oii)", static_cast<KVRec *>(handle)->obj, node_id,
+                    timeout_sec),
+      number);
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             NDArrayHandle *row_ids, int priority) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *res = CallApi(
+      "kv_pull_row_sparse",
+      Py_BuildValue("(ONNNi)", rec->obj, StrListToPy(num, keys),
+                    NDListToPy(num, vals), NDListToPy(num, row_ids),
+                    priority));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- NDArray query/view tail ------------------------------------------ */
+
+int MXGetVersion(int *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("version", PyTuple_New(0));
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_dtype", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_context", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  int ok = PyArg_ParseTuple(res, "ii", out_dev_type, out_dev_id);
+  Py_DECREF(res);
+  if (!ok) {
+    SetErrorFromPython();
+    return -1;
+  }
+  return 0;
+}
+
+/* Call an api fn returning one NDArray and wrap it in a fresh handle. */
+static int NDProduce(const char *fn, PyObject *args, NDArrayHandle *out) {
+  PyObject *res = CallApi(fn, args);
+  if (!res) return -1;
+  *out = new NDRec{res, {}, {}};
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLong(dims[i]));
+  return NDProduce("nd_reshape", Py_BuildValue("(ON)", rec->obj, shp), out);
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  return NDProduce(
+      "nd_slice",
+      Py_BuildValue("(OII)", rec->obj, slice_begin, slice_end), out);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  return NDProduce("nd_at", Py_BuildValue("(OI)", rec->obj, idx), out);
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  return NDProduce("nd_get_grad", Py_BuildValue("(O)", rec->obj), out);
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  return NDProduce("nd_detach", Py_BuildValue("(O)", rec->obj), out);
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_to_bytes", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  rec->bytes.assign(buf, static_cast<size_t>(len));
+  Py_DECREF(res);
+  *out_size = rec->bytes.size();
+  *out_buf = rec->bytes.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *mv = PyMemoryView_FromMemory(
+      const_cast<char *>(static_cast<const char *>(buf)), size, PyBUF_READ);
+  return NDProduce("nd_from_bytes", Py_BuildValue("(N)", mv), out);
+}
+
+/* ---- sparse NDArray ---------------------------------------------------- */
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int /*delay_alloc*/, int dtype, mx_uint num_aux,
+                            int * /*aux_type*/, mx_uint *aux_ndims,
+                            const mx_uint *aux_shape, NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject *aux = PyList_New(num_aux);
+  mx_uint off = 0;
+  for (mx_uint a = 0; a < num_aux; ++a) {
+    mx_uint nd_a = aux_ndims ? aux_ndims[a] : 0;
+    PyObject *s = PyTuple_New(nd_a);
+    for (mx_uint j = 0; j < nd_a; ++j)
+      PyTuple_SET_ITEM(s, j, PyLong_FromUnsignedLong(aux_shape[off + j]));
+    off += nd_a;
+    PyList_SET_ITEM(aux, a, s);
+  }
+  return NDProduce(
+      "nd_create_sparse",
+      Py_BuildValue("(iNiiiN)", storage_type, shp, dev_type, dev_id, dtype,
+                    aux),
+      out);
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_storage_type", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *out_storage_type = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  return NDProduce("nd_data_component", Py_BuildValue("(O)", rec->obj), out);
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  return NDProduce("nd_aux_component",
+                   Py_BuildValue("(OI)", rec->obj, i), out);
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 NDArrayHandle handle_src, int i) {
+  GIL gil;
+  PyObject *res = CallApi(
+      "nd_sync_copy_from_nd",
+      Py_BuildValue("(OOi)", static_cast<NDRec *>(handle_dst)->obj,
+                    static_cast<NDRec *>(handle_src)->obj, i));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- autograd ---------------------------------------------------------- */
+
+static int AGFlagCall(const char *fn, int flag, int *prev) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi(fn, Py_BuildValue("(i)", flag));
+  if (!res) return -1;
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+static int AGFlagQuery(const char *fn, int *curr) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi(fn, PyTuple_New(0));
+  if (!res) return -1;
+  *curr = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  return AGFlagCall("autograd_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  return AGFlagCall("autograd_set_training", is_training, prev);
+}
+
+int MXAutogradIsRecording(int *curr) {
+  return AGFlagQuery("autograd_is_recording", curr);
+}
+
+int MXAutogradIsTraining(int *curr) {
+  return AGFlagQuery("autograd_is_training", curr);
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles) {
+  GIL gil;
+  PyObject *reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i)
+    PyList_SET_ITEM(reqs, i,
+                    PyLong_FromUnsignedLong(reqs_array ? reqs_array[i] : 1));
+  PyObject *res = CallApi(
+      "autograd_mark_variables",
+      Py_BuildValue("(NNN)", NDListToPy(num_var, var_handles), reqs,
+                    NDListToPy(num_var, grad_handles)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int is_train) {
+  GIL gil;
+  PyObject *res = CallApi(
+      "autograd_backward",
+      Py_BuildValue("(NNii)", NDListToPy(num_output, output_handles),
+                    NDListToPy(ograd_handles ? num_output : 0,
+                               ograd_handles),
+                    retain_graph, is_train));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  return MXAutogradBackwardEx(num_output, output_handles, ograd_handles,
+                              retain_graph, 1);
+}
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles) {
+  return MXAutogradBackwardEx(num_output, output_handles, nullptr, 0, 1);
+}
+
+/* ---- CachedOp ---------------------------------------------------------- */
+
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(handle);
+  PyObject *res =
+      CallApi("cached_op_create", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *out = new CachedRec{res, {}};
+  return 0;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  if (!handle) return 0;
+  GIL gil;
+  CachedRec *rec = static_cast<CachedRec *>(handle);
+  Py_XDECREF(rec->obj);
+  delete rec;
+  return 0;
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs) {
+  if (num_outputs && *num_outputs != 0) {
+    SetError("MXInvokeCachedOp: preallocated outputs are not supported — "
+             "pass *num_outputs = 0 and free the returned handles with "
+             "MXNDArrayFree");
+    return -1;
+  }
+  GIL gil;
+  CachedRec *rec = static_cast<CachedRec *>(handle);
+  PyObject *res = CallApi(
+      "cached_op_invoke",
+      Py_BuildValue("(ON)", rec->obj, NDListToPy(num_inputs, inputs)));
+  if (!res) return -1;
+  rec->outputs.clear();
+  Py_ssize_t n = PySequence_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    rec->outputs.push_back(new NDRec{PySequence_GetItem(res, i), {}, {}});
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(rec->outputs.size());
+  *outputs = rec->outputs.data();
+  return 0;
+}
+
+/* ---- Data iterators ---------------------------------------------------- */
+
+static StrList &IterNames() {
+  static StrList names;
+  return names;
+}
+
+static bool EnsureIterNames() {
+  if (!IterNames().store.empty()) return true;
+  PyObject *res = CallApi("list_data_iters", PyTuple_New(0));
+  if (!res) return false;
+  bool ok = PyToStrList(res, &IterNames());
+  Py_DECREF(res);
+  return ok;
+}
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  if (!EnsureIterNames()) return -1;
+  static std::vector<DataIterCreator> creators;
+  if (creators.empty())
+    for (auto &s : IterNames().store)
+      creators.push_back(const_cast<std::string *>(&s));
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+/* Fill an InfoRec from a python (name, desc, names, types, descs[, ...])
+ * tuple; used by both iterator and op info queries. */
+static bool FillInfo(PyObject *res, InfoRec *info) {
+  PyObject *pname = PyTuple_GetItem(res, 0);
+  PyObject *pdesc = PyTuple_GetItem(res, 1);
+  PyObject *pnames = PyTuple_GetItem(res, 2);
+  PyObject *ptypes = PyTuple_GetItem(res, 3);
+  PyObject *pdescs = PyTuple_GetItem(res, 4);
+  if (!pname || !pdesc || !pnames || !ptypes || !pdescs) {
+    SetErrorFromPython();
+    return false;
+  }
+  const char *cn = PyUnicode_AsUTF8(pname);
+  const char *cd = PyUnicode_AsUTF8(pdesc);
+  if (!cn || !cd) {
+    SetErrorFromPython();
+    return false;
+  }
+  info->name = cn;
+  info->desc = cd;
+  return PyToStrList(pnames, &info->arg_names) &&
+         PyToStrList(ptypes, &info->arg_types) &&
+         PyToStrList(pdescs, &info->arg_descs);
+}
+
+/* Pointer-keyed creator-metadata cache shared by the iterator and op
+ * info queries; entries live for the library lifetime (their string
+ * views are handed out to the caller). with_op_fields additionally
+ * reads (key_var_num_args, return_type) from tuple slots 5/6. Caller
+ * must hold the GIL. */
+static InfoRec *GetCachedInfo(std::string *key, const char *api_fn,
+                              bool with_op_fields) {
+  static std::vector<std::string *> keys;
+  static std::vector<InfoRec *> infos;
+  for (size_t i = 0; i < keys.size(); ++i)
+    if (keys[i] == key) return infos[i];
+  PyObject *res = CallApi(api_fn, Py_BuildValue("(s)", key->c_str()));
+  if (!res) return nullptr;
+  InfoRec *info = new InfoRec();
+  bool ok = FillInfo(res, info);
+  if (ok && with_op_fields) {
+    PyObject *kv = PyTuple_GetItem(res, 5);
+    PyObject *rt = PyTuple_GetItem(res, 6);
+    const char *ckv = kv ? PyUnicode_AsUTF8(kv) : nullptr;
+    const char *crt = rt ? PyUnicode_AsUTF8(rt) : nullptr;
+    if (!ckv || !crt) {
+      SetErrorFromPython();
+      ok = false;
+    } else {
+      info->kv_num_args = ckv;
+      info->ret_type = crt;
+    }
+  }
+  Py_DECREF(res);
+  if (!ok) {
+    delete info;
+    return nullptr;
+  }
+  keys.push_back(key);
+  infos.push_back(info);
+  return info;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  GIL gil;
+  InfoRec *info = GetCachedInfo(static_cast<std::string *>(creator),
+                                "data_iter_info", false);
+  if (!info) return -1;
+  *name = info->name.c_str();
+  *description = info->desc.c_str();
+  *num_args = static_cast<mx_uint>(info->arg_names.ptrs.size());
+  *arg_names = info->arg_names.ptrs.data();
+  *arg_type_infos = info->arg_types.ptrs.data();
+  *arg_descriptions = info->arg_descs.ptrs.data();
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  GIL gil;
+  std::string *name = static_cast<std::string *>(creator);
+  PyObject *res = CallApi(
+      "data_iter_create",
+      Py_BuildValue("(sNN)", name->c_str(), StrListToPy(num_param, keys),
+                    StrListToPy(num_param, vals)));
+  if (!res) return -1;
+  *out = new IterRec{res, {}};
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  if (!handle) return 0;
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  Py_XDECREF(rec->obj);
+  delete rec;
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *res = CallApi("data_iter_next", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *res = CallApi("data_iter_reset", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  return NDProduce("data_iter_data", Py_BuildValue("(O)", rec->obj), out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  return NDProduce("data_iter_label", Py_BuildValue("(O)", rec->obj), out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *res = CallApi("data_iter_pad", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *pad = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterGetIndex(DataIterHandle handle, mx_uint64 **out_index,
+                       mx_uint64 *out_size) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *res = CallApi("data_iter_index", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  rec->index.clear();
+  Py_ssize_t n = PySequence_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(res, i);
+    rec->index.push_back(
+        static_cast<mx_uint64>(it ? PyLong_AsUnsignedLongLong(it) : 0));
+    Py_XDECREF(it);
+  }
+  Py_DECREF(res);
+  if (PyErr_Occurred()) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out_index = rec->index.data();
+  *out_size = static_cast<mx_uint64>(rec->index.size());
+  return 0;
+}
+
+/* ---- RecordIO ---------------------------------------------------------- */
+
+static int RecIOCreate(const char *fn, const char *uri,
+                       RecordIOHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi(fn, Py_BuildValue("(s)", uri));
+  if (!res) return -1;
+  *out = new RecIORec{res, {}};
+  return 0;
+}
+
+static int RecIOFree(RecordIOHandle handle) {
+  if (!handle) return 0;
+  GIL gil;
+  RecIORec *rec = static_cast<RecIORec *>(handle);
+  PyObject *res = CallApi("recordio_close", Py_BuildValue("(O)", rec->obj));
+  Py_XDECREF(res);
+  Py_XDECREF(rec->obj);
+  delete rec;
+  return res ? 0 : -1;
+}
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  return RecIOCreate("recordio_writer_create", uri, out);
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) { return RecIOFree(handle); }
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  GIL gil;
+  RecIORec *rec = static_cast<RecIORec *>(handle);
+  PyObject *mv = PyMemoryView_FromMemory(const_cast<char *>(buf), size,
+                                         PyBUF_READ);
+  PyObject *res =
+      CallApi("recordio_write", Py_BuildValue("(ON)", rec->obj, mv));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  GIL gil;
+  RecIORec *rec = static_cast<RecIORec *>(handle);
+  PyObject *res = CallApi("recordio_tell", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *pos = static_cast<size_t>(PyLong_AsUnsignedLongLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  return RecIOCreate("recordio_reader_create", uri, out);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) { return RecIOFree(handle); }
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **out_buf,
+                               size_t *size) {
+  GIL gil;
+  RecIORec *rec = static_cast<RecIORec *>(handle);
+  PyObject *res = CallApi("recordio_read", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  if (res == Py_None) {
+    Py_DECREF(res);
+    *out_buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  rec->buf.assign(buf, static_cast<size_t>(len));
+  Py_DECREF(res);
+  *out_buf = rec->buf.data();
+  *size = rec->buf.size();
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  GIL gil;
+  RecIORec *rec = static_cast<RecIORec *>(handle);
+  PyObject *res = CallApi(
+      "recordio_seek",
+      Py_BuildValue("(OK)", rec->obj,
+                    static_cast<unsigned long long>(pos)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- Symbol query tail ------------------------------------------------- */
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name,
+                                const char **description, mx_uint *num_args,
+                                const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type) {
+  GIL gil;
+  InfoRec *info = GetCachedInfo(static_cast<std::string *>(creator),
+                                "sym_op_info", true);
+  if (!info) return -1;
+  *name = info->name.c_str();
+  *description = info->desc.c_str();
+  *num_args = static_cast<mx_uint>(info->arg_names.ptrs.size());
+  *arg_names = info->arg_names.ptrs.data();
+  *arg_type_infos = info->arg_types.ptrs.data();
+  *arg_descriptions = info->arg_descs.ptrs.data();
+  if (key_var_num_args) *key_var_num_args = info->kv_num_args.c_str();
+  if (return_type) *return_type = info->ret_type.c_str();
+  return 0;
+}
+
+static int SymProduce(const char *fn, PyObject *args, SymbolHandle *out) {
+  PyObject *res = CallApi(fn, args);
+  if (!res) return -1;
+  *out = new SymRec{res, {}, {}, {}, {}, {}, {}, {}};
+  return 0;
+}
+
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out) {
+  GIL gil;
+  return SymProduce(
+      "sym_copy", Py_BuildValue("(O)", static_cast<SymRec *>(sym)->obj),
+      out);
+}
+
+int MXSymbolGetName(SymbolHandle sym, const char **out, int *out_success) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res = CallApi("sym_get_name", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  const char *c = PyUnicode_AsUTF8(res);
+  rec->json = c ? c : "";  /* reuse the string scratch slot */
+  Py_DECREF(res);
+  *out_success = !rec->json.empty();
+  *out = *out_success ? rec->json.c_str() : nullptr;
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *out_success) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res =
+      CallApi("sym_get_attr", Py_BuildValue("(Os)", rec->obj, key));
+  if (!res) return -1;
+  if (res == Py_None) {
+    Py_DECREF(res);
+    *out_success = 0;
+    *out = nullptr;
+    return 0;
+  }
+  const char *c = PyUnicode_AsUTF8(res);
+  if (!c) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  rec->json = c;
+  Py_DECREF(res);
+  *out_success = 1;
+  *out = rec->json.c_str();
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res =
+      CallApi("sym_set_attr", Py_BuildValue("(Oss)", rec->obj, key, value));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolListAttrShallow(SymbolHandle sym, mx_uint *out_size,
+                            const char ***out) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res = CallApi("sym_list_attr", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  bool ok = PyToStrList(res, &rec->aux);  /* reuse a StrList scratch slot */
+  Py_DECREF(res);
+  if (!ok) return -1;
+  *out_size = static_cast<mx_uint>(rec->aux.ptrs.size() / 2);
+  *out = rec->aux.ptrs.data();
+  return 0;
+}
+
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out) {
+  GIL gil;
+  return SymProduce(
+      "sym_get_internals",
+      Py_BuildValue("(O)", static_cast<SymRec *>(sym)->obj), out);
+}
+
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle *out) {
+  GIL gil;
+  return SymProduce(
+      "sym_get_output",
+      Py_BuildValue("(OI)", static_cast<SymRec *>(sym)->obj, index), out);
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *lst = PyList_New(num_symbols);
+  if (!lst) return -1;
+  for (mx_uint i = 0; i < num_symbols; ++i) {
+    PyObject *o = static_cast<SymRec *>(symbols[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(lst, i, o);
+  }
+  return SymProduce("sym_group", Py_BuildValue("(N)", lst), out);
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  /* int-code storage reuses the shape scratch (codes are small ints) */
+  static thread_local std::vector<int> in_codes, out_codes, aux_codes;
+  PyObject *codes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(codes, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject *res = CallApi(
+      "sym_infer_type",
+      Py_BuildValue("(ONN)", rec->obj, StrListToPy(num_args, keys), codes));
+  if (!res) return -1;
+  std::vector<int> *slots[3] = {&in_codes, &out_codes, &aux_codes};
+  for (int g = 0; g < 3; ++g) {
+    PyObject *item = PyTuple_GetItem(res, g);
+    Py_ssize_t n = item ? PySequence_Size(item) : -1;
+    if (n < 0) {
+      SetErrorFromPython();
+      Py_DECREF(res);
+      return -1;
+    }
+    slots[g]->clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_GetItem(item, i);
+      slots[g]->push_back(static_cast<int>(it ? PyLong_AsLong(it) : -1));
+      Py_XDECREF(it);
+    }
+  }
+  Py_DECREF(res);
+  *in_type_size = static_cast<mx_uint>(in_codes.size());
+  *in_type_data = in_codes.data();
+  *out_type_size = static_cast<mx_uint>(out_codes.size());
+  *out_type_data = out_codes.data();
+  *aux_type_size = static_cast<mx_uint>(aux_codes.size());
+  *aux_type_data = aux_codes.data();
+  *complete = 1;
+  return 0;
+}
+
+/* ---- Executor tail ----------------------------------------------------- */
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *res =
+      CallApi("executor_print", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  const char *c = PyUnicode_AsUTF8(res);
+  rec->debug = c ? c : "";
+  Py_DECREF(res);
+  *out_str = rec->debug.c_str();
   return 0;
 }
 

@@ -30,13 +30,20 @@ extern "C" {
 
 typedef unsigned int mx_uint;
 typedef float mx_float;
+typedef unsigned long long mx_uint64;
 typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
 typedef void *AtomicSymbolCreator;
 typedef void *ExecutorHandle;
 typedef void *KVStoreHandle;
+typedef void *CachedOpHandle;
+typedef void *DataIterCreator;
+typedef void *DataIterHandle;
+typedef void *RecordIOHandle;
 
 const char *MXTrainGetLastError();
+/* Library version as MAJOR*10000 + MINOR*100 + PATCH. */
+int MXGetVersion(int *out);
 
 /* ---- NDArray ---------------------------------------------------------- */
 int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
@@ -56,6 +63,47 @@ int MXNDArraySave(const char *fname, mx_uint num_args,
 int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                   NDArrayHandle **out_arr, mx_uint *out_name_size,
                   const char ***out_names);
+/* dtype codes (reference mshadow enum): 0 f32, 1 f64, 2 f16, 3 u8,
+ * 4 i32, 5 i8, 6 i64. */
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+/* View/copy producers: the returned handle is a NEW handle the caller
+ * frees with MXNDArrayFree. */
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+/* Gradient buffer attached by MXAutogradMarkVariables (new handle). */
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+/* Copy detached from the autograd tape (new handle). */
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+/* Opaque single-array byte serialization; the buffer view stays valid
+ * until the next MXNDArraySaveRawBytes on the same handle. */
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf);
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out);
+
+/* ---- sparse NDArray ---------------------------------------------------- */
+/* storage_type: 0 = default(dense), 1 = row_sparse, 2 = csr.
+ * aux arrays: row_sparse has [indices]; csr has [indptr, indices]
+ * (same order as the reference). Created empty/zero, filled with
+ * MXNDArraySyncCopyFromNDArray. */
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int *aux_type, mx_uint *aux_ndims,
+                            const mx_uint *aux_shape, NDArrayHandle *out);
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type);
+/* Dense component handles (new handles; free with MXNDArrayFree). */
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out);
+/* Fill dst's data (i == -1) or aux component i from dense src. */
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 NDArrayHandle handle_src, int i);
 
 /* ---- imperative ops --------------------------------------------------- */
 int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
@@ -68,11 +116,86 @@ int MXImperativeInvokeByName(const char *op_name, int num_inputs,
                              const char **param_keys,
                              const char **param_vals);
 
+/* ---- autograd --------------------------------------------------------- */
+/* Imperative tape controls (reference c_api.h:700-760). prev/curr are
+ * int booleans. */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradIsRecording(int *curr);
+int MXAutogradIsTraining(int *curr);
+/* reqs_array codes: 0 = null, 1 = write, 3 = add. */
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles);
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles);
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph);
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int is_train);
+
+/* ---- CachedOp --------------------------------------------------------- */
+/* The symbol compiled once into an XLA program (reference: the CachedOp
+ * behind gluon hybridize, c_api.h:764-797). Inputs are positional in
+ * list_arguments + list_auxiliary_states order. Differentiable through
+ * the autograd tape when recording. */
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out);
+int MXFreeCachedOp(CachedOpHandle handle);
+/* Pass *num_outputs = 0; free returned handles with MXNDArrayFree. */
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs);
+
+/* ---- Data iterators --------------------------------------------------- */
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+/* Batch accessors return NEW NDArray handles (free them). */
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+int MXDataIterGetIndex(DataIterHandle handle, mx_uint64 **out_index,
+                       mx_uint64 *out_size);
+
+/* ---- RecordIO --------------------------------------------------------- */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+/* *out_buf = NULL, *size = 0 at EOF; the buffer view stays valid until
+ * the next read on the same handle. */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **out_buf,
+                               size_t *size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+
 /* ---- Symbol ----------------------------------------------------------- */
 int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
                                      AtomicSymbolCreator **out_array);
 int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
                                 const char **name);
+/* Op metadata for frontend code generation (reference: every binding's
+ * op generator). key_var_num_args is "" when not variadic. */
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name,
+                                const char **description, mx_uint *num_args,
+                                const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type);
 int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
                                mx_uint num_param, const char **keys,
                                const char **vals, SymbolHandle *out);
@@ -84,6 +207,20 @@ int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
 int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
 int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
 int MXSymbolFree(SymbolHandle sym);
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out);
+/* *out_success = 0 and *out = NULL when the symbol is a multi-output
+ * group (no single name) / the attribute is absent. */
+int MXSymbolGetName(SymbolHandle sym, const char **out, int *out_success);
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *out_success);
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value);
+/* Flattened [k0, v0, k1, v1, ...]; out_size = number of pairs. */
+int MXSymbolListAttrShallow(SymbolHandle sym, mx_uint *out_size,
+                            const char ***out);
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle *out);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
 int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
                           const char ***out_array);
 int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
@@ -103,6 +240,12 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
                        mx_uint *aux_shape_size,
                        const mx_uint **aux_shape_ndim,
                        const mx_uint ***aux_shape_data, int *complete);
+/* Type inference over the dtype codes above; -1 = unknown on input. */
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete);
 
 /* ---- Executor --------------------------------------------------------- */
 /* grad_req codes (reference enum): 0 = null, 1 = write, 3 = add. */
@@ -118,6 +261,9 @@ int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
  * the pointer array stays valid until the next call on this handle. */
 int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
                       NDArrayHandle **out);
+/* Graph debug string (reference MXExecutorPrint); view valid until the
+ * next call on this handle. */
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
 int MXExecutorFree(ExecutorHandle handle);
 
 /* ---- KVStore ---------------------------------------------------------- */
@@ -135,6 +281,16 @@ int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
 int MXKVStoreSetOptimizer(KVStoreHandle handle, const char *opt_name,
                           mx_uint num_param, const char **keys,
                           const char **vals);
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number,
+                            int timeout_sec);
+/* Pull only the rows named by each row_ids array into the row_sparse
+ * vals arrays (reference MXKVStorePullRowSparseEx). */
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             NDArrayHandle *row_ids, int priority);
 
 /* ---- misc ------------------------------------------------------------- */
 int MXRandomSeed(int seed);

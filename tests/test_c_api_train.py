@@ -183,3 +183,396 @@ def test_cpp_training_example_converges(tmp_path):
                           text=True, timeout=560)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "accuracy" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Round-3 groups: autograd, CachedOp, DataIter, sparse, RecordIO, query tails
+# ---------------------------------------------------------------------------
+
+def _nd_from(lib, arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    h = vp()
+    _ck(lib, lib.MXNDArrayCreate((u * arr.ndim)(*arr.shape), arr.ndim, 1, 0,
+                                 0, ctypes.byref(h)))
+    _ck(lib, lib.MXNDArraySyncCopyFromCPU(h, arr.ctypes.data_as(vp),
+                                          arr.size))
+    return h
+
+
+def _nd_to(lib, h, shape):
+    out = np.zeros(shape, np.float32)
+    _ck(lib, lib.MXNDArraySyncCopyToCPU(h, out.ctypes.data_as(vp), out.size))
+    return out
+
+
+def test_version_dtype_context_views(lib):
+    ver = ctypes.c_int()
+    _ck(lib, lib.MXGetVersion(ctypes.byref(ver)))
+    assert ver.value >= 100
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = _nd_from(lib, x)
+    dt = ctypes.c_int(-1)
+    _ck(lib, lib.MXNDArrayGetDType(h, ctypes.byref(dt)))
+    assert dt.value == 0  # float32
+    devt, devi = ctypes.c_int(), ctypes.c_int()
+    _ck(lib, lib.MXNDArrayGetContext(h, ctypes.byref(devt),
+                                     ctypes.byref(devi)))
+    assert devt.value in (1, 2)
+
+    r = vp()
+    _ck(lib, lib.MXNDArrayReshape(h, 2, (ctypes.c_int * 2)(4, 3),
+                                  ctypes.byref(r)))
+    np.testing.assert_array_equal(_nd_to(lib, r, (4, 3)), x.reshape(4, 3))
+    s = vp()
+    _ck(lib, lib.MXNDArraySlice(h, 1, 3, ctypes.byref(s)))
+    np.testing.assert_array_equal(_nd_to(lib, s, (2, 4)), x[1:3])
+    a = vp()
+    _ck(lib, lib.MXNDArrayAt(h, 2, ctypes.byref(a)))
+    np.testing.assert_array_equal(_nd_to(lib, a, (4,)), x[2])
+
+    # raw-bytes round trip
+    nbytes = ctypes.c_size_t()
+    buf = ctypes.POINTER(ctypes.c_char)()
+    _ck(lib, lib.MXNDArraySaveRawBytes(h, ctypes.byref(nbytes),
+                                       ctypes.byref(buf)))
+    raw = ctypes.string_at(buf, nbytes.value)
+    back = vp()
+    _ck(lib, lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                           ctypes.byref(back)))
+    np.testing.assert_array_equal(_nd_to(lib, back, (3, 4)), x)
+    for hh in (h, r, s, a, back):
+        _ck(lib, lib.MXNDArrayFree(hh))
+
+
+def test_autograd_through_abi(lib):
+    """MarkVariables + recorded imperative ops + BackwardEx: d/dx sum(x*x)
+    = 2x lands in the caller's grad handle (reference c_api.h:717-760)."""
+    x = np.array([1.0, -2.0, 3.0], np.float32)
+    hx = _nd_from(lib, x)
+    hg = _nd_from(lib, np.zeros(3))
+    _ck(lib, lib.MXAutogradMarkVariables(1, (vp * 1)(hx), (u * 1)(1),
+                                         (vp * 1)(hg)))
+    prev = ctypes.c_int(-1)
+    _ck(lib, lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)))
+    _ck(lib, lib.MXAutogradSetIsTraining(1, ctypes.byref(prev)))
+    cur = ctypes.c_int(0)
+    _ck(lib, lib.MXAutogradIsRecording(ctypes.byref(cur)))
+    assert cur.value == 1
+
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXImperativeInvokeByName(
+        b"square", 1, (vp * 1)(hx), ctypes.byref(n_out), ctypes.byref(outs),
+        0, None, None))
+    sq = vp(outs[0])
+    n_out2 = ctypes.c_int(0)
+    outs2 = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXImperativeInvokeByName(
+        b"sum", 1, (vp * 1)(sq), ctypes.byref(n_out2), ctypes.byref(outs2),
+        0, None, None))
+    loss = vp(outs2[0])
+    _ck(lib, lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)))
+    _ck(lib, lib.MXAutogradBackwardEx(1, (vp * 1)(loss), None, 0, 1))
+    np.testing.assert_allclose(_nd_to(lib, hg, (3,)), 2 * x)
+
+    # grad is also reachable from the variable handle
+    hgrad = vp()
+    _ck(lib, lib.MXNDArrayGetGrad(hx, ctypes.byref(hgrad)))
+    np.testing.assert_allclose(_nd_to(lib, hgrad, (3,)), 2 * x)
+    det = vp()
+    _ck(lib, lib.MXNDArrayDetach(loss, ctypes.byref(det)))
+    for hh in (hx, hg, sq, loss, hgrad, det):
+        _ck(lib, lib.MXNDArrayFree(hh))
+
+
+def _make_fc_symbol(lib, hidden):
+    sv = vp()
+    _ck(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(sv)))
+    nc = u()
+    creators = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(nc),
+                                                  ctypes.byref(creators)))
+    name = ctypes.c_char_p()
+    fcc = None
+    for i in range(nc.value):
+        _ck(lib, lib.MXSymbolGetAtomicSymbolName(vp(creators[i]),
+                                                 ctypes.byref(name)))
+        if name.value == b"FullyConnected":
+            fcc = vp(creators[i])
+    fc = vp()
+    _ck(lib, lib.MXSymbolCreateAtomicSymbol(
+        fcc, 1, (ctypes.c_char_p * 1)(b"num_hidden"),
+        (ctypes.c_char_p * 1)(str(hidden).encode()), ctypes.byref(fc)))
+    _ck(lib, lib.MXSymbolCompose(fc, b"fc1", 1, None, (vp * 1)(sv)))
+    return fc, sv, fcc
+
+
+def test_cached_op_through_abi(lib):
+    """MXCreateCachedOp/MXInvokeCachedOp: compiled-graph invoke matches
+    numpy, and is differentiable through the autograd tape."""
+    fc, sv, _ = _make_fc_symbol(lib, 4)
+    cop = vp()
+    _ck(lib, lib.MXCreateCachedOp(fc, ctypes.byref(cop)))
+    rng = np.random.RandomState(0)
+    xs = rng.randn(2, 3).astype(np.float32)
+    ws = rng.randn(4, 3).astype(np.float32)
+    bs = rng.randn(4).astype(np.float32)
+    hx, hw, hb = (_nd_from(lib, a) for a in (xs, ws, bs))
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXInvokeCachedOp(cop, 3, (vp * 3)(hx, hw, hb),
+                                  ctypes.byref(n_out), ctypes.byref(outs)))
+    assert n_out.value == 1
+    np.testing.assert_allclose(_nd_to(lib, vp(outs[0]), (2, 4)),
+                               xs @ ws.T + bs, rtol=1e-5)
+    _ck(lib, lib.MXNDArrayFree(vp(outs[0])))
+
+    # differentiable invoke: d/dw sum(fc(x)) = sum_batch(x) per row
+    hgw = _nd_from(lib, np.zeros((4, 3)))
+    _ck(lib, lib.MXAutogradMarkVariables(1, (vp * 1)(hw), (u * 1)(1),
+                                         (vp * 1)(hgw)))
+    prev = ctypes.c_int()
+    _ck(lib, lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)))
+    n2 = ctypes.c_int(0)
+    outs2 = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXInvokeCachedOp(cop, 3, (vp * 3)(hx, hw, hb),
+                                  ctypes.byref(n2), ctypes.byref(outs2)))
+    y = vp(outs2[0])
+    n3 = ctypes.c_int(0)
+    outs3 = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXImperativeInvokeByName(
+        b"sum", 1, (vp * 1)(y), ctypes.byref(n3), ctypes.byref(outs3),
+        0, None, None))
+    loss = vp(outs3[0])
+    _ck(lib, lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)))
+    _ck(lib, lib.MXAutogradBackward(1, (vp * 1)(loss), None, 0))
+    expect = np.tile(xs.sum(0), (4, 1))
+    np.testing.assert_allclose(_nd_to(lib, hgw, (4, 3)), expect, rtol=1e-5)
+    _ck(lib, lib.MXFreeCachedOp(cop))
+    for hh in (hx, hw, hb, hgw, y, loss):
+        _ck(lib, lib.MXNDArrayFree(hh))
+    for s in (fc, sv):
+        _ck(lib, lib.MXSymbolFree(s))
+
+
+def test_data_iter_through_abi(lib, tmp_path):
+    """MXListDataIters/CreateIter/Next/GetData: drive CSVIter end to end
+    (reference c_api.h:1402-1461)."""
+    n_it = u()
+    creators = ctypes.POINTER(vp)()
+    _ck(lib, lib.MXListDataIters(ctypes.byref(n_it), ctypes.byref(creators)))
+    names = {}
+    nm = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    na = u()
+    an = ctypes.POINTER(ctypes.c_char_p)()
+    at = ctypes.POINTER(ctypes.c_char_p)()
+    ad = ctypes.POINTER(ctypes.c_char_p)()
+    for i in range(n_it.value):
+        _ck(lib, lib.MXDataIterGetIterInfo(
+            vp(creators[i]), ctypes.byref(nm), ctypes.byref(desc),
+            ctypes.byref(na), ctypes.byref(an), ctypes.byref(at),
+            ctypes.byref(ad)))
+        names[nm.value.decode()] = vp(creators[i])
+    assert {"MNISTIter", "CSVIter", "ImageRecordIter"} <= set(names)
+
+    rows = np.arange(24, dtype=np.float32).reshape(8, 3)
+    csv = tmp_path / "x.csv"
+    np.savetxt(csv, rows, delimiter=",", fmt="%.1f")
+    it = vp()
+    keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 3)(str(csv).encode(), b"(3,)", b"4")
+    _ck(lib, lib.MXDataIterCreateIter(names["CSVIter"], 3, keys, vals,
+                                      ctypes.byref(it)))
+    seen = []
+    has = ctypes.c_int(1)
+    while True:
+        _ck(lib, lib.MXDataIterNext(it, ctypes.byref(has)))
+        if not has.value:
+            break
+        hd = vp()
+        _ck(lib, lib.MXDataIterGetData(it, ctypes.byref(hd)))
+        seen.append(_nd_to(lib, hd, (4, 3)).copy())
+        pad = ctypes.c_int(-1)
+        _ck(lib, lib.MXDataIterGetPadNum(it, ctypes.byref(pad)))
+        assert pad.value == 0
+        _ck(lib, lib.MXNDArrayFree(hd))
+    np.testing.assert_array_equal(np.concatenate(seen), rows)
+    # reset + second epoch sees the same data
+    _ck(lib, lib.MXDataIterBeforeFirst(it))
+    _ck(lib, lib.MXDataIterNext(it, ctypes.byref(has)))
+    assert has.value == 1
+    _ck(lib, lib.MXDataIterFree(it))
+
+
+def test_sparse_ndarray_through_abi(lib):
+    """MXNDArrayCreateSparseEx + SyncCopyFromNDArray + component handles
+    (reference c_api.h:298): build a row_sparse array from C."""
+    V, D, NNZ = 6, 2, 3
+    h = vp()
+    aux_shape = (u * 1)(NNZ)
+    _ck(lib, lib.MXNDArrayCreateSparseEx(
+        1, (u * 2)(V, D), 2, 1, 0, 0, 0, 1, (ctypes.c_int * 1)(4),
+        (u * 1)(1), aux_shape, ctypes.byref(h)))
+    st = ctypes.c_int(-1)
+    _ck(lib, lib.MXNDArrayGetStorageType(h, ctypes.byref(st)))
+    assert st.value == 1  # row_sparse
+
+    vals = np.array([[1, 2], [3, 4], [5, 6]], np.float32)
+    idx = np.array([0, 2, 5], np.float32)
+    hv, hi = _nd_from(lib, vals), _nd_from(lib, idx)
+    _ck(lib, lib.MXNDArraySyncCopyFromNDArray(h, hv, -1))
+    _ck(lib, lib.MXNDArraySyncCopyFromNDArray(h, hi, 0))
+
+    hd, ha = vp(), vp()
+    _ck(lib, lib.MXNDArrayGetDataNDArray(h, ctypes.byref(hd)))
+    _ck(lib, lib.MXNDArrayGetAuxNDArray(h, 0, ctypes.byref(ha)))
+    np.testing.assert_array_equal(_nd_to(lib, hd, (NNZ, D)), vals)
+    np.testing.assert_array_equal(_nd_to(lib, ha, (NNZ,)), idx)
+    for hh in (h, hv, hi, hd, ha):
+        _ck(lib, lib.MXNDArrayFree(hh))
+
+
+def test_recordio_through_abi(lib, tmp_path):
+    uri = str(tmp_path / "t.rec").encode()
+    w = vp()
+    _ck(lib, lib.MXRecordIOWriterCreate(uri, ctypes.byref(w)))
+    recs = [b"hello", b"tpu" * 100, b"x"]
+    for r in recs:
+        _ck(lib, lib.MXRecordIOWriterWriteRecord(w, r, len(r)))
+    pos = ctypes.c_size_t()
+    _ck(lib, lib.MXRecordIOWriterTell(w, ctypes.byref(pos)))
+    assert pos.value > 0
+    _ck(lib, lib.MXRecordIOWriterFree(w))
+
+    r = vp()
+    _ck(lib, lib.MXRecordIOReaderCreate(uri, ctypes.byref(r)))
+    got = []
+    while True:
+        buf = ctypes.POINTER(ctypes.c_char)()
+        sz = ctypes.c_size_t()
+        _ck(lib, lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                                ctypes.byref(sz)))
+        if not buf:
+            break
+        got.append(ctypes.string_at(buf, sz.value))
+    assert got == recs
+    _ck(lib, lib.MXRecordIOReaderSeek(r, 0))
+    buf = ctypes.POINTER(ctypes.c_char)()
+    sz = ctypes.c_size_t()
+    _ck(lib, lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                            ctypes.byref(sz)))
+    assert ctypes.string_at(buf, sz.value) == recs[0]
+    _ck(lib, lib.MXRecordIOReaderFree(r))
+
+
+def test_symbol_query_tail_through_abi(lib):
+    fc, sv, fcc = _make_fc_symbol(lib, 4)
+    # op metadata for frontend codegen
+    nm, ds, kv, rt = (ctypes.c_char_p() for _ in range(4))
+    na = u()
+    an = ctypes.POINTER(ctypes.c_char_p)()
+    at = ctypes.POINTER(ctypes.c_char_p)()
+    ad = ctypes.POINTER(ctypes.c_char_p)()
+    _ck(lib, lib.MXSymbolGetAtomicSymbolInfo(
+        fcc, ctypes.byref(nm), ctypes.byref(ds), ctypes.byref(na),
+        ctypes.byref(an), ctypes.byref(at), ctypes.byref(ad),
+        ctypes.byref(kv), ctypes.byref(rt)))
+    assert nm.value == b"FullyConnected"
+    args = [an[i] for i in range(na.value)]
+    assert b"num_hidden" in args
+
+    # name / attr round trip
+    name = ctypes.c_char_p()
+    okf = ctypes.c_int()
+    _ck(lib, lib.MXSymbolGetName(fc, ctypes.byref(name), ctypes.byref(okf)))
+    assert okf.value == 1 and name.value == b"fc1"
+    _ck(lib, lib.MXSymbolSetAttr(fc, b"ctx_group", b"stage0"))
+    val = ctypes.c_char_p()
+    _ck(lib, lib.MXSymbolGetAttr(fc, b"ctx_group", ctypes.byref(val),
+                                 ctypes.byref(okf)))
+    assert okf.value == 1 and val.value == b"stage0"
+    _ck(lib, lib.MXSymbolGetAttr(fc, b"nope", ctypes.byref(val),
+                                 ctypes.byref(okf)))
+    assert okf.value == 0
+    npair = u()
+    flat = ctypes.POINTER(ctypes.c_char_p)()
+    _ck(lib, lib.MXSymbolListAttrShallow(fc, ctypes.byref(npair),
+                                         ctypes.byref(flat)))
+    pairs = {flat[2 * i]: flat[2 * i + 1] for i in range(npair.value)}
+    assert pairs.get(b"ctx_group") == b"stage0"
+
+    # copy / internals / output / group
+    cp = vp()
+    _ck(lib, lib.MXSymbolCopy(fc, ctypes.byref(cp)))
+    internals = vp()
+    _ck(lib, lib.MXSymbolGetInternals(fc, ctypes.byref(internals)))
+    ns = u()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _ck(lib, lib.MXSymbolListOutputs(internals, ctypes.byref(ns),
+                                     ctypes.byref(arr)))
+    assert ns.value >= 4  # data, weight, bias, fc output
+    out0 = vp()
+    _ck(lib, lib.MXSymbolGetOutput(internals, 0, ctypes.byref(out0)))
+    grp = vp()
+    _ck(lib, lib.MXSymbolCreateGroup(2, (vp * 2)(fc, cp), ctypes.byref(grp)))
+    _ck(lib, lib.MXSymbolListOutputs(grp, ctypes.byref(ns),
+                                     ctypes.byref(arr)))
+    assert ns.value == 2
+
+    # type inference: float32 data -> float32 everywhere
+    tin, tout, taux = u(), u(), u()
+    tind = ctypes.POINTER(ctypes.c_int)()
+    toutd = ctypes.POINTER(ctypes.c_int)()
+    tauxd = ctypes.POINTER(ctypes.c_int)()
+    comp = ctypes.c_int()
+    _ck(lib, lib.MXSymbolInferType(
+        fc, 1, (ctypes.c_char_p * 1)(b"data"), (ctypes.c_int * 1)(0),
+        ctypes.byref(tin), ctypes.byref(tind), ctypes.byref(tout),
+        ctypes.byref(toutd), ctypes.byref(taux), ctypes.byref(tauxd),
+        ctypes.byref(comp)))
+    assert tin.value == 3 and all(tind[i] == 0 for i in range(3))
+    assert toutd[0] == 0
+    for s in (fc, sv, cp, internals, out0, grp):
+        _ck(lib, lib.MXSymbolFree(s))
+
+
+def test_kvstore_tail_through_abi(lib):
+    kv = vp()
+    _ck(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    rank, size = ctypes.c_int(-1), ctypes.c_int(-1)
+    _ck(lib, lib.MXKVStoreGetRank(kv, ctypes.byref(rank)))
+    _ck(lib, lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)))
+    assert rank.value == 0 and size.value == 1
+    _ck(lib, lib.MXKVStoreBarrier(kv))
+    dead = ctypes.c_int(-1)
+    _ck(lib, lib.MXKVStoreGetNumDeadNode(kv, 0, ctypes.byref(dead), 1))
+    assert dead.value == 0
+    _ck(lib, lib.MXKVStoreFree(kv))
+
+
+def test_kvstore_pull_row_sparse_through_abi(lib):
+    kv = vp()
+    _ck(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    V, D = 5, 2
+    w = np.arange(10, dtype=np.float32).reshape(V, D)
+    hw = _nd_from(lib, w)
+    key = (ctypes.c_char_p * 1)(b"emb")
+    _ck(lib, lib.MXKVStoreInitEx(kv, 1, key, (vp * 1)(hw)))
+
+    dst = vp()
+    _ck(lib, lib.MXNDArrayCreateSparseEx(
+        1, (u * 2)(V, D), 2, 1, 0, 0, 0, 1, (ctypes.c_int * 1)(4),
+        (u * 1)(1), (u * 1)(0), ctypes.byref(dst)))
+    rid = _nd_from(lib, np.array([1, 3], np.float32))
+    _ck(lib, lib.MXKVStorePullRowSparseEx(kv, 1, key, (vp * 1)(dst),
+                                          (vp * 1)(rid), 0))
+    hd, ha = vp(), vp()
+    _ck(lib, lib.MXNDArrayGetDataNDArray(dst, ctypes.byref(hd)))
+    _ck(lib, lib.MXNDArrayGetAuxNDArray(dst, 0, ctypes.byref(ha)))
+    idx = _nd_to(lib, ha, (2,))
+    np.testing.assert_array_equal(idx, [1, 3])
+    np.testing.assert_array_equal(_nd_to(lib, hd, (2, D)), w[[1, 3]])
+    for hh in (hw, dst, rid, hd, ha):
+        _ck(lib, lib.MXNDArrayFree(hh))
+    _ck(lib, lib.MXKVStoreFree(kv))

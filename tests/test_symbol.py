@@ -168,3 +168,22 @@ def test_variable_dedup_name_manager():
     sym.NameManager.reset()
     fc = sym.FullyConnected(sym.Variable("d"), num_hidden=2)
     assert fc.list_arguments()[1].endswith("_weight")
+
+
+def test_infer_type_honors_declared_dtypes():
+    """infer_type propagates declared input dtypes through the graph
+    (numpy promotion; Cast overrides) instead of reporting float32
+    everywhere — the MXSymbolInferType contract."""
+    import numpy as np
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_t, out_t, _ = fc.infer_type(data="float64")
+    names = fc.list_arguments()
+    got = dict(zip(names, arg_t))
+    assert got["data"] == np.dtype("float64")
+    assert got["fc_weight"] == np.dtype("float32")
+    assert out_t[0] == np.dtype("float64")  # promoted through the FC
+
+    casted = mx.sym.Cast(fc, dtype="float16")
+    _, out_t2, _ = casted.infer_type(data="float64")
+    assert out_t2[0] == np.dtype("float16")
