@@ -1,0 +1,504 @@
+/*
+ * XS glue for AI::MXNetTPU — binds the training C ABI (src/capi/c_api.h)
+ * into perl.
+ *
+ * Reference analogue: perl-package/AI-MXNet/ (AI::MXNet binds the same
+ * flat C ABI through swig-generated glue; here the surface is hand-written
+ * XS over the ~98-function mxtpu ABI). Handles cross the boundary as IVs
+ * wrapped by the pure-perl OO layer (lib/AI/MXNetTPU/*.pm); float buffers
+ * cross as pack("f*") strings.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "../../src/capi/c_api.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+/* croak with the ABI's thread-local error message on failure */
+static void ck(pTHX_ int rc) {
+  if (rc != 0) croak("mxtpu: %s", MXTrainGetLastError());
+}
+
+/* AV of IV handles -> malloc'd handle array (caller frees) */
+static NDArrayHandle *av_handles(pTHX_ AV *av, mx_uint *n) {
+  *n = (mx_uint)(av_len(av) + 1);
+  NDArrayHandle *out = (NDArrayHandle *)calloc(*n ? *n : 1, sizeof(void *));
+  mx_uint i;
+  for (i = 0; i < *n; ++i) {
+    SV **sv = av_fetch(av, i, 0);
+    out[i] = (sv && SvOK(*sv)) ? (NDArrayHandle)SvIV(*sv) : NULL;
+  }
+  return out;
+}
+
+/* AV of strings -> malloc'd char* array pointing into the SVs (valid for
+ * the duration of the surrounding XS call; caller frees the array only) */
+static const char **av_strs(pTHX_ AV *av, mx_uint *n) {
+  *n = (mx_uint)(av_len(av) + 1);
+  const char **out = (const char **)calloc(*n ? *n : 1, sizeof(char *));
+  mx_uint i;
+  for (i = 0; i < *n; ++i) {
+    SV **sv = av_fetch(av, i, 0);
+    out[i] = sv ? SvPV_nolen(*sv) : "";
+  }
+  return out;
+}
+
+static AV *handles_av(pTHX_ mx_uint n, NDArrayHandle *hs) {
+  AV *av = newAV();
+  mx_uint i;
+  for (i = 0; i < n; ++i) av_push(av, newSViv((IV)hs[i]));
+  return av;
+}
+
+static AV *strs_av(pTHX_ mx_uint n, const char **ss) {
+  AV *av = newAV();
+  mx_uint i;
+  for (i = 0; i < n; ++i) av_push(av, newSVpv(ss[i], 0));
+  return av;
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+const char *
+mxp_last_error()
+  CODE:
+    RETVAL = MXTrainGetLastError();
+  OUTPUT:
+    RETVAL
+
+int
+mxp_version()
+  CODE:
+    ck(aTHX_ MXGetVersion(&RETVAL));
+  OUTPUT:
+    RETVAL
+
+void
+mxp_random_seed(seed)
+    int seed
+  CODE:
+    ck(aTHX_ MXRandomSeed(seed));
+
+IV
+mxp_nd_create(shape_av)
+    AV *shape_av
+  CODE:
+    mx_uint n, i;
+    mx_uint shape[16];
+    NDArrayHandle h;
+    n = (mx_uint)(av_len(shape_av) + 1);
+    if (n > 16) croak("mxtpu: ndim > 16");
+    for (i = 0; i < n; ++i) {
+      SV **sv = av_fetch(shape_av, i, 0);
+      shape[i] = sv ? (mx_uint)SvUV(*sv) : 0;
+    }
+    ck(aTHX_ MXNDArrayCreate(shape, n, 1, 0, 0, &h));
+    RETVAL = (IV)h;
+  OUTPUT:
+    RETVAL
+
+void
+mxp_nd_free(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXNDArrayFree((NDArrayHandle)h));
+
+void
+mxp_nd_copy_from(h, buf)
+    IV h
+    SV *buf
+  CODE:
+    STRLEN len;
+    const char *p = SvPV(buf, len);
+    ck(aTHX_ MXNDArraySyncCopyFromCPU((NDArrayHandle)h, p,
+                                      len / sizeof(mx_float)));
+
+SV *
+mxp_nd_copy_to(h)
+    IV h
+  CODE:
+    mx_uint nd, i;
+    const mx_uint *shape;
+    size_t size = 1;
+    ck(aTHX_ MXNDArrayGetShape((NDArrayHandle)h, &nd, &shape));
+    for (i = 0; i < nd; ++i) size *= shape[i];
+    RETVAL = newSV(size * sizeof(mx_float));
+    SvPOK_on(RETVAL);
+    ck(aTHX_ MXNDArraySyncCopyToCPU((NDArrayHandle)h, SvPVX(RETVAL), size));
+    SvCUR_set(RETVAL, size * sizeof(mx_float));
+  OUTPUT:
+    RETVAL
+
+AV *
+mxp_nd_shape(h)
+    IV h
+  CODE:
+    mx_uint nd, i;
+    const mx_uint *shape;
+    ck(aTHX_ MXNDArrayGetShape((NDArrayHandle)h, &nd, &shape));
+    RETVAL = newAV();
+    sv_2mortal((SV *)RETVAL);
+    for (i = 0; i < nd; ++i) av_push(RETVAL, newSVuv(shape[i]));
+  OUTPUT:
+    RETVAL
+
+AV *
+mxp_invoke(opname, ins_av, keys_av, vals_av)
+    const char *opname
+    AV *ins_av
+    AV *keys_av
+    AV *vals_av
+  CODE:
+    mx_uint n_in, n_k, n_v;
+    NDArrayHandle *ins = av_handles(aTHX_ ins_av, &n_in);
+    const char **keys = av_strs(aTHX_ keys_av, &n_k);
+    const char **vals = av_strs(aTHX_ vals_av, &n_v);
+    int n_out = 0;
+    NDArrayHandle *outs = NULL;
+    int rc = MXImperativeInvokeByName(opname, (int)n_in, ins, &n_out,
+                                      &outs, (int)n_k, keys, vals);
+    free(ins); free(keys); free(vals);
+    ck(aTHX_ rc);
+    RETVAL = handles_av(aTHX_ (mx_uint)n_out, outs);
+    sv_2mortal((SV *)RETVAL);
+  OUTPUT:
+    RETVAL
+
+IV
+mxp_sym_variable(name)
+    const char *name
+  CODE:
+    SymbolHandle h;
+    ck(aTHX_ MXSymbolCreateVariable(name, &h));
+    RETVAL = (IV)h;
+  OUTPUT:
+    RETVAL
+
+IV
+mxp_sym_create_compose(opname, name, pkeys_av, pvals_av, args_av)
+    const char *opname
+    const char *name
+    AV *pkeys_av
+    AV *pvals_av
+    AV *args_av
+  CODE:
+    /* atomic-symbol creators are name-keyed strings: find ours */
+    mx_uint n_c, i, n_k, n_v, n_a;
+    AtomicSymbolCreator *creators;
+    AtomicSymbolCreator found = NULL;
+    SymbolHandle h;
+    ck(aTHX_ MXSymbolListAtomicSymbolCreators(&n_c, &creators));
+    for (i = 0; i < n_c; ++i) {
+      const char *cname;
+      ck(aTHX_ MXSymbolGetAtomicSymbolName(creators[i], &cname));
+      if (strcmp(cname, opname) == 0) { found = creators[i]; break; }
+    }
+    if (!found) croak("mxtpu: unknown operator %s", opname);
+    {
+      const char **keys = av_strs(aTHX_ pkeys_av, &n_k);
+      const char **vals = av_strs(aTHX_ pvals_av, &n_v);
+      int rc = MXSymbolCreateAtomicSymbol(found, n_k, keys, vals, &h);
+      free(keys); free(vals);
+      ck(aTHX_ rc);
+    }
+    {
+      NDArrayHandle *args = av_handles(aTHX_ args_av, &n_a);
+      int rc = MXSymbolCompose(h, name, n_a, NULL, (SymbolHandle *)args);
+      free(args);
+      if (rc != 0) {
+        MXSymbolFree(h);  /* don't leak the atomic symbol on croak */
+        croak("mxtpu: %s", MXTrainGetLastError());
+      }
+    }
+    RETVAL = (IV)h;
+  OUTPUT:
+    RETVAL
+
+void
+mxp_sym_free(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXSymbolFree((SymbolHandle)h));
+
+AV *
+mxp_sym_list_arguments(h)
+    IV h
+  CODE:
+    mx_uint n;
+    const char **names;
+    ck(aTHX_ MXSymbolListArguments((SymbolHandle)h, &n, &names));
+    RETVAL = strs_av(aTHX_ n, names);
+    sv_2mortal((SV *)RETVAL);
+  OUTPUT:
+    RETVAL
+
+AV *
+mxp_sym_list_outputs(h)
+    IV h
+  CODE:
+    mx_uint n;
+    const char **names;
+    ck(aTHX_ MXSymbolListOutputs((SymbolHandle)h, &n, &names));
+    RETVAL = strs_av(aTHX_ n, names);
+    sv_2mortal((SV *)RETVAL);
+  OUTPUT:
+    RETVAL
+
+AV *
+mxp_sym_list_aux(h)
+    IV h
+  CODE:
+    mx_uint n;
+    const char **names;
+    ck(aTHX_ MXSymbolListAuxiliaryStates((SymbolHandle)h, &n, &names));
+    RETVAL = strs_av(aTHX_ n, names);
+    sv_2mortal((SV *)RETVAL);
+  OUTPUT:
+    RETVAL
+
+const char *
+mxp_sym_tojson(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXSymbolSaveToJSON((SymbolHandle)h, &RETVAL));
+  OUTPUT:
+    RETVAL
+
+IV
+mxp_sym_from_json(json)
+    const char *json
+  CODE:
+    SymbolHandle h;
+    ck(aTHX_ MXSymbolCreateFromJSON(json, &h));
+    RETVAL = (IV)h;
+  OUTPUT:
+    RETVAL
+
+AV *
+mxp_sym_infer_shape(h, names_av, shapes_av)
+    IV h
+    AV *names_av
+    AV *shapes_av
+  CODE:
+    /* shapes_av: AV of AVs of uints, parallel to names_av. Returns
+     * [arg_shapes, out_shapes, aux_shapes], each an AV of shape-AVs. */
+    mx_uint n_names, i, j;
+    const char **keys = av_strs(aTHX_ names_av, &n_names);
+    mx_uint *indptr = (mx_uint *)calloc(n_names + 1, sizeof(mx_uint));
+    mx_uint total = 0;
+    mx_uint *flat;
+    for (i = 0; i < n_names; ++i) {
+      SV **sv = av_fetch(shapes_av, i, 0);
+      AV *s = (sv && SvROK(*sv)) ? (AV *)SvRV(*sv) : NULL;
+      total += s ? (mx_uint)(av_len(s) + 1) : 0;
+      indptr[i + 1] = total;
+    }
+    flat = (mx_uint *)calloc(total ? total : 1, sizeof(mx_uint));
+    for (i = 0; i < n_names; ++i) {
+      SV **sv = av_fetch(shapes_av, i, 0);
+      AV *s = (sv && SvROK(*sv)) ? (AV *)SvRV(*sv) : NULL;
+      mx_uint len = s ? (mx_uint)(av_len(s) + 1) : 0;
+      for (j = 0; j < len; ++j) {
+        SV **e = av_fetch(s, j, 0);
+        flat[indptr[i] + j] = e ? (mx_uint)SvUV(*e) : 0;
+      }
+    }
+    {
+      mx_uint in_n, out_n, aux_n;
+      const mx_uint *in_nd, *out_nd, *aux_nd;
+      const mx_uint **in_d, **out_d, **aux_d;
+      int complete;
+      int rc = MXSymbolInferShape(
+          (SymbolHandle)h, n_names, keys, indptr, flat, &in_n, &in_nd,
+          &in_d, &out_n, &out_nd, &out_d, &aux_n, &aux_nd, &aux_d,
+          &complete);
+      free(keys); free(indptr); free(flat);
+      ck(aTHX_ rc);
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      {
+        mx_uint group;
+        mx_uint ns[3];
+        const mx_uint *nds[3];
+        const mx_uint **ds[3];
+        ns[0] = in_n; ns[1] = out_n; ns[2] = aux_n;
+        nds[0] = in_nd; nds[1] = out_nd; nds[2] = aux_nd;
+        ds[0] = in_d; ds[1] = out_d; ds[2] = aux_d;
+        for (group = 0; group < 3; ++group) {
+          AV *g = newAV();
+          for (i = 0; i < ns[group]; ++i) {
+            AV *s = newAV();
+            for (j = 0; j < nds[group][i]; ++j)
+              av_push(s, newSVuv(ds[group][i][j]));
+            av_push(g, newRV_noinc((SV *)s));
+          }
+          av_push(RETVAL, newRV_noinc((SV *)g));
+        }
+      }
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+mxp_executor_bind(sym, args_av, grads_av, reqs_av, aux_av)
+    IV sym
+    AV *args_av
+    AV *grads_av
+    AV *reqs_av
+    AV *aux_av
+  CODE:
+    mx_uint n_args, n_grads, n_reqs, n_aux, i;
+    NDArrayHandle *args = av_handles(aTHX_ args_av, &n_args);
+    NDArrayHandle *grads = av_handles(aTHX_ grads_av, &n_grads);
+    NDArrayHandle *aux = av_handles(aTHX_ aux_av, &n_aux);
+    mx_uint *reqs;
+    ExecutorHandle ex;
+    int rc;
+    n_reqs = (mx_uint)(av_len(reqs_av) + 1);
+    reqs = (mx_uint *)calloc(n_reqs ? n_reqs : 1, sizeof(mx_uint));
+    for (i = 0; i < n_reqs; ++i) {
+      SV **sv = av_fetch(reqs_av, i, 0);
+      reqs[i] = sv ? (mx_uint)SvUV(*sv) : 0;
+    }
+    rc = MXExecutorBindEX((SymbolHandle)sym, 1, 0, n_args, args, grads,
+                          reqs, n_aux, aux, &ex);
+    free(args); free(grads); free(aux); free(reqs);
+    ck(aTHX_ rc);
+    RETVAL = (IV)ex;
+  OUTPUT:
+    RETVAL
+
+void
+mxp_executor_forward(ex, is_train)
+    IV ex
+    int is_train
+  CODE:
+    ck(aTHX_ MXExecutorForward((ExecutorHandle)ex, is_train));
+
+void
+mxp_executor_backward(ex)
+    IV ex
+  CODE:
+    ck(aTHX_ MXExecutorBackward((ExecutorHandle)ex, 0, NULL));
+
+AV *
+mxp_executor_outputs(ex)
+    IV ex
+  CODE:
+    mx_uint n;
+    NDArrayHandle *outs;
+    ck(aTHX_ MXExecutorOutputs((ExecutorHandle)ex, &n, &outs));
+    RETVAL = handles_av(aTHX_ n, outs);
+    sv_2mortal((SV *)RETVAL);
+  OUTPUT:
+    RETVAL
+
+void
+mxp_executor_free(ex)
+    IV ex
+  CODE:
+    ck(aTHX_ MXExecutorFree((ExecutorHandle)ex));
+
+IV
+mxp_kv_create(type)
+    const char *type
+  CODE:
+    KVStoreHandle kv;
+    ck(aTHX_ MXKVStoreCreate(type, &kv));
+    RETVAL = (IV)kv;
+  OUTPUT:
+    RETVAL
+
+void
+mxp_kv_free(kv)
+    IV kv
+  CODE:
+    ck(aTHX_ MXKVStoreFree((KVStoreHandle)kv));
+
+void
+mxp_kv_init(kv, keys_av, vals_av)
+    IV kv
+    AV *keys_av
+    AV *vals_av
+  CODE:
+    mx_uint n_k, n_v;
+    const char **keys = av_strs(aTHX_ keys_av, &n_k);
+    NDArrayHandle *vals = av_handles(aTHX_ vals_av, &n_v);
+    int rc = MXKVStoreInitEx((KVStoreHandle)kv, n_k, keys, vals);
+    free(keys); free(vals);
+    ck(aTHX_ rc);
+
+void
+mxp_kv_push(kv, keys_av, vals_av, priority)
+    IV kv
+    AV *keys_av
+    AV *vals_av
+    int priority
+  CODE:
+    mx_uint n_k, n_v;
+    const char **keys = av_strs(aTHX_ keys_av, &n_k);
+    NDArrayHandle *vals = av_handles(aTHX_ vals_av, &n_v);
+    int rc = MXKVStorePushEx((KVStoreHandle)kv, n_k, keys, vals, priority);
+    free(keys); free(vals);
+    ck(aTHX_ rc);
+
+void
+mxp_kv_pull(kv, keys_av, vals_av, priority)
+    IV kv
+    AV *keys_av
+    AV *vals_av
+    int priority
+  CODE:
+    mx_uint n_k, n_v;
+    const char **keys = av_strs(aTHX_ keys_av, &n_k);
+    NDArrayHandle *vals = av_handles(aTHX_ vals_av, &n_v);
+    int rc = MXKVStorePullEx((KVStoreHandle)kv, n_k, keys, vals, priority);
+    free(keys); free(vals);
+    ck(aTHX_ rc);
+
+void
+mxp_kv_set_optimizer(kv, opt, keys_av, vals_av)
+    IV kv
+    const char *opt
+    AV *keys_av
+    AV *vals_av
+  CODE:
+    mx_uint n_k, n_v;
+    const char **keys = av_strs(aTHX_ keys_av, &n_k);
+    const char **vals = av_strs(aTHX_ vals_av, &n_v);
+    int rc = MXKVStoreSetOptimizer((KVStoreHandle)kv, opt, n_k, keys, vals);
+    free(keys); free(vals);
+    ck(aTHX_ rc);
+
+void
+mxp_autograd_mark(var, grad)
+    IV var
+    IV grad
+  CODE:
+    NDArrayHandle vh = (NDArrayHandle)var, gh = (NDArrayHandle)grad;
+    mx_uint req = 1;
+    ck(aTHX_ MXAutogradMarkVariables(1, &vh, &req, &gh));
+
+int
+mxp_autograd_set_recording(flag)
+    int flag
+  CODE:
+    int prev = 0;
+    ck(aTHX_ MXAutogradSetIsRecording(flag, &prev));
+    RETVAL = prev;
+  OUTPUT:
+    RETVAL
+
+void
+mxp_autograd_backward(head)
+    IV head
+  CODE:
+    NDArrayHandle hh = (NDArrayHandle)head;
+    ck(aTHX_ MXAutogradBackward(1, &hh, NULL, 0));

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Build the AI::MXNetTPU XS extension against libmxtpu.so.
+#
+# Reference analogue: perl-package/AI-MXNet's Makefile.PL build; kept as a
+# plain script so CI can invoke it hermetically. Produces
+# blib/arch/auto/AI/MXNetTPU/MXNetTPU.so for XSLoader.
+set -euo pipefail
+cd "$(dirname "$0")"
+REPO="$(cd ../.. && pwd)"
+
+CORE=$(perl -MConfig -e 'print "$Config{archlibexp}/CORE"')
+CCFLAGS=$(perl -MConfig -e 'print $Config{ccflags}')
+CCDL=$(perl -MConfig -e 'print $Config{cccdlflags}')
+TYPEMAP=$(perl -MConfig -e 'print "$Config{privlibexp}/ExtUtils/typemap"')
+
+OUT=blib/arch/auto/AI/MXNetTPU
+mkdir -p "$OUT"
+xsubpp -typemap "$TYPEMAP" MXNetTPU.xs > MXNetTPU.c
+gcc -shared $CCDL $CCFLAGS -I"$CORE" MXNetTPU.c \
+    -L"$REPO/mxnet_tpu/_lib" -lmxtpu \
+    -Wl,-rpath,"$REPO/mxnet_tpu/_lib" \
+    -o "$OUT/MXNetTPU.so"
+echo "built $OUT/MXNetTPU.so"

@@ -1,0 +1,64 @@
+#!/usr/bin/perl
+# Train an MLP classifier in pure perl over the mxnet_tpu C ABI.
+#
+# Reference analogue: the AI::MXNet perl examples
+# (perl-package/AI-MXNet/examples/); same shape as
+# examples/cpp-train/train_mlp.cc — symbol graph, bound executor,
+# kvstore store-side SGD, convergence-asserted.
+#
+# Run (after `make` at the repo root and perl-package/AI-MXNetTPU/build.sh):
+#   MXTPU_REPO=$REPO MXTPU_PREDICT_PLATFORM=cpu \
+#     perl -Iblib/arch -Ilib examples/train_mlp.pl
+# Exits 0 iff final training accuracy > 0.9.
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib";
+use lib "$FindBin::Bin/../blib/arch";
+
+use AI::MXNetTPU;
+
+my ($BATCH, $DIM, $HIDDEN, $CLASSES) = (32, 16, 32, 2);
+my ($SAMPLES, $EPOCHS) = (256, 12);
+
+AI::MXNetTPU->seed(0);
+printf "AI::MXNetTPU version %d\n", AI::MXNetTPU->version;
+
+# two-blob synthetic dataset: class = (sum(x) > 0)
+srand(0);
+my (@xs, @ys);
+for my $i (1 .. $SAMPLES) {
+    my $s = 0;
+    for my $j (1 .. $DIM) {
+        # Box-Muller standard normal
+        my $v = sqrt(-2 * log(rand() + 1e-12)) * cos(6.28318530718 * rand());
+        push @xs, $v;
+        $s += $v;
+    }
+    push @ys, $s > 0 ? 1 : 0;
+}
+
+# symbol graph: data -> FC -> relu -> FC -> SoftmaxOutput
+my $data  = AI::MXNetTPU::Symbol->Variable('data');
+my $label = AI::MXNetTPU::Symbol->Variable('softmax_label');
+my $fc1 = AI::MXNetTPU::Symbol->FullyConnected(
+    $data, name => 'fc1', num_hidden => $HIDDEN);
+my $act = AI::MXNetTPU::Symbol->Activation(
+    $fc1, name => 'relu1', act_type => 'relu');
+my $fc2 = AI::MXNetTPU::Symbol->FullyConnected(
+    $act, name => 'fc2', num_hidden => $CLASSES);
+my $net = AI::MXNetTPU::Symbol->SoftmaxOutput(
+    $fc2, $label, name => 'softmax');
+
+my $args = $net->list_arguments;
+print "arguments: @$args\n";
+
+my $mod = AI::MXNetTPU::Module->new(symbol => $net);
+$mod->bind(data_shape => [$BATCH, $DIM], label_shape => [$BATCH]);
+$mod->init_params(scale => 0.1, seed => 1);
+$mod->init_optimizer('sgd', learning_rate => 0.1,
+                     rescale_grad => 1.0 / $BATCH);
+
+my $acc = $mod->fit(\@xs, \@ys, epochs => $EPOCHS);
+printf "final accuracy %.4f\n", $acc;
+exit($acc > 0.9 ? 0 : 1);

@@ -1,0 +1,37 @@
+package AI::MXNetTPU;
+
+# AI::MXNetTPU — perl frontend for the mxnet_tpu training C ABI.
+#
+# Reference analogue: perl-package/AI-MXNet/lib/AI/MXNet.pm (AI::MXNet, the
+# reference's ~19k-LoC perl binding). This is the same architecture in
+# miniature: a compiled XS layer (MXNetTPU.xs) binds the flat C ABI
+# (src/capi/c_api.h), and pure-perl classes wrap the handles with an
+# object API — NDArray, Symbol (op composition), Executor
+# (bind/forward/backward), KVStore (store-side optimizer), and a small
+# Module with a fit() loop. Enough of the AI::MXNet surface to build and
+# train networks end to end from perl.
+
+use strict;
+use warnings;
+
+our $VERSION = '0.11.0';
+
+use XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+use AI::MXNetTPU::NDArray;
+use AI::MXNetTPU::Symbol;
+use AI::MXNetTPU::Executor;
+use AI::MXNetTPU::KVStore;
+use AI::MXNetTPU::Module;
+
+sub version { AI::MXNetTPU::mxp_version() }
+sub seed    { AI::MXNetTPU::mxp_random_seed($_[1] // $_[0]) }
+
+# mx->nd / mx->sym / mx->mod accessors, AI::MXNet style
+sub nd  { 'AI::MXNetTPU::NDArray' }
+sub sym { 'AI::MXNetTPU::Symbol' }
+sub mod { 'AI::MXNetTPU::Module' }
+sub kv  { 'AI::MXNetTPU::KVStore' }
+
+1;

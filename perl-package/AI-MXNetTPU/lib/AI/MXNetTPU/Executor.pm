@@ -1,0 +1,64 @@
+package AI::MXNetTPU::Executor;
+
+# Bound executor (reference: AI::MXNet::Executor). grad_req codes match
+# the ABI: 0 null, 1 write, 3 add.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+my %REQ = (null => 0, write => 1, add => 3);
+
+# Executor->bind($sym, args => {name => NDArray}, grads => {...},
+#                grad_req => 'write'|{name=>req}, aux => {name => NDArray})
+sub bind {
+    my ($class, $sym, %kw) = @_;
+    my $args = $kw{args} or croak "bind needs args";
+    my $grads = $kw{grads} // {};
+    my $req = $kw{grad_req} // 'write';
+    my $aux = $kw{aux} // {};
+    my $names = $sym->list_arguments;
+    my (@arg_h, @grad_h, @req_codes, @aux_h);
+    for my $n (@$names) {
+        croak "bind missing argument $n" unless $args->{$n};
+        push @arg_h, $args->{$n}->handle;
+        my $r = ref $req ? ($req->{$n} // 'null') : $req;
+        $r = 'null' unless $grads->{$n};
+        push @grad_h, $grads->{$n} ? $grads->{$n}->handle : 0;
+        push @req_codes, $REQ{$r} // 0;
+    }
+    for my $n (@{ $sym->list_auxiliary_states }) {
+        croak "bind missing auxiliary state $n" unless $aux->{$n};
+        push @aux_h, $aux->{$n}->handle;
+    }
+    my $ex = AI::MXNetTPU::mxp_executor_bind(
+        $sym->handle, \@arg_h, \@grad_h, \@req_codes, \@aux_h);
+    bless { handle => $ex, sym => $sym, args => $args, grads => $grads,
+            aux => $aux }, $class;
+}
+
+sub forward {
+    my ($self, $is_train) = @_;
+    AI::MXNetTPU::mxp_executor_forward($self->{handle}, $is_train ? 1 : 0);
+    $self;
+}
+
+sub backward {
+    my ($self) = @_;
+    AI::MXNetTPU::mxp_executor_backward($self->{handle});
+    $self;
+}
+
+sub outputs {
+    my ($self) = @_;
+    [map { AI::MXNetTPU::NDArray->_wrap($_) }
+         @{ AI::MXNetTPU::mxp_executor_outputs($self->{handle}) }];
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::mxp_executor_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

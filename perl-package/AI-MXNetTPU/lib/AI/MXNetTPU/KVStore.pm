@@ -1,0 +1,49 @@
+package AI::MXNetTPU::KVStore;
+
+# Key-value store with store-side optimizer (reference:
+# AI::MXNet::KVStore). push(grads) + pull(weights) with a registered
+# optimizer is the update_on_kvstore training path.
+
+use strict;
+use warnings;
+
+sub create {
+    my ($class, $type) = @_;
+    bless { handle => AI::MXNetTPU::mxp_kv_create($type // 'local') },
+        $class;
+}
+
+sub init {
+    my ($self, $keys, $vals) = @_;
+    AI::MXNetTPU::mxp_kv_init($self->{handle}, $keys,
+                              [map { $_->handle } @$vals]);
+}
+
+sub push_ {
+    my ($self, $keys, $vals, $priority) = @_;
+    AI::MXNetTPU::mxp_kv_push($self->{handle}, $keys,
+                              [map { $_->handle } @$vals],
+                              $priority // 0);
+}
+
+sub pull {
+    my ($self, $keys, $outs, $priority) = @_;
+    AI::MXNetTPU::mxp_kv_pull($self->{handle}, $keys,
+                              [map { $_->handle } @$outs],
+                              $priority // 0);
+}
+
+sub set_optimizer {
+    my ($self, $name, %params) = @_;
+    my @keys = sort keys %params;
+    AI::MXNetTPU::mxp_kv_set_optimizer(
+        $self->{handle}, $name, \@keys, [map { "$params{$_}" } @keys]);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::mxp_kv_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

@@ -1,0 +1,142 @@
+package AI::MXNetTPU::Module;
+
+# Minimal Module trainer (reference: AI::MXNet::Module's
+# bind/init_params/init_optimizer/fit surface). Training runs the
+# update_on_kvstore path: gradients are pushed to the store, the
+# store-side optimizer applies the update, weights are pulled back —
+# the same loop the reference's perl frontend drives.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+use List::Util qw(min);
+
+sub new {
+    my ($class, %kw) = @_;
+    croak "Module->new needs symbol" unless $kw{symbol};
+    bless {
+        symbol     => $kw{symbol},
+        data_name  => $kw{data_name} // 'data',
+        label_name => $kw{label_name} // 'softmax_label',
+    }, $class;
+}
+
+sub bind {
+    my ($self, %kw) = @_;
+    my ($dshape, $lshape) = @kw{qw(data_shape label_shape)};
+    my ($args, $outs, $aux) = $self->{symbol}->infer_shape(
+        $self->{data_name} => $dshape, $self->{label_name} => $lshape);
+    my $names = $self->{symbol}->list_arguments;
+    my (%arrays, %grads, %reqs, %auxs);
+    for my $i (0 .. $#$names) {
+        my $n = $names->[$i];
+        $arrays{$n} = AI::MXNetTPU::NDArray->zeros($args->[$i]);
+        my $is_param = $n ne $self->{data_name}
+            && $n ne $self->{label_name};
+        if ($is_param) {
+            $grads{$n} = AI::MXNetTPU::NDArray->zeros($args->[$i]);
+            $reqs{$n} = 'write';
+        } else {
+            $reqs{$n} = 'null';
+        }
+    }
+    my $aux_names = $self->{symbol}->list_auxiliary_states;
+    for my $i (0 .. $#$aux_names) {
+        $auxs{ $aux_names->[$i] } =
+            AI::MXNetTPU::NDArray->zeros($aux->[$i]);
+    }
+    $self->{arrays} = \%arrays;
+    $self->{grads} = \%grads;
+    $self->{aux} = \%auxs;
+    $self->{param_names} = [grep { $reqs{$_} eq 'write' } @$names];
+    $self->{exec} = $self->{symbol}->bind(
+        args => \%arrays, grads => \%grads, grad_req => \%reqs,
+        aux => \%auxs);
+    $self->{batch} = $dshape->[0];
+    $self;
+}
+
+sub init_params {
+    my ($self, %kw) = @_;
+    my $scale = $kw{scale} // 0.07;
+    srand($kw{seed} // 0);
+    for my $n (@{ $self->{param_names} }) {
+        my $a = $self->{arrays}{$n};
+        $a->set([map { rand(2 * $scale) - $scale } 1 .. $a->size]);
+    }
+    $self;
+}
+
+sub init_optimizer {
+    my ($self, $opt, %params) = @_;
+    my $kv = AI::MXNetTPU::KVStore->create('local');
+    $kv->set_optimizer($opt, %params);
+    my $names = $self->{param_names};
+    $kv->init($names, [map { $self->{arrays}{$_} } @$names]);
+    $self->{kv} = $kv;
+    $self;
+}
+
+sub forward_backward {
+    my ($self, $x, $y) = @_;
+    $self->{arrays}{ $self->{data_name} }->set($x);
+    $self->{arrays}{ $self->{label_name} }->set($y);
+    $self->{exec}->forward(1);
+    $self->{exec}->backward;
+    $self;
+}
+
+sub update {
+    my ($self) = @_;
+    my $names = $self->{param_names};
+    $self->{kv}->push_($names, [map { $self->{grads}{$_} } @$names]);
+    $self->{kv}->pull($names, [map { $self->{arrays}{$_} } @$names]);
+    $self;
+}
+
+# fit(\@x_flat, \@labels, epochs => 10): x_flat is row-major sample rows;
+# returns final training accuracy.
+sub fit {
+    my ($self, $xs, $ys, %kw) = @_;
+    my $epochs = $kw{epochs} // 10;
+    my $b = $self->{batch};
+    my $n = scalar @$ys;
+    my $dim = scalar(@$xs) / $n;
+    for my $ep (1 .. $epochs) {
+        for (my $i = 0; $i + $b <= $n; $i += $b) {
+            my @x = @$xs[$i * $dim .. ($i + $b) * $dim - 1];
+            my @y = @$ys[$i .. $i + $b - 1];
+            $self->forward_backward(\@x, \@y)->update;
+        }
+    }
+    $self->score($xs, $ys);
+}
+
+sub score {
+    my ($self, $xs, $ys) = @_;
+    my $b = $self->{batch};
+    my $n = scalar @$ys;
+    my $dim = scalar(@$xs) / $n;
+    my ($hit, $tot) = (0, 0);
+    for (my $i = 0; $i + $b <= $n; $i += $b) {
+        my @x = @$xs[$i * $dim .. ($i + $b) * $dim - 1];
+        $self->{arrays}{ $self->{data_name} }->set(\@x);
+        $self->{exec}->forward(0);
+        my $probs = $self->{exec}->outputs->[0]->values;
+        my $classes = scalar(@$probs) / $b;
+        for my $r (0 .. $b - 1) {
+            my ($best, $bi) = (-1, 0);
+            for my $c (0 .. $classes - 1) {
+                if ($probs->[$r * $classes + $c] > $best) {
+                    $best = $probs->[$r * $classes + $c];
+                    $bi = $c;
+                }
+            }
+            ++$hit if $bi == $ys->[$i + $r];
+            ++$tot;
+        }
+    }
+    $tot ? $hit / $tot : 0;
+}
+
+1;

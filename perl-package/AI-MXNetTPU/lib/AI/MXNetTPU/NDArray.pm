@@ -1,0 +1,82 @@
+package AI::MXNetTPU::NDArray;
+
+# Float32 device array over an ABI handle (reference: AI::MXNet::NDArray,
+# perl-package/AI-MXNet/lib/AI/MXNet/NDArray.pm). Values cross the
+# boundary as pack("f*") strings; imperative ops dispatch by name through
+# MXImperativeInvokeByName.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+use overload
+    '+' => sub { _binop('broadcast_add', @_) },
+    '-' => sub { _binop('broadcast_sub', @_) },
+    '*' => sub { _binop('broadcast_mul', @_) },
+    '""' => sub { my $s = $_[0]->shape; "<NDArray " . join('x', @$s) . ">" };
+
+sub _wrap { my ($class, $h) = @_; bless { handle => $h, own => 1 }, $class }
+
+sub zeros {
+    my ($class, $shape) = @_;
+    my $h = AI::MXNetTPU::mxp_nd_create($shape);
+    $class->_wrap($h);
+}
+
+sub array {
+    my ($class, $vals, $shape) = @_;
+    $shape //= [scalar @$vals];
+    my $self = $class->zeros($shape);
+    $self->set($vals);
+    $self;
+}
+
+sub set {
+    my ($self, $vals) = @_;
+    AI::MXNetTPU::mxp_nd_copy_from($self->{handle}, pack('f*', @$vals));
+    $self;
+}
+
+sub values {
+    my ($self) = @_;
+    [unpack('f*', AI::MXNetTPU::mxp_nd_copy_to($self->{handle}))];
+}
+
+sub shape { AI::MXNetTPU::mxp_nd_shape($_[0]{handle}) }
+
+sub size {
+    my $n = 1;
+    $n *= $_ for @{ $_[0]->shape };
+    $n;
+}
+
+sub handle { $_[0]{handle} }
+
+# invoke a named op on NDArray / scalar-string params:
+#   AI::MXNetTPU::NDArray->invoke('sgd_update', [$w, $g], {lr => 0.1})
+sub invoke {
+    my ($class, $op, $ins, $params) = @_;
+    $params //= {};
+    my @keys = sort keys %$params;
+    my @vals = map { "$params->{$_}" } @keys;
+    my $outs = AI::MXNetTPU::mxp_invoke(
+        $op, [map { $_->{handle} } @$ins], \@keys, \@vals);
+    my @wrapped = map { __PACKAGE__->_wrap($_) } @$outs;
+    wantarray ? @wrapped : $wrapped[0];
+}
+
+sub _binop {
+    my ($op, $a, $b, $swap) = @_;
+    croak "NDArray ops need NDArray operands" unless ref $b;
+    ($a, $b) = ($b, $a) if $swap;
+    __PACKAGE__->invoke($op, [$a, $b]);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::mxp_nd_free($self->{handle})
+        if $self->{own} && $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

@@ -1,0 +1,81 @@
+package AI::MXNetTPU::Symbol;
+
+# Symbolic graph node (reference: AI::MXNet::Symbol,
+# perl-package/AI-MXNet/lib/AI/MXNet/Symbol.pm). Ops compose by name via
+# AUTOLOAD, AI::MXNet style:
+#
+#   my $data = AI::MXNetTPU::Symbol->Variable('data');
+#   my $fc   = AI::MXNetTPU::Symbol->FullyConnected(
+#                  $data, name => 'fc1', num_hidden => 64);
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+our $AUTOLOAD;
+
+sub _wrap { my ($class, $h) = @_; bless { handle => $h }, $class }
+
+sub Variable {
+    my ($class, $name) = @_;
+    $class->_wrap(AI::MXNetTPU::mxp_sym_variable($name));
+}
+
+sub create {
+    my ($class, $op, $args, %params) = @_;
+    # '' lets the python-side NameManager auto-uniquify (lc($op) would
+    # collide across repeated unnamed layers and silently tie weights)
+    my $name = delete $params{name} // '';
+    my @keys = sort keys %params;
+    my @vals = map { "$params{$_}" } @keys;
+    my $h = AI::MXNetTPU::mxp_sym_create_compose(
+        $op, $name, \@keys, \@vals, [map { $_->{handle} } @$args]);
+    $class->_wrap($h);
+}
+
+# AUTOLOAD sugar: Symbol->OpName(@sym_args, %params)
+sub AUTOLOAD {
+    my $class = shift;
+    (my $op = $AUTOLOAD) =~ s/.*:://;
+    return if $op eq 'DESTROY';
+    my @args;
+    push @args, shift @_ while @_ && ref $_[0];
+    $class->create($op, \@args, @_);
+}
+
+sub list_arguments { AI::MXNetTPU::mxp_sym_list_arguments($_[0]{handle}) }
+sub list_outputs   { AI::MXNetTPU::mxp_sym_list_outputs($_[0]{handle}) }
+sub list_auxiliary_states {
+    AI::MXNetTPU::mxp_sym_list_aux($_[0]{handle})
+}
+sub tojson         { AI::MXNetTPU::mxp_sym_tojson($_[0]{handle}) }
+
+sub from_json {
+    my ($class, $json) = @_;
+    $class->_wrap(AI::MXNetTPU::mxp_sym_from_json($json));
+}
+
+# infer_shape(data => [32, 16], ...) -> (\@arg_shapes, \@out_shapes,
+# \@aux_shapes), each an aref of shape arefs in declaration order.
+sub infer_shape {
+    my ($self, %known) = @_;
+    my @names = sort keys %known;
+    my $res = AI::MXNetTPU::mxp_sym_infer_shape(
+        $self->{handle}, \@names, [map { $known{$_} } @names]);
+    @$res;
+}
+
+sub bind {
+    my ($self, %kw) = @_;
+    AI::MXNetTPU::Executor->bind($self, %kw);
+}
+
+sub handle { $_[0]{handle} }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::mxp_sym_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

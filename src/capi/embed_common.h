@@ -12,6 +12,11 @@
 
 #include <Python.h>
 
+#ifdef __linux__
+#include <dlfcn.h>
+#include <cstdio>
+#endif
+
 #include <mutex>
 #include <string>
 
@@ -72,11 +77,31 @@ if plat:
 extern "C" const char *mxtpu_embedded_pkg_b64(void);
 #endif
 
+/* When this library is dlopen'd by a non-python host (perl XS, R, a
+ * plugin loader), libpython arrives with RTLD_LOCAL and python C
+ * extensions (numpy, jaxlib, ...) later fail with undefined PyExc_... /
+ * PyFloat_Type symbols. Re-open it RTLD_GLOBAL (NOLOAD first: it is
+ * already mapped as our link dependency) so extension modules resolve. */
+inline void PromoteLibPython() {
+#ifdef __linux__
+  char name[64];
+  std::snprintf(name, sizeof name, "libpython%d.%d.so.1.0",
+                PY_MAJOR_VERSION, PY_MINOR_VERSION);
+  if (dlopen(name, RTLD_LAZY | RTLD_GLOBAL | RTLD_NOLOAD)) return;
+  if (dlopen(name, RTLD_LAZY | RTLD_GLOBAL)) return;
+  std::snprintf(name, sizeof name, "libpython%d.%d.so", PY_MAJOR_VERSION,
+                PY_MINOR_VERSION);
+  if (dlopen(name, RTLD_LAZY | RTLD_GLOBAL | RTLD_NOLOAD)) return;
+  dlopen(name, RTLD_LAZY | RTLD_GLOBAL);
+#endif
+}
+
 inline bool EnsurePython() {
   static std::once_flag flag;
   static bool ok = false;
   std::call_once(flag, []() {
     if (!Py_IsInitialized()) {
+      PromoteLibPython();
       Py_InitializeEx(0);
       /* release the GIL acquired by initialization so PyGILState works
        * from arbitrary threads below */
