@@ -2,7 +2,10 @@
 
 Reference: python/mxnet/module/base_module.py — BaseModule.fit:376 (epoch
 loop :476-492), forward_backward:189, score, predict, iter_predict,
-init_params/set_params plumbing.
+init_params/set_params plumbing. Same API; the loops here are structured
+around a lookahead batch generator (so ``prepare`` sees the upcoming
+batch while the current one is in flight — the async-prefetch contract)
+and ``predict`` is just a fold over ``iter_predict``.
 """
 from __future__ import annotations
 
@@ -10,16 +13,14 @@ import logging
 import time
 from typing import List, Optional
 
-import numpy as _np
-
 from .. import metric as metric_mod
 from .. import ndarray as nd
-from ..base import MXNetError
 from ..callback import BatchEndParam
 from ..initializer import Uniform
-from ..io import DataBatch
 
 __all__ = ["BaseModule"]
+
+_END = object()
 
 
 def _as_list(obj):
@@ -30,20 +31,46 @@ def _as_list(obj):
     return [obj]
 
 
+def _fire(callbacks, param):
+    """Invoke one callback or a list of them with the same param."""
+    for cb in _as_list(callbacks):
+        cb(param)
+
+
+def _lookahead(iterable):
+    """Yield (batch, upcoming) pairs; ``upcoming`` is None on the last.
+
+    The training loop hands ``upcoming`` to ``prepare`` so bucketing /
+    prefetch modules can stage the next executor while the current step
+    is still in flight (reference: the next_data_batch dance in
+    base_module.py fit)."""
+    it = iter(iterable)
+    here = next(it, _END)
+    while here is not _END:
+        nxt = next(it, _END)
+        yield here, (None if nxt is _END else nxt)
+        here = nxt
+
+
+def _resolve_metric(m):
+    return m if isinstance(m, metric_mod.EvalMetric) else metric_mod.create(m)
+
+
 def _check_input_names(symbol, names, typename, throw):
-    args = symbol.list_arguments()
-    for name in names:
-        if name in args:
-            continue
-        candidates = [a for a in args if not a.endswith(("_weight", "_bias",
-                                                         "_gamma", "_beta"))]
-        msg = (f"You created Module with Module(..., {typename}_names={names}) "
-               f"but input with name '{name}' is not found in "
-               f"symbol.list_arguments(). Did you mean one of: \n"
-               + "\n".join(candidates))
-        if throw:
-            raise ValueError(msg)
-        logging.warning(msg)
+    known = set(symbol.list_arguments())
+    bad = [n for n in names if n not in known]
+    if not bad:
+        return
+    param_suffixes = ("_weight", "_bias", "_gamma", "_beta")
+    data_like = [a for a in symbol.list_arguments()
+                 if not a.endswith(param_suffixes)]
+    msg = (f"You created Module with Module(..., {typename}_names={names}) "
+           f"but input with name '{bad[0]}' is not found in "
+           f"symbol.list_arguments(). Did you mean one of: \n"
+           + "\n".join(data_like))
+    if throw:
+        raise ValueError(msg)
+    logging.warning(msg)
 
 
 class BaseModule:
@@ -60,23 +87,28 @@ class BaseModule:
     # -- properties subclasses provide ---------------------------------------
     @property
     def data_names(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement data_names")
 
     @property
     def output_names(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement output_names")
 
     @property
     def data_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement data_shapes")
 
     @property
     def label_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement label_shapes")
 
     @property
     def output_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement output_shapes")
 
     @property
     def symbol(self):
@@ -84,34 +116,44 @@ class BaseModule:
 
     # -- abstract ops --------------------------------------------------------
     def bind(self, *args, **kwargs):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement bind")
 
     def init_params(self, *args, **kwargs):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement init_params")
 
     def init_optimizer(self, *args, **kwargs):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement init_optimizer")
 
     def forward(self, data_batch, is_train=None):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward")
 
     def backward(self, out_grads=None):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement backward")
 
     def update(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement update")
 
     def get_outputs(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement get_outputs")
 
     def get_input_grads(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement get_input_grads")
 
     def get_params(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement get_params")
 
     def update_metric(self, eval_metric, labels):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement update_metric")
 
     # -- composite ops -------------------------------------------------------
     def forward_backward(self, data_batch):
@@ -127,24 +169,25 @@ class BaseModule:
 
     def save_params(self, fname):
         arg_params, aux_params = self.get_params()
-        save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
-        save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        tagged = {f"arg:{k}": v for k, v in arg_params.items()}
+        tagged.update((f"aux:{k}", v) for k, v in aux_params.items())
+        nd.save(fname, tagged)
 
     def load_params(self, fname):
-        save_dict = nd.load(fname)
-        arg_params, aux_params = {}, {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
+        groups = {"arg": {}, "aux": {}}
+        for tagged_name, value in nd.load(fname).items():
+            tag, _, name = tagged_name.partition(":")
+            if tag not in groups or not name:
                 raise ValueError(f"Invalid param file {fname}")
-        self.set_params(arg_params, aux_params)
+            groups[tag][name] = value
+        self.set_params(groups["arg"], groups["aux"])
 
     # -- scoring / prediction ------------------------------------------------
+    def _trimmed_outputs(self, batch):
+        """Forward outputs with the batch's pad rows dropped."""
+        keep = None if not batch.pad else -batch.pad
+        return [out[:keep] if keep else out for out in self.get_outputs()]
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
@@ -152,26 +195,21 @@ class BaseModule:
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        eval_metric = _resolve_metric(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
+        seen = 0
+        for eval_batch in eval_data:
+            if num_batch is not None and seen == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric, locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=seen,
+                                eval_metric=eval_metric, locals=locals()))
+            seen += 1
+        _fire(score_end_callback,
+              BatchEndParam(epoch=epoch, nbatch=seen,
+                            eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
@@ -182,40 +220,27 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)]
-                       for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+            yield (self._trimmed_outputs(eval_batch), nbatch, eval_batch)
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        """reference: base_module.py predict"""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same " \
-                    "in mini-batches. Maybe bucketing is used?"
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        """reference: base_module.py predict — here a fold over
+        iter_predict."""
+        per_batch = [[o.copy() for o in outs] for outs, _, _ in
+                     self.iter_predict(eval_data, num_batch=num_batch,
+                                       reset=reset)]
+        if not per_batch:
+            return per_batch
+        if not merge_batches:
+            return per_batch
+        widths = {len(outs) for outs in per_batch}
+        assert len(widths) == 1, \
+            "Cannot merge batches, as num of outputs is not the same " \
+            "in mini-batches. Maybe bucketing is used?"
+        merged = [nd.concatenate(column) for column in zip(*per_batch)]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     # -- the main training loop ----------------------------------------------
     def fit(self, train_data, eval_data=None, eval_metric="acc",
@@ -226,7 +251,9 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """reference: base_module.py:376 — the canonical Module training loop."""
+        """reference: base_module.py:376 — the canonical Module training
+        loop: bind → init params/optimizer → per-epoch train pass with
+        lookahead prepare, then the optional validation pass."""
         assert num_epoch is not None, "please specify number of epochs"
 
         self.bind(data_shapes=train_data.provide_data,
@@ -240,66 +267,58 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        train_metric = _resolve_metric(eval_metric)
+        validation_metric = validation_metric or train_metric
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
-
-            for name, val in eval_metric.get_name_value():
+            started = time.time()
+            self._train_one_epoch(train_data, epoch, train_metric,
+                                  batch_end_callback, monitor)
+            for name, val in train_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - started)
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+            # sync the param snapshot back into the module so callbacks
+            # (checkpointing) and the next epoch agree on one copy
+            snapshot = self.get_params()
+            self.set_params(*snapshot)
+            for cb in _as_list(epoch_end_callback):
+                cb(epoch, self.symbol, *snapshot)
 
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
+
+    def _train_one_epoch(self, train_data, epoch, train_metric,
+                         batch_end_callback, monitor):
+        train_metric.reset()
+        for nbatch, (batch, upcoming) in enumerate(_lookahead(train_data)):
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            if upcoming is not None:
+                self.prepare(upcoming)
+            self.update_metric(train_metric, batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=train_metric, locals=locals()))
 
     def prepare(self, data_batch):
         pass
 
     def install_monitor(self, mon):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement install_monitor")
 
     def getstate(self):
         return self.__dict__

@@ -26,7 +26,53 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import MXNetError
 
-__all__ = ["pipeline_apply", "stack_stage_params", "pipeline_from_symbol"]
+__all__ = ["pipeline_apply", "pipeline_value_and_grad",
+           "stack_stage_params", "pipeline_from_symbol",
+           "psum_in_backward", "psum_in_forward"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_in_backward(x, axis_name):
+    """Identity forward, all-reduce backward (Megatron's *g* operator).
+
+    Inside a manual ``shard_map`` body, an activation that is logically
+    replicated across ``axis_name`` but consumed by ``axis_name``-sharded
+    weights (tensor-parallel column split) receives only the LOCAL shard's
+    cotangent from ordinary AD; the true cotangent is the sum over
+    shards. Wrap the activation with this before the sharded branch."""
+    return x
+
+
+def _psum_in_backward_fwd(x, axis_name):
+    return x, None
+
+
+def _psum_in_backward_bwd(axis_name, _, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+psum_in_backward.defvjp(_psum_in_backward_fwd, _psum_in_backward_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_in_forward(x, axis_name):
+    """All-reduce forward, identity backward (Megatron's *f* operator —
+    the pair of :func:`psum_in_backward`, used after a row-sharded
+    matmul). A raw ``lax.psum`` must not be used there: under
+    ``check_vma=False`` its transpose is another psum, which multiplies
+    the cotangent by the axis size."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _psum_in_forward_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _psum_in_forward_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+psum_in_forward.defvjp(_psum_in_forward_fwd, _psum_in_forward_bwd)
 
 
 def stack_stage_params(param_list):
@@ -100,27 +146,226 @@ def pipeline_apply(fn: Callable, stacked_params, x, mesh: Mesh,
     return out[-1].reshape((batch,) + x.shape[1:])
 
 
+def _1f1b_local(params, tail_params, x, y, fn: Callable, loss_fn: Callable,
+                axis_name: str, n_micro: int, reduce_axes=()):
+    """Per-device 1F1B body: each tick runs one backward microbatch-step
+    then one forward microbatch-step, so at most ``2n`` stage inputs are
+    ever live per device (a ring buffer) — versus GPipe's ``n_micro``.
+
+    Schedule (device s, tick t): forward of microbatch ``t - s``;
+    backward of microbatch ``t - 2n + 1 + s``. Activations flow s -> s+1
+    by ppermute, cotangents s -> s-1 by the reverse ppermute; the loss
+    (and its cotangent) is produced on the LAST stage the tick after its
+    forward. Each backward step re-linearizes the stage function at the
+    saved stage input (jax.vjp = per-stage rematerialization).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+    mb_shape = x.shape[1:]
+    ring_sz = 2 * n
+    is_first = idx == 0
+    is_last = idx == n - 1
+
+    def masked_add(acc, upd, active):
+        return jax.tree.map(
+            lambda a, u: a + jnp.where(active, u, jnp.zeros_like(u)),
+            acc, upd)
+
+    def tick(t, carry):
+        (state_f, state_b, pending_ct, ring, grads, tail_g, loss_sum,
+         xgrads) = carry
+
+        # ---- backward half (first: it reads pending_ct from the
+        # previous tick's forward on the last stage)
+        m_b = t - 2 * n + 1 + idx
+        active_b = (m_b >= 0) & (m_b < n_micro)
+        ct_in = jnp.where(is_last, pending_ct, state_b)
+        h_saved = jax.lax.dynamic_index_in_dim(
+            ring, jnp.clip(m_b, 0, n_micro - 1) % ring_sz, 0,
+            keepdims=False)
+        _, stage_vjp = jax.vjp(fn, params, h_saved)
+        dparams, dh_in = stage_vjp(ct_in)
+        grads = masked_add(grads, dparams, active_b)
+        xg_upd = jax.lax.dynamic_update_index_in_dim(
+            xgrads, dh_in, jnp.clip(m_b, 0, n_micro - 1), 0)
+        xgrads = jnp.where(active_b & is_first, xg_upd, xgrads)
+
+        # ---- forward half
+        m_f = t - idx
+        active_f = (m_f >= 0) & (m_f < n_micro)
+        mth = jnp.clip(m_f, 0, n_micro - 1)
+        inp = jax.lax.dynamic_index_in_dim(x, mth, 0, keepdims=False)
+        h_in = jnp.where(is_first, inp, state_f)
+        ring_upd = jax.lax.dynamic_update_index_in_dim(
+            ring, h_in, mth % ring_sz, 0)
+        ring = jnp.where(active_f, ring_upd, ring)
+        h_out = fn(params, h_in)
+        y_mb = jax.lax.dynamic_index_in_dim(y, mth, 0, keepdims=False)
+        l, (d_tail, dh) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            tail_params, h_out, y_mb)
+        produce = active_f & is_last
+        loss_sum = loss_sum + jnp.where(produce, l, 0.0)
+        tail_g = masked_add(tail_g, d_tail, produce)
+        pending_ct = jnp.where(produce, dh, pending_ct)
+
+        # ---- neighbor exchange
+        state_f = jax.lax.ppermute(h_out, axis_name, fwd_perm)
+        state_b = jax.lax.ppermute(dh_in, axis_name, bwd_perm)
+        return (state_f, state_b, pending_ct, ring, grads, tail_g,
+                loss_sum, xgrads)
+
+    zeros_h = jnp.zeros(mb_shape, x.dtype)
+    init = (zeros_h, zeros_h, zeros_h,
+            jnp.zeros((ring_sz,) + mb_shape, x.dtype),
+            jax.tree.map(jnp.zeros_like, params),
+            jax.tree.map(jnp.zeros_like, tail_params),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((n_micro,) + mb_shape, x.dtype))
+    carry = jax.lax.fori_loop(0, n_micro + 2 * n - 1, tick, init)
+    _, _, _, _, grads, tail_g, loss_sum, xgrads = carry
+    # only one stage holds each of these; psum replicates them
+    loss = jax.lax.psum(loss_sum, axis_name) / n_micro
+    tail_g = jax.tree.map(lambda g: jax.lax.psum(g, axis_name) / n_micro,
+                          tail_g)
+    xgrads = jax.lax.psum(xgrads, axis_name) / n_micro
+    grads = jax.tree.map(lambda g: g[None] / n_micro, grads)
+    # composition with data/sequence sharding of the microbatches: each
+    # shard computed the mean loss of ITS slice, so the global mean (and
+    # its gradients) is the psum over those axes divided by their size
+    for ax in reduce_axes:
+        size = jax.lax.axis_size(ax)
+        loss = jax.lax.psum(loss, ax) / size
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, ax) / size, grads)
+        tail_g = jax.tree.map(lambda g: jax.lax.psum(g, ax) / size, tail_g)
+        xgrads = xgrads / size  # stays sharded like x
+    return loss, grads, tail_g, xgrads
+
+
+def pipeline_value_and_grad(fn: Callable, loss_fn: Callable, stacked_params,
+                            tail_params, x, y, mesh: Mesh,
+                            axis_name: str = "pipe",
+                            n_microbatches: int = None,
+                            mb_spec: P = None, label_spec: P = None,
+                            param_spec=None):
+    """1F1B pipeline training step: (mean loss, stage grads, tail grads,
+    input cotangent).
+
+    ``fn(stage_params, h) -> h`` is the per-stage body (stacked_params as
+    in :func:`pipeline_apply`); ``loss_fn(tail_params, h, y_mb) -> scalar``
+    runs on the LAST stage per microbatch — the model's head/epilogue and
+    loss live here, which is what lets backward start while later
+    microbatches are still filling (the 1F1B property). Activation
+    memory per device is a ring of ``2 * n_stages`` stage inputs,
+    independent of the microbatch count (GPipe stores all
+    ``n_micro``); each backward re-linearizes the stage at its saved
+    input (remat). Returns ``x_grad`` so a prologue (embedding) outside
+    the pipeline can be trained through it.
+    """
+    if axis_name not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    n = mesh.shape[axis_name]
+    leaves = jax.tree.leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != n:
+        raise MXNetError(
+            f"stacked_params leading dim {leaves[0].shape[0]} != pipe axis "
+            f"size {n}")
+    n_micro = n_microbatches or n
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise MXNetError(f"batch {batch} not divisible by "
+                         f"n_microbatches {n_micro}")
+    mb = batch // n_micro
+    xm = x.reshape((n_micro, mb) + x.shape[1:])
+    ym = y.reshape((n_micro, mb) + y.shape[1:])
+
+    # mb_spec/label_spec shard the per-microbatch dims (dim 0 of each
+    # microbatch = batch over 'data', a sequence dim over 'seq', ...);
+    # the named axes become grad-reduce axes for the (replicated) params
+    mb_spec = tuple(mb_spec) if mb_spec is not None else ()
+    label_spec = tuple(label_spec) if label_spec is not None else mb_spec
+    reduce_axes = tuple(
+        ax for spec in (mb_spec,) for ax in spec if ax is not None)
+    x_spec = P(None, *mb_spec) if mb_spec else P()
+    y_spec = P(None, *label_spec) if label_spec else P()
+
+    # param_spec (optional): per-leaf PartitionSpecs for stacked_params —
+    # tensor parallelism inside the stage body (e.g. Megatron FFN weights
+    # over 'model'; the body then psums over that axis itself). Such
+    # shard-local params get shard-local exact grads, so they are NOT in
+    # reduce_axes.
+    p_spec = (param_spec if param_spec is not None
+              else jax.tree.map(lambda _: P(axis_name), stacked_params))
+    rep = jax.tree.map(lambda _: P(), tail_params)
+    loss, grads, tail_g, xgrads = jax.shard_map(
+        functools.partial(_1f1b_local, fn=fn, loss_fn=loss_fn,
+                          axis_name=axis_name, n_micro=n_micro,
+                          reduce_axes=reduce_axes),
+        mesh=mesh, in_specs=(p_spec, rep, x_spec, y_spec),
+        out_specs=(P(), p_spec, rep, x_spec),
+        check_vma=False)(stacked_params, tail_params, xm, ym)
+    return loss, grads, tail_g, xgrads.reshape((batch,) + x.shape[1:])
+
+
+
+def _run_nodes(nodes_list, values, name_to_val, is_train):
+    """Evaluate a node list given seeded entry values and named params."""
+    for m in nodes_list:
+        ins = []
+        for parent, i in m.inputs:
+            key = (id(parent), i)
+            if key in values:
+                ins.append(values[key])
+            else:
+                ins.append(name_to_val[parent.name])
+        call_attrs = dict(m.attrs)
+        if m.op.needs_is_train:
+            call_attrs["_is_train"] = is_train
+        if m.op.key_var_num_args and not call_attrs.get(
+                m.op.key_var_num_args):
+            call_attrs[m.op.key_var_num_args] = len(ins)
+        out = m.op.fn(*ins, **call_attrs)
+        if not isinstance(out, tuple):
+            out = (out,)
+        for i, o in enumerate(out):
+            values[(id(m), i)] = o
+    return values
+
+
 def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
                          n_microbatches: int = None,
                          data_name: str = "data"):
-    """Drive the GPipe schedule from ctx_group stage annotations on a Symbol.
+    """Drive a microbatch pipeline from ctx_group stage annotations.
 
     The reference expressed layer placement with ``mx.AttrScope(
     ctx_group='stageK')`` + ``group2ctx`` and got only the dependency
     engine's implicit overlap (SURVEY.md §2.5, graph_executor.cc:386-398).
-    Here the same annotations drive a real microbatch pipeline: nodes
-    labelled ``stage0..stage{n-1}`` become SPMD pipeline stages sharded
-    over the ``axis_name`` mesh axis, activations hop stages via ppermute.
+    Here the annotations drive a real SPMD pipeline over the
+    ``axis_name`` mesh axis, and a real model SHAPE is supported:
 
-    Constraints (checked): stages must be isomorphic (same op sequence,
-    same parameter shapes — the natural shape of a repeated-block model),
-    connected by exactly one same-shaped activation tensor, with no rng
-    ops and no auxiliary states; weights may not be shared across stages.
+    * ``ctx_group='prologue'`` (or any unlabeled nodes with no staged
+      ancestor) — embedding/input stem, computed outside the pipeline
+      loop and trained through the pipeline's input cotangent;
+    * ``ctx_group='stage0'..'stage{n-1}'`` — the pipelined body; stages
+      must be isomorphic (one program runs on every pipe device — the
+      natural shape of a repeated-block transformer), connected by
+      exactly one same-shaped activation, no rng ops, no aux states,
+      no cross-stage weight sharing;
+    * ``ctx_group='epilogue'`` — head + output op, evaluated on the
+      last stage (its loss feeds the 1F1B backward schedule).
 
-    Returns ``apply(arg_dict, x, n_microbatches=...) -> out`` where
-    ``arg_dict`` maps every non-data variable name to its array. The
-    function is jax-differentiable — wrap it in a loss and ``jax.grad``
-    to train, or pass it anywhere an eval function is expected.
+    Returns ``apply(arg_dict, x, n_microbatches=...) -> out`` (inference,
+    GPipe schedule) with two attributes:
+
+    * ``apply.train_step(arg_dict, x, labels, n_microbatches=...) ->
+      (loss, grads_dict)`` — the 1F1B schedule
+      (:func:`pipeline_value_and_grad`): backward starts while the fill
+      is still running, activation memory is a ring of ``2n`` stage
+      inputs per device regardless of microbatch count. Requires the
+      epilogue to end in ``SoftmaxOutput`` (cross-entropy).
+    * ``apply.stage_param_names`` — per-stage parameter name lists.
     """
     from ..base import MXNetError as _Err
 
@@ -132,42 +377,55 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
     if symbol._aux_node_ids():
         raise _Err("pipeline_from_symbol: auxiliary states (BatchNorm "
                    "moving stats) are not supported inside pipeline stages")
+    out_entries = list(symbol._outputs)
+    if len(out_entries) != 1:
+        raise _Err("pipeline symbol must have exactly one output")
 
-    # -- stage assignment: explicit ctx_group attr, else inherit ---------
-    stage_of = {}
+    PRO, EPI = "prologue", "epilogue"
+
+    # -- role assignment: explicit ctx_group, else inherit/prologue ------
+    role_of = {}
     for node in nodes:
         if node.is_variable:
             continue
         grp = node.scope_attrs.get("ctx_group")
-        st = None
-        if grp is not None:
+        role = None
+        if grp in (PRO, EPI):
+            role = grp
+        elif grp is not None:
             if not grp.startswith("stage"):
-                raise _Err(f"ctx_group {grp!r} is not a pipeline stage "
-                           "label (want 'stage<k>')")
+                raise _Err(f"ctx_group {grp!r} is not a pipeline label "
+                           "(want 'prologue', 'epilogue' or 'stage<k>')")
             try:
-                st = int(grp[len("stage"):])
+                role = int(grp[len("stage"):])
             except ValueError:
                 raise _Err(f"ctx_group {grp!r} is not a pipeline stage "
                            "label (want 'stage<k>' with integer k)")
         else:
-            for parent, _ in node.inputs:
-                if id(parent) in stage_of:
-                    st = stage_of[id(parent)]
-                    break
-        if st is None:
-            raise _Err(f"node {node.name} has no stage (annotate with "
-                       "AttrScope(ctx_group='stage0'...))")
-        stage_of[id(node)] = st
-        if node.op.needs_rng:
+            parent_roles = [role_of[id(p)] for p, _ in node.inputs
+                            if id(p) in role_of]
+            if any(r == EPI for r in parent_roles):
+                role = EPI
+            else:
+                staged = [r for r in parent_roles if isinstance(r, int)]
+                role = max(staged) if staged else PRO
+        if role is None:
+            role = PRO
+        role_of[id(node)] = role
+        if node.op.needs_rng and isinstance(role, int):
             raise _Err(f"pipeline stages cannot contain rng op "
                        f"{node.op.name} ({node.name})")
 
+    prologue = [m for m in nodes
+                if not m.is_variable and role_of[id(m)] == PRO]
+    epilogue = [m for m in nodes
+                if not m.is_variable and role_of[id(m)] == EPI]
     stages = [[] for _ in range(n)]
     seen_max = -1
     for node in nodes:
-        if node.is_variable:
+        if node.is_variable or not isinstance(role_of[id(node)], int):
             continue
-        st = stage_of[id(node)]
+        st = role_of[id(node)]
         if not 0 <= st < n:
             raise _Err(f"stage{st} out of range for pipe axis size {n}")
         if st < seen_max:
@@ -177,133 +435,256 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
     if any(not s for s in stages):
         raise _Err(f"need exactly {n} populated stages "
                    f"(pipe axis size), got {sum(1 for s in stages if s)}")
+    # the output must leave from the epilogue (or last stage if none)
+    out_node = out_entries[0][0]
+    if epilogue and role_of.get(id(out_node)) != EPI:
+        raise _Err("the symbol output must come from the epilogue")
 
-    # -- per-stage io: one activation in, one out, own variables ---------
-    out_entries = list(symbol._outputs)
-    if len(out_entries) != 1:
-        raise _Err("pipeline symbol must have exactly one output")
+    # -- per-role io ------------------------------------------------------
+    var_role = {}  # variable id -> role that consumes it
 
-    def stage_io(st_nodes, si):
-        produced = {(id(m), i) for m in st_nodes
+    def section_io(sec_nodes, role):
+        """(entry keys consumed from outside, own variable names)."""
+        produced = {(id(m), i) for m in sec_nodes
                     for i in range(m.num_outputs())}
-        act_in, var_names = None, []
-        for m in st_nodes:
+        entries, var_names = [], []
+        for m in sec_nodes:
             for parent, i in m.inputs:
                 key = (id(parent), i)
                 if key in produced:
                     continue
-                if parent.is_variable:
-                    if parent.name == data_name:
-                        if si != 0:
-                            raise _Err(f"{data_name} consumed by stage{si}"
-                                       " (only stage0 may read the input)")
-                        act_in = key
-                    else:
-                        owner = stage_of.get(id(m))
-                        for other in nodes:
-                            if (not other.is_variable and
-                                    stage_of[id(other)] != owner and
-                                    any(p is parent for p, _ in other.inputs)):
-                                raise _Err(
-                                    f"variable {parent.name} shared across "
-                                    "stages — unsupported in the SPMD "
-                                    "pipeline (stack per-stage copies)")
-                        if parent.name not in var_names:
-                            var_names.append(parent.name)
+                if parent.is_variable and parent.name != data_name:
+                    prev = var_role.setdefault(id(parent), role)
+                    if prev != role:
+                        raise _Err(
+                            f"variable {parent.name} is shared between "
+                            f"{prev} and {role} — unsupported in the SPMD "
+                            "pipeline (make per-section copies)")
+                    if parent.name not in var_names:
+                        var_names.append(parent.name)
                 else:
-                    if act_in is not None and act_in != key:
-                        raise _Err(f"stage{si} consumes more than one "
-                                   "cross-stage tensor")
-                    act_in = key
-        # the activation leaving this stage
-        if si == n - 1:
-            act_out = (id(out_entries[0][0]), out_entries[0][1])
+                    if key not in entries:
+                        entries.append(key)
+        return entries, var_names
+
+    pro_entries, pro_vars = section_io(prologue, PRO)
+    if prologue:
+        if len(pro_entries) != 1:
+            raise _Err("prologue must consume exactly the data input")
+        data_key = pro_entries[0]
+        pro_out_candidates = set()
+        for m in stages[0]:
+            for parent, i in m.inputs:
+                if role_of.get(id(parent)) == PRO:
+                    pro_out_candidates.add((id(parent), i))
+        if len(pro_out_candidates) != 1:
+            raise _Err("prologue -> stage0 boundary must be exactly one "
+                       f"tensor, got {len(pro_out_candidates)}")
+        pro_out = pro_out_candidates.pop()
+    else:
+        data_key = None
+        pro_out = None
+
+    stage_ios = []
+    for si, sec in enumerate(stages):
+        entries, var_names = section_io(sec, si)
+        if len(entries) != 1:
+            raise _Err(f"stage{si} must consume exactly one cross-stage "
+                       f"tensor, got {len(entries)}")
+        act_in = entries[0]
+        if si == 0 and prologue and act_in != pro_out:
+            raise _Err("stage0 must consume the prologue output")
+        # activation leaving this stage
+        if si < n - 1:
+            downstream = stages[si + 1]
         else:
-            nxt = stages[si + 1]
-            nxt_prod = {(id(m), i) for m in nxt for i in range(m.num_outputs())}
+            downstream = epilogue
+        produced = {(id(m), i) for m in sec for i in range(m.num_outputs())}
+        if downstream:
             outs = set()
-            for m in nxt:
+            down_prod = {(id(m), i) for m in downstream
+                         for i in range(m.num_outputs())}
+            for m in downstream:
                 for parent, i in m.inputs:
                     key = (id(parent), i)
-                    if key in produced and key not in nxt_prod:
+                    if key in produced and key not in down_prod:
                         outs.add(key)
             if len(outs) != 1:
-                raise _Err(f"stage{si}->stage{si + 1} boundary must be "
-                           f"exactly one tensor, got {len(outs)}")
+                raise _Err(f"stage{si} boundary must be exactly one "
+                           f"tensor, got {len(outs)}")
             act_out = outs.pop()
-        if act_in is None:
-            raise _Err(f"stage{si} has no incoming activation")
-        return act_in, act_out, var_names
+        else:
+            act_out = (id(out_entries[0][0]), out_entries[0][1])
+        stage_ios.append((act_in, act_out, var_names))
 
-    ios = [stage_io(s, i) for i, s in enumerate(stages)]
+    # -- isomorphism check ------------------------------------------------
+    def signature(sec):
+        return [(m.op.name,
+                 tuple(sorted((k, str(v)) for k, v in m.attrs.items())))
+                for m in sec]
 
-    # -- isomorphism check + stage0 fn -----------------------------------
-    sig0 = [(m.op.name, tuple(sorted((k, str(v)) for k, v in m.attrs.items())))
-            for m in stages[0]]
+    sig0 = signature(stages[0])
     for si in range(1, n):
-        sig = [(m.op.name,
-                tuple(sorted((k, str(v)) for k, v in m.attrs.items())))
-               for m in stages[si]]
-        if sig != sig0:
+        if signature(stages[si]) != sig0:
             raise _Err(
                 f"stage{si} is not isomorphic to stage0 (op/attr sequence "
                 "differs); the SPMD pipeline runs one program on all "
-                "stages")
+                "stages — put distinct input/output layers in "
+                "ctx_group='prologue'/'epilogue'")
+        if len(stage_ios[si][2]) != len(stage_ios[0][2]):
+            raise _Err(f"stage{si} has {len(stage_ios[si][2])} parameters,"
+                       f" stage0 has {len(stage_ios[0][2])}")
 
     st0_nodes = stages[0]
-    act_in0, act_out0, vars0 = ios[0]
-    var_order0 = list(vars0)
+    act_in0, act_out0, var_order0 = stage_ios[0]
+    per_stage_vars = [io[2] for io in stage_ios]
 
+    # -- section functions ------------------------------------------------
     def make_stage_fn(is_train):
         def stage_fn(stage_params, h):
             values = {act_in0: h}
             name_to_val = dict(zip(var_order0, stage_params))
-            for m in st0_nodes:
-                ins = []
-                for parent, i in m.inputs:
-                    key = (id(parent), i)
-                    if key in values:
-                        ins.append(values[key])
-                    else:  # a variable of this stage, mapped by position
-                        ins.append(name_to_val[parent.name])
-                call_attrs = dict(m.attrs)
-                if m.op.needs_is_train:
-                    call_attrs["_is_train"] = is_train
-                if m.op.key_var_num_args and not call_attrs.get(
-                        m.op.key_var_num_args):
-                    call_attrs[m.op.key_var_num_args] = len(ins)
-                out = m.op.fn(*ins, **call_attrs)
-                if not isinstance(out, tuple):
-                    out = (out,)
-                for i, o in enumerate(out):
-                    values[(id(m), i)] = o
+            _run_nodes(st0_nodes, values, name_to_val, is_train)
             return values[act_out0]
         return stage_fn
 
-    # rename map: stage i's k-th variable corresponds to stage0's k-th
-    per_stage_vars = [ios[si][2] for si in range(n)]
-    for si, vs in enumerate(per_stage_vars):
-        if len(vs) != len(var_order0):
-            raise _Err(f"stage{si} has {len(vs)} parameters, stage0 has "
-                       f"{len(var_order0)} — stages must be isomorphic")
+    def prologue_run(pro_params, x, is_train):
+        if not prologue:
+            return x
+        values = {data_key: x}
+        _run_nodes(prologue, values, dict(zip(pro_vars, pro_params)),
+                   is_train)
+        return values[pro_out]
 
-    def apply(arg_dict, x, n_microbatches=n_microbatches, is_train=True):
-        stage_params = []
-        for si in range(n):
-            try:
-                stage_params.append(tuple(arg_dict[v]
-                                          for v in per_stage_vars[si]))
-            except KeyError as e:
-                raise _Err(f"missing pipeline parameter {e}")
+    epi_entry = stage_ios[-1][1] if epilogue else None
+    if epilogue:
+        epi_entries, epi_vars = section_io(epilogue, EPI)
+        # the epilogue may consume ONLY the last stage's activation —
+        # a skip connection from an earlier section would otherwise
+        # surface as an opaque KeyError mid-trace
+        if epi_entries != [epi_entry]:
+            raise _Err(
+                "epilogue must consume exactly the last stage's output; "
+                f"it consumes {len(epi_entries)} cross-section tensors")
+    else:
+        epi_vars = []
+
+    # training loss: epilogue terminating in SoftmaxOutput -> CE on its
+    # logits (the op's implicit loss, like the executor path)
+    softmax_node = out_node if (epilogue and not out_node.is_variable
+                                and out_node.op.name == "SoftmaxOutput") \
+        else None
+    label_var_name = None
+    if softmax_node is not None and len(softmax_node.inputs) > 1:
+        lbl = softmax_node.inputs[1][0]
+        if lbl.is_variable:
+            label_var_name = lbl.name
+    # the label is fed as y, never gathered as a parameter
+    epi_vars = [v for v in epi_vars if v != label_var_name]
+
+    def epilogue_run(epi_params, h, is_train):
+        if not epilogue:
+            return h
+        values = {epi_entry: h}
+        name_to_val = dict(zip(epi_vars, epi_params))
+        if label_var_name and label_var_name not in name_to_val:
+            # inference: SoftmaxOutput ignores the label in forward
+            name_to_val[label_var_name] = jnp.zeros(h.shape[:-1], h.dtype)
+        _run_nodes(epilogue, values, name_to_val, is_train)
+        return values[(id(out_entries[0][0]), out_entries[0][1])]
+
+    sm_attrs = (softmax_node.op.attr_spec.parse(
+        softmax_node.attrs, "SoftmaxOutput")
+        if softmax_node is not None else {})
+
+    def loss_fn(epi_params, h, y_mb, is_train=True):
+        if softmax_node is None:
+            raise _Err("train_step requires the epilogue to end in "
+                       "SoftmaxOutput (cross-entropy)")
+        values = {epi_entry: h}
+        name_to_val = dict(zip(epi_vars, epi_params))
+        if label_var_name:
+            name_to_val[label_var_name] = y_mb
+        head_nodes = [m for m in epilogue if m is not softmax_node]
+        _run_nodes(head_nodes, values, name_to_val, is_train)
+        logits_key = (id(softmax_node.inputs[0][0]),
+                      softmax_node.inputs[0][1])
+        logits = values.get(logits_key)
+        if logits is None:  # logits come straight from the pipeline body
+            logits = h
+        # honor the op's declared CE semantics (use_ignore/ignore_label,
+        # grad_scale, smooth_alpha) the way the executor path does
+        # (ops/nn_ops.py SoftmaxOutput)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ids = y_mb.astype(jnp.int32)
+        smooth = float(sm_attrs.get("smooth_alpha", 0.0) or 0.0)
+        picked = jnp.take_along_axis(logp, jnp.maximum(ids, 0)[..., None],
+                                     axis=-1)[..., 0]
+        if smooth:
+            picked = ((1.0 - smooth) * picked
+                      + smooth * logp.mean(axis=-1))
+        if sm_attrs.get("use_ignore"):
+            keep = (ids != int(sm_attrs.get("ignore_label", -1))) \
+                .astype(picked.dtype)
+            denom = jnp.maximum(keep.sum(), 1.0)
+            loss = -(picked * keep).sum() / denom
+        else:
+            loss = -jnp.mean(picked)
+        return loss * float(sm_attrs.get("grad_scale", 1.0) or 1.0)
+
+    # -- public entry points ----------------------------------------------
+    def _gather(arg_dict, names, what):
         try:
-            stacked = stack_stage_params(stage_params)
+            return tuple(arg_dict[v] for v in names)
+        except KeyError as e:
+            raise _Err(f"missing {what} parameter {e}")
+
+    def _stacked(arg_dict):
+        stage_params = [_gather(arg_dict, vs, f"stage{si}")
+                        for si, vs in enumerate(per_stage_vars)]
+        try:
+            return stack_stage_params(stage_params)
         except Exception as e:
             raise _Err(f"per-stage parameter shapes differ — stages must "
                        f"be isomorphic: {e}")
-        return pipeline_apply(make_stage_fn(bool(is_train)), stacked, x,
-                              mesh, axis_name=axis_name,
-                              n_microbatches=n_microbatches)
 
+    def apply(arg_dict, x, n_microbatches=n_microbatches, is_train=False):
+        pro = _gather(arg_dict, pro_vars, "prologue")
+        epi = _gather(arg_dict, epi_vars, "epilogue")
+        h = prologue_run(pro, x, bool(is_train))
+        h = pipeline_apply(make_stage_fn(bool(is_train)), _stacked(arg_dict),
+                           h, mesh, axis_name=axis_name,
+                           n_microbatches=n_microbatches)
+        return epilogue_run(epi, h, bool(is_train))
+
+    def train_step(arg_dict, x, labels, n_microbatches=n_microbatches,
+                   mb_spec=None, label_spec=None):
+        """1F1B step -> (loss, grads keyed by variable name).
+
+        ``mb_spec``/``label_spec``: optional PartitionSpec entries for
+        the per-microbatch dims, composing pp with dp/sp sharding
+        (see :func:`pipeline_value_and_grad`)."""
+        pro = _gather(arg_dict, pro_vars, "prologue")
+        epi = _gather(arg_dict, epi_vars, "epilogue")
+        stacked = _stacked(arg_dict)
+        h0, pro_vjp = jax.vjp(
+            lambda pv: prologue_run(pv, x, True), pro)
+        loss, g_stacked, g_epi, dh0 = pipeline_value_and_grad(
+            make_stage_fn(True), loss_fn, stacked, epi, h0, labels, mesh,
+            axis_name=axis_name, n_microbatches=n_microbatches,
+            mb_spec=mb_spec, label_spec=label_spec)
+        (g_pro,) = pro_vjp(dh0)
+        grads = {}
+        for si, vs in enumerate(per_stage_vars):
+            for j, name in enumerate(vs):
+                grads[name] = jax.tree.leaves(g_stacked)[j][si]
+        grads.update(zip(epi_vars, g_epi))
+        grads.update(zip(pro_vars, g_pro))
+        return loss, grads
+
+    apply.train_step = train_step
     apply.stage_param_names = per_stage_vars
+    apply.prologue_param_names = list(pro_vars)
+    apply.epilogue_param_names = list(epi_vars)
     apply.stage_fn = make_stage_fn(True)
     return apply

