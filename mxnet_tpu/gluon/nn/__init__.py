@@ -1,5 +1,6 @@
 """Gluon neural-network layers (reference: python/mxnet/gluon/nn/)."""
 from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401
 from .attention import *  # noqa: F401,F403
+from .moe import *  # noqa: F401,F403
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403

@@ -1,0 +1,66 @@
+"""Gluon Switch mixture-of-experts FFN layer (mesh-aware).
+
+Beyond-reference (SURVEY.md §2.5: expert parallelism ❌ in the 2017
+reference). The user-facing handle on the TPU-native expert-parallel
+kernels: give it an ``expert_axis`` mesh-axis name and, under a mesh
+carrying that axis, tokens travel to their experts with all_to_all over
+ICI; without one the same layer runs its dense fallback. The layer's
+second output is the Switch load-balancing auxiliary loss — add it
+(scaled) to the training loss or experts collapse.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["SwitchFFN"]
+
+
+class SwitchFFN(HybridBlock):
+    """Switch/GShard feed-forward over (batch, seq, d_model) inputs.
+
+    ``layer(x) -> (out, aux_loss)``: each token routed to its top-k
+    expert relu-FFNs (capacity-bounded), plus the scalar balance loss.
+    """
+
+    def __init__(self, d_model, hidden_size, num_experts, top_k=1,
+                 capacity_factor=2.0, expert_axis="", dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._d_model = d_model
+        self._hidden = hidden_size
+        self._num_experts = num_experts
+        self._top_k = top_k
+        self._capacity_factor = capacity_factor
+        self._expert_axis = expert_axis
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(d_model, num_experts), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, d_model, hidden_size),
+                dtype=dtype, init=weight_initializer,
+                allow_deferred_init=True)
+            self.expert_b1 = self.params.get(
+                "expert_b1", shape=(num_experts, hidden_size), dtype=dtype,
+                init="zeros", allow_deferred_init=True)
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden_size, d_model),
+                dtype=dtype, init=weight_initializer,
+                allow_deferred_init=True)
+            self.expert_b2 = self.params.get(
+                "expert_b2", shape=(num_experts, d_model), dtype=dtype,
+                init="zeros", allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, **params):
+        out = F.SwitchFFN(
+            x, params["gate_weight"], params["expert_w1"],
+            params["expert_b1"], params["expert_w2"], params["expert_b2"],
+            num_experts=self._num_experts, hidden_size=self._hidden,
+            top_k=self._top_k, capacity_factor=self._capacity_factor,
+            expert_axis=self._expert_axis)
+        return out  # (mixed tokens, aux loss)
+
+    def __repr__(self):
+        return (f"SwitchFFN(d_model={self._d_model}, "
+                f"hidden={self._hidden}, experts={self._num_experts}, "
+                f"top_k={self._top_k}, expert_axis={self._expert_axis!r})")

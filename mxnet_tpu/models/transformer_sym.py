@@ -19,13 +19,29 @@ __all__ = ["get_symbol"]
 
 def get_symbol(vocab_size=1000, seq_len=64, num_layers=2, num_heads=4,
                d_model=64, d_ff=None, seq_axis="", seq_mode="auto",
-               dtype="float32", **kwargs):
+               moe_experts=0, expert_axis="", moe_top_k=1,
+               moe_aux_coeff=1e-2, dtype="float32", **kwargs):
     """Causal transformer LM symbol.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
     (batch, seq_len) next-token targets. Output: per-position softmax
     (batch, seq_len, vocab). ``seq_axis`` names the mesh axis to shard
     the attention sequence over (empty = no sequence parallelism).
+
+    ``moe_experts > 0`` swaps every block's FFN for a ``SwitchFFN``
+    mixture of experts (``expert_axis`` names the mesh axis for
+    expert parallelism; ``moe_top_k`` experts per token). The symbol
+    then has a SECOND output: the summed Switch load-balancing loss,
+    scaled by ``moe_aux_coeff`` and wrapped in ``MakeLoss`` so training
+    through any backward path (Executor, SPMDTrainer) optimizes it
+    alongside the LM loss — without it experts collapse.
+
+    Scaling note: the optimizer's ``rescale_grad`` divides EVERY
+    gradient, and SoftmaxOutput's default CE gradient is the per-token
+    SUM — so with the usual ``rescale_grad=1/(batch*seq)`` the aux term
+    competes against the MEAN token loss. To give the balance term the
+    Switch paper's relative weight alpha, set
+    ``moe_aux_coeff = alpha * batch * seq_len``.
     """
     d_ff = d_ff or 4 * d_model
     data = sym.Variable("data")
@@ -34,6 +50,7 @@ def get_symbol(vocab_size=1000, seq_len=64, num_layers=2, num_heads=4,
     pos = sym.Variable("pos_embed", shape=(seq_len, d_model))
     h = sym.broadcast_add(h, sym.expand_dims(pos, axis=0),
                           name="add_pos")
+    aux_losses = []
     for i in range(num_layers):
         q = sym.FullyConnected(h, num_hidden=d_model, flatten=False,
                                name=f"l{i}_q")
@@ -47,12 +64,26 @@ def get_symbol(vocab_size=1000, seq_len=64, num_layers=2, num_heads=4,
         a = sym.FullyConnected(a, num_hidden=d_model, flatten=False,
                                name=f"l{i}_attn_out")
         h = sym.elemwise_add(h, a, name=f"l{i}_res1")
-        f = sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
-                               name=f"l{i}_ffn1")
-        f = sym.Activation(f, act_type="relu", name=f"l{i}_relu")
-        f = sym.FullyConnected(f, num_hidden=d_model, flatten=False,
-                               name=f"l{i}_ffn2")
+        if moe_experts:
+            moe = sym.SwitchFFN(h, num_experts=moe_experts,
+                                hidden_size=d_ff, top_k=moe_top_k,
+                                expert_axis=expert_axis,
+                                name=f"l{i}_moe")
+            f, layer_aux = moe[0], moe[1]
+            aux_losses.append(layer_aux)
+        else:
+            f = sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
+                                   name=f"l{i}_ffn1")
+            f = sym.Activation(f, act_type="relu", name=f"l{i}_relu")
+            f = sym.FullyConnected(f, num_hidden=d_model, flatten=False,
+                                   name=f"l{i}_ffn2")
         h = sym.elemwise_add(h, f, name=f"l{i}_res2")
     logits = sym.FullyConnected(h, num_hidden=vocab_size, flatten=False,
                                 name="lm_head")
-    return sym.SoftmaxOutput(logits, preserve_shape=True, name="softmax")
+    out = sym.SoftmaxOutput(logits, preserve_shape=True, name="softmax")
+    if not aux_losses:
+        return out
+    total_aux = (aux_losses[0] if len(aux_losses) == 1
+                 else sym.add_n(*aux_losses, name="moe_aux_sum"))
+    balance = sym.MakeLoss(total_aux * moe_aux_coeff, name="moe_balance")
+    return sym.Group([out, balance])

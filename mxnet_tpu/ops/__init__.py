@@ -8,6 +8,7 @@ from . import contrib_ops  # noqa: F401
 from . import contrib_tail_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401
 from . import math_ops  # noqa: F401
+from . import moe_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401

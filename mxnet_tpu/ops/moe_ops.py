@@ -1,0 +1,81 @@
+"""Mixture-of-experts operator: SwitchFFN for sym/nd/gluon.
+
+Beyond-reference (the 2017 reference has no MoE; SURVEY.md §2.5 expert
+parallelism ❌). Same productization pattern as ``MultiHeadAttention``
+(attention_ops.py): a registered graph op whose ``expert_axis`` attr
+names a mesh axis — under an ambient ``parallel.mesh_scope`` carrying
+that axis the experts run expert-parallel with all_to_all dispatch
+(parallel/moe.py); otherwise a dense single-device fallback with the
+same router/capacity math, so one graph runs anywhere.
+
+Two outputs: the mixed tokens AND the Switch load-balancing auxiliary
+loss — feed the loss through ``MakeLoss`` (models/transformer_sym.py
+does) or experts collapse during training.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import AttrSpec
+from .registry import register
+
+
+def _switch_param_shapes(attrs, shapes):
+    d_model = shapes[0][-1]
+    e = int(attrs["num_experts"])
+    f = int(attrs["hidden_size"])
+    return [shapes[0], (d_model, e), (e, d_model, f), (e, f),
+            (e, f, d_model), (e, d_model)]
+
+
+@register("SwitchFFN",
+          attrs=AttrSpec(num_experts=("int",), hidden_size=("int",),
+                         top_k=("int", 1), capacity_factor=("float", 2.0),
+                         expert_axis=("str", "")),
+          num_inputs=6,
+          input_names=["data", "gate_weight", "expert_w1", "expert_b1",
+                       "expert_w2", "expert_b2"],
+          num_outputs=2, output_names=["output", "aux_loss"],
+          param_shapes=_switch_param_shapes)
+def _switch_ffn(data, gate_weight, expert_w1, expert_b1, expert_w2,
+                expert_b2, num_experts, hidden_size, top_k=1,
+                capacity_factor=2.0, expert_axis=""):
+    """Switch/GShard FFN over (..., d_model) inputs.
+
+    Routes each token to its top-k experts (relu FFN each), bounded by a
+    static capacity. ``expert_axis`` names the mesh axis to shard
+    experts (and the token stream) over; absent mesh/axis falls back to
+    the dense path. Output 0: mixed tokens, same shape as ``data``;
+    output 1: scalar load-balance loss (Switch aux; minimum 1.0 at
+    uniform utilization).
+    """
+    from ..parallel.mesh import current_mesh
+    from ..parallel.moe import moe_apply, moe_dense_apply
+
+    shape = data.shape
+    toks = data.reshape(-1, shape[-1])
+    params = (expert_w1, expert_b1, expert_w2, expert_b2)
+
+    def expert_fn(p, t):
+        w1, b1, w2, b2 = p
+        return jnp.maximum(t @ w1 + b1, 0.0) @ w2 + b2
+
+    mesh = None
+    if expert_axis:
+        m = current_mesh()
+        if (m is not None and expert_axis in m.axis_names
+                and m.shape[expert_axis] > 1
+                and toks.shape[0] % m.shape[expert_axis] == 0
+                and num_experts % m.shape[expert_axis] == 0):
+            mesh = m
+    if mesh is not None:
+        out, aux = moe_apply(toks, gate_weight, params, expert_fn, mesh,
+                             axis_name=expert_axis,
+                             capacity_factor=capacity_factor,
+                             top_k=top_k, return_aux=True)
+    else:
+        out, aux = moe_dense_apply(toks, gate_weight, params, expert_fn,
+                                   capacity_factor=capacity_factor,
+                                   top_k=top_k)
+    return out.reshape(shape).astype(data.dtype), aux

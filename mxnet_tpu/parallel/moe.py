@@ -19,7 +19,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import MXNetError
 
-__all__ = ["moe_apply", "top1_router"]
+__all__ = ["moe_apply", "moe_dense_apply", "top1_router", "topk_router",
+           "load_balance_loss"]
 
 
 def top1_router(x, router_w):
@@ -31,33 +32,82 @@ def top1_router(x, router_w):
     return gate, idx
 
 
-def _dispatch_tensors(gate, idx, n_experts: int, capacity: int):
-    """Build dispatch one-hot (T,E,C) and combine weights (T,E,C)."""
-    onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)  # (T,E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per token
-    keep = (pos > 0) & (pos <= capacity)
-    slot = jax.nn.one_hot((pos - 1).astype(jnp.int32), capacity,
-                          dtype=jnp.float32)  # (T,E,C)
-    dispatch = slot * keep[..., None]
-    combine = dispatch * gate[:, None, None]
+def topk_router(x, router_w, k: int):
+    """Softmax router, top-k choices per token.
+
+    Returns (probs (T,E), gates (T,k) renormalized over the chosen k,
+    indices (T,k)) — the GShard/Switch recipe (top-1 degenerates to the
+    Switch router)."""
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if k > probs.shape[-1]:
+        raise MXNetError(
+            f"top_k={k} exceeds the number of experts "
+            f"{probs.shape[-1]}")
+    gates, idxs = jax.lax.top_k(probs, k)
+    if k > 1:
+        # GShard renormalizes over the chosen k; Switch top-1 keeps the
+        # raw probability so the router gets its gradient signal
+        gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    return probs, gates, idxs
+
+
+def load_balance_loss(probs, first_choice, n_experts: int):
+    """Switch load-balancing auxiliary loss: ``E * sum_e f_e * P_e``.
+
+    ``f_e`` = fraction of tokens whose FIRST routing choice is expert e,
+    ``P_e`` = mean router probability of e. Minimized (= 1.0) at uniform
+    utilization; without it real MoE training collapses experts (the
+    Switch Transformer recipe this module cites)."""
+    onehot = jax.nn.one_hot(first_choice, n_experts, dtype=jnp.float32)
+    f = onehot.mean(axis=0)
+    p = probs.mean(axis=0)
+    return n_experts * jnp.sum(f * p)
+
+
+def _dispatch_topk(gates, idxs, n_experts: int, capacity: int):
+    """Dispatch one-hot (T,E,C) and combine weights (T,E,C) for top-k
+    routing with one shared per-expert capacity budget: choice 0 slots
+    fill first (a token's primary expert beats another's secondary)."""
+    T, k = gates.shape
+    dispatch = jnp.zeros((T, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((T, n_experts, capacity), jnp.float32)
+    used = jnp.zeros((n_experts,), jnp.float32)
+    for j in range(k):  # k is a small static constant
+        onehot = jax.nn.one_hot(idxs[:, j], n_experts, dtype=jnp.float32)
+        pos = (jnp.cumsum(onehot, axis=0) + used[None, :]) * onehot
+        keep = (pos > 0) & (pos <= capacity)
+        slot = jax.nn.one_hot((pos - 1).astype(jnp.int32), capacity,
+                              dtype=jnp.float32)
+        dj = slot * keep[..., None]
+        dispatch = dispatch + dj
+        combine = combine + dj * gates[:, j][:, None, None]
+        used = used + onehot.sum(axis=0)
     return dispatch, combine
 
 
 def _moe_local(x, router_w, expert_params, expert_fn, axis_name,
-               capacity_factor):
+               capacity_factor, top_k):
     """Per-device body: route local tokens, a2a to experts, a2a back.
 
     x: (T_loc, D) local tokens; expert_params: pytree with leading dim
-    E_loc (this device's experts).
+    E_loc (this device's experts). Returns (out, aux_loss) where the aux
+    loss is the GLOBAL Switch load-balance term (psum over the axis).
     """
     n = jax.lax.axis_size(axis_name)
     t_loc, d = x.shape
     e_loc = jax.tree.leaves(expert_params)[0].shape[0]
     n_experts = e_loc * n
-    capacity = max(1, int(capacity_factor * t_loc / n_experts))
+    capacity = max(1, int(capacity_factor * top_k * t_loc / n_experts))
 
-    gate, idx = top1_router(x, router_w)
-    dispatch, combine = _dispatch_tensors(gate, idx, n_experts, capacity)
+    probs, gates, idxs = topk_router(x, router_w, top_k)
+    # global balance statistics: local sums psum'd over the mesh axis
+    onehot1 = jax.nn.one_hot(idxs[:, 0], n_experts, dtype=jnp.float32)
+    f = jax.lax.psum(onehot1.sum(0), axis_name)
+    p = jax.lax.psum(probs.sum(0), axis_name)
+    total = jnp.float32(t_loc * n)
+    aux = n_experts * jnp.sum((f / total) * (p / total))
+    dispatch, combine = _dispatch_topk(gates, idxs, n_experts, capacity)
     # (T,E,C),(T,D) -> (E,C,D): per-expert token buffers, expert index
     # e = owner_device * e_loc + local_expert
     xin = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
@@ -76,19 +126,42 @@ def _moe_local(x, router_w, expert_params, expert_fn, axis_name,
                               tiled=True)  # dim 0: expert-owner device
     yout = yout.reshape(n_experts, capacity, d)
     out = jnp.einsum("tec,ecd->td", combine, yout)
-    return out.astype(x.dtype)
+    return out.astype(x.dtype), aux
+
+
+def moe_dense_apply(x, router_w, expert_params, expert_fn: Callable,
+                    capacity_factor: float = 2.0, top_k: int = 1):
+    """Single-device MoE — the no-mesh fallback for SwitchFFN, like
+    attention's full-softmax fallback. Same router/combine math as the
+    expert-parallel path; outputs are identical whenever no expert
+    overflows its capacity (the sharded path bounds capacity per source
+    shard, this one globally). Returns (out, aux_loss)."""
+    t, d = x.shape
+    n_experts = jax.tree.leaves(expert_params)[0].shape[0]
+    capacity = max(1, int(capacity_factor * top_k * t / n_experts))
+    probs, gates, idxs = topk_router(x, router_w, top_k)
+    aux = load_balance_loss(probs, idxs[:, 0], n_experts)
+    dispatch, combine = _dispatch_topk(gates, idxs, n_experts, capacity)
+    xin = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    yout = jax.vmap(expert_fn)(expert_params, xin)
+    out = jnp.einsum("tec,ecd->td", combine, yout)
+    return out.astype(x.dtype), aux
 
 
 def moe_apply(x, router_w, expert_params, expert_fn: Callable, mesh: Mesh,
-              axis_name: str = "expert", capacity_factor: float = 2.0):
+              axis_name: str = "expert", capacity_factor: float = 2.0,
+              top_k: int = 1, return_aux: bool = False):
     """Apply an expert-parallel MoE layer to tokens ``x``.
 
     x: (tokens, d_model), sharded over ``axis_name`` (tokens and experts
     share the axis, EP=DP style). expert_params: pytree with leading dim
     n_experts (divisible by the axis size); ``expert_fn(params_e, (t, d))``
-    -> (t, d) is vmapped over local experts. Top-1 routing with a static
+    -> (t, d) is vmapped over local experts. Top-k routing with a static
     per-expert ``capacity`` bound keeps shapes XLA-friendly; overflow
     tokens pass through with weight 0 (standard Switch behavior).
+
+    With ``return_aux`` also returns the Switch load-balancing loss —
+    add it (scaled) to the training objective or experts collapse.
     """
     if axis_name not in mesh.axis_names:
         raise MXNetError(f"mesh has no axis {axis_name!r}")
@@ -108,7 +181,8 @@ def moe_apply(x, router_w, expert_params, expert_fn: Callable, mesh: Mesh,
     fn = jax.shard_map(
         functools.partial(_moe_local, expert_fn=expert_fn,
                           axis_name=axis_name,
-                          capacity_factor=capacity_factor),
+                          capacity_factor=capacity_factor, top_k=top_k),
         mesh=mesh, in_specs=(P(axis_name), P(), e_spec),
-        out_specs=P(axis_name), check_vma=False)
-    return fn(x, router_w, expert_params)
+        out_specs=(P(axis_name), P()), check_vma=False)
+    out, aux = fn(x, router_w, expert_params)
+    return (out, aux) if return_aux else out
