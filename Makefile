@@ -44,4 +44,50 @@ $(LIBDIR)/libmxtpu.so: src/capi/c_api.cc src/capi/c_api.h \
 clean:
 	rm -rf $(LIBDIR)
 
-.PHONY: all clean
+# ---------------------------------------------------------------------------
+# CI matrix (reference analogue: Jenkinsfile:101-230 build/test stages).
+# Each target is one gated stage; ci/pipeline.yml sequences them. Stages
+# run on the virtual 8-device CPU mesh (tests/conftest.py) so the whole
+# matrix is hermetic — no accelerator required.
+# ---------------------------------------------------------------------------
+
+# stage 1: native shared libraries
+ci-native: all
+
+# stage 2: the amalgamation builds and loads standalone
+ci-amalgamation: ci-native
+	python amalgamation/amalgamation.py
+	python -m pytest tests/test_amalgamation.py -x -q
+
+# stage 3: unit suite (excludes the tiers owned by their own stages)
+ci-unit: ci-native
+	python -m pytest tests/ -x -q \
+	    --ignore=tests/test_examples.py \
+	    --ignore=tests/test_distributed.py \
+	    --ignore=tests/test_perl_frontend.py \
+	    --ignore=tests/test_amalgamation.py
+
+# stage 4: every example executes with its asserts
+ci-examples: ci-native
+	python -m pytest tests/test_examples.py -x -q
+
+# stage 5: real 2-process jax.distributed run
+ci-distributed: ci-native
+	python -m pytest tests/test_distributed.py -x -q
+
+# stage 6: foreign frontends over the C ABI (C++ is part of ci-unit via
+# test_c_api_train; perl builds its XS extension and trains)
+ci-frontends: ci-native
+	perl-package/AI-MXNetTPU/build.sh
+	python -m pytest tests/test_perl_frontend.py -x -q
+
+# stage 7: the driver contract (entry compile-check + multichip dryrun)
+ci-dryrun: ci-native
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+ci: ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
+    ci-frontends ci-dryrun
+	@echo "CI matrix green"
+
+.PHONY: all clean ci ci-native ci-amalgamation ci-unit ci-examples \
+        ci-distributed ci-frontends ci-dryrun

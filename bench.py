@@ -7,6 +7,7 @@ BASELINE.md). Batch/iters overridable via BENCH_BATCH / BENCH_ITERS.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 """
+import glob
 import json
 import os
 import time
@@ -14,6 +15,28 @@ import time
 import numpy as np
 
 BASELINE_IPS = 181.53  # ResNet-50 train img/s, P100 (docs/how_to/perf.md)
+
+# Run-to-run variance of this tunnel-attached chip is up to ~1.5x
+# (BENCH_NOTES.md); anything below best/VARIANCE_BAND is a real
+# regression, not noise.
+VARIANCE_BAND = 1.5
+
+
+def best_recorded_ips():
+    """Best images/sec across every recorded bench artifact
+    (BENCH_r*.json written by the round driver)."""
+    best = 0.0
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            rec = rec.get("parsed", rec)  # driver artifacts nest the line
+            if rec.get("metric") == "resnet50_train_throughput":
+                best = max(best, float(rec.get("value", 0.0)))
+        except (OSError, ValueError, AttributeError):
+            continue
+    return best
 
 
 def main():
@@ -59,14 +82,32 @@ def main():
     # TF/s on ANY dense workload), which bounds achievable MFU well below
     # the datasheet number.
     eff_tflops = ips * 3 * 4.1e9 / 1e12
-    print(json.dumps({
+    record = {
         "metric": "resnet50_train_throughput",
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips / BASELINE_IPS, 3),
         "effective_tflops": round(eff_tflops, 1),
         "mfu": round(eff_tflops / 197.0, 3),
-    }))
+    }
+    # regression guard (VERDICT r2 weak #2): only comparable on the
+    # default config — an overridden BENCH_BATCH/BENCH_ITERS smoke run
+    # is a config difference, not a regression
+    default_config = ("BENCH_BATCH" not in os.environ
+                      and "BENCH_ITERS" not in os.environ)
+    best = best_recorded_ips() if default_config else 0.0
+    regressed = False
+    if best:
+        record["vs_best_recorded"] = round(ips / best, 3)
+        # a drop outside the documented variance band is a real
+        # regression, not tunnel noise
+        regressed = bool(ips < best / VARIANCE_BAND)
+        record["regression"] = regressed
+    print(json.dumps(record))
+    if regressed and os.environ.get("BENCH_ENFORCE"):
+        # CI gate mode: fail the job (the round driver parses the JSON
+        # line instead, so enforcement is opt-in)
+        raise SystemExit(2)
 
 
 if __name__ == "__main__":

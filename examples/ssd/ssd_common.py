@@ -1,0 +1,47 @@
+"""Shared SSD building blocks for the examples in this directory.
+
+Reference analogue: example/ssd/symbol/common.py (the reference's shared
+multibox head plumbing). Both `multibox_toy.py` and `train_ssd.py` use
+these, so the anchor-slot layout rule and the masked loss live in one
+place.
+"""
+from mxnet_tpu import nd
+
+
+def flatten_cls_head(out, n_cls):
+    """(B, A*n_cls, H, W) conv output -> (B, n_cls, H*W*A) class logits.
+
+    MultiBoxPrior orders anchors (y, x, a), so predictions must flatten
+    through NHWC for slot k of the logits to describe anchor k.
+    """
+    B = out.shape[0]
+    return out.transpose((0, 2, 3, 1)).reshape(
+        (B, -1, n_cls)).transpose((0, 2, 1))
+
+
+def flatten_loc_head(out):
+    """(B, A*4, H, W) conv output -> (B, H*W*A*4) offsets (same rule)."""
+    return out.transpose((0, 2, 3, 1)).reshape((out.shape[0], -1))
+
+
+def ssd_loss(cls_pred, loc_pred, loc_t, loc_m, cls_t):
+    """Masked per-anchor CE + smooth-L1, each normalized by its own
+    participating-anchor count (the standard SSD objective).
+
+    ``cls_t`` carries ignore_label -1 on anchors outside the 3:1
+    hard-negative mining set; they contribute nothing to either term.
+    NB: normalize by the KEPT count, not a per-image mean over all
+    anchors — the latter silently shrinks the classification gradient
+    by the ignore fraction (~20x here), which is exactly the bug that
+    kept the toy example from converging.
+    """
+    keep = cls_t >= 0
+    logp = nd.log_softmax(cls_pred, axis=1)             # (B, n_cls, N)
+    target = nd.broadcast_maximum(cls_t, nd.zeros((1,)))
+    picked = nd.pick(logp, target, axis=1)              # (B, N)
+    cls_norm = nd.broadcast_maximum(keep.sum(), nd.ones((1,)))
+    cls_loss = -(picked * keep).sum() / cls_norm
+    loc_norm = nd.broadcast_maximum(loc_m.sum(), nd.ones((1,)))
+    loc_loss = ((nd.smooth_l1(loc_pred - loc_t, scalar=1.0)
+                 * loc_m).sum() / loc_norm)
+    return cls_loss + loc_loss

@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""Single-shot detector: the full training system.
+
+Reference analogue: example/ssd (train.py + symbol/symbol_builder.py +
+dataset/iterator.py + evaluate/eval_metric.py — the reference's ~6k-LoC
+flagship detection app). This is the same multi-component pipeline at
+example scale, end to end:
+
+  dataset   — SyntheticDetIter: multi-object scenes (up to 3 objects of
+              3 shape classes per image), padded (B, M, 5) labels, a
+              DataIter like the reference's DetRecordIter;
+  model     — conv backbone + THREE detection scales (8x8 / 4x4 / 2x2),
+              per-scale anchor boxes (MultiBoxPrior) with growing sizes,
+              per-scale cls/loc conv heads, predictions concatenated
+              across scales exactly like symbol_builder.get_symbol_train;
+  targets   — MultiBoxTarget: IoU matching, variance-encoded loc
+              offsets, 3:1 hard-negative mining;
+  loss      — masked softmax CE (cls) + smooth-L1 (loc);
+  inference — MultiBoxDetection: decode + per-class NMS;
+  eval      — VOC-style mAP@0.5 over a held-out set (the reference's
+              MApMetric), asserted as the convergence gate.
+
+Run:  python train_ssd.py            (defaults converge in ~2 min on CPU)
+      python train_ssd.py --epochs 8 --map-gate 0.6
+"""
+import argparse
+import time
+
+import numpy as np
+
+import os
+import sys
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from ssd_common import flatten_cls_head, flatten_loc_head, ssd_loss  # noqa: E402
+
+IMG = 64
+CLASSES = ("box", "ring", "cross")
+MAX_OBJ = 3
+
+
+# ---------------------------------------------------------------------------
+# dataset (reference: example/ssd/dataset + iterator.py)
+# ---------------------------------------------------------------------------
+
+def _draw(img, cls, x0, y0, w):
+    """Rasterize one object: a distinct shape in a distinct color channel
+    per class (box -> R, ring -> G, cross -> B)."""
+    x1, y1 = x0 + w, y0 + w
+    ch = cls
+    if cls == 0:  # filled box
+        img[ch, y0:y1, x0:x1] += 0.9
+    elif cls == 1:  # ring (hollow box)
+        img[ch, y0:y1, x0:x1] += 0.9
+        m = max(2, w // 4)
+        img[ch, y0 + m:y1 - m, x0 + m:x1 - m] -= 0.9
+    else:  # cross
+        t = max(2, w // 4)
+        c = w // 2
+        img[ch, y0 + c - t // 2:y0 + c + (t + 1) // 2, x0:x1] += 0.9
+        img[ch, y0:y1, x0 + c - t // 2:x0 + c + (t + 1) // 2] += 0.9
+
+
+def make_scene(rng):
+    """(image CHW float32, labels (MAX_OBJ, 5) padded with -1)."""
+    img = rng.rand(3, IMG, IMG).astype(np.float32) * 0.15
+    labels = np.full((MAX_OBJ, 5), -1.0, np.float32)
+    n_obj = rng.randint(1, MAX_OBJ + 1)
+    taken = []
+    for k in range(n_obj):
+        for _ in range(8):  # rejection-sample low-overlap placements
+            w = rng.randint(14, 30)
+            x0 = rng.randint(0, IMG - w)
+            y0 = rng.randint(0, IMG - w)
+            ok = all(abs(x0 - tx) + abs(y0 - ty) > (w + tw) // 2
+                     for tx, ty, tw in taken)
+            if ok:
+                break
+        else:
+            continue
+        taken.append((x0, y0, w))
+        cls = rng.randint(0, len(CLASSES))
+        _draw(img, cls, x0, y0, w)
+        labels[k] = [cls, x0 / IMG, y0 / IMG, (x0 + w) / IMG,
+                     (y0 + w) / IMG]
+    np.clip(img, 0.0, 1.0, out=img)
+    return img, labels
+
+
+class SyntheticDetIter(DataIter):
+    """Detection batches: data (B,3,H,W), label (B, MAX_OBJ, 5)."""
+
+    def __init__(self, batch_size, n_batches, seed):
+        super().__init__(batch_size)
+        self._n = n_batches
+        self._seed = seed
+        self._rng = np.random.RandomState(seed)
+        self._i = 0
+        self.provide_data = [DataDesc("data",
+                                      (batch_size, 3, IMG, IMG))]
+        self.provide_label = [DataDesc("label",
+                                       (batch_size, MAX_OBJ, 5))]
+
+    def reset(self):
+        self._rng = np.random.RandomState(self._seed)
+        self._i = 0
+
+    def next(self):
+        if self._i == self._n:
+            raise StopIteration
+        self._i += 1
+        imgs, labs = zip(*(make_scene(self._rng)
+                           for _ in range(self.batch_size)))
+        return DataBatch([nd.array(np.stack(imgs))],
+                         [nd.array(np.stack(labs))], pad=0)
+
+
+# ---------------------------------------------------------------------------
+# model (reference: example/ssd/symbol/symbol_builder.py)
+# ---------------------------------------------------------------------------
+
+SCALE_SIZES = [(0.15, 0.27), (0.35, 0.5), (0.6, 0.8)]
+RATIOS = (1.0, 2.0, 0.5)
+
+
+class SSDNet:
+    """Backbone + multi-scale heads; one forward returns concatenated
+    anchors/class-preds/loc-preds over every scale."""
+
+    def __init__(self):
+        g = mx.gluon.nn
+        self.backbone = g.HybridSequential()
+        with self.backbone.name_scope():
+            for ch in (16, 32):  # 64 -> 16
+                self.backbone.add(g.Conv2D(ch, 3, padding=1,
+                                           activation="relu"))
+                self.backbone.add(g.MaxPool2D(2))
+            self.backbone.add(g.Conv2D(64, 3, padding=1,
+                                       activation="relu"))
+            self.backbone.add(g.MaxPool2D(2))  # -> 8x8
+        self.down = [g.HybridSequential() for _ in range(2)]
+        for blk in self.down:
+            with blk.name_scope():
+                blk.add(g.Conv2D(64, 3, padding=1, activation="relu"))
+                blk.add(g.MaxPool2D(2))  # 8->4->2
+        n_anchors = len(SCALE_SIZES[0]) + len(RATIOS) - 1
+        n_cls = len(CLASSES) + 1
+        self.cls_heads = [g.Conv2D(n_anchors * n_cls, 3, padding=1)
+                          for _ in range(3)]
+        self.loc_heads = [g.Conv2D(n_anchors * 4, 3, padding=1)
+                          for _ in range(3)]
+        self.blocks = ([self.backbone] + self.down + self.cls_heads
+                       + self.loc_heads)
+        for b in self.blocks:
+            b.initialize(init=mx.init.Xavier())
+
+    def params(self):
+        out = {}
+        for b in self.blocks:
+            out.update({p.name: p for p in b.collect_params().values()})
+        return out
+
+    def forward(self, x):
+        B = x.shape[0]
+        n_cls = len(CLASSES) + 1
+        feats = [self.backbone(x)]
+        for blk in self.down:
+            feats.append(blk(feats[-1]))
+        anchors, cls_preds, loc_preds = [], [], []
+        for feat, sizes, cls_h, loc_h in zip(feats, SCALE_SIZES,
+                                             self.cls_heads,
+                                             self.loc_heads):
+            anchors.append(nd.contrib.MultiBoxPrior(
+                feat, sizes=sizes, ratios=RATIOS, clip=True))
+            cls_preds.append(flatten_cls_head(cls_h(feat), n_cls))
+            loc_preds.append(flatten_loc_head(loc_h(feat)))
+        anchor = nd.concat(*anchors, dim=1)
+        cls_pred = nd.concat(*cls_preds, dim=2)
+        loc_pred = nd.concat(*loc_preds, dim=1)
+        return anchor, cls_pred, loc_pred
+
+
+# ---------------------------------------------------------------------------
+# evaluation (reference: example/ssd/evaluate/eval_metric.py MApMetric)
+# ---------------------------------------------------------------------------
+
+def _iou(a, b):
+    ix1 = max(a[0], b[0])
+    iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2])
+    iy2 = min(a[3], b[3])
+    inter = max(ix2 - ix1, 0.0) * max(iy2 - iy1, 0.0)
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def voc_map(all_dets, all_gts, iou_thresh=0.5):
+    """mAP over classes; detections (score-ranked TP/FP sweep, VOC AP)."""
+    aps = []
+    for c in range(len(CLASSES)):
+        records = []  # (score, is_tp)
+        n_gt = 0
+        for dets, gts in zip(all_dets, all_gts):
+            gt_c = [g for g in gts if int(g[0]) == c]
+            n_gt += len(gt_c)
+            used = [False] * len(gt_c)
+            for d in sorted((d for d in dets if int(d[0]) == c),
+                            key=lambda r: -r[1]):
+                best, bi = 0.0, -1
+                for i, g in enumerate(gt_c):
+                    ov = _iou(d[2:6], g[1:5])
+                    if ov > best:
+                        best, bi = ov, i
+                tp = best >= iou_thresh and not used[bi]
+                if tp:
+                    used[bi] = True
+                records.append((d[1], tp))
+        if n_gt == 0:
+            continue
+        records.sort(key=lambda r: -r[0])
+        tps = np.cumsum([r[1] for r in records]) if records else np.array([])
+        if len(tps) == 0:
+            aps.append(0.0)
+            continue
+        recall = tps / n_gt
+        precision = tps / np.arange(1, len(tps) + 1)
+        # VOC 11-point interpolation
+        ap = float(np.mean([precision[recall >= t].max()
+                            if (recall >= t).any() else 0.0
+                            for t in np.linspace(0, 1, 11)]))
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0
+
+
+def evaluate(net, batch_size, n_batches, seed):
+    it = SyntheticDetIter(batch_size, n_batches, seed)
+    all_dets, all_gts = [], []
+    for batch in it:
+        x = batch.data[0]
+        anchor, cls_pred, loc_pred = net.forward(x)
+        cls_prob = nd.softmax(cls_pred, axis=1)
+        det = nd.contrib.MultiBoxDetection(
+            cls_prob, loc_pred, anchor, threshold=0.4,
+            nms_threshold=0.45).asnumpy()
+        labels = batch.label[0].asnumpy()
+        for b in range(det.shape[0]):
+            all_dets.append([d for d in det[b] if d[0] >= 0])
+            all_gts.append([g for g in labels[b] if g[0] >= 0])
+    return voc_map(all_dets, all_gts)
+
+
+# ---------------------------------------------------------------------------
+# training (reference: example/ssd/train/train_net.py)
+# ---------------------------------------------------------------------------
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=9)
+    ap.add_argument("--batches-per-epoch", type=int, default=24)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.4)
+    ap.add_argument("--eval-batches", type=int, default=6)
+    ap.add_argument("--map-gate", type=float, default=0.5)
+    args = ap.parse_args()
+    rng_seed = 0
+
+    net = SSDNet()
+    trainer = mx.gluon.Trainer(net.params(), "sgd",
+                               {"learning_rate": args.lr, "momentum": 0.9})
+
+    for epoch in range(args.epochs):
+        if epoch == args.epochs * 2 // 3:
+            trainer.set_learning_rate(args.lr / 5)  # step decay
+        it = SyntheticDetIter(args.batch_size, args.batches_per_epoch,
+                              seed=rng_seed + epoch)
+        tic = time.time()
+        total = 0.0
+        for nbatch, batch in enumerate(it):
+            x, labels = batch.data[0], batch.label[0]
+            with mx.autograd.record():
+                anchor, cls_pred, loc_pred = net.forward(x)
+                loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+                    anchor, labels, cls_pred,
+                    negative_mining_ratio=3.0)
+                loss = ssd_loss(cls_pred, loc_pred, loc_t, loc_m, cls_t)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.asnumpy().ravel()[0])
+        speed = args.batches_per_epoch * args.batch_size / (time.time()
+                                                            - tic)
+        print(f"epoch {epoch} loss {total / args.batches_per_epoch:.4f} "
+              f"({speed:.1f} samples/s)")
+
+    m = evaluate(net, args.batch_size, args.eval_batches, seed=999)
+    print(f"mAP@0.5 = {m:.3f} over "
+          f"{args.eval_batches * args.batch_size} held-out scenes")
+    assert m >= args.map_gate, f"mAP {m:.3f} below gate {args.map_gate}"
+
+
+if __name__ == "__main__":
+    main()

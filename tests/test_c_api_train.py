@@ -281,6 +281,9 @@ def test_autograd_through_abi(lib):
     np.testing.assert_allclose(_nd_to(lib, hgrad, (3,)), 2 * x)
     det = vp()
     _ck(lib, lib.MXNDArrayDetach(loss, ctypes.byref(det)))
+    # the embedded interpreter shares this process: restore the global
+    # training flag or later BatchNorm tests observe train mode
+    _ck(lib, lib.MXAutogradSetIsTraining(0, ctypes.byref(prev)))
     for hh in (hx, hg, sq, loss, hgrad, det):
         _ck(lib, lib.MXNDArrayFree(hh))
 

@@ -47,6 +47,7 @@ _CASES = [
     ("memcost/inception_memcost.py", ["--batch-size", "1024"]),
     ("fcn-xs/fcn_toy.py", []),
     ("ssd/multibox_toy.py", []),
+    ("ssd/train_ssd.py", ["--map-gate", "0.45"]),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
      ["--seq-len", "512", "--heads", "8", "--head-dim", "16"]),

@@ -108,3 +108,67 @@ def test_zero_composes_with_tensor_parallel():
     for _ in range(25):
         tr.step(f)
     assert loss() < l0 * 0.5
+
+
+def test_zero_comm_pattern_in_compiled_hlo():
+    """VERDICT r2 #7: the trainer's comm claim, verified against the
+    compiled program — with ZeRO sharding the gradient reduction lowers
+    to reduce-scatter feeding the sharded update plus an all-gather of
+    the params; without it, a plain all-reduce and NO reduce-scatter."""
+    import re
+
+    def build(shard):
+        mesh = make_mesh({"data": 8})
+        sym_net = models.get_symbol("mlp", num_classes=8, num_hidden=64)
+        tr = SPMDTrainer(sym_net, optimizer="adam",
+                         optimizer_params=dict(learning_rate=1e-2,
+                                               rescale_grad=1.0 / 16),
+                         mesh=mesh, shard_optimizer_state=shard)
+        tr.bind(data_shapes={"data": (16, 32)},
+                label_shapes={"softmax_label": (16,)})
+        rng = np.random.RandomState(0)
+        tr.step({"data": rng.rand(16, 32).astype(np.float32),
+                 "softmax_label": rng.randint(0, 8, (16,))
+                 .astype(np.float32)})
+        return tr.compiled_step_hlo()
+
+    def counts(hlo):
+        return {kind: len(re.findall(rf"\b{kind}\b", hlo))
+                for kind in ("reduce-scatter", "all-gather", "all-reduce",
+                             "dynamic-slice")}
+
+    zero = counts(build(True))
+    plain = counts(build(False))
+    # ZeRO: the gradient reduction must feed a SHARDED update — either a
+    # native reduce-scatter (TPU) or its decomposition all-reduce +
+    # dynamic-slice (XLA:CPU lowers it that way) — followed by
+    # all-gathers rebuilding each of the 6 params from its slices.
+    assert (zero["reduce-scatter"] > 0
+            or (zero["all-reduce"] > 0 and zero["dynamic-slice"] > 0)), zero
+    assert zero["all-gather"] >= 6, zero
+    # the replicated-state baseline is a plain all-reduce: nothing is
+    # sliced per device and no param needs regathering
+    assert plain["all-reduce"] > 0, plain
+    assert plain["reduce-scatter"] == 0, plain
+    assert plain["all-gather"] == 0, plain
+    assert plain["dynamic-slice"] == 0, plain
+
+
+def test_zero_warns_when_no_dim_shards(caplog):
+    """A data-indivisible param must be REPORTED, not silently kept
+    replicated (VERDICT r2 #7)."""
+    import logging
+
+    mesh = make_mesh({"data": 8})
+    data = mx.sym.var("data")
+    # 5x3 weight: no dim divisible by 8
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="odd")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    tr = SPMDTrainer(net, optimizer="adam",
+                     optimizer_params=dict(learning_rate=1e-2),
+                     mesh=mesh, shard_optimizer_state=True)
+    with caplog.at_level(logging.WARNING):
+        tr.bind(data_shapes={"data": (8, 3)},
+                label_shapes={"softmax_label": (8,)})
+    assert any("REPLICATED optimizer state" in r.getMessage()
+               for r in caplog.records), caplog.records
