@@ -1,0 +1,49 @@
+# %% [markdown]
+# # Train, checkpoint, resume, predict with Module
+# Reference analogue: example/notebooks' predict/finetune walkthroughs.
+
+# %% synthetic classification task
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+rng = np.random.RandomState(0)
+X = rng.randn(256, 16).astype(np.float32)
+y = (X[:, :8].sum(1) > X[:, 8:].sum(1)).astype(np.float32)
+it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                       label_name="softmax_label")
+
+net = mx.sym.SoftmaxOutput(
+    mx.sym.FullyConnected(
+        mx.sym.Activation(
+            mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=32,
+                                  name="fc1"),
+            act_type="relu"),
+        num_hidden=2, name="fc2"),
+    name="softmax")
+
+# %% train a few epochs and checkpoint
+mod = mx.mod.Module(net)
+mod.fit(it, num_epoch=6,
+        optimizer_params={"learning_rate": 0.5, "rescale_grad": 1 / 32})
+prefix = os.path.join(tempfile.mkdtemp(prefix="nbck_"), "mlp")
+mod.save_checkpoint(prefix, 6)
+assert os.path.exists(prefix + "-symbol.json")
+assert os.path.exists(prefix + "-0006.params")
+
+# %% resume from the checkpoint and keep training
+resumed = mx.mod.Module.load(prefix, 6)
+resumed.fit(it, num_epoch=2, begin_epoch=0,
+            optimizer_params={"learning_rate": 0.1,
+                              "rescale_grad": 1 / 32})
+acc = dict(resumed.score(it, "acc"))["accuracy"]
+assert acc > 0.9, acc
+
+# %% predict on fresh data
+fresh = rng.randn(64, 16).astype(np.float32)
+probs = resumed.predict(mx.io.NDArrayIter(fresh, None, batch_size=32))
+assert probs.shape == (64, 2)
+print(f"module_checkpointing notebook: resumed accuracy {acc:.3f}")

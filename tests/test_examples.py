@@ -47,6 +47,12 @@ _CASES = [
     ("memcost/inception_memcost.py", ["--batch-size", "1024"]),
     ("fcn-xs/fcn_toy.py", []),
     ("ssd/multibox_toy.py", []),
+    ("captcha/captcha_ocr.py", []),
+    ("kaggle-ndsb1/train_plankton_style.py", ["--epochs", "8"]),
+    ("rnn-time-major/lstm_time_major.py", ["--epochs", "12"]),
+    ("notebooks/basics.py", []),
+    ("notebooks/composite_symbol.py", []),
+    ("notebooks/module_checkpointing.py", []),
     ("ssd/train_ssd.py", ["--map-gate", "0.45"]),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
