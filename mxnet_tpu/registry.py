@@ -3,7 +3,9 @@
 Factory factories: ``get_register_func`` / ``get_alias_func`` /
 ``get_create_func`` build per-base-class registries with string, dict and
 JSON-config creation — used by optimizer/initializer/metric style
-registries and available for user extension.
+registries and available for user extension. Structure here: one
+``_TypeRegistry`` object per base class holds the table and the spec
+resolution; the three public functions return bound entry points.
 """
 from __future__ import annotations
 
@@ -12,28 +14,76 @@ import warnings
 
 __all__ = ["get_register_func", "get_alias_func", "get_create_func"]
 
-_REGISTRY = {}
+
+class _TypeRegistry:
+    """Name -> class table plus config-spec resolution for one base."""
+
+    _by_base = {}
+
+    def __init__(self, base_class, nickname):
+        self.base = base_class
+        self.nick = nickname
+        self.table = {}
+
+    @classmethod
+    def of(cls, base_class, nickname):
+        reg = cls._by_base.get(base_class)
+        if reg is None:
+            reg = cls._by_base[base_class] = cls(base_class, nickname)
+        reg.nick = nickname
+        return reg
+
+    def add(self, klass, name=None):
+        if not issubclass(klass, self.base):
+            raise TypeError(
+                f"Can only register subclass of {self.base.__name__}")
+        key = (name or klass.__name__).lower()
+        shadowed = self.table.get(key)
+        if shadowed is not None and shadowed is not klass:
+            warnings.warn(
+                f"New {self.nick} {klass.__module__}.{klass.__name__} "
+                f"registered with name {key} is overriding existing "
+                f"{self.nick} {shadowed.__module__}.{shadowed.__name__}",
+                UserWarning, stacklevel=3)
+        self.table[key] = klass
+        return klass
+
+    def resolve(self, spec, *args, **kwargs):
+        """spec may be: an instance (passed through), a config dict, a
+        JSON string ('["name", {...}]' or '{...}'), or a registered
+        name."""
+        if isinstance(spec, self.base):
+            if args or kwargs:
+                raise ValueError(
+                    f"{self.nick} is already an instance. "
+                    "Additional arguments are invalid")
+            return spec
+        if isinstance(spec, dict):
+            conf = dict(spec)  # don't mutate the caller's config
+            return self.resolve(conf.pop(self.nick), **conf)
+        if not isinstance(spec, str):
+            raise TypeError(f"{self.nick} must be of string type")
+        if spec[:1] in ("[", "{"):
+            assert not args and not kwargs
+            decoded = json.loads(spec)
+            if isinstance(decoded, dict):
+                return self.resolve(decoded.pop(self.nick), **decoded)
+            inner_name, inner_kwargs = decoded
+            return self.resolve(inner_name, **inner_kwargs)
+        klass = self.table.get(spec.lower())
+        if klass is None:
+            raise ValueError(
+                f"{spec.lower()} is not registered. Please register "
+                f"with {self.nick}.register first")
+        return klass(*args, **kwargs)
 
 
 def get_register_func(base_class, nickname):
     """Return a ``register(klass, name=None)`` function for ``base_class``."""
-    registry = _REGISTRY.setdefault(base_class, {})
+    reg = _TypeRegistry.of(base_class, nickname)
 
     def register(klass, name=None):
-        if not issubclass(klass, base_class):
-            raise TypeError(
-                f"Can only register subclass of {base_class.__name__}")
-        if name is None:
-            name = klass.__name__.lower()
-        name = name.lower()
-        if name in registry and registry[name] is not klass:
-            warnings.warn(
-                f"New {nickname} {klass.__module__}.{klass.__name__} "
-                f"registered with name {name} is overriding existing "
-                f"{nickname} {registry[name].__module__}."
-                f"{registry[name].__name__}", UserWarning, stacklevel=2)
-        registry[name] = klass
-        return klass
+        return reg.add(klass, name)
 
     register.__doc__ = f"Register {nickname} to the {nickname} factory"
     return register
@@ -41,57 +91,28 @@ def get_register_func(base_class, nickname):
 
 def get_alias_func(base_class, nickname):
     """Return an ``alias(*names)`` class decorator for ``base_class``."""
-    register = get_register_func(base_class, nickname)
+    reg = _TypeRegistry.of(base_class, nickname)
 
     def alias(*aliases):
-        def reg(klass):
+        def decorate(klass):
             for name in aliases:
-                register(klass, name)
+                reg.add(klass, name)
             return klass
-        return reg
+        return decorate
     return alias
 
 
 def get_create_func(base_class, nickname):
     """Return a ``create(name_or_instance, **kwargs)`` factory accepting a
     registered name, an instance, a dict, or a JSON config string."""
-    registry = _REGISTRY.setdefault(base_class, {})
+    reg = _TypeRegistry.of(base_class, nickname)
 
     def create(*args, **kwargs):
-        if len(args):
-            name = args[0]
-            args = args[1:]
+        if args:
+            spec, rest = args[0], args[1:]
         else:
-            name = kwargs.pop(nickname)
-
-        if isinstance(name, base_class):
-            if args or kwargs:
-                raise ValueError(
-                    f"{nickname} is already an instance. "
-                    "Additional arguments are invalid")
-            return name
-
-        if isinstance(name, dict):
-            return create(**name)
-
-        if not isinstance(name, str):
-            raise TypeError(f"{nickname} must be of string type")
-
-        if name.startswith("["):
-            assert not args and not kwargs
-            name, kwargs = json.loads(name)
-            return create(name, **kwargs)
-        if name.startswith("{"):
-            assert not args and not kwargs
-            kwargs = json.loads(name)
-            return create(**kwargs)
-
-        name = name.lower()
-        if name not in registry:
-            raise ValueError(
-                f"{name} is not registered. Please register with "
-                f"{nickname}.register first")
-        return registry[name](*args, **kwargs)
+            spec, rest = kwargs.pop(nickname), ()
+        return reg.resolve(spec, *rest, **kwargs)
 
     create.__doc__ = f"Create a {nickname} instance from config."
     return create

@@ -273,3 +273,29 @@ def test_im2rec_end_to_end(tmp_path):
     batch = it.next()
     assert batch.data[0].shape == (8, 3, 20, 20)
     assert set(batch.label[0].asnumpy()) == {0.0, 1.0}
+
+
+def test_prefetching_iter_multi_epoch_reset():
+    """Epoch boundaries through the prefetcher: every epoch after a
+    reset must replay the FULL source (regression: a fetch-before-
+    reserve producer staged one stale item across reset, making later
+    epochs start empty or deliver an old batch)."""
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    base = mx.io.NDArrayIter(X, y, batch_size=5, label_name="softmax_label")
+    it = mx.io.PrefetchingIter(base)
+    for epoch in range(4):
+        seen = []
+        while it.iter_next():
+            seen.append(it.current_batch.label[0].asnumpy().copy())
+        got = np.concatenate(seen)
+        np.testing.assert_array_equal(np.sort(got), y)
+        it.reset()
+    # mid-epoch reset: consume one batch, reset, and the next epoch is
+    # still complete and fresh
+    assert it.iter_next()
+    it.reset()
+    seen = []
+    while it.iter_next():
+        seen.append(it.current_batch.label[0].asnumpy().copy())
+    np.testing.assert_array_equal(np.sort(np.concatenate(seen)), y)
