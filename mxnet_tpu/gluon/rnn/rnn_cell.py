@@ -88,17 +88,15 @@ class RecurrentCell(HybridBlock):
         assert not self._modified, \
             "After applying modifier cells the base cell cannot be called "\
             "directly. Call the modifier cell instead."
-        states = []
-        for info in self.state_info(batch_size):
+        def make_state(info):
             self._init_counter += 1
-            if info is not None:
-                info.update(kwargs)
-            else:
-                info = kwargs
-            info = {k: v for k, v in info.items() if not k.startswith("__")}
-            states.append(func(name=f"{self._prefix}begin_state_"
-                               f"{self._init_counter}", **info))
-        return states
+            spec = dict(kwargs) if info is None else {**info, **kwargs}
+            spec = {k: v for k, v in spec.items()
+                    if not k.startswith("__")}
+            return func(name=f"{self._prefix}begin_state_"
+                        f"{self._init_counter}", **spec)
+
+        return [make_state(info) for info in self.state_info(batch_size)]
 
     def __call__(self, inputs, states):
         self._counter += 1
@@ -107,18 +105,22 @@ class RecurrentCell(HybridBlock):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis, F, batch_size = _format_sequence(length, inputs,
-                                                       layout, False)
-        begin_state = _get_begin_state(self, F, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
+        seq, axis, F, batch_size = _format_sequence(length, inputs,
+                                                    layout, False)
+        if length is not None and len(seq) != length:
+            if len(seq) < length:
+                raise ValueError(
+                    f"unroll(length={length}) got only {len(seq)} input "
+                    "steps")
+            seq = seq[:length]
+        states = _get_begin_state(self, F, begin_state, seq, batch_size)
         outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
+        for step_input in seq:
+            step_out, states = self(step_input, states)
+            outputs.append(step_out)
         if merge_outputs:
-            outputs = [F.expand_dims(o, axis=axis) for o in outputs]
-            outputs = F.Concat(*outputs, dim=axis)
+            outputs = F.Concat(*[F.expand_dims(o, axis=axis)
+                                 for o in outputs], dim=axis)
         return outputs, states
 
     def _get_activation(self, F, inputs, activation, **kwargs):
@@ -130,9 +132,26 @@ class RecurrentCell(HybridBlock):
         return super().forward(inputs, states)
 
 
+
 class HybridRecurrentCell(RecurrentCell):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
+
+    def _declare_gate_params(self, hidden_size, input_size, n_gates,
+                             i2h_weight_initializer,
+                             h2h_weight_initializer,
+                             i2h_bias_initializer, h2h_bias_initializer):
+        """Declare the i2h/h2h weight+bias quartet every gated cell
+        carries; ``n_gates`` stacks the per-gate blocks row-wise
+        (1 = Elman, 3 = GRU, 4 = LSTM — the fused-kernel layout)."""
+        rows = n_gates * hidden_size
+        for name, shape, init in (
+                ("i2h_weight", (rows, input_size), i2h_weight_initializer),
+                ("h2h_weight", (rows, hidden_size), h2h_weight_initializer),
+                ("i2h_bias", (rows,), i2h_bias_initializer),
+                ("h2h_bias", (rows,), h2h_bias_initializer)):
+            setattr(self, name, self.params.get(
+                name, shape=shape, init=init, allow_deferred_init=True))
 
 
 class RNNCell(HybridRecurrentCell):
@@ -146,18 +165,11 @@ class RNNCell(HybridRecurrentCell):
         self._hidden_size = hidden_size
         self._activation = activation
         self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._declare_gate_params(hidden_size, input_size, 1,
+                                  i2h_weight_initializer,
+                                  h2h_weight_initializer,
+                                  i2h_bias_initializer,
+                                  h2h_bias_initializer)
 
     def state_info(self, batch_size=0):
         return [{"shape": (batch_size, self._hidden_size),
@@ -186,18 +198,11 @@ class LSTMCell(HybridRecurrentCell):
         super().__init__(prefix=prefix, params=params)
         self._hidden_size = hidden_size
         self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(4 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(4 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(4 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(4 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._declare_gate_params(hidden_size, input_size, 4,
+                                  i2h_weight_initializer,
+                                  h2h_weight_initializer,
+                                  i2h_bias_initializer,
+                                  h2h_bias_initializer)
 
     def state_info(self, batch_size=0):
         return [{"shape": (batch_size, self._hidden_size),
@@ -235,18 +240,11 @@ class GRUCell(HybridRecurrentCell):
         super().__init__(prefix=prefix, params=params)
         self._hidden_size = hidden_size
         self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(3 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(3 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(3 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(3 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._declare_gate_params(hidden_size, input_size, 3,
+                                  i2h_weight_initializer,
+                                  h2h_weight_initializer,
+                                  i2h_bias_initializer,
+                                  h2h_bias_initializer)
 
     def state_info(self, batch_size=0):
         return [{"shape": (batch_size, self._hidden_size),
@@ -287,35 +285,37 @@ class SequentialRNNCell(RecurrentCell):
         assert not self._modified
         return _cells_begin_state(self._children, **kwargs)
 
+    def _per_cell_states(self, states):
+        """Carve the flat state list into per-child slices."""
+        cursor = 0
+        for cell in self._children:
+            width = len(cell.state_info())
+            yield cell, (None if states is None
+                         else states[cursor:cursor + width])
+            cursor += width
+
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
-        for cell in self._children:
+        carried = []
+        for cell, state in self._per_cell_states(states):
             assert not isinstance(cell, BidirectionalCell)
-            n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
             inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+            carried.extend(state)
+        return inputs, carried
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        num_cells = len(self._children)
-        p = 0
-        next_states = []
-        for i, cell in enumerate(self._children):
-            n = len(cell.state_info())
-            cell_begin = None if begin_state is None \
-                else begin_state[p:p + n]
-            p += n
+        last = len(self._children) - 1
+        carried = []
+        for k, (cell, cell_begin) in enumerate(
+                self._per_cell_states(begin_state)):
             inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=cell_begin, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            next_states.extend(states)
-        return inputs, next_states
+                length, inputs=inputs, begin_state=cell_begin,
+                layout=layout,
+                merge_outputs=merge_outputs if k == last else None)
+            carried.extend(states)
+        return inputs, carried
 
     def __getitem__(self, i):
         return self._children[i]
