@@ -133,7 +133,7 @@ def main():
     mod = mx.mod.Module(build_symbol())
     mod.fit(train_it, eval_data=val_it, num_epoch=args.epochs,
             optimizer="adam",
-            optimizer_params={"learning_rate": 2e-3,
+            optimizer_params={"learning_rate": 1e-3,
                               "rescale_grad": 1.0 / args.batch_size},
             batch_end_callback=mx.callback.Speedometer(args.batch_size,
                                                        10))

@@ -53,6 +53,10 @@ def main():
         net.add(SDBlock(32, p))
     net.add(nn.Dense(4))
     net.initialize(mx.init.Xavier())
+    # materialize deferred params before training: the inference path
+    # runs EVERY block, so no parameter is left uninitialized when its
+    # block happens to be dropped on the first training batches
+    net(mx.nd.array(x[:2]))
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 5e-3})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()

@@ -11,6 +11,7 @@ import re
 
 import numpy as _np
 
+from .random import host_rng as _host_rng
 from .base import Registry
 from .ndarray import NDArray, array as nd_array
 
@@ -126,7 +127,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, desc, arr):
-        arr[:] = nd_array(_np.random.uniform(-self.scale, self.scale,
+        arr[:] = nd_array(_host_rng().uniform(-self.scale, self.scale,
                                              arr.shape).astype("float32"))
 
 
@@ -137,7 +138,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, desc, arr):
-        arr[:] = nd_array(_np.random.normal(0, self.sigma,
+        arr[:] = nd_array(_host_rng().normal(0, self.sigma,
                                             arr.shape).astype("float32"))
 
 
@@ -156,7 +157,7 @@ class Xavier(Initializer):
     def _init_weight(self, desc, arr):
         shape = arr.shape
         if len(shape) < 2:
-            arr[:] = nd_array(_np.random.uniform(-0.07, 0.07, shape).astype("float32"))
+            arr[:] = nd_array(_host_rng().uniform(-0.07, 0.07, shape).astype("float32"))
             return
         layout = ""
         if isinstance(desc, InitDesc):
@@ -178,9 +179,9 @@ class Xavier(Initializer):
             factor = fan_out
         scale = _np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            w = _np.random.uniform(-scale, scale, shape)
+            w = _host_rng().uniform(-scale, scale, shape)
         else:
-            w = _np.random.normal(0, scale, shape)
+            w = _host_rng().normal(0, scale, shape)
         arr[:] = nd_array(w.astype("float32"))
 
 
@@ -203,9 +204,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(_np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _host_rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _host_rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = _np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = nd_array((self.scale * q.reshape(arr.shape)).astype("float32"))

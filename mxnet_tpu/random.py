@@ -11,8 +11,9 @@ from __future__ import annotations
 import threading
 
 import jax
+import numpy as _np
 
-__all__ = ["seed", "next_key", "current_key", "swap_key"]
+__all__ = ["seed", "next_key", "current_key", "swap_key", "host_rng"]
 
 _state = threading.local()
 
@@ -32,8 +33,27 @@ def _get():
 
 
 def seed(seed_state: int):
-    """Seed the global imperative PRNG (reference: mx.random.seed)."""
+    """Seed this package's PRNGs (reference: mx.random.seed).
+
+    Covers both the device key chain and the package-owned host
+    generator the initializer zoo draws from (reference initializers
+    draw from mxnet's own RNG, which mx.random.seed covers — same
+    "seed once, init deterministically" contract). Numpy's global
+    stream is deliberately NOT touched: user-owned numpy seeding stays
+    user-owned."""
     _state.key = _make_key(int(seed_state))
+    _state.host_rng = _np.random.RandomState(int(seed_state) % (2 ** 32))
+
+
+def host_rng():
+    """The package-owned numpy RandomState for host-side randomness
+    (initializers and other non-traced draws). Deterministic after
+    :func:`seed`; OS-entropy seeded otherwise — never numpy's global
+    stream, so library calls cannot clobber user streams (and, like the
+    key chain, it is per-thread)."""
+    if not hasattr(_state, "host_rng"):
+        _state.host_rng = _np.random.RandomState()
+    return _state.host_rng
 
 
 def next_key():

@@ -105,7 +105,7 @@ def test_spmd_trainer_matches_single_device():
             s, optimizer="sgd",
             optimizer_params=dict(learning_rate=0.1, rescale_grad=1.0 / 16),
             mesh=mesh)
-        np.random.seed(42)  # identical init across the two runs
+        mx.random.seed(42)  # identical init across the two runs
         tr.bind(data_shapes={"data": (16, 784)},
                 label_shapes={"softmax_label": (16,)},
                 initializer=mx.init.Xavier(rnd_type="gaussian"))

@@ -27,7 +27,7 @@ def _make(shard, opt="sgd", opt_params=None, mesh_axes=None):
         optimizer_params=opt_params or dict(learning_rate=0.5, momentum=0.9,
                                             rescale_grad=1.0 / 32),
         mesh=mesh, shard_optimizer_state=shard)
-    np.random.seed(42)  # identical init across compared runs
+    mx.random.seed(42)  # identical init across compared runs
     tr.bind(data_shapes={"data": (32, 784)},
             label_shapes={"softmax_label": (32,)},
             initializer=mx.init.Xavier(rnd_type="gaussian"))
