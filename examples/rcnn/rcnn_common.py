@@ -1,0 +1,216 @@
+"""Faster-RCNN training components: target assignment + box math.
+
+Reference analogue: example/rcnn/rcnn/io/rpn.py (assign_anchor),
+rcnn/io/rcnn.py (sample_rois), rcnn/symbol/proposal_target.py,
+rcnn/processing/bbox_transform.py + nms.py. The reference runs these
+on the host in numpy (as CustomOps / loader threads) and feeds the
+results to the device graph — the same split is the TPU-idiomatic one:
+ragged, data-dependent target assignment stays on the host producing
+fixed-shape arrays; every dense FLOP runs on the chip.
+
+All box coordinates are pixel x1,y1,x2,y2 with the RCNN +1 pixel-extent
+convention, matching the repo's Proposal op decode
+(mxnet_tpu/ops/contrib_ops.py `_proposal`).
+"""
+import numpy as np
+
+BBOX_STDS = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+
+
+def make_anchor_grid(feat_h, feat_w, stride, scales, ratios):
+    """Anchor array in (y, x, a) order — the Proposal op's layout.
+
+    The base windows come from the op's own generator so host target
+    assignment and device proposal decoding can never desynchronize.
+    """
+    from mxnet_tpu.ops.contrib_ops import _base_anchors
+    base = np.asarray(_base_anchors(stride, scales, ratios),
+                      np.float32)  # (A, 4)
+    ys, xs = np.mgrid[0:feat_h, 0:feat_w].astype(np.float32) * stride
+    shift = np.stack([xs, ys, xs, ys], -1)  # (h, w, 4)
+    return (base[None, None] + shift[:, :, None]).reshape(-1, 4)
+
+
+def iou_matrix(a, b):
+    """Pairwise IoU, a (N,4) vs b (G,4), +1 extents."""
+    if len(b) == 0:
+        return np.zeros((len(a), 0), np.float32)
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = (np.maximum(ix2 - ix1 + 1, 0) * np.maximum(iy2 - iy1 + 1, 0))
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    return inter / (area_a[:, None] + area_b[None] - inter)
+
+
+def encode_boxes(ref, gt):
+    """Deltas that morph ref boxes into gt boxes (Proposal-op inverse)."""
+    rw = ref[:, 2] - ref[:, 0] + 1.0
+    rh = ref[:, 3] - ref[:, 1] + 1.0
+    rcx = ref[:, 0] + 0.5 * (rw - 1)
+    rcy = ref[:, 1] + 0.5 * (rh - 1)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1)
+    gcy = gt[:, 1] + 0.5 * (gh - 1)
+    return np.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,
+                     np.log(gw / rw), np.log(gh / rh)], -1)
+
+
+def decode_boxes(ref, deltas, im_size):
+    """Apply deltas to ref boxes; clip to the image."""
+    rw = ref[:, 2] - ref[:, 0] + 1.0
+    rh = ref[:, 3] - ref[:, 1] + 1.0
+    rcx = ref[:, 0] + 0.5 * (rw - 1)
+    rcy = ref[:, 1] + 0.5 * (rh - 1)
+    cx = deltas[:, 0] * rw + rcx
+    cy = deltas[:, 1] * rh + rcy
+    w = np.exp(deltas[:, 2]) * rw
+    h = np.exp(deltas[:, 3]) * rh
+    out = np.stack([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                    cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)], -1)
+    return np.clip(out, 0, im_size - 1)
+
+
+def assign_anchor_targets(anchors, gt, im_size, rpn_batch=64,
+                          fg_fraction=0.5, fg_thresh=0.6, bg_thresh=0.3,
+                          rng=None):
+    """RPN training targets for one image.
+
+    Returns labels (N,) in {-1 ignore, 0 bg, 1 fg}, deltas (N,4),
+    weights (N,1). Every gt claims its best anchor even below
+    fg_thresh, so no object goes untrained.
+    """
+    rng = rng or np.random
+    n = len(anchors)
+    labels = np.full(n, -1.0, np.float32)
+    deltas = np.zeros((n, 4), np.float32)
+    weights = np.zeros((n, 1), np.float32)
+    inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0)
+              & (anchors[:, 2] < im_size) & (anchors[:, 3] < im_size))
+    if len(gt) == 0:
+        bg = np.flatnonzero(inside)
+        take = rng.choice(bg, min(rpn_batch, len(bg)), replace=False)
+        labels[take] = 0.0
+        return labels, deltas, weights
+    iou = iou_matrix(anchors, gt[:, 1:5])
+    iou[~inside] = -1.0
+    best_gt = iou.argmax(1)
+    best_iou = iou[np.arange(n), best_gt]
+    labels[inside & (best_iou < bg_thresh)] = 0.0
+    labels[best_iou >= fg_thresh] = 1.0
+    labels[iou.argmax(0)] = 1.0  # each gt's best anchor is always fg
+
+    fg = np.flatnonzero(labels == 1)
+    max_fg = int(rpn_batch * fg_fraction)
+    if len(fg) > max_fg:
+        labels[rng.choice(fg, len(fg) - max_fg, replace=False)] = -1.0
+        fg = np.flatnonzero(labels == 1)
+    bg = np.flatnonzero(labels == 0)
+    max_bg = rpn_batch - len(fg)
+    if len(bg) > max_bg:
+        labels[rng.choice(bg, len(bg) - max_bg, replace=False)] = -1.0
+
+    fg = np.flatnonzero(labels == 1)
+    deltas[fg] = encode_boxes(anchors[fg], gt[best_gt[fg], 1:5])
+    weights[fg] = 1.0
+    return labels, deltas, weights
+
+
+def sample_roi_targets(rois, gt, num_classes, rois_per_image=16,
+                       fg_fraction=0.5, fg_thresh=0.5, rng=None):
+    """Sample a fixed-size roi batch for the RCNN head, one image.
+
+    rois (P,4) proposals (gt boxes get appended), gt (G,5) [cls,box].
+    Returns rois (R,4), labels (R,) in [0..num_classes] (0=bg),
+    per-class deltas (R, 4*(C+1)) std-normalized, weights same shape.
+    """
+    rng = rng or np.random
+    nc1 = num_classes + 1
+    if len(gt):
+        rois = np.concatenate([rois, gt[:, 1:5]], 0)
+    iou = iou_matrix(rois, gt[:, 1:5] if len(gt) else gt[:, :4])
+    best = iou.max(1) if iou.shape[1] else np.zeros(len(rois), np.float32)
+    best_gt = iou.argmax(1) if iou.shape[1] else np.zeros(len(rois), int)
+
+    fg = np.flatnonzero(best >= fg_thresh)
+    bg = np.flatnonzero(best < fg_thresh)
+    n_fg = min(int(rois_per_image * fg_fraction), len(fg))
+    if len(fg):
+        fg = rng.choice(fg, n_fg, replace=len(fg) < n_fg)
+    n_bg = rois_per_image - len(fg)
+    if len(bg):
+        bg = rng.choice(bg, n_bg, replace=len(bg) < n_bg)
+    else:  # degenerate: every roi is fg-quality; refill from the
+        # lowest-IoU rois so no near-gt box gets labeled background
+        bg = np.argsort(best)[:max(n_bg, 1)]
+        bg = rng.choice(bg, n_bg, replace=len(bg) < n_bg)
+    keep = np.concatenate([fg, bg]).astype(int)
+
+    out_rois = rois[keep].astype(np.float32)
+    labels = np.zeros(rois_per_image, np.float32)
+    deltas = np.zeros((rois_per_image, 4 * nc1), np.float32)
+    weights = np.zeros((rois_per_image, 4 * nc1), np.float32)
+    for i in range(len(fg)):
+        g = gt[best_gt[keep[i]]]
+        cls = int(g[0]) + 1
+        labels[i] = cls
+        d = encode_boxes(out_rois[i:i + 1], g[None, 1:5])[0] / BBOX_STDS
+        deltas[i, 4 * cls:4 * cls + 4] = d
+        weights[i, 4 * cls:4 * cls + 4] = 1.0
+    return out_rois, labels, deltas, weights
+
+
+def nms(boxes, scores, thresh):
+    """Greedy NMS; returns kept indices, score-descending."""
+    order = np.argsort(-scores)
+    keep = []
+    while len(order):
+        i = order[0]
+        keep.append(i)
+        if len(order) == 1:
+            break
+        rest = order[1:]
+        iou = iou_matrix(boxes[i:i + 1], boxes[rest])[0]
+        order = rest[iou <= thresh]
+    return np.asarray(keep, int)
+
+
+def voc_map(all_dets, all_gts, num_classes, iou_thresh=0.5):
+    """VOC 11-point mAP. all_dets[i] rows [cls, score, x1,y1,x2,y2];
+    all_gts[i] rows [cls, x1,y1,x2,y2] (pixel coords)."""
+    aps = []
+    for c in range(num_classes):
+        records, n_gt = [], 0
+        for dets, gts in zip(all_dets, all_gts):
+            gt_c = np.asarray([g[1:5] for g in gts if int(g[0]) == c],
+                              np.float32)
+            n_gt += len(gt_c)
+            used = np.zeros(len(gt_c), bool)
+            det_c = sorted((d for d in dets if int(d[0]) == c),
+                           key=lambda r: -r[1])
+            for d in det_c:
+                if len(gt_c) == 0:
+                    records.append((d[1], False))
+                    continue
+                iou = iou_matrix(np.asarray(d[2:6], np.float32)[None],
+                                 gt_c)[0]
+                bi = int(iou.argmax())
+                tp = iou[bi] >= iou_thresh and not used[bi]
+                used[bi] |= tp
+                records.append((d[1], tp))
+        if n_gt == 0:
+            continue
+        records.sort(key=lambda r: -r[0])
+        if not records:
+            aps.append(0.0)
+            continue
+        tp = np.cumsum([r[1] for r in records])
+        recall = tp / n_gt
+        precision = tp / np.arange(1, len(tp) + 1)
+        aps.append(float(np.mean([
+            precision[recall >= t].max() if (recall >= t).any() else 0.0
+            for t in np.linspace(0, 1, 11)])))
+    return float(np.mean(aps)) if aps else 0.0

@@ -54,6 +54,7 @@ _CASES = [
     ("notebooks/composite_symbol.py", []),
     ("notebooks/module_checkpointing.py", []),
     ("ssd/train_ssd.py", ["--map-gate", "0.45"]),
+    ("rcnn/train_rcnn.py", ["--map-gate", "0.45"]),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
      ["--seq-len", "512", "--heads", "8", "--head-dim", "16"]),
