@@ -135,18 +135,23 @@ def sample_roi_targets(rois, gt, num_classes, rois_per_image=16,
     best = iou.max(1) if iou.shape[1] else np.zeros(len(rois), np.float32)
     best_gt = iou.argmax(1) if iou.shape[1] else np.zeros(len(rois), int)
 
-    fg = np.flatnonzero(best >= fg_thresh)
-    bg = np.flatnonzero(best < fg_thresh)
-    n_fg = min(int(rois_per_image * fg_fraction), len(fg))
-    if len(fg):
-        fg = rng.choice(fg, n_fg, replace=len(fg) < n_fg)
-    n_bg = rois_per_image - len(fg)
-    if len(bg):
-        bg = rng.choice(bg, n_bg, replace=len(bg) < n_bg)
-    else:  # degenerate: every roi is fg-quality; refill from the
-        # lowest-IoU rois so no near-gt box gets labeled background
-        bg = np.argsort(best)[:max(n_bg, 1)]
-        bg = rng.choice(bg, n_bg, replace=len(bg) < n_bg)
+    fg_all = np.flatnonzero(best >= fg_thresh)
+    bg_all = np.flatnonzero(best < fg_thresh)
+    if len(bg_all) == 0 and len(fg_all):
+        # degenerate: every roi is fg-quality (late training: all
+        # proposals + appended gts overlap objects). Relax the fg cap
+        # and fill the whole batch with fg samples carrying their TRUE
+        # labels — labeling near-gt boxes as background would feed the
+        # head contradictory targets for identical boxes.
+        fg = rng.choice(fg_all, rois_per_image,
+                        replace=len(fg_all) < rois_per_image)
+        bg = np.empty((0,), int)
+    else:
+        n_fg = min(int(rois_per_image * fg_fraction), len(fg_all))
+        fg = (rng.choice(fg_all, n_fg, replace=False) if len(fg_all)
+              else fg_all)
+        n_bg = rois_per_image - len(fg)
+        bg = rng.choice(bg_all, n_bg, replace=len(bg_all) < n_bg)
     keep = np.concatenate([fg, bg]).astype(int)
 
     out_rois = rois[keep].astype(np.float32)

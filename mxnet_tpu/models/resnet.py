@@ -103,12 +103,23 @@ def residual_unit_v1(data, num_filter, stride, dim_match, name, bottle_neck,
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape="224,224,3",
-               version=2, layout="NHWC", dtype="float32", **kwargs):
+               version=2, layout="NHWC", dtype="float32", pipe_stages=0,
+               **kwargs):
     """Build a ResNet (reference: resnet.py:95-185 get_symbol).
 
     image_shape is H,W,C regardless of layout (the data symbol is laid out
     per ``layout``).
+
+    ``pipe_stages=N`` annotates the graph for pipeline parallelism: the
+    stem becomes ``ctx_group='prologue'``, the residual units are spread
+    contiguously over ``stage0..stage{N-1}`` (balanced by unit count),
+    and the head becomes ``ctx_group='epilogue'`` — ready for
+    :func:`..parallel.pipeline.pipeline_from_symbol`, which routes
+    BN-carrying ragged stages to the heterogeneous 1F1B machinery.
     """
+    from ..symbol.symbol import AttrScope
+    import contextlib
+
     if num_layers not in _UNITS:
         raise MXNetError(f"no unit config for resnet-{num_layers}")
     bottle_neck, units = _UNITS[num_layers]
@@ -119,35 +130,55 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="224,224,3",
     height = image_shape[0]
     unit = residual_unit_v2 if version == 2 else residual_unit_v1
 
-    data = sym.Variable(name="data")
-    if dtype in ("float16", "bfloat16"):
-        data = sym.Cast(data=data, dtype=dtype)
-    if height <= 32:  # cifar-style stem (resnet.py:116-120)
-        body = _conv(data, filter_list[0], (3, 3), (1, 1), (1, 1),
-                     "conv0", layout)
-    else:  # imagenet stem (resnet.py:121-127)
-        body = _conv(data, filter_list[0], (7, 7), (2, 2), (3, 3),
-                     "conv0", layout)
-        body = _bn(body, "bn0", layout)
-        body = sym.Activation(data=body, act_type="relu", name="relu0")
-        body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
-                           pad=(1, 1), pool_type="max", layout=layout)
+    total_units = sum(units)
+    if pipe_stages and pipe_stages > total_units:
+        raise MXNetError(f"pipe_stages {pipe_stages} exceeds the "
+                         f"{total_units} residual units of "
+                         f"resnet-{num_layers}")
 
+    def scope(label):
+        return (AttrScope(ctx_group=label) if pipe_stages
+                else contextlib.nullcontext())
+
+    with scope("prologue"):
+        data = sym.Variable(name="data")
+        if dtype in ("float16", "bfloat16"):
+            data = sym.Cast(data=data, dtype=dtype)
+        if height <= 32:  # cifar-style stem (resnet.py:116-120)
+            body = _conv(data, filter_list[0], (3, 3), (1, 1), (1, 1),
+                         "conv0", layout)
+        else:  # imagenet stem (resnet.py:121-127)
+            body = _conv(data, filter_list[0], (7, 7), (2, 2), (3, 3),
+                         "conv0", layout)
+            body = _bn(body, "bn0", layout)
+            body = sym.Activation(data=body, act_type="relu", name="relu0")
+            body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                               pad=(1, 1), pool_type="max", layout=layout)
+
+    u_idx = 0
     for i, n in enumerate(units):
-        stride = (1, 1) if i == 0 else (2, 2)
-        body = unit(body, filter_list[i + 1], stride, False,
-                    f"stage{i + 1}_unit1", bottle_neck, layout)
-        for j in range(n - 1):
-            body = unit(body, filter_list[i + 1], (1, 1), True,
-                        f"stage{i + 1}_unit{j + 2}", bottle_neck, layout)
+        for j in range(n):
+            label = (f"stage{u_idx * pipe_stages // total_units}"
+                     if pipe_stages else None)
+            with scope(label):
+                stride = (1, 1) if (i == 0 or j > 0) else (2, 2)
+                body = unit(body, filter_list[i + 1], stride, j > 0,
+                            f"stage{i + 1}_unit{j + 1}", bottle_neck,
+                            layout)
+            u_idx += 1
 
-    if version == 2:  # final bn-relu (resnet.py:172-173)
-        body = _bn(body, "bn1", layout)
-        body = sym.Activation(data=body, act_type="relu", name="relu1")
-    pool = sym.Pooling(data=body, global_pool=True, kernel=(7, 7),
-                       pool_type="avg", name="pool1", layout=layout)
-    flat = sym.Flatten(data=pool)
-    fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
-    if dtype in ("float16", "bfloat16"):
-        fc1 = sym.Cast(data=fc1, dtype="float32")
-    return sym.SoftmaxOutput(data=fc1, name="softmax")
+    if version == 2:  # final bn-relu (resnet.py:172-173) — staged with
+        # the last pipe stage: the epilogue runs replicated and cannot
+        # carry BatchNorm aux state
+        with scope(f"stage{pipe_stages - 1}" if pipe_stages else None):
+            body = _bn(body, "bn1", layout)
+            body = sym.Activation(data=body, act_type="relu", name="relu1")
+    with scope("epilogue"):
+        pool = sym.Pooling(data=body, global_pool=True, kernel=(7, 7),
+                           pool_type="avg", name="pool1", layout=layout)
+        flat = sym.Flatten(data=pool)
+        fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes,
+                                 name="fc1")
+        if dtype in ("float16", "bfloat16"):
+            fc1 = sym.Cast(data=fc1, dtype="float32")
+        return sym.SoftmaxOutput(data=fc1, name="softmax")

@@ -311,26 +311,13 @@ def pipeline_value_and_grad(fn: Callable, loss_fn: Callable, stacked_params,
 
 
 def _run_nodes(nodes_list, values, name_to_val, is_train):
-    """Evaluate a node list given seeded entry values and named params."""
-    for m in nodes_list:
-        ins = []
-        for parent, i in m.inputs:
-            key = (id(parent), i)
-            if key in values:
-                ins.append(values[key])
-            else:
-                ins.append(name_to_val[parent.name])
-        call_attrs = dict(m.attrs)
-        if m.op.needs_is_train:
-            call_attrs["_is_train"] = is_train
-        if m.op.key_var_num_args and not call_attrs.get(
-                m.op.key_var_num_args):
-            call_attrs[m.op.key_var_num_args] = len(ins)
-        out = m.op.fn(*ins, **call_attrs)
-        if not isinstance(out, tuple):
-            out = (out,)
-        for i, o in enumerate(out):
-            values[(id(m), i)] = o
+    """Evaluate a node list given seeded entry values and named params.
+
+    Thin wrapper over the shared section evaluator in
+    :mod:`.pipeline_hetero` — this path never sees rng nodes (graphs
+    containing them delegate before reaching it), so no key is needed."""
+    from .pipeline_hetero import _run
+    _run(nodes_list, values, name_to_val, is_train, None, {})
     return values
 
 
@@ -348,11 +335,15 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
     * ``ctx_group='prologue'`` (or any unlabeled nodes with no staged
       ancestor) — embedding/input stem, computed outside the pipeline
       loop and trained through the pipeline's input cotangent;
-    * ``ctx_group='stage0'..'stage{n-1}'`` — the pipelined body; stages
-      must be isomorphic (one program runs on every pipe device — the
-      natural shape of a repeated-block transformer), connected by
-      exactly one same-shaped activation, no rng ops, no aux states,
-      no cross-stage weight sharing;
+    * ``ctx_group='stage0'..'stage{n-1}'`` — the pipelined body,
+      connected by exactly one activation per boundary and no
+      cross-stage weight sharing. Isomorphic stages (one program on
+      every pipe device — the natural shape of a repeated-block
+      transformer) take the fast stacked-parameter path below; stages
+      that are ragged, carry aux states (BatchNorm moving stats), or
+      contain rng ops (Dropout) automatically delegate to
+      :func:`.pipeline_hetero.hetero_pipeline_from_symbol`, whose
+      ``train_step`` additionally returns aux updates;
     * ``ctx_group='epilogue'`` — head + output op, evaluated on the
       last stage (its loss feeds the 1F1B backward schedule).
 
@@ -360,7 +351,7 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
     GPipe schedule) with two attributes:
 
     * ``apply.train_step(arg_dict, x, labels, n_microbatches=...) ->
-      (loss, grads_dict)`` — the 1F1B schedule
+      (loss, grads_dict, aux_updates)`` — the 1F1B schedule
       (:func:`pipeline_value_and_grad`): backward starts while the fill
       is still running, activation memory is a ring of ``2n`` stage
       inputs per device regardless of microbatch count. Requires the
@@ -368,156 +359,36 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
     * ``apply.stage_param_names`` — per-stage parameter name lists.
     """
     from ..base import MXNetError as _Err
+    from .pipeline_hetero import (hetero_pipeline_from_symbol, _partition,
+                                  _softmax_ce)
 
     n = mesh.shape.get(axis_name)
     if not n:
         raise _Err(f"mesh has no axis {axis_name!r}")
 
     nodes = symbol._topo_nodes()
-    if symbol._aux_node_ids():
-        raise _Err("pipeline_from_symbol: auxiliary states (BatchNorm "
-                   "moving stats) are not supported inside pipeline stages")
-    out_entries = list(symbol._outputs)
-    if len(out_entries) != 1:
-        raise _Err("pipeline symbol must have exactly one output")
+    if symbol._aux_node_ids() or any(
+            not m.is_variable and m.op.needs_rng for m in nodes):
+        # aux states (BatchNorm moving stats) and rng ops (Dropout) need
+        # the aux-threading / key-replay machinery — in ANY section: the
+        # strict evaluator never passes rng keys, so even an unstaged
+        # random op must take the hetero path
+        return hetero_pipeline_from_symbol(
+            symbol, mesh, axis_name=axis_name,
+            n_microbatches=n_microbatches, data_name=data_name)
 
-    PRO, EPI = "prologue", "epilogue"
-
-    # -- role assignment: explicit ctx_group, else inherit/prologue ------
-    role_of = {}
-    for node in nodes:
-        if node.is_variable:
-            continue
-        grp = node.scope_attrs.get("ctx_group")
-        role = None
-        if grp in (PRO, EPI):
-            role = grp
-        elif grp is not None:
-            if not grp.startswith("stage"):
-                raise _Err(f"ctx_group {grp!r} is not a pipeline label "
-                           "(want 'prologue', 'epilogue' or 'stage<k>')")
-            try:
-                role = int(grp[len("stage"):])
-            except ValueError:
-                raise _Err(f"ctx_group {grp!r} is not a pipeline stage "
-                           "label (want 'stage<k>' with integer k)")
-        else:
-            parent_roles = [role_of[id(p)] for p, _ in node.inputs
-                            if id(p) in role_of]
-            if any(r == EPI for r in parent_roles):
-                role = EPI
-            else:
-                staged = [r for r in parent_roles if isinstance(r, int)]
-                role = max(staged) if staged else PRO
-        if role is None:
-            role = PRO
-        role_of[id(node)] = role
-        if node.op.needs_rng and isinstance(role, int):
-            raise _Err(f"pipeline stages cannot contain rng op "
-                       f"{node.op.name} ({node.name})")
-
-    prologue = [m for m in nodes
-                if not m.is_variable and role_of[id(m)] == PRO]
-    epilogue = [m for m in nodes
-                if not m.is_variable and role_of[id(m)] == EPI]
-    stages = [[] for _ in range(n)]
-    seen_max = -1
-    for node in nodes:
-        if node.is_variable or not isinstance(role_of[id(node)], int):
-            continue
-        st = role_of[id(node)]
-        if not 0 <= st < n:
-            raise _Err(f"stage{st} out of range for pipe axis size {n}")
-        if st < seen_max:
-            raise _Err("stage labels must be topologically non-decreasing")
-        seen_max = max(seen_max, st)
-        stages[st].append(node)
-    if any(not s for s in stages):
-        raise _Err(f"need exactly {n} populated stages "
-                   f"(pipe axis size), got {sum(1 for s in stages if s)}")
-    # the output must leave from the epilogue (or last stage if none)
+    # shared partitioning — pipeline_hetero owns the role-assignment and
+    # boundary rules; the aux name lists are empty here (aux delegated)
+    part = _partition(symbol, n, data_name)
+    prologue, epilogue = part["prologue"], part["epilogue"]
+    stages, stage_ios = part["stages"], part["stage_ios"]
+    pro_vars = part["pro_vars"]
+    epi_vars = list(part["epi_vars"])
+    data_key, pro_out = part["data_key"], part["pro_out"]
+    out_entries = part["out_entries"]
     out_node = out_entries[0][0]
-    if epilogue and role_of.get(id(out_node)) != EPI:
-        raise _Err("the symbol output must come from the epilogue")
 
-    # -- per-role io ------------------------------------------------------
-    var_role = {}  # variable id -> role that consumes it
-
-    def section_io(sec_nodes, role):
-        """(entry keys consumed from outside, own variable names)."""
-        produced = {(id(m), i) for m in sec_nodes
-                    for i in range(m.num_outputs())}
-        entries, var_names = [], []
-        for m in sec_nodes:
-            for parent, i in m.inputs:
-                key = (id(parent), i)
-                if key in produced:
-                    continue
-                if parent.is_variable and parent.name != data_name:
-                    prev = var_role.setdefault(id(parent), role)
-                    if prev != role:
-                        raise _Err(
-                            f"variable {parent.name} is shared between "
-                            f"{prev} and {role} — unsupported in the SPMD "
-                            "pipeline (make per-section copies)")
-                    if parent.name not in var_names:
-                        var_names.append(parent.name)
-                else:
-                    if key not in entries:
-                        entries.append(key)
-        return entries, var_names
-
-    pro_entries, pro_vars = section_io(prologue, PRO)
-    if prologue:
-        if len(pro_entries) != 1:
-            raise _Err("prologue must consume exactly the data input")
-        data_key = pro_entries[0]
-        pro_out_candidates = set()
-        for m in stages[0]:
-            for parent, i in m.inputs:
-                if role_of.get(id(parent)) == PRO:
-                    pro_out_candidates.add((id(parent), i))
-        if len(pro_out_candidates) != 1:
-            raise _Err("prologue -> stage0 boundary must be exactly one "
-                       f"tensor, got {len(pro_out_candidates)}")
-        pro_out = pro_out_candidates.pop()
-    else:
-        data_key = None
-        pro_out = None
-
-    stage_ios = []
-    for si, sec in enumerate(stages):
-        entries, var_names = section_io(sec, si)
-        if len(entries) != 1:
-            raise _Err(f"stage{si} must consume exactly one cross-stage "
-                       f"tensor, got {len(entries)}")
-        act_in = entries[0]
-        if si == 0 and prologue and act_in != pro_out:
-            raise _Err("stage0 must consume the prologue output")
-        # activation leaving this stage
-        if si < n - 1:
-            downstream = stages[si + 1]
-        else:
-            downstream = epilogue
-        produced = {(id(m), i) for m in sec for i in range(m.num_outputs())}
-        if downstream:
-            outs = set()
-            down_prod = {(id(m), i) for m in downstream
-                         for i in range(m.num_outputs())}
-            for m in downstream:
-                for parent, i in m.inputs:
-                    key = (id(parent), i)
-                    if key in produced and key not in down_prod:
-                        outs.add(key)
-            if len(outs) != 1:
-                raise _Err(f"stage{si} boundary must be exactly one "
-                           f"tensor, got {len(outs)}")
-            act_out = outs.pop()
-        else:
-            act_out = (id(out_entries[0][0]), out_entries[0][1])
-        stage_ios.append((act_in, act_out, var_names))
-
-    # -- isomorphism check ------------------------------------------------
+    # -- isomorphism check: ragged stages take the flat-buffer path ------
     def signature(sec):
         return [(m.op.name,
                  tuple(sorted((k, str(v)) for k, v in m.attrs.items())))
@@ -525,18 +396,15 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
 
     sig0 = signature(stages[0])
     for si in range(1, n):
-        if signature(stages[si]) != sig0:
-            raise _Err(
-                f"stage{si} is not isomorphic to stage0 (op/attr sequence "
-                "differs); the SPMD pipeline runs one program on all "
-                "stages — put distinct input/output layers in "
-                "ctx_group='prologue'/'epilogue'")
-        if len(stage_ios[si][2]) != len(stage_ios[0][2]):
-            raise _Err(f"stage{si} has {len(stage_ios[si][2])} parameters,"
-                       f" stage0 has {len(stage_ios[0][2])}")
+        if (signature(stages[si]) != sig0
+                or len(stage_ios[si][2]) != len(stage_ios[0][2])):
+            return hetero_pipeline_from_symbol(
+                symbol, mesh, axis_name=axis_name,
+                n_microbatches=n_microbatches, data_name=data_name,
+                _part=part)
 
     st0_nodes = stages[0]
-    act_in0, act_out0, var_order0 = stage_ios[0]
+    act_in0, act_out0, var_order0, _ = stage_ios[0]
     per_stage_vars = [io[2] for io in stage_ios]
 
     # -- section functions ------------------------------------------------
@@ -557,17 +425,6 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
         return values[pro_out]
 
     epi_entry = stage_ios[-1][1] if epilogue else None
-    if epilogue:
-        epi_entries, epi_vars = section_io(epilogue, EPI)
-        # the epilogue may consume ONLY the last stage's activation —
-        # a skip connection from an earlier section would otherwise
-        # surface as an opaque KeyError mid-trace
-        if epi_entries != [epi_entry]:
-            raise _Err(
-                "epilogue must consume exactly the last stage's output; "
-                f"it consumes {len(epi_entries)} cross-section tensors")
-    else:
-        epi_vars = []
 
     # training loss: epilogue terminating in SoftmaxOutput -> CE on its
     # logits (the op's implicit loss, like the executor path)
@@ -612,25 +469,7 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
         logits = values.get(logits_key)
         if logits is None:  # logits come straight from the pipeline body
             logits = h
-        # honor the op's declared CE semantics (use_ignore/ignore_label,
-        # grad_scale, smooth_alpha) the way the executor path does
-        # (ops/nn_ops.py SoftmaxOutput)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ids = y_mb.astype(jnp.int32)
-        smooth = float(sm_attrs.get("smooth_alpha", 0.0) or 0.0)
-        picked = jnp.take_along_axis(logp, jnp.maximum(ids, 0)[..., None],
-                                     axis=-1)[..., 0]
-        if smooth:
-            picked = ((1.0 - smooth) * picked
-                      + smooth * logp.mean(axis=-1))
-        if sm_attrs.get("use_ignore"):
-            keep = (ids != int(sm_attrs.get("ignore_label", -1))) \
-                .astype(picked.dtype)
-            denom = jnp.maximum(keep.sum(), 1.0)
-            loss = -(picked * keep).sum() / denom
-        else:
-            loss = -jnp.mean(picked)
-        return loss * float(sm_attrs.get("grad_scale", 1.0) or 1.0)
+        return _softmax_ce(logits, y_mb, sm_attrs)
 
     # -- public entry points ----------------------------------------------
     def _gather(arg_dict, names, what):
@@ -659,8 +498,11 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
 
     def train_step(arg_dict, x, labels, n_microbatches=n_microbatches,
                    mb_spec=None, label_spec=None):
-        """1F1B step -> (loss, grads keyed by variable name).
+        """1F1B step -> (loss, grads keyed by variable name, aux_updates).
 
+        ``aux_updates`` is always empty on this path (graphs with aux
+        states delegate to the heterogeneous pipeline, whose train_step
+        returns the same 3-tuple with the written-back values).
         ``mb_spec``/``label_spec``: optional PartitionSpec entries for
         the per-microbatch dims, composing pp with dp/sp sharding
         (see :func:`pipeline_value_and_grad`)."""
@@ -680,7 +522,7 @@ def pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
                 grads[name] = jax.tree.leaves(g_stacked)[j][si]
         grads.update(zip(epi_vars, g_epi))
         grads.update(zip(pro_vars, g_pro))
-        return loss, grads
+        return loss, grads, {}
 
     apply.train_step = train_step
     apply.stage_param_names = per_stage_vars

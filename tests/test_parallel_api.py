@@ -159,18 +159,41 @@ def test_pipeline_from_symbol_matches_executor():
     assert float(l1) < float(l0) * 0.5
 
 
-def test_pipeline_from_symbol_rejects_bad_graphs():
+def test_pipeline_from_symbol_ragged_delegates_to_hetero():
+    """Non-isomorphic stages used to be rejected; they now route to the
+    heterogeneous flat-buffer pipeline and produce executor-exact
+    forwards."""
     d = 16
     mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
-    # non-isomorphic stages (different hidden sizes)
     data = mx.sym.var("data")
     h = data
     for i, hid in enumerate([d, d, 2 * d, d]):
         with mx.AttrScope(ctx_group=f"stage{i}"):
             h = mx.sym.FullyConnected(h, name=f"fc{i}", num_hidden=hid,
                                       flatten=False)
-    with pytest.raises(mx.MXNetError):
-        pipeline_from_symbol(h, mesh)
+    apply_fn = pipeline_from_symbol(h, mesh, n_microbatches=4)
+    assert hasattr(apply_fn, "reference_step")  # hetero path marker
+    rng = np.random.RandomState(3)
+    args = {}
+    pv = d
+    for i, hid in enumerate([d, d, 2 * d, d]):
+        args[f"fc{i}_weight"] = jnp.asarray(
+            rng.normal(0, .4, (hid, pv)).astype(np.float32))
+        args[f"fc{i}_bias"] = jnp.asarray(
+            rng.normal(0, .1, (hid,)).astype(np.float32))
+        pv = hid
+    x = jnp.asarray(rng.normal(0, 1, (8, d)).astype(np.float32))
+    out_pipe = np.asarray(apply_fn(args, x))
+    ex = h.simple_bind(mx.cpu(), data=(8, d), grad_req="null")
+    for name, v in args.items():
+        ex.arg_dict[name][:] = mx.nd.array(np.asarray(v))
+    ref = ex.forward(is_train=False, data=np.asarray(x))[0].asnumpy()
+    np.testing.assert_allclose(out_pipe, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_from_symbol_rejects_bad_graphs():
+    d = 16
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
     # missing stage annotations entirely
     plain = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=d,
                                   name="fc", flatten=False)
@@ -277,7 +300,7 @@ def test_pipeline_heterogeneous_model_1f1b_trains():
             logp, yv.astype(jnp.int32)[..., None], -1))
 
     step = jax.jit(pipe.train_step)
-    loss0, grads = step(args, x, y)
+    loss0, grads, _ = step(args, x, y)
     ref_loss, ref_g = jax.value_and_grad(direct_loss)(args, x, y)
     np.testing.assert_allclose(float(loss0), float(ref_loss), rtol=1e-5)
     for name in args:
@@ -288,9 +311,9 @@ def test_pipeline_heterogeneous_model_1f1b_trains():
     # 1F1B training converges (memorize the toy token stream)
     lr = 1.0
     for _ in range(250):
-        loss, grads = step(args, x, y)
+        loss, grads, _ = step(args, x, y)
         args = {k: v - lr * grads[k] for k, v in args.items()}
-    final, _ = step(args, x, y)
+    final, _, _ = step(args, x, y)
     assert float(final) < float(loss0) * 0.5, (float(loss0), float(final))
 
     # inference path (prologue -> GPipe -> epilogue) agrees with the
