@@ -59,13 +59,26 @@ class NDArray {
   }
 
   void SyncCopyFromCPU(const float *data, size_t size) {
+    RequireF32("SyncCopyFromCPU");
     TCheck(MXNDArraySyncCopyFromCPU(handle(), data, size));
   }
 
   std::vector<float> SyncCopyToCPU() const {
+    RequireF32("SyncCopyToCPU");
     std::vector<float> out(Size());
     TCheck(MXNDArraySyncCopyToCPU(handle(), out.data(), out.size()));
     return out;
+  }
+
+  /* the raw boundary is dtype-native since round 4; these float
+   * convenience wrappers guard against silently mis-sized buffers */
+  void RequireF32(const char *who) const {
+    int dt = 0;
+    TCheck(MXNDArrayGetDType(handle(), &dt));
+    if (dt != 0)
+      throw std::runtime_error(std::string(who) +
+                  ": array dtype is not float32 — use the raw "
+                  "MXNDArraySyncCopy* ABI with dtype-sized buffers");
   }
 
   std::vector<mx_uint> Shape() const {

@@ -10,8 +10,10 @@ float32 buffers / strings / shape vectors at the boundary.
 
 Design: same embedding pattern as the predict ABI (src/capi/
 c_predict_api.cc) — one function here per C entry point group, shaped
-so the C side stays thin. dtype at the C boundary is float32
-(mx_float), matching the reference's predict/cpp-package practice.
+so the C side stays thin. Since round 4 the data boundary is
+dtype-native (raw bytes of the array's dtype, the reference's
+contract), with dtype code 7 = bfloat16 extending the mshadow enum so
+foreign frontends can train on the MXU-native dtype.
 """
 from __future__ import annotations
 
@@ -771,3 +773,571 @@ def kv_pull_row_sparse(kv, keys: List[str], outs: List[NDArray],
                        row_id_arrays: List[NDArray], priority: int) -> None:
     for k, out, rid in zip(keys, outs, row_id_arrays):
         kv.row_sparse_pull(k, out=out, priority=priority, row_ids=rid)
+
+
+# =========================================================================
+# Round-4 surface: the last third of the reference name set — dtype
+# through the boundary (bf16 training from C), SimpleBind, the legacy
+# Function group, profiler, Symbol file IO / queries, RTC, custom ops
+# via C callbacks, monitor/updater callbacks, PS env.
+# Reference: c_api.h:207-230 (profiler), :286-298 (CreateEx), :446-520
+# (Function group), :972-1105 (Symbol IO/partial), :1149 (SimpleBind),
+# :1236 (monitor), :1697 (CustomOp).
+# =========================================================================
+
+import ctypes as _ct
+import os as _os
+
+# TPU extension to the mshadow dtype enum: bfloat16 = 7 (codes 0-6 are
+# the reference's; bf16 is the MXU-native training dtype so foreign
+# frontends need it at the boundary)
+_DTYPE_TO_CODE["bfloat16"] = 7
+_CODE_TO_DTYPE[7] = "bfloat16"
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def nd_dtype_size(arr: NDArray) -> int:
+    """Element size in bytes (the C side scales buffer lengths by it)."""
+    return int(_np_dtype(str(arr.dtype) if not isinstance(arr.dtype, str)
+                         else arr.dtype).itemsize)
+
+
+def nd_create_ex(shape: Sequence[int], dev_type: int, dev_id: int,
+                 dtype_code: int) -> NDArray:
+    """MXNDArrayCreateEx: dtype carried through the boundary."""
+    return nd.zeros(tuple(int(s) for s in shape),
+                    ctx=_ctx(dev_type, dev_id),
+                    dtype=_CODE_TO_DTYPE[int(dtype_code)])
+
+
+def nd_create_none() -> NDArray:
+    """MXNDArrayCreateNone: placeholder handle (0-d empty)."""
+    return nd.zeros((), dtype="float32")
+
+
+def nd_copy_from_ex(arr: NDArray, buf) -> None:
+    """Dtype-honoring MXNDArraySyncCopyFromCPU: ``buf`` holds raw bytes
+    of the array's own dtype (f32 arrays keep the old ABI behavior)."""
+    dt = _np_dtype(str(np.dtype(arr.dtype)) if not isinstance(arr.dtype, str)
+                   else arr.dtype)
+    host = np.frombuffer(buf, dt).reshape(arr.shape)
+    arr[:] = np.array(host)
+
+
+def nd_copy_to_ex(arr: NDArray) -> bytes:
+    """Dtype-honoring MXNDArraySyncCopyToCPU: bytes in the array's own
+    dtype (bf16 arrays produce 2-byte elements)."""
+    a = arr.asnumpy()
+    return np.ascontiguousarray(a).tobytes()
+
+
+def nd_aux_type(arr: NDArray, i: int) -> int:
+    aux = nd_aux_component(arr, int(i))
+    return _DTYPE_TO_CODE[str(np.dtype(aux.dtype))]
+
+
+def nd_grad_state(arr: NDArray) -> int:
+    """MXNDArrayGetGradState: the 'fresh gradient' flag the reference
+    keeps per-array (ndarray.h entry state)."""
+    return int(getattr(arr, "_fresh_grad", 0))
+
+
+def nd_set_grad_state(arr: NDArray, state: int) -> None:
+    arr._fresh_grad = int(state)
+
+
+# -- legacy Function group (reference c_api.h:446-520) ---------------------
+# FunctionHandle == the op registry entry; invoke writes results into the
+# caller's mutate_vars, the old pre-imperative-invoke convention.
+
+def func_describe(op_name: str):
+    """-> (num_use_vars, num_scalars, num_mutate_vars, type_mask)."""
+    entry = OP_TABLE.get(op_name)
+    if entry is None:
+        raise MXNetError(f"unknown function {op_name!r}")
+    n_in = entry.num_inputs if isinstance(entry.num_inputs, int) else 1
+    try:
+        n_out = entry.num_outputs({})
+    except Exception:
+        n_out = 1
+    return n_in, 0, n_out, 1  # kNDArrayArgBeforeScalar
+
+
+def func_invoke(op_name: str, used: List[NDArray], scalars: List[float],
+                mutated: List[NDArray], keys: List[str],
+                vals: List[str]) -> None:
+    """MXFuncInvoke(Ex): run the op on used_vars, store into
+    mutate_vars (value assignment, preserving the caller's handles)."""
+    outs = imperative_invoke(op_name, used, keys, vals)
+    if len(outs) != len(mutated):
+        raise MXNetError(
+            f"{op_name}: {len(outs)} outputs for {len(mutated)} "
+            "mutate_vars")
+    for dst, src in zip(mutated, outs):
+        nd_assign(dst, src)
+
+
+# -- Symbol file IO + query tails ------------------------------------------
+
+def sym_from_file(path: str):
+    with open(path, "r") as f:
+        return _sym_mod.load_json(f.read())
+
+
+def sym_save_file(sym, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(sym.tojson())
+
+
+def sym_get_children(sym):
+    """MXSymbolGetChildren: the direct inputs of the output node(s) as a
+    grouped symbol (reference c_api_symbolic.cc sym->GetChildren)."""
+    from .symbol.symbol import Symbol
+    children = []
+    seen = set()
+    for node, _ in sym._outputs:
+        if node.is_variable:
+            continue
+        for parent, idx in node.inputs:
+            key = (id(parent), idx)
+            if key in seen:
+                continue
+            seen.add(key)
+            children.append(Symbol([(parent, idx)]))
+    return _sym_mod.Group(children)
+
+
+def sym_list_attr_full(sym) -> List[str]:
+    """MXSymbolListAttr: recursive attr walk, flattened
+    [name$key, val, ...] (the reference qualifies keys with the node
+    name)."""
+    out = []
+    for node in sym._topo_nodes():
+        merged = dict(node.scope_attrs)
+        merged.update({k: str(v) for k, v in (node.attrs or {}).items()
+                       if isinstance(v, (str, int, float, bool))})
+        for k, v in sorted(merged.items()):
+            out.extend([f"{node.name}${k}", str(v)])
+    return out
+
+
+def sym_print(sym) -> str:
+    return sym.debug_str() if hasattr(sym, "debug_str") else str(sym)
+
+
+def sym_infer_shape_partial(sym, names: List[str],
+                            shapes: List[Sequence[int]]):
+    """MXSymbolInferShapePartial: best-effort inference — unknown shapes
+    come back empty instead of raising (reference c_api.h:1105)."""
+    known = {n: tuple(int(x) for x in s) for n, s in zip(names, shapes)}
+    try:
+        arg, out, aux = sym.infer_shape_partial(**known)
+    except AttributeError:
+        try:
+            arg, out, aux = sym.infer_shape(**known)
+        except MXNetError:
+            n_arg = len(sym.list_arguments())
+            n_aux = len(sym.list_auxiliary_states())
+            n_out = len(sym.list_outputs())
+            return ([()] * n_arg, [()] * n_out, [()] * n_aux)
+    def fix(ss):
+        # unknown dims/shapes -> 0 entries / empty tuples (the
+        # reference's 0-for-unknown convention)
+        out_list = []
+        for shp in ss:
+            if not shp:
+                out_list.append(())
+            else:
+                out_list.append(tuple(int(x) if x else 0 for x in shp))
+        return out_list
+    return fix(arg), fix(out), fix(aux)
+
+
+def autograd_get_symbol(arr: NDArray):
+    """MXAutogradGetSymbol: reconstruct a Symbol from the autograd tape
+    behind ``arr`` (reference c_api.h:757). Leaf arrays become variables
+    named var<k> in first-visit order."""
+    node = getattr(arr, "_ag_node", None)
+    if node is None:
+        raise MXNetError("array is not the output of a recorded graph")
+    memo = {}
+    var_count = [0]
+
+    def to_sym(nd_arr):
+        ag = getattr(nd_arr, "_ag_node", None)
+        if ag is None:
+            key = id(nd_arr)
+            if key not in memo:
+                memo[key] = _sym_mod.Variable(f"var{var_count[0]}")
+                var_count[0] += 1
+            return memo[key]
+        ag_node = ag
+        out_idx = int(getattr(nd_arr, "_ag_out_index", 0) or 0)
+        key = id(ag_node)
+        if key not in memo:
+            op_name = ag_node.opdef.name
+            fn = getattr(_sym_mod, op_name, None)
+            if fn is None:
+                raise MXNetError(
+                    f"op {op_name} has no symbol counterpart")
+            ins = [to_sym(i) for i in ag_node.inputs]
+            attrs = {k: v for k, v in (ag_node.attrs or {}).items()
+                     if not k.startswith("_")}
+            memo[key] = fn(*ins, **attrs)
+        s = memo[key]
+        return s[out_idx] if ag_node.n_outputs > 1 else s
+    return to_sym(arr)
+
+
+# -- Executor tails --------------------------------------------------------
+
+def executor_backward_ex(ex, head_grads: List[NDArray],
+                         is_train: int) -> None:
+    # the executor's vjp always recomputes in train mode (matching
+    # MXExecutorBackward); is_train=0 is accepted for ABI parity
+    ex.backward(out_grads=list(head_grads) if head_grads else None)
+
+
+def executor_simple_bind(sym, dev_type: int, dev_id: int,
+                         shape_names: List[str],
+                         shapes: List[Sequence[int]],
+                         dtype_names: List[str], dtype_codes: List[int],
+                         grad_req_names: List[str],
+                         grad_req_types: List[str]):
+    """MXExecutorSimpleBind: infer + allocate everything from provided
+    shapes (reference c_api.h:1149 — the bind entry every frontend
+    actually calls). grad reqs arrive as strings like the reference
+    ("null"/"write"/"add"); a single unnamed entry sets the default.
+    -> (executor, arg_names, args, grads_or_None, aux_names, auxs)."""
+    kwargs = {n: tuple(int(x) for x in s)
+              for n, s in zip(shape_names, shapes)}
+    type_attrs = {n: _CODE_TO_DTYPE[int(c)]
+                  for n, c in zip(dtype_names, dtype_codes)}
+    grad_req = "write"
+    named = {n: t for n, t in zip(grad_req_names, grad_req_types) if n}
+    unnamed = [t for n, t in zip(grad_req_names, grad_req_types) if not n]
+    if named:
+        grad_req = named
+    elif unnamed:
+        grad_req = unnamed[0]
+    ex = sym.simple_bind(_ctx(dev_type, dev_id), grad_req=grad_req,
+                         type_dict=type_attrs or None, **kwargs)
+    arg_names = list(sym.list_arguments())
+    aux_names = list(sym.list_auxiliary_states())
+    args = [ex.arg_dict[n] for n in arg_names]
+    grads = [ex.grad_dict.get(n) for n in arg_names]
+    auxs = [ex.aux_dict[n] for n in aux_names]
+    return ex, arg_names, args, grads, aux_names, auxs
+
+
+def executor_internal_outputs(ex):
+    """(names, arrays) of every op output after the last forward — the
+    MXExecutorSetMonitorCallback feed (the repo Monitor's mechanism)."""
+    internals = ex.internal_outputs()
+    names = list(internals)
+    return names, [internals[n] for n in names]
+
+
+# -- KVStore tails ---------------------------------------------------------
+
+def kv_role() -> str:
+    return _os.environ.get("DMLC_ROLE", "worker")
+
+
+def kv_run_server(kv) -> None:
+    """MXKVStoreRunServer: blocking server loop. The XLA-collective
+    design has no separate server processes (SURVEY §2.5 — dist_sync
+    runs reduce-scatter/all-gather over ICI/DCN); for non-worker roles
+    this parks the process like the reference's server loop."""
+    from .kvstore_server import KVStoreServer
+    KVStoreServer(kv).run()
+
+
+def kv_send_command(kv, head: int, body: str) -> None:
+    """MXKVStoreSendCommmandToServers: optimizer/state commands. The
+    collective design has no servers; commands that matter
+    (set_optimizer) have first-class entry points, the rest are
+    accepted and recorded."""
+    if hasattr(kv, "send_command_to_servers"):
+        kv.send_command_to_servers(head, body)
+
+
+def _abi_lib():
+    """Handle to libmxtpu.so for resolving its exported helpers. When
+    the embedding host loaded it RTLD_GLOBAL (perl/C++ frontends),
+    CDLL(None) finds the symbols; otherwise re-dlopen the library file
+    (same handle, refcounted)."""
+    try:
+        lib = _ct.CDLL(None)
+        lib.MXTPUWrapNDArrayForCallback
+        return lib
+    except (AttributeError, OSError):
+        pass
+    path = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                         "_lib", "libmxtpu.so")
+    return _ct.CDLL(path)
+
+
+def kv_set_updater(kv, fn_addr: int, user_addr: int) -> None:
+    """MXKVStoreSetUpdater: install a C updater callback
+    void (*)(int key, NDArrayHandle recv, NDArrayHandle local, void*).
+    Handles are minted through the embedding library's exported
+    MXTPUWrapNDArrayForCallback so the C callback sees real ABI handles
+    it can pass to any MXNDArray* function (ownership stays here; the
+    wrapper handles are freed after the callback returns)."""
+    lib = _abi_lib()
+    wrap = lib.MXTPUWrapNDArrayForCallback
+    wrap.restype = _ct.c_void_p
+    wrap.argtypes = [_ct.py_object]
+    free = lib.MXNDArrayFree
+    free.argtypes = [_ct.c_void_p]
+    cb = _ct.CFUNCTYPE(None, _ct.c_int, _ct.c_void_p, _ct.c_void_p,
+                       _ct.c_void_p)(fn_addr)
+
+    def updater(key, recv, local):
+        # the kvstore passes _str_to_int(key): ints stay ints, non-
+        # numeric names stay strings -> map those through a stable crc
+        try:
+            ikey = int(key)
+        except (TypeError, ValueError):
+            import zlib
+            ikey = zlib.crc32(str(key).encode()) & 0x7fffffff
+        hr = wrap(recv)
+        hl = wrap(local)
+        try:
+            cb(ikey, hr, hl, user_addr or None)
+        finally:
+            free(hr)
+            free(hl)
+
+    kv.set_updater(updater)
+
+
+def init_ps_env(keys: List[str], vals: List[str]) -> None:
+    for k, v in zip(keys, vals):
+        _os.environ[str(k)] = str(v)
+
+
+# -- profiler / misc -------------------------------------------------------
+
+def profiler_set_config(mode: int, filename: str) -> None:
+    """mode: reference mode2int — 0 = symbolic only, 1 = all."""
+    from . import profiler
+    profiler.profiler_set_config("all" if mode else "symbolic", filename)
+
+
+def profiler_set_state(state: int) -> None:
+    from . import profiler
+    profiler.profiler_set_state("run" if state else "stop")
+
+
+def profiler_dump(finished: int) -> None:
+    from . import profiler
+    profiler.dump_profile()
+
+
+def set_num_omp_threads(n: int) -> None:
+    _os.environ["OMP_NUM_THREADS"] = str(int(n))
+
+
+def notify_shutdown() -> None:
+    nd.waitall()
+
+
+# -- RTC (reference c_api.h:1657-1692; Pallas playing NVRTC's role) --------
+
+def rtc_create(name: str, in_names: List[str], out_names: List[str],
+               in_arrays: List[NDArray], out_arrays: List[NDArray],
+               kernel: str):
+    from .rtc import Rtc
+    return Rtc(name, list(zip(in_names, in_arrays)),
+               list(zip(out_names, out_arrays)), kernel)
+
+
+def rtc_push(rtc, ins: List[NDArray], outs: List[NDArray],
+             gridx: int, gridy: int, gridz: int,
+             blockx: int, blocky: int, blockz: int) -> None:
+    rtc.push(list(ins), list(outs), (gridx, gridy, gridz),
+             (blockx, blocky, blockz))
+
+
+# -- custom ops from C callbacks (reference c_api.h:1697) ------------------
+# Own callback protocol (the reference's MXCallbackList dance is CUDA-
+# pointer-shaped); the semantics match: a C caller registers shape
+# inference + forward (+ optional backward) and the op becomes available
+# to every surface (imperative, Symbol, Executor, CachedOp). The host
+# callbacks run under XLA via jax.pure_callback; backward is wired with
+# jax.custom_vjp so the op trains.
+
+_MAX_CUSTOM_NDIM = 8
+
+_INFER_T = _ct.CFUNCTYPE(_ct.c_int, _ct.c_void_p, _ct.c_int,
+                         _ct.POINTER(_ct.c_int), _ct.POINTER(_ct.c_uint),
+                         _ct.POINTER(_ct.c_int), _ct.POINTER(_ct.c_uint))
+_FWD_T = _ct.CFUNCTYPE(_ct.c_int, _ct.c_void_p, _ct.c_int,
+                       _ct.POINTER(_ct.POINTER(_ct.c_float)),
+                       _ct.POINTER(_ct.c_int), _ct.c_int,
+                       _ct.POINTER(_ct.POINTER(_ct.c_float)),
+                       _ct.POINTER(_ct.c_int))
+_BWD_T = _ct.CFUNCTYPE(_ct.c_int, _ct.c_void_p, _ct.c_int,
+                       _ct.POINTER(_ct.POINTER(_ct.c_float)),
+                       _ct.POINTER(_ct.POINTER(_ct.c_float)),
+                       _ct.POINTER(_ct.POINTER(_ct.c_float)),
+                       _ct.POINTER(_ct.c_int), _ct.POINTER(_ct.c_int))
+
+
+def _as_float_ptrs(arrays):
+    bufs = [np.ascontiguousarray(a, np.float32) for a in arrays]
+    ptrs = (_ct.POINTER(_ct.c_float) * len(bufs))(
+        *[b.ctypes.data_as(_ct.POINTER(_ct.c_float)) for b in bufs])
+    sizes = (_ct.c_int * len(bufs))(*[b.size for b in bufs])
+    return bufs, ptrs, sizes
+
+
+def custom_op_register(op_type: str, num_inputs: int, num_outputs: int,
+                       infer_addr: int, fwd_addr: int, bwd_addr: int,
+                       user_addr: int) -> None:
+    """Register a C-callback op (MXCustomOpRegister). The host callbacks
+    run under XLA via jax.pure_callback; note the axon TUNNEL backend
+    does not support host callbacks (real TPU hosts and CPU do), so
+    custom ops require JAX_PLATFORMS=cpu under the tunnel."""
+    import jax
+    import jax.numpy as jnp
+    from .ops.registry import register
+    from .base import AttrSpec
+
+    infer_cb = _INFER_T(infer_addr)
+    fwd_cb = _FWD_T(fwd_addr)
+    bwd_cb = _BWD_T(bwd_addr) if bwd_addr else None
+    user = user_addr or None
+
+    def infer_out_shapes(in_shapes):
+        n = len(in_shapes)
+        in_ndims = (_ct.c_int * n)(*[len(s) for s in in_shapes])
+        flat = [d for s in in_shapes for d in s]
+        in_flat = (_ct.c_uint * max(len(flat), 1))(*flat)
+        out_ndims = (_ct.c_int * num_outputs)()
+        out_flat = (_ct.c_uint * (num_outputs * _MAX_CUSTOM_NDIM))()
+        rc = infer_cb(user, n, in_ndims, in_flat, out_ndims, out_flat)
+        if rc != 0:
+            raise MXNetError(f"{op_type}: infer_shape callback failed "
+                             f"({rc})")
+        shapes, k = [], 0
+        for i in range(num_outputs):
+            nd_i = out_ndims[i]
+            shapes.append(tuple(int(out_flat[k + j]) for j in range(nd_i)))
+            k += _MAX_CUSTOM_NDIM
+        return shapes
+
+    def host_forward(*ins):
+        in_bufs, in_ptrs, in_sizes = _as_float_ptrs(
+            [np.asarray(a) for a in ins])
+        out_shapes = infer_out_shapes([a.shape for a in ins])
+        outs = [np.zeros(s, np.float32) for s in out_shapes]
+        _, out_ptrs, out_sizes = _as_float_ptrs(outs)
+        rc = fwd_cb(user, len(in_bufs), in_ptrs, in_sizes,
+                    len(outs), out_ptrs, out_sizes)
+        if rc != 0:
+            raise MXNetError(f"{op_type}: forward callback failed ({rc})")
+        return tuple(outs)
+
+    def host_backward(ins, ograds):
+        in_bufs, in_ptrs, in_sizes = _as_float_ptrs(
+            [np.asarray(a) for a in ins])
+        og_bufs, og_ptrs, og_sizes = _as_float_ptrs(
+            [np.asarray(g) for g in ograds])
+        igrads = [np.zeros(np.asarray(a).shape, np.float32) for a in ins]
+        _, ig_ptrs, _ = _as_float_ptrs(igrads)
+        rc = bwd_cb(user, len(in_bufs), in_ptrs, og_ptrs, ig_ptrs,
+                    in_sizes, og_sizes)
+        if rc != 0:
+            raise MXNetError(f"{op_type}: backward callback failed ({rc})")
+        return tuple(igrads)
+
+    def impl(*ins):
+        out_shapes = infer_out_shapes([tuple(a.shape) for a in ins])
+        result_shape = tuple(
+            jax.ShapeDtypeStruct(s, jnp.float32) for s in out_shapes)
+        outs = jax.pure_callback(host_forward, result_shape,
+                                 *[a.astype(jnp.float32) for a in ins])
+        return tuple(outs)
+
+    if bwd_cb is not None:
+        core = jax.custom_vjp(impl)
+
+        def fwd_rule(*ins):
+            return impl(*ins), tuple(ins)
+
+        def bwd_rule(res, cts):
+            ins = res
+            ig_shape = tuple(jax.ShapeDtypeStruct(tuple(a.shape),
+                                                  jnp.float32) for a in ins)
+            igs = jax.pure_callback(host_backward, ig_shape, ins,
+                                    tuple(cts))
+            return tuple(igs)
+
+        core.defvjp(fwd_rule, bwd_rule)
+        fn = core
+    else:
+        fn = impl
+
+    def op_fn(*ins, **kw):
+        out = fn(*ins)
+        return out if num_outputs > 1 else out[0]
+
+    register(op_type, num_inputs=num_inputs, num_outputs=num_outputs,
+             attrs=AttrSpec(),
+             differentiable=bwd_cb is not None)(op_fn)
+
+    # late registration: the nd/sym namespace export loops ran at import,
+    # so surface the new op on both frontends now
+    from .ops.registry import OP_TABLE as _table
+    opdef = _table[op_type]
+    nd_mod = __import__("mxnet_tpu.ndarray", fromlist=["_make_op_func"])
+    sym_mod = __import__("mxnet_tpu.symbol", fromlist=["_make_sym_func"])
+    setattr(nd_mod, op_type, nd_mod._make_op_func(opdef, op_type))
+    setattr(sym_mod, op_type, sym_mod._make_sym_func(opdef, op_type))
+
+
+# -- custom autograd Function from C (reference c_api.h:1716) --------------
+
+def custom_function_record(inputs: List[NDArray], outputs: List[NDArray],
+                           bwd_addr: int, user_addr: int) -> List[NDArray]:
+    """MXCustomFunctionRecord: tape a caller-computed mapping
+    inputs -> outputs whose backward is a C callback with the _BWD_T
+    layout (inputs, output grads, input grads). Returns the NEW taped
+    output arrays — the C side re-points the caller's handles at them
+    (the reference mutates the handles in place the same way)."""
+    from . import autograd as ag
+    bwd_cb = _BWD_T(bwd_addr)
+    user = user_addr or None
+    n_in = len(inputs)
+
+    class _CFunction(ag.Function):
+        def forward(self, *ins):
+            return tuple(outputs)
+
+        def backward(self, *ograds):
+            in_np = [i.asnumpy() for i in inputs]
+            og_np = [g.asnumpy() for g in ograds]
+            # keep every cast buffer referenced until the C call returns
+            in_bufs, in_ptrs, in_sizes = _as_float_ptrs(in_np)
+            og_bufs, og_ptrs, og_sizes = _as_float_ptrs(og_np)
+            igrads = [np.zeros(a.shape, np.float32) for a in in_np]
+            ig_bufs, ig_ptrs, _ = _as_float_ptrs(igrads)
+            igrads = ig_bufs
+            rc = bwd_cb(user, n_in, in_ptrs, og_ptrs, ig_ptrs,
+                        in_sizes, og_sizes)
+            if rc != 0:
+                raise MXNetError(
+                    f"custom function backward failed ({rc})")
+            return tuple(nd.array(g) for g in igrads)
+
+    out = _CFunction()(*inputs)
+    return list(out) if isinstance(out, tuple) else [out]

@@ -62,7 +62,7 @@ class NDArray:
     """Multi-dimensional array with MXNet semantics over immutable jax arrays."""
 
     __slots__ = ("_data", "_ctx", "_grad_buf", "_grad_req", "_ag_node",
-                 "_ag_out_index", "_version", "__weakref__")
+                 "_ag_out_index", "_version", "_fresh_grad", "__weakref__")
 
     # ensure ndarray <op> NDArray dispatches to us
     __array_priority__ = 100.0

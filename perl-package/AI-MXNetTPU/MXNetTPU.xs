@@ -62,6 +62,16 @@ static AV *strs_av(pTHX_ mx_uint n, const char **ss) {
   return av;
 }
 
+static size_t mxp_elem_size(int dtype) {
+    /* mshadow codes + the bf16 TPU extension (7) */
+    switch (dtype) {
+        case 1: case 6: return 8;
+        case 2: case 7: return 2;
+        case 3: case 5: return 1;
+        default: return 4;
+    }
+}
+
 MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU
 
 PROTOTYPES: DISABLE
@@ -117,8 +127,11 @@ mxp_nd_copy_from(h, buf)
   CODE:
     STRLEN len;
     const char *p = SvPV(buf, len);
-    ck(aTHX_ MXNDArraySyncCopyFromCPU((NDArrayHandle)h, p,
-                                      len / sizeof(mx_float)));
+    /* the boundary is dtype-native: element count = bytes / elem size */
+    int dt = 0;
+    ck(aTHX_ MXNDArrayGetDType((NDArrayHandle)h, &dt));
+    size_t esz = mxp_elem_size(dt);
+    ck(aTHX_ MXNDArraySyncCopyFromCPU((NDArrayHandle)h, p, len / esz));
 
 SV *
 mxp_nd_copy_to(h)
@@ -129,10 +142,13 @@ mxp_nd_copy_to(h)
     size_t size = 1;
     ck(aTHX_ MXNDArrayGetShape((NDArrayHandle)h, &nd, &shape));
     for (i = 0; i < nd; ++i) size *= shape[i];
-    RETVAL = newSV(size * sizeof(mx_float));
+    int dt = 0;
+    ck(aTHX_ MXNDArrayGetDType((NDArrayHandle)h, &dt));
+    size_t esz = mxp_elem_size(dt);
+    RETVAL = newSV(size * esz);
     SvPOK_on(RETVAL);
     ck(aTHX_ MXNDArraySyncCopyToCPU((NDArrayHandle)h, SvPVX(RETVAL), size));
-    SvCUR_set(RETVAL, size * sizeof(mx_float));
+    SvCUR_set(RETVAL, size * esz);
   OUTPUT:
     RETVAL
 

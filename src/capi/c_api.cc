@@ -14,6 +14,7 @@
 #include <Python.h>
 
 #include <cstring>
+#include <deque>
 #include <string>
 #include <vector>
 
@@ -31,6 +32,7 @@ struct NDRec {
   PyObject *obj;
   std::vector<mx_uint> shape;
   std::string bytes;  /* scratch for MXNDArraySaveRawBytes */
+  long esz = -1;      /* cached element size (dtype is immutable) */
 };
 
 struct StrList {
@@ -74,6 +76,12 @@ struct ExecRec {
    * MXNDArrayFree), matching MXImperativeInvokeByName's convention */
   std::vector<NDArrayHandle> outputs;
   std::string debug;
+  /* monitor callback (MXExecutorSetMonitorCallback); fired per op
+   * output after each forward */
+  ExecutorMonitorCallback mon_cb = nullptr;
+  void *mon_ctx = nullptr;
+  /* scratch for MXExecutorSimpleBind's returned handle arrays */
+  std::vector<NDArrayHandle> sb_args, sb_grads, sb_aux;
 };
 
 struct KVRec {
@@ -228,9 +236,22 @@ bool PyToShapeGroup(PyObject *seq, ShapeGroup *out) {
   return true;
 }
 
-/* global op-name storage for MXListAllOpNames / creators */
-StrList &OpNames() {
-  static StrList names;
+/* global op-name storage for MXListAllOpNames / creators.
+ * A deque keeps string addresses STABLE: creator handles are pointers
+ * to these strings and must survive later additions
+ * (MXCustomOpRegister appends at runtime). */
+struct OpNameStore {
+  std::deque<std::string> store;
+  std::vector<const char *> ptrs;
+
+  void push(std::string v) {
+    store.push_back(std::move(v));
+    ptrs.push_back(store.back().c_str());
+  }
+};
+
+OpNameStore &OpNames() {
+  static OpNameStore names;
   return names;
 }
 
@@ -238,9 +259,12 @@ bool EnsureOpNames() {
   if (!OpNames().store.empty()) return true;
   PyObject *res = CallApi("list_op_names", PyTuple_New(0));
   if (!res) return false;
-  bool ok = PyToStrList(res, &OpNames());
+  StrList tmp;
+  bool ok = PyToStrList(res, &tmp);
   Py_DECREF(res);
-  return ok;
+  if (!ok) return false;
+  for (auto &v : tmp.store) OpNames().push(v);
+  return true;
 }
 
 }  // namespace
@@ -288,15 +312,31 @@ int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
   return 0;
 }
 
+static long NDElemSize(NDRec *rec) {
+  if (rec->esz > 0) return rec->esz;
+  PyObject *res = CallApi("nd_dtype_size", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  long esz = PyLong_AsLong(res);
+  Py_DECREF(res);
+  if (esz <= 0) {
+    SetError("could not determine element size");
+    return -1;
+  }
+  rec->esz = esz;
+  return esz;
+}
+
 int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
                              size_t size) {
   GIL gil;
   NDRec *rec = static_cast<NDRec *>(handle);
+  long esz = NDElemSize(rec);
+  if (esz < 0) return -1;
   PyObject *mv = PyMemoryView_FromMemory(
       const_cast<char *>(static_cast<const char *>(data)),
-      size * sizeof(mx_float), PyBUF_READ);
+      size * esz, PyBUF_READ);
   PyObject *res =
-      CallApi("nd_copy_from", Py_BuildValue("(ON)", rec->obj, mv));
+      CallApi("nd_copy_from_ex", Py_BuildValue("(ON)", rec->obj, mv));
   if (!res) return -1;
   Py_DECREF(res);
   return 0;
@@ -305,7 +345,9 @@ int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
 int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
   GIL gil;
   NDRec *rec = static_cast<NDRec *>(handle);
-  PyObject *res = CallApi("nd_copy_to", Py_BuildValue("(O)", rec->obj));
+  long esz = NDElemSize(rec);
+  if (esz < 0) return -1;
+  PyObject *res = CallApi("nd_copy_to_ex", Py_BuildValue("(O)", rec->obj));
   if (!res) return -1;
   char *buf = nullptr;
   Py_ssize_t len = 0;
@@ -314,7 +356,7 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
     Py_DECREF(res);
     return -1;
   }
-  if (static_cast<size_t>(len) != size * sizeof(mx_float)) {
+  if (static_cast<size_t>(len) != size * static_cast<size_t>(esz)) {
     SetError("MXNDArraySyncCopyToCPU: size mismatch");
     Py_DECREF(res);
     return -1;
@@ -440,9 +482,11 @@ int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
   GIL gil;
   if (!EnsureOpNames()) return -1;
   static std::vector<AtomicSymbolCreator> creators;
-  if (creators.empty())
+  if (creators.size() != OpNames().store.size()) {
+    creators.clear();
     for (auto &s : OpNames().store)
       creators.push_back(const_cast<std::string *>(&s));
+  }
   *out_size = static_cast<mx_uint>(creators.size());
   *out_array = creators.data();
   return 0;
@@ -651,6 +695,29 @@ int MXExecutorForward(ExecutorHandle handle, int is_train) {
       CallApi("executor_forward", Py_BuildValue("(Oi)", rec->obj, is_train));
   if (!res) return -1;
   Py_DECREF(res);
+  if (rec->mon_cb) {
+    /* fire per op output; handle ownership transfers to the callback
+     * (reference monitor convention — python's Monitor wraps + frees) */
+    PyObject *ints = CallApi("executor_internal_outputs",
+                             Py_BuildValue("(O)", rec->obj));
+    if (!ints) return -1;
+    PyObject *pnames = PyTuple_GetItem(ints, 0);
+    PyObject *parrs = PyTuple_GetItem(ints, 1);
+    Py_ssize_t n = pnames ? PySequence_Size(pnames) : -1;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *nm = PySequence_GetItem(pnames, i);
+      PyObject *ar = PySequence_GetItem(parrs, i);
+      const char *c = nm ? PyUnicode_AsUTF8(nm) : nullptr;
+      if (c && ar) {
+        NDRec *h = new NDRec{ar, {}, {}};  /* steals ar's ref */
+        rec->mon_cb(c, h, rec->mon_ctx);
+      } else {
+        Py_XDECREF(ar);
+      }
+      Py_XDECREF(nm);
+    }
+    Py_DECREF(ints);
+  }
   return 0;
 }
 
@@ -1628,6 +1695,799 @@ int MXRandomSeed(int seed) {
   if (!res) return -1;
   Py_DECREF(res);
   return 0;
+}
+
+
+/* ======================================================================
+ * Round-4 surface (see c_api.h): dtype-through-boundary NDArray, legacy
+ * Function group, Symbol file IO/queries, SimpleBind + monitor, int-key
+ * KVStore + updater, profiler, RTC, custom ops from C callbacks.
+ * ====================================================================== */
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int /*delay_alloc*/, int dtype,
+                      NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject *res = CallApi(
+      "nd_create_ex",
+      Py_BuildValue("(Niii)", shp, dev_type, dev_id, dtype));
+  if (!res) return -1;
+  *out = new NDRec{res, {}, {}};
+  return 0;
+}
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("nd_create_none", PyTuple_New(0));
+  if (!res) return -1;
+  *out = new NDRec{res, {}, {}};
+  return 0;
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_copy_to_ex", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  rec->bytes.assign(buf, len);
+  Py_DECREF(res);
+  *out_pdata = rec->bytes.empty() ? nullptr : &rec->bytes[0];
+  return 0;
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res =
+      CallApi("nd_aux_type", Py_BuildValue("(OI)", rec->obj, i));
+  if (!res) return -1;
+  *out_type = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res = CallApi("nd_grad_state", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res =
+      CallApi("nd_set_grad_state", Py_BuildValue("(Oi)", rec->obj, state));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- imperative invoke by creator ------------------------------------- */
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  const std::string *opname = static_cast<std::string *>(creator);
+  return MXImperativeInvokeByName(opname->c_str(), num_inputs, inputs,
+                                  num_outputs, outputs, num_params,
+                                  param_keys, param_vals);
+}
+
+static int CollectStypes(int n, NDArrayHandle *outs,
+                         const int **out_stypes) {
+  GIL gil;
+  static thread_local std::vector<int> stypes;
+  stypes.clear();
+  for (int i = 0; i < n; ++i) {
+    NDRec *rec = static_cast<NDRec *>(outs[i]);
+    PyObject *res =
+        CallApi("nd_storage_type", Py_BuildValue("(O)", rec->obj));
+    if (!res) return -1;
+    stypes.push_back(static_cast<int>(PyLong_AsLong(res)));
+    Py_DECREF(res);
+  }
+  *out_stypes = stypes.data();
+  return 0;
+}
+
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle **outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes) {
+  if (MXImperativeInvoke(creator, num_inputs, inputs, num_outputs, outputs,
+                         num_params, param_keys, param_vals) != 0)
+    return -1;
+  return CollectStypes(*num_outputs, *outputs, out_stypes);
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes) {
+  if (MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                       outputs) != 0)
+    return -1;
+  return CollectStypes(*num_outputs, *outputs, out_stypes);
+}
+
+/* ---- legacy Function group -------------------------------------------- */
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  return MXSymbolListAtomicSymbolCreators(
+      out_size, reinterpret_cast<AtomicSymbolCreator **>(out_array));
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  if (!EnsureOpNames()) return -1;
+  for (auto &sname : OpNames().store) {
+    if (sname == name) {
+      *out = const_cast<std::string *>(&sname);
+      return 0;
+    }
+  }
+  SetError(std::string("unknown function ") + name);
+  return -1;
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions,
+                  const char **return_type) {
+  const char *kv_num_args = nullptr;
+  return MXSymbolGetAtomicSymbolInfo(fun, name, description, num_args,
+                                     arg_names, arg_type_infos,
+                                     arg_descriptions, &kv_num_args,
+                                     return_type);
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask) {
+  GIL gil;
+  const std::string *opname = static_cast<std::string *>(fun);
+  PyObject *res =
+      CallApi("func_describe", Py_BuildValue("(s)", opname->c_str()));
+  if (!res) return -1;
+  long a = 0, b = 0, c = 0, d = 0;
+  if (!PyArg_ParseTuple(res, "llll", &a, &b, &c, &d)) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  Py_DECREF(res);
+  *num_use_vars = static_cast<mx_uint>(a);
+  *num_scalars = static_cast<mx_uint>(b);
+  *num_mutate_vars = static_cast<mx_uint>(c);
+  *type_mask = static_cast<int>(d);
+  return 0;
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals) {
+  mx_uint n_use = 0, n_scalar = 0, n_mut = 0;
+  int mask = 0;
+  if (MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &mask) != 0) return -1;
+  GIL gil;
+  const std::string *opname = static_cast<std::string *>(fun);
+  PyObject *scalars = PyList_New(n_scalar);
+  for (mx_uint i = 0; i < n_scalar; ++i)
+    PyList_SET_ITEM(scalars, i,
+                    PyFloat_FromDouble(scalar_args ? scalar_args[i] : 0.0));
+  PyObject *res = CallApi(
+      "func_invoke",
+      Py_BuildValue("(sNNNNN)", opname->c_str(),
+                    NDListToPy(n_use, use_vars), scalars,
+                    NDListToPy(n_mut, mutate_vars),
+                    StrListToPy(num_params,
+                                const_cast<const char **>(param_keys)),
+                    StrListToPy(num_params,
+                                const_cast<const char **>(param_vals))));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars) {
+  return MXFuncInvokeEx(fun, use_vars, scalar_args, mutate_vars, 0,
+                        nullptr, nullptr);
+}
+
+/* ---- Symbol file IO + query tails -------------------------------------- */
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("sym_from_file", Py_BuildValue("(s)", fname));
+  if (!res) return -1;
+  *out = new SymRec{res, {}, {}, {}, {}, {}, {}, {}};
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res =
+      CallApi("sym_save_file", Py_BuildValue("(Os)", rec->obj, fname));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res =
+      CallApi("sym_get_children", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *out = new SymRec{res, {}, {}, {}, {}, {}, {}, {}};
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle sym, mx_uint *out_size,
+                     const char ***out) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res =
+      CallApi("sym_list_attr_full", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  bool ok = PyToStrList(res, &rec->args);
+  Py_DECREF(res);
+  if (!ok) return -1;
+  /* flattened pairs; out_size counts pairs like the reference */
+  *out_size = static_cast<mx_uint>(rec->args.ptrs.size() / 2);
+  *out = rec->args.ptrs.data();
+  return 0;
+}
+
+int MXSymbolPrint(SymbolHandle sym, const char **out_str) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *res = CallApi("sym_print", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  const char *c = PyUnicode_AsUTF8(res);
+  rec->json = c ? c : "";
+  Py_DECREF(res);
+  *out_str = rec->json.c_str();
+  return 0;
+}
+
+int MXSymbolGrad(SymbolHandle /*sym*/, mx_uint /*num_wrt*/,
+                 const char ** /*wrt*/, SymbolHandle * /*out*/) {
+  SetError(
+      "MXSymbolGrad is not implemented (the reference aborts here too, "
+      "c_api_symbolic.cc:563); use MXAutogradBackward or "
+      "MXExecutorBackward");
+  return -1;
+}
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out) {
+  GIL gil;
+  NDRec *rec = static_cast<NDRec *>(handle);
+  PyObject *res =
+      CallApi("autograd_get_symbol", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;
+  *out = new SymRec{res, {}, {}, {}, {}, {}, {}, {}};
+  return 0;
+}
+
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys,
+                              const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data,
+                              int *complete) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  PyObject *shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  PyObject *res = CallApi(
+      "sym_infer_shape_partial",
+      Py_BuildValue("(ONN)", rec->obj, StrListToPy(num_args, keys), shapes));
+  if (!res) return -1;
+  ShapeGroup *groups[3] = {&rec->in_shapes, &rec->out_shapes,
+                           &rec->aux_shapes};
+  for (int g = 0; g < 3; ++g) {
+    PyObject *item = PyTuple_GetItem(res, g);
+    if (!item || !PyToShapeGroup(item, groups[g])) {
+      Py_DECREF(res);
+      return -1;
+    }
+  }
+  Py_DECREF(res);
+  *in_shape_size = static_cast<mx_uint>(rec->in_shapes.shapes.size());
+  *in_shape_ndim = rec->in_shapes.ndims.data();
+  *in_shape_data = rec->in_shapes.data_ptrs.data();
+  *out_shape_size = static_cast<mx_uint>(rec->out_shapes.shapes.size());
+  *out_shape_ndim = rec->out_shapes.ndims.data();
+  *out_shape_data = rec->out_shapes.data_ptrs.data();
+  *aux_shape_size = static_cast<mx_uint>(rec->aux_shapes.shapes.size());
+  *aux_shape_ndim = rec->aux_shapes.ndims.data();
+  *aux_shape_data = rec->aux_shapes.data_ptrs.data();
+  /* complete == every returned shape known (non-empty) */
+  int done = 1;
+  for (auto &shp : rec->in_shapes.shapes) {
+    done &= !shp.empty();
+    for (mx_uint d : shp) done &= (d != 0);
+  }
+  for (auto &shp : rec->out_shapes.shapes) {
+    done &= !shp.empty();
+    for (mx_uint d : shp) done &= (d != 0);
+  }
+  *complete = done;
+  return 0;
+}
+
+/* ---- Executor bind family + monitor ------------------------------------ */
+
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, mx_uint len,
+                   NDArrayHandle *in_args, NDArrayHandle *arg_grad_store,
+                   mx_uint *grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle *aux_states, ExecutorHandle *out) {
+  return MXExecutorBindEX(sym, dev_type, dev_id, len, in_args,
+                          arg_grad_store, grad_req_type, aux_states_len,
+                          aux_states, out);
+}
+
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    mx_uint /*num_map_keys*/, const char ** /*map_keys*/,
+                    const int * /*map_dev_types*/,
+                    const int * /*map_dev_ids*/, mx_uint len,
+                    NDArrayHandle *in_args, NDArrayHandle *arg_grad_store,
+                    mx_uint *grad_req_type, mx_uint aux_states_len,
+                    NDArrayHandle *aux_states, ExecutorHandle *out) {
+  /* group2ctx maps accepted for parity; placement comes from ctx_group
+   * symbol attrs under the SPMD design */
+  return MXExecutorBindEX(sym, dev_type, dev_id, len, in_args,
+                          arg_grad_store, grad_req_type, aux_states_len,
+                          aux_states, out);
+}
+
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *res = CallApi(
+      "executor_backward_ex",
+      Py_BuildValue("(ONi)", rec->obj, NDListToPy(len, head_grads),
+                    is_train));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorSimpleBind(
+    SymbolHandle sym, int dev_type, int dev_id, mx_uint /*num_g2c_keys*/,
+    const char ** /*g2c_keys*/, const int * /*g2c_dev_types*/,
+    const int * /*g2c_dev_ids*/, mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types, mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx, mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    mx_uint /*num_provided_arg_stypes*/,
+    const char ** /*provided_arg_stype_names*/,
+    const int * /*provided_arg_stypes*/, mx_uint /*num_shared_arg_names*/,
+    const char ** /*shared_arg_name_list*/, int *shared_buffer_len,
+    const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list,
+    mx_uint *num_in_args, NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle /*shared_exec_handle*/, ExecutorHandle *out) {
+  GIL gil;
+  SymRec *rec = static_cast<SymRec *>(sym);
+  /* shapes arrive CSR-style */
+  PyObject *shapes = PyList_New(num_provided_arg_shapes);
+  for (mx_uint i = 0; i < num_provided_arg_shapes; ++i) {
+    mx_uint b = provided_arg_shape_idx[i];
+    mx_uint e = provided_arg_shape_idx[i + 1];
+    PyObject *shp = PyTuple_New(e - b);
+    for (mx_uint j = b; j < e; ++j)
+      PyTuple_SET_ITEM(shp, j - b,
+                       PyLong_FromUnsignedLong(provided_arg_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  PyObject *dtypes = PyList_New(num_provided_arg_dtypes);
+  for (mx_uint i = 0; i < num_provided_arg_dtypes; ++i)
+    PyList_SET_ITEM(dtypes, i, PyLong_FromLong(provided_arg_dtypes[i]));
+  PyObject *res = CallApi(
+      "executor_simple_bind",
+      Py_BuildValue(
+          "(OiiNNNNNN)", rec->obj, dev_type, dev_id,
+          StrListToPy(num_provided_arg_shapes, provided_arg_shape_names),
+          shapes,
+          StrListToPy(num_provided_arg_dtypes, provided_arg_dtype_names),
+          dtypes,
+          StrListToPy(provided_grad_req_list_len, provided_grad_req_names),
+          StrListToPy(provided_grad_req_list_len,
+                      provided_grad_req_types)));
+  if (!res) return -1;
+  /* (executor, arg_names, args, grads, aux_names, auxs) */
+  PyObject *pex = PySequence_GetItem(res, 0);
+  PyObject *pargs = PySequence_GetItem(res, 2);
+  PyObject *pgrads = PySequence_GetItem(res, 3);
+  PyObject *pauxs = PySequence_GetItem(res, 5);
+  Py_DECREF(res);
+  if (!pex || !pargs || !pgrads || !pauxs) {
+    SetErrorFromPython();
+    Py_XDECREF(pex);
+    Py_XDECREF(pargs);
+    Py_XDECREF(pgrads);
+    Py_XDECREF(pauxs);
+    return -1;
+  }
+  ExecRec *er = new ExecRec{pex, {}, {}};
+  er->sb_args.clear();
+  er->sb_grads.clear();
+  er->sb_aux.clear();
+  Py_ssize_t na = PySequence_Size(pargs);
+  for (Py_ssize_t i = 0; i < na; ++i)
+    er->sb_args.push_back(new NDRec{PySequence_GetItem(pargs, i), {}, {}});
+  for (Py_ssize_t i = 0; i < na; ++i) {
+    PyObject *g = PySequence_GetItem(pgrads, i);
+    if (g == Py_None) {
+      Py_DECREF(g);
+      er->sb_grads.push_back(nullptr);
+    } else {
+      er->sb_grads.push_back(new NDRec{g, {}, {}});
+    }
+  }
+  Py_ssize_t nx = PySequence_Size(pauxs);
+  for (Py_ssize_t i = 0; i < nx; ++i)
+    er->sb_aux.push_back(new NDRec{PySequence_GetItem(pauxs, i), {}, {}});
+  Py_DECREF(pargs);
+  Py_DECREF(pgrads);
+  Py_DECREF(pauxs);
+  *num_in_args = static_cast<mx_uint>(na);
+  *in_args = er->sb_args.data();
+  *arg_grads = er->sb_grads.data();
+  *num_aux_states = static_cast<mx_uint>(nx);
+  *aux_states = er->sb_aux.data();
+  /* shared buffers pass through unchanged (XLA owns buffer reuse) */
+  if (updated_shared_buffer_name_list)
+    *updated_shared_buffer_name_list = shared_buffer_name_list;
+  if (updated_shared_buffer_handle_list)
+    *updated_shared_buffer_handle_list = shared_buffer_handle_list;
+  (void)shared_buffer_len;
+  *out = er;
+  return 0;
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  rec->mon_cb = callback;
+  rec->mon_ctx = callback_handle;
+  return 0;
+}
+
+/* ---- KVStore int keys / roles / updater / server ----------------------- */
+
+static void IntKeysToStrs(mx_uint num, const int *keys,
+                          std::vector<std::string> *store,
+                          std::vector<const char *> *ptrs) {
+  store->clear();
+  for (mx_uint i = 0; i < num; ++i)
+    store->push_back(std::to_string(keys[i]));
+  /* pointers taken only after the store stops growing */
+  ptrs->clear();
+  for (auto &s : *store) ptrs->push_back(s.c_str());
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  std::vector<std::string> store;
+  std::vector<const char *> ptrs;
+  IntKeysToStrs(num, keys, &store, &ptrs);
+  return MXKVStoreInitEx(handle, num, ptrs.data(), vals);
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  std::vector<std::string> store;
+  std::vector<const char *> ptrs;
+  IntKeysToStrs(num, keys, &store, &ptrs);
+  return MXKVStorePushEx(handle, num, ptrs.data(), vals, priority);
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  std::vector<std::string> store;
+  std::vector<const char *> ptrs;
+  IntKeysToStrs(num, keys, &store, &ptrs);
+  return MXKVStorePullEx(handle, num, ptrs.data(), vals, priority);
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           NDArrayHandle *row_ids, int priority) {
+  std::vector<std::string> store;
+  std::vector<const char *> ptrs;
+  IntKeysToStrs(num, keys, &store, &ptrs);
+  return MXKVStorePullRowSparseEx(handle, num, ptrs.data(), vals, row_ids,
+                                  priority);
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *res = CallApi(
+      "kv_set_updater",
+      Py_BuildValue("(OKK)", rec->obj,
+                    (unsigned long long)(uintptr_t)updater,
+                    (unsigned long long)(uintptr_t)updater_handle));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController /*controller*/,
+                       void * /*controller_handle*/) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *res = CallApi("kv_run_server", Py_BuildValue("(O)", rec->obj));
+  if (!res) return -1;  /* reports the no-server design loudly */
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *res = CallApi(
+      "kv_send_command",
+      Py_BuildValue("(Ois)", rec->obj, cmd_id, cmd_body ? cmd_body : ""));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+static int KVRoleIs(const char *role, int *ret) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("kv_role", PyTuple_New(0));
+  if (!res) return -1;
+  const char *c = PyUnicode_AsUTF8(res);
+  *ret = (c && std::string(c) == role) ? 1 : 0;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) { return KVRoleIs("worker", ret); }
+int MXKVStoreIsServerNode(int *ret) { return KVRoleIs("server", ret); }
+int MXKVStoreIsSchedulerNode(int *ret) { return KVRoleIs("scheduler", ret); }
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle /*handle*/,
+                                  int /*barrier_before_exit*/) {
+  /* fate-sharing design: workers exit together via the collective
+   * runtime; accepted for parity */
+  return 0;
+}
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi(
+      "init_ps_env", Py_BuildValue("(NN)", StrListToPy(num_vars, keys),
+                                   StrListToPy(num_vars, vals)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- profiler ---------------------------------------------------------- */
+
+int MXSetProfilerConfig(int mode, const char *filename) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi(
+      "profiler_set_config",
+      Py_BuildValue("(is)", mode, filename ? filename : "profile.json"));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res =
+      CallApi("profiler_set_state", Py_BuildValue("(i)", state));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDumpProfile() {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("profiler_dump", Py_BuildValue("(i)", 1));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- RTC --------------------------------------------------------------- */
+
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi(
+      "rtc_create",
+      Py_BuildValue(
+          "(sNNNNs)", name,
+          StrListToPy(num_input, const_cast<const char **>(input_names)),
+          StrListToPy(num_output, const_cast<const char **>(output_names)),
+          NDListToPy(num_input, inputs), NDListToPy(num_output, outputs),
+          kernel));
+  if (!res) return -1;
+  *out = new KVRec{res, {}};  /* plain PyObject holder */
+  return 0;
+}
+
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *res = CallApi(
+      "rtc_push",
+      Py_BuildValue("(ONNIIIIII)", rec->obj, NDListToPy(num_input, inputs),
+                    NDListToPy(num_output, outputs), gridDimX, gridDimY,
+                    gridDimZ, blockDimX, blockDimY, blockDimZ));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRtcFree(RtcHandle handle) {
+  if (!handle) return 0;
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  Py_XDECREF(rec->obj);
+  delete rec;
+  return 0;
+}
+
+/* ---- custom ops / custom function -------------------------------------- */
+
+int MXCustomOpRegister(const char *op_type, const MXCustomOpInfo *info) {
+  if (!EnsurePython()) return -1;
+  if (!info || !info->infer_shape || !info->forward) {
+    SetError("MXCustomOpRegister: infer_shape and forward are required");
+    return -1;
+  }
+  GIL gil;
+  PyObject *res = CallApi(
+      "custom_op_register",
+      Py_BuildValue("(siiKKKK)", op_type, info->num_inputs,
+                    info->num_outputs,
+                    (unsigned long long)(uintptr_t)info->infer_shape,
+                    (unsigned long long)(uintptr_t)info->forward,
+                    (unsigned long long)(uintptr_t)info->backward,
+                    (unsigned long long)(uintptr_t)info->user_data));
+  if (!res) return -1;
+  Py_DECREF(res);
+  /* the op joins every listing (stable deque: existing creator
+   * handles keep working) */
+  if (!OpNames().store.empty()) {
+    bool present = false;
+    for (auto &s : OpNames().store) present |= (s == op_type);
+    if (!present) OpNames().push(op_type);
+  }
+  return 0;
+}
+
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                           int num_outputs, NDArrayHandle *outputs,
+                           const MXCustomFunctionInfo *info) {
+  if (!info || !info->backward) {
+    SetError("MXCustomFunctionRecord: backward callback is required");
+    return -1;
+  }
+  GIL gil;
+  PyObject *res = CallApi(
+      "custom_function_record",
+      Py_BuildValue("(NNKK)", NDListToPy(num_inputs, inputs),
+                    NDListToPy(num_outputs, outputs),
+                    (unsigned long long)(uintptr_t)info->backward,
+                    (unsigned long long)(uintptr_t)info->user_data));
+  if (!res) return -1;
+  Py_ssize_t n = PySequence_Size(res);
+  if (n != num_outputs) {
+    SetError("MXCustomFunctionRecord: output count mismatch");
+    Py_DECREF(res);
+    return -1;
+  }
+  /* re-point the caller's output handles at the taped arrays */
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    NDRec *rec = static_cast<NDRec *>(outputs[i]);
+    PyObject *fresh = PySequence_GetItem(res, i);
+    Py_XDECREF(rec->obj);
+    rec->obj = fresh;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- misc tails --------------------------------------------------------- */
+
+int MXNotifyShutdown() {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res = CallApi("notify_shutdown", PyTuple_New(0));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSetNumOMPThreads(int thread_num) {
+  if (!EnsurePython()) return -1;
+  GIL gil;
+  PyObject *res =
+      CallApi("set_num_omp_threads", Py_BuildValue("(i)", thread_num));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+NDArrayHandle MXTPUWrapNDArrayForCallback(void *pyobject) {
+  PyObject *obj = static_cast<PyObject *>(pyobject);
+  Py_INCREF(obj);
+  return new NDRec{obj, {}, {}};
 }
 
 }  /* extern "C" */

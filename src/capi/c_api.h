@@ -16,7 +16,8 @@
  *   - handles are opaque pointers freed with their MX*Free function;
  *   - returned const char** / mx_uint* views stay valid until the next
  *     call on the same handle (or library, for global lists);
- *   - data buffers at the boundary are float32 (mx_float), row-major;
+ *   - data buffers are raw bytes of the ARRAY's dtype, row-major
+ *     (f32 by default; MXNDArrayCreateEx carries dtype, 7 = bf16);
  *   - dev_type: 1 = cpu, 2 = accelerator (tpu).
  */
 #ifndef MXTPU_C_API_H_
@@ -294,6 +295,249 @@ int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
 
 /* ---- misc ------------------------------------------------------------- */
 int MXRandomSeed(int seed);
+
+/* ======================================================================
+ * Round-4 surface: the remaining reference c_api.h names. dtype codes
+ * extend the mshadow enum with 7 = bfloat16 (the MXU-native training
+ * dtype; codes 0-6 keep the reference's meaning). Data buffers for
+ * MXNDArraySyncCopy{From,To}CPU are raw bytes of the ARRAY's dtype;
+ * `size` stays an element count (f32 arrays keep the old behavior).
+ * ====================================================================== */
+
+typedef void *FunctionHandle;
+typedef void *RtcHandle;
+
+/* ---- NDArray (dtype through the boundary) ----------------------------- */
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArrayCreateNone(NDArrayHandle *out);
+/* Host-synced read view of the data (the reference returns the raw cpu
+ * pointer); bytes of the array's dtype, valid until the next call on
+ * this handle. */
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+/* The per-array 'fresh gradient' flag (reference ndarray entry state). */
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
+int MXNDArraySetGradState(NDArrayHandle handle, int state);
+
+/* ---- imperative invoke by creator handle ------------------------------ */
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+/* +storage types of the outputs (codes: 0 dense, 1 row_sparse, 2 csr);
+ * the view stays valid until the next invoke on this thread. */
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle **outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes);
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes);
+
+/* ---- legacy Function group (reference c_api.h:446-520) ----------------- */
+/* FunctionHandle == the op registry entry; counts come from
+ * MXFuncDescribe, results are written into mutate_vars. */
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+int MXGetFunction(const char *name, FunctionHandle *out);
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions,
+                  const char **return_type);
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask);
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars);
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals);
+
+/* ---- Symbol file IO + query tails -------------------------------------- */
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname);
+/* Direct inputs of the output node(s), as a grouped symbol. */
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out);
+/* Recursive attr walk, flattened [node$key, val, ...] pairs. */
+int MXSymbolListAttr(SymbolHandle sym, mx_uint *out_size,
+                     const char ***out);
+int MXSymbolPrint(SymbolHandle sym, const char **out_str);
+/* Best-effort inference: unknown shapes come back 0-dim, never fails on
+ * incomplete input (reference c_api.h:1105). */
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys,
+                              const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data,
+                              int *complete);
+/* Always fails: the reference's own MXSymbolGrad aborts "not
+ * implemented" (c_api_symbolic.cc:563); use the autograd group. */
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out);
+/* Reconstruct a Symbol from the autograd tape behind a recorded output
+ * (leaf arrays become variables var<k> in first-visit order). */
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out);
+
+/* ---- Executor: bind family + monitor ----------------------------------- */
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, mx_uint len,
+                   NDArrayHandle *in_args, NDArrayHandle *arg_grad_store,
+                   mx_uint *grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle *aux_states, ExecutorHandle *out);
+/* group2ctx maps are accepted for ABI parity; placement is driven by
+ * ctx_group symbol attrs in the XLA design (SPMD partitioning), so the
+ * maps do not re-place the graph. */
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out);
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train);
+/* Infer + allocate everything from provided shapes/dtypes — the bind
+ * entry every reference frontend calls (c_api.h:1149). Signature
+ * mirrors the reference; the shared-buffer plumbing is accepted and
+ * passed through unchanged (XLA owns buffer reuse). Returned handle
+ * arrays stay valid until the next SimpleBind on this thread; the
+ * handles are the caller's to free. */
+typedef void (*ExecutorMonitorCallback)(const char *name,
+                                        NDArrayHandle handle, void *data);
+int MXExecutorSimpleBind(
+    SymbolHandle sym, int dev_type, int dev_id, mx_uint num_g2c_keys,
+    const char **g2c_keys, const int *g2c_dev_types, const int *g2c_dev_ids,
+    mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types, mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx, mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list,
+    mx_uint *num_in_args, NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out);
+/* Fire the callback for every op output after each forward (ownership
+ * of the passed NDArrayHandle transfers to the callback). */
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle);
+
+/* ---- KVStore: int keys, roles, updater, server ------------------------- */
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           NDArrayHandle *row_ids, int priority);
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+typedef void (*MXKVStoreServerController)(int head, const char *body,
+                                          void *controller_handle);
+/* The XLA-collective stack has no parameter-server processes (gradients
+ * reduce in-graph over ICI/DCN); this reports that loudly, matching
+ * kvstore_server.KVStoreServer.run(). */
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body);
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit);
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals);
+
+/* ---- profiler ---------------------------------------------------------- */
+/* mode: 0 = symbolic only, 1 = all (reference mode2int). */
+int MXSetProfilerConfig(int mode, const char *filename);
+int MXSetProfilerState(int state);
+int MXDumpProfile();
+
+/* ---- RTC (Pallas playing NVRTC's role) --------------------------------- */
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out);
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs, mx_uint gridDimX,
+              mx_uint gridDimY, mx_uint gridDimZ, mx_uint blockDimX,
+              mx_uint blockDimY, mx_uint blockDimZ);
+int MXRtcFree(RtcHandle handle);
+
+/* ---- custom ops from C callbacks (reference c_api.h:1697) -------------- */
+/* Own callback protocol (the reference's MXCallbackList dance is
+ * CUDA-pointer-shaped); semantics match: register shape inference +
+ * forward (+ optional backward) and the op becomes available on every
+ * surface — imperative invoke, Symbol/Executor, CachedOp — and trains
+ * (backward wires into autograd). All buffers float32 row-major;
+ * callbacks return 0 on success. Output shape buffers hold up to
+ * MX_CUSTOM_OP_MAX_NDIM dims per output, written at stride
+ * MX_CUSTOM_OP_MAX_NDIM into out_shapes. */
+#define MX_CUSTOM_OP_MAX_NDIM 8
+typedef struct MXCustomOpInfo {
+  void *user_data;
+  int num_inputs;
+  int num_outputs;
+  int (*infer_shape)(void *user_data, int num_inputs, const int *in_ndims,
+                     const unsigned *in_shapes_concat, int *out_ndims,
+                     unsigned *out_shapes_strided);
+  int (*forward)(void *user_data, int num_inputs, const float **in_data,
+                 const int *in_sizes, int num_outputs, float **out_data,
+                 const int *out_sizes);
+  /* NULL = non-differentiable op. in_grads are zero-filled on entry. */
+  int (*backward)(void *user_data, int num_inputs, const float **in_data,
+                  const float **out_grads, float **in_grads,
+                  const int *in_sizes, const int *out_grad_sizes);
+} MXCustomOpInfo;
+int MXCustomOpRegister(const char *op_type, const MXCustomOpInfo *info);
+
+/* Tape a caller-computed inputs -> outputs mapping whose backward is a
+ * C callback with the MXCustomOpInfo.backward layout. The output
+ * handles are re-pointed at the taped arrays in place (reference
+ * c_api.h:1716 semantics). */
+typedef struct MXCustomFunctionInfo {
+  void *user_data;
+  int (*backward)(void *user_data, int num_inputs, const float **in_data,
+                  const float **out_grads, float **in_grads,
+                  const int *in_sizes, const int *out_grad_sizes);
+} MXCustomFunctionInfo;
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                           int num_outputs, NDArrayHandle *outputs,
+                           const MXCustomFunctionInfo *info);
+
+/* ---- misc tails -------------------------------------------------------- */
+int MXNotifyShutdown();
+int MXSetNumOMPThreads(int thread_num);
+
+/* Mint a real NDArrayHandle around a live in-process python NDArray —
+ * the bridge the updater/monitor callback marshaling uses (exported for
+ * the embedded python side; not part of the reference surface). */
+NDArrayHandle MXTPUWrapNDArrayForCallback(void *pyobject);
 
 #ifdef __cplusplus
 }
