@@ -50,7 +50,10 @@ def _parse_tuple(s):
         return (s,)
     s = s.strip()
     if s.startswith("(") or s.startswith("["):
-        return tuple(ast.literal_eval(s.replace("L", "")))
+        v = ast.literal_eval(s.replace("L", ""))
+        # "(2)" evaluates to a bare scalar; shapes stay 1-tuples (the
+        # reference's TShape parser accepts both spellings)
+        return tuple(v) if isinstance(v, (tuple, list)) else (v,)
     return tuple(ast.literal_eval("(" + s + ",)"))
 
 

@@ -518,3 +518,223 @@ mxp_autograd_backward(head)
   CODE:
     NDArrayHandle hh = (NDArrayHandle)head;
     ck(aTHX_ MXAutogradBackward(1, &hh, NULL, 0));
+
+IV
+mxp_nd_assign(dst, src)
+    IV dst
+    IV src
+  CODE:
+    ck(aTHX_ MXNDArrayAssign((NDArrayHandle)dst, (NDArrayHandle)src));
+    RETVAL = dst;
+  OUTPUT:
+    RETVAL
+
+IV
+mxp_nd_detach(h)
+    IV h
+  CODE:
+    NDArrayHandle out;
+    ck(aTHX_ MXNDArrayDetach((NDArrayHandle)h, &out));
+    RETVAL = (IV)out;
+  OUTPUT:
+    RETVAL
+
+IV
+mxp_nd_get_grad(h)
+    IV h
+  CODE:
+    NDArrayHandle out;
+    ck(aTHX_ MXNDArrayGetGrad((NDArrayHandle)h, &out));
+    RETVAL = (IV)out;
+  OUTPUT:
+    RETVAL
+
+int
+mxp_nd_dtype(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXNDArrayGetDType((NDArrayHandle)h, &RETVAL));
+  OUTPUT:
+    RETVAL
+
+AV *
+mxp_list_data_iters()
+  CODE:
+    mx_uint n, i;
+    DataIterCreator *creators;
+    ck(aTHX_ MXListDataIters(&n, &creators));
+    RETVAL = newAV();
+    sv_2mortal((SV *)RETVAL);
+    for (i = 0; i < n; ++i) {
+      const char *name, *desc, **an, **at, **ad;
+      mx_uint na;
+      ck(aTHX_ MXDataIterGetIterInfo(creators[i], &name, &desc, &na,
+                                     &an, &at, &ad));
+      av_push(RETVAL, newSVpv(name, 0));
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+mxp_iter_create(name, keys_av, vals_av)
+    const char *name
+    AV *keys_av
+    AV *vals_av
+  CODE:
+    mx_uint n, i, nk, nv;
+    DataIterCreator *creators;
+    DataIterCreator found = NULL;
+    DataIterHandle it;
+    ck(aTHX_ MXListDataIters(&n, &creators));
+    for (i = 0; i < n && !found; ++i) {
+      const char *inm, *desc, **an, **at, **ad;
+      mx_uint na;
+      ck(aTHX_ MXDataIterGetIterInfo(creators[i], &inm, &desc, &na,
+                                     &an, &at, &ad));
+      if (strcmp(inm, name) == 0) found = creators[i];
+    }
+    if (!found) croak("mxtpu: unknown data iterator %s", name);
+    {
+      const char **keys = av_strs(aTHX_ keys_av, &nk);
+      const char **vals = av_strs(aTHX_ vals_av, &nv);
+      int rc;
+      if (nk != nv) {
+        free(keys);
+        free(vals);
+        croak("mxtpu: iterator param keys/vals length mismatch");
+      }
+      rc = MXDataIterCreateIter(found, nk, keys, vals, &it);
+      free(keys);
+      free(vals);
+      ck(aTHX_ rc);
+    }
+    RETVAL = (IV)it;
+  OUTPUT:
+    RETVAL
+
+void
+mxp_iter_free(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXDataIterFree((DataIterHandle)h));
+
+int
+mxp_iter_next(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXDataIterNext((DataIterHandle)h, &RETVAL));
+  OUTPUT:
+    RETVAL
+
+void
+mxp_iter_before_first(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXDataIterBeforeFirst((DataIterHandle)h));
+
+IV
+mxp_iter_data(h)
+    IV h
+  CODE:
+    NDArrayHandle out;
+    ck(aTHX_ MXDataIterGetData((DataIterHandle)h, &out));
+    RETVAL = (IV)out;
+  OUTPUT:
+    RETVAL
+
+IV
+mxp_iter_label(h)
+    IV h
+  CODE:
+    NDArrayHandle out;
+    ck(aTHX_ MXDataIterGetLabel((DataIterHandle)h, &out));
+    RETVAL = (IV)out;
+  OUTPUT:
+    RETVAL
+
+int
+mxp_iter_pad(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXDataIterGetPadNum((DataIterHandle)h, &RETVAL));
+  OUTPUT:
+    RETVAL
+
+int
+mxp_autograd_set_training(flag)
+    int flag
+  CODE:
+    int prev;
+    ck(aTHX_ MXAutogradSetIsTraining(flag, &prev));
+    RETVAL = prev;
+  OUTPUT:
+    RETVAL
+
+void
+mxp_autograd_mark_variables(vars_av, reqs_av, grads_av)
+    AV *vars_av
+    AV *reqs_av
+    AV *grads_av
+  CODE:
+    mx_uint nv, ng, i;
+    NDArrayHandle *vars = av_handles(aTHX_ vars_av, &nv);
+    NDArrayHandle *grads = av_handles(aTHX_ grads_av, &ng);
+    mx_uint *reqs = (mx_uint *)calloc(nv ? nv : 1, sizeof(mx_uint));
+    for (i = 0; i < nv; ++i) {
+      SV **sv = av_fetch(reqs_av, i, 0);
+      reqs[i] = sv ? (mx_uint)SvUV(*sv) : 1;
+    }
+    {
+      int rc = (nv == ng) ? MXAutogradMarkVariables(nv, vars, reqs, grads)
+                          : -1;
+      free(vars);
+      free(grads);
+      free(reqs);
+      if (nv != ng) croak("mxtpu: vars/grads length mismatch");
+      ck(aTHX_ rc);
+    }
+
+void
+mxp_autograd_backward_multi(heads_av, retain)
+    AV *heads_av
+    int retain
+  CODE:
+    mx_uint n;
+    NDArrayHandle *heads = av_handles(aTHX_ heads_av, &n);
+    int rc = MXAutogradBackward(n, heads, NULL, retain);
+    free(heads);
+    ck(aTHX_ rc);
+
+IV
+mxp_cached_create(sym)
+    IV sym
+  CODE:
+    CachedOpHandle out;
+    ck(aTHX_ MXCreateCachedOp((SymbolHandle)sym, &out));
+    RETVAL = (IV)out;
+  OUTPUT:
+    RETVAL
+
+void
+mxp_cached_free(h)
+    IV h
+  CODE:
+    ck(aTHX_ MXFreeCachedOp((CachedOpHandle)h));
+
+AV *
+mxp_cached_invoke(h, ins_av)
+    IV h
+    AV *ins_av
+  CODE:
+    mx_uint n;
+    int n_out = 0;
+    NDArrayHandle *outs = NULL;
+    NDArrayHandle *ins = av_handles(aTHX_ ins_av, &n);
+    int rc = MXInvokeCachedOp((CachedOpHandle)h, (int)n, ins, &n_out,
+                              &outs);
+    free(ins);
+    ck(aTHX_ rc);
+    RETVAL = handles_av(aTHX_ (mx_uint)n_out, outs);
+    sv_2mortal((SV *)RETVAL);
+  OUTPUT:
+    RETVAL

@@ -24,6 +24,9 @@ use AI::MXNetTPU::Symbol;
 use AI::MXNetTPU::Executor;
 use AI::MXNetTPU::KVStore;
 use AI::MXNetTPU::Module;
+use AI::MXNetTPU::IO;
+use AI::MXNetTPU::AutoGrad;
+use AI::MXNetTPU::CachedOp;
 
 sub version { AI::MXNetTPU::mxp_version() }
 sub seed    { AI::MXNetTPU::mxp_random_seed($_[1] // $_[0]) }
@@ -33,5 +36,7 @@ sub nd  { 'AI::MXNetTPU::NDArray' }
 sub sym { 'AI::MXNetTPU::Symbol' }
 sub mod { 'AI::MXNetTPU::Module' }
 sub kv  { 'AI::MXNetTPU::KVStore' }
+sub io  { 'AI::MXNetTPU::IO' }
+sub autograd { 'AI::MXNetTPU::AutoGrad' }
 
 1;

@@ -1,0 +1,67 @@
+package AI::MXNetTPU::AutoGrad;
+
+# Imperative autograd over the ABI tape (reference: AI::MXNet::AutoGrad,
+# perl-package/AI-MXNet/lib/AI/MXNet/AutoGrad.pm). Block-style record:
+#
+#   AI::MXNetTPU::AutoGrad->mark_variables([$w], [$gw]);
+#   my $loss = AI::MXNetTPU::AutoGrad->record(sub {
+#       my $p = AI::MXNetTPU::NDArray->invoke('FullyConnected',
+#                                             [$x, $w], {num_hidden => 1,
+#                                                        no_bias => 'True'});
+#       ...
+#   });
+#   AI::MXNetTPU::AutoGrad->backward([$loss]);
+#   # $gw now holds dloss/dw
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+sub set_recording { AI::MXNetTPU::mxp_autograd_set_recording($_[1]) }
+sub set_training  { AI::MXNetTPU::mxp_autograd_set_training($_[1]) }
+
+# record(sub { ... }): recording + train mode around the block, restored
+# on exit (also on exceptions)
+sub record {
+    my ($class, $code) = @_;
+    my $prev_r = AI::MXNetTPU::mxp_autograd_set_recording(1);
+    my $prev_t = AI::MXNetTPU::mxp_autograd_set_training(1);
+    my @out = eval { $code->() };
+    my $err = $@;
+    AI::MXNetTPU::mxp_autograd_set_recording($prev_r);
+    AI::MXNetTPU::mxp_autograd_set_training($prev_t);
+    croak $err if $err;
+    wantarray ? @out : $out[0];
+}
+
+my %REQ_CODE = (null => 0, write => 1, add => 3);
+
+sub _req_code {
+    my ($r) = @_;
+    return 1 unless defined $r;
+    return $r if $r =~ /^\d+$/;
+    croak "unknown grad_req '$r' (want null/write/add or 0/1/3)"
+        unless exists $REQ_CODE{$r};
+    $REQ_CODE{$r};
+}
+
+# mark_variables(\@vars, \@grads, \@reqs?): attach gradient buffers
+# (reqs: 'null'/'write'/'add' or codes 0/1/3; default write)
+sub mark_variables {
+    my ($class, $vars, $grads, $reqs) = @_;
+    croak "mark_variables needs vars + grads arefs"
+        unless ref $vars && ref $grads;
+    $reqs //= [map { 1 } @$vars];
+    AI::MXNetTPU::mxp_autograd_mark_variables(
+        [map { $_->handle } @$vars], [map { _req_code($_) } @$reqs],
+        [map { $_->handle } @$grads]);
+}
+
+sub backward {
+    my ($class, $heads, %kw) = @_;
+    $heads = [$heads] unless ref $heads eq 'ARRAY';
+    AI::MXNetTPU::mxp_autograd_backward_multi(
+        [map { $_->handle } @$heads], $kw{retain_graph} ? 1 : 0);
+}
+
+1;

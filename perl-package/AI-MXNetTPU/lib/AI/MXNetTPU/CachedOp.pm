@@ -1,0 +1,38 @@
+package AI::MXNetTPU::CachedOp;
+
+# A symbol compiled once into an XLA program (reference:
+# AI::MXNet::CachedOp — the op behind gluon hybridize). Inputs are
+# positional in list_arguments + list_auxiliary_states order;
+# differentiable through the autograd tape when recording:
+#
+#   my $op = AI::MXNetTPU::CachedOp->new($net);
+#   my @outs = $op->call($x, $w, $b);
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+sub new {
+    my ($class, $sym) = @_;
+    croak "CachedOp->new needs a Symbol" unless ref $sym;
+    bless { handle => AI::MXNetTPU::mxp_cached_create($sym->handle) },
+        $class;
+}
+
+sub call {
+    my ($self, @inputs) = @_;
+    my $outs = AI::MXNetTPU::mxp_cached_invoke(
+        $self->{handle}, [map { $_->handle } @inputs]);
+    my @wrapped = map { AI::MXNetTPU::NDArray->_wrap($_) } @$outs;
+    wantarray ? @wrapped : $wrapped[0];
+}
+
+sub handle { $_[0]{handle} }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::mxp_cached_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

@@ -1,0 +1,73 @@
+package AI::MXNetTPU::IO;
+
+# Data iterators over the ABI's DataIter group (reference:
+# AI::MXNet::IO, perl-package/AI-MXNet/lib/AI/MXNet/IO.pm — iterators
+# created by name through MXDataIterCreateIter). Creators compose by
+# AUTOLOAD, AI::MXNet style:
+#
+#   my $it = AI::MXNetTPU::IO->CSVIter(
+#       data_csv => 'x.csv', data_shape => '(1,8,8)',
+#       label_csv => 'y.csv', batch_size => 32);
+#   while ($it->next) { my ($x, $y) = ($it->data, $it->label); ... }
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+our $AUTOLOAD;
+
+sub list { AI::MXNetTPU::mxp_list_data_iters() }
+
+sub create {
+    my ($class, $name, %params) = @_;
+    my @keys = sort keys %params;
+    # arrayref values (natural perl shapes) serialize to "(a,b,c)"
+    my @vals = map {
+        ref $params{$_} eq 'ARRAY'
+            ? '(' . join(',', @{ $params{$_} }) . ')'
+            : "$params{$_}"
+    } @keys;
+    my $h = AI::MXNetTPU::mxp_iter_create($name, \@keys, \@vals);
+    AI::MXNetTPU::IO::DataIter->_wrap($h);
+}
+
+sub AUTOLOAD {
+    my $class = shift;
+    (my $name = $AUTOLOAD) =~ s/.*:://;
+    return if $name eq 'DESTROY';
+    $class->create($name, @_);
+}
+
+package AI::MXNetTPU::IO::DataIter;
+
+use strict;
+use warnings;
+
+sub _wrap { my ($class, $h) = @_; bless { handle => $h }, $class }
+
+sub reset { AI::MXNetTPU::mxp_iter_before_first($_[0]{handle}); $_[0] }
+
+sub next { AI::MXNetTPU::mxp_iter_next($_[0]{handle}) }
+
+# batch accessors return fresh owned NDArrays
+sub data {
+    AI::MXNetTPU::NDArray->_wrap(
+        AI::MXNetTPU::mxp_iter_data($_[0]{handle}));
+}
+
+sub label {
+    AI::MXNetTPU::NDArray->_wrap(
+        AI::MXNetTPU::mxp_iter_label($_[0]{handle}));
+}
+
+sub pad { AI::MXNetTPU::mxp_iter_pad($_[0]{handle}) }
+
+sub handle { $_[0]{handle} }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::mxp_iter_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

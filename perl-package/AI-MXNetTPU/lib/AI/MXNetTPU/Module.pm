@@ -112,6 +112,71 @@ sub fit {
     $self->score($xs, $ys);
 }
 
+# fit_iter($data_iter, epochs => N, eval_iter => $it2): train from an
+# AI::MXNetTPU::IO::DataIter (device-to-device batch assignment — no
+# host round trip per batch); returns accuracy over eval_iter (or the
+# training iterator when not given).
+sub _assign_batch {
+    my ($self, $name, $src) = @_;
+    my $dst = $self->{arrays}{$name};
+    my ($ds, $ss) = ("@{$dst->shape}", "@{$src->shape}");
+    croak "batch shape ($ss) != bound shape ($ds) for '$name' — "
+        . "rebind or match the iterator's batch_size" unless $ds eq $ss;
+    $dst->copy_from_ndarray($src);
+}
+
+sub fit_iter {
+    my ($self, $it, %kw) = @_;
+    my $epochs = $kw{epochs} // 10;
+    for my $ep (1 .. $epochs) {
+        $it->reset;
+        while ($it->next) {
+            $self->_assign_batch($self->{data_name}, $it->data);
+            $self->_assign_batch($self->{label_name}, $it->label);
+            $self->{exec}->forward(1);
+            $self->{exec}->backward;
+            $self->update;
+        }
+    }
+    $self->score_iter($kw{eval_iter} // $it);
+}
+
+# argmax accuracy over one batch's probs; $skip trailing pad rows
+sub _batch_accuracy {
+    my ($probs, $labels, $skip) = @_;
+    my $b = scalar @$labels;
+    my $classes = scalar(@$probs) / $b;
+    my ($hit, $tot) = (0, 0);
+    for my $r (0 .. $b - 1 - ($skip // 0)) {
+        my ($best, $bi) = (-1, 0);
+        for my $c (0 .. $classes - 1) {
+            if ($probs->[$r * $classes + $c] > $best) {
+                $best = $probs->[$r * $classes + $c];
+                $bi = $c;
+            }
+        }
+        ++$hit if $bi == $labels->[$r];
+        ++$tot;
+    }
+    ($hit, $tot);
+}
+
+sub score_iter {
+    my ($self, $it) = @_;
+    my ($hit, $tot) = (0, 0);
+    $it->reset;
+    while ($it->next) {
+        my ($x, $y) = ($it->data, $it->label);
+        $self->_assign_batch($self->{data_name}, $x);
+        $self->{exec}->forward(0);
+        my ($h, $t) = _batch_accuracy(
+            $self->{exec}->outputs->[0]->values, $y->values, $it->pad);
+        $hit += $h;
+        $tot += $t;
+    }
+    $tot ? $hit / $tot : 0;
+}
+
 sub score {
     my ($self, $xs, $ys) = @_;
     my $b = $self->{batch};
@@ -122,19 +187,11 @@ sub score {
         my @x = @$xs[$i * $dim .. ($i + $b) * $dim - 1];
         $self->{arrays}{ $self->{data_name} }->set(\@x);
         $self->{exec}->forward(0);
-        my $probs = $self->{exec}->outputs->[0]->values;
-        my $classes = scalar(@$probs) / $b;
-        for my $r (0 .. $b - 1) {
-            my ($best, $bi) = (-1, 0);
-            for my $c (0 .. $classes - 1) {
-                if ($probs->[$r * $classes + $c] > $best) {
-                    $best = $probs->[$r * $classes + $c];
-                    $bi = $c;
-                }
-            }
-            ++$hit if $bi == $ys->[$i + $r];
-            ++$tot;
-        }
+        my ($h, $t) = _batch_accuracy(
+            $self->{exec}->outputs->[0]->values,
+            [@$ys[$i .. $i + $b - 1]], 0);
+        $hit += $h;
+        $tot += $t;
     }
     $tot ? $hit / $tot : 0;
 }

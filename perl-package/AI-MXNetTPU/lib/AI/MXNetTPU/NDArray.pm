@@ -10,9 +10,12 @@ use warnings;
 use Carp qw(croak);
 
 use overload
-    '+' => sub { _binop('broadcast_add', @_) },
-    '-' => sub { _binop('broadcast_sub', @_) },
-    '*' => sub { _binop('broadcast_mul', @_) },
+    '+' => sub { _binop('broadcast_add', '_plus_scalar', @_) },
+    '-' => sub { _binop('broadcast_sub', '_minus_scalar', @_,
+                        '_rminus_scalar') },
+    '*' => sub { _binop('broadcast_mul', '_mul_scalar', @_) },
+    '/' => sub { _binop('broadcast_div', '_div_scalar', @_,
+                        '_rdiv_scalar') },
     '""' => sub { my $s = $_[0]->shape; "<NDArray " . join('x', @$s) . ">" };
 
 sub _wrap { my ($class, $h) = @_; bless { handle => $h, own => 1 }, $class }
@@ -52,6 +55,35 @@ sub size {
 
 sub handle { $_[0]{handle} }
 
+sub dtype { AI::MXNetTPU::mxp_nd_dtype($_[0]{handle}) }
+
+# device-to-device value copy (no host round trip)
+sub copy_from_ndarray {
+    my ($self, $src) = @_;
+    AI::MXNetTPU::mxp_nd_assign($self->{handle}, $src->{handle});
+    $self;
+}
+
+# autograd conveniences (AI::MXNet::NDArray style)
+sub attach_grad {
+    my ($self, $req) = @_;
+    my $grad = __PACKAGE__->zeros($self->shape);
+    # $req accepts 'null'/'write'/'add' or codes (AutoGrad validates)
+    AI::MXNetTPU::AutoGrad->mark_variables([$self], [$grad], [$req]);
+    $self->{_grad} = $grad;
+    $self;
+}
+
+sub grad {
+    my ($self) = @_;
+    return $self->{_grad} if $self->{_grad};
+    __PACKAGE__->_wrap(AI::MXNetTPU::mxp_nd_get_grad($self->{handle}));
+}
+
+sub detach {
+    __PACKAGE__->_wrap(AI::MXNetTPU::mxp_nd_detach($_[0]{handle}));
+}
+
 # invoke a named op on NDArray / scalar-string params:
 #   AI::MXNetTPU::NDArray->invoke('sgd_update', [$w, $g], {lr => 0.1})
 sub invoke {
@@ -65,9 +97,15 @@ sub invoke {
     wantarray ? @wrapped : $wrapped[0];
 }
 
+# operator overloading: NDArray op NDArray -> broadcast op;
+# NDArray op scalar -> the *_scalar op (reversed scalar forms where
+# order matters, AI::MXNet::NDArray's dispatch)
 sub _binop {
-    my ($op, $a, $b, $swap) = @_;
-    croak "NDArray ops need NDArray operands" unless ref $b;
+    my ($op, $scalar_op, $a, $b, $swap, $rscalar_op) = @_;
+    if (!ref $b) {
+        my $name = ($swap && $rscalar_op) ? $rscalar_op : $scalar_op;
+        return __PACKAGE__->invoke($name, [$a], { scalar => $b });
+    }
     ($a, $b) = ($b, $a) if $swap;
     __PACKAGE__->invoke($op, [$a, $b]);
 }
