@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..base import AttrSpec, MXNetError
-from .registry import register
+from .registry import OP_TABLE, register
 
 # ---------------------------------------------------------------------------
 # box helpers (shared by multibox + proposal)
@@ -535,6 +535,14 @@ def _ctc_loss(*args, use_data_lengths=False, use_label_lengths=False,
     logp = jnp.transpose(logp, (1, 0, 2))  # (N, T, C)
     return jax.vmap(_ctc_forward)(logp, label.astype(jnp.int32),
                                   data_len, label_len)
+
+
+# symbol auto-fill names follow the attrs (see symbol_invoke): the
+# lengths inputs exist only when their use_* flag is set
+OP_TABLE["_contrib_CTCLoss"].dynamic_input_names = lambda attrs: (
+    ["data", "label"]
+    + (["data_lengths"] if attrs.get("use_data_lengths") else [])
+    + (["label_lengths"] if attrs.get("use_label_lengths") else []))
 
 
 # ---------------------------------------------------------------------------

@@ -770,11 +770,18 @@ def symbol_invoke(opdef: OpDef, inputs: Sequence[Symbol], attrs: Dict,
             input_names = dyn(parsed)
     if input_names and not opdef.key_var_num_args:
         n_expected = len(input_names)
+        fill_names = input_names
         if opdef.num_inputs is None and opdef.input_names is not None:
             # variadic by attrs (e.g. no_bias drops bias; prelu adds gamma)
             n_expected = _expected_inputs(opdef, parsed)
+            # attr-gated OPTIONAL inputs (CTCLoss lengths): positional
+            # fill names would mislabel, e.g. use_label_lengths alone
+            # must auto-name slot 2 'label_lengths', not 'data_lengths'
+            dyn_fill = getattr(opdef, "dynamic_input_names", None)
+            if dyn_fill is not None:
+                fill_names = dyn_fill(parsed) or input_names
         while len(entries) < n_expected:
-            in_name = input_names[len(entries)]
+            in_name = fill_names[len(entries)]
             v = Variable(f"{name}_{in_name}")
             entries.append(v._outputs[0])
     if opdef.key_var_num_args and not parsed.get(opdef.key_var_num_args):
@@ -792,6 +799,9 @@ def _expected_inputs(opdef: OpDef, attrs: Dict) -> int:
         return 2 if attrs.get("use_sequence_length") else 1
     if opdef.name == "UpSampling":
         return int(attrs.get("num_args", 1) or 1)
+    if opdef.name == "_contrib_CTCLoss":
+        return (2 + bool(attrs.get("use_data_lengths"))
+                + bool(attrs.get("use_label_lengths")))
     return len(opdef.input_names or ["data"])
 
 
