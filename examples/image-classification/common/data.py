@@ -1,0 +1,78 @@
+"""Shared data layer for the image-classification examples.
+
+Reference analogue: example/image-classification/common/data.py — the
+argparse group for augmentation flags + the train/val iterator factory.
+No-egress twist: datasets are synthetic "structured class" images (each
+class is a deterministic frequency pattern + noise), so convergence is
+meaningful and CI-friendly; augmentation flags apply real host-side
+transforms like the reference's ImageRecordIter options.
+"""
+import numpy as np
+
+from mxnet_tpu.io import NDArrayIter
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "dataset and augmentation")
+    data.add_argument("--num-classes", type=int, default=10)
+    data.add_argument("--num-examples", type=int, default=512)
+    data.add_argument("--image-shape", default="32,32,3",
+                      help="H,W,C (NHWC — the TPU-native layout)")
+    data.add_argument("--rand-mirror", type=int, default=1,
+                      help="1: random horizontal flips at load time")
+    data.add_argument("--rand-crop", type=int, default=0,
+                      help="1: random crop from +4px padded images")
+    data.add_argument("--max-random-scale", type=float, default=1.0,
+                      help=">1: random brightness scale upper bound")
+    return data
+
+
+def _class_pattern(cls, h, w, c, rng):
+    """Deterministic per-class pattern: a 2-D sinusoid grid whose
+    frequency/orientation encode the class, plus sample noise."""
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    fy, fx = 1 + cls % 4, 1 + (cls // 4) % 4
+    base = np.sin(2 * np.pi * fy * ys / h) * np.cos(2 * np.pi * fx * xs / w)
+    img = np.repeat(base[:, :, None], c, axis=2) * 0.5 + 0.5
+    return (img + rng.normal(0, 0.25, img.shape)).astype(np.float32)
+
+
+def _augment(img, args, rng):
+    if args.rand_mirror and rng.rand() < 0.5:
+        img = img[:, ::-1]
+    if args.rand_crop:
+        h, w, _ = img.shape
+        padded = np.zeros((h + 8, w + 8, img.shape[2]), img.dtype)
+        padded[4:4 + h, 4:4 + w] = img
+        oy, ox = rng.randint(0, 9), rng.randint(0, 9)
+        img = padded[oy:oy + h, ox:ox + w]
+    if args.max_random_scale > 1.0:
+        img = img * rng.uniform(1.0, args.max_random_scale)
+    return img
+
+
+def synthetic_iters(args, kv=None):
+    """(train_iter, val_iter) honoring the augmentation flags. With a
+    multi-worker kvstore each rank takes its own 1/num_workers slice of
+    the example budget (the reference's part_index/num_parts split), so
+    fit.lr_schedule's per-worker epoch_size matches what actually runs."""
+    h, w, c = (int(v) for v in args.image_shape.split(","))
+    rank = kv.rank if kv else 0
+    workers = max(kv.num_workers, 1) if kv else 1
+    rng = np.random.RandomState(100 + rank)
+    n = args.num_examples // workers
+    labels = rng.randint(0, args.num_classes, n)
+    train_x = np.stack([
+        _augment(_class_pattern(int(y), h, w, c, rng), args, rng)
+        for y in labels])
+    val_n = max(args.batch_size, n // 4)
+    val_y = rng.randint(0, args.num_classes, val_n)
+    val_x = np.stack([_class_pattern(int(y), h, w, c, rng)
+                      for y in val_y])
+    train = NDArrayIter({"data": train_x},
+                        {"softmax_label": labels.astype(np.float32)},
+                        batch_size=args.batch_size, shuffle=True)
+    val = NDArrayIter({"data": val_x},
+                      {"softmax_label": val_y.astype(np.float32)},
+                      batch_size=args.batch_size)
+    return train, val

@@ -1,0 +1,140 @@
+"""Shared training layer for the image-classification examples.
+
+Reference analogue: example/image-classification/common/fit.py — the
+argparse surface and fit() loop every train_* script shares: kvstore
+choice, multi-step lr schedule, checkpoint save/resume, top-k metrics,
+progress logging, parameter monitoring. Own design notes: schedules are
+expressed in epochs and compiled to a MultiFactorScheduler in update
+steps; resume restores both epoch and schedule position; dtype flows to
+the symbol builder (bf16 = the MXU-native training dtype).
+"""
+import logging
+import os
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    train = parser.add_argument_group("Training", "model training")
+    train.add_argument("--network", default="resnet")
+    train.add_argument("--num-layers", type=int, default=18)
+    train.add_argument("--batch-size", type=int, default=64)
+    train.add_argument("--num-epochs", type=int, default=4)
+    train.add_argument("--lr", type=float, default=0.05)
+    train.add_argument("--lr-factor", type=float, default=0.1,
+                       help="multiply lr by this at each step epoch")
+    train.add_argument("--lr-step-epochs", default="",
+                       help="comma list of epochs to decay at, e.g. 2,3")
+    train.add_argument("--optimizer", default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=1e-4)
+    train.add_argument("--kv-store", default="local")
+    train.add_argument("--model-prefix", default=None,
+                       help="checkpoint path prefix (enables saving)")
+    train.add_argument("--load-epoch", type=int, default=None,
+                       help="resume from this saved epoch")
+    train.add_argument("--disp-batches", type=int, default=10)
+    train.add_argument("--top-k", type=int, default=0)
+    train.add_argument("--monitor", type=int, default=0,
+                       help="log parameter stats every N batches")
+    train.add_argument("--dtype", default="float32")
+    return train
+
+
+def lr_schedule(args, kv):
+    """(base_lr, scheduler) from the epoch-step flags; resume-aware."""
+    if not args.lr_step_epochs:
+        return args.lr, None
+    epoch_size = max(args.num_examples // args.batch_size, 1)
+    if "dist" in args.kv_store:
+        epoch_size = max(epoch_size // kv.num_workers, 1)
+    begin = args.load_epoch or 0
+    step_epochs = [int(e) for e in args.lr_step_epochs.split(",")]
+    lr = args.lr * (args.lr_factor ** sum(1 for e in step_epochs
+                                          if begin >= e))
+    steps = [epoch_size * (e - begin) for e in step_epochs if e > begin]
+    sched = (mx.lr_scheduler.MultiFactorScheduler(
+        step=steps, factor=args.lr_factor) if steps else None)
+    return lr, sched
+
+
+def load_checkpoint_if_requested(args):
+    """(sym, arg_params, aux_params) or (None, None, None)."""
+    if args.load_epoch is None:
+        return None, None, None
+    assert args.model_prefix, "--load-epoch needs --model-prefix"
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model_prefix, args.load_epoch)
+    logging.info("resumed %s epoch %d", args.model_prefix,
+                 args.load_epoch)
+    return sym, arg_params, aux_params
+
+
+def make_metric(args):
+    metrics = [mx.metric.Accuracy()]
+    if args.top_k > 0:
+        metrics.append(mx.metric.TopKAccuracy(top_k=args.top_k))
+    return mx.metric.CompositeEvalMetric(metrics) if len(metrics) > 1 \
+        else metrics[0]
+
+
+def fit(args, network, data_loader, arg_params=None, aux_params=None):
+    """Train ``network`` with the shared loop.
+
+    network: Symbol ending in SoftmaxOutput; data_loader:
+    fn(args, kv) -> (train_iter, val_iter). ``arg_params``/``aux_params``
+    seed the parameters (fine-tuning); a --load-epoch checkpoint wins
+    when both are present. Returns (Module, val_iter).
+    """
+    kv = mx.kvstore.create(args.kv_store)
+    logging.basicConfig(level=logging.INFO,
+                        format=f"%(asctime)-15s Node[{kv.rank}] "
+                               "%(message)s")
+    train, val = data_loader(args, kv)
+
+    ckpt_sym, ckpt_args, ckpt_aux = load_checkpoint_if_requested(args)
+    if ckpt_sym is not None:
+        network = ckpt_sym
+        arg_params, aux_params = ckpt_args, ckpt_aux
+
+    lr, sched = lr_schedule(args, kv)
+    opt_params = {"learning_rate": lr,
+                  "wd": args.wd,
+                  "rescale_grad": 1.0 / args.batch_size}
+    if args.optimizer in ("sgd", "nag"):
+        opt_params["momentum"] = args.mom
+    if sched is not None:
+        opt_params["lr_scheduler"] = sched
+
+    checkpoint = None
+    if args.model_prefix:
+        dst = os.path.dirname(args.model_prefix)
+        if dst and not os.path.isdir(dst):
+            os.makedirs(dst, exist_ok=True)
+        checkpoint = mx.callback.do_checkpoint(
+            args.model_prefix if kv.rank == 0
+            else f"{args.model_prefix}-{kv.rank}")
+
+    monitor = (mx.mon.Monitor(args.monitor, pattern=".*weight")
+               if args.monitor > 0 else None)
+
+    mod = mx.mod.Module(network, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train,
+            eval_data=val,
+            eval_metric=make_metric(args),
+            kvstore=kv,
+            optimizer=args.optimizer,
+            optimizer_params=opt_params,
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            arg_params=arg_params,
+            aux_params=aux_params,
+            allow_missing=True,
+            begin_epoch=args.load_epoch or 0,
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches),
+            epoch_end_callback=checkpoint,
+            monitor=monitor)
+    return mod, val

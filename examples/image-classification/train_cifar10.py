@@ -1,57 +1,50 @@
-"""CIFAR-10-shaped image classification with gluon model_zoo.
+"""CIFAR-10-shaped training over the shared fit layer.
 
-Reference analogue: example/gluon/image_classification.py — model_zoo
-network, gluon Trainer, DataLoader-style batching. Synthetic data by
-default (no egress); real CIFAR-10 via gluon.data.vision if present.
+Reference analogue: example/image-classification/train_cifar10.py — a
+thin entry: argparse from common.fit/common.data, the network from the
+symbol zoo, everything else (kvstore, lr steps, checkpointing, metrics)
+in the shared fit(). Synthetic structured-class data (no egress); the
+convergence assert makes this a CI gate like the reference's tests.
+
+Run:  python train_cifar10.py --num-epochs 4 --lr-step-epochs 3
+      python train_cifar10.py --model-prefix /tmp/c10 \
+          --load-epoch 2 --num-epochs 4        # resume
 """
 import argparse
-import time
+import os
+import sys
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import data, fit  # noqa: E402
 
-import mxnet_tpu as mx
-from mxnet_tpu.gluon.model_zoo import vision
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
 
 
 def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="resnet18_v1")
-    ap.add_argument("--epochs", type=int, default=2)
-    ap.add_argument("--batch-size", type=int, default=64)
-    ap.add_argument("--samples", type=int, default=512)
-    ap.add_argument("--lr", type=float, default=0.1)
-    args = ap.parse_args()
+    parser = argparse.ArgumentParser(
+        description="train on cifar10-shaped data",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(image_shape="32,32,3", num_classes=10,
+                        num_layers=18, batch_size=32, num_examples=512,
+                        lr=0.05, lr_step_epochs="3")
+    parser.add_argument("--acc-gate", type=float, default=0.8,
+                        help="assert final validation accuracy >= this")
+    args = parser.parse_args()
 
-    rng = np.random.RandomState(0)
-    x = rng.rand(args.samples, 3, 32, 32).astype(np.float32)
-    y = rng.randint(0, 10, args.samples).astype(np.float32)
+    sym = models.get_symbol(args.network, num_layers=args.num_layers,
+                            num_classes=args.num_classes,
+                            image_shape=args.image_shape,
+                            dtype=args.dtype)
+    mod, val = fit.fit(args, sym, data.synthetic_iters)
 
-    net = vision.get_model(args.model, classes=10)
-    net.initialize(init=mx.init.Xavier())
-    net.hybridize()
-    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
-                               {"learning_rate": args.lr, "momentum": 0.9})
-    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
-    metric = mx.metric.Accuracy()
-
-    nb = args.samples // args.batch_size
-    for epoch in range(args.epochs):
-        metric.reset()
-        tic = time.time()
-        for i in range(nb):
-            sl = slice(i * args.batch_size, (i + 1) * args.batch_size)
-            xb = mx.nd.array(x[sl])
-            yb = mx.nd.array(y[sl])
-            with mx.autograd.record():
-                out = net(xb)
-                loss = loss_fn(out, yb)
-            loss.backward()
-            trainer.step(args.batch_size)
-            metric.update([yb], [out])
-        name, acc = metric.get()
-        print(f"epoch {epoch}: {name}={acc:.4f} "
-              f"({args.samples / (time.time() - tic):.0f} samples/s)")
-    print("done")
+    val.reset()
+    score = mod.score(val, mx.metric.Accuracy())
+    acc = score[0][1]
+    print(f"final validation accuracy {acc:.4f}")
+    assert acc >= args.acc_gate, f"accuracy {acc:.4f} < {args.acc_gate}"
 
 
 if __name__ == "__main__":

@@ -1,32 +1,34 @@
-"""ImageNet-style training: symbolic ResNet over the SPMD mesh trainer.
+"""ImageNet-style training over the shared fit layer.
 
-Reference analogue: example/image-classification/train_imagenet.py with
-its ``--benchmark 1`` mode (synthetic data, measures throughput). The
-multi-GPU `--gpus` flag becomes mesh axes: data parallelism over every
-visible device (and tensor parallelism via --model-parallel N).
+Reference analogue: example/image-classification/train_imagenet.py —
+the same thin entry over common/fit.py + common/data.py, plus the
+reference's --benchmark mode (synthetic data, measure throughput). The
+TPU-native twist: benchmark mode runs the SPMD mesh trainer (data
+parallel over every visible device x optional tensor parallelism) the
+way the reference's --gpus ran multi-GPU; training mode runs the
+shared Module fit loop with kvstore/lr-steps/checkpointing.
+
+Run:  python train_imagenet.py --num-layers 50 --benchmark 1
+      python train_imagenet.py --num-layers 18 \
+          --image-shape 64,64,3 --num-classes 10
 """
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import data, fit  # noqa: E402
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--network", default="resnet")
-    ap.add_argument("--num-layers", type=int, default=50)
-    ap.add_argument("--batch-size", type=int, default=64)
-    ap.add_argument("--image-shape", default="224,224,3")
-    ap.add_argument("--num-classes", type=int, default=1000)
-    ap.add_argument("--iters", type=int, default=10)
-    ap.add_argument("--lr", type=float, default=0.1)
-    ap.add_argument("--dtype", default="bfloat16")
-    ap.add_argument("--model-parallel", type=int, default=1,
-                    help="tensor-parallel degree (mesh 'model' axis)")
-    args = ap.parse_args()
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
 
+
+def benchmark(args):
+    """Throughput on synthetic data over the SPMD mesh (dp x tp)."""
     import jax
-    from mxnet_tpu import models
     from mxnet_tpu.parallel import SPMDTrainer, make_mesh
 
     n_dev = len(jax.devices())
@@ -37,20 +39,22 @@ def main():
 
     sym = models.get_symbol(args.network, num_layers=args.num_layers,
                             num_classes=args.num_classes,
-                            image_shape=args.image_shape, dtype=args.dtype)
+                            image_shape=args.image_shape,
+                            dtype=args.dtype)
     h, w, c = (int(v) for v in args.image_shape.split(","))
     tr = SPMDTrainer(sym, optimizer="sgd",
                      optimizer_params={"learning_rate": args.lr,
-                                       "momentum": 0.9,
-                                       "rescale_grad": 1.0 / args.batch_size},
+                                       "momentum": args.mom,
+                                       "rescale_grad":
+                                           1.0 / args.batch_size},
                      mesh=mesh, compute_dtype=args.dtype)
     tr.bind(data_shapes={"data": (args.batch_size, h, w, c)},
             label_shapes={"softmax_label": (args.batch_size,)})
 
     rng = np.random.RandomState(0)
     x = rng.rand(args.batch_size, h, w, c).astype(np.float32)
-    y = rng.randint(0, args.num_classes, args.batch_size).astype(np.float32)
-
+    y = rng.randint(0, args.num_classes,
+                    args.batch_size).astype(np.float32)
     tr.step({"data": x, "softmax_label": y})  # compile
     tic = time.time()
     for _ in range(args.iters):
@@ -60,6 +64,39 @@ def main():
     print(f"{args.network}-{args.num_layers} bs{args.batch_size}: "
           f"{args.batch_size / dt:.1f} images/sec "
           f"({args.batch_size / dt / n_dev:.1f}/chip)")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train on imagenet-shaped data",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(image_shape="224,224,3", num_classes=1000,
+                        num_layers=50, batch_size=64, lr=0.1,
+                        lr_step_epochs="2,3", dtype="bfloat16",
+                        num_examples=256)
+    parser.add_argument("--benchmark", type=int, default=0,
+                        help="1: synthetic-data throughput over the "
+                             "SPMD mesh instead of training")
+    parser.add_argument("--iters", type=int, default=10,
+                        help="benchmark iterations")
+    parser.add_argument("--model-parallel", type=int, default=1,
+                        help="tensor-parallel degree (mesh 'model' axis)")
+    args = parser.parse_args()
+
+    if args.benchmark:
+        benchmark(args)
+        return
+
+    sym = models.get_symbol(args.network, num_layers=args.num_layers,
+                            num_classes=args.num_classes,
+                            image_shape=args.image_shape,
+                            dtype=args.dtype)
+    mod, val = fit.fit(args, sym, data.synthetic_iters)
+    val.reset()
+    score = mod.score(val, mx.metric.Accuracy())
+    print(f"final validation accuracy {score[0][1]:.4f}")
 
 
 if __name__ == "__main__":

@@ -15,12 +15,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CASES = [
     ("module/mnist_mlp.py", ["--epochs", "8"]),
     ("autograd/linear_regression.py", ["--iters", "60"]),
-    ("image-classification/train_cifar10.py",
-     ["--epochs", "1", "--samples", "128", "--batch-size", "32"]),
+    ("image-classification/train_cifar10.py", []),
     ("image-classification/train_imagenet.py",
-     ["--num-layers", "18", "--batch-size", "8", "--iters", "2",
-      "--image-shape", "64,64,3", "--num-classes", "10",
+     ["--benchmark", "1", "--num-layers", "18", "--batch-size", "8",
+      "--iters", "2", "--image-shape", "64,64,3", "--num-classes", "10",
       "--dtype", "float32"]),
+    ("image-classification/fine_tune.py", []),
     ("rnn/lstm_bucketing.py", ["--epochs", "6"]),
     ("numpy-ops/custom_softmax.py", []),
     ("torch/torch_module_mlp.py", []),
