@@ -1,12 +1,22 @@
-"""Samplers (reference: python/mxnet/gluon/data/sampler.py:24-120)."""
+"""Index samplers for gluon data loading.
+
+API parity: python/mxnet/gluon/data/sampler.py (Sampler, Sequential,
+Random, Batch with keep/discard/rollover tail policies). The batch
+grouping here materialises the epoch order once and chunks it by
+slicing — one host-side pass, no per-index accumulation loop.
+"""
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
+_TAIL_POLICIES = ("keep", "discard", "rollover")
+
 
 class Sampler:
+    """Iterable over dataset indices; concrete samplers define the order."""
+
     def __iter__(self):
         raise NotImplementedError
 
@@ -15,57 +25,65 @@ class Sampler:
 
 
 class SequentialSampler(Sampler):
+    """Indices ``0..length-1`` in order."""
+
     def __init__(self, length):
-        self._length = length
+        self._n = int(length)
 
     def __iter__(self):
-        return iter(range(self._length))
+        yield from range(self._n)
 
     def __len__(self):
-        return self._length
+        return self._n
 
 
 class RandomSampler(Sampler):
+    """A fresh uniform permutation of ``0..length-1`` each epoch."""
+
     def __init__(self, length):
-        self._length = length
+        self._n = int(length)
 
     def __iter__(self):
-        return iter(np.random.permutation(self._length).tolist())
+        yield from np.random.permutation(self._n).tolist()
 
     def __len__(self):
-        return self._length
+        return self._n
 
 
 class BatchSampler(Sampler):
-    """Group a sampler into batches; last_batch in {keep, discard, rollover}
-    (reference: sampler.py BatchSampler:75)."""
+    """Chunk an index sampler into fixed-size batches.
+
+    ``last_batch`` picks the tail policy: ``keep`` emits the short tail,
+    ``discard`` drops it, ``rollover`` carries it into the next epoch's
+    first batch.
+    """
 
     def __init__(self, sampler, batch_size, last_batch="keep"):
-        self._sampler = sampler
-        self._batch_size = batch_size
-        self._last_batch = last_batch
-        self._prev = []
-        if last_batch not in ("keep", "discard", "rollover"):
+        if last_batch not in _TAIL_POLICIES:
             raise ValueError(
-                f"last_batch must be keep/discard/rollover, got {last_batch}")
+                f"last_batch must be one of {_TAIL_POLICIES}, got {last_batch!r}")
+        self._source = sampler
+        self._size = int(batch_size)
+        self._policy = last_batch
+        self._carry = []
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
-            if len(batch) == self._batch_size:
-                yield batch
-                batch = []
-        if batch:
-            if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "rollover":
-                self._prev = batch
+        order = self._carry
+        self._carry = []
+        order = order + list(self._source)
+        full = len(order) // self._size
+        for b in range(full):
+            yield order[b * self._size:(b + 1) * self._size]
+        tail = order[full * self._size:]
+        if tail and self._policy == "keep":
+            yield tail
+        elif tail and self._policy == "rollover":
+            self._carry = tail
 
     def __len__(self):
-        if self._last_batch == "keep":
-            return (len(self._sampler) + self._batch_size - 1) \
-                // self._batch_size
-        if self._last_batch == "discard":
-            return len(self._sampler) // self._batch_size
-        return (len(self._sampler) + len(self._prev)) // self._batch_size
+        n = len(self._source)
+        if self._policy == "keep":
+            return -(-n // self._size)
+        if self._policy == "rollover":
+            n += len(self._carry)
+        return n // self._size

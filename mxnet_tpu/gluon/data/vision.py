@@ -23,38 +23,39 @@ __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "ImageRecordDataset",
            "ImageFolderDataset"]
 
 
-class _DownloadedDataset(dataset.Dataset):
+class _OnDiskDataset(dataset.Dataset):
+    """In-memory (data, label) arrays loaded from local files; subclasses
+    implement :meth:`_load` and assign ``self._data``/``self._label``."""
+
     def __init__(self, root, train, transform):
         self._root = os.path.expanduser(root)
-        self._train = train
+        self._train = bool(train)
         self._transform = transform
-        self._data = None
-        self._label = None
-        self._get_data()
+        self._data = self._label = None
+        self._load()
 
     def __getitem__(self, idx):
-        if self._transform is not None:
-            return self._transform(self._data[idx], self._label[idx])
-        return self._data[idx], self._label[idx]
+        sample = (self._data[idx], self._label[idx])
+        return sample if self._transform is None else self._transform(*sample)
 
     def __len__(self):
         return len(self._label)
 
     def _require(self, *fnames):
         paths = [os.path.join(self._root, f) for f in fnames]
-        missing = [p for p in paths if not os.path.exists(p)]
-        if missing:
+        absent = [p for p in paths if not os.path.exists(p)]
+        if absent:
             raise MXNetError(
-                f"{type(self).__name__}: dataset files not found: {missing}. "
+                f"{type(self).__name__}: dataset files not found: {absent}. "
                 "This build has no network egress — place the files under "
                 f"{self._root} manually.")
         return paths
 
-    def _get_data(self):
+    def _load(self):
         raise NotImplementedError
 
 
-class MNIST(_DownloadedDataset):
+class MNIST(_OnDiskDataset):
     """MNIST from idx-format files (reference: vision.py MNIST:59)."""
 
     _train_files = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
@@ -66,28 +67,27 @@ class MNIST(_DownloadedDataset):
 
     @staticmethod
     def _open(path):
-        return gzip.open(path, "rb") if path.endswith(".gz") \
-            else open(path, "rb")
+        opener = gzip.open if path.endswith(".gz") else open
+        return opener(path, "rb")
 
-    def _get_data(self):
-        files = self._train_files if self._train else self._test_files
+    def _load(self):
+        wanted = self._train_files if self._train else self._test_files
         # accept both gzipped and unpacked idx files
-        avail = []
-        for f in files:
-            p = os.path.join(self._root, f)
-            if not os.path.exists(p) and os.path.exists(p[:-3]):
-                f = f[:-3]
-            avail.append(f)
-        data_path, label_path = self._require(*avail)
+        names = []
+        for f in wanted:
+            gz = os.path.join(self._root, f)
+            names.append(f if os.path.exists(gz) or
+                         not os.path.exists(gz[:-3]) else f[:-3])
+        data_path, label_path = self._require(*names)
         with self._open(label_path) as fin:
-            struct.unpack(">II", fin.read(8))
-            label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+            fin.read(8)  # idx header: magic + item count
+            self._label = np.frombuffer(
+                fin.read(), dtype=np.uint8).astype(np.int32)
         with self._open(data_path) as fin:
             _, num, rows, cols = struct.unpack(">IIII", fin.read(16))
-            data = np.frombuffer(fin.read(), dtype=np.uint8)
-            data = data.reshape(num, rows, cols, 1)
-        self._data = nd_array(data.astype(np.float32) / 255.0)
-        self._label = label
+            pixels = np.frombuffer(fin.read(), dtype=np.uint8)
+        images = pixels.reshape(num, rows, cols, 1).astype(np.float32)
+        self._data = nd_array(images / 255.0)
 
 
 class FashionMNIST(MNIST):
@@ -98,36 +98,34 @@ class FashionMNIST(MNIST):
         super().__init__(root, train, transform)
 
 
-class CIFAR10(_DownloadedDataset):
+class CIFAR10(_OnDiskDataset):
     """CIFAR10 from the python pickle batches (reference: vision.py:144)."""
 
     def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
                  transform=None):
         super().__init__(root, train, transform)
 
-    def _read_batch(self, filename):
+    @staticmethod
+    def _read_batch(filename):
         with open(filename, "rb") as fin:
-            batch = pickle.load(fin, encoding="latin1")
-        data = batch["data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-        return data, np.asarray(batch["labels"], np.int32)
+            raw = pickle.load(fin, encoding="latin1")
+        images = raw["data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return images, np.asarray(raw["labels"], np.int32)
 
-    def _get_data(self):
+    def _load(self):
         base = os.path.join(self._root, "cifar-10-batches-py")
-        if self._train:
-            names = [os.path.join(base, f"data_batch_{i}")
-                     for i in range(1, 6)]
-        else:
-            names = [os.path.join(base, "test_batch")]
-        missing = [p for p in names if not os.path.exists(p)]
-        if missing:
+        parts = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if self._train else ["test_batch"])
+        names = [os.path.join(base, p) for p in parts]
+        absent = [p for p in names if not os.path.exists(p)]
+        if absent:
             raise MXNetError(
-                f"CIFAR10: dataset files not found: {missing}. This build "
+                f"CIFAR10: dataset files not found: {absent}. This build "
                 "has no network egress — unpack cifar-10-python.tar.gz "
                 f"under {self._root} manually.")
-        data, label = zip(*(self._read_batch(n) for n in names))
-        self._data = nd_array(
-            np.concatenate(data).astype(np.float32) / 255.0)
-        self._label = np.concatenate(label)
+        images, labels = zip(*map(self._read_batch, names))
+        self._data = nd_array(np.concatenate(images).astype(np.float32) / 255.0)
+        self._label = np.concatenate(labels)
 
 
 class ImageRecordDataset(dataset.RecordFileDataset):
@@ -140,13 +138,11 @@ class ImageRecordDataset(dataset.RecordFileDataset):
 
     def __getitem__(self, idx):
         from ... import image, recordio
-        record = super().__getitem__(idx)
-        header, img = recordio.unpack(record)
-        label = header.label
-        img = image.imdecode(img, self._flag)
-        if self._transform is not None:
-            return self._transform(img, label)
-        return img, label
+        header, raw = recordio.unpack(super().__getitem__(idx))
+        decoded = image.imdecode(raw, self._flag)
+        if self._transform is None:
+            return decoded, header.label
+        return self._transform(decoded, header.label)
 
 
 class ImageFolderDataset(dataset.Dataset):
@@ -157,32 +153,33 @@ class ImageFolderDataset(dataset.Dataset):
         self._flag = flag
         self._transform = transform
         self._exts = (".jpg", ".jpeg", ".png")
-        self._list_images(self._root)
+        self._scan()
 
-    def _list_images(self, root):
+    def _scan(self):
         self.synsets = []
         self.items = []
-        for folder in sorted(os.listdir(root)):
-            path = os.path.join(root, folder)
-            if not os.path.isdir(path):
-                warnings.warn(f"Ignoring {path}: not a directory")
+        for entry in sorted(os.scandir(self._root), key=lambda e: e.name):
+            if not entry.is_dir():
+                warnings.warn(f"Ignoring {entry.path}: not a directory")
                 continue
-            label = len(self.synsets)
-            self.synsets.append(folder)
-            for filename in sorted(os.listdir(path)):
-                if os.path.splitext(filename)[1].lower() not in self._exts:
+            self.synsets.append(entry.name)
+            class_id = len(self.synsets) - 1
+            for fname in sorted(os.listdir(entry.path)):
+                ext = os.path.splitext(fname)[1].lower()
+                if ext not in self._exts:
                     warnings.warn(
-                        f"Ignoring {filename}: unsupported extension")
+                        f"Ignoring {fname}: unsupported extension")
                     continue
-                self.items.append((os.path.join(path, filename), label))
+                self.items.append(
+                    (os.path.join(entry.path, fname), class_id))
 
     def __getitem__(self, idx):
         from ... import image
-        img = image.imread(self.items[idx][0], self._flag)
-        label = self.items[idx][1]
-        if self._transform is not None:
-            return self._transform(img, label)
-        return img, label
+        path, class_id = self.items[idx]
+        decoded = image.imread(path, self._flag)
+        if self._transform is None:
+            return decoded, class_id
+        return self._transform(decoded, class_id)
 
     def __len__(self):
         return len(self.items)

@@ -2,7 +2,9 @@
 
 Reference analogue: python/mxnet/gluon/loss.py (387 LoC — L1/L2,
 SigmoidBinaryCrossEntropy, SoftmaxCrossEntropy, KLDiv). Losses are
-HybridBlocks so they fuse into the compiled training step.
+HybridBlocks so they fuse into the compiled training step. The
+weight/sample-weight scaling and batch-mean reduction shared by every
+loss live in :meth:`Loss._finish` rather than a free-function helper.
 """
 from __future__ import annotations
 
@@ -11,20 +13,6 @@ from .block import HybridBlock
 __all__ = ["Loss", "L1Loss", "L2Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss"]
-
-
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    """Scale loss by a global weight and/or per-sample weights
-    (reference loss.py:_apply_weighting)."""
-    if sample_weight is not None:
-        loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        loss = loss * weight
-    return loss
-
-
-def _reshape_like(F, x, y):
-    return x.reshape(y.shape)
 
 
 class Loss(HybridBlock):
@@ -43,6 +31,21 @@ class Loss(HybridBlock):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
+    def _finish(self, F, loss, sample_weight, scale=None):
+        """Apply per-sample weights + the loss's global weight, then
+        reduce to one scalar per batch element."""
+        if sample_weight is not None:
+            loss = F.broadcast_mul(loss, sample_weight)
+        total_weight = self._weight if scale is None else scale
+        if total_weight is not None:
+            loss = loss * total_weight
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+def _match(F, label, pred):
+    """Give ``label`` the shape of ``pred``."""
+    return label.reshape(pred.shape)
+
 
 class L2Loss(Loss):
     r"""0.5 * weight * (pred - label)^2 (reference loss.py:L2Loss)."""
@@ -51,10 +54,8 @@ class L2Loss(Loss):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(pred - label)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        sq = F.square(pred - _match(F, label, pred))
+        return self._finish(F, sq, sample_weight, scale=self._weight / 2)
 
 
 class L1Loss(Loss):
@@ -64,10 +65,8 @@ class L1Loss(Loss):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        return self._finish(F, F.abs(pred - _match(F, label, pred)),
+                            sample_weight)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
@@ -77,20 +76,19 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
     def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._from_sigmoid = from_sigmoid
+        self._pre_activated = from_sigmoid
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            # log(1+exp(-|x|)) + max(x,0) - x*label (stable logits form)
-            loss = F.relu(pred) - pred * label + \
-                F.Activation(-F.abs(pred), act_type="softrelu")
-        else:
+        label = _match(F, label, pred)
+        if self._pre_activated:
             eps = 1e-12
-            loss = -(F.log(pred + eps) * label +
-                     F.log(1.0 - pred + eps) * (1.0 - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            bce = -(F.log(pred + eps) * label +
+                    F.log(1.0 - pred + eps) * (1.0 - label))
+        else:
+            # log(1+exp(-|x|)) + max(x,0) - x*label (stable logits form)
+            bce = F.relu(pred) - pred * label + \
+                F.Activation(-F.abs(pred), act_type="softrelu")
+        return self._finish(F, bce, sample_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
@@ -103,20 +101,19 @@ class SoftmaxCrossEntropyLoss(Loss):
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._axis = axis
-        self._sparse_label = sparse_label
-        self._from_logits = from_logits
+        self._class_axis = axis
+        self._index_labels = sparse_label
+        self._pre_normalized = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+        logp = pred if self._pre_normalized \
+            else F.log_softmax(pred, axis=self._class_axis)
+        if self._index_labels:
+            ce = -F.pick(logp, label, axis=self._class_axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            ce = -F.sum(logp * _match(F, label, logp),
+                        axis=self._class_axis, keepdims=True)
+        return self._finish(F, ce, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
@@ -128,15 +125,14 @@ class KLDivLoss(Loss):
     def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._from_logits = from_logits
-        self._axis = axis
+        self._pre_normalized = from_logits
+        self._class_axis = axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        logq = pred if self._pre_normalized \
+            else F.log_softmax(pred, axis=self._class_axis)
+        kl = label * (F.log(label + 1e-12) - logq)
+        return self._finish(F, kl, sample_weight)
 
 
 class HuberLoss(Loss):
@@ -147,13 +143,11 @@ class HuberLoss(Loss):
         self._rho = rho
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        err = F.abs(pred - _match(F, label, pred))
+        huber = F.where(err > self._rho,
+                        err - 0.5 * self._rho,
+                        (0.5 / self._rho) * F.square(err))
+        return self._finish(F, huber, sample_weight)
 
 
 class HingeLoss(Loss):
@@ -164,7 +158,5 @@ class HingeLoss(Loss):
         self._margin = margin
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        gap = F.relu(self._margin - pred * _match(F, label, pred))
+        return self._finish(F, gap, sample_weight)

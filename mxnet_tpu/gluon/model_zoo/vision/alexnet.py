@@ -1,10 +1,23 @@
-"""AlexNet (reference: gluon/model_zoo/vision/alexnet.py; arch from
-Krizhevsky et al. 2012, the one-column variant)."""
+"""AlexNet (one-column variant, Krizhevsky et al. 2012).
+
+API parity: python/mxnet/gluon/model_zoo/vision/alexnet.py. Built here
+from a layer table rather than hand-unrolled ``add`` calls, so the
+architecture reads as data.
+"""
 from ... import nn
 from ...block import HybridBlock
 from ._common import load_pretrained
 
 __all__ = ["AlexNet", "alexnet"]
+
+# (channels, kernel, stride, pad); None marks a 3x2 max-pool boundary.
+_CONV_PLAN = [
+    (64, 11, 4, 2), None,
+    (192, 5, 1, 2), None,
+    (384, 3, 1, 1),
+    (256, 3, 1, 1),
+    (256, 3, 1, 1), None,
+]
 
 
 class AlexNet(HybridBlock):
@@ -13,26 +26,20 @@ class AlexNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                for spec in _CONV_PLAN:
+                    if spec is None:
+                        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                    else:
+                        ch, k, s, p = spec
+                        self.features.add(
+                            nn.Conv2D(ch, kernel_size=k, strides=s,
+                                      padding=p, activation="relu"))
                 self.features.add(nn.Flatten())
             self.classifier = nn.HybridSequential(prefix="")
             with self.classifier.name_scope():
-                self.classifier.add(nn.Dense(4096, activation="relu"))
-                self.classifier.add(nn.Dropout(0.5))
-                self.classifier.add(nn.Dense(4096, activation="relu"))
-                self.classifier.add(nn.Dropout(0.5))
+                for _ in range(2):
+                    self.classifier.add(nn.Dense(4096, activation="relu"))
+                    self.classifier.add(nn.Dropout(0.5))
                 self.classifier.add(nn.Dense(classes))
 
     def hybrid_forward(self, F, x):

@@ -1,5 +1,6 @@
 """DenseNet 121/161/169/201 (reference: gluon/model_zoo/vision/densenet.py;
-arch from Huang et al. 2016)."""
+arch from Huang et al. 2016). The BN→relu→conv motif shared by dense
+layers and transitions is factored into one helper."""
 from ... import nn
 from ...block import HybridBlock
 from ._common import load_pretrained
@@ -8,18 +9,22 @@ __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
 
 
+def _bn_relu_conv(seq, channels, kernel, pad=0):
+    seq.add(nn.BatchNorm())
+    seq.add(nn.Activation("relu"))
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, padding=pad,
+                      use_bias=False))
+
+
 class _DenseLayer(HybridBlock):
+    """Bottleneck 1x1 then 3x3 conv; output is concatenated onto the
+    input along channels."""
+
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                                use_bias=False))
+        _bn_relu_conv(self.body, bn_size * growth_rate, kernel=1)
+        _bn_relu_conv(self.body, growth_rate, kernel=3, pad=1)
         if dropout:
             self.body.add(nn.Dropout(dropout))
 
@@ -27,21 +32,21 @@ class _DenseLayer(HybridBlock):
         return F.concat(x, self.body(x), dim=1)
 
 
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix=f"stage{stage_index}_")
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_DenseLayer(growth_rate, bn_size, dropout))
-    return out
+def _stage(depth, bn_size, growth_rate, dropout, index):
+    block = nn.HybridSequential(prefix=f"stage{index}_")
+    with block.name_scope():
+        for _ in range(depth):
+            block.add(_DenseLayer(growth_rate, bn_size, dropout))
+    return block
 
 
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+def _shrink(channels):
+    """Transition: halve channels with a 1x1 conv, halve spatial with
+    stride-2 average pooling."""
+    t = nn.HybridSequential(prefix="")
+    _bn_relu_conv(t, channels, kernel=1)
+    t.add(nn.AvgPool2D(pool_size=2, strides=2))
+    return t
 
 
 class DenseNet(HybridBlock):
@@ -49,40 +54,44 @@ class DenseNet(HybridBlock):
                  bn_size=4, dropout=0, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(
-                    num_layers, bn_size, growth_rate, dropout, i + 1))
-                num_features += num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    num_features //= 2
-                    self.features.add(_make_transition(num_features))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
+            stem = nn.HybridSequential(prefix="")
+            stem.add(nn.Conv2D(num_init_features, kernel_size=7,
+                               strides=2, padding=3, use_bias=False))
+            stem.add(nn.BatchNorm())
+            stem.add(nn.Activation("relu"))
+            stem.add(nn.MaxPool2D(3, 2, 1))
+            width = num_init_features
+            last = len(block_config) - 1
+            for i, depth in enumerate(block_config):
+                stem.add(_stage(depth, bn_size, growth_rate, dropout, i + 1))
+                width += depth * growth_rate
+                if i < last:
+                    width //= 2
+                    stem.add(_shrink(width))
+            stem.add(nn.BatchNorm())
+            stem.add(nn.Activation("relu"))
+            stem.add(nn.GlobalAvgPool2D())
+            stem.add(nn.Flatten())
+            self.features = stem
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
 
 
-_spec = {121: (64, 32, [6, 12, 24, 16]),
-         161: (96, 48, [6, 12, 36, 24]),
-         169: (64, 32, [6, 12, 32, 32]),
-         201: (64, 32, [6, 12, 48, 32])}
+# depth -> (init features, growth rate, layers per stage)
+_VARIANTS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
 
 
-def _get(num_layers, pretrained=False, **kwargs):
-    init, growth, cfg = _spec[num_layers]
-    return load_pretrained(DenseNet(init, growth, cfg, **kwargs),
-                           f"densenet{num_layers}", pretrained)
+def _get(depth, pretrained=False, **kwargs):
+    init, growth, stages = _VARIANTS[depth]
+    net = DenseNet(init, growth, list(stages), **kwargs)
+    return load_pretrained(net, f"densenet{depth}", pretrained)
 
 
 def densenet121(**kw): return _get(121, **kw)

@@ -19,24 +19,22 @@ __all__ = ["split_data", "split_and_load", "clip_global_norm"]
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):
     """Split an array along ``batch_axis`` into ``num_slice`` pieces
-    (reference gluon/utils.py:split_data)."""
-    size = data.shape[batch_axis]
-    if size < num_slice:
+    (reference gluon/utils.py:split_data). The final piece absorbs the
+    remainder when ``even_split=False``."""
+    count = data.shape[batch_axis]
+    if count < num_slice:
         raise MXNetError(
             f"Too many slices ({num_slice}) for data with shape "
             f"{data.shape} along axis {batch_axis}")
-    if even_split and size % num_slice != 0:
+    if count % num_slice and even_split:
         raise MXNetError(
             f"data with shape {data.shape} cannot be evenly split into "
             f"{num_slice} slices along axis {batch_axis}; set "
             "even_split=False to allow uneven partitioning")
-    step = size // num_slice
-    slices = []
-    for i in range(num_slice):
-        begin = i * step
-        end = (i + 1) * step if i < num_slice - 1 else size
-        slices.append(data.slice_axis(batch_axis, begin, end))
-    return slices
+    chunk = count // num_slice
+    cuts = [i * chunk for i in range(num_slice)] + [count]
+    return [data.slice_axis(batch_axis, lo, hi)
+            for lo, hi in zip(cuts, cuts[1:])]
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
@@ -44,10 +42,10 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     (reference gluon/utils.py:split_and_load)."""
     if not isinstance(data, NDArray):
         data = ndarray.array(data)
-    if len(ctx_list) == 1:
+    if len(ctx_list) < 2:
         return [data.as_in_context(ctx_list[0])]
-    slices = split_data(data, len(ctx_list), batch_axis, even_split)
-    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+    parts = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [part.as_in_context(ctx) for part, ctx in zip(parts, ctx_list)]
 
 
 def clip_global_norm(arrays, max_norm):
@@ -55,13 +53,10 @@ def clip_global_norm(arrays, max_norm):
     (reference gluon/utils.py:clip_global_norm)."""
     if not arrays:
         raise MXNetError("arrays must not be empty")
-    total = 0.0
-    for arr in arrays:
-        n = ndarray.norm(arr)
-        total = total + (n * n).asscalar()
-    total_norm = _np.sqrt(total)
-    scale = max_norm / (total_norm + 1e-8)
-    if scale < 1.0:
-        for arr in arrays:
-            arr *= scale
-    return total_norm
+    sq_sum = sum((ndarray.norm(a) ** 2).asscalar() for a in arrays)
+    joint_norm = float(_np.sqrt(sq_sum))
+    ratio = max_norm / (joint_norm + 1e-8)
+    if ratio < 1.0:
+        for a in arrays:
+            a *= ratio
+    return joint_norm

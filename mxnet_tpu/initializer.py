@@ -1,8 +1,11 @@
 """Weight initializers.
 
-Reference: python/mxnet/initializer.py — Initializer base dispatching on the
-parameter name (weight/bias/gamma/beta/moving_*), registry, Uniform/Normal/
-Xavier/MSRAPrelu/Bilinear/Constant/Mixed/One/Zero/LSTMBias.
+Reference surface: python/mxnet/initializer.py — Initializer base
+dispatching on the parameter-name suffix (weight/bias/gamma/beta/
+moving_*), a string registry, and the Uniform/Normal/Xavier/MSRAPrelu/
+Bilinear/Constant/Mixed/One/Zero/LSTMBias family. Dispatch here is a
+suffix-routing table rather than an if/elif chain, and all host-side
+sampling funnels through ``Initializer._store``.
 """
 from __future__ import annotations
 
@@ -27,10 +30,25 @@ class InitDesc(str):
     """Parameter name + attrs hint (reference: initializer.py InitDesc)."""
 
     def __new__(cls, name, attrs=None, global_init=None):
-        ret = super().__new__(cls, name)
-        ret.attrs = attrs or {}
-        ret.global_init = global_init
-        return ret
+        obj = str.__new__(cls, name)
+        obj.attrs = dict(attrs) if attrs else {}
+        obj.global_init = global_init
+        return obj
+
+
+# parameter-name suffix -> handler method name, checked in order
+_SUFFIX_ROUTES = (
+    ("weight", "_init_weight"),
+    ("bias", "_init_bias"),
+    ("gamma", "_init_gamma"),
+    ("beta", "_init_beta"),
+    ("moving_mean", "_init_zero"),
+    ("running_mean", "_init_zero"),
+    ("moving_var", "_init_one"),
+    ("running_var", "_init_one"),
+    ("moving_inv_var", "_init_zero"),
+    ("moving_avg", "_init_zero"),
+)
 
 
 class Initializer:
@@ -38,49 +56,37 @@ class Initializer:
         self._kwargs = kwargs
 
     def dumps(self):
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
 
     def __call__(self, desc, arr: NDArray):
         if not isinstance(desc, InitDesc):
             desc = InitDesc(str(desc))
-        init = desc.attrs.get("__init__", "")
-        if init:
-            klass, kwargs = json.loads(init)
+        override = desc.attrs.get("__init__", "")
+        if override:
+            klass, kwargs = json.loads(override)
             create(klass, **kwargs)._init_weight(desc, arr)
             return
-        name = str(desc)
-        if name.endswith("weight"):
-            self._init_weight(desc, arr)
-        elif name.endswith("bias"):
-            self._init_bias(desc, arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(desc, arr)
-        elif name.endswith("beta"):
-            self._init_beta(desc, arr)
-        elif name.endswith("moving_mean") or name.endswith("running_mean"):
-            self._init_zero(desc, arr)
-        elif name.endswith("moving_var") or name.endswith("running_var"):
-            self._init_one(desc, arr)
-        elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
-            self._init_zero(desc, arr)
-        else:
-            self._init_default(desc, arr)
+        for suffix, handler in _SUFFIX_ROUTES:
+            if str(desc).endswith(suffix):
+                getattr(self, handler)(desc, arr)
+                return
+        self._init_default(desc, arr)
 
-    # -- leaf inits ---------------------------------------------------------
+    # -- shared fill helpers ------------------------------------------------
+    @staticmethod
+    def _store(arr, host):
+        """Move a host numpy draw into the target array as float32."""
+        arr[:] = nd_array(_np.asarray(host, dtype="float32"))
+
     def _init_zero(self, desc, arr):
         arr[:] = 0.0
 
     def _init_one(self, desc, arr):
         arr[:] = 1.0
 
-    def _init_bias(self, desc, arr):
-        arr[:] = 0.0
-
-    def _init_gamma(self, desc, arr):
-        arr[:] = 1.0
-
-    def _init_beta(self, desc, arr):
-        arr[:] = 0.0
+    _init_bias = _init_zero
+    _init_beta = _init_zero
+    _init_gamma = _init_one
 
     def _init_weight(self, desc, arr):
         raise NotImplementedError()
@@ -89,13 +95,12 @@ class Initializer:
         self._init_weight(desc, arr)
 
     def __repr__(self):
-        return f"{self.__class__.__name__}({self._kwargs})"
+        return f"{type(self).__name__}({self._kwargs})"
 
 
 @register
 class Zero(Initializer):
-    def _init_weight(self, desc, arr):
-        arr[:] = 0.0
+    _init_weight = Initializer._init_zero
 
 
 _REG._map["zeros"] = Zero
@@ -103,8 +108,7 @@ _REG._map["zeros"] = Zero
 
 @register
 class One(Initializer):
-    def _init_weight(self, desc, arr):
-        arr[:] = 1.0
+    _init_weight = Initializer._init_one
 
 
 _REG._map["ones"] = One
@@ -124,22 +128,21 @@ class Constant(Initializer):
 class Uniform(Initializer):
     def __init__(self, scale=0.07):
         super().__init__(scale=scale)
-        self.scale = scale
+        self.scale = float(scale)
 
     def _init_weight(self, desc, arr):
-        arr[:] = nd_array(_host_rng().uniform(-self.scale, self.scale,
-                                             arr.shape).astype("float32"))
+        bound = self.scale
+        self._store(arr, _host_rng().uniform(-bound, bound, arr.shape))
 
 
 @register
 class Normal(Initializer):
     def __init__(self, sigma=0.01):
         super().__init__(sigma=sigma)
-        self.sigma = sigma
+        self.sigma = float(sigma)
 
     def _init_weight(self, desc, arr):
-        arr[:] = nd_array(_host_rng().normal(0, self.sigma,
-                                            arr.shape).astype("float32"))
+        self._store(arr, _host_rng().normal(0, self.sigma, arr.shape))
 
 
 @register
@@ -154,42 +157,40 @@ class Xavier(Initializer):
         self.factor_type = factor_type
         self.magnitude = float(magnitude)
 
+    @staticmethod
+    def _fans(desc, shape):
+        """(fan_in, fan_out) honouring an NHWC-style __layout__ hint."""
+        layout = str(desc.attrs.get("__layout__", "")) \
+            if isinstance(desc, InitDesc) else ""
+        if layout.endswith("C") and not layout.startswith("NC") \
+                and len(shape) > 2:
+            # OHWI conv weight: fan_in = I*spatial, fan_out = O*spatial
+            spatial = float(_np.prod(shape[1:-1]))
+            return shape[-1] * spatial, shape[0] * spatial
+        # OIHW (reference layout) / plain (out, in) matrices
+        spatial = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        return shape[1] * spatial, shape[0] * spatial
+
     def _init_weight(self, desc, arr):
         shape = arr.shape
         if len(shape) < 2:
-            arr[:] = nd_array(_host_rng().uniform(-0.07, 0.07, shape).astype("float32"))
+            self._store(arr, _host_rng().uniform(-0.07, 0.07, shape))
             return
-        layout = ""
-        if isinstance(desc, InitDesc):
-            layout = str(desc.attrs.get("__layout__", ""))
-        channel_last = layout.endswith("C") and not layout.startswith("NC")
-        if channel_last and len(shape) > 2:
-            # OHWI conv weight: fan_in = I*spatial, fan_out = O*spatial
-            spatial = float(_np.prod(shape[1:-1]))
-            fan_in, fan_out = shape[-1] * spatial, shape[0] * spatial
-        else:
-            # OIHW (reference layout) / plain (out, in) matrices
-            hw_scale = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
-            fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        else:
-            factor = fan_out
-        scale = _np.sqrt(self.magnitude / factor)
-        if self.rnd_type == "uniform":
-            w = _host_rng().uniform(-scale, scale, shape)
-        else:
-            w = _host_rng().normal(0, scale, shape)
-        arr[:] = nd_array(w.astype("float32"))
+        fan_in, fan_out = self._fans(desc, shape)
+        denom = {"avg": (fan_in + fan_out) / 2.0,
+                 "in": fan_in,
+                 "out": fan_out}[self.factor_type]
+        scale = float(_np.sqrt(self.magnitude / denom))
+        draw = (_host_rng().uniform(-scale, scale, shape)
+                if self.rnd_type == "uniform"
+                else _host_rng().normal(0, scale, shape))
+        self._store(arr, draw)
 
 
 @register
 class MSRAPrelu(Xavier):
     def __init__(self, factor_type="avg", slope=0.25):
-        magnitude = 2.0 / (1 + slope ** 2)
-        super().__init__("gaussian", factor_type, magnitude)
+        super().__init__("gaussian", factor_type, 2.0 / (1 + slope ** 2))
         self._kwargs = {"factor_type": factor_type, "slope": slope}
 
 
@@ -197,36 +198,34 @@ class MSRAPrelu(Xavier):
 class Orthogonal(Initializer):
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)
-        self.scale = scale
+        self.scale = float(scale)
         self.rand_type = rand_type
 
     def _init_weight(self, desc, arr):
-        nout = arr.shape[0]
-        nin = int(_np.prod(arr.shape[1:]))
-        if self.rand_type == "uniform":
-            tmp = _host_rng().uniform(-1.0, 1.0, (nout, nin))
-        else:
-            tmp = _host_rng().normal(0.0, 1.0, (nout, nin))
-        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
-        q = u if u.shape == tmp.shape else v
-        arr[:] = nd_array((self.scale * q.reshape(arr.shape)).astype("float32"))
+        rows = arr.shape[0]
+        cols = int(_np.prod(arr.shape[1:]))
+        seed = (_host_rng().uniform(-1.0, 1.0, (rows, cols))
+                if self.rand_type == "uniform"
+                else _host_rng().normal(0.0, 1.0, (rows, cols)))
+        u, _, v = _np.linalg.svd(seed, full_matrices=False)
+        basis = u if u.shape == seed.shape else v
+        self._store(arr, self.scale * basis.reshape(arr.shape))
 
 
 @register
 class Bilinear(Initializer):
     """Bilinear upsampling kernels (reference: used with Deconvolution
-    UpSampling weights)."""
+    UpSampling weights). Built as an outer product of 1-D triangle
+    filters, broadcast over the channel axes."""
 
     def _init_weight(self, desc, arr):
-        weight = _np.zeros(arr.shape, dtype="float32")
-        shape = arr.shape
-        f = _np.ceil(shape[3] / 2.0)
+        width = arr.shape[3]
+        f = _np.ceil(width / 2.0)
         c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(int(_np.prod(shape))):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        arr[:] = nd_array(weight)
+        tri_x = 1 - _np.abs(_np.arange(width) / f - c)
+        tri_y = 1 - _np.abs(_np.arange(arr.shape[2]) / f - c)
+        kernel = _np.outer(tri_y, tri_x)
+        self._store(arr, _np.broadcast_to(kernel, arr.shape))
 
 
 @register
@@ -238,10 +237,10 @@ class LSTMBias(Initializer):
         self.forget_bias = forget_bias
 
     def _init_weight(self, desc, arr):
-        b = _np.zeros(arr.shape, dtype="float32")
-        num_hidden = arr.shape[0] // 4
-        b[num_hidden:2 * num_hidden] = self.forget_bias  # i, f, g, o order
-        arr[:] = nd_array(b)
+        gates = _np.zeros(arr.shape, dtype="float32")
+        h = arr.shape[0] // 4
+        gates[h:2 * h] = self.forget_bias  # i, f, g, o order
+        self._store(arr, gates)
 
     _init_bias = _init_weight
 
@@ -250,11 +249,12 @@ class LSTMBias(Initializer):
 class Mixed(Initializer):
     def __init__(self, patterns, initializers):
         super().__init__()
-        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+        self._routes = [(re.compile(p), init)
+                        for p, init in zip(patterns, initializers)]
 
     def __call__(self, desc, arr):
-        for pat, init in self.map:
-            if pat.match(str(desc)):
+        for matcher, init in self._routes:
+            if matcher.match(str(desc)):
                 init(desc, arr)
                 return
         raise ValueError(f"parameter {desc} did not match any pattern")
@@ -265,19 +265,19 @@ class Load:
     """Init from a saved param dict, fall back to default_init."""
 
     def __init__(self, param, default_init=None, verbose=False):
-        self.param = {k.replace("arg:", "").replace("aux:", ""): v
-                      for k, v in param.items()}
+        self.param = {k.split(":", 1)[-1]: v for k, v in param.items()}
         self.default_init = default_init
         self.verbose = verbose
 
     def __call__(self, name, arr):
         name = str(name)
-        if name in self.param:
-            arr[:] = self.param[name]
-        else:
-            if self.default_init is None:
-                raise ValueError(f"no init pattern for {name}")
+        known = self.param.get(name)
+        if known is not None:
+            arr[:] = known
+        elif self.default_init is not None:
             self.default_init(name, arr)
+        else:
+            raise ValueError(f"no init pattern for {name}")
 
 
 def create(name, **kwargs):

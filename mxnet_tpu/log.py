@@ -1,71 +1,52 @@
 """Logging utilities (reference: python/mxnet/log.py).
 
 ``get_logger`` attaches a color-capable formatter whose level tag renders
-as ``X:name:message`` (single-letter level) with ANSI colors on TTYs.
+as a single colored letter before the timestamp/source prefix.
 """
 from __future__ import annotations
 
 import logging
 import sys
+import warnings
 
 __all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
            "NOTSET"]
 
-DEBUG = logging.DEBUG
-INFO = logging.INFO
-WARNING = logging.WARNING
-ERROR = logging.ERROR
-NOTSET = logging.NOTSET
+DEBUG, INFO, WARNING = logging.DEBUG, logging.INFO, logging.WARNING
+ERROR, NOTSET = logging.ERROR, logging.NOTSET
 
-PY3 = sys.version_info[0] == 3
+PY3 = sys.version_info.major == 3
+
+# level -> single-letter tag; unknown levels render as "U"
+_TAGS = {logging.CRITICAL: "C", ERROR: "E", WARNING: "W",
+         INFO: "I", DEBUG: "D"}
+# first threshold <= level wins
+_HUES = ((ERROR, "\x1b[31m"), (WARNING, "\x1b[33m"), (NOTSET, "\x1b[32m"))
+_RESET = "\x1b[0m"
 
 
 class _Formatter(logging.Formatter):
     """Per-level colored single-letter formatter (reference log.py:37)."""
 
+    _SOURCE = "%(asctime)s %(process)d %(pathname)s:%(funcName)s:%(lineno)d"
+
     def __init__(self, colored=True):
         self.colored = colored
         super().__init__(datefmt="%m%d %H:%M:%S")
 
-    def _get_color(self, level):
-        if level >= ERROR:
-            return "\x1b[31m"
-        if level >= WARNING:
-            return "\x1b[33m"
-        return "\x1b[32m"
-
-    def _get_label(self, level):
-        if level == logging.CRITICAL:
-            return "C"
-        if level == ERROR:
-            return "E"
-        if level == WARNING:
-            return "W"
-        if level == INFO:
-            return "I"
-        if level == DEBUG:
-            return "D"
-        return "U"
-
     def format(self, record):
-        fmt = ""
+        tag = _TAGS.get(record.levelno, "U")
         if self.colored:
-            fmt = self._get_color(record.levelno)
-        fmt += self._get_label(record.levelno)
-        if self.colored:
-            fmt += "\x1b[0m"
-        fmt += "%(asctime)s %(process)d %(pathname)s:%(funcName)s:" \
-               "%(lineno)d"
-        if self.colored:
-            fmt += "\x1b[0m"
-        fmt += " %(message)s"
-        self._style._fmt = fmt
+            hue = next(c for lo, c in _HUES if record.levelno >= lo)
+            prefix = f"{hue}{tag}{_RESET}{self._SOURCE}{_RESET}"
+        else:
+            prefix = tag + self._SOURCE
+        self._style._fmt = prefix + " %(message)s"
         return super().format(record)
 
 
 def getLogger(name=None, filename=None, filemode=None, level=WARNING):
     """Deprecated alias of :func:`get_logger` (reference log.py:80)."""
-    import warnings
     warnings.warn("getLogger is deprecated, use get_logger instead",
                   DeprecationWarning)
     return get_logger(name, filename, filemode, level)
@@ -74,17 +55,17 @@ def getLogger(name=None, filename=None, filemode=None, level=WARNING):
 def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     """Get a customized logger with a colored console (or file) handler."""
     logger = logging.getLogger(name)
-    if name is not None and not getattr(logger, "_init_done", None):
-        logger._init_done = True
-        if filename:
-            mode = filemode if filemode else "a"
-            hdlr = logging.FileHandler(filename, mode)
-        else:
-            hdlr = logging.StreamHandler()
-            # the colored one only makes sense on a tty
-        colored = not filename and getattr(sys.stderr, "isatty",
-                                           lambda: False)()
-        hdlr.setFormatter(_Formatter(colored=colored))
-        logger.addHandler(hdlr)
-        logger.setLevel(level)
+    if name is None or getattr(logger, "_init_done", False):
+        return logger
+    logger._init_done = True
+    if filename:
+        sink = logging.FileHandler(filename, filemode or "a")
+        tty = False
+    else:
+        sink = logging.StreamHandler()
+        # color only makes sense on a tty
+        tty = getattr(sys.stderr, "isatty", lambda: False)()
+    sink.setFormatter(_Formatter(colored=tty))
+    logger.addHandler(sink)
+    logger.setLevel(level)
     return logger

@@ -1,49 +1,50 @@
-"""Deprecated learning-rate schedulers (reference: python/mxnet/misc.py).
+"""Legacy iteration-indexed learning-rate schedules.
 
-Kept for API parity; new code should use :mod:`mxnet_tpu.lr_scheduler`.
+API parity with the deprecated reference module python/mxnet/misc.py
+(``LearningRateScheduler``/``FactorScheduler`` called with an iteration
+count); new code should use :mod:`mxnet_tpu.lr_scheduler`, which the
+optimizers consume. This shim keeps the old callable contract alive for
+scripts written against the pre-0.7 API.
 """
 from __future__ import annotations
 
 import logging
-import math
 
 __all__ = ["LearningRateScheduler", "FactorScheduler"]
 
+_log = logging.getLogger("mxnet_tpu.misc")
+
 
 class LearningRateScheduler:
-    """Base class of the deprecated scheduler API (called with the
-    iteration count, returns the lr)."""
+    """Deprecated callable schedule: ``lr = sched(iteration)``."""
 
-    def __init__(self):
-        self.base_lr = 0.01
+    def __init__(self, base_lr: float = 0.01):
+        self.base_lr = base_lr
 
-    def __call__(self, iteration):
-        raise NotImplementedError("must override this")
+    def __call__(self, iteration: int) -> float:
+        raise NotImplementedError("subclasses define the schedule curve")
 
 
 class FactorScheduler(LearningRateScheduler):
-    """Reduce learning rate by ``factor`` every ``step`` iterations."""
+    """Geometric decay: ``base_lr * decay ** (iteration // every)``."""
 
-    def __init__(self, step, factor=0.1):
+    def __init__(self, step: int, factor: float = 0.1):
         super().__init__()
         if step < 1:
-            raise ValueError(
-                "Schedule step must be greater or equal than 1 round")
-        if factor >= 1.0:
-            raise ValueError("Factor must be less than 1 to make lr reduce")
-        self.step = step
-        self.factor = factor
-        self.old_lr = self.base_lr
-        self.init = False
+            raise ValueError("step must be a positive iteration count")
+        if not factor < 1.0:
+            raise ValueError("a decay factor must shrink the lr (< 1.0)")
+        self.every = int(step)
+        self.decay = float(factor)
+        # reference-API attribute names, kept for legacy scripts
+        self.step = self.every
+        self.factor = self.decay
+        self._announced: float | None = None
 
-    def __call__(self, iteration):
-        if not self.init:
-            self.init = True
-            self.old_lr = self.base_lr
-        lr = self.base_lr * math.pow(self.factor, int(iteration / self.step))
-        if lr != self.old_lr:
-            self.old_lr = lr
-            logging.info(
-                "At Iteration [%d]: Switch to new learning rate %.5f",
-                iteration, lr)
+    def __call__(self, iteration: int) -> float:
+        lr = self.base_lr * self.decay ** (int(iteration) // self.every)
+        if self._announced not in (None, lr):
+            _log.info("iteration %d: learning rate decayed to %.5f",
+                      iteration, lr)
+        self._announced = lr
         return lr

@@ -3,10 +3,13 @@
 Reference surface: python/mxnet/module/sequential_module.py — ``add`` with
 ``take_labels``/``auto_wiring`` metadata, binding each submodule on the
 previous one's output shapes, forward/backward chaining through the list.
+The chain is held as ``_Link`` records (module + routing flags) rather
+than parallel module/meta lists.
 """
 from __future__ import annotations
 
 import logging
+from typing import NamedTuple
 
 from ..base import MXNetError
 from ..initializer import Uniform
@@ -16,46 +19,63 @@ from .base_module import BaseModule
 __all__ = ["SequentialModule"]
 
 
+class _Link(NamedTuple):
+    """One chained submodule and how data/labels route into it."""
+    module: object
+    wants_labels: bool   # bind-time labels are forwarded to this link
+    auto_wire: bool      # rename upstream outputs to this link's data names
+
+
 class SequentialModule(BaseModule):
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
 
     def __init__(self, logger=logging):
         super().__init__(logger)
-        self._modules = []
-        self._metas = []
+        self._chain: list[_Link] = []
         self._label_shapes = None
         self._data_shapes = None
-        self._meta_keys = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
 
     def add(self, module, **kwargs):
         """Append a module. kwargs: take_labels=True routes the bind-time
         labels to this submodule; auto_wiring=True renames the previous
         module's outputs to this module's data names."""
-        self._modules.append(module)
-        for k in kwargs:
-            if k not in self._meta_keys:
-                raise MXNetError(f"unknown meta {k}; valid: "
-                                 f"{sorted(self._meta_keys)}")
-        self._metas.append(kwargs)
-        self.binded = False
-        self.params_initialized = False
+        known = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        unknown = set(kwargs) - known
+        if unknown:
+            raise MXNetError(f"unknown meta {sorted(unknown)}; "
+                             f"valid: {sorted(known)}")
+        self._chain.append(_Link(module,
+                                 bool(kwargs.get(self.META_TAKE_LABELS)),
+                                 bool(kwargs.get(self.META_AUTO_WIRING))))
+        self.binded = self.params_initialized = False
         self.optimizer_initialized = False
         return self
+
+    def _each(self):
+        return (link.module for link in self._chain)
+
+    @property
+    def _head(self):
+        return self._chain[0].module
+
+    @property
+    def _tail(self):
+        return self._chain[-1].module
 
     # -- introspection ------------------------------------------------------
     @property
     def data_names(self):
-        return self._modules[0].data_names if self._modules else []
+        return self._head.data_names if self._chain else []
 
     @property
     def output_names(self):
-        return self._modules[-1].output_names if self._modules else []
+        return self._tail.output_names if self._chain else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._head.data_shapes
 
     @property
     def label_shapes(self):
@@ -65,16 +85,16 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._tail.output_shapes
 
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params, aux_params = {}, {}
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return arg_params, aux_params
+        args, auxs = {}, {}
+        for mod in self._each():
+            a, x = mod.get_params()
+            args |= a
+            auxs |= x
+        return args, auxs
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
@@ -82,22 +102,19 @@ class SequentialModule(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        for module in self._modules:
-            module.init_params(initializer=initializer,
-                               arg_params=arg_params,
-                               aux_params=aux_params,
-                               allow_missing=True,
-                               force_init=force_init)
+        for mod in self._each():
+            mod.init_params(initializer=initializer, arg_params=arg_params,
+                            aux_params=aux_params, allow_missing=True,
+                            force_init=force_init)
         # duplicate parameter names across submodules are a wiring bug
-        seen = {}
-        for i, module in enumerate(self._modules):
-            arg, _ = module.get_params()
-            for name in arg:
-                if name in seen:
+        owner: dict = {}
+        for i, mod in enumerate(self._each()):
+            for name in mod.get_params()[0]:
+                if name in owner:
                     raise MXNetError(
                         f"duplicate parameter {name} in modules "
-                        f"{seen[name]} and {i}")
-                seen[name] = i
+                        f"{owner[name]} and {i}")
+                owner[name] = i
         self.params_initialized = True
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -109,46 +126,41 @@ class SequentialModule(BaseModule):
         if shared_module is not None:
             raise MXNetError("shared_module not supported by "
                              "SequentialModule")
-        if not self._modules:
+        if not self._chain:
             raise MXNetError("add modules before binding")
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self._data_shapes = data_shapes
-        self._label_shapes = label_shapes
+        # labels survive only if some link consumes them
+        self._label_shapes = (label_shapes if
+                              any(l.wants_labels for l in self._chain)
+                              else None)
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
-            meta_labels = None
-            if meta.get(self.META_TAKE_LABELS):
-                meta_labels = label_shapes
-                anybody_ever_needs_label = True
-            my_inputs_need_grad = bool(
-                inputs_need_grad if i == 0 else for_training)
-            if meta.get(self.META_AUTO_WIRING):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [
-                    DataDesc(dn, ds.shape) for dn, ds in
-                    zip(data_names, my_data_shapes)]
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=meta_labels,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, grad_req=grad_req)
-            my_data_shapes = module.output_shapes
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+        upstream = data_shapes
+        for i, link in enumerate(self._chain):
+            feed = upstream
+            if link.auto_wire:
+                names = link.module.data_names
+                assert len(names) == len(feed)
+                feed = [DataDesc(n, d.shape) for n, d in zip(names, feed)]
+            link.module.bind(
+                data_shapes=feed,
+                label_shapes=label_shapes if link.wants_labels else None,
+                for_training=for_training,
+                inputs_need_grad=(inputs_need_grad if i == 0
+                                  else bool(for_training)),
+                force_rebind=force_rebind, grad_req=grad_req)
+            upstream = link.module.output_shapes
         self.binded = True
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         assert self.binded and self.params_initialized
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for mod in self._each():
+            mod.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
@@ -156,44 +168,44 @@ class SequentialModule(BaseModule):
         from ..io import DataBatch
 
         batch = data_batch
-        for i, module in enumerate(self._modules):
-            module.forward(batch, is_train=is_train)
-            if i == len(self._modules) - 1:
+        for pos, mod in enumerate(self._each(), start=1):
+            mod.forward(batch, is_train=is_train)
+            if pos == len(self._chain):
                 break
-            out = module.get_outputs()
-            batch = DataBatch(data=out, label=data_batch.label,
+            batch = DataBatch(data=mod.get_outputs(),
+                              label=data_batch.label,
                               pad=getattr(data_batch, "pad", 0))
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i, module in reversed(list(enumerate(self._modules))):
-            module.backward(out_grads=out_grads)
-            if i == 0:
+        for pos, link in enumerate(reversed(self._chain)):
+            link.module.backward(out_grads=out_grads)
+            if pos == len(self._chain) - 1:
                 break
-            out_grads = module.get_input_grads()
+            out_grads = link.module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        for mod in self._each():
+            mod.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._tail.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized \
             and self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        return self._head.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if meta.get(self.META_TAKE_LABELS):
-                module.update_metric(eval_metric, labels)
+        for link in self._chain:
+            if link.wants_labels:
+                link.module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for mod in self._each():
+            mod.install_monitor(mon)

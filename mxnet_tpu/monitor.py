@@ -11,67 +11,69 @@ from __future__ import annotations
 
 import logging
 import re
-from typing import List
 
 from .base import MXNetError
 
 __all__ = ["Monitor"]
 
 
+def _mean_abs(x):
+    """Reference default statistic: mean absolute value."""
+    return x.abs().mean() if hasattr(x, "abs") else abs(x).mean()
+
+
 class Monitor:
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def stat_func(x):  # reference default: mean |x|
-                return x.abs().mean() if hasattr(x, "abs") else abs(x).mean()
-        self.interval = interval
-        self.stat_func = stat_func
-        self.re_pattern = re.compile(pattern)
-        self.sort = sort
-        self.exes: List = []
-        self.activated = False
-        self.step = 0
-        self.queue = []
+        self._every = int(interval)
+        self._measure = stat_func or _mean_abs
+        self._name_filter = re.compile(pattern).match
+        self._sorted = bool(sort)
+        self._executors = []
+        self._armed = False
+        self._batch = 0
+        # kept as public aliases for reference-API compatibility
+        self.interval = self._every
+        self.stat_func = self._measure
 
     def install(self, exe):
         """Attach to an executor (reference: exe.set_monitor_callback)."""
-        self.exes.append(exe)
+        self._executors.append(exe)
 
     def tic(self):
-        """Start collecting for this batch if the interval has elapsed."""
-        if self.step % self.interval == 0:
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        """Arm collection for this batch when the interval has elapsed."""
+        if self._batch % self._every == 0:
+            self._armed = True
+        self._batch += 1
+
+    def _pull(self):
+        """Snapshot matching internal outputs from every installed executor."""
+        for exe in self._executors:
+            try:
+                internals = exe.internal_outputs()
+            except MXNetError:
+                continue  # executor has not run yet
+            yield from ((name, arr) for name, arr in internals.items()
+                        if self._name_filter(name))
 
     def toc(self):
         """Collect stats from all installed executors; returns
         [(step, name, stat_str)]."""
-        if not self.activated:
+        if not self._armed:
             return []
-        for exe in self.exes:
-            try:
-                internals = exe.internal_outputs()
-            except MXNetError:
-                continue  # executor not yet run
-            for name, arr in internals.items():
-                if self.re_pattern.match(name):
-                    self.queue.append(
-                        (self.step, name, self.stat_func(arr)))
-        self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if not isinstance(v_list, (list, tuple)):
-                v_list = [v_list]
-            for v in v_list:
-                res.append((n, k, str(v)))
-        self.queue = []
-        return res
+        self._armed = False
+        rows = [(self._batch, name, self._measure(arr))
+                for name, arr in self._pull()]
+        if self._sorted:
+            rows.sort(key=lambda row: row[1])
+        flat = []
+        for step, name, value in rows:
+            values = value if isinstance(value, (list, tuple)) else (value,)
+            flat.extend((step, name, str(v)) for v in values)
+        return flat
 
     def toc_print(self):
         """Collect and log the stats (reference: logging.info per stat)."""
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
-        return res
+        stats = self.toc()
+        for step, name, value in stats:
+            logging.info("Batch: %7d %30s %s", step, name, value)
+        return stats

@@ -6,6 +6,11 @@ Zoneout/Dropout modifiers). Cells compose Symbols; an unrolled graph compiles
 to one XLA program, so the reference's fused-vs-unfused performance split
 disappears — ``FusedRNNCell`` here simply emits the one-op ``RNN`` symbol
 (which lowers to the lax.scan kernel in ops/rnn_ops.py).
+
+The per-step i2h/h2h projection, step naming, and state-info boilerplate
+shared by the three dense cells live in BaseRNNCell helpers
+(``_step_tag``/``_affine_pair``/``_nc_state``) instead of being repeated
+per cell.
 """
 from __future__ import annotations
 
@@ -26,10 +31,12 @@ class RNNParams:
         self._params = {}
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        if name not in self._params:
-            self._params[name] = symbol.Variable(name, **kwargs)
-        return self._params[name]
+        full = self._prefix + name
+        try:
+            return self._params[full]
+        except KeyError:
+            return self._params.setdefault(full,
+                                           symbol.Variable(full, **kwargs))
 
 
 class BaseRNNCell:
@@ -37,19 +44,14 @@ class BaseRNNCell:
     (reference rnn_cell.py:BaseRNNCell)."""
 
     def __init__(self, prefix="", params=None):
-        if params is None:
-            params = RNNParams(prefix)
-            self._own_params = True
-        else:
-            self._own_params = False
+        self._own_params = params is None
         self._prefix = prefix
-        self._params = params
+        self._params = RNNParams(prefix) if params is None else params
         self._modified = False
         self.reset()
 
     def reset(self):
-        self._init_counter = -1
-        self._counter = -1
+        self._init_counter = self._counter = -1
 
     def __call__(self, inputs, states):
         raise NotImplementedError
@@ -65,107 +67,117 @@ class BaseRNNCell:
 
     @property
     def state_shape(self):
-        return [ele["shape"] for ele in self.state_info]
+        return [info["shape"] for info in self.state_info]
 
     @property
     def _gate_names(self):
         return ()
 
+    # -- shared naming / projection helpers ---------------------------------
+    def _fresh_state_name(self):
+        self._init_counter += 1
+        return f"{self._prefix}begin_state_{self._init_counter}"
+
+    def _step_tag(self):
+        self._counter += 1
+        return f"{self._prefix}t{self._counter}_"
+
+    def _nc_state(self):
+        return {"shape": (0, self._num_hidden), "__layout__": "NC"}
+
+    def _bind_dense_params(self, bias_init=None):
+        """Fetch the four i2h/h2h weight/bias Variables onto the cell."""
+        bias_kw = {} if bias_init is None else {"init": bias_init}
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias", **bias_kw)
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    def _affine_pair(self, x, h_prev, gates, tag):
+        """The two projections every dense cell starts with."""
+        width = self._num_hidden * gates
+        i2h = symbol.FullyConnected(x, self._iW, self._iB,
+                                    num_hidden=width, name=f"{tag}i2h")
+        h2h = symbol.FullyConnected(h_prev, self._hW, self._hB,
+                                    num_hidden=width, name=f"{tag}h2h")
+        return i2h, h2h
+
     def begin_state(self, func=symbol.zeros, **kwargs):
         """Initial state symbols (reference rnn_cell.py:begin_state)."""
         assert not self._modified, \
-            "After applying modifier cells the base cell cannot be called "\
-            "directly. Call the modifier cell instead."
-        states = []
+            "this cell is wrapped by a modifier; step the modifier instead"
+        fresh = []
         for info in self.state_info:
-            self._init_counter += 1
-            if info is not None:
-                info = dict(info, **kwargs)
-            else:
-                info = dict(kwargs)
-            info = {k: v for k, v in info.items()
-                    if not k.startswith("__")}  # drop __layout__ etc.
-            state = func(name=f"{self._prefix}begin_state_"
-                         f"{self._init_counter}", **info)
-            states.append(state)
-        return states
+            merged = {**(info or {}), **kwargs}
+            merged = {k: v for k, v in merged.items()
+                      if not k.startswith("__")}  # drop __layout__ etc.
+            fresh.append(func(name=self._fresh_state_name(), **merged))
+        return fresh
 
     def _auto_begin_state(self, ref, batch_axis=0):
         """Default zero begin states sized from the input symbol's batch dim
         (the XLA-era replacement for the reference's bidirectional shape
         inference of zeros(shape=(0, H)) states)."""
-        states = []
-        for info in self.state_info:
-            self._init_counter += 1
-            states.append(getattr(symbol, "_begin_state_zeros")(
-                ref, shape=info["shape"], batch_axis=batch_axis,
-                name=f"{self._prefix}begin_state_{self._init_counter}"))
-        return states
+        zeros_like_batch = getattr(symbol, "_begin_state_zeros")
+        return [zeros_like_batch(ref, shape=info["shape"],
+                                 batch_axis=batch_axis,
+                                 name=self._fresh_state_name())
+                for info in self.state_info]
 
     def unpack_weights(self, args):
         """Split fused parameter blobs into per-gate arrays
         (reference rnn_cell.py:unpack_weights)."""
-        args = dict(args)
-        if not self._gate_names:
-            return args
-        h = self._num_hidden
-        for group_name in ("i2h", "h2h"):
-            weight = args.pop(f"{self._prefix}{group_name}_weight")
-            bias = args.pop(f"{self._prefix}{group_name}_bias")
+        out = dict(args)
+        for group in ("i2h", "h2h") if self._gate_names else ():
+            blob_w = out.pop(f"{self._prefix}{group}_weight")
+            blob_b = out.pop(f"{self._prefix}{group}_bias")
+            h = self._num_hidden
             for j, gate in enumerate(self._gate_names):
-                wname = f"{self._prefix}{group_name}{gate}_weight"
-                args[wname] = weight[j * h:(j + 1) * h].copy()
-                bname = f"{self._prefix}{group_name}{gate}_bias"
-                args[bname] = bias[j * h:(j + 1) * h].copy()
-        return args
+                rows = slice(j * h, (j + 1) * h)
+                out[f"{self._prefix}{group}{gate}_weight"] = \
+                    blob_w[rows].copy()
+                out[f"{self._prefix}{group}{gate}_bias"] = blob_b[rows].copy()
+        return out
 
     def pack_weights(self, args):
-        args = dict(args)
-        if not self._gate_names:
-            return args
-        for group_name in ("i2h", "h2h"):
-            weight = []
-            bias = []
-            for gate in self._gate_names:
-                weight.append(args.pop(
-                    f"{self._prefix}{group_name}{gate}_weight"))
-                bias.append(args.pop(
-                    f"{self._prefix}{group_name}{gate}_bias"))
-            args[f"{self._prefix}{group_name}_weight"] = \
-                ndarray.concatenate(weight)
-            args[f"{self._prefix}{group_name}_bias"] = \
-                ndarray.concatenate(bias)
-        return args
+        out = dict(args)
+        for group in ("i2h", "h2h") if self._gate_names else ():
+            ws, bs = zip(*((out.pop(f"{self._prefix}{group}{g}_weight"),
+                            out.pop(f"{self._prefix}{group}{g}_bias"))
+                           for g in self._gate_names))
+            out[f"{self._prefix}{group}_weight"] = \
+                ndarray.concatenate(list(ws))
+            out[f"{self._prefix}{group}_bias"] = ndarray.concatenate(list(bs))
+        return out
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         """Unroll the cell for ``length`` steps (reference :295)."""
         self.reset()
-        inputs, _ = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self._auto_begin_state(inputs[0])
-        states = begin_state
-        outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
-        outputs, _ = _format_sequence(length, outputs, layout, merge_outputs)
-        return outputs, states
+        steps, _ = _normalize_sequence(length, inputs, layout, False)
+        carry = begin_state if begin_state is not None \
+            else self._auto_begin_state(steps[0])
+        outs = []
+        for x in steps:
+            y, carry = self(x, carry)
+            outs.append(y)
+        outs, _ = _format_sequence(length, outs, layout, merge_outputs)
+        return outs, carry
 
     def _get_activation(self, inputs, activation, **kwargs):
-        if isinstance(activation, str):
-            return symbol.Activation(inputs, act_type=activation, **kwargs)
-        return activation(inputs, **kwargs)
+        if callable(activation):
+            return activation(inputs, **kwargs)
+        return symbol.Activation(inputs, act_type=activation, **kwargs)
 
 
 def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
     """inputs → list of per-step symbols (reference rnn_cell.py helpers)."""
     axis = layout.find("T")
     if isinstance(inputs, symbol.Symbol):
-        in_axis = (in_layout or layout).find("T")
         if len(inputs.list_outputs()) == 1:
             # one symbol carrying the whole sequence: split on time axis
-            inputs = symbol.split(inputs, axis=in_axis, num_outputs=length,
+            t_axis = (in_layout or layout).find("T")
+            inputs = symbol.split(inputs, axis=t_axis, num_outputs=length,
                                   squeeze_axis=1)
             inputs = list(inputs) if length > 1 else [inputs]
         else:
@@ -179,8 +191,8 @@ def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
 def _format_sequence(length, outputs, layout, merge):
     axis = layout.find("T")
     if merge:
-        outputs = [symbol.expand_dims(o, axis=axis) for o in outputs]
-        outputs = symbol.Concat(*outputs, dim=axis)
+        expanded = [symbol.expand_dims(o, axis=axis) for o in outputs]
+        outputs = symbol.Concat(*expanded, dim=axis)
     return outputs, axis
 
 
@@ -192,30 +204,21 @@ class RNNCell(BaseRNNCell):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
         self._activation = activation
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._bind_dense_params()
 
     @property
     def state_info(self):
-        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+        return [self._nc_state()]
 
     @property
     def _gate_names(self):
         return ("",)
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = f"{self._prefix}t{self._counter}_"
-        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
-                                    num_hidden=self._num_hidden,
-                                    name=f"{name}i2h")
-        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
-                                    num_hidden=self._num_hidden,
-                                    name=f"{name}h2h")
+        tag = self._step_tag()
+        i2h, h2h = self._affine_pair(inputs, states[0], 1, tag)
         output = self._get_activation(i2h + h2h, self._activation,
-                                      name=f"{name}out")
+                                      name=f"{tag}out")
         return output, [output]
 
 
@@ -227,39 +230,27 @@ class LSTMCell(BaseRNNCell):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
         from ..initializer import LSTMBias
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias",
-                                   init=LSTMBias(forget_bias=forget_bias))
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._bind_dense_params(LSTMBias(forget_bias=forget_bias))
 
     @property
     def state_info(self):
-        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
-                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+        return [self._nc_state(), self._nc_state()]
 
     @property
     def _gate_names(self):
         return ("_i", "_f", "_c", "_o")
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = f"{self._prefix}t{self._counter}_"
-        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name=f"{name}i2h")
-        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name=f"{name}h2h")
-        gates = i2h + h2h
-        slices = symbol.SliceChannel(gates, num_outputs=4,
-                                     name=f"{name}slice")
-        in_gate = symbol.Activation(slices[0], act_type="sigmoid")
-        forget_gate = symbol.Activation(slices[1], act_type="sigmoid")
-        in_transform = symbol.Activation(slices[2], act_type="tanh")
-        out_gate = symbol.Activation(slices[3], act_type="sigmoid")
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
+        tag = self._step_tag()
+        i2h, h2h = self._affine_pair(inputs, states[0], 4, tag)
+        lanes = symbol.SliceChannel(i2h + h2h, num_outputs=4,
+                                    name=f"{tag}slice")
+        sig = lambda s: symbol.Activation(s, act_type="sigmoid")  # noqa: E731
+        tanh = lambda s: symbol.Activation(s, act_type="tanh")  # noqa: E731
+        keep, forget, cand, emit = \
+            sig(lanes[0]), sig(lanes[1]), tanh(lanes[2]), sig(lanes[3])
+        next_c = forget * states[1] + keep * cand
+        next_h = emit * tanh(next_c)
         return next_h, [next_h, next_c]
 
 
@@ -269,38 +260,28 @@ class GRUCell(BaseRNNCell):
     def __init__(self, num_hidden, prefix="gru_", params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._bind_dense_params()
 
     @property
     def state_info(self):
-        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+        return [self._nc_state()]
 
     @property
     def _gate_names(self):
         return ("_r", "_z", "_o")
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = f"{self._prefix}t{self._counter}_"
+        tag = self._step_tag()
         prev_h = states[0]
-        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name=f"{name}i2h")
-        h2h = symbol.FullyConnected(prev_h, self._hW, self._hB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name=f"{name}h2h")
-        i2h_r, i2h_z, i2h = symbol.SliceChannel(
-            i2h, num_outputs=3, name=f"{name}i2h_slice")
-        h2h_r, h2h_z, h2h = symbol.SliceChannel(
-            h2h, num_outputs=3, name=f"{name}h2h_slice")
-        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid")
-        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid")
-        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h,
-                                       act_type="tanh")
-        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        i2h, h2h = self._affine_pair(inputs, prev_h, 3, tag)
+        i_r, i_z, i_n = symbol.SliceChannel(i2h, num_outputs=3,
+                                            name=f"{tag}i2h_slice")
+        h_r, h_z, h_n = symbol.SliceChannel(h2h, num_outputs=3,
+                                            name=f"{tag}h2h_slice")
+        reset = symbol.Activation(i_r + h_r, act_type="sigmoid")
+        update = symbol.Activation(i_z + h_z, act_type="sigmoid")
+        cand = symbol.Activation(i_n + reset * h_n, act_type="tanh")
+        next_h = update * prev_h + (1.0 - update) * cand
         return next_h, [next_h]
 
 
@@ -311,24 +292,19 @@ class FusedRNNCell(BaseRNNCell):
     def __init__(self, num_hidden, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0.0, get_next_state=False,
                  forget_bias=1.0, prefix=None, params=None):
-        if prefix is None:
-            prefix = f"{mode}_"
-        super().__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        self._num_layers = num_layers
-        self._mode = mode
-        self._bidirectional = bidirectional
-        self._dropout = dropout
-        self._get_next_state = get_next_state
+        super().__init__(prefix=f"{mode}_" if prefix is None else prefix,
+                         params=params)
+        self._num_hidden, self._num_layers = num_hidden, num_layers
+        self._mode, self._bidirectional = mode, bidirectional
+        self._dropout, self._get_next_state = dropout, get_next_state
         self._parameter = self.params.get("parameters")
         self._directions = ["l", "r"] if bidirectional else ["l"]
 
     @property
     def state_info(self):
-        D = 2 if self._bidirectional else 1
-        b = {"shape": (D * self._num_layers, 0, self._num_hidden),
-             "__layout__": "LNC"}
-        return [b] * (2 if self._mode == "lstm" else 1)
+        depth = len(self._directions) * self._num_layers
+        block = {"shape": (depth, 0, self._num_hidden), "__layout__": "LNC"}
+        return [block] * (2 if self._mode == "lstm" else 1)
 
     @property
     def _gate_names(self):
@@ -345,60 +321,48 @@ class FusedRNNCell(BaseRNNCell):
         (l0_i2h_weight, r0_h2h_bias, ...)."""
         pieces = _unpack(arr._data, self._num_layers, li, lh, self._mode,
                          self._bidirectional)
-        args = {}
+        named = {}
         for layer in range(self._num_layers):
             for d, dname in enumerate(self._directions):
                 w_i2h, w_h2h, b_i2h, b_h2h = pieces[layer][d]
                 base = f"{self._prefix}{dname}{layer}_"
-                args[f"{base}i2h_weight"] = ndarray.NDArray(w_i2h)
-                args[f"{base}h2h_weight"] = ndarray.NDArray(w_h2h)
-                args[f"{base}i2h_bias"] = ndarray.NDArray(b_i2h)
-                args[f"{base}h2h_bias"] = ndarray.NDArray(b_h2h)
-        return args
+                named[f"{base}i2h_weight"] = ndarray.NDArray(w_i2h)
+                named[f"{base}h2h_weight"] = ndarray.NDArray(w_h2h)
+                named[f"{base}i2h_bias"] = ndarray.NDArray(b_i2h)
+                named[f"{base}h2h_bias"] = ndarray.NDArray(b_h2h)
+        return named
 
     def unpack_weights(self, args):
-        args = dict(args)
-        arr = args.pop(self._parameter.name)
-        b = self._num_gates * self._num_hidden
-        m = arr.size
-        li = (m // b - (self._num_layers - 1) *
-              (self._num_hidden * (1 + len(self._directions)) + 2 *
-               len(self._directions)) - self._num_hidden - 2) \
-            // len(self._directions) if False else None
+        out = dict(args)
+        blob = out.pop(self._parameter.name)
         # solve input size from total param count
-        input_size = self._infer_input_size(arr.size)
-        args.update(self._slice_weights(arr, input_size, self._num_hidden))
-        return args
+        input_size = self._infer_input_size(blob.size)
+        out.update(self._slice_weights(blob, input_size, self._num_hidden))
+        return out
 
     def _infer_input_size(self, total):
         H, L = self._num_hidden, self._num_layers
-        mode, bi = self._mode, self._bidirectional
         # closed form is messy; scan plausible sizes
-        for input_size in range(1, 65536):
-            if rnn_param_size(L, input_size, H, mode, bi) == total:
-                return input_size
+        for candidate in range(1, 65536):
+            if rnn_param_size(L, candidate, H, self._mode,
+                              self._bidirectional) == total:
+                return candidate
         raise MXNetError("cannot infer input size from parameter length")
 
     def pack_weights(self, args):
         import numpy as np
-        args = dict(args)
-        H = self._num_hidden
-        flat = []
-        b0 = args[f"{self._prefix}l0_i2h_weight"]
-        input_size = b0.shape[1]
-        in_size = input_size
-        biases = []
+        out = dict(args)
+        mats, vecs = [], []
         for layer in range(self._num_layers):
             for dname in self._directions:
                 base = f"{self._prefix}{dname}{layer}_"
-                flat.append(args.pop(f"{base}i2h_weight").asnumpy().ravel())
-                flat.append(args.pop(f"{base}h2h_weight").asnumpy().ravel())
-                biases.append(args.pop(f"{base}i2h_bias").asnumpy().ravel())
-                biases.append(args.pop(f"{base}h2h_bias").asnumpy().ravel())
-            in_size = H * len(self._directions)
-        args[self._parameter.name] = ndarray.array(
-            np.concatenate(flat + biases))
-        return args
+                mats.append(out.pop(f"{base}i2h_weight").asnumpy().ravel())
+                mats.append(out.pop(f"{base}h2h_weight").asnumpy().ravel())
+                vecs.append(out.pop(f"{base}i2h_bias").asnumpy().ravel())
+                vecs.append(out.pop(f"{base}h2h_bias").asnumpy().ravel())
+        out[self._parameter.name] = ndarray.array(
+            np.concatenate(mats + vecs))
+        return out
 
     def __call__(self, inputs, states):
         raise MXNetError(
@@ -407,37 +371,37 @@ class FusedRNNCell(BaseRNNCell):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis = _normalize_sequence(length, inputs, layout, True)
+        steps, axis = _normalize_sequence(length, inputs, layout, True)
         # fused op consumes TNC: stack per-step inputs on a leading T axis
         stacked = symbol.Concat(
-            *[symbol.expand_dims(x, axis=0) for x in inputs], dim=0) \
-            if isinstance(inputs, list) else inputs
+            *[symbol.expand_dims(x, axis=0) for x in steps], dim=0) \
+            if isinstance(steps, list) else steps
         if begin_state is None:
             begin_state = self._auto_begin_state(stacked, batch_axis=1)
-        states = list(begin_state)
-        rnn_inputs = [stacked, self._parameter] + states
-        rnn = symbol.RNN(*rnn_inputs, state_size=self._num_hidden,
+        carry = list(begin_state)
+        rnn = symbol.RNN(stacked, self._parameter, *carry,
+                         state_size=self._num_hidden,
                          num_layers=self._num_layers, mode=self._mode,
                          bidirectional=self._bidirectional, p=self._dropout,
                          state_outputs=self._get_next_state,
                          name=f"{self._prefix}rnn")
         if not self._get_next_state:
-            outputs, states = rnn, []
+            outputs, carry = rnn, []
         elif self._mode == "lstm":
-            outputs, states = rnn[0], [rnn[1], rnn[2]]
+            outputs, carry = rnn[0], [rnn[1], rnn[2]]
         else:
-            outputs, states = rnn[0], [rnn[1]]
+            outputs, carry = rnn[0], [rnn[1]]
         if merge_outputs is False:
             outputs = list(symbol.split(outputs, axis=0, num_outputs=length,
                                         squeeze_axis=1))
         elif layout == "NTC":
             outputs = symbol.swapaxes(outputs, dim1=0, dim2=1)
-        return outputs, states
+        return outputs, carry
 
     def unfuse(self):
         """Equivalent stack of unfused cells (reference :780)."""
         stack = SequentialRNNCell()
-        get_cell = {
+        make = {
             "rnn_relu": lambda p: RNNCell(self._num_hidden,
                                           activation="relu", prefix=p),
             "rnn_tanh": lambda p: RNNCell(self._num_hidden,
@@ -445,15 +409,16 @@ class FusedRNNCell(BaseRNNCell):
             "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
             "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
         }[self._mode]
+        last = self._num_layers - 1
         for i in range(self._num_layers):
             if self._bidirectional:
                 stack.add(BidirectionalCell(
-                    get_cell(f"{self._prefix}l{i}_"),
-                    get_cell(f"{self._prefix}r{i}_"),
+                    make(f"{self._prefix}l{i}_"),
+                    make(f"{self._prefix}r{i}_"),
                     output_prefix=f"{self._prefix}bi_l{i}_"))
             else:
-                stack.add(get_cell(f"{self._prefix}l{i}_"))
-            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(make(f"{self._prefix}l{i}_"))
+            if self._dropout > 0 and i != last:
                 stack.add(DropoutCell(self._dropout,
                                       prefix=f"{self._prefix}_dropout{i}_"))
         return stack
@@ -475,10 +440,10 @@ class SequentialRNNCell(BaseRNNCell):
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return [info for c in self._cells for info in c.state_info]
 
     def begin_state(self, **kwargs):
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
 
     def unpack_weights(self, args):
         for cell in self._cells:
@@ -492,33 +457,32 @@ class SequentialRNNCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
+        carry_out = []
+        cursor = 0
         for cell in self._cells:
-            n = len(cell.state_info)
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.extend(state)
-        return inputs, next_states
+            width = len(cell.state_info)
+            inputs, piece = cell(inputs, states[cursor:cursor + width])
+            cursor += width
+            carry_out.extend(piece)
+        return inputs, carry_out
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        num_cells = len(self._cells)
-        p = 0
-        outputs = inputs
-        states = []
+        cursor = 0
+        flowing = inputs
+        carry = []
+        last = len(self._cells) - 1
         for i, cell in enumerate(self._cells):
-            n = len(cell.state_info)
-            cell_begin = None if begin_state is None \
-                else begin_state[p:p + n]
-            outputs, st = cell.unroll(
-                length, outputs, begin_state=cell_begin, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            p += n
-            states.extend(st)
-        return outputs, states
+            width = len(cell.state_info)
+            sub_begin = None if begin_state is None \
+                else begin_state[cursor:cursor + width]
+            flowing, piece = cell.unroll(
+                length, flowing, begin_state=sub_begin, layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            cursor += width
+            carry.extend(piece)
+        return flowing, carry
 
 
 class DropoutCell(BaseRNNCell):
@@ -584,22 +548,23 @@ class ZoneoutCell(ModifierCell):
         super().reset()
         self.prev_output = None
 
+    @staticmethod
+    def _zone(p, fresh, stale):
+        """Keep each unit of ``fresh`` with prob 1-p, else reuse ``stale``."""
+        coin = symbol.Dropout(symbol.ones_like(fresh), p=p)
+        return symbol.where(coin, fresh, stale)
+
     def __call__(self, inputs, states):
-        cell = self.base_cell
-        next_output, next_states = cell(inputs, states)
-        mask = lambda p, like: symbol.Dropout(  # noqa: E731
-            symbol.ones_like(like), p=p)
-        prev_output = self.prev_output if self.prev_output is not None \
+        next_output, next_states = self.base_cell(inputs, states)
+        stale_out = self.prev_output if self.prev_output is not None \
             else symbol.zeros_like(next_output)
-        output = symbol.where(mask(self.zoneout_outputs, next_output),
-                              next_output, prev_output) \
+        output = self._zone(self.zoneout_outputs, next_output, stale_out) \
             if self.zoneout_outputs > 0.0 else next_output
-        states = [symbol.where(mask(self.zoneout_states, new_s), new_s,
-                               old_s)
-                  for new_s, old_s in zip(next_states, states)] \
-            if self.zoneout_states > 0.0 else next_states
+        if self.zoneout_states > 0.0:
+            next_states = [self._zone(self.zoneout_states, new_s, old_s)
+                           for new_s, old_s in zip(next_states, states)]
         self.prev_output = output
-        return output, states
+        return output, next_states
 
 
 class ResidualCell(ModifierCell):
@@ -607,8 +572,7 @@ class ResidualCell(ModifierCell):
 
     def __call__(self, inputs, states):
         output, states = self.base_cell(inputs, states)
-        output = output + inputs
-        return output, states
+        return output + inputs, states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
@@ -618,9 +582,9 @@ class ResidualCell(ModifierCell):
             length, inputs, begin_state=begin_state, layout=layout,
             merge_outputs=False)
         self.base_cell._modified = True
-        inputs, _ = _normalize_sequence(length, inputs, layout, False)
-        outputs = [o + i for o, i in zip(outputs, inputs)]
-        outputs, _ = _format_sequence(length, outputs, layout, merge_outputs)
+        steps, _ = _normalize_sequence(length, inputs, layout, False)
+        summed = [o + i for o, i in zip(outputs, steps)]
+        outputs, _ = _format_sequence(length, summed, layout, merge_outputs)
         return outputs, states
 
 
@@ -648,33 +612,29 @@ class BidirectionalCell(BaseRNNCell):
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return [info for c in self._cells for info in c.state_info]
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        steps, axis = _normalize_sequence(length, inputs, layout, False)
         if begin_state is None:
-            begin_state = sum(
-                (c._auto_begin_state(inputs[0]) for c in self._cells), [])
-        states = begin_state
-        l_cell, r_cell = self._cells
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info)],
+            begin_state = [s for c in self._cells
+                           for s in c._auto_begin_state(steps[0])]
+        fwd, bwd = self._cells
+        split_at = len(fwd.state_info)
+        fwd_out, fwd_states = fwd.unroll(
+            length, inputs=steps, begin_state=begin_state[:split_at],
             layout=layout, merge_outputs=False)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=states[len(l_cell.state_info):],
+        bwd_out, bwd_states = bwd.unroll(
+            length, inputs=steps[::-1], begin_state=begin_state[split_at:],
             layout=layout, merge_outputs=False)
-        outputs = [symbol.Concat(l_o, r_o, dim=1,
-                                 name=f"{self._output_prefix}t{i}")
-                   for i, (l_o, r_o) in enumerate(
-                       zip(l_outputs, reversed(r_outputs)))]
-        outputs, _ = _format_sequence(length, outputs, layout, merge_outputs)
-        states = l_states + r_states
-        return outputs, states
+        joined = [symbol.Concat(f, b, dim=1,
+                                name=f"{self._output_prefix}t{i}")
+                  for i, (f, b) in enumerate(zip(fwd_out, bwd_out[::-1]))]
+        outputs, _ = _format_sequence(length, joined, layout, merge_outputs)
+        return outputs, fwd_states + bwd_states
