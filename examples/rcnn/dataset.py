@@ -1,0 +1,199 @@
+"""Detection datasets: the imdb abstraction + loaders.
+
+Reference analogue: example/rcnn/rcnn/dataset/imdb.py (roidb records,
+append_flipped_images) and dataset/pascal_voc.py (VOC XML annotations).
+``PascalVOC`` reads the standard VOCdevkit layout from local disk (this
+environment has no egress, so nothing downloads); ``SyntheticShapes``
+generates the three-class scene set used by the CI gates — every sample
+is reproducible from its index alone, so train/val splits need no files.
+"""
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+VOC_CLASSES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car",
+    "cat", "chair", "cow", "diningtable", "dog", "horse", "motorbike",
+    "person", "pottedplant", "sheep", "sofa", "train", "tvmonitor")
+
+
+class ImageDB:
+    """A detection dataset: indexed (image, gt) samples plus metadata.
+
+    ``sample(i)`` returns (image CHW float32 in [0,1], gt rows
+    [cls, x1, y1, x2, y2] in pixel coords). ``roidb()`` materialises the
+    annotation records without images, mirroring the reference's roidb.
+    """
+
+    classes: tuple = ()
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def sample(self, i):
+        raise NotImplementedError
+
+    def roidb(self):
+        return [{"index": i, "gt": self.sample(i)[1]}
+                for i in range(len(self))]
+
+    def append_flipped(self):
+        """Horizontally-flipped copy of every sample appended at the end
+        (reference imdb.py:append_flipped_images)."""
+        return _Flipped(self)
+
+    def batches(self, batch_size, rng):
+        """Yield (imgs (B,C,H,W), [gt...]) minibatches in random order."""
+        order = rng.permutation(len(self))
+        for lo in range(0, len(order) - batch_size + 1, batch_size):
+            picked = [self.sample(int(j))
+                      for j in order[lo:lo + batch_size]]
+            yield np.stack([p[0] for p in picked]), [p[1] for p in picked]
+
+
+class _Flipped(ImageDB):
+    def __init__(self, base):
+        self._base = base
+        self.classes = base.classes
+
+    def __len__(self):
+        return 2 * len(self._base)
+
+    def sample(self, i):
+        n = len(self._base)
+        img, gt = self._base.sample(i % n)
+        if i < n:
+            return img, gt
+        width = img.shape[-1]
+        flipped = img[..., ::-1].copy()
+        gt = gt.copy()
+        if len(gt):
+            x1 = gt[:, 1].copy()
+            gt[:, 1] = width - 1 - gt[:, 3]
+            gt[:, 3] = width - 1 - x1
+        return flipped, gt
+
+
+class PascalVOC(ImageDB):
+    """VOCdevkit reader: JPEGImages/ + Annotations/*.xml + ImageSets
+    (reference dataset/pascal_voc.py — gt_roidb/load_pascal_annotation).
+
+    Images decode through the framework's own image module; boxes keep
+    the VOC 1-based convention converted to 0-based pixel coords.
+    """
+
+    classes = VOC_CLASSES
+
+    def __init__(self, devkit_root, image_set="trainval", year="2007",
+                 use_difficult=False, short_side=None):
+        self._voc = os.path.join(devkit_root, f"VOC{year}")
+        self._short = short_side
+        self._difficult = use_difficult
+        listing = os.path.join(self._voc, "ImageSets", "Main",
+                               f"{image_set}.txt")
+        if not os.path.exists(listing):
+            raise FileNotFoundError(
+                f"VOC image set listing not found: {listing} (no network "
+                "egress in this environment — stage the VOCdevkit locally)")
+        with open(listing) as fin:
+            self._ids = [ln.strip().split()[0] for ln in fin if ln.strip()]
+
+    def __len__(self):
+        return len(self._ids)
+
+    def _annotation(self, stem):
+        tree = ET.parse(os.path.join(self._voc, "Annotations",
+                                     f"{stem}.xml"))
+        rows = []
+        for obj in tree.findall("object"):
+            if not self._difficult and \
+                    int(obj.findtext("difficult", "0")) == 1:
+                continue
+            name = obj.findtext("name")
+            if name not in self.classes:
+                continue
+            box = obj.find("bndbox")
+            # VOC stores 1-based corners
+            coords = [float(box.findtext(k)) - 1.0
+                      for k in ("xmin", "ymin", "xmax", "ymax")]
+            rows.append([float(self.classes.index(name))] + coords)
+        return np.asarray(rows, np.float32).reshape(-1, 5)
+
+    def sample(self, i):
+        from mxnet_tpu import image as mx_image
+        stem = self._ids[i]
+        raw = mx_image.imread(
+            os.path.join(self._voc, "JPEGImages", f"{stem}.jpg"))
+        img = raw.asnumpy().astype(np.float32) / 255.0     # HWC
+        gt = self._annotation(stem)
+        if self._short is not None:
+            h, w = img.shape[:2]
+            scale = self._short / min(h, w)
+            img = _resize_hwc(img, int(round(h * scale)),
+                              int(round(w * scale)))
+            if len(gt):
+                gt[:, 1:5] *= scale
+        return img.transpose(2, 0, 1), gt
+
+    def roidb(self):
+        # annotations only — no image decode (reference gt_roidb)
+        return [{"index": i, "gt": self._annotation(stem)}
+                for i, stem in enumerate(self._ids)]
+
+
+def _resize_hwc(img, out_h, out_w):
+    """Nearest-neighbour host resize (keeps this module dependency-free)."""
+    ys = (np.arange(out_h) * img.shape[0] / out_h).astype(int)
+    xs = (np.arange(out_w) * img.shape[1] / out_w).astype(int)
+    return img[ys][:, xs]
+
+
+class SyntheticShapes(ImageDB):
+    """Three-class procedural scenes (box / ring / cross), reproducible
+    per index — the CI stand-in for VOC."""
+
+    def __init__(self, n, im_size=64, seed=0, classes=("box", "ring",
+                                                       "cross")):
+        self._n = n
+        self._size = im_size
+        self._seed = seed
+        self.classes = tuple(classes)
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, i):
+        rng = np.random.RandomState(self._seed * 1000003 + i)
+        size = self._size
+        img = rng.rand(3, size, size).astype(np.float32) * 0.15
+        gts, taken = [], []
+        for _ in range(rng.randint(1, 4)):
+            for _ in range(8):
+                w = rng.randint(16, 33)
+                x0 = rng.randint(0, size - w)
+                y0 = rng.randint(0, size - w)
+                if all(abs(x0 - tx) + abs(y0 - ty) > (w + tw) // 2
+                       for tx, ty, tw in taken):
+                    break
+            else:
+                continue
+            taken.append((x0, y0, w))
+            cls = rng.randint(0, len(self.classes))
+            x1, y1 = x0 + w, y0 + w
+            if cls == 0:
+                img[0, y0:y1, x0:x1] += 0.9
+            elif cls == 1:
+                img[1, y0:y1, x0:x1] += 0.9
+                m = max(2, w // 4)
+                img[1, y0 + m:y1 - m, x0 + m:x1 - m] -= 0.9
+            else:
+                t = max(2, w // 4)
+                c = w // 2
+                img[2, y0 + c - t // 2:y0 + c + (t + 1) // 2,
+                    x0:x1] += 0.9
+                img[2, y0:y1,
+                    x0 + c - t // 2:x0 + c + (t + 1) // 2] += 0.9
+            gts.append([cls, x0, y0, x1 - 1, y1 - 1])
+        np.clip(img, 0.0, 1.0, out=img)
+        return img, np.asarray(gts, np.float32).reshape(-1, 5)

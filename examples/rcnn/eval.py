@@ -1,0 +1,74 @@
+"""Detection evaluation: per-class VOC AP report + proposal recall.
+
+Reference analogue: example/rcnn/rcnn/dataset/pascal_voc_eval.py (voc_eval
+per class, 11-point metric) and the recall printout of rcnn/core/tester.py.
+``voc_map`` in rcnn_common stays the single-number gate; this module
+produces the per-class table the reference's evaluate_detections prints.
+"""
+import numpy as np
+
+from rcnn_common import iou_matrix
+
+
+def class_ap(all_dets, all_gts, cls, iou_thresh=0.5):
+    """11-point AP for one class id; returns (ap, n_gt, n_det)."""
+    records, n_gt = [], 0
+    for dets, gts in zip(all_dets, all_gts):
+        gt_c = np.asarray([g[1:5] for g in gts if int(g[0]) == cls],
+                          np.float32)
+        n_gt += len(gt_c)
+        used = np.zeros(len(gt_c), bool)
+        for d in sorted((d for d in dets if int(d[0]) == cls),
+                        key=lambda r: -r[1]):
+            if len(gt_c) == 0:
+                records.append((d[1], False))
+                continue
+            iou = iou_matrix(np.asarray(d[2:6], np.float32)[None], gt_c)[0]
+            bi = int(iou.argmax())
+            hit = iou[bi] >= iou_thresh and not used[bi]
+            used[bi] |= hit
+            records.append((d[1], hit))
+    if n_gt == 0:
+        return float("nan"), 0, len(records)
+    if not records:
+        return 0.0, n_gt, 0
+    records.sort(key=lambda r: -r[0])
+    tp = np.cumsum([r[1] for r in records])
+    recall = tp / n_gt
+    precision = tp / np.arange(1, len(tp) + 1)
+    ap = float(np.mean([
+        precision[recall >= t].max() if (recall >= t).any() else 0.0
+        for t in np.linspace(0, 1, 11)]))
+    return ap, n_gt, len(records)
+
+
+def evaluate_detections(all_dets, all_gts, class_names, iou_thresh=0.5,
+                        log=print):
+    """Per-class AP table + mAP (reference evaluate_detections print).
+    mAP is the mean of the per-class APs over classes with ground truth
+    — the same skip-zero-gt semantics as rcnn_common.voc_map, computed
+    once."""
+    log(f"{'class':>12} {'AP':>7} {'#gt':>5} {'#det':>6}")
+    aps = []
+    for c, name in enumerate(class_names):
+        ap, n_gt, n_det = class_ap(all_dets, all_gts, c, iou_thresh)
+        log(f"{name:>12} {ap:7.3f} {n_gt:5d} {n_det:6d}")
+        if n_gt:
+            aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    log(f"{'mAP':>12} {m:7.3f}")
+    return m
+
+
+def proposal_recall(proposals, all_gts, iou_thresh=0.5):
+    """Fraction of gt boxes covered by at least one proposal
+    (reference tester.py recall statistics)."""
+    covered = total = 0
+    for props, gts in zip(proposals, all_gts):
+        gt = np.asarray([g[1:5] for g in gts], np.float32)
+        total += len(gt)
+        if not len(gt) or not len(props):
+            continue
+        iou = iou_matrix(np.asarray(props, np.float32), gt)
+        covered += int((iou.max(0) >= iou_thresh).sum())
+    return covered / max(total, 1)

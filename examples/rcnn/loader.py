@@ -1,0 +1,91 @@
+"""AnchorLoader: a DataIter serving RPN training batches.
+
+Reference analogue: example/rcnn/rcnn/core/loader.py (AnchorLoader) —
+the iterator that pairs images with host-assigned anchor targets so a
+Module (or any DataIter consumer) can train the RPN through the
+framework's standard fit machinery. Data names mirror the reference:
+data = (data, im_info, gt_boxes), label = (label, bbox_target,
+bbox_weight).
+
+Ragged ground truth is padded to ``max_gt`` rows with cls = -1 sentinel
+rows (static shapes keep every traced program cacheable); consumers
+filter rows with gt[:, 0] >= 0.
+"""
+import numpy as np
+
+from mxnet_tpu import nd
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+
+from rcnn_common import assign_anchor_targets, make_anchor_grid
+
+
+class AnchorLoader(DataIter):
+    def __init__(self, db, batch_size, im_size, stride, scales, ratios,
+                 rpn_batch=64, max_gt=8, shuffle=True, seed=0):
+        super().__init__(batch_size)
+        self._db = db
+        self._im = im_size
+        self._rpn_batch = rpn_batch
+        self._max_gt = max_gt
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        feat = im_size // stride
+        self._anchors = make_anchor_grid(feat, feat, stride, scales,
+                                         ratios)
+        self._n_anchor = len(self._anchors)
+        self._order = np.arange(len(db))
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        b = self.batch_size
+        return [DataDesc("data", (b, 3, self._im, self._im)),
+                DataDesc("im_info", (b, 3)),
+                DataDesc("gt_boxes", (b, self._max_gt, 5))]
+
+    @property
+    def provide_label(self):
+        b = self.batch_size
+        return [DataDesc("label", (b, self._n_anchor)),
+                DataDesc("bbox_target", (b, self._n_anchor, 4)),
+                DataDesc("bbox_weight", (b, self._n_anchor, 1))]
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+
+    def _pad_gt(self, gt):
+        out = np.full((self._max_gt, 5), -1.0, np.float32)
+        out[:min(len(gt), self._max_gt)] = gt[:self._max_gt]
+        return out
+
+    def next(self):
+        b = self.batch_size
+        if self._cursor + b > len(self._order):
+            raise StopIteration
+        picked = [self._db.sample(int(j)) for j in
+                  self._order[self._cursor:self._cursor + b]]
+        self._cursor += b
+
+        imgs = np.stack([p[0] for p in picked])
+        lab = np.zeros((b, self._n_anchor), np.float32)
+        tgt = np.zeros((b, self._n_anchor, 4), np.float32)
+        wgt = np.zeros((b, self._n_anchor, 1), np.float32)
+        for i, (_, gt) in enumerate(picked):
+            lab[i], tgt[i], wgt[i] = assign_anchor_targets(
+                self._anchors, gt, self._im, rpn_batch=self._rpn_batch,
+                rng=self._rng)
+        im_info = np.tile(
+            np.array([self._im, self._im, 1.0], np.float32), (b, 1))
+        gt_pad = np.stack([self._pad_gt(p[1]) for p in picked])
+        return DataBatch(
+            data=[nd.array(imgs), nd.array(im_info), nd.array(gt_pad)],
+            label=[nd.array(lab), nd.array(tgt), nd.array(wgt)],
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+    @staticmethod
+    def unpad_gt(padded):
+        """Recover the ragged gt list from a padded (B, max_gt, 5) array."""
+        return [row[row[:, 0] >= 0] for row in padded]

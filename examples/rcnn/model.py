@@ -1,0 +1,233 @@
+"""Faster-RCNN network + train/infer steps shared by the rcnn tools.
+
+Reference analogue: example/rcnn/rcnn/symbol/symbol_vgg.py (get_vgg_train /
+get_vgg_test, shrunk to a 3-stage stride-8 backbone) and the per-batch
+logic of rcnn/core/module.py. The host/device split is the TPU-idiomatic
+one: ragged target assignment runs in numpy producing fixed-shape arrays,
+every dense FLOP runs on the chip, and each traced program caches once.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+from rcnn_common import (BBOX_STDS, assign_anchor_targets, decode_boxes,
+                         nms, sample_roi_targets)
+
+IMG = 64
+STRIDE = 8
+FEAT = IMG // STRIDE
+SCALES = (2.0, 3.0, 4.0)
+RATIOS = (0.5, 1.0, 2.0)
+A = len(SCALES) * len(RATIOS)
+N_ANCHOR = FEAT * FEAT * A
+CLASSES = ("box", "ring", "cross")
+NC1 = len(CLASSES) + 1
+ROIS_PER_IMG = 16
+POST_NMS = 12
+RPN_BATCH = 64
+
+
+class RCNN:
+    """Backbone + RPN heads + ROI head as named gluon blocks."""
+
+    def __init__(self):
+        g = mx.gluon.nn
+        self.backbone = g.HybridSequential()
+        with self.backbone.name_scope():
+            for ch in (16, 32, 64):  # stride 8: 64 -> 8
+                self.backbone.add(g.Conv2D(ch, 3, padding=1,
+                                           activation="relu"))
+                self.backbone.add(g.MaxPool2D(2))
+        self.rpn_conv = g.Conv2D(64, 3, padding=1, activation="relu")
+        self.rpn_cls = g.Conv2D(2 * A, 1)
+        self.rpn_bbox = g.Conv2D(4 * A, 1)
+        self.fc = g.Dense(128, activation="relu")
+        self.cls_score = g.Dense(NC1)
+        self.bbox_pred = g.Dense(4 * NC1)
+        self.blocks = [self.backbone, self.rpn_conv, self.rpn_cls,
+                       self.rpn_bbox, self.fc, self.cls_score,
+                       self.bbox_pred]
+        for b in self.blocks:
+            b.initialize(init=mx.init.Xavier())
+
+    # -- parameter groups (for the alternating-training stages) ------------
+    def params(self, group="all"):
+        """'all' | 'rpn' (rpn heads only) | 'head' (roi head only) |
+        'backbone'."""
+        pick = {"all": self.blocks,
+                "backbone": [self.backbone],
+                "rpn": [self.rpn_conv, self.rpn_cls, self.rpn_bbox],
+                "rpn_full": [self.backbone, self.rpn_conv, self.rpn_cls,
+                             self.rpn_bbox],
+                "head": [self.fc, self.cls_score, self.bbox_pred]}[group]
+        out = {}
+        for b in pick:
+            out.update({p.name: p for p in b.collect_params().values()})
+        return out
+
+    def _param_slots(self):
+        """(slot_key, Parameter) pairs keyed by block index + creation
+        order — stable across RCNN instances, unlike gluon's
+        process-global auto-name counters."""
+        for bi, block in enumerate(self.blocks):
+            for j, p in enumerate(block.collect_params().values()):
+                yield f"b{bi}.{j}", p
+
+    def save_params(self, filename):
+        nd.save(filename, {slot: p.data()
+                           for slot, p in self._param_slots()})
+
+    def load_params(self, filename):
+        stored = nd.load(filename)
+        for slot, p in self._param_slots():
+            p.set_data(stored[slot])
+
+    # -- forward pieces -----------------------------------------------------
+    def rpn_forward(self, x):
+        """feat, anchor-ordered cls logits (B,N,2), bbox deltas (B,N,4),
+        and the Proposal-layout cls/bbox maps."""
+        B = x.shape[0]
+        feat = self.backbone(x)
+        r = self.rpn_conv(feat)
+        cls_map = self.rpn_cls(r)       # (B, 2A, h, w): c = j*A + i
+        bbox_map = self.rpn_bbox(r)     # (B, 4A, h, w): c = i*4 + k
+        logits = (cls_map.reshape((B, 2, A, FEAT, FEAT))
+                  .transpose(axes=(0, 3, 4, 2, 1))
+                  .reshape((B, N_ANCHOR, 2)))
+        deltas = (bbox_map.reshape((B, A, 4, FEAT, FEAT))
+                  .transpose(axes=(0, 3, 4, 1, 2))
+                  .reshape((B, N_ANCHOR, 4)))
+        return feat, logits, deltas, cls_map, bbox_map
+
+    def head_forward(self, feat, rois_nd):
+        pooled = nd.ROIPooling(feat, rois_nd, pooled_size=(4, 4),
+                               spatial_scale=1.0 / STRIDE)
+        h = self.fc(pooled.reshape((pooled.shape[0], -1)))
+        return self.cls_score(h), self.bbox_pred(h)
+
+
+def proposal_cls_prob(cls_map):
+    """(B,2A,h,w) rpn cls map -> same layout softmaxed over the bg/fg
+    pair (channel c = j*A + i is already the Proposal op's layout)."""
+    B = cls_map.shape[0]
+    return (nd.softmax(cls_map.reshape((B, 2, A, FEAT, FEAT)), axis=1)
+            .reshape((B, 2 * A, FEAT, FEAT)))
+
+
+def gen_proposals(cls_prob, bbox_map, i, im_info, post_nms=POST_NMS):
+    """Per-image RPN proposals as a host (post_nms, 4) array."""
+    rois = nd.Proposal(
+        cls_prob[i:i + 1], bbox_map[i:i + 1], im_info,
+        feature_stride=STRIDE, scales=SCALES, ratios=RATIOS,
+        rpn_pre_nms_top_n=N_ANCHOR, rpn_post_nms_top_n=post_nms,
+        threshold=0.7, rpn_min_size=8)
+    return rois.asnumpy()[:, 1:]
+
+
+def rpn_losses(logits, deltas, lab, tgt, wgt, batch):
+    """Anchor cls + smooth-l1 reg losses from assigned targets.
+
+    Targets may arrive as host numpy (train_step) or as the device
+    arrays an AnchorLoader batch already carries — no round trip."""
+    from mxnet_tpu.ndarray import NDArray
+    if not isinstance(lab, NDArray):
+        lab, tgt, wgt = nd.array(lab), nd.array(tgt), nd.array(wgt)
+    mask = lab >= 0
+    idx = nd.maximum(lab, 0)
+    logp = nd.log_softmax(logits, axis=-1)
+    cls_loss = -nd.sum(nd.pick(logp, idx) * mask) / (batch * RPN_BATCH)
+    bbox_loss = nd.sum(nd.smooth_l1(
+        (deltas - tgt) * wgt, scalar=3.0)) / (batch * RPN_BATCH)
+    return cls_loss, bbox_loss
+
+
+def head_losses(scores, preds, lab_nd, d_nd, w_nd, n_roi):
+    cls_loss = -nd.sum(
+        nd.pick(nd.log_softmax(scores, axis=-1), lab_nd)) / n_roi
+    bbox_loss = nd.sum(nd.smooth_l1(
+        (preds - d_nd) * w_nd, scalar=1.0)) / n_roi
+    return cls_loss, bbox_loss
+
+
+def sample_head_batch(props, gts, rng):
+    """Sample fixed-size roi batches for every image; returns device
+    arrays (rois with batch index column, labels, deltas, weights)."""
+    rois, labels, bdeltas, bweights = [], [], [], []
+    for i, p in enumerate(props):
+        r, l, d, w = sample_roi_targets(
+            p, gts[i], len(CLASSES), rois_per_image=ROIS_PER_IMG, rng=rng)
+        rois.append(np.concatenate(
+            [np.full((len(r), 1), i, np.float32), r], 1))
+        labels.append(l)
+        bdeltas.append(d)
+        bweights.append(w)
+    return (nd.array(np.concatenate(rois)),
+            nd.array(np.concatenate(labels)),
+            nd.array(np.concatenate(bdeltas)),
+            nd.array(np.concatenate(bweights)))
+
+
+def train_step(net, trainer, imgs, gts, anchors, im_info, rng):
+    """One approximate-joint step: RPN losses + proposal sampling +
+    head losses, single backward (reference train_end2end.py)."""
+    B = len(gts)
+    lab = np.zeros((B, N_ANCHOR), np.float32)
+    tgt = np.zeros((B, N_ANCHOR, 4), np.float32)
+    wgt = np.zeros((B, N_ANCHOR, 1), np.float32)
+    for i, g in enumerate(gts):
+        lab[i], tgt[i], wgt[i] = assign_anchor_targets(
+            anchors, g, IMG, rpn_batch=RPN_BATCH, rng=rng)
+    x = nd.array(imgs)
+
+    with mx.autograd.record():
+        feat, logits, deltas, cls_map, bbox_map = net.rpn_forward(x)
+        rpn_cls_loss, rpn_bbox_loss = rpn_losses(
+            logits, deltas, lab, tgt, wgt, B)
+
+        with mx.autograd.pause():
+            cls_prob = proposal_cls_prob(cls_map.detach())
+            bmap = bbox_map.detach()
+            props = [gen_proposals(cls_prob, bmap, i, im_info)
+                     for i in range(B)]
+        rois_nd, lab_nd, d_nd, w_nd = sample_head_batch(props, gts, rng)
+        scores, preds = net.head_forward(feat, rois_nd)
+        rcnn_cls_loss, rcnn_bbox_loss = head_losses(
+            scores, preds, lab_nd, d_nd, w_nd, B * ROIS_PER_IMG)
+        loss = (rpn_cls_loss + rpn_bbox_loss
+                + rcnn_cls_loss + rcnn_bbox_loss)
+    loss.backward()
+    trainer.step(B)
+    return tuple(float(v.asnumpy().ravel()[0]) for v in
+                 (rpn_cls_loss, rpn_bbox_loss, rcnn_cls_loss,
+                  rcnn_bbox_loss))
+
+
+def detect(net, img, im_info, score_thresh=0.05, nms_thresh=0.3):
+    """Full two-stage inference for one image; rows
+    [cls, score, x1,y1,x2,y2] (reference rcnn/core/tester.py)."""
+    x = nd.array(img[None])
+    feat, _, _, cls_map, bbox_map = net.rpn_forward(x)
+    cls_prob = proposal_cls_prob(cls_map)
+    rois = gen_proposals(cls_prob, bbox_map, 0, im_info)
+    rois_nd = nd.array(np.concatenate(
+        [np.zeros((len(rois), 1), np.float32), rois], 1))
+    scores, preds = net.head_forward(feat, rois_nd)
+    probs = nd.softmax(scores, axis=-1).asnumpy()
+    preds = preds.asnumpy()
+    dets = []
+    for c in range(1, NC1):
+        sc = probs[:, c]
+        keep = sc >= score_thresh
+        if not keep.any():
+            continue
+        boxes = decode_boxes(rois[keep],
+                             preds[keep, 4 * c:4 * c + 4] * BBOX_STDS, IMG)
+        kept = nms(boxes, sc[keep], nms_thresh)
+        dets.extend([c - 1, float(sc[keep][k])] + boxes[k].tolist()
+                    for k in kept)
+    return dets
+
+
+def default_im_info():
+    return nd.array(np.array([[IMG, IMG, 1.0]], np.float32))

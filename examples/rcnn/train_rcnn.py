@@ -4,19 +4,16 @@
 Reference analogue: example/rcnn/train_end2end.py + rcnn/ package (the
 reference's 7.3k-LoC flagship detection app: AnchorLoader, assign_anchor,
 Proposal CustomOp, proposal_target, ROIPooling head, MutableModule,
-pascal_voc eval). Same multi-stage pipeline at example scale:
+pascal_voc eval). Same multi-stage pipeline, split over this package:
 
-  dataset    — synthetic multi-object scenes, gt in pixel coords;
-  RPN        — 3x3 conv + per-anchor cls/reg heads trained against
-               host-assigned anchor targets (assign_anchor_targets);
-  Proposal   — the repo's Proposal op (decode + NMS) under
-               autograd.pause(), approximate-joint style;
-  sampling   — sample_roi_targets: fg/bg roi sampling with gt append
-               and per-class std-normalized bbox targets;
-  head       — ROIPooling -> FC -> (C+1)-way cls + per-class bbox reg,
-               gradient flowing through ROIPooling into the backbone;
-  inference  — per-class decode + NMS;
-  eval       — VOC 11-point mAP@0.5, asserted as the convergence gate.
+  dataset.py     — imdb abstraction, VOC-XML reader, synthetic scenes;
+  loader.py      — AnchorLoader DataIter (host anchor targets);
+  model.py       — backbone/RPN/head blocks + joint train_step/detect;
+  rcnn_common.py — target assignment + box math (host numpy);
+  eval.py        — per-class AP table, proposal recall;
+  this script    — the approximate-joint driver + mAP gate;
+  train_alternate.py — the 4-stage alternating schedule;
+  demo.py        — checkpoint load + ASCII visualisation.
 
 The split between host and device is deliberate TPU design, not a
 shortcut: ragged target assignment runs in numpy producing fixed-shape
@@ -34,231 +31,13 @@ import time
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import nd
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from rcnn_common import (BBOX_STDS, assign_anchor_targets, decode_boxes,  # noqa: E402
-                         make_anchor_grid, nms, sample_roi_targets, voc_map)
-
-IMG = 64
-STRIDE = 8
-FEAT = IMG // STRIDE
-SCALES = (2.0, 3.0, 4.0)
-RATIOS = (0.5, 1.0, 2.0)
-A = len(SCALES) * len(RATIOS)
-N_ANCHOR = FEAT * FEAT * A
-CLASSES = ("box", "ring", "cross")
-NC1 = len(CLASSES) + 1
-ROIS_PER_IMG = 16
-POST_NMS = 12
-RPN_BATCH = 64
-
-
-# ---------------------------------------------------------------------------
-# dataset (reference: rcnn/dataset/pascal_voc.py + io/rpn.py loader)
-# ---------------------------------------------------------------------------
-
-def make_scene(rng):
-    """One scene: image (3,IMG,IMG), gt rows [cls, x1,y1,x2,y2] pixels."""
-    img = rng.rand(3, IMG, IMG).astype(np.float32) * 0.15
-    gts = []
-    taken = []
-    for _ in range(rng.randint(1, 4)):
-        for _ in range(8):
-            w = rng.randint(16, 33)
-            x0 = rng.randint(0, IMG - w)
-            y0 = rng.randint(0, IMG - w)
-            if all(abs(x0 - tx) + abs(y0 - ty) > (w + tw) // 2
-                   for tx, ty, tw in taken):
-                break
-        else:
-            continue
-        taken.append((x0, y0, w))
-        cls = rng.randint(0, len(CLASSES))
-        x1, y1 = x0 + w, y0 + w
-        if cls == 0:
-            img[0, y0:y1, x0:x1] += 0.9
-        elif cls == 1:
-            img[1, y0:y1, x0:x1] += 0.9
-            m = max(2, w // 4)
-            img[1, y0 + m:y1 - m, x0 + m:x1 - m] -= 0.9
-        else:
-            t = max(2, w // 4)
-            c = w // 2
-            img[2, y0 + c - t // 2:y0 + c + (t + 1) // 2, x0:x1] += 0.9
-            img[2, y0:y1, x0 + c - t // 2:x0 + c + (t + 1) // 2] += 0.9
-        gts.append([cls, x0, y0, x1 - 1, y1 - 1])
-    np.clip(img, 0.0, 1.0, out=img)
-    return img, np.asarray(gts, np.float32).reshape(-1, 5)
-
-
-# ---------------------------------------------------------------------------
-# model (reference: rcnn/symbol/symbol_vgg.py get_vgg_train, shrunk)
-# ---------------------------------------------------------------------------
-
-class RCNN:
-    def __init__(self):
-        g = mx.gluon.nn
-        self.backbone = g.HybridSequential()
-        with self.backbone.name_scope():
-            for ch in (16, 32, 64):  # stride 8: 64 -> 8
-                self.backbone.add(g.Conv2D(ch, 3, padding=1,
-                                           activation="relu"))
-                self.backbone.add(g.MaxPool2D(2))
-        self.rpn_conv = g.Conv2D(64, 3, padding=1, activation="relu")
-        self.rpn_cls = g.Conv2D(2 * A, 1)
-        self.rpn_bbox = g.Conv2D(4 * A, 1)
-        self.fc = g.Dense(128, activation="relu")
-        self.cls_score = g.Dense(NC1)
-        self.bbox_pred = g.Dense(4 * NC1)
-        self.blocks = [self.backbone, self.rpn_conv, self.rpn_cls,
-                       self.rpn_bbox, self.fc, self.cls_score,
-                       self.bbox_pred]
-        for b in self.blocks:
-            b.initialize(init=mx.init.Xavier())
-
-    def params(self):
-        out = {}
-        for b in self.blocks:
-            out.update({p.name: p for p in b.collect_params().values()})
-        return out
-
-    def rpn_forward(self, x):
-        """feat, anchor-ordered cls logits (B,N,2), bbox deltas (B,N,4),
-        and the Proposal-layout cls/bbox maps."""
-        B = x.shape[0]
-        feat = self.backbone(x)
-        r = self.rpn_conv(feat)
-        cls_map = self.rpn_cls(r)       # (B, 2A, h, w): c = j*A + i
-        bbox_map = self.rpn_bbox(r)     # (B, 4A, h, w): c = i*4 + k
-        logits = (cls_map.reshape((B, 2, A, FEAT, FEAT))
-                  .transpose(axes=(0, 3, 4, 2, 1))
-                  .reshape((B, N_ANCHOR, 2)))
-        deltas = (bbox_map.reshape((B, A, 4, FEAT, FEAT))
-                  .transpose(axes=(0, 3, 4, 1, 2))
-                  .reshape((B, N_ANCHOR, 4)))
-        return feat, logits, deltas, cls_map, bbox_map
-
-    def head_forward(self, feat, rois_nd):
-        pooled = nd.ROIPooling(feat, rois_nd, pooled_size=(4, 4),
-                               spatial_scale=1.0 / STRIDE)
-        h = self.fc(pooled.reshape((pooled.shape[0], -1)))
-        return self.cls_score(h), self.bbox_pred(h)
-
-
-def proposal_cls_prob(cls_map):
-    """(B,2A,h,w) rpn cls map -> same layout softmaxed over the bg/fg
-    pair (channel c = j*A + i is already the Proposal op's layout)."""
-    B = cls_map.shape[0]
-    return (nd.softmax(cls_map.reshape((B, 2, A, FEAT, FEAT)), axis=1)
-            .reshape((B, 2 * A, FEAT, FEAT)))
-
-
-def gen_proposals(cls_prob, bbox_map, i, im_info, post_nms=POST_NMS):
-    """Per-image RPN proposals as a host (post_nms, 4) array."""
-    rois = nd.Proposal(
-        cls_prob[i:i + 1], bbox_map[i:i + 1], im_info,
-        feature_stride=STRIDE, scales=SCALES, ratios=RATIOS,
-        rpn_pre_nms_top_n=N_ANCHOR, rpn_post_nms_top_n=post_nms,
-        threshold=0.7, rpn_min_size=8)
-    return rois.asnumpy()[:, 1:]
-
-
-# ---------------------------------------------------------------------------
-# training (reference: train_end2end.py approximate-joint schedule)
-# ---------------------------------------------------------------------------
-
-def train_step(net, trainer, imgs, gts, anchors, im_info, rng):
-    B = len(gts)
-    lab = np.zeros((B, N_ANCHOR), np.float32)
-    tgt = np.zeros((B, N_ANCHOR, 4), np.float32)
-    wgt = np.zeros((B, N_ANCHOR, 1), np.float32)
-    for i, g in enumerate(gts):
-        lab[i], tgt[i], wgt[i] = assign_anchor_targets(
-            anchors, g, IMG, rpn_batch=RPN_BATCH, rng=rng)
-    mask = nd.array((lab >= 0).astype(np.float32))
-    idx = nd.array(np.maximum(lab, 0))
-    tgt_nd, wgt_nd = nd.array(tgt), nd.array(wgt)
-    x = nd.array(imgs)
-
-    with mx.autograd.record():
-        feat, logits, deltas, cls_map, bbox_map = net.rpn_forward(x)
-        logp = nd.log_softmax(logits, axis=-1)
-        rpn_cls_loss = -nd.sum(nd.pick(logp, idx) * mask) / (B * RPN_BATCH)
-        rpn_bbox_loss = nd.sum(nd.smooth_l1(
-            (deltas - tgt_nd) * wgt_nd, scalar=3.0)) / (B * RPN_BATCH)
-
-        with mx.autograd.pause():
-            cls_prob = proposal_cls_prob(cls_map.detach())
-            bmap = bbox_map.detach()
-            props = [gen_proposals(cls_prob, bmap, i, im_info)
-                     for i in range(B)]
-        rois, labels, bdeltas, bweights = [], [], [], []
-        for i in range(B):
-            r, l, d, w = sample_roi_targets(
-                props[i], gts[i], len(CLASSES),
-                rois_per_image=ROIS_PER_IMG, rng=rng)
-            rois.append(np.concatenate(
-                [np.full((len(r), 1), i, np.float32), r], 1))
-            labels.append(l)
-            bdeltas.append(d)
-            bweights.append(w)
-        rois_nd = nd.array(np.concatenate(rois))
-        lab_nd = nd.array(np.concatenate(labels))
-        d_nd = nd.array(np.concatenate(bdeltas))
-        w_nd = nd.array(np.concatenate(bweights))
-        n_roi = B * ROIS_PER_IMG
-
-        scores, preds = net.head_forward(feat, rois_nd)
-        rcnn_cls_loss = -nd.sum(
-            nd.pick(nd.log_softmax(scores, axis=-1), lab_nd)) / n_roi
-        rcnn_bbox_loss = nd.sum(nd.smooth_l1(
-            (preds - d_nd) * w_nd, scalar=1.0)) / n_roi
-        loss = (rpn_cls_loss + rpn_bbox_loss
-                + rcnn_cls_loss + rcnn_bbox_loss)
-    loss.backward()
-    trainer.step(B)
-    return tuple(float(v.asnumpy().ravel()[0]) for v in
-                 (rpn_cls_loss, rpn_bbox_loss, rcnn_cls_loss,
-                  rcnn_bbox_loss))
-
-
-# ---------------------------------------------------------------------------
-# inference + eval (reference: rcnn/core/tester.py pred_eval)
-# ---------------------------------------------------------------------------
-
-def detect(net, img, im_info, score_thresh=0.05, nms_thresh=0.3):
-    x = nd.array(img[None])
-    feat, _, _, cls_map, bbox_map = net.rpn_forward(x)
-    cls_prob = proposal_cls_prob(cls_map)
-    rois = gen_proposals(cls_prob, bbox_map, 0, im_info)
-    rois_nd = nd.array(np.concatenate(
-        [np.zeros((len(rois), 1), np.float32), rois], 1))
-    scores, preds = net.head_forward(feat, rois_nd)
-    probs = nd.softmax(scores, axis=-1).asnumpy()
-    preds = preds.asnumpy()
-    dets = []
-    for c in range(1, NC1):
-        sc = probs[:, c]
-        keep = sc >= score_thresh
-        if not keep.any():
-            continue
-        boxes = decode_boxes(rois[keep],
-                             preds[keep, 4 * c:4 * c + 4] * BBOX_STDS, IMG)
-        kept = nms(boxes, sc[keep], nms_thresh)
-        dets.extend([c - 1, float(sc[keep][k])] + boxes[k].tolist()
-                    for k in kept)
-    return dets
-
-
-def evaluate(net, n_scenes, im_info, seed):
-    rng = np.random.RandomState(seed)
-    all_dets, all_gts = [], []
-    for _ in range(n_scenes):
-        img, gt = make_scene(rng)
-        all_dets.append(detect(net, img, im_info))
-        all_gts.append(gt.tolist())
-    return voc_map(all_dets, all_gts, len(CLASSES))
+from dataset import SyntheticShapes  # noqa: E402
+from eval import evaluate_detections  # noqa: E402
+from model import (CLASSES, FEAT, IMG, RATIOS, SCALES, STRIDE, RCNN,  # noqa: E402
+                   default_im_info, detect, train_step)
+from rcnn_common import make_anchor_grid  # noqa: E402
 
 
 def main():
@@ -276,28 +55,33 @@ def main():
     trainer = mx.gluon.Trainer(net.params(), "sgd",
                                {"learning_rate": args.lr, "momentum": 0.9})
     anchors = make_anchor_grid(FEAT, FEAT, STRIDE, SCALES, RATIOS)
-    im_info = nd.array(np.array([[IMG, IMG, 1.0]], np.float32))
+    im_info = default_im_info()
 
     for epoch in range(args.epochs):
         if epoch == args.epochs * 2 // 3:
             trainer.set_learning_rate(args.lr / 5)
         rng = np.random.RandomState(100 + epoch)
+        db = SyntheticShapes(
+            args.batches_per_epoch * args.batch_size, im_size=IMG,
+            seed=100 + epoch)
         tic = time.time()
         sums = np.zeros(4)
-        for _ in range(args.batches_per_epoch):
-            scenes = [make_scene(rng) for _ in range(args.batch_size)]
-            imgs = np.stack([s[0] for s in scenes])
-            gts = [s[1] for s in scenes]
+        n_batches = 0
+        for imgs, gts in db.batches(args.batch_size, rng):
             sums += train_step(net, trainer, imgs, gts, anchors, im_info,
                                rng)
-        sums /= args.batches_per_epoch
-        speed = (args.batches_per_epoch * args.batch_size
-                 / (time.time() - tic))
+            n_batches += 1
+        sums /= n_batches
+        speed = n_batches * args.batch_size / (time.time() - tic)
         print(f"epoch {epoch} rpn-cls {sums[0]:.3f} rpn-box {sums[1]:.3f} "
               f"rcnn-cls {sums[2]:.3f} rcnn-box {sums[3]:.3f} "
               f"({speed:.1f} img/s)")
 
-    m = evaluate(net, args.eval_scenes, im_info, seed=999)
+    val = SyntheticShapes(args.eval_scenes, im_size=IMG, seed=999)
+    samples = [val.sample(i) for i in range(len(val))]
+    all_dets = [detect(net, img, im_info) for img, _ in samples]
+    all_gts = [gt.tolist() for _, gt in samples]
+    m = evaluate_detections(all_dets, all_gts, CLASSES)
     print(f"mAP@0.5 = {m:.3f} over {args.eval_scenes} held-out scenes")
     assert m >= args.map_gate, f"mAP {m:.3f} below gate {args.map_gate}"
 

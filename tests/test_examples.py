@@ -56,6 +56,8 @@ _CASES = [
     ("notebooks/module_checkpointing.py", []),
     ("ssd/train_ssd.py", ["--map-gate", "0.45"]),
     ("rcnn/train_rcnn.py", ["--map-gate", "0.45"]),
+    ("rcnn/train_alternate.py", ["--map-gate", "0.4"]),
+    ("rcnn/demo.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
      ["--seq-len", "512", "--heads", "8", "--head-dim", "16"]),

@@ -1,0 +1,137 @@
+"""rcnn example package: dataset / loader / eval units.
+
+Reference analogue: the reference ships rcnn/ as an importable package
+(dataset/imdb.py, core/loader.py, dataset/pascal_voc_eval.py); these
+tests pin the same contracts on our examples/rcnn modules without
+running full training (the training gates live in test_examples.py).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "rcnn"))
+
+from dataset import ImageDB, PascalVOC, SyntheticShapes  # noqa: E402
+from eval import class_ap, evaluate_detections, proposal_recall  # noqa: E402
+
+
+def test_synthetic_db_reproducible():
+    db = SyntheticShapes(8, seed=4)
+    img1, gt1 = db.sample(3)
+    img2, gt2 = db.sample(3)
+    np.testing.assert_array_equal(img1, img2)
+    np.testing.assert_array_equal(gt1, gt2)
+    assert img1.shape == (3, 64, 64) and gt1.shape[1] == 5
+    assert 0.0 <= img1.min() and img1.max() <= 1.0
+
+
+def test_flipped_db_mirrors_boxes():
+    db = SyntheticShapes(4, seed=9)
+    aug = db.append_flipped()
+    assert len(aug) == 2 * len(db)
+    img, gt = db.sample(1)
+    fimg, fgt = aug.sample(1 + len(db))
+    np.testing.assert_array_equal(fimg, img[..., ::-1])
+    if len(gt):
+        w = img.shape[-1]
+        np.testing.assert_allclose(fgt[:, 1], w - 1 - gt[:, 3])
+        np.testing.assert_allclose(fgt[:, 3], w - 1 - gt[:, 1])
+        np.testing.assert_array_equal(fgt[:, 0], gt[:, 0])
+        np.testing.assert_array_equal(fgt[:, [2, 4]], gt[:, [2, 4]])
+
+
+def _write_voc_fixture(root):
+    """Minimal VOCdevkit: 2 images, XML annotations, trainval listing."""
+    from mxnet_tpu import image as mx_image
+    voc = os.path.join(root, "VOC2007")
+    for sub in ("JPEGImages", "Annotations",
+                os.path.join("ImageSets", "Main")):
+        os.makedirs(os.path.join(voc, sub), exist_ok=True)
+    rng = np.random.RandomState(0)
+    names = ["000001", "000007"]
+    boxes = {"000001": [("dog", 10, 12, 40, 44), ("person", 2, 2, 20, 30)],
+             "000007": [("car", 5, 8, 50, 58)]}
+    for stem in names:
+        arr = (rng.rand(64, 64, 3) * 255).astype(np.uint8)
+        mx_image.imwrite(os.path.join(voc, "JPEGImages", f"{stem}.jpg"),
+                         arr)
+        objs = "".join(
+            f"<object><name>{n}</name><difficult>0</difficult><bndbox>"
+            f"<xmin>{x1 + 1}</xmin><ymin>{y1 + 1}</ymin>"
+            f"<xmax>{x2 + 1}</xmax><ymax>{y2 + 1}</ymax>"
+            "</bndbox></object>"
+            for n, x1, y1, x2, y2 in boxes[stem])
+        with open(os.path.join(voc, "Annotations", f"{stem}.xml"),
+                  "w") as f:
+            f.write(f"<annotation><filename>{stem}.jpg</filename>"
+                    f"<size><width>64</width><height>64</height>"
+                    f"<depth>3</depth></size>{objs}</annotation>")
+    with open(os.path.join(voc, "ImageSets", "Main", "trainval.txt"),
+              "w") as f:
+        f.write("\n".join(names) + "\n")
+    return root
+
+
+def test_pascal_voc_reader(tmp_path):
+    root = _write_voc_fixture(str(tmp_path))
+    db = PascalVOC(root, image_set="trainval", year="2007")
+    assert len(db) == 2
+    img, gt = db.sample(0)
+    assert img.shape[0] == 3 and img.dtype == np.float32
+    assert img.max() <= 1.0
+    # dog + person, 1-based xml corners converted to 0-based
+    assert {int(r[0]) for r in gt} == \
+        {db.classes.index("dog"), db.classes.index("person")}
+    dog = gt[[int(r[0]) == db.classes.index("dog") for r in gt]][0]
+    np.testing.assert_allclose(dog[1:5], [10, 12, 40, 44])
+    # roidb materialises annotations without decoding images
+    roidb = db.roidb()
+    assert len(roidb) == 2 and roidb[1]["gt"].shape == (1, 5)
+
+
+def test_anchor_loader_contract():
+    from loader import AnchorLoader
+    db = SyntheticShapes(8, seed=2)
+    it = AnchorLoader(db, batch_size=4, im_size=64, stride=8,
+                      scales=(2.0, 3.0, 4.0), ratios=(0.5, 1.0, 2.0),
+                      rpn_batch=32, max_gt=6, seed=3)
+    batches = list(it)
+    assert len(batches) == 2
+    b = batches[0]
+    shapes = [d.shape for d in b.data]
+    n_anchor = (64 // 8) ** 2 * 9
+    assert shapes == [(4, 3, 64, 64), (4, 3), (4, 6, 5)]
+    assert [l.shape for l in b.label] == \
+        [(4, n_anchor), (4, n_anchor, 4), (4, n_anchor, 1)]
+    lab = b.label[0].asnumpy()
+    # labels in {-1, 0, 1}; the sampled rpn batch is bounded
+    assert set(np.unique(lab)) <= {-1.0, 0.0, 1.0}
+    assert ((lab >= 0).sum(axis=1) <= 32).all()
+    # fg anchors carry weighted targets
+    wgt = b.label[2].asnumpy()
+    assert (wgt[lab == 1] == 1.0).all()
+    # padded gt unpads to ragged rows
+    ragged = AnchorLoader.unpad_gt(b.data[2].asnumpy())
+    assert all(r.shape[1] == 5 and (r[:, 0] >= 0).all() for r in ragged)
+    # epoch 2 after reset
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_eval_per_class_and_recall():
+    # one image, two classes; class 0 detected correctly, class 1 missed
+    gts = [[[0, 10, 10, 20, 20], [1, 40, 40, 50, 50]]]
+    dets = [[[0, 0.9, 10, 10, 20, 20], [0, 0.3, 0, 0, 5, 5]]]
+    ap0, n_gt0, n_det0 = class_ap(dets, gts, 0)
+    ap1, _, _ = class_ap(dets, gts, 1)
+    assert ap0 == pytest.approx(1.0) and n_gt0 == 1 and n_det0 == 2
+    assert ap1 == 0.0
+    lines = []
+    m = evaluate_detections(dets, gts, ("a", "b"), log=lines.append)
+    assert m == pytest.approx(0.5)
+    assert any("mAP" in ln for ln in lines)
+    rec = proposal_recall([[[10, 10, 20, 20]]], gts)
+    assert rec == pytest.approx(0.5)
