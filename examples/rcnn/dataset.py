@@ -103,8 +103,18 @@ class PascalVOC(ImageDB):
         return len(self._ids)
 
     def _annotation(self, stem):
+        """gt rows for one image, scaled to the short_side resize if one
+        is configured — annotations and sampled images always agree.
+        Image dims come from the XML <size> element, so roidb() never
+        decodes pixels."""
         tree = ET.parse(os.path.join(self._voc, "Annotations",
                                      f"{stem}.xml"))
+        scale = 1.0
+        if self._short is not None:
+            size = tree.find("size")
+            h = float(size.findtext("height"))
+            w = float(size.findtext("width"))
+            scale = self._short / min(h, w)
         rows = []
         for obj in tree.findall("object"):
             if not self._difficult and \
@@ -115,7 +125,7 @@ class PascalVOC(ImageDB):
                 continue
             box = obj.find("bndbox")
             # VOC stores 1-based corners
-            coords = [float(box.findtext(k)) - 1.0
+            coords = [(float(box.findtext(k)) - 1.0) * scale
                       for k in ("xmin", "ymin", "xmax", "ymax")]
             rows.append([float(self.classes.index(name))] + coords)
         return np.asarray(rows, np.float32).reshape(-1, 5)
@@ -126,14 +136,12 @@ class PascalVOC(ImageDB):
         raw = mx_image.imread(
             os.path.join(self._voc, "JPEGImages", f"{stem}.jpg"))
         img = raw.asnumpy().astype(np.float32) / 255.0     # HWC
-        gt = self._annotation(stem)
+        gt = self._annotation(stem)   # already short_side-scaled
         if self._short is not None:
             h, w = img.shape[:2]
             scale = self._short / min(h, w)
             img = _resize_hwc(img, int(round(h * scale)),
                               int(round(w * scale)))
-            if len(gt):
-                gt[:, 1:5] *= scale
         return img.transpose(2, 0, 1), gt
 
     def roidb(self):

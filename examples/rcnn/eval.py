@@ -2,44 +2,13 @@
 
 Reference analogue: example/rcnn/rcnn/dataset/pascal_voc_eval.py (voc_eval
 per class, 11-point metric) and the recall printout of rcnn/core/tester.py.
-``voc_map`` in rcnn_common stays the single-number gate; this module
-produces the per-class table the reference's evaluate_detections prints.
+The matching/AP implementation lives in rcnn_common.class_ap (shared with
+voc_map); this module renders the per-class table the reference's
+evaluate_detections prints and computes proposal recall.
 """
 import numpy as np
 
-from rcnn_common import iou_matrix
-
-
-def class_ap(all_dets, all_gts, cls, iou_thresh=0.5):
-    """11-point AP for one class id; returns (ap, n_gt, n_det)."""
-    records, n_gt = [], 0
-    for dets, gts in zip(all_dets, all_gts):
-        gt_c = np.asarray([g[1:5] for g in gts if int(g[0]) == cls],
-                          np.float32)
-        n_gt += len(gt_c)
-        used = np.zeros(len(gt_c), bool)
-        for d in sorted((d for d in dets if int(d[0]) == cls),
-                        key=lambda r: -r[1]):
-            if len(gt_c) == 0:
-                records.append((d[1], False))
-                continue
-            iou = iou_matrix(np.asarray(d[2:6], np.float32)[None], gt_c)[0]
-            bi = int(iou.argmax())
-            hit = iou[bi] >= iou_thresh and not used[bi]
-            used[bi] |= hit
-            records.append((d[1], hit))
-    if n_gt == 0:
-        return float("nan"), 0, len(records)
-    if not records:
-        return 0.0, n_gt, 0
-    records.sort(key=lambda r: -r[0])
-    tp = np.cumsum([r[1] for r in records])
-    recall = tp / n_gt
-    precision = tp / np.arange(1, len(tp) + 1)
-    ap = float(np.mean([
-        precision[recall >= t].max() if (recall >= t).any() else 0.0
-        for t in np.linspace(0, 1, 11)]))
-    return ap, n_gt, len(records)
+from rcnn_common import class_ap, iou_matrix
 
 
 def evaluate_detections(all_dets, all_gts, class_names, iou_thresh=0.5,

@@ -11,6 +11,8 @@ Ragged ground truth is padded to ``max_gt`` rows with cls = -1 sentinel
 rows (static shapes keep every traced program cacheable); consumers
 filter rows with gt[:, 0] >= 0.
 """
+import warnings
+
 import numpy as np
 
 from mxnet_tpu import nd
@@ -57,7 +59,7 @@ class AnchorLoader(DataIter):
 
     def _pad_gt(self, gt):
         out = np.full((self._max_gt, 5), -1.0, np.float32)
-        out[:min(len(gt), self._max_gt)] = gt[:self._max_gt]
+        out[:len(gt)] = gt
         return out
 
     def next(self):
@@ -69,16 +71,27 @@ class AnchorLoader(DataIter):
         self._cursor += b
 
         imgs = np.stack([p[0] for p in picked])
+        # keep the anchor targets and the gt_boxes stream consistent:
+        # both see the SAME (possibly truncated) gt set
+        gts = []
+        for _, gt in picked:
+            if len(gt) > self._max_gt:
+                warnings.warn(
+                    f"AnchorLoader: image has {len(gt)} gt boxes, "
+                    f"keeping the {self._max_gt} largest (max_gt)")
+                area = ((gt[:, 3] - gt[:, 1]) * (gt[:, 4] - gt[:, 2]))
+                gt = gt[np.argsort(-area)[:self._max_gt]]
+            gts.append(gt)
         lab = np.zeros((b, self._n_anchor), np.float32)
         tgt = np.zeros((b, self._n_anchor, 4), np.float32)
         wgt = np.zeros((b, self._n_anchor, 1), np.float32)
-        for i, (_, gt) in enumerate(picked):
+        for i, gt in enumerate(gts):
             lab[i], tgt[i], wgt[i] = assign_anchor_targets(
                 self._anchors, gt, self._im, rpn_batch=self._rpn_batch,
                 rng=self._rng)
         im_info = np.tile(
             np.array([self._im, self._im, 1.0], np.float32), (b, 1))
-        gt_pad = np.stack([self._pad_gt(p[1]) for p in picked])
+        gt_pad = np.stack([self._pad_gt(g) for g in gts])
         return DataBatch(
             data=[nd.array(imgs), nd.array(im_info), nd.array(gt_pad)],
             label=[nd.array(lab), nd.array(tgt), nd.array(wgt)],

@@ -183,39 +183,46 @@ def nms(boxes, scores, thresh):
     return np.asarray(keep, int)
 
 
+def class_ap(all_dets, all_gts, cls, iou_thresh=0.5):
+    """11-point AP for one class id; returns (ap, n_gt, n_det).
+    all_dets[i] rows [cls, score, x1,y1,x2,y2]; all_gts[i] rows
+    [cls, x1,y1,x2,y2] (pixel coords). ap is NaN when the class has no
+    ground truth (reference pascal_voc_eval.py:voc_eval)."""
+    records, n_gt = [], 0
+    for dets, gts in zip(all_dets, all_gts):
+        gt_c = np.asarray([g[1:5] for g in gts if int(g[0]) == cls],
+                          np.float32)
+        n_gt += len(gt_c)
+        used = np.zeros(len(gt_c), bool)
+        for d in sorted((d for d in dets if int(d[0]) == cls),
+                        key=lambda r: -r[1]):
+            if len(gt_c) == 0:
+                records.append((d[1], False))
+                continue
+            iou = iou_matrix(np.asarray(d[2:6], np.float32)[None],
+                             gt_c)[0]
+            bi = int(iou.argmax())
+            tp = iou[bi] >= iou_thresh and not used[bi]
+            used[bi] |= tp
+            records.append((d[1], tp))
+    if n_gt == 0:
+        return float("nan"), 0, len(records)
+    if not records:
+        return 0.0, n_gt, 0
+    records.sort(key=lambda r: -r[0])
+    tp = np.cumsum([r[1] for r in records])
+    recall = tp / n_gt
+    precision = tp / np.arange(1, len(tp) + 1)
+    ap = float(np.mean([
+        precision[recall >= t].max() if (recall >= t).any() else 0.0
+        for t in np.linspace(0, 1, 11)]))
+    return ap, n_gt, len(records)
+
+
 def voc_map(all_dets, all_gts, num_classes, iou_thresh=0.5):
-    """VOC 11-point mAP. all_dets[i] rows [cls, score, x1,y1,x2,y2];
-    all_gts[i] rows [cls, x1,y1,x2,y2] (pixel coords)."""
-    aps = []
-    for c in range(num_classes):
-        records, n_gt = [], 0
-        for dets, gts in zip(all_dets, all_gts):
-            gt_c = np.asarray([g[1:5] for g in gts if int(g[0]) == c],
-                              np.float32)
-            n_gt += len(gt_c)
-            used = np.zeros(len(gt_c), bool)
-            det_c = sorted((d for d in dets if int(d[0]) == c),
-                           key=lambda r: -r[1])
-            for d in det_c:
-                if len(gt_c) == 0:
-                    records.append((d[1], False))
-                    continue
-                iou = iou_matrix(np.asarray(d[2:6], np.float32)[None],
-                                 gt_c)[0]
-                bi = int(iou.argmax())
-                tp = iou[bi] >= iou_thresh and not used[bi]
-                used[bi] |= tp
-                records.append((d[1], tp))
-        if n_gt == 0:
-            continue
-        records.sort(key=lambda r: -r[0])
-        if not records:
-            aps.append(0.0)
-            continue
-        tp = np.cumsum([r[1] for r in records])
-        recall = tp / n_gt
-        precision = tp / np.arange(1, len(tp) + 1)
-        aps.append(float(np.mean([
-            precision[recall >= t].max() if (recall >= t).any() else 0.0
-            for t in np.linspace(0, 1, 11)])))
+    """VOC 11-point mAP: mean of per-class APs over classes that have
+    ground truth (one matching implementation: class_ap)."""
+    aps = [ap for ap in (class_ap(all_dets, all_gts, c, iou_thresh)[0]
+                         for c in range(num_classes))
+           if not np.isnan(ap)]
     return float(np.mean(aps)) if aps else 0.0

@@ -1,0 +1,85 @@
+"""Acoustic-model architectures, selected by config.
+
+Reference analogue: example/speech_recognition/arch_deepspeech.py
+composing stt_layer_conv / stt_layer_gru / stt_layer_lstm /
+stt_layer_fc into a config-chosen stack (conv front-end, N recurrent
+layers, optional bidirectional). Per-bucket symbols share parameters
+through the cells' RNNParams, exactly as BucketingModule requires.
+"""
+import mxnet_tpu as mx
+
+from data import N_BINS, N_CLASSES
+
+
+def _conv_front(data, t, channels):
+    """Stride-1 temporal conv front-end: (N, T, BINS) -> (N, T, channels)
+    (reference stt_layer_conv.py; stride kept 1 so every bucket's T is
+    preserved and the CTC frame count matches the label math)."""
+    x = mx.sym.Reshape(data, shape=(0, 1, t, N_BINS))      # N,1,T,BINS
+    x = mx.sym.Convolution(x, kernel=(3, N_BINS), pad=(1, 0),
+                           num_filter=channels, name="conv_front")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.Reshape(x, shape=(0, channels, t))          # N,C,T
+    return mx.sym.transpose(x, axes=(0, 2, 1))             # N,T,C
+
+
+def _make_cell(kind, hidden, prefix):
+    if kind == "lstm":
+        return mx.rnn.LSTMCell(num_hidden=hidden, prefix=prefix)
+    if kind == "gru":
+        return mx.rnn.GRUCell(num_hidden=hidden, prefix=prefix)
+    return mx.rnn.RNNCell(num_hidden=hidden, prefix=prefix)
+
+
+def build_stack(cfg):
+    """Recurrent stack from an [arch] config section dict."""
+    kind = cfg.get("cell", "gru")
+    hidden = int(cfg.get("hidden", 64))
+    layers = int(cfg.get("num_rnn_layer", 1))
+    bidirectional = cfg.get("is_bi_rnn", "false").lower() == "true"
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(layers):
+        if bidirectional:
+            stack.add(mx.rnn.BidirectionalCell(
+                _make_cell(kind, hidden, f"am_l{i}_fw_"),
+                _make_cell(kind, hidden, f"am_l{i}_bw_"),
+                output_prefix=f"am_bi{i}_"))
+        else:
+            stack.add(_make_cell(kind, hidden, f"am_l{i}_"))
+    width = hidden * (2 if bidirectional else 1)
+    return stack, width
+
+
+def make_sym_gen(cfg):
+    """Bucket-keyed symbol generator for BucketingModule.
+
+    cfg keys ([arch]): cell gru|lstm|rnn, hidden, num_rnn_layer,
+    is_bi_rnn, conv_channels (0 disables the conv front-end),
+    skip_concat (concat raw features onto the rnn output).
+    """
+    stack, width = build_stack(cfg)
+    conv_ch = int(cfg.get("conv_channels", 0))
+    skip = cfg.get("skip_concat", "true").lower() == "true"
+
+    def sym_gen(bucket_key):
+        t = bucket_key
+        data = mx.sym.var("data")            # (N, T, bins)
+        label = mx.sym.var("label")          # (N, L_MAX)
+        feats_in = _conv_front(data, t, conv_ch) if conv_ch else data
+        stack.reset()
+        out, _ = stack.unroll(t, inputs=feats_in, layout="NTC",
+                              merge_outputs=True)
+        feats = mx.sym.Concat(out, data, dim=2) if skip else out
+        fan_in = width + (N_BINS if skip else 0)
+        pred = mx.sym.Reshape(feats, shape=(-1, fan_in))
+        pred = mx.sym.FullyConnected(pred, num_hidden=N_CLASSES,
+                                     name="cls")
+        tnc = mx.sym.Reshape(pred, shape=(-4, -1, t, N_CLASSES))
+        tnc = mx.sym.transpose(tnc, axes=(1, 0, 2))  # (T, N, C)
+        loss = mx.sym.MakeLoss(mx.sym.CTCLoss(tnc, label),
+                               name="ctc_loss")
+        probs = mx.sym.BlockGrad(mx.sym.softmax(tnc, axis=-1),
+                                 name="probs")
+        return mx.sym.Group([loss, probs]), ("data",), ("label",)
+
+    return sym_gen

@@ -1,0 +1,32 @@
+"""Config plumbing: .cfg files + command-line overrides.
+
+Reference analogue: example/speech_recognition/config_util.py
+(parse_args loads a ConfigParser file, every --section_key flag
+overrides the file value). Here overrides are ``section.key=value``
+tokens so the driver's own argparse surface stays small.
+"""
+import configparser
+import os
+
+
+def load_config(path, overrides=()):
+    """Parse ``path`` and apply ``section.key=value`` overrides; returns
+    {section: {key: value}} with plain string values."""
+    parser = configparser.ConfigParser()
+    if path:
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"config file not found: {path}")
+        parser.read(path)
+    cfg = {s: dict(parser.items(s)) for s in parser.sections()}
+    for token in overrides:
+        target, eq, value = token.partition("=")
+        section, dot, key = target.partition(".")
+        if not (eq and dot and section and key):
+            raise ValueError(
+                f"override must look like section.key=value, got {token!r}")
+        cfg.setdefault(section, {})[key] = value
+    return cfg
+
+
+def section(cfg, name):
+    return cfg.get(name, {})

@@ -1,0 +1,144 @@
+"""Speech data: synthetic utterances, normalization, bucketed iterator.
+
+Reference analogue: example/speech_recognition/stt_datagenerator.py
+(feature generation + the train-set mean/std normalization it computes
+before training) and stt_io_bucketingiter.py (BucketSTTIter). Utterances
+are word sequences over a small grapheme alphabet rendered to
+filterbank-style formant-band frames with variable symbol durations and
+gaps, so CTC's alignment does real work and lengths vary.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+
+GRAPHEMES = "abcd"
+SPACE = len(GRAPHEMES) + 1          # word separator symbol id (5)
+N_CLASSES = len(GRAPHEMES) + 2      # blank(0) + graphemes(1..4) + space
+N_BINS = 12
+L_MAX = 16
+
+
+def make_utterance(rng):
+    """Random word sequence -> (frames (T, N_BINS), symbol ids)."""
+    words = []
+    for _ in range(rng.randint(2, 5)):
+        words.append([rng.randint(1, len(GRAPHEMES) + 1)
+                      for _ in range(rng.randint(2, 4))])
+    symbols = []
+    for i, w in enumerate(words):
+        if i:
+            symbols.append(SPACE)
+        symbols.extend(w)
+    frames = []
+    for s in symbols:
+        for _ in range(rng.randint(1, 3)):      # leading gap
+            frames.append(rng.normal(0, 0.15, N_BINS))
+        band = np.zeros(N_BINS)
+        band[2 * (s - 1):2 * (s - 1) + 3] = 1.0  # formant band per symbol
+        for k in range(rng.randint(3, 7)):       # held 3-6 frames
+            frames.append(band * (0.6 + 0.4 * 0.7 ** k)
+                          + rng.normal(0, 0.15, N_BINS))
+    return np.asarray(frames, np.float32), symbols
+
+
+def words_of(symbols):
+    out, cur = [], []
+    for s in symbols:
+        if s == SPACE:
+            if cur:
+                out.append(tuple(cur))
+            cur = []
+        else:
+            cur.append(s)
+    if cur:
+        out.append(tuple(cur))
+    return out
+
+
+class FeatureNormalizer:
+    """Per-bin mean/std fitted on the training portion and applied to
+    every utterance (reference stt_datagenerator.py:sample_normalize —
+    the reference estimates from k samples; here the full train set)."""
+
+    def __init__(self, utterances=None):
+        self.mean = np.zeros(N_BINS, np.float32)
+        self.std = np.ones(N_BINS, np.float32)
+        if utterances:
+            stacked = np.concatenate([f for f, _ in utterances])
+            self.mean = stacked.mean(0)
+            self.std = stacked.std(0) + 1e-6
+
+    def __call__(self, frames):
+        return (frames - self.mean) / self.std
+
+    def state(self):
+        return {"mean": self.mean, "std": self.std}
+
+    @classmethod
+    def from_state(cls, state):
+        out = cls()
+        out.mean = np.asarray(state["mean"], np.float32)
+        out.std = np.asarray(state["std"], np.float32)
+        return out
+
+
+class SpeechBucketIter(DataIter):
+    """Utterances bucketed by frame count; labels zero-padded to L_MAX.
+
+    Training (allow_partial=False) emits only full batches but
+    RESHUFFLES each bucket every reset, so the sub-batch remainder
+    rotates and every utterance trains (the reference's
+    stt_io_bucketingiter shuffles on reset the same way). Evaluation
+    (allow_partial=True) pads the final batch per bucket and reports
+    the pad count so every utterance is scored exactly once.
+    """
+
+    def __init__(self, utterances, batch_size, buckets, seed=0,
+                 allow_partial=False, normalizer=None):
+        super().__init__(batch_size)
+        self.buckets = sorted(buckets)
+        self.default_bucket_key = self.buckets[-1]
+        self._allow_partial = allow_partial
+        self._norm = normalizer
+        self._rng = np.random.RandomState(seed)
+        self._bucketed = {b: [] for b in self.buckets}
+        for frames, symbols in utterances:
+            for b in self.buckets:
+                if len(frames) <= b and len(symbols) <= L_MAX:
+                    self._bucketed[b].append((frames, symbols))
+                    break
+        self.provide_data = [DataDesc(
+            "data", (batch_size, self.default_bucket_key, N_BINS))]
+        self.provide_label = [DataDesc("label", (batch_size, L_MAX))]
+        self._plan = []
+        self.reset()
+
+    def reset(self):
+        self._plan = []
+        for b, utts in self._bucketed.items():
+            if not self._allow_partial:
+                self._rng.shuffle(utts)
+            for i in range(0, len(utts), self.batch_size):
+                chunk = utts[i:i + self.batch_size]
+                if len(chunk) < self.batch_size and not self._allow_partial:
+                    break
+                self._plan.append((b, chunk))
+        self._i = 0
+
+    def next(self):
+        if self._i == len(self._plan):
+            raise StopIteration
+        b, utts = self._plan[self._i]
+        self._i += 1
+        pad = self.batch_size - len(utts)
+        x = np.zeros((self.batch_size, b, N_BINS), np.float32)
+        y = np.zeros((self.batch_size, L_MAX), np.float32)
+        for k, (frames, symbols) in enumerate(utts):
+            x[k, :len(frames)] = self._norm(frames) if self._norm \
+                else frames
+            y[k, :len(symbols)] = symbols
+        return DataBatch(
+            [mx.nd.array(x)], [mx.nd.array(y)], pad=pad, bucket_key=b,
+            provide_data=[DataDesc("data", (self.batch_size, b, N_BINS))],
+            provide_label=[DataDesc("label", (self.batch_size, L_MAX))])

@@ -1,0 +1,102 @@
+"""CTC decoding + error metrics.
+
+Reference analogue: example/speech_recognition/stt_metric.py
+(EvalSTTMetric: greedy path collapse + CER during training) and the
+prefix beam search used at test time.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+from data import words_of
+
+
+def greedy_decode(probs_tnc):
+    """(T, N, C) posteriors -> per-sample collapsed symbol sequences."""
+    path = probs_tnc.argmax(2)                    # (T, N)
+    out = []
+    for i in range(path.shape[1]):
+        seq, prev = [], -1
+        for s in path[:, i]:
+            if s != prev and s != 0:
+                seq.append(int(s))
+            prev = s
+        out.append(seq)
+    return out
+
+
+def beam_decode(probs_tc, beam=4):
+    """Prefix beam search over one utterance's (T, C) posteriors."""
+    # prefix -> (p_blank, p_nonblank)
+    beams = {(): (1.0, 0.0)}
+    for t in range(probs_tc.shape[0]):
+        p = probs_tc[t]
+        nxt = {}
+
+        def add(prefix, pb, pnb):
+            opb, opnb = nxt.get(prefix, (0.0, 0.0))
+            nxt[prefix] = (opb + pb, opnb + pnb)
+
+        for prefix, (pb, pnb) in beams.items():
+            add(prefix, (pb + pnb) * p[0], 0.0)          # blank
+            if prefix:
+                add(prefix, 0.0, pnb * p[prefix[-1]])    # repeat last
+            for c in range(1, probs_tc.shape[1]):
+                if prefix and c == prefix[-1]:
+                    add(prefix + (c,), 0.0, pb * p[c])
+                else:
+                    add(prefix + (c,), 0.0, (pb + pnb) * p[c])
+        beams = dict(sorted(nxt.items(), key=lambda kv: -sum(kv[1]))[:beam])
+    return list(max(beams.items(), key=lambda kv: sum(kv[1]))[0])
+
+
+def edit_distance(a, b):
+    m, n = len(a), len(b)
+    d = np.arange(n + 1, dtype=np.int32)
+    for i in range(1, m + 1):
+        prev, d[0] = d[0], i
+        for j in range(1, n + 1):
+            cur = min(d[j] + 1, d[j - 1] + 1,
+                      prev + (a[i - 1] != b[j - 1]))
+            prev, d[j] = d[j], cur
+    return int(d[n])
+
+
+class CTCErrorMetric(mx.metric.EvalMetric):
+    """Running CER from greedy decoding (the reference's EvalSTTMetric)."""
+
+    def __init__(self):
+        super().__init__("cer")
+
+    def update(self, labels, preds):
+        probs = preds[1].asnumpy()               # (T, N, C)
+        y = labels[0].asnumpy()
+        for i, seq in enumerate(greedy_decode(probs)):
+            ref = [int(s) for s in y[i] if s != 0]
+            self.sum_metric += edit_distance(seq, ref) / max(len(ref), 1)
+            self.num_inst += 1
+
+
+def evaluate(mod, it, beam):
+    """(greedy CER, WER over beam-decoded words, utterances scored)."""
+    cer_n = cer_d = 0
+    wer_n = wer_d = 0
+    scored = 0
+    it.reset()
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        probs = mod.get_outputs()[1].asnumpy()   # (T, N, C)
+        y = batch.label[0].asnumpy()
+        hyps_g = greedy_decode(probs)
+        for i in range(probs.shape[1] - batch.pad):
+            ref = [int(s) for s in y[i] if s != 0]
+            cer_n += edit_distance(hyps_g[i], ref)
+            cer_d += max(len(ref), 1)
+            hyp_b = beam_decode(probs[:, i, :], beam=beam)
+            rw, hw = words_of(ref), words_of(hyp_b)
+            wer_n += edit_distance(hw, rw)
+            wer_d += max(len(rw), 1)
+            scored += 1
+    if wer_d == 0:
+        raise RuntimeError("evaluate() scored zero utterances")
+    return cer_n / cer_d, wer_n / wer_d, scored

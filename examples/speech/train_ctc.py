@@ -2,308 +2,148 @@
 """Speech recognition: bucketed CTC acoustic training with WER gate.
 
 Reference analogue: example/speech_recognition (the reference's 3k-LoC
-deepspeech app: train.py driving STTBucketingIter + stt_bucketing_module
-+ stt_layer_* acoustic stacks + warpctc loss + stt_metric's EvalSTTMetric
-CER). The same multi-component system at example scale:
+deepspeech app: main.py/train.py driving STTBucketingIter +
+stt_bucketing_module + arch_deepspeech stacks + warpctc loss +
+stt_metric's EvalSTTMetric). The same multi-component system, split
+over this package:
 
-  dataset  — synthetic utterances: word sequences over a 4-grapheme
-             alphabet + word separator, rendered to filterbank-style
-             formant-band frames with variable symbol durations and
-             gaps (CTC's alignment does real work, lengths vary);
-  iterator — SpeechBucketIter: utterances bucketed by frame count,
-             zero-padded labels (CTCLoss's padding_mask recovers
-             label lengths), the reference's stt_io_bucketingiter;
-  model    — per-bucket GRU acoustic stack with frame-skip input
-             concat, per-frame grapheme classifier, parameters shared
-             across buckets through BucketingModule;
-  loss     — CTCLoss (blank=0) under MakeLoss; per-frame posteriors
-             exported through BlockGrad for decoding;
-  decode   — greedy collapse AND prefix beam search (stt_metric's
-             two decode paths);
-  eval     — CER (grapheme edit distance) during training, WER (word
-             edit distance, words split on the separator) as the
-             final convergence gate.
+  config_util.py — .cfg parsing + section.key=value overrides;
+  data.py        — synthetic utterances, train-set feature
+                   normalization, SpeechBucketIter;
+  arch.py        — config-chosen stacks: gru/lstm/rnn cells, multi
+                   layer, bidirectional, conv front-end, skip concat;
+  metric.py      — greedy + prefix-beam decode, CER metric, WER eval;
+  this script    — modes train (fit + checkpoint) and load (restore a
+                   checkpoint, evaluate only), WER convergence gate.
 
-Run:  python train_ctc.py                 (converges in ~2 min on CPU)
-      python train_ctc.py --epochs 12 --wer-gate 0.1
+Run:  python train_ctc.py                              (built-in config)
+      python train_ctc.py --config default.cfg arch.is_bi_rnn=true
+      python train_ctc.py --mode load --checkpoint am.ckpt
 """
 import argparse
 import logging
+import os
+import sys
 
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu.io import DataBatch, DataDesc, DataIter
 
-GRAPHEMES = "abcd"
-SPACE = len(GRAPHEMES) + 1          # word separator symbol id (5)
-N_CLASSES = len(GRAPHEMES) + 2      # blank(0) + graphemes(1..4) + space
-N_BINS = 12
-L_MAX = 16
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from arch import make_sym_gen  # noqa: E402
+from config_util import load_config, section  # noqa: E402
+from data import (FeatureNormalizer, SpeechBucketIter,  # noqa: E402
+                  make_utterance)
+from metric import CTCErrorMetric, evaluate  # noqa: E402
 
-
-# ---------------------------------------------------------------------------
-# dataset (reference: stt_datagenerator.py — utterance -> feature frames)
-# ---------------------------------------------------------------------------
-
-def make_utterance(rng):
-    """Random word sequence -> (frames (T, N_BINS), symbol ids)."""
-    words = []
-    for _ in range(rng.randint(2, 5)):
-        words.append([rng.randint(1, len(GRAPHEMES) + 1)
-                      for _ in range(rng.randint(2, 4))])
-    symbols = []
-    for i, w in enumerate(words):
-        if i:
-            symbols.append(SPACE)
-        symbols.extend(w)
-    frames = []
-    for s in symbols:
-        for _ in range(rng.randint(1, 3)):      # leading gap
-            frames.append(rng.normal(0, 0.15, N_BINS))
-        band = np.zeros(N_BINS)
-        band[2 * (s - 1):2 * (s - 1) + 3] = 1.0  # formant band per symbol
-        for k in range(rng.randint(3, 7)):       # held 3-6 frames
-            frames.append(band * (0.6 + 0.4 * 0.7 ** k)
-                          + rng.normal(0, 0.15, N_BINS))
-    return np.asarray(frames, np.float32), symbols
+_DEFAULT_CFG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "default.cfg")
 
 
-def words_of(symbols):
-    out, cur = [], []
-    for s in symbols:
-        if s == SPACE:
-            if cur:
-                out.append(tuple(cur))
-            cur = []
-        else:
-            cur.append(s)
-    if cur:
-        out.append(tuple(cur))
-    return out
+def build_data(cfg, batch_size):
+    dcfg, tcfg = section(cfg, "data"), section(cfg, "train")
+    buckets = [int(b) for b in dcfg["buckets"].split(",")]
+    rng = np.random.RandomState(3)
+    utts = [make_utterance(rng) for _ in range(int(dcfg["utterances"]))]
+    utts = [(f, s) for f, s in utts if len(f) <= buckets[-1]]
+    n_eval = max(2 * batch_size, len(utts) // 8)
+    norm = (FeatureNormalizer(utts[n_eval:])
+            if tcfg["normalize"].lower() == "true" else None)
+    train_it = SpeechBucketIter(utts[n_eval:], batch_size, buckets,
+                                normalizer=norm)
+    eval_it = SpeechBucketIter(utts[:n_eval], batch_size, buckets,
+                               allow_partial=True, normalizer=norm)
+    return train_it, eval_it, n_eval, norm
 
 
-# ---------------------------------------------------------------------------
-# bucketed iterator (reference: stt_io_bucketingiter.py)
-# ---------------------------------------------------------------------------
-
-class SpeechBucketIter(DataIter):
-    """Utterances bucketed by frame count; labels zero-padded to L_MAX.
-
-    Training (allow_partial=False) emits only full batches but
-    RESHUFFLES each bucket every reset, so the sub-batch remainder
-    rotates and every utterance trains (the reference's
-    stt_io_bucketingiter shuffles on reset the same way). Evaluation
-    (allow_partial=True) pads the final batch per bucket and reports
-    the pad count so every utterance is scored exactly once.
-    """
-
-    def __init__(self, utterances, batch_size, buckets, seed=0,
-                 allow_partial=False):
-        super().__init__(batch_size)
-        self.buckets = sorted(buckets)
-        self.default_bucket_key = self.buckets[-1]
-        self._allow_partial = allow_partial
-        self._rng = np.random.RandomState(seed)
-        self._bucketed = {b: [] for b in self.buckets}
-        for frames, symbols in utterances:
-            for b in self.buckets:
-                if len(frames) <= b and len(symbols) <= L_MAX:
-                    self._bucketed[b].append((frames, symbols))
-                    break
-        self.provide_data = [DataDesc(
-            "data", (batch_size, self.default_bucket_key, N_BINS))]
-        self.provide_label = [DataDesc("label", (batch_size, L_MAX))]
-        self._plan = []
-        self.reset()
-
-    def reset(self):
-        self._plan = []
-        for b, utts in self._bucketed.items():
-            if not self._allow_partial:
-                self._rng.shuffle(utts)
-            for i in range(0, len(utts), self.batch_size):
-                chunk = utts[i:i + self.batch_size]
-                if len(chunk) < self.batch_size and not self._allow_partial:
-                    break
-                self._plan.append((b, chunk))
-        self._i = 0
-
-    def next(self):
-        if self._i == len(self._plan):
-            raise StopIteration
-        b, utts = self._plan[self._i]
-        self._i += 1
-        pad = self.batch_size - len(utts)
-        x = np.zeros((self.batch_size, b, N_BINS), np.float32)
-        y = np.zeros((self.batch_size, L_MAX), np.float32)
-        for k, (frames, symbols) in enumerate(utts):
-            x[k, :len(frames)] = frames
-            y[k, :len(symbols)] = symbols
-        return DataBatch(
-            [mx.nd.array(x)], [mx.nd.array(y)], pad=pad, bucket_key=b,
-            provide_data=[DataDesc("data", (self.batch_size, b, N_BINS))],
-            provide_label=[DataDesc("label", (self.batch_size, L_MAX))])
+def save_checkpoint(path, mod, norm):
+    args_p, aux_p = mod.get_params()
+    blob = {f"arg:{k}": v for k, v in args_p.items()}
+    blob.update({f"aux:{k}": v for k, v in aux_p.items()})
+    if norm is not None:
+        blob["norm:mean"] = mx.nd.array(norm.mean)
+        blob["norm:std"] = mx.nd.array(norm.std)
+    mx.nd.save(path, blob)
 
 
-# ---------------------------------------------------------------------------
-# model (reference: arch_deepspeech.py via stt_layer_gru/fc + warpctc)
-# ---------------------------------------------------------------------------
-
-def make_sym_gen(hidden):
-    cell = mx.rnn.GRUCell(num_hidden=hidden, prefix="am_")
-
-    def sym_gen(bucket_key):
-        t = bucket_key
-        data = mx.sym.var("data")            # (N, T, bins)
-        label = mx.sym.var("label")          # (N, L_MAX)
-        out, _ = cell.unroll(t, inputs=data, layout="NTC",
-                             merge_outputs=True)
-        feats = mx.sym.Concat(out, data, dim=2)   # frame-skip concat
-        pred = mx.sym.Reshape(feats, shape=(-1, hidden + N_BINS))
-        pred = mx.sym.FullyConnected(pred, num_hidden=N_CLASSES,
-                                     name="cls")
-        tnc = mx.sym.Reshape(pred, shape=(-4, -1, t, N_CLASSES))
-        tnc = mx.sym.transpose(tnc, axes=(1, 0, 2))  # (T, N, C)
-        loss = mx.sym.MakeLoss(mx.sym.CTCLoss(tnc, label),
-                               name="ctc_loss")
-        probs = mx.sym.BlockGrad(mx.sym.softmax(tnc, axis=-1),
-                                 name="probs")
-        return mx.sym.Group([loss, probs]), ("data",), ("label",)
-
-    return sym_gen
-
-
-# ---------------------------------------------------------------------------
-# decoding + metrics (reference: stt_metric.py EvalSTTMetric)
-# ---------------------------------------------------------------------------
-
-def greedy_decode(probs_tnc):
-    """(T, N, C) posteriors -> per-sample collapsed symbol sequences."""
-    path = probs_tnc.argmax(2)                    # (T, N)
-    out = []
-    for i in range(path.shape[1]):
-        seq, prev = [], -1
-        for s in path[:, i]:
-            if s != prev and s != 0:
-                seq.append(int(s))
-            prev = s
-        out.append(seq)
-    return out
-
-
-def beam_decode(probs_tc, beam=4):
-    """Prefix beam search over one utterance's (T, C) posteriors."""
-    # prefix -> (p_blank, p_nonblank)
-    beams = {(): (1.0, 0.0)}
-    for t in range(probs_tc.shape[0]):
-        p = probs_tc[t]
-        nxt = {}
-
-        def add(prefix, pb, pnb):
-            opb, opnb = nxt.get(prefix, (0.0, 0.0))
-            nxt[prefix] = (opb + pb, opnb + pnb)
-
-        for prefix, (pb, pnb) in beams.items():
-            add(prefix, (pb + pnb) * p[0], 0.0)          # blank
-            if prefix:
-                add(prefix, 0.0, pnb * p[prefix[-1]])    # repeat last
-            for c in range(1, probs_tc.shape[1]):
-                if prefix and c == prefix[-1]:
-                    add(prefix + (c,), 0.0, pb * p[c])
-                else:
-                    add(prefix + (c,), 0.0, (pb + pnb) * p[c])
-        beams = dict(sorted(nxt.items(), key=lambda kv: -sum(kv[1]))[:beam])
-    return list(max(beams.items(), key=lambda kv: sum(kv[1]))[0])
-
-
-def edit_distance(a, b):
-    m, n = len(a), len(b)
-    d = np.arange(n + 1, dtype=np.int32)
-    for i in range(1, m + 1):
-        prev, d[0] = d[0], i
-        for j in range(1, n + 1):
-            cur = min(d[j] + 1, d[j - 1] + 1,
-                      prev + (a[i - 1] != b[j - 1]))
-            prev, d[j] = d[j], cur
-    return int(d[n])
-
-
-class CTCErrorMetric(mx.metric.EvalMetric):
-    """Running CER from greedy decoding (the reference's EvalSTTMetric)."""
-
-    def __init__(self):
-        super().__init__("cer")
-
-    def update(self, labels, preds):
-        probs = preds[1].asnumpy()               # (T, N, C)
-        y = labels[0].asnumpy()
-        for i, seq in enumerate(greedy_decode(probs)):
-            ref = [int(s) for s in y[i] if s != 0]
-            self.sum_metric += edit_distance(seq, ref) / max(len(ref), 1)
-            self.num_inst += 1
-
-
-def evaluate(mod, it, beam):
-    """(greedy CER, WER over beam-decoded words, utterances scored)."""
-    cer_n = cer_d = 0
-    wer_n = wer_d = 0
-    scored = 0
-    it.reset()
-    for batch in it:
-        mod.forward(batch, is_train=False)
-        probs = mod.get_outputs()[1].asnumpy()   # (T, N, C)
-        y = batch.label[0].asnumpy()
-        hyps_g = greedy_decode(probs)
-        for i in range(probs.shape[1] - batch.pad):
-            ref = [int(s) for s in y[i] if s != 0]
-            cer_n += edit_distance(hyps_g[i], ref)
-            cer_d += max(len(ref), 1)
-            hyp_b = beam_decode(probs[:, i, :], beam=beam)
-            rw, hw = words_of(ref), words_of(hyp_b)
-            wer_n += edit_distance(hw, rw)
-            wer_d += max(len(rw), 1)
-            scored += 1
-    if wer_d == 0:
-        raise RuntimeError("evaluate() scored zero utterances")
-    return cer_n / cer_d, wer_n / wer_d, scored
+def load_checkpoint(path):
+    blob = mx.nd.load(path)
+    args_p = {k[4:]: v for k, v in blob.items() if k.startswith("arg:")}
+    aux_p = {k[4:]: v for k, v in blob.items() if k.startswith("aux:")}
+    norm = None
+    if "norm:mean" in blob:
+        norm = FeatureNormalizer.from_state(
+            {"mean": blob["norm:mean"].asnumpy(),
+             "std": blob["norm:std"].asnumpy()})
+    return args_p, aux_p, norm
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=10)
-    ap.add_argument("--utterances", type=int, default=480)
-    ap.add_argument("--batch-size", type=int, default=16)
-    ap.add_argument("--hidden", type=int, default=64)
-    ap.add_argument("--lr", type=float, default=4e-3)
-    ap.add_argument("--beam", type=int, default=4)
-    ap.add_argument("--wer-gate", type=float, default=0.15)
+    ap.add_argument("--config", default=None,
+                    help=".cfg file; built-in toy config if omitted")
+    ap.add_argument("overrides", nargs="*",
+                    help="section.key=value config overrides")
+    ap.add_argument("--mode", choices=("train", "load"), default="train")
+    ap.add_argument("--checkpoint", default="am.ckpt")
+    # deprecated flat flags kept for compatibility with earlier rounds
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--wer-gate", type=float, default=None)
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
+    # default.cfg beside the script is the single source of defaults; a
+    # --config file overlays it, then section.key=value overrides win
+    cfg_path = args.config or _DEFAULT_CFG
+    if not os.path.exists(cfg_path):
+        beside = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              cfg_path)
+        if os.path.exists(beside):
+            cfg_path = beside
+    cfg = load_config(_DEFAULT_CFG)
+    for s, kv in load_config(cfg_path, args.overrides).items():
+        cfg.setdefault(s, {}).update(kv)
+    if args.epochs is not None:
+        cfg["train"]["epochs"] = str(args.epochs)
+    if args.wer_gate is not None:
+        cfg["test"]["wer_gate"] = str(args.wer_gate)
+
+    tcfg, xcfg = section(cfg, "train"), section(cfg, "test")
+    batch_size = int(tcfg["batch_size"])
+
     mx.random.seed(3)
-    rng = np.random.RandomState(3)
-    buckets = [40, 60, 80]
-    utts = [make_utterance(rng) for _ in range(args.utterances)]
-    utts = [(f, s) for f, s in utts if len(f) <= buckets[-1]]
-    n_eval = max(2 * args.batch_size, len(utts) // 8)
-    train_it = SpeechBucketIter(utts[n_eval:], args.batch_size, buckets)
-    eval_it = SpeechBucketIter(utts[:n_eval], args.batch_size, buckets,
-                               allow_partial=True)
+    train_it, eval_it, n_eval, norm = build_data(cfg, batch_size)
 
     mod = mx.mod.BucketingModule(
-        make_sym_gen(args.hidden),
+        make_sym_gen(section(cfg, "arch")),
         default_bucket_key=train_it.default_bucket_key)
-    mod.fit(train_it, num_epoch=args.epochs, optimizer="adam",
-            optimizer_params={"learning_rate": args.lr},
-            eval_metric=CTCErrorMetric(),
-            initializer=mx.init.Xavier())
 
-    cer, wer, scored = evaluate(mod, eval_it, args.beam)
+    if args.mode == "load":
+        args_p, aux_p, saved_norm = load_checkpoint(args.checkpoint)
+        # the checkpoint's normalization (possibly none) always wins —
+        # evaluating with a mismatched normalizer silently destroys WER
+        for it in (train_it, eval_it):
+            it._norm = saved_norm
+        mod.bind(data_shapes=train_it.provide_data,
+                 label_shapes=train_it.provide_label, for_training=False)
+        mod.set_params(args_p, aux_p)
+        print(f"restored checkpoint {args.checkpoint}")
+    else:
+        mod.fit(train_it, num_epoch=int(tcfg["epochs"]),
+                optimizer=tcfg["optimizer"],
+                optimizer_params={
+                    "learning_rate": float(tcfg["learning_rate"])},
+                eval_metric=CTCErrorMetric(),
+                initializer=mx.init.Xavier())
+        save_checkpoint(args.checkpoint, mod, norm)
+        print(f"saved checkpoint {args.checkpoint}")
+
+    cer, wer, scored = evaluate(mod, eval_it, int(xcfg["beam"]))
     assert scored == n_eval, (scored, n_eval)
     print(f"held-out CER {cer:.3f}  WER {wer:.3f} "
-          f"(beam={args.beam}, {scored} utterances)")
-    assert wer <= args.wer_gate, f"WER {wer:.3f} above gate {args.wer_gate}"
+          f"(beam={xcfg['beam']}, {scored} utterances)")
+    gate = float(xcfg["wer_gate"])
+    assert wer <= gate, f"WER {wer:.3f} above gate {gate}"
 
 
 if __name__ == "__main__":

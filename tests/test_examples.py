@@ -40,7 +40,8 @@ _CASES = [
     ("neural-style/neural_style_toy.py", []),
     ("dec/dec_toy.py", []),
     ("speech/speech_gru_acoustic.py", ["--epochs", "10"]),
-    ("speech/train_ctc.py", ["--wer-gate", "0.2"]),
+    ("speech/train_ctc.py",
+     ["--config", "default.cfg", "test.wer_gate=0.2"]),
     ("bayesian-methods/sgld_regression.py", ["--iters", "6000"]),
     ("dsd/dsd_training.py", []),
     ("sparse/linear_classification.py", []),

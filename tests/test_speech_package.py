@@ -1,0 +1,93 @@
+"""speech example package: config / arch / data units.
+
+Reference analogue: the reference decomposes speech_recognition into
+config_util + arch_deepspeech + stt_layer_* + stt_io_bucketingiter;
+these tests pin those contracts on our examples/speech modules without
+full training (the WER convergence gate lives in test_examples.py).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "speech"))
+
+from config_util import load_config, section  # noqa: E402
+from data import (FeatureNormalizer, N_BINS, N_CLASSES, L_MAX,  # noqa: E402
+                  SpeechBucketIter, make_utterance)
+
+_SPEECH_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "speech")
+
+
+def test_config_file_and_overrides():
+    cfg = load_config(os.path.join(_SPEECH_DIR, "default.cfg"),
+                      overrides=["arch.is_bi_rnn=true",
+                                 "train.epochs=2",
+                                 "newsec.key=v"])
+    assert section(cfg, "arch")["cell"] == "gru"
+    assert section(cfg, "arch")["is_bi_rnn"] == "true"   # overridden
+    assert section(cfg, "train")["epochs"] == "2"
+    assert section(cfg, "newsec")["key"] == "v"
+    with pytest.raises(ValueError):
+        load_config(None, overrides=["malformed"])
+    with pytest.raises(FileNotFoundError):
+        load_config("/nonexistent/x.cfg")
+
+
+def test_feature_normalizer_roundtrip():
+    rng = np.random.RandomState(0)
+    utts = [make_utterance(rng) for _ in range(8)]
+    norm = FeatureNormalizer(utts)
+    stacked = np.concatenate([norm(f) for f, _ in utts])
+    np.testing.assert_allclose(stacked.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(stacked.std(0), 1.0, atol=1e-2)
+    again = FeatureNormalizer.from_state(norm.state())
+    np.testing.assert_array_equal(again.mean, norm.mean)
+
+
+@pytest.mark.parametrize("variant", [
+    {"cell": "gru", "hidden": "16"},
+    {"cell": "lstm", "hidden": "12", "is_bi_rnn": "true"},
+    {"cell": "gru", "hidden": "12", "conv_channels": "6"},
+    {"cell": "rnn", "hidden": "12", "num_rnn_layer": "2",
+     "skip_concat": "false"},
+])
+def test_arch_variants_train_one_step(variant):
+    """Every config-selectable stack binds, runs fwd+bwd, and produces
+    finite CTC loss + correctly shaped posteriors."""
+    from arch import make_sym_gen
+    import mxnet_tpu as mx
+    t, b = 12, 2
+    sym, data_names, label_names = make_sym_gen(variant)(t)
+    ex = sym.simple_bind(data=(b, t, N_BINS), label=(b, L_MAX))
+    rng = np.random.RandomState(1)
+    x = rng.rand(b, t, N_BINS).astype(np.float32)
+    y = np.zeros((b, L_MAX), np.float32)
+    y[:, 0:2] = [[1, 2], [3, 4]]
+    ex.forward(is_train=True, data=x, label=y)
+    loss, probs = [o.asnumpy() for o in ex.outputs]
+    assert probs.shape == (t, b, N_CLASSES)
+    assert np.isfinite(loss).all() and (loss > 0).all()
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+    ex.backward()
+    grads = [g.asnumpy() for g in ex.grad_arrays if g is not None]
+    assert grads and any(np.abs(g).sum() > 0 for g in grads)
+
+
+def test_bucket_iter_partial_vs_full():
+    rng = np.random.RandomState(5)
+    utts = [make_utterance(rng) for _ in range(21)]
+    utts = [(f, s) for f, s in utts if len(f) <= 80]
+    full = SpeechBucketIter(utts, 4, [40, 60, 80])
+    partial = SpeechBucketIter(utts, 4, [40, 60, 80], allow_partial=True)
+    n_full = sum(4 for _ in full)
+    n_scored = sum(4 - b.pad for b in partial)
+    assert n_scored == len(utts)
+    assert n_full <= len(utts)
+    # every batch's data is the bucket-sized shape
+    partial.reset()
+    for b in partial:
+        assert b.data[0].shape[1] == b.bucket_key
