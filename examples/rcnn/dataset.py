@@ -102,19 +102,26 @@ class PascalVOC(ImageDB):
     def __len__(self):
         return len(self._ids)
 
-    def _annotation(self, stem):
-        """gt rows for one image, scaled to the short_side resize if one
-        is configured — annotations and sampled images always agree.
-        Image dims come from the XML <size> element, so roidb() never
-        decodes pixels."""
+    def _annotation(self, stem, scale=None):
+        """gt rows for one image, scaled by ``scale`` (the short_side
+        resize factor). sample() passes the factor computed from the
+        decoded image so boxes and pixels can never diverge; roidb()
+        leaves it None and the factor comes from the XML <size> element
+        (no pixel decode), failing loudly if the element is absent."""
         tree = ET.parse(os.path.join(self._voc, "Annotations",
                                      f"{stem}.xml"))
-        scale = 1.0
-        if self._short is not None:
-            size = tree.find("size")
-            h = float(size.findtext("height"))
-            w = float(size.findtext("width"))
-            scale = self._short / min(h, w)
+        if scale is None:
+            scale = 1.0
+            if self._short is not None:
+                size = tree.find("size")
+                if size is None:
+                    raise ValueError(
+                        f"{stem}.xml has no <size> element; roidb() needs "
+                        "it to scale boxes for short_side — use sample() "
+                        "or fix the annotation")
+                h = float(size.findtext("height"))
+                w = float(size.findtext("width"))
+                scale = self._short / min(h, w)
         rows = []
         for obj in tree.findall("object"):
             if not self._difficult and \
@@ -136,12 +143,13 @@ class PascalVOC(ImageDB):
         raw = mx_image.imread(
             os.path.join(self._voc, "JPEGImages", f"{stem}.jpg"))
         img = raw.asnumpy().astype(np.float32) / 255.0     # HWC
-        gt = self._annotation(stem)   # already short_side-scaled
+        scale = 1.0
         if self._short is not None:
             h, w = img.shape[:2]
             scale = self._short / min(h, w)
             img = _resize_hwc(img, int(round(h * scale)),
                               int(round(w * scale)))
+        gt = self._annotation(stem, scale=scale)
         return img.transpose(2, 0, 1), gt
 
     def roidb(self):

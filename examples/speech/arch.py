@@ -24,11 +24,12 @@ def _conv_front(data, t, channels):
 
 
 def _make_cell(kind, hidden, prefix):
-    if kind == "lstm":
-        return mx.rnn.LSTMCell(num_hidden=hidden, prefix=prefix)
-    if kind == "gru":
-        return mx.rnn.GRUCell(num_hidden=hidden, prefix=prefix)
-    return mx.rnn.RNNCell(num_hidden=hidden, prefix=prefix)
+    makers = {"lstm": mx.rnn.LSTMCell, "gru": mx.rnn.GRUCell,
+              "rnn": mx.rnn.RNNCell}
+    if kind not in makers:
+        raise ValueError(f"unknown arch.cell {kind!r}; "
+                         f"choose from {sorted(makers)}")
+    return makers[kind](num_hidden=hidden, prefix=prefix)
 
 
 def build_stack(cfg):

@@ -40,15 +40,20 @@ _DEFAULT_CFG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "default.cfg")
 
 
-def build_data(cfg, batch_size):
+def build_data(cfg, batch_size, norm="fit"):
+    """norm: 'fit' trains a FeatureNormalizer on the train split (when
+    the config asks for one); anything else — a restored normalizer or
+    None — is used as-is (load mode must evaluate with the checkpoint's
+    normalization)."""
     dcfg, tcfg = section(cfg, "data"), section(cfg, "train")
     buckets = [int(b) for b in dcfg["buckets"].split(",")]
     rng = np.random.RandomState(3)
     utts = [make_utterance(rng) for _ in range(int(dcfg["utterances"]))]
     utts = [(f, s) for f, s in utts if len(f) <= buckets[-1]]
     n_eval = max(2 * batch_size, len(utts) // 8)
-    norm = (FeatureNormalizer(utts[n_eval:])
-            if tcfg["normalize"].lower() == "true" else None)
+    if norm == "fit":
+        norm = (FeatureNormalizer(utts[n_eval:])
+                if tcfg["normalize"].lower() == "true" else None)
     train_it = SpeechBucketIter(utts[n_eval:], batch_size, buckets,
                                 normalizer=norm)
     eval_it = SpeechBucketIter(utts[:n_eval], batch_size, buckets,
@@ -112,18 +117,21 @@ def main():
     batch_size = int(tcfg["batch_size"])
 
     mx.random.seed(3)
-    train_it, eval_it, n_eval, norm = build_data(cfg, batch_size)
+    if args.mode == "load":
+        # restore first: the checkpoint's normalization (possibly none)
+        # always wins — evaluating with a mismatched normalizer silently
+        # destroys WER — and no fresh normalizer fit is wasted
+        args_p, aux_p, saved_norm = load_checkpoint(args.checkpoint)
+        train_it, eval_it, n_eval, norm = build_data(cfg, batch_size,
+                                                     norm=saved_norm)
+    else:
+        train_it, eval_it, n_eval, norm = build_data(cfg, batch_size)
 
     mod = mx.mod.BucketingModule(
         make_sym_gen(section(cfg, "arch")),
         default_bucket_key=train_it.default_bucket_key)
 
     if args.mode == "load":
-        args_p, aux_p, saved_norm = load_checkpoint(args.checkpoint)
-        # the checkpoint's normalization (possibly none) always wins —
-        # evaluating with a mismatched normalizer silently destroys WER
-        for it in (train_it, eval_it):
-            it._norm = saved_norm
         mod.bind(data_shapes=train_it.provide_data,
                  label_shapes=train_it.provide_label, for_training=False)
         mod.set_params(args_p, aux_p)

@@ -9,7 +9,8 @@ units prefer; the reference's NCHW remains available via ``layout=``.
 from __future__ import annotations
 
 from ..base import MXNetError
-from . import (alexnet, lenet, mlp, resnet, transformer,  # noqa: F401
+from . import (alexnet, googlenet, inception_bn, lenet, mlp,  # noqa: F401
+               mobilenet, resnet, resnext, transformer,
                transformer_sym, vgg)
 from .transformer import TransformerConfig, TransformerLM  # noqa: F401
 
@@ -19,6 +20,12 @@ _MODELS = {
     "vgg": vgg.get_symbol,
     "lenet": lenet.get_symbol,
     "mlp": mlp.get_symbol,
+    "googlenet": googlenet.get_symbol,
+    "resnet-v1": lambda **kw: resnet.get_symbol(
+        **{**kw, "version": 1}),
+    "inception-bn": inception_bn.get_symbol,
+    "mobilenet": mobilenet.get_symbol,
+    "resnext": resnext.get_symbol,
     "transformer_lm": transformer_sym.get_symbol,
 }
 

@@ -19,6 +19,16 @@ from mxnet_tpu.parallel import SPMDTrainer, make_mesh, param_pspec
     ("resnet", dict(num_layers=18, num_classes=10, image_shape="32,32,3"),
      (4, 32, 32, 3)),
     ("vgg", dict(num_layers=11, num_classes=10), (2, 32, 32, 3)),
+    ("googlenet", dict(num_classes=10), (2, 64, 64, 3)),
+    ("inception-bn", dict(num_classes=10, image_shape="64,64,3"),
+     (2, 64, 64, 3)),
+    ("inception-bn", dict(num_classes=10, image_shape="28,28,3"),
+     (2, 28, 28, 3)),
+    ("mobilenet", dict(num_classes=10, multiplier=0.5), (2, 64, 64, 3)),
+    ("resnext", dict(num_layers=50, num_classes=10, num_group=8),
+     (2, 64, 64, 3)),
+    ("resnet-v1", dict(num_layers=18, num_classes=10,
+                       image_shape="32,32,3"), (2, 32, 32, 3)),
 ])
 def test_model_forward_backward(name, kw, dshape):
     s = models.get_symbol(name, **kw)

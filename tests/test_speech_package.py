@@ -11,15 +11,13 @@ import sys
 import numpy as np
 import pytest
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "examples", "speech"))
+_SPEECH_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "speech")
+sys.path.insert(0, _SPEECH_DIR)
 
 from config_util import load_config, section  # noqa: E402
 from data import (FeatureNormalizer, N_BINS, N_CLASSES, L_MAX,  # noqa: E402
                   SpeechBucketIter, make_utterance)
-
-_SPEECH_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "examples", "speech")
 
 
 def test_config_file_and_overrides():
