@@ -83,7 +83,9 @@ def main():
     mx.random.seed(23)
     rng = np.random.RandomState(7)
     net = RCNN()
-    if args.params and os.path.exists(args.params):
+    if args.params and not os.path.exists(args.params):
+        ap.error(f"--params file not found: {args.params}")
+    if args.params:
         net.load_params(args.params)
         print(f"loaded parameters from {args.params}")
     else:

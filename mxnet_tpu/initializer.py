@@ -177,9 +177,14 @@ class Xavier(Initializer):
             self._store(arr, _host_rng().uniform(-0.07, 0.07, shape))
             return
         fan_in, fan_out = self._fans(desc, shape)
-        denom = {"avg": (fan_in + fan_out) / 2.0,
-                 "in": fan_in,
-                 "out": fan_out}[self.factor_type]
+        denoms = {"avg": (fan_in + fan_out) / 2.0,
+                  "in": fan_in,
+                  "out": fan_out}
+        if self.factor_type not in denoms:
+            raise ValueError(
+                f"unknown factor_type {self.factor_type!r}; "
+                f"choose one of {sorted(denoms)}")
+        denom = denoms[self.factor_type]
         scale = float(_np.sqrt(self.magnitude / denom))
         draw = (_host_rng().uniform(-scale, scale, shape)
                 if self.rnd_type == "uniform"

@@ -30,16 +30,32 @@ class FactorScheduler(LearningRateScheduler):
 
     def __init__(self, step: int, factor: float = 0.1):
         super().__init__()
-        if step < 1:
-            raise ValueError("step must be a positive iteration count")
-        if not factor < 1.0:
-            raise ValueError("a decay factor must shrink the lr (< 1.0)")
-        self.every = int(step)
-        self.decay = float(factor)
-        # reference-API attribute names, kept for legacy scripts
-        self.step = self.every
-        self.factor = self.decay
+        self.step = step      # validated by the property setters
+        self.factor = factor
         self._announced: float | None = None
+
+    # reference-API attribute names; properties so legacy scripts that
+    # mutate sched.step / sched.factor after construction still take
+    # effect, with the same validation as construction
+    @property
+    def step(self) -> int:
+        return self.every
+
+    @step.setter
+    def step(self, value: int) -> None:
+        if value < 1:
+            raise ValueError("step must be a positive iteration count")
+        self.every = int(value)
+
+    @property
+    def factor(self) -> float:
+        return self.decay
+
+    @factor.setter
+    def factor(self, value: float) -> None:
+        if not value < 1.0:
+            raise ValueError("a decay factor must shrink the lr (< 1.0)")
+        self.decay = float(value)
 
     def __call__(self, iteration: int) -> float:
         lr = self.base_lr * self.decay ** (int(iteration) // self.every)

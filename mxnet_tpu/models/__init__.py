@@ -9,7 +9,8 @@ units prefer; the reference's NCHW remains available via ``layout=``.
 from __future__ import annotations
 
 from ..base import MXNetError
-from . import (alexnet, googlenet, inception_bn, lenet, mlp,  # noqa: F401
+from . import (alexnet, googlenet, inception_bn, inception_resnet_v2,  # noqa: F401
+               inception_v3, inception_v4, lenet, mlp,
                mobilenet, resnet, resnext, transformer,
                transformer_sym, vgg)
 from .transformer import TransformerConfig, TransformerLM  # noqa: F401
@@ -24,6 +25,9 @@ _MODELS = {
     "resnet-v1": lambda **kw: resnet.get_symbol(
         **{**kw, "version": 1}),
     "inception-bn": inception_bn.get_symbol,
+    "inception-v3": inception_v3.get_symbol,
+    "inception-v4": inception_v4.get_symbol,
+    "inception-resnet-v2": inception_resnet_v2.get_symbol,
     "mobilenet": mobilenet.get_symbol,
     "resnext": resnext.get_symbol,
     "transformer_lm": transformer_sym.get_symbol,

@@ -5,6 +5,10 @@ Reference analogue: example/image-classification/symbols/googlenet.py
 inception mixes are a table here; each mix concatenates a 1x1 branch,
 a reduced 3x3 branch, a reduced 5x5 branch, and a pooled projection
 along channels.
+
+Deviation from the reference symbol: the classifier keeps the paper's
+0.4 dropout before the FC layer (Szegedy et al. §6); the reference
+symbol file omits it. Noted in PARITY.md §1 L10.
 """
 from __future__ import annotations
 

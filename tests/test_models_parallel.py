@@ -29,6 +29,9 @@ from mxnet_tpu.parallel import SPMDTrainer, make_mesh, param_pspec
      (2, 64, 64, 3)),
     ("resnet-v1", dict(num_layers=18, num_classes=10,
                        image_shape="32,32,3"), (2, 32, 32, 3)),
+    ("inception-v3", dict(num_classes=10), (1, 139, 139, 3)),
+    ("inception-v4", dict(num_classes=10), (1, 139, 139, 3)),
+    ("inception-resnet-v2", dict(num_classes=10), (1, 139, 139, 3)),
 ])
 def test_model_forward_backward(name, kw, dshape):
     s = models.get_symbol(name, **kw)

@@ -3,17 +3,20 @@
 
 Reference: tools/im2rec.py (list creation + multi-worker packing into
 ``.rec`` + ``.idx``). Same CLI surface for the common flags; packing is
-thread-parallel (decode/encode releases the GIL in cv2).
+process-parallel (``--num-thread`` spawns decoder processes, sidestepping
+the GIL the way the reference's native tools/im2rec.cc pthread pool did —
+see PARITY.md §2.4 for why no C++ packer is needed here).
 
 Usage:
   python tools/im2rec.py PREFIX ROOT --list            # write PREFIX.lst
   python tools/im2rec.py PREFIX ROOT                   # pack PREFIX.lst -> .rec
 """
 import argparse
+import functools
 import os
 import random
 import sys
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -131,8 +134,18 @@ def im2rec(args, path_lst):
     record = recordio.MXIndexedRecordIO(out_base + ".idx",
                                         out_base + ".rec", "w")
     items = list(read_list(path_lst))
-    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
-        for i, buf in pool.map(lambda it: _encode_one(args, it), items):
+    encode = functools.partial(_encode_one, args)
+    if args.num_thread > 1:
+        # decoder processes, not threads: JPEG decode is the hot loop and
+        # must scale past the GIL (the reference solved this with the
+        # native im2rec.cc pthread pool)
+        with ProcessPoolExecutor(max_workers=args.num_thread) as pool:
+            results = pool.map(encode, items, chunksize=16)
+            for i, buf in results:
+                if buf is not None:
+                    record.write_idx(i, buf)
+    else:
+        for i, buf in map(encode, items):
             if buf is not None:
                 record.write_idx(i, buf)
     record.close()
