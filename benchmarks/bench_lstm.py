@@ -18,24 +18,19 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=64)
-    ap.add_argument("--seq-len", type=int, default=256)
-    ap.add_argument("--num-hidden", type=int, default=1024)
-    ap.add_argument("--num-layers", type=int, default=2)
-    ap.add_argument("--vocab", type=int, default=10000)
-    ap.add_argument("--iters", type=int, default=10)
-    args = ap.parse_args()
+def run(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
+        vocab=10000, iters=10, quiet=False):
+    """Measure LSTM training throughput; returns the metric record.
 
-    import jax
+    Importable entry — bench.py calls this to emit the second north-star
+    metric (BASELINE.md:64) alongside the ResNet-50 number."""
     import mxnet_tpu as mx
 
-    T, N, H, V = args.seq_len, args.batch_size, args.num_hidden, args.vocab
+    T, N, H, V = seq_len, batch_size, num_hidden, vocab
     data = mx.sym.var("data")
     embed = mx.sym.Embedding(data, input_dim=V, output_dim=H, name="embed")
     embed = mx.sym.SwapAxis(embed, dim1=0, dim2=1)  # NTC -> TNC
-    stack = mx.rnn.FusedRNNCell(H, num_layers=args.num_layers, mode="lstm",
+    stack = mx.rnn.FusedRNNCell(H, num_layers=num_layers, mode="lstm",
                                 prefix="lstm_")
     out, _ = stack.unroll(T, inputs=embed, merge_outputs=True, layout="TNC")
     pred = mx.sym.Reshape(out, shape=(-1, H))
@@ -71,23 +66,37 @@ def main():
     step()  # compile
     sync()
     t0 = time.time()
-    for _ in range(args.iters):
+    for _ in range(iters):
         step()
     sync()
-    dt = (time.time() - t0) / args.iters
+    dt = (time.time() - t0) / iters
     tps = N * T / dt
     # fwd flops/token: 8H^2 per LSTM layer (4 gates x two HxH matmuls)
     # + 2HV head + 0 embedding (gather); train step ~ 3x fwd
-    flops_tok = 3 * (8 * H * H * args.num_layers + 2 * H * V)
-    print(f"LSTM {args.num_layers}x{H} bs{N} T={T}: "
-          f"{dt * 1000:.1f} ms/step, {tps:,.0f} tokens/sec/chip")
-    print(json.dumps({
+    flops_tok = 3 * (8 * H * H * num_layers + 2 * H * V)
+    if not quiet:
+        print(f"LSTM {num_layers}x{H} bs{N} T={T}: "
+              f"{dt * 1000:.1f} ms/step, {tps:,.0f} tokens/sec/chip")
+    return {
         "metric": "lstm_train_throughput",
         "value": round(tps, 0),
         "unit": "tokens/sec/chip",
-        "config": f"{args.num_layers}x{H} bs{N} T={T} V={V}",
+        "config": f"{num_layers}x{H} bs{N} T={T} V={V}",
         "effective_tflops": round(tps * flops_tok / 1e12, 1),
-    }))
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--num-hidden", type=int, default=1024)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=10000)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    print(json.dumps(run(args.batch_size, args.seq_len, args.num_hidden,
+                         args.num_layers, args.vocab, args.iters)))
 
 
 if __name__ == "__main__":

@@ -23,7 +23,7 @@ from dataset import SyntheticShapes  # noqa: E402
 from eval import proposal_recall  # noqa: E402
 from model import (CLASSES, IMG, RATIOS, SCALES, STRIDE, RCNN,  # noqa: E402
                    default_im_info, detect, train_step)
-from rcnn_common import make_anchor_grid  # noqa: E402
+from rcnn_common import make_anchor_grid, norm_for_checkpoint  # noqa: E402
 
 
 def ascii_render(img, dets, width=48):
@@ -85,9 +85,12 @@ def main():
     net = RCNN()
     if args.params and not os.path.exists(args.params):
         ap.error(f"--params file not found: {args.params}")
+    norm = None
     if args.params:
         net.load_params(args.params)
-        print(f"loaded parameters from {args.params}")
+        norm, norm_path = norm_for_checkpoint(args.params, len(CLASSES))
+        print(f"loaded parameters from {args.params}"
+              + (f" + bbox norm {norm_path}" if norm_path else ""))
     else:
         quick_train(net, args.train_epochs, rng)
         net.save_params(args.save_params)
@@ -103,7 +106,8 @@ def main():
     gts_all, boxes_all = [], []
     for i in range(len(val)):
         img, gt = val.sample(i)
-        dets = detect(net, img, im_info, score_thresh=args.score_thresh)
+        dets = detect(net, img, im_info, score_thresh=args.score_thresh,
+                      norm=norm)
         dumped[f"scene{i}"] = np.asarray(dets, np.float32).reshape(-1, 6)
         n_hits += len(dets)
         gts_all.append(gt.tolist())

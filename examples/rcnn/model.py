@@ -11,7 +11,7 @@ import numpy as np
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 
-from rcnn_common import (BBOX_STDS, assign_anchor_targets, decode_boxes,
+from rcnn_common import (BboxNorm, assign_anchor_targets, decode_boxes,
                          nms, sample_roi_targets)
 
 IMG = 64
@@ -150,13 +150,14 @@ def head_losses(scores, preds, lab_nd, d_nd, w_nd, n_roi):
     return cls_loss, bbox_loss
 
 
-def sample_head_batch(props, gts, rng):
+def sample_head_batch(props, gts, rng, norm=None):
     """Sample fixed-size roi batches for every image; returns device
     arrays (rois with batch index column, labels, deltas, weights)."""
     rois, labels, bdeltas, bweights = [], [], [], []
     for i, p in enumerate(props):
         r, l, d, w = sample_roi_targets(
-            p, gts[i], len(CLASSES), rois_per_image=ROIS_PER_IMG, rng=rng)
+            p, gts[i], len(CLASSES), rois_per_image=ROIS_PER_IMG, rng=rng,
+            norm=norm)
         rois.append(np.concatenate(
             [np.full((len(r), 1), i, np.float32), r], 1))
         labels.append(l)
@@ -168,17 +169,26 @@ def sample_head_batch(props, gts, rng):
             nd.array(np.concatenate(bweights)))
 
 
-def train_step(net, trainer, imgs, gts, anchors, im_info, rng):
+def train_step(net, trainer, imgs, gts, anchors, im_info, rng, norm=None,
+               im_infos=None):
     """One approximate-joint step: RPN losses + proposal sampling +
-    head losses, single backward (reference train_end2end.py)."""
+    head losses, single backward (reference train_end2end.py).
+
+    ``norm`` is a BboxNorm for per-class target normalization;
+    ``im_infos`` (B, 3) host rows [h, w, scale] bound the anchor-inside
+    test and the Proposal clip per image (padded/multi-scale inputs) —
+    without it every image is a full IMG square."""
     B = len(gts)
     lab = np.zeros((B, N_ANCHOR), np.float32)
     tgt = np.zeros((B, N_ANCHOR, 4), np.float32)
     wgt = np.zeros((B, N_ANCHOR, 1), np.float32)
     for i, g in enumerate(gts):
         lab[i], tgt[i], wgt[i] = assign_anchor_targets(
-            anchors, g, IMG, rpn_batch=RPN_BATCH, rng=rng)
+            anchors, g, IMG, rpn_batch=RPN_BATCH, rng=rng,
+            im_info=None if im_infos is None else im_infos[i])
     x = nd.array(imgs)
+    info_nd = (im_info if im_infos is None
+               else nd.array(np.asarray(im_infos, np.float32)))
 
     with mx.autograd.record():
         feat, logits, deltas, cls_map, bbox_map = net.rpn_forward(x)
@@ -188,9 +198,12 @@ def train_step(net, trainer, imgs, gts, anchors, im_info, rng):
         with mx.autograd.pause():
             cls_prob = proposal_cls_prob(cls_map.detach())
             bmap = bbox_map.detach()
-            props = [gen_proposals(cls_prob, bmap, i, im_info)
-                     for i in range(B)]
-        rois_nd, lab_nd, d_nd, w_nd = sample_head_batch(props, gts, rng)
+            props = [gen_proposals(
+                cls_prob, bmap, i,
+                info_nd if im_infos is None else info_nd[i:i + 1])
+                for i in range(B)]
+        rois_nd, lab_nd, d_nd, w_nd = sample_head_batch(props, gts, rng,
+                                                        norm=norm)
         scores, preds = net.head_forward(feat, rois_nd)
         rcnn_cls_loss, rcnn_bbox_loss = head_losses(
             scores, preds, lab_nd, d_nd, w_nd, B * ROIS_PER_IMG)
@@ -203,9 +216,52 @@ def train_step(net, trainer, imgs, gts, anchors, im_info, rng):
                   rcnn_bbox_loss))
 
 
-def detect(net, img, im_info, score_thresh=0.05, nms_thresh=0.3):
+def prepare_image(img):
+    """Scale an arbitrary (C, H, W) image onto the network's IMG square.
+
+    Returns (padded (C, IMG, IMG), im_info row [scaled_h, scaled_w,
+    scale]) — the reference tester's resize-to-target-scale + im_info
+    contract (rcnn/core/tester.py im_detect): boxes predicted in the
+    scaled frame map back to source coords by 1/scale."""
+    c, h, w = img.shape
+    scale = IMG / max(h, w)
+    sh, sw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
+    ys = (np.arange(sh) / scale).astype(int).clip(0, h - 1)
+    xs = (np.arange(sw) / scale).astype(int).clip(0, w - 1)
+    out = np.zeros((c, IMG, IMG), img.dtype)
+    out[:, :sh, :sw] = img[:, ys][:, :, xs]
+    return out, np.array([sh, sw, scale], np.float32)
+
+
+def detect(net, img, im_info=None, score_thresh=0.05, nms_thresh=0.3,
+           norm=None):
     """Full two-stage inference for one image; rows
-    [cls, score, x1,y1,x2,y2] (reference rcnn/core/tester.py)."""
+    [cls, score, x1,y1,x2,y2] in the SOURCE image's coordinates
+    (reference rcnn/core/tester.py im_detect + pred boxes /= scale).
+
+    Any (C, H, W) input works: non-IMG images are scaled/padded through
+    prepare_image and the Proposal clip + final box mapping honor the
+    resulting im_info. ``norm`` de-normalizes per-class bbox predictions
+    (defaults to the fixed BBOX_STDS constants)."""
+    norm = norm or BboxNorm(len(CLASSES))
+    if im_info is None:
+        _, src_h, src_w = img.shape
+        if (src_h, src_w) != (IMG, IMG):
+            img, info_row = prepare_image(img)
+        else:
+            info_row = np.array([IMG, IMG, 1.0], np.float32)
+        im_info = nd.array(info_row[None])
+        scale = float(info_row[2])
+    else:
+        # explicit im_info: img is the PREPARED (scaled/padded) input,
+        # so the source extent comes from im_info, not from img.shape
+        info_row = np.asarray(
+            im_info.asnumpy() if hasattr(im_info, "asnumpy")
+            else im_info, np.float32).reshape(-1)[:3]
+        im_info = nd.array(info_row[None])
+        scale = float(info_row[2])
+        src_h = int(round(float(info_row[0]) / scale))
+        src_w = int(round(float(info_row[1]) / scale))
     x = nd.array(img[None])
     feat, _, _, cls_map, bbox_map = net.rpn_forward(x)
     cls_prob = proposal_cls_prob(cls_map)
@@ -221,8 +277,13 @@ def detect(net, img, im_info, score_thresh=0.05, nms_thresh=0.3):
         keep = sc >= score_thresh
         if not keep.any():
             continue
-        boxes = decode_boxes(rois[keep],
-                             preds[keep, 4 * c:4 * c + 4] * BBOX_STDS, IMG)
+        boxes = decode_boxes(
+            rois[keep], norm.denormalize(c, preds[keep, 4 * c:4 * c + 4]),
+            IMG)
+        # back to source coordinates, clipped to the source extent
+        boxes = boxes / scale
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, src_w - 1)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, src_h - 1)
         kept = nms(boxes, sc[keep], nms_thresh)
         dets.extend([c - 1, float(sc[keep][k])] + boxes[k].tolist()
                     for k in kept)

@@ -17,6 +17,100 @@ import numpy as np
 BBOX_STDS = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
 
 
+class BboxNorm:
+    """Per-class bbox-target normalization (reference:
+    rcnn/processing/bbox_regression.py add_bbox_regression_targets —
+    the BBOX_NORMALIZATION_PRECOMPUTED=False branch computes per-class
+    means/stds over the roidb's regression targets; here the same
+    statistics with (C+1, 4) tables, class 0 = background unused).
+
+    The default (means=0, stds=BBOX_STDS broadcast) reproduces the
+    fixed-constant normalization every caller used before."""
+
+    def __init__(self, num_classes, means=None, stds=None):
+        nc1 = num_classes + 1
+        self.means = (np.zeros((nc1, 4), np.float32) if means is None
+                      else np.asarray(means, np.float32).reshape(nc1, 4))
+        self.stds = (np.tile(BBOX_STDS, (nc1, 1)) if stds is None
+                     else np.asarray(stds, np.float32).reshape(nc1, 4))
+
+    def normalize(self, cls, delta):
+        return (delta - self.means[cls]) / self.stds[cls]
+
+    def denormalize(self, cls, delta):
+        return delta * self.stds[cls] + self.means[cls]
+
+    def save(self, npz_file):
+        np.savez(npz_file, means=self.means, stds=self.stds)
+
+    @classmethod
+    def load(cls, npz_file):
+        with np.load(npz_file) as z:
+            self = cls.__new__(cls)
+            self.means = z["means"].astype(np.float32)
+            self.stds = z["stds"].astype(np.float32)
+            return self
+
+
+def norm_for_checkpoint(params_path, num_classes):
+    """The BboxNorm a params checkpoint was trained with.
+
+    train_rcnn.py writes ``<prefix>-NNNN.params`` + ``<prefix>.norm.npz``;
+    this resolves the sibling npz (also accepts ``<path>.norm.npz`` next
+    to an arbitrary ``<path>.params``) and falls back to the fixed
+    BBOX_STDS constants when none exists — so consumers de-normalize
+    with the SAME statistics the head was trained against."""
+    import os
+    import re
+    base = re.sub(r"-\d+\.params$", "", params_path)
+    if base == params_path:
+        base = re.sub(r"\.params$", "", params_path)
+    cand = base + ".norm.npz"
+    if os.path.exists(cand):
+        return BboxNorm.load(cand), cand
+    return BboxNorm(num_classes), None
+
+
+def estimate_bbox_stats(db, num_classes, n_images=64, jitter=0.15,
+                        samples_per_gt=8, rng=None):
+    """Per-class regression-target statistics from a dataset.
+
+    The reference computes them over the roidb's precomputed proposals
+    (selective search); this environment has none, so the proposal
+    distribution is simulated by jittering each gt box (uniform +-jitter
+    of its size in position and log-scale) — the same near-gt population
+    the RCNN head trains on. Returns a BboxNorm."""
+    rng = rng or np.random.RandomState(0)
+    sums = np.zeros((num_classes + 1, 4), np.float64)
+    sqs = np.zeros((num_classes + 1, 4), np.float64)
+    cnt = np.zeros(num_classes + 1, np.int64)
+    for i in range(min(n_images, len(db))):
+        _, gt = db.sample(i)
+        for g in gt:
+            cls = int(g[0]) + 1
+            box = g[1:5]
+            w = box[2] - box[0] + 1.0
+            h = box[3] - box[1] + 1.0
+            for _ in range(samples_per_gt):
+                dx, dy = rng.uniform(-jitter, jitter, 2) * (w, h)
+                sw, sh = np.exp(rng.uniform(-jitter, jitter, 2))
+                prop = np.array([box[0] + dx, box[1] + dy,
+                                 box[0] + dx + w * sw - 1,
+                                 box[1] + dy + h * sh - 1], np.float32)
+                d = encode_boxes(prop[None], box[None])[0]
+                sums[cls] += d
+                sqs[cls] += d * d
+                cnt[cls] += 1
+    means = np.zeros((num_classes + 1, 4), np.float32)
+    stds = np.tile(BBOX_STDS, (num_classes + 1, 1))
+    seen = cnt > 0
+    means[seen] = (sums[seen] / cnt[seen, None]).astype(np.float32)
+    var = np.zeros_like(sqs)
+    var[seen] = sqs[seen] / cnt[seen, None] - means[seen] ** 2
+    stds[seen] = np.sqrt(np.maximum(var[seen], 1e-8)).astype(np.float32)
+    return BboxNorm(num_classes, means, stds)
+
+
 def make_anchor_grid(feat_h, feat_w, stride, scales, ratios):
     """Anchor array in (y, x, a) order — the Proposal op's layout.
 
@@ -76,20 +170,25 @@ def decode_boxes(ref, deltas, im_size):
 
 def assign_anchor_targets(anchors, gt, im_size, rpn_batch=64,
                           fg_fraction=0.5, fg_thresh=0.6, bg_thresh=0.3,
-                          rng=None):
+                          rng=None, im_info=None):
     """RPN training targets for one image.
 
     Returns labels (N,) in {-1 ignore, 0 bg, 1 fg}, deltas (N,4),
     weights (N,1). Every gt claims its best anchor even below
-    fg_thresh, so no object goes untrained.
+    fg_thresh, so no object goes untrained. ``im_info`` = (h, w[, scale])
+    bounds the anchors-inside test to the VALID image extent when the
+    input is a padded rectangle (reference rpn.py assign_anchor uses
+    im_info the same way); without it the square im_size bounds apply.
     """
     rng = rng or np.random
     n = len(anchors)
     labels = np.full(n, -1.0, np.float32)
     deltas = np.zeros((n, 4), np.float32)
     weights = np.zeros((n, 1), np.float32)
+    h_lim, w_lim = ((float(im_info[0]), float(im_info[1]))
+                    if im_info is not None else (im_size, im_size))
     inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0)
-              & (anchors[:, 2] < im_size) & (anchors[:, 3] < im_size))
+              & (anchors[:, 2] < w_lim) & (anchors[:, 3] < h_lim))
     if len(gt) == 0:
         bg = np.flatnonzero(inside)
         take = rng.choice(bg, min(rpn_batch, len(bg)), replace=False)
@@ -120,15 +219,18 @@ def assign_anchor_targets(anchors, gt, im_size, rpn_batch=64,
 
 
 def sample_roi_targets(rois, gt, num_classes, rois_per_image=16,
-                       fg_fraction=0.5, fg_thresh=0.5, rng=None):
+                       fg_fraction=0.5, fg_thresh=0.5, rng=None,
+                       norm=None):
     """Sample a fixed-size roi batch for the RCNN head, one image.
 
     rois (P,4) proposals (gt boxes get appended), gt (G,5) [cls,box].
     Returns rois (R,4), labels (R,) in [0..num_classes] (0=bg),
-    per-class deltas (R, 4*(C+1)) std-normalized, weights same shape.
+    per-class deltas (R, 4*(C+1)) normalized by ``norm`` (a BboxNorm;
+    default = the fixed BBOX_STDS constants), weights same shape.
     """
     rng = rng or np.random
     nc1 = num_classes + 1
+    norm = norm or BboxNorm(num_classes)
     if len(gt):
         rois = np.concatenate([rois, gt[:, 1:5]], 0)
     iou = iou_matrix(rois, gt[:, 1:5] if len(gt) else gt[:, :4])
@@ -162,7 +264,8 @@ def sample_roi_targets(rois, gt, num_classes, rois_per_image=16,
         g = gt[best_gt[keep[i]]]
         cls = int(g[0]) + 1
         labels[i] = cls
-        d = encode_boxes(out_rois[i:i + 1], g[None, 1:5])[0] / BBOX_STDS
+        d = norm.normalize(
+            cls, encode_boxes(out_rois[i:i + 1], g[None, 1:5])[0])
         deltas[i, 4 * cls:4 * cls + 4] = d
         weights[i, 4 * cls:4 * cls + 4] = 1.0
     return out_rois, labels, deltas, weights

@@ -8,10 +8,14 @@ pascal_voc eval). Same multi-stage pipeline, split over this package:
 
   dataset.py     — imdb abstraction, VOC-XML reader, synthetic scenes;
   loader.py      — AnchorLoader DataIter (host anchor targets);
-  model.py       — backbone/RPN/head blocks + joint train_step/detect;
-  rcnn_common.py — target assignment + box math (host numpy);
+  model.py       — backbone/RPN/head blocks + joint train_step/detect
+                   (+ prepare_image: the scale/im_info contract);
+  rcnn_common.py — target assignment, box math, BboxNorm per-class
+                   bbox-target statistics (bbox_regression.py analogue);
   eval.py        — per-class AP table, proposal recall;
-  this script    — the approximate-joint driver + mAP gate;
+  this script    — the approximate-joint system driver: per-class bbox
+                   normalization, epoch checkpoints, lr schedule,
+                   multi-scale im_info-aware evaluation, mAP gate;
   train_alternate.py — the 4-stage alternating schedule;
   demo.py        — checkpoint load + ASCII visualisation.
 
@@ -22,6 +26,7 @@ every traced program has static shapes and caches once.
 
 Run:  python train_rcnn.py             (converges in ~2 min on CPU)
       python train_rcnn.py --epochs 10 --map-gate 0.6
+      python train_rcnn.py --eval-scales 64,96   # multi-scale eval
 """
 import argparse
 import os
@@ -37,7 +42,23 @@ from dataset import SyntheticShapes  # noqa: E402
 from eval import evaluate_detections  # noqa: E402
 from model import (CLASSES, FEAT, IMG, RATIOS, SCALES, STRIDE, RCNN,  # noqa: E402
                    default_im_info, detect, train_step)
-from rcnn_common import make_anchor_grid  # noqa: E402
+from rcnn_common import (BboxNorm, estimate_bbox_stats,  # noqa: E402
+                         make_anchor_grid, norm_for_checkpoint)
+
+
+def evaluate(net, norm, scales, n_scenes):
+    """im_info-aware evaluation: each scale renders scenes at that size;
+    detect() rescales through prepare_image and maps boxes back to
+    source coords, so gt comparison happens in the source frame (the
+    reference tester's contract)."""
+    results = {}
+    for scale in scales:
+        val = SyntheticShapes(n_scenes, im_size=scale, seed=999)
+        samples = [val.sample(i) for i in range(len(val))]
+        dets = [detect(net, img, norm=norm) for img, _ in samples]
+        gts = [gt.tolist() for _, gt in samples]
+        results[scale] = evaluate_detections(dets, gts, CLASSES)
+    return results
 
 
 def main():
@@ -47,15 +68,52 @@ def main():
     ap.add_argument("--batch-size", type=int, default=4)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--eval-scenes", type=int, default=48)
+    ap.add_argument("--eval-scales", default=str(IMG),
+                    help="comma list of scene sizes to evaluate at; "
+                    "non-native sizes exercise the im_info scale path")
     ap.add_argument("--map-gate", type=float, default=0.5)
+    ap.add_argument("--no-bbox-norm", action="store_true",
+                    help="use the fixed BBOX_STDS constants instead of "
+                    "per-class statistics")
+    ap.add_argument("--save-prefix", default=None,
+                    help="write <prefix>-NNNN.params + <prefix>.norm.npz "
+                    "each epoch")
+    ap.add_argument("--resume", default=None,
+                    help="params checkpoint to continue from")
     args = ap.parse_args()
 
     mx.random.seed(7)
     net = RCNN()
+    if args.resume:
+        net.load_params(args.resume)
+        print(f"resumed from {args.resume}")
     trainer = mx.gluon.Trainer(net.params(), "sgd",
                                {"learning_rate": args.lr, "momentum": 0.9})
     anchors = make_anchor_grid(FEAT, FEAT, STRIDE, SCALES, RATIOS)
     im_info = default_im_info()
+
+    # per-class bbox-target statistics from the training distribution
+    # (reference bbox_regression.add_bbox_regression_targets); a resumed
+    # run reuses the checkpoint's saved statistics — estimating fresh
+    # ones would silently diverge from what the head was trained against
+    resumed_norm = None
+    if args.resume:
+        resumed_norm, norm_path = norm_for_checkpoint(args.resume,
+                                                      len(CLASSES))
+        if norm_path:
+            print(f"resumed bbox norm from {norm_path}")
+        else:
+            resumed_norm = None
+    if resumed_norm is not None:
+        norm = resumed_norm
+    elif args.no_bbox_norm:
+        norm = BboxNorm(len(CLASSES))
+    else:
+        stats_db = SyntheticShapes(64, im_size=IMG, seed=555)
+        norm = estimate_bbox_stats(stats_db, len(CLASSES),
+                                   rng=np.random.RandomState(5))
+        print("per-class bbox stds:",
+              np.round(norm.stds[1:], 3).tolist())
 
     for epoch in range(args.epochs):
         if epoch == args.epochs * 2 // 3:
@@ -69,20 +127,24 @@ def main():
         n_batches = 0
         for imgs, gts in db.batches(args.batch_size, rng):
             sums += train_step(net, trainer, imgs, gts, anchors, im_info,
-                               rng)
+                               rng, norm=norm)
             n_batches += 1
         sums /= n_batches
         speed = n_batches * args.batch_size / (time.time() - tic)
         print(f"epoch {epoch} rpn-cls {sums[0]:.3f} rpn-box {sums[1]:.3f} "
               f"rcnn-cls {sums[2]:.3f} rcnn-box {sums[3]:.3f} "
               f"({speed:.1f} img/s)")
+        if args.save_prefix:
+            net.save_params(f"{args.save_prefix}-{epoch:04d}.params")
+            norm.save(f"{args.save_prefix}.norm.npz")
 
-    val = SyntheticShapes(args.eval_scenes, im_size=IMG, seed=999)
-    samples = [val.sample(i) for i in range(len(val))]
-    all_dets = [detect(net, img, im_info) for img, _ in samples]
-    all_gts = [gt.tolist() for _, gt in samples]
-    m = evaluate_detections(all_dets, all_gts, CLASSES)
-    print(f"mAP@0.5 = {m:.3f} over {args.eval_scenes} held-out scenes")
+    scales = [int(s) for s in args.eval_scales.split(",")]
+    results = evaluate(net, norm, scales, args.eval_scenes)
+    for scale, m in results.items():
+        tag = "" if scale == IMG else " (via im_info scale path)"
+        print(f"mAP@0.5 = {m:.3f} at scene size {scale}{tag} "
+              f"over {args.eval_scenes} held-out scenes")
+    m = results[scales[0]]
     assert m >= args.map_gate, f"mAP {m:.3f} below gate {args.map_gate}"
 
 

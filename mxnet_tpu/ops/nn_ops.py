@@ -315,9 +315,18 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape[axis] = data.shape[axis]
 
     if _is_train and not use_global_stats:
+        # one-pass sufficient statistics: sum and sum-of-squares reduce in
+        # a single multi-output fusion (one HBM read of the activation),
+        # where mean-then-var would read it twice; accumulation is fp32
+        # regardless of the compute dtype so bf16 activations lose nothing
         x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=reduce_axes)
-        var = jnp.var(x32, axis=reduce_axes)
+        n = 1
+        for i in reduce_axes:
+            n *= data.shape[i]
+        s1 = jnp.sum(x32, axis=reduce_axes)
+        s2 = jnp.sum(lax.square(x32), axis=reduce_axes)
+        mean = s1 / n
+        var = jnp.maximum(s2 / n - lax.square(mean), 0.0)
         new_mean = momentum * moving_mean + (1 - momentum) * mean
         new_var = momentum * moving_var + (1 - momentum) * var
     else:

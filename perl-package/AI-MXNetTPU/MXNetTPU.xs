@@ -188,6 +188,17 @@ mxp_invoke(opname, ins_av, keys_av, vals_av)
     RETVAL
 
 IV
+mxp_sym_get_output(h, index)
+    IV h
+    IV index
+  CODE:
+    SymbolHandle out;
+    ck(aTHX_ MXSymbolGetOutput((SymbolHandle)h, (mx_uint)index, &out));
+    RETVAL = (IV)out;
+  OUTPUT:
+    RETVAL
+
+IV
 mxp_sym_variable(name)
     const char *name
   CODE:

@@ -24,9 +24,16 @@ use AI::MXNetTPU::Symbol;
 use AI::MXNetTPU::Executor;
 use AI::MXNetTPU::KVStore;
 use AI::MXNetTPU::Module;
+use AI::MXNetTPU::Module::Bucketing;
 use AI::MXNetTPU::IO;
 use AI::MXNetTPU::AutoGrad;
 use AI::MXNetTPU::CachedOp;
+use AI::MXNetTPU::Optimizer;
+use AI::MXNetTPU::Initializer;
+use AI::MXNetTPU::Metric;
+use AI::MXNetTPU::Callback;
+use AI::MXNetTPU::LRScheduler;
+use AI::MXNetTPU::RNN;
 
 sub version { AI::MXNetTPU::mxp_version() }
 sub seed    { AI::MXNetTPU::mxp_random_seed($_[1] // $_[0]) }
@@ -38,5 +45,10 @@ sub mod { 'AI::MXNetTPU::Module' }
 sub kv  { 'AI::MXNetTPU::KVStore' }
 sub io  { 'AI::MXNetTPU::IO' }
 sub autograd { 'AI::MXNetTPU::AutoGrad' }
+sub optimizer { 'AI::MXNetTPU::Optimizer' }
+sub init      { 'AI::MXNetTPU::Initializer' }
+sub metric    { 'AI::MXNetTPU::Metric' }
+sub callback  { 'AI::MXNetTPU::Callback' }
+sub rnn       { 'AI::MXNetTPU::RNN' }
 
 1;

@@ -1,0 +1,65 @@
+package AI::MXNetTPU::Callback;
+
+# Training callbacks (reference: AI::MXNet::Callback,
+# perl-package/AI-MXNet/lib/AI/MXNet/Callback.pm). A callback is a code
+# ref called with a param hash { epoch, nbatch, eval_metric } at batch
+# (or epoch) boundaries; these constructors return such refs.
+
+use strict;
+use warnings;
+use Time::HiRes qw(time);
+
+# Speedometer(batch_size, frequent): logs samples/sec (+ metric) every
+# `frequent` batches — the reference's training heartbeat.
+sub Speedometer {
+    my ($class, $batch_size, $frequent) = @_;
+    $frequent //= 50;
+    my ($init, $tic, $last) = (0, 0, 0);
+    sub {
+        my (%p) = @_;
+        my $count = $p{nbatch};
+        if ($init) {
+            if (($count - $last) >= $frequent) {
+                my $speed = ($count - $last) * $batch_size
+                    / (time() - $tic);
+                my $msg = sprintf("Epoch[%d] Batch [%d]\tSpeed: %.2f "
+                                  . "samples/sec", $p{epoch}, $count,
+                                  $speed);
+                if ($p{eval_metric}) {
+                    my ($n, $v) = $p{eval_metric}->get;
+                    $msg .= sprintf("\tTrain-%s=%f", $n, $v);
+                }
+                print "$msg\n";
+                ($tic, $last) = (time(), $count);
+            }
+        } else {
+            ($init, $tic, $last) = (1, time(), $count);
+        }
+    };
+}
+
+# ProgressBar(total): prints a bar each epoch end
+sub ProgressBar {
+    my ($class, $total, $length) = @_;
+    $length //= 40;
+    sub {
+        my (%p) = @_;
+        my $filled = int($length * ($p{nbatch} + 1) / $total);
+        $filled = $length if $filled > $length;
+        print '[' . ('=' x $filled) . ('.' x ($length - $filled))
+            . "]\r";
+    };
+}
+
+# LogValidationMetricsCallback: epoch-end validation metric lines
+sub LogValidationMetricsCallback {
+    my ($class) = @_;
+    sub {
+        my (%p) = @_;
+        return unless $p{eval_metric};
+        my ($n, $v) = $p{eval_metric}->get;
+        printf("Epoch[%d] Validation-%s=%f\n", $p{epoch}, $n, $v);
+    };
+}
+
+1;

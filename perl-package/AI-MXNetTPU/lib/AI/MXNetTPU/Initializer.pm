@@ -1,0 +1,131 @@
+package AI::MXNetTPU::Initializer;
+
+# Parameter initializers (reference: AI::MXNet::Initializer,
+# perl-package/AI-MXNet/lib/AI/MXNet/Initializer.pm). The base class owns
+# the name-pattern dispatch the reference uses: *_bias / *_beta ->
+# zeros, *_gamma / *_moving_var -> ones, *_moving_mean -> zeros,
+# everything else -> the subclass's _init_weight.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+sub new { bless { %{ $_[1] // {} } }, $_[0] }
+
+sub call {
+    my ($self, $name, $arr) = @_;
+    if ($name =~ /(?:_bias|_beta|_moving_mean)$/) {
+        $arr->set([(0) x $arr->size]);
+    } elsif ($name =~ /(?:_gamma|_moving_var)$/) {
+        $arr->set([(1) x $arr->size]);
+    } else {
+        $self->_init_weight($name, $arr);
+    }
+    $arr;
+}
+
+sub _init_weight { croak "subclasses implement _init_weight" }
+
+sub _fans {
+    my ($shape) = @_;
+    my $spatial = 1;
+    $spatial *= $shape->[$_] for 2 .. $#$shape;
+    my $fan_out = $shape->[0] * $spatial;
+    my $fan_in = (@$shape > 1 ? $shape->[1] : $shape->[0]) * $spatial;
+    ($fan_in, $fan_out);
+}
+
+package AI::MXNetTPU::Initializer::Uniform;
+
+our @ISA = ('AI::MXNetTPU::Initializer');
+
+sub new {
+    my ($class, %kw) = @_;
+    bless { scale => $kw{scale} // 0.07 }, $class;
+}
+
+sub _init_weight {
+    my ($self, $name, $arr) = @_;
+    my $s = $self->{scale};
+    $arr->set([map { rand(2 * $s) - $s } 1 .. $arr->size]);
+}
+
+package AI::MXNetTPU::Initializer::Normal;
+
+our @ISA = ('AI::MXNetTPU::Initializer');
+
+sub new {
+    my ($class, %kw) = @_;
+    bless { sigma => $kw{sigma} // 0.01 }, $class;
+}
+
+sub _gauss {
+    # Box-Muller
+    my $u1 = rand() || 1e-12;
+    my $u2 = rand();
+    sqrt(-2 * log($u1)) * cos(2 * 3.14159265358979 * $u2);
+}
+
+sub _init_weight {
+    my ($self, $name, $arr) = @_;
+    my $s = $self->{sigma};
+    $arr->set([map { $s * _gauss() } 1 .. $arr->size]);
+}
+
+package AI::MXNetTPU::Initializer::Xavier;
+
+our @ISA = ('AI::MXNetTPU::Initializer');
+use Carp qw(croak);
+
+sub new {
+    my ($class, %kw) = @_;
+    bless {
+        rnd_type    => $kw{rnd_type} // 'uniform',
+        factor_type => $kw{factor_type} // 'avg',
+        magnitude   => $kw{magnitude} // 3,
+    }, $class;
+}
+
+sub _init_weight {
+    my ($self, $name, $arr) = @_;
+    my ($fan_in, $fan_out) =
+        AI::MXNetTPU::Initializer::_fans($arr->shape);
+    my %denom = (avg => ($fan_in + $fan_out) / 2,
+                 in => $fan_in, out => $fan_out);
+    my $d = $denom{ $self->{factor_type} }
+        or croak "factor_type must be avg/in/out";
+    my $scale = sqrt($self->{magnitude} / $d);
+    if ($self->{rnd_type} eq 'uniform') {
+        $arr->set([map { rand(2 * $scale) - $scale } 1 .. $arr->size]);
+    } else {
+        $arr->set([map { $scale
+            * AI::MXNetTPU::Initializer::Normal::_gauss() }
+            1 .. $arr->size]);
+    }
+}
+
+package AI::MXNetTPU::Initializer::Zero;
+
+our @ISA = ('AI::MXNetTPU::Initializer');
+sub _init_weight { $_[2]->set([(0) x $_[2]->size]) }
+
+package AI::MXNetTPU::Initializer::One;
+
+our @ISA = ('AI::MXNetTPU::Initializer');
+sub _init_weight { $_[2]->set([(1) x $_[2]->size]) }
+
+package AI::MXNetTPU::Initializer::Constant;
+
+our @ISA = ('AI::MXNetTPU::Initializer');
+
+sub new {
+    my ($class, %kw) = @_;
+    bless { value => $kw{value} // 0 }, $class;
+}
+
+sub _init_weight {
+    my ($self, $name, $arr) = @_;
+    $arr->set([($self->{value}) x $arr->size]);
+}
+
+1;

@@ -1,0 +1,65 @@
+package AI::MXNetTPU::LRScheduler;
+
+# Learning-rate schedules (reference: AI::MXNet::LRScheduler,
+# perl-package/AI-MXNet/lib/AI/MXNet/LRScheduler.pm). An optimizer with a
+# scheduler asks it for the lr at every update count.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+sub new {
+    my ($class, %kw) = @_;
+    bless { base_lr => $kw{base_lr} // 0.01 }, $class;
+}
+
+sub base_lr { my $s = shift; $s->{base_lr} = shift if @_; $s->{base_lr} }
+
+sub call { croak "subclasses implement call(num_update)" }
+
+package AI::MXNetTPU::LRScheduler::FactorScheduler;
+
+# lr = base_lr * factor ** floor(num_update / step)
+our @ISA = ('AI::MXNetTPU::LRScheduler');
+use Carp qw(croak);
+
+sub new {
+    my ($class, %kw) = @_;
+    my $self = AI::MXNetTPU::LRScheduler::new($class, %kw);
+    croak "step must be >= 1" unless ($kw{step} // 1) >= 1;
+    $self->{step}   = $kw{step} // 1;
+    $self->{factor} = $kw{factor} // 1;
+    $self->{stop_factor_lr} = $kw{stop_factor_lr} // 1e-8;
+    $self;
+}
+
+sub call {
+    my ($self, $num_update) = @_;
+    my $lr = $self->{base_lr}
+        * $self->{factor} ** int($num_update / $self->{step});
+    $lr < $self->{stop_factor_lr} ? $self->{stop_factor_lr} : $lr;
+}
+
+package AI::MXNetTPU::LRScheduler::MultiFactorScheduler;
+
+# lr drops by factor at each listed step boundary
+our @ISA = ('AI::MXNetTPU::LRScheduler');
+
+sub new {
+    my ($class, %kw) = @_;
+    my $self = AI::MXNetTPU::LRScheduler::new($class, %kw);
+    $self->{steps}  = $kw{step} // [];
+    $self->{factor} = $kw{factor} // 1;
+    $self;
+}
+
+sub call {
+    my ($self, $num_update) = @_;
+    my $lr = $self->{base_lr};
+    for my $s (@{ $self->{steps} }) {
+        $lr *= $self->{factor} if $num_update >= $s;
+    }
+    $lr;
+}
+
+1;

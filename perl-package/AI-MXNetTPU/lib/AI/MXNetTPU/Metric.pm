@@ -1,0 +1,152 @@
+package AI::MXNetTPU::Metric;
+
+# Evaluation metrics (reference: AI::MXNet::Metric,
+# perl-package/AI-MXNet/lib/AI/MXNet/Metric.pm). update() takes perl
+# arrays of labels and flat prediction rows (NDArray->values output) so
+# metrics run on whatever the executor returns, host-side.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+my %REGISTRY;
+
+sub register { $REGISTRY{ lc $_[0] } = $_[1] }
+
+sub create {
+    my ($class, $name, %kw) = @_;
+    my $impl = $REGISTRY{ lc $name }
+        or croak "unknown metric '$name' (have: "
+        . join(', ', sort keys %REGISTRY) . ")";
+    $impl->new(%kw);
+}
+
+sub new {
+    my ($class, %kw) = @_;
+    bless { name => $kw{name} // lc((split /::/, $class)[-1]),
+            sum => 0, count => 0 }, $class;
+}
+
+sub reset { my $s = shift; @$s{qw(sum count)} = (0, 0); $s }
+
+sub get {
+    my ($self) = @_;
+    ($self->{name}, $self->{count} ? $self->{sum} / $self->{count} : 'nan');
+}
+
+sub update { croak "subclasses implement update(labels, preds)" }
+
+sub _rows {
+    # flat prediction vector + label count -> row width
+    my ($preds, $n) = @_;
+    croak "empty label batch" unless $n;
+    my $w = @$preds / $n;
+    croak "preds not divisible by labels" if $w != int($w);
+    $w;
+}
+
+package AI::MXNetTPU::Metric::Accuracy;
+
+our @ISA = ('AI::MXNetTPU::Metric');
+
+sub update {
+    my ($self, $labels, $preds) = @_;
+    my $w = AI::MXNetTPU::Metric::_rows($preds, scalar @$labels);
+    for my $r (0 .. $#$labels) {
+        my ($best, $bi) = (-9e99, 0);
+        for my $c (0 .. $w - 1) {
+            ($best, $bi) = ($preds->[$r * $w + $c], $c)
+                if $preds->[$r * $w + $c] > $best;
+        }
+        ++$self->{sum} if $bi == $labels->[$r];
+        ++$self->{count};
+    }
+    $self;
+}
+
+AI::MXNetTPU::Metric::register('accuracy', __PACKAGE__);
+AI::MXNetTPU::Metric::register('acc', __PACKAGE__);
+
+package AI::MXNetTPU::Metric::TopKAccuracy;
+
+our @ISA = ('AI::MXNetTPU::Metric');
+
+sub new {
+    my ($class, %kw) = @_;
+    my $self = AI::MXNetTPU::Metric::new($class, %kw);
+    $self->{top_k} = $kw{top_k} // 5;
+    $self->{name} = "top_k_accuracy_$self->{top_k}";
+    $self;
+}
+
+sub update {
+    my ($self, $labels, $preds) = @_;
+    my $w = AI::MXNetTPU::Metric::_rows($preds, scalar @$labels);
+    for my $r (0 .. $#$labels) {
+        my @order = sort { $preds->[$r * $w + $b] <=> $preds->[$r * $w + $a] }
+            0 .. $w - 1;
+        my %top = map { $_ => 1 } @order[0 .. $self->{top_k} - 1];
+        ++$self->{sum} if $top{ $labels->[$r] };
+        ++$self->{count};
+    }
+    $self;
+}
+
+AI::MXNetTPU::Metric::register('top_k_accuracy', __PACKAGE__);
+
+package AI::MXNetTPU::Metric::MSE;
+
+our @ISA = ('AI::MXNetTPU::Metric');
+
+sub update {
+    my ($self, $labels, $preds) = @_;
+    for my $i (0 .. $#$labels) {
+        my $d = $preds->[$i] - $labels->[$i];
+        $self->{sum} += $d * $d;
+        ++$self->{count};
+    }
+    $self;
+}
+
+AI::MXNetTPU::Metric::register('mse', __PACKAGE__);
+
+package AI::MXNetTPU::Metric::CrossEntropy;
+
+our @ISA = ('AI::MXNetTPU::Metric');
+
+sub update {
+    my ($self, $labels, $preds) = @_;
+    my $w = AI::MXNetTPU::Metric::_rows($preds, scalar @$labels);
+    for my $r (0 .. $#$labels) {
+        my $p = $preds->[$r * $w + $labels->[$r]];
+        $p = 1e-12 if $p < 1e-12;
+        $self->{sum} -= log($p);
+        ++$self->{count};
+    }
+    $self;
+}
+
+AI::MXNetTPU::Metric::register('ce', __PACKAGE__);
+AI::MXNetTPU::Metric::register('cross-entropy', __PACKAGE__);
+
+package AI::MXNetTPU::Metric::Perplexity;
+
+# exp(mean CE) — the RNN/LM metric (reference Metric.pm Perplexity)
+our @ISA = ('AI::MXNetTPU::Metric::CrossEntropy');
+
+sub new {
+    my ($class, %kw) = @_;
+    my $self = AI::MXNetTPU::Metric::new($class, %kw);
+    $self->{name} = 'perplexity';
+    $self;
+}
+
+sub get {
+    my ($self) = @_;
+    ('perplexity', $self->{count}
+        ? exp($self->{sum} / $self->{count}) : 'nan');
+}
+
+AI::MXNetTPU::Metric::register('perplexity', __PACKAGE__);
+
+1;

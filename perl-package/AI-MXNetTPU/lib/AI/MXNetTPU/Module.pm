@@ -58,17 +58,35 @@ sub bind {
 
 sub init_params {
     my ($self, %kw) = @_;
-    my $scale = $kw{scale} // 0.07;
     srand($kw{seed} // 0);
-    for my $n (@{ $self->{param_names} }) {
-        my $a = $self->{arrays}{$n};
-        $a->set([map { rand(2 * $scale) - $scale } 1 .. $a->size]);
+    if (my $init = $kw{initializer}) {
+        # an AI::MXNetTPU::Initializer — name-pattern dispatch included
+        $init->call($_, $self->{arrays}{$_})
+            for @{ $self->{param_names} };
+    } else {
+        my $scale = $kw{scale} // 0.07;
+        for my $n (@{ $self->{param_names} }) {
+            my $a = $self->{arrays}{$n};
+            $a->set([map { rand(2 * $scale) - $scale } 1 .. $a->size]);
+        }
     }
     $self;
 }
 
+# init_optimizer($name, %params)            -> store-side update (KVStore)
+# init_optimizer($name, local => 1, %params) -> pure-perl Optimizer tier
+#   driving the device update ops through NDArray->invoke (reference:
+#   Module's update_on_kvstore=0 local-updater path)
 sub init_optimizer {
     my ($self, $opt, %params) = @_;
+    if (delete $params{local}) {
+        require AI::MXNetTPU::Optimizer;
+        my $o = ref $opt ? $opt
+            : AI::MXNetTPU::Optimizer->create($opt, %params);
+        $self->{updater} = AI::MXNetTPU::Optimizer::Updater->new($o);
+        $self->{opt} = $o;
+        return $self;
+    }
     my $kv = AI::MXNetTPU::KVStore->create('local');
     $kv->set_optimizer($opt, %params);
     my $names = $self->{param_names};
@@ -89,6 +107,12 @@ sub forward_backward {
 sub update {
     my ($self) = @_;
     my $names = $self->{param_names};
+    if (my $u = $self->{updater}) {
+        $self->{opt}->begin_update;
+        $u->call($_, $self->{grads}{ $names->[$_] },
+                 $self->{arrays}{ $names->[$_] }) for 0 .. $#$names;
+        return $self;
+    }
     $self->{kv}->push_($names, [map { $self->{grads}{$_} } @$names]);
     $self->{kv}->pull($names, [map { $self->{arrays}{$_} } @$names]);
     $self;

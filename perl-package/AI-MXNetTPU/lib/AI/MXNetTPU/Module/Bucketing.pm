@@ -1,0 +1,119 @@
+package AI::MXNetTPU::Module::Bucketing;
+
+# Bucketing module (reference: AI::MXNet::Module::Bucketing,
+# perl-package/AI-MXNet/lib/AI/MXNet/Module/Bucketing.pm). Variable-
+# length sequence training without dynamic shapes: ``sym_gen`` builds a
+# symbol per bucket key (an unrolled length); one executor per bucket is
+# bound lazily, every bucket sharing the SAME parameter/grad NDArrays
+# (binding by name), so an update through any bucket advances them all.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+use parent -norequire, 'AI::MXNetTPU::Module';
+
+use AI::MXNetTPU::NDArray;
+use AI::MXNetTPU::Executor;
+
+sub new {
+    my ($class, %kw) = @_;
+    croak "Bucketing->new needs sym_gen" unless $kw{sym_gen};
+    croak "Bucketing->new needs default_bucket_key"
+        unless defined $kw{default_bucket_key};
+    bless {
+        sym_gen    => $kw{sym_gen},
+        default_bucket_key => $kw{default_bucket_key},
+        data_name  => $kw{data_name} // 'data',
+        label_name => $kw{label_name} // 'softmax_label',
+        # extra_shapes: explicit shapes for input-like variables shape
+        # inference cannot reach (RNN begin_state); these bind as fresh
+        # zero arrays per bucket with grad_req null, not as parameters
+        extra_shapes => $kw{extra_shapes} // {},
+        execs      => {},
+    }, $class;
+}
+
+# bind(data_shape => [...], label_shape => [...]) — shapes OF THE
+# DEFAULT BUCKET; parameters are allocated from its inferred shapes
+# and shared by every later bucket.
+sub bind {
+    my ($self, %kw) = @_;
+    my $key = $self->{default_bucket_key};
+    my $sym = $self->{sym_gen}->($key);
+    my ($args, $outs, $aux) = $sym->infer_shape(
+        $self->{data_name}  => $kw{data_shape},
+        $self->{label_name} => $kw{label_shape},
+        %{ $self->{extra_shapes} });
+    my $names = $sym->list_arguments;
+    my (%arrays, %grads);
+    for my $i (0 .. $#$names) {
+        my $n = $names->[$i];
+        next if $n eq $self->{data_name} || $n eq $self->{label_name}
+            || $self->{extra_shapes}{$n};
+        $arrays{$n} = AI::MXNetTPU::NDArray->zeros($args->[$i]);
+        $grads{$n}  = AI::MXNetTPU::NDArray->zeros($args->[$i]);
+    }
+    $self->{params} = \%arrays;
+    $self->{param_grads} = \%grads;
+    $self->{param_names} = [sort keys %arrays];
+    $self->{aux_shapes_known} = {};
+    $self->{batch} = $kw{data_shape}[0];
+    $self->switch_bucket($key, $kw{data_shape}, $kw{label_shape});
+    $self;
+}
+
+# lazily bind (then activate) the executor for one bucket
+sub switch_bucket {
+    my ($self, $key, $dshape, $lshape) = @_;
+    if (!$self->{execs}{$key}) {
+        my $sym = $self->{sym_gen}->($key);
+        my ($args, $outs, $aux) = $sym->infer_shape(
+            $self->{data_name}  => $dshape,
+            $self->{label_name} => $lshape,
+            %{ $self->{extra_shapes} });
+        my $names = $sym->list_arguments;
+        my (%arrays, %grads, %reqs, %auxs);
+        for my $i (0 .. $#$names) {
+            my $n = $names->[$i];
+            if ($n eq $self->{data_name} || $n eq $self->{label_name}
+                    || $self->{extra_shapes}{$n}) {
+                $arrays{$n} = AI::MXNetTPU::NDArray->zeros($args->[$i]);
+                $reqs{$n} = 'null';
+            } else {
+                croak "bucket $key introduces parameter $n absent from "
+                    . "the default bucket — sym_gen must keep one "
+                    . "parameter set" unless $self->{params}{$n};
+                $arrays{$n} = $self->{params}{$n};
+                $grads{$n}  = $self->{param_grads}{$n};
+                $reqs{$n} = 'write';
+            }
+        }
+        my $aux_names = $sym->list_auxiliary_states;
+        $auxs{ $aux_names->[$_] } =
+            AI::MXNetTPU::NDArray->zeros($aux->[$_]) for 0 .. $#$aux_names;
+        $self->{execs}{$key} = {
+            exec => $sym->bind(args => \%arrays, grads => \%grads,
+                               grad_req => \%reqs, aux => \%auxs),
+            arrays => \%arrays,
+        };
+    }
+    my $b = $self->{execs}{$key};
+    $self->{exec}   = $b->{exec};
+    $self->{arrays} = { %{ $b->{arrays} } };
+    $self->{grads}  = $self->{param_grads};
+    $self->{cur_key} = $key;
+    $self;
+}
+
+# one training step on a bucketed batch
+sub forward_backward_bucket {
+    my ($self, $key, $x, $y, $dshape, $lshape) = @_;
+    $self->switch_bucket($key, $dshape, $lshape);
+    $self->{arrays}{ $self->{data_name} }->set($x);
+    $self->{arrays}{ $self->{label_name} }->set($y);
+    $self->{exec}->forward(1);
+    $self->{exec}->backward;
+    $self;
+}
+
+1;

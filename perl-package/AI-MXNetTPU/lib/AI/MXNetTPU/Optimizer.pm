@@ -1,0 +1,196 @@
+package AI::MXNetTPU::Optimizer;
+
+# Pure-perl optimizer tier over the registered update ops (reference:
+# AI::MXNet::Optimizer, perl-package/AI-MXNet/lib/AI/MXNet/Optimizer.pm).
+# Where the existing KVStore path runs the optimizer store-side in C,
+# these classes drive the SAME device-side update ops (sgd_update /
+# sgd_mom_update / adam_update / rmsprop_update) imperatively through
+# NDArray->invoke, with perl owning state creation, lr scheduling and
+# per-parameter multipliers — the reference's local-updater architecture.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+my %REGISTRY;
+
+sub register {
+    my ($name, $class) = @_;
+    $REGISTRY{ lc $name } = $class;
+}
+
+sub create {
+    my ($class, $name, %kw) = @_;
+    my $impl = $REGISTRY{ lc $name }
+        or croak "unknown optimizer '$name' (have: "
+        . join(', ', sort keys %REGISTRY) . ")";
+    $impl->new(%kw);
+}
+
+sub new {
+    my ($class, %kw) = @_;
+    bless {
+        learning_rate => $kw{learning_rate} // 0.01,
+        wd            => $kw{wd} // 0,
+        rescale_grad  => $kw{rescale_grad} // 1,
+        clip_gradient => $kw{clip_gradient} // -1,
+        lr_scheduler  => $kw{lr_scheduler},
+        lr_mult       => $kw{lr_mult} // {},
+        wd_mult       => $kw{wd_mult} // {},
+        num_update    => 0,
+    }, $class;
+}
+
+# one state slot per parameter index (reference create_state)
+sub create_state { undef }
+
+sub _lr {
+    my ($self, $index) = @_;
+    my $lr = $self->{lr_scheduler}
+        ? $self->{lr_scheduler}->call($self->{num_update})
+        : $self->{learning_rate};
+    $lr * ($self->{lr_mult}{$index} // 1);
+}
+
+sub _wd {
+    my ($self, $index) = @_;
+    $self->{wd} * ($self->{wd_mult}{$index} // 1);
+}
+
+sub _common {
+    my ($self) = @_;
+    my %p = (rescale_grad => $self->{rescale_grad});
+    $p{clip_gradient} = $self->{clip_gradient}
+        if $self->{clip_gradient} > 0;
+    %p;
+}
+
+sub begin_update { ++$_[0]{num_update} }
+
+sub update { croak "subclasses implement update(index, w, g, state)" }
+
+package AI::MXNetTPU::Optimizer::SGD;
+
+# sgd_update / sgd_mom_update (reference: Optimizer.pm SGD)
+our @ISA = ('AI::MXNetTPU::Optimizer');
+
+sub new {
+    my ($class, %kw) = @_;
+    my $self = AI::MXNetTPU::Optimizer::new($class, %kw);
+    $self->{momentum} = $kw{momentum} // 0;
+    $self;
+}
+
+sub create_state {
+    my ($self, $index, $weight) = @_;
+    return undef unless $self->{momentum};
+    AI::MXNetTPU::NDArray->zeros($weight->shape);
+}
+
+sub update {
+    my ($self, $index, $w, $g, $state) = @_;
+    my %p = ($self->_common,
+             lr => $self->_lr($index), wd => $self->_wd($index));
+    if ($self->{momentum}) {
+        my ($nw, $nm) = AI::MXNetTPU::NDArray->invoke(
+            'sgd_mom_update', [$w, $g, $state],
+            { %p, momentum => $self->{momentum} });
+        $w->copy_from_ndarray($nw);
+        $state->copy_from_ndarray($nm);
+    } else {
+        my $nw = AI::MXNetTPU::NDArray->invoke('sgd_update', [$w, $g],
+                                               \%p);
+        $w->copy_from_ndarray($nw);
+    }
+}
+
+AI::MXNetTPU::Optimizer::register('sgd', __PACKAGE__);
+
+package AI::MXNetTPU::Optimizer::Adam;
+
+# adam_update with bias-corrected lr (reference: Optimizer.pm Adam —
+# coef = sqrt(1-b2^t)/(1-b1^t) folded into lr)
+our @ISA = ('AI::MXNetTPU::Optimizer');
+
+sub new {
+    my ($class, %kw) = @_;
+    my $self = AI::MXNetTPU::Optimizer::new($class, %kw);
+    $self->{learning_rate} = $kw{learning_rate} // 0.001;
+    $self->{beta1}   = $kw{beta1} // 0.9;
+    $self->{beta2}   = $kw{beta2} // 0.999;
+    $self->{epsilon} = $kw{epsilon} // 1e-8;
+    $self;
+}
+
+sub create_state {
+    my ($self, $index, $weight) = @_;
+    [AI::MXNetTPU::NDArray->zeros($weight->shape),
+     AI::MXNetTPU::NDArray->zeros($weight->shape)];
+}
+
+sub update {
+    my ($self, $index, $w, $g, $state) = @_;
+    my $t = $self->{num_update};
+    my $coef = sqrt(1 - $self->{beta2} ** $t) / (1 - $self->{beta1} ** $t);
+    my ($mean, $var) = @$state;
+    my ($nw, $nm, $nv) = AI::MXNetTPU::NDArray->invoke(
+        'adam_update', [$w, $g, $mean, $var],
+        { $self->_common,
+          lr => $self->_lr($index) * $coef, wd => $self->_wd($index),
+          beta1 => $self->{beta1}, beta2 => $self->{beta2},
+          epsilon => $self->{epsilon} });
+    $w->copy_from_ndarray($nw);
+    $mean->copy_from_ndarray($nm);
+    $var->copy_from_ndarray($nv);
+}
+
+AI::MXNetTPU::Optimizer::register('adam', __PACKAGE__);
+
+package AI::MXNetTPU::Optimizer::RMSProp;
+
+our @ISA = ('AI::MXNetTPU::Optimizer');
+
+sub new {
+    my ($class, %kw) = @_;
+    my $self = AI::MXNetTPU::Optimizer::new($class, %kw);
+    $self->{gamma1}  = $kw{gamma1} // 0.95;
+    $self->{epsilon} = $kw{epsilon} // 1e-8;
+    $self;
+}
+
+sub create_state {
+    my ($self, $index, $weight) = @_;
+    AI::MXNetTPU::NDArray->zeros($weight->shape);
+}
+
+sub update {
+    my ($self, $index, $w, $g, $state) = @_;
+    my ($nw, $nn) = AI::MXNetTPU::NDArray->invoke(
+        'rmsprop_update', [$w, $g, $state],
+        { $self->_common,
+          lr => $self->_lr($index), wd => $self->_wd($index),
+          gamma1 => $self->{gamma1}, epsilon => $self->{epsilon} });
+    $w->copy_from_ndarray($nw);
+    $state->copy_from_ndarray($nn);
+}
+
+AI::MXNetTPU::Optimizer::register('rmsprop', __PACKAGE__);
+
+package AI::MXNetTPU::Optimizer::Updater;
+
+# index -> state bookkeeping around one optimizer (reference get_updater)
+
+sub new {
+    my ($class, $opt) = @_;
+    bless { opt => $opt, states => {} }, $class;
+}
+
+sub call {
+    my ($self, $index, $grad, $weight) = @_;
+    my $st = $self->{states};
+    $st->{$index} = $self->{opt}->create_state($index, $weight)
+        unless exists $st->{$index};
+    $self->{opt}->update($index, $weight, $grad, $st->{$index});
+}
+
+1;

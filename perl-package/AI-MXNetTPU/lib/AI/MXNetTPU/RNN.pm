@@ -1,0 +1,227 @@
+package AI::MXNetTPU::RNN;
+
+# Symbolic RNN cells (reference: AI::MXNet::RNN::Cell,
+# perl-package/AI-MXNet/lib/AI/MXNet/RNN/Cell.pm). Each cell owns its
+# parameter Variables (created once, shared across time steps) and
+# composes one step's graph through Symbol ops; unroll() chains steps
+# over a sequence. The cells are the bucketing script's sym_gen
+# building blocks: one cell instance => one parameter set reused by
+# every bucket length.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+my $SYM = 'AI::MXNetTPU::Symbol';
+
+package AI::MXNetTPU::RNN::Cell;
+
+# vanilla RNN cell: h' = act(W_i x + b_i + W_h h + b_h)
+use Carp qw(croak);
+
+sub new {
+    my ($class, %kw) = @_;
+    my $self = bless {
+        num_hidden => ($kw{num_hidden} or croak "num_hidden required"),
+        prefix     => $kw{prefix} // 'rnn_',
+        activation => $kw{activation} // 'tanh',
+        counter    => 0,
+    }, $class;
+    $self->_init_params($self->_num_gates);
+    $self;
+}
+
+sub _num_gates { 1 }
+
+sub _init_params {
+    my ($self, $gates) = @_;
+    my $p = $self->{prefix};
+    $self->{ $_->[0] } = AI::MXNetTPU::Symbol->Variable("$p$_->[1]")
+        for (['i2h_weight', 'i2h_weight'], ['i2h_bias', 'i2h_bias'],
+             ['h2h_weight', 'h2h_weight'], ['h2h_bias', 'h2h_bias']);
+}
+
+sub state_info { [{ shape => [0, $_[0]{num_hidden}] }] }
+
+sub begin_state {
+    my ($self, %kw) = @_;
+    my $p = $self->{prefix};
+    [map { AI::MXNetTPU::Symbol->Variable("${p}begin_state_$_") }
+     0 .. $#{ $self->state_info }];
+}
+
+# one step: ($output, \@new_states)
+sub call {
+    my ($self, $x, $states) = @_;
+    my $p = $self->{prefix};
+    my $n = $self->{counter}++;
+    my $g = $self->{num_hidden} * $self->_num_gates;
+    my $i2h = AI::MXNetTPU::Symbol->FullyConnected(
+        $x, $self->{i2h_weight}, $self->{i2h_bias},
+        num_hidden => $g, name => "${p}t${n}_i2h");
+    my $h2h = AI::MXNetTPU::Symbol->FullyConnected(
+        $states->[0], $self->{h2h_weight}, $self->{h2h_bias},
+        num_hidden => $g, name => "${p}t${n}_h2h");
+    my $out = AI::MXNetTPU::Symbol->Activation(
+        AI::MXNetTPU::Symbol->elemwise_add($i2h, $h2h),
+        act_type => $self->{activation}, name => "${p}t${n}_out");
+    ($out, [$out]);
+}
+
+# unroll(length, \@step_inputs) -> (\@outputs, \@final_states)
+sub unroll {
+    my ($self, $length, $inputs, %kw) = @_;
+    croak "unroll needs $length inputs" unless @$inputs == $length;
+    my $states = $kw{begin_state} // $self->begin_state;
+    my @outs;
+    for my $t (0 .. $length - 1) {
+        (my $o, $states) = $self->call($inputs->[$t], $states);
+        push @outs, $o;
+    }
+    (\@outs, $states);
+}
+
+sub reset { $_[0]{counter} = 0 }
+
+package AI::MXNetTPU::RNN::LSTMCell;
+
+# LSTM: one fused 4-gate FC pair per step, SliceChannel into
+# in/forget/cell/out (the reference LSTMCell's gate order)
+our @ISA = ('AI::MXNetTPU::RNN::Cell');
+
+sub new {
+    my ($class, %kw) = @_;
+    $kw{prefix} //= 'lstm_';
+    my $self = AI::MXNetTPU::RNN::Cell::new($class, %kw);
+    $self;
+}
+
+sub _num_gates { 4 }
+
+sub state_info {
+    my ($self) = @_;
+    [{ shape => [0, $self->{num_hidden}] },
+     { shape => [0, $self->{num_hidden}] }];
+}
+
+sub call {
+    my ($self, $x, $states) = @_;
+    my $S = 'AI::MXNetTPU::Symbol';
+    my $p = $self->{prefix};
+    my $n = $self->{counter}++;
+    my $g = $self->{num_hidden} * 4;
+    my $i2h = $S->FullyConnected($x, $self->{i2h_weight},
+                                 $self->{i2h_bias},
+                                 num_hidden => $g,
+                                 name => "${p}t${n}_i2h");
+    my $h2h = $S->FullyConnected($states->[0], $self->{h2h_weight},
+                                 $self->{h2h_bias},
+                                 num_hidden => $g,
+                                 name => "${p}t${n}_h2h");
+    my $gates = $S->SliceChannel($S->elemwise_add($i2h, $h2h),
+                                 num_outputs => 4, axis => 1,
+                                 name => "${p}t${n}_slice");
+    my @gate = map { $S->_wrap(AI::MXNetTPU::mxp_sym_get_output(
+        $gates->{handle}, $_)) } 0 .. 3;
+    my $i = $S->Activation($gate[0], act_type => 'sigmoid');
+    my $f = $S->Activation($gate[1], act_type => 'sigmoid');
+    my $c = $S->Activation($gate[2], act_type => 'tanh');
+    my $o = $S->Activation($gate[3], act_type => 'sigmoid');
+    my $next_c = $S->elemwise_add(
+        $S->elemwise_mul($f, $states->[1]),
+        $S->elemwise_mul($i, $c));
+    my $next_h = $S->elemwise_mul(
+        $o, $S->Activation($next_c, act_type => 'tanh'));
+    ($next_h, [$next_h, $next_c]);
+}
+
+package AI::MXNetTPU::RNN::GRUCell;
+
+our @ISA = ('AI::MXNetTPU::RNN::Cell');
+
+sub new {
+    my ($class, %kw) = @_;
+    $kw{prefix} //= 'gru_';
+    AI::MXNetTPU::RNN::Cell::new($class, %kw);
+}
+
+sub _num_gates { 3 }
+
+sub call {
+    my ($self, $x, $states) = @_;
+    my $S = 'AI::MXNetTPU::Symbol';
+    my $p = $self->{prefix};
+    my $n = $self->{counter}++;
+    my $H = $self->{num_hidden};
+    my $i2h = $S->FullyConnected($x, $self->{i2h_weight},
+                                 $self->{i2h_bias}, num_hidden => 3 * $H,
+                                 name => "${p}t${n}_i2h");
+    my $h2h = $S->FullyConnected($states->[0], $self->{h2h_weight},
+                                 $self->{h2h_bias}, num_hidden => 3 * $H,
+                                 name => "${p}t${n}_h2h");
+    my $si = $S->SliceChannel($i2h, num_outputs => 3, axis => 1,
+                              name => "${p}t${n}_i_slice");
+    my $sh = $S->SliceChannel($h2h, num_outputs => 3, axis => 1,
+                              name => "${p}t${n}_h_slice");
+    my @gi = map { $S->_wrap(AI::MXNetTPU::mxp_sym_get_output(
+        $si->{handle}, $_)) } 0 .. 2;
+    my @gh = map { $S->_wrap(AI::MXNetTPU::mxp_sym_get_output(
+        $sh->{handle}, $_)) } 0 .. 2;
+    my $r = $S->Activation($S->elemwise_add($gi[0], $gh[0]),
+                           act_type => 'sigmoid');
+    my $z = $S->Activation($S->elemwise_add($gi[1], $gh[1]),
+                           act_type => 'sigmoid');
+    my $cand = $S->Activation(
+        $S->elemwise_add($gi[2], $S->elemwise_mul($r, $gh[2])),
+        act_type => 'tanh');
+    # h' = z*h + (1-z)*cand
+    my $next_h = $S->elemwise_add(
+        $S->elemwise_mul($z, $states->[0]),
+        $S->elemwise_sub($cand, $S->elemwise_mul($z, $cand)));
+    ($next_h, [$next_h]);
+}
+
+package AI::MXNetTPU::RNN::SequentialRNNCell;
+
+# stack of cells applied in order each step
+use Carp qw(croak);
+
+sub new { bless { cells => [] }, $_[0] }
+
+sub add { push @{ $_[0]{cells} }, $_[1]; $_[0] }
+
+sub begin_state {
+    my ($self) = @_;
+    [map { @{ $_->begin_state } } @{ $self->{cells} }];
+}
+
+sub call {
+    my ($self, $x, $states) = @_;
+    my (@next, $o);
+    my $i = 0;
+    $o = $x;
+    for my $cell (@{ $self->{cells} }) {
+        my $n = scalar @{ $cell->state_info };
+        my @mine = @$states[$i .. $i + $n - 1];
+        ($o, my $ns) = $cell->call($o, \@mine);
+        push @next, @$ns;
+        $i += $n;
+    }
+    ($o, \@next);
+}
+
+sub unroll {
+    my ($self, $length, $inputs, %kw) = @_;
+    croak "unroll needs $length inputs" unless @$inputs == $length;
+    my $states = $kw{begin_state} // $self->begin_state;
+    my @outs;
+    for my $t (0 .. $length - 1) {
+        (my $o, $states) = $self->call($inputs->[$t], $states);
+        push @outs, $o;
+    }
+    (\@outs, $states);
+}
+
+sub reset { $_->reset for @{ $_[0]{cells} } }
+
+1;

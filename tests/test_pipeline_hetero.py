@@ -127,27 +127,30 @@ def test_hetero_apply_matches_executor_forward():
                                atol=1e-5)
 
 
-def test_resnet50_staged_1f1b_exact():
-    """The flagship: ResNet-50 staged over pipe=4 by ctx_group
-    (pipe_stages=4), one 1F1B training step exact vs the unpipelined
+def test_resnet50_staged_1f1b_steady_state_exact():
+    """The flagship, in 1F1B *steady state*: ResNet-50 staged over pipe=4
+    by ctx_group (pipe_stages=4), n_microbatches = 16 = 4x stages — the
+    schedule runs well past fill (microbatches >> stages), so a bug that
+    only appears after the warm-up ramp (ring-slot reuse, carried-state
+    clobbering) cannot pass. One training step exact vs the unpipelined
     sequential reference — 153 parameter grads and 98 BatchNorm aux
     states."""
     sym = models.get_symbol("resnet", num_layers=50, num_classes=10,
-                            image_shape="16,16,3", pipe_stages=4)
+                            image_shape="8,8,3", pipe_stages=4)
     mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
-    apply_fn = pipeline_from_symbol(sym, mesh, n_microbatches=2)
+    apply_fn = pipeline_from_symbol(sym, mesh, n_microbatches=16)
     assert hasattr(apply_fn, "reference_step")
     # every residual unit landed in a stage; stem/head outside
     assert sum(len(v) for v in apply_fn.stage_param_names) == 150
     assert sum(len(a) for a in apply_fn.stage_aux_names) == 98
 
-    ex = sym.simple_bind(mx.cpu(), data=(4, 16, 16, 3), grad_req="null")
+    ex = sym.simple_bind(mx.cpu(), data=(16, 8, 8, 3), grad_req="null")
     args = {k: jnp.asarray(v.asnumpy()) for k, v in ex.arg_dict.items()
             if k not in ("data", "softmax_label")}
     auxs = {k: jnp.asarray(v.asnumpy()) for k, v in ex.aux_dict.items()}
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(4, 16, 16, 3).astype(np.float32))
-    y = jnp.asarray(rng.randint(0, 10, (4,)).astype(np.float32))
+    x = jnp.asarray(rng.rand(16, 8, 8, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, (16,)).astype(np.float32))
     key = jax.random.PRNGKey(1)
 
     loss_p, grads_p, aux_p = apply_fn.train_step(args, x, y,
@@ -165,3 +168,68 @@ def test_resnet50_staged_1f1b_exact():
         np.testing.assert_allclose(
             np.asarray(aux_p[k]), np.asarray(aux_r[k]),
             rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def _ragged_relu_symbol(d_in, widths, n_classes):
+    """BN/rng-free ragged pipeline (deterministic compile, for the
+    memory-bound test)."""
+    data = mx.sym.var("data")
+    h = data
+    with mx.AttrScope(ctx_group="prologue"):
+        h = mx.sym.FullyConnected(h, name="embed", num_hidden=widths[0],
+                                  flatten=False)
+    for i, w in enumerate(widths):
+        with mx.AttrScope(ctx_group=f"stage{i}"):
+            h = mx.sym.FullyConnected(h, name=f"fc{i}", num_hidden=w,
+                                      flatten=False)
+            h = mx.sym.Activation(h, act_type="relu", name=f"act{i}")
+    with mx.AttrScope(ctx_group="epilogue"):
+        h = mx.sym.FullyConnected(h, name="head", num_hidden=n_classes,
+                                  flatten=False)
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_hetero_1f1b_activation_ring_memory_bound():
+    """The 1F1B memory claim, asserted on compiled buffers: saved
+    activations live in a ring of 2*n_stages slots, so compile-time temp
+    memory must NOT grow with the number of microbatches beyond the
+    per-microbatch I/O buffers (pipeline input, its gradient, and the
+    prologue staging — ~3 flat activation buffers per microbatch). A
+    schedule that retained per-microbatch activations for backward (the
+    GPipe failure mode) would grow by at least the stage-internal
+    activation footprint per microbatch and fail the slope bound."""
+    d_in, widths = 256, [512, 384, 512, 256]
+    out = _ragged_relu_symbol(d_in, widths, 5)
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    apply_fn = pipeline_from_symbol(out, mesh)
+    rng = np.random.RandomState(0)
+    args, prev = {}, widths[0]
+    pairs = [("embed", d_in, widths[0])]
+    for i, w in enumerate(widths):
+        pairs.append((f"fc{i}", prev, w))
+        prev = w
+    pairs.append(("head", prev, 5))
+    for nm, a, b in pairs:
+        args[f"{nm}_weight"] = jnp.asarray(
+            rng.normal(0, .1, (b, a)).astype(np.float32))
+        args[f"{nm}_bias"] = jnp.zeros((b,), jnp.float32)
+
+    mb = 32                      # fixed microbatch SIZE
+    l_act_bytes = mb * max(widths) * 4   # one flat activation buffer
+
+    def temp_bytes(n_micro):
+        x = jnp.zeros((mb * n_micro, d_in), jnp.float32)
+        y = jnp.zeros((mb * n_micro,), jnp.float32)
+        f = jax.jit(lambda a, x, y: apply_fn.train_step(
+            a, x, y, n_microbatches=n_micro, rng=jax.random.PRNGKey(0)))
+        return f.lower(args, x, y).compile() \
+            .memory_analysis().temp_size_in_bytes
+
+    t8, t32 = temp_bytes(8), temp_bytes(32)
+    slope = (t32 - t8) / 24.0    # bytes per extra microbatch
+    # I/O buffers cost ~3 L_act per microbatch; GPipe-style retention
+    # would cost >= stage-count * L_act more on top (here >= 4 L_act)
+    assert slope <= 3.5 * l_act_bytes, (slope, l_act_bytes)
+    # and in absolute terms the schedule's working set is flat: 4x the
+    # microbatch count grows temp memory by well under 2x
+    assert t32 < 1.5 * t8, (t8, t32)
