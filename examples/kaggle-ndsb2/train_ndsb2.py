@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Second National Data Science Bowl: cardiac-volume regression miniature.
+
+Reference analogue: example/kaggle-ndsb2/Train.py — a LeNet over
+FRAME DIFFERENCES of a 30-frame cardiac MRI sequence, trained against a
+600-bin CDF encoding of the volume label with LogisticRegressionOutput,
+scored by CRPS, fed from CSVIter files. The same system here at CI
+scale: synthetic beating-disc sequences whose pulse amplitude encodes
+the "volume", a 60-bin CDF target, the same frame-diff SliceChannel
+head, a CSVIter round trip, and the reference's custom-metric hook
+(mx.metric.np(CRPS)).
+
+Run: python train_ndsb2.py            (~1 min on CPU)
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+FRAMES = 12
+SIZE = 24
+BINS = 60
+
+
+def get_lenet():
+    """Frame-difference LeNet (reference Train.py get_lenet): consecutive
+    frame deltas isolate the motion signal before any convolution."""
+    source = mx.sym.Variable("data")
+    source = (source - 128) * (1.0 / 128)
+    frames = mx.sym.SliceChannel(source, num_outputs=FRAMES)
+    diffs = [frames[i + 1] - frames[i] for i in range(FRAMES - 1)]
+    net = mx.sym.Concat(*diffs)
+    for i, (k, f) in enumerate([((5, 5), 16), ((3, 3), 16)]):
+        net = mx.sym.Convolution(net, kernel=k, num_filter=f,
+                                 name=f"conv{i}")
+        net = mx.sym.BatchNorm(net, fix_gamma=True, name=f"bn{i}")
+        net = mx.sym.Activation(net, act_type="relu", name=f"act{i}")
+        net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                             stride=(2, 2), name=f"pool{i}")
+    flat = mx.sym.Flatten(net)
+    flat = mx.sym.Dropout(flat, p=0.3)
+    fc = mx.sym.FullyConnected(flat, num_hidden=BINS)
+    # sigmoid head: each output bin predicts P(volume < bin)
+    return mx.sym.LogisticRegressionOutput(fc, name="softmax")
+
+
+def CRPS(label, pred):
+    """Continuous ranked probability score with the reference's
+    monotonicity repair (Train.py CRPS): a CDF cannot decrease."""
+    pred = pred.copy()
+    for j in range(pred.shape[1] - 1):
+        ahead = pred[:, j + 1] < pred[:, j]
+        pred[ahead, j + 1] = pred[ahead, j]
+    return np.sum(np.square(label - pred)) / label.size
+
+
+def encode_label(volumes):
+    """Volume scalar -> CDF target rows (reference encode_label:
+    bin b is 1 iff volume < b)."""
+    return (volumes[:, None] < np.arange(BINS)[None]).astype(np.uint8)
+
+
+def make_sequences(n, seed):
+    """Synthetic cine loops: a disc whose radius pulses with amplitude
+    proportional to the label volume. The DIFFERENCE between frames
+    carries the signal, matching the network's inductive bias."""
+    rng = np.random.RandomState(seed)
+    vols = rng.uniform(5, BINS - 5, n)
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE]
+    seqs = np.empty((n, FRAMES, SIZE, SIZE), np.float32)
+    for i, v in enumerate(vols):
+        cy, cx = rng.uniform(SIZE * .35, SIZE * .65, 2)
+        base = SIZE * 0.14
+        amp = base * (v / BINS)
+        for t in range(FRAMES):
+            r = base + amp * (0.5 + 0.5 * np.sin(2 * np.pi * t / FRAMES))
+            disc = ((yy - cy) ** 2 + (xx - cx) ** 2) < r * r
+            seqs[i, t] = disc * 200.0 + rng.rand(SIZE, SIZE) * 20.0
+    return seqs, vols
+
+
+def write_csv(prefix, seqs, labels):
+    """CSVIter-consumable files (reference feeds CSVs so the full set
+    never has to sit in memory)."""
+    data_csv = prefix + "-data.csv"
+    label_csv = prefix + "-label.csv"
+    np.savetxt(data_csv, seqs.reshape(len(seqs), -1), delimiter=",",
+               fmt="%g")
+    np.savetxt(label_csv, encode_label(labels), delimiter=",", fmt="%g")
+    return data_csv, label_csv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--train", type=int, default=96)
+    ap.add_argument("--val", type=int, default=32)
+    ap.add_argument("--crps-gate", type=float, default=0.08)
+    args = ap.parse_args()
+
+    mx.random.seed(3)
+    workdir = tempfile.mkdtemp(prefix="ndsb2_")
+    train_seqs, train_vols = make_sequences(args.train, seed=1)
+    data_csv, label_csv = write_csv(os.path.join(workdir, "train"),
+                                    train_seqs, train_vols)
+    data_train = mx.io.CSVIter(
+        data_csv=data_csv, data_shape=(FRAMES, SIZE, SIZE),
+        label_csv=label_csv, label_shape=(BINS,),
+        batch_size=args.batch_size)
+
+    mod = mx.mod.Module(get_lenet(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(data_train, num_epoch=args.epochs,
+            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
+            eval_metric=mx.metric.np(CRPS),
+            initializer=mx.init.Xavier())
+
+    val_seqs, val_vols = make_sequences(args.val, seed=2)
+    preds = mod.predict(mx.io.NDArrayIter(
+        {"data": val_seqs}, batch_size=args.batch_size)).asnumpy()
+    score = CRPS(encode_label(val_vols), preds)
+    print(f"validation CRPS = {score:.4f} over {args.val} sequences")
+    assert score < args.crps_gate, \
+        f"CRPS {score:.4f} above gate {args.crps_gate}"
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""How-to: watch weight/activation norms during training with Monitor.
+
+Reference analogue: example/python-howto/monitor_weights.py — fit an
+MLP with Monitor(interval, norm_stat) printing per-tensor norms every N
+batches.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def norm_stat(d):
+    return mx.nd.norm(d) / np.sqrt(d.size)
+
+
+def main():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+    h = mx.sym.Activation(h, name="relu1", act_type="relu")
+    h = mx.sym.FullyConnected(h, name="fc2", num_hidden=10)
+    mlp = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 16).astype(np.float32)
+    y = (x.sum(-1) * 2 % 10 // 1).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": x}, {"softmax_label": y},
+                           batch_size=32, shuffle=True)
+
+    seen = []
+    mon = mx.mon.Monitor(2, norm_stat)
+    mod = mx.mod.Module(mlp)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            monitor=mon,
+            batch_end_callback=lambda p: seen.append(p.nbatch))
+    assert seen, "no batches ran"
+    print("monitored 2 epochs over", max(seen) + 1, "batches/epoch")
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

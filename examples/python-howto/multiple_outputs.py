@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""How-to: expose internal layers as extra outputs with Group.
+
+Reference analogue: example/python-howto/multiple_outputs.py — group an
+internal FullyConnected with the final softmax so one executor returns
+both.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    net = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=net, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=64)
+    out = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    group = mx.sym.Group([fc1, out])
+    print(group.list_outputs())
+    assert group.list_outputs() == ["fc1_output", "softmax_output"]
+
+    ex = group.simple_bind(mx.cpu(), data=(4, 32), grad_req="null")
+    ex.forward(is_train=False, data=np.random.rand(4, 32),
+               softmax_label=np.zeros(4))
+    hidden, probs = (o.asnumpy() for o in ex.outputs)
+    assert hidden.shape == (4, 128)
+    assert np.allclose(probs.sum(-1), 1.0, atol=1e-4)
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -3,8 +3,12 @@
 Reference analogue: python/mxnet/test_utils.py — ``check_numeric_gradient``
 (:620), ``check_symbolic_forward``/``backward`` (:744/:809),
 ``assert_almost_equal`` (:328), ``check_consistency`` (:987),
-``default_context`` (:49). The CPU↔GPU consistency pattern becomes
-eager-vs-jit / dtype cross-checks (SURVEY.md §4 "TPU translation").
+``default_context`` (:49). Same public surface; the mechanics are this
+repo's own: the finite-difference loop walks ``np.ndindex`` through a
+loss closure, grad_req handling is one shared dispatch table, and the
+MNIST idx reader parses headers as big-endian numpy views. The CPU↔GPU
+consistency pattern becomes eager-vs-jit / dtype cross-checks
+(SURVEY.md §4 "TPU translation").
 """
 from __future__ import annotations
 
@@ -16,7 +20,7 @@ import time
 
 import numpy as np
 
-from .context import Context, cpu, current_context
+from .context import Context, cpu, current_context  # noqa: F401 (re-export)
 from . import ndarray as nd
 from .ndarray import NDArray
 from .symbol import Symbol
@@ -30,10 +34,10 @@ def default_context() -> Context:
     """The context test suites run on; switchable via MXNET_TEST_DEVICE
     (reference: test_utils.py:49-56, env-switchable default ctx)."""
     dev = os.environ.get("MXNET_TEST_DEVICE", "")
-    if dev:
-        name, _, idx = dev.partition(":")
-        return Context(name, int(idx or 0))
-    return current_context()
+    if not dev:
+        return current_context()
+    name, _, idx = dev.partition(":")
+    return Context(name, int(idx or 0))
 
 
 def set_default_context(ctx: Context):
@@ -52,28 +56,25 @@ def get_rtol(rtol=None):
 
 
 def random_arrays(*shapes):
-    """Random float32 numpy arrays (reference :81)."""
-    arrays = [np.array(_rng.randn(), dtype=default_dtype()) if len(s) == 0
-              else _rng.randn(*s).astype(default_dtype()) for s in shapes]
-    if len(arrays) == 1:
-        return arrays[0]
-    return arrays
+    """Random float32 numpy arrays, one per shape (reference :81)."""
+    made = [_rng.randn(*s).astype(default_dtype()) if s
+            else np.array(_rng.randn(), dtype=default_dtype())
+            for s in shapes]
+    return made[0] if len(made) == 1 else made
 
 
 def random_sample(population, k):
-    """Sample without replacement (reference :90)."""
-    population_copy = population[:]
-    np.random.shuffle(population_copy)
-    return population_copy[0:k]
+    """k items without replacement (reference :90)."""
+    picks = np.random.permutation(len(population))[:k]
+    return [population[i] for i in picks]
 
 
 def rand_shape_2d(dim0=10, dim1=10):
-    return _rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1)
+    return tuple(_rng.randint(1, d + 1) for d in (dim0, dim1))
 
 
 def rand_shape_3d(dim0=10, dim1=10, dim2=10):
-    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
-            _rng.randint(1, dim2 + 1))
+    return tuple(_rng.randint(1, d + 1) for d in (dim0, dim1, dim2))
 
 
 def rand_shape_nd(n, dim=10):
@@ -97,19 +98,17 @@ def rand_sparse_ndarray(shape, stype, density=None, distribution=None,
     density = _rng.rand() if density is None else density
     dtype = default_dtype() if dtype is None else dtype
     if stype == "row_sparse":
-        num_rows = shape[0]
-        idx_sample = _rng.rand(num_rows)
-        indices = np.argwhere(idx_sample < density).reshape(-1)
-        if indices.shape[0] == 0:
-            return sparse.zeros("row_sparse", shape, dtype=dtype), \
-                np.zeros(shape, dtype=dtype)
-        val = _rng.rand(indices.shape[0], *shape[1:]).astype(dtype)
-        arr = sparse.row_sparse_array((val, indices), shape=shape, dtype=dtype)
+        hit = np.flatnonzero(_rng.rand(shape[0]) < density)
+        if hit.size == 0:
+            return (sparse.zeros("row_sparse", shape, dtype=dtype),
+                    np.zeros(shape, dtype=dtype))
+        vals = _rng.rand(hit.size, *shape[1:]).astype(dtype)
+        arr = sparse.row_sparse_array((vals, hit), shape=shape, dtype=dtype)
         return arr, arr.asnumpy()
     if stype == "csr":
         assert len(shape) == 2
         dense = _rng.rand(*shape).astype(dtype)
-        dense[_rng.rand(*shape) >= density] = 0
+        dense *= _rng.rand(*shape) < density
         arr = sparse.csr_matrix(dense)
         return arr, dense
     raise ValueError(f"unknown storage type {stype}")
@@ -121,34 +120,31 @@ def rand_sparse_ndarray(shape, stype, density=None, distribution=None,
 def np_reduce(dat, axis, keepdims, numpy_reduce_func):
     """Apply a numpy reduction with MXNet axis/keepdims semantics
     (reference :268)."""
-    if isinstance(axis, int):
-        axis = [axis]
+    if axis is None:
+        axes = tuple(range(dat.ndim))
+    elif isinstance(axis, int):
+        axes = (axis,)
     else:
-        axis = list(axis) if axis is not None else range(len(dat.shape))
-    ret = dat
-    for i in reversed(sorted(axis)):
-        ret = numpy_reduce_func(ret, axis=i)
+        axes = tuple(axis)
+    out = dat
+    for ax in sorted(axes, reverse=True):
+        out = numpy_reduce_func(out, axis=ax)
     if keepdims:
-        keepdims_shape = list(dat.shape)
-        for i in axis:
-            keepdims_shape[i] = 1
-        ret = ret.reshape(tuple(keepdims_shape))
-    return ret
+        kept = tuple(1 if i in axes else s
+                     for i, s in enumerate(dat.shape))
+        out = out.reshape(kept)
+    return out
 
 
 def _as_np(a):
-    if isinstance(a, NDArray):
-        return a.asnumpy()
-    return np.asarray(a)
+    return a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
 
 
 def find_max_violation(a, b, rtol=None, atol=None):
     rtol, atol = get_rtol(rtol), get_atol(atol)
-    diff = np.abs(a - b)
-    tol = atol + rtol * np.abs(b)
-    violation = diff / (tol + 1e-20)
-    loc = np.unravel_index(np.argmax(violation), violation.shape)
-    return loc, np.max(violation)
+    excess = np.abs(a - b) / (atol + rtol * np.abs(b) + 1e-20)
+    where = np.unravel_index(int(np.argmax(excess)), excess.shape)
+    return where, float(excess.max())
 
 
 def same(a, b):
@@ -169,14 +165,15 @@ def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
     raise AssertionError(
         "Error %f exceeds tolerance rtol=%f, atol=%f. "
         " Location of maximum error:%s, %s=%f, %s=%f"
-        % (rel, rtol, atol, str(index), names[0], a[index], names[1], b[index]))
+        % (rel, rtol, atol, str(index), names[0], a[index], names[1],
+           b[index]))
 
 
 def _zero_nans(a, b):
     a, b = _as_np(a).copy(), _as_np(b).copy()
-    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
-    a[nan_mask] = 0
-    b[nan_mask] = 0
+    bad = np.isnan(a) | np.isnan(b)
+    a[bad] = 0
+    b[bad] = 0
     return a, b
 
 
@@ -191,12 +188,12 @@ def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
 
 
 def same_array(array1, array2):
-    """Check two NDArrays share the same handle (reference :1247)."""
+    """Check two NDArrays share the same handle: a mutation through one
+    must be visible through the other (reference :1247)."""
     array1[:] = array1.asnumpy() + 1
-    if not same(array1.asnumpy(), array2.asnumpy()):
-        return False
+    coupled = same(array1.asnumpy(), array2.asnumpy())
     array1[:] = array1.asnumpy() - 1
-    return same(array1.asnumpy(), array2.asnumpy())
+    return coupled and same(array1.asnumpy(), array2.asnumpy())
 
 
 def retry(n):
@@ -206,12 +203,12 @@ def retry(n):
     def decorate(f):
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
-            for i in range(n):
+            for attempt in range(n):
                 try:
                     return f(*args, **kwargs)
-                except AssertionError as e:
-                    if i == n - 1:
-                        raise e
+                except AssertionError:
+                    if attempt == n - 1:
+                        raise
                     np.random.seed(int(time.time() * 1e6) % (1 << 30))
         return wrapper
     return decorate
@@ -220,28 +217,31 @@ def retry(n):
 # -- symbolic checking -------------------------------------------------------
 
 
+def _as_ndarray_dict(names, values, ctx, dtype, what):
+    """kwargs-or-positional values → {name: NDArray} for one name list."""
+    if values is None:
+        return {}
+    if not isinstance(values, dict):
+        values = dict(zip(names, values))
+    elif what == "argument" and set(values) != set(names):
+        raise ValueError(
+            "Symbol arguments and keys of the given location do not match."
+            f"symbol args:{names}, location.keys():{list(values)}")
+    return {k: v if isinstance(v, NDArray)
+            else nd.array(v, ctx=ctx, dtype=dtype)
+            for k, v in values.items()}
+
+
 def _parse_location(sym: Symbol, location, ctx, dtype=None):
     """kwargs-or-list → {arg_name: NDArray} (reference :450)."""
     assert isinstance(location, (dict, list, tuple))
-    arg_names = sym.list_arguments()
-    if isinstance(location, dict):
-        if set(location.keys()) != set(arg_names):
-            raise ValueError(
-                "Symbol arguments and keys of the given location do not match."
-                f"symbol args:{arg_names}, location.keys():{list(location)}")
-    else:
-        location = dict(zip(arg_names, location))
-    return {k: v if isinstance(v, NDArray) else nd.array(v, ctx=ctx, dtype=dtype)
-            for k, v in location.items()}
+    return _as_ndarray_dict(sym.list_arguments(), location, ctx, dtype,
+                            "argument")
 
 
 def _parse_aux_states(sym: Symbol, aux_states, ctx, dtype=None):
-    if aux_states is None:
-        return {}
-    if isinstance(aux_states, (list, tuple)):
-        aux_states = dict(zip(sym.list_auxiliary_states(), aux_states))
-    return {k: v if isinstance(v, NDArray) else nd.array(v, ctx=ctx, dtype=dtype)
-            for k, v in aux_states.items()}
+    return _as_ndarray_dict(sym.list_auxiliary_states(), aux_states, ctx,
+                            dtype, "auxiliary state")
 
 
 def simple_forward(sym, ctx=None, is_train=False, **inputs):
@@ -252,59 +252,82 @@ def simple_forward(sym, ctx=None, is_train=False, **inputs):
         executor.arg_dict[k][:] = v
     executor.forward(is_train=is_train)
     outputs = [x.asnumpy() for x in executor.outputs]
-    if len(outputs) == 1:
-        outputs = outputs[0]
-    return outputs
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def _normalize_grad_req(grad_req, names):
+    if isinstance(grad_req, str):
+        return {k: grad_req for k in names}
+    if isinstance(grad_req, (list, tuple)):
+        return dict(zip(names, grad_req))
+    return dict(grad_req)
+
+
+def _check_one_grad(name, req, measured, want, seed_grad, rtol, atol,
+                    tags):
+    """Assert one gradient under its grad_req semantics — shared by the
+    numeric and symbolic checkers. 'write': measured == want; 'add':
+    measured minus the pre-seeded grad == want; 'null': the seed must
+    survive untouched."""
+    left_right = {
+        "write": (want, measured),
+        "add": (want, measured - seed_grad),
+        "null": (seed_grad, measured),
+    }
+    if req not in left_right:
+        raise ValueError(f"Invalid grad_req {req} for {name}")
+    left, right = left_right[req]
+    assert_almost_equal(left, right, rtol, atol,
+                        (f"{tags[0]}_{name}", f"{tags[1]}_{name}"))
 
 
 def numeric_grad(executor, location, aux_states=None, eps=1e-4,
                  use_forward_train=True):
     """Central finite differences of sum(outputs[0]) wrt each arg
     (reference :560). ``location`` is {name: numpy array}."""
-    for k, v in location.items():
+    aux_states = aux_states or {}
+
+    def loss_with(name, arr):
+        """Scalar loss with ONLY ``name`` re-uploaded (every other arg
+        already sits at its base value on the executor). Aux states are
+        reset each probe because a train-mode forward may overwrite
+        them."""
+        executor.arg_dict[name][:] = arr
+        for k, v in aux_states.items():
+            executor.aux_dict[k][:] = v
+        executor.forward(is_train=use_forward_train)
+        return executor.outputs[0].asnumpy().astype(np.float64).sum()
+
+    base = {k: np.array(v, copy=True) for k, v in location.items()}
+    for k, v in base.items():  # park every arg at the unperturbed point
         executor.arg_dict[k][:] = v
-    # asnumpy() can hand back read-only buffers; finite differencing
-    # perturbs entries in place, so take writable copies
-    location = {k: np.array(v, copy=True) for k, v in location.items()}
-    approx_grads = {k: np.zeros(v.shape, dtype=v.dtype)
-                    for k, v in location.items()}
-
-    for k, v in location.items():
-        old_value = v.copy()
-        for i in range(int(np.prod(v.shape)) if v.shape else 1):
-            # forward at x+eps/2 and x-eps/2
-            v.reshape(-1)[i] = old_value.reshape(-1)[i] + eps / 2.0
-            executor.arg_dict[k][:] = v
-            if aux_states is not None:
-                for key, val in aux_states.items():
-                    executor.aux_dict[key][:] = val
-            executor.forward(is_train=use_forward_train)
-            f_peps = executor.outputs[0].asnumpy().astype(np.float64).sum()
-
-            v.reshape(-1)[i] = old_value.reshape(-1)[i] - eps / 2.0
-            executor.arg_dict[k][:] = v
-            if aux_states is not None:
-                for key, val in aux_states.items():
-                    executor.aux_dict[key][:] = val
-            executor.forward(is_train=use_forward_train)
-            f_neps = executor.outputs[0].asnumpy().astype(np.float64).sum()
-
-            approx_grads[k].reshape(-1)[i] = (f_peps - f_neps) / eps
-            v.reshape(-1)[i] = old_value.reshape(-1)[i]
-        # copy back the original value
-        executor.arg_dict[k][:] = old_value
-    return approx_grads
+    grads = {}
+    for name, center in base.items():
+        g = np.zeros_like(center, dtype=center.dtype)
+        bumped = center.copy()
+        for idx in (np.ndindex(*center.shape) if center.shape
+                    else [()]):
+            bumped[idx] = center[idx] + eps / 2.0
+            up = loss_with(name, bumped)
+            bumped[idx] = center[idx] - eps / 2.0
+            down = loss_with(name, bumped)
+            g[idx] = (up - down) / eps
+            bumped[idx] = center[idx]
+        executor.arg_dict[name][:] = center  # restore before the next arg
+        grads[name] = g
+    return grads
 
 
 def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
                            rtol=1e-2, atol=None, grad_nodes=None,
-                           use_forward_train=True, ctx=None, dtype=np.float32):
+                           use_forward_train=True, ctx=None,
+                           dtype=np.float32):
     """Verify symbolic gradients against finite differences on a random
     projection of the outputs (reference :620).
 
     Unlike the reference's 1e-20 default, ``atol`` defaults to the fp32
-    finite-difference noise floor (~2·ulp(loss)/eps): a central difference of
-    a float32 forward cannot resolve gradients smaller than that, and a
+    finite-difference noise floor (~2·ulp(loss)/eps): a central difference
+    of a float32 forward cannot resolve gradients smaller than that, and a
     purely relative check fails spuriously on near-zero entries.
     """
     ctx = ctx or default_context()
@@ -312,74 +335,59 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
         # noise floor scales with the forward's ulp: ~2·ulp(loss)/eps
         atol = 2e-3 if np.dtype(dtype).itemsize <= 4 else 1e-8
 
-    def random_projection(shape):
-        # random_projection should not have elements too small,
-        # otherwise too much precision is lost in numerical gradient
-        plain = _rng.rand(*shape) + 0.1
-        return plain
-
     location = _parse_location(sym, location, ctx, dtype=dtype)
-    location_npy = {k: v.asnumpy() for k, v in location.items()}
     aux_states = _parse_aux_states(sym, aux_states, ctx, dtype=dtype)
-    aux_npy = {k: v.asnumpy() for k, v in aux_states.items()}
+    host_args = {k: v.asnumpy() for k, v in location.items()}
+    host_aux = {k: v.asnumpy() for k, v in aux_states.items()}
 
     if grad_nodes is None:
-        grad_nodes = sym.list_arguments()
-        grad_req = {k: "write" for k in grad_nodes}
+        grad_req = {k: "write" for k in sym.list_arguments()}
+    elif isinstance(grad_nodes, dict):
+        grad_req = dict(grad_nodes)
     elif isinstance(grad_nodes, (list, tuple)):
         grad_req = {k: "write" for k in grad_nodes}
-    elif isinstance(grad_nodes, dict):
-        grad_req = grad_nodes.copy()
-        grad_nodes = list(grad_nodes.keys())
     else:
         raise ValueError(f"Invalid grad_nodes {grad_nodes}")
+    grad_nodes = list(grad_req)
 
-    input_shape = {k: v.shape for k, v in location.items()}
-    _, out_shape, _ = sym.infer_shape(**input_shape)
+    _, out_shape, _ = sym.infer_shape(
+        **{k: v.shape for k, v in location.items()})
     from . import sym as _sym_ns
-    proj = _sym_ns.Variable("__random_proj")
-    out = _sym_ns.sum(sym[0] * proj)
-    out = _sym_ns.MakeLoss(out)
+    # project the (possibly multi-dim) output onto a random direction so
+    # one scalar loss checks every output entry's gradient at once; keep
+    # entries away from zero or FD precision drowns
+    proj_name = "__random_proj"
+    proj = _sym_ns.Variable(proj_name)
+    loss_sym = _sym_ns.MakeLoss(_sym_ns.sum(sym[0] * proj))
 
-    location = dict(location)
-    location["__random_proj"] = nd.array(random_projection(out_shape[0]),
-                                         ctx=ctx, dtype=dtype)
-    args_grad_npy = {k: _rng.normal(0, 0.01, size=location[k].shape)
-                     for k in grad_nodes}
-    args_grad_npy["__random_proj"] = _rng.normal(0, 0.01, size=out_shape[0])
-    args_grad = {k: nd.array(v, ctx=ctx, dtype=dtype)
-                 for k, v in args_grad_npy.items()}
-    grad_req = dict(grad_req)
-    grad_req["__random_proj"] = "write"
-
-    executor = out.bind(ctx, args=location, args_grad=args_grad,
-                        grad_req=grad_req, aux_states=aux_states)
+    location = dict(location, **{proj_name: nd.array(
+        _rng.rand(*out_shape[0]) + 0.1, ctx=ctx, dtype=dtype)})
+    grad_req = dict(grad_req, **{proj_name: "write"})
+    seed_grads = {k: _rng.normal(0, 0.01, size=location[k].shape)
+                  for k in grad_nodes + [proj_name]}
+    executor = loss_sym.bind(
+        ctx, args=location,
+        args_grad={k: nd.array(v, ctx=ctx, dtype=dtype)
+                   for k, v in seed_grads.items()},
+        grad_req=grad_req, aux_states=aux_states)
 
     executor.forward(is_train=True)
     assert len(executor.outputs) == 1
     executor.backward()
-    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+    measured = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
 
-    numeric_gradients = numeric_grad(
-        executor, {**location_npy,
-                   "__random_proj": location["__random_proj"].asnumpy()},
-        aux_npy, eps=numeric_eps, use_forward_train=use_forward_train)
+    fd = numeric_grad(
+        executor,
+        dict(host_args,
+             **{proj_name: location[proj_name].asnumpy()}),
+        host_aux, eps=numeric_eps, use_forward_train=use_forward_train)
 
     for name in grad_nodes:
-        fd_grad = numeric_gradients[name]
-        sym_grad = symbolic_grads[name]
-        if grad_req[name] == "write":
-            assert_almost_equal(fd_grad, sym_grad, rtol, atol,
-                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
-        elif grad_req[name] == "add":
-            assert_almost_equal(fd_grad, sym_grad - args_grad_npy[name],
-                                rtol, atol,
-                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
-        elif grad_req[name] == "null":
-            assert_almost_equal(args_grad_npy[name], sym_grad, rtol, atol,
-                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
-        else:
-            raise ValueError(f"Invalid grad_req {grad_req[name]} for {name}")
+        # note the operand order the numeric checker historically used:
+        # FD on the left, symbolic on the right
+        _check_one_grad(name, grad_req[name], measured[name], fd[name],
+                        seed_grads[name], rtol, atol,
+                        ("NUMERICAL", "BACKWARD"))
 
 
 def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
@@ -394,12 +402,10 @@ def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
     executor = sym.bind(ctx, args=location, grad_req="null",
                         aux_states=aux_states)
     executor.forward(is_train=False)
-    outputs = [x.asnumpy() for x in executor.outputs]
-    for output_name, expect, output in zip(sym.list_outputs(), expected,
-                                           outputs):
-        assert_almost_equal(expect, output, rtol, atol,
-                            ("EXPECTED_%s" % output_name,
-                             "FORWARD_%s" % output_name))
+    for out_name, want, got in zip(sym.list_outputs(), expected,
+                                   executor.outputs):
+        assert_almost_equal(want, got.asnumpy(), rtol, atol,
+                            (f"EXPECTED_{out_name}", f"FORWARD_{out_name}"))
     return executor.outputs
 
 
@@ -409,42 +415,30 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
     """Compare executor backward grads against expected numpy values
     (reference :809)."""
     ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
     location = _parse_location(sym, location, ctx, dtype=dtype)
     aux_states = _parse_aux_states(sym, aux_states, ctx, dtype=dtype)
-    if isinstance(expected, (list, tuple)):
-        expected = dict(zip(sym.list_arguments(), expected))
-    args_grad_npy = {k: _rng.normal(size=v.shape)
-                     for k, v in expected.items()}
-    args_grad_data = {k: nd.array(v, ctx=ctx, dtype=dtype)
-                      for k, v in args_grad_npy.items()}
-    if isinstance(grad_req, str):
-        grad_req = {k: grad_req for k in sym.list_arguments()}
-    elif isinstance(grad_req, (list, tuple)):
-        grad_req = dict(zip(sym.list_arguments(), grad_req))
+    if not isinstance(expected, dict):
+        expected = dict(zip(arg_names, expected))
+    grad_req = _normalize_grad_req(grad_req, arg_names)
+    seed_grads = {k: _rng.normal(size=v.shape)
+                  for k, v in expected.items()}
 
-    executor = sym.bind(ctx, args=location, args_grad=args_grad_data,
-                        grad_req=grad_req, aux_states=aux_states)
+    executor = sym.bind(
+        ctx, args=location,
+        args_grad={k: nd.array(v, ctx=ctx, dtype=dtype)
+                   for k, v in seed_grads.items()},
+        grad_req=grad_req, aux_states=aux_states)
     executor.forward(is_train=True)
-    if isinstance(out_grads, (tuple, list)):
-        out_grads = [nd.array(v, ctx=ctx, dtype=dtype) for v in out_grads]
-    elif isinstance(out_grads, dict):
-        out_grads = [nd.array(out_grads[k], ctx=ctx, dtype=dtype)
-                     for k in sym.list_outputs()]
-    executor.backward(out_grads)
-    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items()}
+    if isinstance(out_grads, dict):
+        out_grads = [out_grads[k] for k in sym.list_outputs()]
+    executor.backward([nd.array(v, ctx=ctx, dtype=dtype)
+                       for v in out_grads])
     for name in expected:
-        if grad_req[name] == "write":
-            assert_almost_equal(expected[name], grads[name], rtol, atol,
-                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
-        elif grad_req[name] == "add":
-            assert_almost_equal(expected[name],
-                                grads[name] - args_grad_npy[name], rtol, atol,
-                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
-        elif grad_req[name] == "null":
-            assert_almost_equal(args_grad_npy[name], grads[name], rtol, atol,
-                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
-        else:
-            raise ValueError(f"Invalid grad_req {grad_req[name]} for {name}")
+        _check_one_grad(name, grad_req[name],
+                        executor.grad_dict[name].asnumpy(),
+                        expected[name], seed_grads[name], rtol, atol,
+                        ("EXPECTED", "BACKWARD"))
     return executor.grad_arrays
 
 
@@ -457,96 +451,85 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
     eager-vs-jit and/or multiple dtypes (SURVEY.md §4). Each ctx spec is a
     dict like {'ctx': mx.cpu(), 'data': shape, 'type_dict': {...}}.
     """
+    known_dtypes = (np.float16, np.float32, np.float64, np.uint8, np.int32)
     if tol is None:
-        tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
-               np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
-               np.dtype(np.int32): 0}
+        tol = dict(zip(map(np.dtype, known_dtypes),
+                       (1e-1, 1e-3, 1e-5, 0, 0)))
     elif isinstance(tol, (float, int)):
-        tol = {np.dtype(np.float16): tol, np.dtype(np.float32): tol,
-               np.dtype(np.float64): tol, np.dtype(np.uint8): tol,
-               np.dtype(np.int32): tol}
+        tol = {np.dtype(dt): tol for dt in known_dtypes}
 
     assert len(ctx_list) > 1
-    if isinstance(sym, Symbol):
-        sym = [sym] * len(ctx_list)
-    else:
-        assert len(sym) == len(ctx_list)
+    syms = [sym] * len(ctx_list) if isinstance(sym, Symbol) else sym
+    assert len(syms) == len(ctx_list)
 
-    output_names = sym[0].list_outputs()
-    arg_names = sym[0].list_arguments()
+    output_names = syms[0].list_outputs()
+    arg_names = syms[0].list_arguments()
     exe_list = []
-    for s, ctx in zip(sym, ctx_list):
+    for s, spec in zip(syms, ctx_list):
         assert s.list_arguments() == arg_names
         assert s.list_outputs() == output_names
-        kwargs = {k: v for k, v in ctx.items()
+        shapes = {k: v for k, v in spec.items()
                   if k not in ("ctx", "type_dict")}
-        exe_list.append(s.simple_bind(ctx["ctx"], grad_req=grad_req,
-                                      type_dict=ctx.get("type_dict"),
-                                      **kwargs))
+        exe_list.append(s.simple_bind(spec["ctx"], grad_req=grad_req,
+                                      type_dict=spec.get("type_dict"),
+                                      **shapes))
 
-    arg_params = {} if arg_params is None else arg_params
-    aux_params = {} if aux_params is None else aux_params
+    # shared host-side values, filled per executor in its own dtype
+    arg_params = dict(arg_params or {})
+    aux_params = dict(aux_params or {})
     for n, arr in exe_list[0].arg_dict.items():
-        if n not in arg_params:
-            arg_params[n] = np.random.normal(
-                size=arr.shape, scale=scale).astype(np.float64)
-    for n, arr in exe_list[0].aux_dict.items():
-        if n not in aux_params:
-            aux_params[n] = 0
+        arg_params.setdefault(n, np.random.normal(
+            size=arr.shape, scale=scale).astype(np.float64))
+    for n in exe_list[0].aux_dict:
+        aux_params.setdefault(n, 0)
     for exe in exe_list:
         for name, arr in exe.arg_dict.items():
             arr[:] = arg_params[name].astype(str(arr.dtype))
         for name, arr in exe.aux_dict.items():
             arr[:] = aux_params[name]
 
-    gt = ground_truth
+    def cross_check(tag, per_exe_values, truth, skip_idx):
+        for i, values in enumerate(per_exe_values):
+            if i == skip_idx:
+                continue
+            t = tol[dtypes[i]]
+            for name, got in values:
+                if got is None:
+                    continue
+                try:
+                    assert_almost_equal(got.asnumpy(), truth[name],
+                                        rtol=t, atol=t)
+                except AssertionError as e:
+                    print(f"{tag} Err: ctx {i} vs ctx {max_idx} at {name}")
+                    print(e)
+                    if raise_on_err:
+                        raise
 
-    # forward
     for exe in exe_list:
         exe.forward(is_train=(grad_req != "null"))
     dtypes = [np.dtype(str(exe.outputs[0].dtype)) for exe in exe_list]
     max_idx = int(np.argmax([dt.itemsize for dt in dtypes]))
+    gt = ground_truth
     if gt is None:
         gt = {n: v.asnumpy() for n, v in
               zip(output_names, exe_list[max_idx].outputs)}
-    for i, exe in enumerate(exe_list):
-        if i == max_idx and ground_truth is None:
-            continue
-        rtol = atol = tol[dtypes[i]]
-        for name, arr in zip(output_names, exe.outputs):
-            try:
-                assert_almost_equal(arr.asnumpy(), gt[name], rtol=rtol,
-                                    atol=atol)
-            except AssertionError as e:
-                print(f"Predict Err: ctx {i} vs ctx {max_idx} at {name}")
-                print(e)
-                if raise_on_err:
-                    raise
+    cross_check("Predict",
+                [list(zip(output_names, exe.outputs)) for exe in exe_list],
+                gt, max_idx if ground_truth is None else -1)
 
-    # backward
     if grad_req != "null":
-        out_grads_npy = [np.random.normal(size=gt[n].shape)
-                         for n in output_names]
-        for exe, ctx in zip(exe_list, ctx_list):
-            exe.backward([nd.array(g, ctx=ctx["ctx"], dtype=str(o.dtype))
-                          for g, o in zip(out_grads_npy, exe.outputs)])
+        head_grads = [np.random.normal(size=gt[n].shape)
+                      for n in output_names]
+        for exe, spec in zip(exe_list, ctx_list):
+            exe.backward([nd.array(g, ctx=spec["ctx"], dtype=str(o.dtype))
+                          for g, o in zip(head_grads, exe.outputs)])
         gt_grad = {n: v.asnumpy() for n, v in
-                   zip(arg_names, exe_list[max_idx].grad_arrays) if v is not None}
-        for i, exe in enumerate(exe_list):
-            if i == max_idx:
-                continue
-            rtol = atol = tol[dtypes[i]]
-            for name, arr in zip(arg_names, exe.grad_arrays):
-                if arr is None:
-                    continue
-                try:
-                    assert_almost_equal(arr.asnumpy(), gt_grad[name],
-                                        rtol=rtol, atol=atol)
-                except AssertionError as e:
-                    print(f"Train Err: ctx {i} vs ctx {max_idx} at {name}")
-                    print(e)
-                    if raise_on_err:
-                        raise
+                   zip(arg_names, exe_list[max_idx].grad_arrays)
+                   if v is not None}
+        cross_check("Train",
+                    [list(zip(arg_names, exe.grad_arrays))
+                     for exe in exe_list],
+                    gt_grad, max_idx)
     return gt
 
 
@@ -554,8 +537,7 @@ def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
                 typ="whole", **kwargs):
     """Time forward(+backward) throughput of a symbol (reference :913)."""
     ctx = ctx or default_context()
-    if grad_req is None:
-        grad_req = "write"
+    grad_req = grad_req or "write"
     if location is None:
         exe = sym.simple_bind(grad_req=grad_req, ctx=ctx, **kwargs)
         location = {k: np.random.normal(size=arr.shape, scale=1.0)
@@ -563,35 +545,45 @@ def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
     else:
         exe = sym.simple_bind(grad_req=grad_req, ctx=ctx,
                               **{k: v.shape for k, v in location.items()})
-    for name, iarr in location.items():
-        exe.arg_dict[name][:] = iarr.astype(str(exe.arg_dict[name].dtype))
+    for name, host in location.items():
+        exe.arg_dict[name][:] = host.astype(str(exe.arg_dict[name].dtype))
 
-    if typ == "whole":
-        exe.forward(is_train=True)
-        exe.backward(out_grads=exe.outputs)
-        for output in exe.outputs:
-            output.wait_to_read()
-        tic = time.time()
-        for _ in range(N):
-            exe.forward(is_train=True)
+    if typ not in ("whole", "forward"):
+        raise ValueError(f"typ can only be 'whole' or 'forward', got {typ}")
+
+    def one_pass():
+        exe.forward(is_train=(typ == "whole"))
+        if typ == "whole":
             exe.backward(out_grads=exe.outputs)
+
+    def drain():
         for output in exe.outputs:
             output.wait_to_read()
-        return (time.time() - tic) / N
-    elif typ == "forward":
-        exe.forward(is_train=False)
-        for output in exe.outputs:
-            output.wait_to_read()
-        tic = time.time()
-        for _ in range(N):
-            exe.forward(is_train=False)
-        for output in exe.outputs:
-            output.wait_to_read()
-        return (time.time() - tic) / N
-    raise ValueError(f"typ can only be 'whole' or 'forward', got {typ}")
+
+    one_pass()  # warm (compile) outside the timed region
+    drain()
+    tic = time.time()
+    for _ in range(N):
+        one_pass()
+    drain()
+    return (time.time() - tic) / N
 
 
 # -- datasets ----------------------------------------------------------------
+
+
+def _read_idx(path):
+    """One MNIST idx file → numpy array. The format is a big-endian
+    header (magic byte 3 = dtype code, byte 4 = rank) then dims then raw
+    data; everything parses as numpy views, no struct module."""
+    import gzip
+    with gzip.open(path, "rb") as f:
+        blob = f.read()
+    magic = np.frombuffer(blob[:4], ">u1")
+    rank = int(magic[3])
+    dims = np.frombuffer(blob[4:4 + 4 * rank], ">u4").astype(int)
+    body = np.frombuffer(blob[4 + 4 * rank:], np.uint8)
+    return body.reshape(dims)
 
 
 def get_mnist(path=None):
@@ -599,33 +591,24 @@ def get_mnist(path=None):
     stand-in when the files are absent (zero-egress environment; reference
     :1197 downloads from the web)."""
     path = path or os.environ.get("MXNET_TPU_MNIST", "data/mnist")
-    import gzip
-    import struct
-
-    def read_data(label_path, image_path):
-        with gzip.open(label_path) as flbl:
-            struct.unpack(">II", flbl.read(8))
-            label = np.frombuffer(flbl.read(), dtype=np.int8)
-        with gzip.open(image_path, "rb") as fimg:
-            _, _, rows, cols = struct.unpack(">IIII", fimg.read(16))
-            image = np.frombuffer(
-                fimg.read(), dtype=np.uint8).reshape(len(label), rows, cols)
-            image = image.reshape(
-                image.shape[0], 1, 28, 28).astype(np.float32) / 255
-        return label, image
-
-    files = ["train-labels-idx1-ubyte.gz", "train-images-idx3-ubyte.gz",
-             "t10k-labels-idx1-ubyte.gz", "t10k-images-idx3-ubyte.gz"]
-    if all(os.path.exists(os.path.join(path, f)) for f in files):
-        train_lbl, train_img = read_data(os.path.join(path, files[0]),
-                                         os.path.join(path, files[1]))
-        test_lbl, test_img = read_data(os.path.join(path, files[2]),
-                                       os.path.join(path, files[3]))
-    else:
-        train_lbl, train_img = synthetic_mnist(6000, seed=42)
-        test_lbl, test_img = synthetic_mnist(1000, seed=43)
-    return {"train_data": train_img, "train_label": train_lbl,
-            "test_data": test_img, "test_label": test_lbl}
+    splits = {"train": ("train-labels-idx1-ubyte.gz",
+                        "train-images-idx3-ubyte.gz"),
+              "test": ("t10k-labels-idx1-ubyte.gz",
+                       "t10k-images-idx3-ubyte.gz")}
+    have_files = all(os.path.exists(os.path.join(path, f))
+                     for pair in splits.values() for f in pair)
+    out = {}
+    for split, (lbl_file, img_file) in splits.items():
+        if have_files:
+            lbl = _read_idx(os.path.join(path, lbl_file)).astype(np.int8)
+            img = (_read_idx(os.path.join(path, img_file))
+                   .reshape(-1, 1, 28, 28).astype(np.float32) / 255)
+        else:
+            lbl, img = synthetic_mnist(6000 if split == "train" else 1000,
+                                       seed=42 if split == "train" else 43)
+        out[f"{split}_data"] = img
+        out[f"{split}_label"] = lbl
+    return out
 
 
 def synthetic_mnist(n, seed=42):
@@ -642,14 +625,13 @@ def synthetic_mnist(n, seed=42):
 def list_gpus():
     """Reference :1126 — GPUs don't exist here; report TPU count instead."""
     import jax
-    return list(range(len([d for d in jax.devices()
-                           if d.platform == "tpu"])))
+    return list(range(sum(d.platform == "tpu" for d in jax.devices())))
 
 
 def download(url, fname=None, dirname=None, overwrite=False):
     """Reference :1144. Zero-egress environment: only serves files already
     present on disk; raises otherwise."""
-    fname = fname or url.split("/")[-1]
+    fname = fname or url.rsplit("/", 1)[-1]
     if dirname is not None:
         fname = os.path.join(dirname, fname)
     if os.path.exists(fname) and not overwrite:

@@ -4,8 +4,9 @@ package AI::MXNetTPU::Module::Bucketing;
 # perl-package/AI-MXNet/lib/AI/MXNet/Module/Bucketing.pm). Variable-
 # length sequence training without dynamic shapes: ``sym_gen`` builds a
 # symbol per bucket key (an unrolled length); one executor per bucket is
-# bound lazily, every bucket sharing the SAME parameter/grad NDArrays
-# (binding by name), so an update through any bucket advances them all.
+# bound lazily, every bucket sharing the SAME parameter/grad/aux-state
+# NDArrays (binding by name), so an update through any bucket — and any
+# BatchNorm moving statistic it accumulates — advances them all.
 
 use strict;
 use warnings;
@@ -56,7 +57,11 @@ sub bind {
     $self->{params} = \%arrays;
     $self->{param_grads} = \%grads;
     $self->{param_names} = [sort keys %arrays];
-    $self->{aux_shapes_known} = {};
+    # aux states (BatchNorm moving stats) allocated once from the default
+    # bucket and shared by every bucket's executor, like parameters
+    my $aux_names = $sym->list_auxiliary_states;
+    $self->{aux} = { map { $aux_names->[$_] =>
+        AI::MXNetTPU::NDArray->zeros($aux->[$_]) } 0 .. $#$aux_names };
     $self->{batch} = $kw{data_shape}[0];
     $self->switch_bucket($key, $kw{data_shape}, $kw{label_shape});
     $self;
@@ -88,9 +93,11 @@ sub switch_bucket {
                 $reqs{$n} = 'write';
             }
         }
-        my $aux_names = $sym->list_auxiliary_states;
-        $auxs{ $aux_names->[$_] } =
-            AI::MXNetTPU::NDArray->zeros($aux->[$_]) for 0 .. $#$aux_names;
+        for my $an (@{ $sym->list_auxiliary_states }) {
+            croak "bucket $key introduces auxiliary state $an absent "
+                . "from the default bucket" unless $self->{aux}{$an};
+            $auxs{$an} = $self->{aux}{$an};
+        }
         $self->{execs}{$key} = {
             exec => $sym->bind(args => \%arrays, grads => \%grads,
                                grad_req => \%reqs, aux => \%auxs),

@@ -183,7 +183,9 @@ sub call {
 
 package AI::MXNetTPU::RNN::SequentialRNNCell;
 
-# stack of cells applied in order each step
+# stack of cells applied in order each step; unroll comes from the base
+# Cell (same call/begin_state interface)
+our @ISA = ('AI::MXNetTPU::RNN::Cell');
 use Carp qw(croak);
 
 sub new { bless { cells => [] }, $_[0] }
@@ -208,18 +210,6 @@ sub call {
         $i += $n;
     }
     ($o, \@next);
-}
-
-sub unroll {
-    my ($self, $length, $inputs, %kw) = @_;
-    croak "unroll needs $length inputs" unless @$inputs == $length;
-    my $states = $kw{begin_state} // $self->begin_state;
-    my @outs;
-    for my $t (0 .. $length - 1) {
-        (my $o, $states) = $self->call($inputs->[$t], $states);
-        push @outs, $o;
-    }
-    (\@outs, $states);
 }
 
 sub reset { $_->reset for @{ $_[0]{cells} } }

@@ -60,6 +60,11 @@ _CASES = [
                             "--eval-scales", "64,96"]),
     ("rcnn/train_alternate.py", ["--map-gate", "0.4"]),
     ("rcnn/demo.py", []),
+    ("kaggle-ndsb2/train_ndsb2.py", []),
+    ("python-howto/debug_conv.py", []),
+    ("python-howto/multiple_outputs.py", []),
+    ("python-howto/monitor_weights.py", []),
+    ("python-howto/data_iter.py", []),
     ("profiler/profile_training.py", ["--iters", "5"]),
     ("parallel/sequence_parallel_attention.py",
      ["--seq-len", "512", "--heads", "8", "--head-dim", "16"]),
