@@ -25,8 +25,47 @@ def greedy_decode(probs_tnc):
     return out
 
 
-def beam_decode(probs_tc, beam=4):
-    """Prefix beam search over one utterance's (T, C) posteriors."""
+class CharLM:
+    """Character (symbol-id) bigram language model with add-k smoothing.
+
+    The shallow-fusion score source (reference systems fuse a KenLM at
+    decode time, speech_recognition README "language model"): fit on
+    the TRAIN transcripts, consulted per emitted symbol during the
+    prefix beam search. Symbol 0 doubles as the start-of-sequence
+    context."""
+
+    def __init__(self, num_symbols, k=0.5):
+        self._counts = np.full((num_symbols, num_symbols), k, np.float64)
+
+    def fit(self, transcripts):
+        for seq in transcripts:
+            prev = 0
+            for s in seq:
+                self._counts[prev, int(s)] += 1.0
+                prev = int(s)
+        self._logp = np.log(self._counts
+                            / self._counts.sum(1, keepdims=True))
+        return self
+
+    def logp(self, sym, prev):
+        return float(self._logp[int(prev), int(sym)])
+
+
+def beam_decode(probs_tc, beam=4, lm=None, alpha=0.6, beta=0.4):
+    """Prefix beam search over one utterance's (T, C) posteriors.
+
+    With ``lm``, shallow fusion: each symbol emission is additionally
+    weighted by exp(alpha * lm.logp(c | prev) + beta) — alpha scales the
+    LM opinion, beta is the insertion bonus that counteracts the LM's
+    length penalty (the standard fusion scoring). The (prev, c) weight
+    table is materialized once per decode, not per step."""
+    lm_w = (np.exp(alpha * lm._logp + beta) if lm is not None else None)
+
+    def fused(prefix, c, p_c):
+        if lm_w is None:
+            return p_c
+        return p_c * lm_w[prefix[-1] if prefix else 0, c]
+
     # prefix -> (p_blank, p_nonblank)
     beams = {(): (1.0, 0.0)}
     for t in range(probs_tc.shape[0]):
@@ -43,9 +82,10 @@ def beam_decode(probs_tc, beam=4):
                 add(prefix, 0.0, pnb * p[prefix[-1]])    # repeat last
             for c in range(1, probs_tc.shape[1]):
                 if prefix and c == prefix[-1]:
-                    add(prefix + (c,), 0.0, pb * p[c])
+                    add(prefix + (c,), 0.0, pb * fused(prefix, c, p[c]))
                 else:
-                    add(prefix + (c,), 0.0, (pb + pnb) * p[c])
+                    add(prefix + (c,), 0.0,
+                        (pb + pnb) * fused(prefix, c, p[c]))
         beams = dict(sorted(nxt.items(), key=lambda kv: -sum(kv[1]))[:beam])
     return list(max(beams.items(), key=lambda kv: sum(kv[1]))[0])
 
@@ -77,8 +117,9 @@ class CTCErrorMetric(mx.metric.EvalMetric):
             self.num_inst += 1
 
 
-def evaluate(mod, it, beam):
-    """(greedy CER, WER over beam-decoded words, utterances scored)."""
+def evaluate(mod, it, beam, lm=None, alpha=0.6, beta=0.4):
+    """(greedy CER, WER over beam-decoded words, utterances scored).
+    ``lm`` enables shallow-fusion decoding (see beam_decode)."""
     cer_n = cer_d = 0
     wer_n = wer_d = 0
     scored = 0
@@ -92,7 +133,8 @@ def evaluate(mod, it, beam):
             ref = [int(s) for s in y[i] if s != 0]
             cer_n += edit_distance(hyps_g[i], ref)
             cer_d += max(len(ref), 1)
-            hyp_b = beam_decode(probs[:, i, :], beam=beam)
+            hyp_b = beam_decode(probs[:, i, :], beam=beam, lm=lm,
+                                alpha=alpha, beta=beta)
             rw, hw = words_of(ref), words_of(hyp_b)
             wer_n += edit_distance(hw, rw)
             wer_d += max(len(rw), 1)

@@ -34,7 +34,7 @@ from arch import make_sym_gen  # noqa: E402
 from config_util import load_config, section  # noqa: E402
 from data import (FeatureNormalizer, SpeechBucketIter,  # noqa: E402
                   make_utterance)
-from metric import CTCErrorMetric, evaluate  # noqa: E402
+from metric import CharLM, CTCErrorMetric, evaluate  # noqa: E402
 
 _DEFAULT_CFG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "default.cfg")
@@ -58,7 +58,8 @@ def build_data(cfg, batch_size, norm="fit"):
                                 normalizer=norm)
     eval_it = SpeechBucketIter(utts[:n_eval], batch_size, buckets,
                                allow_partial=True, normalizer=norm)
-    return train_it, eval_it, n_eval, norm
+    train_transcripts = [s for _, s in utts[n_eval:]]
+    return train_it, eval_it, n_eval, norm, train_transcripts
 
 
 def save_checkpoint(path, mod, norm):
@@ -122,10 +123,11 @@ def main():
         # always wins — evaluating with a mismatched normalizer silently
         # destroys WER — and no fresh normalizer fit is wasted
         args_p, aux_p, saved_norm = load_checkpoint(args.checkpoint)
-        train_it, eval_it, n_eval, norm = build_data(cfg, batch_size,
-                                                     norm=saved_norm)
+        (train_it, eval_it, n_eval, norm,
+         transcripts) = build_data(cfg, batch_size, norm=saved_norm)
     else:
-        train_it, eval_it, n_eval, norm = build_data(cfg, batch_size)
+        (train_it, eval_it, n_eval, norm,
+         transcripts) = build_data(cfg, batch_size)
 
     mod = mx.mod.BucketingModule(
         make_sym_gen(section(cfg, "arch")),
@@ -152,6 +154,21 @@ def main():
           f"(beam={xcfg['beam']}, {scored} utterances)")
     gate = float(xcfg["wer_gate"])
     assert wer <= gate, f"WER {wer:.3f} above gate {gate}"
+
+    # shallow LM fusion (reference decode-time KenLM): a bigram fit on
+    # the TRAIN transcripts re-weights symbol emissions in the beam;
+    # fused WER must not degrade the acoustic-only number on held-out
+    if xcfg.get("use_lm", "true").lower() == "true":
+        from data import N_CLASSES
+        lm = CharLM(N_CLASSES).fit(transcripts)
+        _, wer_lm, _ = evaluate(
+            mod, eval_it, int(xcfg["beam"]), lm=lm,
+            alpha=float(xcfg.get("lm_alpha", "0.6")),
+            beta=float(xcfg.get("lm_beta", "0.4")))
+        print(f"held-out WER with LM fusion {wer_lm:.3f} "
+              f"(alpha={xcfg.get('lm_alpha', '0.6')})")
+        assert wer_lm <= wer + 0.02, \
+            f"LM fusion degraded WER: {wer_lm:.3f} vs {wer:.3f}"
 
 
 if __name__ == "__main__":

@@ -34,6 +34,11 @@ use AI::MXNetTPU::Metric;
 use AI::MXNetTPU::Callback;
 use AI::MXNetTPU::LRScheduler;
 use AI::MXNetTPU::RNN;
+use AI::MXNetTPU::Monitor;
+use AI::MXNetTPU::Visualization;
+use AI::MXNetTPU::TestUtils;
+use AI::MXNetTPU::Context;
+use AI::MXNetTPU::Random;
 
 sub version { AI::MXNetTPU::mxp_version() }
 sub seed    { AI::MXNetTPU::mxp_random_seed($_[1] // $_[0]) }
@@ -50,5 +55,9 @@ sub init      { 'AI::MXNetTPU::Initializer' }
 sub metric    { 'AI::MXNetTPU::Metric' }
 sub callback  { 'AI::MXNetTPU::Callback' }
 sub rnn       { 'AI::MXNetTPU::RNN' }
+sub mon       { 'AI::MXNetTPU::Monitor' }
+sub viz       { 'AI::MXNetTPU::Visualization' }
+sub context   { 'AI::MXNetTPU::Context' }
+sub random    { 'AI::MXNetTPU::Random' }
 
 1;

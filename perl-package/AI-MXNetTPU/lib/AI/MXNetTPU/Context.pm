@@ -1,0 +1,38 @@
+package AI::MXNetTPU::Context;
+
+# Device context (reference: AI::MXNet::Context,
+# perl-package/AI-MXNet/lib/AI/MXNet/Context.pm). The rebuild's ABI is
+# device-transparent (XLA owns placement), so Context is the naming
+# surface: cpu()/gpu()/tpu() constructors, device_type/device_id, and a
+# current-context stack for API parity with scripts that scope work
+# under `with` blocks.
+
+use strict;
+use warnings;
+
+my @STACK = ();
+
+sub new {
+    my ($class, $type, $id) = @_;
+    bless { device_type => $type // 'tpu', device_id => $id // 0 },
+        ref($class) || $class;
+}
+
+sub cpu { __PACKAGE__->new('cpu', $_[1] // 0) }
+sub gpu { __PACKAGE__->new('gpu', $_[1] // 0) }
+sub tpu { __PACKAGE__->new('tpu', $_[1] // 0) }
+
+sub device_type { $_[0]{device_type} }
+sub device_id   { $_[0]{device_id} }
+
+sub current { @STACK ? $STACK[-1] : __PACKAGE__->new }
+
+sub push_ctx { push @STACK, $_[1]; $_[1] }
+sub pop_ctx  { pop @STACK }
+
+use overload
+    '""' => sub { "$_[0]{device_type}($_[0]{device_id})" },
+    '==' => sub { "$_[0]" eq "$_[1]" },
+    'eq' => sub { "$_[0]" eq "$_[1]" };
+
+1;

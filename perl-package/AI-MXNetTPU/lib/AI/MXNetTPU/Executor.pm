@@ -40,6 +40,7 @@ sub bind {
 sub forward {
     my ($self, $is_train) = @_;
     AI::MXNetTPU::mxp_executor_forward($self->{handle}, $is_train ? 1 : 0);
+    $_->_observe($self) for @{ $self->{_monitors} // [] };
     $self;
 }
 

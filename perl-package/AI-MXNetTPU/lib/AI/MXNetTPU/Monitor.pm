@@ -1,0 +1,72 @@
+package AI::MXNetTPU::Monitor;
+
+# Executor output monitor (reference: AI::MXNet::Monitor,
+# perl-package/AI-MXNet/lib/AI/MXNet/Monitor.pm). Captures a statistic
+# of every executor output each `interval` forwards between tic/toc;
+# install() hooks an Executor so Module code needs no changes.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+# new(interval, stat_func): stat_func maps an NDArray to a scalar (or
+# NDArray); default = mean absolute value
+sub new {
+    my ($class, $interval, $stat) = @_;
+    bless {
+        interval => $interval // 1,
+        stat => $stat // sub {
+            my ($arr) = @_;
+            my $v = $arr->values;
+            my $s = 0;
+            $s += abs($_) for @$v;
+            @$v ? $s / @$v : 0;
+        },
+        step => 0, active => 0, queue => [],
+    }, $class;
+}
+
+sub install {
+    my ($self, $exec) = @_;
+    push @{ $exec->{_monitors} //= [] }, $self;
+    $self;
+}
+
+sub tic {
+    my ($self) = @_;
+    $self->{active} = 1;
+    $self->{step} = 0;   # each tic/toc window samples from its own start
+    $self->{queue} = [];
+    $self;
+}
+
+# called by Executor->forward after each run
+sub _observe {
+    my ($self, $exec) = @_;
+    return unless $self->{active};
+    ++$self->{step};
+    return if ($self->{step} - 1) % $self->{interval};
+    my $outs = $exec->outputs;
+    for my $i (0 .. $#$outs) {
+        push @{ $self->{queue} },
+            [$self->{step}, "output$i", $self->{stat}->($outs->[$i])];
+    }
+}
+
+sub toc {
+    my ($self) = @_;
+    $self->{active} = 0;
+    my $q = $self->{queue};
+    $self->{queue} = [];
+    $q;
+}
+
+sub toc_print {
+    my ($self) = @_;
+    for my $row (@{ $self->toc }) {
+        my ($step, $name, $val) = @$row;
+        printf "Batch: %7d %30s %s\n", $step, $name, $val;
+    }
+}
+
+1;

@@ -1,0 +1,31 @@
+package AI::MXNetTPU::Random;
+
+# Device random sampling (reference: AI::MXNet::Random,
+# perl-package/AI-MXNet/lib/AI/MXNet/Random.pm). seed() goes through the
+# ABI (MXRandomSeed analog); uniform/normal draw on-device through the
+# registered sampling ops via NDArray->invoke — no host RNG round trip.
+
+use strict;
+use warnings;
+
+sub seed { AI::MXNetTPU::mxp_random_seed($_[1] // $_[0]) }
+
+# uniform(low, high, shape) -> NDArray
+sub uniform {
+    my ($class, $low, $high, $shape) = @_;
+    AI::MXNetTPU::NDArray->invoke(
+        '_random_uniform', [],
+        { low => $low // 0, high => $high // 1,
+          shape => '(' . join(',', @$shape) . ')' });
+}
+
+# normal(loc, scale, shape) -> NDArray
+sub normal {
+    my ($class, $loc, $scale, $shape) = @_;
+    AI::MXNetTPU::NDArray->invoke(
+        '_random_normal', [],
+        { loc => $loc // 0, scale => $scale // 1,
+          shape => '(' . join(',', @$shape) . ')' });
+}
+
+1;

@@ -1,0 +1,50 @@
+package AI::MXNetTPU::TestUtils;
+
+# Test helpers (reference: AI::MXNet::TestUtils,
+# perl-package/AI-MXNet/lib/AI/MXNet/TestUtils.pm) — the comparison and
+# random-data functions the perl test scripts share.
+
+use strict;
+use warnings;
+use Exporter 'import';
+
+our @EXPORT_OK = qw(same almost_equal reldiff rand_ndarray zip_arrays);
+
+sub same {
+    my ($a, $b) = @_;
+    return 0 unless @$a == @$b;
+    $a->[$_] == $b->[$_] or return 0 for 0 .. $#$a;
+    1;
+}
+
+sub reldiff {
+    my ($a, $b) = @_;
+    return 1 unless @$a == @$b;   # length mismatch = maximal difference
+    my ($num, $den) = (0, 0);
+    for my $i (0 .. $#$a) {
+        $num += abs($a->[$i] - $b->[$i]);
+        $den += abs($a->[$i]) + abs($b->[$i]);
+    }
+    $den ? $num / $den : 0;
+}
+
+sub almost_equal {
+    my ($a, $b, $tol) = @_;
+    reldiff($a, $b) <= ($tol // 1e-6);
+}
+
+sub rand_ndarray {
+    my ($shape, $scale) = @_;
+    $scale //= 1;
+    my $n = 1;
+    $n *= $_ for @$shape;
+    AI::MXNetTPU::NDArray->array(
+        [map { (rand(2) - 1) * $scale } 1 .. $n], $shape);
+}
+
+sub zip_arrays {
+    my ($a, $b) = @_;
+    map { [$a->[$_], $b->[$_]] } 0 .. $#$a;
+}
+
+1;

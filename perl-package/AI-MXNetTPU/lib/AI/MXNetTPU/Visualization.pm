@@ -1,0 +1,50 @@
+package AI::MXNetTPU::Visualization;
+
+# Network summary printing (reference: AI::MXNet::Visualization,
+# perl-package/AI-MXNet/lib/AI/MXNet/Visualization.pm print_summary).
+# Walks the symbol's JSON graph and prints one row per op node with the
+# shapes of its parameter inputs and its parameter count; returns the
+# total parameter count.
+
+use strict;
+use warnings;
+use Carp qw(croak);
+use JSON::PP ();
+
+sub print_summary {
+    my ($class, $symbol, %shapes) = @_;
+    my $graph = JSON::PP::decode_json($symbol->tojson);
+    my $nodes = $graph->{nodes};
+
+    my ($arg_shapes) = $symbol->infer_shape(%shapes);
+    my $arg_names = $symbol->list_arguments;
+    my %arg_shape;
+    $arg_shape{ $arg_names->[$_] } = $arg_shapes->[$_]
+        for 0 .. $#$arg_names;
+
+    my $line = '-' x 68;
+    printf "%s\n%-28s %-22s %-12s\n%s\n", $line,
+        'Layer (type)', 'Param Shapes', 'Param #', $line;
+    my $total = 0;
+    for my $node (@$nodes) {
+        next if $node->{op} eq 'null';
+        my ($params, @pshapes) = (0);
+        for my $in (@{ $node->{inputs} }) {
+            my $src = $nodes->[ $in->[0] ];
+            next unless $src->{op} eq 'null';
+            my $shape = $arg_shape{ $src->{name} } or next;
+            next if $src->{name} =~ /^(?:data|.*_label)$/;
+            my $n = 1;
+            $n *= $_ for @$shape;
+            $params += $n;
+            push @pshapes, '(' . join('x', @$shape) . ')';
+        }
+        $total += $params;
+        printf "%-28s %-22s %-12d\n",
+            "$node->{name} ($node->{op})", join(' ', @pshapes), $params;
+    }
+    print "$line\nTotal params: $total\n$line\n";
+    $total;
+}
+
+1;

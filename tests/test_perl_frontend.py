@@ -157,3 +157,53 @@ def test_perl_lstm_bucketing_converges(perl_ext):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "final accuracy" in proc.stdout
     assert "ok" in proc.stdout.splitlines()[-1]
+
+
+def test_perl_utility_module_tier(perl_ext, tmp_path):
+    """Round-5 tier-2 modules: Random (device sampling ops through the
+    ABI), Context, TestUtils, Monitor (executor hook), Visualization
+    (JSON-graph summary) — the remaining AI::MXNet module families the
+    perl frontend was missing."""
+    script = tmp_path / "tier2.t.pl"
+    script.write_text(r"""
+use strict; use warnings;
+use AI::MXNetTPU;
+my $fail = 0;
+sub ok_ { my ($c, $m) = @_; print(($c ? "ok" : "FAIL"), " - $m\n"); $fail |= !$c }
+
+AI::MXNetTPU::Random->seed(5);
+my $u = AI::MXNetTPU::Random->uniform(0, 1, [4, 4]);
+ok_($u->size == 16, "uniform shape");
+ok_((grep { $_ >= 0 && $_ <= 1 } @{$u->values}) == 16, "uniform range");
+my $nrm = AI::MXNetTPU::Random->normal(0, 1, [1000]);
+my $m = 0; $m += $_ for @{$nrm->values}; $m /= 1000;
+ok_(abs($m) < 0.2, "normal mean ~0");
+
+my $ctx = AI::MXNetTPU::Context->cpu(0);
+ok_("$ctx" eq "cpu(0)", "context stringify");
+
+use AI::MXNetTPU::TestUtils qw(same almost_equal rand_ndarray);
+ok_(same([1,2,3],[1,2,3]), "same");
+ok_(almost_equal([1,2],[1.0000001,2], 1e-5), "almost_equal");
+ok_(rand_ndarray([2,3])->size == 6, "rand_ndarray");
+
+my $S = 'AI::MXNetTPU::Symbol';
+my $x = $S->Variable('data');
+my $fc = $S->FullyConnected($x, name => 'fc', num_hidden => 3);
+my %args = (data => AI::MXNetTPU::NDArray->array([1,2,3,4], [2,2]),
+            fc_weight => AI::MXNetTPU::NDArray->array([(0.1) x 6], [3,2]),
+            fc_bias => AI::MXNetTPU::NDArray->array([0,0,0], [3]));
+my $ex = $fc->bind(args => \%args, grads => {}, grad_req => 'null');
+my $mon = AI::MXNetTPU::Monitor->new(1);
+$mon->install($ex);
+$mon->tic;
+$ex->forward(0); $ex->forward(0);
+ok_(scalar(@{$mon->toc}) == 2, "monitor captured");
+ok_(AI::MXNetTPU::Visualization->print_summary($fc, data => [2,2]) == 9,
+    "print_summary params");
+print $fail ? "TIER2 FAIL\n" : "TIER2 PASS\n";
+exit $fail;
+""")
+    proc = _run_perl(str(script))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "TIER2 PASS" in proc.stdout
