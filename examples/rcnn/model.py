@@ -150,13 +150,22 @@ def head_losses(scores, preds, lab_nd, d_nd, w_nd, n_roi):
     return cls_loss, bbox_loss
 
 
-def sample_head_batch(props, gts, rng, norm=None):
+def _per_roi_loss(scores, preds, lab_nd, d_nd, w_nd):
+    """Host vector of each roi's cls+bbox loss — the OHEM ranking key
+    (reference example/rcnn OHEM: rank by loss, keep the hardest)."""
+    cls = -nd.pick(nd.log_softmax(scores, axis=-1), lab_nd)
+    box = nd.sum(nd.smooth_l1((preds - d_nd) * w_nd, scalar=1.0), axis=-1)
+    return (cls + box).asnumpy()
+
+
+def sample_head_batch(props, gts, rng, norm=None, rois_per_image=None):
     """Sample fixed-size roi batches for every image; returns device
     arrays (rois with batch index column, labels, deltas, weights)."""
     rois, labels, bdeltas, bweights = [], [], [], []
     for i, p in enumerate(props):
         r, l, d, w = sample_roi_targets(
-            p, gts[i], len(CLASSES), rois_per_image=ROIS_PER_IMG, rng=rng,
+            p, gts[i], len(CLASSES),
+            rois_per_image=rois_per_image or ROIS_PER_IMG, rng=rng,
             norm=norm)
         rois.append(np.concatenate(
             [np.full((len(r), 1), i, np.float32), r], 1))
@@ -170,14 +179,17 @@ def sample_head_batch(props, gts, rng, norm=None):
 
 
 def train_step(net, trainer, imgs, gts, anchors, im_info, rng, norm=None,
-               im_infos=None):
+               im_infos=None, ohem=False):
     """One approximate-joint step: RPN losses + proposal sampling +
     head losses, single backward (reference train_end2end.py).
 
     ``norm`` is a BboxNorm for per-class target normalization;
     ``im_infos`` (B, 3) host rows [h, w, scale] bound the anchor-inside
     test and the Proposal clip per image (padded/multi-scale inputs) —
-    without it every image is a full IMG square."""
+    without it every image is a full IMG square. ``ohem`` switches the
+    head to online hard example mining (reference example/rcnn OHEM
+    variant): an oversampled roi batch is scored grad-free, and only the
+    ROIS_PER_IMG-per-image highest-loss rois backprop."""
     B = len(gts)
     lab = np.zeros((B, N_ANCHOR), np.float32)
     tgt = np.zeros((B, N_ANCHOR, 4), np.float32)
@@ -198,12 +210,45 @@ def train_step(net, trainer, imgs, gts, anchors, im_info, rng, norm=None,
         with mx.autograd.pause():
             cls_prob = proposal_cls_prob(cls_map.detach())
             bmap = bbox_map.detach()
+            # OHEM mines from a wide candidate set: keep 4x the usual
+            # proposals so the "hardest" selection has real choices
             props = [gen_proposals(
                 cls_prob, bmap, i,
-                info_nd if im_infos is None else info_nd[i:i + 1])
+                info_nd if im_infos is None else info_nd[i:i + 1],
+                post_nms=4 * ROIS_PER_IMG if ohem else POST_NMS)
                 for i in range(B)]
-        rois_nd, lab_nd, d_nd, w_nd = sample_head_batch(props, gts, rng,
-                                                        norm=norm)
+        if ohem:
+            # oversample 4x, score every roi grad-free, keep the
+            # hardest ROIS_PER_IMG *unique* rois per image for the real
+            # backward (sampling with replacement would otherwise rank
+            # duplicate copies, over-weighting a few rois)
+            over = 4 * ROIS_PER_IMG
+            rois_nd, lab_nd, d_nd, w_nd = sample_head_batch(
+                props, gts, rng, norm=norm, rois_per_image=over)
+            with mx.autograd.pause():
+                s0, p0 = net.head_forward(feat, rois_nd)
+                per_roi = _per_roi_loss(s0, p0, lab_nd, d_nd, w_nd)
+            rois_host = rois_nd.asnumpy()
+            keep_parts = []
+            for i in range(B):
+                lo = i * over
+                block = rois_host[lo:lo + over, 1:]
+                _, uniq = np.unique(block, axis=0, return_index=True)
+                order = uniq[np.argsort(-per_roi[lo + uniq])]
+                sel = order[:ROIS_PER_IMG]
+                if len(sel) < ROIS_PER_IMG:   # tiny pool: pad w/ hardest
+                    sel = np.concatenate(
+                        [sel, np.repeat(sel[:1], ROIS_PER_IMG - len(sel))])
+                keep_parts.append(lo + sel)
+            keep = np.concatenate(keep_parts)
+            keep_nd = nd.array(keep.astype(np.float32))
+            rois_nd = nd.take(rois_nd, keep_nd)
+            lab_nd = nd.take(lab_nd, keep_nd)
+            d_nd = nd.take(d_nd, keep_nd)
+            w_nd = nd.take(w_nd, keep_nd)
+        else:
+            rois_nd, lab_nd, d_nd, w_nd = sample_head_batch(
+                props, gts, rng, norm=norm)
         scores, preds = net.head_forward(feat, rois_nd)
         rcnn_cls_loss, rcnn_bbox_loss = head_losses(
             scores, preds, lab_nd, d_nd, w_nd, B * ROIS_PER_IMG)

@@ -75,6 +75,12 @@ def main():
     ap.add_argument("--no-bbox-norm", action="store_true",
                     help="use the fixed BBOX_STDS constants instead of "
                     "per-class statistics")
+    ap.add_argument("--ohem", action="store_true",
+                    help="online hard example mining in the head "
+                    "(oversample 4x, backprop the hardest rois)")
+    ap.add_argument("--scale-jitter", action="store_true",
+                    help="multi-scale training: scenes shrunk onto the "
+                    "canvas with per-image im_info bounds")
     ap.add_argument("--save-prefix", default=None,
                     help="write <prefix>-NNNN.params + <prefix>.norm.npz "
                     "each epoch")
@@ -126,8 +132,31 @@ def main():
         sums = np.zeros(4)
         n_batches = 0
         for imgs, gts in db.batches(args.batch_size, rng):
+            im_infos = None
+            if args.scale_jitter:
+                # genuine multi-scale: shrink the scene onto a corner of
+                # the IMG canvas, so objects really change size relative
+                # to the anchors; im_info bounds the valid (src x src)
+                # region for anchor assignment and the Proposal clip
+                # (the reference's multi-scale loader contract)
+                jit_imgs, jit_gts, im_infos = [], [], []
+                for img, gt in zip(imgs, gts):
+                    s = rng.uniform(0.6, 1.0)
+                    src = max(8, int(round(IMG * s)))
+                    ys = (np.arange(src) * IMG / src).astype(int)
+                    canvas = np.zeros_like(img)
+                    canvas[:, :src, :src] = img[:, ys][:, :, ys]
+                    g = gt.copy()
+                    if len(g):
+                        g[:, 1:5] = g[:, 1:5] * (src / IMG)
+                    jit_imgs.append(canvas)
+                    jit_gts.append(g)
+                    im_infos.append(
+                        np.array([src, src, 1.0], np.float32))
+                imgs, gts = np.stack(jit_imgs), jit_gts
             sums += train_step(net, trainer, imgs, gts, anchors, im_info,
-                               rng, norm=norm)
+                               rng, norm=norm, im_infos=im_infos,
+                               ohem=args.ohem)
             n_batches += 1
         sums /= n_batches
         speed = n_batches * args.batch_size / (time.time() - tic)

@@ -117,11 +117,18 @@ class CTCErrorMetric(mx.metric.EvalMetric):
             self.num_inst += 1
 
 
-def evaluate(mod, it, beam, lm=None, alpha=0.6, beta=0.4):
+def evaluate(mod, it, beam, lm=None, alpha=0.6, beta=0.4,
+             also_plain=False):
     """(greedy CER, WER over beam-decoded words, utterances scored).
-    ``lm`` enables shallow-fusion decoding (see beam_decode)."""
+
+    ``lm`` enables shallow-fusion decoding (see beam_decode). With
+    ``also_plain`` the acoustic forward runs ONCE and each utterance's
+    posteriors are beam-decoded twice — plain and fused — returning
+    (cer, wer_plain, wer_fused, scored)."""
     cer_n = cer_d = 0
-    wer_n = wer_d = 0
+    wer = {False: [0, 0], True: [0, 0]}   # fused? -> [errors, words]
+    variants = [(False, None)] if lm is None else (
+        [(False, None), (True, lm)] if also_plain else [(True, lm)])
     scored = 0
     it.reset()
     for batch in it:
@@ -133,12 +140,17 @@ def evaluate(mod, it, beam, lm=None, alpha=0.6, beta=0.4):
             ref = [int(s) for s in y[i] if s != 0]
             cer_n += edit_distance(hyps_g[i], ref)
             cer_d += max(len(ref), 1)
-            hyp_b = beam_decode(probs[:, i, :], beam=beam, lm=lm,
-                                alpha=alpha, beta=beta)
-            rw, hw = words_of(ref), words_of(hyp_b)
-            wer_n += edit_distance(hw, rw)
-            wer_d += max(len(rw), 1)
+            rw = words_of(ref)
+            for fused, use_lm in variants:
+                hyp = beam_decode(probs[:, i, :], beam=beam, lm=use_lm,
+                                  alpha=alpha, beta=beta)
+                wer[fused][0] += edit_distance(words_of(hyp), rw)
+                wer[fused][1] += max(len(rw), 1)
             scored += 1
-    if wer_d == 0:
+    if scored == 0:
         raise RuntimeError("evaluate() scored zero utterances")
-    return cer_n / cer_d, wer_n / wer_d, scored
+    if also_plain and lm is not None:
+        return (cer_n / cer_d, wer[False][0] / wer[False][1],
+                wer[True][0] / wer[True][1], scored)
+    fused = lm is not None
+    return cer_n / cer_d, wer[fused][0] / wer[fused][1], scored

@@ -148,23 +148,26 @@ def main():
         save_checkpoint(args.checkpoint, mod, norm)
         print(f"saved checkpoint {args.checkpoint}")
 
-    cer, wer, scored = evaluate(mod, eval_it, int(xcfg["beam"]))
+    # shallow LM fusion (reference decode-time KenLM): a bigram fit on
+    # the TRAIN transcripts re-weights symbol emissions in the beam;
+    # one acoustic forward serves both decodes (also_plain), and the
+    # fused WER must not degrade the acoustic-only number on held-out
+    use_lm = xcfg.get("use_lm", "true").lower() == "true"
+    if use_lm:
+        from data import N_CLASSES
+        lm = CharLM(N_CLASSES).fit(transcripts)
+        cer, wer, wer_lm, scored = evaluate(
+            mod, eval_it, int(xcfg["beam"]), lm=lm,
+            alpha=float(xcfg.get("lm_alpha", "0.6")),
+            beta=float(xcfg.get("lm_beta", "0.4")), also_plain=True)
+    else:
+        cer, wer, scored = evaluate(mod, eval_it, int(xcfg["beam"]))
     assert scored == n_eval, (scored, n_eval)
     print(f"held-out CER {cer:.3f}  WER {wer:.3f} "
           f"(beam={xcfg['beam']}, {scored} utterances)")
     gate = float(xcfg["wer_gate"])
     assert wer <= gate, f"WER {wer:.3f} above gate {gate}"
-
-    # shallow LM fusion (reference decode-time KenLM): a bigram fit on
-    # the TRAIN transcripts re-weights symbol emissions in the beam;
-    # fused WER must not degrade the acoustic-only number on held-out
-    if xcfg.get("use_lm", "true").lower() == "true":
-        from data import N_CLASSES
-        lm = CharLM(N_CLASSES).fit(transcripts)
-        _, wer_lm, _ = evaluate(
-            mod, eval_it, int(xcfg["beam"]), lm=lm,
-            alpha=float(xcfg.get("lm_alpha", "0.6")),
-            beta=float(xcfg.get("lm_beta", "0.4")))
+    if use_lm:
         print(f"held-out WER with LM fusion {wer_lm:.3f} "
               f"(alpha={xcfg.get('lm_alpha', '0.6')})")
         assert wer_lm <= wer + 0.02, \

@@ -14,7 +14,8 @@ use Carp qw(croak);
 sub new {
     my ($class, $interval, $stat) = @_;
     bless {
-        interval => $interval // 1,
+        # clamp: 0/undef both mean "every forward" (a 0 modulus would die)
+        interval => ($interval && $interval > 0) ? $interval : 1,
         stat => $stat // sub {
             my ($arr) = @_;
             my $v = $arr->values;

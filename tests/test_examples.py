@@ -56,8 +56,8 @@ _CASES = [
     ("notebooks/composite_symbol.py", []),
     ("notebooks/module_checkpointing.py", []),
     ("ssd/train_ssd.py", ["--map-gate", "0.45"]),
-    ("rcnn/train_rcnn.py", ["--map-gate", "0.45",
-                            "--eval-scales", "64,96"]),
+    ("rcnn/train_rcnn.py", ["--map-gate", "0.45", "--ohem",
+                            "--scale-jitter", "--eval-scales", "64,96"]),
     ("rcnn/train_alternate.py", ["--map-gate", "0.4"]),
     ("rcnn/demo.py", []),
     ("kaggle-ndsb2/train_ndsb2.py", []),

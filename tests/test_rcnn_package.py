@@ -201,3 +201,42 @@ def test_detect_maps_boxes_back_to_source_coords():
     for d in dets:
         x1, y1, x2, y2 = d[2:6]
         assert 0 <= x1 <= 2 * IMG - 1 and 0 <= y2 <= 2 * IMG - 1
+
+
+def test_train_step_ohem_and_scale_jitter_mechanics():
+    """OHEM head sampling + per-image im_info training: one step with
+    both options produces finite losses and updates parameters."""
+    import mxnet_tpu as mx
+    from dataset import SyntheticShapes
+    from model import (IMG, FEAT, RATIOS, SCALES, STRIDE, RCNN,
+                       default_im_info, prepare_image, train_step)
+    from rcnn_common import make_anchor_grid
+
+    mx.random.seed(11)
+    rng = np.random.RandomState(4)
+    net = RCNN()
+    trainer = mx.gluon.Trainer(net.params(), "sgd",
+                               {"learning_rate": 0.05})
+    anchors = make_anchor_grid(FEAT, FEAT, STRIDE, SCALES, RATIOS)
+    db = SyntheticShapes(2, im_size=80, seed=5)
+    imgs, gts, infos = [], [], []
+    for i in range(2):
+        img, gt = db.sample(i)
+        prepped, info = prepare_image(img)
+        g = gt.copy()
+        if len(g):
+            g[:, 1:5] = g[:, 1:5] * info[2]
+        imgs.append(prepped)
+        gts.append(g)
+        infos.append(info)
+    # first step materializes gluon's deferred-init parameters
+    losses = train_step(net, trainer, np.stack(imgs), gts, anchors,
+                        default_im_info(), rng, im_infos=infos, ohem=True)
+    assert all(np.isfinite(v) for v in losses), losses
+    before = {k: p.data().asnumpy().copy()
+              for k, p in net.params("rpn").items()}
+    losses = train_step(net, trainer, np.stack(imgs), gts, anchors,
+                        default_im_info(), rng, im_infos=infos, ohem=True)
+    assert all(np.isfinite(v) for v in losses), losses
+    after = {k: p.data().asnumpy() for k, p in net.params("rpn").items()}
+    assert any(not np.allclose(before[k], after[k]) for k in before)
