@@ -85,9 +85,15 @@ ci-frontends: ci-native
 ci-dryrun: ci-native
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+# stage 8: fault-injection smoke — crash-safe checkpoints, auto-resume,
+# retry/backoff under deterministic faults (docs/how_to/fault_tolerance.md)
+ci-resilience: ci-native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+	    -m 'not slow' -x -q
+
 ci: ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
-    ci-frontends ci-dryrun
+    ci-frontends ci-dryrun ci-resilience
 	@echo "CI matrix green"
 
 .PHONY: all clean ci ci-native ci-amalgamation ci-unit ci-examples \
-        ci-distributed ci-frontends ci-dryrun
+        ci-distributed ci-frontends ci-dryrun ci-resilience

@@ -15,7 +15,8 @@ import time
 from collections import namedtuple
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
-           "module_checkpoint", "ProgressBar", "BatchEndParam"]
+           "module_checkpoint", "ProgressBar", "BatchEndParam",
+           "ResilienceMonitor"]
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -122,6 +123,46 @@ class ProgressBar:
         fill = int(round(self.bar_len * frac))
         bar = "=" * fill + "-" * (self.bar_len - fill)
         logging.info("[%s] %d%%\r", bar, -(-100 * param.nbatch // self.total))
+
+
+class ResilienceMonitor:
+    """Speedometer-style batch-end callback surfacing the fault-tolerance
+    counters (resilience.stats()): I/O retries, retry give-ups, and
+    injected-fault fires per site. Logs every ``frequent`` batches but
+    only when a counter moved since the last report, so a healthy run
+    stays silent. The latest snapshot stays readable on ``.stats``."""
+
+    def __init__(self, frequent=50):
+        self.frequent = max(1, int(frequent))
+        self.stats = None
+        self._last_reported = None
+
+    @staticmethod
+    def _total(stats):
+        return (sum(stats["retry"]["retries"].values())
+                + sum(stats["retry"]["giveups"].values())
+                + sum(stats["faults"]["fired"].values()))
+
+    def __call__(self, param):
+        from .resilience import stats as _resilience_stats
+        self.stats = _resilience_stats()
+        if param.nbatch % self.frequent:
+            return
+        if self._last_reported is not None \
+                and self._total(self.stats) == self._total(
+                    self._last_reported):
+            return
+        self._last_reported = self.stats
+        parts = []
+        for label, n in sorted(self.stats["retry"]["retries"].items()):
+            parts.append(f"retries[{label}]={n}")
+        for label, n in sorted(self.stats["retry"]["giveups"].items()):
+            parts.append(f"giveups[{label}]={n}")
+        for site, n in sorted(self.stats["faults"]["fired"].items()):
+            parts.append(f"faults[{site}]={n}")
+        if parts:
+            logging.warning("Epoch[%d] Batch [%d]\tResilience: %s",
+                            param.epoch, param.nbatch, "\t".join(parts))
 
 
 class LogValidationMetricsCallback:

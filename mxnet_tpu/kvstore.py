@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Union
 from .base import MXNetError
 from .ndarray import NDArray
 from .optimizer import Optimizer, get_updater
+from .resilience import guarded_call, guarded_point
 
 __all__ = ["KVStore", "create"]
 
@@ -40,7 +41,20 @@ class KVStore:
         self._residuals: Dict = {}      # error-feedback state per key/slot
 
     # -- core API -----------------------------------------------------------
+    # init/push/pull/barrier run behind named fault sites under the
+    # default retry policy (resilience/). The fault points fire *before*
+    # any state mutation, so an injected fault never leaves a
+    # half-applied push behind. pull is a pure read and is retried
+    # whole; init/push/barrier are NOT — a push that fails after
+    # applying the updater to some keys must not be blindly re-run
+    # (double gradient step), and a retried barrier would issue an
+    # unmatched collective — so for those only the fault site retries
+    # and the real operation runs exactly once.
     def init(self, key, value):
+        guarded_point("kvstore.init")
+        return self._init_impl(key, value)
+
+    def _init_impl(self, key, value):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k in self._store:
@@ -89,6 +103,10 @@ class KVStore:
     def push(self, key, value, priority=0):
         """Aggregate grads into the store; runs the updater if set
         (reference: KVStoreLocal::Push + comm reduce, comm.h:90-434)."""
+        guarded_point("kvstore.push")
+        return self._push_impl(key, value, priority)
+
+    def _push_impl(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         for k, vlist in zip(keys, values):
             if k not in self._store:
@@ -121,6 +139,15 @@ class KVStore:
                 self._store[k]._set_data(agg._data)
 
     def pull(self, key, out=None, priority=0):
+        from .resilience import faults
+        if faults.active_plan() is None:
+            # per-batch hot path: an in-memory read has no transient
+            # failures to retry, so skip the policy machinery entirely
+            return self._pull_impl(key, out, priority)
+        return guarded_call("kvstore.pull", self._pull_impl, key, out,
+                            priority)
+
+    def _pull_impl(self, key, out=None, priority=0):
         keys, outs = self._normalize(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -202,6 +229,10 @@ class KVStore:
         return 1
 
     def barrier(self):
+        guarded_point("kvstore.barrier")
+        return self._barrier_impl()
+
+    def _barrier_impl(self):
         if "dist" in self.type:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("kvstore_barrier")
@@ -217,20 +248,32 @@ class KVStore:
         collective for everyone and jax.distributed tears the job down, so
         a *running* job by construction has zero dead peers; recovery is
         relaunch + checkpoint-resume (SURVEY.md §5.3 — the reference's
-        practical recovery path too)."""
-        return 0
+        practical recovery path too). Under an active FaultPlan the honest
+        answer is the injected fault model: the count of armed or observed
+        fault sites."""
+        from .resilience import faults
+        plan = faults.active_plan()
+        if plan is None:
+            return 0
+        return len(plan.sites() | faults.observed_sites())
 
-    def save_optimizer_states(self, fname, dump_optimizer=False):
+    def get_optimizer_states(self, dump_optimizer=False) -> bytes:
+        """Serialized updater state (Module checkpointing reads this so
+        the bytes land inside the manifest-covered .states file)."""
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        return self._updater.get_states(dump_optimizer)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        from .resilience import checkpoint as _ckpt
+        _ckpt.write_bytes_guarded(fname,
+                                  self.get_optimizer_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot load states for distributed training")
-        with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+        from .resilience import checkpoint as _ckpt
+        self._updater.set_states(_ckpt.read_bytes_guarded(fname))
 
     # -- helpers -------------------------------------------------------------
     @staticmethod

@@ -19,39 +19,45 @@ __all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
 from .callback import BatchEndParam  # noqa: F401  (reference keeps it here)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    states=None):
     """Write prefix-symbol.json + prefix-%04d.params (reference: model.py:340).
 
     The params container keys use the reference's 'arg:'/'aux:' prefixes.
+    Every file is written atomically (tmp + fsync + rename) and the
+    checkpoint gets a SHA-256 manifest (resilience/checkpoint.py), for
+    the epoch-numbered and the epoch-less (``epoch=None`` →
+    ``prefix.params``) naming schemes alike. ``states`` optionally adds
+    serialized optimizer state to the checkpoint + manifest.
     """
-    if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
-    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
-    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
-    logging.info("Saved checkpoint to \"%s\"", param_name)
+    from .resilience import checkpoint as _ckpt
+    _ckpt.write_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                           states=states)
 
 
-def load_checkpoint(prefix, epoch) -> Tuple:
-    """Load (symbol, arg_params, aux_params) (reference: model.py:370)."""
-    import os
-    symbol = None
-    if os.path.exists(f"{prefix}-symbol.json"):
-        symbol = sym.load(f"{prefix}-symbol.json")
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    if not os.path.exists(param_name) and os.path.exists(param_name + ".npz"):
-        param_name += ".npz"
-    save_dict = nd.load(param_name)
-    arg_params: Dict = {}
-    aux_params: Dict = {}
-    for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        elif tp == "aux":
-            aux_params[name] = v
+def load_checkpoint(prefix, epoch=None) -> Tuple:
+    """Load (symbol, arg_params, aux_params) (reference: model.py:370).
+
+    The checkpoint's manifest is verified first; on corruption (e.g. a
+    flipped byte or a torn write) the newest older checkpoint that
+    verifies is loaded instead, with a warning. ``epoch=None`` loads the
+    epoch-less ``prefix.params`` if present, else the newest valid
+    checkpoint at ``prefix``."""
+    _, symbol, arg_params, aux_params, _ = _load_checkpoint_ex(prefix, epoch)
     return (symbol, arg_params, aux_params)
+
+
+def _load_checkpoint_ex(prefix, epoch=None):
+    """Verified load returning ``(epoch_used, symbol, arg, aux,
+    states_path)`` — callers that need the *actual* epoch after a
+    corrupt-checkpoint fallback (Module.load optimizer-state pairing,
+    fit(resume='auto')) use this."""
+    import os
+    from .resilience import checkpoint as _ckpt
+    if epoch is None and not os.path.exists(
+            _ckpt.checkpoint_paths(prefix, None)["params"]):
+        epoch = _ckpt.AUTO
+    return _ckpt.load_checkpoint_ex(prefix, epoch)
 
 
 def _create_kvstore(kvstore, num_device, arg_params):

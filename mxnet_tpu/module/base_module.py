@@ -250,11 +250,58 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
+            monitor=None, checkpoint_prefix=None, checkpoint_period=1,
+            resume=None, save_optimizer_states=True):
         """reference: base_module.py:376 — the canonical Module training
         loop: bind → init params/optimizer → per-epoch train pass with
-        lookahead prepare, then the optional validation pass."""
+        lookahead prepare, then the optional validation pass.
+
+        Fault tolerance (docs/how_to/fault_tolerance.md): with
+        ``checkpoint_prefix`` set, a manifest-covered checkpoint (params +
+        optimizer state) is written atomically every ``checkpoint_period``
+        epochs. ``resume='auto'`` discovers the newest *valid* checkpoint
+        at that prefix and continues from its epoch — optimizer state and
+        update counters included, so the resumed run follows the
+        uninterrupted trajectory exactly; with no valid checkpoint it
+        starts fresh. ``resume=<int>`` demands that specific epoch."""
         assert num_epoch is not None, "please specify number of epochs"
+
+        resume_states = None
+        if resume is True:   # fit(resume=True) means 'auto', not epoch 1
+            resume = "auto"
+        if resume is not None and resume is not False:
+            assert checkpoint_prefix, "resume requires checkpoint_prefix"
+            from ..resilience import CheckpointCorrupt
+            from ..resilience.checkpoint import AUTO, load_checkpoint_ex
+            try:
+                # resume=<int> demands that exact epoch (no fallback to a
+                # different one); only 'auto' may walk back to an older
+                # valid checkpoint
+                (ck_epoch, _, ck_arg, ck_aux,
+                 resume_states) = load_checkpoint_ex(
+                    checkpoint_prefix,
+                    AUTO if resume == "auto" else resume,
+                    allow_fallback=(resume == "auto"))
+                arg_params, aux_params = ck_arg, ck_aux
+                force_init = True
+                if isinstance(ck_epoch, int):
+                    begin_epoch = ck_epoch
+                else:
+                    self.logger.warning(
+                        "resumed epoch-less checkpoint %s carries no "
+                        "epoch number; fit restarts at epoch 0 on the "
+                        "restored params", checkpoint_prefix)
+                self.logger.info("fit: resuming from checkpoint %s epoch=%s",
+                                 checkpoint_prefix, ck_epoch)
+            except (FileNotFoundError, CheckpointCorrupt):
+                # only "nothing to resume" starts fresh; an unreachable
+                # checkpoint directory (dead mount, permissions) raises —
+                # silently retraining from scratch would bury the prior
+                # lineage under newer checkpoints at the same prefix
+                if resume != "auto":
+                    raise
+                self.logger.info("fit(resume='auto'): no valid checkpoint "
+                                 "at %s, starting fresh", checkpoint_prefix)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -266,6 +313,9 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if resume_states is not None and hasattr(self,
+                                                 "load_optimizer_states"):
+            self.load_optimizer_states(resume_states)
 
         train_metric = _resolve_metric(eval_metric)
         validation_metric = validation_metric or train_metric
@@ -285,6 +335,25 @@ class BaseModule:
             self.set_params(*snapshot)
             for cb in _as_list(epoch_end_callback):
                 cb(epoch, self.symbol, *snapshot)
+            if checkpoint_prefix and (epoch + 1) % max(
+                    1, int(checkpoint_period)) == 0:
+                # checkpoint labeled epoch+1 == "epochs completed", matching
+                # the do_checkpoint callback convention; resume picks it up
+                # as begin_epoch
+                if hasattr(self, "save_checkpoint"):
+                    self.save_checkpoint(
+                        checkpoint_prefix, epoch + 1,
+                        save_optimizer_states=save_optimizer_states)
+                else:
+                    if save_optimizer_states:
+                        self.logger.warning(
+                            "%s has no save_checkpoint; checkpointing "
+                            "params only (optimizer state will be "
+                            "reinitialized on resume)",
+                            type(self).__name__)
+                    from ..model import save_checkpoint as _save_ckpt
+                    _save_ckpt(checkpoint_prefix, epoch + 1, self.symbol,
+                               *snapshot)
 
             if eval_data:
                 for name, val in self.score(

@@ -342,6 +342,11 @@ class SPMDTrainer:
         """Run one optimizer step on a global batch; returns outputs."""
         if self._step_fn is None:
             raise MXNetError("call bind() before step()")
+        # fault site only, no retry: the step donates its param/state
+        # buffers, so re-running a half-executed step is never safe —
+        # recovery from a failed step is restore_latest()+resume
+        from ..resilience import fault_point
+        fault_point("trainer.step")
         inputs = {}
         for n, v in batch.items():
             if isinstance(v, NDArray):
@@ -416,45 +421,185 @@ class SPMDTrainer:
         return {"params": self.params, "states": self.states,
                 "aux": self.aux}
 
-    def save_checkpoint(self, directory, step=0):
-        """Write a sharded checkpoint to <directory>/step_<step>."""
+    def save_checkpoint(self, directory, step=0, epoch=None):
+        """Write a sharded checkpoint to <directory>/step_<step>, then a
+        ``manifest.json`` with SHA-256 digests of every file in it (the
+        validity marker restore_latest trusts). Orbax itself writes to a
+        tmp dir and renames, so a crash mid-save never corrupts an
+        existing checkpoint; the save runs under the default retry
+        policy behind the ``checkpoint.write`` fault site."""
         import os
 
         import orbax.checkpoint as ocp
+
+        from ..resilience import guarded_call
 
         if self._step_fn is None:
             raise MXNetError("bind() before save_checkpoint()")
         path = os.path.join(os.path.abspath(directory), f"step_{step}")
         state = self._ckpt_state()
-        state["meta"] = {"num_update": np.int64(self._num_update),
+        state["meta"] = {"num_update": np.asarray(self._num_update, np.int64),
+                         "epoch": np.asarray(-1 if epoch is None else epoch,
+                                             np.int64),
                          "rng": np.asarray(self._rng)}
-        with ocp.StandardCheckpointer() as ck:
-            ck.save(path, state, force=True)
+
+        def _save():
+            with ocp.StandardCheckpointer() as ck:
+                ck.save(path, state, force=True)
+
+        guarded_call("checkpoint.write", _save)
+        from ..resilience import checkpoint as _ckpt
+        _ckpt.write_dir_manifest(path)
         return path
 
     def restore_checkpoint(self, directory, step=0):
         """Exact resume from save_checkpoint; call bind() first (the
-        checkpoint restores onto the bound shardings)."""
+        checkpoint restores onto the bound shardings). Verifies the
+        checkpoint's manifest before reading it."""
         import os
 
         import orbax.checkpoint as ocp
 
+        from ..resilience import guarded_call
+
         if self._step_fn is None:
             raise MXNetError("bind() before restore_checkpoint()")
         path = os.path.join(os.path.abspath(directory), f"step_{step}")
+        from ..resilience import checkpoint as _ckpt
+        _ckpt.verify_dir_manifest(path)
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=x.sharding),
             self._ckpt_state())
         abstract["meta"] = {
             "num_update": np.zeros((), np.int64),
+            "epoch": np.zeros((), np.int64),
             "rng": np.zeros(np.asarray(self._rng).shape,
                             np.asarray(self._rng).dtype)}
-        with ocp.StandardCheckpointer() as ck:
-            state = ck.restore(path, abstract)
+
+        def _restore():
+            with ocp.StandardCheckpointer() as ck:
+                return ck.restore(path, abstract)
+
+        try:
+            state = guarded_call("checkpoint.read", _restore)
+        except (ValueError, KeyError) as err:
+            # checkpoints written before the epoch field existed have
+            # meta={num_update, rng}; retry with the legacy tree shape —
+            # but only when the mismatch is actually about that field,
+            # so a genuine shape/sharding mismatch keeps its real error
+            # and does not pay a second full restore
+            if "epoch" not in str(err):
+                raise
+            del abstract["meta"]["epoch"]
+            state = guarded_call("checkpoint.read", _restore)
+            state["meta"]["epoch"] = np.int64(-1)
         self.params = state["params"]
         self.states = state["states"]
         self.aux = state["aux"]
         self._num_update = int(state["meta"]["num_update"])
+        self._restored_epoch = int(state["meta"]["epoch"])
         self._rng = jnp.asarray(state["meta"]["rng"])
         return self
+
+    def restore_latest(self, directory):
+        """Resume from the newest *valid* ``step_<N>`` checkpoint under
+        ``directory``: candidates are tried newest-first, and one that
+        fails manifest verification (torn write, flipped byte) is skipped
+        with a warning. Returns the restored step, or None if the
+        directory holds no usable checkpoint."""
+        import logging
+        import os
+
+        from ..resilience import CheckpointCorrupt, RetryExhausted
+
+        base = os.path.abspath(directory)
+        steps = []
+        if os.path.isdir(base):
+            for name in os.listdir(base):
+                if name.startswith("step_") and name[5:].isdigit():
+                    steps.append(int(name[5:]))
+        for step in sorted(steps, reverse=True):
+            try:
+                self.restore_checkpoint(directory, step=step)
+                if step != max(steps):
+                    logging.warning(
+                        "restore_latest: fell back to step_%d (newer "
+                        "checkpoints failed verification)", step)
+                return step
+            except (CheckpointCorrupt, OSError, ValueError, KeyError,
+                    RetryExhausted) as err:
+                logging.warning("restore_latest: skipping step_%d: %s",
+                                step, err)
+        return None
+
+    # -- training loop ------------------------------------------------------
+
+    def fit(self, train_data, num_epoch, checkpoint_dir=None,
+            checkpoint_period=1, resume=None, batch_end_callback=None,
+            epoch_end_callback=None):
+        """Minimal epoch loop over a DataIter (call bind() first):
+        each batch becomes one fused SPMD step. With ``checkpoint_dir``,
+        a sharded checkpoint is written every ``checkpoint_period``
+        epochs; ``resume='auto'`` continues from the newest valid one
+        (params, optimizer state, update counter, rng — bitwise the
+        trajectory the uninterrupted run takes), ``resume=<int>`` demands
+        that exact ``step_<N>`` checkpoint."""
+        if self._step_fn is None:
+            raise MXNetError("call bind() before fit()")
+        begin_epoch = 0
+        if resume is True:   # fit(resume=True) means 'auto', not step 1
+            resume = "auto"
+        if resume is not None and resume is not False:
+            if not checkpoint_dir:
+                raise MXNetError("fit(resume=...) requires checkpoint_dir")
+            if resume == "auto":
+                restored = self.restore_latest(checkpoint_dir)
+            else:
+                self.restore_checkpoint(checkpoint_dir, step=int(resume))
+                restored = int(resume)
+            if restored is not None:
+                saved_epoch = getattr(self, "_restored_epoch", -1)
+                if saved_epoch < 0:
+                    import logging
+                    logging.warning(
+                        "resumed checkpoint step_%s carries no epoch "
+                        "metadata (saved via save_checkpoint without "
+                        "epoch=); fit restarts at epoch 0 on the restored "
+                        "params", restored)
+                begin_epoch = saved_epoch if saved_epoch >= 0 else 0
+        from ..callback import BatchEndParam
+        cbs = (batch_end_callback if isinstance(batch_end_callback, list)
+               else [batch_end_callback]) if batch_end_callback is not None \
+            else []
+        for epoch in range(begin_epoch, num_epoch):
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                inputs = self._batch_dict(batch)
+                self.step(inputs)
+                for cb in cbs:
+                    cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                     eval_metric=None, locals=locals()))
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch, self)
+            if checkpoint_dir and (epoch + 1) % max(
+                    1, int(checkpoint_period)) == 0:
+                self.save_checkpoint(checkpoint_dir, step=self._num_update,
+                                     epoch=epoch + 1)
+        return self
+
+    def _batch_dict(self, batch) -> Dict[str, np.ndarray]:
+        """Map a DataBatch onto this trainer's data/label names."""
+        if isinstance(batch, dict):
+            return batch
+        inputs = {}
+        data = batch.data if isinstance(batch.data, (list, tuple)) \
+            else [batch.data]
+        for name, arr in zip(self._data_names, data):
+            inputs[name] = arr
+        if batch.label is not None:
+            label = batch.label if isinstance(batch.label, (list, tuple)) \
+                else [batch.label]
+            for name, arr in zip(self._label_names, label):
+                inputs[name] = arr
+        return inputs

@@ -1,0 +1,77 @@
+"""Fault-tolerant training runtime.
+
+Three pillars (docs/how_to/fault_tolerance.md):
+
+- :mod:`.checkpoint` — crash-safe checkpoint I/O: atomic tmp+fsync+rename
+  writes, per-checkpoint SHA-256 manifests, newest-valid discovery and
+  corrupt-file fallback.
+- :mod:`.retry` — exponential backoff + jitter + deadline around the
+  host-I/O surfaces (checkpoint files, kvstore entry points, data
+  iterator fetch), with injectable clock/sleep for tests.
+- :mod:`.faults` — deterministic fault injection: a seedable
+  :class:`~.faults.FaultPlan` arms named sites (``checkpoint.write``,
+  ``kvstore.push``, ``io.next``, ``trainer.step``, ...) to raise on the
+  Nth call; also armable via ``MXNET_TPU_FAULT_PLAN``.
+
+The reference stack's ps-lite heartbeat/dead-node machinery collapsed in
+the SPMD port to "a dead process fails the collective for everyone"
+(kvstore.py); this package builds the matching recovery path: relaunch +
+``fit(resume='auto')`` from the last good checkpoint.
+"""
+from __future__ import annotations
+
+from . import checkpoint, faults, retry  # noqa: F401
+from .checkpoint import (AUTO, CheckpointCorrupt, atomic_output,  # noqa: F401
+                         atomic_write_bytes, find_checkpoints,
+                         load_checkpoint_ex, verify_manifest,
+                         write_checkpoint)
+from .faults import (FaultPlan, InjectedFault, InjectedKill,  # noqa: F401
+                     InjectedTimeout, fault_point)
+from .retry import RetryExhausted, RetryPolicy, default_policy  # noqa: F401
+
+__all__ = ["checkpoint", "faults", "retry", "FaultPlan", "RetryPolicy",
+           "RetryExhausted", "CheckpointCorrupt", "InjectedFault",
+           "InjectedTimeout", "InjectedKill", "fault_point", "guarded_call",
+           "guarded_point", "default_policy", "stats", "reset_stats", "AUTO"]
+
+
+def guarded_call(site: str, fn, *args, policy=None, **kwargs):
+    """Run ``fn`` behind fault site ``site`` under the default (or given)
+    retry policy: each attempt first passes the fault point, so injected
+    retriable faults exercise the same backoff path real transient I/O
+    errors do. Non-retriable exceptions (StopIteration, MXNetError,
+    InjectedKill, ...) propagate immediately."""
+    pol = policy or retry.default_policy()
+
+    def attempt():
+        faults.fault_point(site)
+        return fn(*args, **kwargs)
+
+    return pol.call(attempt, label=site)
+
+
+def guarded_point(site: str, policy=None):
+    """Pass fault site ``site`` under the default (or given) retry policy
+    WITHOUT wrapping the caller's work: injected retriable faults
+    exercise the backoff path, but the real operation then runs exactly
+    once. This is the guard for non-idempotent operations (gradient
+    push, collective barrier, cursor-advancing iterator fetch) where a
+    blind re-run after a mid-operation failure could double-apply or
+    silently skip work. With no plan armed this is a single ``is None``
+    check, keeping the hot paths (per-batch fetch, per-key push) free of
+    retry machinery."""
+    if faults.active_plan() is None:
+        return
+    pol = policy or retry.default_policy()
+    pol.call(faults.fault_point, site, label=site)
+
+
+def stats() -> dict:
+    """Combined fault + retry counters (surfaced by
+    ``callback.ResilienceMonitor`` and ``KVStore.num_dead_node``)."""
+    return {"faults": faults.stats(), "retry": retry.stats()}
+
+
+def reset_stats():
+    faults.reset_stats()
+    retry.reset_stats()

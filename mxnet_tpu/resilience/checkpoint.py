@@ -1,0 +1,399 @@
+"""Crash-safe checkpoint I/O: atomic writes, manifests, discovery.
+
+Reference analogue: ``python/mxnet/model.py`` save_checkpoint/
+load_checkpoint wrote ``prefix-symbol.json`` + ``prefix-%04d.params``
+with bare ``open(...)`` — a preemption mid-write leaves a truncated
+params file that poisons the *newest* checkpoint, exactly the one a
+relaunch wants. Here every file goes through tmp + fsync + rename
+(crash leaves either the old complete file or a stray ``*.tmp``, never
+a torn one), and each checkpoint carries a manifest with SHA-256
+digests so a corrupt file is *detected* at load and the runtime falls
+back to the last good checkpoint instead of resuming from garbage.
+
+Naming schemes (both discoverable by :func:`find_checkpoints`):
+
+- epoch-numbered: ``prefix-%04d.params`` / ``.states`` /
+  ``prefix-%04d.manifest.json`` (+ shared ``prefix-symbol.json``)
+- epoch-less (``epoch=None``): ``prefix.params`` / ``prefix.states`` /
+  ``prefix.manifest.json``
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import re
+from typing import Dict, List, Optional
+
+from . import faults, retry
+
+__all__ = ["CheckpointCorrupt", "atomic_output", "atomic_write_bytes",
+           "write_bytes_guarded", "read_bytes_guarded",
+           "file_digest", "write_manifest", "verify_manifest",
+           "write_dir_manifest", "verify_dir_manifest",
+           "manifest_path", "checkpoint_paths", "write_checkpoint",
+           "find_checkpoints", "load_checkpoint_ex", "MANIFEST_VERSION"]
+
+MANIFEST_VERSION = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed manifest verification (missing file, size or
+    digest mismatch, unreadable manifest)."""
+
+
+# -- atomic file primitives --------------------------------------------------
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+    except OSError:
+        return  # e.g. platforms that cannot open a directory
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_output(path: str):
+    """Yield a tmp path for the caller to write; on clean exit, fsync the
+    tmp file, pass the ``checkpoint.write`` fault point, and rename over
+    ``path``. A crash (or injected kill) at any moment leaves either the
+    previous complete ``path`` or a ``path.tmp`` — never a torn file."""
+    tmp = path + ".tmp"
+    yield tmp
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
+    # the kill-mid-write window: tmp is durable, rename has not happened
+    faults.fault_point("checkpoint.write")
+    os.replace(tmp, path)
+    _fsync_dir(path)
+
+
+def atomic_write_bytes(path: str, data: bytes):
+    with atomic_output(path) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+    return path
+
+
+def write_bytes_guarded(path: str, data: bytes) -> str:
+    """:func:`atomic_write_bytes` under the default retry policy behind
+    the ``checkpoint.write`` site — the one guard for optimizer-state
+    and manifest blobs wherever they are written."""
+    return retry.default_policy().call(atomic_write_bytes, path, data,
+                                       label="checkpoint.write")
+
+
+def read_bytes_guarded(path: str) -> bytes:
+    """Read a whole file behind the ``checkpoint.read`` fault site under
+    the default retry policy."""
+    def _attempt():
+        faults.fault_point("checkpoint.read")
+        with open(path, "rb") as f:
+            return f.read()
+    return retry.default_policy().call(_attempt, label="checkpoint.read")
+
+
+def file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# -- manifests ---------------------------------------------------------------
+
+def _stem(prefix: str, epoch: Optional[int]) -> str:
+    return prefix if epoch is None else "%s-%04d" % (prefix, epoch)
+
+
+def manifest_path(prefix: str, epoch: Optional[int]) -> str:
+    return _stem(prefix, epoch) + ".manifest.json"
+
+
+def checkpoint_paths(prefix: str, epoch: Optional[int]) -> Dict[str, str]:
+    stem = _stem(prefix, epoch)
+    return {"params": stem + ".params", "states": stem + ".states",
+            "symbol": prefix + "-symbol.json",
+            "manifest": stem + ".manifest.json"}
+
+
+def write_manifest(prefix: str, epoch: Optional[int], files: Dict[str, str],
+                   step: Optional[int] = None, extra: Optional[dict] = None):
+    """Write the per-checkpoint manifest. ``files`` maps role (params/
+    states/symbol) to an existing path; each entry records size + sha256
+    so a single flipped byte is detected at load time."""
+    entries = {}
+    for role, path in files.items():
+        entries[role] = {"file": os.path.basename(path),
+                         "size": os.path.getsize(path),
+                         "sha256": file_digest(path)}
+    doc = {"format_version": MANIFEST_VERSION, "epoch": epoch, "step": step,
+           "files": entries}
+    if extra:
+        doc.update(extra)
+    path = manifest_path(prefix, epoch)
+    atomic_write_bytes(path, json.dumps(doc, indent=1, sort_keys=True)
+                       .encode("utf-8"))
+    return path
+
+
+def verify_manifest(prefix: str, epoch: Optional[int]) -> dict:
+    """Verify every file listed in the checkpoint's manifest; return the
+    manifest dict. Raises :class:`CheckpointCorrupt` on any mismatch."""
+    mpath = manifest_path(prefix, epoch)
+    if not os.path.exists(mpath):
+        raise CheckpointCorrupt(f"no manifest at {mpath}")
+    try:
+        with open(mpath, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as err:
+        raise CheckpointCorrupt(f"unreadable manifest {mpath}: {err}") \
+            from err
+    base_dir = os.path.dirname(os.path.abspath(mpath))
+    for role, entry in doc.get("files", {}).items():
+        fpath = os.path.join(base_dir, entry["file"])
+        if not os.path.exists(fpath):
+            raise CheckpointCorrupt(f"{mpath}: missing {role} file "
+                                    f"{entry['file']}")
+        if os.path.getsize(fpath) != entry["size"]:
+            raise CheckpointCorrupt(
+                f"{mpath}: {role} file {entry['file']} size "
+                f"{os.path.getsize(fpath)} != recorded {entry['size']}")
+        if file_digest(fpath) != entry["sha256"]:
+            raise CheckpointCorrupt(
+                f"{mpath}: {role} file {entry['file']} digest mismatch "
+                "(corrupt or partially written)")
+    return doc
+
+
+def write_dir_manifest(path: str) -> str:
+    """Digest every file under directory ``path`` (sharded/orbax
+    checkpoints) into an atomic ``manifest.json`` at its root."""
+    entries = {}
+    for root, _, names in os.walk(path):
+        for name in names:
+            if name == "manifest.json" or name.endswith(".tmp"):
+                continue
+            fpath = os.path.join(root, name)
+            rel = os.path.relpath(fpath, path)
+            entries[rel] = {"size": os.path.getsize(fpath),
+                            "sha256": file_digest(fpath)}
+    doc = {"format_version": MANIFEST_VERSION, "files": entries}
+    mpath = os.path.join(path, "manifest.json")
+    atomic_write_bytes(mpath, json.dumps(doc, indent=1, sort_keys=True)
+                       .encode("utf-8"))
+    return mpath
+
+
+def verify_dir_manifest(path: str):
+    """Counterpart of :func:`write_dir_manifest`: raise
+    :class:`CheckpointCorrupt` if any file disagrees with the directory's
+    ``manifest.json``; a directory without one passes unverified
+    (legacy)."""
+    mpath = os.path.join(path, "manifest.json")
+    if not os.path.exists(mpath):
+        return
+    try:
+        with open(mpath, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as err:
+        raise CheckpointCorrupt(f"unreadable manifest {mpath}: {err}") \
+            from err
+    for rel, entry in doc.get("files", {}).items():
+        fpath = os.path.join(path, rel)
+        if not os.path.exists(fpath):
+            raise CheckpointCorrupt(f"{path}: missing {rel}")
+        if os.path.getsize(fpath) != entry["size"] \
+                or file_digest(fpath) != entry["sha256"]:
+            raise CheckpointCorrupt(
+                f"{path}: {rel} does not match its manifest digest")
+
+
+# -- high-level checkpoint write / discovery / load --------------------------
+
+def write_checkpoint(prefix: str, epoch: Optional[int], symbol,
+                     arg_params: dict, aux_params: dict,
+                     states: Optional[bytes] = None,
+                     step: Optional[int] = None) -> Dict[str, str]:
+    """Atomically write one checkpoint (symbol json, params, optional
+    optimizer states) plus its manifest. Retries transient I/O errors
+    under the default policy. Returns the role->path map."""
+    paths = checkpoint_paths(prefix, epoch)
+    pol = retry.default_policy()
+    files = {}
+
+    def _write_symbol():
+        with atomic_output(paths["symbol"]) as tmp:
+            symbol.save(tmp)
+
+    def _write_params():
+        from .. import ndarray as nd
+        save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+        save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+        with atomic_output(paths["params"]) as tmp:
+            nd.save(tmp, save_dict)
+
+    if symbol is not None:
+        pol.call(_write_symbol, label="checkpoint.write")
+        files["symbol"] = paths["symbol"]
+    pol.call(_write_params, label="checkpoint.write")
+    files["params"] = paths["params"]
+    if states is not None:
+        pol.call(atomic_write_bytes, paths["states"], states,
+                 label="checkpoint.write")
+        files["states"] = paths["states"]
+    pol.call(write_manifest, prefix, epoch, files, step=step,
+             label="checkpoint.write")
+    logging.info("Saved checkpoint to \"%s\"", paths["params"])
+    return paths
+
+
+_EPOCH_RE = re.compile(r"-(\d{4,})\.params$")
+
+
+def find_checkpoints(prefix: str) -> List[Optional[int]]:
+    """Epochs with a params file at ``prefix``, newest first — by epoch
+    number (the semantic recency key; mtimes lie after a backup restore),
+    file mtime breaking ties. ``None`` denotes the epoch-less scheme and
+    sorts oldest. A missing directory means no checkpoints; any other
+    listing failure (permissions, dead mount) propagates — it must not
+    masquerade as a fresh start."""
+    base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
+    base = os.path.basename(prefix)
+    found = []
+    try:
+        names = os.listdir(base_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    for name in names:
+        if not name.startswith(base) or not name.endswith(".params"):
+            continue
+        rest = name[len(base):]
+        if rest == ".params":
+            epoch = None
+        else:
+            m = _EPOCH_RE.match(rest)
+            if not m:
+                continue
+            epoch = int(m.group(1))
+        st = os.stat(os.path.join(base_dir, name))
+        found.append((-1 if epoch is None else epoch, st.st_mtime_ns, epoch))
+    found.sort(key=lambda t: (t[0], t[1]), reverse=True)
+    return [t[2] for t in found]
+
+
+#: sentinel: discover the newest valid checkpoint instead of naming one
+AUTO = "auto"
+
+
+def load_checkpoint_ex(prefix: str, epoch=AUTO, allow_fallback: bool = True,
+                       verify: bool = True):
+    """Load a verified checkpoint; returns ``(epoch_used, symbol,
+    arg_params, aux_params, states_path_or_None)``.
+
+    ``epoch`` is an int (epoch-numbered scheme), ``None`` (the epoch-less
+    ``prefix.params`` scheme), or :data:`AUTO` to discover the newest
+    valid checkpoint at ``prefix``. A checkpoint that fails manifest
+    verification is skipped with a warning and the next older one is
+    tried (``allow_fallback``); legacy checkpoints without a manifest
+    load unverified with an info log."""
+    from .. import ndarray as nd
+    from .. import symbol as sym
+
+    candidates = find_checkpoints(prefix)
+    if epoch is AUTO or epoch == AUTO:
+        ordered = candidates
+    else:
+        # requested checkpoint first, then the rest as fallbacks
+        ordered = [epoch] + [e for e in candidates if e != epoch]
+    if not ordered:
+        # FileNotFoundError so callers can tell "nothing to resume"
+        # (start fresh) apart from storage failures (propagate)
+        raise FileNotFoundError(f"no checkpoint found at prefix {prefix!r}")
+
+    last_err = None
+    storage_err = None
+    # a manifest-less checkpoint is only "legacy" while NO candidate at
+    # this prefix carries a manifest; once any does, a missing manifest
+    # means the writer died between the params rename and the manifest
+    # write — treat it as torn and fall back
+    any_manifest = any(os.path.exists(manifest_path(prefix, e))
+                       for e in ordered)
+    for i, ep in enumerate(ordered):
+        paths = checkpoint_paths(prefix, ep)
+        doc = None
+        try:
+            # injected/transient faults at the read site back off and
+            # retry; only retry exhaustion falls through to the next
+            # (older) candidate
+            retry.default_policy().call(faults.fault_point,
+                                        "checkpoint.read",
+                                        label="checkpoint.read")
+            if verify:
+                if os.path.exists(paths["manifest"]):
+                    doc = verify_manifest(prefix, ep)
+                elif any_manifest:
+                    raise CheckpointCorrupt(
+                        f"{_stem(prefix, ep)} has no manifest (torn "
+                        "write?)")
+                elif os.path.exists(paths["params"]):
+                    logging.info("checkpoint %s has no manifest; loading "
+                                 "unverified (legacy format)",
+                                 paths["params"])
+            symbol = None
+            if os.path.exists(paths["symbol"]):
+                symbol = sym.load(paths["symbol"])
+            pname = paths["params"]
+            if not os.path.exists(pname) and os.path.exists(pname + ".npz"):
+                pname += ".npz"
+            save_dict = retry.default_policy().call(
+                nd.load, pname, label="checkpoint.read")
+            arg_params, aux_params = {}, {}
+            for k, v in save_dict.items():
+                tp, _, name = k.partition(":")
+                if tp == "arg":
+                    arg_params[name] = v
+                elif tp == "aux":
+                    aux_params[name] = v
+            if doc is not None:
+                # only trust a .states file the manifest records (and
+                # verify_manifest digest-checked); a stray one from an
+                # earlier run at the same prefix is a different
+                # trajectory's optimizer state
+                states = paths["states"] \
+                    if "states" in doc.get("files", {}) else None
+            else:
+                states = paths["states"] \
+                    if os.path.exists(paths["states"]) else None
+            if i > 0:
+                logging.warning(
+                    "checkpoint %s was corrupt or missing; fell back to "
+                    "last good checkpoint %s", _stem(prefix, ordered[0]),
+                    _stem(prefix, ep))
+            return ep, symbol, arg_params, aux_params, states
+        except (CheckpointCorrupt, OSError, ValueError,
+                retry.RetryExhausted) as err:
+            last_err = err
+            if isinstance(err, (retry.RetryExhausted, PermissionError)):
+                storage_err = err
+            if not allow_fallback:
+                raise
+            logging.warning("checkpoint %s rejected: %s",
+                            _stem(prefix, ep), err)
+    if storage_err is not None:
+        # storage-level failure (exhausted retries, permissions): must not
+        # collapse into "corrupt" — an auto-resume caller would treat that
+        # as nothing-to-resume and retrain over the existing lineage
+        raise storage_err
+    raise CheckpointCorrupt(
+        f"no loadable checkpoint at prefix {prefix!r}; "
+        f"last error: {last_err}")

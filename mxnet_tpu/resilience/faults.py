@@ -1,0 +1,206 @@
+"""Deterministic fault injection for the training runtime.
+
+Reference analogue: ps-lite's ``SimpleApp`` test hooks and the reference's
+``tests/nightly/dist_sync_kvstore.py`` kill/relaunch scripts — but made
+deterministic and in-process so the recovery paths (atomic checkpoint,
+retry/backoff, ``fit(resume='auto')``) can be proven in unit tests.
+
+A :class:`FaultPlan` arms named *sites*; production code marks those
+sites with :func:`fault_point`.  When the armed condition is met (the
+Nth call to the site, or a seeded coin flip), the site raises one of the
+injected-fault exceptions below.  With no plan armed a fault point is a
+single ``is None`` check, so the instrumentation is free on hot paths.
+
+Arming from the environment (no code changes required)::
+
+    MXNET_TPU_FAULT_PLAN="checkpoint.write:2:kill;kvstore.push:1:ioerror"
+    MXNET_TPU_FAULT_SEED=7   # seeds probabilistic rules
+
+Each rule is ``site:nth:kind`` (fail the Nth call and every one of the
+``count`` following; default count 1) or ``site:p=0.1:kind`` (each call
+fails with probability 0.1, drawn from the plan's seeded RNG).
+Kinds: ``ioerror`` (retriable OSError), ``timeout`` (retriable
+TimeoutError), ``kill`` (a BaseException — simulates process death, never
+retried, escapes ``except Exception``).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict, List, Optional, Set
+
+__all__ = ["FaultPlan", "InjectedFault", "InjectedTimeout", "InjectedKill",
+           "arm", "disarm", "active_plan", "fault_point", "stats",
+           "reset_stats", "observed_sites", "SITES"]
+
+# Sites instrumented by the runtime (documentation; fault_point accepts any
+# name so downstream code can add its own).
+SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
+         "kvstore.push", "kvstore.pull", "kvstore.barrier", "io.next",
+         "trainer.step")
+
+ENV_PLAN = "MXNET_TPU_FAULT_PLAN"
+ENV_SEED = "MXNET_TPU_FAULT_SEED"
+
+
+class InjectedFault(OSError):
+    """Injected transient I/O failure (retriable: an OSError)."""
+
+
+class InjectedTimeout(TimeoutError):
+    """Injected timeout (retriable: a TimeoutError)."""
+
+
+class InjectedKill(BaseException):
+    """Injected process death. Deliberately a BaseException: it must sail
+    through ``except Exception`` handlers and retry loops exactly like a
+    SIGKILL would, leaving partial state (e.g. a checkpoint tmp file)
+    behind for the recovery path to deal with."""
+
+
+_KINDS = {"ioerror": InjectedFault, "timeout": InjectedTimeout,
+          "kill": InjectedKill}
+
+
+class _Rule:
+    __slots__ = ("nth", "count", "prob", "exc")
+
+    def __init__(self, nth=None, count=1, prob=None, exc=InjectedFault):
+        self.nth = nth          # 1-based call number to start failing at
+        self.count = count      # how many consecutive calls fail
+        self.prob = prob        # alternatively: per-call probability
+        self.exc = exc
+
+
+class FaultPlan:
+    """A seedable set of armed fault rules, keyed by site name."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._rules: Dict[str, List[_Rule]] = {}
+
+    def arm(self, site: str, nth: Optional[int] = None, count: int = 1,
+            prob: Optional[float] = None, exc="ioerror") -> "FaultPlan":
+        """Arm ``site`` to fail on the Nth call (``nth``, 1-based, for
+        ``count`` consecutive calls) or with per-call probability
+        ``prob``. ``exc`` is a kind name from {ioerror, timeout, kill}
+        or an exception class. Returns self for chaining."""
+        if (nth is None) == (prob is None):
+            raise ValueError("arm() needs exactly one of nth= or prob=")
+        if isinstance(exc, str):
+            if exc not in _KINDS:
+                raise ValueError(f"unknown fault kind {exc!r}; "
+                                 f"choose from {sorted(_KINDS)}")
+            exc = _KINDS[exc]
+        self._rules.setdefault(site, []).append(
+            _Rule(nth=nth, count=count, prob=prob, exc=exc))
+        return self
+
+    def sites(self) -> Set[str]:
+        return set(self._rules)
+
+    def _check(self, site: str, ncall: int):
+        """Return the exception class to raise for this call, or None."""
+        for rule in self._rules.get(site, ()):
+            if rule.nth is not None:
+                if rule.nth <= ncall < rule.nth + rule.count:
+                    return rule.exc
+            elif rule.prob is not None:
+                if self._rng.random() < rule.prob:
+                    return rule.exc
+        return None
+
+    @classmethod
+    def from_env(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        """Parse a ``site:nth:kind;site:p=0.1:kind`` spec string."""
+        plan = cls(seed=seed)
+        for part in spec.replace(",", ";").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) not in (2, 3):
+                raise ValueError(f"bad fault rule {part!r} "
+                                 "(want site:nth[:kind] or site:p=X[:kind])")
+            site, when = fields[0], fields[1]
+            kind = fields[2] if len(fields) == 3 else "ioerror"
+            if when.startswith("p="):
+                plan.arm(site, prob=float(when[2:]), exc=kind)
+            else:
+                plan.arm(site, nth=int(when), exc=kind)
+        return plan
+
+
+_lock = threading.Lock()
+_active: Optional[FaultPlan] = None
+_env_checked = False
+_calls: Dict[str, int] = {}     # site -> total fault_point() invocations
+_fired: Dict[str, int] = {}     # site -> injected faults raised
+
+
+def arm(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` as the active fault plan (replacing any)."""
+    global _active, _env_checked
+    with _lock:
+        _active = plan
+        _env_checked = True     # explicit arming overrides the env var
+        _calls.clear()
+        _fired.clear()
+    return plan
+
+
+def disarm():
+    """Deactivate fault injection (counters keep their values)."""
+    global _active, _env_checked
+    with _lock:
+        _active = None
+        _env_checked = True
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The active plan; lazily arms from MXNET_TPU_FAULT_PLAN once."""
+    global _active, _env_checked
+    if _active is None and not _env_checked:
+        with _lock:
+            if _active is None and not _env_checked:
+                spec = os.environ.get(ENV_PLAN)
+                if spec:
+                    seed = int(os.environ.get(ENV_SEED, "0"))
+                    _active = FaultPlan.from_env(spec, seed=seed)
+                _env_checked = True
+    return _active
+
+
+def fault_point(site: str):
+    """Mark a fault-injectable site. No-op unless a plan arms ``site``."""
+    plan = active_plan()
+    if plan is None:
+        return
+    with _lock:
+        n = _calls.get(site, 0) + 1
+        _calls[site] = n
+        exc = plan._check(site, n)
+        if exc is not None:
+            _fired[site] = _fired.get(site, 0) + 1
+    if exc is not None:
+        raise exc(f"injected fault at {site} (call #{n})")
+
+
+def observed_sites() -> Set[str]:
+    """Sites where an injected fault has actually fired."""
+    with _lock:
+        return {s for s, n in _fired.items() if n}
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    """Snapshot of per-site fault-point call and fire counters."""
+    with _lock:
+        return {"calls": dict(_calls), "fired": dict(_fired)}
+
+
+def reset_stats():
+    with _lock:
+        _calls.clear()
+        _fired.clear()
