@@ -51,6 +51,15 @@ clean:
 # matrix is hermetic — no accelerator required.
 # ---------------------------------------------------------------------------
 
+# stage 0: tpu-lint — AST-based static analysis for TPU/JAX hazards
+# (host syncs under trace, trace-time side effects, retrace storms,
+# untracked RNG, registry/test/doc drift; docs/how_to/tpu_lint.md).
+# Fails on findings not in the committed tpu-lint-baseline.json.
+lint-tpu:
+	python -m mxnet_tpu.analysis --root . mxnet_tpu
+
+ci-lint: lint-tpu
+
 # stage 1: native shared libraries
 ci-native: all
 
@@ -91,9 +100,9 @@ ci-resilience: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
 	    -m 'not slow' -x -q
 
-ci: ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
+ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-frontends ci-dryrun ci-resilience
 	@echo "CI matrix green"
 
-.PHONY: all clean ci ci-native ci-amalgamation ci-unit ci-examples \
-        ci-distributed ci-frontends ci-dryrun ci-resilience
+.PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
+        ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience

@@ -1,0 +1,32 @@
+"""mxnet_tpu.analysis — tpu-lint, static analysis for TPU/JAX hazards.
+
+An stdlib-``ast`` linter (no dependencies beyond the Python standard
+library) that catches the failure modes a TPU-native MXNet inherits from
+JAX before they ship: host syncs on the step path, side effects baked in
+at trace time, retrace storms, untracked RNG that breaks bitwise resume,
+and registry/test/doc drift. See docs/how_to/tpu_lint.md for the rule
+catalog and CLI usage (``python -m mxnet_tpu.analysis``,
+``make lint-tpu``).
+
+This module stays import-light on purpose: ``import mxnet_tpu`` pulls
+:mod:`annotations` (for the ``@hot_path`` marker used by hot modules) but
+the checker machinery loads lazily, only when linting.
+"""
+from __future__ import annotations
+
+from .annotations import hot_path
+
+__all__ = ["hot_path", "lint", "Finding", "CHECKERS", "main"]
+
+_LAZY = {"lint", "Finding", "CHECKERS"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import core
+        from . import checkers  # noqa: F401  (populate CHECKERS)
+        return getattr(core, name)
+    if name == "main":
+        from .cli import main
+        return main
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,6 @@
+"""``python -m mxnet_tpu.analysis`` — the tpu-lint CLI entry point."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

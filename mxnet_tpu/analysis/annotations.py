@@ -1,0 +1,32 @@
+"""Runtime-visible markers consumed by tpu-lint's static analysis.
+
+The linter (mxnet_tpu/analysis) treats functions decorated with
+:func:`hot_path` as roots of the per-step training path: everything
+statically reachable from them inside the same module is audited for
+device->host sync points exactly like code reachable from a
+``jax.jit``/``shard_map``/``scan`` trace. At runtime the decorator is an
+identity function — zero overhead, no behavior change.
+
+Kept dependency-free (stdlib only) so importing it never drags the
+analysis machinery — or jax — into the hot modules that use it.
+"""
+from __future__ import annotations
+
+__all__ = ["hot_path"]
+
+
+def hot_path(reason=None):
+    """Mark a function as part of the per-step training hot path.
+
+    Usable bare (``@hot_path``) or with a justification string
+    (``@hot_path("per-batch metric update")``). tpu-lint's
+    host-sync-under-trace checker audits marked functions and everything
+    they call in-module; the runtime behavior is untouched.
+    """
+    if callable(reason):        # bare @hot_path
+        return reason
+
+    def deco(fn):
+        return fn
+
+    return deco

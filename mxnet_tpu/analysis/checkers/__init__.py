@@ -1,0 +1,8 @@
+"""tpu-lint checkers. Importing this package populates the registry;
+each module is one rule (docs/how_to/tpu_lint.md documents the catalog
+and how to add a checker)."""
+from . import host_sync         # noqa: F401
+from . import side_effects      # noqa: F401
+from . import retrace           # noqa: F401
+from . import rng               # noqa: F401
+from . import registry_consistency  # noqa: F401

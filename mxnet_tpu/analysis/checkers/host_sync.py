@@ -1,0 +1,87 @@
+"""host-sync-under-trace: device->host readbacks where they serialize.
+
+A ``.asnumpy()`` (or ``.item()``, ``float()``, ``np.asarray`` on a device
+array...) blocks until every queued computation lands, so one stray call
+inside a traced function or the per-step path turns JAX's async dispatch
+into lock-step execution — the classic silent 10x. Inside an actual trace
+it is worse still: the value is captured as a constant and the graph is
+wrong, not just slow.
+
+Flagged in **traced** regions (jit/shard_map/scan/... — see
+tracecontext.py): sync attribute calls, ``np.array``/``np.asarray``,
+``jax.device_get``, and ``float()``/``int()``/``bool()`` on non-literal
+arguments.
+
+Flagged on the **hot path** (``@hot_path`` roots, e.g.
+``SPMDTrainer.step`` and the per-batch metric/callback path): sync
+attribute calls and ``np.array``/``np.asarray`` — ``jax.device_get`` is
+deliberately allowed there because a single *batched* transfer at a
+report boundary is exactly the recommended fix.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, FileCtx, register_checker
+from ..tracecontext import TraceAnalysis, dotted_name, walk_region
+
+# methods that force a sync on NDArray/jax arrays/metrics
+SYNC_ATTRS = {"asnumpy", "asscalar", "item", "tolist", "wait_to_read",
+              "get_name_value"}
+NP_ALIASES = {"np", "numpy", "_np", "onp"}
+NP_SYNC_FNS = {"array", "asarray", "asanyarray"}
+CASTS = {"float", "int", "bool"}
+
+
+def _np_sync_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name or "." not in name:
+        return False
+    root, leaf = name.split(".", 1)[0], name.rsplit(".", 1)[-1]
+    return root in NP_ALIASES and leaf in NP_SYNC_FNS
+
+
+@register_checker
+class HostSyncChecker(Checker):
+    name = "host-sync-under-trace"
+    description = ("device->host sync (.asnumpy()/.item()/float()/"
+                   "np.asarray/...) reachable from a traced function or "
+                   "the @hot_path per-step path")
+
+    def check_file(self, ctx: FileCtx):
+        analysis = TraceAnalysis(ctx.tree)
+        for fn, qual, kind, why in analysis.regions():
+            where = (f"{kind} code ({why})" if kind == "traced"
+                     else f"the per-step hot path ({why})")
+            for node in walk_region(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in SYNC_ATTRS):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`.{node.func.attr}()` forces a device->host "
+                        f"sync inside {where}; defer it to an epoch/"
+                        f"report boundary", context=qual)
+                elif _np_sync_call(node):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`{dotted_name(node.func)}()` copies to host "
+                        f"inside {where}; keep data device-resident or "
+                        f"batch the transfer", context=qual)
+                elif kind == "traced":
+                    leaf = dotted_name(node.func)
+                    if leaf and leaf.rsplit(".", 1)[-1] == "device_get":
+                        yield ctx.finding(
+                            self.name, node,
+                            f"`{leaf}()` inside {where} blocks the trace "
+                            f"on a host transfer", context=qual)
+                    elif (isinstance(node.func, ast.Name)
+                          and node.func.id in CASTS
+                          and len(node.args) == 1
+                          and not isinstance(node.args[0], ast.Constant)):
+                        yield ctx.finding(
+                            self.name, node,
+                            f"`{node.func.id}()` on a traced value bakes "
+                            f"it in as a compile-time constant inside "
+                            f"{where} (and syncs to host)", context=qual)

@@ -1,0 +1,159 @@
+"""registry-consistency: registries, tests, and docs must name the same
+things.
+
+Two registries in this tree have contracts that live partly outside the
+code, where nothing (until now) stopped them drifting:
+
+* **fault sites** — every site named in ``resilience/faults.py``
+  (the ``SITES`` tuple plus every ``fault_point("...")`` literal in the
+  runtime) is a promise that (a) a test in
+  ``tests/test_resilience.py`` injects a fault there and (b)
+  ``docs/how_to/fault_tolerance.md`` documents it. A site armed in code
+  but absent from either is an untested/undocumented recovery path.
+* **operators** — ``mxnet_tpu/ops`` registrations feed the generated
+  ``nd.*``/``sym.*`` namespaces and their doc surface
+  (``ndarray_doc``/``symbol_doc`` attach examples by class name
+  ``<op>Doc``). A duplicate literal registration or alias collision
+  silently overwrites an op; a ``<op>Doc`` class whose op does not exist
+  attaches its examples to nothing.
+
+This is a project-level pass: it reads the linted ASTs for the registry
+side and the raw text of the test/doc files for the contract side.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..core import Checker, Finding, Project, register_checker
+from ..tracecontext import dotted_name
+
+FAULTS_PY = "mxnet_tpu/resilience/faults.py"
+FAULT_TESTS = "tests/test_resilience.py"
+FAULT_DOCS = "docs/how_to/fault_tolerance.md"
+OPS_PREFIX = "mxnet_tpu/ops/"
+DOC_BASES = {"NDArrayDoc", "SymbolDoc"}
+
+
+def _string_constants(node: ast.AST) -> List[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+@register_checker
+class RegistryConsistencyChecker(Checker):
+    name = "registry-consistency"
+    description = ("fault sites must appear in test_resilience.py and "
+                   "fault_tolerance.md; op registrations must not collide "
+                   "and <op>Doc classes must name real ops")
+
+    def check_project(self, project: Project):
+        yield from self._check_fault_sites(project)
+        yield from self._check_ops(project)
+
+    # -- fault sites -------------------------------------------------------
+
+    def _collect_sites(self, project: Project) -> List[Tuple[str, str, int]]:
+        """(site, relpath, line) for SITES entries and fault_point literals."""
+        out: List[Tuple[str, str, int]] = []
+        for ctx in project.ctxs:
+            if ctx.relpath == FAULTS_PY:
+                for node in ast.walk(ctx.tree):
+                    if (isinstance(node, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "SITES"
+                                    for t in node.targets)):
+                        for site in _string_constants(node.value):
+                            out.append((site, ctx.relpath, node.lineno))
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if name.rsplit(".", 1)[-1] != "fault_point":
+                    continue
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    out.append((node.args[0].value, ctx.relpath,
+                                node.lineno))
+        return out
+
+    def _check_fault_sites(self, project: Project):
+        sites = self._collect_sites(project)
+        if not sites:
+            return
+        surfaces = [(FAULT_TESTS, "no test injects a fault there"),
+                    (FAULT_DOCS, "the fault-tolerance guide does not "
+                                 "document it")]
+        for surface, consequence in surfaces:
+            text = project.read_text(surface)
+            if text is None:
+                continue        # partial checkouts / fixture trees
+            seen: Set[Tuple[str, str]] = set()
+            for site, relpath, line in sites:
+                if site in text or (site, surface) in seen:
+                    continue
+                seen.add((site, surface))
+                yield Finding(
+                    rule=self.name, path=relpath, line=line, col=0,
+                    message=f"fault site '{site}' is armed in the runtime "
+                            f"but missing from {surface} — {consequence}",
+                    context="<registry>")
+
+    # -- operators ---------------------------------------------------------
+
+    def _check_ops(self, project: Project):
+        registered: Dict[str, Tuple[str, int]] = {}
+        literal_universe: Set[str] = set()
+        ops_ctxs = [c for c in project.ctxs
+                    if c.relpath.startswith(OPS_PREFIX)]
+        for ctx in ops_ctxs:
+            literal_universe.update(_string_constants(ctx.tree))
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf not in ("register", "alias"):
+                    continue
+                names: List[str] = []
+                if (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    names.append(node.args[0].value)
+                for kw in node.keywords:
+                    if kw.arg == "aliases":
+                        names.extend(_string_constants(kw.value))
+                for opname in names:
+                    if opname in registered:
+                        prev_path, prev_line = registered[opname]
+                        yield Finding(
+                            rule=self.name, path=ctx.relpath,
+                            line=node.lineno, col=node.col_offset,
+                            message=f"op '{opname}' is registered/aliased "
+                                    f"more than once (first at "
+                                    f"{prev_path}) — the second "
+                                    f"registration silently wins",
+                            context="<registry>")
+                    else:
+                        registered[opname] = (ctx.relpath, node.lineno)
+        if not ops_ctxs:
+            return
+        universe = set(registered) | literal_universe
+        for ctx in project.ctxs:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = {dotted_name(b) or "" for b in node.bases}
+                if not any(b.rsplit(".", 1)[-1] in DOC_BASES
+                           for b in bases):
+                    continue
+                if not node.name.endswith("Doc") or node.name in DOC_BASES:
+                    continue
+                op = node.name[:-len("Doc")]
+                if op not in universe:
+                    yield Finding(
+                        rule=self.name, path=ctx.relpath, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"doc class {node.name} targets op "
+                                f"'{op}', which is not registered in "
+                                f"mxnet_tpu/ops — its examples attach to "
+                                f"nothing", context="<registry>")

@@ -1,0 +1,130 @@
+"""retrace-amplification: jit call sites that defeat the trace cache.
+
+``jax.jit`` caches compiled programs on the *wrapper object* plus the
+static-argument values. Three site shapes silently throw that cache away
+and recompile every call:
+
+* **fresh wrapper per iteration** — ``jax.jit(f)`` constructed inside a
+  ``for``/``while`` body;
+* **immediately-invoked wrapper** — ``jax.jit(f)(x)`` inside a function
+  body: the wrapper dies with the call, so every invocation of the outer
+  function retraces (at module level it runs once and is fine);
+* **unhashable static args** — a callable built with
+  ``static_argnums=...`` invoked with a list/dict/set literal (or
+  comprehension) in a static position: either a TypeError or, via
+  fallback hashing, a retrace per call.
+
+The static-args pass is intra-file and literal-based: it follows
+``g = jax.jit(f, static_argnums=...)`` assignments and
+``@partial(jax.jit, static_argnums=...)`` decorations, then inspects
+positional arguments at ``g(...)`` call sites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set, Tuple
+
+from ..core import Checker, FileCtx, register_checker
+from ..tracecontext import JIT_CACHE_WRAPPERS, dotted_name
+
+UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+              ast.SetComp, ast.GeneratorExp)
+
+
+def _wrapper_seg(node: ast.AST):
+    name = dotted_name(node)
+    seg = name.rsplit(".", 1)[-1] if name else None
+    return seg if seg in JIT_CACHE_WRAPPERS else None
+
+
+def _static_positions(call: ast.Call) -> Set[int]:
+    """Literal static_argnums positions of a jit(...) call, if decidable."""
+    for kw in call.keywords:
+        if kw.arg != "static_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = set()
+            for elt in v.elts:
+                if (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    out.add(elt.value)
+            return out
+    return set()
+
+
+@register_checker
+class RetraceChecker(Checker):
+    name = "retrace-amplification"
+    description = ("jit wrappers built per call/iteration, or static "
+                   "arguments that are unhashable — every call recompiles")
+
+    def check_file(self, ctx: FileCtx):
+        # name -> (static positions, definition line) for jitted callables
+        static_sites: Dict[str, Tuple[Set[int], int]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                seg = _wrapper_seg(node.value.func)
+                if seg and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    pos = _static_positions(node.value)
+                    if pos:
+                        static_sites[node.targets[0].id] = (pos, node.lineno)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and dotted_name(
+                            dec.func) in ("partial", "functools.partial"):
+                        if any(_wrapper_seg(a) for a in dec.args):
+                            pos = _static_positions(dec)
+                            if pos:
+                                static_sites[node.name] = (pos, node.lineno)
+
+        yield from self._walk(ctx, ctx.tree, loop_depth=0, func_depth=0,
+                              static_sites=static_sites)
+
+    def _walk(self, ctx, node, loop_depth, func_depth, static_sites):
+        for child in ast.iter_child_nodes(node):
+            ld, fd = loop_depth, func_depth
+            if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                ld += 1
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                # a function defined in a loop runs on its own schedule:
+                # its body starts a fresh loop context
+                fd += 1
+                ld = 0
+            if isinstance(child, ast.Call):
+                seg = _wrapper_seg(child.func)
+                if seg and ld > 0:
+                    yield ctx.finding(
+                        self.name, child,
+                        f"`{dotted_name(child.func)}(...)` constructs a "
+                        f"fresh jitted callable inside a loop — its trace "
+                        f"cache is discarded every iteration; hoist the "
+                        f"wrapper out of the loop")
+                elif (isinstance(child.func, ast.Call)
+                      and _wrapper_seg(child.func.func) and fd > 0
+                      and ld == 0):   # in a loop, the in-loop rule owns it
+                    yield ctx.finding(
+                        self.name, child,
+                        f"immediately-invoked "
+                        f"`{dotted_name(child.func.func)}(f)(...)` inside "
+                        f"a function: the wrapper (and its compiled "
+                        f"cache) is rebuilt on every call — bind it once "
+                        f"outside")
+                elif (isinstance(child.func, ast.Name)
+                      and child.func.id in static_sites):
+                    positions, defline = static_sites[child.func.id]
+                    for i, arg in enumerate(child.args):
+                        if i in positions and isinstance(arg, UNHASHABLE):
+                            yield ctx.finding(
+                                self.name, arg,
+                                f"static argument {i} of "
+                                f"`{child.func.id}()` (static_argnums at "
+                                f"its definition) is built fresh and "
+                                f"unhashable here — pass a hashable "
+                                f"(tuple/frozenset) or make it dynamic")
+            yield from self._walk(ctx, child, ld, fd, static_sites)

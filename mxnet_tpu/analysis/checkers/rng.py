@@ -1,0 +1,100 @@
+"""untracked-rng: global-RNG draws that break bitwise-identical resume.
+
+PR 1's resilience runtime guarantees that ``fit(resume='auto')`` replays
+a crashed run bitwise-identically: every random draw must come from state
+that is checkpointed (the trainer's threaded jax PRNG key, or
+``mxnet_tpu.random`` which it seeds). A ``np.random.uniform()`` or
+``random.random()`` draws from hidden process-global state that no
+checkpoint captures — after a resume the stream diverges silently. Inside
+a *traced* function it is doubly wrong: the draw happens once at trace
+time and is baked into the graph as a constant.
+
+Flagged:
+
+* in traced or ``@hot_path`` regions — any global-RNG call
+  (``np.random.*``, ``random.*``);
+* anywhere in checkpoint-relevant modules (the resilience runtime, the
+  trainer/module/model step-and-checkpoint path) — the code whose
+  determinism the resume guarantee rests on.
+
+Explicitly seeded generator objects (``random.Random(seed)``,
+``np.random.RandomState(seed)``, ``np.random.default_rng(seed)``) are
+*not* flagged: their state is constructed from a recorded seed and can be
+restored.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, FileCtx, register_checker
+from ..tracecontext import TraceAnalysis, dotted_name, walk_region
+
+NP_ALIASES = {"np", "numpy", "_np", "onp"}
+SEEDED_CTORS = {"RandomState", "default_rng", "Generator", "SeedSequence",
+                "Random", "PRNGKey", "key"}
+PY_RNG_FNS = {"random", "randint", "randrange", "uniform", "normalvariate",
+              "gauss", "choice", "choices", "shuffle", "sample", "seed",
+              "betavariate", "expovariate", "getrandbits", "triangular"}
+
+# modules whose determinism the resume-bitwise-identical guarantee rests
+# on: global-RNG use is flagged here even outside traced/hot regions
+CHECKPOINT_RELEVANT = ("mxnet_tpu/resilience/", "mxnet_tpu/parallel/",
+                       "mxnet_tpu/module/", "mxnet_tpu/model.py",
+                       "mxnet_tpu/kvstore.py")
+
+
+def _global_rng_call(call: ast.Call):
+    """Return a description if this call draws from hidden global RNG
+    state, else None."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    if parts[-1] in SEEDED_CTORS:
+        return None
+    if len(parts) >= 3 and parts[0] in NP_ALIASES and parts[1] == "random":
+        return f"`{name}()` draws from numpy's process-global RNG"
+    if len(parts) == 2 and parts[0] == "random" and parts[1] in PY_RNG_FNS:
+        return f"`{name}()` draws from the stdlib process-global RNG"
+    return None
+
+
+@register_checker
+class RngChecker(Checker):
+    name = "untracked-rng"
+    description = ("np.random/random global-state draws in traced, "
+                   "hot-path, or checkpoint-relevant code — breaks "
+                   "bitwise-identical resume; use seeded mxnet_tpu.random "
+                   "keys")
+
+    def check_file(self, ctx: FileCtx):
+        analysis = TraceAnalysis(ctx.tree)
+        in_region = set()
+        for fn, qual, kind, why in analysis.regions():
+            for node in walk_region(fn):
+                in_region.add(node)
+                if not isinstance(node, ast.Call):
+                    continue
+                desc = _global_rng_call(node)
+                if desc:
+                    extra = (" — and inside a trace it is baked in as a "
+                             "constant" if kind == "traced" else "")
+                    yield ctx.finding(
+                        self.name, node,
+                        f"{desc} in {kind} code ({why}); no checkpoint "
+                        f"captures that state, so resume diverges{extra}. "
+                        f"Thread a seeded mxnet_tpu.random key instead",
+                        context=qual)
+        if any(ctx.relpath.startswith(p) or ctx.relpath == p.rstrip("/")
+               for p in CHECKPOINT_RELEVANT):
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, ast.Call) and node not in in_region):
+                    desc = _global_rng_call(node)
+                    if desc:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"{desc} in checkpoint-relevant module "
+                            f"{ctx.relpath}; the resume-bitwise-identical "
+                            f"guarantee requires seeded, checkpointable "
+                            f"RNG state (mxnet_tpu.random / "
+                            f"random.Random(seed))")

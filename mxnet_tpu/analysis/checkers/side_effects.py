@@ -1,0 +1,135 @@
+"""trace-time-side-effects: Python effects baked in (or lost) at trace time.
+
+A traced function runs as Python exactly once per compilation; any
+side effect in it — a ``print``, a ``logging`` call, appending to an
+enclosing-scope list, writing ``self.x`` — happens at *trace* time, not
+per step. The usual symptom: debug output that appears once and never
+again, or a cache/counter that silently stops updating after the first
+call. (jax.debug.print / jax.debug.callback are the traced-safe
+alternatives and are not flagged.)
+
+Flagged, in traced regions only:
+
+* ``print(...)``, ``logging.<level>(...)``, ``warnings.warn(...)``;
+* ``global`` / ``nonlocal`` declarations;
+* mutating method calls (``append``/``update``/``add``/...) whose
+  receiver is not local to the traced function (enclosing scope or
+  ``self``/``cls``);
+* subscript/attribute assignment through a non-local receiver
+  (``cache[k] = v``, ``self.count += 1``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ..core import Checker, FileCtx, register_checker
+from ..tracecontext import TraceAnalysis, dotted_name, walk_region
+
+MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+            "popitem", "remove", "discard", "clear", "setdefault",
+            "write", "writelines"}
+LOG_ROOTS = {"logging", "warnings", "logger", "log"}
+
+
+def _region_locals(fn: ast.AST) -> Set[str]:
+    """Names bound inside the region: parameters and assignment targets.
+    (Approximate on purpose — a linter's scope model, not a compiler's.)"""
+    out: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            out.add(a.arg)
+    for node in walk_region(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+        elif isinstance(node, (ast.comprehension,)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            out.add(node.name)
+    return out
+
+
+def _receiver_root(node: ast.AST):
+    """Base Name of an attribute/subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register_checker
+class SideEffectChecker(Checker):
+    name = "trace-time-side-effects"
+    description = ("print/logging, global/nonlocal, or mutation of "
+                   "enclosing-scope state inside a traced function — "
+                   "runs at trace time, not per step")
+
+    def check_file(self, ctx: FileCtx):
+        analysis = TraceAnalysis(ctx.tree)
+        for fn, qual, kind, why in analysis.regions():
+            if kind != "traced":
+                continue
+            local = _region_locals(fn)
+
+            def nonlocal_root(recv):
+                root = _receiver_root(recv)
+                if root in ("self", "cls"):
+                    return root
+                if root is not None and root not in local:
+                    return root
+                return None
+
+            for node in walk_region(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kw = ("global" if isinstance(node, ast.Global)
+                          else "nonlocal")
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`{kw} {', '.join(node.names)}` inside traced "
+                        f"code ({why}): the rebind happens once at trace "
+                        f"time", context=qual)
+                elif isinstance(node, ast.Call):
+                    name = dotted_name(node.func) or ""
+                    root = name.split(".", 1)[0]
+                    if name == "print":
+                        yield ctx.finding(
+                            self.name, node,
+                            f"`print()` inside traced code ({why}) fires "
+                            f"only at trace time — use jax.debug.print "
+                            f"for per-step output", context=qual)
+                    elif root in LOG_ROOTS and "." in name:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"`{name}()` inside traced code ({why}) "
+                            f"fires only at trace time — use "
+                            f"jax.debug.callback", context=qual)
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in MUTATORS):
+                        root = nonlocal_root(node.func.value)
+                        if root is not None:
+                            yield ctx.finding(
+                                self.name, node,
+                                f"`{root}...{node.func.attr}()` mutates "
+                                f"state from outside the traced function "
+                                f"({why}); the mutation happens once at "
+                                f"trace time", context=qual)
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for tgt in targets:
+                        if not isinstance(tgt, (ast.Attribute,
+                                                ast.Subscript)):
+                            continue
+                        root = nonlocal_root(tgt)
+                        if root is not None:
+                            yield ctx.finding(
+                                self.name, tgt,
+                                f"assignment through `{root}` mutates "
+                                f"state from outside the traced function "
+                                f"({why}); it runs once at trace time",
+                                context=qual)

@@ -1,0 +1,113 @@
+"""tpu-lint CLI: ``python -m mxnet_tpu.analysis`` / ``make lint-tpu``.
+
+Exit codes: 0 — clean (or every finding is in the committed baseline);
+1 — new findings; 2 — usage error. ``--write-baseline`` snapshots the
+current findings as the grandfathered set and exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import core
+
+DEFAULT_BASELINE = "tpu-lint-baseline.json"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="tpu-lint: AST-based static analysis for TPU/JAX "
+                    "hazards (docs/how_to/tpu_lint.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: mxnet_tpu/ "
+                         "under --root)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths, the baseline, and "
+                         "cross-file consistency checks (default: cwd)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/"
+                         f"{DEFAULT_BASELINE} when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring any baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--checker", action="append", dest="checkers",
+                    metavar="RULE", help="run only the named checker "
+                    "(repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    from . import checkers as _pkg  # noqa: F401  (populate registry)
+
+    if args.list_rules:
+        for name in sorted(core.CHECKERS):
+            print(f"{name}: {core.CHECKERS[name].description}")
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    paths = args.paths
+    if args.write_baseline and paths:
+        print("tpu-lint: --write-baseline lints the default full target; "
+              "explicit paths would drop every other file's grandfathered "
+              "entries — omit them", file=sys.stderr)
+        return 2
+    if not paths:
+        default = os.path.join(root, "mxnet_tpu")
+        if not os.path.isdir(default):
+            print("tpu-lint: no paths given and no mxnet_tpu/ under "
+                  f"{root}", file=sys.stderr)
+            return 2
+        paths = [default]
+    if args.checkers:
+        unknown = [c for c in args.checkers if c not in core.CHECKERS]
+        if unknown:
+            print(f"tpu-lint: unknown checker(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            print("tpu-lint: --write-baseline with --checker would drop "
+                  "every other rule's grandfathered entries; run it over "
+                  "all checkers", file=sys.stderr)
+            return 2
+
+    try:
+        findings = core.lint(paths, root=root, checkers=args.checkers)
+    except FileNotFoundError as exc:
+        print(f"tpu-lint: no such path: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        core.write_baseline(baseline_path, findings)
+        print(f"tpu-lint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    fingerprints = (set() if args.no_baseline
+                    else core.load_baseline(baseline_path))
+    new, grandfathered = core.split_by_baseline(findings, fingerprints)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(f) | {"fingerprint": f.fingerprint()}
+                    for f in new],
+            "grandfathered": len(grandfathered)}, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        summary = (f"tpu-lint: {len(new)} new finding(s)"
+                   + (f", {len(grandfathered)} grandfathered"
+                      if grandfathered else ""))
+        print(summary if new or grandfathered else "tpu-lint: clean")
+    return 1 if new else 0

@@ -1,0 +1,294 @@
+"""tpu-lint core: findings, checker registry, suppressions, baseline, runner.
+
+Reference analogue: Relay/TVM make a graph framework diagnosable by
+running typed passes over an IR (PAPERS.md: arxiv 1810.00952, 1802.04799).
+mxnet_tpu's "IR" for host-side hazards is the Python source itself, so the
+pass infrastructure here runs over stdlib ``ast`` trees — no new
+dependencies — and the passes are the checkers under
+``mxnet_tpu/analysis/checkers/``.
+
+Three mechanisms make the linter deployable on a live tree:
+
+* **suppressions** — ``# tpu-lint: disable=<rule>[,<rule>...]`` as a
+  trailing comment silences the named rules on that line; on a line of
+  its own it silences them for the whole file. ``disable=all`` works.
+* **baseline** — a committed JSON file of fingerprinted findings that are
+  grandfathered; the CLI exits non-zero only on findings *not* in it.
+  Fingerprints hash (rule, path, enclosing-function, message) and ignore
+  line numbers, so unrelated edits don't invalidate the baseline.
+* **registry** — checkers self-register via :func:`register_checker`;
+  adding a rule is one module under ``checkers/`` (docs/how_to/tpu_lint.md).
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "Checker", "FileCtx", "Project", "CHECKERS",
+           "register_checker", "collect_files", "lint",
+           "load_baseline", "write_baseline", "split_by_baseline"]
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``context`` is the enclosing function's qualname
+    (or ``<module>``) — part of the baseline fingerprint precisely so the
+    fingerprint survives line-number drift."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    context: str = "<module>"
+
+    def fingerprint(self) -> str:
+        digest = hashlib.sha1(self.message.encode("utf-8")).hexdigest()[:10]
+        return f"{self.rule}:{self.path}:{self.context}:{digest}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message} [{self.context}]")
+
+
+# ---------------------------------------------------------------------------
+# checker registry
+# ---------------------------------------------------------------------------
+
+CHECKERS: Dict[str, type] = {}
+
+
+class Checker:
+    """Base checker. Subclasses set ``name``/``description`` and override
+    ``check_file`` (per-file AST pass) and/or ``check_project`` (one pass
+    with every parsed file + the repo root, for cross-file consistency)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check_file(self, ctx: "FileCtx") -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: "Project") -> Iterable[Finding]:
+        return ()
+
+
+def register_checker(cls):
+    """Class decorator: add a Checker subclass to the global registry."""
+    if not cls.name:
+        raise ValueError(f"checker {cls.__name__} needs a non-empty name")
+    if cls.name in CHECKERS:
+        raise ValueError(f"checker {cls.name!r} registered twice")
+    CHECKERS[cls.name] = cls
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# per-file context + suppression comments
+# ---------------------------------------------------------------------------
+
+# rule list stops at the first non-rule token, so trailing prose is fine:
+# "# tpu-lint: disable=host-sync-under-trace — scalar metadata, not tracers"
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*disable=((?:all|[A-Za-z0-9_\-]+)"
+    r"(?:\s*,\s*(?:all|[A-Za-z0-9_\-]+))*)")
+
+
+def _parse_suppressions(src: str) -> Tuple[Set[str], Dict[int, Set[str]]]:
+    """Scan comments for ``tpu-lint: disable=`` pragmas.
+
+    Returns (file_disables, {line: disables}). A pragma on a line that
+    holds only the comment applies file-wide; a trailing pragma applies to
+    its own line.
+    """
+    file_disables: Set[str] = set()
+    line_disables: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if tok.line.strip().startswith("#"):
+                file_disables |= rules
+            else:
+                line_disables.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass        # partial token stream: keep whatever was collected
+    return file_disables, line_disables
+
+
+class FileCtx:
+    """One parsed source file plus its suppression pragmas."""
+
+    def __init__(self, path: str, relpath: str, src: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.src = src
+        self.tree = ast.parse(src)
+        self.file_disables, self.line_disables = _parse_suppressions(src)
+
+    def suppressed(self, finding: Finding) -> bool:
+        for disables in (self.file_disables,
+                         self.line_disables.get(finding.line, ())):
+            if "all" in disables or finding.rule in disables:
+                return True
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                context: str = "<module>") -> Finding:
+        return Finding(rule=rule, path=self.relpath,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message, context=context)
+
+
+class Project:
+    """The full lint target: parsed files + the repo root (for project
+    checkers that need to read files outside the linted set, e.g. tests
+    and docs)."""
+
+    def __init__(self, root: str, ctxs: Sequence[FileCtx]):
+        self.root = root
+        self.ctxs = list(ctxs)
+        self._by_relpath = {c.relpath: c for c in self.ctxs}
+
+    def ctx(self, relpath: str) -> Optional[FileCtx]:
+        return self._by_relpath.get(relpath)
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        full = os.path.join(self.root, relpath)
+        if not os.path.isfile(full):
+            return None
+        with open(full, "r", encoding="utf-8") as fh:
+            return fh.read()
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", ".eggs"}
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for base, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+                out.extend(os.path.join(base, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            raise FileNotFoundError(p)
+    return sorted(set(out))
+
+
+def lint(paths: Sequence[str], root: Optional[str] = None,
+         checkers: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the (selected) checkers over ``paths``; returns unsuppressed
+    findings sorted by location. Unparseable files surface as
+    ``parse-error`` findings rather than crashing the run."""
+    # populate the registry (checker modules self-register on import)
+    from . import checkers as _checkers_pkg  # noqa: F401
+
+    root = os.path.abspath(root or os.getcwd())
+    ctxs: List[FileCtx] = []
+    findings: List[Finding] = []
+    for path in collect_files(paths):
+        relpath = os.path.relpath(os.path.abspath(path), root)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            ctxs.append(FileCtx(path, relpath, src))
+        except (SyntaxError, UnicodeDecodeError, ValueError) as exc:
+            findings.append(Finding(
+                rule="parse-error", path=relpath.replace(os.sep, "/"),
+                line=getattr(exc, "lineno", 1) or 1, col=0,
+                message=f"could not parse: {exc.__class__.__name__}"))
+
+    selected = [CHECKERS[n]() for n in (checkers or sorted(CHECKERS))]
+    project = Project(root, ctxs)
+    for checker in selected:
+        for ctx in ctxs:
+            for f in checker.check_file(ctx):
+                if not ctx.suppressed(f):
+                    findings.append(f)
+        for f in checker.check_project(project):
+            ctx = project.ctx(f.path)
+            if ctx is None or not ctx.suppressed(f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def _ordinal_fingerprints(findings: Sequence[Finding]
+                          ) -> List[Tuple[Finding, str]]:
+    """Fingerprint each finding, disambiguating repeats.
+
+    Identical (rule, path, context, message) findings would otherwise
+    collapse into one fingerprint, letting a *new* duplicate violation
+    hide behind a single grandfathered entry. The first occurrence (in
+    location order) keeps the base fingerprint; later ones get ``#2``,
+    ``#3``, ... — ordinals are order-based, so line drift still does not
+    invalidate a baseline, but count growth does.
+    """
+    counts: Dict[str, int] = {}
+    out: List[Tuple[Finding, str]] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        base = f.fingerprint()
+        n = counts[base] = counts.get(base, 0) + 1
+        out.append((f, base if n == 1 else f"{base}#{n}"))
+    return out
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Fingerprint set from a baseline file; empty if the file is absent."""
+    if not os.path.isfile(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"] for e in data.get("findings", ())}
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    """Persist ``findings`` as the new grandfathered baseline."""
+    entries = [{"fingerprint": fp, "rule": f.rule, "path": f.path,
+                "context": f.context, "message": f.message}
+               for f, fp in _ordinal_fingerprints(list(findings))]
+    entries.sort(key=lambda e: e["fingerprint"])
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def split_by_baseline(findings: Sequence[Finding], fingerprints: Set[str]
+                      ) -> Tuple[List[Finding], List[Finding]]:
+    """Partition into (new, grandfathered), matching repeats by ordinal."""
+    new, old = [], []
+    for f, fp in _ordinal_fingerprints(findings):
+        (old if fp in fingerprints else new).append(f)
+    new.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return new, old

@@ -1,0 +1,265 @@
+"""Static trace-region analysis: which functions run under a JAX trace?
+
+The behavioral checkers (host-sync, side-effects, untracked-rng) all need
+the same answer: *is this statement executed at trace time or on the
+per-step hot path?* This module computes it once per file:
+
+**Traced roots**
+  * functions decorated with ``jit``/``pjit``/``shard_map``/``vmap``/
+    ``pmap``/``remat``/``grad`` & friends, including through
+    ``functools.partial(jax.jit, ...)``;
+  * named functions and lambdas passed to a trace-inducing call
+    (``jax.jit(step, ...)``, ``jax.lax.scan(body, ...)``,
+    ``shard_map(lambda ...)``) — resolved through the *lexical* scope
+    chain of the call site, so ``jax.jit(step)`` inside ``bind()`` marks
+    the closure defined there, not a same-named method elsewhere.
+
+**Hot-path roots**
+  * functions decorated with ``@hot_path`` (analysis/annotations.py) —
+    how the Module/SPMDTrainer per-step path is declared to the linter.
+
+**Host escapes** — functions handed to ``jax.pure_callback`` /
+``io_callback`` / ``jax.debug.callback`` run on the *host*, outside the
+trace, and are excluded (with everything only reachable through them).
+``eval_shape`` is also not trace-inducing here: it is a one-shot abstract
+evaluation whose closures conventionally harvest shape metadata by
+mutation.
+
+**Propagation** — within the file, calls by lexically-resolved bare name
+(``helper(x)``) and self/cls-method calls (``self.measure(...)``) extend
+each region to its callees, and functions nested inside a traced function
+are traced (closures baked into the trace). The analysis is deliberately
+intra-module: a linter wants cheap, explainable reach, not a
+whole-program call graph — cross-module hot paths are declared with
+``@hot_path`` at their entry points instead.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+__all__ = ["TRACE_WRAPPERS", "JIT_CACHE_WRAPPERS", "HOST_ESCAPES",
+           "dotted_name", "TraceAnalysis", "walk_region"]
+
+# Call/decorator names (last dotted segment) that trace their function
+# arguments. Loose by design: a linter prefers a rare false hit that a
+# suppression comment can document over a silent miss.
+TRACE_WRAPPERS = {
+    "jit", "pjit", "pmap", "vmap", "shard_map", "xmap",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan",
+    "remat", "checkpoint", "grad", "value_and_grad", "jacfwd", "jacrev",
+    "custom_vjp", "custom_jvp", "pallas_call",
+}
+
+# The subset whose *construction* owns a trace cache — building one of
+# these per call/iteration is the retrace-amplification hazard.
+JIT_CACHE_WRAPPERS = {"jit", "pjit", "pmap"}
+
+# Functions passed to these run host-side, outside any trace. Matched on
+# the last dotted segment, plus the two dotted idioms below whose last
+# segment alone would be too generic to key on.
+HOST_ESCAPES = {"pure_callback", "io_callback"}
+HOST_ESCAPE_SUFFIXES = ("debug.callback", "host_callback.call")
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_segment(node: ast.AST) -> Optional[str]:
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _is_trace_decorator(dec: ast.AST) -> bool:
+    if _last_segment(dec) in TRACE_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        seg = _last_segment(dec.func)
+        if seg in TRACE_WRAPPERS:
+            return True
+        if seg == "partial":        # @partial(jax.jit, static_argnums=...)
+            return any(_last_segment(a) in TRACE_WRAPPERS for a in dec.args)
+    return False
+
+
+def _is_hot_decorator(dec: ast.AST) -> bool:
+    if _last_segment(dec) == "hot_path":
+        return True
+    return (isinstance(dec, ast.Call)
+            and _last_segment(dec.func) == "hot_path")
+
+
+def walk_region(fn: ast.AST) -> Iterator[ast.AST]:
+    """Yield the nodes of one function's own body, stopping at nested
+    function/lambda boundaries (nested regions are analyzed — and
+    reported — on their own)."""
+    body = fn.body if isinstance(fn, _FUNC_NODES) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class TraceAnalysis:
+    """Per-file map from function/lambda nodes to their execution region.
+
+    ``regions()`` yields ``(node, qualname, kind, why)`` where kind is
+    ``"traced"`` or ``"hot"`` (traced wins when both apply).
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.qualname: Dict[ast.AST, str] = {}
+        # every named function/method, for self.X and attribute resolution
+        self._by_name: Dict[str, List[ast.AST]] = {}
+        # lexical scope -> {name: def}; key None is module level. Methods
+        # (immediate children of a class body) are *not* lexical names.
+        self._scope_defs: Dict[Optional[ast.AST], Dict[str, ast.AST]] = {}
+        # function -> enclosing-function chain, innermost first
+        self._scope_chain: Dict[ast.AST, Tuple] = {}
+        self._children: Dict[ast.AST, List[ast.AST]] = {}
+        self._host_escaped: Set[ast.AST] = set()
+        self._traced: Dict[ast.AST, str] = {}
+        self._hot: Dict[ast.AST, str] = {}
+        self._index(tree, prefix="", parent=None, in_class=False)
+        self._mark_wrapper_call_args(tree, scope=())
+        self._propagate()
+
+    # -- construction ------------------------------------------------------
+
+    def _record(self, node: ast.AST, parent: Optional[ast.AST]):
+        if parent is not None:
+            self._children.setdefault(parent, []).append(node)
+        chain = ((parent,) + self._scope_chain.get(parent, ())
+                 if parent is not None else ())
+        self._scope_chain[node] = chain
+
+    def _index(self, node: ast.AST, prefix: str,
+               parent: Optional[ast.AST], in_class: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                qual = f"{prefix}{child.name}"
+                self.qualname[child] = qual
+                self._by_name.setdefault(child.name, []).append(child)
+                if not in_class:    # methods aren't bare-name reachable
+                    self._scope_defs.setdefault(parent, {})[child.name] \
+                        = child
+                self._record(child, parent)
+                for dec in child.decorator_list:
+                    if _is_trace_decorator(dec):
+                        self._traced[child] = "trace-inducing decorator"
+                    elif _is_hot_decorator(dec):
+                        self._hot[child] = "@hot_path"
+                self._index(child, prefix=f"{qual}.", parent=child,
+                            in_class=False)
+            elif isinstance(child, ast.ClassDef):
+                self._index(child, prefix=f"{prefix}{child.name}.",
+                            parent=parent, in_class=True)
+            elif isinstance(child, ast.Lambda):
+                self.qualname[child] = f"{prefix}<lambda>"
+                self._record(child, parent)
+                self._index(child, prefix=prefix, parent=child,
+                            in_class=False)
+            else:
+                self._index(child, prefix=prefix, parent=parent,
+                            in_class=in_class)
+
+    def _resolve_lexical(self, name: str, scope: Tuple) -> List[ast.AST]:
+        """Resolve a bare name through the enclosing-function chain, then
+        module scope. Never falls through to methods of unrelated
+        classes — bare names obey lexical scoping."""
+        for fn in scope:
+            hit = self._scope_defs.get(fn, {}).get(name)
+            if hit is not None:
+                return [hit]
+        hit = self._scope_defs.get(None, {}).get(name)
+        return [hit] if hit is not None else []
+
+    def _fn_args_of(self, call: ast.Call, scope: Tuple) -> List[ast.AST]:
+        """Function-valued arguments of a call: lambdas, lexically
+        resolved names, and self-attribute methods."""
+        out: List[ast.AST] = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Lambda):
+                out.append(arg)
+            elif isinstance(arg, ast.Name):
+                out.extend(self._resolve_lexical(arg.id, scope))
+            elif (isinstance(arg, ast.Attribute)
+                  and isinstance(arg.value, ast.Name)
+                  and arg.value.id in ("self", "cls")):
+                out.extend(self._by_name.get(arg.attr, ()))
+        return out
+
+    def _mark_wrapper_call_args(self, node: ast.AST, scope: Tuple):
+        """``jax.jit(step)`` / ``scan(body, ...)``: mark function-valued
+        arguments as traced; args of pure_callback & co. as host-escaped.
+        ``scope`` is the chain of enclosing functions, innermost first."""
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(child, _FUNC_NODES + (ast.Lambda,)):
+                child_scope = (child,) + scope
+            elif isinstance(child, ast.Call):
+                seg = _last_segment(child.func)
+                full = dotted_name(child.func) or ""
+                if seg in HOST_ESCAPES or full.endswith(
+                        HOST_ESCAPE_SUFFIXES):
+                    self._host_escaped.update(
+                        self._fn_args_of(child, scope))
+                elif seg in TRACE_WRAPPERS:
+                    for fn in self._fn_args_of(child, scope):
+                        self._traced.setdefault(fn, f"passed to {seg}()")
+            self._mark_wrapper_call_args(child, child_scope)
+
+    def _callees(self, fn: ast.AST) -> Set[ast.AST]:
+        """In-module callees: lexically-scoped bare-name calls and
+        self/cls-method calls."""
+        scope = (fn,) + self._scope_chain.get(fn, ())
+        out: Set[ast.AST] = set()
+        for node in walk_region(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                out.update(self._resolve_lexical(node.func.id, scope))
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id in ("self", "cls")):
+                out.update(self._by_name.get(node.func.attr, ()))
+        return out
+
+    def _propagate(self):
+        for marks, label in ((self._traced, "traced"), (self._hot, "hot")):
+            for fn in self._host_escaped:
+                marks.pop(fn, None)
+            frontier = list(marks)
+            while frontier:
+                fn = frontier.pop()
+                why = f"called from {label} " \
+                      f"{self.qualname.get(fn, '<lambda>')}()"
+                nxt = self._children.get(fn, []) + list(self._callees(fn))
+                for callee in nxt:
+                    if callee not in marks \
+                            and callee not in self._host_escaped:
+                        marks[callee] = why
+                        frontier.append(callee)
+
+    # -- queries -----------------------------------------------------------
+
+    def regions(self) -> Iterator[Tuple[ast.AST, str, str, str]]:
+        for fn, why in self._traced.items():
+            yield fn, self.qualname.get(fn, "<lambda>"), "traced", why
+        for fn, why in self._hot.items():
+            if fn not in self._traced:
+                yield fn, self.qualname.get(fn, "<lambda>"), "hot", why

@@ -1231,7 +1231,9 @@ def custom_op_register(op_type: str, num_inputs: int, num_outputs: int,
         shapes, k = [], 0
         for i in range(num_outputs):
             nd_i = out_ndims[i]
-            shapes.append(tuple(int(out_flat[k + j]) for j in range(nd_i)))
+            # trace-time shape inference over host ctypes buffers — these
+            # ints are static metadata, never tracer values
+            shapes.append(tuple(int(out_flat[k + j]) for j in range(nd_i)))  # tpu-lint: disable=host-sync-under-trace
             k += _MAX_CUSTOM_NDIM
         return shapes
 

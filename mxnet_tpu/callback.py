@@ -14,6 +14,8 @@ import logging
 import time
 from collections import namedtuple
 
+from .analysis.annotations import hot_path
+
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
            "module_checkpoint", "ProgressBar", "BatchEndParam",
            "ResilienceMonitor"]
@@ -55,8 +57,11 @@ def _metric_line(prefix_parts, metric, reset):
     """One log line: prefix parts + every (name, value) pair of ``metric``."""
     parts = list(prefix_parts)
     if metric is not None:
+        # intentional report-boundary sync: every caller gates this on its
+        # `frequent`/`period`, so the drained readback is amortized — the
+        # per-batch update path itself stays sync-free (metric.py)
         parts += [f"{name}={value:f}"
-                  for name, value in metric.get_name_value()]
+                  for name, value in metric.get_name_value()]  # tpu-lint: disable=host-sync-under-trace
         if reset:
             metric.reset()
     logging.info("\t".join(parts))
@@ -65,10 +70,12 @@ def _metric_line(prefix_parts, metric, reset):
 def log_train_metric(period, auto_reset=False):
     """Log training metrics every ``period`` batches."""
 
+    @hot_path("batch-end callback, fires every batch")
     def callback(param):
         if param.eval_metric is None or param.nbatch % period:
             return
-        for name, value in param.eval_metric.get_name_value():
+        # intentional: gated on `period` just above — a report boundary
+        for name, value in param.eval_metric.get_name_value():  # tpu-lint: disable=host-sync-under-trace
             logging.info("Iter[%d] Batch[%d] Train-%s=%f",
                          param.epoch, param.nbatch, name, value)
         if auto_reset:
@@ -92,6 +99,7 @@ class Speedometer:
         self._tick = None       # wall time at the last report boundary
         self._prev_batch = -1
 
+    @hot_path("batch-end callback, fires every batch")
     def __call__(self, param):
         if param.nbatch < self._prev_batch:
             self._tick = None
@@ -118,6 +126,7 @@ class ProgressBar:
         self.bar_len = length
         self.total = total
 
+    @hot_path("batch-end callback, fires every batch")
     def __call__(self, param):
         frac = param.nbatch / float(self.total)
         fill = int(round(self.bar_len * frac))
@@ -143,6 +152,7 @@ class ResilienceMonitor:
                 + sum(stats["retry"]["giveups"].values())
                 + sum(stats["faults"]["fired"].values()))
 
+    @hot_path("batch-end callback, fires every batch")
     def __call__(self, param):
         from .resilience import stats as _resilience_stats
         self.stats = _resilience_stats()

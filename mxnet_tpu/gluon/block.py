@@ -305,8 +305,11 @@ class HybridBlock(Block):
                 autograd.set_training(old_train)
                 autograd.set_recording(old_rec)
             flat_out, out_fmt = _flatten_nd(out)
-            out_meta["fmt"] = out_fmt
-            out_meta["n_visible"] = len(flat_out)
+            # intentional trace-time harvest: the eval_shape call below
+            # runs fn abstractly once, and these writes capture output
+            # structure (identical for every later trace of fn)
+            out_meta["fmt"] = out_fmt  # tpu-lint: disable=trace-time-side-effects
+            out_meta["n_visible"] = len(flat_out)  # tpu-lint: disable=trace-time-side-effects
             results = [o._data for o in flat_out]
             # aux states written in-place during the trace (BatchNorm moving
             # stats) become extra outputs, written back by aux_update
@@ -316,7 +319,7 @@ class HybridBlock(Block):
                 if w._data is not v0:
                     aux_updates[len(results)] = n_in + j
                     results.append(w._data)
-            out_meta["aux_update"] = aux_updates
+            out_meta["aux_update"] = aux_updates  # tpu-lint: disable=trace-time-side-effects
             return tuple(results)
 
         # trace once eagerly (cheap — abstract eval) to learn output count

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import re
 
+from .analysis.annotations import hot_path
 from .base import MXNetError
 
 __all__ = ["Monitor"]
@@ -20,6 +21,44 @@ __all__ = ["Monitor"]
 def _mean_abs(x):
     """Reference default statistic: mean absolute value."""
     return x.abs().mean() if hasattr(x, "abs") else abs(x).mean()
+
+
+def _host_batch(values):
+    """Fetch many device stat values in one transfer.
+
+    Stat functions return device scalars (NDArray or jax arrays) or
+    lists/tuples of them; stringifying one by one would serialize a
+    device->host sync per element (tpu-lint: host-sync-under-trace). All
+    device leaves — including those nested in list/tuple stats — are
+    gathered into one ``jax.device_get``; host-side values (python
+    floats, strings) pass through untouched.
+    """
+    import jax
+
+    leaves = []
+
+    def _is_device(v):
+        return hasattr(v, "_data") or isinstance(v, jax.Array)
+
+    def _index(v):
+        if _is_device(v):
+            leaves.append(v._data if hasattr(v, "_data") else v)
+            return ("leaf", len(leaves) - 1)
+        if isinstance(v, (list, tuple)):
+            return ("seq", [_index(e) for e in v])
+        return ("raw", v)
+
+    def _restore(spec, fetched):
+        kind, payload = spec
+        if kind == "leaf":
+            return fetched[payload]
+        if kind == "seq":
+            return [_restore(s, fetched) for s in payload]
+        return payload
+
+    specs = [_index(v) for v in values]
+    fetched = jax.device_get(leaves) if leaves else []
+    return [_restore(spec, fetched) for spec in specs]
 
 
 class Monitor:
@@ -39,6 +78,7 @@ class Monitor:
         """Attach to an executor (reference: exe.set_monitor_callback)."""
         self._executors.append(exe)
 
+    @hot_path("called every batch from the fit loop")
     def tic(self):
         """Arm collection for this batch when the interval has elapsed."""
         if self._batch % self._every == 0:
@@ -55,6 +95,7 @@ class Monitor:
             yield from ((name, arr) for name, arr in internals.items()
                         if self._name_filter(name))
 
+    @hot_path("called every batch from the fit loop; interval-gated")
     def toc(self):
         """Collect stats from all installed executors; returns
         [(step, name, stat_str)]."""
@@ -65,10 +106,13 @@ class Monitor:
                 for name, arr in self._pull()]
         if self._sorted:
             rows.sort(key=lambda row: row[1])
+        # one batched readback for every stat of this interval, instead
+        # of a sync per row when str() hits each device scalar below
+        values = _host_batch([row[2] for row in rows])
         flat = []
-        for step, name, value in rows:
-            values = value if isinstance(value, (list, tuple)) else (value,)
-            flat.extend((step, name, str(v)) for v in values)
+        for (step, name, _), value in zip(rows, values):
+            items = value if isinstance(value, (list, tuple)) else (value,)
+            flat.extend((step, name, str(v)) for v in items)
         return flat
 
     def toc_print(self):

@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import initializer as _init_mod, optimizer as _opt_mod
+from ..analysis.annotations import hot_path
 from ..base import MXNetError
 from ..executor import build_graph_eval
 from ..ndarray import NDArray
@@ -338,6 +339,7 @@ class SPMDTrainer:
 
     # -- stepping ----------------------------------------------------------
 
+    @hot_path("the per-step training path (ISSUE: SPMDTrainer.step)")
     def step(self, batch: Dict[str, np.ndarray]):
         """Run one optimizer step on a global batch; returns outputs."""
         if self._step_fn is None:
@@ -355,7 +357,9 @@ class SPMDTrainer:
                 # per batch (catastrophic through a remote tunnel)
                 v = v._data
             elif not isinstance(v, jax.Array):
-                v = np.asarray(v)
+                # host-side input prep: device arrays took the _data path
+                # above, so this never reads back from the accelerator
+                v = np.asarray(v)  # tpu-lint: disable=host-sync-under-trace
             # no-op when v is already device-resident with this sharding
             inputs[n] = jax.device_put(v, self._in_shardings[n])
         self._num_update += 1

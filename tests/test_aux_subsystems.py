@@ -173,3 +173,24 @@ def test_backward_mirror_knob_same_grads():
                                    g1, rtol=1e-5, atol=1e-6)
     finally:
         del os.environ["MXTPU_BACKWARD_DO_MIRROR"]
+
+
+def test_monitor_list_stat_func_batched_readback():
+    """Custom stat functions returning a list of device scalars flatten
+    into per-value rows, fetched in one batched transfer (monitor
+    _host_batch handles nested device leaves)."""
+    sym = _mlp()
+    ex = sym.simple_bind(data=(2, 10))
+    mon = mx.Monitor(interval=1, pattern="fc1_output",
+                     stat_func=lambda x: [x.min(), x.max()])
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True,
+               data=np.random.rand(2, 10).astype(np.float32),
+               softmax_label=np.zeros(2, np.float32))
+    stats = mon.toc()
+    rows = [s for s in stats if s[1] == "fc1_output"]
+    assert len(rows) == 2                      # one row per list element
+    host = ex.internal_outputs()["fc1_output"].asnumpy()
+    assert float(rows[0][2]) == pytest.approx(float(host.min()), rel=1e-5)
+    assert float(rows[1][2]) == pytest.approx(float(host.max()), rel=1e-5)

@@ -90,3 +90,93 @@ def test_metric_reset_and_get_name_value():
     assert dict(acc.get_name_value())["accuracy"] == 1.0
     acc.reset()
     assert np.isnan(acc.get()[1]) or acc.get()[1] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# deferred-sync behavior (tpu-lint host-sync-under-trace: update() buffers
+# device arrays; the readback happens at get()/epoch boundaries)
+# ---------------------------------------------------------------------------
+
+def _batch(label_vals, pred_rows):
+    return ([nd.array(np.asarray(label_vals, np.float32))],
+            [nd.array(np.asarray(pred_rows, np.float32))])
+
+
+def test_update_defers_and_get_drains():
+    acc = mx.metric.Accuracy()
+    for _ in range(5):
+        acc.update(*_batch([1], [[0.1, 0.9]]))
+    assert len(acc._pending) == 5          # no sync yet
+    assert acc.num_inst == 0
+    assert acc.get()[1] == pytest.approx(1.0)
+    assert not acc._pending                # drained
+    assert acc.num_inst == 5
+
+
+def test_count_cap_triggers_amortized_drain():
+    acc = mx.metric.Accuracy()
+    for _ in range(mx.metric.MAX_PENDING):
+        acc.update(*_batch([0], [[0.9, 0.1]]))
+    assert not acc._pending                # safety valve drained
+    assert acc.num_inst == mx.metric.MAX_PENDING
+
+
+def test_byte_cap_triggers_early_drain(monkeypatch):
+    monkeypatch.setattr(mx.metric, "MAX_PENDING_BYTES", 16)
+    acc = mx.metric.Accuracy()
+    acc.update(*_batch([1, 0], [[0.1, 0.9], [0.8, 0.2]]))  # 24 B > 16 B
+    assert not acc._pending
+    assert acc.num_inst == 2
+
+
+def test_drain_error_keeps_later_batches():
+    acc = mx.metric.Accuracy()
+    acc.update(*_batch([1], [[0.1, 0.9]]))                # good
+    acc.update([nd.array(np.zeros(2, np.float32))],       # bad: 2 labels,
+               [nd.array(np.array([[0.1, 0.9]], np.float32))])  # 1 row
+    acc.update(*_batch([0], [[0.9, 0.1]]))                # good
+    with pytest.raises(ValueError):
+        acc.get()
+    # byte accounting tracks the re-queued remainder (safety valve stays
+    # honest after a failed drain)
+    assert acc._pending_bytes == sum(
+        sum(x.nbytes for x in ls) + sum(x.nbytes for x in ps)
+        for ls, ps in acc._pending) > 0
+    # offender consumed, the batch after it is still accounted for
+    assert acc.get()[1] == pytest.approx(1.0)
+    assert acc.num_inst == 2
+    assert acc._pending_bytes == 0
+
+
+def test_reset_discards_pending():
+    acc = mx.metric.Accuracy()
+    acc.update(*_batch([1], [[0.1, 0.9]]))
+    acc.reset()
+    assert not acc._pending and acc._pending_bytes == 0
+    assert np.isnan(acc.get()[1])
+
+
+def test_snapshot_copies_recycled_numpy_buffers():
+    """A caller reusing one numpy buffer across batches must not alias
+    every pending entry to the final batch's contents."""
+    acc = mx.metric.Accuracy()
+    label_buf = np.zeros(1, np.float32)
+    pred_buf = np.zeros((1, 2), np.float32)
+    # batch 1: label 1, pred argmax 1 (correct)
+    label_buf[:] = 1.0
+    pred_buf[:] = [[0.1, 0.9]]
+    acc.update([label_buf], [pred_buf])
+    # buffer recycled for batch 2: label 0, pred argmax 1 (wrong)
+    label_buf[:] = 0.0
+    pred_buf[:] = [[0.2, 0.8]]
+    acc.update([label_buf], [pred_buf])
+    assert acc.get()[1] == pytest.approx(0.5)   # not 0.0, not 1.0
+
+
+def test_loss_ignores_label_argument_entirely():
+    m = mx.metric.Loss()
+    pred = nd.array(np.array([2.0, 4.0], np.float32))
+    m.update(0, [pred])          # scalar placeholder label: reference OK
+    m.update(None, [pred])
+    assert m.get()[1] == pytest.approx(3.0)
+    assert m.num_inst == 4

@@ -346,6 +346,38 @@ def test_kvstore_push_retries_injected_fault(monkeypatch):
     monkeypatch.setattr(rretry, "_default", None)
 
 
+def test_kvstore_init_barrier_checkpoint_read_sites_retry(monkeypatch,
+                                                          tmp_path):
+    """The kvstore.init, kvstore.barrier and checkpoint.read fault sites
+    ride the same retry/backoff path as push/pull (tpu-lint
+    registry-consistency: every armed site must be exercised here)."""
+    from mxnet_tpu.resilience import retry as rretry
+    monkeypatch.setattr(rretry, "_default",
+                        RetryPolicy(max_retries=3, base_delay=0.0,
+                                    jitter=0.0, sleep=lambda s: None))
+    faults.arm(FaultPlan().arm("kvstore.init", nth=1, exc="ioerror")
+               .arm("kvstore.barrier", nth=1, exc="timeout")
+               .arm("checkpoint.read", nth=1, exc="ioerror"))
+    kv = mx.kv.create("local")
+    kv.init("w", nd.array(np.ones(3, np.float32)))  # init site retried
+    kv.barrier()                                    # barrier site retried
+    out = nd.array(np.zeros(3, np.float32))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(3))
+    blob = tmp_path / "state.bin"
+    blob.write_bytes(b"payload")
+    # checkpoint.read: first attempt faults, retry reads the real bytes
+    assert rckpt.read_bytes_guarded(str(blob)) == b"payload"
+    st = resilience.stats()
+    assert st["retry"]["retries"]["kvstore.init"] == 1
+    assert st["retry"]["retries"]["kvstore.barrier"] == 1
+    assert st["retry"]["retries"]["checkpoint.read"] == 1
+    assert st["faults"]["fired"] == {"kvstore.init": 1,
+                                     "kvstore.barrier": 1,
+                                     "checkpoint.read": 1}
+    monkeypatch.setattr(rretry, "_default", None)
+
+
 def test_data_iter_fetch_retries_and_stopiteration_passes(monkeypatch):
     from mxnet_tpu.resilience import retry as rretry
     monkeypatch.setattr(rretry, "_default",

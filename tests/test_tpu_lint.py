@@ -1,0 +1,476 @@
+"""tpu-lint suite: every checker proves a true positive AND a true
+negative on fixture snippets, plus suppression-comment, baseline, CLI
+exit-code, and lint-the-real-tree behavior (docs/how_to/tpu_lint.md)."""
+import json
+import os
+import textwrap
+
+import pytest
+
+from mxnet_tpu.analysis import core
+from mxnet_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint(tmp_path, name="snippet.py", source="", extra=None):
+    """Write fixture file(s) under tmp_path and lint them."""
+    files = {name: source, **(extra or {})}
+    paths = []
+    for rel, src in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src))
+        paths.append(str(full))
+    return core.lint(paths, root=str(tmp_path))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# host-sync-under-trace
+# ---------------------------------------------------------------------------
+
+def test_host_sync_true_positives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return float(x.sum())          # cast on traced value
+
+        def body(carry, x):
+            probe = x.asnumpy()            # sync inside scan body
+            host = np.asarray(x)           # host copy inside trace
+            return carry, probe + host
+
+        out = jax.lax.scan(body, 0.0, None)
+    """)
+    sync = [f for f in findings if f.rule == "host-sync-under-trace"]
+    assert len(sync) == 3
+    assert {f.context for f in sync} == {"step", "body"}
+
+
+def test_host_sync_hot_path_and_propagation(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        from mxnet_tpu.analysis.annotations import hot_path
+
+        class Metric:
+            @hot_path("per-batch update")
+            def update(self, labels, preds):
+                self._accumulate(labels, preds)
+
+            def _accumulate(self, labels, preds):
+                for l, p in zip(labels, preds):
+                    self.sum += as_host(l)
+
+        def as_host(x):
+            return x.asnumpy()
+    """)
+    sync = [f for f in findings if f.rule == "host-sync-under-trace"]
+    assert len(sync) == 1 and sync[0].context == "as_host"
+
+
+def test_host_sync_true_negatives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x * 2 + jax.numpy.sum(x)
+
+        def epoch_end(metric):            # not traced, not hot: free to sync
+            return metric.asnumpy(), float(np.pi)
+
+        def host_fn(x):                   # pure_callback target: host-side
+            return np.asarray(x) + x.item()
+
+        def wrapped(x):
+            return jax.pure_callback(host_fn, x, x)
+    """)
+    assert "host-sync-under-trace" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# trace-time-side-effects
+# ---------------------------------------------------------------------------
+
+def test_side_effects_true_positives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+        import logging
+
+        seen = []
+        counters = {}
+
+        @jax.jit
+        def step(x):
+            print("step!", x)              # fires once, at trace time
+            logging.info("tracing %s", x)
+            seen.append(x)                 # enclosing-scope mutation
+            counters["n"] = 1              # enclosing-scope dict write
+            return x
+    """)
+    effects = [f for f in findings if f.rule == "trace-time-side-effects"]
+    assert len(effects) == 4
+
+
+def test_side_effects_true_negatives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        def eager(x):                      # not traced: effects are fine
+            print(x)
+            cache = []
+            cache.append(x)
+            return cache
+
+        @jax.jit
+        def step(x):
+            local = []                     # local mutation is fine
+            local.append(x * 2)
+            table = {}
+            table["y"] = x
+            return local[0] + table["y"]
+    """)
+    assert "trace-time-side-effects" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# retrace-amplification
+# ---------------------------------------------------------------------------
+
+def test_retrace_true_positives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        def f(x, cfg):
+            return x
+
+        def train(batches):
+            for b in batches:
+                out = jax.jit(f)(b, None)      # fresh wrapper per iteration
+            return out
+
+        def predict(x):
+            return jax.jit(lambda y: y + 1)(x)  # immediately-invoked
+
+        g = jax.jit(f, static_argnums=(1,))
+
+        def call_bad(x):
+            return g(x, [1, 2, 3])              # unhashable static arg
+    """)
+    retrace = [f for f in findings if f.rule == "retrace-amplification"]
+    assert len(retrace) == 3
+
+
+def test_retrace_true_negatives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        def f(x, cfg):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+        module_level = jax.jit(f)(1.0, None)    # runs once at import: fine
+
+        def train(batches):
+            for b in batches:
+                out = g(b, (1, 2, 3))           # hashable static: fine
+            return out
+    """)
+    assert "retrace-amplification" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# untracked-rng
+# ---------------------------------------------------------------------------
+
+def test_rng_true_positives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+        import random
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            noise = np.random.uniform(size=3)   # baked in at trace time
+            return x + noise + random.random()
+    """)
+    rng = [f for f in findings if f.rule == "untracked-rng"]
+    assert len(rng) == 2
+
+
+def test_rng_checkpoint_relevant_module_and_negatives(tmp_path):
+    findings = run_lint(
+        tmp_path, name="mxnet_tpu/resilience/thing.py", source="""
+        import random
+        import numpy as np
+
+        def jittered_backoff(attempt):
+            return attempt * np.random.uniform()   # hidden global state
+
+        def seeded(seed):
+            rng = random.Random(seed)              # seeded ctor: fine
+            gen = np.random.default_rng(seed)      # seeded ctor: fine
+            return rng.random() + gen.uniform()
+    """)
+    rng = [f for f in findings if f.rule == "untracked-rng"]
+    assert len(rng) == 1 and "np.random.uniform" in rng[0].message
+
+    clean = run_lint(tmp_path, name="mxnet_tpu/io.py", source="""
+        import numpy as np
+
+        def shuffle_indices(n, seed):       # not checkpoint-relevant, not
+            np.random.seed(seed)            # traced/hot: out of scope
+            return np.random.permutation(n)
+    """)
+    assert "untracked-rng" not in rules_of(clean)
+
+
+# ---------------------------------------------------------------------------
+# registry-consistency
+# ---------------------------------------------------------------------------
+
+_FAULTS_FIXTURE = """
+    SITES = ("checkpoint.write", "io.next")
+
+    def fault_point(site):
+        pass
+"""
+
+
+def test_registry_consistency_fault_sites(tmp_path):
+    findings = run_lint(
+        tmp_path, name="mxnet_tpu/resilience/faults.py",
+        source=_FAULTS_FIXTURE,
+        extra={
+            "tests/test_resilience.py": "# exercises checkpoint.write\n",
+            "docs/how_to/fault_tolerance.md":
+                "covers checkpoint.write and io.next\n",
+        })
+    reg = [f for f in findings if f.rule == "registry-consistency"]
+    # io.next missing from tests; both sites present in docs
+    assert len(reg) == 1
+    assert "io.next" in reg[0].message and "test_resilience" in reg[0].message
+
+
+def test_registry_consistency_ops_and_negatives(tmp_path):
+    findings = run_lint(
+        tmp_path, name="mxnet_tpu/ops/math_ops.py", source="""
+        def register(name, aliases=()):
+            def deco(fn):
+                return fn
+            return deco
+
+        register("relu")(lambda x: x)
+        register("relu", aliases=["Activation"])(lambda x: x)  # duplicate
+    """, extra={"mxnet_tpu/ndarray_doc.py": """
+        class NDArrayDoc:
+            pass
+
+        class reluDoc(NDArrayDoc):
+            '''Examples for a real op.'''
+
+        class ghostDoc(NDArrayDoc):
+            '''Examples for an op that does not exist.'''
+    """})
+    reg = [f for f in findings if f.rule == "registry-consistency"]
+    msgs = " | ".join(f.message for f in reg)
+    assert len(reg) == 2
+    assert "registered/aliased more than once" in msgs
+    assert "ghost" in msgs and "reluDoc" not in msgs
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline + CLI
+# ---------------------------------------------------------------------------
+
+_BAD_SNIPPET = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return float(x.sum())
+"""
+
+
+def test_line_suppression_silences_only_that_line(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        @jax.jit
+        def step(x):
+            a = float(x.sum())  # tpu-lint: disable=host-sync-under-trace
+            b = int(x.max())
+            return a + b
+    """)
+    sync = [f for f in findings if f.rule == "host-sync-under-trace"]
+    assert len(sync) == 1 and "int()" in sync[0].message
+
+
+def test_suppression_allows_trailing_justification_prose(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x.sum())  # tpu-lint: disable=host-sync-under-trace static metadata, not a tracer
+    """)
+    assert "host-sync-under-trace" not in rules_of(findings)
+
+
+def test_retrace_loop_context_resets_inside_nested_function(tmp_path):
+    """jit in the *body* of a function defined in a loop runs on the
+    function's schedule, not per loop iteration — no finding."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        def build(devs):
+            makers = []
+            for d in devs:
+                def maker(scale=d):
+                    def seg(x):
+                        return x * scale
+                    return jax.jit(seg)      # runs when maker() is called
+                makers.append(maker)
+            return makers
+    """)
+    assert "retrace-amplification" not in rules_of(findings)
+
+
+def test_file_suppression_silences_whole_file(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        # tpu-lint: disable=host-sync-under-trace
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x.sum()) + int(x.max())
+    """)
+    assert "host-sync-under-trace" not in rules_of(findings)
+
+
+def test_baseline_grandfathers_old_findings(tmp_path):
+    findings = run_lint(tmp_path, source=_BAD_SNIPPET)
+    assert findings
+    baseline = tmp_path / "tpu-lint-baseline.json"
+    core.write_baseline(str(baseline), findings)
+    fingerprints = core.load_baseline(str(baseline))
+    new, old = core.split_by_baseline(findings, fingerprints)
+    assert not new and len(old) == len(findings)
+    # a fresh finding is NOT covered
+    more = run_lint(tmp_path, name="other.py", source="""
+        import jax
+
+        @jax.jit
+        def other(x):
+            return x.item()
+    """)
+    new, _ = core.split_by_baseline(more, fingerprints)
+    assert len(new) == 1
+
+
+def test_baseline_ordinals_catch_new_identical_violation(tmp_path):
+    """A second violation with the same (rule, path, context, message) as
+    a grandfathered one must NOT hide behind its fingerprint."""
+    one = run_lint(tmp_path, source="""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x.sum())
+    """)
+    baseline = tmp_path / "tpu-lint-baseline.json"
+    core.write_baseline(str(baseline), one)
+    fingerprints = core.load_baseline(str(baseline))
+    two = run_lint(tmp_path, source="""
+        import jax
+
+        @jax.jit
+        def step(x):
+            a = float(x.sum())
+            return float(x.sum()) + a      # same message, new occurrence
+    """)
+    new, old = core.split_by_baseline(two, fingerprints)
+    assert len(old) == 1 and len(new) == 1
+
+
+def test_cli_write_baseline_refuses_single_checker(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(_BAD_SNIPPET))
+    rc = lint_main([str(bad), "--root", str(tmp_path),
+                    "--checker", "untracked-rng", "--write-baseline"])
+    assert rc == 2
+    assert "grandfathered" in capsys.readouterr().err
+
+
+def test_cli_write_baseline_refuses_explicit_paths(tmp_path, capsys):
+    """Partial-tree baseline writes would drop other files' entries."""
+    (tmp_path / "mxnet_tpu").mkdir()
+    bad = tmp_path / "mxnet_tpu" / "bad.py"
+    bad.write_text(textwrap.dedent(_BAD_SNIPPET))
+    rc = lint_main([str(bad), "--root", str(tmp_path), "--write-baseline"])
+    assert rc == 2
+    assert "grandfathered" in capsys.readouterr().err
+    # the default full-target form still works
+    assert lint_main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    assert lint_main(["--root", str(tmp_path)]) == 0
+
+
+def test_cli_exit_codes_and_write_baseline(tmp_path, capsys):
+    (tmp_path / "mxnet_tpu").mkdir()      # the default lint target
+    bad = tmp_path / "mxnet_tpu" / "bad.py"
+    bad.write_text(textwrap.dedent(_BAD_SNIPPET))
+    root = ["--root", str(tmp_path)]
+    assert lint_main([str(bad)] + root) == 1          # new finding
+    assert lint_main(["--write-baseline"] + root) == 0
+    assert lint_main([str(bad)] + root) == 0          # baselined now
+    assert lint_main([str(bad), "--no-baseline"] + root) == 1
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("host-sync-under-trace", "trace-time-side-effects",
+                 "retrace-amplification", "untracked-rng",
+                 "registry-consistency"):
+        assert rule in out
+
+
+def test_cli_json_output(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(_BAD_SNIPPET))
+    assert lint_main([str(bad), "--root", str(tmp_path), "--json",
+                      "--no-baseline"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["new"] and data["new"][0]["rule"] == "host-sync-under-trace"
+    assert data["new"][0]["fingerprint"]
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    findings = run_lint(tmp_path, source="def broken(:\n")
+    assert rules_of(findings) == {"parse-error"}
+
+
+# ---------------------------------------------------------------------------
+# the committed tree itself
+# ---------------------------------------------------------------------------
+
+def test_repo_lints_clean_against_committed_baseline():
+    """`make lint-tpu` contract: the committed tree has zero new findings
+    (the hot paths in metric/monitor/callback/trainer stay honest)."""
+    rc = lint_main([os.path.join(REPO, "mxnet_tpu"), "--root", REPO])
+    assert rc == 0
+
+
+def test_repo_hot_paths_have_zero_baseline_entries():
+    """Grandfathered findings must never cover the per-step hot path
+    (ISSUE 2: the linter lands with an honest zero-baseline there)."""
+    baseline = os.path.join(REPO, "tpu-lint-baseline.json")
+    with open(baseline) as fh:
+        entries = json.load(fh)["findings"]
+    hot_files = {"mxnet_tpu/metric.py", "mxnet_tpu/monitor.py",
+                 "mxnet_tpu/callback.py", "mxnet_tpu/parallel/trainer.py"}
+    assert not [e for e in entries if e["path"] in hot_files]
