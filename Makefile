@@ -100,9 +100,19 @@ ci-resilience: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
 	    -m 'not slow' -x -q
 
+# stage 9: serving smoke — boot a threaded server on a toy model, arm a
+# FaultPlan that kills the backend mid-stream, assert shed/open/recover
+# without hangs (docs/how_to/serving.md); `timeout` bounds the stage so
+# a reintroduced hang fails instead of wedging the runner
+ci-serving: ci-native
+	timeout -k 10 120 env JAX_PLATFORMS=cpu python ci/serving_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
-    ci-frontends ci-dryrun ci-resilience
+    ci-frontends ci-dryrun ci-resilience ci-serving
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
-        ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience
+        ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
+        ci-serving

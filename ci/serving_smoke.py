@@ -1,0 +1,97 @@
+"""Serving smoke stage (`make ci-serving`, docs/how_to/serving.md).
+
+Boots a *threaded* server on a toy model — real worker threads, real
+clock, unlike the deterministic fake-clock unit suite — then arms a
+FaultPlan that kills the backend mid-stream and asserts the full
+degradation story without ever hanging:
+
+1. burst traffic beyond queue capacity -> immediate QueueFull shed;
+2. injected backend faults -> circuit opens -> fast-fail CircuitOpen;
+3. cool-down elapses -> half-open probe -> circuit recloses and the
+   endpoint serves again (readyz flips back to ready).
+
+The whole script is further bounded by `timeout` in the Makefile, so a
+regression that reintroduces a hang fails the stage instead of wedging
+the runner.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu.resilience import FaultPlan, faults  # noqa: E402
+from mxnet_tpu.serving import (CallableBackend, CircuitBreaker,  # noqa: E402
+                               CircuitOpen, InferenceServer, QueueFull)
+
+
+def main():
+    def slowish(arrays):
+        time.sleep(0.02)              # enough service time to pile a burst
+        return [arrays["data"] * 2.0]
+
+    breaker = CircuitBreaker(window=8, min_calls=3, failure_rate=0.6,
+                             cooldown=0.2, probes=1)
+    server = InferenceServer(CallableBackend(slowish), buckets=[4],
+                             capacity=3, workers=1, breaker=breaker,
+                             default_deadline=10.0, name="smoke")
+    server.warm_up()
+    assert server.readyz()["ready"], server.readyz()
+
+    # -- 1. overload: the bounded queue sheds instead of queueing forever
+    pending, shed = [], 0
+    for _ in range(12):
+        try:
+            pending.append(server.submit(np.ones((2, 3), np.float32)))
+        except QueueFull:
+            shed += 1
+    assert shed > 0, "burst of 12 into capacity 3 must shed"
+    for req in pending:
+        out = server.result(req)
+        assert out[0].shape == (2, 3)
+    print(f"shed ok: {shed}/12 rejected immediately, rest served")
+
+    # -- 2. backend dies mid-stream: circuit opens, callers fast-fail
+    faults.arm(FaultPlan().arm("serving.forward", nth=1, count=5))
+    failures = 0
+    for _ in range(5):
+        try:
+            server.predict(np.ones((2, 3), np.float32), deadline=5.0)
+        except OSError:
+            failures += 1
+        except CircuitOpen:
+            break
+    assert breaker.state == "open", breaker.stats()
+    try:
+        server.predict(np.ones((2, 3), np.float32), deadline=5.0)
+        raise AssertionError("open circuit must fast-fail")
+    except CircuitOpen:
+        pass
+    assert not server.readyz()["ready"]
+    print(f"circuit ok: opened after {failures} injected faults, "
+          f"fast-fails while open")
+
+    # -- 3. recovery: cool-down -> half-open probe -> reclosed
+    deadline = time.monotonic() + 30.0
+    while breaker.state == "open":
+        assert time.monotonic() < deadline, "cool-down never elapsed"
+        time.sleep(0.05)
+    out = server.predict(np.ones((2, 3), np.float32), deadline=5.0)
+    assert np.all(out[0] == 2.0)
+    assert breaker.state == "closed"
+    assert server.readyz()["ready"]
+    print("recovery ok: half-open probe reclosed the circuit")
+
+    stats = server.stats()
+    server.close()
+    print(f"serving smoke PASS: {stats['completed']} served, "
+          f"{stats['shed']} shed, circuit opened "
+          f"{stats['circuit']['opened_count']}x")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,7 @@ from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401
 from . import resilience  # noqa: F401
+from . import serving  # noqa: F401
 from . import notebook  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401

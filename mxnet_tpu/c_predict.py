@@ -21,31 +21,51 @@ from . import symbol as _sym_mod
 __all__ = ["Predictor", "load_ndarray_file"]
 
 
+def _corrupt(what: str, err: BaseException) -> MXNetError:
+    """Normalize np.load's failure zoo (zipfile.BadZipFile, ValueError,
+    EOFError, OSError, ...) on corrupt/truncated bytes into one clear,
+    catchable MXNetError — a bad artifact must read as 'bad artifact'
+    at the serving/ABI boundary, not as a leaked internal exception."""
+    return MXNetError(
+        f"corrupt or truncated {what}: cannot parse as an "
+        f"npz/NDArray container ({type(err).__name__}: {err})")
+
+
 def _params_from_bytes(param_bytes: bytes):
     """Parse an in-memory .params (npz container with arg:/aux: keys)."""
     arg_params, aux_params = {}, {}
     if not param_bytes:
         return arg_params, aux_params
-    with np.load(_io.BytesIO(param_bytes)) as f:
-        for k in f.keys():
-            if ":" in k:
-                tp, name = k.split(":", 1)
-            else:
-                tp, name = "arg", k
-            (arg_params if tp == "arg" else aux_params)[name] = f[k]
+    try:
+        with np.load(_io.BytesIO(param_bytes)) as f:
+            for k in f.keys():
+                if ":" in k:
+                    tp, name = k.split(":", 1)
+                else:
+                    tp, name = "arg", k
+                (arg_params if tp == "arg" else aux_params)[name] = f[k]
+    except MXNetError:
+        raise
+    except Exception as err:
+        raise _corrupt(".params bytes", err) from err
     return arg_params, aux_params
 
 
 def load_ndarray_file(nd_bytes: bytes):
     """MXNDListCreate's loader: returns (keys, arrays) from file bytes."""
-    with np.load(_io.BytesIO(nd_bytes)) as f:
-        keys = list(f.keys())
-        if all(k.isdigit() for k in keys):
-            keys_sorted = sorted(keys, key=int)
-            return [""] * len(keys_sorted), [f[k] for k in keys_sorted]
-        arrays = [f[k] for k in keys]
-        names = [k.split(":", 1)[1] if ":" in k else k for k in keys]
-        return names, arrays
+    try:
+        with np.load(_io.BytesIO(nd_bytes)) as f:
+            keys = list(f.keys())
+            if all(k.isdigit() for k in keys):
+                keys_sorted = sorted(keys, key=int)
+                return [""] * len(keys_sorted), [f[k] for k in keys_sorted]
+            arrays = [f[k] for k in keys]
+            names = [k.split(":", 1)[1] if ":" in k else k for k in keys]
+            return names, arrays
+    except MXNetError:
+        raise
+    except Exception as err:
+        raise _corrupt("NDArray-file bytes", err) from err
 
 
 def load_ndarray_list_flat(nd_bytes: bytes):

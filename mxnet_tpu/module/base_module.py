@@ -212,6 +212,13 @@ class BaseModule:
                             eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
+    def as_serving_backend(self, input_name=None):
+        """Adapt this bound module for the serving runtime
+        (:class:`mxnet_tpu.serving.InferenceServer`): forward-only, one
+        host batch in, numpy outputs back (docs/how_to/serving.md)."""
+        from ..serving.backends import ModuleBackend
+        return ModuleBackend(self, input_name=input_name)
+
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
         if reset:

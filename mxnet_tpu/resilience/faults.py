@@ -38,7 +38,9 @@ __all__ = ["FaultPlan", "InjectedFault", "InjectedTimeout", "InjectedKill",
 # name so downstream code can add its own).
 SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          "kvstore.push", "kvstore.pull", "kvstore.barrier", "io.next",
-         "trainer.step")
+         "trainer.step",
+         # serving runtime (mxnet_tpu/serving, docs/how_to/serving.md)
+         "serving.forward", "serving.load", "serving.queue")
 
 ENV_PLAN = "MXNET_TPU_FAULT_PLAN"
 ENV_SEED = "MXNET_TPU_FAULT_SEED"

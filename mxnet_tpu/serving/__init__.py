@@ -1,0 +1,48 @@
+"""Production model-serving runtime (docs/how_to/serving.md).
+
+The inference counterpart of :mod:`mxnet_tpu.resilience`: where that
+package keeps *training* alive across faults, this one keeps *serving*
+up under overload and backend failure, reusing the same primitives —
+the injectable-clock :class:`~mxnet_tpu.resilience.RetryPolicy` and the
+seedable :class:`~mxnet_tpu.resilience.FaultPlan` (sites
+``serving.forward``, ``serving.load``, ``serving.queue``).
+
+Five pillars:
+
+- **Admission control** (:mod:`.admission`) — a bounded queue that
+  sheds (``QueueFull``) instead of building unbounded latency; optional
+  oldest-first eviction.
+- **Deadlines** — every request carries an absolute budget enforced
+  end-to-end: in queue, in flight, and at the caller (watchdog).
+- **Circuit breaking** (:mod:`.breaker`) — closed -> open on backend
+  error rate -> half-open probe -> closed; wraps forward *and* load.
+- **Graceful degradation** (:mod:`.warmup`, fallback) — shape-bucketed
+  warm-up so live requests never compile, off-bucket batches padded
+  (``@hot_path``, tpu-lint-clean) not retraced, and an optional
+  fallback model served while the circuit is open.
+- **Probes + stats** — ``healthz()``/``readyz()`` and a per-endpoint
+  counter surface (:func:`stats`) mirroring ``resilience.retry.stats()``.
+"""
+from __future__ import annotations
+
+from . import admission, backends, breaker, errors, server, warmup  # noqa: F401
+from .admission import AdmissionQueue, Deadline, Request  # noqa: F401
+from .backends import (CallableBackend, ModuleBackend,  # noqa: F401
+                       PredictorBackend)
+from .breaker import CircuitBreaker  # noqa: F401
+from .errors import (CircuitOpen, DeadlineExceeded, QueueFull,  # noqa: F401
+                     ServerClosed, ServingError)
+from .server import InferenceServer, endpoint_stats, endpoints  # noqa: F401
+from .warmup import ShapeBuckets  # noqa: F401
+
+__all__ = ["InferenceServer", "AdmissionQueue", "Deadline", "Request",
+           "CircuitBreaker", "ShapeBuckets", "CallableBackend",
+           "PredictorBackend", "ModuleBackend", "ServingError",
+           "QueueFull", "DeadlineExceeded", "CircuitOpen", "ServerClosed",
+           "endpoints", "endpoint_stats", "stats"]
+
+
+def stats() -> dict:
+    """Per-endpoint serving counters (the serving mirror of
+    :func:`mxnet_tpu.resilience.stats`)."""
+    return endpoint_stats()

@@ -1,0 +1,226 @@
+"""Admission control: deadlines, requests, and the bounded queue.
+
+The queue is the only place a request may wait, and it is bounded:
+beyond ``capacity`` the runtime *sheds* — either the new arrival
+(``policy='reject'``, the default) or the oldest queued request
+(``policy='evict-oldest'``, which favours fresh traffic whose deadline
+still has budget). Shedding is immediate (:class:`~.errors.QueueFull`),
+so burst overload degrades to fast-fail instead of unbounded latency.
+
+Deadlines are absolute timestamps on an injectable clock
+(``expires_at = clock() + budget``), so tests drive every expiry path —
+including a backward clock jump, which *extends* the remaining budget
+rather than spuriously expiring the request — with zero real sleeps.
+
+The ``serving.queue`` fault site sits at the top of :meth:`offer` behind
+the resilience retry policy (:func:`~mxnet_tpu.resilience.guarded_point`),
+mirroring ``io.next``: injected retriable faults exercise the backoff
+path, then admission proceeds exactly once.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..resilience import guarded_point
+from .errors import DeadlineExceeded, QueueFull, ServerClosed
+
+__all__ = ["Deadline", "Request", "AdmissionQueue"]
+
+
+class Deadline:
+    """An absolute expiry on an injectable clock (None = no budget)."""
+
+    __slots__ = ("clock", "expires_at")
+
+    def __init__(self, budget: Optional[float],
+                 clock: Callable[[], float] = time.monotonic):
+        self.clock = clock
+        self.expires_at = None if budget is None else clock() + budget
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left, negative if already expired, None if unbounded.
+        A backward clock jump makes this *grow* — a request is only ever
+        expired by the clock moving past ``expires_at``."""
+        if self.expires_at is None:
+            return None
+        return self.expires_at - self.clock()
+
+    def expired(self) -> bool:
+        rem = self.remaining()
+        return rem is not None and rem <= 0
+
+
+class Request:
+    """One in-flight inference request: inputs + deadline + a settable
+    result slot the caller waits on. States: queued -> running -> done.
+    ``abandon()`` is the caller-side watchdog giving up — a late result
+    from a wedged worker is then discarded, never delivered."""
+
+    __slots__ = ("inputs", "deadline", "use_fallback", "state", "worker",
+                 "enqueued_at", "_event", "_value", "_error", "_lock")
+
+    def __init__(self, inputs, deadline: Deadline, use_fallback=False):
+        self.inputs = inputs
+        self.deadline = deadline
+        self.use_fallback = use_fallback
+        self.state = "queued"
+        self.worker = None
+        self.enqueued_at = deadline.clock()
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        self._lock = threading.Lock()
+
+    def complete(self, value) -> bool:
+        """Deliver a result; False if the caller already abandoned."""
+        with self._lock:
+            delivered = self.state != "abandoned"
+            if delivered:
+                self._value = value
+                self.state = "done"
+            self._event.set()
+            return delivered
+
+    def fail(self, error: BaseException) -> bool:
+        with self._lock:
+            delivered = self.state != "abandoned"
+            if delivered:
+                self._error = error
+                self.state = "done"
+            self._event.set()
+            return delivered
+
+    def start(self, worker) -> bool:
+        """Worker claims the request (queued -> running); False when the
+        caller already abandoned it (the worker must then drop it)."""
+        with self._lock:
+            if self.state != "queued":
+                return False
+            self.worker = worker
+            self.state = "running"
+            return True
+
+    def abandon(self) -> str:
+        """Caller gives up (deadline hit while queued or in flight).
+        Returns the state the request was in, so the server can tell a
+        merely-queued request from one wedged inside a forward."""
+        with self._lock:
+            prior = self.state
+            if prior != "done":
+                self.state = "abandoned"
+            return prior
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+
+class AdmissionQueue:
+    """Bounded FIFO between submitters and workers.
+
+    ``offer`` never blocks: at capacity it sheds (per policy) instead.
+    ``take`` blocks until an item arrives or the queue is closed (then
+    returns None); ``poll`` is the non-blocking variant that drives the
+    deterministic ``workers=0`` mode.
+    """
+
+    POLICIES = ("reject", "evict-oldest")
+
+    def __init__(self, capacity: int = 64, policy: str = "reject",
+                 clock: Callable[[], float] = time.monotonic):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if policy not in self.POLICIES:
+            raise ValueError(f"policy must be one of {self.POLICIES}")
+        self.capacity = capacity
+        self.policy = policy
+        self.clock = clock
+        self._items: deque = deque()
+        self._cv = threading.Condition()
+        self.open = True
+        self.admitted = 0
+        self.shed = 0
+        self.evicted = 0
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    depth = __len__
+
+    def offer(self, req: Request) -> Optional[Request]:
+        """Admit ``req`` or shed. Raises QueueFull when the request
+        itself is rejected; with evict-oldest the *evicted* request is
+        failed with QueueFull and the new one is admitted — the evicted
+        request is returned so the caller can account for it."""
+        guarded_point("serving.queue")
+        evicted = None
+        with self._cv:
+            if not self.open:
+                # closed != full: racing a shutdown must read as
+                # shutdown, not as retryable overload
+                raise ServerClosed("admission queue is closed")
+            if len(self._items) >= self.capacity:
+                if self.policy == "reject":
+                    self.shed += 1
+                    raise QueueFull(
+                        f"admission queue at capacity ({self.capacity}); "
+                        f"request shed")
+                evicted = self._items.popleft()
+                self.shed += 1
+                self.evicted += 1
+            self._items.append(req)
+            self.admitted += 1
+            self._cv.notify()
+        if evicted is not None:
+            evicted.fail(QueueFull(
+                f"shed from queue (evict-oldest, capacity "
+                f"{self.capacity}): a newer request took the slot"))
+        return evicted
+
+    def take(self) -> Optional[Request]:
+        """Worker side: block for the next request; None once closed."""
+        with self._cv:
+            while not self._items and self.open:
+                self._cv.wait()
+            if self._items:
+                return self._items.popleft()
+            return None
+
+    def poll(self) -> Optional[Request]:
+        """Non-blocking take (drives the synchronous workers=0 mode)."""
+        with self._cv:
+            if self._items:
+                return self._items.popleft()
+            return None
+
+    def expire_queued(self) -> int:
+        """Fail every queued request whose deadline has passed, freeing
+        their capacity slots; returns how many expiries were *delivered*
+        (already-abandoned requests are reclaimed but not re-counted).
+        Called on every submit so dead deadlines never crowd out live
+        traffic."""
+        expired = []
+        with self._cv:
+            live = deque()
+            for req in self._items:
+                if req.deadline.expired():
+                    expired.append(req)
+                else:
+                    live.append(req)
+            self._items = live
+        delivered = 0
+        for req in expired:
+            if req.fail(DeadlineExceeded(
+                    "deadline expired while waiting in queue "
+                    f"(queued {req.deadline.clock() - req.enqueued_at:.3f}s)")):
+                delivered += 1
+        return delivered
+
+    def close(self):
+        with self._cv:
+            self.open = False
+            self._cv.notify_all()

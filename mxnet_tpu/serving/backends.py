@@ -1,0 +1,133 @@
+"""Model backends the serving runtime can front.
+
+A backend is anything with ``load()`` (parse/bind, may raise
+:class:`~mxnet_tpu.base.MXNetError` on corrupt artifacts — the server
+guards it behind the ``serving.load`` fault site + retry policy) and
+``infer(arrays) -> [np.ndarray, ...]`` where ``arrays`` maps input name
+to a host batch whose leading axis is the batch dimension.
+
+Three adapters cover the tree's inference surfaces:
+
+- :class:`CallableBackend` — any python callable (tests, toy smoke).
+- :class:`PredictorBackend` — the C predict ABI surface
+  (:class:`~mxnet_tpu.c_predict.Predictor`): one bound executor per
+  declared bucket size, created at ``load()``/warm-up so live requests
+  never compile.
+- :class:`ModuleBackend` — a bound :class:`~mxnet_tpu.module.Module`
+  driven forward-only (also reachable as
+  ``module.as_serving_backend()``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["CallableBackend", "PredictorBackend", "ModuleBackend"]
+
+
+class CallableBackend:
+    """Wrap ``fn(arrays: dict) -> list[np.ndarray] | np.ndarray``."""
+
+    def __init__(self, fn: Callable, input_name: str = "data",
+                 input_specs: Optional[Dict[str, Sequence[int]]] = None):
+        self.fn = fn
+        self.input_name = input_name
+        # name -> per-row shape, used by bucketed warm-up probes
+        self.input_specs = ({k: tuple(v) for k, v in input_specs.items()}
+                            if input_specs else {input_name: ()})
+
+    def load(self):
+        pass
+
+    def infer(self, arrays: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        out = self.fn(arrays)
+        if isinstance(out, np.ndarray):
+            return [out]
+        return list(out)
+
+
+class PredictorBackend:
+    """Serve a symbol-JSON + .params artifact through the C predict ABI
+    python half. Each batch-size bucket gets its own bound
+    :class:`~mxnet_tpu.c_predict.Predictor` (fixed shapes are the whole
+    point of bucketed warm-up); ``load()`` validates the artifact bytes
+    eagerly so corruption surfaces at startup, not mid-traffic."""
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 row_shape: Sequence[int], input_name: str = "data",
+                 dev_type: int = 1, dev_id: int = 0):
+        self.symbol_json = symbol_json
+        self.param_bytes = param_bytes
+        self.row_shape = tuple(int(d) for d in row_shape)
+        self.input_name = input_name
+        self.input_specs = {input_name: self.row_shape}
+        self.dev_type = dev_type
+        self.dev_id = dev_id
+        self._predictors: Dict[int, object] = {}
+        self._loaded = False
+
+    def load(self):
+        """Validate the artifact (symbol JSON + param bytes). Raises
+        MXNetError on corrupt/truncated inputs."""
+        from .. import c_predict
+        from .. import symbol as _sym
+        c_predict._params_from_bytes(self.param_bytes)
+        _sym.load_json(self.symbol_json)
+        self._loaded = True
+
+    def bind_bucket(self, batch_size: int):
+        """Create (or return) the bound executor for one bucket size —
+        this is where the trace+compile cost lands, at warm-up."""
+        from .. import c_predict
+        if batch_size not in self._predictors:
+            self._predictors[batch_size] = c_predict.Predictor(
+                self.symbol_json, self.param_bytes,
+                self.dev_type, self.dev_id,
+                {self.input_name: (batch_size,) + self.row_shape})
+        return self._predictors[batch_size]
+
+    def infer(self, arrays: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        batch = arrays[self.input_name]
+        pred = self.bind_bucket(int(batch.shape[0]))
+        buf = np.ascontiguousarray(batch, np.float32)
+        pred.set_input(self.input_name, memoryview(buf.reshape(-1)),
+                       buf.shape)
+        pred.forward()
+        outs = []
+        for i in range(pred.num_outputs()):
+            shape = pred.output_shape(i)
+            out = np.empty(int(np.prod(shape, dtype=np.int64)), np.float32)
+            pred.get_output(i, memoryview(out))
+            outs.append(out.reshape(shape))
+        return outs
+
+
+class ModuleBackend:
+    """Forward-only adapter over a bound, initialized Module."""
+
+    def __init__(self, module, input_name: Optional[str] = None):
+        self.module = module
+        names = [d[0] for d in module.data_shapes]
+        self.input_names = names
+        self.input_name = input_name or names[0]
+        # every declared input, so multi-input modules warm up whole
+        self.input_specs = {d[0]: tuple(d[1][1:])
+                            for d in module.data_shapes}
+        self.row_shape = self.input_specs[self.input_name]
+
+    def load(self):
+        if not (self.module.binded and self.module.params_initialized):
+            raise MXNetError(
+                "ModuleBackend needs a bound module with initialized "
+                "params (bind + init_params/set_params first)")
+
+    def infer(self, arrays: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        from .. import ndarray as nd
+        from ..io import DataBatch
+        data = [nd.array(np.ascontiguousarray(arrays[name], np.float32))
+                for name in self.input_names]
+        self.module.forward(DataBatch(data=data), is_train=False)
+        return [o.asnumpy() for o in self.module.get_outputs()]

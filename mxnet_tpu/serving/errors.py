@@ -1,0 +1,45 @@
+"""Serving-runtime error taxonomy (docs/how_to/serving.md).
+
+Every rejection the runtime can produce is a distinct, catchable type so
+callers (and the C predict ABI shim above them) can map them onto
+transport-level status codes: ``QueueFull`` -> 429/503 shed,
+``DeadlineExceeded`` -> 504, ``CircuitOpen`` -> 503 degraded,
+``ServerClosed`` -> connection refused. All derive from
+:class:`~mxnet_tpu.base.MXNetError` so blanket MXNet error handling
+still works, and none derive from OSError/TimeoutError — a rejection is
+a *decision*, not a transient fault, and must never be swallowed by a
+retry policy.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "QueueFull", "DeadlineExceeded", "CircuitOpen",
+           "ServerClosed"]
+
+
+class ServingError(MXNetError):
+    """Base class for serving-runtime rejections."""
+
+
+class QueueFull(ServingError):
+    """The admission queue is at capacity: the request was shed (or, with
+    the evict-oldest policy, an older queued request was shed in its
+    favour). Raised *immediately* at submit time — load shedding means
+    fast-fail, never unbounded queueing latency."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline budget ran out — while waiting in queue,
+    or while its forward was in flight (the caller is released by the
+    watchdog; the wedged worker is abandoned and replaced)."""
+
+
+class CircuitOpen(ServingError):
+    """The backend circuit breaker is open and no fallback model is
+    configured: requests fast-fail until the cool-down elapses and a
+    half-open probe succeeds."""
+
+
+class ServerClosed(ServingError):
+    """The server has been shut down; no further requests are accepted."""

@@ -1,0 +1,444 @@
+"""The serving runtime: admission -> deadline -> circuit -> forward.
+
+Request lifecycle (docs/how_to/serving.md):
+
+1. ``submit()`` — fast-fail checks first: server closed? circuit open
+   with no fallback? Then the bounded admission queue (``QueueFull``
+   beyond capacity; ``serving.queue`` fault site). Nothing past this
+   point ever blocks the submitter.
+2. A worker (a daemon thread, or the caller itself via ``run_pending``
+   in the deterministic ``workers=0`` mode) takes the request: a
+   deadline that expired *while queued* fails immediately without
+   touching the backend; otherwise the forward runs behind the
+   ``serving.forward`` fault site and the circuit breaker.
+3. ``result()`` — the caller waits at most the remaining deadline
+   (injectable ``wait``). On timeout the request is abandoned: if it
+   was wedged inside a forward, that worker is written off and a
+   replacement is spawned (the watchdog), so one stuck backend call
+   never shrinks the worker pool.
+
+Degradation ladder: primary forward -> fallback model (circuit open or
+primary failure) -> fast-fail. ``healthz()``/``readyz()`` expose the
+whole state machine for probes; ``stats()`` mirrors
+``resilience.retry.stats()`` per endpoint.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+from ..base import MXNetError
+from ..resilience import RetryExhausted, faults, guarded_call
+from .admission import AdmissionQueue, Deadline, Request
+from .breaker import CircuitBreaker, OPEN
+from .errors import CircuitOpen, DeadlineExceeded, QueueFull, ServerClosed
+from .warmup import ShapeBuckets
+
+__all__ = ["InferenceServer", "endpoint_stats", "endpoints"]
+
+_ENDPOINTS: Dict[str, "InferenceServer"] = {}
+_endpoints_lock = threading.Lock()
+
+
+def endpoints() -> Dict[str, "InferenceServer"]:
+    """Live endpoint registry (name -> server)."""
+    with _endpoints_lock:
+        return dict(_ENDPOINTS)
+
+
+def endpoint_stats() -> Dict[str, Dict]:
+    """Per-endpoint counters, the serving mirror of
+    ``resilience.retry.stats()``."""
+    return {name: srv.stats() for name, srv in endpoints().items()}
+
+
+class _Worker(threading.Thread):
+    """One queue-draining daemon thread. ``wedged`` is set by the
+    watchdog when a caller abandons a request this worker is stuck
+    inside; the worker then retires as soon as the stuck call returns
+    (a replacement has already been spawned)."""
+
+    _seq = 0
+
+    def __init__(self, server: "InferenceServer"):
+        _Worker._seq += 1
+        super().__init__(name=f"serving-worker-{_Worker._seq}",
+                         daemon=True)
+        self.server = server
+        self.wedged = False
+
+    def run(self):
+        while not self.wedged:
+            req = self.server._queue.take()
+            if req is None:       # queue closed
+                return
+            self.server._process(req, worker=self)
+
+
+class InferenceServer:
+    """A production-posture server around one model backend.
+
+    Parameters
+    ----------
+    backend : object with ``load()`` and ``infer(dict) -> [np.ndarray]``
+    fallback : optional second backend served while the circuit is open
+        (and on a primary forward failure) — degraded, but up.
+    buckets : declared batch-size buckets for warm-up + padding; None
+        disables shape management (the backend sees raw shapes).
+    capacity / shed_policy : admission queue bound and overflow policy
+        (``'reject'`` | ``'evict-oldest'``).
+    default_deadline : per-request budget in seconds when the caller
+        does not pass one (None = unbounded).
+    breaker : a :class:`~.breaker.CircuitBreaker`; defaults to one on
+        ``clock``.
+    workers : daemon worker threads; 0 = synchronous mode where the
+        caller drives ``run_pending()`` (deterministic tests).
+    clock / wait : injectable time source and event-wait, so every
+        deadline/cool-down path is testable with zero real sleeps.
+    """
+
+    def __init__(self, backend, *, name: str = "default",
+                 fallback=None, buckets: Optional[Sequence[int]] = None,
+                 capacity: int = 64, shed_policy: str = "reject",
+                 default_deadline: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 retry_policy=None, workers: int = 1,
+                 clock: Callable[[], float] = time.monotonic,
+                 wait: Optional[Callable] = None):
+        self.name = name
+        self.backend = backend
+        self.fallback = fallback
+        self.buckets = ShapeBuckets(buckets) if buckets else None
+        self.default_deadline = default_deadline
+        self.clock = clock
+        self._wait = wait or (lambda event, timeout: event.wait(timeout))
+        self.breaker = breaker or CircuitBreaker(clock=clock)
+        self.retry_policy = retry_policy
+        self._queue = AdmissionQueue(capacity, shed_policy, clock)
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {
+            "admitted": 0, "completed": 0, "failed": 0,
+            "shed": 0, "evicted": 0, "rejected_open": 0,
+            "deadline_queued": 0, "deadline_inflight": 0,
+            "degraded": 0, "wedged_workers": 0, "abandoned": 0,
+            "load_failures": 0, "warmed_buckets": 0}
+        self._warmed = False
+        self._load_ok = None          # None = not attempted yet
+        self._fallback_ok = False     # fallback loaded and usable
+        self._load_error = None
+        self._closed = False
+        self._last_success: Optional[float] = None
+        self._n_workers = workers
+        self._workers = []
+        for _ in range(workers):
+            self._spawn_worker()
+        with _endpoints_lock:
+            _ENDPOINTS[name] = self
+
+    # -- startup -------------------------------------------------------------
+
+    def _spawn_worker(self):
+        worker = _Worker(self)
+        self._workers.append(worker)
+        worker.start()
+
+    def _count(self, key: str, n: int = 1):
+        with self._lock:
+            self._stats[key] = self._stats.get(key, 0) + n
+
+    def _load_one(self, backend, count_circuit: bool = True):
+        """Load a backend behind the ``serving.load`` fault site +
+        retry policy. A *primary* load exhaustion/corruption counts
+        against the circuit (the retry-then-circuit path); a fallback's
+        does not — the primary's error window must reflect only the
+        primary's health."""
+        try:
+            guarded_call("serving.load", backend.load,
+                         policy=self.retry_policy)
+            return True
+        except (MXNetError, RetryExhausted, OSError, ValueError) as err:
+            self._count("load_failures")
+            if count_circuit:
+                self.breaker.record_failure()
+            self._load_error = err
+            return False
+
+    def _fallback_ready(self) -> bool:
+        """A fallback exists AND its load succeeded — a fallback whose
+        artifact is itself corrupt must never be routed to."""
+        return self.fallback is not None and self._fallback_ok
+
+    def _warm_buckets(self, backend):
+        import numpy as np
+        specs = getattr(backend, "input_specs", None) or \
+            {getattr(backend, "input_name", "data"):
+             tuple(getattr(backend, "row_shape", ()))}
+        for size in self.buckets.sizes:
+            probe = {name: np.zeros((size,) + tuple(row), np.float32)
+                     for name, row in specs.items()}
+            self._forward(backend, probe)
+            if backend is self.backend:
+                self._count("warmed_buckets")
+
+    def warm_up(self, strict: bool = True):
+        """Load the backend(s) and pre-trace every declared bucket —
+        for the fallback too, so degraded mode never eats a compile
+        either. With ``strict`` (default) a primary-load failure raises
+        unless the fallback loaded — in which case the server comes up
+        degraded instead of down."""
+        self._load_error = None
+        self._load_ok = self._load_one(self.backend)
+        if self.fallback is not None:
+            self._fallback_ok = self._load_one(self.fallback,
+                                               count_circuit=False)
+        if not self._load_ok:
+            if strict and not self._fallback_ok:
+                raise MXNetError(
+                    f"serving endpoint {self.name!r}: backend load "
+                    f"failed ({self._load_error}) and no fallback is "
+                    f"available") from self._load_error
+            if self.buckets is not None and self._fallback_ok:
+                self._warm_buckets(self.fallback)
+            self._warmed = self._fallback_ok
+            return self
+        if self.buckets is not None:
+            self._warm_buckets(self.backend)
+            if self._fallback_ok:
+                self._warm_buckets(self.fallback)
+        self._warmed = True
+        return self
+
+    # -- request path --------------------------------------------------------
+
+    def _as_inputs(self, inputs) -> Dict:
+        if isinstance(inputs, dict):
+            return inputs
+        name = getattr(self.backend, "input_name", "data")
+        return {name: inputs}
+
+    def submit(self, inputs, deadline: Optional[float] = None) -> Request:
+        """Admit a request; returns immediately with a waitable
+        :class:`~.admission.Request` or raises a fast-fail rejection
+        (ServerClosed / CircuitOpen / QueueFull)."""
+        if self._closed:
+            raise ServerClosed(f"endpoint {self.name!r} is shut down")
+        expired = self._queue.expire_queued()
+        if expired:                   # dead deadlines don't hold capacity
+            self._count("deadline_queued", expired)
+        budget = self.default_deadline if deadline is None else deadline
+        dl = Deadline(budget, self.clock)
+        use_fallback = False
+        if self.breaker.state == OPEN:
+            if not self._fallback_ready():
+                self._count("rejected_open")
+                raise CircuitOpen(
+                    f"endpoint {self.name!r}: circuit open "
+                    f"(backend failing); no fallback available")
+            use_fallback = True
+        req = Request(self._as_inputs(inputs), dl,
+                      use_fallback=use_fallback)
+        try:
+            evicted = self._queue.offer(req)
+        except QueueFull:
+            self._count("shed")
+            raise
+        if evicted is not None:       # evict-oldest shed an older request
+            self._count("shed")
+            self._count("evicted")
+        self._count("admitted")
+        return req
+
+    def predict(self, inputs, deadline: Optional[float] = None):
+        """Synchronous convenience: submit + (in workers=0 mode) drive
+        the queue + wait out the deadline."""
+        req = self.submit(inputs, deadline=deadline)
+        if self._n_workers == 0:
+            self.run_pending()
+        return self.result(req)
+
+    def result(self, req: Request):
+        """Wait for ``req`` at most its remaining deadline; on timeout
+        abandon it (watchdog: a wedged worker is replaced) and raise
+        DeadlineExceeded."""
+        remaining = req.deadline.remaining()
+        if self._wait(req._event, remaining):
+            if req._error is not None:
+                raise req._error
+            return req._value
+        prior = req.abandon()
+        if prior == "done":           # raced a just-delivered result
+            if req._error is not None:
+                raise req._error
+            return req._value
+        self._count("abandoned")
+        if prior == "running":
+            self._count("deadline_inflight")
+            self._watchdog_replace(req.worker)
+            if not req.use_fallback:
+                # a forward wedged past the deadline is failure evidence
+                # — without this, a wedged half-open probe would leave
+                # the circuit stuck and unreported
+                self.breaker.record_failure()
+        else:
+            self._count("deadline_queued")
+        raise DeadlineExceeded(
+            f"deadline exceeded while {prior} "
+            f"(budget ran out on endpoint {self.name!r})")
+
+    def _watchdog_replace(self, worker):
+        """A caller abandoned a request wedged inside ``worker``'s
+        forward: write the worker off and keep the pool at strength."""
+        if worker is None or worker.wedged:
+            return
+        worker.wedged = True
+        self._count("wedged_workers")
+        if not self._closed:
+            self._spawn_worker()
+
+    def run_pending(self, max_items: Optional[int] = None) -> int:
+        """Synchronously drain the queue (the workers=0 mode); returns
+        how many requests were processed."""
+        done = 0
+        while max_items is None or done < max_items:
+            req = self._queue.poll()
+            if req is None:
+                break
+            self._process(req, worker=None)
+            done += 1
+        return done
+
+    # -- worker side ---------------------------------------------------------
+
+    def _process(self, req: Request, worker=None):
+        if req.deadline.expired():
+            if req.fail(DeadlineExceeded(
+                    "deadline expired while waiting in queue")):
+                # only count a delivered expiry — the caller-side
+                # watchdog already counted an abandoned one
+                self._count("deadline_queued")
+            return
+        if not req.start(worker):     # caller already abandoned it
+            return
+        try:
+            if req.use_fallback:
+                outs = self._forward(self.fallback, req.inputs)
+                self._count("degraded")
+            else:
+                outs = self._try_primary(req)
+                if outs is None:      # rejection already recorded on req
+                    return
+        except Exception as err:      # noqa: BLE001 — delivered to caller
+            self._count("failed")
+            req.fail(err)
+            return
+        self._count("completed")
+        req.complete(outs)
+
+    def _try_primary(self, req: Request):
+        """Primary forward under the circuit breaker, falling back to
+        the fallback model on open-circuit or forward failure. Returns
+        outputs, or None after failing ``req`` directly."""
+        if not self.breaker.allow():
+            if self._fallback_ready():
+                req.use_fallback = True   # the watchdog must not charge
+                self._count("degraded")   # a fallback wedge to the primary
+                return self._forward(self.fallback, req.inputs)
+            self._count("rejected_open")
+            req.fail(CircuitOpen(
+                f"endpoint {self.name!r}: circuit open; no fallback"))
+            return None
+        try:
+            outs = self._forward(self.backend, req.inputs)
+        except Exception:
+            self.breaker.record_failure()
+            if self._fallback_ready():
+                req.use_fallback = True
+                self._count("degraded")
+                return self._forward(self.fallback, req.inputs)
+            raise
+        self.breaker.record_success()
+        with self._lock:
+            self._last_success = self.clock()
+        return outs
+
+    def _forward(self, backend, inputs: Dict):
+        """One backend forward with bucket padding/unpadding around it.
+        The ``serving.forward`` fault site guards the *primary* backend
+        only — the fallback is the degradation answer to that fault, so
+        injecting into it would make degraded mode untestable."""
+        if backend is self.backend:
+            faults.fault_point("serving.forward")
+        if self.buckets is None:
+            return backend.infer(inputs)
+        # all inputs are batch-major: pad each one to the same bucket
+        fed, true_rows = {}, None
+        for name, batch in inputs.items():
+            fed[name], rows = self.buckets.pad_batch(batch)
+            true_rows = rows if true_rows is None else true_rows
+        outs = backend.infer(fed)
+        return self.buckets.slice_outputs(outs, true_rows)
+
+    # -- probes / introspection ----------------------------------------------
+
+    def healthz(self) -> Dict:
+        """Liveness + vitals: queue depth, circuit state, worker pool,
+        age of the last successful primary forward."""
+        alive = [w for w in self._workers if w.is_alive() and not w.wedged]
+        with self._lock:
+            last = self._last_success
+        return {
+            "ok": not self._closed,
+            "queue_depth": self._queue.depth(),
+            "queue_capacity": self._queue.capacity,
+            "circuit": self.breaker.state,
+            "workers": {"configured": self._n_workers,
+                        "alive": len(alive),
+                        "wedged": self._stats["wedged_workers"]},
+            "last_success_age": (None if last is None
+                                 else self.clock() - last),
+            "warmed": self._warmed,
+            "degraded": self.breaker.state == OPEN
+                        and self._fallback_ready(),
+        }
+
+    def readyz(self) -> Dict:
+        """Readiness: warmed up, accepting, and able to serve — either
+        the circuit is not open, or a fallback stands in."""
+        reasons = []
+        if self._closed:
+            reasons.append("server closed")
+        if not self._warmed:
+            reasons.append("not warmed up")
+        if self.breaker.state == OPEN and not self._fallback_ready():
+            reasons.append("circuit open with no fallback")
+        if self._queue.depth() >= self._queue.capacity:
+            reasons.append("admission queue full")
+        return {"ready": not reasons, "reasons": reasons}
+
+    def stats(self) -> Dict:
+        with self._lock:
+            counters = dict(self._stats)
+        counters["queue"] = {"depth": self._queue.depth(),
+                             "admitted": self._queue.admitted,
+                             "shed": self._queue.shed,
+                             "evicted": self._queue.evicted}
+        counters["circuit"] = self.breaker.stats()
+        return counters
+
+    def close(self, join_timeout: float = 2.0):
+        """Stop accepting, wake the workers, unregister the endpoint."""
+        self._closed = True
+        self._queue.close()
+        for worker in self._workers:
+            if worker.is_alive() and not worker.wedged:
+                worker.join(timeout=join_timeout)
+        with _endpoints_lock:
+            if _ENDPOINTS.get(self.name) is self:
+                del _ENDPOINTS[self.name]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

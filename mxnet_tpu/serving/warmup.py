@@ -1,0 +1,70 @@
+"""Shape-bucketed warm-up: the TVM/nncase-style ahead-of-time answer to
+first-request compile latency (PAPERS.md).
+
+On a TPU every new input shape is a fresh XLA trace+compile — seconds of
+latency that must never land on a live request. The server therefore
+declares its batch-size *buckets* up front, pre-traces each one at
+startup (:meth:`~.server.InferenceServer.warm_up`), and at request time
+pads any off-bucket batch up to the smallest bucket that fits, slicing
+the padding back off the outputs. The steady-state request path then
+sees only the declared shapes: zero retraces, ever.
+
+``pad_batch``/``slice_outputs`` run per request, so they are
+``@hot_path``-annotated — tpu-lint audits them (and everything they call
+in this module) for device->host syncs, and the serving baseline is kept
+at zero findings.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..analysis.annotations import hot_path
+
+__all__ = ["ShapeBuckets"]
+
+
+class ShapeBuckets:
+    """Declared batch-size buckets, padded along axis 0."""
+
+    def __init__(self, sizes: Sequence[int]):
+        if not sizes:
+            raise ValueError("need at least one bucket size")
+        cleaned = sorted({int(s) for s in sizes})
+        if cleaned[0] < 1:
+            raise ValueError("bucket sizes must be >= 1")
+        self.sizes: Tuple[int, ...] = tuple(cleaned)
+
+    def bucket_for(self, n: int) -> Optional[int]:
+        """Smallest declared bucket that fits a batch of ``n`` rows
+        (None when ``n`` exceeds the largest bucket)."""
+        for size in self.sizes:
+            if size >= n:
+                return size
+        return None
+
+    @hot_path("per-request pad on the serving fast path")
+    def pad_batch(self, batch: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Pad ``batch`` up to its bucket; returns (padded, true_rows).
+        An exact-bucket batch passes through untouched. A batch larger
+        than the largest bucket is a contract violation — padding cannot
+        help and retracing is exactly what warm-up exists to prevent."""
+        n = batch.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket is None:
+            raise MXNetError(
+                f"batch of {n} rows exceeds the largest declared "
+                f"bucket {self.sizes[-1]}; declare a larger bucket "
+                f"(retracing on a live request is not an option)")
+        if bucket == n:
+            return batch, n
+        pad = np.zeros((bucket - n,) + batch.shape[1:], batch.dtype)
+        return np.concatenate([batch, pad], axis=0), n
+
+    @hot_path("per-request unpad on the serving fast path")
+    def slice_outputs(self, outputs, true_rows: int):
+        """Drop pad rows from each output (axis 0) after the forward."""
+        return [out[:true_rows] if out.shape and out.shape[0] >= true_rows
+                else out for out in outputs]

@@ -187,3 +187,30 @@ def test_partial_out_python_side(tmp_path):
     out = np.zeros(16, np.float32)
     pred.get_output(0, memoryview(out))
     assert np.abs(out).sum() > 0
+
+
+def test_corrupt_param_bytes_raise_mxnet_error():
+    """Corrupt/truncated .params bytes must surface as MXNetError with
+    a clear message, not a leaked zipfile/ValueError (the serving
+    runtime's serving.load path depends on this contract)."""
+    from mxnet_tpu.c_predict import _params_from_bytes, load_ndarray_file
+
+    with pytest.raises(mx.MXNetError, match="corrupt or truncated"):
+        _params_from_bytes(b"definitely not an npz container")
+    with pytest.raises(mx.MXNetError, match="corrupt or truncated"):
+        load_ndarray_file(b"\x00\x01\x02garbage")
+
+    # a real npz cut off mid-archive (truncated download/copy)
+    import io as _io
+    buf = _io.BytesIO()
+    np.savez(buf, **{"arg:w": np.ones((4, 4), np.float32)})
+    whole = buf.getvalue()
+    with pytest.raises(mx.MXNetError, match="corrupt or truncated"):
+        _params_from_bytes(whole[:len(whole) // 2])
+
+    # empty bytes stay a valid no-params artifact
+    assert _params_from_bytes(b"") == ({}, {})
+
+    # intact bytes still parse
+    args, aux = _params_from_bytes(whole)
+    assert list(args) == ["w"] and aux == {}

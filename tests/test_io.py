@@ -299,3 +299,38 @@ def test_prefetching_iter_multi_epoch_reset():
     while it.iter_next():
         seen.append(it.current_batch.label[0].asnumpy().copy())
     np.testing.assert_array_equal(np.sort(np.concatenate(seen)), y)
+
+
+def test_prefetching_iter_producer_error_propagates_not_deadlocks():
+    """A source whose next() raises must surface the error in the
+    consumer (regression: the producer thread died on any
+    non-StopIteration exception and the consumer then blocked forever
+    in take())."""
+
+    class ExplodingIter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=2)
+            self.n = 0
+            self.provide_data = [mx.io.DataDesc("data", (2, 3))]
+            self.provide_label = [mx.io.DataDesc("softmax_label", (2,))]
+
+        def reset(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n > 1:
+                raise ValueError("source blew up mid-epoch")
+            arr = mx.nd.array(np.zeros((2, 3), np.float32))
+            lab = mx.nd.array(np.zeros((2,), np.float32))
+            return mx.io.DataBatch(data=[arr], label=[lab], pad=0, index=None)
+
+    it = mx.io.PrefetchingIter(ExplodingIter())
+    assert it.iter_next()             # batch 1 arrives normally
+    with pytest.raises(ValueError, match="blew up"):
+        it.iter_next()                # batch 2: the error, not a hang
+    # the producer survived the error and reset() re-arms the source
+    it.reset()
+    assert it.iter_next()
+    with pytest.raises(ValueError, match="blew up"):
+        it.iter_next()

@@ -1,0 +1,720 @@
+"""Serving runtime (mxnet_tpu/serving/): admission control, deadlines,
+circuit breaking, shape-bucketed warm-up, graceful degradation, probes.
+
+Every timing-sensitive path — queue expiry, watchdog, circuit cool-down,
+retry backoff — runs on an injectable fake clock: zero real sleeps, no
+``time.time()`` in any assertion. Fault sites ``serving.forward``,
+``serving.load`` and ``serving.queue`` are armed with deterministic
+:class:`~mxnet_tpu.resilience.FaultPlan` rules (the registry-consistency
+contract for those sites lives here).
+"""
+import io as _io
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import resilience, serving
+from mxnet_tpu.resilience import FaultPlan, RetryExhausted, RetryPolicy, faults
+from mxnet_tpu.resilience.retry import set_default_policy
+from mxnet_tpu.serving import (AdmissionQueue, CallableBackend,
+                               CircuitBreaker, CircuitOpen, Deadline,
+                               DeadlineExceeded, InferenceServer,
+                               ModuleBackend, PredictorBackend, QueueFull,
+                               Request, ServerClosed, ShapeBuckets)
+
+
+class FakeClock:
+    """A manually driven monotonic clock (may also jump backward)."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    """Disarmed faults, fresh counters, no leftover endpoints."""
+    faults.disarm()
+    resilience.reset_stats()
+    set_default_policy(None)
+    yield
+    faults.disarm()
+    resilience.reset_stats()
+    set_default_policy(None)
+    for srv in serving.endpoints().values():
+        srv.close()
+
+
+def _echo(arrays):
+    return [np.ascontiguousarray(arrays["data"], np.float32) * 2.0]
+
+
+def _server(clock, *, fn=_echo, **kw):
+    kw.setdefault("workers", 0)
+    kw.setdefault("clock", clock)
+    srv = InferenceServer(CallableBackend(fn), **kw)
+    srv.warm_up()
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# admission queue + load shedding
+# ---------------------------------------------------------------------------
+
+def test_queue_rejects_beyond_capacity():
+    clock = FakeClock()
+    srv = _server(clock, capacity=2, name="cap")
+    r1 = srv.submit(np.ones((1, 2), np.float32))
+    r2 = srv.submit(np.ones((1, 2), np.float32))
+    with pytest.raises(QueueFull):
+        srv.submit(np.ones((1, 2), np.float32))
+    assert srv.stats()["shed"] == 1
+    srv.run_pending()
+    assert srv.result(r1)[0].shape == (1, 2)
+    assert srv.result(r2)[0].shape == (1, 2)
+
+
+def test_queue_evict_oldest_sheds_the_old_request():
+    clock = FakeClock()
+    srv = _server(clock, capacity=2, shed_policy="evict-oldest",
+                  name="evict")
+    r1 = srv.submit(np.ones((1, 2), np.float32))
+    r2 = srv.submit(np.ones((1, 2), np.float32))
+    r3 = srv.submit(np.ones((1, 2), np.float32))   # evicts r1
+    with pytest.raises(QueueFull, match="evict-oldest"):
+        srv.result(r1)
+    srv.run_pending()
+    assert srv.result(r2) and srv.result(r3)
+    assert srv.stats()["queue"]["evicted"] == 1
+    # the top-level counters mirror the eviction too, not just the
+    # nested queue snapshot (monitoring reads these)
+    assert srv.stats()["evicted"] == 1 and srv.stats()["shed"] == 1
+
+
+def test_queue_fault_site_retries_then_admits():
+    """serving.queue sits behind the resilience retry policy, like
+    io.next: an injected transient admission fault backs off (fake
+    clock) and the request is then admitted exactly once."""
+    clock = FakeClock()
+    pol = RetryPolicy(max_retries=2, base_delay=0.5, jitter=0.0,
+                      clock=clock, sleep=clock.advance, seed=0)
+    set_default_policy(pol)
+    faults.arm(FaultPlan().arm("serving.queue", nth=1, count=1))
+    srv = _server(clock, name="qfault")
+    out = srv.predict(np.ones((2, 2), np.float32))
+    assert out[0].shape == (2, 2)
+    assert resilience.retry.stats()["retries"].get("serving.queue") == 1
+    assert faults.stats()["fired"].get("serving.queue") == 1
+
+
+# ---------------------------------------------------------------------------
+# deadlines under the injectable clock (including skew)
+# ---------------------------------------------------------------------------
+
+def test_deadline_expires_while_queued():
+    clock = FakeClock()
+    calls = []
+    srv = _server(clock, fn=lambda a: calls.append(1) or _echo(a),
+                  name="dlq")
+    calls.clear()                     # drop any warm-up traffic
+    req = srv.submit(np.ones((1, 2), np.float32), deadline=1.0)
+    clock.advance(2.0)                # expires in queue
+    srv.run_pending()
+    with pytest.raises(DeadlineExceeded, match="queue"):
+        srv.result(req)
+    assert calls == []                # backend never touched
+    assert srv.stats()["deadline_queued"] == 1
+
+
+def test_backward_clock_jump_extends_not_expires():
+    clock = FakeClock()
+    srv = _server(clock, name="dlskew")
+    req = srv.submit(np.ones((1, 2), np.float32), deadline=1.0)
+    clock.advance(-100.0)             # NTP-style backward jump
+    srv.run_pending()
+    assert srv.result(req)[0].shape == (1, 2)
+    assert req.deadline.remaining() > 1.0   # budget grew, never negative
+
+
+def test_deadline_object_math_under_skew():
+    clock = FakeClock()
+    dl = Deadline(5.0, clock)
+    clock.advance(3.0)
+    assert dl.remaining() == pytest.approx(2.0)
+    clock.advance(-10.0)
+    assert dl.remaining() == pytest.approx(12.0) and not dl.expired()
+    clock.advance(20.0)
+    assert dl.expired()
+    assert Deadline(None, clock).remaining() is None
+
+
+def test_retry_policy_deadline_math_under_clock_skew():
+    """RetryPolicy.delay + deadline accounting with the clock jumping
+    both ways (satellite: no time.time() anywhere in here)."""
+    clock = FakeClock()
+    pol = RetryPolicy(max_retries=5, base_delay=1.0, max_delay=1.0,
+                      jitter=0.0, deadline=10.0, clock=clock,
+                      sleep=clock.advance, seed=0)
+    assert pol.delay(1) == pytest.approx(1.0)
+    assert pol.delay(7) == pytest.approx(1.0)   # capped
+
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 2:
+            clock.advance(-50.0)      # backward jump mid-backoff
+        if state["n"] <= 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call(flaky, label="skew.back") == "ok"
+
+    def wedged():
+        clock.advance(100.0)          # forward jump past the budget
+        raise OSError("still down")
+
+    with pytest.raises(RetryExhausted, match="deadline"):
+        pol.call(wedged, label="skew.fwd")
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_on_error_rate_and_recloses():
+    clock = FakeClock()
+    br = CircuitBreaker(window=10, min_calls=4, failure_rate=0.5,
+                        cooldown=30.0, probes=1, clock=clock)
+    br.record_success()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"       # 1/3 failures, min_calls not met
+    br.record_failure()               # 2/4 == 0.5 rate with min_calls met
+    assert br.state == "open" and br.stats()["opened_count"] == 1
+    br2 = CircuitBreaker(window=6, min_calls=3, failure_rate=1.0,
+                         cooldown=30.0, probes=1, clock=clock)
+    for _ in range(3):
+        br2.record_failure()
+    assert br2.state == "open" and not br2.allow()
+    clock.advance(30.0)
+    assert br2.state == "half-open"
+    assert br2.allow()                # the probe slot
+    assert not br2.allow()            # only one probe at a time
+    br2.record_success()
+    assert br2.state == "closed"
+
+
+def test_breaker_probe_failure_reopens_and_cooldown_restarts():
+    clock = FakeClock()
+    br = CircuitBreaker(window=4, min_calls=2, failure_rate=1.0,
+                        cooldown=10.0, probes=1, clock=clock)
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "open"
+    clock.advance(10.0)
+    assert br.allow()                 # half-open probe
+    br.record_failure()               # probe fails
+    assert br.state == "open"
+    clock.advance(5.0)
+    assert br.state == "open"         # cool-down restarted
+    clock.advance(5.0)
+    assert br.state == "half-open"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chaos test: faults -> open -> half-open -> reclose,
+# shedding under a full queue, zero real sleeps
+# ---------------------------------------------------------------------------
+
+def test_chaos_forward_faults_circuit_lifecycle_and_shedding():
+    clock = FakeClock()
+    # 2 healthy requests precede the fault burst, so with 3 consecutive
+    # failures the window reads 3/5 = 0.6 — the trip point
+    br = CircuitBreaker(window=10, min_calls=3, failure_rate=0.6,
+                        cooldown=10.0, probes=1, clock=clock)
+    srv = _server(clock, capacity=2, buckets=[4], breaker=br,
+                  default_deadline=60.0, name="chaos")
+    assert srv.stats()["warmed_buckets"] == 1
+
+    # under a full queue, excess traffic gets QueueFull immediately
+    held = [srv.submit(np.ones((2, 3), np.float32)) for _ in range(2)]
+    with pytest.raises(QueueFull):
+        srv.submit(np.ones((2, 3), np.float32))
+    srv.run_pending()
+    for req in held:
+        assert srv.result(req)[0].shape == (2, 3)
+
+    # arm serving.forward to fail the next 3 requests (arming resets
+    # the site call counters, so the next forward is call #1)
+    faults.arm(FaultPlan().arm("serving.forward", nth=1, count=3))
+    for _ in range(3):
+        with pytest.raises(OSError):
+            srv.predict(np.ones((2, 3), np.float32))
+    assert br.state == "open"
+
+    # open circuit: fast-fail at submit, no queueing, no backend call
+    with pytest.raises(CircuitOpen):
+        srv.predict(np.ones((2, 3), np.float32))
+    assert srv.stats()["rejected_open"] >= 1
+    assert srv.readyz() == {"ready": False,
+                            "reasons": ["circuit open with no fallback"]}
+
+    # cool-down elapses on the injected clock -> half-open -> a probe
+    # success recloses
+    clock.advance(10.0)
+    assert br.state == "half-open"
+    out = srv.predict(np.ones((2, 3), np.float32))
+    assert out[0].shape == (2, 3)
+    assert br.state == "closed"
+    assert srv.readyz()["ready"]
+    assert faults.stats()["fired"]["serving.forward"] == 3
+
+
+def test_fallback_model_serves_while_circuit_open():
+    clock = FakeClock()
+    br = CircuitBreaker(window=4, min_calls=2, failure_rate=1.0,
+                        cooldown=100.0, clock=clock)
+    fallback = CallableBackend(lambda a: [np.zeros_like(a["data"])])
+    srv = InferenceServer(CallableBackend(_echo), fallback=fallback,
+                          breaker=br, workers=0, clock=clock,
+                          name="degraded")
+    srv.warm_up()
+    faults.arm(FaultPlan().arm("serving.forward", nth=1, count=2))
+    # primary fails -> per-request fallback keeps answers flowing
+    out = srv.predict(np.ones((1, 2), np.float32))
+    assert np.all(out[0] == 0.0)
+    out = srv.predict(np.ones((1, 2), np.float32))
+    assert np.all(out[0] == 0.0)
+    assert br.state == "open"
+    # open circuit + fallback: admitted and served degraded, not rejected
+    out = srv.predict(np.ones((1, 2), np.float32))
+    assert np.all(out[0] == 0.0)
+    h = srv.healthz()
+    assert h["degraded"] and h["circuit"] == "open"
+    assert srv.readyz()["ready"]
+    assert srv.stats()["degraded"] == 3
+
+
+# ---------------------------------------------------------------------------
+# shape-bucketed warm-up + padding (never retrace on a live request)
+# ---------------------------------------------------------------------------
+
+def test_warmup_pretraces_buckets_and_pads_off_bucket_shapes():
+    clock = FakeClock()
+    shapes_seen = []
+
+    def tracking(arrays):
+        shapes_seen.append(arrays["data"].shape)
+        return [arrays["data"] + 1.0]
+
+    srv = _server(clock, fn=tracking, buckets=[2, 4], name="buckets")
+    assert sorted(s[0] for s in shapes_seen) == [2, 4]   # pre-traced
+
+    out = srv.predict(np.ones((3, 5), np.float32))       # off-bucket
+    assert out[0].shape == (3, 5)                        # sliced back
+    out = srv.predict(np.ones((1, 5), np.float32))
+    assert out[0].shape == (1, 5)
+    # the backend only ever saw declared bucket shapes -> zero retraces
+    assert {s[0] for s in shapes_seen} == {2, 4}
+
+    with pytest.raises(mx.MXNetError, match="largest declared bucket"):
+        srv.predict(np.ones((9, 5), np.float32))
+
+
+def test_shape_buckets_unit():
+    b = ShapeBuckets([4, 2])
+    assert b.sizes == (2, 4)
+    assert b.bucket_for(1) == 2 and b.bucket_for(4) == 4
+    assert b.bucket_for(5) is None
+    padded, n = b.pad_batch(np.ones((3, 2), np.float32))
+    assert padded.shape == (4, 2) and n == 3
+    assert np.all(padded[3] == 0.0)
+    same, n = b.pad_batch(np.ones((2, 2), np.float32))
+    assert same.shape == (2, 2) and n == 2
+    outs = b.slice_outputs([np.ones((4, 7)), np.ones((4,))], 3)
+    assert outs[0].shape == (3, 7) and outs[1].shape == (3,)
+
+
+# ---------------------------------------------------------------------------
+# serving.load: corrupt artifacts, retry-then-circuit
+# ---------------------------------------------------------------------------
+
+def _corrupt_backend():
+    """A real PredictorBackend over garbage param bytes: load() must
+    surface MXNetError (c_predict hardening), not a zipfile leak."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc",
+                                num_hidden=3)
+    return PredictorBackend(net.tojson(), b"this is not an npz file",
+                            row_shape=(5,))
+
+
+def test_load_transient_faults_retry_then_succeed():
+    clock = FakeClock()
+    pol = RetryPolicy(max_retries=3, base_delay=0.1, jitter=0.0,
+                      clock=clock, sleep=clock.advance, seed=0)
+    faults.arm(FaultPlan().arm("serving.load", nth=1, count=2))
+    srv = InferenceServer(CallableBackend(_echo), workers=0, clock=clock,
+                          retry_policy=pol, name="loadretry")
+    srv.warm_up()
+    assert srv.readyz()["ready"]
+    assert resilience.retry.stats()["retries"]["serving.load"] == 2
+    assert srv.stats()["load_failures"] == 0
+
+
+def test_load_corrupt_params_opens_circuit_fallback_degraded():
+    """The retry-then-circuit path on top of the c_predict hardening:
+    corrupt .params -> MXNetError from load -> breaker failure -> the
+    fallback model carries traffic (degraded but up)."""
+    clock = FakeClock()
+    br = CircuitBreaker(window=4, min_calls=1, failure_rate=1.0,
+                        cooldown=1000.0, clock=clock)
+    fallback = CallableBackend(lambda a: [np.zeros_like(a["data"])])
+    srv = InferenceServer(_corrupt_backend(), fallback=fallback,
+                          breaker=br, workers=0, clock=clock,
+                          name="corrupt")
+    srv.warm_up()                     # degraded, not dead
+    assert br.state == "open"
+    assert srv.stats()["load_failures"] == 1
+    out = srv.predict(np.ones((2, 5), np.float32))
+    assert np.all(out[0] == 0.0)
+    assert srv.healthz()["degraded"]
+
+
+def test_load_corrupt_params_no_fallback_is_fatal():
+    clock = FakeClock()
+    srv = InferenceServer(_corrupt_backend(), workers=0, clock=clock,
+                          name="corrupt2")
+    with pytest.raises(mx.MXNetError, match="load failed"):
+        srv.warm_up()
+    assert not srv.readyz()["ready"]
+
+
+# ---------------------------------------------------------------------------
+# watchdog: a wedged forward never blocks the caller past its budget
+# ---------------------------------------------------------------------------
+
+def test_wedged_forward_watchdog_replaces_worker():
+    clock = FakeClock()
+    gate = threading.Event()
+    started = threading.Event()
+
+    def wedging(arrays):
+        if not gate.is_set():
+            started.set()
+            gate.wait(30.0)           # a wedged backend call
+        return _echo(arrays)
+
+    def fake_wait(event, timeout):
+        """Injectable wait: no real sleeping — a bounded wait 'elapses'
+        by advancing the fake clock."""
+        if timeout is None:
+            return event.wait(30.0)
+        if event.wait(0):
+            return True
+        clock.advance(timeout)
+        return event.wait(0)
+
+    srv = InferenceServer(CallableBackend(wedging), workers=1,
+                          clock=clock, wait=fake_wait, name="wedge")
+    srv.warm_up()
+    req = srv.submit(np.ones((1, 2), np.float32), deadline=1.0)
+    assert started.wait(30.0)         # the worker is now inside forward
+    with pytest.raises(DeadlineExceeded):
+        srv.result(req)               # released at the budget, not later
+    stats = srv.stats()
+    assert stats["deadline_inflight"] == 1
+    assert stats["wedged_workers"] == 1
+    gate.set()                        # unwedge the backend
+    # the replacement worker serves fresh traffic; the late result of
+    # the abandoned request is discarded, never delivered
+    out = srv.predict(np.full((2, 2), 3.0, np.float32))
+    assert np.all(out[0] == 6.0)
+    assert req.state == "abandoned"
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# probes, stats surface, lifecycle
+# ---------------------------------------------------------------------------
+
+def test_healthz_readyz_contract():
+    clock = FakeClock()
+    srv = InferenceServer(CallableBackend(_echo), workers=0, clock=clock,
+                          capacity=1, name="probe")
+    ready = srv.readyz()
+    assert not ready["ready"] and "not warmed up" in ready["reasons"]
+    srv.warm_up()
+    assert srv.readyz()["ready"]
+    h = srv.healthz()
+    assert h["ok"] and h["circuit"] == "closed" and h["warmed"]
+    assert h["queue_depth"] == 0 and h["queue_capacity"] == 1
+    srv.submit(np.ones((1, 2), np.float32))
+    assert not srv.readyz()["ready"]          # queue full
+    srv.run_pending()
+    clock.advance(7.0)
+    assert srv.healthz()["last_success_age"] == pytest.approx(7.0)
+    srv.close()
+    assert not srv.healthz()["ok"]
+    with pytest.raises(ServerClosed):
+        srv.submit(np.ones((1, 2), np.float32))
+
+
+def test_endpoint_stats_mirror():
+    clock = FakeClock()
+    srv = _server(clock, name="ep1")
+    srv.predict(np.ones((1, 2), np.float32))
+    table = serving.stats()
+    assert "ep1" in table
+    assert table["ep1"]["completed"] == 1
+    assert table["ep1"]["circuit"]["state"] == "closed"
+    assert set(table["ep1"]["queue"]) == {"depth", "admitted", "shed",
+                                          "evicted"}
+    srv.close()
+    assert "ep1" not in serving.stats()
+
+
+# ---------------------------------------------------------------------------
+# real backends: Predictor (C predict ABI surface) and Module
+# ---------------------------------------------------------------------------
+
+def _toy_artifact(nclass=3, dim=5, seed=0):
+    rng = np.random.RandomState(seed)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc",
+                                num_hidden=nclass)
+    buf = _io.BytesIO()
+    np.savez(buf, **{"arg:fc_weight":
+                     rng.randn(nclass, dim).astype(np.float32),
+                     "arg:fc_bias": np.zeros(nclass, np.float32)})
+    return net.tojson(), buf.getvalue()
+
+
+def test_predictor_backend_bucketed_end_to_end():
+    clock = FakeClock()
+    sym_json, params = _toy_artifact()
+    backend = PredictorBackend(sym_json, params, row_shape=(5,))
+    srv = InferenceServer(backend, buckets=[2, 4], workers=0,
+                          clock=clock, name="pred")
+    srv.warm_up()
+    assert sorted(backend._predictors) == [2, 4]   # pre-bound executors
+    x = np.random.RandomState(1).rand(3, 5).astype(np.float32)
+    out = srv.predict(x)
+    assert out[0].shape == (3, 3)
+    # off-bucket batch was padded, not re-bound
+    assert sorted(backend._predictors) == [2, 4]
+    # row-for-row agreement with an exact-bucket request
+    exact = srv.predict(np.concatenate(
+        [x, np.zeros((1, 5), np.float32)], axis=0))
+    np.testing.assert_allclose(out[0], exact[0][:3], rtol=1e-5)
+
+
+def test_module_backend_via_as_serving_backend():
+    clock = FakeClock()
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc",
+                                num_hidden=4)
+    mod = mx.mod.Module(net, label_names=[], context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))], label_shapes=None,
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+    backend = mod.as_serving_backend()
+    assert isinstance(backend, ModuleBackend)
+    srv = InferenceServer(backend, buckets=[4], workers=0, clock=clock,
+                          name="mod")
+    srv.warm_up()
+    out = srv.predict(np.ones((2, 6), np.float32))
+    assert out[0].shape == (2, 4)
+    # degenerate and full batches round-trip through the same executor
+    assert srv.predict(np.ones((4, 6), np.float32))[0].shape == (4, 4)
+
+
+# ---------------------------------------------------------------------------
+# admission queue unit coverage
+# ---------------------------------------------------------------------------
+
+def test_admission_queue_expire_queued_helper():
+    clock = FakeClock()
+    q = AdmissionQueue(capacity=4, clock=clock)
+    live = Request(None, Deadline(100.0, clock))
+    dead = Request(None, Deadline(1.0, clock))
+    q.offer(live)
+    q.offer(dead)
+    clock.advance(5.0)
+    assert q.expire_queued() == 1
+    assert dead.done and isinstance(dead._error, DeadlineExceeded)
+    assert q.poll() is live and q.poll() is None
+
+
+def test_closed_queue_reads_as_shutdown_not_overload():
+    """A submit racing close() must surface ServerClosed (stop calling),
+    never QueueFull (retry later)."""
+    clock = FakeClock()
+    q = AdmissionQueue(capacity=2, clock=clock)
+    q.close()
+    with pytest.raises(ServerClosed):
+        q.offer(Request(None, Deadline(None, clock)))
+
+
+def test_runtime_fallback_routing_marks_request():
+    """A request admitted while the circuit was closed but *served* by
+    the fallback (circuit opened while it was queued) is flagged, so a
+    later deadline wedge is charged to the fallback, not the primary."""
+    clock = FakeClock()
+    br = CircuitBreaker(window=4, min_calls=1, failure_rate=1.0,
+                        cooldown=1000.0, clock=clock)
+    fb = CallableBackend(lambda a: [np.zeros_like(a["data"])])
+    srv = InferenceServer(CallableBackend(_echo), fallback=fb,
+                          breaker=br, workers=0, clock=clock,
+                          name="runtime-fb")
+    srv.warm_up()
+    req = srv.submit(np.ones((1, 2), np.float32))
+    assert not req.use_fallback       # circuit closed at submit time
+    br.record_failure()               # opens while the request is queued
+    srv.run_pending()
+    assert req.use_fallback           # runtime routing is recorded
+    assert np.all(srv.result(req)[0] == 0.0)
+
+
+def test_admission_queue_validation():
+    with pytest.raises(ValueError):
+        AdmissionQueue(capacity=0)
+    with pytest.raises(ValueError):
+        AdmissionQueue(policy="drop-newest")
+    with pytest.raises(ValueError):
+        ShapeBuckets([])
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_rate=0.0)
+
+
+# ---------------------------------------------------------------------------
+# review regressions: wedged probes, broken fallbacks, queue reclamation
+# ---------------------------------------------------------------------------
+
+def test_breaker_wedged_probe_reopens_instead_of_sticking():
+    """A half-open probe that never reports (wedged/abandoned) must
+    count as a failure after the cool-down — not leave the breaker
+    stuck half-open rejecting forever."""
+    clock = FakeClock()
+    br = CircuitBreaker(window=4, min_calls=1, failure_rate=1.0,
+                        cooldown=10.0, probes=1, clock=clock)
+    br.record_failure()
+    clock.advance(10.0)
+    assert br.state == "half-open"
+    assert br.allow()                 # probe granted... and then wedges
+    clock.advance(10.0)               # probe never reports back
+    assert br.state == "open"         # wedged probe counted as failure
+    clock.advance(10.0)               # a fresh cool-down elapses
+    assert br.state == "half-open"
+    assert br.allow()                 # a NEW probe is granted
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_wedged_inflight_abandon_records_breaker_failure():
+    """Server-side: abandoning a request wedged in the primary forward
+    feeds the circuit breaker (the probe/wedge evidence path)."""
+    clock = FakeClock()
+    gate = threading.Event()
+    started = threading.Event()
+
+    def wedging(arrays):
+        if not gate.is_set():
+            started.set()
+            gate.wait(30.0)
+        return _echo(arrays)
+
+    def fake_wait(event, timeout):
+        if timeout is None:
+            return event.wait(30.0)
+        if event.wait(0):
+            return True
+        clock.advance(timeout)
+        return event.wait(0)
+
+    srv = InferenceServer(CallableBackend(wedging), workers=1,
+                          clock=clock, wait=fake_wait, name="wedgebr")
+    srv.warm_up()
+    req = srv.submit(np.ones((1, 2), np.float32), deadline=1.0)
+    assert started.wait(30.0)
+    with pytest.raises(DeadlineExceeded):
+        srv.result(req)
+    assert srv.breaker.stats()["window_failures"] == 1
+    gate.set()
+    srv.close()
+
+
+def test_corrupt_fallback_is_never_served_and_breaker_unpolluted():
+    """A fallback whose own load failed must not be routed to when the
+    circuit opens, and its load failure must not count against the
+    primary's error window."""
+    clock = FakeClock()
+    br = CircuitBreaker(window=6, min_calls=2, failure_rate=1.0,
+                        cooldown=1000.0, clock=clock)
+    srv = InferenceServer(CallableBackend(_echo),
+                          fallback=_corrupt_backend(), breaker=br,
+                          workers=0, clock=clock, name="badfb")
+    srv.warm_up()                     # primary fine, fallback corrupt
+    assert srv.stats()["load_failures"] == 1
+    assert br.stats()["window_failures"] == 0   # primary window clean
+    faults.arm(FaultPlan().arm("serving.forward", nth=1, count=2))
+    for _ in range(2):                # primary fails -> no usable fallback
+        with pytest.raises(OSError):
+            srv.predict(np.ones((2, 5), np.float32))
+    assert br.state == "open"
+    with pytest.raises(CircuitOpen):  # fast-fail, NOT the broken fallback
+        srv.predict(np.ones((2, 5), np.float32))
+    assert srv.stats()["degraded"] == 0
+    assert not srv.readyz()["ready"]
+
+
+def test_expired_queued_requests_free_capacity_for_new_traffic():
+    clock = FakeClock()
+    srv = _server(clock, capacity=2, name="reclaim")
+    r1 = srv.submit(np.ones((1, 2), np.float32), deadline=1.0)
+    r2 = srv.submit(np.ones((1, 2), np.float32), deadline=1.0)
+    clock.advance(5.0)                # both die in queue
+    r3 = srv.submit(np.ones((1, 2), np.float32), deadline=10.0)
+    assert srv.stats()["deadline_queued"] == 2   # reclaimed + delivered
+    srv.run_pending()
+    assert srv.result(r3)[0].shape == (1, 2)
+    for dead in (r1, r2):
+        with pytest.raises(DeadlineExceeded):
+            srv.result(dead)
+
+
+def test_queued_expiry_counted_once_after_caller_abandon():
+    clock = FakeClock()
+    srv = _server(clock, name="once")
+    req = srv.submit(np.ones((1, 2), np.float32), deadline=1.0)
+    clock.advance(5.0)
+    with pytest.raises(DeadlineExceeded):
+        srv.result(req)               # caller-side abandonment counts it
+    srv.run_pending()                 # worker dequeues the corpse
+    assert srv.stats()["deadline_queued"] == 1
+    assert srv.stats()["abandoned"] == 1
+
+
+def test_module_backend_multi_input_warmup_and_padding():
+    clock = FakeClock()
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    net = mx.sym.FullyConnected(a + b, name="fc", num_hidden=2)
+    mod = mx.mod.Module(net, data_names=["a", "b"], label_names=[],
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("a", (4, 3)), ("b", (4, 3))],
+             label_shapes=None, for_training=False)
+    mod.init_params(mx.init.Xavier())
+    backend = mod.as_serving_backend()
+    assert set(backend.input_specs) == {"a", "b"}
+    srv = InferenceServer(backend, buckets=[4], workers=0, clock=clock,
+                          name="multi")
+    srv.warm_up()                     # probe must cover BOTH inputs
+    out = srv.predict({"a": np.ones((2, 3), np.float32),
+                       "b": np.ones((2, 3), np.float32)})
+    assert out[0].shape == (2, 2)     # both inputs padded, output sliced

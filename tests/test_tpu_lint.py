@@ -259,6 +259,44 @@ def test_registry_consistency_fault_sites(tmp_path):
     assert "io.next" in reg[0].message and "test_resilience" in reg[0].message
 
 
+def test_registry_consistency_serving_surfaces(tmp_path):
+    """The fault-site contract is a *group* of surfaces: serving sites
+    may live in test_serving.py / serving.md instead of the training-
+    side files, and coverage in any file of the group satisfies it."""
+    fixture = """
+        SITES = ("serving.forward", "serving.queue")
+
+        def fault_point(site):
+            pass
+    """
+    # covered: each site appears in one file of each group
+    findings = run_lint(
+        tmp_path, name="mxnet_tpu/resilience/faults.py", source=fixture,
+        extra={
+            "tests/test_resilience.py": "# trains only\n",
+            "tests/test_serving.py":
+                "arms serving.forward and serving.queue\n",
+            "docs/how_to/fault_tolerance.md": "# training guide\n",
+            "docs/how_to/serving.md":
+                "documents serving.forward and serving.queue\n",
+        })
+    assert "registry-consistency" not in rules_of(findings)
+
+    # uncovered: serving.queue absent from every doc surface
+    findings = run_lint(
+        tmp_path, name="mxnet_tpu/resilience/faults.py", source=fixture,
+        extra={
+            "tests/test_serving.py":
+                "arms serving.forward and serving.queue\n",
+            "docs/how_to/fault_tolerance.md": "# training guide\n",
+            "docs/how_to/serving.md": "only serving.forward here\n",
+        })
+    reg = [f for f in findings if f.rule == "registry-consistency"]
+    assert len(reg) == 1
+    assert "serving.queue" in reg[0].message
+    assert "serving.md" in reg[0].message
+
+
 def test_registry_consistency_ops_and_negatives(tmp_path):
     findings = run_lint(
         tmp_path, name="mxnet_tpu/ops/math_ops.py", source="""
