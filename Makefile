@@ -109,10 +109,23 @@ ci-serving: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
 	    -m 'not slow' -x -q
 
+# stage 10: data-pipeline chaos smoke — a short fit over deliberately
+# corrupted .rec shards with MXNET_TPU_FAULT_PLAN arming the io.open_shard/
+# io.read_record sites: the run must complete within the skip budget,
+# stats must report the injected faults, and a kill + fit(resume='auto')
+# must reproduce the exact batch sequence
+# (docs/how_to/data_resilience.md)
+ci-data: ci-native
+	timeout -k 10 180 env JAX_PLATFORMS=cpu \
+	    MXNET_TPU_FAULT_PLAN="io.open_shard:2:ioerror;io.read_record:5:ioerror" \
+	    python ci/data_chaos_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience_data.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
-    ci-frontends ci-dryrun ci-resilience ci-serving
+    ci-frontends ci-dryrun ci-resilience ci-serving ci-data
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
-        ci-serving
+        ci-serving ci-data

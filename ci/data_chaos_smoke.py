@@ -1,0 +1,195 @@
+"""Data-pipeline chaos smoke (`make ci-data`, ci/pipeline.yml).
+
+A short fit over a deliberately corrupted `.rec` shard set, with
+transient open/read faults armed through MXNET_TPU_FAULT_PLAN (the env
+spec this script runs under — see the Makefile stage), asserting:
+
+1. the run completes: corrupt records are quarantined within the skip
+   budget instead of killing training;
+2. `resilience.data.stats()` / `faults.stats()` report exactly the
+   damage and the injected faults the armed plan describes;
+3. an InjectedKill mid-epoch followed by `fit(resume='auto')` reproduces
+   the exact batch sequence of an uninterrupted run (shuffle included) —
+   deterministic mid-epoch resume end to end.
+
+Exits non-zero on any violation. docs/how_to/data_resilience.md
+documents the subsystem.
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import recordio, resilience, sym           # noqa: E402
+from mxnet_tpu.resilience import (DataGuardPolicy,        # noqa: E402
+                                  FaultPlan, InjectedKill, RecordIter,
+                                  RetryPolicy, ShardSet, faults, retry)
+
+DIM = 4
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def write_shards(root, nshards=2, per_shard=8):
+    shards = []
+    rng = np.random.RandomState(0)
+    for s in range(nshards):
+        path = os.path.join(root, f"part-{s}.rec")
+        w = recordio.MXRecordIO(path, "w")
+        for i in range(per_shard):
+            vec = rng.randn(DIM).astype(np.float32)
+            w.write(recordio.pack(
+                recordio.IRHeader(0, float(i % 3), i, 0), vec.tobytes()))
+        w.close()
+        shards.append(path)
+    return shards
+
+
+def record_offsets(path):
+    r = recordio.MXRecordIO(path, "r")
+    offs = []
+    while True:
+        pos = r.tell()
+        if r.read() is None:
+            break
+        offs.append(pos)
+    r.close()
+    return offs
+
+
+def corrupt_byte(path, offset):
+    blob = bytearray(open(path, "rb").read())
+    blob[offset] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+
+
+def make_iter(shards):
+    return RecordIter(
+        ShardSet(shards, policy=DataGuardPolicy(max_skipped_records=8,
+                                                poison_threshold=4)),
+        data_shape=(DIM,), batch_size=4, label_name="softmax_label")
+
+
+def make_module():
+    d = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(d, name="fc", num_hidden=3), name="softmax")
+    return mx.mod.Module(net, context=mx.cpu())
+
+
+def recording_cb(stream):
+    def cb(param):
+        batch = param.locals["batch"]
+        stream.append((param.epoch, batch.data[0].asnumpy().tobytes()))
+    return cb
+
+
+def fit(mod, shards, stream, prefix=None, resume=None):
+    mod.fit(make_iter(shards), num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            batch_end_callback=recording_cb(stream),
+            checkpoint_prefix=prefix, checkpoint_batch_period=2,
+            resume=resume)
+
+
+def main():
+    # CI runs this under `timeout`; keep backoff sleeps near zero anyway
+    retry.set_default_policy(RetryPolicy(max_retries=3, base_delay=0.001,
+                                         max_delay=0.01, jitter=0.0))
+    plan_spec = os.environ.get(faults.ENV_PLAN)
+    check(plan_spec, f"{faults.ENV_PLAN} is armed in the environment")
+
+    root = tempfile.mkdtemp(prefix="chaos_rec_")
+    shards = write_shards(root)
+    offs = record_offsets(shards[0])
+    corrupt_byte(shards[0], offs[2])          # bad magic mid-shard
+    corrupt_byte(shards[1], offs[5])          # and one in the 2nd shard
+
+    # ---- phase 1: chaos fit completes under the env-armed plan ----------
+    faults.arm(FaultPlan.from_env(plan_spec,
+                                  seed=int(os.environ.get(faults.ENV_SEED,
+                                                          "0"))))
+    np.random.seed(0)
+    mx.random.seed(0)
+    stream = []
+    fit(make_module(), shards, stream)
+    check(len(stream) > 0, "chaos fit completed and saw batches")
+
+    st = resilience.data.stats()
+    fired = faults.stats()["fired"]
+    armed = {rule.split(":")[0] for rule in
+             plan_spec.replace(",", ";").split(";") if rule.strip()}
+    check(st["records_skipped"] == 4,
+          f"2 corrupt records quarantined per epoch x2 epochs "
+          f"(records_skipped={st['records_skipped']})")
+    check(st["shards_quarantined"] == 0,
+          "no shard crossed the poison threshold")
+    for site in armed:
+        check(fired.get(site, 0) >= 1,
+              f"armed fault site {site} fired "
+              f"(fired={fired.get(site, 0)})")
+    retries = resilience.retry.stats()["retries"]
+    check(any(retries.get(s, 0) for s in armed),
+          f"injected transient faults were retried ({retries})")
+
+    # ---- phase 2: kill mid-epoch, resume, compare batch streams ---------
+    faults.disarm()
+    resilience.reset_stats()
+    ckdir = tempfile.mkdtemp(prefix="chaos_ck_")
+    prefix = os.path.join(ckdir, "run")
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    ref_stream = []
+    ref_mod = make_module()
+    fit(ref_mod, shards, ref_stream)
+    ref_params = {k: v.asnumpy() for k, v in ref_mod.get_params()[0].items()}
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    # call 8 = epoch 1's end-of-epoch fetch: lands after the nbatch=1
+    # mid-epoch checkpoint, so the resume is genuinely mid-epoch
+    faults.arm(FaultPlan().arm("io.next", nth=8, exc="kill"))
+    try:
+        fit(make_module(), shards, [], prefix=prefix)
+        check(False, "InjectedKill fired mid-epoch")
+    except InjectedKill:
+        check(True, "InjectedKill fired mid-epoch")
+    faults.disarm()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    resumed_stream = []
+    resumed_mod = make_module()
+    fit(resumed_mod, shards, resumed_stream, prefix=prefix, resume="auto")
+    got_params = {k: v.asnumpy()
+                  for k, v in resumed_mod.get_params()[0].items()}
+
+    st = resilience.data.stats()
+    check(st["resumes"] == 1 and st["last_resume"] is not None
+          and st["last_resume"]["nbatch"] > 0,
+          f"mid-epoch resume recorded (last_resume={st['last_resume']})")
+    offset = len(ref_stream) - len(resumed_stream)
+    check(0 < offset < len(ref_stream),
+          f"resume skipped {offset} already-trained batches")
+    check(ref_stream[offset:] == resumed_stream,
+          "post-resume batch stream is bitwise-identical to the "
+          "uninterrupted run")
+    for k in ref_params:
+        check(np.array_equal(ref_params[k], got_params[k]),
+              f"final param {k} bitwise-identical after kill+resume")
+
+    print("data chaos smoke: all checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,11 +30,14 @@ from ..tracecontext import dotted_name
 
 FAULTS_PY = "mxnet_tpu/resilience/faults.py"
 # Each contract surface is a *group* of files: a site is covered when it
-# appears in any file of the group. The serving runtime (PR 3) keeps its
-# fault-site tests/docs beside its own subsystem rather than growing the
-# training-side files forever.
-FAULT_TESTS = ("tests/test_resilience.py", "tests/test_serving.py")
-FAULT_DOCS = ("docs/how_to/fault_tolerance.md", "docs/how_to/serving.md")
+# appears in any file of the group. The serving runtime (PR 3) and the
+# resilient data pipeline (PR 4) keep their fault-site tests/docs beside
+# their own subsystems rather than growing the training-side files
+# forever.
+FAULT_TESTS = ("tests/test_resilience.py", "tests/test_serving.py",
+               "tests/test_resilience_data.py")
+FAULT_DOCS = ("docs/how_to/fault_tolerance.md", "docs/how_to/serving.md",
+              "docs/how_to/data_resilience.md")
 OPS_PREFIX = "mxnet_tpu/ops/"
 DOC_BASES = {"NDArrayDoc", "SymbolDoc"}
 

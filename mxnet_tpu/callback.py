@@ -136,26 +136,57 @@ class ProgressBar:
 
 class ResilienceMonitor:
     """Speedometer-style batch-end callback surfacing the fault-tolerance
-    counters (resilience.stats()): I/O retries, retry give-ups, and
-    injected-fault fires per site. Logs every ``frequent`` batches but
-    only when a counter moved since the last report, so a healthy run
-    stays silent. The latest snapshot stays readable on ``.stats``."""
+    counters (resilience.stats()): I/O retries, retry give-ups,
+    injected-fault fires per site, and the data-pipeline quarantine
+    counters (records/batches skipped, shards quarantined, resyncs).
+    Logs every ``frequent`` batches but only when a counter moved since
+    the last report, so a healthy run stays silent; when it observes an
+    epoch transition (the first batch of the next epoch) it reports the
+    finished epoch's quarantine-health delta once, silent when the data
+    pipeline took no damage. The final epoch has no successor batch, so
+    its tally is read from ``.stats`` (or ``resilience.data.stats()``)
+    rather than logged."""
+
+    _DATA_KEYS = ("records_skipped", "batches_skipped",
+                  "shards_quarantined", "resyncs")
 
     def __init__(self, frequent=50):
         self.frequent = max(1, int(frequent))
         self.stats = None
         self._last_reported = None
+        self._epoch = None
+        self._epoch_data_base = None
 
-    @staticmethod
-    def _total(stats):
+    @classmethod
+    def _total(cls, stats):
         return (sum(stats["retry"]["retries"].values())
                 + sum(stats["retry"]["giveups"].values())
-                + sum(stats["faults"]["fired"].values()))
+                + sum(stats["faults"]["fired"].values())
+                + sum(stats.get("data", {}).get(k, 0)
+                      for k in cls._DATA_KEYS))
+
+    def _report_epoch_health(self, epoch, data):
+        """Per-epoch quarantine health: what this epoch's pipeline
+        absorbed (deltas against the epoch-start snapshot)."""
+        base = self._epoch_data_base or {}
+        moved = {k: data.get(k, 0) - base.get(k, 0)
+                 for k in self._DATA_KEYS}
+        if any(moved.values()):
+            logging.warning(
+                "Epoch[%d] data-resilience: %s\tquarantined_total=%d",
+                epoch, "\t".join(f"{k}={v}" for k, v in moved.items()
+                                 if v), data.get("shards_quarantined", 0))
 
     @hot_path("batch-end callback, fires every batch")
     def __call__(self, param):
         from .resilience import stats as _resilience_stats
         self.stats = _resilience_stats()
+        data = self.stats.get("data", {})
+        if self._epoch is None:
+            self._epoch, self._epoch_data_base = param.epoch, dict(data)
+        elif param.epoch != self._epoch:
+            self._report_epoch_health(self._epoch, data)
+            self._epoch, self._epoch_data_base = param.epoch, dict(data)
         if param.nbatch % self.frequent:
             return
         if self._last_reported is not None \
@@ -170,6 +201,9 @@ class ResilienceMonitor:
             parts.append(f"giveups[{label}]={n}")
         for site, n in sorted(self.stats["faults"]["fired"].items()):
             parts.append(f"faults[{site}]={n}")
+        for key in self._DATA_KEYS:
+            if data.get(key, 0):
+                parts.append(f"data[{key}]={data[key]}")
         if parts:
             logging.warning("Epoch[%d] Batch [%d]\tResilience: %s",
                             param.epoch, param.nbatch, "\t".join(parts))

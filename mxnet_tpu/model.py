@@ -20,7 +20,7 @@ from .callback import BatchEndParam  # noqa: F401  (reference keeps it here)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    states=None):
+                    states=None, iter_state=None):
     """Write prefix-symbol.json + prefix-%04d.params (reference: model.py:340).
 
     The params container keys use the reference's 'arg:'/'aux:' prefixes.
@@ -28,11 +28,12 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     checkpoint gets a SHA-256 manifest (resilience/checkpoint.py), for
     the epoch-numbered and the epoch-less (``epoch=None`` →
     ``prefix.params``) naming schemes alike. ``states`` optionally adds
-    serialized optimizer state to the checkpoint + manifest.
+    serialized optimizer state, and ``iter_state`` a JSON data-iterator
+    snapshot for mid-epoch resume, to the checkpoint + manifest.
     """
     from .resilience import checkpoint as _ckpt
     _ckpt.write_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                           states=states)
+                           states=states, iter_state=iter_state)
 
 
 def load_checkpoint(prefix, epoch=None) -> Tuple:

@@ -15,6 +15,7 @@ from typing import List, Optional
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from ..base import MXNetError
 from ..callback import BatchEndParam
 from ..initializer import Uniform
 
@@ -37,19 +38,35 @@ def _fire(callbacks, param):
         cb(param)
 
 
-def _lookahead(iterable):
-    """Yield (batch, upcoming) pairs; ``upcoming`` is None on the last.
+def _lookahead(iterable, snapshot=None, want=None):
+    """Yield (batch, upcoming, state) triples; ``upcoming`` is None on
+    the last.
 
     The training loop hands ``upcoming`` to ``prepare`` so bucketing /
     prefetch modules can stage the next executor while the current step
     is still in flight (reference: the next_data_batch dance in
-    base_module.py fit)."""
+    base_module.py fit).
+
+    ``snapshot`` (the iterator's ``state_dict`` when mid-epoch
+    checkpointing is armed) is called after fetching each batch and
+    *before* fetching the next — so ``state`` is the exact
+    about-to-fetch-the-next-batch resume point, uncontaminated by the
+    lookahead prefetch. ``want(k)`` (k = 0-based position in this
+    epoch's stream) gates the snapshot to the batches that will
+    actually checkpoint — state_dict() cost is source-defined
+    (arbitrary iterators may pay O(dataset)), so it must not run every
+    batch."""
     it = iter(iterable)
     here = next(it, _END)
+    k = 0
     while here is not _END:
+        state = None
+        if snapshot is not None and (want is None or want(k)):
+            state = snapshot()
         nxt = next(it, _END)
-        yield here, (None if nxt is _END else nxt)
+        yield here, (None if nxt is _END else nxt), state
         here = nxt
+        k += 1
 
 
 def _resolve_metric(m):
@@ -258,28 +275,38 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint_prefix=None, checkpoint_period=1,
-            resume=None, save_optimizer_states=True):
+            checkpoint_batch_period=None, resume=None,
+            save_optimizer_states=True):
         """reference: base_module.py:376 — the canonical Module training
         loop: bind → init params/optimizer → per-epoch train pass with
         lookahead prepare, then the optional validation pass.
 
-        Fault tolerance (docs/how_to/fault_tolerance.md): with
-        ``checkpoint_prefix`` set, a manifest-covered checkpoint (params +
-        optimizer state) is written atomically every ``checkpoint_period``
-        epochs. ``resume='auto'`` discovers the newest *valid* checkpoint
-        at that prefix and continues from its epoch — optimizer state and
-        update counters included, so the resumed run follows the
-        uninterrupted trajectory exactly; with no valid checkpoint it
-        starts fresh. ``resume=<int>`` demands that specific epoch."""
+        Fault tolerance (docs/how_to/fault_tolerance.md,
+        docs/how_to/data_resilience.md): with ``checkpoint_prefix`` set,
+        a manifest-covered checkpoint (params + optimizer state) is
+        written atomically every ``checkpoint_period`` epochs — plus,
+        with ``checkpoint_batch_period=N``, every N batches *within* an
+        epoch, including the data iterator's ``state_dict()`` (position
+        + shuffle RNG). ``resume='auto'`` discovers the newest *valid*
+        checkpoint at that prefix and continues from its epoch — and,
+        when the checkpoint carries iterator state and ``train_data``
+        supports ``load_state_dict``, from its exact batch position, so
+        the resumed run replays a bitwise-identical batch sequence; with
+        no valid checkpoint it starts fresh. ``resume=<int>`` demands
+        that specific epoch."""
         assert num_epoch is not None, "please specify number of epochs"
 
         resume_states = None
+        resume_iter_state = None
+        begin_batch = 0
         if resume is True:   # fit(resume=True) means 'auto', not epoch 1
             resume = "auto"
         if resume is not None and resume is not False:
             assert checkpoint_prefix, "resume requires checkpoint_prefix"
             from ..resilience import CheckpointCorrupt
-            from ..resilience.checkpoint import AUTO, load_checkpoint_ex
+            from ..resilience.checkpoint import (AUTO, epoch_of_label,
+                                                 load_checkpoint_ex,
+                                                 load_iter_state)
             try:
                 # resume=<int> demands that exact epoch (no fallback to a
                 # different one); only 'auto' may walk back to an older
@@ -292,12 +319,25 @@ class BaseModule:
                 arg_params, aux_params = ck_arg, ck_aux
                 force_init = True
                 if isinstance(ck_epoch, int):
-                    begin_epoch = ck_epoch
+                    # a mid-epoch label maps back to its in-progress
+                    # epoch; the iterator state below refines the batch
+                    begin_epoch = epoch_of_label(ck_epoch)
                 else:
                     self.logger.warning(
                         "resumed epoch-less checkpoint %s carries no "
                         "epoch number; fit restarts at epoch 0 on the "
                         "restored params", checkpoint_prefix)
+                try:
+                    resume_iter_state = load_iter_state(checkpoint_prefix,
+                                                        ck_epoch)
+                except CheckpointCorrupt as err:
+                    # the params/states already loaded and verified; a
+                    # bad iterator-state file must degrade to an
+                    # epoch-start resume, not throw that work away
+                    self.logger.warning(
+                        "checkpoint %s: iterator state unreadable (%s); "
+                        "resuming at the start of epoch %s instead of "
+                        "mid-epoch", checkpoint_prefix, err, ck_epoch)
                 self.logger.info("fit: resuming from checkpoint %s epoch=%s",
                                  checkpoint_prefix, ck_epoch)
             except (FileNotFoundError, CheckpointCorrupt):
@@ -309,6 +349,12 @@ class BaseModule:
                     raise
                 self.logger.info("fit(resume='auto'): no valid checkpoint "
                                  "at %s, starting fresh", checkpoint_prefix)
+
+        from ..resilience.data import (apply_resume_state,
+                                       supports_state as _supports_state)
+        if resume_iter_state is not None:
+            begin_epoch, begin_batch = apply_resume_state(
+                train_data, resume_iter_state, logger=self.logger)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -327,10 +373,60 @@ class BaseModule:
         train_metric = _resolve_metric(eval_metric)
         validation_metric = validation_metric or train_metric
 
+        can_snapshot = _supports_state(train_data)
+        if can_snapshot and checkpoint_prefix and checkpoint_batch_period \
+                and hasattr(train_data, "enable_state_snapshots"):
+            # PrefetchingIter-style sources capture per-prefetch
+            # snapshots only once armed — they cost O(dataset) each, so
+            # arming is tied to batch-period checkpointing; the
+            # epoch-end-only snapshot below degrades gracefully instead
+            train_data.enable_state_snapshots()
+        batch_ckpt = None
+        if checkpoint_prefix and checkpoint_batch_period:
+            if can_snapshot:
+                from ..resilience.checkpoint import (mid_epoch_label,
+                                                     remove_checkpoint)
+                prev_mid = [None]
+
+                def _save_mid_epoch(ep, nbatch, iter_snapshot):
+                    # a FRESH stem per save (mid_epoch_label): never
+                    # overwrite the previous good checkpoint in place —
+                    # a torn multi-file replace would destroy it. The
+                    # superseded mid-epoch stem is rolled afterwards so
+                    # a long epoch holds at most one on disk.
+                    label = mid_epoch_label(ep, nbatch)
+                    self._write_fit_checkpoint(
+                        checkpoint_prefix, label, save_optimizer_states,
+                        iter_state={"epoch": ep, "nbatch": nbatch + 1,
+                                    "iterator": iter_snapshot})
+                    if prev_mid[0] is not None:
+                        remove_checkpoint(checkpoint_prefix, prev_mid[0])
+                    prev_mid[0] = label
+
+                batch_ckpt = (max(1, int(checkpoint_batch_period)),
+                              _save_mid_epoch)
+            else:
+                self.logger.warning(
+                    "checkpoint_batch_period=%s ignored: train_data (%s) "
+                    "has no state_dict()", checkpoint_batch_period,
+                    type(train_data).__name__)
+
         for epoch in range(begin_epoch, num_epoch):
             started = time.time()
-            self._train_one_epoch(train_data, epoch, train_metric,
-                                  batch_end_callback, monitor)
+            nseen = self._train_one_epoch(train_data, epoch, train_metric,
+                                          batch_end_callback, monitor,
+                                          begin_batch=begin_batch,
+                                          batch_ckpt=batch_ckpt)
+            # a mid-epoch resume whose checkpoint landed on the epoch's
+            # last batch replays an empty tail: the epoch's end-of-epoch
+            # callbacks and eval (almost certainly) already ran before
+            # the crash — firing them again would double their side
+            # effects. This is deliberately at-most-once: a crash in the
+            # narrow window between that final checkpoint and the
+            # callbacks skips them for that epoch (exactly-once through
+            # kills would need transactional callback markers)
+            replayed_empty_tail = begin_batch > 0 and nseen == 0
+            begin_batch = 0
             for name, val in train_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
@@ -340,29 +436,47 @@ class BaseModule:
             # (checkpointing) and the next epoch agree on one copy
             snapshot = self.get_params()
             self.set_params(*snapshot)
-            for cb in _as_list(epoch_end_callback):
-                cb(epoch, self.symbol, *snapshot)
+            if not replayed_empty_tail:
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, *snapshot)
+            # reset BEFORE the epoch-end checkpoint: the persisted
+            # iterator state is then the fresh next-epoch position
+            # (post-reshuffle), so a resumed shuffled run replays the
+            # next epoch's batch sequence bitwise. When eval shares the
+            # train iterator, eval must consume it first — keep the
+            # legacy order (checkpoint → eval → reset, no iter state).
+            shared_iter = eval_data is train_data
+            if not shared_iter:
+                train_data.reset()
             if checkpoint_prefix and (epoch + 1) % max(
                     1, int(checkpoint_period)) == 0:
                 # checkpoint labeled epoch+1 == "epochs completed", matching
                 # the do_checkpoint callback convention; resume picks it up
                 # as begin_epoch
-                if hasattr(self, "save_checkpoint"):
-                    self.save_checkpoint(
-                        checkpoint_prefix, epoch + 1,
-                        save_optimizer_states=save_optimizer_states)
-                else:
-                    if save_optimizer_states:
-                        self.logger.warning(
-                            "%s has no save_checkpoint; checkpointing "
-                            "params only (optimizer state will be "
-                            "reinitialized on resume)",
-                            type(self).__name__)
-                    from ..model import save_checkpoint as _save_ckpt
-                    _save_ckpt(checkpoint_prefix, epoch + 1, self.symbol,
-                               *snapshot)
+                iter_state = None
+                if can_snapshot and not shared_iter:
+                    try:
+                        iter_state = {"epoch": epoch + 1, "nbatch": 0,
+                                      "iterator": train_data.state_dict()}
+                    except MXNetError as err:
+                        # e.g. a PrefetchingIter whose per-prefetch
+                        # snapshots are disarmed (no batch-period
+                        # checkpointing): epoch-granularity resume
+                        # without iterator state, as before this PR
+                        self.logger.debug(
+                            "epoch-end iterator snapshot unavailable "
+                            "(%s); checkpoint carries no iterator state",
+                            err)
+                self._write_fit_checkpoint(checkpoint_prefix, epoch + 1,
+                                           save_optimizer_states,
+                                           iter_state=iter_state)
+                # this epoch-end checkpoint supersedes the epoch's
+                # mid-epoch stems: sweep them so they cannot outrank it
+                from ..resilience.checkpoint import \
+                    clear_mid_epoch_checkpoints
+                clear_mid_epoch_checkpoints(checkpoint_prefix, epoch + 1)
 
-            if eval_data:
+            if eval_data and not replayed_empty_tail:
                 for name, val in self.score(
                         eval_data, validation_metric,
                         score_end_callback=eval_end_callback,
@@ -370,12 +484,45 @@ class BaseModule:
                         epoch=epoch):
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
-            train_data.reset()
+            if shared_iter:
+                train_data.reset()
+
+    def _write_fit_checkpoint(self, prefix, epoch, save_optimizer_states,
+                              iter_state=None):
+        """One checkpoint write for fit(): the module's own
+        save_checkpoint when it has one (params + optimizer state +
+        iterator state, all manifest-covered), else the params-only
+        model.save_checkpoint fallback."""
+        if hasattr(self, "save_checkpoint"):
+            self.save_checkpoint(prefix, epoch,
+                                 save_optimizer_states=save_optimizer_states,
+                                 iter_state=iter_state)
+        else:
+            if save_optimizer_states:
+                self.logger.warning(
+                    "%s has no save_checkpoint; checkpointing "
+                    "params only (optimizer state will be "
+                    "reinitialized on resume)", type(self).__name__)
+            from ..model import save_checkpoint as _save_ckpt
+            _save_ckpt(prefix, epoch, self.symbol, *self.get_params(),
+                       iter_state=iter_state)
 
     def _train_one_epoch(self, train_data, epoch, train_metric,
-                         batch_end_callback, monitor):
+                         batch_end_callback, monitor, begin_batch=0,
+                         batch_ckpt=None):
+        """Returns the number of batches trained this epoch."""
         train_metric.reset()
-        for nbatch, (batch, upcoming) in enumerate(_lookahead(train_data)):
+        snapshot = want = None
+        if batch_ckpt is not None:
+            snapshot = train_data.state_dict
+            period = batch_ckpt[0]
+            # snapshot only the batches that will actually checkpoint
+            want = lambda k: (begin_batch + k + 1) % period == 0  # noqa: E731
+        nseen = 0
+        for k, (batch, upcoming, state) in enumerate(
+                _lookahead(train_data, snapshot, want)):
+            nbatch = begin_batch + k
+            nseen = k + 1
             if monitor is not None:
                 monitor.tic()
             self.forward_backward(batch)
@@ -388,6 +535,9 @@ class BaseModule:
             _fire(batch_end_callback,
                   BatchEndParam(epoch=epoch, nbatch=nbatch,
                                 eval_metric=train_metric, locals=locals()))
+            if batch_ckpt is not None and (nbatch + 1) % batch_ckpt[0] == 0:
+                batch_ckpt[1](epoch, nbatch, state)
+        return nseen
 
     def prepare(self, data_batch):
         pass

@@ -425,13 +425,18 @@ class SPMDTrainer:
         return {"params": self.params, "states": self.states,
                 "aux": self.aux}
 
-    def save_checkpoint(self, directory, step=0, epoch=None):
+    def save_checkpoint(self, directory, step=0, epoch=None,
+                        iter_state=None):
         """Write a sharded checkpoint to <directory>/step_<step>, then a
         ``manifest.json`` with SHA-256 digests of every file in it (the
         validity marker restore_latest trusts). Orbax itself writes to a
         tmp dir and renames, so a crash mid-save never corrupts an
         existing checkpoint; the save runs under the default retry
-        policy behind the ``checkpoint.write`` fault site."""
+        policy behind the ``checkpoint.write`` fault site.
+        ``iter_state`` (a JSON-serializable data-iterator snapshot)
+        lands in ``iter_state.json`` inside the checkpoint dir,
+        manifest-covered, for deterministic mid-epoch resume."""
+        import json
         import os
 
         import orbax.checkpoint as ocp
@@ -453,6 +458,10 @@ class SPMDTrainer:
 
         guarded_call("checkpoint.write", _save)
         from ..resilience import checkpoint as _ckpt
+        if iter_state is not None:
+            _ckpt.atomic_write_bytes(
+                os.path.join(path, "iter_state.json"),
+                json.dumps(iter_state, sort_keys=True).encode("utf-8"))
         _ckpt.write_dir_manifest(path)
         return path
 
@@ -504,6 +513,13 @@ class SPMDTrainer:
         self._num_update = int(state["meta"]["num_update"])
         self._restored_epoch = int(state["meta"]["epoch"])
         self._rng = jnp.asarray(state["meta"]["rng"])
+        import json
+        ipath = os.path.join(path, "iter_state.json")
+        self._restored_iter_state = None
+        if os.path.exists(ipath):
+            # digest-verified above by verify_dir_manifest
+            with open(ipath, "r", encoding="utf-8") as f:
+                self._restored_iter_state = json.load(f)
         return self
 
     def restore_latest(self, directory):
@@ -540,18 +556,24 @@ class SPMDTrainer:
     # -- training loop ------------------------------------------------------
 
     def fit(self, train_data, num_epoch, checkpoint_dir=None,
-            checkpoint_period=1, resume=None, batch_end_callback=None,
-            epoch_end_callback=None):
+            checkpoint_period=1, checkpoint_batch_period=None, resume=None,
+            batch_end_callback=None, epoch_end_callback=None):
         """Minimal epoch loop over a DataIter (call bind() first):
         each batch becomes one fused SPMD step. With ``checkpoint_dir``,
         a sharded checkpoint is written every ``checkpoint_period``
-        epochs; ``resume='auto'`` continues from the newest valid one
-        (params, optimizer state, update counter, rng — bitwise the
-        trajectory the uninterrupted run takes), ``resume=<int>`` demands
-        that exact ``step_<N>`` checkpoint."""
+        epochs — plus, with ``checkpoint_batch_period=N``, every N
+        batches within an epoch including the iterator's
+        ``state_dict()``; ``resume='auto'`` continues from the newest
+        valid one (params, optimizer state, update counter, rng, and —
+        when the checkpoint carries iterator state and ``train_data``
+        supports ``load_state_dict`` — the exact mid-epoch batch
+        position: bitwise the trajectory the uninterrupted run takes),
+        ``resume=<int>`` demands that exact ``step_<N>`` checkpoint."""
         if self._step_fn is None:
             raise MXNetError("call bind() before fit()")
         begin_epoch = 0
+        begin_batch = 0
+        resume_iter = None
         if resume is True:   # fit(resume=True) means 'auto', not step 1
             resume = "auto"
         if resume is not None and resume is not False:
@@ -572,24 +594,101 @@ class SPMDTrainer:
                         "epoch=); fit restarts at epoch 0 on the restored "
                         "params", restored)
                 begin_epoch = saved_epoch if saved_epoch >= 0 else 0
+                resume_iter = getattr(self, "_restored_iter_state", None)
+        from ..resilience.data import (apply_resume_state,
+                                       supports_state as _supports_state)
+        if resume_iter is not None:
+            begin_epoch, begin_batch = apply_resume_state(train_data,
+                                                          resume_iter)
         from ..callback import BatchEndParam
         cbs = (batch_end_callback if isinstance(batch_end_callback, list)
                else [batch_end_callback]) if batch_end_callback is not None \
             else []
+        can_snapshot = _supports_state(train_data)
+        if can_snapshot and checkpoint_dir and checkpoint_batch_period \
+                and hasattr(train_data, "enable_state_snapshots"):
+            # PrefetchingIter-style sources capture per-prefetch
+            # snapshots only once armed — they cost O(dataset) each, so
+            # arming is tied to batch-period checkpointing; the
+            # epoch-end-only snapshot below degrades gracefully instead
+            train_data.enable_state_snapshots()
+        bperiod = max(1, int(checkpoint_batch_period)) \
+            if checkpoint_batch_period else None
+        # NOTE: this mid-epoch checkpoint orchestration deliberately
+        # parallels BaseModule.fit (module/base_module.py) — the trainer
+        # rolls whole step_<N> dirs where Module rolls labeled stems,
+        # and skips the epoch-end write after an empty-tail replay
+        # because its dir would collide with the promoted mid save.
+        # A semantics change here must be mirrored there.
+        import shutil
+        last_mid_step = None
+        prev_mid_path = None
         for epoch in range(begin_epoch, num_epoch):
-            train_data.reset()
-            for nbatch, batch in enumerate(train_data):
+            if begin_batch == 0:
+                train_data.reset()
+            # else: mid-epoch resume — the restored iterator already
+            # sits at begin_batch; a reset would replay the epoch head
+            nseen = 0
+            for k, batch in enumerate(train_data):
+                nbatch = begin_batch + k
+                nseen = k + 1
                 inputs = self._batch_dict(batch)
                 self.step(inputs)
                 for cb in cbs:
                     cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
                                      eval_metric=None, locals=locals()))
-            if epoch_end_callback is not None:
+                if checkpoint_dir and bperiod and can_snapshot \
+                        and (nbatch + 1) % bperiod == 0:
+                    # state_dict() here is "about to fetch nbatch+1" —
+                    # the exact resume point for this mid-epoch save
+                    path = self.save_checkpoint(
+                        checkpoint_dir, step=self._num_update, epoch=epoch,
+                        iter_state={"epoch": epoch, "nbatch": nbatch + 1,
+                                    "iterator": train_data.state_dict()})
+                    last_mid_step = self._num_update
+                    # roll the superseded mid-epoch dir: a long epoch
+                    # holds at most one mid-epoch checkpoint on disk
+                    if prev_mid_path is not None and prev_mid_path != path:
+                        shutil.rmtree(prev_mid_path, ignore_errors=True)
+                    prev_mid_path = path
+            # a mid-epoch resume whose checkpoint landed on the epoch's
+            # last batch replays an empty tail: this epoch's end-of-epoch
+            # callback and checkpoint already happened before the crash
+            replayed_empty_tail = begin_batch > 0 and nseen == 0
+            begin_batch = 0
+            if epoch_end_callback is not None and not replayed_empty_tail:
                 epoch_end_callback(epoch, self)
-            if checkpoint_dir and (epoch + 1) % max(
-                    1, int(checkpoint_period)) == 0:
+            if checkpoint_dir and not replayed_empty_tail \
+                    and (epoch + 1) % max(
+                        1, int(checkpoint_period)) == 0:
+                if self._num_update == last_mid_step:
+                    # the final batch's mid-epoch save already captured
+                    # this exact state (same num_update/params/rng, and
+                    # its exhausted iterator position resumes into
+                    # epoch+1 identically); rewriting the same step_<N>
+                    # dir would delete-then-rewrite the newest good
+                    # checkpoint — the torn window this design avoids.
+                    # Promote that dir to epoch-checkpoint status: it
+                    # must survive the next epoch's mid-epoch roll so
+                    # per-epoch retention (rollback/model selection)
+                    # keeps one checkpoint per epoch boundary.
+                    prev_mid_path = None
+                    continue
+                iter_state = None
+                if can_snapshot:
+                    try:
+                        # exhausted end-of-epoch state: the resumed loop
+                        # reset()s into epoch+1 drawing from the restored
+                        # shuffle RNG, so the next epoch replays bitwise
+                        iter_state = {"epoch": epoch + 1, "nbatch": 0,
+                                      "iterator": train_data.state_dict()}
+                    except MXNetError:
+                        # a disarmed PrefetchingIter (no batch-period
+                        # checkpointing): epoch-granularity resume
+                        # without iterator state, as before this PR
+                        pass
                 self.save_checkpoint(checkpoint_dir, step=self._num_update,
-                                     epoch=epoch + 1)
+                                     epoch=epoch + 1, iter_state=iter_state)
         return self
 
     def _batch_dict(self, batch) -> Dict[str, np.ndarray]:

@@ -27,6 +27,8 @@ from collections import namedtuple
 import numpy as np
 
 from .base import MXNetError
+from .resilience import faults as _faults
+from .resilience import retry as _retry
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
            "pack", "unpack", "pack_img", "unpack_img"]
@@ -51,6 +53,7 @@ class MXRecordIO:
         self.flag = flag
         self.record = None
         self.is_open = False
+        self._bad_start = None   # start offset of the last corrupt record
         # serializes seek+read pairs (DataLoader workers share the handle)
         self._lock = threading.Lock()
         self.open()
@@ -60,7 +63,16 @@ class MXRecordIO:
             self.record = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.record = open(self.uri, "rb")
+            # the ``io.open_shard`` fault site: transient open failures
+            # (injected or real) back off under the default retry policy;
+            # permanent ones (FileNotFoundError, ...) fail fast so shard
+            # failover (resilience/data.py) can quarantine the shard
+            def _open():
+                _faults.fault_point("io.open_shard")
+                return open(self.uri, "rb")
+
+            self.record = _retry.default_policy().call(
+                _open, label="io.open_shard")
             self.writable = False
         else:
             raise MXNetError(f"Invalid flag {self.flag}")
@@ -107,25 +119,65 @@ class MXRecordIO:
             self.record.write(b"\x00" * pad)
 
     def read(self):
-        """Read the next record, None at EOF. Reassembles split records."""
+        """Read the next record, None at EOF. Reassembles split records.
+
+        A corrupt record (bad magic, truncated payload/split) raises
+        :class:`MXNetError` with the record's start offset in the message.
+        Transient I/O errors pass the ``io.read_record`` fault site and
+        retry under the default policy — each attempt seeks back to the
+        record's start offset first, so a retried read is idempotent.
+        """
         if self.writable:
             raise MXNetError("not opened for reading")
+        start = self.record.tell()
+        if _faults.active_plan() is None:
+            # hot path: one plain parse attempt, no retry machinery —
+            # per-record reads must stay near-free when healthy (the
+            # site convention: "with no plan armed, a single is-None
+            # check"); a real transient OSError falls through to the
+            # retry loop below
+            try:
+                return self._read_at_cursor(start)
+            except MXNetError:
+                self._bad_start = start
+                raise
+            except OSError:
+                pass
+
+        def _attempt():
+            if self.record.tell() != start:
+                self.record.seek(start)
+            _faults.fault_point("io.read_record")
+            return self._read_at_cursor(start)
+
+        try:
+            return _retry.default_policy().call(_attempt,
+                                                label="io.read_record")
+        except MXNetError:
+            # remember where the corrupt record started so resync() can
+            # re-establish framing without trusting its garbage length
+            self._bad_start = start
+            raise
+
+    def _read_at_cursor(self, start):
         parts = []
         while True:
             head = self.record.read(8)
             if len(head) < 8:
                 if parts:
                     raise MXNetError(
-                        f"truncated split record at EOF in {self.uri}")
+                        f"truncated split record at EOF in {self.uri} "
+                        f"(record starts at offset {start})")
                 return None
             magic, lrec = struct.unpack("<II", head)
             if magic != _kMagic:
-                raise MXNetError(f"invalid record magic {magic:#x} in "
-                                 f"{self.uri}")
+                raise MXNetError(f"invalid record magic {magic:#x} at "
+                                 f"offset {start} in {self.uri}")
             cflag, length = _decode_lrec(lrec)
             payload = self.record.read(length)
             if len(payload) < length:
-                raise MXNetError(f"truncated record in {self.uri}")
+                raise MXNetError(f"truncated record at offset {start} in "
+                                 f"{self.uri}")
             pad = (4 - length % 4) % 4
             if pad:
                 self.record.read(pad)
@@ -135,8 +187,60 @@ class MXRecordIO:
             if cflag == 3:  # end of a split record
                 return b"".join(parts)
 
+    def resync(self):
+        """Scan forward for the next record boundary (the magic word at
+        4-byte alignment) and seek there. Called by the quarantine
+        machinery (resilience/data.py) after a corrupt record to
+        re-establish framing; the scan starts just past the corrupt
+        record's *start* offset, not the cursor — a garbage length field
+        may have dragged the cursor over perfectly good records. Returns
+        True when a candidate boundary was found, False at EOF."""
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        pos = getattr(self, "_bad_start", None)
+        if pos is None:
+            pos = self.record.tell()
+        pos += 4            # strictly past the bad record's start
+        pos += (4 - pos % 4) % 4
+        self._bad_start = None
+        chunk_size = 1 << 16
+        while True:
+            self.record.seek(pos)
+            chunk = self.record.read(chunk_size + len(_MAGIC_BYTES))
+            if len(chunk) < len(_MAGIC_BYTES):
+                return False
+            at = 0
+            while True:
+                at = chunk.find(_MAGIC_BYTES, at)
+                if at < 0 or at >= chunk_size + 1:
+                    break
+                if (pos + at) % 4 == 0:
+                    self.record.seek(pos + at)
+                    return True
+                at += 1
+            pos += chunk_size
+
     def tell(self):
         return self.record.tell()
+
+    # -- checkpointable position (resilience/data.py, mid-epoch resume) ------
+
+    def state_dict(self):
+        """JSON-serializable read position; pair with
+        :meth:`load_state_dict` for deterministic mid-epoch resume."""
+        return {"uri": self.uri,
+                "pos": int(self.record.tell()) if self.is_open else 0}
+
+    def load_state_dict(self, state):
+        if state.get("uri") not in (None, self.uri):
+            raise MXNetError(
+                f"iterator state was saved for shard {state['uri']!r}, "
+                f"not {self.uri!r}")
+        if not self.is_open:
+            self.open()
+        if self.writable:
+            raise MXNetError("cannot restore read position on a writer")
+        self.record.seek(int(state["pos"]))
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -165,12 +269,24 @@ class MXIndexedRecordIO(MXRecordIO):
                     f"{self.uri}; regenerate it (e.g. tools/im2rec.py) or "
                     "use MXRecordIO for sequential access")
             with open(self.idx_path) as f:
-                for line in f:
-                    parts = line.strip().split("\t")
-                    if len(parts) >= 2:
+                for lineno, line in enumerate(f, 1):
+                    stripped = line.strip()
+                    if not stripped:
+                        continue
+                    parts = stripped.split("\t")
+                    try:
+                        if len(parts) < 2:
+                            raise ValueError("expected 'key\\toffset'")
                         key = self.key_type(parts[0])
-                        self.idx[key] = int(parts[1])
-                        self.keys.append(key)
+                        offset = int(parts[1])
+                    except ValueError as err:
+                        raise MXNetError(
+                            f"malformed index line {lineno} in "
+                            f"{self.idx_path}: {stripped!r} ({err}); "
+                            "regenerate the index (e.g. tools/im2rec.py)"
+                        ) from err
+                    self.idx[key] = offset
+                    self.keys.append(key)
 
     def close(self):
         if self.is_open and self.fidx is not None:
@@ -186,7 +302,13 @@ class MXIndexedRecordIO(MXRecordIO):
     def seek(self, idx):
         if self.writable:
             raise MXNetError("not opened for reading")
-        self.record.seek(self.idx[idx])
+        try:
+            pos = self.idx[idx]
+        except KeyError:
+            raise MXNetError(f"key {idx!r} not in index for {self.uri} "
+                             f"({len(self.idx)} keys loaded from "
+                             f"{self.idx_path})") from None
+        self.record.seek(pos)
 
     def read_idx(self, idx):
         with self._lock:
@@ -223,13 +345,30 @@ def pack(header: IRHeader, s: bytes) -> bytes:
 
 
 def unpack(s: bytes):
-    """Inverse of pack (reference: recordio.py unpack:344)."""
+    """Inverse of pack (reference: recordio.py unpack:344).
+
+    Truncated buffers (shorter than the IRHeader, or shorter than the
+    label payload the header's flag declares) raise :class:`MXNetError`
+    rather than ``struct.error``/silent short reads, so the quarantine
+    machinery (resilience/data.py) classifies every decode failure under
+    one exception type. The ``io.decode`` fault site sits at the top so
+    injected decode faults are distinguishable from read faults."""
+    _faults.fault_point("io.decode")
+    if len(s) < _IR_SIZE:
+        raise MXNetError(
+            f"truncated record: {len(s)} bytes is shorter than the "
+            f"{_IR_SIZE}-byte IRHeader")
     header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
     s = s[_IR_SIZE:]
     if header.flag > 0:
-        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        nbytes = header.flag * 4
+        if len(s) < nbytes:
+            raise MXNetError(
+                f"truncated record: header declares {header.flag} labels "
+                f"({nbytes} bytes) but only {len(s)} payload bytes follow")
+        label = np.frombuffer(s[:nbytes], dtype=np.float32)
         header = header._replace(label=label)
-        s = s[header.flag * 4:]
+        s = s[nbytes:]
     return header, s
 
 
@@ -265,16 +404,34 @@ def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg") -> bytes:
 
 def unpack_img(s: bytes, iscolor=-1):
     """Unpack to (header, BGR image array) (reference: recordio.py
-    unpack_img:374)."""
+    unpack_img:374). A payload the image codec rejects (truncated or
+    corrupt compressed bytes) raises :class:`MXNetError` — the same
+    exception type :func:`unpack` uses — so quarantine classification
+    sees one failure type for every decode stage."""
     header, s = unpack(s)
     img = np.frombuffer(s, dtype=np.uint8)
     try:
         import cv2
-        img = cv2.imdecode(img, iscolor)
     except ImportError:
+        cv2 = None
+    if cv2 is not None:
+        try:
+            img = cv2.imdecode(img, iscolor)
+        except Exception as err:   # cv2.error on e.g. an empty buffer
+            raise MXNetError(
+                f"corrupt image payload ({len(s)} bytes): {err}") from err
+        if img is None:
+            raise MXNetError(
+                f"corrupt image payload ({len(s)} bytes): cv2.imdecode "
+                "rejected it")
+    else:
         import io as _io
 
         from PIL import Image
-        im = Image.open(_io.BytesIO(s))
-        img = np.asarray(im.convert("RGB"))[..., ::-1]  # RGB->BGR like cv2
+        try:
+            im = Image.open(_io.BytesIO(s))
+            img = np.asarray(im.convert("RGB"))[..., ::-1]  # RGB->BGR (cv2)
+        except Exception as err:
+            raise MXNetError(
+                f"corrupt image payload ({len(s)} bytes): {err}") from err
     return header, img

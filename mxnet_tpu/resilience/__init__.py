@@ -12,6 +12,10 @@ Three pillars (docs/how_to/fault_tolerance.md):
   :class:`~.faults.FaultPlan` arms named sites (``checkpoint.write``,
   ``kvstore.push``, ``io.next``, ``trainer.step``, ...) to raise on the
   Nth call; also armable via ``MXNET_TPU_FAULT_PLAN``.
+- :mod:`.data` — the resilient data pipeline
+  (docs/how_to/data_resilience.md): corrupt-record quarantine under
+  bounded skip budgets, shard failover, and checkpointable iterator
+  state for deterministic mid-epoch resume.
 
 The reference stack's ps-lite heartbeat/dead-node machinery collapsed in
 the SPMD port to "a dead process fails the collective for everyone"
@@ -20,19 +24,24 @@ the SPMD port to "a dead process fails the collective for everyone"
 """
 from __future__ import annotations
 
-from . import checkpoint, faults, retry  # noqa: F401
+from . import checkpoint, data, faults, retry  # noqa: F401
 from .checkpoint import (AUTO, CheckpointCorrupt, atomic_output,  # noqa: F401
                          atomic_write_bytes, find_checkpoints,
                          load_checkpoint_ex, verify_manifest,
                          write_checkpoint)
-from .faults import (FaultPlan, InjectedFault, InjectedKill,  # noqa: F401
-                     InjectedTimeout, fault_point)
+from .data import (DataBudgetExceeded, DataGuardPolicy,  # noqa: F401
+                   RecordIter, ResilientIter, ShardSet, guard)
+from .faults import (SITES, FaultPlan, InjectedFault,  # noqa: F401
+                     InjectedKill, InjectedTimeout, fault_point)
 from .retry import RetryExhausted, RetryPolicy, default_policy  # noqa: F401
 
-__all__ = ["checkpoint", "faults", "retry", "FaultPlan", "RetryPolicy",
-           "RetryExhausted", "CheckpointCorrupt", "InjectedFault",
-           "InjectedTimeout", "InjectedKill", "fault_point", "guarded_call",
-           "guarded_point", "default_policy", "stats", "reset_stats", "AUTO"]
+__all__ = ["checkpoint", "data", "faults", "retry", "FaultPlan",
+           "RetryPolicy", "RetryExhausted", "CheckpointCorrupt",
+           "InjectedFault", "InjectedTimeout", "InjectedKill", "fault_point",
+           "guarded_call", "guarded_point", "default_policy", "stats",
+           "reset_stats", "AUTO", "SITES", "DataGuardPolicy",
+           "DataBudgetExceeded", "ShardSet", "ResilientIter", "RecordIter",
+           "guard"]
 
 
 def guarded_call(site: str, fn, *args, policy=None, **kwargs):
@@ -67,11 +76,13 @@ def guarded_point(site: str, policy=None):
 
 
 def stats() -> dict:
-    """Combined fault + retry counters (surfaced by
+    """Combined fault + retry + data-pipeline counters (surfaced by
     ``callback.ResilienceMonitor`` and ``KVStore.num_dead_node``)."""
-    return {"faults": faults.stats(), "retry": retry.stats()}
+    return {"faults": faults.stats(), "retry": retry.stats(),
+            "data": data.stats()}
 
 
 def reset_stats():
     faults.reset_stats()
     retry.reset_stats()
+    data.reset_stats()

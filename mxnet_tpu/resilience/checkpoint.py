@@ -34,7 +34,10 @@ __all__ = ["CheckpointCorrupt", "atomic_output", "atomic_write_bytes",
            "file_digest", "write_manifest", "verify_manifest",
            "write_dir_manifest", "verify_dir_manifest",
            "manifest_path", "checkpoint_paths", "write_checkpoint",
-           "find_checkpoints", "load_checkpoint_ex", "MANIFEST_VERSION"]
+           "find_checkpoints", "load_checkpoint_ex", "load_iter_state",
+           "mid_epoch_label", "epoch_of_label", "remove_checkpoint",
+           "clear_mid_epoch_checkpoints", "MID_EPOCH_STRIDE",
+           "MANIFEST_VERSION"]
 
 MANIFEST_VERSION = 1
 
@@ -123,6 +126,7 @@ def checkpoint_paths(prefix: str, epoch: Optional[int]) -> Dict[str, str]:
     stem = _stem(prefix, epoch)
     return {"params": stem + ".params", "states": stem + ".states",
             "symbol": prefix + "-symbol.json",
+            "iter": stem + ".iter.json",
             "manifest": stem + ".manifest.json"}
 
 
@@ -223,10 +227,12 @@ def verify_dir_manifest(path: str):
 def write_checkpoint(prefix: str, epoch: Optional[int], symbol,
                      arg_params: dict, aux_params: dict,
                      states: Optional[bytes] = None,
-                     step: Optional[int] = None) -> Dict[str, str]:
+                     step: Optional[int] = None,
+                     iter_state: Optional[dict] = None) -> Dict[str, str]:
     """Atomically write one checkpoint (symbol json, params, optional
-    optimizer states) plus its manifest. Retries transient I/O errors
-    under the default policy. Returns the role->path map."""
+    optimizer states, optional data-iterator state for mid-epoch resume)
+    plus its manifest. Retries transient I/O errors under the default
+    policy. Returns the role->path map."""
     paths = checkpoint_paths(prefix, epoch)
     pol = retry.default_policy()
     files = {}
@@ -251,6 +257,11 @@ def write_checkpoint(prefix: str, epoch: Optional[int], symbol,
         pol.call(atomic_write_bytes, paths["states"], states,
                  label="checkpoint.write")
         files["states"] = paths["states"]
+    if iter_state is not None:
+        pol.call(atomic_write_bytes, paths["iter"],
+                 json.dumps(iter_state, sort_keys=True).encode("utf-8"),
+                 label="checkpoint.write")
+        files["iter"] = paths["iter"]
     pol.call(write_manifest, prefix, epoch, files, step=step,
              label="checkpoint.write")
     logging.info("Saved checkpoint to \"%s\"", paths["params"])
@@ -293,6 +304,67 @@ def find_checkpoints(prefix: str) -> List[Optional[int]]:
 
 #: sentinel: discover the newest valid checkpoint instead of naming one
 AUTO = "auto"
+
+#: mid-epoch checkpoints get their own stem namespace so every write
+#: targets a FRESH stem — overwriting the previous good checkpoint in
+#: place would open a torn-group window (params renamed, manifest not
+#: yet) that destroys the newest valid checkpoint. Labels are
+#: ``(epoch+1)*STRIDE + nbatch + 1``: they outrank the end-of-epoch
+#: ``epoch`` label they follow, grow monotonically within the epoch,
+#: and are swept by :func:`clear_mid_epoch_checkpoints` once the
+#: epoch-end checkpoint that supersedes them lands.
+MID_EPOCH_STRIDE = 1000000
+
+
+def mid_epoch_label(epoch: int, nbatch: int) -> int:
+    """Stem number for a mid-epoch checkpoint of 0-based ``epoch`` taken
+    after batch ``nbatch``."""
+    if int(nbatch) + 1 >= MID_EPOCH_STRIDE:
+        # past the stride the label would land in the next epoch's
+        # namespace — misattributing the resume epoch and escaping the
+        # sweep; fail loudly instead
+        raise ValueError(
+            f"mid-epoch checkpoint at batch {nbatch} exceeds the "
+            f"{MID_EPOCH_STRIDE}-batch label namespace; raise "
+            "checkpoint_batch_period so fewer than 1e6 mid-epoch "
+            "checkpoints land per epoch")
+    return (int(epoch) + 1) * MID_EPOCH_STRIDE + int(nbatch) + 1
+
+
+def epoch_of_label(label: int) -> int:
+    """The 0-based in-progress epoch a checkpoint label belongs to —
+    for an end-of-epoch label (epochs completed) this is the epoch to
+    run next, for a mid-epoch label the epoch it interrupted."""
+    if label >= MID_EPOCH_STRIDE:
+        return label // MID_EPOCH_STRIDE - 1
+    return label
+
+
+def remove_checkpoint(prefix: str, epoch) -> None:
+    """Best-effort removal of one checkpoint's files (params/states/
+    iter/manifest; the symbol file is shared across the prefix). Used
+    to roll superseded mid-epoch checkpoints so a long epoch holds at
+    most one on disk."""
+    for role, path in checkpoint_paths(prefix, epoch).items():
+        if role == "symbol":
+            continue
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def clear_mid_epoch_checkpoints(prefix: str, completed_epoch: int):
+    """Sweep mid-epoch checkpoints superseded by the end-of-epoch
+    checkpoint labeled ``completed_epoch`` (mid-epoch stems of every
+    epoch < ``completed_epoch``). A sweep failure is non-fatal: stale
+    mid-epoch checkpoints are consistent (they resume the epoch tail
+    redundantly but bitwise-correctly) and age out on later sweeps."""
+    bound = (completed_epoch + 1) * MID_EPOCH_STRIDE
+    for ep in find_checkpoints(prefix):
+        if ep is None or ep < MID_EPOCH_STRIDE or ep >= bound:
+            continue
+        remove_checkpoint(prefix, ep)
 
 
 def load_checkpoint_ex(prefix: str, epoch=AUTO, allow_fallback: bool = True,
@@ -397,3 +469,32 @@ def load_checkpoint_ex(prefix: str, epoch=AUTO, allow_fallback: bool = True,
     raise CheckpointCorrupt(
         f"no loadable checkpoint at prefix {prefix!r}; "
         f"last error: {last_err}")
+
+
+def load_iter_state(prefix: str, epoch) -> Optional[dict]:
+    """Data-iterator state persisted with checkpoint ``(prefix, epoch)``
+    for mid-epoch resume, or None when the checkpoint carries none.
+
+    Only an ``iter`` role recorded in the manifest is trusted (its
+    digest was verified at load time) — a stray ``.iter.json`` left by
+    an earlier run at the same stem belongs to a different trajectory,
+    exactly like a stray ``.states`` file."""
+    mpath = manifest_path(prefix, epoch)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as err:
+        raise CheckpointCorrupt(f"unreadable manifest {mpath}: {err}") \
+            from err
+    if "iter" not in doc.get("files", {}):
+        return None
+    ipath = checkpoint_paths(prefix, epoch)["iter"]
+    try:
+        with open(ipath, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError) as err:
+        raise CheckpointCorrupt(
+            f"iterator state {ipath} is recorded in the manifest but "
+            f"unreadable: {err}") from err

@@ -1,0 +1,700 @@
+"""Resilient data pipeline: corrupt-record quarantine, shard failover,
+and deterministic mid-epoch resume.
+
+A single flipped bit in a ``.rec`` shard used to kill an entire training
+run — ``MXRecordIO.read`` raises on bad magic with no recovery path, and
+a crashed ``fit`` restarted its epoch from batch 0 because no iterator
+could checkpoint its position. This module contains input faults at the
+iterator (docs/how_to/data_resilience.md):
+
+- :class:`ShardSet` — a resilient sequential reader over one or more
+  ``.rec`` shards. Per-record corruption is *quarantined*: the bad record
+  is skipped (the reader resyncs to the next magic-word boundary) under a
+  bounded skip budget; ``poison_threshold`` consecutive failures
+  quarantine the whole shard and fail over to the next one. Transient
+  open/read faults retry through :mod:`.retry` behind the
+  ``io.open_shard`` / ``io.read_record`` fault sites.
+- :class:`ResilientIter` (and the :func:`guard` convenience) — the same
+  budget/quarantine semantics wrapped around any ``DataIter``.
+- :class:`RecordIter` — a minimal ``DataIter`` over a :class:`ShardSet`
+  (fixed-shape float32 payloads packed with :func:`recordio.pack`), the
+  bridge that lets ``Module.fit`` / ``SPMDTrainer.fit`` train straight
+  off guarded shards.
+- checkpointable iterator state — everything here exposes
+  ``state_dict()`` / ``load_state_dict()`` (position, shuffle-RNG state,
+  epoch, quarantine set); the checkpoint layer persists it into the
+  SHA-256 manifests so ``fit(resume='auto')`` resumes mid-epoch with a
+  bitwise-identical batch sequence.
+
+Budgets escalate to :class:`DataBudgetExceeded` (an ``MXNetError``) —
+silent data loss is impossible: exhausting ``max_skipped_records`` or
+``max_quarantined_shards`` raises instead of dropping more data, and
+outer guards re-raise it rather than absorbing it as one more skip.
+
+:func:`stats` mirrors ``retry.stats()``: records skipped, shards
+quarantined, resyncs, batches skipped, and the last resume position.
+``callback.ResilienceMonitor`` surfaces these per epoch.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from . import retry as _retry
+from .retry import RetryExhausted
+
+__all__ = ["DataGuardPolicy", "DataBudgetExceeded", "ShardSet",
+           "ResilientIter", "RecordIter", "guard", "stats", "reset_stats",
+           "note_resume", "supports_state", "apply_resume_state"]
+
+
+class DataBudgetExceeded(MXNetError):
+    """A data-resilience budget (``max_skipped_records`` /
+    ``max_quarantined_shards`` / ``poison_threshold`` escalation) was
+    exhausted. A distinct type so *outer* guards re-raise it instead of
+    absorbing it as one more skippable failure — once a budget says
+    stop, nothing above may keep dropping data."""
+
+
+def supports_state(it) -> bool:
+    """True when ``it`` exposes the checkpointable-state protocol *all
+    the way down*: it has ``state_dict`` and, for wrapper iterators
+    (ResizeIter, PrefetchingIter, ResilientIter, ShardSet over raw
+    readers), every wrapped source does too (wrappers report this via a
+    ``supports_state`` property). The fit() loops gate mid-epoch
+    checkpointing on this — a wrapper over a snapshot-less source must
+    not be checkpointed, or the resume would silently replay the epoch
+    head while claiming an exact position."""
+    if not hasattr(it, "state_dict"):
+        return False
+    return bool(getattr(it, "supports_state", True))
+
+
+ENV_MAX_SKIP = "MXNET_TPU_DATA_MAX_SKIP"
+ENV_POISON = "MXNET_TPU_DATA_POISON"
+ENV_MAX_QUARANTINE = "MXNET_TPU_DATA_MAX_QUARANTINE"
+
+
+class DataGuardPolicy:
+    """Bounds on how much input damage a run may absorb silently.
+
+    - ``max_skipped_records``: total corrupt records (or batches, for
+      :class:`ResilientIter`) that may be quarantined per epoch before
+      the guard escalates to :class:`MXNetError`.
+    - ``poison_threshold``: consecutive failures that declare the
+      current shard (or wrapped iterator) *poisoned* — a poisoned shard
+      is quarantined whole and the reader fails over to the next shard.
+    - ``max_quarantined_shards``: shards that may be quarantined before
+      escalation.
+    - ``retry_policy``: :class:`~.retry.RetryPolicy` for the decode
+      stage (:class:`RecordIter`). The ``io.open_shard`` /
+      ``io.read_record`` sites retry under the *process default* policy
+      inside ``MXRecordIO`` — override those via
+      ``retry.set_default_policy`` (tests do, for fake clocks).
+
+    Defaults are env-overridable (``MXNET_TPU_DATA_MAX_SKIP``,
+    ``MXNET_TPU_DATA_POISON``, ``MXNET_TPU_DATA_MAX_QUARANTINE``) so a
+    relaunch can widen budgets without a code change.
+    """
+
+    def __init__(self, max_skipped_records: Optional[int] = None,
+                 poison_threshold: Optional[int] = None,
+                 max_quarantined_shards: Optional[int] = None,
+                 retry_policy=None):
+        env = os.environ.get
+        if max_skipped_records is None:
+            max_skipped_records = int(env(ENV_MAX_SKIP, "64"))
+        if poison_threshold is None:
+            poison_threshold = int(env(ENV_POISON, "8"))
+        if max_quarantined_shards is None:
+            max_quarantined_shards = int(env(ENV_MAX_QUARANTINE, "1"))
+        if max_skipped_records < 0 or poison_threshold < 1 \
+                or max_quarantined_shards < 0:
+            raise ValueError("budgets must be >= 0 (poison_threshold >= 1)")
+        self.max_skipped_records = max_skipped_records
+        self.poison_threshold = poison_threshold
+        self.max_quarantined_shards = max_quarantined_shards
+        self.retry_policy = retry_policy
+
+    def _retry(self):
+        return self.retry_policy or _retry.default_policy()
+
+
+# -- pipeline-wide counters (mirror retry.stats()) ---------------------------
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+_last_resume: Optional[dict] = None
+
+
+def _count(key: str, n: int = 1):
+    with _lock:
+        _counters[key] = _counters.get(key, 0) + n
+
+
+def note_resume(position: dict):
+    """Record a mid-epoch resume (called by the fit() resume paths)."""
+    global _last_resume
+    with _lock:
+        _counters["resumes"] = _counters.get("resumes", 0) + 1
+        _last_resume = dict(position)
+
+
+def apply_resume_state(train_data, iter_state, logger=None):
+    """Apply a checkpointed iterator state to ``train_data`` for the
+    fit() resume paths; returns ``(begin_epoch, begin_batch)``.
+
+    Degrades instead of dying: when ``train_data`` cannot restore a
+    position, or the restore itself fails (e.g. a checkpointed shard
+    has since vanished), the epoch restarts from batch 0 on the loaded
+    params with a warning — the epoch number still comes from the
+    checkpoint metadata, which needs no iterator support."""
+    import logging as _logging
+    log = logger or _logging
+    epoch = int(iter_state.get("epoch", 0))
+    if not supports_state(train_data):
+        log.warning(
+            "checkpoint carries data-iterator state but train_data (%s) "
+            "cannot restore a position; restarting epoch %d from batch 0",
+            type(train_data).__name__, epoch)
+        return epoch, 0
+    try:
+        train_data.load_state_dict(iter_state["iterator"])
+    except (MXNetError, OSError, RetryExhausted) as err:
+        log.warning(
+            "failed to restore data-iterator state (%s); restarting "
+            "epoch %d from batch 0", err, epoch)
+        try:    # a half-applied restore must not leak into the epoch
+            train_data.reset()
+        except Exception:
+            pass
+        return epoch, 0
+    nbatch = int(iter_state.get("nbatch", 0))
+    note_resume({"epoch": epoch, "nbatch": nbatch})
+    log.info("fit: restored data-iterator state — resuming at epoch %d "
+             "batch %d", epoch, nbatch)
+    return epoch, nbatch
+
+
+def stats() -> dict:
+    """Snapshot of the data-pipeline resilience counters:
+    ``records_skipped``, ``shards_quarantined``, ``resyncs``,
+    ``batches_skipped``, ``resumes``, and ``last_resume`` (the position
+    of the most recent mid-epoch resume, or None)."""
+    with _lock:
+        out = {"records_skipped": 0, "shards_quarantined": 0, "resyncs": 0,
+               "batches_skipped": 0, "resumes": 0}
+        out.update(_counters)
+        out["last_resume"] = dict(_last_resume) if _last_resume else None
+        return out
+
+
+def reset_stats():
+    global _last_resume
+    with _lock:
+        _counters.clear()
+        _last_resume = None
+
+
+# -- shard-level guard -------------------------------------------------------
+
+class ShardSet:
+    """Resilient sequential record reader over ``.rec`` shards.
+
+    ``shards`` is a list of ``.rec`` URIs (or already-open readers with a
+    ``read()`` method — ``close()``/``resync()``/``tell()`` are used when
+    present: a reader without ``resync`` loses the rest of its shard on
+    the first corrupt record, and one without ``tell``/
+    ``load_state_dict`` cannot be position-checkpointed, see
+    :attr:`supports_state`). :meth:`read` returns the next record's bytes, or
+    None once every shard is exhausted. Corrupt records are quarantined
+    and skipped (with a resync to the next record boundary); a shard that
+    fails to open, exhausts its read retries, or crosses
+    ``poison_threshold`` consecutive corrupt records is quarantined whole
+    and reading fails over to the next shard. Budgets come from
+    ``policy`` (:class:`DataGuardPolicy`); exceeding one raises
+    :class:`MXNetError`.
+
+    ``reset()`` starts the next epoch: per-epoch skip counters restart
+    but quarantined shards *stay* quarantined — a poisoned file does not
+    get a second chance to stall epoch N+1.
+    """
+
+    def __init__(self, shards, policy: Optional[DataGuardPolicy] = None):
+        if isinstance(shards, (str, os.PathLike)) \
+                or hasattr(shards, "read"):    # a single reader instance
+            shards = [shards]
+        self._shards: List = list(shards)
+        if not self._shards:
+            raise MXNetError("ShardSet needs at least one shard")
+        self.policy = policy or DataGuardPolicy()
+        self._cur = 0               # index into self._shards
+        self._reader = None
+        self._quarantined: set = set()   # shard indices
+        self._skipped = 0           # per-epoch quarantined records
+        self._consec = 0            # consecutive failures in current shard
+        self._epoch = 0
+
+    # readers -----------------------------------------------------------
+
+    def _uri(self, i) -> str:
+        s = self._shards[i]
+        return getattr(s, "uri", None) or str(s)
+
+    def _open(self, i):
+        """Open shard ``i``; transient faults retry inside
+        ``MXRecordIO.open`` (the ``io.open_shard`` site)."""
+        s = self._shards[i]
+        if hasattr(s, "read"):
+            if not getattr(s, "is_open", True):
+                s.open()
+            return s
+        from ..recordio import MXRecordIO
+        return MXRecordIO(str(s), "r")
+
+    def poison_current(self, why):
+        """Quarantine the shard currently being read (called by decode
+        stages — e.g. :class:`RecordIter` — when consecutive undecodable
+        records cross the poison threshold; framing-level corruption is
+        handled internally by :meth:`read`)."""
+        if self._cur < len(self._shards):
+            self._quarantine_shard(self._cur, why)
+
+    @staticmethod
+    def _close_reader(reader):
+        try:
+            if hasattr(reader, "close"):
+                reader.close()
+        except Exception:       # a half-dead handle must not mask the
+            pass                # failure being handled
+
+    def _quarantine_shard(self, i, why):
+        import logging
+        if i not in self._quarantined:
+            self._quarantined.add(i)
+            _count("shards_quarantined")
+            logging.warning("quarantining shard %s: %s", self._uri(i), why)
+        if self._reader is not None:
+            self._close_reader(self._reader)
+            self._reader = None
+        self._consec = 0
+        self._cur = i + 1
+        if len(self._quarantined) > self.policy.max_quarantined_shards:
+            raise DataBudgetExceeded(
+                f"quarantined {len(self._quarantined)} shard(s), over the "
+                f"max_quarantined_shards={self.policy.max_quarantined_shards}"
+                f" budget; last: {self._uri(i)} ({why}) — refusing to "
+                "continue silently, widen DataGuardPolicy or fix the data")
+
+    def _skip_record(self, why):
+        self._skipped += 1
+        self._consec += 1
+        _count("records_skipped")
+        if self._skipped > self.policy.max_skipped_records:
+            raise DataBudgetExceeded(
+                f"skipped {self._skipped} corrupt records this epoch, over "
+                f"the max_skipped_records={self.policy.max_skipped_records} "
+                f"budget; last: {why} — refusing to continue silently, "
+                "widen DataGuardPolicy or fix the data")
+
+    def read(self) -> Optional[bytes]:
+        """Next record's bytes, or None when every shard is exhausted."""
+        while self._cur < len(self._shards):
+            i = self._cur
+            if i in self._quarantined:
+                self._cur += 1
+                continue
+            if self._reader is None:
+                try:
+                    # transient open faults retry *inside* MXRecordIO.open
+                    # (the io.open_shard site, process default policy)
+                    self._reader = self._open(i)
+                except (RetryExhausted, OSError) as err:
+                    self._quarantine_shard(i, f"open failed: {err}")
+                    continue
+                self._consec = 0
+            try:
+                rec = self._reader.read()
+            except MXNetError as err:
+                # corrupt record: quarantine it, resync framing
+                self._skip_record(err)
+                if self._consec >= self.policy.poison_threshold:
+                    self._quarantine_shard(
+                        i, f"{self._consec} consecutive corrupt records "
+                           f"(poison_threshold), last: {err}")
+                    continue
+                # a reader without resync() cannot re-establish framing:
+                # the rest of its shard is abandoned (already counted)
+                if hasattr(self._reader, "resync") and self._reader.resync():
+                    _count("resyncs")
+                else:
+                    self._advance()
+                continue
+            except (RetryExhausted, OSError) as err:
+                # transient reads already retried inside MXRecordIO.read;
+                # exhaustion here is a shard-level failure → fail over
+                self._quarantine_shard(i, f"read retries exhausted: {err}")
+                continue
+            if rec is None:
+                self._advance()
+                continue
+            self._consec = 0
+            return rec
+        return None
+
+    def _advance(self):
+        self.close()
+        self._cur += 1
+        self._consec = 0
+
+    def reset(self):
+        """Start the next epoch at the first non-quarantined shard."""
+        self.close()
+        self._cur = 0
+        self._skipped = 0
+        self._consec = 0
+        self._epoch += 1
+
+    @property
+    def current_index(self) -> int:
+        """Index of the shard the last record came from (consumers like
+        RecordIter use it to scope their own consecutive-failure
+        counters to one shard)."""
+        return self._cur
+
+    def close(self):
+        if self._reader is not None:
+            self._close_reader(self._reader)
+            self._reader = None
+
+    @property
+    def quarantined_uris(self) -> List[str]:
+        return sorted(self._uri(i) for i in self._quarantined)
+
+    # checkpointable state ----------------------------------------------
+
+    @property
+    def supports_state(self) -> bool:
+        """Position snapshots need every reader-instance shard to carry
+        the state protocol itself (URI shards always qualify — they are
+        opened as MXRecordIO)."""
+        return all(not hasattr(s, "read")
+                   or (hasattr(s, "tell") and hasattr(s, "load_state_dict"))
+                   for s in self._shards)
+
+    def state_dict(self) -> dict:
+        pos = 0
+        if self._reader is not None:
+            if not hasattr(self._reader, "tell"):
+                raise MXNetError(
+                    f"shard reader {type(self._reader).__name__} has no "
+                    "tell(); its position cannot be snapshotted")
+            pos = int(self._reader.tell())
+        return {"cur": int(self._cur), "pos": pos,
+                "quarantined": sorted(int(i) for i in self._quarantined),
+                "skipped": int(self._skipped), "epoch": int(self._epoch),
+                "uris": [self._uri(i) for i in range(len(self._shards))]}
+
+    def load_state_dict(self, state: dict):
+        uris = state.get("uris")
+        if uris is not None and list(uris) != \
+                [self._uri(i) for i in range(len(self._shards))]:
+            raise MXNetError(
+                f"ShardSet state was saved for shards {uris!r}; this set "
+                f"reads {[self._uri(i) for i in range(len(self._shards))]!r}")
+        self.close()
+        self._quarantined = set(int(i) for i in state.get("quarantined", ()))
+        self._skipped = int(state.get("skipped", 0))
+        self._epoch = int(state.get("epoch", 0))
+        self._consec = 0
+        self._cur = int(state["cur"])
+        if self._cur < len(self._shards) \
+                and self._cur not in self._quarantined:
+            self._reader = self._open(self._cur)
+            if not hasattr(self._reader, "load_state_dict"):
+                raise MXNetError(
+                    f"shard reader {type(self._reader).__name__} has no "
+                    "load_state_dict(); its position cannot be restored")
+            self._reader.load_state_dict({"pos": int(state.get("pos", 0))})
+
+
+# -- iterator-level guard ----------------------------------------------------
+
+class ResilientIter:
+    """Wrap any ``DataIter`` with quarantine semantics: a batch whose
+    fetch raises :class:`MXNetError` (corrupt input) or a transient
+    ``OSError``/``TimeoutError`` that survived the inner retries is
+    *skipped* under the policy's ``max_skipped_records`` budget;
+    ``poison_threshold`` consecutive failures — or an exhausted budget —
+    escalate to :class:`MXNetError`. ``StopIteration`` and
+    ``InjectedKill`` (any ``BaseException``) propagate untouched.
+
+    Delegates ``provide_data``/``provide_label``/``batch_size`` and the
+    checkpointable-state protocol to the wrapped iterator, so it
+    composes with ``PrefetchingIter`` and mid-epoch resume."""
+
+    def __init__(self, data_iter, policy: Optional[DataGuardPolicy] = None):
+        self._iter = data_iter
+        self.policy = policy or DataGuardPolicy()
+        self._skipped = 0
+        self._consec = 0
+
+    # iteration ---------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        while True:
+            try:
+                batch = self._iter.next()
+            except StopIteration:
+                raise
+            except DataBudgetExceeded:
+                # an inner guard's budget already said stop: absorbing
+                # it as one more skippable batch would keep dropping
+                # data past the hard limit
+                raise
+            except (MXNetError, OSError, TimeoutError,
+                    RetryExhausted) as err:
+                self._skipped += 1
+                self._consec += 1
+                _count("batches_skipped")
+                if self._consec >= self.policy.poison_threshold:
+                    raise DataBudgetExceeded(
+                        f"{self._consec} consecutive batch fetches failed "
+                        f"(poison_threshold); iterator is poisoned, last: "
+                        f"{err}") from err
+                if self._skipped > self.policy.max_skipped_records:
+                    raise DataBudgetExceeded(
+                        f"skipped {self._skipped} batches this epoch, over "
+                        f"the max_skipped_records="
+                        f"{self.policy.max_skipped_records} budget; last: "
+                        f"{err}") from err
+                continue
+            self._consec = 0
+            return batch
+
+    def __next__(self):
+        # same batch-fetch fault site contract as DataIter.__next__
+        from . import guarded_point
+        guarded_point("io.next")
+        return self.next()
+
+    def reset(self):
+        self._skipped = 0
+        self._consec = 0
+        self._iter.reset()
+
+    # delegation --------------------------------------------------------
+
+    @property
+    def batch_size(self):
+        return self._iter.batch_size
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def getdata(self):
+        return self._iter.getdata()
+
+    def getlabel(self):
+        return self._iter.getlabel()
+
+    def getindex(self):
+        return self._iter.getindex()
+
+    def getpad(self):
+        return self._iter.getpad()
+
+    # checkpointable state ----------------------------------------------
+
+    @property
+    def supports_state(self) -> bool:
+        return supports_state(self._iter)
+
+    def enable_state_snapshots(self):
+        """Pass the snapshot-arming signal through to the wrapped
+        iterator (PrefetchingIter needs it before iteration starts)."""
+        if hasattr(self._iter, "enable_state_snapshots"):
+            self._iter.enable_state_snapshots()
+
+    def state_dict(self) -> dict:
+        if not self.supports_state:
+            raise MXNetError(
+                f"wrapped iterator {type(self._iter).__name__} has no "
+                "state_dict(); a ResilientIter snapshot would lose the "
+                "data position")
+        return {"skipped": int(self._skipped),
+                "inner": self._iter.state_dict()}
+
+    def load_state_dict(self, state: dict):
+        if state.get("inner") is None or not self.supports_state:
+            raise MXNetError(
+                "ResilientIter state carries no inner iterator position "
+                "(or the wrapped iterator cannot restore one); refusing "
+                "a resume that would silently replay the epoch head")
+        self._skipped = int(state.get("skipped", 0))
+        self._consec = 0
+        self._iter.load_state_dict(state["inner"])
+
+
+def guard(source, policy: Optional[DataGuardPolicy] = None):
+    """Wrap ``source`` in the matching resilience guard: a ``DataIter``
+    (anything with ``next``/``provide_data``) becomes a
+    :class:`ResilientIter`; a raw RecordIO reader (anything with
+    ``read``), a shard URI, or a list of either becomes a
+    :class:`ShardSet`."""
+    if hasattr(source, "next") or hasattr(source, "provide_data"):
+        return ResilientIter(source, policy=policy)
+    return ShardSet(source, policy=policy)
+
+
+# -- DataIter over guarded shards --------------------------------------------
+
+class RecordIter:
+    """Minimal ``DataIter`` over a :class:`ShardSet` of ``.rec`` shards
+    whose records were packed with :func:`recordio.pack` — an
+    ``IRHeader`` (scalar label) plus a fixed-shape float32 payload.
+    Decode runs behind the ``io.decode`` fault site under the policy's
+    retry policy; a record that fails to decode (truncated payload,
+    wrong size) is quarantined through the shard set's skip budget.
+
+    The pure-python bridge that lets ``Module.fit`` and
+    ``SPMDTrainer.fit`` train straight off (possibly damaged) shards;
+    the image pipeline's ``ImageRecordIter`` remains the production
+    path for images.
+    """
+
+    def __init__(self, shards, data_shape, batch_size,
+                 policy: Optional[DataGuardPolicy] = None,
+                 data_name="data", label_name="softmax_label",
+                 last_batch_handle="discard"):
+        self._shards = shards if isinstance(shards, ShardSet) \
+            else ShardSet(shards, policy=policy)
+        self.policy = self._shards.policy
+        self.batch_size = int(batch_size)
+        self.data_shape = tuple(int(d) for d in data_shape)
+        self.data_name = data_name
+        self.label_name = label_name
+        if last_batch_handle not in ("discard", "pad"):
+            raise MXNetError("last_batch_handle must be 'discard' or 'pad'")
+        self.last_batch_handle = last_batch_handle
+        self._nfloat = 1
+        for d in self.data_shape:
+            self._nfloat *= d
+        # ShardSet.read resets its own consecutive counter on every
+        # successful read, so decode failures need their own: without
+        # it a shard whose records all *read* fine but never decode
+        # could only die on the global skip budget, never fail over.
+        # Scoped per shard (_decode_shard) so a streak straddling a
+        # shard boundary cannot poison the healthy next shard.
+        self._decode_fails = 0
+        self._decode_shard = None
+
+    @property
+    def provide_data(self):
+        from ..io import DataDesc
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        from ..io import DataDesc
+        return [DataDesc(self.label_name, (self.batch_size,))]
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        self._decode_fails = 0
+        self._decode_shard = None
+        self._shards.reset()
+
+    def _decode(self, rec):
+        import numpy as np
+
+        from ..recordio import unpack
+        header, payload = unpack(rec)    # io.decode fault site inside
+        if len(payload) != self._nfloat * 4:
+            raise MXNetError(
+                f"record payload is {len(payload)} bytes, want "
+                f"{self._nfloat * 4} for data_shape {self.data_shape}")
+        data = np.frombuffer(payload, dtype=np.float32) \
+            .reshape(self.data_shape)
+        label = float(header.label) if not hasattr(header.label, "__len__") \
+            else float(header.label[0])
+        return data, label
+
+    def next(self):
+        import numpy as np
+        pol = self.policy._retry()
+        datas, labels = [], []
+        while len(datas) < self.batch_size:
+            rec = self._shards.read()
+            if rec is None:
+                break
+            if self._shards.current_index != self._decode_shard:
+                self._decode_shard = self._shards.current_index
+                self._decode_fails = 0
+            try:
+                # decode is pure → idempotent, so injected/transient
+                # decode faults retry the whole call
+                data, label = pol.call(self._decode, rec,
+                                       label="io.decode")
+            except (MXNetError, RetryExhausted) as err:
+                self._shards._skip_record(f"decode: {err}")
+                self._decode_fails += 1
+                if self._decode_fails >= self.policy.poison_threshold:
+                    self._shards.poison_current(
+                        f"{self._decode_fails} consecutive undecodable "
+                        f"records (poison_threshold), last: {err}")
+                    self._decode_fails = 0
+                continue
+            self._decode_fails = 0
+            datas.append(data)
+            labels.append(label)
+        if not datas:
+            raise StopIteration
+        pad = self.batch_size - len(datas)
+        if pad and self.last_batch_handle == "discard":
+            raise StopIteration
+        if pad:
+            datas.extend([datas[-1]] * pad)
+            labels.extend([labels[-1]] * pad)
+        from ..io import DataBatch
+        from ..ndarray import array as nd_array
+        return DataBatch(
+            data=[nd_array(np.stack(datas))],
+            label=[nd_array(np.asarray(labels, np.float32))], pad=pad,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+    def __next__(self):
+        # same batch-fetch fault site contract as DataIter.__next__
+        from . import guarded_point
+        guarded_point("io.next")
+        return self.next()
+
+    @property
+    def quarantined_uris(self):
+        return self._shards.quarantined_uris
+
+    # checkpointable state ----------------------------------------------
+
+    @property
+    def supports_state(self) -> bool:
+        return self._shards.supports_state
+
+    def state_dict(self) -> dict:
+        return {"shards": self._shards.state_dict()}
+
+    def load_state_dict(self, state: dict):
+        self._shards.load_state_dict(state["shards"])

@@ -39,6 +39,9 @@ __all__ = ["FaultPlan", "InjectedFault", "InjectedTimeout", "InjectedKill",
 SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          "kvstore.push", "kvstore.pull", "kvstore.barrier", "io.next",
          "trainer.step",
+         # data pipeline (recordio.py + resilience/data.py,
+         # docs/how_to/data_resilience.md)
+         "io.open_shard", "io.read_record", "io.decode",
          # serving runtime (mxnet_tpu/serving, docs/how_to/serving.md)
          "serving.forward", "serving.load", "serving.queue")
 

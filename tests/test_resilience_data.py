@@ -1,0 +1,1000 @@
+"""Resilient data pipeline (mxnet_tpu/resilience/data.py).
+
+Corrupt-shard goldens (bad magic, truncated payload, truncated split
+record, poisoned index) prove quarantine-then-continue under bounded
+skip budgets, poison-threshold shard failover, and escalation to
+MXNetError when a budget is exhausted — silent data loss is impossible.
+The fault sites ``io.open_shard`` / ``io.read_record`` / ``io.decode``
+retry transient failures with zero real sleeps (fake clock), and
+checkpointable iterator state gives ``fit(resume='auto')`` a
+bitwise-identical mid-epoch resume, shuffled iterators included.
+"""
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import NDArrayIter, PrefetchingIter, ResizeIter
+from mxnet_tpu.resilience import (DataGuardPolicy, FaultPlan, InjectedKill,
+                                  RecordIter, RetryPolicy, ShardSet, faults,
+                                  guard, retry)
+from mxnet_tpu.resilience import data as rdata
+from mxnet_tpu.resilience.checkpoint import load_iter_state, verify_manifest
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Disarmed faults, fresh counters, and a fast default retry policy
+    (fake clock, zero real sleeps) for every test."""
+    now = [0.0]
+    faults.disarm()
+    resilience.reset_stats()
+    retry.set_default_policy(RetryPolicy(
+        max_retries=3, base_delay=0.01, jitter=0.0,
+        clock=lambda: now[0],
+        sleep=lambda s: now.__setitem__(0, now[0] + s)))
+    yield
+    faults.disarm()
+    resilience.reset_stats()
+    retry.set_default_policy(None)
+
+
+DIM = 4                       # floats per record payload
+
+
+def _write_shard(path, labels, dim=DIM, seed=0):
+    """A .rec shard of pack()ed float32 records, one per label."""
+    rng = np.random.RandomState(seed)
+    w = recordio.MXRecordIO(str(path), "w")
+    payloads = []
+    for i, lab in enumerate(labels):
+        vec = rng.randn(dim).astype(np.float32)
+        payloads.append(vec)
+        w.write(recordio.pack(recordio.IRHeader(0, float(lab), i, 0),
+                              vec.tobytes()))
+    w.close()
+    return payloads
+
+
+def _read_all(ss):
+    out = []
+    while True:
+        rec = ss.read()
+        if rec is None:
+            return out
+        out.append(rec)
+
+
+def _record_offsets(path):
+    """Start offsets of every record in a healthy shard."""
+    r = recordio.MXRecordIO(str(path), "r")
+    offs = []
+    while True:
+        pos = r.tell()
+        if r.read() is None:
+            break
+        offs.append(pos)
+    r.close()
+    return offs
+
+
+def _corrupt(path, offset, flip=0xFF):
+    blob = bytearray(open(path, "rb").read())
+    blob[offset] ^= flip
+    open(path, "wb").write(bytes(blob))
+
+
+def _poison_lengths(path, offsets):
+    """Give records at ``offsets`` a garbage length field (magic stays
+    valid): each read fails 'truncated record' and resync lands on the
+    next record's boundary — the consecutive-failure pattern the poison
+    threshold exists for."""
+    blob = bytearray(open(path, "rb").read())
+    for off in offsets:
+        blob[off + 4:off + 8] = struct.pack("<I", (1 << 29) - 1)
+    open(path, "wb").write(bytes(blob))
+
+
+# -- satellite: truncated unpack raises MXNetError ---------------------------
+
+def test_unpack_truncated_header_raises_mxneterror():
+    with pytest.raises(MXNetError, match="shorter than the .*IRHeader"):
+        recordio.unpack(b"\x01\x02\x03")
+
+
+def test_unpack_truncated_label_payload_raises_mxneterror():
+    label = np.arange(5, dtype=np.float32)
+    s = recordio.pack(recordio.IRHeader(0, label, 1, 0), b"img")
+    # drop the tail so the declared 5-label payload cannot be satisfied
+    with pytest.raises(MXNetError, match="declares 5 labels"):
+        recordio.unpack(s[:recordio._IR_SIZE + 8])
+
+
+def test_unpack_img_corrupt_payload_raises_mxneterror():
+    s = recordio.pack(recordio.IRHeader(0, 1.0, 0, 0), b"\x00not-an-image")
+    with pytest.raises(MXNetError, match="corrupt image payload"):
+        recordio.unpack_img(s)
+
+
+# -- satellite: indexed reader error surface ---------------------------------
+
+def test_read_idx_unknown_key_raises_mxneterror(tmp_path):
+    frec, fidx = str(tmp_path / "a.rec"), str(tmp_path / "a.idx")
+    w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    w.write_idx(0, b"rec0")
+    w.close()
+    r = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    with pytest.raises(MXNetError, match="key 99 not in index for"):
+        r.read_idx(99)
+    r.close()
+
+
+def test_malformed_idx_line_raises_mxneterror(tmp_path):
+    frec, fidx = str(tmp_path / "a.rec"), str(tmp_path / "a.idx")
+    w = recordio.MXRecordIO(frec, "w")
+    w.write(b"rec0")
+    w.close()
+    with open(fidx, "w") as f:
+        f.write("0\t0\nnot-a-key-offset-pair\n")
+    with pytest.raises(MXNetError, match="malformed index line 2"):
+        recordio.MXIndexedRecordIO(fidx, frec, "r")
+
+
+def test_poisoned_index_offset_raises_then_quarantines(tmp_path):
+    """An index entry pointing mid-record yields a bad-magic MXNetError;
+    the same shard read sequentially through guard() survives."""
+    frec, fidx = str(tmp_path / "a.rec"), str(tmp_path / "a.idx")
+    w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(4):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    # poison key 2's offset to point inside record 1
+    lines = open(fidx).read().splitlines()
+    k, off = lines[2].split("\t")
+    lines[2] = f"{k}\t{int(off) - 2}"
+    open(fidx, "w").write("\n".join(lines) + "\n")
+    r = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    assert r.read_idx(1) == b"rec1"
+    with pytest.raises(MXNetError, match="invalid record magic"):
+        r.read_idx(2)
+    r.close()
+    # sequential access through the guard still sees every record —
+    # wrapping either a URI or an open reader instance
+    assert _read_all(guard(str(frec))) == [b"rec0", b"rec1", b"rec2",
+                                           b"rec3"]
+    assert _read_all(guard(recordio.MXRecordIO(frec, "r"))) == [
+        b"rec0", b"rec1", b"rec2", b"rec3"]
+
+
+# -- corrupt-shard goldens: quarantine then continue -------------------------
+
+def test_bad_magic_record_quarantined_and_stream_continues(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, [0, 1, 2, 3, 4])
+    offs = _record_offsets(p)
+    _corrupt(p, offs[2])          # flip a magic byte of record 2
+    ss = ShardSet([p], policy=DataGuardPolicy(max_skipped_records=4))
+    recs = _read_all(ss)
+    assert len(recs) == 4         # record 2 quarantined, rest intact
+    st = rdata.stats()
+    assert st["records_skipped"] == 1
+    assert st["resyncs"] == 1
+    assert st["shards_quarantined"] == 0
+
+
+def test_truncated_payload_at_eof_quarantined(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, [0, 1, 2])
+    blob = open(p, "rb").read()
+    open(p, "wb").write(blob[:-6])     # tear the last record's payload
+    recs = _read_all(ShardSet([p]))
+    assert len(recs) == 2
+    assert rdata.stats()["records_skipped"] == 1
+
+
+def test_truncated_split_record_quarantined(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, [0, 1])
+    with open(p, "ab") as f:           # a split record that never ends:
+        f.write(struct.pack("<II", 0xCED7230A, (1 << 29) | 4))  # cflag=1
+        f.write(b"part")
+    recs = _read_all(ShardSet([p]))
+    assert len(recs) == 2
+    assert rdata.stats()["records_skipped"] == 1
+
+
+def test_skip_budget_exhaustion_escalates(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, [0, 1, 2, 3])
+    offs = _record_offsets(p)
+    _corrupt(p, offs[1])
+    _corrupt(p, offs[3])
+    ss = ShardSet([p], policy=DataGuardPolicy(max_skipped_records=1,
+                                              poison_threshold=10))
+    with pytest.raises(MXNetError, match="over the max_skipped_records=1"):
+        _read_all(ss)
+
+
+def test_poison_threshold_quarantines_shard_and_fails_over(tmp_path):
+    bad, good = str(tmp_path / "bad.rec"), str(tmp_path / "good.rec")
+    _write_shard(bad, [9, 9, 9, 9, 9])
+    _poison_lengths(bad, _record_offsets(bad)[:3])
+    _write_shard(good, [0, 1, 2])
+    ss = ShardSet([bad, good],
+                  policy=DataGuardPolicy(max_skipped_records=50,
+                                         poison_threshold=3,
+                                         max_quarantined_shards=1))
+    recs = _read_all(ss)
+    assert len(recs) == 3                     # failover reached good.rec
+    st = rdata.stats()
+    assert st["shards_quarantined"] == 1
+    assert ss.quarantined_uris == [bad]
+
+
+def test_garbage_shard_exhausts_after_failed_resync(tmp_path):
+    """Pure garbage: one skip, resync finds no boundary, the shard set
+    moves on to the next shard instead of spinning."""
+    bad, good = str(tmp_path / "bad.rec"), str(tmp_path / "good.rec")
+    open(bad, "wb").write(b"\x00garbage" * 32)
+    _write_shard(good, [0, 1, 2])
+    ss = ShardSet([bad, good])
+    assert len(_read_all(ss)) == 3
+    assert rdata.stats()["records_skipped"] == 1
+
+
+def test_max_quarantined_shards_escalates(tmp_path):
+    shards = []
+    for name in ("a.rec", "b.rec"):
+        p = str(tmp_path / name)
+        _write_shard(p, [9, 9, 9])
+        _poison_lengths(p, _record_offsets(p)[:2])
+        shards.append(p)
+    ss = ShardSet(shards, policy=DataGuardPolicy(max_skipped_records=100,
+                                                 poison_threshold=2,
+                                                 max_quarantined_shards=1))
+    with pytest.raises(MXNetError,
+                       match="over the max_quarantined_shards=1"):
+        _read_all(ss)
+
+
+def test_quarantined_shard_stays_quarantined_across_reset(tmp_path):
+    bad, good = str(tmp_path / "bad.rec"), str(tmp_path / "good.rec")
+    _write_shard(bad, [9, 9, 9])
+    _poison_lengths(bad, _record_offsets(bad)[:2])
+    _write_shard(good, [0, 1])
+    ss = ShardSet([bad, good],
+                  policy=DataGuardPolicy(poison_threshold=2,
+                                         max_quarantined_shards=1))
+    assert len(_read_all(ss)) == 2
+    assert rdata.stats()["shards_quarantined"] == 1
+    ss.reset()
+    assert len(_read_all(ss)) == 2   # epoch 2 skips bad.rec outright
+    assert rdata.stats()["shards_quarantined"] == 1
+
+
+# -- fault sites: retry with zero real sleeps --------------------------------
+
+def test_open_shard_transient_fault_retries(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, [0, 1])
+    faults.arm(FaultPlan().arm("io.open_shard", nth=1, exc="ioerror"))
+    assert len(_read_all(ShardSet([p]))) == 2
+    assert retry.stats()["retries"].get("io.open_shard", 0) >= 1
+
+
+def test_open_shard_missing_file_fails_over(tmp_path):
+    good = str(tmp_path / "good.rec")
+    _write_shard(good, [0, 1, 2])
+    ss = ShardSet([str(tmp_path / "nope.rec"), good],
+                  policy=DataGuardPolicy(max_quarantined_shards=1))
+    assert len(_read_all(ss)) == 3
+    assert rdata.stats()["shards_quarantined"] == 1
+
+
+def test_read_record_transient_fault_retries_without_skipping(tmp_path):
+    p = str(tmp_path / "a.rec")
+    payloads = _write_shard(p, [0, 1, 2, 3])
+    faults.arm(FaultPlan().arm("io.read_record", nth=2, exc="ioerror",
+                               count=2))
+    recs = _read_all(ShardSet([p]))
+    # the seek-back retry re-reads the same record: nothing skipped,
+    # nothing duplicated
+    assert recs == [
+        recordio.pack(recordio.IRHeader(0, float(i), i, 0), v.tobytes())
+        for i, v in enumerate(payloads)]
+    assert rdata.stats()["records_skipped"] == 0
+    assert retry.stats()["retries"].get("io.read_record", 0) >= 2
+
+
+def test_read_record_retry_exhaustion_quarantines_shard(tmp_path):
+    bad, good = str(tmp_path / "bad.rec"), str(tmp_path / "good.rec")
+    _write_shard(bad, [0, 1])
+    _write_shard(good, [2, 3])
+    # exactly 1 attempt + 3 retries: bad.rec's first read exhausts the
+    # policy; good.rec then reads clean
+    faults.arm(FaultPlan().arm("io.read_record", nth=1, exc="ioerror",
+                               count=4))
+    ss = ShardSet([bad, good],
+                  policy=DataGuardPolicy(max_quarantined_shards=2))
+    recs = _read_all(ss)
+    assert len(recs) == 2            # failed over mid-shard to good.rec
+    assert rdata.stats()["shards_quarantined"] == 1
+
+
+def test_decode_fault_retries_and_recorditer_yields(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, [0, 1, 2, 3, 4, 5])
+    faults.arm(FaultPlan().arm("io.decode", nth=2, exc="ioerror"))
+    it = RecordIter([p], data_shape=(DIM,), batch_size=3)
+    batches = list(it)
+    assert len(batches) == 2
+    assert retry.stats()["retries"].get("io.decode", 0) >= 1
+    assert rdata.stats()["records_skipped"] == 0
+
+
+def test_decode_fail_streak_does_not_poison_across_shard_boundary(
+        tmp_path):
+    """Consecutive decode failures straddling a shard boundary must not
+    quarantine the healthy next shard — the counter is per shard."""
+    a, b = str(tmp_path / "a.rec"), str(tmp_path / "b.rec")
+    wa = recordio.MXRecordIO(a, "w")
+    for i in range(2):   # shard A *ends* with undecodable payloads
+        wa.write(recordio.pack(recordio.IRHeader(0, 0.0, i, 0), b"xy"))
+    wa.close()
+    wb = recordio.MXRecordIO(b, "w")   # shard B *starts* with one more
+    wb.write(recordio.pack(recordio.IRHeader(0, 0.0, 9, 0), b"xy"))
+    wb.close()
+    _write_shard(b + ".good", [0, 1, 2])
+    it = RecordIter(
+        ShardSet([a, b, b + ".good"],
+                 policy=DataGuardPolicy(max_skipped_records=50,
+                                        poison_threshold=3,
+                                        max_quarantined_shards=0)),
+        data_shape=(DIM,), batch_size=3)
+    # 3 undecodable records total (2 in A + 1 in B) — a cross-shard
+    # streak of 3 would poison and escalate; per-shard scoping must not
+    assert len(list(it)) == 1
+    assert rdata.stats()["shards_quarantined"] == 0
+
+
+def test_long_epoch_holds_at_most_one_mid_epoch_checkpoint(tmp_path):
+    """Superseded mid-epoch stems are rolled after each save, so a
+    killed run leaves exactly one mid-epoch checkpoint on disk."""
+    from mxnet_tpu.resilience.checkpoint import (MID_EPOCH_STRIDE,
+                                                 find_checkpoints)
+    prefix = str(tmp_path / "run")
+    np.random.seed(0)
+    mx.random.seed(0)
+    victim = mx.mod.Module(_mlp(), context=mx.cpu())
+    # epoch 1 sees mid-epoch saves at nbatch 1 and 3 before the kill
+    faults.arm(FaultPlan().arm("io.next", nth=12, exc="kill"))
+    with pytest.raises(InjectedKill):
+        _fit(victim, [], prefix=prefix)
+    faults.disarm()
+    mids = [e for e in find_checkpoints(prefix)
+            if e is not None and e >= MID_EPOCH_STRIDE]
+    assert len(mids) == 1
+
+
+def test_recorditer_quarantines_undecodable_record(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, [0, 1, 2, 3])
+    # append a record whose payload is NOT a DIM-float vector: framing is
+    # intact (read succeeds) but decode must quarantine it
+    extra = recordio.pack(recordio.IRHeader(0, 9.0, 9, 0), b"\x01\x02")
+    with open(p, "ab") as f:
+        f.write(struct.pack("<II", 0xCED7230A, len(extra)))
+        f.write(extra + b"\x00" * ((4 - len(extra) % 4) % 4))
+    it = RecordIter([p], data_shape=(DIM,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    assert rdata.stats()["records_skipped"] == 1
+
+
+# -- guarded DataIter + prefetching ------------------------------------------
+
+class _FlakyIter:
+    """A DataIter whose Nth fetches raise MXNetError (corrupt input)."""
+
+    def __init__(self, n=8, batch_size=2, fail_at=(2, 3)):
+        self._inner = NDArrayIter(np.arange(n * DIM, dtype=np.float32)
+                                  .reshape(n, DIM),
+                                  np.zeros(n, np.float32),
+                                  batch_size=batch_size)
+        self.batch_size = batch_size
+        self.fail_at = set(fail_at)
+        self._calls = 0
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._calls = 0
+        self._inner.reset()
+
+    def next(self):
+        self._calls += 1
+        batch = self._inner.next()   # advance even when we then "corrupt"
+        if self._calls in self.fail_at:
+            raise MXNetError(f"corrupt batch #{self._calls}")
+        return batch
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+
+def test_resilient_iter_skips_corrupt_batches_under_budget():
+    it = guard(_FlakyIter(), DataGuardPolicy(max_skipped_records=4,
+                                             poison_threshold=4))
+    assert len(list(it)) == 2
+    assert rdata.stats()["batches_skipped"] == 2
+
+
+def test_resilient_iter_poison_threshold_escalates():
+    it = guard(_FlakyIter(fail_at=(1, 2, 3)),
+               DataGuardPolicy(max_skipped_records=50, poison_threshold=3))
+    with pytest.raises(MXNetError, match="poisoned"):
+        list(it)
+
+
+def test_resilient_iter_reraises_inner_budget_escalation():
+    """Once an inner guard's budget says stop, an outer guard must not
+    absorb that as one more skippable batch."""
+    from mxnet_tpu.resilience import DataBudgetExceeded
+
+    class _ExhaustedInner(_FlakyIter):
+        def next(self):
+            self._calls += 1
+            if self._calls >= 2:
+                raise DataBudgetExceeded("inner budget exhausted")
+            return self._inner.next()
+
+    it = guard(_ExhaustedInner(),
+               DataGuardPolicy(max_skipped_records=50, poison_threshold=50))
+    with pytest.raises(DataBudgetExceeded, match="inner budget"):
+        list(it)
+    assert rdata.stats()["batches_skipped"] == 0
+
+
+def test_resume_degrades_when_checkpointed_shard_vanished(tmp_path):
+    """fit(resume='auto') over a shard that disappeared after the
+    checkpoint restarts the epoch with a warning instead of crashing
+    (the shard then quarantines on first read)."""
+    from mxnet_tpu.resilience.data import apply_resume_state
+    a, b = str(tmp_path / "a.rec"), str(tmp_path / "b.rec")
+    _write_shard(a, [0, 1, 2])
+    _write_shard(b, [3, 4])
+    ss = ShardSet([a, b], policy=DataGuardPolicy(max_quarantined_shards=1))
+    ss.read()
+    state = {"epoch": 1, "nbatch": 1, "iterator": ss.state_dict()}
+    ss.close()
+    os.remove(a)
+    fresh = ShardSet([a, b],
+                     policy=DataGuardPolicy(max_quarantined_shards=1))
+    epoch, nbatch = apply_resume_state(fresh, state)
+    assert (epoch, nbatch) == (1, 0)      # degraded to epoch start
+    assert len(_read_all(fresh)) == 2     # b.rec via quarantine failover
+
+
+def test_ndarray_iter_load_state_validates_shape_and_shuffle():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    src = NDArrayIter(X, batch_size=2, shuffle=True, seed=1)
+    state = src.state_dict()
+    small = NDArrayIter(X[:6], batch_size=2, shuffle=True, seed=1)
+    with pytest.raises(MXNetError, match="same data"):
+        small.load_state_dict(state)
+    unshuffled = NDArrayIter(X, batch_size=2, shuffle=False)
+    with pytest.raises(MXNetError, match="shuffle mode mismatch"):
+        unshuffled.load_state_dict(state)
+
+
+def test_resilient_iter_budget_escalates():
+    it = guard(_FlakyIter(fail_at=(1, 3)),
+               DataGuardPolicy(max_skipped_records=1, poison_threshold=5))
+    with pytest.raises(MXNetError, match="over the max_skipped_records=1"):
+        list(it)
+
+
+def test_prefetching_iter_over_guarded_iter_survives_mid_shard_fault(
+        tmp_path):
+    """The whole stack: corrupt record mid-shard + a transient read
+    fault, read through RecordIter → guard() → PrefetchingIter, with
+    zero real sleeps."""
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, list(range(8)))
+    offs = _record_offsets(p)
+    _corrupt(p, offs[3])
+    faults.arm(FaultPlan().arm("io.read_record", nth=5, exc="ioerror"))
+    it = PrefetchingIter(guard(RecordIter([p], data_shape=(DIM,),
+                                          batch_size=2)))
+    batches = list(it)
+    assert len(batches) == 3          # 7 good records -> 3 full batches
+    st = rdata.stats()
+    assert st["records_skipped"] == 1
+    assert retry.stats()["retries"].get("io.read_record", 0) >= 1
+
+
+# -- checkpointable iterator state -------------------------------------------
+
+def _drain(it, n=None):
+    out = []
+    for batch in it:
+        out.append(batch.data[0].asnumpy().tobytes())
+        if n is not None and len(out) == n:
+            break
+    return out
+
+
+def test_ndarray_iter_state_roundtrip_shuffled():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    a = NDArrayIter(X, batch_size=2, shuffle=True, seed=7)
+    got = _drain(a, 2)
+    state = a.state_dict()
+    rest_a = _drain(a)           # remaining this epoch
+    a.reset()
+    next_epoch_a = _drain(a)
+
+    b = NDArrayIter(X, batch_size=2, shuffle=True, seed=99)  # wrong seed
+    b.load_state_dict(state)     # ...fixed by the restored state
+    assert _drain(b) == rest_a
+    b.reset()
+    assert _drain(b) == next_epoch_a
+    assert json.loads(json.dumps(state)) == state   # JSON-serializable
+
+
+class _StatelessIter:
+    """A DataIter-shaped source with no state protocol."""
+
+    def __init__(self, n=6, batch_size=2):
+        self._inner = NDArrayIter(np.zeros((n, DIM), np.float32),
+                                  batch_size=batch_size)
+        self.batch_size = batch_size
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+
+def test_wrappers_over_stateless_source_refuse_to_snapshot():
+    """A wrapper must not claim a position it cannot restore: fit()'s
+    supports_state gate skips it, and a direct state_dict() raises
+    instead of silently writing a useless snapshot."""
+    from mxnet_tpu.resilience.data import supports_state
+    for wrapper in (ResizeIter(_StatelessIter(), size=2),
+                    guard(_StatelessIter())):
+        assert not supports_state(wrapper)
+        with pytest.raises(MXNetError, match="no state_dict"):
+            wrapper.state_dict()
+    # PrefetchingIter still prefetches fine over a stateless source
+    pf = PrefetchingIter(_StatelessIter())
+    assert not supports_state(pf)
+    assert len(list(pf)) == 3
+
+
+def test_ndarray_iter_shuffle_reproducible_from_global_seed():
+    """np.random.seed(0) before construction keeps giving the same
+    shuffle order (the owned RNG draws its seed from the global
+    stream), so pre-existing reproduction recipes keep reproducing."""
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    np.random.seed(123)
+    a = _drain(NDArrayIter(X, batch_size=2, shuffle=True))
+    np.random.seed(123)
+    b = _drain(NDArrayIter(X, batch_size=2, shuffle=True))
+    assert a == b
+
+
+def test_decode_poison_threshold_fails_over_shard(tmp_path):
+    """A shard whose records read fine but never decode must poison at
+    the threshold and fail over, not bleed the whole skip budget."""
+    bad, good = str(tmp_path / "bad.rec"), str(tmp_path / "good.rec")
+    w = recordio.MXRecordIO(bad, "w")
+    for i in range(6):   # framing-valid records with undecodable payload
+        w.write(recordio.pack(recordio.IRHeader(0, 0.0, i, 0), b"xy"))
+    w.close()
+    _write_shard(good, [0, 1, 2, 3])
+    it = RecordIter(
+        ShardSet([bad, good],
+                 policy=DataGuardPolicy(max_skipped_records=50,
+                                        poison_threshold=3,
+                                        max_quarantined_shards=1)),
+        data_shape=(DIM,), batch_size=2)
+    assert len(list(it)) == 2         # good.rec's 4 records
+    st = rdata.stats()
+    assert st["shards_quarantined"] == 1
+    assert st["records_skipped"] == 3  # poisoned at the threshold
+
+
+def test_corrupt_iter_state_degrades_to_epoch_start_resume(tmp_path,
+                                                           monkeypatch):
+    """A valid params checkpoint whose iterator state turns out
+    unreadable (post-verification race) resumes at the epoch start
+    instead of throwing the verified checkpoint away."""
+    from mxnet_tpu.resilience import CheckpointCorrupt
+    from mxnet_tpu.resilience import checkpoint as rckpt
+
+    prefix = str(tmp_path / "run")
+    np.random.seed(0)
+    mx.random.seed(0)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    _fit(mod, [], prefix=prefix)
+    ref = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    def boom(prefix_, epoch_):
+        raise CheckpointCorrupt("iter state unreadable (test)")
+
+    monkeypatch.setattr(rckpt, "load_iter_state", boom)
+    resumed = mx.mod.Module(_mlp(), context=mx.cpu())
+    resumed.fit(_blob_iter(), num_epoch=3, optimizer="sgd",
+                checkpoint_prefix=prefix, resume="auto")
+    got = {k: v.asnumpy() for k, v in resumed.get_params()[0].items()}
+    # epoch 3 == num_epoch: nothing left to train, params unchanged —
+    # proving the valid checkpoint was restored, not discarded
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+
+
+def test_resize_iter_state_roundtrip():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    a = ResizeIter(NDArrayIter(X, batch_size=2), size=3)
+    _drain(a, 1)
+    state = a.state_dict()
+    rest = _drain(a)
+    b = ResizeIter(NDArrayIter(X, batch_size=2), size=3)
+    b.load_state_dict(state)
+    assert _drain(b) == rest
+
+
+def test_recordio_reader_state_roundtrip(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_shard(p, [0, 1, 2, 3])
+    r = recordio.MXRecordIO(p, "r")
+    first = r.read()
+    state = r.state_dict()
+    rest = [r.read(), r.read(), r.read()]
+    r.close()
+    r2 = recordio.MXRecordIO(p, "r")
+    r2.load_state_dict(state)
+    assert [r2.read(), r2.read(), r2.read()] == rest
+    assert r2.read() is None
+    assert first is not None
+    r2.close()
+
+
+def test_shardset_state_roundtrip_mid_shard(tmp_path):
+    p1, p2 = str(tmp_path / "a.rec"), str(tmp_path / "b.rec")
+    _write_shard(p1, [0, 1, 2])
+    _write_shard(p2, [3, 4])
+    a = ShardSet([p1, p2])
+    seen = [a.read(), a.read()]
+    state = a.state_dict()
+    rest_a = _read_all(a)
+    b = ShardSet([p1, p2])
+    b.load_state_dict(state)
+    assert _read_all(b) == rest_a
+    assert len(seen) + len(rest_a) == 5
+    assert json.loads(json.dumps(state)) == state
+
+
+def test_prefetching_iter_state_accounts_for_prefetch_offset():
+    """The producer races one batch ahead; state_dict() must return the
+    pre-fetch snapshot of the staged batch so a restore replays it."""
+    X = np.arange(48, dtype=np.float32).reshape(12, 4)
+    ref = _drain(NDArrayIter(X, batch_size=2, shuffle=True, seed=5))
+
+    a = PrefetchingIter(NDArrayIter(X, batch_size=2, shuffle=True, seed=5))
+    a.enable_state_snapshots()      # fit() does this when checkpointing
+    got = _drain(a, 2)
+    assert got == ref[:2]
+    state = a.state_dict()
+
+    b = PrefetchingIter(NDArrayIter(X, batch_size=2, shuffle=True, seed=5))
+    b.load_state_dict(state)
+    assert _drain(b) == ref[2:]
+
+
+def test_prefetching_iter_snapshots_disarmed_by_default():
+    """Per-prefetch snapshots cost O(dataset) each, so they stay off
+    until armed — a disarmed state_dict() refuses loudly."""
+    pf = PrefetchingIter(NDArrayIter(np.zeros((8, 4), np.float32),
+                                     batch_size=2))
+    _drain(pf, 1)
+    with pytest.raises(MXNetError, match="disarmed"):
+        pf.state_dict()
+
+
+def test_resilient_iter_skips_retry_exhausted_fetches():
+    """A transient failure that outlives the inner retries surfaces as
+    RetryExhausted — the guard must quarantine it like any other
+    transient, not crash the run."""
+    from mxnet_tpu.resilience import RetryExhausted
+
+    class _ExhaustedIter(_FlakyIter):
+        def next(self):
+            self._calls += 1
+            batch = self._inner.next()
+            if self._calls in self.fail_at:
+                raise RetryExhausted("io.read_record: gave up")
+            return batch
+
+    it = guard(_ExhaustedIter(fail_at=(2,)),
+               DataGuardPolicy(max_skipped_records=4, poison_threshold=4))
+    assert len(list(it)) == 3
+    assert rdata.stats()["batches_skipped"] == 1
+
+
+def test_shardset_minimal_duck_reader_quarantines_without_resync():
+    """A reader exposing only read() (no close/resync/tell) must not
+    crash the guard: corrupt record -> rest of shard abandoned, EOF ->
+    clean failover."""
+    class _MinimalReader:
+        uri = "<duck>"
+
+        def __init__(self):
+            self._recs = [b"ok0", MXNetError("corrupt"), b"never"]
+
+        def read(self):
+            if not self._recs:
+                return None
+            item = self._recs.pop(0)
+            if isinstance(item, Exception):
+                raise item
+            return item
+
+    ss = ShardSet([_MinimalReader()],
+                  policy=DataGuardPolicy(max_skipped_records=4))
+    assert _read_all(ss) == [b"ok0"]
+    assert rdata.stats()["records_skipped"] == 1
+    assert not ss.supports_state
+
+
+# -- mid-epoch resume: bitwise-identical batch stream ------------------------
+
+def _mlp(nclass=3):
+    from mxnet_tpu import sym
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=nclass)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _blob_iter(seed=42):
+    rng = np.random.RandomState(0)
+    X = rng.randn(60, 5).astype(np.float32)
+    y = (np.arange(60) % 3).astype(np.float32)
+    return NDArrayIter(X, y, batch_size=10, shuffle=True, seed=seed)
+
+
+def _recording_cb(rec):
+    def cb(param):
+        batch = param.locals["batch"]
+        rec.append((param.epoch, batch.data[0].asnumpy().tobytes(),
+                    batch.label[0].asnumpy().tobytes()))
+    return cb
+
+
+def _fit(mod, rec, prefix=None, resume=None):
+    mod.fit(_blob_iter(), num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            batch_end_callback=_recording_cb(rec),
+            checkpoint_prefix=prefix, checkpoint_batch_period=2,
+            resume=resume)
+
+
+def test_fit_mid_epoch_kill_then_resume_is_bitwise_identical(tmp_path):
+    """The acceptance scenario: InjectedKill mid-epoch, fit(resume='auto'),
+    and the concatenated post-resume batch stream — shuffled iterator
+    included — is bitwise-identical to an uninterrupted run, as are the
+    final parameters."""
+    prefix = str(tmp_path / "run")
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    ref_mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    ref_stream = []
+    _fit(ref_mod, ref_stream)
+    ref_params = {k: v.asnumpy() for k, v in ref_mod.get_params()[0].items()}
+
+    # kill at the 12th batch fetch: mid-epoch 1, past a mid-epoch
+    # checkpoint boundary (checkpoint_batch_period=2)
+    np.random.seed(0)
+    mx.random.seed(0)
+    victim = mx.mod.Module(_mlp(), context=mx.cpu())
+    faults.arm(FaultPlan().arm("io.next", nth=12, exc="kill"))
+    with pytest.raises(InjectedKill):
+        _fit(victim, [], prefix=prefix)
+    faults.disarm()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    resumed = mx.mod.Module(_mlp(), context=mx.cpu())
+    resumed_stream = []
+    _fit(resumed, resumed_stream, prefix=prefix, resume="auto")
+    got_params = {k: v.asnumpy()
+                  for k, v in resumed.get_params()[0].items()}
+
+    # resumed mid-epoch (not from batch 0 of the epoch)
+    st = rdata.stats()
+    assert st["resumes"] == 1
+    assert st["last_resume"]["nbatch"] > 0
+    # the resumed stream is exactly the tail of the uninterrupted one
+    offset = len(ref_stream) - len(resumed_stream)
+    assert 0 < offset < len(ref_stream)
+    assert ref_stream[offset:] == resumed_stream
+    for k in ref_params:
+        np.testing.assert_array_equal(ref_params[k], got_params[k],
+                                      err_msg=k)
+
+
+def test_mid_epoch_checkpoint_iter_state_is_manifest_covered(tmp_path):
+    prefix = str(tmp_path / "run")
+    np.random.seed(0)
+    mx.random.seed(0)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    _fit(mod, [], prefix=prefix)
+    # completed run: every mid-epoch stem was swept by its epoch-end
+    # checkpoint, so the newest checkpoint is the final epoch-end one
+    from mxnet_tpu.resilience.checkpoint import (MID_EPOCH_STRIDE,
+                                                 find_checkpoints)
+    eps = find_checkpoints(prefix)
+    assert eps and all(e is not None and e < MID_EPOCH_STRIDE
+                       for e in eps)
+    assert eps[0] == 3
+    doc = verify_manifest(prefix, 3)
+    assert "iter" in doc["files"]
+    state = load_iter_state(prefix, 3)
+    assert state["epoch"] == 3 and state["nbatch"] == 0
+    assert "rng0" in state["iterator"]   # O(1) shuffle-replay encoding
+    # a flipped byte in the iterator state fails verification loudly
+    ipath = str(tmp_path / "run-0003.iter.json")
+    _corrupt(ipath, 2)
+    from mxnet_tpu.resilience import CheckpointCorrupt
+    with pytest.raises(CheckpointCorrupt, match="digest mismatch"):
+        verify_manifest(prefix, 3)
+
+
+# -- chaos acceptance --------------------------------------------------------
+
+def test_fit_with_shared_train_eval_iterator_trains_every_epoch(tmp_path):
+    """eval_data is train_data (one shared iterator): eval must consume
+    it before the end-of-epoch reset, or every epoch after the first
+    trains zero batches."""
+    it = _blob_iter()
+    counts = {}
+
+    def cb(param):
+        counts[param.epoch] = counts.get(param.epoch, 0) + 1
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, eval_data=it, num_epoch=3, optimizer="sgd",
+            batch_end_callback=cb,
+            checkpoint_prefix=str(tmp_path / "run"))
+    assert counts == {0: 6, 1: 6, 2: 6}
+
+
+def test_chaos_fit_over_corrupt_shards_completes_within_budget(tmp_path):
+    """Training over a shard set with injected corrupt records and
+    open/read faults completes within the skip budget; stats match the
+    armed plan; exceeding the poison threshold raises MXNetError."""
+    shards = []
+    for s, labels in enumerate(([0, 1, 2, 0, 1, 2], [0, 1, 2, 0, 1, 2])):
+        p = str(tmp_path / f"part-{s}.rec")
+        _write_shard(p, labels, seed=s)
+        shards.append(p)
+    offs = _record_offsets(shards[0])
+    _corrupt(shards[0], offs[2])      # one corrupt record mid-shard
+
+    faults.arm(FaultPlan()
+               .arm("io.open_shard", nth=1, exc="ioerror")
+               .arm("io.read_record", nth=4, exc="ioerror"))
+
+    def make_iter():
+        return RecordIter(
+            ShardSet(shards, policy=DataGuardPolicy(
+                max_skipped_records=4, poison_threshold=4)),
+            data_shape=(DIM,), batch_size=2, label_name="softmax_label")
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    from mxnet_tpu import sym
+    d = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(d, name="fc", num_hidden=3), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(make_iter(), num_epoch=2, optimizer="sgd")
+
+    st = rdata.stats()
+    fired = faults.stats()["fired"]
+    assert st["records_skipped"] == 2       # the corrupt record, per epoch
+    assert st["shards_quarantined"] == 0    # contained below poison level
+    assert fired.get("io.open_shard") == 1  # matches the armed plan
+    assert fired.get("io.read_record") == 1
+    assert retry.stats()["retries"].get("io.open_shard", 0) >= 1
+
+    # the same damage with a zero budget escalates instead of dropping
+    faults.disarm()
+    strict = RecordIter(
+        ShardSet([shards[0]],
+                 policy=DataGuardPolicy(max_skipped_records=0,
+                                        poison_threshold=4)),
+        data_shape=(DIM,), batch_size=2)
+    with pytest.raises(MXNetError, match="max_skipped_records=0"):
+        list(strict)
+
+
+# -- SPMDTrainer mid-epoch resume --------------------------------------------
+
+def test_trainer_mid_epoch_kill_resume_bitwise(tmp_path):
+    import jax
+
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(40, 10).astype(np.float32)
+    y = (np.arange(40) % 4).astype(np.float32)
+
+    def make_trainer():
+        net = _mlp(nclass=4)
+        mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+        tr = SPMDTrainer(net, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1}, mesh=mesh)
+        tr.bind(data_shapes={"data": (10, 10)},
+                label_shapes={"softmax_label": (10,)})
+        return tr
+
+    def make_iter():
+        return NDArrayIter(X, y, batch_size=10, shuffle=True, seed=3)
+
+    mx.random.seed(0)
+    ref = make_trainer()
+    ref.fit(make_iter(), num_epoch=3)
+    ref_w = np.asarray(ref.params["fc1_weight"])
+
+    ckdir = str(tmp_path / "trainer")
+    mx.random.seed(0)
+    victim = make_trainer()
+    faults.arm(FaultPlan().arm("trainer.step", nth=7, exc="kill"))
+    with pytest.raises(InjectedKill):
+        victim.fit(make_iter(), num_epoch=3, checkpoint_dir=ckdir,
+                   checkpoint_batch_period=2)
+    faults.disarm()
+
+    resumed = make_trainer()
+    resumed.fit(make_iter(), num_epoch=3, checkpoint_dir=ckdir,
+                checkpoint_batch_period=2, resume="auto")
+    assert rdata.stats()["resumes"] == 1
+    assert rdata.stats()["last_resume"]["nbatch"] > 0
+    assert resumed._num_update == ref._num_update
+    np.testing.assert_array_equal(np.asarray(resumed.params["fc1_weight"]),
+                                  ref_w)
