@@ -122,10 +122,19 @@ ci-data: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience_data.py \
 	    -m 'not slow' -x -q
 
+# stage 11: step-runtime smoke — a 2-step micro-LSTM and micro-attention
+# through the fused runtime (mxnet_tpu/perf) asserting no-retrace
+# (MXTPU_RETRACE_STRICT=1) and bitwise donation-equivalence
+# (docs/how_to/performance.md); CPU-only, inside the tier-1 time budget
+ci-perf: ci-native
+	timeout -k 10 120 env JAX_PLATFORMS=cpu python ci/perf_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_perf_runtime.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
-    ci-frontends ci-dryrun ci-resilience ci-serving ci-data
+    ci-frontends ci-dryrun ci-resilience ci-serving ci-data ci-perf
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
-        ci-serving ci-data
+        ci-serving ci-data ci-perf

@@ -10,12 +10,15 @@
 
 Prints ONE json line: the ResNet-50 record (metric/value/unit/
 vs_baseline, as every prior round) with the LSTM record nested under
-``lstm_train_tokens_per_sec``. Both carry their own vs_best_recorded +
-regression flag against the best across recorded BENCH_r*.json rounds.
+``lstm_train_tokens_per_sec`` and the flagship-tier records nested under
+``flash_attention`` / ``moe_dispatch``. Every metric carries its own
+vs_best_recorded + regression flag against the best across recorded
+BENCH_r*.json rounds (the flagship metrics self-seed on their first
+recorded round).
 
 Batch/iters overridable via BENCH_BATCH / BENCH_ITERS — such smoke runs
-skip the LSTM half and the regression guard (config difference, not a
-regression).
+skip the LSTM/flagship halves and the regression guard (config
+difference, not a regression).
 """
 import glob
 import json
@@ -41,8 +44,11 @@ LSTM_PRIOR_BEST = 298385.0
 
 def best_recorded():
     """Best recorded value per metric across every BENCH_r*.json the
-    round driver wrote. Returns (best_resnet_ips, best_lstm_tps)."""
-    best_ips, best_tps = 0.0, LSTM_PRIOR_BEST
+    round driver wrote. Returns a dict with keys ``resnet`` / ``lstm`` /
+    ``flash_attention`` / ``moe_dispatch`` (the last two are 0.0 until a
+    round records them — this round seeds that history)."""
+    best = {"resnet": 0.0, "lstm": LSTM_PRIOR_BEST,
+            "flash_attention": 0.0, "moe_dispatch": 0.0}
     here = os.path.dirname(os.path.abspath(__file__))
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         try:
@@ -50,13 +56,18 @@ def best_recorded():
                 rec = json.load(f)
             rec = rec.get("parsed", rec)  # driver artifacts nest the line
             if rec.get("metric") == "resnet50_train_throughput":
-                best_ips = max(best_ips, float(rec.get("value", 0.0)))
-            lstm = rec.get("lstm_train_tokens_per_sec")
-            if isinstance(lstm, dict):
-                best_tps = max(best_tps, float(lstm.get("value", 0.0)))
+                best["resnet"] = max(best["resnet"],
+                                     float(rec.get("value", 0.0)))
+            for key, nested in (("lstm", "lstm_train_tokens_per_sec"),
+                                ("flash_attention", "flash_attention"),
+                                ("moe_dispatch", "moe_dispatch")):
+                sub = rec.get(nested)
+                if isinstance(sub, dict):
+                    best[key] = max(best[key],
+                                    float(sub.get("value", 0.0)))
         except (OSError, ValueError, AttributeError, TypeError):
             continue
-    return best_ips, best_tps
+    return best
 
 
 def bench_resnet(batch, iters):
@@ -119,8 +130,33 @@ def bench_lstm():
         "value": rec["value"],
         "unit": rec["unit"],
         "config": rec["config"],
+        "impl": rec.get("impl", "classic"),
         "effective_tflops": rec["effective_tflops"],
     }
+
+
+def bench_flagship():
+    """Flash-attention + MoE-dispatch records (flagship tier)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_flagship as _flag
+    fa = _flag.bench_flash_attention(quiet=True)
+    moe = _flag.bench_moe_dispatch(quiet=True)
+    return fa, moe
+
+
+def _guard(rec, best):
+    """Attach vs_best_recorded + regression to a nested metric record.
+
+    A zero ``best`` means no prior round recorded this metric: the
+    record self-seeds (ratio 1.0, no regression) and becomes the history
+    the NEXT round is judged against."""
+    base = best if best else float(rec["value"])
+    rec["vs_best_recorded"] = round(float(rec["value"]) / base, 3) \
+        if base else 1.0
+    rec["regression"] = bool(base and float(rec["value"])
+                             < base / VARIANCE_BAND)
+    return rec["regression"]
 
 
 def main():
@@ -134,19 +170,25 @@ def main():
     record = bench_resnet(batch, iters)
     regressed = False
     if default_config:
-        best_ips, best_tps = best_recorded()
-        if best_ips:
-            record["vs_best_recorded"] = round(record["value"] / best_ips, 3)
-            regressed = bool(record["value"] < best_ips / VARIANCE_BAND)
+        best = best_recorded()
+        if best["resnet"]:
+            record["vs_best_recorded"] = round(
+                record["value"] / best["resnet"], 3)
+            regressed = bool(record["value"]
+                             < best["resnet"] / VARIANCE_BAND)
             record["regression"] = regressed
 
         lstm = bench_lstm()
-        if best_tps:
-            lstm["vs_best_recorded"] = round(lstm["value"] / best_tps, 3)
-            lstm["regression"] = bool(
-                lstm["value"] < best_tps / VARIANCE_BAND)
-            regressed = regressed or lstm["regression"]
+        regressed |= _guard(lstm, best["lstm"])
         record["lstm_train_tokens_per_sec"] = lstm
+
+        # flagship tier (flash attention / MoE): first recorded perf
+        # evidence + regression guard from this round on
+        fa, moe = bench_flagship()
+        regressed |= _guard(fa, best["flash_attention"])
+        regressed |= _guard(moe, best["moe_dispatch"])
+        record["flash_attention"] = fa
+        record["moe_dispatch"] = moe
 
     print(json.dumps(record))
     if regressed and os.environ.get("BENCH_ENFORCE"):

@@ -3,9 +3,16 @@
 
 BASELINE.md north star #2: "Gluon LSTM tokens/sec" — no published
 reference number exists (the reference's CPU RNN was a stub and cuDNN
-numbers weren't published for 0.11), so this establishes the measured
-baseline. Runs the fused RNN op (Pallas LSTM cell on TPU) through a
-training step.
+numbers weren't published for 0.11), so the round-2 measurement seeds the
+regression guard (bench.py LSTM_PRIOR_BEST).
+
+The step runs through the shared fused runtime (mxnet_tpu/perf): ONE
+donated XLA program per step — forward, backward and the SGD update —
+with the packed LSTM parameter pre-split into per-layer pieces at layout
+time and bf16 compute over fp32 master weights (the same mixed-precision
+policy as the ResNet-50 half of bench.py). ``--classic`` runs the
+pre-round-6 forward/backward/update path for A/B attribution
+(benchmarks/profile_lstm.py prints both).
 """
 import argparse
 import json
@@ -18,13 +25,13 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
-        vocab=10000, iters=10, quiet=False):
-    """Measure LSTM training throughput; returns the metric record.
+def build(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
+          vocab=10000):
+    """The exact bench model: Embedding -> fused LSTM stack -> FC -> softmax.
 
-    Importable entry — bench.py calls this to emit the second north-star
-    metric (BASELINE.md:64) alongside the ResNet-50 number."""
+    Returns (module, batch) bound, initialized, optimizer-ready."""
     import mxnet_tpu as mx
+    from mxnet_tpu.io import DataBatch, DataDesc
 
     T, N, H, V = seq_len, batch_size, num_hidden, vocab
     data = mx.sym.var("data")
@@ -40,7 +47,6 @@ def run(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
 
     mod = mx.mod.Module(net, data_names=["data"],
                         label_names=["softmax_label"])
-    from mxnet_tpu.io import DataDesc, DataBatch
     mod.bind(data_shapes=[DataDesc("data", (N, T))],
              label_shapes=[DataDesc("softmax_label", (N, T))])
     mod.init_params(mx.init.Xavier())
@@ -50,18 +56,47 @@ def run(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
     batch = DataBatch(
         data=[mx.nd.array(rng.randint(0, V, (N, T)).astype(np.float32))],
         label=[mx.nd.array(rng.randint(0, V, (N, T)).astype(np.float32))])
+    return mod, batch
 
-    def step():
-        mod.forward(batch, is_train=True)
-        mod.backward()
-        mod.update()
 
-    def sync():
-        # scalar host read = true device sync without a bulk transfer
-        # (tunnel block_until_ready lies; fetching the full weight would
-        # bill a ~40MB copy to the timed region)
-        w = mod._exec.arg_dict["pred_weight"]
-        return float(w[0:1, 0:1].asnumpy()[0, 0])
+def run(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
+        vocab=10000, iters=10, quiet=False, classic=False,
+        compute_dtype="bfloat16"):
+    """Measure LSTM training throughput; returns the metric record.
+
+    Importable entry — bench.py calls this to emit the second north-star
+    metric (BASELINE.md:64) alongside the ResNet-50 number."""
+    T, N, H, V = seq_len, batch_size, num_hidden, vocab
+    mod, batch = build(batch_size, seq_len, num_hidden, num_layers, vocab)
+
+    if classic:
+        impl = "classic"
+
+        def step():
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+
+        def sync():
+            # scalar host read = true device sync without a bulk transfer
+            # (tunnel block_until_ready lies; fetching the full weight
+            # would bill a ~40MB copy to the timed region)
+            w = mod._exec.arg_dict["pred_weight"]
+            return float(w[0:1, 0:1].asnumpy()[0, 0])
+    else:
+        from mxnet_tpu import perf
+        stepper = perf.module_stepper(mod, compute_dtype=compute_dtype)
+        if stepper is None:
+            raise RuntimeError("bench module unexpectedly ineligible for "
+                               "the fused step runtime")
+        impl = f"fused-{compute_dtype or 'fp32'}"
+
+        def step():
+            stepper.step(batch)
+
+        def sync():
+            w = stepper._params["pred_weight"]
+            return float(np.asarray(w[0:1, 0:1]).ravel()[0])
 
     step()  # compile
     sync()
@@ -75,13 +110,14 @@ def run(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
     # + 2HV head + 0 embedding (gather); train step ~ 3x fwd
     flops_tok = 3 * (8 * H * H * num_layers + 2 * H * V)
     if not quiet:
-        print(f"LSTM {num_layers}x{H} bs{N} T={T}: "
+        print(f"LSTM {num_layers}x{H} bs{N} T={T} [{impl}]: "
               f"{dt * 1000:.1f} ms/step, {tps:,.0f} tokens/sec/chip")
     return {
         "metric": "lstm_train_throughput",
         "value": round(tps, 0),
         "unit": "tokens/sec/chip",
         "config": f"{num_layers}x{H} bs{N} T={T} V={V}",
+        "impl": impl,
         "effective_tflops": round(tps * flops_tok / 1e12, 1),
     }
 
@@ -94,9 +130,15 @@ def main():
     ap.add_argument("--num-layers", type=int, default=2)
     ap.add_argument("--vocab", type=int, default=10000)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--classic", action="store_true",
+                    help="pre-round-6 forward/backward/update path")
+    ap.add_argument("--fp32", action="store_true",
+                    help="disable the bf16 compute cast")
     args = ap.parse_args()
     print(json.dumps(run(args.batch_size, args.seq_len, args.num_hidden,
-                         args.num_layers, args.vocab, args.iters)))
+                         args.num_layers, args.vocab, args.iters,
+                         classic=args.classic,
+                         compute_dtype=None if args.fp32 else "bfloat16")))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""ci-perf: CPU-only smoke of the shared step runtime.
+
+Drives a 2-step micro-LSTM (Module front end, packed-param piece layout)
+and a 2-step micro-attention model (SPMDTrainer front end) through the
+fused runtime and asserts the two contracts the perf tier rests on
+(docs/how_to/performance.md):
+
+* **no-retrace** — the second step hits the trace cache (CompileGuard
+  count stays 1, and MXTPU_RETRACE_STRICT=1 turns any violation into a
+  hard failure);
+* **donation-equivalence** — the donated step is bitwise identical to
+  the undonated step.
+
+Bounded by the Makefile `timeout` so a reintroduced hang fails the stage
+instead of wedging the runner.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_RETRACE_STRICT"] = "1"
+
+import mxnet_tpu as mx                                   # noqa: E402
+from mxnet_tpu import perf                               # noqa: E402
+from mxnet_tpu.io import DataBatch, DataDesc             # noqa: E402
+
+
+def micro_lstm(donate):
+    data = mx.sym.var("data")
+    embed = mx.sym.Embedding(data, input_dim=30, output_dim=8, name="embed")
+    embed = mx.sym.SwapAxis(embed, dim1=0, dim2=1)
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm", prefix="lstm_")
+    out, _ = cell.unroll(5, inputs=embed, merge_outputs=True, layout="TNC")
+    pred = mx.sym.FullyConnected(mx.sym.Reshape(out, shape=(-1, 8)),
+                                 num_hidden=30, name="pred")
+    label = mx.sym.Reshape(mx.sym.var("softmax_label"), shape=(-1,))
+    net = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[DataDesc("data", (2, 5))],
+             label_shapes=[DataDesc("softmax_label", (2, 5))])
+    mx.random.seed(1)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "momentum": 0.9})
+    stepper = perf.module_stepper(mod, donate=donate)
+    assert stepper is not None, "micro-LSTM unexpectedly ineligible"
+    assert "lstm_parameters" in stepper._fused.layouts, \
+        "packed-param layout hoist not applied"
+    rng = np.random.RandomState(0)
+    batch = DataBatch(
+        data=[mx.nd.array(rng.randint(0, 30, (2, 5)).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 30, (2, 5)).astype(np.float32))])
+    for _ in range(2):
+        stepper.step(batch)
+    assert stepper.guard.count == 1, \
+        f"micro-LSTM retraced: {stepper.guard.count} compiles"
+    arg, _ = mod.get_params()
+    return {n: v.asnumpy() for n, v in arg.items()}
+
+
+def micro_attention(donate):
+    import jax
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    q = mx.sym.var("data")
+    attn = mx.sym.MultiHeadAttention(q, q, q, num_heads=2, causal=True)
+    pred = mx.sym.FullyConnected(mx.sym.Reshape(attn, shape=(-1, 8)),
+                                 num_hidden=6, name="pred")
+    net = mx.sym.SoftmaxOutput(pred, mx.sym.Reshape(
+        mx.sym.var("softmax_label"), shape=(-1,)), name="softmax")
+    mx.random.seed(2)
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr = SPMDTrainer(net, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     mesh=mesh, donate_buffers=donate)
+    tr.bind(data_shapes={"data": (2, 4, 8)},
+            label_shapes={"softmax_label": (2, 4)})
+    rng = np.random.RandomState(0)
+    feed = {"data": rng.rand(2, 4, 8).astype(np.float32),
+            "softmax_label": rng.randint(0, 6, (2, 4)).astype(np.float32)}
+    for _ in range(2):
+        tr.step(feed)
+    assert tr.retrace_guard.count == 1, \
+        f"micro-attention retraced: {tr.retrace_guard.count} compiles"
+    arg, _ = tr.get_params()
+    return {n: v.asnumpy() for n, v in arg.items()}
+
+
+def check_equivalence(name, build):
+    donated = build(True)
+    undonated = build(False)
+    for n in donated:
+        assert np.array_equal(donated[n], undonated[n]), \
+            f"{name}: donated != undonated for {n}"
+    print(f"perf-smoke {name}: no-retrace ok, "
+          f"donation-equivalence ok ({len(donated)} params)")
+
+
+def main():
+    check_equivalence("micro-lstm", micro_lstm)
+    check_equivalence("micro-attention", micro_attention)
+    print("ci-perf smoke green")
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,7 @@ from . import torch as th  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401
+from . import perf  # noqa: F401
 from . import resilience  # noqa: F401
 from . import serving  # noqa: F401
 from . import notebook  # noqa: F401

@@ -6,3 +6,4 @@ from . import side_effects      # noqa: F401
 from . import retrace           # noqa: F401
 from . import rng               # noqa: F401
 from . import registry_consistency  # noqa: F401
+from . import donation          # noqa: F401

@@ -1,0 +1,85 @@
+"""undonated-hot-jit: per-step jit programs that never donate buffers.
+
+A training/serving step that carries array-tree state (parameters,
+optimizer moments, aux stats) through a ``jax.jit`` WITHOUT
+``donate_argnums``/``donate_argnames`` makes XLA keep both the input and
+the output copy of every buffer live across the step — double the HBM
+footprint and an extra copy pass, exactly the waste the shared step
+runtime (perf/step_runtime.py) exists to remove. The rule:
+
+* a ``jit``/``pjit`` construction **inside a ``@hot_path`` region**
+  (tracecontext.py — the declared per-step path, plus everything it
+  reaches in-module)
+* whose wrapped function takes two or more parameters (an array-tree
+  state argument plus inputs; single-argument helpers have no in/out
+  state pair worth donating — resolved lexically when possible, assumed
+  stateful when not)
+* and whose call site sets no ``donate_argnums``/``donate_argnames``
+
+is flagged. Steps that genuinely must not donate (aliased buffers read
+after the call) document it with
+``# tpu-lint: disable=undonated-hot-jit — <why>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, FileCtx, register_checker
+from ..tracecontext import TraceAnalysis, dotted_name, walk_region
+
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+_JIT_SEGS = {"jit", "pjit"}
+
+
+def _jit_seg(node: ast.AST):
+    name = dotted_name(node)
+    seg = name.rsplit(".", 1)[-1] if name else None
+    return seg if seg in _JIT_SEGS else None
+
+
+def _param_count(fn: ast.AST):
+    args = fn.args
+    return (len(args.posonlyargs) + len(args.args)
+            + (1 if args.vararg else 0))
+
+
+@register_checker
+class DonationChecker(Checker):
+    name = "undonated-hot-jit"
+    description = ("jax.jit on the @hot_path per-step path wrapping an "
+                   "array-tree-state function without donate_argnums — "
+                   "doubles live buffers per step")
+
+    def check_file(self, ctx: FileCtx):
+        analysis = TraceAnalysis(ctx.tree)
+        for fn, qual, kind, why in analysis.regions():
+            if kind != "hot":
+                continue
+            scope = (fn,) + analysis._scope_chain.get(fn, ())
+            for node in walk_region(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                seg = _jit_seg(node.func)
+                if seg is None or not node.args:
+                    continue
+                if any(kw.arg in _DONATE_KWARGS for kw in node.keywords):
+                    continue
+                # resolve the wrapped fn: a helper with <2 params has no
+                # (state, inputs) split — nothing to donate
+                target = node.args[0]
+                resolved = None
+                if isinstance(target, ast.Lambda):
+                    resolved = target
+                elif isinstance(target, ast.Name):
+                    hits = analysis._resolve_lexical(target.id, scope)
+                    resolved = hits[0] if hits else None
+                if resolved is not None and _param_count(resolved) < 2:
+                    continue
+                yield ctx.finding(
+                    self.name, node,
+                    f"`{dotted_name(node.func)}(...)` on the per-step hot "
+                    f"path ({why}) takes array-tree state but sets no "
+                    f"donate_argnums — input AND output buffers stay "
+                    f"live every step; donate the state arguments (see "
+                    f"perf/step_runtime.py) or suppress with a reason",
+                    context=qual)

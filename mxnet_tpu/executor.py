@@ -68,9 +68,14 @@ def build_graph_eval(symbol, collect_all=False, proxies=None):
     (vocab, dim) gather (see Executor)."""
     nodes = symbol._topo_nodes()
     aux_ids = symbol._aux_node_ids()
-    # deterministic per-random-node key folding
+    # deterministic per-random-node key folding. Only nodes that ACTUALLY
+    # sample (op.uses_rng — e.g. RNN with inter-layer dropout p=0 does
+    # not) get a folded key; ops whose signature takes a key they will
+    # not use receive the step key unfolded. A graph with no sampling
+    # node at all sets ``eval_fn.needs_rng = False`` so the caller can
+    # skip the per-step key split entirely.
     random_nodes = [n for n in nodes
-                    if n.op is not None and n.op.needs_rng]
+                    if n.op is not None and n.op.uses_rng(n.attrs)]
     rng_index = {id(n): i for i, n in enumerate(random_nodes)}
     out_entries = list(symbol._outputs)
     proxies = proxies or {}
@@ -91,9 +96,11 @@ def build_graph_eval(symbol, collect_all=False, proxies=None):
                 call_attrs["_is_train"] = is_train
             if node.op.key_var_num_args and not call_attrs.get(node.op.key_var_num_args):
                 call_attrs[node.op.key_var_num_args] = len(ins)
-            if node.op.needs_rng:
+            if id(node) in rng_index:
                 key = jax.random.fold_in(rng, rng_index[id(node)])
                 out = node.op.fn(key, *ins, **call_attrs)
+            elif node.op.needs_rng:
+                out = node.op.fn(rng, *ins, **call_attrs)
             else:
                 out = node.op.fn(*ins, **call_attrs)
             if not isinstance(out, tuple):
@@ -116,6 +123,7 @@ def build_graph_eval(symbol, collect_all=False, proxies=None):
             outputs = [values[(id(n), i)] for n, i in out_entries]
         return outputs, aux_updates
 
+    eval_fn.needs_rng = bool(random_nodes)
     return eval_fn
 
 
@@ -136,7 +144,7 @@ def build_placed_graph_eval(symbol, group2dev):
     nodes = symbol._topo_nodes()
     aux_ids = symbol._aux_node_ids()
     random_nodes = [n for n in nodes
-                    if n.op is not None and n.op.needs_rng]
+                    if n.op is not None and n.op.uses_rng(n.attrs)]
     rng_index = {id(n): i for i, n in enumerate(random_nodes)}
     out_entries = list(symbol._outputs)
     default_dev = next(iter(group2dev.values()))
@@ -220,9 +228,11 @@ def build_placed_graph_eval(symbol, group2dev):
                 if node.op.key_var_num_args and not call_attrs.get(
                         node.op.key_var_num_args):
                     call_attrs[node.op.key_var_num_args] = len(ins)
-                if node.op.needs_rng:
+                if id(node) in rng_index:
                     key = jax.random.fold_in(rng, rng_index[id(node)])
                     out = node.op.fn(key, *ins, **call_attrs)
+                elif node.op.needs_rng:
+                    out = node.op.fn(rng, *ins, **call_attrs)
                 else:
                     out = node.op.fn(*ins, **call_attrs)
                 if not isinstance(out, tuple):
@@ -262,7 +272,23 @@ def build_placed_graph_eval(symbol, group2dev):
         outputs = [values[(id(n), i)] for n, i in out_entries]
         return outputs, aux_updates
 
+    eval_fn.needs_rng = bool(random_nodes)
     return eval_fn
+
+
+_NULL_KEY = None
+
+
+def _null_key():
+    """Cached PRNG key fed to executors whose graph samples nothing: the
+    per-bind/per-step key-split subgraph (a device dispatch + a host
+    round-trip through the key chain) is skipped for pure-deterministic
+    graphs — it showed up as copy/layout ms in the r5 profile."""
+    global _NULL_KEY
+    if _NULL_KEY is None:
+        with jax.ensure_compile_time_eval():
+            _NULL_KEY = jax.random.PRNGKey(0)
+    return _NULL_KEY
 
 
 def _sparse_grad_specs(symbol, grad_req):
@@ -331,6 +357,9 @@ class Executor:
         # share compiled programs across executors of the same graph
         # (reference: shared_exec memory-pool reuse for bucketing,
         # graph_executor.cc:879-881 — here we share the jit cache instead)
+        self._needs_rng = any(
+            n.op is not None and not n.is_variable
+            and n.op.uses_rng(n.attrs) for n in symbol._topo_nodes())
         if shared_exec is not None and shared_exec._symbol is symbol:
             self._fwd = shared_exec._fwd
             self._fwd_bwd = shared_exec._fwd_bwd
@@ -477,7 +506,9 @@ class Executor:
                 _as_jax(val, dtype=self.arg_dict[name].dtype))
         arg_vals = {n: self._arg_val(n) for n in self._arg_names}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
-        rng = _random.next_key()
+        # deterministic graphs skip the per-step key split (and leave the
+        # global key chain untouched — they draw nothing from it)
+        rng = _random.next_key() if self._needs_rng else _null_key()
         from . import profiler as _profiler
         with _profiler.profile_scope("Forward", "executor", "symbolic",
                                      sync=lambda: outs):
@@ -504,7 +535,7 @@ class Executor:
                 _as_jax(val, dtype=self.arg_dict[name].dtype))
         arg_vals = {n: self._arg_val(n) for n in self._arg_names}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
-        rng = _random.next_key()
+        rng = _random.next_key() if self._needs_rng else _null_key()
         self._run_fwd_bwd(arg_vals, aux_vals, rng, out_grads)
         return self.outputs
 

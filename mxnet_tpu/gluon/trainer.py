@@ -37,6 +37,12 @@ class Trainer:
         self._init_optimizer(optimizer, optimizer_params)
         # last-seen grad-buffer versions, for stale-grad detection
         self._grad_versions = [None] * len(self._params)
+        # fused whole-update program (perf/step_runtime.py): None = not
+        # built, False = optimizer has no functional rule. Donation of
+        # the weight/state buffers is on by default (SPMDTrainer
+        # semantics); tests toggle _donate_buffers before first step.
+        self._fused_apply = None
+        self._donate_buffers = True
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -67,8 +73,17 @@ class Trainer:
         """Apply one optimizer update using each parameter's current grad
         (reference trainer.py:step). A parameter whose grad buffer has not
         been rewritten since the previous step is stale; as in the reference
-        this raises unless ``ignore_stale_grad``."""
+        this raises unless ``ignore_stale_grad``.
+
+        When the optimizer has a functional rule (sgd/nag/adam/rmsprop),
+        the whole update runs as ONE jitted program with the weight and
+        optimizer-state buffers donated (perf/step_runtime.py) — the
+        per-step ``rescale_grad`` is a traced input, so changing batch
+        sizes never retrace. Anything else falls back to the imperative
+        per-parameter loop below.
+        """
         self._optimizer.rescale_grad = self._scale / batch_size
+        live = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -87,7 +102,35 @@ class Trainer:
                 self._states[i] = self._optimizer.create_state(
                     i, param.data())
                 self._states_created[i] = True
+            live.append((i, param, grad))
+        if self._fused_step(live):
+            return
+        for i, param, grad in live:
             self._optimizer.update(i, param.data(), grad, self._states[i])
+
+    def _fused_step(self, live):
+        """One donated program for every (weight, grad, state) triple;
+        returns False when this step must run imperatively."""
+        from ..base import getenv
+        if self._fused_apply is False or not live \
+                or not getenv("MXTPU_FUSED_STEP", 1, int):
+            return False
+        if any(getattr(g, "stype", "default") != "default"
+               or getattr(p.data(), "stype", "default") != "default"
+               for _i, p, g in live):
+            return False
+        opt = self._optimizer
+        if self._fused_apply is None or self._fused_apply._opt is not opt:
+            from ..perf import FusedOptimizerApply, has_functional_update
+            if not has_functional_update(opt):
+                self._fused_apply = False
+                return False
+            self._fused_apply = FusedOptimizerApply(
+                opt, name="gluon-trainer", donate=self._donate_buffers)
+        from ..perf.step_runtime import apply_fused_triples
+        triples = [(i, param.data(), grad) for i, param, grad in live]
+        return apply_fused_triples(self._fused_apply, opt, triples,
+                                   lambda i: self._states[i])
 
     def save_states(self, fname):
         """Serialize optimizer states (reference trainer.py:save_states)."""

@@ -116,7 +116,17 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
-    """Local updater path (reference: model.py:117)."""
+    """Local updater path (reference: model.py:117).
+
+    Kvstore-free local updates take the fused apply when the optimizer
+    has a functional rule (perf/step_runtime.py): one donated XLA
+    program for the whole parameter set instead of one dispatch per
+    parameter — same math, same Updater-state bookkeeping."""
+    if kvstore is None and num_device == 1:
+        from .perf import fused_update_params
+        if fused_update_params(param_arrays, grad_arrays, updater,
+                               param_names):
+            return
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list is None or (isinstance(grad_list, list)

@@ -518,6 +518,15 @@ class BaseModule:
             period = batch_ckpt[0]
             # snapshot only the batches that will actually checkpoint
             want = lambda k: (begin_batch + k + 1) % period == 0  # noqa: E731
+        # fused whole-step path (perf/step_runtime.py): forward, backward
+        # and the optimizer update in ONE donated XLA program. Modules
+        # that cannot take it (monitor installed, kvstore, sparse grads,
+        # exotic optimizer, ...) return None and keep the imperative pair
+        fused_step = None
+        if monitor is None:
+            getter = getattr(self, "_fused_train_step", None)
+            if getter is not None:
+                fused_step = getter()
         nseen = 0
         for k, (batch, upcoming, state) in enumerate(
                 _lookahead(train_data, snapshot, want)):
@@ -525,8 +534,11 @@ class BaseModule:
             nseen = k + 1
             if monitor is not None:
                 monitor.tic()
-            self.forward_backward(batch)
-            self.update()
+            if fused_step is not None:
+                fused_step(batch)
+            else:
+                self.forward_backward(batch)
+                self.update()
             if upcoming is not None:
                 self.prepare(upcoming)
             self.update_metric(train_metric, batch.label)

@@ -89,6 +89,20 @@ class OpDef:
             return self._num_outputs(attrs)
         return self._num_outputs
 
+    def uses_rng(self, attrs) -> bool:
+        """Does THIS instantiation actually draw randomness?
+
+        ``needs_rng`` stays truthy whenever the fn signature takes a key
+        (every call site threads one); a *callable* ``needs_rng`` is an
+        attrs predicate refining that — e.g. the fused RNN op only
+        samples when its inter-layer dropout ``p`` is nonzero. Executors
+        use this to skip the per-step key split/fold for graphs that are
+        deterministic in practice.
+        """
+        if callable(self.needs_rng):
+            return bool(self.needs_rng(attrs))
+        return bool(self.needs_rng)
+
     def parse_attrs(self, raw_attrs: Dict) -> Dict:
         return self.attr_spec.parse(raw_attrs, self.name)
 

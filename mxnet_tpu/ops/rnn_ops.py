@@ -149,8 +149,17 @@ def _rnn_impl(rng, data, parameters, state, state_cell, state_size,
     T, N, input_size = data.shape
     H = state_size
     D = _num_directions(bidirectional)
-    pieces = _unpack(parameters, num_layers, input_size, H, mode,
-                     bidirectional)
+    if isinstance(parameters, (list, tuple)):
+        # pre-split per-(layer, direction) pieces: the perf step runtime
+        # (perf/step_runtime.py PackedRNNLayout) hoists the unpack to
+        # parameter-layout time, so neither the forward slice/reshape of
+        # the packed vector nor the backward gradient concat appears in
+        # the step program — and the 2-D weight pieces are visible to the
+        # mixed-precision cast (the flat vector is 1-D and never was)
+        pieces = parameters
+    else:
+        pieces = _unpack(parameters, num_layers, input_size, H, mode,
+                         bidirectional)
     x = data
     h_states, c_states = [], []
     for layer in range(num_layers):
@@ -211,11 +220,21 @@ def _rnn_param_shapes(attrs, shapes):
     return out
 
 
+def _rnn_uses_rng(attrs):
+    """Inter-layer dropout is the RNN op's only randomness: with p=0 the
+    graph is deterministic and the executor's per-step key split/fold is
+    skipped entirely (the signature still takes a key, unused)."""
+    try:
+        return float(attrs.get("p", 0.0) or 0.0) > 0.0
+    except (TypeError, ValueError):
+        return True
+
+
 @register("RNN",
           num_inputs=None,
           input_names=["data", "parameters", "state", "state_cell"],
           num_outputs=_rnn_nout,
-          needs_rng=True,
+          needs_rng=_rnn_uses_rng,
           needs_is_train=True,
           param_shapes=_rnn_param_shapes,
           attrs=AttrSpec(state_size=("int",), num_layers=("int",),

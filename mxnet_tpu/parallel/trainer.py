@@ -25,93 +25,16 @@ from ..analysis.annotations import hot_path
 from ..base import MXNetError
 from ..executor import build_graph_eval
 from ..ndarray import NDArray
-from ..ops.registry import OP_TABLE
 from .mesh import make_mesh
 from .sharding import batch_pspec, param_pspec
 
 __all__ = ["SPMDTrainer"]
 
 
-def _functional_update(opt):
-    """Map an Optimizer instance to (init_state, update) pure functions.
-
-    The reference runs optimizer ops imperatively per weight
-    (optimizer.py SGD.update → sgd_mom_update op); here the same registered
-    op *functions* are traced into the step program.
-    update(w, g, state, lr, wd, t) -> (new_w, new_state); t is the traced
-    update count (for Adam bias correction, reference optimizer.py:539).
-    """
-    kind = type(opt).__name__.lower()
-    rescale = float(opt.rescale_grad)
-    clip = float(opt.clip_gradient) if opt.clip_gradient else -1.0
-    common = dict(rescale_grad=rescale, clip_gradient=clip)
-
-    if kind == "sgd":
-        momentum = float(getattr(opt, "momentum", 0.0))
-
-        def init_state(w):
-            return jnp.zeros_like(w) if momentum else ()
-
-        def update(w, g, s, lr, wd, t):
-            if momentum:
-                new_w, new_m = OP_TABLE["sgd_mom_update"].fn(
-                    w, g, s, lr=lr, momentum=momentum, wd=wd, **common)
-                return new_w, new_m
-            return OP_TABLE["sgd_update"].fn(w, g, lr=lr, wd=wd, **common), ()
-
-        return init_state, update
-
-    if kind == "nag":
-        momentum = float(getattr(opt, "momentum", 0.0))
-
-        def init_state(w):
-            return jnp.zeros_like(w) if momentum else ()
-
-        def update(w, g, s, lr, wd, t):
-            # Nesterov lookahead, mirroring optimizer.py NAG.update
-            g = g * rescale
-            if clip > 0:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd * w
-            if momentum:
-                new_s = momentum * s + g
-                return w - lr * (g + momentum * new_s), new_s
-            return w - lr * g, ()
-
-        return init_state, update
-
-    if kind == "adam":
-        b1, b2, eps = float(opt.beta1), float(opt.beta2), float(opt.epsilon)
-
-        def init_state(w):
-            return (jnp.zeros_like(w), jnp.zeros_like(w))
-
-        def update(w, g, s, lr, wd, t):
-            mean, var = s
-            coef = jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
-            new_w, new_mean, new_var = OP_TABLE["adam_update"].fn(
-                w, g, mean, var, lr=lr * coef, beta1=b1, beta2=b2,
-                epsilon=eps, wd=wd, **common)
-            return new_w, (new_mean, new_var)
-
-        return init_state, update
-
-    if kind == "rmsprop":
-        g1, eps = float(opt.gamma1), float(opt.epsilon)
-
-        def init_state(w):
-            return jnp.zeros_like(w)
-
-        def update(w, g, s, lr, wd, t):
-            new_w, new_n = OP_TABLE["rmsprop_update"].fn(
-                w, g, s, lr=lr, gamma1=g1, epsilon=eps, wd=wd, **common)
-            return new_w, new_n
-
-        return init_state, update
-
-    raise MXNetError(
-        f"SPMDTrainer has no functional rule for optimizer {kind!r}; "
-        "use sgd/nag/adam/rmsprop or Module's imperative update path")
+# The functional optimizer rules moved to the shared step runtime
+# (perf/step_runtime.py) so Module/Gluon/model.py trace the SAME update
+# math; this alias keeps the historical import path working.
+from ..perf.step_runtime import functional_update as _functional_update  # noqa: E402,E501
 
 
 class SPMDTrainer:
@@ -122,7 +45,7 @@ class SPMDTrainer:
                  mesh=None, data_names: Sequence[str] = ("data",),
                  label_names: Sequence[str] = ("softmax_label",),
                  param_rules=None, dtype="float32", compute_dtype=None,
-                 shard_optimizer_state=False):
+                 shard_optimizer_state=False, donate_buffers=True):
         self._symbol = symbol
         self._mesh = mesh if mesh is not None else make_mesh()
         self._data_names = list(data_names)
@@ -155,6 +78,13 @@ class SPMDTrainer:
         self._num_update = 0
         self._step_fn = None
         self._rng = jax.random.PRNGKey(0)
+        # donation is the default (in-place param/state update); tests
+        # toggle it off to prove bitwise equivalence of the two modes
+        self._donate = bool(donate_buffers)
+        # retrace detector shared with the Module/Gluon runtimes: steps
+        # after the first compile must hit the trace cache
+        from ..perf import CompileGuard
+        self.retrace_guard = CompileGuard("spmd-step")
 
     # -- initialization ----------------------------------------------------
 
@@ -310,7 +240,10 @@ class SPMDTrainer:
                        for n, v in new_aux.items()}
             return new_params, new_states, new_aux, outs
 
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        self.retrace_guard.count = 0    # fresh program after (re)bind
+        self._step_fn = jax.jit(self.retrace_guard.wrap(step),
+                                donate_argnums=(0, 1, 2) if self._donate
+                                else ())
         self._step_abstract_args = None  # re-snapshot after (re)bind
         # sequence parallelism: shard the sequence dim (dim 1) of token
         # inputs over the axis the graph's attention ops actually name —
@@ -404,6 +337,9 @@ class SPMDTrainer:
         if getattr(self, "_step_abstract_args", None) is None:
             raise MXNetError("run at least one step() first")
         from .mesh import mesh_scope
+        # this abstract lower is a deliberate extra trace, not a step
+        # retrace — raise the guard's budget so it stays quiet
+        self.retrace_guard.expected += 1
         with mesh_scope(self._mesh):
             lowered = self._step_fn.lower(*self._step_abstract_args)
         return lowered.compile().as_text()

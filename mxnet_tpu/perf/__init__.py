@@ -1,0 +1,27 @@
+"""Shared step runtime: whole-step jit, buffer donation, retrace guarding.
+
+The fast path SPMDTrainer always had — one XLA program per training step
+(forward + backward + optimizer) with parameter/optimizer/aux buffers
+donated in place, and a stable trace signature so step 2..N never
+recompile — promoted into a runtime every trainer front end shares:
+
+* ``Module.fit`` (module/base_module.py) steps through a
+  :class:`FusedStep` when the module is eligible;
+* the Gluon :class:`~mxnet_tpu.gluon.trainer.Trainer` applies its whole
+  update in one donated program (:class:`FusedOptimizerApply`);
+* ``model._update_params`` (the imperative Module.update path) batches
+  the per-parameter optimizer dispatches the same way;
+* ``SPMDTrainer`` keeps its fused step but now draws the optimizer rules
+  and the :class:`CompileGuard` retrace detector from here.
+
+See docs/how_to/performance.md for the methodology (profile → fix →
+regression-guard) and the donation semantics.
+"""
+from .step_runtime import (CompileGuard, FusedOptimizerApply, FusedStep,
+                           PackedRNNLayout, functional_update,
+                           fused_update_params, has_functional_update,
+                           module_stepper, plan_param_layouts)
+
+__all__ = ["CompileGuard", "FusedOptimizerApply", "FusedStep",
+           "PackedRNNLayout", "functional_update", "fused_update_params",
+           "has_functional_update", "module_stepper", "plan_param_layouts"]

@@ -1,0 +1,846 @@
+"""The shared training-step runtime.
+
+Three mechanisms, each previously private to ``SPMDTrainer``
+(parallel/trainer.py), factored out so every trainer front end — Module,
+Gluon Trainer, the imperative ``model._update_params`` path — runs the
+same way:
+
+* **whole-step jit with donated buffers** (:class:`FusedStep`): forward,
+  backward (vjp) and the optimizer update traced into ONE XLA program;
+  parameter / optimizer-state / aux buffers are donated so XLA updates
+  them in place (reference analogue: automatic weight-update sharding,
+  arxiv 2004.13336, pushes the update into the step function the same
+  way). One device dispatch per step instead of
+  1 (fwd) + 1 (fwd+bwd) + N_params (optimizer).
+
+* **retrace guarding** (:class:`CompileGuard`): the python body of a
+  jitted step runs only when jax traces it, so counting executions of a
+  wrapper counts compilations. Steps 2..N of a training loop must hit
+  the trace cache; the guard logs (or raises, ``MXTPU_RETRACE_STRICT=1``)
+  when they do not.
+
+* **parameter-layout hoisting** (:class:`PackedRNNLayout`): the fused
+  ``RNN`` op's packed parameter vector is split into per-layer/direction
+  weight and bias pieces ONCE at layout time, and the step function
+  carries the pieces. The in-graph slice/reshape of the packed vector on
+  every forward — and the concat that rebuilt its gradient on every
+  backward — disappear, and the 2-D weight pieces become visible to the
+  mixed-precision cast (a flat packed vector is 1-D, so the bf16 compute
+  cast never reached RNN weights before).
+
+Optimizer rules are the functional (w, g, s) -> (w', s') forms of the
+registered update ops (:func:`functional_update`), shared with
+``SPMDTrainer``.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import logging
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, getenv
+from ..executor import _null_key, build_graph_eval
+from ..ops.registry import OP_TABLE
+from ..ops.rnn_ops import _unpack, rnn_param_size
+
+__all__ = ["functional_update", "has_functional_update", "CompileGuard",
+           "PackedRNNLayout", "plan_param_layouts", "FusedStep",
+           "module_stepper", "FusedOptimizerApply", "apply_fused_triples",
+           "fused_update_params"]
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """Donation is best-effort: backends without input-output aliasing
+    (CPU) fall back to copies — numerics identical — so jax's advisory
+    warning is noise on the hermetic CPU CI mesh. Scoped to THIS
+    runtime's program executions only: a user's own donated jits keep
+    their diagnostics."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+# ---------------------------------------------------------------------------
+# functional optimizer rules (moved here from parallel/trainer.py)
+# ---------------------------------------------------------------------------
+
+_FUNCTIONAL_KINDS = ("sgd", "nag", "adam", "rmsprop")
+
+
+def functional_update(opt, rescale_override=None):
+    """Map an Optimizer instance to (init_state, update) pure functions.
+
+    The reference runs optimizer ops imperatively per weight
+    (optimizer.py SGD.update → sgd_mom_update op); here the same registered
+    op *functions* are traced into the step program.
+    update(w, g, state, lr, wd, t) -> (new_w, new_state); t is the traced
+    update count (for Adam bias correction, reference optimizer.py:539).
+
+    ``rescale_override`` replaces the optimizer's static
+    ``rescale_grad`` inside the rule — callers that rescale dynamically
+    (Gluon's per-step ``scale / batch_size``) pre-multiply the gradient
+    and pass 1.0 so clipping still applies to the rescaled gradient.
+    """
+    kind = type(opt).__name__.lower()
+    rescale = float(opt.rescale_grad if rescale_override is None
+                    else rescale_override)
+    clip = float(opt.clip_gradient) if opt.clip_gradient else -1.0
+    common = dict(rescale_grad=rescale, clip_gradient=clip)
+
+    if kind == "sgd":
+        momentum = float(getattr(opt, "momentum", 0.0))
+
+        def init_state(w):
+            return jnp.zeros_like(w) if momentum else ()
+
+        def update(w, g, s, lr, wd, t):
+            if momentum:
+                new_w, new_m = OP_TABLE["sgd_mom_update"].fn(
+                    w, g, s, lr=lr, momentum=momentum, wd=wd, **common)
+                return new_w, new_m
+            return OP_TABLE["sgd_update"].fn(w, g, lr=lr, wd=wd, **common), ()
+
+        return init_state, update
+
+    if kind == "nag":
+        momentum = float(getattr(opt, "momentum", 0.0))
+
+        def init_state(w):
+            return jnp.zeros_like(w) if momentum else ()
+
+        def update(w, g, s, lr, wd, t):
+            # Nesterov lookahead, mirroring optimizer.py NAG.update
+            g = g * rescale
+            if clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd * w
+            if momentum:
+                new_s = momentum * s + g
+                return w - lr * (g + momentum * new_s), new_s
+            return w - lr * g, ()
+
+        return init_state, update
+
+    if kind == "adam":
+        b1, b2, eps = float(opt.beta1), float(opt.beta2), float(opt.epsilon)
+
+        def init_state(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, s, lr, wd, t):
+            mean, var = s
+            coef = jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+            new_w, new_mean, new_var = OP_TABLE["adam_update"].fn(
+                w, g, mean, var, lr=lr * coef, beta1=b1, beta2=b2,
+                epsilon=eps, wd=wd, **common)
+            return new_w, (new_mean, new_var)
+
+        return init_state, update
+
+    if kind == "rmsprop":
+        g1, eps = float(opt.gamma1), float(opt.epsilon)
+
+        def init_state(w):
+            return jnp.zeros_like(w)
+
+        def update(w, g, s, lr, wd, t):
+            new_w, new_n = OP_TABLE["rmsprop_update"].fn(
+                w, g, s, lr=lr, gamma1=g1, epsilon=eps, wd=wd, **common)
+            return new_w, new_n
+
+        return init_state, update
+
+    raise MXNetError(
+        f"no functional rule for optimizer {kind!r}; "
+        "use sgd/nag/adam/rmsprop or the imperative update path")
+
+
+def has_functional_update(opt) -> bool:
+    """True when :func:`functional_update` reproduces ``opt`` exactly."""
+    kind = type(opt).__name__.lower()
+    if kind not in _FUNCTIONAL_KINDS:
+        return False
+    if kind in ("sgd", "nag") and getattr(opt, "multi_precision", False):
+        return False        # fp16 master-weight tuples stay imperative
+    if kind == "rmsprop" and (getattr(opt, "centered", False)
+                              or getattr(opt, "clip_weights", None)):
+        return False        # functional rule covers the plain variant only
+    return True
+
+
+# ---------------------------------------------------------------------------
+# retrace detection
+# ---------------------------------------------------------------------------
+
+class CompileGuard:
+    """Counts compilations of a jitted callable.
+
+    ``jax.jit`` runs the wrapped python body once per trace-cache miss;
+    wrapping that body makes compilation observable. After the expected
+    warm-up compiles, further traces are a bug (shape drift, weak-type
+    flapping, unstable static args): the guard logs a warning, or raises
+    when ``MXTPU_RETRACE_STRICT=1``.
+    """
+
+    def __init__(self, name: str, expected: int = 1):
+        self.name = name
+        self.expected = expected
+        self.count = 0
+
+    def wrap(self, fn):
+        @functools.wraps(fn)
+        def counted(*args, **kwargs):
+            self.count += 1
+            if self.count > self.expected:
+                msg = (f"CompileGuard[{self.name}]: compile #{self.count} "
+                       f"(expected {self.expected}) — the step is "
+                       "retracing; check input shapes/dtypes for drift")
+                if getenv("MXTPU_RETRACE_STRICT", 0, int):
+                    raise MXNetError(msg)
+                logging.warning(msg)
+            return fn(*args, **kwargs)
+
+        return counted
+
+    @property
+    def retraced(self) -> bool:
+        return self.count > self.expected
+
+
+# ---------------------------------------------------------------------------
+# packed-RNN parameter layout
+# ---------------------------------------------------------------------------
+
+class PackedRNNLayout:
+    """Split/join rule for one fused-RNN packed parameter vector.
+
+    ``split`` turns the flat vector into the nested
+    ``((w_i2h, w_h2h, b_i2h, b_h2h) per direction) per layer`` pieces the
+    RNN op consumes directly (ops/rnn_ops.py accepts either form);
+    ``join`` is the exact inverse, matching ``_unpack``'s offsets, and is
+    only paid at sync/checkpoint boundaries — never per step. Momentum /
+    Adam-moment vectors split with the same rule (the update math is
+    elementwise, so updating pieces is updating the packed vector).
+    """
+
+    def __init__(self, name, state_size, num_layers, mode, bidirectional):
+        self.name = name
+        self.state_size = int(state_size)
+        self.num_layers = int(num_layers)
+        self.mode = mode
+        self.bidirectional = bool(bidirectional)
+        self._input_size = None
+
+    def _resolve_input_size(self, total):
+        if self._input_size is not None:
+            return self._input_size
+        # rnn_param_size is linear in input_size: only layer 0's i2h
+        # block scales with it (D * G * H * input_size); invert directly
+        from ..ops.rnn_ops import _GATES
+        D = 2 if self.bidirectional else 1
+        slope = D * _GATES[self.mode] * self.state_size
+        fixed = rnn_param_size(self.num_layers, 0, self.state_size,
+                               self.mode, self.bidirectional)
+        cand, rem = divmod(total - fixed, slope)
+        if rem or cand <= 0:
+            raise MXNetError(
+                f"cannot infer RNN input size from packed parameter "
+                f"length {total} for {self.name!r}")
+        self._input_size = int(cand)
+        return self._input_size
+
+    def split(self, flat):
+        insz = self._resolve_input_size(int(flat.shape[0]))
+        pieces = _unpack(flat, self.num_layers, insz, self.state_size,
+                         self.mode, self.bidirectional)
+        return tuple(tuple(per_dir) for per_dir in pieces)
+
+    def join(self, pieces):
+        mats, vecs = [], []
+        for per_layer in pieces:
+            for w_i2h, w_h2h, _b_i2h, _b_h2h in per_layer:
+                mats.append(w_i2h.ravel())
+                mats.append(w_h2h.ravel())
+        for per_layer in pieces:
+            for _w_i2h, _w_h2h, b_i2h, b_h2h in per_layer:
+                vecs.append(b_i2h.ravel())
+                vecs.append(b_h2h.ravel())
+        return jnp.concatenate(mats + vecs)
+
+
+def plan_param_layouts(symbol) -> Dict[str, PackedRNNLayout]:
+    """Packed parameters that can be hoisted to piece layout.
+
+    A variable qualifies when its ONLY consumer is the ``parameters``
+    slot of a fused ``RNN`` node — a second consumer would see the packed
+    view and force a per-step re-join.
+    """
+    nodes = symbol._topo_nodes()
+    consumers: Dict[int, int] = {}
+    for n in nodes:
+        if n.is_variable:
+            continue
+        for p, _ in n.inputs:
+            if p.is_variable:
+                consumers[id(p)] = consumers.get(id(p), 0) + 1
+    layouts: Dict[str, PackedRNNLayout] = {}
+    for node in nodes:
+        if node.is_variable or node.op.name != "RNN":
+            continue
+        if len(node.inputs) < 2:
+            continue
+        pvar = node.inputs[1][0]
+        if not pvar.is_variable or consumers.get(id(pvar), 0) != 1:
+            continue
+        layouts[pvar.name] = PackedRNNLayout(
+            pvar.name, node.attrs["state_size"], node.attrs["num_layers"],
+            node.attrs.get("mode", "lstm"),
+            node.attrs.get("bidirectional") in (True, "True", "1"))
+    return layouts
+
+
+# ---------------------------------------------------------------------------
+# shared state-format adapters (functional <-> imperative Updater/Trainer)
+# ---------------------------------------------------------------------------
+
+def _to_jax(v):
+    return v._data if hasattr(v, "_data") else jnp.asarray(v)
+
+
+def _is_empty(state):
+    return isinstance(state, tuple) and not state
+
+
+def _imp_state_to_functional(kind, state):
+    """Imperative ``create_state`` output -> functional-rule state."""
+    if kind in ("sgd", "nag"):
+        if isinstance(state, tuple):        # multi-precision master weights
+            raise MXNetError("multi-precision state is not fusable")
+        return () if state is None else _to_jax(state)
+    if kind == "adam":
+        mean, var = state
+        return (_to_jax(mean), _to_jax(var))
+    if kind == "rmsprop":
+        (n,) = state
+        return _to_jax(n)
+    raise MXNetError(f"no state adapter for optimizer {kind!r}")
+
+
+def _functional_state_to_imp(kind, fstate, existing):
+    """Write a functional state back through the imperative containers.
+
+    Mutates ``existing`` (the NDArrays the Updater/Trainer owns) via
+    ``_set_data`` so aliases — saved-state serialization, user handles —
+    observe the update; returns ``existing``.
+    """
+    if kind in ("sgd", "nag"):
+        if existing is not None and not _is_empty(fstate):
+            existing._set_data(fstate)
+        return existing
+    if kind == "adam":
+        mean, var = existing
+        mean._set_data(fstate[0])
+        var._set_data(fstate[1])
+        return existing
+    if kind == "rmsprop":
+        existing[0]._set_data(fstate)
+        return existing
+    raise MXNetError(f"no state adapter for optimizer {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# FusedStep: whole-graph forward+backward+update in one donated program
+# ---------------------------------------------------------------------------
+
+class FusedStep:
+    """One symbol, one optimizer, one compiled training step.
+
+    Functional core: ``step(params, states, aux, inputs, rng, lr, t)``
+    returns ``(params', states', aux', outputs)`` with the first three
+    donated. ``params`` values are jax arrays — or piece-trees for
+    packed RNN parameters (:func:`plan_param_layouts`). ``inputs`` holds
+    batch data/labels plus any frozen (non-trainable) parameters.
+
+    ``compute_dtype`` mirrors SPMDTrainer mixed precision: fp32 master
+    params, 2-D+ leaves cast once inside the step so the MXU sees bf16
+    operands — including embedding tables, which are cast BEFORE the
+    gather (casting after would stream the full fp32 activation).
+    """
+
+    def __init__(self, symbol, optimizer, param_names: Sequence[str],
+                 compute_dtype=None, donate: bool = True,
+                 name: str = "fused-step"):
+        self._symbol = symbol
+        self._optimizer = optimizer
+        self._param_names = list(param_names)
+        self._eval_fn = build_graph_eval(symbol)
+        self.needs_rng = bool(getattr(self._eval_fn, "needs_rng", True))
+        self.layouts = {n: lo for n, lo in plan_param_layouts(symbol).items()
+                        if n in self._param_names}
+        self.donate = bool(donate)
+        self.guard = CompileGuard(name)
+        self._kind = type(optimizer).__name__.lower()
+        self._init_state, update = functional_update(optimizer)
+
+        # static per-param wd / lr multipliers (reference: set_wd_mult —
+        # biases/BN params get wd 0); the dynamic base lr stays an input
+        wd_by_name = {n: float(optimizer.wd * optimizer.wd_mult.get(n, 1.0))
+                      for n in self._param_names}
+        lr_mult = {n: float(optimizer.lr_mult.get(n, 1.0))
+                   for n in self._param_names}
+        eval_fn = self._eval_fn
+        cdt = jnp.dtype(compute_dtype) if compute_dtype else None
+        self.compute_dtype = cdt
+
+        def cast(v):
+            if cdt is not None and v.ndim >= 2 and v.dtype == jnp.float32:
+                return v.astype(cdt)
+            return v
+
+        def step(params, states, aux, inputs, rng, lr, t):
+            def loss_f(p):
+                merged = dict(inputs)
+                for n, v in p.items():
+                    merged[n] = jax.tree_util.tree_map(cast, v)
+                outs, aux_up = eval_fn(merged, aux, rng, True)
+                return outs, aux_up
+
+            (outs, aux_up), vjp_fn = jax.vjp(loss_f, params)
+            # terminal loss layers (SoftmaxOutput & friends) define their
+            # own gradient and ignore the head cotangent — ones matches
+            # the executor's default backward contract
+            cts = [jnp.ones_like(o) for o in outs]
+            zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+            (grads,) = vjp_fn((cts, zero_aux))
+            new_params, new_states = {}, {}
+            for n in params:
+                w_leaves, treedef = jax.tree_util.tree_flatten(params[n])
+                g_leaves = jax.tree_util.tree_leaves(grads[n])
+                nw, ns = [], []
+                for w, g, s in zip(w_leaves, g_leaves, states[n]):
+                    w2, s2 = update(w, g, s, lr * lr_mult[n],
+                                    wd_by_name[n], t)
+                    nw.append(w2)
+                    ns.append(s2)
+                new_params[n] = jax.tree_util.tree_unflatten(treedef, nw)
+                new_states[n] = ns
+            new_aux = dict(aux)
+            new_aux.update(aux_up)
+            return new_params, new_states, new_aux, outs
+
+        self._step_fn = jax.jit(self.guard.wrap(step),
+                                donate_argnums=(0, 1, 2) if donate else ())
+
+    # -- state management ----------------------------------------------------
+
+    def init(self, arg_params: Dict, aux_params: Dict,
+             imp_states: Optional[Dict[int, object]] = None):
+        """Build (params, states, aux) from name->array dicts.
+
+        ``imp_states`` maps param INDEX (position in ``param_names``) to
+        an imperative ``create_state`` value; present entries seed the
+        functional state (checkpoint-resumed momentum survives), missing
+        ones start at the optimizer's zero state.
+        """
+        params, states = {}, {}
+        for i, n in enumerate(self._param_names):
+            v = _to_jax(arg_params[n])
+            imp = (imp_states or {}).get(i)
+            if n in self.layouts:
+                pieces = self.layouts[n].split(v)
+                params[n] = pieces
+                if imp is not None:
+                    fs = _imp_state_to_functional(self._kind, imp)
+                    states[n] = self._split_state(n, fs)
+                else:
+                    states[n] = [self._init_state(w)
+                                 for w in jax.tree_util.tree_leaves(pieces)]
+            else:
+                params[n] = v
+                if imp is not None:
+                    states[n] = [_imp_state_to_functional(self._kind, imp)]
+                else:
+                    states[n] = [self._init_state(v)]
+        aux = {n: _to_jax(v) for n, v in aux_params.items()}
+        return params, states, aux
+
+    def _split_state(self, name, fstate):
+        """Split a packed-shaped functional state to align with pieces."""
+        lo = self.layouts[name]
+        if _is_empty(fstate):               # stateless rule
+            return [() for _ in range(4 * lo.num_layers
+                                      * (2 if lo.bidirectional else 1))]
+        if isinstance(fstate, tuple):       # adam (mean, var)
+            parts = [jax.tree_util.tree_leaves(lo.split(f)) for f in fstate]
+            return [tuple(p[i] for p in parts) for i in range(len(parts[0]))]
+        return jax.tree_util.tree_leaves(lo.split(fstate))
+
+    def _join_state(self, name, leaves):
+        lo = self.layouts[name]
+        if not leaves or _is_empty(leaves[0]):
+            return ()
+        if isinstance(leaves[0], tuple):    # adam (mean, var) per leaf
+            joined = []
+            for j in range(len(leaves[0])):
+                tmpl = lo.split(jnp.zeros(
+                    sum(int(np.prod(l[j].shape)) for l in leaves),
+                    leaves[0][j].dtype))
+                flat = [l[j] for l in leaves]
+                joined.append(lo.join(jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(tmpl), flat)))
+            return tuple(joined)
+        tmpl = lo.split(jnp.zeros(
+            sum(int(np.prod(l.shape)) for l in leaves), leaves[0].dtype))
+        return lo.join(jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(tmpl), leaves))
+
+    def packed_params(self, params: Dict) -> Dict:
+        """params dict with piece-trees re-joined to flat packed vectors."""
+        out = {}
+        for n, v in params.items():
+            out[n] = self.layouts[n].join(v) if n in self.layouts else v
+        return out
+
+    def packed_state(self, name, state_leaves):
+        """Functional state leaves -> one imperative-shaped state value."""
+        if name in self.layouts:
+            return self._join_state(name, state_leaves)
+        return state_leaves[0]
+
+    def __call__(self, params, states, aux, inputs, rng, lr, t):
+        with _quiet_donation():
+            return self._step_fn(params, states, aux, inputs, rng, lr, t)
+
+
+# ---------------------------------------------------------------------------
+# Module front end
+# ---------------------------------------------------------------------------
+
+class ModuleStepper:
+    """Drives a bound Module through :class:`FusedStep`.
+
+    Owns the device-side training state between ``step`` calls;
+    ``sync_to_module`` writes parameters/aux back through the executor's
+    NDArrays and the optimizer's Updater states, so ``get_params`` /
+    checkpointing / ``save_optimizer_states`` see exactly what a
+    forward_backward+update loop would have produced.
+    """
+
+    def __init__(self, module, fused: FusedStep, frozen: Sequence[str]):
+        self._module = module
+        self._fused = fused
+        self._frozen = list(frozen)
+        exec_ = module._exec
+        # updater states are keyed by position in the MODULE's param list
+        # (the _update_params enumeration); remap to the fused (trainable
+        # only) positions so resumed momentum lands on the right weight
+        self._mod_index = {n: i for i, n in enumerate(module._param_names)}
+        imp_states = None
+        updater = module._updater
+        if updater is not None and updater.states:
+            imp_states = {i: updater.states[self._mod_index[n]]
+                          for i, n in enumerate(fused._param_names)
+                          if self._mod_index[n] in updater.states}
+        self._params, self._states, self._aux = fused.init(
+            {n: exec_.arg_dict[n] for n in fused._param_names},
+            {n: exec_.aux_dict[n] for n in exec_._aux_names},
+            imp_states=imp_states)
+        self._num_update = module._optimizer.num_update
+        self._synced = True
+        self._stale = False
+
+    @property
+    def guard(self):
+        return self._fused.guard
+
+    def invalidate(self):
+        """Mark the device-side state stale (the module's parameters were
+        written externally — set_params/init_params/loaded states); the
+        next step re-pulls from the module. The compiled step survives:
+        refresh rebuilds state, not the program, so no retrace."""
+        self._stale = True
+
+    def refresh(self):
+        mod = self._module
+        exec_ = mod._exec
+        updater = mod._updater
+        imp_states = None
+        if updater is not None and updater.states:
+            imp_states = {i: updater.states[self._mod_index[n]]
+                          for i, n in enumerate(self._fused._param_names)
+                          if self._mod_index[n] in updater.states}
+        self._params, self._states, self._aux = self._fused.init(
+            {n: exec_.arg_dict[n] for n in self._fused._param_names},
+            {n: exec_.aux_dict[n] for n in exec_._aux_names},
+            imp_states=imp_states)
+        self._num_update = mod._optimizer.num_update
+        self._synced = True
+        self._stale = False
+
+    def step(self, data_batch):
+        from .. import random as _random
+        from ..ndarray import NDArray
+        from ..ndarray.ndarray import _as_jax
+
+        if self._stale:
+            self.refresh()
+        mod = self._module
+        exec_ = mod._exec
+        inputs = {}
+        for name, val in mod._input_dict(data_batch).items():
+            inputs[name] = _as_jax(val, dtype=exec_.arg_dict[name].dtype)
+        for name in self._frozen:
+            inputs[name] = exec_.arg_dict[name]._data
+        rng = (_random.next_key() if self._fused.needs_rng
+               else _null_key())
+        self._num_update += 1
+        opt = mod._optimizer
+        lr = jnp.float32(opt.lr if opt.lr_scheduler is None
+                         else opt.lr_scheduler(self._num_update))
+        t = jnp.float32(self._num_update)
+        self._params, self._states, self._aux, outs = self._fused(
+            self._params, self._states, self._aux, inputs, rng, lr, t)
+        exec_.outputs = [NDArray(o) for o in outs]
+        mod._params_dirty = True
+        self._synced = False
+        return outs
+
+    def sync_to_module(self):
+        """Write params/aux/optimizer-state back into the module."""
+        if self._synced:
+            return
+        mod = self._module
+        exec_ = mod._exec
+        packed = self._fused.packed_params(self._params)
+        for n, v in packed.items():
+            exec_.arg_dict[n]._set_data(v)
+        for n, v in self._aux.items():
+            exec_.aux_dict[n]._set_data(v)
+        opt = mod._optimizer
+        updater = mod._updater
+        kind = self._fused._kind
+        for n in self._fused._param_names:
+            mi = self._mod_index[n]
+            opt._index_update_count[mi] = self._num_update
+            if updater is None:
+                continue
+            fstate = self._fused.packed_state(n, self._states[n])
+            if mi not in updater.states:
+                updater.states[mi] = opt.create_state(mi, exec_.arg_dict[n])
+                updater.states_synced[mi] = True
+            if updater.states[mi] is not None:
+                _functional_state_to_imp(kind, fstate, updater.states[mi])
+        opt.num_update = max(opt.num_update, self._num_update)
+        self._synced = True
+
+
+def module_stepper(module, compute_dtype=None, donate=True):
+    """Build a :class:`ModuleStepper` for ``module``, or return None.
+
+    Eligibility is conservative — anything the fused program cannot
+    reproduce exactly falls back to the imperative
+    forward_backward+update path:
+    kvstore-free local update, dense gradients, ``grad_req='write'``,
+    no ctx-group placement / multi-context mesh / module states, and an
+    optimizer with a functional rule. ``MXTPU_FUSED_STEP=0`` disables
+    the fused path globally.
+    """
+    if not getenv("MXTPU_FUSED_STEP", 1, int):
+        return None
+    if not (module.binded and module.params_initialized
+            and module.optimizer_initialized):
+        return None
+    if module._kvstore is not None or module._update_on_kvstore:
+        return None
+    if getattr(module, "_dp_mesh", None) is not None:
+        return None
+    if getattr(module, "_group2ctxs", None):
+        return None
+    if module._state_names or module.inputs_need_grad:
+        return None
+    if not has_functional_update(module._optimizer):
+        return None
+    exec_ = module._exec
+    if getattr(exec_, "_sparse_specs", None):
+        return None
+    if not hasattr(exec_, "_grad_req"):
+        return None
+    frozen = []
+    for n in module._param_names:
+        req = exec_._grad_req.get(n, "null")
+        if req == "write":
+            continue
+        if req == "null":
+            frozen.append(n)
+        else:
+            return None     # grad_req='add' accumulation stays imperative
+    trainable = [n for n in module._param_names if n not in frozen]
+    if not trainable:
+        return None
+    try:
+        fused = FusedStep(module._symbol, module._optimizer, trainable,
+                          compute_dtype=compute_dtype, donate=donate,
+                          name=f"module-step:{type(module).__name__}")
+        stepper = ModuleStepper(module, fused, frozen)
+    except MXNetError:
+        return None
+    # register on the module so get_params / checkpointing / the classic
+    # forward path sync the donated device state before touching the
+    # executor's (now-consumed) buffers
+    if hasattr(module, "_fused_stepper"):
+        module._fused_stepper = stepper
+    return stepper
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer apply (Gluon Trainer + model._update_params)
+# ---------------------------------------------------------------------------
+
+class FusedOptimizerApply:
+    """Apply one optimizer to N parameters in ONE donated program.
+
+    Replaces N per-parameter ``imperative_invoke`` dispatches (reference:
+    kvstore push/pull + Updater loop) with a single jit call. Gradients
+    are pre-multiplied by the dynamic ``rescale`` input, so per-step
+    rescale changes (Gluon's ``scale / batch_size``) never retrace; lr /
+    wd / t are traced vectors for the same reason.
+    """
+
+    def __init__(self, optimizer, name="fused-update", donate=True):
+        self._opt = optimizer
+        self._kind = type(optimizer).__name__.lower()
+        if not has_functional_update(optimizer):
+            raise MXNetError(
+                f"optimizer {self._kind!r} has no functional rule")
+        self._init_state, update = functional_update(optimizer,
+                                                     rescale_override=1.0)
+        self.guard = CompileGuard(name, expected=1)
+
+        def apply(ws, gs, ss, lrs, wds, ts, rescale):
+            new_ws, new_ss = [], []
+            for i, (w, g, s) in enumerate(zip(ws, gs, ss)):
+                # rescale in the gradient's own dtype: the imperative op
+                # multiplies by a weak python float, which never promotes
+                g = g * rescale.astype(g.dtype)
+                w2, s2 = update(w, g, s, lrs[i], wds[i], ts[i])
+                new_ws.append(w2)
+                new_ss.append(s2)
+            return new_ws, new_ss
+
+        self._jit = jax.jit(self.guard.wrap(apply),
+                            donate_argnums=(0, 2) if donate else ())
+
+    def state_to_functional(self, state):
+        return _imp_state_to_functional(self._kind, state)
+
+    def writeback_state(self, fstate, existing):
+        return _functional_state_to_imp(self._kind, fstate, existing)
+
+    def __call__(self, ws, gs, ss, lrs, wds, ts, rescale):
+        # a changed parameter-set signature (a layer frozen/unfrozen,
+        # a different module sharing this updater) is a LEGITIMATE new
+        # program, not trace-cache thrash — raise the guard's budget so
+        # only same-signature recompiles count as retraces
+        sig = tuple((tuple(w.shape), str(w.dtype)) for w in ws)
+        last = getattr(self, "_last_sig", None)
+        if last is not None and sig != last:
+            self.guard.expected += 1
+        self._last_sig = sig
+        with _quiet_donation():
+            return self._jit(list(ws), list(gs), list(ss),
+                             jnp.asarray(lrs, jnp.float32),
+                             jnp.asarray(wds, jnp.float32),
+                             jnp.asarray(ts, jnp.float32),
+                             jnp.float32(rescale))
+
+
+def apply_fused_triples(apply, opt, triples, get_state):
+    """Shared convert→count→apply→writeback core for the Gluon Trainer
+    and the ``_update_params`` fused paths.
+
+    ``triples``: ``(index, weight_nd, grad_nd)``; ``get_state(index)``
+    returns the imperative optimizer state (caller creates missing
+    ones first). ALL states are converted before any counter is bumped,
+    so a conversion failure falls back to the imperative loop with the
+    update counts untouched (no double-counting). Returns False on that
+    fallback, True when the fused program applied and wrote back.
+    """
+    try:
+        fss = [apply.state_to_functional(get_state(i))
+               for i, _w, _g in triples]
+    except (MXNetError, TypeError, ValueError):
+        return False
+    ws, gs, ss, lrs, wds, ts = [], [], [], [], [], []
+    for (i, w, g), fs in zip(triples, fss):
+        opt._update_count(i)
+        lrs.append(opt._get_lr(i))
+        wds.append(opt._get_wd(i))
+        ts.append(opt._index_update_count[i])
+        ws.append(w._data)
+        gs.append(g._data)
+        ss.append(fs)
+    new_ws, new_ss = apply(ws, gs, ss, lrs, wds, ts, opt.rescale_grad)
+    for (i, w, _g), nw, ns in zip(triples, new_ws, new_ss):
+        w._set_data(nw)
+        state = get_state(i)
+        if state is not None:
+            apply.writeback_state(ns, state)
+    return True
+
+
+def _dense_ndarray(x):
+    return (hasattr(x, "_data")
+            and getattr(x, "stype", "default") == "default")
+
+
+def fused_update_params(param_arrays, grad_arrays, updater, param_names):
+    """Fused path for ``model._update_params`` (local, kvstore-free).
+
+    Returns True when the whole update was applied in one program;
+    False means the caller must run the imperative per-param loop.
+    Updater-state bookkeeping (creation, update counters) matches the
+    imperative path so optimizer-state checkpoints are identical.
+    """
+    if not getenv("MXTPU_FUSED_STEP", 1, int):
+        return False
+    opt = updater.optimizer
+    if not has_functional_update(opt):
+        return False
+    live = []
+    for index, (w, g) in enumerate(zip(param_arrays, grad_arrays)):
+        if g is None or (isinstance(g, list) and g[0] is None):
+            continue
+        if isinstance(w, list) or isinstance(g, list):
+            return False
+        if not (_dense_ndarray(w) and _dense_ndarray(g)):
+            return False
+        live.append((index, w, g))
+    if not live:
+        return True
+    apply = getattr(updater, "_fused_apply", None)
+    if apply is None or apply._opt is not opt:
+        try:
+            # donate=False: the executor's last-forward snapshot (_last)
+            # aliases these weight buffers — Monitor's internal_outputs
+            # replay after update() must keep seeing live arrays. The
+            # fused win here is the 1-dispatch update; whole-step
+            # donation lives in FusedStep where the module owns aliasing
+            apply = FusedOptimizerApply(opt, name="updater-apply",
+                                        donate=False)
+        except MXNetError:
+            return False
+        updater._fused_apply = apply
+    for index, w, _g in live:
+        if index not in updater.states:
+            updater.states[index] = opt.create_state(index, w)
+            updater.states_synced[index] = True
+    return apply_fused_triples(apply, opt, live,
+                               lambda i: updater.states[i])

@@ -328,6 +328,73 @@ def test_registry_consistency_ops_and_negatives(tmp_path):
 # suppressions + baseline + CLI
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# undonated-hot-jit
+# ---------------------------------------------------------------------------
+
+def test_undonated_hot_jit_true_positives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+        from mxnet_tpu.analysis.annotations import hot_path
+
+        class Trainer:
+            @hot_path("per-step path")
+            def bind(self):
+                def step(params, states, inputs):
+                    return params
+                self._fn = jax.jit(step)            # state, no donation
+
+            @hot_path
+            def rebind(self):
+                self._fn = jax.jit(self.mystery)    # unresolvable: flag
+    """)
+    hits = [f for f in findings if f.rule == "undonated-hot-jit"]
+    assert len(hits) == 2
+    assert {f.context for f in hits} == {"Trainer.bind", "Trainer.rebind"}
+
+
+def test_undonated_hot_jit_true_negatives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+        from mxnet_tpu.analysis.annotations import hot_path
+
+        class Trainer:
+            @hot_path("per-step path")
+            def bind(self):
+                def step(params, states, inputs):
+                    return params
+                # donated: the whole point
+                self._fn = jax.jit(step, donate_argnums=(0, 1))
+                # donate_argnames works too
+                self._g = jax.jit(step, donate_argnames=("params",))
+
+            @hot_path
+            def probe(self):
+                # single-arg helper: no (state, inputs) pair to donate
+                self._scalar = jax.jit(lambda x: x.ravel()[0])
+
+        def cold_path():
+            def step(params, states):
+                return params
+            return jax.jit(step)                    # not on the hot path
+    """)
+    assert "undonated-hot-jit" not in rules_of(findings)
+
+
+def test_undonated_hot_jit_suppression(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+        from mxnet_tpu.analysis.annotations import hot_path
+
+        @hot_path
+        def bind(self):
+            def step(params, inputs):
+                return params
+            return jax.jit(step)  # tpu-lint: disable=undonated-hot-jit — aliased reads
+    """)
+    assert "undonated-hot-jit" not in rules_of(findings)
+
+
 _BAD_SNIPPET = """
     import jax
 
@@ -473,7 +540,7 @@ def test_cli_exit_codes_and_write_baseline(tmp_path, capsys):
     out = capsys.readouterr().out
     for rule in ("host-sync-under-trace", "trace-time-side-effects",
                  "retrace-amplification", "untracked-rng",
-                 "registry-consistency"):
+                 "registry-consistency", "undonated-hot-jit"):
         assert rule in out
 
 
