@@ -131,10 +131,27 @@ ci-perf: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_perf_runtime.py \
 	    -m 'not slow' -x -q
 
+# stage 12: elastic chaos smoke — the 8-device CPU mesh with
+# MXNET_TPU_FAULT_PLAN killing a device at a seeded probe: detect →
+# checkpoint → re-mesh (8→4 past the batch-divisibility wall) →
+# re-shard → resume with the bitwise-identical batch stream and
+# allclose losses vs an uninterrupted run; plus the mid-step collective
+# death (restore + rewind). Injectable clocks only; `timeout` bounds
+# the stage so a reintroduced hang fails instead of wedging the runner
+# (docs/how_to/elastic_training.md)
+ci-elastic: ci-native
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	    MXNET_TPU_FAULT_PLAN="mesh.probe:4:ioerror" \
+	    MXNET_TPU_FAULT_SEED=7 \
+	    python ci/elastic_chaos_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
-    ci-frontends ci-dryrun ci-resilience ci-serving ci-data ci-perf
+    ci-frontends ci-dryrun ci-resilience ci-serving ci-data ci-perf \
+    ci-elastic
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
-        ci-serving ci-data ci-perf
+        ci-serving ci-data ci-perf ci-elastic

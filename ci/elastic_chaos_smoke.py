@@ -1,0 +1,159 @@
+"""Elastic-training chaos smoke (`make ci-elastic`, ci/pipeline.yml).
+
+Pod-scale chaos on the 8-device CPU mesh: MXNET_TPU_FAULT_PLAN (the env
+spec this script runs under — see the Makefile stage) arms a seeded
+device kill at the `mesh.probe` site; a second, explicitly-armed plan
+exercises the harder `mesh.collective` mid-step death. Asserts:
+
+1. the loss is detected and the run re-meshes (8 -> 4 here: 7, 6, 5
+   survivors all fail the 16-sample global-batch divisibility wall) —
+   checkpoint -> re-shard through the parallel/sharding.py rules ->
+   resume, with `resilience.stats()["elastic"]` reporting exactly the
+   damage;
+2. the batch stream is BITWISE identical to an uninterrupted run
+   (shuffled iterator included) and per-step losses + final params stay
+   allclose — the topology changed, the trajectory did not;
+3. a mid-step collective death (donated buffers untrusted) restores the
+   newest atomic checkpoint onto the survivors, rewinds the iterator,
+   and still reproduces the exact stream;
+4. zero real sleeps: the controller runs on an injected fake clock and
+   the resume-latency counters move on it.
+
+Exits non-zero on any violation. docs/how_to/elastic_training.md
+documents the subsystem.
+"""
+import hashlib
+import itertools
+import os
+import sys
+import tempfile
+
+# 8 virtual CPU devices, forced before any jax import (same contract as
+# tests/conftest.py)
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np                                        # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import models, resilience                  # noqa: E402
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh     # noqa: E402
+from mxnet_tpu.resilience import FaultPlan, faults        # noqa: E402
+from mxnet_tpu.resilience.elastic import ElasticConfig    # noqa: E402
+
+BATCH = 16
+EPOCHS = 3
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def tonp(v):
+    return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+
+def run(plan=None, ckdir=None):
+    """One 3-epoch fit over a fixed shuffled 48-sample set; returns
+    (trainer, batch-stream hashes, per-step losses)."""
+    faults.disarm()
+    resilience.reset_stats()
+    mesh = make_mesh({"data": 8})
+    net = models.get_symbol("mlp", num_classes=10)
+    tr = SPMDTrainer(
+        net, optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0 / BATCH), mesh=mesh)
+    mx.random.seed(42)
+    tr.bind(data_shapes={"data": (BATCH, 784)},
+            label_shapes={"softmax_label": (BATCH,)})
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 10, (48,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=True, seed=5)
+    hashes, losses = [], []
+
+    def record(param):
+        inp = param.locals["inputs"]
+        h = hashlib.sha256()
+        for n in sorted(inp):
+            h.update(np.ascontiguousarray(tonp(inp[n])).tobytes())
+        hashes.append(h.hexdigest())
+        p = np.asarray(param.locals["step_outs"][0])
+        lab = tonp(inp["softmax_label"]).astype(int)
+        losses.append(float(-np.log(p[np.arange(len(lab)), lab]
+                                    + 1e-9).mean()))
+
+    if plan is None:
+        tr.fit(it, num_epoch=EPOCHS, batch_end_callback=record)
+    else:
+        faults.arm(plan)
+        fake_clock = itertools.count()      # injectable: no real sleeps
+        tr.fit(it, num_epoch=EPOCHS, checkpoint_dir=ckdir,
+               checkpoint_batch_period=1, batch_end_callback=record,
+               elastic=True,
+               elastic_config=ElasticConfig(
+                   clock=lambda: float(next(fake_clock))))
+        faults.disarm()
+    return tr, hashes, losses
+
+
+def compare(tag, ref, chaos):
+    tr_ref, h_ref, l_ref = ref
+    tr_ch, h_ch, l_ch = chaos
+    check(h_ch == h_ref,
+          f"{tag}: batch stream bitwise-identical "
+          f"({len(h_ch)} batches)")
+    check(np.allclose(l_ch, l_ref, rtol=1e-4, atol=1e-5),
+          f"{tag}: per-step losses allclose to uninterrupted run")
+    for n in tr_ref.params:
+        check(np.allclose(np.asarray(tr_ch.params[n]),
+                          np.asarray(tr_ref.params[n]),
+                          rtol=1e-4, atol=1e-5),
+              f"{tag}: final param {n} allclose")
+
+
+def main():
+    spec = os.environ.get(resilience.faults.ENV_PLAN)
+    check(spec and "mesh.probe" in spec,
+          f"MXNET_TPU_FAULT_PLAN arms mesh.probe (got {spec!r})")
+    seed = int(os.environ.get(resilience.faults.ENV_SEED, "0"))
+
+    ref = run()
+    check(len(ref[1]) == EPOCHS * 3, "reference run: 9 steps over 3 epochs")
+
+    # scenario 1: the env-armed plan kills a device at a seeded probe
+    with tempfile.TemporaryDirectory() as d:
+        chaos = run(FaultPlan.from_env(spec, seed=seed), d)
+        est = resilience.stats()["elastic"]
+        check(est["losses_detected"] == 1,
+              f"device loss detected (stats: {est})")
+        check(est["remeshes"] == 1, "exactly one re-mesh")
+        check(len(chaos[0]._mesh.devices.flat) == 4,
+              "re-meshed 8 -> 4 devices (16-batch divisibility wall)")
+        check(est["last_resume_s"] > 0.0,
+              "resume latency measured on the injected clock")
+        compare("probe-loss", ref, chaos)
+
+    # scenario 2: mid-step collective death -> restore + rewind
+    with tempfile.TemporaryDirectory() as d:
+        plan = FaultPlan(seed=3).arm("mesh.collective", nth=5,
+                                     exc="ioerror")
+        chaos = run(plan, d)
+        est = resilience.stats()["elastic"]
+        check(est["collective_failures"] == 1 and est["remeshes"] == 1,
+              f"collective death recovered via checkpoint (stats: {est})")
+        compare("collective-death", ref, chaos)
+
+    print("elastic chaos smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -137,8 +137,12 @@ class ProgressBar:
 class ResilienceMonitor:
     """Speedometer-style batch-end callback surfacing the fault-tolerance
     counters (resilience.stats()): I/O retries, retry give-ups,
-    injected-fault fires per site, and the data-pipeline quarantine
-    counters (records/batches skipped, shards quarantined, resyncs).
+    injected-fault fires per site, the data-pipeline quarantine
+    counters (records/batches skipped, shards quarantined, resyncs),
+    and the elastic-training counters (device losses/additions,
+    re-meshes, collective failures, resume latency) — probe counts are
+    deliberately excluded from the movement test so a healthy elastic
+    run (probing every batch, finding nothing) stays silent.
     Logs every ``frequent`` batches but only when a counter moved since
     the last report, so a healthy run stays silent; when it observes an
     epoch transition (the first batch of the next epoch) it reports the
@@ -149,6 +153,8 @@ class ResilienceMonitor:
 
     _DATA_KEYS = ("records_skipped", "batches_skipped",
                   "shards_quarantined", "resyncs")
+    _ELASTIC_KEYS = ("losses_detected", "devices_added", "remeshes",
+                     "collective_failures")
 
     def __init__(self, frequent=50):
         self.frequent = max(1, int(frequent))
@@ -163,7 +169,9 @@ class ResilienceMonitor:
                 + sum(stats["retry"]["giveups"].values())
                 + sum(stats["faults"]["fired"].values())
                 + sum(stats.get("data", {}).get(k, 0)
-                      for k in cls._DATA_KEYS))
+                      for k in cls._DATA_KEYS)
+                + sum(stats.get("elastic", {}).get(k, 0)
+                      for k in cls._ELASTIC_KEYS))
 
     def _report_epoch_health(self, epoch, data):
         """Per-epoch quarantine health: what this epoch's pipeline
@@ -204,6 +212,14 @@ class ResilienceMonitor:
         for key in self._DATA_KEYS:
             if data.get(key, 0):
                 parts.append(f"data[{key}]={data[key]}")
+        elastic = self.stats.get("elastic", {})
+        if any(elastic.get(k, 0) for k in self._ELASTIC_KEYS):
+            for key in self._ELASTIC_KEYS:
+                if elastic.get(key, 0):
+                    parts.append(f"elastic[{key}]={elastic[key]}")
+            parts.append(f"elastic[probes]={elastic.get('probes', 0)}")
+            parts.append("elastic[last_resume_s]="
+                         f"{elastic.get('last_resume_s', 0.0):.3f}")
         if parts:
             logging.warning("Epoch[%d] Batch [%d]\tResilience: %s",
                             param.epoch, param.nbatch, "\t".join(parts))

@@ -94,6 +94,31 @@ class SPMDTrainer:
         initializer = initializer or _init_mod.Xavier(magnitude=2.0)
         known = dict(data_shapes)
         known.update(label_shapes or {})
+        # remembered for elastic re-binds: remesh() re-runs bind with the
+        # same global shapes on a different mesh (resilience/elastic.py)
+        self._bound_data_shapes = dict(data_shapes)
+        self._bound_label_shapes = dict(label_shapes or {})
+        self._global_batch = (int(known[self._data_names[0]][0])
+                              if self._data_names
+                              and self._data_names[0] in known else None)
+        # validate up front, BEFORE any state is replaced: failing after
+        # params/_step_fn were rebuilt would leave a torn half-bound
+        # trainer behind the error. This is the first wall an elastic
+        # re-mesh hits when it picks an incompatible device count, so
+        # it must be the framework's own error (raised while the
+        # trainer is still intact), not a jax shape blowup at step one.
+        if "data" in self._mesh.axis_names:
+            dsize = self._mesh.shape["data"]
+            for n in list(self._data_names) + list(self._label_names):
+                shp = known.get(n)
+                if shp and shp[0] % dsize:
+                    raise MXNetError(
+                        f"global batch size {shp[0]} for input '{n}' is "
+                        f"not divisible by the mesh 'data' axis "
+                        f"({dsize} devices); use a global batch "
+                        "divisible by the data-parallel degree, or "
+                        "re-mesh to a compatible device count (elastic "
+                        "re-meshing selects one automatically)")
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**known)
         arg_names = self._symbol.list_arguments()
         aux_names = self._symbol.list_auxiliary_states()
@@ -240,7 +265,7 @@ class SPMDTrainer:
                        for n, v in new_aux.items()}
             return new_params, new_states, new_aux, outs
 
-        self.retrace_guard.count = 0    # fresh program after (re)bind
+        self.retrace_guard.rebind()     # fresh program after (re)bind
         self._step_fn = jax.jit(self.retrace_guard.wrap(step),
                                 donate_argnums=(0, 1, 2) if self._donate
                                 else ())
@@ -281,7 +306,12 @@ class SPMDTrainer:
         # buffers, so re-running a half-executed step is never safe —
         # recovery from a failed step is restore_latest()+resume
         from ..resilience import fault_point
+        from ..resilience.elastic import check_collective
         fault_point("trainer.step")
+        # mesh.collective: a participant dying mid-collective surfaces as
+        # DeviceLost; fit(elastic=True) recovers via checkpoint restore
+        # onto the surviving devices (resilience/elastic.py)
+        check_collective()
         inputs = {}
         for n, v in batch.items():
             if isinstance(v, NDArray):
@@ -489,11 +519,49 @@ class SPMDTrainer:
                                 step, err)
         return None
 
+    # -- elastic re-mesh ----------------------------------------------------
+
+    def remesh(self, mesh, carry_state=True):
+        """Re-bind this trainer onto ``mesh`` (an elastic topology
+        change: devices lost or added — resilience/elastic.py). The
+        partition rules re-derive every sharding for the new topology
+        (the ZeRO state specs included, so the cross-replica update
+        layout survives the change) and the step program recompiles
+        exactly once — the CompileGuard treats a rebind as a new
+        program lifetime, not a retrace.
+
+        With ``carry_state`` (the between-steps path: state is
+        consistent) params / optimizer state / aux move bitwise:
+        re-gathered to host, then re-sharded under the new mesh's
+        rules. With ``carry_state=False`` (the failed-step path) the
+        trainer re-initializes and the caller restores a checkpoint —
+        after a mid-step device loss the donated buffers are untrusted
+        and the dead device's shards are gone."""
+        if self._step_fn is None:
+            raise MXNetError("call bind() before remesh()")
+        old_params, old_states, old_aux = self.params, self.states, self.aux
+        self._mesh = mesh
+        if not carry_state:
+            self.bind(self._bound_data_shapes, self._bound_label_shapes)
+            return self
+        self.bind(self._bound_data_shapes, self._bound_label_shapes,
+                  arg_params={n: np.asarray(v)
+                              for n, v in old_params.items()},
+                  aux_params={n: np.asarray(v) for n, v in old_aux.items()})
+        # bind() built zero optimizer state on the new shardings;
+        # overwrite with the surviving state, re-gathered and re-sharded
+        # the same way (bitwise: pure data movement, no arithmetic)
+        self.states = jax.tree_util.tree_map(
+            lambda new, old: jax.device_put(np.asarray(old), new.sharding),
+            self.states, old_states)
+        return self
+
     # -- training loop ------------------------------------------------------
 
     def fit(self, train_data, num_epoch, checkpoint_dir=None,
             checkpoint_period=1, checkpoint_batch_period=None, resume=None,
-            batch_end_callback=None, epoch_end_callback=None):
+            batch_end_callback=None, epoch_end_callback=None,
+            elastic=False, elastic_config=None):
         """Minimal epoch loop over a DataIter (call bind() first):
         each batch becomes one fused SPMD step. With ``checkpoint_dir``,
         a sharded checkpoint is written every ``checkpoint_period``
@@ -504,7 +572,17 @@ class SPMDTrainer:
         when the checkpoint carries iterator state and ``train_data``
         supports ``load_state_dict`` — the exact mid-epoch batch
         position: bitwise the trajectory the uninterrupted run takes),
-        ``resume=<int>`` demands that exact ``step_<N>`` checkpoint."""
+        ``resume=<int>`` demands that exact ``step_<N>`` checkpoint.
+
+        ``elastic=True`` (requires ``checkpoint_dir``) arms the elastic
+        controller (resilience/elastic.py): the device set is probed
+        every batch, and a device lost or added mid-run triggers
+        checkpoint → re-mesh onto a compatible surviving topology →
+        re-shard → resume, with the bitwise-identical batch stream.
+        Pass a pre-built :class:`~mxnet_tpu.resilience.elastic.
+        ElasticController` as ``elastic`` to inject a custom probe/
+        health monitor; ``elastic_config`` takes an
+        :class:`~mxnet_tpu.resilience.elastic.ElasticConfig`."""
         if self._step_fn is None:
             raise MXNetError("call bind() before fit()")
         begin_epoch = 0
@@ -536,7 +614,6 @@ class SPMDTrainer:
         if resume_iter is not None:
             begin_epoch, begin_batch = apply_resume_state(train_data,
                                                           resume_iter)
-        from ..callback import BatchEndParam
         cbs = (batch_end_callback if isinstance(batch_end_callback, list)
                else [batch_end_callback]) if batch_end_callback is not None \
             else []
@@ -550,6 +627,54 @@ class SPMDTrainer:
             train_data.enable_state_snapshots()
         bperiod = max(1, int(checkpoint_batch_period)) \
             if checkpoint_batch_period else None
+        controller = None
+        if elastic:
+            from ..resilience.elastic import ElasticController
+            if isinstance(elastic, ElasticController):
+                controller = elastic      # caller-built: injectable probe
+                if elastic_config is not None:
+                    raise MXNetError(
+                        "fit(): pass elastic_config when elastic=True, "
+                        "or build the ElasticController with its config "
+                        "— not both (the controller's own config would "
+                        "silently win)")
+                if controller.trainer is not self:
+                    raise MXNetError(
+                        "fit(): the ElasticController was built for a "
+                        "different trainer — its recovery would re-mesh "
+                        "and restore that trainer while this one keeps "
+                        "the broken mesh")
+            else:
+                if not checkpoint_dir:
+                    raise MXNetError("fit(elastic=True) requires "
+                                     "checkpoint_dir")
+                controller = ElasticController(self, checkpoint_dir,
+                                               config=elastic_config)
+        if controller is None:
+            self._run_epochs(train_data, num_epoch, begin_epoch,
+                             begin_batch, checkpoint_dir, checkpoint_period,
+                             bperiod, can_snapshot, cbs,
+                             epoch_end_callback, None)
+            return self
+        from ..resilience.elastic import DeviceLost
+        while True:
+            try:
+                self._run_epochs(train_data, num_epoch, begin_epoch,
+                                 begin_batch, checkpoint_dir,
+                                 checkpoint_period, bperiod, can_snapshot,
+                                 cbs, epoch_end_callback, controller)
+                return self
+            except DeviceLost as err:
+                # a collective participant died mid-step: the donated
+                # buffers are untrusted — re-mesh onto the survivors,
+                # restore the newest checkpoint, rewind the iterator
+                begin_epoch, begin_batch = controller.recover(train_data,
+                                                              err)
+
+    def _run_epochs(self, train_data, num_epoch, begin_epoch, begin_batch,
+                    checkpoint_dir, checkpoint_period, bperiod,
+                    can_snapshot, cbs, epoch_end_callback, controller):
+        from ..callback import BatchEndParam
         # NOTE: this mid-epoch checkpoint orchestration deliberately
         # parallels BaseModule.fit (module/base_module.py) — the trainer
         # rolls whole step_<N> dirs where Module rolls labeled stems,
@@ -569,7 +694,7 @@ class SPMDTrainer:
                 nbatch = begin_batch + k
                 nseen = k + 1
                 inputs = self._batch_dict(batch)
-                self.step(inputs)
+                step_outs = self.step(inputs)  # noqa: F841 — in locals()
                 for cb in cbs:
                     cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
                                      eval_metric=None, locals=locals()))
@@ -587,6 +712,27 @@ class SPMDTrainer:
                     if prev_mid_path is not None and prev_mid_path != path:
                         shutil.rmtree(prev_mid_path, ignore_errors=True)
                     prev_mid_path = path
+                if controller is not None:
+                    # between steps the state is consistent: a detected
+                    # topology change checkpoints, re-meshes and
+                    # re-shards in place — the stream continues at the
+                    # very next batch, no rewind
+                    if controller.check(train_data, epoch=epoch,
+                                        nbatch=nbatch):
+                        # the controller checkpointed this exact state
+                        # (or reused this batch's mid-epoch save):
+                        # promote it like a mid save so an epoch-end
+                        # write at the same update count skips instead
+                        # of delete-then-rewriting the step_<N> dir —
+                        # and roll the superseded mid dir so the
+                        # one-mid-checkpoint-on-disk invariant holds
+                        last_mid_step = self._num_update
+                        cpath = controller.last_checkpoint_path
+                        if cpath:
+                            if prev_mid_path not in (None, cpath):
+                                shutil.rmtree(prev_mid_path,
+                                              ignore_errors=True)
+                            prev_mid_path = cpath
             # a mid-epoch resume whose checkpoint landed on the epoch's
             # last batch replays an empty tail: this epoch's end-of-epoch
             # callback and checkpoint already happened before the crash
@@ -625,7 +771,6 @@ class SPMDTrainer:
                         pass
                 self.save_checkpoint(checkpoint_dir, step=self._num_update,
                                      epoch=epoch + 1, iter_state=iter_state)
-        return self
 
     def _batch_dict(self, batch) -> Dict[str, np.ndarray]:
         """Map a DataBatch onto this trainer's data/label names."""

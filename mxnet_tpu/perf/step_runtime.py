@@ -193,6 +193,7 @@ class CompileGuard:
     def __init__(self, name: str, expected: int = 1):
         self.name = name
         self.expected = expected
+        self._initial_expected = expected
         self.count = 0
 
     def wrap(self, fn):
@@ -209,6 +210,20 @@ class CompileGuard:
             return fn(*args, **kwargs)
 
         return counted
+
+    def rebind(self):
+        """Start a new program lifetime: the next compile is *expected*.
+
+        The legitimate recompile case — an elastic re-mesh rebuilding
+        the donated step for a new topology (resilience/elastic.py),
+        or any deliberate re-bind — resets the counter instead of
+        raising the budget, so an unexpected retrace right after the
+        rebind still trips the guard. The budget also drops back to
+        its construction-time value: ``expected`` bumps granted to the
+        OLD program (extra deliberate lowers, signature changes) do
+        not carry over as slack the new program could retrace into."""
+        self.count = 0
+        self.expected = self._initial_expected
 
     @property
     def retraced(self) -> bool:
@@ -436,8 +451,23 @@ class FusedStep:
             new_aux.update(aux_up)
             return new_params, new_states, new_aux, outs
 
-        self._step_fn = jax.jit(self.guard.wrap(step),
-                                donate_argnums=(0, 1, 2) if donate else ())
+        self._step_body = step
+        self._compile_step()
+
+    def _compile_step(self):
+        self._step_fn = jax.jit(self.guard.wrap(self._step_body),
+                                donate_argnums=(0, 1, 2) if self.donate
+                                else ())
+
+    def rebind(self):
+        """Rebuild the donated whole-step program (an elastic topology
+        or placement change re-shards its inputs — resilience/
+        elastic.py): a FRESH jit, because the old executable aliases
+        donated buffers that no longer exist, with the guard reset so
+        the one recompile is an expected new program, not a retrace."""
+        self.guard.rebind()
+        self._compile_step()
+        return self
 
     # -- state management ----------------------------------------------------
 

@@ -16,6 +16,10 @@ Three pillars (docs/how_to/fault_tolerance.md):
   (docs/how_to/data_resilience.md): corrupt-record quarantine under
   bounded skip budgets, shard failover, and checkpointable iterator
   state for deterministic mid-epoch resume.
+- :mod:`.elastic` — elastic multichip training
+  (docs/how_to/elastic_training.md): device-loss/addition detection
+  (``mesh.probe``/``mesh.collective`` fault sites, injectable probe),
+  checkpoint → re-mesh → re-shard → bitwise-exact resume.
 
 The reference stack's ps-lite heartbeat/dead-node machinery collapsed in
 the SPMD port to "a dead process fails the collective for everyone"
@@ -24,24 +28,27 @@ the SPMD port to "a dead process fails the collective for everyone"
 """
 from __future__ import annotations
 
-from . import checkpoint, data, faults, retry  # noqa: F401
+from . import checkpoint, data, elastic, faults, retry  # noqa: F401
 from .checkpoint import (AUTO, CheckpointCorrupt, atomic_output,  # noqa: F401
                          atomic_write_bytes, find_checkpoints,
                          load_checkpoint_ex, verify_manifest,
                          write_checkpoint)
 from .data import (DataBudgetExceeded, DataGuardPolicy,  # noqa: F401
                    RecordIter, ResilientIter, ShardSet, guard)
+from .elastic import (DeviceLost, ElasticConfig,  # noqa: F401
+                      ElasticController, MeshHealth)
 from .faults import (SITES, FaultPlan, InjectedFault,  # noqa: F401
                      InjectedKill, InjectedTimeout, fault_point)
 from .retry import RetryExhausted, RetryPolicy, default_policy  # noqa: F401
 
-__all__ = ["checkpoint", "data", "faults", "retry", "FaultPlan",
+__all__ = ["checkpoint", "data", "elastic", "faults", "retry", "FaultPlan",
            "RetryPolicy", "RetryExhausted", "CheckpointCorrupt",
            "InjectedFault", "InjectedTimeout", "InjectedKill", "fault_point",
            "guarded_call", "guarded_point", "default_policy", "stats",
            "reset_stats", "AUTO", "SITES", "DataGuardPolicy",
            "DataBudgetExceeded", "ShardSet", "ResilientIter", "RecordIter",
-           "guard"]
+           "guard", "DeviceLost", "MeshHealth", "ElasticConfig",
+           "ElasticController"]
 
 
 def guarded_call(site: str, fn, *args, policy=None, **kwargs):
@@ -79,10 +86,11 @@ def stats() -> dict:
     """Combined fault + retry + data-pipeline counters (surfaced by
     ``callback.ResilienceMonitor`` and ``KVStore.num_dead_node``)."""
     return {"faults": faults.stats(), "retry": retry.stats(),
-            "data": data.stats()}
+            "data": data.stats(), "elastic": elastic.stats()}
 
 
 def reset_stats():
     faults.reset_stats()
     retry.reset_stats()
     data.reset_stats()
+    elastic.reset_stats()

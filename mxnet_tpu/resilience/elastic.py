@@ -1,0 +1,430 @@
+"""Elastic multichip training: device loss/addition, re-mesh, resume.
+
+The robustness tier so far survives process crashes (checkpoint.py),
+corrupt data (data.py), and overload (serving/); this module makes a
+*topology change* — a device lost or added mid-run — a recoverable
+event instead of a fatal one (docs/how_to/elastic_training.md):
+
+- :class:`MeshHealth` detects the change: an injectable
+  device-enumeration probe (default ``jax.devices()``) plus two fault
+  sites, ``mesh.probe`` and ``mesh.collective`` (registered in
+  :data:`~.faults.SITES`), so a seedable :class:`~.faults.FaultPlan`
+  kills a device deterministically at the Nth probe or mid-step — the
+  in-process analogue of ps-lite's heartbeat timeout.
+- :class:`ElasticController` reacts: checkpoint the consistent state
+  (the atomic-manifest machinery of checkpoint.py, mid-epoch iterator
+  state from data.py) → select the largest surviving device set whose
+  data-parallel degree divides the global batch → rebuild the mesh and
+  re-shard params/optimizer state through the ``parallel/sharding.py``
+  partition rules → resume. The batch stream is bitwise the one the
+  uninterrupted run consumes (the iterator state machinery guarantees
+  position; the *global* batch size never changes, only its split), so
+  losses stay allclose to an uninterrupted run.
+- :class:`DeviceLost` is the typed failure a collective raises when a
+  participant vanishes mid-step; ``SPMDTrainer.fit(elastic=True)``
+  catches it, restores the last good checkpoint onto the shrunken mesh
+  and rewinds the iterator (the donated step may have half-consumed
+  its buffers, so in-place continuation is never safe — see
+  ``SPMDTrainer.step``).
+
+Sharded-update layouts survive the re-mesh by construction: the rules
+in ``parallel/sharding.py`` (and the ZeRO state specs of
+``SPMDTrainer.bind``) are *functions of the mesh*, so re-binding on the
+new mesh re-derives the cross-replica sharding of arxiv 2004.13336 for
+the new topology instead of trying to migrate device-local slices.
+
+Everything is deterministic and clock-injectable: tests and the chaos
+smoke (``ci/elastic_chaos_smoke.py``) run with fake clocks and seeded
+plans, zero real sleeps.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..base import MXNetError
+from . import faults
+from .faults import InjectedFault, InjectedTimeout
+
+__all__ = ["DeviceLost", "MeshHealth", "ElasticConfig", "ElasticController",
+           "check_collective", "stats", "reset_stats",
+           "SITE_PROBE", "SITE_COLLECTIVE"]
+
+#: fault site passed on every device-enumeration probe; an injected
+#: fault here marks one currently-healthy device dead (seeded choice)
+SITE_PROBE = "mesh.probe"
+#: fault site passed inside the training step, standing in for the ICI
+#: collectives; an injected fault here raises :class:`DeviceLost`
+SITE_COLLECTIVE = "mesh.collective"
+
+
+class DeviceLost(MXNetError):
+    """A mesh participant vanished mid-step (a collective failed).
+
+    Raised by :func:`check_collective` under an armed ``mesh.collective``
+    fault; a real deployment maps its runtime's collective failure
+    (XLA's halted-collective error) to this type at the same seam.
+    ``SPMDTrainer.fit(elastic=True)`` recovers: restore the last good
+    checkpoint onto the surviving devices and rewind the iterator.
+    """
+
+
+def check_collective():
+    """Pass the ``mesh.collective`` fault site; raise :class:`DeviceLost`
+    when a fault is injected there. With no plan armed this is a single
+    ``is None`` check, so the per-step cost is nil."""
+    if faults.active_plan() is None:
+        return
+    try:
+        faults.fault_point(SITE_COLLECTIVE)
+    except (InjectedFault, InjectedTimeout) as err:
+        _count("collective_failures")
+        raise DeviceLost(
+            f"collective failed mid-step ({err}); a mesh participant is "
+            "gone — recover via checkpoint restore onto the surviving "
+            "devices (fit(elastic=True) does this automatically)") from err
+
+
+# -- counters (resilience.stats()["elastic"]) --------------------------------
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+_resume = {"last_s": 0.0, "total_s": 0.0}
+
+
+def _count(key: str, n: int = 1):
+    with _lock:
+        _counters[key] = _counters.get(key, 0) + n
+
+
+def _note_resume(seconds: float):
+    with _lock:
+        _resume["last_s"] = float(seconds)
+        _resume["total_s"] += float(seconds)
+
+
+def stats() -> dict:
+    """Elastic counters: probes, detected losses/additions, re-meshes,
+    collective failures, and checkpoint→re-mesh→resume latency (seconds,
+    as measured by the controller's injectable clock)."""
+    with _lock:
+        out = {k: _counters.get(k, 0)
+               for k in ("probes", "losses_detected", "devices_added",
+                         "remeshes", "collective_failures")}
+        out["last_resume_s"] = _resume["last_s"]
+        out["resume_total_s"] = _resume["total_s"]
+        return out
+
+
+def reset_stats():
+    with _lock:
+        _counters.clear()
+        _resume["last_s"] = 0.0
+        _resume["total_s"] = 0.0
+
+
+# -- detection ---------------------------------------------------------------
+
+class MeshHealth:
+    """Device-health monitor over an injectable enumeration probe.
+
+    ``probe`` returns the currently-visible device list (default:
+    ``jax.devices()``). Two ways a device dies:
+
+    - an injected fault at :data:`SITE_PROBE` (armed via ``FaultPlan`` /
+      ``MXNET_TPU_FAULT_PLAN="mesh.probe:N:ioerror"``) marks one
+      currently-healthy device dead — chosen by a seeded RNG (the plan's
+      seed), so the same plan kills the same device every run;
+    - :meth:`mark_failure`, called by the recovery path when a
+      collective fails mid-step.
+
+    Killed device ids stay excluded from :meth:`healthy_devices` until
+    :meth:`heal` — a lost TPU chip does not rejoin on its own. Device
+    *addition* needs no special casing: the probe simply reports more
+    devices than the current mesh uses (tests inject a growing probe).
+    """
+
+    def __init__(self, probe: Optional[Callable[[], Sequence]] = None,
+                 seed: Optional[int] = None, min_devices: int = 1):
+        if probe is None:
+            def probe():
+                import jax
+                return jax.devices()
+        self._probe = probe
+        self._seed = seed
+        self._killed: set = set()
+        self.min_devices = max(1, int(min_devices))
+
+    def _kill_seed(self) -> int:
+        if self._seed is not None:
+            return self._seed
+        plan = faults.active_plan()
+        return plan.seed if plan is not None else 0
+
+    def _kill_one(self):
+        alive = [d for d in self._probe() if d.id not in self._killed]
+        if not alive:
+            return
+        # deterministic victim: same seed + same loss ordinal -> same
+        # device, independent of call timing (the chaos smoke depends
+        # on replaying the exact failure)
+        rng = random.Random(self._kill_seed() * 1000003 + len(self._killed))
+        victim = alive[rng.randrange(len(alive))]
+        self._killed.add(victim.id)
+        _count("losses_detected")
+        logging.warning("MeshHealth: device %s lost (%d healthy remain)",
+                        victim, len(alive) - 1)
+
+    def mark_failure(self):
+        """Record a device loss observed indirectly (a failed collective
+        rather than a failed probe)."""
+        self._kill_one()
+
+    def healthy_devices(self) -> List:
+        """Enumerate currently-usable devices. Passes the ``mesh.probe``
+        fault site first: an injected fault there kills one device."""
+        _count("probes")
+        try:
+            faults.fault_point(SITE_PROBE)
+        except (InjectedFault, InjectedTimeout):
+            self._kill_one()
+        devs = [d for d in self._probe() if d.id not in self._killed]
+        if len(devs) < self.min_devices:
+            raise MXNetError(
+                f"only {len(devs)} healthy device(s) remain, below the "
+                f"elastic min_devices={self.min_devices} floor — cannot "
+                "re-mesh; restore on a repaired slice instead")
+        return devs
+
+    def heal(self):
+        """Forget recorded losses (a repaired/restarted slice)."""
+        self._killed.clear()
+
+
+# -- reaction ----------------------------------------------------------------
+
+class ElasticConfig:
+    """Tunables for :class:`ElasticController`.
+
+    ``check_period``: probe the device set every N batches (default 1).
+    ``min_devices``: refuse to re-mesh below this many devices.
+    ``max_remeshes``: give up (re-raise) after this many topology
+    changes in one ``fit`` — a flapping mesh is an outage, not elastic.
+    ``clock``: injectable monotonic clock for the resume-latency metric
+    (tests and the chaos smoke pass fakes; no real sleeps anywhere).
+    """
+
+    def __init__(self, check_period: int = 1, min_devices: int = 1,
+                 max_remeshes: int = 8,
+                 clock: Optional[Callable[[], float]] = None):
+        self.check_period = max(1, int(check_period))
+        self.min_devices = max(1, int(min_devices))
+        self.max_remeshes = int(max_remeshes)
+        self.clock = clock or time.monotonic
+
+
+class ElasticController:
+    """Drives one ``SPMDTrainer`` through topology changes.
+
+    Two entry points, both called from ``SPMDTrainer.fit``:
+
+    - :meth:`check` (between steps, state consistent): probe; when the
+      usable topology changed, checkpoint → re-mesh → re-shard the live
+      params/optimizer state in place — no rewind, the very next batch
+      continues the stream.
+    - :meth:`recover` (a step died on :class:`DeviceLost`): the donated
+      step may have half-consumed its buffers, so the live state is
+      untrusted — mark the loss, re-bind on the survivors, restore the
+      newest valid checkpoint, rewind the iterator to its recorded
+      position. Returns ``(begin_epoch, begin_batch)`` for the re-entry.
+    """
+
+    def __init__(self, trainer, checkpoint_dir: str,
+                 health: Optional[MeshHealth] = None,
+                 config: Optional[ElasticConfig] = None):
+        if not checkpoint_dir:
+            raise MXNetError("ElasticController requires a checkpoint_dir")
+        self.trainer = trainer
+        self.checkpoint_dir = checkpoint_dir
+        self.config = config or ElasticConfig()
+        self.health = health or MeshHealth(min_devices=self.config.min_devices)
+        self.health.min_devices = max(self.health.min_devices,
+                                      self.config.min_devices)
+        mesh = trainer._mesh
+        if "data" not in mesh.axis_names:
+            raise MXNetError(
+                "elastic training re-meshes along the 'data' axis; mesh "
+                f"axes {mesh.axis_names} have none")
+        self.remeshes = 0
+        self._since_check = 0
+        #: step_<N> dir of the most recent checkpoint check() wrote (or
+        #: reused); fit's loop rolls its superseded mid-epoch dirs by it
+        self.last_checkpoint_path: Optional[str] = None
+
+    # -- topology selection -------------------------------------------------
+
+    def _select(self, devices: Sequence) -> List:
+        """Largest usable prefix of ``devices``: non-data axes keep their
+        sizes (tensor/sequence/expert-parallel degree is a property of
+        the program, not the pool), the data axis takes the largest
+        count that divides the global batch."""
+        tr = self.trainer
+        mesh = tr._mesh
+        other = math.prod(s for n, s in mesh.shape.items() if n != "data")
+        batch = getattr(tr, "_global_batch", None)
+        max_data = len(devices) // other
+        for nd in range(max_data, 0, -1):
+            if nd * other < self.config.min_devices:
+                break
+            if batch is not None and batch % nd:
+                continue
+            return list(devices)[:nd * other]
+        raise MXNetError(
+            f"no usable topology for {len(devices)} healthy devices: need "
+            f"{other} device(s) per data replica and a data degree "
+            f"dividing the global batch ({batch}); at least "
+            f"{self.config.min_devices} device(s) required")
+
+    def _axes_for(self, n_devices: int) -> Dict[str, int]:
+        mesh = self.trainer._mesh
+        other = math.prod(s for n, s in mesh.shape.items() if n != "data")
+        axes = {n: (s if n != "data" else n_devices // other)
+                for n, s in mesh.shape.items()}
+        return axes
+
+    def _build_mesh(self, devices: Sequence):
+        from ..parallel.mesh import make_mesh
+        return make_mesh(self._axes_for(len(devices)), devices=devices)
+
+    def _bump_remesh(self, err=None):
+        self.remeshes += 1
+        _count("remeshes")
+        if self.remeshes > self.config.max_remeshes:
+            raise MXNetError(
+                f"mesh changed {self.remeshes} times in one fit "
+                f"(max_remeshes={self.config.max_remeshes}); the device "
+                "pool is flapping — treat as an outage") from err
+
+    # -- between-steps path -------------------------------------------------
+
+    def check(self, train_data=None, epoch: int = 0, nbatch: int = -1) -> bool:
+        """Probe (every ``check_period`` calls); on topology change,
+        checkpoint the consistent live state (with iterator position
+        when ``train_data`` can snapshot one), re-mesh, re-shard in
+        place. Returns True when a re-mesh happened."""
+        self._since_check += 1
+        if self._since_check < self.config.check_period:
+            return False
+        self._since_check = 0
+        devices = self.health.healthy_devices()
+        target = self._select(devices)
+        current = [d.id for d in self.trainer._mesh.devices.flat]
+        if [d.id for d in target] == current:
+            return False
+        if len(target) > len(current):
+            _count("devices_added", len(target) - len(current))
+        self._bump_remesh()
+        clock = self.config.clock
+        t0 = clock()
+        tr = self.trainer
+        iter_state = None
+        from .data import supports_state
+        if train_data is not None and nbatch >= 0 \
+                and supports_state(train_data):
+            try:
+                # state_dict() here is "about to fetch nbatch+1" — the
+                # exact position the re-meshed run continues from (and
+                # the rewind point if the re-mesh itself dies)
+                iter_state = {"epoch": epoch, "nbatch": nbatch + 1,
+                              "iterator": train_data.state_dict()}
+            except MXNetError:
+                # e.g. a PrefetchingIter without armed snapshots:
+                # checkpoint without a position (epoch-granularity
+                # rewind), exactly like the fit() epoch-end path
+                iter_state = None
+        # a mid-epoch (checkpoint_batch_period) save this very batch
+        # already wrote step_<num_update> with this exact state —
+        # re-saving would delete-then-rewrite the newest good
+        # checkpoint (the torn window the fresh-stem design avoids);
+        # reuse it instead. step numbers are the monotonic update
+        # counter, so an existing *valid* dir is this state. A WRITE
+        # failure here propagates as itself (disk full is a storage
+        # outage, not a device loss).
+        import os
+        step_dir = os.path.join(os.path.abspath(self.checkpoint_dir),
+                                f"step_{tr._num_update}")
+        if not os.path.exists(os.path.join(step_dir, "manifest.json")):
+            tr.save_checkpoint(self.checkpoint_dir, step=tr._num_update,
+                               epoch=epoch, iter_state=iter_state)
+        self.last_checkpoint_path = step_dir
+        try:
+            tr.remesh(self._build_mesh(target))
+        except Exception as err:    # noqa: BLE001 — see below
+            # the in-place path gathers shards still resident on the
+            # OLD mesh; with a genuinely dead device (not the simulated
+            # kill) that gather fails with a backend runtime error.
+            # Surface it as DeviceLost so fit's recovery loop takes the
+            # checkpoint-restore + iterator-rewind path (the checkpoint
+            # above just landed, so no progress is lost) — the loss is
+            # already recorded, recover() must not mark a second victim.
+            if isinstance(err, DeviceLost):
+                raise
+            lost = DeviceLost(
+                f"in-place re-shard failed ({err.__class__.__name__}: "
+                f"{err}); falling back to checkpoint restore on the "
+                "surviving devices")
+            lost.already_marked = True
+            raise lost from err
+        _note_resume(clock() - t0)
+        logging.warning(
+            "elastic: re-meshed %d -> %d devices at update %d "
+            "(checkpointed, re-sharded in place)", len(current),
+            len(target), tr._num_update)
+        return True
+
+    # -- failed-step path ---------------------------------------------------
+
+    def recover(self, train_data, err: Optional[BaseException] = None):
+        """A step raised :class:`DeviceLost`: re-bind on the survivors,
+        restore the newest valid checkpoint, rewind the iterator.
+        Returns ``(begin_epoch, begin_batch)``."""
+        if not getattr(err, "already_marked", False):
+            # a loss surfaced by check()'s failed in-place path was
+            # already recorded by the probe; only a fresh mid-step
+            # collective failure needs a victim marked here
+            self.health.mark_failure()
+        devices = self.health.healthy_devices()
+        target = self._select(devices)
+        if not getattr(err, "already_marked", False):
+            # ditto: the check() fallback already counted its re-mesh
+            # attempt against max_remeshes
+            self._bump_remesh(err)
+        clock = self.config.clock
+        t0 = clock()
+        tr = self.trainer
+        # carry_state=False: the donated step half-consumed its buffers,
+        # and on real hardware the dead device's shards are simply gone —
+        # the checkpoint, not the live mesh, is the source of truth
+        tr.remesh(self._build_mesh(target), carry_state=False)
+        restored = tr.restore_latest(self.checkpoint_dir)
+        if restored is None:
+            raise MXNetError(
+                f"device lost mid-step but {self.checkpoint_dir!r} holds "
+                "no usable checkpoint to recover from") from err
+        begin_epoch = max(getattr(tr, "_restored_epoch", 0), 0)
+        begin_batch = 0
+        iter_state = getattr(tr, "_restored_iter_state", None)
+        if iter_state is not None:
+            from .data import apply_resume_state
+            begin_epoch, begin_batch = apply_resume_state(
+                train_data, iter_state)
+        _note_resume(clock() - t0)
+        logging.warning(
+            "elastic: recovered from lost device onto %d devices — "
+            "restored step_%s, resuming at epoch %d batch %d",
+            len(target), restored, begin_epoch, begin_batch)
+        return begin_epoch, begin_batch

@@ -43,7 +43,11 @@ SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          # docs/how_to/data_resilience.md)
          "io.open_shard", "io.read_record", "io.decode",
          # serving runtime (mxnet_tpu/serving, docs/how_to/serving.md)
-         "serving.forward", "serving.load", "serving.queue")
+         "serving.forward", "serving.load", "serving.queue",
+         # elastic training (resilience/elastic.py,
+         # docs/how_to/elastic_training.md): device-enumeration probe +
+         # in-step collective — injected faults simulate device loss
+         "mesh.probe", "mesh.collective")
 
 ENV_PLAN = "MXNET_TPU_FAULT_PLAN"
 ENV_SEED = "MXNET_TPU_FAULT_SEED"

@@ -1,0 +1,502 @@
+"""Elastic multichip training (resilience/elastic.py,
+docs/how_to/elastic_training.md).
+
+Pod-scale chaos on the virtual 8-device CPU mesh: a seeded FaultPlan
+kills a device at the ``mesh.probe`` / ``mesh.collective`` fault sites,
+and the elastic controller must detect → checkpoint → re-mesh →
+re-shard → resume with the bitwise-identical batch stream and allclose
+losses versus an uninterrupted run. All clocks injectable, zero real
+sleeps (the chaos smoke ``ci/elastic_chaos_smoke.py`` runs the same
+contract under ``MXNET_TPU_FAULT_PLAN``).
+"""
+import hashlib
+import itertools
+
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+from mxnet_tpu.resilience import FaultPlan, faults
+from mxnet_tpu.resilience.elastic import (DeviceLost, ElasticConfig,
+                                          ElasticController, MeshHealth,
+                                          check_collective)
+
+BATCH = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    resilience.reset_stats()
+    yield
+    faults.disarm()
+    resilience.reset_stats()
+
+
+def _make_trainer(mesh_axes=None, devices=None, batch=BATCH,
+                  opt="sgd", opt_params=None):
+    mesh = make_mesh(mesh_axes or {"data": 8}, devices=devices)
+    s = models.get_symbol("mlp", num_classes=10)
+    tr = SPMDTrainer(
+        s, optimizer=opt,
+        optimizer_params=opt_params or dict(learning_rate=0.1, momentum=0.9,
+                                            rescale_grad=1.0 / batch),
+        mesh=mesh)
+    mx.random.seed(42)
+    tr.bind(data_shapes={"data": (batch, 784)},
+            label_shapes={"softmax_label": (batch,)})
+    return tr
+
+
+def _feed(seed=0, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    return {"data": rng.randn(batch, 784).astype(np.float32),
+            "softmax_label": rng.randint(0, 10, (batch,))
+            .astype(np.float32)}
+
+
+def _tonp(v):
+    return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+
+# ---------------------------------------------------------------------------
+# detection: MeshHealth + fault sites
+# ---------------------------------------------------------------------------
+
+def test_mesh_probe_injected_loss_is_seed_deterministic():
+    """The same armed plan kills the same device every run (the chaos
+    smoke replays failures byte-for-byte)."""
+    victims = []
+    for _ in range(2):
+        faults.arm(FaultPlan(seed=11).arm("mesh.probe", nth=2,
+                                          exc="ioerror"))
+        health = MeshHealth()
+        first = health.healthy_devices()
+        assert len(first) == 8
+        second = health.healthy_devices()     # nth=2 fires here
+        assert len(second) == 7
+        (lost,) = set(d.id for d in first) - set(d.id for d in second)
+        victims.append(lost)
+        # the loss is sticky: a later probe still excludes the victim
+        assert len(health.healthy_devices()) == 7
+        health.heal()
+        assert len(health.healthy_devices()) == 8
+        faults.disarm()
+    assert victims[0] == victims[1]
+    assert resilience.stats()["elastic"]["losses_detected"] == 2
+
+
+def test_mesh_health_min_devices_floor():
+    health = MeshHealth(min_devices=8)
+    faults.arm(FaultPlan(seed=0).arm("mesh.probe", nth=1, exc="ioerror"))
+    with pytest.raises(MXNetError, match="min_devices"):
+        health.healthy_devices()
+
+
+def test_collective_site_raises_typed_device_lost():
+    check_collective()          # disarmed: free no-op
+    faults.arm(FaultPlan(seed=0).arm("mesh.collective", nth=1,
+                                     exc="ioerror"))
+    with pytest.raises(DeviceLost, match="collective failed"):
+        check_collective()
+    faults.disarm()
+    assert resilience.stats()["elastic"]["collective_failures"] == 1
+
+
+def test_trainer_step_surfaces_device_lost():
+    tr = _make_trainer()
+    faults.arm(FaultPlan(seed=0).arm("mesh.collective", nth=1,
+                                     exc="timeout"))
+    with pytest.raises(DeviceLost):
+        tr.step(_feed())
+    faults.disarm()
+    tr.step(_feed())            # params were untouched by the failure
+    assert tr._num_update == 1
+
+
+# ---------------------------------------------------------------------------
+# the error path re-meshing hits first: batch divisibility
+# ---------------------------------------------------------------------------
+
+def test_bind_rejects_indivisible_global_batch():
+    mesh = make_mesh({"data": 8})
+    s = models.get_symbol("mlp", num_classes=10)
+    tr = SPMDTrainer(s, optimizer="sgd", mesh=mesh)
+    with pytest.raises(MXNetError, match="not divisible by the mesh "
+                                         "'data' axis"):
+        tr.bind(data_shapes={"data": (30, 784)},
+                label_shapes={"softmax_label": (30,)})
+
+
+def test_controller_selects_batch_compatible_topology():
+    """16-sample global batch, 7 survivors: 7, 6, 5 all fail the
+    divisibility wall, so the controller lands on 4 devices."""
+    tr = _make_trainer()
+    ctl = ElasticController(tr, "unused-dir")
+    chosen = ctl._select(jax.devices()[:7])
+    assert len(chosen) == 4
+    with pytest.raises(MXNetError, match="no usable topology"):
+        ElasticController(
+            tr, "d", config=ElasticConfig(min_devices=5))._select(
+                jax.devices()[:7])
+
+
+# ---------------------------------------------------------------------------
+# re-shard determinism: 8 -> 4 -> 2, bitwise after re-gather
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt,opt_params", [
+    ("sgd", dict(learning_rate=0.1, momentum=0.9,
+                 rescale_grad=1.0 / BATCH)),
+    ("adam", dict(learning_rate=1e-3, rescale_grad=1.0 / BATCH)),
+])
+def test_checkpoint_reshard_8_to_4_to_2_bitwise(tmp_path, opt, opt_params):
+    """Save under the 8-device mesh, restore under 4 and 2: the param
+    AND optimizer-state pytrees must be bitwise-equal after re-gather —
+    the round trip through the parallel/sharding.py partition rules is
+    pure data movement."""
+    tr = _make_trainer(opt=opt, opt_params=opt_params)
+    for i in range(3):
+        tr.step(_feed(i))
+    tr.save_checkpoint(str(tmp_path), step=3, epoch=0)
+    ref_p = {n: np.asarray(v) for n, v in tr.params.items()}
+    ref_s = jax.tree_util.tree_map(lambda x: np.asarray(x), tr.states)
+
+    for ndev in (4, 2):
+        tr2 = _make_trainer(mesh_axes={"data": ndev},
+                            devices=jax.devices()[:ndev],
+                            opt=opt, opt_params=opt_params)
+        tr2.restore_checkpoint(str(tmp_path), step=3)
+        assert tr2._num_update == 3
+        for n in ref_p:
+            got = np.asarray(tr2.params[n])
+            np.testing.assert_array_equal(got, ref_p[n], err_msg=n)
+        jax.tree_util.tree_map(
+            np.testing.assert_array_equal,
+            jax.tree_util.tree_map(lambda x: np.asarray(x), tr2.states),
+            ref_s)
+
+
+def test_inplace_remesh_carries_state_bitwise_and_zero_retrace():
+    """remesh() re-shards the live pytrees bitwise AND the rebuilt
+    donated program compiles exactly once — the CompileGuard rebind
+    contract of the perf/ seam."""
+    tr = _make_trainer()
+    for i in range(2):
+        tr.step(_feed(i))
+    before_p = {n: np.asarray(v) for n, v in tr.params.items()}
+    before_s = jax.tree_util.tree_map(lambda x: np.asarray(x), tr.states)
+    tr.remesh(make_mesh({"data": 4}, devices=jax.devices()[:4]))
+    for n in before_p:
+        np.testing.assert_array_equal(np.asarray(tr.params[n]),
+                                      before_p[n], err_msg=n)
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal,
+        jax.tree_util.tree_map(lambda x: np.asarray(x), tr.states),
+        before_s)
+    assert tr._num_update == 2    # counter survives the re-bind
+    tr.step(_feed(2))
+    tr.step(_feed(3))
+    assert tr.retrace_guard.count == 1        # one compile post-remesh
+    assert not tr.retrace_guard.retraced
+
+
+def test_zero_state_sharding_rederived_after_remesh():
+    """ZeRO optimizer-state sharding (arxiv 2004.13336's cross-replica
+    update layout) survives the topology change: the state spec is a
+    function of the mesh, so the 1/N slice re-derives as 1/M."""
+    tr = _make_trainer(opt_params=dict(learning_rate=0.1, momentum=0.9,
+                                       rescale_grad=1.0 / BATCH))
+    tr._shard_opt = True
+    tr.bind(data_shapes={"data": (BATCH, 784)},
+            label_shapes={"softmax_label": (BATCH,)})
+    tr.step(_feed(0))
+    leaf8 = jax.tree_util.tree_leaves(tr.states["fc1_weight"])[0]
+    assert leaf8.addressable_shards[0].data.shape == (16, 784)  # 1/8
+    before = np.asarray(leaf8)
+    tr.remesh(make_mesh({"data": 4}, devices=jax.devices()[:4]))
+    leaf4 = jax.tree_util.tree_leaves(tr.states["fc1_weight"])[0]
+    assert leaf4.addressable_shards[0].data.shape == (32, 784)  # 1/4
+    np.testing.assert_array_equal(np.asarray(leaf4), before)
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: seeded loss mid-fit -> exact resume
+# ---------------------------------------------------------------------------
+
+def _run_fit(plan=None, ckdir=None, num_epoch=3, health=None):
+    """One fit over a fixed 48-sample set (shuffled, owned RNG seed):
+    returns (trainer, batch-stream hashes, per-step losses)."""
+    faults.disarm()
+    resilience.reset_stats()
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 10, (48,)).astype(np.float32)
+    tr = _make_trainer()
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=True, seed=5)
+    hashes, losses = [], []
+
+    def record(param):
+        inp = param.locals["inputs"]
+        h = hashlib.sha256()
+        for n in sorted(inp):
+            h.update(np.ascontiguousarray(_tonp(inp[n])).tobytes())
+        hashes.append(h.hexdigest())
+        p = np.asarray(param.locals["step_outs"][0])
+        lab = _tonp(inp["softmax_label"]).astype(int)
+        losses.append(float(-np.log(p[np.arange(len(lab)), lab]
+                                    + 1e-9).mean()))
+
+    if plan is None:
+        tr.fit(it, num_epoch=num_epoch, batch_end_callback=record)
+        return tr, hashes, losses
+    faults.arm(plan)
+    fake_clock = itertools.count()
+    cfg = ElasticConfig(clock=lambda: float(next(fake_clock)))
+    if health is not None:
+        # a pre-built controller carries its own config — fit() rejects
+        # a redundant elastic_config alongside it
+        elastic, elastic_config = ElasticController(
+            tr, str(ckdir), health=health, config=cfg), None
+    else:
+        elastic, elastic_config = True, cfg
+    tr.fit(it, num_epoch=num_epoch, checkpoint_dir=str(ckdir),
+           checkpoint_batch_period=1, batch_end_callback=record,
+           elastic=elastic, elastic_config=elastic_config)
+    faults.disarm()
+    return tr, hashes, losses
+
+
+def test_probe_loss_remesh_resumes_exactly(tmp_path):
+    """Seeded device kill at the 4th probe: detect → checkpoint →
+    re-mesh 8→4 → re-shard in place → the batch stream stays bitwise
+    identical and losses/params allclose to the uninterrupted run."""
+    tr_ref, h_ref, l_ref = _run_fit()
+    plan = FaultPlan(seed=7).arm("mesh.probe", nth=4, exc="ioerror")
+    tr_el, h_el, l_el = _run_fit(plan, tmp_path)
+    est = resilience.stats()["elastic"]
+    assert len(tr_el._mesh.devices.flat) == 4
+    assert est["losses_detected"] == 1 and est["remeshes"] == 1
+    assert est["last_resume_s"] > 0.0       # fake clock, no real sleeps
+    assert h_el == h_ref                    # bitwise batch stream
+    np.testing.assert_allclose(l_el, l_ref, rtol=1e-4, atol=1e-5)
+    for n in tr_ref.params:
+        np.testing.assert_allclose(np.asarray(tr_el.params[n]),
+                                   np.asarray(tr_ref.params[n]),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_collective_failure_restores_rewinds_exactly(tmp_path):
+    """Device dies mid-step (failed collective): the donated buffers are
+    untrusted, so recovery restores the newest checkpoint onto the
+    shrunken mesh and rewinds the iterator — the successful-step stream
+    still matches the uninterrupted run batch for batch."""
+    tr_ref, h_ref, l_ref = _run_fit()
+    plan = FaultPlan(seed=3).arm("mesh.collective", nth=5, exc="ioerror")
+    tr_k, h_k, l_k = _run_fit(plan, tmp_path)
+    est = resilience.stats()["elastic"]
+    assert est["collective_failures"] == 1 and est["remeshes"] == 1
+    assert len(tr_k._mesh.devices.flat) == 4
+    assert h_k == h_ref
+    np.testing.assert_allclose(l_k, l_ref, rtol=1e-4, atol=1e-5)
+    for n in tr_ref.params:
+        np.testing.assert_allclose(np.asarray(tr_k.params[n]),
+                                   np.asarray(tr_ref.params[n]),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_device_addition_grows_mesh(tmp_path):
+    """The probe reporting devices beyond the current mesh re-meshes
+    outward — repaired capacity rejoins without a restart."""
+    tr_ref, h_ref, l_ref = _run_fit()
+
+    # start on 4 devices; after 3 probes the pool "repairs" to 8
+    calls = {"n": 0}
+
+    def growing_probe():
+        calls["n"] += 1
+        return jax.devices()[:4] if calls["n"] <= 3 else jax.devices()
+
+    faults.disarm()
+    resilience.reset_stats()
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 10, (48,)).astype(np.float32)
+    mx.random.seed(42)
+    tr = _make_trainer(mesh_axes={"data": 4}, devices=jax.devices()[:4])
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=True, seed=5)
+    ctl = ElasticController(
+        tr, str(tmp_path), health=MeshHealth(probe=growing_probe),
+        config=ElasticConfig(clock=lambda: 0.0))
+    # growth needs no injected fault at all — the probe just reports more
+    tr.fit(it, num_epoch=2, checkpoint_dir=str(tmp_path),
+           checkpoint_batch_period=1, elastic=ctl)
+    est = resilience.stats()["elastic"]
+    assert len(tr._mesh.devices.flat) == 8
+    assert est["devices_added"] == 4 and est["remeshes"] == 1
+
+
+def test_fit_rejects_controller_plus_config(tmp_path):
+    tr = _make_trainer()
+    ctl = ElasticController(tr, str(tmp_path))
+    it = mx.io.NDArrayIter(np.zeros((16, 784), np.float32),
+                           np.zeros((16,), np.float32), batch_size=BATCH)
+    with pytest.raises(MXNetError, match="not both"):
+        tr.fit(it, num_epoch=1, checkpoint_dir=str(tmp_path),
+               elastic=ctl, elastic_config=ElasticConfig())
+
+
+def test_check_reuses_this_batchs_checkpoint(tmp_path):
+    """A mid-epoch save this batch already wrote step_<N>: check() must
+    reuse it, never delete-then-rewrite the newest good checkpoint."""
+    import os
+
+    tr = _make_trainer()
+    tr.step(_feed(0))
+    tr.save_checkpoint(str(tmp_path), step=tr._num_update, epoch=0)
+    mpath = os.path.join(str(tmp_path), f"step_{tr._num_update}",
+                         "manifest.json")
+    before = open(mpath, "rb").read()
+    faults.arm(FaultPlan(seed=7).arm("mesh.probe", nth=1, exc="ioerror"))
+    ctl = ElasticController(tr, str(tmp_path),
+                            config=ElasticConfig(clock=lambda: 0.0))
+    assert ctl.check() is True
+    faults.disarm()
+    assert len(tr._mesh.devices.flat) == 4
+    assert open(mpath, "rb").read() == before    # untouched, not rewritten
+
+
+def test_check_inplace_failure_falls_back_as_device_lost(tmp_path,
+                                                         monkeypatch):
+    """A dead device makes the in-place gather fail with a backend
+    error mid-check: that must surface as DeviceLost (already marked,
+    no second victim) so fit's recovery loop restores from checkpoint
+    instead of dying."""
+    tr = _make_trainer()
+    tr.step(_feed(0))
+    faults.arm(FaultPlan(seed=7).arm("mesh.probe", nth=1, exc="ioerror"))
+    ctl = ElasticController(tr, str(tmp_path),
+                            config=ElasticConfig(clock=lambda: 0.0))
+    monkeypatch.setattr(
+        type(tr), "remesh",
+        lambda self, mesh, carry_state=True:
+            (_ for _ in ()).throw(RuntimeError("shard on dead device")))
+    with pytest.raises(DeviceLost, match="in-place re-shard failed") \
+            as excinfo:
+        ctl.check()
+    faults.disarm()
+    assert excinfo.value.already_marked
+    before = resilience.stats()["elastic"]["losses_detected"]
+    monkeypatch.undo()
+    # check() saved step_1 before the re-shard died: recover restores
+    # it onto the survivors — and must NOT mark a second victim for a
+    # loss check() already recorded
+    assert ctl.recover(None, excinfo.value) == (0, 0)
+    assert resilience.stats()["elastic"]["losses_detected"] == before
+    assert len(tr._mesh.devices.flat) == 4
+
+
+def test_recover_without_checkpoint_reraises(tmp_path):
+    tr = _make_trainer()
+    ctl = ElasticController(tr, str(tmp_path / "empty"),
+                            config=ElasticConfig(clock=lambda: 0.0))
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    it = mx.io.NDArrayIter(X, np.zeros((48,), np.float32),
+                           batch_size=BATCH)
+    with pytest.raises(MXNetError, match="no usable checkpoint"):
+        ctl.recover(it, DeviceLost("boom"))
+
+
+def test_flapping_mesh_hits_max_remeshes(tmp_path):
+    """Every probe killing another device must eventually give up as an
+    outage instead of re-meshing forever."""
+    plan = FaultPlan(seed=1)
+    for nth in range(2, 12):
+        plan.arm("mesh.probe", nth=nth, exc="ioerror")
+    faults.arm(plan)
+    tr = _make_trainer()
+    ctl = ElasticController(tr, str(tmp_path),
+                            config=ElasticConfig(clock=lambda: 0.0,
+                                                 max_remeshes=2))
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    y = np.zeros((48,), np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    with pytest.raises(MXNetError, match="max_remeshes"):
+        tr.fit(it, num_epoch=8, checkpoint_dir=str(tmp_path), elastic=ctl)
+
+
+# ---------------------------------------------------------------------------
+# counters + monitor + perf-seam rebind
+# ---------------------------------------------------------------------------
+
+def test_stats_shape_and_reset():
+    st = resilience.stats()
+    assert set(st["elastic"]) == {"probes", "losses_detected",
+                                  "devices_added", "remeshes",
+                                  "collective_failures", "last_resume_s",
+                                  "resume_total_s"}
+    MeshHealth().healthy_devices()
+    assert resilience.stats()["elastic"]["probes"] == 1
+    resilience.reset_stats()
+    assert resilience.stats()["elastic"]["probes"] == 0
+
+
+def test_resilience_monitor_reports_elastic_counters(caplog):
+    import logging
+
+    from mxnet_tpu.callback import BatchEndParam, ResilienceMonitor
+    from mxnet_tpu.resilience import elastic as elastic_mod
+    mon = ResilienceMonitor(frequent=1)
+    elastic_mod._count("probes", 5)
+    with caplog.at_level(logging.WARNING):
+        mon(BatchEndParam(epoch=0, nbatch=0, eval_metric=None, locals={}))
+    # probes alone (healthy elastic run) stay silent
+    assert "elastic" not in caplog.text
+    elastic_mod._count("losses_detected")
+    elastic_mod._count("remeshes")
+    elastic_mod._note_resume(1.5)
+    with caplog.at_level(logging.WARNING):
+        mon(BatchEndParam(epoch=0, nbatch=1, eval_metric=None, locals={}))
+    assert "elastic[losses_detected]=1" in caplog.text
+    assert "elastic[remeshes]=1" in caplog.text
+    assert "elastic[last_resume_s]=1.500" in caplog.text
+
+
+def test_fused_step_rebind_is_not_a_retrace():
+    """The perf/ seam contract: FusedStep.rebind() rebuilds the donated
+    program and the recompile counts as a new lifetime, not a retrace
+    (MXTPU_RETRACE_STRICT would abort a real re-mesh otherwise)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import optimizer as opt_mod, sym
+    from mxnet_tpu.perf.step_runtime import FusedStep
+
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.var("data"), num_hidden=4, name="fc"),
+        name="softmax")
+    fused = FusedStep(net, opt_mod.create("sgd", learning_rate=0.1),
+                      ["fc_weight", "fc_bias"], name="elastic-rebind-test")
+    rng = np.random.RandomState(0)
+    params, states, aux = fused.init(
+        {"fc_weight": jnp.asarray(rng.randn(4, 6).astype(np.float32)),
+         "fc_bias": jnp.zeros((4,), jnp.float32)}, {})
+    inputs = {"data": jnp.asarray(rng.randn(2, 6).astype(np.float32)),
+              "softmax_label": jnp.zeros((2,), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    t = jnp.float32(1)
+    params, states, aux, _ = fused(params, states, aux, inputs, key,
+                                   jnp.float32(0.1), t)
+    assert fused.guard.count == 1
+    fused.rebind()
+    params, states, aux, _ = fused(params, states, aux, inputs, key,
+                                   jnp.float32(0.1), t)
+    params, states, aux, _ = fused(params, states, aux, inputs, key,
+                                   jnp.float32(0.1), t)
+    assert fused.guard.count == 1 and not fused.guard.retraced
+    # budget bumps granted to the OLD program (deliberate extra lowers,
+    # compiled_step_hlo-style) must not carry over as retrace slack
+    fused.guard.expected += 2
+    fused.rebind()
+    assert fused.guard.expected == 1 and fused.guard.count == 0

@@ -2,10 +2,21 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from mxnet_tpu.parallel import make_mesh
 from mxnet_tpu.parallel.moe import moe_apply, top1_router
 from mxnet_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+# every test in this file drives pipeline/moe paths built on the public
+# jax.shard_map API, absent from this container's jax build — these 8
+# are pre-existing seed failures (CHANGES.md PR 5 note, verified via
+# git-stash A/B); skip with a reason instead of carrying known-F noise,
+# the same pattern PR 2 used for test_two_process_group
+pytestmark = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax.shard_map missing in this jax build (pre-existing seed "
+           "failure; runs where jax ships the public shard_map API)")
 
 
 def _stage(params, h):
