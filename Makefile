@@ -147,11 +147,22 @@ ci-elastic: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py \
 	    -m 'not slow' -x -q
 
+# stage 13: compiler smoke — two cold→warm runs of a micro model against
+# a fresh cache dir (under MXTPU_RETRACE_STRICT=1): the warm process must
+# record cache hits + a compile-count drop + a faster start, a corrupt
+# entry must cost exactly one recompile, and the pass-correctness suite
+# (bitwise equivalence vs un-passed graphs) must hold
+# (docs/how_to/compiler.md)
+ci-compiler: ci-native
+	timeout -k 10 420 env JAX_PLATFORMS=cpu python ci/compiler_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_compiler.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-frontends ci-dryrun ci-resilience ci-serving ci-data ci-perf \
-    ci-elastic
+    ci-elastic ci-compiler
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
-        ci-serving ci-data ci-perf ci-elastic
+        ci-serving ci-data ci-perf ci-elastic ci-compiler

@@ -76,6 +76,7 @@ from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401
 from . import perf  # noqa: F401
+from . import compiler  # noqa: F401
 from . import resilience  # noqa: F401
 from . import serving  # noqa: F401
 from . import notebook  # noqa: F401

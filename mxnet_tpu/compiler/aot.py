@@ -1,0 +1,278 @@
+"""PersistentJit: ``jax.jit`` with an ahead-of-time, on-disk program
+store — plus the in-process program registry the executor shares traced
+programs through.
+
+A ``PersistentJit`` behaves exactly like the ``jax.jit`` it wraps; the
+difference is WHERE the executable comes from on the first call of each
+call signature:
+
+1. in-memory table (this object already materialized the program);
+2. the persistent :class:`~.cache.CompilationCache` — the executable is
+   deserialized (``jax.experimental.serialize_executable``), skipping
+   trace AND XLA compile entirely (the warm start);
+3. a real ``lower().compile()`` — traced once, compiled once, then
+   serialized into the cache for every later process.
+
+Every step of the persistent path is best-effort: an unserializable
+program (exotic callbacks), an unpicklable pytree, a backend without
+executable serialization — each falls back to the plain ``jax.jit``
+call path and counts a *bypass*. Numerics are identical on every path;
+the cache can only ever change latency.
+
+``on_materialize(kind)`` (kind in ``{"compiled", "loaded"}``) fires once
+per new executable so retrace guards can count a cache load as the one
+expected program materialization instead of reporting a missed compile.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, Optional, Sequence
+
+from ..base import getenv
+from . import cache as _cache
+from .fingerprint import aval_signature, program_key
+
+__all__ = ["PersistentJit", "ProgramRegistry", "program_stats",
+           "reset_program_stats"]
+
+_lock = threading.Lock()
+_prog_counters: Dict[str, int] = {}
+
+
+def _count(key: str, n: int = 1):
+    with _lock:
+        _prog_counters[key] = _prog_counters.get(key, 0) + n
+
+
+def program_stats() -> Dict[str, int]:
+    """compiled/loaded/bypassed/shared program counters."""
+    with _lock:
+        base = {"compiled": 0, "loaded": 0, "bypassed": 0, "shared": 0,
+                "invalid_load": 0}
+        base.update(_prog_counters)
+        return base
+
+
+def reset_program_stats():
+    with _lock:
+        _prog_counters.clear()
+
+
+def _serializer():
+    try:
+        from jax.experimental import serialize_executable as se
+        return se
+    except ImportError:
+        return None
+
+
+class PersistentJit:
+    """Drop-in ``jax.jit`` wrapper with AOT load/store per call signature.
+
+    ``key_parts`` are the stable identity strings of the *function
+    being compiled* (graph fingerprint, optimizer signature, transform
+    signature, ...); the concrete call signature (avals, shardings,
+    statics) is appended per materialization. ``kind`` names the call
+    site in the persisted key and the logs."""
+
+    def __init__(self, fn: Callable, *, kind: str,
+                 key_parts: Sequence[str] = (),
+                 static_argnums: Sequence[int] = (),
+                 donate_argnums: Sequence[int] = (),
+                 on_materialize: Optional[Callable[[str], None]] = None):
+        import jax
+        self._fn = fn
+        self.kind = kind
+        self._key_parts = tuple(str(p) for p in key_parts)
+        self._static = tuple(static_argnums)
+        self._static_set = frozenset(static_argnums)
+        self._donate = tuple(donate_argnums)
+        self._on_materialize = on_materialize
+        self._jit = jax.jit(fn, static_argnums=self._static or None,
+                            donate_argnums=self._donate or None)
+        # instances are shared process-wide (executor ProgramRegistry)
+        # and called from serving worker threads: materialization is
+        # serialized so one signature never deserializes/compiles twice
+        self._mat_lock = threading.Lock()
+        self._programs: Dict[object, Callable] = {}
+        # once persistence is known to be unusable for this function
+        # (backend without executable serialization, lower()/compile()
+        # rejection), every later call goes straight to the plain jit —
+        # the per-call signature walk must not outlive its purpose
+        self._disabled = _serializer() is None
+        # steady-state fast path, keyed by the static-arg values: each
+        # statics combination keeps a short candidate list of
+        # materialized programs, tried in order — the compiled
+        # executable validates its own dynamic avals, raising on
+        # mismatch (cheap) so the next candidate is tried. This keeps
+        # multi-bucket serving (several dynamic shapes under identical
+        # statics) off the per-leaf signature walk; only a signature
+        # explosion (> _FAST_CANDIDATES) falls back to full dispatch.
+        self._fast: Dict[object, list] = {}
+
+    _FAST_CANDIDATES = 4
+
+    # expose the underlying jit for callers that need .lower() etc.
+    @property
+    def jit(self):
+        return self._jit
+
+    def _persist_ok(self) -> bool:
+        """Donated programs are excluded from the persistent store by
+        default: on this jax build's CPU backend, CALLING a deserialized
+        executable with buffer donation corrupts the process heap for
+        some program shapes (reproducibly: donated whole-step programs
+        carrying an LSTM scan — glibc abort at exit; donated MLP steps
+        and every undonated program are clean). Until the upstream
+        serialization path is trustworthy for aliased buffers,
+        ``MXTPU_COMPILE_CACHE_DONATED=1`` is the explicit opt-in; the
+        undonated executor/serving programs — the serving-cold-start and
+        resume paths — stay cached by default."""
+        if not self._donate:
+            return True
+        return bool(getenv("MXTPU_COMPILE_CACHE_DONATED", 0, int))
+
+    def __call__(self, *args):
+        if self._disabled or not _cache.cache_enabled() \
+                or not self._persist_ok():
+            return self._jit(*args)
+        try:
+            statics_key = tuple(args[i] for i in self._static)
+            fast = self._fast.get(statics_key)
+        except (TypeError, IndexError):     # unhashable static: full path
+            statics_key = None
+            fast = None
+        if fast:
+            for cand in fast:
+                try:
+                    return cand(*args)
+                except (TypeError, ValueError):
+                    continue        # aval mismatch: try the next bucket
+        try:
+            sig, canon = aval_signature(args, self._static)
+        except Exception:   # noqa: BLE001 — exotic leaves: plain jit path
+            _count("bypassed")
+            return self._jit(*args)
+        prog = self._programs.get(sig)
+        if prog is None:
+            with self._mat_lock:
+                prog = self._programs.get(sig)   # double-checked
+                if prog is None:
+                    prog = self._materialize(canon, args)
+                    self._programs[sig] = prog
+                    if statics_key is not None and prog is not self._jit:
+                        cands = self._fast.setdefault(statics_key, [])
+                        if len(cands) < self._FAST_CANDIDATES:
+                            cands.append(prog)
+        return prog(*args)
+
+    # -- materialization -----------------------------------------------------
+
+    def _wrap_compiled(self, compiled) -> Callable:
+        static_set = self._static_set
+
+        def run(*args):
+            # no try/except here: the executable validates its input
+            # avals itself, and a signature-matched call that still
+            # fails is a real error the caller must see. (The fast path
+            # in __call__ catches the validation error for the one
+            # legitimate case — aval drift — and re-dispatches.)
+            dyn = tuple(a for i, a in enumerate(args) if i not in static_set)
+            return compiled(*dyn)
+
+        return run
+
+    def _notify(self, kind: str):
+        _count(kind)
+        if self._on_materialize is not None:
+            self._on_materialize(kind)
+
+    def _materialize(self, canon: str, args) -> Callable:
+        se = _serializer()
+        if se is None:
+            _count("bypassed")
+            return self._jit
+        key = program_key(self.kind, "+".join(self._key_parts), canon,
+                          donation=self._donate)
+        store = _cache.default_cache()
+        data = store.get(key)
+        if data is not None:
+            try:
+                payload, in_tree, out_tree = pickle.loads(data)
+                compiled = se.deserialize_and_load(payload, in_tree,
+                                                   out_tree)
+                self._notify("loaded")
+                return self._wrap_compiled(compiled)
+            except Exception as err:    # noqa: BLE001 — entry unusable here
+                logging.warning("PersistentJit[%s]: cached executable "
+                                "%s failed to load (%s); recompiling",
+                                self.kind, key[:12], err)
+                # a digest-valid entry that cannot deserialize is as
+                # invalid as a corrupt one — one shared invalidation
+                # definition lives on the cache
+                store.invalidate(key)
+                _count("invalid_load")
+        try:
+            compiled = self._jit.lower(*args).compile()
+        except Exception as err:        # noqa: BLE001 — AOT-unfriendly call
+            logging.debug("PersistentJit[%s]: lower/compile failed (%s); "
+                          "plain jit path", self.kind, err)
+            _count("bypassed")
+            self._disabled = True       # don't re-pay the sig walk per call
+            return self._jit
+        self._notify("compiled")
+        try:
+            payload, in_tree, out_tree = se.serialize(compiled)
+            store.put(key, pickle.dumps((payload, in_tree, out_tree)),
+                      meta={"kind": self.kind, "sig": canon[:512]})
+        except Exception as err:        # noqa: BLE001 — unserializable
+            logging.debug("PersistentJit[%s]: executable not "
+                          "serializable (%s); in-process only", self.kind,
+                          err)
+        return self._wrap_compiled(compiled)
+
+
+class ProgramRegistry:
+    """Fingerprint-keyed LRU of in-process program bundles.
+
+    Replaces the executor's ``shared_exec._symbol is symbol`` staleness
+    rule: two executors over structurally identical graphs (same
+    fingerprint + same sparse-proxy signature) share ONE set of traced
+    callables, so the second bind's first step hits the first's trace
+    cache instead of silently retracing. Capped — eviction only costs
+    sharing, never correctness."""
+
+    def __init__(self, cap: Optional[int] = None):
+        if cap is None:
+            cap = getenv("MXTPU_PROGRAM_REGISTRY_CAP", 64, int)
+        self.cap = int(cap)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[object, object]" = OrderedDict()
+
+    def get_or_build(self, key, builder: Callable[[], object]):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                _count("shared")
+                return hit
+        bundle = builder()
+        with self._lock:
+            # a racing builder may have landed first; last one wins is
+            # fine (both bundles are equivalent programs)
+            self._entries[key] = bundle
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.cap:
+                self._entries.popitem(last=False)
+        return bundle
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()

@@ -1,0 +1,227 @@
+"""Stable graph + program fingerprints: the compilation-cache key.
+
+Two layers, matching the two caches they key:
+
+* :func:`graph_fingerprint` — the STRUCTURAL identity of a bound
+  symbolic graph: canonicalized node list (op, name, attrs, scope
+  attrs, input wiring) + output entries. Shape-polymorphic: it keys the
+  in-process program registry (``jax.jit`` handles per-shape dispatch),
+  replacing ``executor.py``'s old ``shared_exec._symbol is symbol``
+  staleness rule — any two executors over structurally identical graphs
+  now share one traced program.
+* :func:`program_key` — the PERSISTED executable identity: structural
+  fingerprint + concrete input avals (shapes/dtypes/weak types/
+  shardings) + static-arg values + mesh + donation signature + the
+  pass-pipeline transform signature + the environment salt
+  (:func:`code_salt`). Any input that can change the compiled artifact
+  is in the key; anything else would serve a stale executable.
+
+Node *names* are deliberately part of the structural fingerprint: the
+traced programs take ``{name: array}`` dict pytrees, so names are part
+of the program's calling convention even though they never affect the
+math. Two models built by identical code get identical names from the
+deterministic ``NameManager`` and therefore share.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["canonical_graph", "graph_fingerprint", "code_salt",
+           "mesh_signature", "aval_signature", "program_key",
+           "optimizer_signature"]
+
+
+def canonical_graph(symbol) -> dict:
+    """Canonical JSON-able form of a symbol's graph.
+
+    Like ``Symbol.tojson`` but with sorted attr keys, scope attrs kept
+    separate from op attrs, and the aux-input roles included (an aux
+    state and an argument are different calling conventions)."""
+    nodes = symbol._topo_nodes()
+    nid = {id(n): i for i, n in enumerate(nodes)}
+    aux_ids = symbol._aux_node_ids()
+    out_nodes = []
+    for node in nodes:
+        if node.op is not None:
+            attrs = node.op.attr_spec.serialize(node.attrs)
+        else:
+            attrs = {k: str(v) for k, v in node.attrs.items()}
+        out_nodes.append({
+            "op": "null" if node.is_variable else node.op.name,
+            "name": node.name,
+            "aux": bool(node.is_variable and id(node) in aux_ids),
+            "attrs": dict(sorted(attrs.items())),
+            "scope": dict(sorted(node.scope_attrs.items())),
+            "inputs": [[nid[id(p)], i] for p, i in node.inputs],
+        })
+    return {"nodes": out_nodes,
+            "heads": [[nid[id(n)], i] for n, i in symbol._outputs]}
+
+
+def _sha(payload: str) -> str:
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+def graph_fingerprint(symbol) -> str:
+    """Structural fingerprint (sha256 hex) of a symbol's graph."""
+    return _sha(json.dumps(canonical_graph(symbol), sort_keys=True,
+                           separators=(",", ":")))
+
+
+# -- environment salt --------------------------------------------------------
+
+# Source files whose edits change the SEMANTICS of a traced program
+# without changing any graph fingerprint input: the op implementations,
+# the graph evaluators, and the step builders. Their content hash joins
+# every persisted program key, so editing an op kernel invalidates the
+# cache instead of serving the old executable. (Content, not mtime —
+# fresh checkouts of the same code still share a cache.)
+_SALT_ROOTS: Tuple[str, ...] = ("ops", "perf", "compiler", "parallel")
+_SALT_FILES: Tuple[str, ...] = ("executor.py",)
+
+_CODE_SALT: Optional[str] = None
+
+
+def code_salt() -> str:
+    """Process-cached hash of jax/backend versions + the trace-semantics
+    source files. ``MXTPU_COMPILE_CACHE_SALT`` overrides (tests pin it
+    to prove cross-process stability without hashing the tree twice)."""
+    global _CODE_SALT
+    if _CODE_SALT is None:
+        override = os.environ.get("MXTPU_COMPILE_CACHE_SALT")
+        if override:
+            _CODE_SALT = _sha("override:" + override)
+            return _CODE_SALT
+        import jax
+        from .. import libinfo
+        h = hashlib.sha256()
+        h.update(f"mxnet_tpu={libinfo.__version__};"
+                 f"jax={jax.__version__};".encode())
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(pkg_root, f) for f in _SALT_FILES]
+        for root in _SALT_ROOTS:
+            base = os.path.join(pkg_root, root)
+            for dirpath, _dirs, names in os.walk(base):
+                paths.extend(os.path.join(dirpath, n) for n in names
+                             if n.endswith(".py"))
+        for path in sorted(paths):
+            try:
+                with open(path, "rb") as f:
+                    h.update(os.path.relpath(path, pkg_root).encode())
+                    h.update(f.read())
+            except OSError:
+                continue
+        _CODE_SALT = h.hexdigest()
+    return _CODE_SALT
+
+
+def optimizer_signature(opt, rescale=None) -> str:
+    """Canonical signature of the optimizer statics a functional update
+    rule bakes into a traced step (perf.functional_update): kind,
+    rescale, clip, and the per-kind hyperparameters. ``rescale``
+    overrides ``opt.rescale_grad`` for call sites that rescale
+    dynamically (Gluon pre-multiplies and bakes 1.0). One definition so
+    the three persisting call sites (FusedStep, FusedOptimizerApply,
+    SPMD step) can never drift apart."""
+    if rescale is None:
+        rescale = float(opt.rescale_grad)
+    return "opt=" + ";".join(str(x) for x in (
+        type(opt).__name__.lower(), float(rescale),
+        float(opt.clip_gradient or 0.0),
+        getattr(opt, "momentum", None),
+        getattr(opt, "beta1", None),
+        getattr(opt, "beta2", None),
+        getattr(opt, "epsilon", None),
+        getattr(opt, "gamma1", None)))
+
+
+# -- call-signature pieces ---------------------------------------------------
+
+def mesh_signature(mesh) -> str:
+    """Stable identity of a mesh (or any static cache-key object).
+
+    For a ``jax.sharding.Mesh``: axis names x sizes + per-device
+    platform/kind/index — the facts a compiled executable is pinned to.
+    ``None`` and plain scalars stringify."""
+    if mesh is None:
+        return "none"
+    axis_names = getattr(mesh, "axis_names", None)
+    if axis_names is not None:
+        devs = getattr(mesh, "devices", None)
+        dev_sig = ""
+        if devs is not None:
+            flat = devs.ravel().tolist() if hasattr(devs, "ravel") else devs
+            dev_sig = ",".join(
+                f"{getattr(d, 'platform', '?')}:{getattr(d, 'id', '?')}"
+                for d in flat)
+        shape = dict(getattr(mesh, "shape", {}))
+        return (f"mesh[{','.join(map(str, axis_names))}]"
+                f"{sorted(shape.items())}({dev_sig})")
+    return repr(mesh)
+
+
+def _leaf_sig(x) -> str:
+    """One aval leaf: shape/dtype/weak-type + sharding identity."""
+    shape = tuple(getattr(x, "shape", ()))
+    dtype = str(getattr(x, "dtype", type(x).__name__))
+    weak = bool(getattr(x, "weak_type", False))
+    sh = getattr(x, "sharding", None)
+    if sh is None:
+        shsig = "-"
+    else:
+        spec = getattr(sh, "spec", None)
+        if spec is not None:        # NamedSharding: mesh + partition spec
+            shsig = f"{mesh_signature(getattr(sh, 'mesh', None))}/{spec}"
+        else:                       # single-device: pin the device index
+            dev = next(iter(sh.device_set), None) if hasattr(
+                sh, "device_set") else None
+            shsig = f"dev{getattr(dev, 'id', '?')}"
+    return f"{shape}:{dtype}:w{int(weak)}:{shsig}"
+
+
+def aval_signature(args: Sequence, static_argnums: Sequence[int] = ()):
+    """(hashable in-process sig, canonical string) for one call's args.
+
+    The hashable form dispatches the in-memory program table; the string
+    joins the persisted key. Static args contribute their values (via
+    :func:`mesh_signature` for mesh-like objects, ``repr`` otherwise);
+    dynamic args contribute per-leaf avals + the pytree structure."""
+    import jax
+    statics = set(static_argnums)
+    parts = []
+    for i, arg in enumerate(args):
+        if i in statics:
+            parts.append(f"s{i}={mesh_signature(arg)}")
+            continue
+        leaves, treedef = jax.tree_util.tree_flatten(arg)
+        parts.append(f"a{i}={treedef}|" + ";".join(
+            _leaf_sig(leaf) for leaf in leaves))
+    canon = "&".join(parts)
+    return canon, canon
+
+
+def program_key(kind: str, graph_fp: str, avals_sig: str,
+                donation: Sequence[int] = (), transform_sig: str = "",
+                extra: str = "") -> str:
+    """The persisted-executable key: sha256 over every compile input —
+    including the XLA/jax compile environment (flags, matmul precision,
+    x64), which changes the generated code without touching any graph
+    input; read per call, not cached, because tests and conftest flip
+    them at runtime."""
+    import jax
+    payload = "|".join([
+        "v1", kind, graph_fp, avals_sig,
+        f"donate={tuple(sorted(donation))}",
+        transform_sig, extra,
+        f"backend={jax.default_backend()}",
+        f"ndev={jax.device_count()}",
+        f"devkind={getattr(jax.devices()[0], 'device_kind', '?')}",
+        f"xla_flags={os.environ.get('XLA_FLAGS', '')}",
+        f"mmprec={jax.config.jax_default_matmul_precision}",
+        f"x64={jax.config.jax_enable_x64}",
+        f"salt={code_salt()}",
+    ])
+    return _sha(payload)

@@ -1,0 +1,83 @@
+"""GraphIR: the explicit node-list form the graph passes operate on.
+
+Reference analogue: ``nnvm::Graph`` — a node list plus output entries
+plus an attribute dictionary that passes read and write
+(include/nnvm/graph.h; TVM arxiv 1802.04799 §3 and Relay arxiv
+1810.00952 keep the same shape: a small typed IR that every pass maps
+over). A :class:`~mxnet_tpu.symbol.Symbol` defines its graph implicitly
+by reachability from the output entries; the IR makes the node list
+*explicit* so a pass can represent states a Symbol cannot (nodes made
+dead by a rewrite, nodes scheduled for replacement) and so pass stats
+(nodes pruned/merged) are observable.
+
+Passes must treat :class:`~mxnet_tpu.symbol.symbol.SymbolNode` objects
+as IMMUTABLE — they are shared with every other Symbol built from the
+same subexpressions. A rewiring pass therefore clones affected nodes via
+:func:`clone_node` and leaves the originals untouched.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..symbol.symbol import Symbol, SymbolNode
+
+__all__ = ["GraphIR", "clone_node"]
+
+
+def clone_node(node: SymbolNode, inputs) -> SymbolNode:
+    """Copy of ``node`` with new input entries.
+
+    Bypasses ``SymbolNode.__init__`` so the clone keeps the ORIGINAL
+    scope attrs (ctx_group placement, user annotations) instead of
+    capturing whatever ``AttrScope`` happens to be ambient while a pass
+    runs. ``attrs`` is shared by reference — passes never mutate it.
+    """
+    clone = object.__new__(SymbolNode)
+    clone.op = node.op
+    clone.name = node.name
+    clone.attrs = node.attrs
+    clone.inputs = list(inputs)
+    clone.scope_attrs = dict(node.scope_attrs)
+    return clone
+
+
+class GraphIR:
+    """An explicit, topologically ordered node list + output entries.
+
+    ``annotations`` is the pass-to-pass/pass-to-runtime side channel
+    (remat decision, future sharding specs and quantization rewrites);
+    it survives :meth:`to_symbol` by living on the
+    :class:`~mxnet_tpu.compiler.passes.OptimizeResult`.
+    """
+
+    def __init__(self, nodes: List[SymbolNode],
+                 outputs: List[Tuple[SymbolNode, int]]):
+        self.nodes = list(nodes)
+        self.outputs = list(outputs)
+        self.annotations: Dict[str, object] = {}
+
+    @classmethod
+    def from_symbol(cls, symbol: Symbol) -> "GraphIR":
+        return cls(symbol._topo_nodes(), symbol._outputs)
+
+    def to_symbol(self) -> Symbol:
+        return Symbol(list(self.outputs))
+
+    # -- helpers shared by passes -------------------------------------------
+
+    def reachable_ids(self) -> set:
+        """ids of nodes reachable from the output entries."""
+        seen: set = set()
+        stack = [n for n, _ in self.outputs]
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            for parent, _ in node.inputs:
+                if id(parent) not in seen:
+                    stack.append(parent)
+        return seen
+
+    def num_ops(self) -> int:
+        return sum(1 for n in self.nodes if not n.is_variable)

@@ -76,3 +76,29 @@ register_knob("MXTPU_CPU_WORKER_NTHREADS", int, 4,
 register_knob("MXTPU_BACKWARD_DO_MIRROR", int, 0,
               "trade FLOPs for memory via jax.checkpoint rematerialization "
               "in executor backward (ref MXNET_BACKWARD_DO_MIRROR)")
+register_knob("MXTPU_GRAPH_PASSES", int, 1,
+              "run the bind-time graph-pass pipeline (DCE/CSE/remat "
+              "policy; mxnet_tpu/compiler) — 0 disables")
+register_knob("MXTPU_COMPILE_CACHE", int, 1,
+              "persist compiled executables under "
+              "MXTPU_COMPILE_CACHE_DIR so later processes skip "
+              "recompilation — 0 disables the disk layer")
+register_knob("MXTPU_COMPILE_CACHE_DIR", str,
+              "~/.cache/mxnet_tpu/executables",
+              "root of the persistent compilation cache")
+register_knob("MXTPU_COMPILE_CACHE_MB", float, 512,
+              "LRU size bound of the compilation cache, megabytes")
+register_knob("MXTPU_COMPILE_CACHE_DONATED", int, 0,
+              "also persist buffer-donating programs (fused/SPMD steps) "
+              "— off by default: deserialized donated executables corrupt "
+              "the heap on this jax build's CPU backend for some shapes")
+register_knob("MXTPU_REMAT_MB", float, None,
+              "activation-memory budget: a training bind whose estimated "
+              "forward activations exceed it gets jax.checkpoint remat "
+              "(the remat-policy pass decision)")
+register_knob("MXTPU_OP_COSTS", str, None,
+              "json file of measured per-op ms (profile harness output) "
+              "pricing the remat-policy recompute estimate")
+register_knob("MXTPU_PROGRAM_REGISTRY_CAP", int, 64,
+              "max fingerprint-keyed executor program bundles shared "
+              "in-process (LRU; eviction only costs sharing)")

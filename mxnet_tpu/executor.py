@@ -278,6 +278,23 @@ def build_placed_graph_eval(symbol, group2dev):
 
 _NULL_KEY = None
 
+_PROGRAMS = None
+
+
+def _program_registry():
+    """Process-wide fingerprint-keyed registry of executor programs
+    (compiler.aot.ProgramRegistry): two executors over structurally
+    identical graphs share ONE pair of traced fwd/fwd_bwd callables —
+    the replacement for the old ``shared_exec._symbol is symbol``
+    staleness rule, which only ever shared through an explicitly
+    threaded executor and silently retraced for equal graphs built
+    twice."""
+    global _PROGRAMS
+    if _PROGRAMS is None:
+        from .compiler.aot import ProgramRegistry
+        _PROGRAMS = ProgramRegistry()
+    return _PROGRAMS
+
 
 def _null_key():
     """Cached PRNG key fed to executors whose graph samples nothing: the
@@ -354,17 +371,30 @@ class Executor:
         self.outputs: List[NDArray] = []
         self._diff_args = [n for n in self._arg_names
                           if grad_req.get(n, "null") != "null"]
-        # share compiled programs across executors of the same graph
-        # (reference: shared_exec memory-pool reuse for bucketing,
-        # graph_executor.cc:879-881 — here we share the jit cache instead)
+        # compiled-program sharing across executors happens through the
+        # fingerprint-keyed registry below (reference: shared_exec
+        # memory-pool reuse for bucketing, graph_executor.cc:879-881 —
+        # ``shared_exec`` still shares BUFFERS in simple_bind; programs
+        # are shared whenever the graph fingerprints match, no explicit
+        # threading required)
         self._needs_rng = any(
             n.op is not None and not n.is_variable
             and n.op.uses_rng(n.attrs) for n in symbol._topo_nodes())
-        if shared_exec is not None and shared_exec._symbol is symbol:
+        if shared_exec is not None and shared_exec._symbol is symbol \
+                and getattr(shared_exec, "_placed", False):
+            # placed executors keep the identity-based share (the
+            # fingerprint registry below covers only the jitted
+            # single-device path): reshape()/bucketing over a ctx_group
+            # graph must reuse the per-group segment jits. Checked
+            # before _is_placed because reshape() does not re-thread
+            # group2ctx — the shared executor's placement carries over.
+            self._placed = True
             self._fwd = shared_exec._fwd
             self._fwd_bwd = shared_exec._fwd_bwd
             self._sparse_specs = shared_exec._sparse_specs
-        elif _is_placed(group2ctx):
+            self._last = None
+            return
+        if _is_placed(group2ctx):
             # ctx_group model parallelism: per-group device placement with
             # internally jitted segments; no outer jit (it would collapse
             # everything back onto one device). The segment jits are built
@@ -407,68 +437,129 @@ class Executor:
                 return outs, aux_up, grads, {}
 
             self._sparse_specs = []  # placed path: dense gradients only
+            self._placed = True
             self._fwd = fwd_placed
             self._fwd_bwd = fwd_bwd_placed
             self._last = None
             return
         else:
-            self._sparse_specs = (sparse_specs if sparse_specs is not None
-                                  else _sparse_grad_specs(symbol, grad_req))
-            specs = self._sparse_specs
-            eval_fn = build_graph_eval(
-                symbol, proxies={s["nid"]: s["proxy"] for s in specs})
+            if shared_exec is not None and shared_exec._symbol is symbol \
+                    and getattr(shared_exec, "_psig", None) is not None:
+                # identity memoization over the fingerprint route: the
+                # SAME symbol object (reshape(), bucketing partial
+                # batches) has by definition the same fingerprint, so
+                # re-running the pass pipeline and re-serializing the
+                # canonical graph would only rediscover it. Programs are
+                # shared directly when the grad-req-derived sparse-proxy
+                # signature also matches; any mismatch falls through to
+                # the full (registry) path.
+                specs = (sparse_specs if sparse_specs is not None
+                         else _sparse_grad_specs(symbol, grad_req))
+                psig = tuple((s["w"], s["d"], s["dim"]) for s in specs)
+                if psig == shared_exec._psig:
+                    self._sparse_specs = shared_exec._sparse_specs
+                    self._psig = psig
+                    self.graph_fingerprint = shared_exec.graph_fingerprint
+                    self._fwd = shared_exec._fwd
+                    self._fwd_bwd = shared_exec._fwd_bwd
+                    self._last = None
+                    return
+            # the compiler layer runs here: graph passes at bind time,
+            # then fingerprint-keyed program sharing + the persistent
+            # executable cache (mxnet_tpu/compiler, docs/how_to/compiler.md)
+            from . import compiler as _compiler
+            all_arrs = list(args.items()) + list(aux.items())
+            opt_res = _compiler.optimize(
+                symbol,
+                input_shapes={n: tuple(v.shape) for n, v in all_arrs},
+                input_dtypes={n: str(v.dtype) for n, v in all_arrs},
+                for_training=any(r != "null" for r in grad_req.values()),
+                mesh_key=_ambient_mesh_key())
+            opt_sym = opt_res.symbol
+            if opt_res.changed or sparse_specs is None:
+                # a rewriting pass invalidates precomputed node ids (and
+                # can change variable consumer counts): recompute on the
+                # graph that is actually traced
+                sparse_specs = _sparse_grad_specs(opt_sym, grad_req)
+            self._sparse_specs = specs = sparse_specs
+            remat = bool(opt_res.remat
+                         or getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int))
+            fp = _compiler.graph_fingerprint(opt_sym)
+            self.graph_fingerprint = fp
+            psig = tuple((s["w"], s["d"], s["dim"]) for s in specs)
+            self._psig = psig
+            eager = bool(getenv("MXTPU_EXEC_EAGER", 0, int))
 
-            # mesh_key is a pure cache key: mesh-aware ops (attention
-            # seq_axis) consult the ambient mesh at TRACE time, so the
-            # compiled program must be keyed on it — otherwise a program
-            # first traced outside mesh_scope would silently keep running
-            # unsharded under a later mesh (and vice versa)
-            def fwd(arg_vals, aux_vals, rng, is_train, mesh_key=None):
-                outs, aux_up = eval_fn(arg_vals, aux_vals, rng, is_train)
-                return outs, aux_up
+            def _build_programs():
+                eval_fn = build_graph_eval(
+                    opt_sym, proxies={s["nid"]: s["proxy"] for s in specs})
 
-            def fwd_bwd(arg_vals, aux_vals, rng, head_grads, diff_names,
-                        mesh_key=None):
-                # diff_names is static: each executor passes its own grad_req
-                # selection even when the compiled program is shared
-                diff = {n: arg_vals[n] for n in diff_names}
-                # zero proxies on each sparse-grad Embedding output: the
-                # vjp cotangent w.r.t. a proxy is d(emb_out), from which
-                # the row_sparse weight grad is assembled host-side
-                # without ever materializing the dense (vocab, dim) grad
-                proxy_vals = {
-                    s["proxy"]: jnp.zeros(
-                        tuple(arg_vals[s["d"]].shape) + (s["dim"],),
-                        arg_vals[s["w"]].dtype)
-                    for s in specs}
-
-                def f(diff_args, proxy_args):
-                    merged = dict(arg_vals)
-                    merged.update(diff_args)
-                    merged.update(proxy_args)
-                    outs, aux_up = eval_fn(merged, aux_vals, rng, True)
+                # mesh_key is a pure cache key: mesh-aware ops (attention
+                # seq_axis) consult the ambient mesh at TRACE time, so the
+                # compiled program must be keyed on it — otherwise a program
+                # first traced outside mesh_scope would silently keep running
+                # unsharded under a later mesh (and vice versa)
+                def fwd(arg_vals, aux_vals, rng, is_train, mesh_key=None):
+                    outs, aux_up = eval_fn(arg_vals, aux_vals, rng, is_train)
                     return outs, aux_up
 
-                if getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int):
-                    # trade FLOPs for memory: recompute activations in the
-                    # backward pass (reference MXNET_BACKWARD_DO_MIRROR /
-                    # memonger — here XLA rematerialization)
-                    f = jax.checkpoint(f)
-                (outs, aux_up), vjp_fn = jax.vjp(f, diff, proxy_vals)
-                cts = [hg if hg is not None else jnp.ones_like(o)
-                       for o, hg in zip(outs, head_grads)]
-                zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
-                grads, proxy_grads = vjp_fn((cts, zero_aux))
-                return outs, aux_up, grads, proxy_grads
+                def fwd_bwd(arg_vals, aux_vals, rng, head_grads, diff_names,
+                            mesh_key=None):
+                    # diff_names is static: each executor passes its own
+                    # grad_req selection even when the program is shared
+                    diff = {n: arg_vals[n] for n in diff_names}
+                    # zero proxies on each sparse-grad Embedding output: the
+                    # vjp cotangent w.r.t. a proxy is d(emb_out), from which
+                    # the row_sparse weight grad is assembled host-side
+                    # without ever materializing the dense (vocab, dim) grad
+                    proxy_vals = {
+                        s["proxy"]: jnp.zeros(
+                            tuple(arg_vals[s["d"]].shape) + (s["dim"],),
+                            arg_vals[s["w"]].dtype)
+                        for s in specs}
 
-            if getenv("MXTPU_EXEC_EAGER", 0, int):
-                # debugging mode: run un-jitted, op by op (reference
-                # MXNET_ENGINE_TYPE=NaiveEngine — engine.cc:31-41)
-                self._fwd = fwd
-                self._fwd_bwd = fwd_bwd
+                    def f(diff_args, proxy_args):
+                        merged = dict(arg_vals)
+                        merged.update(diff_args)
+                        merged.update(proxy_args)
+                        outs, aux_up = eval_fn(merged, aux_vals, rng, True)
+                        return outs, aux_up
+
+                    if remat:
+                        # trade FLOPs for memory: recompute activations in
+                        # the backward pass (the remat-policy pass decision,
+                        # or the explicit MXNET_BACKWARD_DO_MIRROR knob —
+                        # reference memonger; here XLA rematerialization)
+                        f = jax.checkpoint(f)
+                    (outs, aux_up), vjp_fn = jax.vjp(f, diff, proxy_vals)
+                    cts = [hg if hg is not None else jnp.ones_like(o)
+                           for o, hg in zip(outs, head_grads)]
+                    zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+                    grads, proxy_grads = vjp_fn((cts, zero_aux))
+                    return outs, aux_up, grads, proxy_grads
+
+                if eager:
+                    # debugging mode: run un-jitted, op by op (reference
+                    # MXNET_ENGINE_TYPE=NaiveEngine — engine.cc:31-41)
+                    return fwd, fwd_bwd
+                # the EFFECTIVE remat flag, not transform_sig's: with
+                # MXTPU_GRAPH_PASSES=0 the sig is frozen at remat=0
+                # while MXNET_BACKWARD_DO_MIRROR can still flip the
+                # traced program — the persisted key must split on it
+                key_parts = (fp, opt_res.transform_sig,
+                             f"effremat={int(remat)}", f"sparse={psig}")
+                return (_compiler.PersistentJit(
+                            fwd, kind="executor-fwd", key_parts=key_parts,
+                            static_argnums=(3, 4)),
+                        _compiler.PersistentJit(
+                            fwd_bwd, kind="executor-fwd-bwd",
+                            key_parts=key_parts, static_argnums=(4, 5)))
+
+            if eager:
+                self._fwd, self._fwd_bwd = _build_programs()
             else:
-                self._fwd = jax.jit(fwd, static_argnums=(3, 4))
-                self._fwd_bwd = jax.jit(fwd_bwd, static_argnums=(4, 5))
+                self._fwd, self._fwd_bwd = _program_registry().get_or_build(
+                    (fp, psig, remat), _build_programs)
         self._last = None  # (arg_vals, aux_vals, rng) of the last forward
 
     # -- API ----------------------------------------------------------------

@@ -356,6 +356,16 @@ class BaseModule:
             begin_epoch, begin_batch = apply_resume_state(
                 train_data, resume_iter_state, logger=self.logger)
 
+        # warm-start accounting for resumed runs: the persistent
+        # compilation cache (mxnet_tpu/compiler) serves this process's
+        # step programs if an earlier run compiled them — report what
+        # the resume actually skipped once the first epoch materialized
+        # every program (docs/how_to/compiler.md)
+        resume_compiler_base = None
+        if resume is not None and resume is not False:
+            from .. import compiler as _compiler
+            resume_compiler_base = _compiler.stats()
+
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -427,6 +437,17 @@ class BaseModule:
             # kills would need transactional callback markers)
             replayed_empty_tail = begin_batch > 0 and nseen == 0
             begin_batch = 0
+            if resume_compiler_base is not None:
+                from .. import compiler as _compiler
+                now = _compiler.stats()
+                self.logger.info(
+                    "fit(resume): compiler served %d cached program(s), "
+                    "compiled %d fresh",
+                    now["programs"]["loaded"]
+                    - resume_compiler_base["programs"]["loaded"],
+                    now["programs"]["compiled"]
+                    - resume_compiler_base["programs"]["compiled"])
+                resume_compiler_base = None
             for name, val in train_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,

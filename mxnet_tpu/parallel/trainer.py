@@ -71,7 +71,13 @@ class SPMDTrainer:
         if isinstance(optimizer, str):
             optimizer = _opt_mod.create(optimizer, **(optimizer_params or {}))
         self._optimizer = optimizer
-        self._eval_fn = build_graph_eval(symbol)
+        # graph passes (DCE/CSE/remat policy) run in bind(), where input
+        # shapes are known so the remat-policy activation estimate can
+        # engage; the trainer keeps the ORIGINAL symbol for naming/shape
+        # surfaces and traces the optimized one (mxnet_tpu/compiler)
+        self._opt_res = None
+        self._graph_fingerprint = None
+        self._eval_fn = None
         self.params: Dict[str, jax.Array] = {}
         self.states: Dict[str, object] = {}
         self.aux: Dict[str, jax.Array] = {}
@@ -214,7 +220,24 @@ class SPMDTrainer:
                       for n in param_names}
         lr_mult = {n: float(self._optimizer.lr_mult.get(n, 1.0))
                    for n in param_names}
+        # graph passes with the now-known bind shapes (remat budget can
+        # price the activations); re-run on every (re)bind — a remesh
+        # changes nothing structural, so the fingerprint is stable
+        from .. import compiler as _compiler
+        all_shapes = dict(shapes)
+        all_shapes.update(dict(zip(aux_names, aux_shapes)))
+        self._opt_res = _compiler.optimize(
+            self._symbol, for_training=True,
+            input_shapes=all_shapes,
+            input_dtypes={n: str(self._dtype) for n in all_shapes})
+        self._graph_fingerprint = _compiler.graph_fingerprint(
+            self._opt_res.symbol)
+        self._eval_fn = build_graph_eval(self._opt_res.symbol)
         eval_fn = self._eval_fn
+        # the explicit mirror knob must survive MXTPU_GRAPH_PASSES=0
+        from ..base import getenv as _getenv
+        remat = bool(self._opt_res.remat
+                     or _getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int))
         param_sh = {n: params[n].sharding for n in params}
         aux_sh = {n: NamedSharding(mesh, P()) for n in aux}
 
@@ -233,6 +256,11 @@ class SPMDTrainer:
                 outs, aux_up = eval_fn(merged, aux, rng, True)
                 return outs, aux_up
 
+            if remat:
+                # remat-policy pass decision (MXTPU_REMAT_MB budget /
+                # MXNET_BACKWARD_DO_MIRROR): recompute activations in
+                # the backward instead of holding them
+                loss_f = jax.checkpoint(loss_f)
             (outs, aux_up), vjp_fn = jax.vjp(loss_f, params)
             cts = [jnp.ones_like(o) for o in outs]
             zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
@@ -266,9 +294,32 @@ class SPMDTrainer:
             return new_params, new_states, new_aux, outs
 
         self.retrace_guard.rebind()     # fresh program after (re)bind
-        self._step_fn = jax.jit(self.retrace_guard.wrap(step),
-                                donate_argnums=(0, 1, 2) if self._donate
-                                else ())
+        guard = self.retrace_guard
+
+        def materialized(kind):
+            if kind == "loaded":
+                # persisted-cache hit: the traced body never runs, so the
+                # guard's one expected compile is credited by hand
+                guard.count += 1
+
+        # everything static that enters the traced step joins the
+        # persistent-program identity: graph + pass decisions, mesh,
+        # optimizer rule statics, sharding layout, ZeRO mode, precision
+        shard_sig = sorted((n, str(state_specs[n])) for n in param_names)
+        key_parts = (
+            self._graph_fingerprint, self._opt_res.transform_sig,
+            f"effremat={int(remat)}",
+            "mesh=" + _compiler.mesh_signature(mesh),
+            _compiler.fingerprint.optimizer_signature(self._optimizer),
+            f"wd={sorted(wd_by_name.items())}",
+            f"lrm={sorted(lr_mult.items())}",
+            f"zero={int(shard_opt)}", f"cdt={compute_dtype}",
+            f"shards={shard_sig}")
+        self._step_fn = _compiler.PersistentJit(
+            self.retrace_guard.wrap(step), kind="spmd-step",
+            key_parts=key_parts,
+            donate_argnums=(0, 1, 2) if self._donate else (),
+            on_materialize=materialized)
         self._step_abstract_args = None  # re-snapshot after (re)bind
         # sequence parallelism: shard the sequence dim (dim 1) of token
         # inputs over the axis the graph's attention ops actually name —
@@ -371,7 +422,7 @@ class SPMDTrainer:
         # retrace — raise the guard's budget so it stays quiet
         self.retrace_guard.expected += 1
         with mesh_scope(self._mesh):
-            lowered = self._step_fn.lower(*self._step_abstract_args)
+            lowered = self._step_fn.jit.lower(*self._step_abstract_args)
         return lowered.compile().as_text()
 
     def get_params(self):

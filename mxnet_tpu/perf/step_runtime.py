@@ -392,18 +392,44 @@ class FusedStep:
 
     def __init__(self, symbol, optimizer, param_names: Sequence[str],
                  compute_dtype=None, donate: bool = True,
-                 name: str = "fused-step"):
+                 name: str = "fused-step", input_shapes=None,
+                 input_dtypes=None):
+        from .. import compiler as _compiler
         self._symbol = symbol
         self._optimizer = optimizer
         self._param_names = list(param_names)
-        self._eval_fn = build_graph_eval(symbol)
+        # graph passes at bind time (DCE/CSE/remat policy); the fused
+        # step traces the optimized graph, the module keeps the
+        # original. input_shapes/dtypes (every bound arg + aux) feed
+        # the remat-policy activation estimate — without them the
+        # MXTPU_REMAT_MB budget cannot engage.
+        opt_res = _compiler.optimize(symbol, for_training=True,
+                                     input_shapes=input_shapes,
+                                     input_dtypes=input_dtypes)
+        opt_sym = opt_res.symbol
+        # the explicit mirror knob must survive MXTPU_GRAPH_PASSES=0
+        # (with passes on, the remat-policy pass already folds it in)
+        self._remat = bool(opt_res.remat
+                           or getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int))
+        self._eval_fn = build_graph_eval(opt_sym)
         self.needs_rng = bool(getattr(self._eval_fn, "needs_rng", True))
-        self.layouts = {n: lo for n, lo in plan_param_layouts(symbol).items()
+        self.layouts = {n: lo for n, lo in plan_param_layouts(opt_sym).items()
                         if n in self._param_names}
         self.donate = bool(donate)
         self.guard = CompileGuard(name)
         self._kind = type(optimizer).__name__.lower()
         self._init_state, update = functional_update(optimizer)
+        # persistent-program identity: everything static that enters the
+        # traced step — graph, pass decisions, optimizer rule + statics,
+        # layout hoists, compute dtype (donation joins via donate_argnums)
+        self._program_key_parts = (
+            _compiler.graph_fingerprint(opt_sym), opt_res.transform_sig,
+            f"effremat={int(self._remat)}",
+            _compiler.fingerprint.optimizer_signature(optimizer),
+            f"wd={sorted((n, float(optimizer.wd * optimizer.wd_mult.get(n, 1.0))) for n in self._param_names)}",
+            f"lrm={sorted((n, float(optimizer.lr_mult.get(n, 1.0))) for n in self._param_names)}",
+            f"cdt={compute_dtype}",
+            f"layouts={sorted(self.layouts)}")
 
         # static per-param wd / lr multipliers (reference: set_wd_mult —
         # biases/BN params get wd 0); the dynamic base lr stays an input
@@ -420,6 +446,8 @@ class FusedStep:
                 return v.astype(cdt)
             return v
 
+        remat = self._remat
+
         def step(params, states, aux, inputs, rng, lr, t):
             def loss_f(p):
                 merged = dict(inputs)
@@ -428,6 +456,11 @@ class FusedStep:
                 outs, aux_up = eval_fn(merged, aux, rng, True)
                 return outs, aux_up
 
+            if remat:
+                # remat-policy pass decision: recompute activations in
+                # the backward instead of holding them (memory budget
+                # MXTPU_REMAT_MB / MXNET_BACKWARD_DO_MIRROR)
+                loss_f = jax.checkpoint(loss_f)
             (outs, aux_up), vjp_fn = jax.vjp(loss_f, params)
             # terminal loss layers (SoftmaxOutput & friends) define their
             # own gradient and ignore the head cotangent — ones matches
@@ -455,9 +488,21 @@ class FusedStep:
         self._compile_step()
 
     def _compile_step(self):
-        self._step_fn = jax.jit(self.guard.wrap(self._step_body),
-                                donate_argnums=(0, 1, 2) if self.donate
-                                else ())
+        from ..compiler import PersistentJit
+
+        def materialized(kind):
+            if kind == "loaded":
+                # a persisted-cache hit IS the one expected program
+                # materialization: the traced body never runs, so the
+                # guard's compile counter must be advanced by hand or a
+                # later real retrace would be under-counted
+                self.guard.count += 1
+
+        self._step_fn = PersistentJit(
+            self.guard.wrap(self._step_body), kind="fused-step",
+            key_parts=self._program_key_parts,
+            donate_argnums=(0, 1, 2) if self.donate else (),
+            on_materialize=materialized)
 
     def rebind(self):
         """Rebuild the donated whole-step program (an elastic topology
@@ -715,10 +760,15 @@ def module_stepper(module, compute_dtype=None, donate=True):
     trainable = [n for n in module._param_names if n not in frozen]
     if not trainable:
         return None
+    all_arrs = list(exec_.arg_dict.items()) + list(exec_.aux_dict.items())
     try:
         fused = FusedStep(module._symbol, module._optimizer, trainable,
                           compute_dtype=compute_dtype, donate=donate,
-                          name=f"module-step:{type(module).__name__}")
+                          name=f"module-step:{type(module).__name__}",
+                          input_shapes={n: tuple(v.shape)
+                                        for n, v in all_arrs},
+                          input_dtypes={n: str(v.dtype)
+                                        for n, v in all_arrs})
         stepper = ModuleStepper(module, fused, frozen)
     except MXNetError:
         return None
@@ -765,8 +815,20 @@ class FusedOptimizerApply:
                 new_ss.append(s2)
             return new_ws, new_ss
 
-        self._jit = jax.jit(self.guard.wrap(apply),
-                            donate_argnums=(0, 2) if donate else ())
+        from ..compiler import PersistentJit
+
+        def materialized(kind):
+            if kind == "loaded":
+                self.guard.count += 1   # cache hit = the expected compile
+
+        from ..compiler.fingerprint import optimizer_signature
+        self._jit = PersistentJit(
+            self.guard.wrap(apply), kind="fused-update",
+            # rescale=1.0: this apply pre-multiplies the gradient by the
+            # dynamic rescale input, so the baked value is always 1.0
+            key_parts=(optimizer_signature(optimizer, rescale=1.0),),
+            donate_argnums=(0, 2) if donate else (),
+            on_materialize=materialized)
 
     def state_to_functional(self, state):
         return _imp_state_to_functional(self._kind, state)

@@ -47,7 +47,11 @@ SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          # elastic training (resilience/elastic.py,
          # docs/how_to/elastic_training.md): device-enumeration probe +
          # in-step collective — injected faults simulate device loss
-         "mesh.probe", "mesh.collective")
+         "mesh.probe", "mesh.collective",
+         # persistent compilation cache (mxnet_tpu/compiler/cache.py,
+         # docs/how_to/compiler.md): a failed/corrupt entry read is
+         # quarantined and falls back to recompile, never fails a bind
+         "compiler.cache.read")
 
 ENV_PLAN = "MXNET_TPU_FAULT_PLAN"
 ENV_SEED = "MXNET_TPU_FAULT_SEED"

@@ -122,7 +122,8 @@ class InferenceServer:
             "shed": 0, "evicted": 0, "rejected_open": 0,
             "deadline_queued": 0, "deadline_inflight": 0,
             "degraded": 0, "wedged_workers": 0, "abandoned": 0,
-            "load_failures": 0, "warmed_buckets": 0}
+            "load_failures": 0, "warmed_buckets": 0,
+            "warmup_cache_hits": 0, "warmup_compiles": 0}
         self._warmed = False
         self._load_ok = None          # None = not attempted yet
         self._fallback_ok = False     # fallback loaded and usable
@@ -186,7 +187,26 @@ class InferenceServer:
         for the fallback too, so degraded mode never eats a compile
         either. With ``strict`` (default) a primary-load failure raises
         unless the fallback loaded — in which case the server comes up
-        degraded instead of down."""
+        degraded instead of down.
+
+        With the persistent compilation cache warm (a previous process
+        served the same model/buckets), each bucket's pre-trace becomes
+        a cache READ instead of an XLA compile — the cold-start win is
+        reported as ``warmup_cache_hits``/``warmup_compiles`` in this
+        endpoint's stats (mxnet_tpu/compiler, docs/how_to/compiler.md)."""
+        from .. import compiler as _compiler
+        before = _compiler.stats()
+        try:
+            return self._warm_up_impl(strict)
+        finally:
+            after = _compiler.stats()
+            self._count("warmup_cache_hits",
+                        after["cache"]["hits"] - before["cache"]["hits"])
+            self._count("warmup_compiles",
+                        after["programs"]["compiled"]
+                        - before["programs"]["compiled"])
+
+    def _warm_up_impl(self, strict: bool = True):
         self._load_error = None
         self._load_ok = self._load_one(self.backend)
         if self.fallback is not None:

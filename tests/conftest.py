@@ -15,6 +15,19 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
                                + " --xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# hermetic persistent compilation cache (mxnet_tpu/compiler): a
+# session-scoped tmp root so test outcomes never depend on executables a
+# previous run left in ~/.cache, and developer/CI home dirs don't grow.
+# setdefault — an explicit MXTPU_COMPILE_CACHE_DIR (warm-start debugging)
+# still wins.
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+_compile_cache_root = tempfile.mkdtemp(prefix="mxtpu-test-compile-cache-")
+os.environ.setdefault("MXTPU_COMPILE_CACHE_DIR", _compile_cache_root)
+atexit.register(shutil.rmtree, _compile_cache_root, ignore_errors=True)
+
 import jax  # noqa: E402
 
 # the env var alone is not enough under the axon TPU tunnel — force via config

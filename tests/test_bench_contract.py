@@ -49,6 +49,8 @@ def test_best_recorded_reads_round_artifacts():
     # flagship metrics seed from their first recorded round
     assert best["flash_attention"] >= 0.0
     assert best["moe_dispatch"] >= 0.0
+    # compiler tier (warm-start speedup) seeds the same way
+    assert best["compile_cache"] >= 0.0
 
 
 def test_flagship_guard_self_seeds():
