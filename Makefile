@@ -158,11 +158,23 @@ ci-compiler: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_compiler.py \
 	    -m 'not slow' -x -q
 
+# stage 14: preemption chaos smoke — a REAL SIGTERM to a child training
+# process mid-epoch must yield the typed exit code, the clean-exit
+# marker and a bitwise-exact resumed batch stream; a second leg injects
+# a step stall via MXNET_TPU_FAULT_PLAN and the escalation ladder
+# (retry → rebind) must recover unattended; then the unit suite
+# (signals, watchdog, crash-loop — fake clocks, zero sleeps)
+# (docs/how_to/preemption.md)
+ci-preempt: ci-native
+	timeout -k 10 300 env JAX_PLATFORMS=cpu python ci/preempt_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-frontends ci-dryrun ci-resilience ci-serving ci-data ci-perf \
-    ci-elastic ci-compiler
+    ci-elastic ci-compiler ci-preempt
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
-        ci-serving ci-data ci-perf ci-elastic ci-compiler
+        ci-serving ci-data ci-perf ci-elastic ci-compiler ci-preempt

@@ -1,0 +1,218 @@
+"""Preemption chaos smoke (`make ci-preempt`, ci/pipeline.yml).
+
+Two legs, both against the REAL runtime (docs/how_to/preemption.md):
+
+1. **SIGTERM mid-epoch** — the parent spawns a child training process
+   (this script with ``--child``) whose ``Module.fit`` runs under a
+   ``TrainingSupervisor`` with real OS signal handlers, waits until the
+   child is mid-epoch (it prints one line per trained batch), sends a
+   real ``SIGTERM``, and asserts:
+
+   - the child exits with the typed code ``EXIT_PREEMPTED`` (83);
+   - the clean-exit marker is on disk and verifies;
+   - a resumed child (``--resume``) finishes the job and the
+     concatenated batch streams (killed prefix + resumed suffix) are
+     BITWISE identical to an uninterrupted reference run.
+
+2. **Injected stall** — a child runs with
+   ``MXNET_TPU_FAULT_PLAN="supervisor.heartbeat:3;supervisor.heartbeat:4"``
+   (two consecutive stalls at the 3rd step): the escalation ladder must
+   clear it — rung 1 retry, rung 2 rebind — with NO manual
+   intervention, training must complete, and the supervisor counters
+   must report exactly that ladder walk.
+
+Exits non-zero on any violation.
+"""
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EPOCHS = 3
+BATCH = 16
+NBATCHES = 6          # 96 samples / 16
+STEP_PAUSE = 0.25     # child: seconds per batch, the parent's kill window
+
+
+def _build_symbol(mx):
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def child(workdir: str, tag: str, resume: bool, pause: float,
+          stall: bool) -> int:
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import resilience
+    from mxnet_tpu.resilience import Preempted, TrainingSupervisor
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(BATCH * NBATCHES, 8).astype(np.float32)
+    y = rng.randint(0, 4, (BATCH * NBATCHES,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=True, seed=11,
+                           label_name="softmax_label")
+    mx.random.seed(7)
+    mod = mx.mod.Module(_build_symbol(mx), data_names=["data"],
+                        label_names=["softmax_label"])
+    hashes_path = os.path.join(workdir, f"hashes-{tag}.jsonl")
+    out = open(hashes_path, "a", encoding="utf-8")
+
+    def record(param):
+        b = param.locals["batch"]
+        digest = hashlib.sha256(np.ascontiguousarray(
+            b.data[0].asnumpy()).tobytes()).hexdigest()[:16]
+        out.write(json.dumps([param.epoch, param.nbatch, digest]) + "\n")
+        out.flush()
+        print(f"BATCH {param.epoch} {param.nbatch}", flush=True)
+        if pause:
+            time.sleep(pause)   # the parent's window to land the SIGTERM
+
+    try:
+        mod.fit(it, num_epoch=EPOCHS, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                initializer=mx.init.Xavier(), batch_end_callback=record,
+                checkpoint_prefix=os.path.join(workdir, "ck"),
+                resume="auto" if resume else None,
+                supervisor=TrainingSupervisor())
+    except Preempted as err:
+        out.close()
+        print(f"PREEMPTED {err.exit_code}", flush=True)
+        return err.exit_code
+    out.close()
+    if stall:
+        print("STATS " + json.dumps(resilience.stats()["supervisor"]),
+              flush=True)
+    print("DONE", flush=True)
+    return 0
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def read_hashes(workdir, tag):
+    path = os.path.join(workdir, f"hashes-{tag}.jsonl")
+    with open(path, "r", encoding="utf-8") as f:
+        return [tuple(json.loads(line)) for line in f if line.strip()]
+
+
+def spawn(workdir, tag, *, resume=False, pause=0.0, stall=False, env=None):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", workdir,
+           "--tag", tag, "--pause", str(pause)]
+    if resume:
+        cmd.append("--resume")
+    if stall:
+        cmd.append("--stall")
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                            env=full_env,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+
+
+def main():
+    import tempfile
+
+    from mxnet_tpu.resilience.supervisor import (EXIT_PREEMPTED,
+                                                 read_preempt_marker)
+
+    # -- leg 1: real SIGTERM mid-epoch -> marker + bitwise resume -----------
+    with tempfile.TemporaryDirectory() as ref_dir:
+        proc = spawn(ref_dir, "ref")
+        out, _ = proc.communicate(timeout=240)
+        check(proc.returncode == 0, f"reference run completes (rc "
+                                    f"{proc.returncode})")
+        ref = read_hashes(ref_dir, "ref")
+        check(len(ref) == EPOCHS * NBATCHES,
+              f"reference stream has {EPOCHS * NBATCHES} batches")
+
+        with tempfile.TemporaryDirectory() as d:
+            proc = spawn(d, "killed", pause=STEP_PAUSE)
+            # wait until the child is mid-epoch (epoch 1, batch >= 1),
+            # then send the real SIGTERM
+            for line in proc.stdout:
+                line = line.strip()
+                if line.startswith("BATCH"):
+                    _, ep, nb = line.split()
+                    if int(ep) >= 1 and int(nb) >= 1:
+                        proc.send_signal(signal.SIGTERM)
+                        break
+            proc.stdout.read()       # drain to EOF
+            rc = proc.wait(timeout=240)
+            check(rc == EXIT_PREEMPTED,
+                  f"SIGTERM mid-epoch exits with the typed code "
+                  f"{EXIT_PREEMPTED} (got {rc})")
+            marker = read_preempt_marker(os.path.join(d, "ck"))
+            check(marker is not None and marker.get("clean"),
+                  f"clean-exit marker written ({marker})")
+            killed = read_hashes(d, "killed")
+            check(0 < len(killed) < len(ref),
+                  f"child was killed mid-run ({len(killed)} batches)")
+            check(killed == ref[:len(killed)],
+                  "killed run's stream is a bitwise prefix of the "
+                  "reference")
+            check((marker["epoch"], marker["nbatch"])
+                  == tuple(killed[-1][:2]),
+                  "marker records exactly the last trained batch")
+
+            proc = spawn(d, "resumed", resume=True)
+            out, _ = proc.communicate(timeout=240)
+            check(proc.returncode == 0,
+                  f"resumed run completes (rc {proc.returncode})")
+            resumed = read_hashes(d, "resumed")
+            check(killed + resumed == ref,
+                  "killed prefix + resumed suffix == reference stream "
+                  "(bitwise-exact resume)")
+            check(read_preempt_marker(os.path.join(d, "ck")) is None,
+                  "resume consumed the clean-exit marker")
+
+    # -- leg 2: injected stall -> the ladder recovers unattended ------------
+    with tempfile.TemporaryDirectory() as d:
+        plan = "supervisor.heartbeat:3;supervisor.heartbeat:4"
+        proc = spawn(d, "stall", stall=True,
+                     env={"MXNET_TPU_FAULT_PLAN": plan})
+        out, _ = proc.communicate(timeout=240)
+        check(proc.returncode == 0,
+              f"stalled run recovers and completes (rc {proc.returncode})")
+        stats = None
+        for line in out.splitlines():
+            if line.startswith("STATS "):
+                stats = json.loads(line[len("STATS "):])
+        check(stats is not None, "child reported supervisor stats")
+        check(stats["stalls"] == 2 and stats["stall_retries"] == 1
+              and stats["stall_rebinds"] == 1
+              and stats["stall_aborts"] == 0,
+              f"escalation ladder cleared the stall: retry then rebind "
+              f"({stats})")
+        stalled = read_hashes(d, "stall")
+        check(len(stalled) == EPOCHS * NBATCHES,
+              "stalled run still trained every batch")
+
+    print("preempt smoke: PASS")
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        args = sys.argv[1:]
+        workdir = args[args.index("--child") + 1]
+        tag = args[args.index("--tag") + 1]
+        pause = float(args[args.index("--pause") + 1])
+        sys.exit(child(workdir, tag, resume="--resume" in args,
+                       pause=pause, stall="--stall" in args))
+    main()

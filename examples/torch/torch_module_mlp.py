@@ -42,8 +42,12 @@ def main():
                            label_name="softmax_label")
     mod = mx.mod.Module(net, data_names=["data"],
                         label_names=["softmax_label"])
+    # momentum matters here: plain SGD at this lr plateaus at ~0.898 on
+    # the seeded data — right under the 0.9 gate (a marginal convergence
+    # gate reads as a flake); with momentum the same budget lands 0.98+
+    # across seeds, so the gate tests convergence, not luck
     mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
-            optimizer_params={"learning_rate": 0.3},
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
             initializer=mx.init.Xavier())
     acc = mod.score(it, mx.metric.Accuracy())[0][1]
     print(f"accuracy with torch layers: {acc:.4f}")

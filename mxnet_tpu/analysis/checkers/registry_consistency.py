@@ -36,11 +36,11 @@ FAULTS_PY = "mxnet_tpu/resilience/faults.py"
 # forever.
 FAULT_TESTS = ("tests/test_resilience.py", "tests/test_serving.py",
                "tests/test_resilience_data.py", "tests/test_elastic.py",
-               "tests/test_compiler.py")
+               "tests/test_compiler.py", "tests/test_supervisor.py")
 FAULT_DOCS = ("docs/how_to/fault_tolerance.md", "docs/how_to/serving.md",
               "docs/how_to/data_resilience.md",
               "docs/how_to/elastic_training.md",
-              "docs/how_to/compiler.md")
+              "docs/how_to/compiler.md", "docs/how_to/preemption.md")
 OPS_PREFIX = "mxnet_tpu/ops/"
 DOC_BASES = {"NDArrayDoc", "SymbolDoc"}
 

@@ -102,3 +102,22 @@ register_knob("MXTPU_OP_COSTS", str, None,
 register_knob("MXTPU_PROGRAM_REGISTRY_CAP", int, 64,
               "max fingerprint-keyed executor program bundles shared "
               "in-process (LRU; eviction only costs sharing)")
+register_knob("MXTPU_SUPERVISOR", int, 0,
+              "arm the preemption-aware training supervisor in every "
+              "fit() (signal handlers, stall watchdog, crash-loop "
+              "guard; docs/how_to/preemption.md)")
+register_knob("MXTPU_STALL_TIMEOUT", float, None,
+              "seconds a step heartbeat may go stale before the "
+              "watchdog raises StepStalled and walks the escalation "
+              "ladder (unset = watchdog off)")
+register_knob("MXTPU_STALL_POLL", float, None,
+              "watchdog thread poll period, seconds (default: "
+              "stall timeout / 4)")
+register_knob("MXTPU_CRASH_LOOP_LIMIT", int, 3,
+              "consecutive resume attempts at one (epoch, batch) before "
+              "that batch is quarantined as poison")
+register_knob("MXTPU_CRASH_BACKOFF_BASE", float, 1.0,
+              "first crash-loop resume backoff, seconds (doubles per "
+              "repeat attempt)")
+register_knob("MXTPU_CRASH_BACKOFF_CAP", float, 60.0,
+              "upper bound on one crash-loop resume backoff, seconds")

@@ -276,7 +276,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint_prefix=None, checkpoint_period=1,
             checkpoint_batch_period=None, resume=None,
-            save_optimizer_states=True):
+            save_optimizer_states=True, supervisor=None):
         """reference: base_module.py:376 — the canonical Module training
         loop: bind → init params/optimizer → per-epoch train pass with
         lookahead prepare, then the optional validation pass.
@@ -293,12 +293,27 @@ class BaseModule:
         supports ``load_state_dict``, from its exact batch position, so
         the resumed run replays a bitwise-identical batch sequence; with
         no valid checkpoint it starts fresh. ``resume=<int>`` demands
-        that specific epoch."""
+        that specific epoch.
+
+        Preemption awareness (docs/how_to/preemption.md): ``supervisor``
+        (True, a :class:`~mxnet_tpu.resilience.TrainingSupervisor`, or
+        armed process-wide via ``MXTPU_SUPERVISOR=1``) makes the loop
+        survive what doesn't raise — SIGTERM finishes the in-flight
+        step, checkpoints with iterator state, writes a clean-exit
+        marker and raises :class:`~mxnet_tpu.resilience.Preempted`
+        (typed exit code); a stalled step walks the retry → rebind →
+        abort escalation ladder; repeated crashes at one (epoch, batch)
+        back off exponentially and eventually quarantine that batch."""
         assert num_epoch is not None, "please specify number of epochs"
+
+        from ..resilience import supervisor as _sup_mod
+        sup = _sup_mod.resolve(supervisor)
 
         resume_states = None
         resume_iter_state = None
         begin_batch = 0
+        resumed = False
+        resumed_label = None
         if resume is True:   # fit(resume=True) means 'auto', not epoch 1
             resume = "auto"
         if resume is not None and resume is not False:
@@ -340,6 +355,15 @@ class BaseModule:
                         "mid-epoch", checkpoint_prefix, err, ck_epoch)
                 self.logger.info("fit: resuming from checkpoint %s epoch=%s",
                                  checkpoint_prefix, ck_epoch)
+                resumed = True
+                resumed_label = ck_epoch
+                # an abnormal exit strands superseded mid-epoch stems
+                # (killed between a mid save and its roll, or before the
+                # epoch-end sweep); GC them now, bounded by the stem we
+                # actually loaded so a fallback never deletes newer
+                # evidence (docs/how_to/preemption.md)
+                from ..resilience.checkpoint import sweep_stale_checkpoints
+                sweep_stale_checkpoints(checkpoint_prefix, used=ck_epoch)
             except (FileNotFoundError, CheckpointCorrupt):
                 # only "nothing to resume" starts fresh; an unreachable
                 # checkpoint directory (dead mount, permissions) raises —
@@ -355,6 +379,26 @@ class BaseModule:
         if resume_iter_state is not None:
             begin_epoch, begin_batch = apply_resume_state(
                 train_data, resume_iter_state, logger=self.logger)
+
+        crash_guard = None
+        if sup is not None and checkpoint_prefix:
+            if resumed:
+                # the clean-exit marker served its purpose: this resume
+                # consumed the preemption checkpoint
+                _sup_mod.clear_preempt_marker(checkpoint_prefix)
+                # crash-loop protection: repeated resumes at the same
+                # (epoch, batch) back off exponentially; past the limit
+                # that batch is presumed poison and quarantined under
+                # the DataGuardPolicy budget (resilience/supervisor.py)
+                crash_guard = sup.crash_guard(checkpoint_prefix)
+                crash_guard.on_resume(begin_epoch, begin_batch)
+                begin_batch = _sup_mod.skip_quarantined_batches(
+                    train_data, crash_guard, begin_epoch, begin_batch,
+                    logger=self.logger)
+            else:
+                # a fresh run at this prefix starts a new lineage: a
+                # stale clean-exit marker must not claim it was preempted
+                _sup_mod.clear_preempt_marker(checkpoint_prefix)
 
         # warm-start accounting for resumed runs: the persistent
         # compilation cache (mxnet_tpu/compiler) serves this process's
@@ -384,49 +428,91 @@ class BaseModule:
         validation_metric = validation_metric or train_metric
 
         can_snapshot = _supports_state(train_data)
-        if can_snapshot and checkpoint_prefix and checkpoint_batch_period \
+        if can_snapshot and checkpoint_prefix \
+                and (checkpoint_batch_period or sup is not None) \
                 and hasattr(train_data, "enable_state_snapshots"):
             # PrefetchingIter-style sources capture per-prefetch
             # snapshots only once armed — they cost O(dataset) each, so
-            # arming is tied to batch-period checkpointing; the
-            # epoch-end-only snapshot below degrades gracefully instead
+            # arming is tied to batch-period checkpointing (or an armed
+            # supervisor, whose preemption checkpoint can land on any
+            # batch); the epoch-end-only snapshot degrades gracefully
             train_data.enable_state_snapshots()
         batch_ckpt = None
-        if checkpoint_prefix and checkpoint_batch_period:
-            if can_snapshot:
-                from ..resilience.checkpoint import (mid_epoch_label,
-                                                     remove_checkpoint)
-                prev_mid = [None]
+        mid_saver = None
+        if checkpoint_prefix and (checkpoint_batch_period
+                                  or sup is not None):
+            from ..resilience.checkpoint import (mid_epoch_label,
+                                                 remove_checkpoint)
+            prev_mid = [None]
 
-                def _save_mid_epoch(ep, nbatch, iter_snapshot):
-                    # a FRESH stem per save (mid_epoch_label): never
-                    # overwrite the previous good checkpoint in place —
-                    # a torn multi-file replace would destroy it. The
-                    # superseded mid-epoch stem is rolled afterwards so
-                    # a long epoch holds at most one on disk.
-                    label = mid_epoch_label(ep, nbatch)
-                    self._write_fit_checkpoint(
-                        checkpoint_prefix, label, save_optimizer_states,
-                        iter_state={"epoch": ep, "nbatch": nbatch + 1,
-                                    "iterator": iter_snapshot})
-                    if prev_mid[0] is not None:
-                        remove_checkpoint(checkpoint_prefix, prev_mid[0])
-                    prev_mid[0] = label
+            def _save_mid_epoch(ep, nbatch, iter_snapshot):
+                # a FRESH stem per save (mid_epoch_label): never
+                # overwrite the previous good checkpoint in place —
+                # a torn multi-file replace would destroy it. The
+                # superseded mid-epoch stem is rolled afterwards so
+                # a long epoch holds at most one on disk.
+                label = mid_epoch_label(ep, nbatch)
+                if prev_mid[0] == label:
+                    # this batch's period save already captured exactly
+                    # this state (a preempt/abort landing on a
+                    # checkpoint batch): re-writing would delete-then-
+                    # rewrite the newest good checkpoint, and the roll
+                    # below would then remove the stem it just wrote
+                    return label
+                self._write_fit_checkpoint(
+                    checkpoint_prefix, label, save_optimizer_states,
+                    iter_state=({"epoch": ep, "nbatch": nbatch + 1,
+                                 "iterator": iter_snapshot}
+                                if iter_snapshot is not None else None))
+                if prev_mid[0] is not None:
+                    remove_checkpoint(checkpoint_prefix, prev_mid[0])
+                prev_mid[0] = label
+                return label
 
+            mid_saver = _save_mid_epoch
+            if checkpoint_batch_period and can_snapshot:
                 batch_ckpt = (max(1, int(checkpoint_batch_period)),
                               _save_mid_epoch)
-            else:
-                self.logger.warning(
-                    "checkpoint_batch_period=%s ignored: train_data (%s) "
-                    "has no state_dict()", checkpoint_batch_period,
-                    type(train_data).__name__)
+        if checkpoint_batch_period and not can_snapshot:
+            self.logger.warning(
+                "checkpoint_batch_period=%s ignored: train_data (%s) "
+                "has no state_dict()", checkpoint_batch_period,
+                type(train_data).__name__)
 
+        from contextlib import ExitStack
+        with ExitStack() as _sup_stack:
+            if sup is not None:
+                _sup_stack.enter_context(sup.attach())
+            self._fit_epochs(
+                train_data, eval_data, begin_epoch, begin_batch, num_epoch,
+                train_metric, validation_metric, batch_end_callback,
+                epoch_end_callback, eval_end_callback,
+                eval_batch_end_callback, monitor, checkpoint_prefix,
+                checkpoint_period, save_optimizer_states, can_snapshot,
+                batch_ckpt, resume_compiler_base, sup, mid_saver,
+                crash_guard, resumed_label)
+
+    def _fit_epochs(self, train_data, eval_data, begin_epoch, begin_batch,
+                    num_epoch, train_metric, validation_metric,
+                    batch_end_callback, epoch_end_callback,
+                    eval_end_callback, eval_batch_end_callback, monitor,
+                    checkpoint_prefix, checkpoint_period,
+                    save_optimizer_states, can_snapshot, batch_ckpt,
+                    resume_compiler_base, sup, mid_saver, crash_guard,
+                    resumed_label=None):
+        """The epoch loop of :meth:`fit` (extracted so the supervisor
+        context wraps exactly the supervised region)."""
         for epoch in range(begin_epoch, num_epoch):
             started = time.time()
-            nseen = self._train_one_epoch(train_data, epoch, train_metric,
-                                          batch_end_callback, monitor,
-                                          begin_batch=begin_batch,
-                                          batch_ckpt=batch_ckpt)
+            nseen = self._train_one_epoch(
+                train_data, epoch, train_metric, batch_end_callback,
+                monitor, begin_batch=begin_batch, batch_ckpt=batch_ckpt,
+                sup=sup,
+                snapshot_fn=(train_data.state_dict if can_snapshot
+                             else None),
+                mid_saver=mid_saver, crash_guard=crash_guard,
+                marker_target=checkpoint_prefix,
+                resumed_label=resumed_label)
             # a mid-epoch resume whose checkpoint landed on the epoch's
             # last batch replays an empty tail: the epoch's end-of-epoch
             # callbacks and eval (almost certainly) already ran before
@@ -530,12 +616,25 @@ class BaseModule:
 
     def _train_one_epoch(self, train_data, epoch, train_metric,
                          batch_end_callback, monitor, begin_batch=0,
-                         batch_ckpt=None):
+                         batch_ckpt=None, sup=None, snapshot_fn=None,
+                         mid_saver=None, crash_guard=None,
+                         marker_target=None, resumed_label=None):
         """Returns the number of batches trained this epoch."""
         train_metric.reset()
         snapshot = want = None
-        if batch_ckpt is not None:
-            snapshot = train_data.state_dict
+        if sup is not None and snapshot_fn is not None:
+            # preemption/stall checkpoints can land on ANY batch, and a
+            # checkpoint's params must pair with the EXACT iterator
+            # position (a stale snapshot would double-train the gap on
+            # resume) — so the supervised loop deliberately snapshots
+            # every batch, overriding the want() cost gate below. Cheap
+            # for the standard iterators (position + rng); a source
+            # whose state_dict pays O(dataset) should amortize it like
+            # PrefetchingIter's armed per-prefetch snapshots, or report
+            # supports_state False and accept epoch-granularity preempt
+            snapshot = snapshot_fn
+        elif batch_ckpt is not None:
+            snapshot = snapshot_fn or train_data.state_dict
             period = batch_ckpt[0]
             # snapshot only the batches that will actually checkpoint
             want = lambda k: (begin_batch + k + 1) % period == 0  # noqa: E731
@@ -544,22 +643,63 @@ class BaseModule:
         # that cannot take it (monitor installed, kvstore, sparse grads,
         # exotic optimizer, ...) return None and keep the imperative pair
         fused_step = None
+        rebind = None
         if monitor is None:
             getter = getattr(self, "_fused_train_step", None)
             if getter is not None:
                 fused_step = getter()
+            if fused_step is not None:
+                # stall-ladder rung 2: rebuild the donated whole-step
+                # program (FusedStep.rebind via the module's stepper)
+                rebind = getattr(self, "_rebind_fused_step", None)
         nseen = 0
+        prev_state = None       # last *trained* position (abort rewind)
+        progressed = False
         for k, (batch, upcoming, state) in enumerate(
                 _lookahead(train_data, snapshot, want)):
             nbatch = begin_batch + k
             nseen = k + 1
             if monitor is not None:
                 monitor.tic()
-            if fused_step is not None:
-                fused_step(batch)
+            if sup is None:
+                if fused_step is not None:
+                    fused_step(batch)
+                else:
+                    self.forward_backward(batch)
+                    self.update()
             else:
-                self.forward_backward(batch)
-                self.update()
+                def _one_step(_b=batch):
+                    if fused_step is not None:
+                        fused_step(_b)
+                    else:
+                        self.forward_backward(_b)
+                        self.update()
+
+                def _abort_ckpt(err, _nb=nbatch, _ps=prev_state):
+                    # ladder exhausted: persist the last consistent,
+                    # fully-trained position (the stalled batch itself
+                    # replays on resume)
+                    if mid_saver is None:
+                        return
+                    from ..resilience.checkpoint import mid_epoch_label
+                    target = mid_epoch_label(epoch, max(_nb - 1, 0))
+                    if target == resumed_label:
+                        # zero successful steps since resume: the stem
+                        # this run resumed from IS this exact state —
+                        # rewriting it in place (with the job already
+                        # dying) risks tearing the only good checkpoint
+                        return
+                    mid_saver(epoch, max(_nb - 1, 0),
+                              _ps if _nb > 0 else None)
+
+                sup.run_step(_one_step, rebind=rebind,
+                             on_abort=_abort_ckpt,
+                             label=f"step epoch {epoch} batch {nbatch}")
+            if crash_guard is not None and not progressed:
+                # first successful step past the resume point: the
+                # crash-loop attempt counter starts over
+                crash_guard.note_progress()
+                progressed = True
             if upcoming is not None:
                 self.prepare(upcoming)
             self.update_metric(train_metric, batch.label)
@@ -570,6 +710,18 @@ class BaseModule:
                                 eval_metric=train_metric, locals=locals()))
             if batch_ckpt is not None and (nbatch + 1) % batch_ckpt[0] == 0:
                 batch_ckpt[1](epoch, nbatch, state)
+            if sup is not None and sup.check_preempt():
+                # graceful preemption: the in-flight step above finished;
+                # checkpoint exactly this position (+ iterator state when
+                # snapshots are available), drop the clean-exit marker,
+                # exit typed. resume='auto' continues bitwise.
+                label = None
+                if mid_saver is not None:
+                    label = mid_saver(epoch, nbatch, state)
+                sup.preempt_exit(marker_target, label=label, epoch=epoch,
+                                 nbatch=nbatch)
+            if state is not None:
+                prev_state = state
         return nseen
 
     def prepare(self, data_batch):

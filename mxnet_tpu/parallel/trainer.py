@@ -315,11 +315,17 @@ class SPMDTrainer:
             f"lrm={sorted(lr_mult.items())}",
             f"zero={int(shard_opt)}", f"cdt={compute_dtype}",
             f"shards={shard_sig}")
-        self._step_fn = _compiler.PersistentJit(
-            self.retrace_guard.wrap(step), kind="spmd-step",
-            key_parts=key_parts,
-            donate_argnums=(0, 1, 2) if self._donate else (),
-            on_materialize=materialized)
+        def _build_step_fn():
+            self._step_fn = _compiler.PersistentJit(
+                self.retrace_guard.wrap(step), kind="spmd-step",
+                key_parts=key_parts,
+                donate_argnums=(0, 1, 2) if self._donate else (),
+                on_materialize=materialized)
+
+        # kept for rebind_step(): the stall-escalation ladder rebuilds
+        # the program without re-running bind (resilience/supervisor.py)
+        self._rebuild_step_fn = _build_step_fn
+        _build_step_fn()
         self._step_abstract_args = None  # re-snapshot after (re)bind
         # sequence parallelism: shard the sequence dim (dim 1) of token
         # inputs over the axis the graph's attention ops actually name —
@@ -344,6 +350,18 @@ class SPMDTrainer:
                     and shp[1] % mesh.shape[seq_axis] == 0):
                 spec[1] = seq_axis
             self._in_shardings[n] = NamedSharding(mesh, P(*spec))
+        return self
+
+    def rebind_step(self):
+        """Rebuild the donated step program on the SAME mesh and live
+        state — stall-escalation rung 2 (resilience/supervisor.py): a
+        wedged executable/dispatch is abandoned for a fresh jit. The
+        retrace guard treats this as a new program lifetime, and the
+        abstract-args snapshot survives (shapes/shardings unchanged)."""
+        if self._step_fn is None:
+            raise MXNetError("call bind() before rebind_step()")
+        self.retrace_guard.rebind()
+        self._rebuild_step_fn()
         return self
 
     # -- stepping ----------------------------------------------------------
@@ -612,7 +630,7 @@ class SPMDTrainer:
     def fit(self, train_data, num_epoch, checkpoint_dir=None,
             checkpoint_period=1, checkpoint_batch_period=None, resume=None,
             batch_end_callback=None, epoch_end_callback=None,
-            elastic=False, elastic_config=None):
+            elastic=False, elastic_config=None, supervisor=None):
         """Minimal epoch loop over a DataIter (call bind() first):
         each batch becomes one fused SPMD step. With ``checkpoint_dir``,
         a sharded checkpoint is written every ``checkpoint_period``
@@ -633,12 +651,23 @@ class SPMDTrainer:
         Pass a pre-built :class:`~mxnet_tpu.resilience.elastic.
         ElasticController` as ``elastic`` to inject a custom probe/
         health monitor; ``elastic_config`` takes an
-        :class:`~mxnet_tpu.resilience.elastic.ElasticConfig`."""
+        :class:`~mxnet_tpu.resilience.elastic.ElasticConfig`.
+
+        ``supervisor`` (True, a :class:`~mxnet_tpu.resilience.
+        TrainingSupervisor`, or ``MXTPU_SUPERVISOR=1``) arms preemption
+        awareness (docs/how_to/preemption.md): SIGTERM finishes the
+        in-flight step, checkpoints (iterator state included) with a
+        clean-exit marker and exits typed; a stalled step walks the
+        retry → ``rebind_step()`` → elastic re-mesh → abort ladder;
+        crash loops at one (epoch, batch) back off and quarantine."""
         if self._step_fn is None:
             raise MXNetError("call bind() before fit()")
+        from ..resilience import supervisor as _sup_mod
+        sup = _sup_mod.resolve(supervisor)
         begin_epoch = 0
         begin_batch = 0
         resume_iter = None
+        restored = None
         if resume is True:   # fit(resume=True) means 'auto', not step 1
             resume = "auto"
         if resume is not None and resume is not False:
@@ -665,16 +694,39 @@ class SPMDTrainer:
         if resume_iter is not None:
             begin_epoch, begin_batch = apply_resume_state(train_data,
                                                           resume_iter)
+        crash_guard = None
+        if sup is not None and checkpoint_dir:
+            if restored is not None:
+                # the clean-exit marker served its purpose: this resume
+                # consumed the preemption checkpoint
+                _sup_mod.clear_preempt_marker(checkpoint_dir)
+                # crash-loop protection (resilience/supervisor.py):
+                # repeated resumes at one (epoch, batch) back off
+                # exponentially; past the limit the batch is quarantined
+                # under the DataGuardPolicy budget and skipped
+                import os as _os
+                _os.makedirs(_os.path.abspath(checkpoint_dir),
+                             exist_ok=True)
+                crash_guard = sup.crash_guard(checkpoint_dir)
+                crash_guard.on_resume(begin_epoch, begin_batch)
+                begin_batch = _sup_mod.skip_quarantined_batches(
+                    train_data, crash_guard, begin_epoch, begin_batch)
+            else:
+                # fresh lineage: a stale clean-exit marker must not
+                # claim this run was preempted
+                _sup_mod.clear_preempt_marker(checkpoint_dir)
         cbs = (batch_end_callback if isinstance(batch_end_callback, list)
                else [batch_end_callback]) if batch_end_callback is not None \
             else []
         can_snapshot = _supports_state(train_data)
-        if can_snapshot and checkpoint_dir and checkpoint_batch_period \
+        if can_snapshot and checkpoint_dir \
+                and (checkpoint_batch_period or sup is not None) \
                 and hasattr(train_data, "enable_state_snapshots"):
             # PrefetchingIter-style sources capture per-prefetch
             # snapshots only once armed — they cost O(dataset) each, so
-            # arming is tied to batch-period checkpointing; the
-            # epoch-end-only snapshot below degrades gracefully instead
+            # arming is tied to batch-period checkpointing (or an armed
+            # supervisor, whose preemption checkpoint can land on any
+            # batch); the epoch-end-only snapshot degrades gracefully
             train_data.enable_state_snapshots()
         bperiod = max(1, int(checkpoint_batch_period)) \
             if checkpoint_batch_period else None
@@ -701,30 +753,43 @@ class SPMDTrainer:
                                      "checkpoint_dir")
                 controller = ElasticController(self, checkpoint_dir,
                                                config=elastic_config)
-        if controller is None:
-            self._run_epochs(train_data, num_epoch, begin_epoch,
-                             begin_batch, checkpoint_dir, checkpoint_period,
-                             bperiod, can_snapshot, cbs,
-                             epoch_end_callback, None)
-            return self
-        from ..resilience.elastic import DeviceLost
-        while True:
-            try:
+        if sup is not None:
+            # rung 3 of the stall ladder needs an elastic controller;
+            # without one the ladder is retry → rebind → abort
+            sup.can_remesh = controller is not None
+        from contextlib import ExitStack
+        with ExitStack() as _sup_stack:
+            if sup is not None:
+                _sup_stack.enter_context(sup.attach())
+            if controller is None:
                 self._run_epochs(train_data, num_epoch, begin_epoch,
                                  begin_batch, checkpoint_dir,
                                  checkpoint_period, bperiod, can_snapshot,
-                                 cbs, epoch_end_callback, controller)
+                                 cbs, epoch_end_callback, None, sup,
+                                 crash_guard)
                 return self
-            except DeviceLost as err:
-                # a collective participant died mid-step: the donated
-                # buffers are untrusted — re-mesh onto the survivors,
-                # restore the newest checkpoint, rewind the iterator
-                begin_epoch, begin_batch = controller.recover(train_data,
-                                                              err)
+            from ..resilience.elastic import DeviceLost
+            while True:
+                try:
+                    self._run_epochs(train_data, num_epoch, begin_epoch,
+                                     begin_batch, checkpoint_dir,
+                                     checkpoint_period, bperiod,
+                                     can_snapshot, cbs, epoch_end_callback,
+                                     controller, sup, crash_guard)
+                    return self
+                except DeviceLost as err:
+                    # a collective participant died mid-step (or a step
+                    # stalled through retry+rebind — the ladder's rung 3
+                    # surfaces as DeviceLost too): the donated buffers
+                    # are untrusted — re-mesh onto the survivors,
+                    # restore the newest checkpoint, rewind the iterator
+                    begin_epoch, begin_batch = controller.recover(
+                        train_data, err)
 
     def _run_epochs(self, train_data, num_epoch, begin_epoch, begin_batch,
                     checkpoint_dir, checkpoint_period, bperiod,
-                    can_snapshot, cbs, epoch_end_callback, controller):
+                    can_snapshot, cbs, epoch_end_callback, controller,
+                    sup=None, crash_guard=None):
         from ..callback import BatchEndParam
         # NOTE: this mid-epoch checkpoint orchestration deliberately
         # parallels BaseModule.fit (module/base_module.py) — the trainer
@@ -735,6 +800,20 @@ class SPMDTrainer:
         import shutil
         last_mid_step = None
         prev_mid_path = None
+        prev_state = None       # last *trained* position (stall rewinds)
+        progressed = False
+        remesh_exc = None
+        if sup is not None and controller is not None:
+            from ..resilience.elastic import DeviceLost
+
+            def remesh_exc(err):
+                # rung 3: a step that stalls through retry + rebind is
+                # treated as a sick participant — the outer fit loop's
+                # DeviceLost recovery restores onto survivors (PR 6)
+                return DeviceLost(
+                    f"step stalled through retry and rebind ({err}); "
+                    "escalating to elastic re-mesh: restore the newest "
+                    "checkpoint onto the surviving devices")
         for epoch in range(begin_epoch, num_epoch):
             if begin_batch == 0:
                 train_data.reset()
@@ -745,7 +824,41 @@ class SPMDTrainer:
                 nbatch = begin_batch + k
                 nseen = k + 1
                 inputs = self._batch_dict(batch)
-                step_outs = self.step(inputs)  # noqa: F841 — in locals()
+                if sup is None:
+                    step_outs = self.step(inputs)  # noqa: F841 in locals()
+                else:
+                    def _abort_ckpt(err, _ep=epoch, _ps=prev_state):
+                        # ladder exhausted: persist the last consistent,
+                        # fully-trained position before aborting (the
+                        # stalled batch itself replays on resume)
+                        if not checkpoint_dir:
+                            return
+                        import os
+                        step_dir = os.path.join(
+                            os.path.abspath(checkpoint_dir),
+                            f"step_{self._num_update}")
+                        if os.path.exists(os.path.join(
+                                step_dir, "manifest.json")):
+                            # this update count is already on disk —
+                            # e.g. the very checkpoint this run resumed
+                            # from, stalling before the first update
+                            # committed. Orbax force=True would delete
+                            # it before rewriting; with the job already
+                            # dying, a kill mid-save would destroy the
+                            # only good copy.
+                            return
+                        self.save_checkpoint(
+                            checkpoint_dir, step=self._num_update,
+                            epoch=_ep, iter_state=_ps)
+
+                    step_outs = sup.run_step(  # noqa: F841 — in locals()
+                        lambda _b=inputs: self.step(_b),
+                        rebind=self.rebind_step, remesh_exc=remesh_exc,
+                        on_abort=_abort_ckpt,
+                        label=f"SPMD step epoch {epoch} batch {nbatch}")
+                    if crash_guard is not None and not progressed:
+                        crash_guard.note_progress()
+                        progressed = True
                 for cb in cbs:
                     cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
                                      eval_metric=None, locals=locals()))
@@ -784,6 +897,50 @@ class SPMDTrainer:
                                 shutil.rmtree(prev_mid_path,
                                               ignore_errors=True)
                             prev_mid_path = cpath
+                if sup is not None:
+                    if can_snapshot:
+                        try:
+                            # "about to fetch nbatch+1": the exact resume
+                            # point after the step that just completed —
+                            # kept one batch behind for stall rewinds,
+                            # used directly by a preemption checkpoint.
+                            # Per-batch on purpose: checkpoint params
+                            # must pair with the exact position (a stale
+                            # snapshot double-trains the gap on resume);
+                            # O(dataset)-snapshot sources should report
+                            # supports_state False instead
+                            prev_state = {
+                                "epoch": epoch, "nbatch": nbatch + 1,
+                                "iterator": train_data.state_dict()}
+                        except MXNetError:
+                            prev_state = None
+                    if sup.check_preempt():
+                        # graceful preemption: the in-flight step is
+                        # done; checkpoint this exact position, drop the
+                        # clean-exit marker, exit typed (resume='auto'
+                        # continues bitwise)
+                        if checkpoint_dir:
+                            import os
+                            step_dir = os.path.join(
+                                os.path.abspath(checkpoint_dir),
+                                f"step_{self._num_update}")
+                            if not os.path.exists(os.path.join(
+                                    step_dir, "manifest.json")):
+                                # a bperiod save this very batch already
+                                # captured this exact state; re-saving
+                                # would delete-then-rewrite the newest
+                                # good checkpoint
+                                step_dir = self.save_checkpoint(
+                                    checkpoint_dir, step=self._num_update,
+                                    epoch=epoch, iter_state=prev_state)
+                            last_mid_step = self._num_update
+                            if prev_mid_path not in (None, step_dir):
+                                shutil.rmtree(prev_mid_path,
+                                              ignore_errors=True)
+                            prev_mid_path = step_dir
+                        sup.preempt_exit(
+                            checkpoint_dir, label=self._num_update,
+                            epoch=epoch, nbatch=nbatch)
             # a mid-epoch resume whose checkpoint landed on the epoch's
             # last batch replays an empty tail: this epoch's end-of-epoch
             # callback and checkpoint already happened before the crash

@@ -643,6 +643,13 @@ class ModuleStepper:
         refresh rebuilds state, not the program, so no retrace."""
         self._stale = True
 
+    def rebind(self):
+        """Rebuild the donated whole-step program (stall-escalation
+        rung 2, resilience/supervisor.py): a wedged executable/dispatch
+        is abandoned for a fresh jit; device-side state is untouched."""
+        self._fused.rebind()
+        return self
+
     def refresh(self):
         mod = self._module
         exec_ = mod._exec

@@ -20,6 +20,12 @@ Three pillars (docs/how_to/fault_tolerance.md):
   (docs/how_to/elastic_training.md): device-loss/addition detection
   (``mesh.probe``/``mesh.collective`` fault sites, injectable probe),
   checkpoint → re-mesh → re-shard → bitwise-exact resume.
+- :mod:`.supervisor` — the preemption-aware training supervisor
+  (docs/how_to/preemption.md): graceful SIGTERM checkpointing with a
+  clean-exit marker and typed exit codes, a step-stall watchdog with a
+  retry → rebind → re-mesh → abort escalation ladder
+  (``supervisor.signal``/``supervisor.heartbeat`` fault sites), and
+  crash-loop backoff with poison-batch quarantine.
 
 The reference stack's ps-lite heartbeat/dead-node machinery collapsed in
 the SPMD port to "a dead process fails the collective for everyone"
@@ -28,7 +34,7 @@ the SPMD port to "a dead process fails the collective for everyone"
 """
 from __future__ import annotations
 
-from . import checkpoint, data, elastic, faults, retry  # noqa: F401
+from . import checkpoint, data, elastic, faults, retry, supervisor  # noqa: F401,E501
 from .checkpoint import (AUTO, CheckpointCorrupt, atomic_output,  # noqa: F401
                          atomic_write_bytes, find_checkpoints,
                          load_checkpoint_ex, verify_manifest,
@@ -40,6 +46,9 @@ from .elastic import (DeviceLost, ElasticConfig,  # noqa: F401
 from .faults import (SITES, FaultPlan, InjectedFault,  # noqa: F401
                      InjectedKill, InjectedTimeout, fault_point)
 from .retry import RetryExhausted, RetryPolicy, default_policy  # noqa: F401
+from .supervisor import (CrashLoopGuard, ImmediateAbort,  # noqa: F401
+                         Preempted, SignalRuntime, StallAbort,
+                         StallWatchdog, StepStalled, TrainingSupervisor)
 
 __all__ = ["checkpoint", "data", "elastic", "faults", "retry", "FaultPlan",
            "RetryPolicy", "RetryExhausted", "CheckpointCorrupt",
@@ -48,7 +57,9 @@ __all__ = ["checkpoint", "data", "elastic", "faults", "retry", "FaultPlan",
            "reset_stats", "AUTO", "SITES", "DataGuardPolicy",
            "DataBudgetExceeded", "ShardSet", "ResilientIter", "RecordIter",
            "guard", "DeviceLost", "MeshHealth", "ElasticConfig",
-           "ElasticController"]
+           "ElasticController", "supervisor", "TrainingSupervisor",
+           "SignalRuntime", "StallWatchdog", "CrashLoopGuard", "Preempted",
+           "ImmediateAbort", "StepStalled", "StallAbort"]
 
 
 def guarded_call(site: str, fn, *args, policy=None, **kwargs):
@@ -86,7 +97,8 @@ def stats() -> dict:
     """Combined fault + retry + data-pipeline counters (surfaced by
     ``callback.ResilienceMonitor`` and ``KVStore.num_dead_node``)."""
     return {"faults": faults.stats(), "retry": retry.stats(),
-            "data": data.stats(), "elastic": elastic.stats()}
+            "data": data.stats(), "elastic": elastic.stats(),
+            "supervisor": supervisor.stats()}
 
 
 def reset_stats():
@@ -94,3 +106,4 @@ def reset_stats():
     retry.reset_stats()
     data.reset_stats()
     elastic.reset_stats()
+    supervisor.reset_stats()

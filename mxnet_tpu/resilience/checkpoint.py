@@ -36,8 +36,8 @@ __all__ = ["CheckpointCorrupt", "atomic_output", "atomic_write_bytes",
            "manifest_path", "checkpoint_paths", "write_checkpoint",
            "find_checkpoints", "load_checkpoint_ex", "load_iter_state",
            "mid_epoch_label", "epoch_of_label", "remove_checkpoint",
-           "clear_mid_epoch_checkpoints", "MID_EPOCH_STRIDE",
-           "MANIFEST_VERSION"]
+           "clear_mid_epoch_checkpoints", "sweep_stale_checkpoints",
+           "MID_EPOCH_STRIDE", "MANIFEST_VERSION"]
 
 MANIFEST_VERSION = 1
 
@@ -272,12 +272,14 @@ _EPOCH_RE = re.compile(r"-(\d{4,})\.params$")
 
 
 def find_checkpoints(prefix: str) -> List[Optional[int]]:
-    """Epochs with a params file at ``prefix``, newest first — by epoch
-    number (the semantic recency key; mtimes lie after a backup restore),
-    file mtime breaking ties. ``None`` denotes the epoch-less scheme and
-    sorts oldest. A missing directory means no checkpoints; any other
-    listing failure (permissions, dead mount) propagates — it must not
-    masquerade as a fresh start."""
+    """Epochs with a params file at ``prefix``, newest first — by
+    *supersession order* (:func:`_order_key`: an end-of-epoch label
+    outranks every mid-epoch stem of earlier epochs, not just smaller
+    raw labels; mtimes lie after a backup restore so they only break
+    ties). ``None`` denotes the epoch-less scheme and sorts oldest. A
+    missing directory means no checkpoints; any other listing failure
+    (permissions, dead mount) propagates — it must not masquerade as a
+    fresh start."""
     base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
     base = os.path.basename(prefix)
     found = []
@@ -297,7 +299,7 @@ def find_checkpoints(prefix: str) -> List[Optional[int]]:
                 continue
             epoch = int(m.group(1))
         st = os.stat(os.path.join(base_dir, name))
-        found.append((-1 if epoch is None else epoch, st.st_mtime_ns, epoch))
+        found.append((_order_key(epoch), st.st_mtime_ns, epoch))
     found.sort(key=lambda t: (t[0], t[1]), reverse=True)
     return [t[2] for t in found]
 
@@ -314,6 +316,20 @@ AUTO = "auto"
 #: and are swept by :func:`clear_mid_epoch_checkpoints` once the
 #: epoch-end checkpoint that supersedes them lands.
 MID_EPOCH_STRIDE = 1000000
+
+
+def _order_key(label: Optional[int]) -> int:
+    """Total supersession order over checkpoint labels: the epoch-less
+    scheme sorts oldest; an end-of-epoch label L (L epochs completed)
+    supersedes every mid-epoch stem of epochs < L, whose labels are in
+    ``[(E+1)*STRIDE + 1, (E+2)*STRIDE)`` for epoch E ≤ L-1 — i.e.
+    everything below ``(L+1)*STRIDE``; mid-epoch stems order by their
+    own (monotonic within the epoch) label."""
+    if label is None:
+        return -1
+    if label < MID_EPOCH_STRIDE:
+        return (label + 1) * MID_EPOCH_STRIDE
+    return label
 
 
 def mid_epoch_label(epoch: int, nbatch: int) -> int:
@@ -365,6 +381,45 @@ def clear_mid_epoch_checkpoints(prefix: str, completed_epoch: int):
         if ep is None or ep < MID_EPOCH_STRIDE or ep >= bound:
             continue
         remove_checkpoint(prefix, ep)
+
+
+def sweep_stale_checkpoints(prefix: str, used=None) -> int:
+    """GC mid-epoch stems superseded by a newer checkpoint. Returns the
+    number of stems removed.
+
+    Normal runs roll mid-epoch stems as they go and sweep them at epoch
+    end — but an *abnormal* exit (kill between a mid save and its roll,
+    or between the epoch-end write and its sweep) strands superseded
+    ``<stem>.iter.json`` checkpoints on disk. This runs at the next
+    discovery (the ``fit(resume=...)`` paths call it after a successful
+    load) so stale stems die at startup, not only at the epoch end they
+    never reached.
+
+    ``used`` pins the supersession bound to the checkpoint actually
+    resumed (never sweep anything newer than what was loaded — an
+    ``auto`` resume that *fell back* past a corrupt newest stem must
+    keep the evidence); ``None`` bounds by the newest stem present.
+    Failures are non-fatal, like :func:`clear_mid_epoch_checkpoints`:
+    a stale stem is redundant, not wrong."""
+    candidates = find_checkpoints(prefix)
+    if not candidates:
+        return 0
+    bound_label = candidates[0] if used is None else used
+    if bound_label is None:
+        return 0
+    bound = _order_key(bound_label)
+    removed = 0
+    for ep in candidates:
+        if ep is None or ep < MID_EPOCH_STRIDE or ep == bound_label:
+            continue
+        if _order_key(ep) < bound:
+            remove_checkpoint(prefix, ep)
+            removed += 1
+    if removed:
+        logging.info("swept %d stale mid-epoch checkpoint stem(s) at %s "
+                     "(superseded by %s)", removed, prefix,
+                     _stem(prefix, bound_label))
+    return removed
 
 
 def load_checkpoint_ex(prefix: str, epoch=AUTO, allow_fallback: bool = True,

@@ -51,7 +51,13 @@ SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          # persistent compilation cache (mxnet_tpu/compiler/cache.py,
          # docs/how_to/compiler.md): a failed/corrupt entry read is
          # quarantined and falls back to recompile, never fails a bind
-         "compiler.cache.read")
+         "compiler.cache.read",
+         # training supervisor (resilience/supervisor.py,
+         # docs/how_to/preemption.md): an injected fault at
+         # supervisor.signal simulates a delivered SIGTERM, one at
+         # supervisor.heartbeat simulates a stalled step (drives the
+         # retry → rebind → re-mesh → abort escalation ladder)
+         "supervisor.signal", "supervisor.heartbeat")
 
 ENV_PLAN = "MXNET_TPU_FAULT_PLAN"
 ENV_SEED = "MXNET_TPU_FAULT_SEED"

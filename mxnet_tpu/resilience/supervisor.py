@@ -1,0 +1,871 @@
+"""Preemption-aware training supervision: signals, stalls, crash loops.
+
+The resilience runtime so far recovers from faults that *raise* —
+corrupt records (data.py), dead devices (elastic.py), failed I/O
+(retry.py) — but a production TPU job's most common killers don't
+raise: the scheduler sends SIGTERM (preemption), or a step silently
+hangs (wedged collective, stuck data fetch, stalled compile) and
+``fit()`` blocks forever. This module turns both into checkpointed,
+resumable events (docs/how_to/preemption.md):
+
+- **graceful preemption** — :class:`TrainingSupervisor` installs
+  SIGTERM/SIGINT handlers through one shared :class:`SignalRuntime`.
+  The first signal only sets a flag; the fit loop finishes the
+  in-flight step, writes an atomic checkpoint + iterator state (the
+  PR 1/4 plumbing) and a clean-exit *marker*, then raises
+  :class:`Preempted` carrying :data:`EXIT_PREEMPTED`. A second signal
+  means the scheduler is out of patience: :class:`ImmediateAbort` (a
+  BaseException, like :class:`~.faults.InjectedKill`) aborts on the
+  spot with :data:`EXIT_ABORTED` — the atomic-checkpoint machinery
+  guarantees whatever was mid-write tears safely.
+- **step-stall watchdog** — the loop heartbeats
+  (:meth:`TrainingSupervisor.heartbeat`, fault site
+  ``supervisor.heartbeat``) on an injectable clock; a monitor thread
+  (:class:`StallWatchdog`) that sees a heartbeat older than
+  ``MXTPU_STALL_TIMEOUT`` raises typed :class:`StepStalled` in the
+  supervised thread. :meth:`TrainingSupervisor.run_step` walks the
+  escalation ladder: retry the step → rebind the compiled program
+  (``CompileGuard.rebind()`` / ``FusedStep.rebind()``) → elastic
+  re-mesh (PR 6, when a controller is armed) → checkpoint-and-abort
+  (:class:`StallAbort`, :data:`EXIT_STALLED`).
+- **crash-loop protection** — :class:`CrashLoopGuard` persists a
+  resume-attempt counter beside the checkpoint manifest
+  (``<prefix>.resume.json``). Repeated resumes at the same
+  ``(epoch, batch)`` back off exponentially (injectable sleep), and
+  past ``MXTPU_CRASH_LOOP_LIMIT`` attempts the batch itself is
+  presumed poison and *quarantined* through PR 4's
+  :class:`~.data.DataGuardPolicy` budget — the resumed run skips it
+  instead of dying there forever.
+
+Everything is injectable — clock, sleep, signal delivery
+(:meth:`SignalRuntime.deliver`), watchdog polling — so
+``tests/test_supervisor.py`` and the chaos smoke
+(``ci/preempt_smoke.py``) prove every path with fake clocks and zero
+real sleeps. Counters surface under
+``resilience.stats()["supervisor"]``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal as _signal
+import threading
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+from ..base import MXNetError
+from . import faults
+from .faults import InjectedFault, InjectedTimeout
+
+__all__ = ["TrainingSupervisor", "SignalRuntime", "StallWatchdog",
+           "CrashLoopGuard", "Preempted", "ImmediateAbort", "StepStalled",
+           "StallAbort", "stats", "reset_stats", "signal_runtime",
+           "skip_quarantined_batches",
+           "SITE_SIGNAL", "SITE_HEARTBEAT", "EXIT_PREEMPTED", "EXIT_ABORTED",
+           "EXIT_STALLED", "MARKER_SUFFIX", "preempt_marker_path",
+           "read_preempt_marker"]
+
+#: fault site passed when a (real or injected) preemption signal lands;
+#: ``MXNET_TPU_FAULT_PLAN="supervisor.signal:N:ioerror"`` simulates a
+#: SIGTERM at the Nth between-steps check without any process signaling
+SITE_SIGNAL = "supervisor.signal"
+#: fault site passed on every step heartbeat; an injected fault here
+#: simulates a stalled step and drives the escalation ladder
+SITE_HEARTBEAT = "supervisor.heartbeat"
+
+# typed exit codes (>128 mimics signal-death codes without colliding
+# with the shell's own 128+SIGTERM=143; schedulers key restarts on them)
+EXIT_PREEMPTED = 83   #: graceful: checkpoint + marker written, clean exit
+EXIT_ABORTED = 84     #: second signal: immediate abort, no checkpoint
+EXIT_STALLED = 85     #: watchdog ladder exhausted: checkpoint-and-abort
+
+ENV_STALL_TIMEOUT = "MXTPU_STALL_TIMEOUT"
+ENV_STALL_POLL = "MXTPU_STALL_POLL"
+ENV_CRASH_LIMIT = "MXTPU_CRASH_LOOP_LIMIT"
+ENV_BACKOFF_BASE = "MXTPU_CRASH_BACKOFF_BASE"
+ENV_BACKOFF_CAP = "MXTPU_CRASH_BACKOFF_CAP"
+ENV_SUPERVISOR = "MXTPU_SUPERVISOR"
+
+MARKER_SUFFIX = ".preempt.json"
+
+
+class Preempted(MXNetError):
+    """Graceful preemption completed: the in-flight step finished, the
+    checkpoint + clean-exit marker are on disk. ``exit_code`` is
+    :data:`EXIT_PREEMPTED`; a launcher ``sys.exit(err.exit_code)``-s so
+    the scheduler sees the typed code."""
+
+    def __init__(self, msg, exit_code: int = EXIT_PREEMPTED):
+        super().__init__(msg)
+        self.exit_code = exit_code
+
+
+class ImmediateAbort(BaseException):
+    """Second signal during the grace window: abort NOW. Deliberately a
+    BaseException (like :class:`~.faults.InjectedKill`) so it sails
+    through ``except Exception`` and retry loops exactly like the
+    SIGKILL that would follow."""
+
+    def __init__(self, msg, exit_code: int = EXIT_ABORTED):
+        super().__init__(msg)
+        self.exit_code = exit_code
+
+
+class StepStalled(MXNetError):
+    """A training step exceeded the stall timeout (wedged collective,
+    stuck data fetch, stalled compile) — raised by the watchdog or by an
+    injected fault at ``supervisor.heartbeat``. Recoverable: the
+    supervisor's escalation ladder handles it."""
+
+
+class StallAbort(MXNetError):
+    """The stall-escalation ladder is exhausted (retry, rebind and
+    re-mesh all stalled again): state was checkpointed where possible
+    and the run must abort with :data:`EXIT_STALLED` for the scheduler
+    to relaunch into ``fit(resume='auto')``."""
+
+    def __init__(self, msg, exit_code: int = EXIT_STALLED):
+        super().__init__(msg)
+        self.exit_code = exit_code
+
+
+# -- counters (resilience.stats()["supervisor"]) -----------------------------
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+_backoff = {"total_s": 0.0}
+
+
+def _count(key: str, n: int = 1):
+    with _lock:
+        _counters[key] = _counters.get(key, 0) + n
+
+
+def _count_nolock(key: str, n: int = 1):
+    """Counter bump for SIGNAL-HANDLER paths. A real OS handler runs on
+    the main thread at an arbitrary bytecode boundary — if that thread
+    already holds the module lock (a monitor polling stats()), taking
+    it here would self-deadlock the handler and the process would die
+    un-checkpointed. A GIL-atomic dict update is enough for advisory
+    counters."""
+    _counters[key] = _counters.get(key, 0) + n
+
+
+def stats() -> dict:
+    """Supervisor counters: signals seen, graceful preempt exits,
+    immediate aborts, stalls and the ladder rung that cleared each
+    (``stall_retries``/``stall_rebinds``/``stall_remeshes``/
+    ``stall_aborts``), crash-loop resume attempts, total backoff slept
+    (on the injectable sleep), and batches quarantined as poison."""
+    with _lock:
+        out = {k: _counters.get(k, 0)
+               for k in ("signals", "second_signals", "preempt_exits",
+                         "aborts", "stalls", "stall_retries",
+                         "stall_rebinds", "stall_remeshes", "stall_aborts",
+                         "crash_resumes", "batches_quarantined")}
+        out["crash_backoff_s"] = _backoff["total_s"]
+        return out
+
+
+def reset_stats():
+    with _lock:
+        _counters.clear()
+        _backoff["total_s"] = 0.0
+
+
+# -- shared signal runtime ---------------------------------------------------
+
+class SignalRuntime:
+    """One process-wide owner of the preemption signal handlers.
+
+    Training supervisors AND serving endpoints subscribe listeners;
+    the runtime installs each OS handler once (main thread only — the
+    CPython rule) and fans every delivery out to all subscribers, so a
+    process that both trains and serves drains its server and
+    checkpoints its trainer off the same SIGTERM. :meth:`deliver` is
+    the injectable path: tests (and non-main-thread embedders) call it
+    with a signum and get the exact dispatch a real signal takes.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners: list = []          # [(listener, frozenset sigs)]
+        self._installed: Dict[int, object] = {}
+
+    def subscribe(self, listener, signals: Sequence[int]):
+        """Register ``listener.on_signal(signum)`` for ``signals``,
+        installing OS handlers for any not yet owned. An EMPTY signal
+        set means "no OS wiring, receive every injected delivery" (the
+        test hook); a non-empty set also *filters* dispatch — a server
+        subscribed to SIGTERM only must not drain on the Ctrl-C another
+        subscriber installed. Off the main thread the OS install is
+        skipped (CPython forbids it) and only injected delivery reaches
+        the listener."""
+        with self._lock:
+            if all(entry[0] is not listener for entry in self._listeners):
+                self._listeners.append((listener, frozenset(signals)))
+            if threading.current_thread() is not threading.main_thread():
+                logging.warning(
+                    "SignalRuntime: not on the main thread; OS signal "
+                    "handlers not installed (injected deliver() only)")
+                return
+            for signum in signals:
+                if signum in self._installed:
+                    continue
+                try:
+                    prev = _signal.signal(signum, self._handler)
+                except (ValueError, OSError) as err:
+                    logging.warning("SignalRuntime: cannot install handler "
+                                    "for signal %s: %s", signum, err)
+                    continue
+                self._installed[signum] = prev
+
+    def unsubscribe(self, listener):
+        """Drop ``listener``; when no listeners remain, restore every
+        original OS handler."""
+        with self._lock:
+            self._listeners = [e for e in self._listeners
+                               if e[0] is not listener]
+            if self._listeners:
+                return
+            if threading.current_thread() is threading.main_thread():
+                for signum, prev in self._installed.items():
+                    try:
+                        _signal.signal(signum, prev)
+                    except (ValueError, OSError, TypeError):
+                        pass
+                self._installed.clear()
+
+    def _handler(self, signum, frame):    # real OS delivery (main thread)
+        self.deliver(signum)
+
+    def deliver(self, signum: int):
+        """Dispatch one signal to every subscriber whose set includes
+        ``signum`` (empty set = all) — the injectable equivalent of the
+        OS handler (tests call this directly).
+
+        Handler-safe by construction: NO locks (the interrupted main
+        thread may hold them — see :func:`_count_nolock`; ``list()`` of
+        a list is GIL-atomic against subscribe/unsubscribe), and an
+        :class:`ImmediateAbort` from one listener is held until every
+        other listener has seen the signal — a process that trains AND
+        serves must run the server's close path even though the
+        trainer's abort will unwind the stack."""
+        _count_nolock("signals")
+        abort = None
+        for listener, sigs in list(self._listeners):
+            if sigs and signum not in sigs:
+                continue
+            try:
+                listener.on_signal(signum)
+            except ImmediateAbort as err:
+                abort = abort or err
+        if abort is not None:
+            raise abort
+
+
+_runtime: Optional[SignalRuntime] = None
+_runtime_lock = threading.Lock()
+
+
+def signal_runtime() -> SignalRuntime:
+    """The process-wide :class:`SignalRuntime` singleton."""
+    global _runtime
+    if _runtime is None:
+        with _runtime_lock:
+            if _runtime is None:
+                _runtime = SignalRuntime()
+    return _runtime
+
+
+# -- stall watchdog ----------------------------------------------------------
+
+class StallWatchdog:
+    """Monitor thread raising :class:`StepStalled` into a stalled step.
+
+    ``beat()`` (called by :meth:`TrainingSupervisor.heartbeat`) stamps
+    the injectable clock; :meth:`check` compares the stamp against
+    ``timeout`` and reports a stall. In thread mode (:meth:`start`) the
+    check runs every ``poll`` real seconds and a detected stall is
+    raised *in the supervised thread* at its next bytecode boundary
+    (``PyThreadState_SetAsyncExc``) — that covers python-level hangs
+    (stuck fetch loops, lock waits); a step wedged inside an
+    uninterruptible C call cannot be unwound from here, so after
+    ``grace`` further seconds without a fresh beat the watchdog calls
+    ``hard_abort`` (default ``os._exit(EXIT_STALLED)``) and the
+    scheduler relaunches into ``resume='auto'`` — the honest answer
+    when the interpreter itself is hostage. Tests drive :meth:`check`
+    directly on a fake clock; no thread, no sleeps.
+    """
+
+    def __init__(self, timeout: float, clock: Callable[[], float] = None,
+                 poll: Optional[float] = None, grace: Optional[float] = None,
+                 hard_abort: Optional[Callable[[int], None]] = None):
+        if timeout <= 0:
+            raise ValueError("StallWatchdog timeout must be > 0")
+        self.timeout = float(timeout)
+        self.clock = clock or time.monotonic
+        self.poll = float(poll) if poll else max(0.5, self.timeout / 4.0)
+        self.grace = float(grace) if grace is not None else self.timeout
+        self.hard_abort = hard_abort or (lambda code: os._exit(code))
+        self._last_beat: Optional[float] = None
+        self._raised_at: Optional[float] = None
+        self._target_tid: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self):
+        self._last_beat = self.clock()
+        self._raised_at = None          # progress: stand down
+
+    def suspend(self):
+        """Stand the watchdog down until the next :meth:`beat`. The
+        supervised window is the STEP itself: eval passes, epoch-end
+        checkpoint writes and the ladder's own actions (rebind, abort
+        checkpointing) run with no heartbeats, and must neither accrue
+        staleness nor trip the hard-abort — ``run_step`` suspends on
+        every exit and the next heartbeat re-arms."""
+        self._last_beat = None
+        self._raised_at = None
+
+    def stale_for(self) -> float:
+        """Seconds since the last beat (0 before the first)."""
+        if self._last_beat is None:
+            return 0.0
+        return max(0.0, self.clock() - self._last_beat)
+
+    def check(self) -> bool:
+        """One watchdog tick. Returns True when the heartbeat is stale;
+        in thread mode also escalates (async raise, then hard abort)."""
+        stale = self.stale_for()
+        if stale <= self.timeout:
+            return False
+        if self._target_tid is not None:
+            if self._raised_at is None:
+                self._raised_at = self.clock()
+                _count("stalls")
+                logging.error(
+                    "StallWatchdog: heartbeat %.1fs stale (timeout %.1fs) "
+                    "— raising StepStalled in the training thread",
+                    stale, self.timeout)
+                self._async_raise()
+            elif self.clock() - self._raised_at > self.grace:
+                logging.error(
+                    "StallWatchdog: step still wedged %.1fs after the "
+                    "async raise (uninterruptible call?) — hard abort "
+                    "with exit code %d", self.clock() - self._raised_at,
+                    EXIT_STALLED)
+                self.hard_abort(EXIT_STALLED)
+        return True
+
+    def _async_raise(self):
+        import ctypes
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(self._target_tid),
+            ctypes.py_object(StepStalled))
+
+    def start(self, target_thread: Optional[threading.Thread] = None):
+        """Start the monitor thread, supervising ``target_thread``
+        (default: the calling thread)."""
+        if self._thread is not None:
+            return self
+        self._target_tid = (target_thread or threading.current_thread()).ident
+        self.beat()                      # arm from "now", not from epoch 0
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(self.poll):
+                self.check()
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="mxtpu-stall-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=max(1.0, 2 * self.poll))
+        self._thread = None
+        self._target_tid = None
+
+
+# -- crash-loop guard --------------------------------------------------------
+
+class CrashLoopGuard:
+    """Exponential backoff + poison-batch quarantine for resume loops.
+
+    Persists ``{attempts, position, quarantined}`` beside the
+    checkpoint manifest (``<prefix or dir>/…resume.json``, atomic
+    tmp+rename like every other checkpoint file). Every
+    ``fit(resume=...)`` calls :meth:`on_resume` with the position it is
+    about to resume at:
+
+    - a *different* position than the last crash resets the counter
+      (the job is making progress between failures);
+    - the *same* position increments it and sleeps
+      ``min(cap, base * 2**(attempts-2))`` on the injectable sleep —
+      a crash-looping job must not hammer the scheduler;
+    - past ``limit`` attempts the position itself is presumed poison
+      (a batch that reliably kills the process — the one failure mode
+      PR 4's in-band quarantine cannot see, because the process never
+      survives to record it) and is quarantined under the
+      :class:`~.data.DataGuardPolicy` skip budget: ``on_resume``
+      returns ``"quarantine"`` and the fit loop skips that batch.
+
+    :meth:`note_progress` (first successful step past the resume point)
+    resets the persisted counter.
+    """
+
+    def __init__(self, path: str, limit: Optional[int] = None,
+                 backoff_base: Optional[float] = None,
+                 backoff_cap: Optional[float] = None,
+                 policy=None, sleep: Callable[[float], None] = time.sleep):
+        from .. import config as _config
+        from .data import DataGuardPolicy
+        self.path = path
+        # env fallbacks go through the config registry (typed, MXNET_-
+        # alias-aware) — the knobs are declared there, reading them any
+        # other way would fork the semantics
+        self.limit = int(limit if limit is not None
+                         else _config.get(ENV_CRASH_LIMIT))
+        self.backoff_base = float(backoff_base if backoff_base is not None
+                                  else _config.get(ENV_BACKOFF_BASE))
+        self.backoff_cap = float(backoff_cap if backoff_cap is not None
+                                 else _config.get(ENV_BACKOFF_CAP))
+        if self.limit < 1:
+            raise ValueError("crash-loop limit must be >= 1")
+        self.policy = policy or DataGuardPolicy()
+        self.sleep = sleep
+        self._doc = self._read()
+
+    def _read(self) -> dict:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict):
+                raise ValueError("not a dict")
+            doc.setdefault("attempts", 0)
+            doc.setdefault("position", None)
+            doc.setdefault("quarantined", [])
+            return doc
+        except FileNotFoundError:
+            return {"attempts": 0, "position": None, "quarantined": []}
+        except (OSError, ValueError) as err:
+            # an unreadable attempt file must not block recovery — it
+            # only *bounds* recovery; start the count over
+            logging.warning("CrashLoopGuard: unreadable %s (%s); "
+                            "resetting attempt counter", self.path, err)
+            return {"attempts": 0, "position": None, "quarantined": []}
+
+    def _write(self):
+        from .checkpoint import atomic_write_bytes
+        atomic_write_bytes(self.path, json.dumps(
+            self._doc, sort_keys=True).encode("utf-8"))
+
+    @property
+    def attempts(self) -> int:
+        return int(self._doc["attempts"])
+
+    def quarantined(self) -> list:
+        """Positions quarantined as poison, as ``[epoch, nbatch]``."""
+        return [list(p) for p in self._doc["quarantined"]]
+
+    def is_quarantined(self, epoch: int, nbatch: int) -> bool:
+        return [int(epoch), int(nbatch)] in self._doc["quarantined"]
+
+    def backoff_for(self, attempts: int) -> float:
+        """Backoff before resume attempt N at the same position (the
+        first re-attempt — attempts=2 — waits ``backoff_base``)."""
+        if attempts < 2:
+            return 0.0
+        return min(self.backoff_cap,
+                   self.backoff_base * 2.0 ** (attempts - 2))
+
+    def on_resume(self, epoch: int, nbatch: int) -> str:
+        """Record a resume at ``(epoch, nbatch)``; back off when it
+        repeats. Returns ``"fresh"`` (first time at this position),
+        ``"retry"`` (repeat, backoff slept), or ``"quarantine"`` (limit
+        exceeded — the caller must skip this batch; the position is now
+        recorded and the attempt counter reset)."""
+        from . import data as _data
+        from .data import DataBudgetExceeded
+        pos = [int(epoch), int(nbatch)]
+        _count("crash_resumes")
+        if self._doc["position"] != pos:
+            self._doc["position"] = pos
+            self._doc["attempts"] = 1
+            self._write()
+            return "fresh"
+        self._doc["attempts"] += 1
+        if self._doc["attempts"] > self.limit:
+            if len(self._doc["quarantined"]) \
+                    >= self.policy.max_skipped_records:
+                raise DataBudgetExceeded(
+                    f"crash-loop quarantine would skip batch "
+                    f"{len(self._doc['quarantined']) + 1}, beyond the "
+                    f"DataGuardPolicy max_skipped_records="
+                    f"{self.policy.max_skipped_records} budget — the "
+                    "input (or the job) is systematically broken; "
+                    "refusing to silently drop more data")
+            self._doc["quarantined"].append(pos)
+            self._doc["attempts"] = 0
+            self._doc["position"] = None
+            self._write()
+            _count("batches_quarantined")
+            _data._count("batches_skipped")
+            logging.error(
+                "CrashLoopGuard: %d consecutive crashes resuming at "
+                "epoch %d batch %d — quarantining that batch as poison "
+                "(%d/%d quarantine budget used)", self.limit + 1, epoch,
+                nbatch, len(self._doc["quarantined"]),
+                self.policy.max_skipped_records)
+            return "quarantine"
+        self._write()
+        pause = self.backoff_for(self._doc["attempts"])
+        if pause > 0:
+            with _lock:
+                _backoff["total_s"] += pause
+            logging.warning(
+                "CrashLoopGuard: resume attempt %d at epoch %d batch %d "
+                "— backing off %.1fs before continuing", self.attempts,
+                epoch, nbatch, pause)
+            self.sleep(pause)
+        return "retry"
+
+    def note_progress(self):
+        """Training advanced past the crash position: reset the
+        counter (quarantine history is kept — poison stays poison)."""
+        if self._doc["attempts"] or self._doc["position"] is not None:
+            self._doc["attempts"] = 0
+            self._doc["position"] = None
+            self._write()
+
+
+def skip_quarantined_batches(train_data, guard: CrashLoopGuard, epoch: int,
+                             batch: int, logger=None) -> int:
+    """Advance ``train_data`` past every contiguous quarantined position
+    starting at ``(epoch, batch)`` (the fit() resume paths call this
+    right after :meth:`CrashLoopGuard.on_resume`); returns the new batch
+    index. Refuses re-iterable sources — consuming a throwaway iterator
+    from one skips nothing, and the loop would retrain the poison batch
+    under a shifted index; those get backoff only."""
+    log = logger or logging
+    while guard.is_quarantined(epoch, batch):
+        src = iter(train_data)
+        if src is not train_data:
+            log.warning(
+                "fit: batch %d of epoch %d is quarantined but train_data "
+                "(%s) is re-iterable, not a stateful iterator — cannot "
+                "skip it; continuing with backoff only", batch, epoch,
+                type(train_data).__name__)
+            break
+        log.warning(
+            "fit: batch %d of epoch %d is quarantined as poison (crash "
+            "loop); skipping it", batch, epoch)
+        try:
+            next(src)
+        except StopIteration:
+            break
+        batch += 1
+    return batch
+
+
+# -- clean-exit marker -------------------------------------------------------
+
+def preempt_marker_path(prefix_or_dir: str) -> str:
+    """Marker location for a checkpoint prefix (Module scheme) or
+    checkpoint directory (SPMDTrainer scheme)."""
+    if os.path.isdir(prefix_or_dir):
+        return os.path.join(prefix_or_dir, "preempt.json")
+    return prefix_or_dir + MARKER_SUFFIX
+
+
+def read_preempt_marker(prefix_or_dir: str) -> Optional[dict]:
+    """The clean-exit marker left by a graceful preemption, or None."""
+    path = preempt_marker_path(prefix_or_dir)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as err:
+        logging.warning("unreadable preempt marker %s: %s", path, err)
+        return None
+
+
+def clear_preempt_marker(prefix_or_dir: str):
+    try:
+        os.remove(preempt_marker_path(prefix_or_dir))
+    except OSError:
+        pass
+
+
+# -- the supervisor ----------------------------------------------------------
+
+class TrainingSupervisor:
+    """Drives one training loop through preemption, stalls and crash
+    loops (docs/how_to/preemption.md).
+
+    The fit loops (``Module.fit``, ``SPMDTrainer.fit``) hold one of
+    these and call three things:
+
+    - :meth:`check_preempt` between steps — True once a signal landed
+      (or a fault is injected at ``supervisor.signal``); the loop then
+      checkpoints and calls :meth:`preempt_exit`.
+    - :meth:`run_step` around each step — heartbeats, converts stalls
+      into ladder walks (retry → ``rebind()`` → re-mesh → abort).
+    - :meth:`crash_guard` at resume time — the persisted attempt
+      counter + poison-batch quarantine.
+
+    ``signals=()`` builds a supervisor with no OS wiring (tests inject
+    via :meth:`on_signal` / the shared runtime's ``deliver``).
+    """
+
+    def __init__(self, stall_timeout: Optional[float] = None,
+                 signals: Optional[Sequence[int]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 watchdog: Optional[StallWatchdog] = None,
+                 crash_limit: Optional[int] = None,
+                 backoff_base: Optional[float] = None,
+                 backoff_cap: Optional[float] = None,
+                 guard_policy=None):
+        from .. import config as _config
+        if stall_timeout is None:
+            stall_timeout = _config.get(ENV_STALL_TIMEOUT)
+        self.stall_timeout = stall_timeout
+        self.clock = clock
+        self.sleep = sleep
+        self._crash_limit = crash_limit
+        self._backoff_base = backoff_base
+        self._backoff_cap = backoff_cap
+        self._guard_policy = guard_policy
+        if watchdog is None and stall_timeout:
+            watchdog = StallWatchdog(stall_timeout, clock=clock,
+                                     poll=_config.get(ENV_STALL_POLL))
+        self.watchdog = watchdog
+        self._signals = (tuple(signals) if signals is not None
+                         else (_signal.SIGTERM, _signal.SIGINT))
+        self._preempt_signum: Optional[int] = None
+        self._stall_streak = 0
+        self.can_remesh = False     # fit(elastic=...) arms this
+        self._attached = 0
+
+    # -- signal side --------------------------------------------------------
+
+    def on_signal(self, signum: int):
+        """SignalRuntime dispatch target. First signal: request a
+        graceful preemption (flag only — the loop finishes the step).
+        Second: :class:`ImmediateAbort`."""
+        if self._preempt_signum is None:
+            self._preempt_signum = signum
+            logging.warning(
+                "TrainingSupervisor: signal %s — finishing the in-flight "
+                "step, then checkpoint + clean exit (code %d); a second "
+                "signal aborts immediately", signum, EXIT_PREEMPTED)
+            return
+        _count_nolock("second_signals")    # handler path: no locks
+        _count_nolock("aborts")
+        logging.error("TrainingSupervisor: second signal %s — immediate "
+                      "abort (code %d)", signum, EXIT_ABORTED)
+        raise ImmediateAbort(
+            f"second preemption signal ({signum}) during the grace "
+            f"window", exit_code=EXIT_ABORTED)
+
+    @property
+    def preempt_requested(self) -> bool:
+        return self._preempt_signum is not None
+
+    def check_preempt(self) -> bool:
+        """Between-steps poll: has a preemption signal landed? Also
+        passes the ``supervisor.signal`` fault site so a FaultPlan can
+        inject a preemption without any real signaling (the chaos
+        smoke's deterministic leg)."""
+        if faults.active_plan() is not None:
+            try:
+                faults.fault_point(SITE_SIGNAL)
+            except (InjectedFault, InjectedTimeout):
+                if self._preempt_signum is None:
+                    signal_runtime().deliver(int(_signal.SIGTERM))
+        return self.preempt_requested
+
+    def attach(self):
+        """Context manager wiring this supervisor into the shared
+        signal runtime + starting the watchdog thread (skipped when the
+        watchdog runs on an injected clock — tests drive ``check()``).
+        Re-entrant: nested fit calls share one subscription."""
+        return _Attached(self)
+
+    def preempt_exit(self, marker_target: Optional[str], *, label=None,
+                     epoch=None, nbatch=None, extra: Optional[dict] = None):
+        """Finish a graceful preemption: write the clean-exit marker
+        beside the checkpoint and raise :class:`Preempted`. The caller
+        has already written the checkpoint itself."""
+        _count("preempt_exits")
+        if marker_target:
+            from .checkpoint import atomic_write_bytes
+            doc = {"clean": True, "exit_code": EXIT_PREEMPTED,
+                   "signal": self._preempt_signum,
+                   "label": label, "epoch": epoch, "nbatch": nbatch}
+            if extra:
+                doc.update(extra)
+            atomic_write_bytes(preempt_marker_path(marker_target),
+                               json.dumps(doc, sort_keys=True)
+                               .encode("utf-8"))
+        raise Preempted(
+            f"preempted by signal {self._preempt_signum}: checkpoint "
+            f"written ({label if label is not None else 'params only'}), "
+            f"exiting with code {EXIT_PREEMPTED}")
+
+    # -- stall side ---------------------------------------------------------
+
+    def heartbeat(self):
+        """Stamp the watchdog clock and pass the ``supervisor.heartbeat``
+        fault site; an injected fault there IS a stalled step (raises
+        :class:`StepStalled`). With no plan armed and no watchdog this
+        is two attribute checks — free on the hot path."""
+        if self.watchdog is not None:
+            self.watchdog.beat()
+        if faults.active_plan() is None:
+            return
+        try:
+            faults.fault_point(SITE_HEARTBEAT)
+        except (InjectedFault, InjectedTimeout) as err:
+            _count("stalls")
+            raise StepStalled(
+                f"injected stall at {SITE_HEARTBEAT}: {err}") from err
+
+    def run_step(self, step: Callable, *, rebind: Optional[Callable] = None,
+                 remesh_exc: Optional[Callable] = None,
+                 on_abort: Optional[Callable] = None, label: str = "step"):
+        """Run one training step under stall supervision, walking the
+        escalation ladder on consecutive :class:`StepStalled`:
+
+        1. **retry** the step once — transient stalls (a slow host
+           fetch, a GC pause tripping a tight timeout) clear here;
+        2. **rebind** the compiled program (``rebind()``:
+           ``FusedStep.rebind`` / ``CompileGuard.rebind`` + re-jit) —
+           a wedged executable/dispatch clears here;
+        3. **re-mesh** — when ``remesh_exc`` is set (SPMD fit with an
+           elastic controller armed) raise its exception so the outer
+           recovery loop restores onto a surviving topology (PR 6);
+        4. **checkpoint-and-abort** — ``on_abort()`` checkpoints what
+           the caller can, then :class:`StallAbort` with
+           :data:`EXIT_STALLED`.
+
+        The streak resets on any successful step and *survives* a
+        re-mesh recovery (rung 3 re-enters here; a still-stalling step
+        then falls through to rung 4 instead of ping-ponging)."""
+        while True:
+            try:
+                self.heartbeat()
+                out = step()
+                self._stall_streak = 0
+                if self.watchdog is not None:
+                    # the supervised window is the step only: metric
+                    # updates, eval passes and checkpoint writes between
+                    # steps run beat-less and must not read as stalls
+                    self.watchdog.suspend()
+                return out
+            except StepStalled as err:
+                if self.watchdog is not None:
+                    # ladder actions (rebind can recompile for minutes,
+                    # on_abort writes a checkpoint) run unsupervised —
+                    # a mid-rung async raise or hard-abort would skip
+                    # the rest of the ladder
+                    self.watchdog.suspend()
+                self._stall_streak += 1
+                rung = self._stall_streak
+                if rung == 1:
+                    _count("stall_retries")
+                    logging.warning("%s stalled (%s); ladder rung 1: "
+                                    "retrying the step", label, err)
+                    continue
+                if rung == 2 and rebind is not None:
+                    _count("stall_rebinds")
+                    logging.warning("%s stalled again; ladder rung 2: "
+                                    "rebinding the compiled step", label)
+                    rebind()
+                    continue
+                if rung <= 3 and remesh_exc is not None \
+                        and self.can_remesh:
+                    _count("stall_remeshes")
+                    logging.warning("%s still stalled; ladder rung 3: "
+                                    "escalating to elastic re-mesh", label)
+                    raise remesh_exc(err) from err
+                _count("stall_aborts")
+                logging.error("%s stalled through the whole ladder; "
+                              "checkpoint-and-abort (exit code %d)",
+                              label, EXIT_STALLED)
+                if on_abort is not None:
+                    on_abort(err)
+                raise StallAbort(
+                    f"{label} stalled {rung} consecutive times through "
+                    f"retry/rebind/re-mesh; aborting for relaunch "
+                    f"(resume='auto' continues from the checkpoint)"
+                ) from err
+
+    # -- crash-loop side ----------------------------------------------------
+
+    def crash_guard(self, checkpoint_target: str) -> CrashLoopGuard:
+        """The persisted crash-loop guard for a checkpoint prefix/dir
+        (file ``…resume.json`` beside the manifests)."""
+        if os.path.isdir(checkpoint_target):
+            path = os.path.join(checkpoint_target, "resume_attempts.json")
+        else:
+            path = checkpoint_target + ".resume.json"
+        return CrashLoopGuard(path, limit=self._crash_limit,
+                              backoff_base=self._backoff_base,
+                              backoff_cap=self._backoff_cap,
+                              policy=self._guard_policy, sleep=self.sleep)
+
+
+class _Attached:
+    """Context manager for :meth:`TrainingSupervisor.attach`."""
+
+    def __init__(self, sup: TrainingSupervisor):
+        self.sup = sup
+
+    def __enter__(self):
+        sup = self.sup
+        sup._attached += 1
+        if sup._attached == 1:
+            # always subscribe (so injected deliver() reaches the
+            # supervisor even with signals=()); the runtime installs OS
+            # handlers only for the listed signums
+            signal_runtime().subscribe(sup, sup._signals)
+            if sup.watchdog is not None \
+                    and sup.watchdog.clock is time.monotonic:
+                # a fake-clock watchdog is driven by the test's own
+                # check() calls; only a real-time one needs the thread
+                sup.watchdog.start()
+        return sup
+
+    def __exit__(self, *exc):
+        sup = self.sup
+        sup._attached -= 1
+        if sup._attached == 0:
+            if sup.watchdog is not None:
+                sup.watchdog.stop()
+            signal_runtime().unsubscribe(sup)
+        return False
+
+
+def resolve(supervisor) -> Optional[TrainingSupervisor]:
+    """Normalize a fit() ``supervisor=`` argument: an instance is used
+    as-is, True builds a default, None consults the ``MXTPU_SUPERVISOR``
+    config knob (default off — installing signal handlers must be asked
+    for; a malformed value raises through the typed registry instead of
+    silently arming)."""
+    if isinstance(supervisor, TrainingSupervisor):
+        return supervisor
+    if supervisor is True:
+        return TrainingSupervisor()
+    if supervisor is None:
+        from .. import config as _config
+        if _config.get(ENV_SUPERVISOR):
+            return TrainingSupervisor()
+    return None

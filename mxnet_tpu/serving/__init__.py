@@ -22,6 +22,12 @@ Five pillars:
   fallback model served while the circuit is open.
 - **Probes + stats** — ``healthz()``/``readyz()`` and a per-endpoint
   counter surface (:func:`stats`) mirroring ``resilience.retry.stats()``.
+- **Graceful drain** (docs/how_to/preemption.md) — the same signal
+  runtime the training supervisor uses: on SIGTERM ``readyz()`` flips
+  false immediately, admission sheds with the *retriable*
+  :class:`~.errors.Draining` error, in-flight requests finish within
+  their deadlines, then the server closes
+  (``install_signal_handlers()`` / ``drain()``).
 """
 from __future__ import annotations
 
@@ -30,8 +36,8 @@ from .admission import AdmissionQueue, Deadline, Request  # noqa: F401
 from .backends import (CallableBackend, ModuleBackend,  # noqa: F401
                        PredictorBackend)
 from .breaker import CircuitBreaker  # noqa: F401
-from .errors import (CircuitOpen, DeadlineExceeded, QueueFull,  # noqa: F401
-                     ServerClosed, ServingError)
+from .errors import (CircuitOpen, DeadlineExceeded, Draining,  # noqa: F401
+                     QueueFull, ServerClosed, ServingError)
 from .server import InferenceServer, endpoint_stats, endpoints  # noqa: F401
 from .warmup import ShapeBuckets  # noqa: F401
 
@@ -39,7 +45,7 @@ __all__ = ["InferenceServer", "AdmissionQueue", "Deadline", "Request",
            "CircuitBreaker", "ShapeBuckets", "CallableBackend",
            "PredictorBackend", "ModuleBackend", "ServingError",
            "QueueFull", "DeadlineExceeded", "CircuitOpen", "ServerClosed",
-           "endpoints", "endpoint_stats", "stats"]
+           "Draining", "endpoints", "endpoint_stats", "stats"]
 
 
 def stats() -> dict:

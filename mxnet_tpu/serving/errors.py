@@ -15,7 +15,7 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "QueueFull", "DeadlineExceeded", "CircuitOpen",
-           "ServerClosed"]
+           "ServerClosed", "Draining"]
 
 
 class ServingError(MXNetError):
@@ -43,3 +43,16 @@ class CircuitOpen(ServingError):
 
 class ServerClosed(ServingError):
     """The server has been shut down; no further requests are accepted."""
+
+
+class Draining(ServingError):
+    """The endpoint received a preemption signal and is draining
+    (docs/how_to/preemption.md): admission is closed, in-flight requests
+    finish within their deadlines, then the server closes. *Retriable*:
+    unlike the other rejections this one is a replica-local lifecycle
+    decision, not a verdict on the request — a client (or the load
+    balancer reading ``readyz()``, which flipped false the instant the
+    signal landed) should resubmit to another replica. Maps to 503 +
+    Retry-After on a transport."""
+
+    retriable = True

@@ -32,7 +32,8 @@ from ..base import MXNetError
 from ..resilience import RetryExhausted, faults, guarded_call
 from .admission import AdmissionQueue, Deadline, Request
 from .breaker import CircuitBreaker, OPEN
-from .errors import CircuitOpen, DeadlineExceeded, QueueFull, ServerClosed
+from .errors import (CircuitOpen, DeadlineExceeded, Draining, QueueFull,
+                     ServerClosed)
 from .warmup import ShapeBuckets
 
 __all__ = ["InferenceServer", "endpoint_stats", "endpoints"]
@@ -105,10 +106,12 @@ class InferenceServer:
                  breaker: Optional[CircuitBreaker] = None,
                  retry_policy=None, workers: int = 1,
                  clock: Callable[[], float] = time.monotonic,
-                 wait: Optional[Callable] = None):
+                 wait: Optional[Callable] = None,
+                 drain_grace: float = 30.0):
         self.name = name
         self.backend = backend
         self.fallback = fallback
+        self.drain_grace = drain_grace
         self.buckets = ShapeBuckets(buckets) if buckets else None
         self.default_deadline = default_deadline
         self.clock = clock
@@ -123,12 +126,17 @@ class InferenceServer:
             "deadline_queued": 0, "deadline_inflight": 0,
             "degraded": 0, "wedged_workers": 0, "abandoned": 0,
             "load_failures": 0, "warmed_buckets": 0,
-            "warmup_cache_hits": 0, "warmup_compiles": 0}
+            "warmup_cache_hits": 0, "warmup_compiles": 0,
+            "drain_signals": 0, "drained_rejects": 0}
         self._warmed = False
         self._load_ok = None          # None = not attempted yet
         self._fallback_ok = False     # fallback loaded and usable
         self._load_error = None
         self._closed = False
+        self._draining = False
+        self._inflight = 0
+        self._idle = threading.Event()
+        self._idle.set()
         self._last_success: Optional[float] = None
         self._n_workers = workers
         self._workers = []
@@ -243,6 +251,14 @@ class InferenceServer:
         (ServerClosed / CircuitOpen / QueueFull)."""
         if self._closed:
             raise ServerClosed(f"endpoint {self.name!r} is shut down")
+        if self._draining:
+            # preemption drain: shed with the RETRIABLE rejection —
+            # readyz() already flipped false, the client resubmits to
+            # another replica (docs/how_to/preemption.md)
+            self._count("drained_rejects")
+            raise Draining(
+                f"endpoint {self.name!r} is draining after a preemption "
+                f"signal; retry against another replica")
         expired = self._queue.expire_queued()
         if expired:                   # dead deadlines don't hold capacity
             self._count("deadline_queued", expired)
@@ -331,6 +347,18 @@ class InferenceServer:
     # -- worker side ---------------------------------------------------------
 
     def _process(self, req: Request, worker=None):
+        with self._lock:
+            self._inflight += 1
+            self._idle.clear()
+        try:
+            self._process_inner(req, worker=worker)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                if self._inflight == 0 and self._queue.depth() == 0:
+                    self._idle.set()
+
+    def _process_inner(self, req: Request, worker=None):
         if req.deadline.expired():
             if req.fail(DeadlineExceeded(
                     "deadline expired while waiting in queue")):
@@ -409,6 +437,8 @@ class InferenceServer:
             last = self._last_success
         return {
             "ok": not self._closed,
+            "draining": self._draining,
+            "inflight": self._inflight,
             "queue_depth": self._queue.depth(),
             "queue_capacity": self._queue.capacity,
             "circuit": self.breaker.state,
@@ -428,6 +458,10 @@ class InferenceServer:
         reasons = []
         if self._closed:
             reasons.append("server closed")
+        if self._draining:
+            # flips false the INSTANT the signal lands — the balancer
+            # stops routing here while in-flight requests finish
+            reasons.append("draining (preemption signal)")
         if not self._warmed:
             reasons.append("not warmed up")
         if self.breaker.state == OPEN and not self._fallback_ready():
@@ -446,6 +480,65 @@ class InferenceServer:
         counters["circuit"] = self.breaker.stats()
         return counters
 
+    # -- graceful drain (docs/how_to/preemption.md) ---------------------------
+
+    def install_signal_handlers(self, signals=None):
+        """Subscribe this endpoint to the shared preemption
+        :class:`~mxnet_tpu.resilience.SignalRuntime` (the one the
+        training supervisor uses, so a process that trains AND serves
+        handles one SIGTERM coherently). First signal: ``readyz()``
+        flips false immediately, admission sheds with the retriable
+        :class:`~.errors.Draining` error, a daemon thread finishes the
+        in-flight requests within their deadlines and closes the
+        server. Second signal: close immediately."""
+        import signal as _signal
+
+        from ..resilience.supervisor import signal_runtime
+        self._signals = (tuple(signals) if signals is not None
+                         else (_signal.SIGTERM, _signal.SIGINT))
+        signal_runtime().subscribe(self, self._signals)
+        return self
+
+    def on_signal(self, signum: int):
+        """SignalRuntime dispatch target (tests inject via
+        ``signal_runtime().deliver(signum)``)."""
+        if not self._draining:
+            self._draining = True           # readyz false NOW
+            self._count("drain_signals")
+            if self._n_workers == 0:
+                # deterministic mode: the caller drives run_pending();
+                # draining completes on its next predict/run_pending
+                return
+            # the grace bound matters: a WEDGED worker never decrements
+            # the in-flight count, and an unbounded drain would then
+            # hold the pod until the scheduler's SIGKILL
+            threading.Thread(target=self.drain, daemon=True,
+                             kwargs={"grace": self.drain_grace},
+                             name=f"serving-drain-{self.name}").start()
+            return
+        self._count("drain_signals")
+        self.close(join_timeout=0.1)        # second signal: abort drain
+
+    def drain(self, grace: Optional[float] = None, poll: float = 0.1):
+        """Stop admission and finish the in-flight work, then
+        ``close()``. Queued requests and expiry checks are deadline-
+        bounded, but a request WEDGED inside a backend call is not (the
+        deadline is only enforced around the call, not inside it) — so
+        ``grace`` bounds the whole drain; the signal path passes
+        ``drain_grace``. In ``workers=0`` mode the caller's thread
+        drains the queue synchronously — deterministic, zero sleeps."""
+        self._draining = True
+        start = self.clock()
+        if self._n_workers == 0:
+            self.run_pending()
+        else:
+            while self._queue.depth() > 0 or self._inflight > 0:
+                if grace is not None and self.clock() - start > grace:
+                    break
+                self._idle.wait(poll)
+        self.close()
+        return self
+
     def close(self, join_timeout: float = 2.0):
         """Stop accepting, wake the workers, unregister the endpoint."""
         self._closed = True
@@ -453,6 +546,10 @@ class InferenceServer:
         for worker in self._workers:
             if worker.is_alive() and not worker.wedged:
                 worker.join(timeout=join_timeout)
+        if getattr(self, "_signals", None):
+            from ..resilience.supervisor import signal_runtime
+            signal_runtime().unsubscribe(self)
+            self._signals = None
         with _endpoints_lock:
             if _ENDPOINTS.get(self.name) is self:
                 del _ENDPOINTS[self.name]

@@ -1,0 +1,657 @@
+"""Preemption-aware training supervisor (resilience/supervisor.py,
+docs/how_to/preemption.md).
+
+Signal, stall and crash-loop paths with injectable clocks and signal
+delivery ONLY — zero real sleeps, zero real process signals (the chaos
+smoke ``ci/preempt_smoke.py`` covers the real-SIGTERM leg).
+"""
+import hashlib
+import json
+import os
+import signal as _signal
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import resilience
+from mxnet_tpu.resilience import (CrashLoopGuard, FaultPlan, ImmediateAbort,
+                                  Preempted, StallAbort, StallWatchdog,
+                                  StepStalled, TrainingSupervisor, faults)
+from mxnet_tpu.resilience.data import DataBudgetExceeded, DataGuardPolicy
+from mxnet_tpu.resilience.supervisor import (EXIT_ABORTED, EXIT_PREEMPTED,
+                                             EXIT_STALLED, SITE_HEARTBEAT,
+                                             SITE_SIGNAL, read_preempt_marker,
+                                             signal_runtime)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.disarm()
+    resilience.reset_stats()
+    yield
+    faults.disarm()
+    resilience.reset_stats()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _sup(**kw):
+    kw.setdefault("signals", ())
+    kw.setdefault("sleep", lambda s: None)
+    return TrainingSupervisor(**kw)
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_sites_registered():
+    assert SITE_SIGNAL == "supervisor.signal"
+    assert SITE_HEARTBEAT == "supervisor.heartbeat"
+    assert SITE_SIGNAL in resilience.SITES
+    assert SITE_HEARTBEAT in resilience.SITES
+
+
+def test_stats_surface():
+    s = resilience.stats()["supervisor"]
+    for key in ("signals", "second_signals", "preempt_exits", "aborts",
+                "stalls", "stall_retries", "stall_rebinds",
+                "stall_remeshes", "stall_aborts", "crash_resumes",
+                "batches_quarantined", "crash_backoff_s"):
+        assert key in s
+
+
+# -- signal semantics --------------------------------------------------------
+
+def test_first_signal_sets_flag_only():
+    sup = _sup()
+    with sup.attach():
+        assert not sup.preempt_requested
+        signal_runtime().deliver(int(_signal.SIGTERM))
+        assert sup.preempt_requested
+        assert sup.check_preempt()
+    assert resilience.stats()["supervisor"]["signals"] == 1
+
+
+def test_second_signal_immediate_abort():
+    sup = _sup()
+    with sup.attach():
+        signal_runtime().deliver(int(_signal.SIGTERM))
+        with pytest.raises(ImmediateAbort) as err:
+            signal_runtime().deliver(int(_signal.SIGTERM))
+        assert err.value.exit_code == EXIT_ABORTED
+    # ImmediateAbort is a BaseException: it must escape `except Exception`
+    assert not isinstance(ImmediateAbort("x"), Exception)
+    assert resilience.stats()["supervisor"]["second_signals"] == 1
+
+
+def test_injected_signal_fault_simulates_sigterm():
+    faults.arm(FaultPlan().arm(SITE_SIGNAL, nth=2))
+    sup = _sup()
+    with sup.attach():
+        assert not sup.check_preempt()      # call 1: no fault
+        assert sup.check_preempt()          # call 2: injected SIGTERM
+        assert sup.preempt_requested
+
+
+def test_signal_filter_and_abort_still_reaches_all_listeners():
+    # a listener subscribed to SIGTERM only must not see SIGINT; and an
+    # ImmediateAbort from one listener (the trainer's second-signal
+    # path) must not starve the others (the server's close path)
+    seen = []
+
+    class Listener:
+        def __init__(self, name, abort=False):
+            self.name, self.abort = name, abort
+
+        def on_signal(self, signum):
+            seen.append((self.name, signum))
+            if self.abort:
+                raise ImmediateAbort("now")
+
+    rt = signal_runtime()
+    aborter = Listener("aborter", abort=True)
+    server = Listener("server")
+    term_only = Listener("term-only")
+    rt.subscribe(aborter, ())
+    rt.subscribe(server, ())
+    rt.subscribe(term_only, (int(_signal.SIGTERM),))
+    try:
+        with pytest.raises(ImmediateAbort):
+            rt.deliver(int(_signal.SIGINT))
+        # everyone subscribed to SIGINT saw it, despite the abort;
+        # the SIGTERM-only listener did not
+        assert ("aborter", int(_signal.SIGINT)) in seen
+        assert ("server", int(_signal.SIGINT)) in seen
+        assert all(n != "term-only" for n, _ in seen)
+    finally:
+        rt.unsubscribe(aborter)
+        rt.unsubscribe(server)
+        rt.unsubscribe(term_only)
+
+
+def test_unsubscribed_after_detach():
+    sup = _sup()
+    with sup.attach():
+        pass
+    signal_runtime().deliver(int(_signal.SIGTERM))
+    assert not sup.preempt_requested        # no longer listening
+
+
+# -- watchdog true/false positives -------------------------------------------
+
+def test_watchdog_trips_on_stale_heartbeat():
+    clock = FakeClock()
+    wd = StallWatchdog(timeout=10.0, clock=clock)
+    wd.beat()
+    clock.advance(10.5)
+    assert wd.check() is True
+    assert wd.stale_for() == pytest.approx(10.5)
+
+
+def test_watchdog_false_positive_slow_but_progressing():
+    # a slow step that still heartbeats within the timeout never trips
+    clock = FakeClock()
+    wd = StallWatchdog(timeout=10.0, clock=clock)
+    for _ in range(20):
+        wd.beat()
+        clock.advance(9.0)      # slow, but inside the budget
+        assert wd.check() is False
+
+
+def test_watchdog_escalation_async_raise_then_hard_abort():
+    clock = FakeClock()
+    aborted = []
+    raised = []
+    wd = StallWatchdog(timeout=5.0, clock=clock, grace=7.0,
+                       hard_abort=aborted.append)
+    wd._async_raise = lambda: raised.append(True)   # no real async exc
+    wd._target_tid = 1                              # thread mode armed
+    wd.beat()
+    clock.advance(6.0)
+    assert wd.check() is True
+    assert raised and not aborted       # first: raise into the thread
+    clock.advance(6.0)
+    assert wd.check() is True
+    assert not aborted                  # still inside the grace window
+    clock.advance(2.0)
+    wd.check()
+    assert aborted == [EXIT_STALLED]    # wedged in C: hard abort
+    wd.beat()
+    clock.advance(1.0)
+    assert wd.check() is False          # a beat stands the watchdog down
+
+
+# -- the escalation ladder (run_step) ----------------------------------------
+
+def test_ladder_rung1_retry_clears_transient_stall():
+    faults.arm(FaultPlan().arm(SITE_HEARTBEAT, nth=1))
+    sup = _sup()
+    calls = []
+    out = sup.run_step(lambda: calls.append(1) or "ok")
+    assert out == "ok" and len(calls) == 1
+    s = resilience.stats()["supervisor"]
+    assert s["stalls"] == 1 and s["stall_retries"] == 1
+    assert s["stall_rebinds"] == 0
+
+
+def test_ladder_rung2_rebind():
+    faults.arm(FaultPlan().arm(SITE_HEARTBEAT, nth=1, count=2))
+    sup = _sup()
+    rebinds = []
+    out = sup.run_step(lambda: "ok", rebind=lambda: rebinds.append(1))
+    assert out == "ok" and rebinds == [1]
+    s = resilience.stats()["supervisor"]
+    assert s["stall_retries"] == 1 and s["stall_rebinds"] == 1
+
+
+def test_ladder_rung3_remesh_escalates_to_caller():
+    # 4 consecutive stalls: retry, rebind, re-mesh escalation, then the
+    # post-recovery re-entry stalls once more -> abort rung
+    faults.arm(FaultPlan().arm(SITE_HEARTBEAT, nth=1, count=4))
+    sup = _sup()
+    sup.can_remesh = True
+
+    class Escalate(Exception):
+        pass
+
+    with pytest.raises(Escalate):
+        sup.run_step(lambda: "ok", rebind=lambda: None,
+                     remesh_exc=lambda err: Escalate(str(err)))
+    s = resilience.stats()["supervisor"]
+    assert s["stall_remeshes"] == 1 and s["stall_aborts"] == 0
+    # the streak survives the re-mesh: a still-stalling step goes
+    # straight to the abort rung instead of ping-ponging
+    aborted = []
+    with pytest.raises(StallAbort) as err:
+        sup.run_step(lambda: "ok", rebind=lambda: None,
+                     remesh_exc=lambda e: Escalate(str(e)),
+                     on_abort=lambda e: aborted.append(e))
+    assert err.value.exit_code == EXIT_STALLED
+    assert aborted
+
+
+def test_ladder_abort_without_remesh():
+    # no remesh hook (Module path): retry -> rebind -> abort
+    faults.arm(FaultPlan().arm(SITE_HEARTBEAT, nth=1, count=5))
+    sup = _sup()
+    aborted = []
+    with pytest.raises(StallAbort):
+        sup.run_step(lambda: "ok", rebind=lambda: None,
+                     on_abort=lambda e: aborted.append(e))
+    assert len(aborted) == 1
+    assert resilience.stats()["supervisor"]["stall_aborts"] == 1
+
+
+def test_ladder_streak_resets_on_success():
+    faults.arm(FaultPlan().arm(SITE_HEARTBEAT, nth=1)
+               .arm(SITE_HEARTBEAT, nth=3))
+    sup = _sup()
+    sup.run_step(lambda: "a")       # stall -> retry -> ok (streak reset)
+    sup.run_step(lambda: "b")       # stall -> retry -> ok again
+    s = resilience.stats()["supervisor"]
+    assert s["stall_retries"] == 2 and s["stall_rebinds"] == 0
+
+
+def test_ladder_catches_mid_step_stall():
+    # a watchdog async-raise lands INSIDE the step body, not at the
+    # heartbeat: the ladder must catch that too
+    sup = _sup()
+    state = {"n": 0}
+
+    def step():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise StepStalled("async raise mid-step")
+        return "ok"
+
+    assert sup.run_step(step) == "ok"
+    assert resilience.stats()["supervisor"]["stall_retries"] == 1
+
+
+# -- crash-loop guard --------------------------------------------------------
+
+def test_crash_loop_backoff_schedule(tmp_path):
+    slept = []
+    path = str(tmp_path / "r.json")
+    guard = CrashLoopGuard(path, limit=5, backoff_base=2.0,
+                           backoff_cap=10.0, sleep=slept.append)
+    assert guard.on_resume(0, 3) == "fresh"
+    assert slept == []
+    assert guard.on_resume(0, 3) == "retry"        # attempt 2: base
+    assert guard.on_resume(0, 3) == "retry"        # attempt 3: 2*base
+    assert guard.on_resume(0, 3) == "retry"        # attempt 4: 4*base
+    assert guard.on_resume(0, 3) == "retry"        # attempt 5: capped
+    assert slept == [2.0, 4.0, 8.0, 10.0]
+    assert resilience.stats()["supervisor"]["crash_backoff_s"] == 24.0
+    # persisted beside the manifest, atomic
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["attempts"] == 5 and doc["position"] == [0, 3]
+
+
+def test_crash_loop_position_change_resets(tmp_path):
+    slept = []
+    guard = CrashLoopGuard(str(tmp_path / "r.json"), limit=3,
+                           backoff_base=1.0, sleep=slept.append)
+    guard.on_resume(0, 3)
+    guard.on_resume(0, 3)
+    assert guard.on_resume(1, 0) == "fresh"        # progress between crashes
+    assert guard.attempts == 1
+
+
+def test_crash_loop_quarantines_poison_batch(tmp_path):
+    path = str(tmp_path / "r.json")
+    guard = CrashLoopGuard(path, limit=2, backoff_base=0.0,
+                           sleep=lambda s: None)
+    assert guard.on_resume(0, 3) == "fresh"
+    assert guard.on_resume(0, 3) == "retry"
+    assert guard.on_resume(0, 3) == "quarantine"
+    assert guard.is_quarantined(0, 3)
+    assert guard.attempts == 0                     # counter starts over
+    assert resilience.stats()["supervisor"]["batches_quarantined"] == 1
+    # a NEW guard over the same file sees the quarantine (persisted)
+    guard2 = CrashLoopGuard(path, limit=2, sleep=lambda s: None)
+    assert guard2.is_quarantined(0, 3)
+
+
+def test_crash_loop_quarantine_respects_data_budget(tmp_path):
+    policy = DataGuardPolicy(max_skipped_records=1, poison_threshold=8,
+                             max_quarantined_shards=1)
+    guard = CrashLoopGuard(str(tmp_path / "r.json"), limit=1,
+                           backoff_base=0.0, policy=policy,
+                           sleep=lambda s: None)
+    guard.on_resume(0, 1)
+    assert guard.on_resume(0, 1) == "quarantine"   # budget: 1/1 used
+    guard.on_resume(0, 2)
+    with pytest.raises(DataBudgetExceeded):
+        guard.on_resume(0, 2)                      # would exceed budget
+
+
+def test_crash_loop_note_progress_resets(tmp_path):
+    guard = CrashLoopGuard(str(tmp_path / "r.json"), limit=3,
+                           backoff_base=0.0, sleep=lambda s: None)
+    guard.on_resume(0, 3)
+    guard.on_resume(0, 3)
+    guard.note_progress()
+    assert guard.attempts == 0
+    assert guard.on_resume(0, 3) == "fresh"
+
+
+def test_crash_loop_unreadable_file_resets_not_raises(tmp_path):
+    path = str(tmp_path / "r.json")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("{torn")
+    guard = CrashLoopGuard(path, limit=3, sleep=lambda s: None)
+    assert guard.attempts == 0
+    assert guard.on_resume(0, 0) == "fresh"
+
+
+# -- Module.fit integration ---------------------------------------------------
+
+def _mlp():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+_rng = np.random.RandomState(0)
+_X = _rng.rand(96, 8).astype(np.float32)
+_Y = _rng.randint(0, 4, (96,)).astype(np.float32)
+
+
+def _fit(nep, prefix=None, sup=None, resume=None, preempt_at=None,
+         recs=None, batch_period=None):
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), data_names=["data"],
+                        label_names=["softmax_label"])
+    it = mx.io.NDArrayIter(_X, _Y, batch_size=16, shuffle=True, seed=3,
+                           label_name="softmax_label")
+
+    def cb(param):
+        b = param.locals["batch"]
+        h = hashlib.sha256(np.ascontiguousarray(
+            b.data[0].asnumpy()).tobytes()).hexdigest()[:12]
+        if recs is not None:
+            recs.append((param.epoch, param.nbatch, h))
+        if preempt_at is not None \
+                and (param.epoch, param.nbatch) == preempt_at:
+            signal_runtime().deliver(int(_signal.SIGTERM))
+
+    mod.fit(it, num_epoch=nep, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier(), batch_end_callback=cb,
+            checkpoint_prefix=prefix, checkpoint_batch_period=batch_period,
+            resume=resume, supervisor=sup)
+    return mod
+
+
+def test_fit_preempt_checkpoint_marker_and_bitwise_resume(tmp_path):
+    ref = []
+    _fit(2, recs=ref)
+    assert len(ref) == 12
+
+    prefix = str(tmp_path / "ck")
+    killed = []
+    with pytest.raises(Preempted) as err:
+        _fit(2, prefix=prefix, sup=_sup(), preempt_at=(0, 3), recs=killed)
+    assert err.value.exit_code == EXIT_PREEMPTED
+    assert len(killed) == 4                 # the in-flight step finished
+    marker = read_preempt_marker(prefix)
+    assert marker and marker["clean"] and marker["exit_code"] == 83
+    assert (marker["epoch"], marker["nbatch"]) == (0, 3)
+    assert resilience.stats()["supervisor"]["preempt_exits"] == 1
+
+    resumed = []
+    _fit(2, prefix=prefix, sup=_sup(), resume="auto", recs=resumed)
+    assert killed + resumed == ref          # bitwise-exact continuation
+    assert read_preempt_marker(prefix) is None   # marker consumed
+
+
+def test_fit_preempt_on_checkpoint_batch_keeps_the_stem(tmp_path):
+    # a preemption landing on the very batch a checkpoint_batch_period
+    # save just captured computes the SAME mid-epoch label — the saver
+    # must reuse that stem, not delete-then-rewrite (and then roll) it
+    ref = []
+    _fit(2, recs=ref)
+    prefix = str(tmp_path / "ck")
+    killed = []
+    with pytest.raises(Preempted):
+        _fit(2, prefix=prefix, sup=_sup(), preempt_at=(0, 1), recs=killed,
+             batch_period=2)             # bperiod save fires at nbatch=1
+    from mxnet_tpu.resilience.checkpoint import (find_checkpoints,
+                                                 mid_epoch_label)
+    assert mid_epoch_label(0, 1) in find_checkpoints(prefix)
+    resumed = []
+    _fit(2, prefix=prefix, sup=_sup(), resume="auto", recs=resumed)
+    assert killed + resumed == ref
+
+
+def test_watchdog_suspend_covers_unsupervised_windows():
+    # between run_step calls (eval, checkpoint writes) the watchdog is
+    # suspended: arbitrary beat-less time must not read as a stall
+    clock = FakeClock()
+    wd = StallWatchdog(timeout=5.0, clock=clock)
+    sup = _sup(watchdog=wd, stall_timeout=5.0)
+    sup.run_step(lambda: "ok")
+    clock.advance(1000.0)               # a long eval pass, no heartbeats
+    assert wd.check() is False
+    sup.run_step(lambda: "ok")          # heartbeat re-arms, still fine
+    assert wd.check() is False
+
+
+def test_fit_double_signal_aborts_without_checkpoint(tmp_path):
+    prefix = str(tmp_path / "ck")
+    delivered = []
+
+    def double(param):
+        if (param.epoch, param.nbatch) == (0, 1) and not delivered:
+            delivered.append(1)
+            signal_runtime().deliver(int(_signal.SIGTERM))
+            with pytest.raises(ImmediateAbort) as err:
+                signal_runtime().deliver(int(_signal.SIGTERM))
+            assert err.value.exit_code == EXIT_ABORTED
+            raise err.value                 # as the real handler would
+
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), data_names=["data"],
+                        label_names=["softmax_label"])
+    it = mx.io.NDArrayIter(_X, _Y, batch_size=16,
+                           label_name="softmax_label")
+    with pytest.raises(ImmediateAbort):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                initializer=mx.init.Xavier(), batch_end_callback=double,
+                checkpoint_prefix=prefix, supervisor=_sup())
+    # the abort wrote NOTHING new — no checkpoint, no clean marker
+    from mxnet_tpu.resilience.checkpoint import find_checkpoints
+    assert find_checkpoints(prefix) == []
+    assert read_preempt_marker(prefix) is None
+
+
+def test_fit_stall_ladder_retry_and_rebind(tmp_path):
+    faults.arm(FaultPlan().arm(SITE_HEARTBEAT, nth=3, count=2))
+    _fit(2, sup=_sup())
+    s = resilience.stats()["supervisor"]
+    assert s["stall_retries"] == 1 and s["stall_rebinds"] == 1
+    assert s["stall_aborts"] == 0
+
+
+def test_fit_stall_abort_checkpoints_last_trained_position(tmp_path):
+    prefix = str(tmp_path / "ck")
+    faults.arm(FaultPlan().arm(SITE_HEARTBEAT, nth=3, count=10))
+    recs = []
+    with pytest.raises(StallAbort) as err:
+        _fit(2, prefix=prefix, sup=_sup(), recs=recs)
+    assert err.value.exit_code == EXIT_STALLED
+    from mxnet_tpu.resilience.checkpoint import find_checkpoints
+    cks = find_checkpoints(prefix)
+    assert cks, "abort must leave a checkpoint for the relaunch"
+    # resume replays the stalled batch: the combined stream stays exact
+    ref = []
+    _fit(2, recs=ref)
+    faults.disarm()
+    resumed = []
+    _fit(2, prefix=prefix, sup=_sup(), resume="auto", recs=resumed)
+    assert recs + resumed == ref
+
+
+def test_fit_resume_skips_quarantined_batch(tmp_path):
+    ref = []
+    _fit(2, recs=ref)
+    prefix = str(tmp_path / "ck")
+    killed = []
+    with pytest.raises(Preempted):
+        _fit(2, prefix=prefix, sup=_sup(), preempt_at=(0, 2), recs=killed)
+    # simulate a crash loop at the resume position (0, 3): pre-seed the
+    # attempt counter at the limit, so the next resume quarantines it
+    sup = _sup(crash_limit=2, backoff_base=0.0)
+    guard = sup.crash_guard(prefix)
+    assert guard.on_resume(0, 3) == "fresh"
+    assert guard.on_resume(0, 3) == "retry"
+    resumed = []
+    _fit(2, prefix=prefix, sup=sup, resume="auto", recs=resumed)
+    # batch (0,3) was quarantined and skipped: the resumed stream starts
+    # at the NEXT batch of the reference ordering
+    assert resilience.stats()["supervisor"]["batches_quarantined"] == 1
+    assert resumed[0][:2] == (0, 4)
+    assert resumed[0][2] == ref[4][2]       # same shuffled stream, batch 4
+    assert len(killed) + 1 + len(resumed) == len(ref)
+
+
+def test_fresh_fit_clears_stale_marker(tmp_path):
+    prefix = str(tmp_path / "ck")
+    with pytest.raises(Preempted):
+        _fit(2, prefix=prefix, sup=_sup(), preempt_at=(0, 1))
+    assert read_preempt_marker(prefix) is not None
+    _fit(1, prefix=prefix, sup=_sup())      # fresh lineage, no resume
+    assert read_preempt_marker(prefix) is None
+
+
+# -- stale-stem GC (discovery/startup sweep) ---------------------------------
+
+def _write_ck(prefix, label):
+    from mxnet_tpu.resilience.checkpoint import write_checkpoint
+    sym = _mlp()
+    arg = {"fc1_weight": mx.nd.zeros((16, 8)),
+           "fc1_bias": mx.nd.zeros((16,)),
+           "fc2_weight": mx.nd.zeros((4, 16)),
+           "fc2_bias": mx.nd.zeros((4,))}
+    write_checkpoint(prefix, label, sym, arg, {})
+
+
+def test_find_checkpoints_supersession_order(tmp_path):
+    from mxnet_tpu.resilience.checkpoint import (find_checkpoints,
+                                                 mid_epoch_label)
+    prefix = str(tmp_path / "ck")
+    # stale mid stems of epoch 0 + the end-of-epoch-1 checkpoint that
+    # supersedes them (abnormal exit killed the sweep)
+    _write_ck(prefix, mid_epoch_label(0, 1))
+    _write_ck(prefix, mid_epoch_label(0, 3))
+    _write_ck(prefix, 1)
+    # raw-label ordering would put the (huge) mid labels first and make
+    # resume='auto' pick a STALE stem; supersession order must not
+    assert find_checkpoints(prefix)[0] == 1
+
+
+def test_sweep_stale_checkpoints(tmp_path):
+    from mxnet_tpu.resilience.checkpoint import (find_checkpoints,
+                                                 mid_epoch_label,
+                                                 sweep_stale_checkpoints)
+    prefix = str(tmp_path / "ck")
+    _write_ck(prefix, mid_epoch_label(0, 1))
+    _write_ck(prefix, mid_epoch_label(0, 3))
+    _write_ck(prefix, 1)
+    _write_ck(prefix, mid_epoch_label(1, 0))    # newer than epoch-1 end
+    assert sweep_stale_checkpoints(prefix) == 2
+    assert sorted(find_checkpoints(prefix)) == [1, mid_epoch_label(1, 0)]
+    # bounded by the USED checkpoint: a fallback resume must not delete
+    # stems newer than what it actually loaded
+    assert sweep_stale_checkpoints(prefix, used=1) == 0
+    assert sorted(find_checkpoints(prefix)) == [1, mid_epoch_label(1, 0)]
+
+
+def test_resume_sweeps_stale_stems(tmp_path):
+    from mxnet_tpu.resilience.checkpoint import (find_checkpoints,
+                                                 mid_epoch_label)
+    prefix = str(tmp_path / "ck")
+    killed = []
+    with pytest.raises(Preempted):
+        _fit(2, prefix=prefix, sup=_sup(), preempt_at=(1, 2), recs=killed,
+             batch_period=2)
+    # strand a stale superseded stem, as a kill between save and roll
+    # would (older than everything on disk)
+    _write_ck(prefix, mid_epoch_label(0, 0))
+    assert mid_epoch_label(0, 0) in find_checkpoints(prefix)
+    _fit(2, prefix=prefix, sup=_sup(), resume="auto")
+    assert mid_epoch_label(0, 0) not in find_checkpoints(prefix)
+
+
+# -- serving graceful drain ---------------------------------------------------
+
+def _server(**kw):
+    from mxnet_tpu.serving import CallableBackend, InferenceServer
+    backend = CallableBackend(
+        lambda inputs: [np.asarray(inputs["data"]).sum(axis=1)])
+    srv = InferenceServer(backend, workers=0, **kw)
+    srv.warm_up()
+    srv.install_signal_handlers(signals=())
+    return srv
+
+
+def test_serving_drain_readyz_flips_and_sheds_retriable():
+    from mxnet_tpu.serving import Draining
+    srv = _server(name="drain-a")
+    try:
+        queued = srv.submit(np.ones((2, 3), np.float32))
+        assert srv.readyz()["ready"]
+        signal_runtime().deliver(int(_signal.SIGTERM))
+        rz = srv.readyz()
+        assert not rz["ready"]              # flips false IMMEDIATELY
+        assert any("draining" in r for r in rz["reasons"])
+        with pytest.raises(Draining) as err:
+            srv.submit(np.ones((2, 3), np.float32))
+        assert err.value.retriable          # clients resubmit elsewhere
+        assert isinstance(err.value, mx.base.MXNetError)
+        # the in-flight (queued) request still completes within its
+        # deadline — drain finishes work, then closes
+        srv.drain()
+        outs = srv.result(queued)
+        assert np.allclose(outs[0], [3.0, 3.0])
+        assert srv._closed
+        st = srv.stats()
+        assert st["drain_signals"] == 1 and st["drained_rejects"] == 1
+        assert st["completed"] == 1
+    finally:
+        srv.close()
+
+
+def test_serving_second_signal_closes_immediately():
+    from mxnet_tpu.serving import ServerClosed
+    srv = _server(name="drain-b")
+    signal_runtime().deliver(int(_signal.SIGTERM))
+    signal_runtime().deliver(int(_signal.SIGTERM))
+    assert srv._closed
+    with pytest.raises(ServerClosed):
+        srv.submit(np.ones((1, 3), np.float32))
+
+
+# -- resolve() ---------------------------------------------------------------
+
+def test_resolve_env_arming(monkeypatch):
+    from mxnet_tpu.resilience.supervisor import resolve
+    assert resolve(None) is None
+    assert isinstance(resolve(True), TrainingSupervisor)
+    sup = _sup()
+    assert resolve(sup) is sup
+    monkeypatch.setenv("MXTPU_SUPERVISOR", "1")
+    assert isinstance(resolve(None), TrainingSupervisor)
