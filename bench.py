@@ -10,11 +10,14 @@
 
 Prints ONE json line: the ResNet-50 record (metric/value/unit/
 vs_baseline, as every prior round) with the LSTM record nested under
-``lstm_train_tokens_per_sec`` and the flagship-tier records nested under
-``flash_attention`` / ``moe_dispatch``. Every metric carries its own
-vs_best_recorded + regression flag against the best across recorded
-BENCH_r*.json rounds (the flagship metrics self-seed on their first
-recorded round).
+``lstm_train_tokens_per_sec``, the flagship-tier records nested under
+``flash_attention`` / ``moe_dispatch``, the compiler tier under
+``compile_cache``, and the pod-scale tier under ``multichip``
+(8-device ResNet-50 + LSTM throughput, 1→8 scaling, ZeRO
+optimizer-state bytes/chip — benchmarks/bench_multichip.py). Every
+metric carries its own vs_best_recorded + regression flag against the
+best across recorded BENCH_r*.json rounds (new metrics self-seed on
+their first recorded round).
 
 Batch/iters overridable via BENCH_BATCH / BENCH_ITERS — such smoke runs
 skip the LSTM/flagship halves and the regression guard (config
@@ -49,7 +52,7 @@ def best_recorded():
     round records them — this round seeds that history)."""
     best = {"resnet": 0.0, "lstm": LSTM_PRIOR_BEST,
             "flash_attention": 0.0, "moe_dispatch": 0.0,
-            "compile_cache": 0.0}
+            "compile_cache": 0.0, "multichip": 0.0}
     here = os.path.dirname(os.path.abspath(__file__))
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         try:
@@ -62,7 +65,8 @@ def best_recorded():
             for key, nested in (("lstm", "lstm_train_tokens_per_sec"),
                                 ("flash_attention", "flash_attention"),
                                 ("moe_dispatch", "moe_dispatch"),
-                                ("compile_cache", "compile_cache")):
+                                ("compile_cache", "compile_cache"),
+                                ("multichip", "multichip")):
                 sub = rec.get(nested)
                 if isinstance(sub, dict):
                     best[key] = max(best[key],
@@ -147,6 +151,23 @@ def bench_flagship():
     return fa, moe
 
 
+def bench_multichip():
+    """Pod-scale record: ResNet-50 + Gluon-LSTM data-parallel across the
+    8-device mesh with ZeRO weight-update sharding — per-chip/aggregate
+    throughput, 1→8 aggregate scaling, optimizer-state bytes/chip
+    measured from the live state pytrees, bitwise ZeRO-vs-replicated
+    (benchmarks/bench_multichip.py). Runs in a self-provisioned
+    8-virtual-CPU-device child: the virtual mesh exercises the real
+    SPMD programs/collectives; `host_cores` in the record contextualizes
+    the scaling number (aggregate scaling saturates near the host core
+    count for compute-bound steps — on a real pod slice the same
+    measurement is the ICI scaling number)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_multichip as _mc
+    return _mc.run(quiet=True)
+
+
 def bench_compile_cache():
     """compile_cold_start_s / cache_warm_start_s pair via two real
     subprocesses (benchmarks/bench_compile_cache.py); the guarded value
@@ -219,6 +240,21 @@ def main():
         cc["regression"] = float(cc["value"]) < 1.0
         regressed |= cc["regression"]
         record["compile_cache"] = cc
+
+        # pod-scale tier: the multichip record (ISSUE 9). The guarded
+        # value is the 8-device aggregate ResNet throughput on the CPU
+        # child (host-stable round over round); the ZeRO memory
+        # contract is enforced absolutely — optimizer state per chip
+        # must actually shrink in ZeRO mode, and the ZeRO step must
+        # reproduce the replicated step.
+        mc = bench_multichip()
+        regressed |= _guard(mc, best["multichip"])
+        zrec = mc.get("zero", {})
+        mc["zero_contract_violation"] = bool(
+            float(zrec.get("reduction", 0.0)) < 2.0
+            or not zrec.get("allclose_vs_replicated", False))
+        regressed |= mc["zero_contract_violation"]
+        record["multichip"] = mc
 
     print(json.dumps(record))
     if regressed and os.environ.get("BENCH_ENFORCE"):

@@ -26,10 +26,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
-          vocab=10000):
+          vocab=10000, momentum=0.0):
     """The exact bench model: Embedding -> fused LSTM stack -> FC -> softmax.
 
-    Returns (module, batch) bound, initialized, optimizer-ready."""
+    Returns (module, batch) bound, initialized, optimizer-ready.
+    ``momentum`` is 0 for the tracked single-chip metric (unchanged
+    since round 2); bench_multichip passes 0.9 so the ZeRO
+    optimizer-state measurement has per-slot state to shard."""
     import mxnet_tpu as mx
     from mxnet_tpu.io import DataBatch, DataDesc
 
@@ -51,7 +54,8 @@ def build(batch_size=64, seq_len=256, num_hidden=1024, num_layers=2,
              label_shapes=[DataDesc("softmax_label", (N, T))])
     mod.init_params(mx.init.Xavier())
     mod.init_optimizer(optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.5})
+                       optimizer_params={"learning_rate": 0.5,
+                                         "momentum": momentum})
     rng = np.random.RandomState(0)
     batch = DataBatch(
         data=[mx.nd.array(rng.randint(0, V, (N, T)).astype(np.float32))],

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""CI smoke for multichip SPMD + ZeRO weight-update sharding.
+
+Run by `make ci-multichip` under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` and
+``MXTPU_RETRACE_STRICT=1`` (docs/how_to/multichip.md). Asserts, on the
+8-virtual-device CPU mesh:
+
+1. the ZeRO-sharded step reproduces the replicated step — bitwise for
+   the layout-stable MLP (the default ``MXTPU_ZERO=1`` contract), and
+   the per-step losses stay equal over several steps;
+2. the compiled ZeRO step's optimized HLO carries an actual all-gather
+   (or all-to-all) collective — the updated-param re-gather happens
+   INSIDE the donated program, not as per-step host traffic;
+3. optimizer-state bytes/chip, measured from the live state pytrees'
+   shard shapes, drop by exactly the data degree (8x);
+4. zero retraces: MXTPU_RETRACE_STRICT=1 turns any second compile of a
+   step program into a hard error, so simply finishing is the assert.
+
+Everything runs in-process (the driver exports the XLA flag); total
+budget is the Makefile's `timeout`.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("MXTPU_RETRACE_STRICT", "1")
+
+N_DEV = 8
+BATCH = 16
+STEPS = 3
+
+
+def _mlp_sym():
+    import mxnet_tpu as mx
+    h = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=32,
+                              name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=8, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _feed(seed):
+    rng = np.random.RandomState(seed)
+    return {"data": rng.rand(BATCH, 16).astype(np.float32),
+            "softmax_label": rng.randint(0, 8, (BATCH,))
+            .astype(np.float32)}
+
+
+def _run(zero):
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    tr = SPMDTrainer(
+        _mlp_sym(), optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0 / BATCH),
+        mesh=make_mesh({"data": N_DEV}),
+        shard_optimizer_state=zero)
+    tr.bind(data_shapes={"data": (BATCH, 16)},
+            label_shapes={"softmax_label": (BATCH,)})
+    losses = []
+    for i in range(STEPS):
+        outs = tr.step(_feed(i))
+        losses.append(np.asarray(outs[0]))
+    return tr, losses
+
+
+def main():
+    import jax
+
+    n = len(jax.devices())
+    assert n >= N_DEV, (
+        f"smoke needs {N_DEV} devices, got {n} — run via `make "
+        "ci-multichip` (it exports XLA_FLAGS="
+        f"--xla_force_host_platform_device_count={N_DEV})")
+
+    from mxnet_tpu.parallel import state_bytes_per_device
+
+    tr_rep, losses_rep = _run(zero=False)
+    tr_zero, losses_zero = _run(zero=True)
+
+    # 1. equivalence: losses equal every step, params bitwise at the end
+    for i, (a, b) in enumerate(zip(losses_rep, losses_zero)):
+        assert np.allclose(a, b, rtol=1e-6, atol=1e-7), \
+            f"step {i}: ZeRO losses diverged from replicated"
+    for name in tr_rep.params:
+        assert np.array_equal(np.asarray(tr_rep.params[name]),
+                              np.asarray(tr_zero.params[name])), \
+            f"param {name}: ZeRO != replicated after {STEPS} steps"
+    print(f"multichip smoke: ZeRO == replicated over {STEPS} steps "
+          "(losses allclose, params bitwise)")
+
+    # 2. the re-gather is a compiled collective, not host traffic
+    hlo = tr_zero.compiled_step_hlo()
+    assert ("all-gather" in hlo or "all-to-all" in hlo), \
+        "ZeRO step HLO shows no re-gather collective"
+    print("multichip smoke: all-gather present in the compiled ZeRO HLO")
+
+    # 3. measured state-memory drop = the data degree
+    b_rep = state_bytes_per_device(tr_rep.states)
+    b_zero = state_bytes_per_device(tr_zero.states)
+    assert b_zero and b_rep == N_DEV * b_zero, \
+        f"state bytes/chip: replicated {b_rep} vs ZeRO {b_zero} " \
+        f"(expected exactly {N_DEV}x)"
+    print(f"multichip smoke: optimizer state {b_rep} -> {b_zero} "
+          f"bytes/chip ({N_DEV}x drop, measured)")
+
+    # 4. reaching here under MXTPU_RETRACE_STRICT=1 means zero retraces
+    assert os.environ.get("MXTPU_RETRACE_STRICT") == "1"
+    print("multichip smoke: zero retraces under MXTPU_RETRACE_STRICT=1")
+    print("multichip smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -404,7 +404,14 @@ class OptimizeResult:
 
     @property
     def transform_sig(self) -> str:
-        return f"passes={int(self.changed)};remat={int(self.remat)}"
+        sig = f"passes={int(self.changed)};remat={int(self.remat)}"
+        # the sharding annotator (parallel/sharding.py) stamps the plan
+        # signature so program keys built from this sig can never serve
+        # an executable compiled for a different layout/ZeRO mode
+        shard = self.annotations.get("sharding_sig")
+        if shard:
+            sig += f";shard={shard}"
+        return sig
 
 
 def optimize(symbol, input_shapes=None, input_dtypes=None,

@@ -102,6 +102,15 @@ register_knob("MXTPU_OP_COSTS", str, None,
 register_knob("MXTPU_PROGRAM_REGISTRY_CAP", int, 64,
               "max fingerprint-keyed executor program bundles shared "
               "in-process (LRU; eviction only costs sharing)")
+register_knob("MXTPU_ZERO", int, 0,
+              "default ZeRO-1 mode for mesh trainers: shard optimizer "
+              "state + the weight-update math over the data axis, "
+              "re-gathering params via the ICI inside the donated step "
+              "(docs/how_to/multichip.md; arxiv 2004.13336)")
+register_knob("MXTPU_PARTITION_RULES", str, None,
+              "ordered partition rules as JSON [[regex, spec], ...] or "
+              "@/path/to/rules.json — resolved by the rule engine in "
+              "parallel/sharding.py (docs/how_to/multichip.md)")
 register_knob("MXTPU_SUPERVISOR", int, 0,
               "arm the preemption-aware training supervisor in every "
               "fit() (signal handlers, stall watchdog, crash-loop "

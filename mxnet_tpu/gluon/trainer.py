@@ -18,7 +18,8 @@ __all__ = ["Trainer"]
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
-                 kvstore="device", compression_params=None):
+                 kvstore="device", compression_params=None,
+                 mesh=None, shard_optimizer_state=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -43,6 +44,28 @@ class Trainer:
         # semantics); tests toggle _donate_buffers before first step.
         self._fused_apply = None
         self._donate_buffers = True
+        # ZeRO weight-update sharding at the Gluon seam (parallel/
+        # sharding.py): optimizer state + update math shard over the
+        # mesh's data axis inside the fused program; weights stay the
+        # single logical copy. shard_optimizer_state=None defers to the
+        # MXTPU_ZERO knob (only consulted when a mesh is given).
+        if shard_optimizer_state and mesh is None:
+            raise MXNetError(
+                "Trainer(shard_optimizer_state=True) needs mesh= — ZeRO "
+                "shards the update over the mesh's 'data' axis")
+        self._plan = None
+        if mesh is not None:
+            from ..parallel.sharding import ShardingPlan
+            self._plan = ShardingPlan(mesh, zero=shard_optimizer_state)
+            # same wall SPMDTrainer.bind raises: a requested ZeRO mode
+            # with no data axis to shard over must fail loudly, not
+            # silently train with replicated state
+            if self._plan.zero_requested and "data" not in mesh.axis_names:
+                raise MXNetError(
+                    "shard_optimizer_state (ZeRO) shards the weight "
+                    "update over the mesh 'data' axis, but this mesh "
+                    f"has axes {mesh.axis_names} — add a 'data' axis "
+                    "or disable ZeRO")
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -126,7 +149,8 @@ class Trainer:
                 self._fused_apply = False
                 return False
             self._fused_apply = FusedOptimizerApply(
-                opt, name="gluon-trainer", donate=self._donate_buffers)
+                opt, name="gluon-trainer", donate=self._donate_buffers,
+                sharding=self._plan)
         from ..perf.step_runtime import apply_fused_triples
         triples = [(i, param.data(), grad) for i, param, grad in live]
         return apply_fused_triples(self._fused_apply, opt, triples,

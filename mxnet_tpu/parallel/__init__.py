@@ -15,7 +15,10 @@ machinery with SPMD over a ``jax.sharding.Mesh``:
 """
 from .mesh import (make_mesh, local_mesh, mesh_scope,  # noqa: F401
                    current_mesh)
-from .sharding import batch_pspec, param_pspec, shard_params  # noqa: F401
+from .sharding import (batch_pspec, param_pspec,  # noqa: F401
+                       shard_params, match_partition_rules, parse_rules,
+                       rules_from_env, ShardingPlan, zero_shard_spec,
+                       state_bytes_per_device, plan_scope, current_plan)
 from .trainer import SPMDTrainer  # noqa: F401
 from .sequence import (ring_attention, sequence_sharded_attention,  # noqa: F401
                        ulysses_attention)

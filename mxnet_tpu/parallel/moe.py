@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import MXNetError
+from .compat import axis_size, shard_map
 
 __all__ = ["moe_apply", "moe_dense_apply", "top1_router", "topk_router",
            "load_balance_loss"]
@@ -94,7 +95,7 @@ def _moe_local(x, router_w, expert_params, expert_fn, axis_name,
     E_loc (this device's experts). Returns (out, aux_loss) where the aux
     loss is the GLOBAL Switch load-balance term (psum over the axis).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     t_loc, d = x.shape
     e_loc = jax.tree.leaves(expert_params)[0].shape[0]
     n_experts = e_loc * n
@@ -178,7 +179,7 @@ def moe_apply(x, router_w, expert_params, expert_fn: Callable, mesh: Mesh,
             f"router_w routes to {router_w.shape[-1]} experts but "
             f"expert_params holds {n_experts}")
     e_spec = jax.tree.map(lambda _: P(axis_name), expert_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_moe_local, expert_fn=expert_fn,
                           axis_name=axis_name,
                           capacity_factor=capacity_factor, top_k=top_k),

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import MXNetError
+from .compat import axis_size, shard_map
 
 __all__ = ["pipeline_apply", "pipeline_value_and_grad",
            "stack_stage_params", "pipeline_from_symbol",
@@ -83,7 +84,7 @@ def stack_stage_params(param_list):
 def _pipe_local(params, x, fn: Callable, axis_name: str, n_micro: int):
     """Per-device body. params: this stage's pytree (leading dim squeezed);
     x: (n_micro, mb, ...) replicated microbatch inputs."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -109,7 +110,8 @@ def _pipe_local(params, x, fn: Callable, axis_name: str, n_micro: int):
             jnp.zeros((n_micro,) + mb_shape, x.dtype))
     _, outputs = jax.lax.fori_loop(0, n_micro + n - 1, tick, init)
     # out_specs stacks per-device buffers along a leading pipe dim; only
-    # the last stage's buffer holds the real outputs — caller slices [-1]
+    # the last stage's buffer holds the real outputs (the others stay
+    # zero) — caller contracts the stage dim away
     return outputs[None]
 
 
@@ -138,12 +140,20 @@ def pipeline_apply(fn: Callable, stacked_params, x, mesh: Mesh,
     xm = x.reshape((n_micro, batch // n_micro) + x.shape[1:])
 
     p_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    out = jax.shard_map(
+    out = shard_map(
         functools.partial(_pipe_local, fn=fn, axis_name=axis_name,
                           n_micro=n_micro),
         mesh=mesh, in_specs=(p_spec, P()), out_specs=P(axis_name),
         check_vma=False)(stacked_params, xm)
-    return out[-1].reshape((batch,) + x.shape[1:])
+    # exact out[-1], written as a one-hot contraction over the sharded
+    # stage dim: slicing it would transpose to a cross-partition
+    # dynamic_update_slice, which old jaxlib's SPMD partitioner
+    # miscompiles (s64/s32 index compare); multiply+reduce transposes to
+    # broadcast+mask, safe on every build. Non-last buffers are exactly
+    # zero, so the sum is bitwise the last stage's buffer.
+    mask = (jnp.arange(n) == n - 1).astype(out.dtype)
+    last = jnp.tensordot(mask, out, axes=1)
+    return last.reshape((batch,) + x.shape[1:])
 
 
 def _1f1b_local(params, tail_params, x, y, fn: Callable, loss_fn: Callable,
@@ -159,7 +169,7 @@ def _1f1b_local(params, tail_params, x, y, fn: Callable, loss_fn: Callable,
     forward. Each backward step re-linearizes the stage function at the
     saved stage input (jax.vjp = per-stage rematerialization).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
@@ -236,7 +246,7 @@ def _1f1b_local(params, tail_params, x, y, fn: Callable, loss_fn: Callable,
     # shard computed the mean loss of ITS slice, so the global mean (and
     # its gradients) is the psum over those axes divided by their size
     for ax in reduce_axes:
-        size = jax.lax.axis_size(ax)
+        size = axis_size(ax)
         loss = jax.lax.psum(loss, ax) / size
         grads = jax.tree.map(lambda g: jax.lax.psum(g, ax) / size, grads)
         tail_g = jax.tree.map(lambda g: jax.lax.psum(g, ax) / size, tail_g)
@@ -299,7 +309,7 @@ def pipeline_value_and_grad(fn: Callable, loss_fn: Callable, stacked_params,
     p_spec = (param_spec if param_spec is not None
               else jax.tree.map(lambda _: P(axis_name), stacked_params))
     rep = jax.tree.map(lambda _: P(), tail_params)
-    loss, grads, tail_g, xgrads = jax.shard_map(
+    loss, grads, tail_g, xgrads = shard_map(
         functools.partial(_1f1b_local, fn=fn, loss_fn=loss_fn,
                           axis_name=axis_name, n_micro=n_micro,
                           reduce_axes=reduce_axes),

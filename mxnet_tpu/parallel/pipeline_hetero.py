@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import MXNetError
+from .compat import axis_size, shard_map
 from .. import random as _random
 
 __all__ = ["hetero_pipeline_from_symbol"]
@@ -504,7 +505,7 @@ def hetero_pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
     def _local_train(stacked_p, stacked_aux, epi_params, xflat, ym,
                      base_key, *, n_micro, fwd_br, diff_br, act_n_shape,
                      L_act):
-        nn = jax.lax.axis_size(axis_name)
+        nn = axis_size(axis_name)
         idx = jax.lax.axis_index(axis_name)
         p_loc = jnp.squeeze(stacked_p, 0)
         aux0 = jnp.squeeze(stacked_aux, 0)
@@ -595,7 +596,7 @@ def hetero_pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
     # -- GPipe inference ---------------------------------------------------
     def _local_fwd(stacked_p, stacked_aux, xflat, base_key, *, n_micro,
                    fwd_br, L_act):
-        nn = jax.lax.axis_size(axis_name)
+        nn = axis_size(axis_name)
         idx = jax.lax.axis_index(axis_name)
         p_loc = jnp.squeeze(stacked_p, 0)
         aux_loc = jnp.squeeze(stacked_aux, 0)
@@ -651,7 +652,7 @@ def hetero_pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
         stacked_aux = jnp.stack([
             _pack(_gather(aux_dict, per_stage_aux[k], f"stage{k} aux"),
                   L_aux) for k in range(n)])
-        out = jax.shard_map(
+        out = shard_map(
             functools.partial(_local_fwd, n_micro=n_micro, fwd_br=fwd_br,
                               L_act=L_act),
             mesh=mesh, in_specs=(P(axis_name), P(axis_name), P(), P()),
@@ -701,7 +702,7 @@ def hetero_pipeline_from_symbol(symbol, mesh: Mesh, axis_name: str = "pipe",
                   L_aux) for k in range(n)])
         epi_p = _gather(arg_dict, epi_vars, "epilogue")
 
-        loss, g_stacked, aux_out, g_epi, xgrads = jax.shard_map(
+        loss, g_stacked, aux_out, g_epi, xgrads = shard_map(
             functools.partial(_local_train, n_micro=n_micro,
                               fwd_br=fwd_br, diff_br=diff_br,
                               act_n_shape=act_shapes[n], L_act=L_act),

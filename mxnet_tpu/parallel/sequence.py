@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import MXNetError
+from .compat import axis_size, shard_map
 
 __all__ = ["ring_attention", "ulysses_attention", "sequence_sharded_attention"]
 
@@ -75,7 +76,7 @@ def _block(q, k, v, kpos, qpos, scale, causal, carry):
 def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
                      scale: Optional[float]):
     """Per-shard body: rotate K/V blocks around `axis_name`, accumulate."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -145,7 +146,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     _check_seq_divides(q, k, mesh, axis_name)
     b_ax, h_ax = _bh_axes(q, mesh, axis_name, batch_axis, head_axis)
     spec = P(b_ax, h_ax, axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_attn_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -198,7 +199,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
             f"ulysses needs local heads ({local_heads}) divisible by mesh "
             f"axis {axis_name!r} ({n})")
     spec = P(b_ax, h_ax, axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -388,24 +388,46 @@ class FusedStep:
     params, 2-D+ leaves cast once inside the step so the MXU sees bf16
     operands — including embedding tables, which are cast BEFORE the
     gather (casting after would stream the full fp32 activation).
+
+    ``mesh``/``sharding`` make the SAME donated program SPMD over a
+    named mesh (parallel/sharding.py's rule engine): parameters and
+    optimizer state are placed by the plan's specs, the batch arrives
+    split over the ``data`` axis, and — in the plan's ZeRO mode — each
+    gradient is pinned to the state spec (lowering the batch all-reduce
+    to a reduce-scatter), the update runs on each replica's 1/N slice,
+    and the updated parameter is constrained back to its param spec:
+    the all-gather happens via the interconnect INSIDE the donated
+    step, never as a separate dispatch (arxiv 2004.13336). This is the
+    one seam that gives Module and the Gluon Trainer the multichip
+    weight-update sharding SPMDTrainer has.
     """
 
     def __init__(self, symbol, optimizer, param_names: Sequence[str],
                  compute_dtype=None, donate: bool = True,
                  name: str = "fused-step", input_shapes=None,
-                 input_dtypes=None):
+                 input_dtypes=None, mesh=None, sharding=None):
         from .. import compiler as _compiler
+        from ..parallel.sharding import ShardingPlan, plan_scope
         self._symbol = symbol
         self._optimizer = optimizer
         self._param_names = list(param_names)
+        if sharding is not None and mesh is None:
+            mesh = sharding.mesh
+        if mesh is not None and sharding is None:
+            sharding = ShardingPlan(mesh)
+        self.mesh = mesh
+        self.plan = sharding
         # graph passes at bind time (DCE/CSE/remat policy); the fused
         # step traces the optimized graph, the module keeps the
         # original. input_shapes/dtypes (every bound arg + aux) feed
         # the remat-policy activation estimate — without them the
-        # MXTPU_REMAT_MB budget cannot engage.
-        opt_res = _compiler.optimize(symbol, for_training=True,
-                                     input_shapes=input_shapes,
-                                     input_dtypes=input_dtypes)
+        # MXTPU_REMAT_MB budget cannot engage. plan_scope: the sharding
+        # annotator stamps the plan into the IR annotations, so
+        # transform_sig (and the program key) carries the layout.
+        with plan_scope(self.plan):
+            opt_res = _compiler.optimize(symbol, for_training=True,
+                                         input_shapes=input_shapes,
+                                         input_dtypes=input_dtypes)
         opt_sym = opt_res.symbol
         # the explicit mirror knob must survive MXTPU_GRAPH_PASSES=0
         # (with passes on, the remat-policy pass already folds it in)
@@ -429,7 +451,8 @@ class FusedStep:
             f"wd={sorted((n, float(optimizer.wd * optimizer.wd_mult.get(n, 1.0))) for n in self._param_names)}",
             f"lrm={sorted((n, float(optimizer.lr_mult.get(n, 1.0))) for n in self._param_names)}",
             f"cdt={compute_dtype}",
-            f"layouts={sorted(self.layouts)}")
+            f"layouts={sorted(self.layouts)}",
+            f"plan={'-' if self.plan is None else self.plan.signature_hash()}")
 
         # static per-param wd / lr multipliers (reference: set_wd_mult —
         # biases/BN params get wd 0); the dynamic base lr stays an input
@@ -447,6 +470,17 @@ class FusedStep:
             return v
 
         remat = self._remat
+        plan = self.plan
+        if plan is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _psh(n, v):
+                return NamedSharding(plan.mesh, plan.param_spec(n, v.shape))
+
+            def _ssh(n, v):
+                return NamedSharding(plan.mesh, plan.state_spec(n, v.shape))
+
+            _repl = NamedSharding(plan.mesh, PartitionSpec())
 
         def step(params, states, aux, inputs, rng, lr, t):
             def loss_f(p):
@@ -474,14 +508,55 @@ class FusedStep:
                 g_leaves = jax.tree_util.tree_leaves(grads[n])
                 nw, ns = [], []
                 for w, g, s in zip(w_leaves, g_leaves, states[n]):
-                    w2, s2 = update(w, g, s, lr * lr_mult[n],
-                                    wd_by_name[n], t)
+                    if plan is not None and plan.zero and plan.zero_rs:
+                        # comm-optimal ZeRO (MXTPU_ZERO=2): pin the grad
+                        # to the state spec — GSPMD lowers the batch-axis
+                        # gradient reduction to a reduce_scatter and each
+                        # replica updates only its 1/N slice
+                        # (arxiv 2004.13336). Last-ulp drift vs
+                        # replicated: a different summation order.
+                        g = jax.lax.with_sharding_constraint(g, _ssh(n, g))
+                        w2, s2 = update(w, g, s, lr * lr_mult[n],
+                                        wd_by_name[n], t)
+                    elif plan is not None and plan.zero:
+                        # bitwise ZeRO (default): the full all-reduce
+                        # runs in the replicated program's order, then
+                        # the update slices inside a shard_map whose
+                        # pinned boundary keeps the 1/N layout from
+                        # re-laying-out the forward/backward
+                        # no explicit grad pin: the shard_map's own
+                        # replicated in_spec places the exact demand the
+                        # replicated program's elementwise update does,
+                        # so both programs' forward/backward regions
+                        # carry identical constraints
+                        from ..parallel.sharding import \
+                            zero_sharded_update
+                        w2, s2 = zero_sharded_update(
+                            plan.mesh, plan.data_axis, update, w, g, s,
+                            lr * lr_mult[n], wd_by_name[n], t,
+                            plan.param_spec(n, w.shape),
+                            plan.state_spec(n, w.shape))
+                    else:
+                        w2, s2 = update(w, g, s, lr * lr_mult[n],
+                                        wd_by_name[n], t)
+                    if plan is not None:
+                        # the param constraint is the in-step all_gather
+                        # rebuilding full params from the updated slices
+                        # (and, ZeRO off, pins the steady-state layout so
+                        # donated outputs never flap shardings)
+                        w2 = jax.lax.with_sharding_constraint(w2, _psh(n, w2))
+                        s2 = jax.tree_util.tree_map(
+                            lambda x: jax.lax.with_sharding_constraint(
+                                x, _ssh(n, x)), s2)
                     nw.append(w2)
                     ns.append(s2)
                 new_params[n] = jax.tree_util.tree_unflatten(treedef, nw)
                 new_states[n] = ns
             new_aux = dict(aux)
             new_aux.update(aux_up)
+            if plan is not None:
+                new_aux = {n: jax.lax.with_sharding_constraint(v, _repl)
+                           for n, v in new_aux.items()}
             return new_params, new_states, new_aux, outs
 
         self._step_body = step
@@ -524,6 +599,11 @@ class FusedStep:
         an imperative ``create_state`` value; present entries seed the
         functional state (checkpoint-resumed momentum survives), missing
         ones start at the optimizer's zero state.
+
+        With a sharding plan, every leaf is device_put with its rule's
+        NamedSharding (params by param spec, state slots by the — ZeRO —
+        state spec, aux replicated), so the first step's program is
+        compiled for the steady-state layout.
         """
         params, states = {}, {}
         for i, n in enumerate(self._param_names):
@@ -545,6 +625,19 @@ class FusedStep:
                 else:
                     states[n] = [self._init_state(v)]
         aux = {n: _to_jax(v) for n, v in aux_params.items()}
+        plan = self.plan
+        if plan is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            params = {n: jax.tree_util.tree_map(
+                lambda x, _n=n: jax.device_put(x, NamedSharding(
+                    plan.mesh, plan.param_spec(_n, x.shape))), v)
+                for n, v in params.items()}
+            states = {n: jax.tree_util.tree_map(
+                lambda x, _n=n: jax.device_put(x, NamedSharding(
+                    plan.mesh, plan.state_spec(_n, x.shape))), v)
+                for n, v in states.items()}
+            repl = NamedSharding(plan.mesh, PartitionSpec())
+            aux = {n: jax.device_put(v, repl) for n, v in aux.items()}
         return params, states, aux
 
     def _split_state(self, name, fstate):
@@ -592,7 +685,13 @@ class FusedStep:
 
     def __call__(self, params, states, aux, inputs, rng, lr, t):
         with _quiet_donation():
-            return self._step_fn(params, states, aux, inputs, rng, lr, t)
+            if self.mesh is None:
+                return self._step_fn(params, states, aux, inputs, rng, lr, t)
+            # mesh-aware ops (MultiHeadAttention seq_axis, ...) consult
+            # the ambient mesh while the step traces (first call only)
+            from ..parallel.mesh import mesh_scope
+            with mesh_scope(self.mesh):
+                return self._step_fn(params, states, aux, inputs, rng, lr, t)
 
 
 # ---------------------------------------------------------------------------
@@ -676,11 +775,30 @@ class ModuleStepper:
             self.refresh()
         mod = self._module
         exec_ = mod._exec
+        plan = self._fused.plan
         inputs = {}
         for name, val in mod._input_dict(data_batch).items():
-            inputs[name] = _as_jax(val, dtype=exec_.arg_dict[name].dtype)
+            v = _as_jax(val, dtype=exec_.arg_dict[name].dtype)
+            if plan is not None:
+                # the global batch arrives split over the data axis; a
+                # device-resident array with this sharding is a no-op
+                from jax.sharding import NamedSharding
+                v = jax.device_put(v, NamedSharding(
+                    plan.mesh, plan.batch_spec(v.ndim)))
+            inputs[name] = v
         for name in self._frozen:
-            inputs[name] = exec_.arg_dict[name]._data
+            v = exec_.arg_dict[name]._data
+            if plan is not None:
+                from jax.sharding import NamedSharding
+                v2 = jax.device_put(v, NamedSharding(
+                    plan.mesh, plan.param_spec(name, v.shape)))
+                if v2 is not v:
+                    # pay the replicated->plan re-layout once: store the
+                    # sharded array back so every later step's
+                    # device_put is the no-op fast path
+                    exec_.arg_dict[name]._data = v2
+                v = v2
+            inputs[name] = v
         rng = (_random.next_key() if self._fused.needs_rng
                else _null_key())
         self._num_update += 1
@@ -724,7 +842,8 @@ class ModuleStepper:
         self._synced = True
 
 
-def module_stepper(module, compute_dtype=None, donate=True):
+def module_stepper(module, compute_dtype=None, donate=True, mesh=None,
+                   sharding=None):
     """Build a :class:`ModuleStepper` for ``module``, or return None.
 
     Eligibility is conservative — anything the fused program cannot
@@ -734,9 +853,28 @@ def module_stepper(module, compute_dtype=None, donate=True):
     no ctx-group placement / multi-context mesh / module states, and an
     optimizer with a functional rule. ``MXTPU_FUSED_STEP=0`` disables
     the fused path globally.
+
+    ``mesh``/``sharding`` run the module's whole-step program SPMD over
+    a named mesh (batch over ``data``, params by the plan's rules, ZeRO
+    weight-update sharding per the plan): data-parallel Module training
+    with no kvstore. The module's bound batch is the GLOBAL batch and
+    must divide over the data axis.
     """
     if not getenv("MXTPU_FUSED_STEP", 1, int):
         return None
+    if sharding is not None and mesh is None:
+        mesh = sharding.mesh
+    if mesh is not None:
+        from ..parallel.sharding import ShardingPlan, divisibility_error
+        if sharding is None:
+            sharding = ShardingPlan(mesh)
+        dsize = mesh.shape.get(sharding.data_axis, 1)
+        if dsize > 1 and module.binded:
+            for desc in (module._data_shapes or []) + \
+                    (module._label_shapes or []):
+                if desc.shape and desc.shape[0] % dsize:
+                    raise divisibility_error(desc.shape[0], desc.name,
+                                             sharding.data_axis, dsize)
     if not (module.binded and module.params_initialized
             and module.optimizer_initialized):
         return None
@@ -775,7 +913,8 @@ def module_stepper(module, compute_dtype=None, donate=True):
                           input_shapes={n: tuple(v.shape)
                                         for n, v in all_arrs},
                           input_dtypes={n: str(v.dtype)
-                                        for n, v in all_arrs})
+                                        for n, v in all_arrs},
+                          mesh=mesh, sharding=sharding)
         stepper = ModuleStepper(module, fused, frozen)
     except MXNetError:
         return None
@@ -799,17 +938,45 @@ class FusedOptimizerApply:
     are pre-multiplied by the dynamic ``rescale`` input, so per-step
     rescale changes (Gluon's ``scale / batch_size``) never retrace; lr /
     wd / t are traced vectors for the same reason.
+
+    ``mesh``/``sharding`` arm the plan's ZeRO mode for this update
+    (arxiv 2004.13336 applied at the Gluon seam): each optimizer-state
+    slot lives as a 1/N slice over the ``data`` axis, the gradient is
+    pinned to the same slice layout before the update, and the updated
+    weight is constrained back to replicated — the all-gather runs
+    inside the one donated program. Weights keep the reference's
+    single-logical-copy semantics; only the update math + state shard.
     """
 
-    def __init__(self, optimizer, name="fused-update", donate=True):
+    def __init__(self, optimizer, name="fused-update", donate=True,
+                 mesh=None, sharding=None):
         self._opt = optimizer
         self._kind = type(optimizer).__name__.lower()
         if not has_functional_update(optimizer):
             raise MXNetError(
                 f"optimizer {self._kind!r} has no functional rule")
+        if sharding is not None and mesh is None:
+            mesh = sharding.mesh
+        if mesh is not None and sharding is None:
+            from ..parallel.sharding import ShardingPlan
+            sharding = ShardingPlan(mesh)
+        self.plan = sharding
+        plan = sharding if (sharding is not None and sharding.zero) else None
         self._init_state, update = functional_update(optimizer,
                                                      rescale_override=1.0)
         self.guard = CompileGuard(name, expected=1)
+        if plan is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel.sharding import zero_shard_spec
+
+            def _zsh(v):
+                # Gluon params are anonymous at this seam (indexed, not
+                # named), so ZeRO slices by shape over a replicated base
+                return NamedSharding(plan.mesh, zero_shard_spec(
+                    PartitionSpec(), v.shape, plan.mesh, plan.data_axis))
+
+            _repl = NamedSharding(plan.mesh, PartitionSpec())
 
         def apply(ws, gs, ss, lrs, wds, ts, rescale):
             new_ws, new_ss = [], []
@@ -817,7 +984,19 @@ class FusedOptimizerApply:
                 # rescale in the gradient's own dtype: the imperative op
                 # multiplies by a weak python float, which never promotes
                 g = g * rescale.astype(g.dtype)
+                if plan is not None:
+                    # ZeRO: the update consumes grad/state slices; the
+                    # updated weight all-gathers back inside the program
+                    g = jax.lax.with_sharding_constraint(g, _zsh(g))
+                    s = jax.tree_util.tree_map(
+                        lambda x: jax.lax.with_sharding_constraint(
+                            x, _zsh(x)), s)
                 w2, s2 = update(w, g, s, lrs[i], wds[i], ts[i])
+                if plan is not None:
+                    w2 = jax.lax.with_sharding_constraint(w2, _repl)
+                    s2 = jax.tree_util.tree_map(
+                        lambda x: jax.lax.with_sharding_constraint(
+                            x, _zsh(x)), s2)
                 new_ws.append(w2)
                 new_ss.append(s2)
             return new_ws, new_ss
@@ -833,7 +1012,9 @@ class FusedOptimizerApply:
             self.guard.wrap(apply), kind="fused-update",
             # rescale=1.0: this apply pre-multiplies the gradient by the
             # dynamic rescale input, so the baked value is always 1.0
-            key_parts=(optimizer_signature(optimizer, rescale=1.0),),
+            key_parts=(optimizer_signature(optimizer, rescale=1.0),
+                       "plan=" + ("-" if self.plan is None
+                                  else self.plan.signature_hash())),
             donate_argnums=(0, 2) if donate else (),
             on_materialize=materialized)
 

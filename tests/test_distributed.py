@@ -49,8 +49,9 @@ _WORKER = textwrap.dedent("""
 
     # global mesh spans both processes; a sharded psum sees every device
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel.compat import shard_map
     mesh = dist.global_mesh({"world": 4})
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda x: jax.lax.psum(x, "world"), mesh=mesh,
         in_specs=P(), out_specs=P(), check_vma=False),
         in_shardings=NamedSharding(mesh, P()),

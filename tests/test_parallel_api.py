@@ -17,15 +17,15 @@ from mxnet_tpu.parallel import (SPMDTrainer, make_mesh, mesh_scope,
                                 pipeline_from_symbol)
 
 
-# jax.shard_map (the public API parallel/'s manual-SPMD paths target)
-# is absent from this container's jax build — these 8 tests are
-# pre-existing seed failures (CHANGES.md PR 2/PR 5 notes, verified via
-# git-stash A/B); skip with a reason instead of carrying known-F noise,
-# the same pattern PR 2 used for test_two_process_group
+# the manual-SPMD paths run through parallel/compat.shard_map, which
+# adapts to either jax.shard_map (new API) or
+# jax.experimental.shard_map (the 0.4.x line) — skip only when a build
+# carries neither
+from mxnet_tpu.parallel.compat import has_shard_map
+
 requires_shard_map = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="jax.shard_map missing in this jax build (pre-existing seed "
-           "failure; runs where jax ships the public shard_map API)")
+    not has_shard_map(),
+    reason="no shard_map implementation in this jax build")
 
 
 def _manual_attention(q, k, v, num_heads, causal):

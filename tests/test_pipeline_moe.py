@@ -8,15 +8,15 @@ from mxnet_tpu.parallel import make_mesh
 from mxnet_tpu.parallel.moe import moe_apply, top1_router
 from mxnet_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
-# every test in this file drives pipeline/moe paths built on the public
-# jax.shard_map API, absent from this container's jax build — these 8
-# are pre-existing seed failures (CHANGES.md PR 5 note, verified via
-# git-stash A/B); skip with a reason instead of carrying known-F noise,
-# the same pattern PR 2 used for test_two_process_group
+# every test in this file drives pipeline/moe paths that run through
+# parallel/compat.shard_map, which adapts to either jax.shard_map (new
+# API) or jax.experimental.shard_map (the 0.4.x line) — skip only when
+# a build carries neither
+from mxnet_tpu.parallel.compat import has_shard_map
+
 pytestmark = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="jax.shard_map missing in this jax build (pre-existing seed "
-           "failure; runs where jax ships the public shard_map API)")
+    not has_shard_map(),
+    reason="no shard_map implementation in this jax build")
 
 
 def _stage(params, h):
