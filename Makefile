@@ -113,6 +113,17 @@ ci-serving: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
 	    -m 'not slow' -x -q
 
+# stage 9b: continuous-batching smoke — under MXTPU_RETRACE_STRICT=1,
+# concurrent submitters coalesce into measurably fewer dispatches than
+# requests, LSTM decode slots join/leave the running batch mid-flight
+# with outputs bitwise-equal to sequential execution, and zero live
+# compiles anywhere in the batched path (docs/how_to/serving.md)
+ci-batching: ci-native
+	timeout -k 10 180 env JAX_PLATFORMS=cpu MXTPU_RETRACE_STRICT=1 \
+	    python ci/batching_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_batching.py \
+	    -m 'not slow' -x -q
+
 # stage 10: data-pipeline chaos smoke — a short fit over deliberately
 # corrupted .rec shards with MXNET_TPU_FAULT_PLAN arming the io.open_shard/
 # io.read_record sites: the run must complete within the skip budget,
@@ -191,11 +202,11 @@ ci-multichip: ci-native
 	    -m 'not slow' -x -q
 
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
-    ci-frontends ci-dryrun ci-resilience ci-serving ci-data ci-perf \
-    ci-elastic ci-compiler ci-preempt ci-multichip
+    ci-frontends ci-dryrun ci-resilience ci-serving ci-batching ci-data \
+    ci-perf ci-elastic ci-compiler ci-preempt ci-multichip
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
-        ci-serving ci-data ci-perf ci-elastic ci-compiler ci-preempt \
-        ci-multichip
+        ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \
+        ci-preempt ci-multichip

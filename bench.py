@@ -12,9 +12,12 @@ Prints ONE json line: the ResNet-50 record (metric/value/unit/
 vs_baseline, as every prior round) with the LSTM record nested under
 ``lstm_train_tokens_per_sec``, the flagship-tier records nested under
 ``flash_attention`` / ``moe_dispatch``, the compiler tier under
-``compile_cache``, and the pod-scale tier under ``multichip``
+``compile_cache``, the pod-scale tier under ``multichip``
 (8-device ResNet-50 + LSTM throughput, 1→8 scaling, ZeRO
-optimizer-state bytes/chip — benchmarks/bench_multichip.py). Every
+optimizer-state bytes/chip — benchmarks/bench_multichip.py), and the
+serving tier under ``serving`` (continuous-batching requests/sec vs
+one-at-a-time at the same deadline + stateful decode tokens/sec —
+benchmarks/bench_serving.py). Every
 metric carries its own vs_best_recorded + regression flag against the
 best across recorded BENCH_r*.json rounds (new metrics self-seed on
 their first recorded round).
@@ -52,7 +55,7 @@ def best_recorded():
     round records them — this round seeds that history)."""
     best = {"resnet": 0.0, "lstm": LSTM_PRIOR_BEST,
             "flash_attention": 0.0, "moe_dispatch": 0.0,
-            "compile_cache": 0.0, "multichip": 0.0}
+            "compile_cache": 0.0, "multichip": 0.0, "serving": 0.0}
     here = os.path.dirname(os.path.abspath(__file__))
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         try:
@@ -66,7 +69,8 @@ def best_recorded():
                                 ("flash_attention", "flash_attention"),
                                 ("moe_dispatch", "moe_dispatch"),
                                 ("compile_cache", "compile_cache"),
-                                ("multichip", "multichip")):
+                                ("multichip", "multichip"),
+                                ("serving", "serving")):
                 sub = rec.get(nested)
                 if isinstance(sub, dict):
                     best[key] = max(best[key],
@@ -168,6 +172,22 @@ def bench_multichip():
     return _mc.run(quiet=True)
 
 
+def bench_serving():
+    """Serving-throughput record (ISSUE 10): the same open-loop burst of
+    single-row ResNet requests through the same server with the batch
+    coalescer on (max_batch=16) vs off (one dispatch per request), both
+    inside the same per-request deadline, plus the stateful LSTM decode
+    tokens/sec with a mid-stream join/leave churn
+    (benchmarks/bench_serving.py). The guarded value is the batched
+    requests/sec; the acceptance contract (enforced absolutely in
+    main()) is speedup >= 3x, decode bitwise == sequential, and zero
+    retraces/unwarmed dispatch signatures."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving as _srv
+    return _srv.run(quiet=True)
+
+
 def bench_compile_cache():
     """compile_cold_start_s / cache_warm_start_s pair via two real
     subprocesses (benchmarks/bench_compile_cache.py); the guarded value
@@ -255,6 +275,23 @@ def main():
             or not zrec.get("allclose_vs_replicated", False))
         regressed |= mc["zero_contract_violation"]
         record["multichip"] = mc
+
+        # serving tier: continuous batching (ISSUE 10). The guarded
+        # value is batched requests/sec; the acceptance contract is
+        # absolute — the coalesced path must beat one-at-a-time >= 3x
+        # at the same deadline, stateful decode must be bitwise equal
+        # to sequential with zero retraces, and no dispatch may leave
+        # the warmed signature set — no matter what history says.
+        srv = bench_serving()
+        regressed |= _guard(srv, best["serving"])
+        dec = srv.get("decode", {})
+        srv["serving_contract_violation"] = bool(
+            float(srv.get("batched_speedup", 0.0)) < 3.0
+            or not dec.get("bitwise_vs_sequential", False)
+            or int(dec.get("retraces", 1)) != 0
+            or int(srv.get("unwarmed_signatures", 1)) != 0)
+        regressed |= srv["serving_contract_violation"]
+        record["serving"] = srv
 
     print(json.dumps(record))
     if regressed and os.environ.get("BENCH_ENFORCE"):

@@ -1,0 +1,166 @@
+"""Continuous-batching smoke stage (`make ci-batching`,
+docs/how_to/serving.md).
+
+Runs under ``MXTPU_RETRACE_STRICT=1`` — a single live-request compile
+anywhere in the batched serving path fails the stage — and asserts the
+two throughput contracts end to end, with real threads and a real
+clock (the deterministic fake-clock matrix lives in
+tests/test_batching.py):
+
+1. **coalescing**: concurrent submitters against a threaded server
+   merge into measurably fewer dispatches than requests — every result
+   still correct per request, every dispatch signature inside the
+   warmed set;
+2. **stateful in-flight decode**: LSTM sequences join and leave the
+   running batch between decode steps (a real Module through
+   ``as_decode_backend``), outputs bitwise-equal to each sequence
+   decoded alone, zero retraces.
+
+The whole script is further bounded by `timeout` in the Makefile, so a
+regression that reintroduces a hang fails the stage instead of wedging
+the runner.
+"""
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.serving import (CallableBackend, InferenceServer,  # noqa: E402
+                               InflightBatcher)
+
+SUBMITTERS = 6
+PER_SUBMITTER = 8
+MAX_BATCH = 8
+
+
+def smoke_coalescing():
+    """Concurrent submitters -> coalesced dispatches < request count."""
+    import jax
+    import jax.numpy as jnp
+
+    fwd = jax.jit(lambda x: x * 2.0)
+
+    def backend_fn(arrays):
+        out = np.asarray(fwd(jnp.asarray(arrays["data"])))
+        time.sleep(0.01)   # service time, so a burst piles the queue
+        return [out]
+
+    server = InferenceServer(
+        CallableBackend(backend_fn, input_specs={"data": (16,)}),
+        name="batching-smoke", max_batch=MAX_BATCH, batch_wait=0.005,
+        workers=1, capacity=64, default_deadline=30.0)
+    server.warm_up()
+    assert server.readyz()["ready"], server.readyz()
+
+    n = SUBMITTERS * PER_SUBMITTER
+    errors = []
+
+    def submitter(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(PER_SUBMITTER):
+                x = rng.rand(1, 16).astype(np.float32)
+                out = server.result(server.submit({"data": x}))
+                np.testing.assert_array_equal(out[0], x * 2.0)
+        except Exception as err:   # noqa: BLE001 — re-raised below
+            errors.append(err)
+
+    threads = [threading.Thread(target=submitter, args=(i,))
+               for i in range(SUBMITTERS)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    wall = time.monotonic() - t0
+    if errors:
+        raise errors[0]
+
+    stats = server.stats()
+    server.close()
+    assert stats["completed"] == n, stats
+    assert stats["dispatches"] < n, (
+        f"no coalescing: {stats['dispatches']} dispatches for {n} "
+        f"requests")
+    assert stats["coalesced_requests"] > 0, stats
+    assert stats["batching"]["unwarmed_dispatch_signatures"] == 0, (
+        "a live dispatch left the warmed signature set")
+    print(f"coalescing ok: {n} requests in {stats['dispatches']} "
+          f"dispatches ({wall:.2f}s wall, strict retrace mode)")
+
+
+def _lstm_batcher(capacity, dim, hidden, name):
+    """A real LSTM decode step, identically initialized per call."""
+    x = mx.sym.Variable("data")
+    h = mx.sym.Variable("h")
+    c = mx.sym.Variable("c")
+    cell = mx.rnn.LSTMCell(hidden, prefix="dec_")
+    out, (nh, nc) = cell(x, [h, c])
+    logits = mx.sym.FullyConnected(out, name="proj", num_hidden=8)
+    mod = mx.mod.Module(mx.sym.Group([logits, nh, nc]),
+                        data_names=["data", "h", "c"],
+                        label_names=[], context=mx.cpu())
+    mod.bind(data_shapes=[("data", (capacity, dim)),
+                          ("h", (capacity, hidden)),
+                          ("c", (capacity, hidden))],
+             label_shapes=None, for_training=False)
+    mx.random.seed(11)
+    mod.init_params(mx.init.Xavier())
+    return InflightBatcher(mod.as_decode_backend(["h", "c"]),
+                           name=name).warm_up()
+
+
+def smoke_inflight_decode():
+    """Slots join/leave mid-flight, bitwise == sequential, 0 retraces."""
+    capacity, dim, hidden = 4, 6, 16
+    rng = np.random.RandomState(3)
+    tokens = {name: [rng.rand(dim).astype(np.float32) for _ in range(4)]
+              for name in "ABC"}
+
+    b = _lstm_batcher(capacity, dim, hidden, "decode-smoke")
+    got = {name: [] for name in "ABC"}
+    slot = {"A": b.join(), "B": b.join()}
+    for t in range(2):                       # A, B in flight
+        outs = b.step({slot[n]: {"data": tokens[n][t]} for n in "AB"})
+        for n in "AB":
+            got[n].append(outs[slot[n]][0])
+    b.leave(slot["A"])                       # A leaves mid-flight
+    slot["C"] = b.join()                     # C joins the running batch
+    for t in range(2):
+        outs = b.step({slot[n]: {"data": tokens[n][t + 2 if n == "B"
+                                                   else t]}
+                       for n in "BC"})
+        for n in "BC":
+            got[n].append(outs[slot[n]][0])
+    stats = b.stats()
+    assert stats["retraced"] is False, stats
+    assert stats["steps"] == 4 and stats["tokens"] == 8, stats
+
+    # sequential reference: each sequence decoded alone, fresh batcher
+    for name, n_steps in (("A", 2), ("B", 4), ("C", 2)):
+        solo = _lstm_batcher(capacity, dim, hidden, f"decode-ref-{name}")
+        s = solo.join()
+        for t in range(n_steps):
+            out = solo.step({s: {"data": tokens[name][t]}})[s][0]
+            np.testing.assert_array_equal(out, got[name][t])
+    print(f"in-flight decode ok: join/leave mid-flight bitwise == "
+          f"sequential, {stats['steps']} steps, 0 retraces")
+
+
+def main():
+    assert os.environ.get("MXTPU_RETRACE_STRICT") == "1", \
+        "run me under MXTPU_RETRACE_STRICT=1 (the Makefile stage does)"
+    smoke_coalescing()
+    smoke_inflight_decode()
+    print("batching smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,9 @@ def main():
 
     breaker = CircuitBreaker(window=8, min_calls=3, failure_rate=0.6,
                              cooldown=0.2, probes=1)
-    server = InferenceServer(CallableBackend(slowish), buckets=[4],
+    server = InferenceServer(CallableBackend(slowish,
+                                             input_specs={"data": (3,)}),
+                             buckets=[4],
                              capacity=3, workers=1, breaker=breaker,
                              default_deadline=10.0, name="smoke")
     server.warm_up()

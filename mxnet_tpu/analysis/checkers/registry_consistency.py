@@ -35,8 +35,9 @@ FAULTS_PY = "mxnet_tpu/resilience/faults.py"
 # their own subsystems rather than growing the training-side files
 # forever.
 FAULT_TESTS = ("tests/test_resilience.py", "tests/test_serving.py",
-               "tests/test_resilience_data.py", "tests/test_elastic.py",
-               "tests/test_compiler.py", "tests/test_supervisor.py")
+               "tests/test_batching.py", "tests/test_resilience_data.py",
+               "tests/test_elastic.py", "tests/test_compiler.py",
+               "tests/test_supervisor.py")
 FAULT_DOCS = ("docs/how_to/fault_tolerance.md", "docs/how_to/serving.md",
               "docs/how_to/data_resilience.md",
               "docs/how_to/elastic_training.md",

@@ -31,8 +31,8 @@ from typing import Dict
 from . import aot, cache, fingerprint, ir, passes  # noqa: F401
 from .aot import PersistentJit, ProgramRegistry  # noqa: F401
 from .cache import CompilationCache, cache_enabled, default_cache  # noqa: F401
-from .fingerprint import (code_salt, graph_fingerprint,  # noqa: F401
-                          mesh_signature, program_key)
+from .fingerprint import (batch_signature, code_salt,  # noqa: F401
+                          graph_fingerprint, mesh_signature, program_key)
 from .ir import GraphIR  # noqa: F401
 from .passes import (Annotate, CommonSubexpressionElimination,  # noqa: F401
                      DeadOpElimination, OptimizeResult, Pass, PassContext,
@@ -44,7 +44,7 @@ __all__ = ["ir", "passes", "fingerprint", "cache", "aot", "GraphIR",
            "DeadOpElimination", "CommonSubexpressionElimination",
            "RematPolicy", "Annotate", "register_annotator",
            "default_pass_manager", "optimize", "graph_fingerprint",
-           "code_salt", "mesh_signature", "program_key",
+           "code_salt", "mesh_signature", "batch_signature", "program_key",
            "CompilationCache", "default_cache", "cache_enabled",
            "PersistentJit", "ProgramRegistry", "stats", "reset_stats"]
 

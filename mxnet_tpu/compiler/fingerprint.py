@@ -30,8 +30,8 @@ import os
 from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = ["canonical_graph", "graph_fingerprint", "code_salt",
-           "mesh_signature", "aval_signature", "program_key",
-           "optimizer_signature"]
+           "mesh_signature", "aval_signature", "batch_signature",
+           "program_key", "optimizer_signature"]
 
 
 def canonical_graph(symbol) -> dict:
@@ -180,6 +180,23 @@ def _leaf_sig(x) -> str:
                 sh, "device_set") else None
             shsig = f"dev{getattr(dev, 'id', '?')}"
     return f"{shape}:{dtype}:w{int(weak)}:{shsig}"
+
+
+def batch_signature(arrays: Dict, route: str = "primary") -> str:
+    """Canonical signature of one batched-dispatch feed: sorted
+    ``name=shape:dtype`` pairs plus the routing leg (primary/fallback).
+
+    The serving coalescer keys its :class:`~mxnet_tpu.perf.CompileGuard`
+    and its warm-up contract on this — the SAME shape/dtype
+    canonicalization (:func:`_leaf_sig`) that joins avals into the
+    persisted :func:`program_key`, so "warmed" in the serving tier and
+    "cached" in the compilation tier can never disagree about what a
+    shape is. Two batches with equal signatures are guaranteed to reuse
+    one compiled program; a signature outside the warmed set is exactly
+    a cold compile."""
+    parts = [f"{name}={_leaf_sig(arr)}"
+             for name, arr in sorted(arrays.items())]
+    return f"{route}|" + ";".join(parts)
 
 
 def aval_signature(args: Sequence, static_argnums: Sequence[int] = ()):

@@ -130,3 +130,18 @@ register_knob("MXTPU_CRASH_BACKOFF_BASE", float, 1.0,
               "repeat attempt)")
 register_knob("MXTPU_CRASH_BACKOFF_CAP", float, 60.0,
               "upper bound on one crash-loop resume backoff, seconds")
+register_knob("MXTPU_MAX_BATCH", int, 1,
+              "total rows one coalesced serving dispatch may carry "
+              "(mxnet_tpu/serving/batching.py) — 1 disables continuous "
+              "batching; warm-up then pre-traces every bucket at 1, "
+              "max, and the powers of two between")
+register_knob("MXTPU_BATCH_WAIT_MS", float, 2.0,
+              "milliseconds a threaded serving worker may hold the "
+              "first request open for more traffic to coalesce "
+              "(bounded by every member's remaining deadline; the "
+              "deterministic workers=0 mode never waits)")
+register_knob("MXTPU_TENANT_QUOTAS", str, None,
+              "per-tenant serving admission quotas + fair-share "
+              "weights: 'name:quota[:weight],...' (quota '*' = "
+              "unbounded) or JSON {name: {quota, weight}} — unset "
+              "disables quotas (docs/how_to/serving.md)")

@@ -236,6 +236,15 @@ class BaseModule:
         from ..serving.backends import ModuleBackend
         return ModuleBackend(self, input_name=input_name)
 
+    def as_decode_backend(self, state_names):
+        """Adapt this bound module as one *stateful decode step* for the
+        in-flight batcher (:class:`mxnet_tpu.serving.InflightBatcher`):
+        ``state_names`` are the data inputs carrying per-slot recurrent
+        state, and the symbol's last ``len(state_names)`` outputs are
+        the next states in the same order (docs/how_to/serving.md)."""
+        from ..serving.slots import ModuleStepBackend
+        return ModuleStepBackend(self, state_names)
+
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
         if reset:

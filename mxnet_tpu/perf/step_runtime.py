@@ -37,6 +37,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import logging
+import threading
 import warnings
 from typing import Dict, List, Optional, Sequence
 
@@ -195,21 +196,74 @@ class CompileGuard:
         self.expected = expected
         self._initial_expected = expected
         self.count = 0
+        self._signatures = set()
+        # observe()/expect() are called from concurrent serving worker
+        # threads; unlocked check-then-add and count += would lose
+        # compiles exactly when the strict budget matters (re-entrant:
+        # observe holds it across _record_compile)
+        self._guard_lock = threading.RLock()
+
+    def _record_compile(self):
+        """Count one compile; past the budget, warn — or raise under
+        ``MXTPU_RETRACE_STRICT=1``."""
+        with self._guard_lock:
+            self.count += 1
+            over = self.count > self.expected
+            n = self.count
+        if over:
+            msg = (f"CompileGuard[{self.name}]: compile #{n} "
+                   f"(expected {self.expected}) — the step is "
+                   "retracing; check input shapes/dtypes for drift")
+            if getenv("MXTPU_RETRACE_STRICT", 0, int):
+                raise MXNetError(msg)
+            logging.warning(msg)
 
     def wrap(self, fn):
         @functools.wraps(fn)
         def counted(*args, **kwargs):
-            self.count += 1
-            if self.count > self.expected:
-                msg = (f"CompileGuard[{self.name}]: compile #{self.count} "
-                       f"(expected {self.expected}) — the step is "
-                       "retracing; check input shapes/dtypes for drift")
-                if getenv("MXTPU_RETRACE_STRICT", 0, int):
-                    raise MXNetError(msg)
-                logging.warning(msg)
+            self._record_compile()
             return fn(*args, **kwargs)
 
         return counted
+
+    def observe(self, signature) -> bool:
+        """Count a *new* dispatch signature as one compile.
+
+        For callers that cannot wrap the jitted body — the serving
+        batched dispatch, whose compiles happen inside a backend's own
+        executors — each distinct (shape, dtype) signature stands in
+        for one trace-cache miss: the first sighting counts against the
+        budget (and trips the strict/warn machinery exactly like a
+        wrapped compile), repeats are the steady-state cache hit.
+        ``expect(sig)`` pre-registers warm-up signatures as both seen
+        and budgeted. Returns True when the signature was new."""
+        with self._guard_lock:
+            if signature in self._signatures:
+                return False
+            self._signatures.add(signature)
+            try:
+                self._record_compile()
+            except MXNetError:
+                # the strict raise aborts the caller's dispatch: no
+                # compile actually happened, so BOTH the signature and
+                # the count roll back — a retry raises again instead of
+                # silently cold-compiling past the guard, and rejected
+                # dispatches do not inflate the compile stats
+                self._signatures.discard(signature)
+                self.count -= 1
+                raise
+            return True
+
+    def expect(self, signature) -> bool:
+        """Pre-register a warm-up signature: seen AND budgeted — a live
+        dispatch repeating it is free, anything else is a retrace."""
+        with self._guard_lock:
+            if signature in self._signatures:
+                return False
+            self._signatures.add(signature)
+            self.count += 1
+            self.expected = max(self.expected, self.count)
+            return True
 
     def rebind(self):
         """Start a new program lifetime: the next compile is *expected*.
@@ -222,8 +276,10 @@ class CompileGuard:
         its construction-time value: ``expected`` bumps granted to the
         OLD program (extra deliberate lowers, signature changes) do
         not carry over as slack the new program could retrace into."""
-        self.count = 0
-        self.expected = self._initial_expected
+        with self._guard_lock:
+            self.count = 0
+            self.expected = self._initial_expected
+            self._signatures.clear()
 
     @property
     def retraced(self) -> bool:

@@ -31,21 +31,32 @@ Five pillars:
 """
 from __future__ import annotations
 
-from . import admission, backends, breaker, errors, server, warmup  # noqa: F401
-from .admission import AdmissionQueue, Deadline, Request  # noqa: F401
+from . import (admission, backends, batching, breaker, errors,  # noqa: F401
+               server, slots, warmup)
+from .admission import (AdmissionQueue, Deadline, Request,  # noqa: F401
+                        TenantPolicy)
 from .backends import (CallableBackend, ModuleBackend,  # noqa: F401
                        PredictorBackend)
+from .batching import BatchCoalescer, request_signature  # noqa: F401
 from .breaker import CircuitBreaker  # noqa: F401
-from .errors import (CircuitOpen, DeadlineExceeded, Draining,  # noqa: F401
-                     QueueFull, ServerClosed, ServingError)
+from .errors import (BatchFailed, CircuitOpen, DeadlineExceeded,  # noqa: F401
+                     Draining, QueueFull, QuotaExceeded, RequestTooLarge,
+                     ServerClosed, ServingError, SlotsFull,
+                     UnwarmedSignature)
 from .server import InferenceServer, endpoint_stats, endpoints  # noqa: F401
-from .warmup import ShapeBuckets  # noqa: F401
+from .slots import (CallableStepBackend, InflightBatcher,  # noqa: F401
+                    ModuleStepBackend, SlotTable)
+from .warmup import ShapeBuckets, coalescer_sizes  # noqa: F401
 
 __all__ = ["InferenceServer", "AdmissionQueue", "Deadline", "Request",
-           "CircuitBreaker", "ShapeBuckets", "CallableBackend",
-           "PredictorBackend", "ModuleBackend", "ServingError",
-           "QueueFull", "DeadlineExceeded", "CircuitOpen", "ServerClosed",
-           "Draining", "endpoints", "endpoint_stats", "stats"]
+           "TenantPolicy", "CircuitBreaker", "ShapeBuckets",
+           "coalescer_sizes", "BatchCoalescer", "request_signature",
+           "SlotTable", "InflightBatcher", "CallableStepBackend",
+           "ModuleStepBackend", "CallableBackend", "PredictorBackend",
+           "ModuleBackend", "ServingError", "QueueFull",
+           "DeadlineExceeded", "CircuitOpen", "ServerClosed", "Draining",
+           "QuotaExceeded", "BatchFailed", "SlotsFull", "RequestTooLarge",
+           "UnwarmedSignature", "endpoints", "endpoint_stats", "stats"]
 
 
 def stats() -> dict:

@@ -1,4 +1,4 @@
-"""Admission control: deadlines, requests, and the bounded queue.
+"""Admission control: deadlines, requests, tenants, and the bounded queue.
 
 The queue is the only place a request may wait, and it is bounded:
 beyond ``capacity`` the runtime *sheds* — either the new arrival
@@ -6,6 +6,16 @@ beyond ``capacity`` the runtime *sheds* — either the new arrival
 (``policy='evict-oldest'``, which favours fresh traffic whose deadline
 still has budget). Shedding is immediate (:class:`~.errors.QueueFull`),
 so burst overload degrades to fast-fail instead of unbounded latency.
+
+Requests carry a ``tenant`` and a ``priority``. Eviction respects
+priority strictly: the victim is the *oldest among the lowest-priority*
+queued requests, and a strictly-higher-priority request is never
+evicted while a lower-priority one is queued — an arrival that would
+require that is itself shed instead. Dequeue order is priority-strict
+too, with **weighted fair** selection between tenants at the same
+priority (stride scheduling over :class:`TenantPolicy` weights), FIFO
+within a tenant. Per-tenant quotas cap how much of the queue one tenant
+may hold (:class:`~.errors.QuotaExceeded`, retriable).
 
 Deadlines are absolute timestamps on an injectable clock
 (``expires_at = clock() + budget``), so tests drive every expiry path —
@@ -19,15 +29,21 @@ path, then admission proceeds exactly once.
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
 from collections import deque
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
+from ..base import MXNetError
 from ..resilience import guarded_point
-from .errors import DeadlineExceeded, QueueFull, ServerClosed
+from .errors import (DeadlineExceeded, QueueFull, QuotaExceeded,
+                     ServerClosed)
 
-__all__ = ["Deadline", "Request", "AdmissionQueue"]
+__all__ = ["Deadline", "Request", "AdmissionQueue", "TenantPolicy",
+           "DEFAULT_TENANT"]
+
+DEFAULT_TENANT = "default"
 
 
 class Deadline:
@@ -57,15 +73,23 @@ class Request:
     """One in-flight inference request: inputs + deadline + a settable
     result slot the caller waits on. States: queued -> running -> done.
     ``abandon()`` is the caller-side watchdog giving up — a late result
-    from a wedged worker is then discarded, never delivered."""
+    from a wedged worker is then discarded, never delivered.
+
+    ``tenant``/``priority`` feed admission accounting and dequeue order;
+    ``rows`` is the leading-axis size of the inputs, what the batch
+    coalescer budgets against ``MXTPU_MAX_BATCH``."""
 
     __slots__ = ("inputs", "deadline", "use_fallback", "state", "worker",
-                 "enqueued_at", "_event", "_value", "_error", "_lock")
+                 "enqueued_at", "tenant", "priority", "_event", "_value",
+                 "_error", "_lock", "_sig")
 
-    def __init__(self, inputs, deadline: Deadline, use_fallback=False):
+    def __init__(self, inputs, deadline: Deadline, use_fallback=False,
+                 tenant: str = DEFAULT_TENANT, priority: int = 0):
         self.inputs = inputs
         self.deadline = deadline
         self.use_fallback = use_fallback
+        self.tenant = tenant
+        self.priority = int(priority)
         self.state = "queued"
         self.worker = None
         self.enqueued_at = deadline.clock()
@@ -73,6 +97,19 @@ class Request:
         self._value = None
         self._error = None
         self._lock = threading.Lock()
+        self._sig = None              # batching.request_signature cache
+
+    @property
+    def rows(self) -> int:
+        """Leading-axis rows of the inputs (1 when unknown/scalar)."""
+        if isinstance(self.inputs, dict):
+            for batch in self.inputs.values():
+                shape = getattr(batch, "shape", None)
+                if shape:
+                    return int(shape[0])
+            return 1
+        shape = getattr(self.inputs, "shape", None)
+        return int(shape[0]) if shape else 1
 
     def complete(self, value) -> bool:
         """Deliver a result; False if the caller already abandoned."""
@@ -118,19 +155,113 @@ class Request:
         return self._event.is_set()
 
 
+class TenantPolicy:
+    """Per-tenant admission quotas and fair-share weights.
+
+    ``quota`` bounds how many requests a tenant may hold queued at once
+    (None = unbounded); ``weight`` scales its share of the dequeue
+    bandwidth at equal priority (stride scheduling: a weight-2 tenant is
+    picked twice as often as a weight-1 tenant under contention).
+    Unlisted tenants get the ``default_quota``/``default_weight``.
+
+    Parsed from ``MXTPU_TENANT_QUOTAS`` by :meth:`parse`, either the
+    compact form ``"name:quota[:weight],..."`` (quota ``*`` = unbounded)
+    or a JSON object ``{"name": {"quota": n, "weight": w}, ...}``.
+    """
+
+    def __init__(self, tenants: Optional[Dict[str, Dict]] = None,
+                 default_quota: Optional[int] = None,
+                 default_weight: float = 1.0):
+        self._tenants: Dict[str, Dict] = {}
+        self.default_quota = default_quota
+        self.default_weight = float(default_weight)
+        for name, spec in (tenants or {}).items():
+            quota = spec.get("quota")
+            weight = float(spec.get("weight", default_weight))
+            if quota is not None and int(quota) < 1:
+                raise MXNetError(
+                    f"tenant {name!r}: quota must be >= 1 or None/'*' "
+                    f"(got {quota!r})")
+            if weight <= 0:
+                raise MXNetError(
+                    f"tenant {name!r}: weight must be > 0 (got {weight!r})")
+            self._tenants[name] = {"quota": (None if quota is None
+                                             else int(quota)),
+                                   "weight": weight}
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> Optional["TenantPolicy"]:
+        """Build a policy from the ``MXTPU_TENANT_QUOTAS`` string; None
+        or empty disables tenant quotas (weights default to 1)."""
+        if not spec or not spec.strip():
+            return None
+        spec = spec.strip()
+        if spec.startswith("{"):
+            try:
+                table = json.loads(spec)
+            except ValueError as err:
+                raise MXNetError(
+                    f"malformed MXTPU_TENANT_QUOTAS JSON: {err}") from err
+            if not isinstance(table, dict) or not all(
+                    isinstance(v, dict) for v in table.values()):
+                raise MXNetError(
+                    "MXTPU_TENANT_QUOTAS JSON must map tenant name -> "
+                    '{"quota": n|null, "weight": w}')
+            return cls(table)
+        tenants: Dict[str, Dict] = {}
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            parts = item.split(":")
+            if len(parts) not in (2, 3) or not parts[0]:
+                raise MXNetError(
+                    f"malformed MXTPU_TENANT_QUOTAS entry {item!r}; "
+                    f"expected name:quota[:weight]")
+            try:
+                quota = None if parts[1] in ("*", "") else int(parts[1])
+                weight = float(parts[2]) if len(parts) == 3 else 1.0
+            except ValueError as err:
+                raise MXNetError(
+                    f"malformed MXTPU_TENANT_QUOTAS entry {item!r}: "
+                    f"{err}") from err
+            tenants[parts[0]] = {"quota": quota, "weight": weight}
+        return cls(tenants)
+
+    def quota(self, tenant: str) -> Optional[int]:
+        spec = self._tenants.get(tenant)
+        return spec["quota"] if spec else self.default_quota
+
+    def weight(self, tenant: str) -> float:
+        spec = self._tenants.get(tenant)
+        return spec["weight"] if spec else self.default_weight
+
+    def tenants(self) -> Dict[str, Dict]:
+        return {name: dict(spec) for name, spec in self._tenants.items()}
+
+
 class AdmissionQueue:
-    """Bounded FIFO between submitters and workers.
+    """Bounded queue between submitters and workers.
 
     ``offer`` never blocks: at capacity it sheds (per policy) instead.
     ``take`` blocks until an item arrives or the queue is closed (then
     returns None); ``poll`` is the non-blocking variant that drives the
-    deterministic ``workers=0`` mode.
+    deterministic ``workers=0`` mode. Dequeue order: highest priority
+    first; at equal priority, weighted-fair across tenants (stride
+    scheduling over ``tenants`` weights), FIFO within a tenant — plain
+    FIFO when neither priorities nor tenant weights are in play.
+
+    ``on_tenant_event(tenant, key, n)`` is the server's per-tenant
+    counter hook: the queue credits expirations and evictions to the
+    owning tenant through it (one counter surface, owned by the server).
     """
 
     POLICIES = ("reject", "evict-oldest")
 
     def __init__(self, capacity: int = 64, policy: str = "reject",
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 tenants: Optional[TenantPolicy] = None,
+                 on_tenant_event: Optional[Callable] = None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         if policy not in self.POLICIES:
@@ -138,8 +269,11 @@ class AdmissionQueue:
         self.capacity = capacity
         self.policy = policy
         self.clock = clock
+        self.tenants = tenants
+        self._on_tenant_event = on_tenant_event or (lambda *a, **k: None)
         self._items: deque = deque()
         self._cv = threading.Condition()
+        self._vtime: Dict[str, float] = {}   # stride-scheduling clocks
         self.open = True
         self.admitted = 0
         self.shed = 0
@@ -151,11 +285,28 @@ class AdmissionQueue:
 
     depth = __len__
 
+    # -- enqueue (with priority-safe shedding) -------------------------------
+
+    def _victim_index(self) -> int:
+        """Oldest among the lowest-priority queued requests — eviction
+        must never take a strictly-higher-priority request while a
+        lower-priority one is queued (the starvation fix)."""
+        low = min(r.priority for r in self._items)
+        for i, req in enumerate(self._items):
+            if req.priority == low:
+                return i
+        raise AssertionError("unreachable: queue emptied under the lock")
+
     def offer(self, req: Request) -> Optional[Request]:
         """Admit ``req`` or shed. Raises QueueFull when the request
         itself is rejected; with evict-oldest the *evicted* request is
         failed with QueueFull and the new one is admitted — the evicted
-        request is returned so the caller can account for it."""
+        request is returned so the caller can account for it. A new
+        arrival is also rejected (never admitted by eviction) when every
+        queued request outranks it: eviction strictly favours priority.
+        Tenant quotas are enforced HERE, under the queue lock — a
+        check outside it would let concurrent submitters race past the
+        bound (:class:`~.errors.QuotaExceeded`, retriable)."""
         guarded_point("serving.queue")
         evicted = None
         with self._cv:
@@ -163,46 +314,152 @@ class AdmissionQueue:
                 # closed != full: racing a shutdown must read as
                 # shutdown, not as retryable overload
                 raise ServerClosed("admission queue is closed")
+            if self.tenants is not None:
+                quota = self.tenants.quota(req.tenant)
+                if quota is not None and sum(
+                        1 for r in self._items
+                        if r.tenant == req.tenant) >= quota:
+                    raise QuotaExceeded(
+                        f"tenant {req.tenant!r} is at its admission "
+                        f"quota ({quota} queued); retry after earlier "
+                        f"requests complete")
             if len(self._items) >= self.capacity:
                 if self.policy == "reject":
                     self.shed += 1
                     raise QueueFull(
                         f"admission queue at capacity ({self.capacity}); "
                         f"request shed")
-                evicted = self._items.popleft()
+                idx = self._victim_index()
+                victim = self._items[idx]
+                if victim.priority > req.priority:
+                    # every queued request outranks the arrival: shed
+                    # the arrival, never the higher-priority work
+                    self.shed += 1
+                    raise QueueFull(
+                        f"admission queue at capacity ({self.capacity}) "
+                        f"with only higher-priority requests queued; "
+                        f"request shed")
+                del self._items[idx]
+                evicted = victim
                 self.shed += 1
                 self.evicted += 1
             self._items.append(req)
             self.admitted += 1
-            self._cv.notify()
+            # wake EVERY waiter class: a single notify could land on a
+            # gatherer in wait_arrival() that cannot use this request,
+            # leaving an idle take() worker asleep with work queued
+            self._cv.notify_all()
         if evicted is not None:
+            self._on_tenant_event(evicted.tenant, "evicted")
             evicted.fail(QueueFull(
                 f"shed from queue (evict-oldest, capacity "
                 f"{self.capacity}): a newer request took the slot"))
         return evicted
 
-    def take(self) -> Optional[Request]:
-        """Worker side: block for the next request; None once closed."""
+    # -- fair pick -----------------------------------------------------------
+
+    def _weight(self, tenant: str) -> float:
+        return self.tenants.weight(tenant) if self.tenants else 1.0
+
+    def _pick_locked(self) -> Optional[Request]:
+        """Highest priority first; weighted-fair across tenants at that
+        priority (stride scheduling: pick the smallest virtual time,
+        advance it by 1/weight); FIFO within a tenant. Without a
+        TenantPolicy, tenant labels carry no scheduling weight — the
+        pick is plain FIFO within the top priority, as documented."""
+        if not self._items:
+            return None
+        first = self._items[0]
+        if all(r.priority == first.priority and r.tenant == first.tenant
+               for r in self._items):
+            # fast path — also keeps single-tenant order byte-stable
+            # across the no-tenant and tenant-configured configurations
+            self._items.popleft()
+            return first
+        top = max(r.priority for r in self._items)
+        if self.tenants is None:
+            # no policy: labels are accounting metadata, not weights
+            for i, req in enumerate(self._items):
+                if req.priority == top:
+                    del self._items[i]
+                    return req
+
+        heads: Dict[str, int] = {}
+        for i, req in enumerate(self._items):
+            if req.priority == top and req.tenant not in heads:
+                heads[req.tenant] = i
+        # the floor is the INCUMBENTS' smallest clock: a tenant first
+        # seen now (or re-entering after idling/pruning) starts AT the
+        # floor — it gets its fair share from here on, never a monopoly
+        # refund of virtual time it did not spend waiting
+        existing = [self._vtime[t] for t in heads if t in self._vtime]
+        floor = min(existing) if existing else 0.0
+        tenant = min(heads, key=lambda t: (self._vtime.get(t, floor), t))
+        self._vtime[tenant] = (max(self._vtime.get(tenant, floor), floor)
+                               + 1.0 / self._weight(tenant))
+        if len(self._vtime) > 4 * max(16, len(self._items)):
+            # bound the map against client-invented tenant names: a
+            # tenant with nothing queued re-enters at the floor anyway
+            # (the documented idle rule), so its entry is droppable
+            queued = {r.tenant for r in self._items}
+            self._vtime = {t: v for t, v in self._vtime.items()
+                           if t in queued}
+        idx = heads[tenant]
+        req = self._items[idx]
+        del self._items[idx]
+        return req
+
+    def take(self, on_pop: Optional[Callable] = None) -> Optional[Request]:
+        """Worker side: block for the next request; None once closed.
+        ``on_pop`` runs on the popped request UNDER THE QUEUE LOCK,
+        before it is returned — the server counts the request in-flight
+        there, so a drain polling depth/in-flight can never catch it in
+        the gap between leaving the queue and being accounted."""
         with self._cv:
             while not self._items and self.open:
                 self._cv.wait()
-            if self._items:
-                return self._items.popleft()
-            return None
+            req = self._pick_locked()
+            if req is not None and on_pop is not None:
+                on_pop(req)
+            return req
 
     def poll(self) -> Optional[Request]:
         """Non-blocking take (drives the synchronous workers=0 mode)."""
         with self._cv:
-            if self._items:
-                return self._items.popleft()
+            return self._pick_locked()
+
+    def poll_compatible(self, predicate: Callable[[Request], bool]
+                        ) -> Optional[Request]:
+        """Pop the first queued request satisfying ``predicate`` (the
+        batch coalescer's merge scan). Skipped requests keep their
+        positions — coalescing pulls shape-mates out of line, everything
+        else is untouched."""
+        with self._cv:
+            for i, req in enumerate(self._items):
+                if predicate(req):
+                    del self._items[i]
+                    return req
             return None
+
+    def wait_arrival(self, since: int, timeout: float) -> int:
+        """Block until a NEW request is admitted (``admitted`` moves
+        past ``since``), the queue closes, or ``timeout`` elapses;
+        returns the current admitted count. The threaded coalescer's
+        wait-for-more-traffic step: keyed on arrivals, not non-empty,
+        so a backlog of merge-incompatible requests cannot busy-spin
+        the gathering worker — and the wait is real wall time, so an
+        injected non-advancing clock cannot wedge it either."""
+        with self._cv:
+            if self.admitted == since and self.open:
+                self._cv.wait(timeout)
+            return self.admitted
 
     def expire_queued(self) -> int:
         """Fail every queued request whose deadline has passed, freeing
         their capacity slots; returns how many expiries were *delivered*
         (already-abandoned requests are reclaimed but not re-counted).
-        Called on every submit so dead deadlines never crowd out live
-        traffic."""
+        Each expiry is credited to the owning tenant's counters. Called
+        on every submit so dead deadlines never crowd out live traffic."""
         expired = []
         with self._cv:
             live = deque()
@@ -217,6 +474,9 @@ class AdmissionQueue:
             if req.fail(DeadlineExceeded(
                     "deadline expired while waiting in queue "
                     f"(queued {req.deadline.clock() - req.enqueued_at:.3f}s)")):
+                # credited to the owning tenant only when delivered —
+                # the caller-side abandon path already counted the rest
+                self._on_tenant_event(req.tenant, "deadline_queued")
                 delivered += 1
         return delivered
 

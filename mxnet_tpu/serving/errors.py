@@ -15,7 +15,8 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "QueueFull", "DeadlineExceeded", "CircuitOpen",
-           "ServerClosed", "Draining"]
+           "ServerClosed", "Draining", "QuotaExceeded", "BatchFailed",
+           "SlotsFull", "RequestTooLarge", "UnwarmedSignature"]
 
 
 class ServingError(MXNetError):
@@ -54,5 +55,56 @@ class Draining(ServingError):
     balancer reading ``readyz()``, which flipped false the instant the
     signal landed) should resubmit to another replica. Maps to 503 +
     Retry-After on a transport."""
+
+    retriable = True
+
+
+class QuotaExceeded(ServingError):
+    """The owning tenant is at its admission quota
+    (``MXTPU_TENANT_QUOTAS``): this request was shed to protect the
+    other tenants' share of the queue, not because of anything wrong
+    with the request itself. *Retriable* — the tenant's own earlier
+    requests completing frees the quota; resubmit after backoff. Maps
+    to 429 + Retry-After on a transport."""
+
+    retriable = True
+
+
+class BatchFailed(ServingError):
+    """The coalesced dispatch this request rode in failed as a whole
+    (backend fault or worker death mid-batch). The failure says nothing
+    about this *individual* request — it shared an XLA dispatch with
+    strangers — so the error is *retriable*: resubmitting gets a fresh
+    batch. The circuit breaker was charged once for the dispatch, not
+    once per passenger. ``cause`` carries the backend's exception."""
+
+    retriable = True
+
+    def __init__(self, msg, cause=None):
+        super().__init__(msg)
+        self.cause = cause
+
+
+class RequestTooLarge(ServingError):
+    """The request carries more rows than the largest warmed bucket: a
+    *client* error, rejected at submit() — it could only fail at pad
+    time, and must never charge the circuit breaker. Split the batch
+    or declare a larger bucket. Maps to 413 on a transport."""
+
+
+class UnwarmedSignature(ServingError):
+    """A live dispatch's shape/dtype signature fell outside the warmed
+    set — exactly a production cold compile, fatal under
+    ``MXTPU_RETRACE_STRICT=1``. A client/config error (wrong dtype, an
+    input warm-up never declared), NOT backend-health evidence: the
+    circuit breaker is never charged for it — one misbehaving client
+    must not open the circuit for everyone."""
+
+
+class SlotsFull(ServingError):
+    """Every decode slot in the in-flight batch is occupied
+    (:class:`~.slots.SlotTable`): the sequence cannot join until one of
+    the running sequences finishes. *Retriable* — slots free as
+    sequences complete. Maps to 429 + Retry-After on a transport."""
 
     retriable = True

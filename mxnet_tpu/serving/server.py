@@ -1,40 +1,53 @@
-"""The serving runtime: admission -> deadline -> circuit -> forward.
+"""The serving runtime: admission -> deadline -> circuit -> batched forward.
 
 Request lifecycle (docs/how_to/serving.md):
 
 1. ``submit()`` — fast-fail checks first: server closed? circuit open
-   with no fallback? Then the bounded admission queue (``QueueFull``
-   beyond capacity; ``serving.queue`` fault site). Nothing past this
-   point ever blocks the submitter.
+   with no fallback? Tenant over quota (``QuotaExceeded``, retriable)?
+   Then the bounded admission queue (``QueueFull`` beyond capacity;
+   ``serving.queue`` fault site). Nothing past this point ever blocks
+   the submitter.
 2. A worker (a daemon thread, or the caller itself via ``run_pending``
-   in the deterministic ``workers=0`` mode) takes the request: a
-   deadline that expired *while queued* fails immediately without
-   touching the backend; otherwise the forward runs behind the
-   ``serving.forward`` fault site and the circuit breaker.
+   in the deterministic ``workers=0`` mode) takes the weighted-fair
+   pick from the queue and — with ``max_batch > 1`` — *coalesces* every
+   shape-compatible queued request into ONE dispatch
+   (:class:`~.batching.BatchCoalescer`): merged rows are padded to the
+   nearest warmed bucket, one forward runs, outputs scatter back per
+   request. Deadlines hold per member: a request whose budget died in
+   queue never rides the dispatch.
 3. ``result()`` — the caller waits at most the remaining deadline
    (injectable ``wait``). On timeout the request is abandoned: if it
    was wedged inside a forward, that worker is written off and a
    replacement is spawned (the watchdog), so one stuck backend call
    never shrinks the worker pool.
 
+Failure accounting is per DISPATCH: a coalesced forward that dies fails
+its members with the retriable :class:`~.errors.BatchFailed` and charges
+the circuit breaker once — N passengers are not N pieces of evidence.
+
 Degradation ladder: primary forward -> fallback model (circuit open or
 primary failure) -> fast-fail. ``healthz()``/``readyz()`` expose the
 whole state machine for probes; ``stats()`` mirrors
-``resilience.retry.stats()`` per endpoint.
+``resilience.retry.stats()`` per endpoint, now with a ``per_tenant``
+breakdown and the batching counters.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence, Union
 
 from ..base import MXNetError
+from ..perf import CompileGuard
 from ..resilience import RetryExhausted, faults, guarded_call
-from .admission import AdmissionQueue, Deadline, Request
+from .admission import (DEFAULT_TENANT, AdmissionQueue, Deadline, Request,
+                        TenantPolicy)
+from .batching import BatchCoalescer
 from .breaker import CircuitBreaker, OPEN
-from .errors import (CircuitOpen, DeadlineExceeded, Draining, QueueFull,
-                     ServerClosed)
-from .warmup import ShapeBuckets
+from .errors import (BatchFailed, CircuitOpen, DeadlineExceeded, Draining,
+                     QueueFull, QuotaExceeded, RequestTooLarge,
+                     ServerClosed, UnwarmedSignature)
+from .warmup import ShapeBuckets, coalescer_sizes
 
 __all__ = ["InferenceServer", "endpoint_stats", "endpoints"]
 
@@ -71,10 +84,10 @@ class _Worker(threading.Thread):
 
     def run(self):
         while not self.wedged:
-            req = self.server._queue.take()
-            if req is None:       # queue closed
+            batch = self.server._take_batch()
+            if batch is None:     # queue closed
                 return
-            self.server._process(req, worker=self)
+            self.server._process_batch(batch, worker=self, counted=True)
 
 
 class InferenceServer:
@@ -86,15 +99,31 @@ class InferenceServer:
     fallback : optional second backend served while the circuit is open
         (and on a primary forward failure) — degraded, but up.
     buckets : declared batch-size buckets for warm-up + padding; None
-        disables shape management (the backend sees raw shapes).
+        disables shape management (the backend sees raw shapes) unless
+        ``max_batch > 1`` turns it on at the coalescer's sizes.
     capacity / shed_policy : admission queue bound and overflow policy
-        (``'reject'`` | ``'evict-oldest'``).
+        (``'reject'`` | ``'evict-oldest'``). Eviction is priority-safe:
+        the victim is the oldest among the lowest-priority queued
+        requests, never a strictly-higher-priority one.
     default_deadline : per-request budget in seconds when the caller
         does not pass one (None = unbounded).
     breaker : a :class:`~.breaker.CircuitBreaker`; defaults to one on
         ``clock``.
     workers : daemon worker threads; 0 = synchronous mode where the
         caller drives ``run_pending()`` (deterministic tests).
+    max_batch : total rows one coalesced dispatch may carry (default:
+        ``MXTPU_MAX_BATCH``; 1 = one request per dispatch, the pre-
+        batching behavior). Warm-up then pre-traces every bucket at
+        1, ``max_batch``, and the powers of two between, so a coalesced
+        batch never compiles on a live request.
+    batch_wait : seconds a threaded worker may hold the first request
+        open for more traffic to coalesce (default:
+        ``MXTPU_BATCH_WAIT_MS`` / 1000; the ``workers=0`` mode never
+        waits). Bounded by every member's remaining deadline.
+    tenants : a :class:`~.admission.TenantPolicy` (or its
+        ``MXTPU_TENANT_QUOTAS`` string form) declaring per-tenant
+        admission quotas and weighted fair shares; None (default knob)
+        disables quotas and serves tenants FIFO.
     clock / wait : injectable time source and event-wait, so every
         deadline/cool-down path is testable with zero real sleeps.
     """
@@ -105,21 +134,56 @@ class InferenceServer:
                  default_deadline: Optional[float] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  retry_policy=None, workers: int = 1,
+                 max_batch: Optional[int] = None,
+                 batch_wait: Optional[float] = None,
+                 tenants: Optional[Union[TenantPolicy, str]] = None,
                  clock: Callable[[], float] = time.monotonic,
                  wait: Optional[Callable] = None,
                  drain_grace: float = 30.0):
+        from .. import config as _config
         self.name = name
         self.backend = backend
         self.fallback = fallback
         self.drain_grace = drain_grace
-        self.buckets = ShapeBuckets(buckets) if buckets else None
+        if max_batch is None:
+            max_batch = _config.get("MXTPU_MAX_BATCH")
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_batch = int(max_batch)
+        if batch_wait is None:
+            batch_wait = _config.get("MXTPU_BATCH_WAIT_MS") / 1000.0
+        self.batch_wait = float(batch_wait)
+        if tenants is None:
+            tenants = TenantPolicy.parse(
+                _config.get("MXTPU_TENANT_QUOTAS"))
+        elif isinstance(tenants, str):
+            tenants = TenantPolicy.parse(tenants)
+        self.tenants = tenants
+        declared = ShapeBuckets(buckets) if buckets else None
+        if self.max_batch > 1:
+            # the batch-dimension bucket satellite: every size the
+            # coalescer can dispatch is a warmed bucket, so a coalesced
+            # batch never recompiles (MXTPU_RETRACE_STRICT-asserted)
+            sizes = coalescer_sizes(self.max_batch)
+            self.buckets = (declared.union(sizes) if declared
+                            else ShapeBuckets(sizes))
+        else:
+            self.buckets = declared
         self.default_deadline = default_deadline
         self.clock = clock
         self._wait = wait or (lambda event, timeout: event.wait(timeout))
         self.breaker = breaker or CircuitBreaker(clock=clock)
         self.retry_policy = retry_policy
-        self._queue = AdmissionQueue(capacity, shed_policy, clock)
+        self._batch_guard = CompileGuard(f"serving.batched[{name}]",
+                                         expected=0)
+        self._coalescer = BatchCoalescer(
+            self.max_batch, wait=self.batch_wait, clock=clock,
+            guard=self._batch_guard, name=name)
         self._lock = threading.Lock()
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}
+        self._queue = AdmissionQueue(capacity, shed_policy, clock,
+                                     tenants=tenants,
+                                     on_tenant_event=self._tenant_count)
         self._stats: Dict[str, int] = {
             "admitted": 0, "completed": 0, "failed": 0,
             "shed": 0, "evicted": 0, "rejected_open": 0,
@@ -127,7 +191,9 @@ class InferenceServer:
             "degraded": 0, "wedged_workers": 0, "abandoned": 0,
             "load_failures": 0, "warmed_buckets": 0,
             "warmup_cache_hits": 0, "warmup_compiles": 0,
-            "drain_signals": 0, "drained_rejects": 0}
+            "drain_signals": 0, "drained_rejects": 0,
+            "dispatches": 0, "coalesced_requests": 0,
+            "batch_failures": 0, "quota_rejected": 0}
         self._warmed = False
         self._load_ok = None          # None = not attempted yet
         self._fallback_ok = False     # fallback loaded and usable
@@ -155,6 +221,13 @@ class InferenceServer:
     def _count(self, key: str, n: int = 1):
         with self._lock:
             self._stats[key] = self._stats.get(key, 0) + n
+
+    def _tenant_count(self, tenant: str, key: str, n: int = 1):
+        """Per-tenant counter hook (also handed to the queue, which
+        credits expirations/evictions to the owning tenant)."""
+        with self._lock:
+            counters = self._tenant_stats.setdefault(tenant, {})
+            counters[key] = counters.get(key, 0) + n
 
     def _load_one(self, backend, count_circuit: bool = True):
         """Load a backend behind the ``serving.load`` fault site +
@@ -186,7 +259,7 @@ class InferenceServer:
         for size in self.buckets.sizes:
             probe = {name: np.zeros((size,) + tuple(row), np.float32)
                      for name, row in specs.items()}
-            self._forward(backend, probe)
+            self._forward(backend, probe, warming=True)
             if backend is self.backend:
                 self._count("warmed_buckets")
 
@@ -196,6 +269,14 @@ class InferenceServer:
         either. With ``strict`` (default) a primary-load failure raises
         unless the fallback loaded — in which case the server comes up
         degraded instead of down.
+
+        With ``max_batch > 1`` the bucket set includes every size the
+        coalescer can dispatch (1, max, powers of two between), and each
+        probe's shape signature is budgeted into the batched-dispatch
+        :class:`~mxnet_tpu.perf.CompileGuard` — a live dispatch outside
+        the warmed set is a guard trip (fatal under
+        ``MXTPU_RETRACE_STRICT=1``), because it is exactly a production
+        cold compile.
 
         With the persistent compilation cache warm (a previous process
         served the same model/buckets), each bucket's pre-trace becomes
@@ -245,10 +326,14 @@ class InferenceServer:
         name = getattr(self.backend, "input_name", "data")
         return {name: inputs}
 
-    def submit(self, inputs, deadline: Optional[float] = None) -> Request:
+    def submit(self, inputs, deadline: Optional[float] = None,
+               tenant: str = DEFAULT_TENANT, priority: int = 0) -> Request:
         """Admit a request; returns immediately with a waitable
         :class:`~.admission.Request` or raises a fast-fail rejection
-        (ServerClosed / CircuitOpen / QueueFull)."""
+        (ServerClosed / CircuitOpen / QuotaExceeded / QueueFull).
+        ``tenant`` feeds quota + fair-share accounting; higher
+        ``priority`` dequeues first and is never evicted in favour of
+        lower-priority work."""
         if self._closed:
             raise ServerClosed(f"endpoint {self.name!r} is shut down")
         if self._draining:
@@ -273,22 +358,48 @@ class InferenceServer:
                     f"(backend failing); no fallback available")
             use_fallback = True
         req = Request(self._as_inputs(inputs), dl,
-                      use_fallback=use_fallback)
+                      use_fallback=use_fallback, tenant=tenant,
+                      priority=priority)
+        if self.buckets is not None:
+            largest = max(self.buckets.sizes)
+            if req.rows > largest:
+                # a client error, rejected at admission: letting it ride
+                # would fail at pad time AND charge the circuit breaker
+                # — one oversized caller must never open the circuit
+                # for everyone
+                self._count("shed")
+                self._tenant_count(tenant, "shed")
+                raise RequestTooLarge(
+                    f"request of {req.rows} rows exceeds the largest "
+                    f"warmed bucket ({largest}) on endpoint "
+                    f"{self.name!r}; split the batch or declare a "
+                    f"larger bucket")
         try:
+            # the quota is enforced by the queue UNDER ITS LOCK — a
+            # depth check out here would let concurrent submitters race
+            # past the bound together
             evicted = self._queue.offer(req)
+        except QuotaExceeded:
+            self._count("quota_rejected")
+            self._tenant_count(tenant, "quota_rejected")
+            raise
         except QueueFull:
             self._count("shed")
+            self._tenant_count(tenant, "shed")
             raise
         if evicted is not None:       # evict-oldest shed an older request
             self._count("shed")
             self._count("evicted")
         self._count("admitted")
+        self._tenant_count(tenant, "admitted")
         return req
 
-    def predict(self, inputs, deadline: Optional[float] = None):
+    def predict(self, inputs, deadline: Optional[float] = None,
+                tenant: str = DEFAULT_TENANT, priority: int = 0):
         """Synchronous convenience: submit + (in workers=0 mode) drive
         the queue + wait out the deadline."""
-        req = self.submit(inputs, deadline=deadline)
+        req = self.submit(inputs, deadline=deadline, tenant=tenant,
+                          priority=priority)
         if self._n_workers == 0:
             self.run_pending()
         return self.result(req)
@@ -308,6 +419,7 @@ class InferenceServer:
                 raise req._error
             return req._value
         self._count("abandoned")
+        self._tenant_count(req.tenant, "abandoned")
         if prior == "running":
             self._count("deadline_inflight")
             self._watchdog_replace(req.worker)
@@ -334,87 +446,170 @@ class InferenceServer:
 
     def run_pending(self, max_items: Optional[int] = None) -> int:
         """Synchronously drain the queue (the workers=0 mode); returns
-        how many requests were processed."""
+        how many requests were processed. Coalescing applies — what is
+        queued together and shape-compatible rides one dispatch — but
+        nothing ever waits for more traffic (deterministic mode)."""
         done = 0
         while max_items is None or done < max_items:
-            req = self._queue.poll()
-            if req is None:
+            first = self._queue.poll()
+            if first is None:
                 break
-            self._process(req, worker=None)
-            done += 1
+            batch = self._coalescer.gather(first, self._queue,
+                                           may_wait=False)
+            self._process_batch(batch, worker=None)
+            done += len(batch)
         return done
 
     # -- worker side ---------------------------------------------------------
 
-    def _process(self, req: Request, worker=None):
+    def _begin_inflight(self, n: int):
         with self._lock:
-            self._inflight += 1
+            self._inflight += n
             self._idle.clear()
+
+    def _take_batch(self, may_wait: bool = True):
+        """Worker side: blocking fair pick + coalescing gather. The
+        popped request is counted in-flight BEFORE the gather hold —
+        a drain racing the take must see it, or it would close the
+        server around a request that is neither queued nor dispatched
+        yet (gathered mates get the same treatment as they leave the
+        queue)."""
+        first = self._queue.take(
+            on_pop=lambda _r: self._begin_inflight(1))
+        if first is None:
+            return None
+        batch = self._coalescer.gather(first, self._queue,
+                                       may_wait=may_wait)
+        if len(batch) > 1:
+            self._begin_inflight(len(batch) - 1)
+        return batch
+
+    def _process_batch(self, batch, worker=None, counted=False):
+        if not counted:
+            self._begin_inflight(len(batch))
         try:
-            self._process_inner(req, worker=worker)
+            self._process_batch_inner(batch, worker=worker)
         finally:
+            # depth() is read OUTSIDE self._lock: take(on_pop) counts
+            # in-flight under the queue lock, so holding self._lock
+            # while taking the queue lock here would invert the order
+            # and deadlock. A stale _idle wakeup is harmless — drain
+            # re-checks its condition on every loop.
             with self._lock:
-                self._inflight -= 1
-                if self._inflight == 0 and self._queue.depth() == 0:
-                    self._idle.set()
+                self._inflight -= len(batch)
+                inflight = self._inflight
+            if inflight == 0 and self._queue.depth() == 0:
+                self._idle.set()
 
-    def _process_inner(self, req: Request, worker=None):
-        if req.deadline.expired():
-            if req.fail(DeadlineExceeded(
-                    "deadline expired while waiting in queue")):
-                # only count a delivered expiry — the caller-side
-                # watchdog already counted an abandoned one
-                self._count("deadline_queued")
+    def _process_batch_inner(self, batch, worker=None):
+        live = []
+        for req in batch:
+            if req.deadline.expired():
+                # a dead member never rides the dispatch
+                if req.fail(DeadlineExceeded(
+                        "deadline expired while waiting in queue")):
+                    # only count a delivered expiry — the caller-side
+                    # watchdog already counted an abandoned one
+                    self._count("deadline_queued")
+                    self._tenant_count(req.tenant, "deadline_queued")
+                continue
+            if req.start(worker):     # caller may have abandoned it
+                live.append(req)
+        if not live:
             return
-        if not req.start(worker):     # caller already abandoned it
-            return
+        # merge ONCE per logical batch: a fallback retry after a primary
+        # failure reuses the merged feed, and the dispatch counters
+        # count logical batches — never twice for the same passengers
+        merged, spans = self._coalescer.merge(live)
+        self._count("dispatches")
+        if len(live) > 1:
+            self._count("coalesced_requests", len(live))
         try:
-            if req.use_fallback:
-                outs = self._forward(self.fallback, req.inputs)
-                self._count("degraded")
+            if live[0].use_fallback:  # signature-homogeneous batch
+                per_req = self._dispatch(self.fallback, merged, spans)
+                self._count("degraded", len(live))
             else:
-                outs = self._try_primary(req)
-                if outs is None:      # rejection already recorded on req
+                per_req = self._try_primary_batch(live, merged, spans)
+                if per_req is None:   # rejection already recorded
                     return
-        except Exception as err:      # noqa: BLE001 — delivered to caller
-            self._count("failed")
-            req.fail(err)
+        except Exception as err:      # noqa: BLE001 — delivered to callers
+            self._fail_batch(live, err)
             return
-        self._count("completed")
-        req.complete(outs)
+        self._count("completed", len(live))
+        for req, outs in zip(live, per_req):
+            self._tenant_count(req.tenant, "completed")
+            req.complete(outs)
 
-    def _try_primary(self, req: Request):
+    def _fail_batch(self, live, err):
+        """One dispatch died: every member fails, the multi-request case
+        with the *retriable* BatchFailed (the batch says nothing about
+        any individual request), the single-request case with the raw
+        backend error (the pre-batching contract)."""
+        self._count("failed", len(live))
+        if len(live) > 1:
+            self._count("batch_failures")
+            for req in live:
+                self._tenant_count(req.tenant, "failed")
+                # an unwarmed signature is ABOUT every member (they all
+                # share it): deliver the typed non-retriable error raw —
+                # wrapping it retriable would invite a doomed resubmit
+                req.fail(err if isinstance(err, UnwarmedSignature)
+                         else BatchFailed(
+                    f"coalesced dispatch of {len(live)} requests failed "
+                    f"on endpoint {self.name!r}: {err}", cause=err))
+        else:
+            self._tenant_count(live[0].tenant, "failed")
+            live[0].fail(err)
+
+    def _try_primary_batch(self, live, merged, spans):
         """Primary forward under the circuit breaker, falling back to
-        the fallback model on open-circuit or forward failure. Returns
-        outputs, or None after failing ``req`` directly."""
+        the fallback model on open-circuit or forward failure. Breaker
+        evidence is PER DISPATCH — one success or one failure no matter
+        how many requests rode it. Returns per-request outputs, or None
+        after failing the members directly."""
         if not self.breaker.allow():
             if self._fallback_ready():
-                req.use_fallback = True   # the watchdog must not charge
-                self._count("degraded")   # a fallback wedge to the primary
-                return self._forward(self.fallback, req.inputs)
-            self._count("rejected_open")
-            req.fail(CircuitOpen(
-                f"endpoint {self.name!r}: circuit open; no fallback"))
+                for req in live:
+                    req.use_fallback = True   # the watchdog must not
+                self._count("degraded", len(live))  # charge the primary
+                return self._dispatch(self.fallback, merged, spans)
+            self._count("rejected_open", len(live))
+            for req in live:
+                req.fail(CircuitOpen(
+                    f"endpoint {self.name!r}: circuit open; no fallback"))
             return None
         try:
-            outs = self._forward(self.backend, req.inputs)
-        except Exception:
-            self.breaker.record_failure()
-            if self._fallback_ready():
-                req.use_fallback = True
-                self._count("degraded")
-                return self._forward(self.fallback, req.inputs)
+            per_req = self._dispatch(self.backend, merged, spans)
+        except UnwarmedSignature:
+            # a client/config error (wrong dtype, undeclared input) —
+            # not backend-health evidence; never charge the breaker
             raise
-        self.breaker.record_success()
+        except Exception:
+            self.breaker.record_failure()     # once per dispatch
+            if self._fallback_ready():
+                for req in live:
+                    req.use_fallback = True
+                self._count("degraded", len(live))
+                return self._dispatch(self.fallback, merged, spans)
+            raise
+        self.breaker.record_success()         # once per dispatch
         with self._lock:
             self._last_success = self.clock()
-        return outs
+        return per_req
 
-    def _forward(self, backend, inputs: Dict):
+    def _dispatch(self, backend, merged, spans):
+        """Run ONE forward over the merged feed, scatter the rows back
+        per member."""
+        outs = self._forward(backend, merged)
+        return self._coalescer.scatter(outs, spans)
+
+    def _forward(self, backend, inputs: Dict, warming: bool = False):
         """One backend forward with bucket padding/unpadding around it.
         The ``serving.forward`` fault site guards the *primary* backend
         only — the fallback is the degradation answer to that fault, so
-        injecting into it would make degraded mode untestable."""
+        injecting into it would make degraded mode untestable. The
+        padded feed's shape signature is checked against the warmed set
+        (warm-up probes register it, live dispatches observe it)."""
         if backend is self.backend:
             faults.fault_point("serving.forward")
         if self.buckets is None:
@@ -424,6 +619,16 @@ class InferenceServer:
         for name, batch in inputs.items():
             fed[name], rows = self.buckets.pad_batch(batch)
             true_rows = rows if true_rows is None else true_rows
+        route = "primary" if backend is self.backend else "fallback"
+        if self.max_batch > 1:
+            # the warmed-signature contract is part of opting into
+            # batching: a pre-batching bucketed server whose backend
+            # never declared row specs must keep serving exactly as it
+            # did (its probe shapes cannot match live traffic)
+            if warming:
+                self._coalescer.expect_signature(fed, route)
+            else:
+                self._coalescer.observe_signature(fed, route)
         outs = backend.infer(fed)
         return self.buckets.slice_outputs(outs, true_rows)
 
@@ -448,6 +653,7 @@ class InferenceServer:
             "last_success_age": (None if last is None
                                  else self.clock() - last),
             "warmed": self._warmed,
+            "max_batch": self.max_batch,
             "degraded": self.breaker.state == OPEN
                         and self._fallback_ready(),
         }
@@ -473,11 +679,21 @@ class InferenceServer:
     def stats(self) -> Dict:
         with self._lock:
             counters = dict(self._stats)
+            per_tenant = {t: dict(c) for t, c in self._tenant_stats.items()}
         counters["queue"] = {"depth": self._queue.depth(),
                              "admitted": self._queue.admitted,
                              "shed": self._queue.shed,
                              "evicted": self._queue.evicted}
         counters["circuit"] = self.breaker.stats()
+        counters["per_tenant"] = per_tenant
+        counters["batching"] = {
+            "max_batch": self.max_batch,
+            "batch_wait_ms": self.batch_wait * 1000.0,
+            "dispatches": counters["dispatches"],
+            "coalesced_requests": counters["coalesced_requests"],
+            "warmed_signatures": self._batch_guard.expected,
+            "unwarmed_dispatch_signatures": max(
+                0, self._batch_guard.count - self._batch_guard.expected)}
         return counters
 
     # -- graceful drain (docs/how_to/preemption.md) ---------------------------
@@ -520,13 +736,15 @@ class InferenceServer:
         self.close(join_timeout=0.1)        # second signal: abort drain
 
     def drain(self, grace: Optional[float] = None, poll: float = 0.1):
-        """Stop admission and finish the in-flight work, then
-        ``close()``. Queued requests and expiry checks are deadline-
-        bounded, but a request WEDGED inside a backend call is not (the
-        deadline is only enforced around the call, not inside it) — so
-        ``grace`` bounds the whole drain; the signal path passes
-        ``drain_grace``. In ``workers=0`` mode the caller's thread
-        drains the queue synchronously — deterministic, zero sleeps."""
+        """Stop admission and finish the in-flight work — the in-flight
+        COALESCED batch included: its members are counted in-flight
+        until their outputs scatter — then ``close()``. Queued requests
+        and expiry checks are deadline-bounded, but a request WEDGED
+        inside a backend call is not (the deadline is only enforced
+        around the call, not inside it) — so ``grace`` bounds the whole
+        drain; the signal path passes ``drain_grace``. In ``workers=0``
+        mode the caller's thread drains the queue synchronously —
+        deterministic, zero sleeps."""
         self._draining = True
         start = self.clock()
         if self._n_workers == 0:

@@ -23,7 +23,24 @@ import numpy as np
 from ..base import MXNetError
 from ..analysis.annotations import hot_path
 
-__all__ = ["ShapeBuckets"]
+__all__ = ["ShapeBuckets", "coalescer_sizes"]
+
+
+def coalescer_sizes(max_batch: int) -> Tuple[int, ...]:
+    """The batch sizes the coalescer can dispatch, all of which warm-up
+    must pre-trace: 1, ``max_batch``, and every power of two between.
+    A coalesced batch is padded up to the smallest of these that fits,
+    so dispatch shapes are drawn from this closed set and a live
+    coalesced batch never recompiles (asserted under
+    ``MXTPU_RETRACE_STRICT=1``)."""
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1")
+    sizes = {1, int(max_batch)}
+    p = 2
+    while p < max_batch:
+        sizes.add(p)
+        p *= 2
+    return tuple(sorted(sizes))
 
 
 class ShapeBuckets:
@@ -36,6 +53,12 @@ class ShapeBuckets:
         if cleaned[0] < 1:
             raise ValueError("bucket sizes must be >= 1")
         self.sizes: Tuple[int, ...] = tuple(cleaned)
+
+    def union(self, sizes: Sequence[int]) -> "ShapeBuckets":
+        """A new bucket set extended with ``sizes`` — how the server
+        folds the coalescer's dispatch sizes (:func:`coalescer_sizes`)
+        into the caller-declared buckets before warm-up."""
+        return ShapeBuckets(self.sizes + tuple(sizes))
 
     def bucket_for(self, n: int) -> Optional[int]:
         """Smallest declared bucket that fits a batch of ``n`` rows

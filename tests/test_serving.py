@@ -325,7 +325,9 @@ def test_warmup_pretraces_buckets_and_pads_off_bucket_shapes():
     # the backend only ever saw declared bucket shapes -> zero retraces
     assert {s[0] for s in shapes_seen} == {2, 4}
 
-    with pytest.raises(mx.MXNetError, match="largest declared bucket"):
+    # oversized: rejected at SUBMIT (client error, breaker untouched),
+    # not at pad time — see test_batching.py for the breaker contract
+    with pytest.raises(mx.MXNetError, match="exceeds the largest"):
         srv.predict(np.ones((9, 5), np.float32))
 
 
