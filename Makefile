@@ -201,12 +201,28 @@ ci-multichip: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_sharding_rules.py \
 	    -m 'not slow' -x -q
 
+# stage 16: fleet chaos smoke — a REAL threaded 3-replica fleet under
+# MXNET_TPU_FAULT_PLAN (fleet.dispatch kills one replica mid-burst:
+# zero lost requests, eviction + standby failover observable, chaos p99
+# within the stated bound of a no-fault reference) plus one rolling
+# v1->v2 reload with zero dropped requests and the rollback gate
+# enforced — all under MXTPU_RETRACE_STRICT=1, so finishing clean is
+# the zero-retrace assertion; then the fake-clock unit suite
+# (docs/how_to/fleet.md)
+ci-fleet: ci-native
+	timeout -k 10 180 env JAX_PLATFORMS=cpu MXTPU_RETRACE_STRICT=1 \
+	    MXNET_TPU_FAULT_PLAN="fleet.dispatch:10:ioerror" \
+	    MXNET_TPU_FAULT_SEED=7 \
+	    python ci/fleet_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-frontends ci-dryrun ci-resilience ci-serving ci-batching ci-data \
-    ci-perf ci-elastic ci-compiler ci-preempt ci-multichip
+    ci-perf ci-elastic ci-compiler ci-preempt ci-multichip ci-fleet
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
         ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \
-        ci-preempt ci-multichip
+        ci-preempt ci-multichip ci-fleet

@@ -17,7 +17,9 @@ vs_baseline, as every prior round) with the LSTM record nested under
 optimizer-state bytes/chip — benchmarks/bench_multichip.py), and the
 serving tier under ``serving`` (continuous-batching requests/sec vs
 one-at-a-time at the same deadline + stateful decode tokens/sec —
-benchmarks/bench_serving.py). Every
+benchmarks/bench_serving.py) and ``fleet`` (3-replica vs 1-replica
+aggregate requests/sec + p99 with a replica-kill chaos leg —
+benchmarks/bench_fleet.py). Every
 metric carries its own vs_best_recorded + regression flag against the
 best across recorded BENCH_r*.json rounds (new metrics self-seed on
 their first recorded round).
@@ -55,7 +57,8 @@ def best_recorded():
     round records them — this round seeds that history)."""
     best = {"resnet": 0.0, "lstm": LSTM_PRIOR_BEST,
             "flash_attention": 0.0, "moe_dispatch": 0.0,
-            "compile_cache": 0.0, "multichip": 0.0, "serving": 0.0}
+            "compile_cache": 0.0, "multichip": 0.0, "serving": 0.0,
+            "fleet": 0.0}
     here = os.path.dirname(os.path.abspath(__file__))
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         try:
@@ -70,7 +73,8 @@ def best_recorded():
                                 ("moe_dispatch", "moe_dispatch"),
                                 ("compile_cache", "compile_cache"),
                                 ("multichip", "multichip"),
-                                ("serving", "serving")):
+                                ("serving", "serving"),
+                                ("fleet", "fleet")):
                 sub = rec.get(nested)
                 if isinstance(sub, dict):
                     best[key] = max(best[key],
@@ -188,6 +192,22 @@ def bench_serving():
     return _srv.run(quiet=True)
 
 
+def bench_fleet():
+    """Serving-fleet record (ISSUE 11): the same open-loop burst through
+    a 3-replica FleetRouter vs a single replica (aggregate requests/sec
+    + p99 each, scaling bounded by host_cores on this one-host bench),
+    plus the replica-kill chaos leg — a seeded fleet.dispatch fault
+    kills one replica mid-burst (benchmarks/bench_fleet.py). The
+    guarded value is the 3-replica requests/sec; the acceptance
+    contract (enforced absolutely in main()) is zero lost requests,
+    the eviction+failover observable, and chaos p99 within the stated
+    bound of the no-fault run."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_fleet as _flt
+    return _flt.run(quiet=True)
+
+
 def bench_compile_cache():
     """compile_cold_start_s / cache_warm_start_s pair via two real
     subprocesses (benchmarks/bench_compile_cache.py); the guarded value
@@ -292,6 +312,23 @@ def main():
             or int(srv.get("unwarmed_signatures", 1)) != 0)
         regressed |= srv["serving_contract_violation"]
         record["serving"] = srv
+
+        # fleet tier: replicated routing (ISSUE 11). The guarded value
+        # is 3-replica aggregate requests/sec; the chaos contract is
+        # absolute — killing a replica mid-burst must lose ZERO
+        # requests (every one re-routed to a terminal response), the
+        # eviction + failover must be observable, and the chaos p99
+        # must stay within the stated bound of the no-fault run.
+        flt = bench_fleet()
+        regressed |= _guard(flt, best["fleet"])
+        chaos = flt.get("chaos", {})
+        flt["fleet_contract_violation"] = bool(
+            int(chaos.get("lost", 1)) != 0
+            or int(chaos.get("evictions", 0)) < 1
+            or int(chaos.get("failovers", 0)) < 1
+            or not chaos.get("p99_within_bound", False))
+        regressed |= flt["fleet_contract_violation"]
+        record["fleet"] = flt
 
     print(json.dumps(record))
     if regressed and os.environ.get("BENCH_ENFORCE"):

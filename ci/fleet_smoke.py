@@ -1,0 +1,124 @@
+"""Fleet smoke stage (`make ci-fleet`, docs/how_to/fleet.md).
+
+Boots a REAL 3-replica fleet — threaded workers, real clock, unlike the
+deterministic fake-clock unit suite — under two chaos legs, bounded by
+`timeout` in the Makefile so a reintroduced hang fails the stage:
+
+1. replica kill mid-burst: the env-armed `MXNET_TPU_FAULT_PLAN`
+   (fleet.dispatch) kills one replica on its Nth live dispatch — every
+   request must still reach a terminal correct answer (ZERO lost), the
+   eviction + failover must be observable in serving.stats(), and the
+   chaos p99 must stay within a stated bound of a no-fault reference
+   burst;
+2. rolling reload mid-traffic: the fleet rolls v1 -> v2 with the
+   version gate enforced (promoting v1 again raises RollbackRefused) —
+   zero dropped requests, pre-reload traffic answered by v1, fresh
+   traffic by v2.
+
+MXTPU_RETRACE_STRICT=1 holds for the whole script: any dispatch outside
+the warmed signature set would raise, so finishing clean IS the
+zero-retrace assertion.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.resilience import RollbackRefused, faults  # noqa: E402
+from mxnet_tpu.serving import CallableBackend, FleetRouter  # noqa: E402
+
+N = 30
+P99_FACTOR, P99_PAD_S = 5.0, 0.5
+
+
+def _factory_scaled(scale):
+    def make(rid, source):
+        s = float(source if isinstance(source, int) else scale)
+
+        def fn(arrays, _s=s):
+            time.sleep(0.002)          # enough service time for a burst
+            return [np.ascontiguousarray(arrays["data"], np.float32) * _s]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+    return make
+
+
+def _burst(fr):
+    t0 = time.perf_counter()
+    pending = [fr.submit(np.ones((1, 3), np.float32) * (i + 1))
+               for i in range(N)]
+    latencies, outs = [], []
+    for req in pending:
+        fr.tick()
+        outs.append(fr.result(req))
+        latencies.append(time.perf_counter() - t0)
+    return outs, float(np.percentile(latencies, 99))
+
+
+def main():
+    # -- leg 1: replica kill mid-burst (env-armed fault plan) ----------
+    fr = FleetRouter(_factory_scaled(2.0), name="smoke-chaos",
+                     replicas=3, standbys=1, workers=1, buckets=[1],
+                     capacity=N, default_deadline=20.0,
+                     probe_period=0.005)
+    outs, chaos_p99 = _burst(fr)
+    for i, out in enumerate(outs):
+        assert np.all(out[0] == 2.0 * (i + 1)), (i, out)
+    stats = serving.stats()["fleet"]["smoke-chaos"]["totals"]
+    fr.close()
+    assert stats["delivered"] == N, stats
+    assert stats["failed_terminal"] == 0, stats
+    assert stats["evictions"] == 1, stats
+    assert stats["failovers"] == 1, stats
+    assert stats["re_routed"] >= 1, stats
+    print(f"chaos ok: {N}/{N} delivered, {stats['re_routed']} re-routed "
+          f"around the killed replica, standby warm in "
+          f"{stats['last_standby_ready_s']:.3f}s")
+
+    # -- no-fault reference: the p99 bound the chaos leg must hold -----
+    faults.disarm()
+    fr = FleetRouter(_factory_scaled(2.0), name="smoke-ref",
+                     replicas=3, standbys=1, workers=1, buckets=[1],
+                     capacity=N, default_deadline=20.0,
+                     probe_period=0.005)
+    _, ref_p99 = _burst(fr)
+    fr.close()
+    bound = ref_p99 * P99_FACTOR + P99_PAD_S
+    assert chaos_p99 <= bound, (chaos_p99, ref_p99, bound)
+    print(f"p99 ok: chaos {chaos_p99:.3f}s <= bound {bound:.3f}s "
+          f"(no-fault {ref_p99:.3f}s)")
+
+    # -- leg 2: rolling reload mid-traffic, zero dropped ---------------
+    fr = FleetRouter(_factory_scaled(1.0), name="smoke-reload",
+                     replicas=3, standbys=1, workers=1, buckets=[1],
+                     capacity=N, default_deadline=20.0,
+                     probe_period=0.005, initial_model=1)
+    pending = [fr.submit(np.ones((1, 3), np.float32)) for _ in range(N)]
+    assert fr.reload(2) == 2           # standby warms v2 first, then
+    for req in pending:                # the old replicas drain: v1
+        out = fr.result(req)           # answers, nothing dropped
+        assert np.all(out[0] == 1.0), out
+    fresh = fr.result(fr.submit(np.ones((1, 3), np.float32)))
+    assert np.all(fresh[0] == 2.0), fresh
+    try:
+        fr.reload(1)
+        raise AssertionError("rollback to v1 must be refused")
+    except RollbackRefused:
+        pass
+    stats = fr.stats()["totals"]
+    fr.close()
+    assert stats["failed_terminal"] == 0, stats
+    assert stats["delivered"] == N + 1, stats
+    assert stats["reload_generations"] == 1, stats
+    print(f"reload ok: v1->v2 rolled with {N} in-flight requests, zero "
+          "dropped, rollback refused without the flag")
+    print("fleet smoke PASS (strict mode: zero unwarmed dispatches)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

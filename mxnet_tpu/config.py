@@ -145,3 +145,12 @@ register_knob("MXTPU_TENANT_QUOTAS", str, None,
               "weights: 'name:quota[:weight],...' (quota '*' = "
               "unbounded) or JSON {name: {quota, weight}} — unset "
               "disables quotas (docs/how_to/serving.md)")
+register_knob("MXTPU_FLEET_REPLICAS", int, 3,
+              "default ACTIVE replica count of a serving FleetRouter "
+              "(mxnet_tpu/serving/fleet.py, docs/how_to/fleet.md)")
+register_knob("MXTPU_FLEET_PROBE_PERIOD", float, 1.0,
+              "seconds between fleet replica-health probe passes on "
+              "the router's injectable clock (FleetRouter.tick)")
+register_knob("MXTPU_FLEET_EVICT_AFTER", int, 3,
+              "consecutive failed health probes after which a fleet "
+              "replica is evicted and a warm standby promoted")

@@ -35,10 +35,11 @@ the SPMD port to "a dead process fails the collective for everyone"
 from __future__ import annotations
 
 from . import checkpoint, data, elastic, faults, retry, supervisor  # noqa: F401,E501
-from .checkpoint import (AUTO, CheckpointCorrupt, atomic_output,  # noqa: F401
-                         atomic_write_bytes, find_checkpoints,
-                         load_checkpoint_ex, verify_manifest,
-                         write_checkpoint)
+from .checkpoint import (AUTO, CheckpointCorrupt, RollbackRefused,  # noqa: F401,E501
+                         atomic_output, atomic_write_bytes,
+                         find_checkpoints, load_checkpoint_ex,
+                         model_version_info, require_newer_version,
+                         verify_manifest, write_checkpoint)
 from .data import (DataBudgetExceeded, DataGuardPolicy,  # noqa: F401
                    RecordIter, ResilientIter, ShardSet, guard)
 from .elastic import (DeviceLost, ElasticConfig,  # noqa: F401
@@ -52,6 +53,7 @@ from .supervisor import (CrashLoopGuard, ImmediateAbort,  # noqa: F401
 
 __all__ = ["checkpoint", "data", "elastic", "faults", "retry", "FaultPlan",
            "RetryPolicy", "RetryExhausted", "CheckpointCorrupt",
+           "RollbackRefused", "model_version_info", "require_newer_version",
            "InjectedFault", "InjectedTimeout", "InjectedKill", "fault_point",
            "guarded_call", "guarded_point", "default_policy", "stats",
            "reset_stats", "AUTO", "SITES", "DataGuardPolicy",

@@ -29,12 +29,14 @@ from typing import Dict, List, Optional
 
 from . import faults, retry
 
-__all__ = ["CheckpointCorrupt", "atomic_output", "atomic_write_bytes",
+__all__ = ["CheckpointCorrupt", "RollbackRefused", "atomic_output",
+           "atomic_write_bytes",
            "write_bytes_guarded", "read_bytes_guarded",
            "file_digest", "write_manifest", "verify_manifest",
            "write_dir_manifest", "verify_dir_manifest",
            "manifest_path", "checkpoint_paths", "write_checkpoint",
            "find_checkpoints", "load_checkpoint_ex", "load_iter_state",
+           "model_version_info", "require_newer_version",
            "mid_epoch_label", "epoch_of_label", "remove_checkpoint",
            "clear_mid_epoch_checkpoints", "sweep_stale_checkpoints",
            "MID_EPOCH_STRIDE", "MANIFEST_VERSION"]
@@ -45,6 +47,15 @@ MANIFEST_VERSION = 1
 class CheckpointCorrupt(RuntimeError):
     """A checkpoint failed manifest verification (missing file, size or
     digest mismatch, unreadable manifest)."""
+
+
+class RollbackRefused(RuntimeError):
+    """A model-version gate refused to move backward: the candidate
+    checkpoint's ``model_version`` is not strictly newer than the one
+    currently served/trained (:func:`require_newer_version`). Promoting
+    an older model is almost always an accident — a stale manifest path,
+    a half-synced artifact store — so it requires the explicit
+    ``force_rollback`` flag (docs/how_to/fleet.md)."""
 
 
 # -- atomic file primitives --------------------------------------------------
@@ -131,15 +142,20 @@ def checkpoint_paths(prefix: str, epoch: Optional[int]) -> Dict[str, str]:
 
 
 def write_manifest(prefix: str, epoch: Optional[int], files: Dict[str, str],
-                   step: Optional[int] = None, extra: Optional[dict] = None):
+                   step: Optional[int] = None, extra: Optional[dict] = None,
+                   digests: Optional[Dict[str, str]] = None):
     """Write the per-checkpoint manifest. ``files`` maps role (params/
     states/symbol) to an existing path; each entry records size + sha256
-    so a single flipped byte is detected at load time."""
+    so a single flipped byte is detected at load time. ``digests`` maps
+    role to an already-computed sha256 — a caller that hashed a file for
+    its own purposes (the model_uid default) must not pay for hashing a
+    multi-GB params file twice."""
     entries = {}
     for role, path in files.items():
+        sha = (digests or {}).get(role) or file_digest(path)
         entries[role] = {"file": os.path.basename(path),
                          "size": os.path.getsize(path),
-                         "sha256": file_digest(path)}
+                         "sha256": sha}
     doc = {"format_version": MANIFEST_VERSION, "epoch": epoch, "step": step,
            "files": entries}
     if extra:
@@ -179,9 +195,11 @@ def verify_manifest(prefix: str, epoch: Optional[int]) -> dict:
     return doc
 
 
-def write_dir_manifest(path: str) -> str:
+def write_dir_manifest(path: str, extra: Optional[dict] = None) -> str:
     """Digest every file under directory ``path`` (sharded/orbax
-    checkpoints) into an atomic ``manifest.json`` at its root."""
+    checkpoints) into an atomic ``manifest.json`` at its root.
+    ``extra`` entries (e.g. ``model_version``/``model_uid``) are merged
+    into the manifest document."""
     entries = {}
     for root, _, names in os.walk(path):
         for name in names:
@@ -192,6 +210,8 @@ def write_dir_manifest(path: str) -> str:
             entries[rel] = {"size": os.path.getsize(fpath),
                             "sha256": file_digest(fpath)}
     doc = {"format_version": MANIFEST_VERSION, "files": entries}
+    if extra:
+        doc.update(extra)
     mpath = os.path.join(path, "manifest.json")
     atomic_write_bytes(mpath, json.dumps(doc, indent=1, sort_keys=True)
                        .encode("utf-8"))
@@ -228,11 +248,20 @@ def write_checkpoint(prefix: str, epoch: Optional[int], symbol,
                      arg_params: dict, aux_params: dict,
                      states: Optional[bytes] = None,
                      step: Optional[int] = None,
-                     iter_state: Optional[dict] = None) -> Dict[str, str]:
+                     iter_state: Optional[dict] = None,
+                     model_version: Optional[int] = None,
+                     model_uid: Optional[str] = None) -> Dict[str, str]:
     """Atomically write one checkpoint (symbol json, params, optional
     optimizer states, optional data-iterator state for mid-epoch resume)
     plus its manifest. Retries transient I/O errors under the default
-    policy. Returns the role->path map."""
+    policy. Returns the role->path map.
+
+    ``model_version`` is a caller-owned **monotonic** model generation
+    (``model_uid`` an optional human/audit identity, defaulting to the
+    params digest when a version is given): the serving fleet's rolling
+    reload reads them back via :func:`model_version_info` and refuses to
+    promote a non-newer model without an explicit ``force_rollback``
+    (:func:`require_newer_version`, docs/how_to/fleet.md)."""
     paths = checkpoint_paths(prefix, epoch)
     pol = retry.default_policy()
     files = {}
@@ -262,8 +291,18 @@ def write_checkpoint(prefix: str, epoch: Optional[int], symbol,
                  json.dumps(iter_state, sort_keys=True).encode("utf-8"),
                  label="checkpoint.write")
         files["iter"] = paths["iter"]
-    pol.call(write_manifest, prefix, epoch, files, step=step,
-             label="checkpoint.write")
+    extra = None
+    digests = None
+    if model_version is not None:
+        if model_uid is None:
+            sha = file_digest(paths["params"])
+            model_uid = sha[:16]
+            digests = {"params": sha}   # hashed once, reused by the
+            # manifest entry below — never twice for a huge params file
+        extra = {"model_version": int(model_version),
+                 "model_uid": str(model_uid)}
+    pol.call(write_manifest, prefix, epoch, files, step=step, extra=extra,
+             digests=digests, label="checkpoint.write")
     logging.info("Saved checkpoint to \"%s\"", paths["params"])
     return paths
 
@@ -553,3 +592,71 @@ def load_iter_state(prefix: str, epoch) -> Optional[dict]:
         raise CheckpointCorrupt(
             f"iterator state {ipath} is recorded in the manifest but "
             f"unreadable: {err}") from err
+
+
+# -- model-version gate (serving fleet rolling reload) -----------------------
+
+def model_version_info(source, epoch=AUTO):
+    """``(model_version, model_uid)`` recorded in a checkpoint manifest,
+    ``(None, None)`` when the checkpoint is unversioned.
+
+    ``source`` is flexible, matching what a reload announcement can
+    carry: a manifest document (dict), a path to a ``*.manifest.json``
+    file, a directory holding a ``manifest.json`` (orbax/sharded
+    scheme), or a checkpoint *prefix* — then ``epoch`` selects the
+    checkpoint (:data:`AUTO` = newest by supersession order)."""
+    if isinstance(source, dict):
+        doc = source
+    else:
+        path = os.fspath(source)
+        if os.path.isdir(path):
+            mpath = os.path.join(path, "manifest.json")
+        elif path.endswith(".json"):
+            mpath = path
+        else:
+            if epoch is AUTO or epoch == AUTO:
+                found = find_checkpoints(path)
+                if not found:
+                    return None, None
+                epoch = found[0]
+            mpath = manifest_path(path, epoch)
+        if not os.path.exists(mpath):
+            return None, None
+        try:
+            with open(mpath, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as err:
+            raise CheckpointCorrupt(
+                f"unreadable manifest {mpath}: {err}") from err
+    version = doc.get("model_version")
+    uid = doc.get("model_uid")
+    return (None if version is None else int(version),
+            None if uid is None else str(uid))
+
+
+def require_newer_version(current: Optional[int], candidate: Optional[int],
+                          force_rollback: bool = False,
+                          what: str = "model") -> Optional[int]:
+    """Gate a promotion on the monotonic ``model_version``: the
+    candidate must be STRICTLY newer than what is currently live, or
+    the caller must say ``force_rollback=True`` out loud.
+
+    ``current is None`` (nothing versioned is live yet) admits anything;
+    a versioned current refuses an *unversioned* candidate too — "I
+    cannot prove this is newer" must not silently pass the gate the
+    versioning exists for. Returns the candidate version on success;
+    raises :class:`RollbackRefused` otherwise."""
+    if current is None or force_rollback:
+        return candidate
+    if candidate is None:
+        raise RollbackRefused(
+            f"refusing to promote an unversioned {what} over live "
+            f"version {current}: the manifest carries no model_version, "
+            "so it cannot be proven newer — write the checkpoint with "
+            "model_version= or pass force_rollback=True")
+    if int(candidate) <= int(current):
+        raise RollbackRefused(
+            f"refusing to promote {what} version {candidate} over live "
+            f"version {current}: rolling reload only moves forward — "
+            "pass force_rollback=True to deliberately roll back")
+    return candidate

@@ -57,7 +57,14 @@ SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          # supervisor.signal simulates a delivered SIGTERM, one at
          # supervisor.heartbeat simulates a stalled step (drives the
          # retry → rebind → re-mesh → abort escalation ladder)
-         "supervisor.signal", "supervisor.heartbeat")
+         "supervisor.signal", "supervisor.heartbeat",
+         # serving fleet (mxnet_tpu/serving/fleet.py,
+         # docs/how_to/fleet.md): the replica-health probe and the
+         # per-replica dispatch — an injected fault at fleet.probe kills
+         # one seeded replica (the MeshHealth pattern at fleet scope), a
+         # fault at fleet.dispatch kills the replica whose forward it
+         # was, mid-burst
+         "fleet.probe", "fleet.dispatch")
 
 ENV_PLAN = "MXNET_TPU_FAULT_PLAN"
 ENV_SEED = "MXNET_TPU_FAULT_SEED"

@@ -28,38 +28,61 @@ Five pillars:
   :class:`~.errors.Draining` error, in-flight requests finish within
   their deadlines, then the server closes
   (``install_signal_handlers()`` / ``drain()``).
+- **The fleet** (:mod:`.fleet`, docs/how_to/fleet.md) — N replica
+  servers behind a :class:`~.fleet.FleetRouter`: least-loaded routing
+  with the weighted-fair stride scheduler shared fleet-wide,
+  health-probe-driven replica eviction with warm-standby promotion,
+  idempotent re-dispatch of a dead replica's backlog, and zero-drop
+  rolling model reload gated on the checkpoint manifest's monotonic
+  ``model_version``.
 """
 from __future__ import annotations
 
 from . import (admission, backends, batching, breaker, errors,  # noqa: F401
-               server, slots, warmup)
+               fleet, server, slots, warmup)
 from .admission import (AdmissionQueue, Deadline, Request,  # noqa: F401
-                        TenantPolicy)
+                        StrideScheduler, TenantPolicy)
 from .backends import (CallableBackend, ModuleBackend,  # noqa: F401
                        PredictorBackend)
 from .batching import BatchCoalescer, request_signature  # noqa: F401
 from .breaker import CircuitBreaker  # noqa: F401
 from .errors import (BatchFailed, CircuitOpen, DeadlineExceeded,  # noqa: F401
-                     Draining, QueueFull, QuotaExceeded, RequestTooLarge,
-                     ServerClosed, ServingError, SlotsFull,
-                     UnwarmedSignature)
+                     Draining, FleetUnavailable, QueueFull, QuotaExceeded,
+                     ReplicaEvicted, RequestTooLarge, ServerClosed,
+                     ServingError, SlotsFull, UnwarmedSignature)
+from .fleet import (FleetRequest, FleetRouter, Replica,  # noqa: F401
+                    fleet_stats, fleets)
 from .server import InferenceServer, endpoint_stats, endpoints  # noqa: F401
 from .slots import (CallableStepBackend, InflightBatcher,  # noqa: F401
                     ModuleStepBackend, SlotTable)
 from .warmup import ShapeBuckets, coalescer_sizes  # noqa: F401
 
 __all__ = ["InferenceServer", "AdmissionQueue", "Deadline", "Request",
-           "TenantPolicy", "CircuitBreaker", "ShapeBuckets",
+           "TenantPolicy", "StrideScheduler", "CircuitBreaker",
+           "ShapeBuckets",
            "coalescer_sizes", "BatchCoalescer", "request_signature",
            "SlotTable", "InflightBatcher", "CallableStepBackend",
            "ModuleStepBackend", "CallableBackend", "PredictorBackend",
            "ModuleBackend", "ServingError", "QueueFull",
            "DeadlineExceeded", "CircuitOpen", "ServerClosed", "Draining",
            "QuotaExceeded", "BatchFailed", "SlotsFull", "RequestTooLarge",
-           "UnwarmedSignature", "endpoints", "endpoint_stats", "stats"]
+           "UnwarmedSignature", "ReplicaEvicted", "FleetUnavailable",
+           "FleetRouter", "FleetRequest", "Replica", "fleets",
+           "fleet_stats", "endpoints", "endpoint_stats", "stats"]
 
 
 def stats() -> dict:
-    """Per-endpoint serving counters (the serving mirror of
-    :func:`mxnet_tpu.resilience.stats`)."""
-    return endpoint_stats()
+    """Per-endpoint serving counters plus the ``fleet`` block — per-
+    replica counters keyed by replica id and aggregated fleet totals
+    (evictions, failovers, re-routed requests, reload generations) —
+    the serving mirror of :func:`mxnet_tpu.resilience.stats`.
+
+    ``fleet`` is a reserved key of this table: an endpoint literally
+    named ``"fleet"`` keeps its counters under ``fleet_endpoint`` here
+    (and under its own name in :func:`endpoint_stats`) rather than
+    being clobbered by the fleet-registry block."""
+    out = endpoint_stats()
+    if "fleet" in out:
+        out["fleet_endpoint"] = out.pop("fleet")
+    out["fleet"] = fleet_stats()
+    return out

@@ -41,7 +41,7 @@ from .errors import (DeadlineExceeded, QueueFull, QuotaExceeded,
                      ServerClosed)
 
 __all__ = ["Deadline", "Request", "AdmissionQueue", "TenantPolicy",
-           "DEFAULT_TENANT"]
+           "StrideScheduler", "DEFAULT_TENANT"]
 
 DEFAULT_TENANT = "default"
 
@@ -154,6 +154,19 @@ class Request:
     def done(self) -> bool:
         return self._event.is_set()
 
+    def peek(self):
+        """Non-consuming outcome probe: ``('pending', None)`` until the
+        request settles, then ``('value', v)`` or ``('error', e)``. The
+        fleet router's re-dispatch dedupe reads this — a prior attempt
+        that raced to a value must be delivered instead of re-running
+        the request on another replica."""
+        with self._lock:
+            if self.state != "done" or not self._event.is_set():
+                return ("pending", None)
+            if self._error is not None:
+                return ("error", self._error)
+            return ("value", self._value)
+
 
 class TenantPolicy:
     """Per-tenant admission quotas and fair-share weights.
@@ -240,6 +253,74 @@ class TenantPolicy:
         return {name: dict(spec) for name, spec in self._tenants.items()}
 
 
+class StrideScheduler:
+    """Weighted-fair stride state: one virtual clock per tenant, advanced
+    by ``1/weight`` on every pick, smallest clock dispatches next.
+
+    Extracted from the queue so the state is *shareable*: a single
+    :class:`AdmissionQueue` owns a private instance (the PR 10 per-queue
+    behavior, unchanged), while the fleet router hands every replica's
+    queue ONE instance — a tenant's fair share is then measured against
+    its dispatches across the whole fleet, not per replica queue
+    (docs/how_to/fleet.md). Thread-safe under its own lock; the lock
+    order is queue -> stride, and the scheduler never calls back into a
+    queue.
+
+    A tenant first seen (or re-entering after idling/pruning) starts AT
+    the incumbents' floor — its fair share runs from here on, never a
+    monopoly refund of virtual time it did not spend waiting.
+    """
+
+    #: hard cap on the clock map in SHARED (fleet) mode, where one
+    #: queue's queued-tenant set says nothing about the others'
+    SHARED_CAP = 65536
+
+    def __init__(self):
+        self._vtime: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        #: the fleet router flips this on the instance it shares: a
+        #: per-queue ``prune_to`` must then be ignored — pruning against
+        #: ONE queue's queued tenants would drop every other replica
+        #: queue's clocks and refund heavy tenants to the floor
+        self.shared = False
+
+    def pick(self, candidates, weight: Callable[[str], float],
+             prune_to=None, bound: int = 64) -> str:
+        """Pick the candidate tenant with the smallest virtual clock
+        (name-ordered tie break) and advance it by ``1/weight(tenant)``.
+        ``prune_to``/``bound`` cap the clock map against client-invented
+        tenant names: past ``bound`` entries, tenants outside
+        ``prune_to`` are dropped (they re-enter at the floor anyway —
+        the documented idle rule). In shared mode that per-queue prune
+        is ignored; instead a hard cap drops the LOWEST clocks — a
+        dropped tenant re-enters at (or above) the floor, so the prune
+        can penalize an idle tenant slightly but never refund a heavy
+        one."""
+        with self._lock:
+            existing = [self._vtime[t] for t in candidates
+                        if t in self._vtime]
+            floor = min(existing) if existing else 0.0
+            tenant = min(candidates,
+                         key=lambda t: (self._vtime.get(t, floor), t))
+            self._vtime[tenant] = (max(self._vtime.get(tenant, floor),
+                                       floor) + 1.0 / weight(tenant))
+            if self.shared:
+                if len(self._vtime) > self.SHARED_CAP:
+                    keep = sorted(self._vtime.items(),
+                                  key=lambda kv: kv[1],
+                                  reverse=True)[:self.SHARED_CAP // 2]
+                    self._vtime = dict(keep)
+            elif prune_to is not None and len(self._vtime) > bound:
+                self._vtime = {t: v for t, v in self._vtime.items()
+                               if t in prune_to}
+            return tenant
+
+    def clocks(self) -> Dict[str, float]:
+        """Snapshot of the per-tenant virtual clocks (introspection)."""
+        with self._lock:
+            return dict(self._vtime)
+
+
 class AdmissionQueue:
     """Bounded queue between submitters and workers.
 
@@ -261,7 +342,8 @@ class AdmissionQueue:
     def __init__(self, capacity: int = 64, policy: str = "reject",
                  clock: Callable[[], float] = time.monotonic,
                  tenants: Optional[TenantPolicy] = None,
-                 on_tenant_event: Optional[Callable] = None):
+                 on_tenant_event: Optional[Callable] = None,
+                 stride: Optional[StrideScheduler] = None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         if policy not in self.POLICIES:
@@ -273,7 +355,10 @@ class AdmissionQueue:
         self._on_tenant_event = on_tenant_event or (lambda *a, **k: None)
         self._items: deque = deque()
         self._cv = threading.Condition()
-        self._vtime: Dict[str, float] = {}   # stride-scheduling clocks
+        # private by default (per-queue fairness, the PR 10 behavior);
+        # the fleet router passes one shared instance per replica queue
+        # so fair shares are measured fleet-wide
+        self.stride = stride or StrideScheduler()
         self.open = True
         self.admitted = 0
         self.shed = 0
@@ -388,22 +473,10 @@ class AdmissionQueue:
         for i, req in enumerate(self._items):
             if req.priority == top and req.tenant not in heads:
                 heads[req.tenant] = i
-        # the floor is the INCUMBENTS' smallest clock: a tenant first
-        # seen now (or re-entering after idling/pruning) starts AT the
-        # floor — it gets its fair share from here on, never a monopoly
-        # refund of virtual time it did not spend waiting
-        existing = [self._vtime[t] for t in heads if t in self._vtime]
-        floor = min(existing) if existing else 0.0
-        tenant = min(heads, key=lambda t: (self._vtime.get(t, floor), t))
-        self._vtime[tenant] = (max(self._vtime.get(tenant, floor), floor)
-                               + 1.0 / self._weight(tenant))
-        if len(self._vtime) > 4 * max(16, len(self._items)):
-            # bound the map against client-invented tenant names: a
-            # tenant with nothing queued re-enters at the floor anyway
-            # (the documented idle rule), so its entry is droppable
-            queued = {r.tenant for r in self._items}
-            self._vtime = {t: v for t, v in self._vtime.items()
-                           if t in queued}
+        tenant = self.stride.pick(
+            heads, self._weight,
+            prune_to={r.tenant for r in self._items},
+            bound=4 * max(16, len(self._items)))
         idx = heads[tenant]
         req = self._items[idx]
         del self._items[idx]
@@ -477,6 +550,25 @@ class AdmissionQueue:
                 # credited to the owning tenant only when delivered —
                 # the caller-side abandon path already counted the rest
                 self._on_tenant_event(req.tenant, "deadline_queued")
+                delivered += 1
+        return delivered
+
+    def shed_all(self, make_error: Callable[[Request], BaseException]) -> int:
+        """Pop EVERY queued request and fail it with
+        ``make_error(request)`` — the eviction path of the fleet router:
+        a replica leaving the fleet must turn its whole backlog into
+        typed *retriable* rejections the waiting callers re-dispatch on,
+        not silently strand it behind a closed queue. Returns how many
+        failures were delivered (abandoned requests are reclaimed but
+        not re-counted); each is credited to the owning tenant."""
+        with self._cv:
+            victims = list(self._items)
+            self._items.clear()
+            self._cv.notify_all()
+        delivered = 0
+        for req in victims:
+            if req.fail(make_error(req)):
+                self._on_tenant_event(req.tenant, "shed")
                 delivered += 1
         return delivered
 

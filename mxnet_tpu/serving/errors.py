@@ -16,7 +16,8 @@ from ..base import MXNetError
 
 __all__ = ["ServingError", "QueueFull", "DeadlineExceeded", "CircuitOpen",
            "ServerClosed", "Draining", "QuotaExceeded", "BatchFailed",
-           "SlotsFull", "RequestTooLarge", "UnwarmedSignature"]
+           "SlotsFull", "RequestTooLarge", "UnwarmedSignature",
+           "ReplicaEvicted", "FleetUnavailable"]
 
 
 class ServingError(MXNetError):
@@ -99,6 +100,28 @@ class UnwarmedSignature(ServingError):
     input warm-up never declared), NOT backend-health evidence: the
     circuit breaker is never charged for it — one misbehaving client
     must not open the circuit for everyone."""
+
+
+class ReplicaEvicted(ServingError):
+    """The replica holding this request was evicted from the serving
+    fleet (failed health probes, breached error-rate bound, or killed
+    outright — docs/how_to/fleet.md). The request itself is fine; it was
+    simply parked on the wrong box. *Retriable*: the fleet router
+    re-dispatches it to a surviving replica (idempotently — delivery is
+    deduped on the fleet request id), and an external client should
+    resubmit. Maps to 503 + Retry-After on a transport."""
+
+    retriable = True
+
+
+class FleetUnavailable(ServingError):
+    """No ACTIVE replica can take the request right now — every replica
+    is evicted, draining, or mid-promotion. Distinct from
+    :class:`ServerClosed` (the fleet is not shut down, it is degraded)
+    and *retriable*: a standby promotion or reload hand-off completing
+    restores capacity. Maps to 503 + Retry-After on a transport."""
+
+    retriable = True
 
 
 class SlotsFull(ServingError):

@@ -1,0 +1,1006 @@
+"""The serving fleet: replicated routing, eviction, rolling reload.
+
+One :class:`~.server.InferenceServer` survives overload and backend
+faults, but not its own death: a process kill or a model reload drops
+every queued and in-flight request. This module makes the *fleet* the
+unit that must survive (ROADMAP item 3b; nncase's deployment framing,
+PAPERS.md arxiv 2512.21571): a :class:`FleetRouter` fronts N replica
+servers and composes the building blocks the tree already has —
+
+- **Global weighted-fair scheduling.** Every replica's admission queue
+  shares ONE :class:`~.admission.StrideScheduler`, so a tenant's fair
+  share is measured against its dispatches across the whole fleet — the
+  PR 10 per-queue stride scheduler, generalized. Routing itself is a
+  least-loaded pick (queue depth + in-flight) over the ACTIVE replicas,
+  with *sticky* routing for slot-holding decode sessions
+  (``submit(session=...)`` pins a session to the replica holding its
+  state).
+- **Health-probe-driven lifecycle.** ``tick()`` probes each replica on
+  the injectable clock (the :class:`~mxnet_tpu.resilience.MeshHealth`
+  pattern at fleet scope): the ``fleet.probe`` fault site kills one
+  *seeded* replica per injected fault, ``fleet.dispatch`` kills the
+  replica whose forward it was — mid-burst. A replica failing
+  ``evict_after`` consecutive probes, or breaching the error-rate
+  bound, is **evicted**: its backlog is shed with the typed *retriable*
+  :class:`~.errors.ReplicaEvicted`, waiting callers re-dispatch
+  idempotently (delivery deduped on the fleet request id), and a warm
+  standby is promoted — serve-ready in the measured ``ready_s`` (the
+  PR 7 persistent compile cache plus PR 10 warm-up make that seconds,
+  not minutes).
+- **Rolling model reload, zero dropped requests.** ``reload(source)``
+  announces a new checkpoint manifest: a standby loads + warms the new
+  version FIRST, traffic shifts to it, then the old replica drains
+  (PR 8's drain) and retires — repeat per replica. The monotonic
+  ``model_version`` recorded in checkpoint manifests gates the hand-off
+  (:func:`~mxnet_tpu.resilience.require_newer_version`): promoting an
+  older or unversioned model raises
+  :class:`~mxnet_tpu.resilience.RollbackRefused` unless
+  ``force_rollback=True`` is said out loud.
+
+Everything is deterministic and clock-injectable: replicas run
+``workers=0`` in tests, ``run_pending()`` drives the whole fleet from
+the calling thread, and the chaos acceptance (kill 1 of 3 replicas
+mid-burst via a seeded :class:`~mxnet_tpu.resilience.FaultPlan`) proves
+zero request loss with fake clocks and zero real sleeps
+(docs/how_to/fleet.md, ``make ci-fleet``).
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+from ..resilience import faults
+from ..resilience.checkpoint import (model_version_info,
+                                     require_newer_version)
+from ..resilience.faults import InjectedFault, InjectedTimeout
+from .admission import DEFAULT_TENANT, Deadline, StrideScheduler, TenantPolicy
+from .errors import (CircuitOpen, DeadlineExceeded, Draining,
+                     FleetUnavailable, QueueFull, QuotaExceeded,
+                     ReplicaEvicted, RequestTooLarge, ServerClosed,
+                     UnwarmedSignature)
+from .server import InferenceServer
+
+__all__ = ["FleetRouter", "FleetRequest", "Replica", "fleet_stats",
+           "fleets", "SITE_PROBE", "SITE_DISPATCH",
+           "ACTIVE", "STANDBY", "DRAINING", "EVICTED", "RETIRED"]
+
+#: fault site passed on every replica-health probe; an injected fault
+#: kills one currently-healthy replica (seeded choice, MeshHealth-style)
+SITE_PROBE = "fleet.probe"
+#: fault site passed inside every replica dispatch; an injected fault
+#: kills the replica whose forward it was — the mid-burst process death
+SITE_DISPATCH = "fleet.dispatch"
+
+ACTIVE = "active"
+STANDBY = "standby"
+DRAINING = "draining"
+EVICTED = "evicted"
+RETIRED = "retired"
+
+_FLEETS: Dict[str, "FleetRouter"] = {}
+_fleets_lock = threading.Lock()
+
+
+def fleets() -> Dict[str, "FleetRouter"]:
+    """Live fleet registry (name -> router)."""
+    with _fleets_lock:
+        return dict(_FLEETS)
+
+
+def fleet_stats() -> Dict[str, Dict]:
+    """Per-fleet counters, the fleet block of ``serving.stats()``."""
+    return {name: router.stats() for name, router in fleets().items()}
+
+
+class Replica:
+    """One fleet member: an :class:`~.server.InferenceServer` plus its
+    lifecycle state, model generation, and health bookkeeping."""
+
+    __slots__ = ("id", "server", "state", "model_version", "model_uid",
+                 "model_source", "killed", "kill_reason", "probe_failures",
+                 "ready_s", "routed", "re_routed_from", "warming",
+                 "_err_base")
+
+    def __init__(self, rid: str, model_version=None, model_uid=None,
+                 model_source=None):
+        self.id = rid
+        self.server: Optional[InferenceServer] = None
+        self.state = STANDBY
+        self.model_version = model_version
+        self.model_uid = model_uid
+        self.model_source = model_source
+        self.killed = False
+        self.kill_reason = None
+        self.probe_failures = 0
+        self.ready_s = None          # measured load+warm seconds
+        self.routed = 0              # requests first routed here
+        self.re_routed_from = 0      # requests that left after a failure
+        self.warming = True          # warm-up probes skip fleet.dispatch
+        self._err_base = (0, 0)      # (completed, failed) window baseline
+
+    def kill(self, reason: str):
+        """Simulated process death: every later dispatch on this replica
+        fails, and the default health probe reports it down."""
+        if not self.killed:
+            self.killed = True
+            self.kill_reason = reason
+            logging.warning("fleet: replica %s killed (%s)", self.id,
+                            reason)
+
+
+class _ReplicaBackend:
+    """Per-replica wrapper around the factory-made backend: passes the
+    ``fleet.dispatch`` fault site on every live forward (an injected
+    fault there kills THIS replica mid-burst) and fails fast once the
+    replica is dead — a killed process answers nothing."""
+
+    def __init__(self, inner, replica: Replica):
+        self.inner = inner
+        self.replica = replica
+        # proxy the warm-up metadata the server reads
+        for attr in ("input_name", "input_specs", "row_shape",
+                     "input_names"):
+            if hasattr(inner, attr):
+                setattr(self, attr, getattr(inner, attr))
+
+    def load(self):
+        self.inner.load()
+
+    def infer(self, arrays):
+        replica = self.replica
+        if replica.killed:
+            raise ReplicaEvicted(
+                f"replica {replica.id} is dead "
+                f"({replica.kill_reason}); re-dispatch elsewhere")
+        if not replica.warming:
+            # warm-up probes are excluded so a fault plan's Nth-dispatch
+            # rule counts LIVE traffic only — deterministic mid-burst
+            try:
+                faults.fault_point(SITE_DISPATCH)
+            except (InjectedFault, InjectedTimeout):
+                replica.kill(f"injected fault at {SITE_DISPATCH}")
+                raise
+        return self.inner.infer(arrays)
+
+
+class FleetRequest:
+    """The router-side handle a fleet caller waits on. It owns the
+    request identity (``id``) and a FIRST-WINS settle latch: however
+    many replica attempts the request rides, exactly one outcome is
+    ever delivered to the client — the idempotent-re-dispatch contract
+    (dedupe on the request id at the router, never at the replicas)."""
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    __slots__ = ("id", "inputs", "deadline", "tenant", "priority",
+                 "session", "attempts", "_value", "_error", "_settled",
+                 "_lock")
+
+    def __init__(self, inputs, deadline: Deadline,
+                 tenant: str = DEFAULT_TENANT, priority: int = 0,
+                 session: Optional[str] = None, fleet: str = "fleet"):
+        with FleetRequest._seq_lock:
+            FleetRequest._seq += 1
+            self.id = f"{fleet}-{FleetRequest._seq}"
+        self.inputs = inputs
+        self.deadline = deadline
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.session = session
+        #: [(replica, inner Request)] in dispatch order
+        self.attempts: List[Tuple[Replica, object]] = []
+        self._value = None
+        self._error = None
+        self._settled = False
+        self._lock = threading.Lock()
+
+    @property
+    def settled(self) -> bool:
+        return self._settled
+
+    def settle_value(self, value) -> bool:
+        with self._lock:
+            if self._settled:
+                return False
+            self._value = value
+            self._settled = True
+            return True
+
+    def settle_error(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._settled:
+                return False
+            self._error = error
+            self._settled = True
+            return True
+
+    def deliver(self):
+        """Replay the settled outcome — ``result()`` on an already
+        settled request returns the SAME value (or raises the same
+        error), never a second delivery."""
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def prior_value(self):
+        """``(True, value)`` when any earlier attempt already completed
+        with a value — a dead replica that had in fact processed the
+        request before failing over. The router delivers that instead
+        of re-running the work."""
+        for _, inner in self.attempts[:-1]:
+            status, payload = inner.peek()
+            if status == "value":
+                return True, payload
+        return False, None
+
+
+class FleetRouter:
+    """N replica servers behind one router (docs/how_to/fleet.md).
+
+    Parameters
+    ----------
+    backend_factory : ``f(replica_id, model_source) -> backend``.
+        Called once per replica spawn; ``model_source`` is whatever
+        ``reload()`` was announced with (None for the initial model), so
+        a factory can load the named checkpoint manifest.
+    replicas / standbys : ACTIVE serving replicas and warm standbys
+        (defaults: ``MXTPU_FLEET_REPLICAS`` / 1).
+    probe : injectable health probe ``f(replica) -> bool``; the default
+        reports a replica down when it is killed, closed, or (threaded
+        mode) its worker pool is empty.
+    probe_period : seconds between probe passes on the injectable clock
+        (``MXTPU_FLEET_PROBE_PERIOD``); ``tick()`` more often is a no-op.
+    evict_after : consecutive failed probes that evict a replica
+        (``MXTPU_FLEET_EVICT_AFTER``).
+    error_rate / error_min_calls : evict a replica whose failure
+        fraction over at least ``error_min_calls`` outcomes since the
+        last window reaches ``error_rate`` — the breaker-independent
+        fleet-level bound.
+    max_redispatch : failed replica attempts one request may ride
+        before its last error is delivered as terminal (default:
+        ``replicas + standbys + 1``).
+    initial_model : model source for the first generation (manifest
+        path / dict / version int / None = unversioned).
+    drain_grace : seconds a threaded retiring replica may spend
+        finishing its backlog.
+    seed : seeded-kill RNG override (default: the armed fault plan's
+        seed, the MeshHealth convention).
+    clock : injectable time source shared with every replica server.
+    server_kwargs : forwarded to every :class:`InferenceServer`
+        (``workers``, ``capacity``, ``max_batch``, ``buckets``,
+        ``default_deadline``, ...). ``workers=0`` makes the whole fleet
+        deterministic: ``run_pending()``/``predict()`` drive it from the
+        calling thread. Per-replica breakers are created per server;
+        pass ``breaker_factory`` instead of a shared ``breaker``.
+    """
+
+    def __init__(self, backend_factory: Callable, *, name: str = "fleet",
+                 replicas: Optional[int] = None, standbys: int = 1,
+                 probe: Optional[Callable[[Replica], bool]] = None,
+                 probe_period: Optional[float] = None,
+                 evict_after: Optional[int] = None,
+                 error_rate: float = 0.5, error_min_calls: int = 8,
+                 max_redispatch: Optional[int] = None,
+                 initial_model=None, drain_grace: float = 30.0,
+                 seed: Optional[int] = None,
+                 breaker_factory: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 **server_kwargs):
+        from .. import config as _config
+        if "breaker" in server_kwargs:
+            raise MXNetError(
+                "a fleet needs one breaker PER replica; pass "
+                "breaker_factory=... instead of a shared breaker")
+        self.name = name
+        self.backend_factory = backend_factory
+        if replicas is None:
+            replicas = _config.get("MXTPU_FLEET_REPLICAS")
+        if replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        if probe_period is None:
+            probe_period = _config.get("MXTPU_FLEET_PROBE_PERIOD")
+        if evict_after is None:
+            evict_after = _config.get("MXTPU_FLEET_EVICT_AFTER")
+        if evict_after < 1:
+            raise ValueError("evict_after must be >= 1")
+        self.n_replicas = int(replicas)
+        self.n_standbys = max(0, int(standbys))
+        self.probe_period = float(probe_period)
+        self.evict_after = int(evict_after)
+        self.error_rate = float(error_rate)
+        self.error_min_calls = int(error_min_calls)
+        self.max_redispatch = (self.n_replicas + self.n_standbys + 1
+                               if max_redispatch is None
+                               else int(max_redispatch))
+        self.drain_grace = float(drain_grace)
+        self.clock = clock
+        self._seed = seed
+        self._probe_fn = probe or self._default_probe
+        self._breaker_factory = breaker_factory
+        self._server_kwargs = dict(server_kwargs)
+        self._workers0 = self._server_kwargs.get("workers", 1) == 0
+        tenants = self._server_kwargs.pop("tenants", None)
+        if isinstance(tenants, str):
+            tenants = TenantPolicy.parse(tenants)
+        self._tenants = tenants
+        # THE shared stride: one fair-share clock set for every replica
+        # queue, so a tenant's weighted share is fleet-global
+        self._stride = StrideScheduler()
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, Replica] = {}
+        self._retired: List[Replica] = []
+        self._sessions: Dict[str, Optional[str]] = {}
+        self._seq = 0
+        self._kills = 0
+        self._last_probe: Optional[float] = None
+        self._closed = False
+        self._totals: Dict[str, float] = {
+            "submitted": 0, "delivered": 0, "failed_terminal": 0,
+            "re_routed": 0, "dedup_hits": 0, "evictions": 0,
+            "failovers": 0, "failovers_without_standby": 0,
+            "probes": 0, "probe_failures": 0, "shed_on_eviction": 0,
+            "standby_spawns": 0, "spawn_failures": 0,
+            "reload_generations": 0, "sessions_relocated": 0,
+            "last_standby_ready_s": 0.0}
+        self._stride.shared = True   # pruning must never drop another
+        # replica queue's tenant clocks (StrideScheduler.pick)
+        self.model_version, self.model_uid = \
+            self._resolve_model(initial_model)
+        self._model_source = initial_model
+        try:
+            for _ in range(self.n_replicas):
+                self._spawn(ACTIVE, self.model_version, self.model_uid,
+                            initial_model)
+            for _ in range(self.n_standbys):
+                self._spawn(STANDBY, self.model_version, self.model_uid,
+                            initial_model)
+        except BaseException:
+            # a later spawn failing must not strand the earlier
+            # replicas' worker threads + endpoint-registry entries
+            with self._lock:
+                members = list(self._replicas.values())
+            for replica in members:
+                replica.server.close(join_timeout=0.1)
+            raise
+        with _fleets_lock:
+            _FLEETS[name] = self
+
+    # -- counters ------------------------------------------------------------
+
+    def _count(self, key: str, n=1):
+        with self._lock:
+            self._totals[key] = self._totals.get(key, 0) + n
+
+    # -- spawn / model -------------------------------------------------------
+
+    @staticmethod
+    def _resolve_model(source):
+        """(version, uid) from a reload announcement: an int version, a
+        (version, uid) pair, or a checkpoint manifest (path / prefix /
+        dict) read via :func:`model_version_info`. None = unversioned."""
+        if source is None:
+            return None, None
+        if isinstance(source, int):
+            return source, None
+        if isinstance(source, tuple):
+            return (None if source[0] is None else int(source[0]),
+                    source[1])
+        return model_version_info(source)
+
+    def _spawn(self, state: str, version, uid, source) -> Replica:
+        """Create, load, and WARM one replica; the measured ``ready_s``
+        is the standby-promotion latency the compile cache buys down."""
+        with self._lock:
+            self._seq += 1
+            rid = f"r{self._seq}"
+        replica = Replica(rid, version, uid, source)
+        try:
+            backend = _ReplicaBackend(self.backend_factory(rid, source),
+                                      replica)
+        except BaseException:
+            self._count("spawn_failures")
+            raise
+        kwargs = dict(self._server_kwargs)
+        if self._breaker_factory is not None:
+            kwargs["breaker"] = self._breaker_factory()
+        server = InferenceServer(
+            backend, name=f"{self.name}/{rid}", clock=self.clock,
+            tenants=self._tenants, stride=self._stride, **kwargs)
+        replica.server = server
+        t0 = self.clock()
+        try:
+            server.warm_up()
+        except BaseException:
+            self._count("spawn_failures")
+            server.close(join_timeout=0.1)
+            raise
+        replica.warming = False
+        replica.ready_s = self.clock() - t0
+        replica.state = state
+        replica._err_base = (0, 0)
+        with self._lock:
+            self._replicas[rid] = replica
+        self._count("standby_spawns")
+        return replica
+
+    # -- routing -------------------------------------------------------------
+
+    def _active(self, exclude=()) -> List[Replica]:
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.state == ACTIVE and not r.killed
+                    and r.id not in exclude]
+
+    def _route(self, session: Optional[str], exclude=()) -> Replica:
+        """Least-loaded pick over the ACTIVE replicas; a ``session``
+        sticks to the replica pinned to it (the decode slot holding its
+        state lives there) until that replica leaves the fleet."""
+        active = self._active(exclude)
+        if not active:
+            raise FleetUnavailable(
+                f"fleet {self.name!r}: no active replica can take the "
+                "request (evicted/draining/promoting); retry shortly")
+        if session is not None:
+            pinned = self._pinned_live(session)
+            if pinned is not None:
+                # a LIVE home is sticky unconditionally — even when it
+                # just rejected a submit (`exclude`): the decode slot
+                # state lives there, so the rejection must surface to
+                # the caller (see _dispatch), never turn into a silent
+                # re-pin that strands the state
+                return pinned
+            # no live home: fall through to the least-loaded pick. The
+            # pin is committed only when a submit SUCCEEDS there
+            # (_commit_pin) — a freshly-chosen replica that rejects
+            # must not become the session's home
+        return min(active, key=lambda r: (r.server.load_factor(), r.id))
+
+    def _commit_pin(self, session: str, replica: Replica):
+        """Record ``replica`` as the session's home, called on a
+        SUCCESSFUL submit only. A prior entry (a live pin elsewhere
+        cannot reach here; an eviction/retire tombstone or a dead pin
+        can) means the session's old home died — the relocation is
+        counted, and the client must re-seed its decode state."""
+        missing = object()
+        with self._lock:
+            prior = self._sessions.get(session, missing)
+            if prior is not missing and prior != replica.id:
+                self._totals["sessions_relocated"] += 1
+            if len(self._sessions) > 65536:
+                # soft cap against unbounded session names: drop
+                # tombstones first; past that, the OLDEST pins go —
+                # an overflowing live session loses stickiness (its
+                # next submit re-pins and counts as relocated), which
+                # is the deliberate trade against unbounded memory, so
+                # say it out loud
+                self._sessions = {s: p for s, p
+                                  in self._sessions.items()
+                                  if p is not None}
+                if len(self._sessions) > 65536:
+                    logging.warning(
+                        "fleet %s: > 65536 live session pins; evicting "
+                        "the oldest (their next submit re-pins)",
+                        self.name)
+                while len(self._sessions) > 65536:
+                    self._sessions.pop(next(iter(self._sessions)))
+            self._sessions[session] = replica.id
+
+    def submit(self, inputs, deadline: Optional[float] = None,
+               tenant: str = DEFAULT_TENANT, priority: int = 0,
+               session: Optional[str] = None) -> FleetRequest:
+        """Admit a request into the fleet; returns a waitable
+        :class:`FleetRequest`. Routing is least-loaded over ACTIVE
+        replicas (sticky under ``session``); a replica that sheds
+        (QueueFull / Draining / CircuitOpen / closed) is skipped and the
+        next one tried — only a fleet-wide rejection reaches the
+        caller."""
+        if self._closed:
+            raise ServerClosed(f"fleet {self.name!r} is shut down")
+        freq = FleetRequest(inputs, Deadline(deadline, self.clock),
+                            tenant=tenant, priority=priority,
+                            session=session, fleet=self.name)
+        self._dispatch(freq)
+        self._count("submitted")
+        return freq
+
+    def _pinned_live(self, session: Optional[str]) -> Optional[Replica]:
+        """The session's pinned replica IF it is still an alive ACTIVE
+        member, else None."""
+        if session is None:
+            return None
+        with self._lock:
+            pin = self._sessions.get(session)
+            replica = self._replicas.get(pin) if pin else None
+        if replica is not None and replica.state == ACTIVE \
+                and not replica.killed:
+            return replica
+        return None
+
+    def _dispatch(self, freq: FleetRequest, exclude=()):
+        """Route + submit one attempt; on a replica-local rejection move
+        on to the next replica (``exclude`` pre-seeds replicas a prior
+        attempt already failed on). Raises when no replica admits it."""
+        tried = set(exclude)
+        last_err = None
+        while True:
+            try:
+                replica = self._route(freq.session, exclude=tried)
+            except FleetUnavailable:
+                raise last_err or FleetUnavailable(
+                    f"fleet {self.name!r}: every active replica "
+                    "rejected the request")
+            try:
+                inner = replica.server.submit(
+                    freq.inputs, deadline=freq.deadline.remaining(),
+                    tenant=freq.tenant, priority=freq.priority)
+            except (QuotaExceeded, RequestTooLarge):
+                # tenant-quota and client errors are verdicts on the
+                # REQUEST, not the replica — another box changes nothing
+                raise
+            except (QueueFull, Draining, ServerClosed, CircuitOpen,
+                    ReplicaEvicted) as err:
+                if self._pinned_live(freq.session) is replica:
+                    # the session's LIVE home rejected this submit: its
+                    # decode state lives there, so the (retriable)
+                    # rejection goes to the caller — re-routing would
+                    # silently strand the state on the old replica
+                    raise
+                tried.add(replica.id)
+                last_err = err
+                continue
+            replica.routed += 1
+            if freq.session is not None:
+                self._commit_pin(freq.session, replica)
+            freq.attempts.append((replica, inner))
+            return inner
+
+    def predict(self, inputs, deadline: Optional[float] = None,
+                tenant: str = DEFAULT_TENANT, priority: int = 0,
+                session: Optional[str] = None):
+        """Synchronous convenience: submit + result (driving the fleet
+        in ``workers=0`` mode)."""
+        return self.result(self.submit(inputs, deadline=deadline,
+                                       tenant=tenant, priority=priority,
+                                       session=session))
+
+    @staticmethod
+    def _retriable(err: BaseException) -> bool:
+        """May this attempt's failure be answered by another replica?
+        Typed retriable rejections, transient backend faults
+        (OSError/TimeoutError — injected kills included), and
+        replica-local verdicts (closed, circuit open) re-dispatch;
+        deadline expiry and client errors are terminal."""
+        if isinstance(err, (DeadlineExceeded, RequestTooLarge,
+                            UnwarmedSignature, QuotaExceeded)):
+            return False
+        if getattr(err, "retriable", False):
+            return True
+        return isinstance(err, (OSError, TimeoutError, ServerClosed,
+                                CircuitOpen))
+
+    def result(self, freq: FleetRequest):
+        """Wait out ``freq``: deliver its replica's answer, or — when
+        the attempt died for a replica-local reason — re-dispatch to a
+        surviving replica, bounded by the deadline and
+        ``max_redispatch``. Exactly ONE outcome is ever delivered
+        (first-wins settle latch; repeated calls replay it), and a dead
+        replica's late value is preferred over re-running the work
+        (``prior_value`` dedupe)."""
+        if freq.settled:
+            return freq.deliver()
+        while True:
+            replica, inner = freq.attempts[-1]
+            if self._workers0:
+                self.run_pending()
+            try:
+                value = replica.server.result(inner)
+            except Exception as err:      # noqa: BLE001 — triaged below
+                if not self._retriable(err) or freq.deadline.expired():
+                    freq.settle_error(err)
+                    self._count("failed_terminal")
+                    raise
+                # dedupe on the request id: an earlier attempt that in
+                # fact completed (the dead replica HAD processed it)
+                # wins over running the request a second time
+                done, prior = freq.prior_value()
+                if done:
+                    freq.settle_value(prior)
+                    self._count("dedup_hits")
+                    self._count("delivered")
+                    return prior
+                if len(freq.attempts) > self.max_redispatch:
+                    freq.settle_error(err)
+                    self._count("failed_terminal")
+                    raise
+                replica.re_routed_from += 1
+                self._count("re_routed")
+                try:
+                    self._redispatch(freq)
+                except Exception as derr:  # noqa: BLE001 — terminal
+                    freq.settle_error(derr)
+                    self._count("failed_terminal")
+                    raise
+                continue
+            freq.settle_value(value)
+            self._count("delivered")
+            return freq.deliver()
+
+    def _redispatch(self, freq: FleetRequest):
+        """Failover dispatch: PREFER a replica no prior attempt failed
+        on — a broken-but-alive replica must not absorb every retry
+        while healthy survivors sit idle — but fall back to the
+        attempted set when nothing else will take it (a transient
+        failure on the only live replica retries there, it does not
+        die). Sessions skip the exclusion: their live home IS the
+        right replica to retry."""
+        attempted = {r.id for r, _ in freq.attempts}
+        if freq.session is not None or not attempted:
+            return self._dispatch(freq)
+        try:
+            return self._dispatch(freq, exclude=attempted)
+        except (QuotaExceeded, RequestTooLarge):
+            raise
+        except MXNetError:
+            return self._dispatch(freq)
+
+    def run_pending(self, max_items: Optional[int] = None) -> int:
+        """Drive every ``workers=0`` replica's queue from the calling
+        thread (ACTIVE and DRAINING — a draining replica still owes its
+        backlog answers); returns requests processed."""
+        done = 0
+        with self._lock:
+            members = list(self._replicas.values())
+        for replica in members:
+            server = replica.server
+            if replica.state in (ACTIVE, DRAINING) \
+                    and server._n_workers == 0 and not server._closed:
+                done += server.run_pending(max_items)
+        return done
+
+    # -- health-probe lifecycle ----------------------------------------------
+
+    def _default_probe(self, replica: Replica) -> bool:
+        if replica.killed:
+            return False
+        hz = replica.server.healthz()
+        if not hz["ok"]:
+            return False
+        if replica.server._n_workers > 0 and hz["workers"]["alive"] == 0:
+            return False
+        return True
+
+    def _kill_seed(self) -> int:
+        if self._seed is not None:
+            return self._seed
+        plan = faults.active_plan()
+        return plan.seed if plan is not None else 0
+
+    def _kill_seeded(self):
+        """An injected ``fleet.probe`` fault kills one currently-healthy
+        replica — seeded victim choice, so the same plan kills the same
+        replica every run (the MeshHealth convention)."""
+        with self._lock:
+            alive = sorted((r for r in self._replicas.values()
+                            if not r.killed
+                            and r.state in (ACTIVE, STANDBY, DRAINING)),
+                           key=lambda r: r.id)
+        if not alive:
+            return
+        rng = random.Random(self._kill_seed() * 1000003 + self._kills)
+        self._kills += 1
+        alive[rng.randrange(len(alive))].kill(
+            f"injected fault at {SITE_PROBE}")
+
+    def tick(self) -> bool:
+        """One maintenance pass, period-gated on the injectable clock:
+        probe health, evict, promote. Call it from the serving control
+        loop (the smoke/bench drive it between results); returns True
+        when a probe pass actually ran."""
+        now = self.clock()
+        if self._last_probe is not None \
+                and now - self._last_probe < self.probe_period:
+            return False
+        self._last_probe = now
+        self.probe_once()
+        return True
+
+    def probe_once(self):
+        """Probe every ACTIVE/STANDBY replica once (no period gate)."""
+        with self._lock:
+            members = [r for r in self._replicas.values()
+                       if r.state in (ACTIVE, STANDBY)]
+        for replica in members:
+            self._count("probes")
+            try:
+                faults.fault_point(SITE_PROBE)
+            except (InjectedFault, InjectedTimeout):
+                self._kill_seeded()
+            if self._probe_fn(replica):
+                replica.probe_failures = 0
+            else:
+                replica.probe_failures += 1
+                self._count("probe_failures")
+                if replica.probe_failures >= self.evict_after:
+                    self._evict(replica,
+                                f"failed {replica.probe_failures} "
+                                "consecutive probes")
+                    continue
+            self._check_error_rate(replica)
+
+    def _check_error_rate(self, replica: Replica):
+        """The breaker-independent fleet bound: a replica whose failure
+        fraction since the last window reaches ``error_rate`` over at
+        least ``error_min_calls`` outcomes is evicted outright — an
+        error-spewing box is worse than a silent one."""
+        if replica.state != ACTIVE:
+            return
+        srv = replica.server
+        with srv._lock:
+            completed = srv._stats["completed"]
+            failed = srv._stats["failed"]
+        base_c, base_f = replica._err_base
+        d_total = (completed - base_c) + (failed - base_f)
+        if d_total < self.error_min_calls:
+            return
+        rate = (failed - base_f) / float(d_total)
+        replica._err_base = (completed, failed)
+        if rate >= self.error_rate:
+            self._evict(replica,
+                        f"error rate {rate:.2f} over {d_total} calls "
+                        f">= bound {self.error_rate}")
+
+    def kill_replica(self, rid: str, reason: str = "operator kill"):
+        """Mark one replica dead (tests / chaos drills); the next probe
+        pass evicts it."""
+        with self._lock:
+            replica = self._replicas[rid]
+        replica.kill(reason)
+
+    def _evict(self, replica: Replica, reason: str):
+        """The eviction ladder's last rung: shed the backlog with the
+        retriable :class:`ReplicaEvicted` (waiting callers re-dispatch),
+        drop the replica's session pins, close it, promote a standby."""
+        if replica.state in (EVICTED, RETIRED):
+            return
+        was_active = replica.state == ACTIVE
+        replica.state = EVICTED
+        self._count("evictions")
+        logging.warning("fleet %s: evicting replica %s (%s)", self.name,
+                        replica.id, reason)
+        with self._lock:
+            for session, pin in list(self._sessions.items()):
+                if pin == replica.id:
+                    self._sessions[session] = None   # tombstone: the
+                    # session HAD a home; its next submit re-pins and
+                    # counts as a relocation
+        shed = replica.server.shed_queued(
+            lambda req, _r=replica, _why=reason: ReplicaEvicted(
+                f"replica {_r.id} evicted ({_why}); the router is "
+                "re-dispatching this request"))
+        if shed:
+            self._count("shed_on_eviction", shed)
+        replica.server.close(join_timeout=0.1)
+        self._retire_record(replica)
+        if was_active:
+            self._promote_standby()
+        else:
+            # a dead STANDBY degrades the warm-failover pool just as
+            # surely as a promotion consuming one — replenish either way
+            self._replenish_standbys()
+
+    def _promote_standby(self):
+        """Failover: flip a warm standby ACTIVE (its measured
+        ``ready_s`` is the promotion latency) and replenish the pool;
+        with no standby on hand, spawn straight into ACTIVE."""
+        with self._lock:
+            standby = next(
+                (r for r in sorted(self._replicas.values(),
+                                   key=lambda r: r.id)
+                 if r.state == STANDBY and not r.killed
+                 # never promote a standby from another generation — a
+                 # failover must not silently roll the fleet back to a
+                 # model it reloaded off of
+                 and r.model_version == self.model_version), None)
+        if standby is not None:
+            standby.state = ACTIVE
+            standby._err_base = (0, 0)
+            self._count("failovers")
+            with self._lock:
+                self._totals["last_standby_ready_s"] = standby.ready_s
+            logging.warning(
+                "fleet %s: standby %s promoted (warm in %.3fs)",
+                self.name, standby.id, standby.ready_s)
+        else:
+            self._count("failovers_without_standby")
+            try:
+                promoted = self._spawn(ACTIVE, self.model_version,
+                                       self.model_uid, self._model_source)
+                with self._lock:
+                    self._totals["last_standby_ready_s"] = promoted.ready_s
+            except Exception as err:    # noqa: BLE001 — fleet degrades
+                logging.error(
+                    "fleet %s: cold replacement spawn failed (%s); "
+                    "serving degraded on the survivors", self.name, err)
+                return
+        self._replenish_standbys()
+
+    def _replenish_standbys(self):
+        """Spawn standbys until the pool is back at ``n_standbys``
+        (non-fatal on failure: the fleet degrades to cold failover)."""
+        if self.n_standbys <= 0:
+            return
+        while True:
+            with self._lock:
+                n_standby = sum(1 for r in self._replicas.values()
+                                if r.state == STANDBY and not r.killed)
+            if n_standby >= self.n_standbys:
+                return
+            try:
+                self._spawn(STANDBY, self.model_version,
+                            self.model_uid, self._model_source)
+            except Exception as err:  # noqa: BLE001 — non-fatal
+                logging.error(
+                    "fleet %s: standby replenish failed (%s)",
+                    self.name, err)
+                return
+
+    def _retire_record(self, replica: Replica):
+        with self._lock:
+            self._replicas.pop(replica.id, None)
+            self._retired.append(replica)
+            del self._retired[:-16]      # bounded history for stats()
+
+    # -- rolling reload ------------------------------------------------------
+
+    def reload(self, source, force_rollback: bool = False) -> int:
+        """Roll the fleet onto a new model generation with ZERO dropped
+        requests: per active replica, a fresh server loads + warms the
+        new version FIRST, traffic shifts to it, then the old replica
+        drains its backlog and retires. The monotonic ``model_version``
+        gate refuses a non-newer generation without
+        ``force_rollback=True``
+        (:class:`~mxnet_tpu.resilience.RollbackRefused`). Returns the
+        promoted version."""
+        version, uid = self._resolve_model(source)
+        require_newer_version(self.model_version, version,
+                              force_rollback=force_rollback,
+                              what=f"fleet {self.name!r} model")
+        with self._lock:
+            old_actives = sorted(
+                (r for r in self._replicas.values() if r.state == ACTIVE),
+                key=lambda r: r.id)
+        for old in old_actives:
+            if old.state != ACTIVE:      # evicted mid-reload
+                continue
+            # spawn-before-retire IS the zero-drop ordering: a failed
+            # spawn aborts the reload with the old replicas still up
+            fresh = self._spawn(STANDBY, version, uid, source)
+            fresh.state = ACTIVE
+            old.state = DRAINING
+            self._drain_retire(old)
+        # the standby pool follows the new generation: a failover must
+        # never promote the model the fleet just rolled off of
+        with self._lock:
+            stale = [r for r in self._replicas.values()
+                     if r.state == STANDBY and r.model_version != version]
+        for standby in stale:
+            try:
+                self._spawn(STANDBY, version, uid, source)
+            except Exception as err:      # noqa: BLE001 — non-fatal
+                logging.error(
+                    "fleet %s: standby refresh failed (%s); failover "
+                    "is cold until a replenish succeeds", self.name, err)
+            # the stale standby retires EITHER WAY: a cold failover is
+            # degraded, promoting the model the fleet just rolled off
+            # of would be wrong (and _promote_standby refuses it too)
+            standby.state = RETIRED
+            standby.server.close(join_timeout=0.1)
+            self._retire_record(standby)
+        self.model_version, self.model_uid = version, uid
+        self._model_source = source
+        self._count("reload_generations")
+        logging.warning("fleet %s: rolling reload complete — serving "
+                        "model version %s (uid %s)", self.name, version,
+                        uid)
+        return version
+
+    def _drain_retire(self, replica: Replica):
+        """Finish a DRAINING replica's queued + in-flight work, then
+        close and retire it. ``workers=0`` drains synchronously (zero
+        sleeps); threaded mode bounds the drain by ``drain_grace``."""
+        server = replica.server
+        if server._n_workers == 0:
+            server.run_pending()
+            server.close()
+        else:
+            server.drain(grace=self.drain_grace)
+        with self._lock:
+            for session, pin in list(self._sessions.items()):
+                if pin == replica.id:
+                    self._sessions[session] = None   # tombstone
+        replica.state = RETIRED
+        self._retire_record(replica)
+
+    # -- probes / introspection ----------------------------------------------
+
+    def healthz(self) -> Dict:
+        with self._lock:
+            members = list(self._replicas.values())
+        states = {r.id: r.state for r in members}
+        return {
+            "ok": not self._closed and any(
+                r.state == ACTIVE and not r.killed for r in members),
+            "replicas": states,
+            "active": sum(1 for r in members
+                          if r.state == ACTIVE and not r.killed),
+            "standby": sum(1 for r in members
+                           if r.state == STANDBY and not r.killed),
+            "model_version": self.model_version,
+        }
+
+    def readyz(self) -> Dict:
+        hz = self.healthz()
+        reasons = []
+        if self._closed:
+            reasons.append("fleet closed")
+        if hz["active"] == 0:
+            reasons.append("no active replica")
+        elif hz["active"] < self.n_replicas:
+            reasons.append(
+                f"degraded: {hz['active']}/{self.n_replicas} replicas")
+        return {"ready": not reasons, "reasons": reasons}
+
+    def stats(self) -> Dict:
+        """Per-replica counters keyed by replica id plus aggregated
+        totals — the fleet block of ``serving.stats()``, mirroring
+        ``retry.stats()`` conventions (counters only, monotonic)."""
+        with self._lock:
+            members = list(self._replicas.values()) + list(self._retired)
+            totals = dict(self._totals)
+        replicas = {}
+        for r in members:
+            server = r.server
+            with server._lock:
+                completed = server._stats["completed"]
+                failed = server._stats["failed"]
+            replicas[r.id] = {
+                "state": r.state,
+                "endpoint": server.name,
+                "model_version": r.model_version,
+                "killed": r.killed,
+                "probe_failures": r.probe_failures,
+                "ready_s": r.ready_s,
+                "routed": r.routed,
+                "re_routed_from": r.re_routed_from,
+                "completed": completed,
+                "failed": failed,
+            }
+        totals["active_replicas"] = sum(
+            1 for r in members if r.state == ACTIVE and not r.killed)
+        totals["model_version"] = self.model_version
+        totals["sessions_pinned"] = len(self._sessions)
+        return {"replicas": replicas, "totals": totals}
+
+    # -- shutdown ------------------------------------------------------------
+
+    def close(self):
+        """Close every replica and unregister the fleet."""
+        self._closed = True
+        with self._lock:
+            members = list(self._replicas.values())
+        for replica in members:
+            replica.server.close()
+        with _fleets_lock:
+            if _FLEETS.get(self.name) is self:
+                del _FLEETS[self.name]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

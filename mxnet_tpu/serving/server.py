@@ -41,7 +41,7 @@ from ..base import MXNetError
 from ..perf import CompileGuard
 from ..resilience import RetryExhausted, faults, guarded_call
 from .admission import (DEFAULT_TENANT, AdmissionQueue, Deadline, Request,
-                        TenantPolicy)
+                        StrideScheduler, TenantPolicy)
 from .batching import BatchCoalescer
 from .breaker import CircuitBreaker, OPEN
 from .errors import (BatchFailed, CircuitOpen, DeadlineExceeded, Draining,
@@ -124,6 +124,11 @@ class InferenceServer:
         ``MXTPU_TENANT_QUOTAS`` string form) declaring per-tenant
         admission quotas and weighted fair shares; None (default knob)
         disables quotas and serves tenants FIFO.
+    stride : an optional shared :class:`~.admission.StrideScheduler`.
+        The fleet router passes one instance to every replica server so
+        a tenant's weighted fair share is measured across the whole
+        fleet instead of per queue (docs/how_to/fleet.md); standalone
+        servers leave it None and keep their private per-queue clocks.
     clock / wait : injectable time source and event-wait, so every
         deadline/cool-down path is testable with zero real sleeps.
     """
@@ -137,6 +142,7 @@ class InferenceServer:
                  max_batch: Optional[int] = None,
                  batch_wait: Optional[float] = None,
                  tenants: Optional[Union[TenantPolicy, str]] = None,
+                 stride: Optional[StrideScheduler] = None,
                  clock: Callable[[], float] = time.monotonic,
                  wait: Optional[Callable] = None,
                  drain_grace: float = 30.0):
@@ -183,7 +189,8 @@ class InferenceServer:
         self._tenant_stats: Dict[str, Dict[str, int]] = {}
         self._queue = AdmissionQueue(capacity, shed_policy, clock,
                                      tenants=tenants,
-                                     on_tenant_event=self._tenant_count)
+                                     on_tenant_event=self._tenant_count,
+                                     stride=stride)
         self._stats: Dict[str, int] = {
             "admitted": 0, "completed": 0, "failed": 0,
             "shed": 0, "evicted": 0, "rejected_open": 0,
@@ -631,6 +638,25 @@ class InferenceServer:
                 self._coalescer.observe_signature(fed, route)
         outs = backend.infer(fed)
         return self.buckets.slice_outputs(outs, true_rows)
+
+    # -- fleet hooks (mxnet_tpu/serving/fleet.py) -----------------------------
+
+    def load_factor(self) -> int:
+        """Queued + in-flight requests — the router's least-loaded
+        routing signal. Cheap enough to read per submit."""
+        with self._lock:
+            inflight = self._inflight
+        return self._queue.depth() + inflight
+
+    def shed_queued(self, make_error) -> int:
+        """Fail every queued request with ``make_error(request)`` —
+        the fleet eviction path: an evicted replica's backlog becomes
+        typed retriable rejections the router re-dispatches on, never
+        silently stranded work. Returns delivered failures."""
+        shed = self._queue.shed_all(make_error)
+        if shed:
+            self._count("shed", shed)
+        return shed
 
     # -- probes / introspection ----------------------------------------------
 

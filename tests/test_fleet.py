@@ -1,0 +1,768 @@
+"""Serving fleet (mxnet_tpu/serving/fleet.py): replicated routing,
+health-driven eviction, zero-drop rolling reload, chaos.
+
+Every replica runs ``workers=0`` on an injectable FakeClock — the whole
+fleet is driven synchronously from the test thread, zero real sleeps.
+Fault sites ``fleet.probe`` and ``fleet.dispatch`` are armed with
+deterministic seeded :class:`~mxnet_tpu.resilience.FaultPlan` rules (the
+registry-consistency contract for those sites lives here), matching the
+MeshHealth convention: same seed -> same victim, every run.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import resilience, serving
+from mxnet_tpu.resilience import (FaultPlan, RollbackRefused, faults,
+                                  model_version_info,
+                                  require_newer_version)
+from mxnet_tpu.resilience.checkpoint import write_checkpoint
+from mxnet_tpu.serving import (AdmissionQueue, CallableBackend,
+                               FleetRouter, FleetUnavailable, QueueFull,
+                               ReplicaEvicted, Request, StrideScheduler,
+                               TenantPolicy)
+from mxnet_tpu.serving.admission import Deadline
+from mxnet_tpu.serving.fleet import ACTIVE, STANDBY
+
+
+class FakeClock:
+    """A manually driven monotonic clock (may also jump backward)."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    faults.disarm()
+    resilience.reset_stats()
+    yield
+    faults.disarm()
+    resilience.reset_stats()
+    for router in serving.fleets().values():
+        router.close()
+    for srv in serving.endpoints().values():
+        srv.close()
+
+
+def _factory(calls=None):
+    """Backend factory recording (replica_id, live) per infer — the
+    side-effect trace the idempotency tests read. Live traffic carries
+    ones (non-zero even after bucket padding); warm-up probes are all
+    zeros, so ``live`` discriminates them."""
+    def make(rid, source):
+        def fn(arrays, _rid=rid):
+            if calls is not None:
+                calls.append((_rid, bool(arrays["data"].any())))
+            return [np.ascontiguousarray(arrays["data"], np.float32) * 2.0]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+    return make
+
+
+def _live(calls):
+    """The non-warm-up entries of a ``_factory`` trace."""
+    return [c for c in calls if c[1]]
+
+
+def _fleet(clock, *, factory=None, name="flt", **kw):
+    kw.setdefault("replicas", 3)
+    kw.setdefault("standbys", 1)
+    kw.setdefault("workers", 0)
+    kw.setdefault("buckets", [4])
+    kw.setdefault("probe_period", 1.0)
+    kw.setdefault("evict_after", 3)
+    return FleetRouter(factory or _factory(), name=name, clock=clock, **kw)
+
+
+def _ones(rows=1):
+    return np.ones((rows, 3), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# routing: least-loaded, skip-full, sticky sessions
+# ---------------------------------------------------------------------------
+
+def test_least_loaded_routing_spreads_a_burst():
+    clock = FakeClock()
+    fr = _fleet(clock, name="route")
+    reqs = [fr.submit(_ones()) for _ in range(6)]
+    # nothing processed yet: load = queue depth, so the burst spreads
+    # 2-2-2 over the three active replicas
+    depths = sorted(r.server.load_factor()
+                    for r in fr._replicas.values() if r.state == ACTIVE)
+    assert depths == [2, 2, 2]
+    for req in reqs:
+        assert np.all(fr.result(req)[0] == 2.0)
+    assert fr.stats()["totals"]["delivered"] == 6
+
+
+def test_submit_skips_full_replicas_then_sheds():
+    clock = FakeClock()
+    fr = _fleet(clock, name="full", replicas=2, standbys=0, capacity=1)
+    fr.submit(_ones())
+    fr.submit(_ones())            # second replica takes it
+    with pytest.raises(QueueFull):
+        fr.submit(_ones())        # both queues full -> fleet-wide shed
+    assert fr.run_pending() == 2
+
+
+def test_sticky_sessions_pin_and_relocate_on_eviction():
+    clock = FakeClock()
+    fr = _fleet(clock, name="sticky")
+    first = fr.predict(_ones(), session="s1")
+    assert np.all(first[0] == 2.0)
+    home = fr._sessions["s1"]
+    # pile load elsewhere: the session must STAY pinned regardless
+    for _ in range(4):
+        fr.predict(_ones())
+    fr.predict(_ones(), session="s1")
+    assert fr._sessions["s1"] == home
+    routed_home = fr._replicas[home].routed
+    assert routed_home >= 2
+    # eviction unpins; the next sessioned submit re-pins elsewhere
+    fr.kill_replica(home, "test kill")
+    for _ in range(3):
+        fr.probe_once()
+    assert home not in fr._replicas
+    fr.predict(_ones(), session="s1")
+    assert fr._sessions["s1"] != home
+    assert fr.stats()["totals"]["sessions_relocated"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the global stride: one fair-share clock set across every replica queue
+# ---------------------------------------------------------------------------
+
+def test_fleet_queues_share_one_stride_scheduler():
+    clock = FakeClock()
+    fr = _fleet(clock, name="stride")
+    queues = [r.server._queue for r in fr._replicas.values()]
+    assert len({id(q.stride) for q in queues}) == 1
+    assert queues[0].stride is fr._stride
+
+
+def test_shared_stride_makes_fairness_global_across_queues():
+    # the generalization the fleet relies on, proven at the queue
+    # level: tenant a consuming fleet bandwidth through queue 1 leaves
+    # a's GLOBAL clock ahead of b's, so queue 2 serves b first — a
+    # per-queue stride (the PR 10 behavior, asserted as the
+    # counterfactual below) knows nothing of q1 and serves a first.
+    clock = FakeClock()
+    policy = TenantPolicy({"a": {"quota": None, "weight": 1.0},
+                           "b": {"quota": None, "weight": 1.0}})
+
+    def req(tenant, priority=0):
+        return Request({"data": _ones()}, Deadline(None, clock),
+                       tenant=tenant, priority=priority)
+
+    def fill(q):
+        # both tenants become stride incumbents with clocks a=2.0,
+        # b=1.0 (the trailing low-priority a keeps the queue mixed, so
+        # b's pick goes through the stride, not the fast path)
+        q.offer(req("a", priority=1))
+        q.offer(req("a", priority=1))
+        q.offer(req("b", priority=1))
+        q.offer(req("a", priority=0))
+        assert [q.poll().tenant for _ in range(4)] == ["a", "a", "b", "a"]
+
+    shared = StrideScheduler()
+    q1 = AdmissionQueue(8, clock=clock, tenants=policy, stride=shared)
+    q2 = AdmissionQueue(8, clock=clock, tenants=policy, stride=shared)
+    fill(q1)
+    assert shared.clocks() == {"a": 2.0, "b": 1.0}
+    q2.offer(req("a"))
+    q2.offer(req("b"))
+    # global clocks: b is owed bandwidth fleet-wide -> b dequeues first
+    assert [q2.poll().tenant, q2.poll().tenant] == ["b", "a"]
+
+    # counterfactual: private per-queue strides (no sharing) — q2 knows
+    # nothing of q1's traffic and serves a first (the name tie at the
+    # newcomer floor)
+    p1 = AdmissionQueue(8, clock=clock, tenants=policy)
+    p2 = AdmissionQueue(8, clock=clock, tenants=policy)
+    fill(p1)
+    p2.offer(req("a"))
+    p2.offer(req("b"))
+    assert [p2.poll().tenant, p2.poll().tenant] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# health probes: eviction ladder, seeded kills, error-rate bound
+# ---------------------------------------------------------------------------
+
+def test_eviction_needs_k_consecutive_probe_failures():
+    clock = FakeClock()
+    fr = _fleet(clock, name="ladder", evict_after=3)
+    fr.kill_replica("r1", "test")
+    fr.probe_once()
+    fr.probe_once()
+    assert "r1" in fr._replicas          # 2 < evict_after: still listed
+    assert fr._replicas["r1"].probe_failures == 2
+    fr.probe_once()
+    assert "r1" not in fr._replicas      # 3rd failure evicts
+    stats = fr.stats()["totals"]
+    assert stats["evictions"] == 1
+    assert stats["failovers"] == 1       # the standby took its place
+    assert stats["probe_failures"] == 3
+    assert fr.healthz()["active"] == 3   # fleet back at strength
+
+
+def test_probe_recovery_resets_the_failure_streak():
+    clock = FakeClock()
+    flaky = {"down": False}
+    fr = _fleet(clock, name="flaky", evict_after=3,
+                probe=lambda replica: not (flaky["down"]
+                                           and replica.id == "r1"))
+    flaky["down"] = True
+    fr.probe_once()
+    fr.probe_once()
+    flaky["down"] = False                # transient blip heals
+    fr.probe_once()
+    assert fr._replicas["r1"].probe_failures == 0
+    assert "r1" in fr._replicas
+    assert fr.stats()["totals"]["evictions"] == 0
+
+
+def test_tick_is_period_gated_on_the_injectable_clock():
+    clock = FakeClock()
+    fr = _fleet(clock, name="tick", probe_period=5.0)
+    assert fr.tick()                     # first tick always probes
+    assert not fr.tick()                 # same instant: gated
+    clock.advance(4.9)
+    assert not fr.tick()
+    clock.advance(0.2)
+    assert fr.tick()
+
+
+def test_injected_probe_fault_kills_a_seeded_replica():
+    clock = FakeClock()
+    victims = []
+    for _ in range(2):                   # same plan -> same victim
+        faults.arm(FaultPlan(seed=11).arm("fleet.probe", nth=1))
+        fr = _fleet(clock, name="seeded")
+        fr.probe_once()
+        victims.append(sorted(r.id for r in fr._replicas.values()
+                              if r.killed))
+        fr.close()
+        faults.disarm()
+    assert victims[0] == victims[1]
+    assert len(victims[0]) == 1
+
+
+def test_error_rate_bound_evicts_a_failing_replica():
+    clock = FakeClock()
+
+    def make(rid, source):
+        def fn(arrays, _rid=rid):
+            # r1 fails every LIVE forward (warm-up probes are zeros and
+            # pass — the replica came up healthy, then went rotten)
+            if _rid == "r1" and arrays["data"].any():
+                raise OSError(f"replica {_rid} backend rotten")
+            return [arrays["data"] * 2.0]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+
+    fr = _fleet(clock, factory=make, name="errate", replicas=1,
+                standbys=1, error_rate=0.5, error_min_calls=4,
+                max_redispatch=0)
+    for _ in range(4):
+        with pytest.raises(OSError):
+            fr.predict(_ones())
+    fr.probe_once()                      # error-rate check runs here
+    assert "r1" not in fr._replicas
+    stats = fr.stats()["totals"]
+    assert stats["evictions"] == 1 and stats["failovers"] == 1
+    # the promoted standby (r2: healthy backend) serves
+    assert np.all(fr.predict(_ones())[0] == 2.0)
+
+
+def test_fleet_unavailable_when_every_replica_is_gone():
+    clock = FakeClock()
+    spawned = []
+
+    def make(rid, source):
+        if len(spawned) >= 1:            # only the first spawn succeeds
+            raise mx.base.MXNetError("artifact store down")
+        spawned.append(rid)
+        return CallableBackend(lambda a: [a["data"] * 2.0],
+                               input_specs={"data": (3,)})
+
+    fr = _fleet(clock, factory=make, name="empty", replicas=1, standbys=0)
+    fr.kill_replica("r1", "test")
+    for _ in range(3):
+        fr.probe_once()                  # evict; replacement spawn fails
+    assert fr.healthz()["active"] == 0
+    with pytest.raises(FleetUnavailable):
+        fr.submit(_ones())
+    assert fr.stats()["totals"]["failovers_without_standby"] == 1
+    assert fr.stats()["totals"]["spawn_failures"] == 1
+
+
+# ---------------------------------------------------------------------------
+# re-route idempotency: exactly-once delivery across replica attempts
+# ---------------------------------------------------------------------------
+
+def test_reroute_after_dispatch_kill_delivers_exactly_once():
+    clock = FakeClock()
+    calls = []
+    # the 1st LIVE dispatch dies (fleet.dispatch) — its replica is
+    # killed mid-forward, the request re-routes to a survivor
+    faults.arm(FaultPlan(seed=3).arm("fleet.dispatch", nth=1))
+    fr = _fleet(clock, factory=_factory(calls), name="once", replicas=2,
+                standbys=0)
+    freq = fr.submit(_ones())
+    out = fr.result(freq)
+    assert np.all(out[0] == 2.0)
+    stats = fr.stats()["totals"]
+    assert stats["re_routed"] == 1
+    assert stats["delivered"] == 1
+    # the dead replica never produced a value (killed BEFORE its model
+    # ran), the survivor produced exactly one live forward
+    assert len(_live(calls)) == 1
+    # repeated result() replays the settled outcome — never a second
+    # delivery, even after the dead replica's zombie completes late
+    dead_inner = freq.attempts[0][1]
+    dead_inner.complete([np.zeros((1, 3), np.float32)])
+    again = fr.result(freq)
+    assert again is out
+
+
+def test_reroute_dedupes_on_a_prior_attempts_late_value():
+    # the dead replica HAD processed the request (its value raced in
+    # while the router was failing over): the router must deliver THAT
+    # value once, not run the request a second time
+    clock = FakeClock()
+    calls = []
+    fr = _fleet(clock, factory=_factory(calls), name="dedupe",
+                replicas=2, standbys=0)
+    freq = fr.submit(_ones())
+    first_replica, inner1 = freq.attempts[0]
+    # the replica's worker completed the forward just as the process
+    # died — the value exists, the router only sees the failover
+    inner1.start(None)
+    inner1.complete([np.full((1, 3), 42.0, np.float32)])
+    fr._dispatch(freq)                   # the failover attempt
+    second_replica, inner2 = freq.attempts[1]
+    assert second_replica.id != first_replica.id
+    fr.kill_replica(second_replica.id, "second box dies too")
+    out = fr.result(freq)                # attempt 2 fails retriable ->
+    assert np.all(out[0] == 42.0)        # prior value wins, exactly once
+    totals = fr.stats()["totals"]
+    assert totals["dedup_hits"] == 1
+    assert totals["delivered"] == 1
+    # NO backend ever ran the request (warm-up probes aside)
+    assert _live(calls) == []
+
+
+def test_evicted_backlog_is_shed_retriable_and_redispatched():
+    clock = FakeClock()
+    fr = _fleet(clock, name="backlog")
+    reqs = [fr.submit(_ones()) for _ in range(6)]
+    victim = next(iter(fr._replicas))    # holds ~2 queued requests
+    fr.kill_replica(victim, "test")
+    for _ in range(3):
+        fr.probe_once()
+    # the shed backlog was failed with the retriable ReplicaEvicted;
+    # result() re-dispatches them to the survivors — zero loss
+    for req in reqs:
+        assert np.all(fr.result(req)[0] == 2.0)
+    totals = fr.stats()["totals"]
+    assert totals["shed_on_eviction"] == 2
+    assert totals["re_routed"] == 2
+    assert totals["delivered"] == 6
+
+
+def test_redispatch_prefers_an_unattempted_replica():
+    # a broken-but-alive replica must not absorb every retry while a
+    # healthy survivor sits idle: the failover excludes replicas prior
+    # attempts already failed on
+    clock = FakeClock()
+
+    def make(rid, source):
+        def fn(arrays, _rid=rid):
+            if _rid == "r1" and arrays["data"].any():
+                raise OSError("r1 flaky")      # alive, but failing live
+            return [arrays["data"] * 2.0]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+
+    fr = _fleet(clock, factory=make, name="prefer", replicas=2,
+                standbys=0)
+    freq = fr.submit(_ones())                  # r1 first (id tie-break)
+    out = fr.result(freq)
+    assert np.all(out[0] == 2.0)               # ...but r2 delivered
+    assert [r.id for r, _ in freq.attempts] == ["r1", "r2"]
+    totals = fr.stats()["totals"]
+    assert totals["re_routed"] == 1            # ONE failover, not a
+    assert totals["delivered"] == 1            # burn-down on r1
+
+
+def test_redispatch_falls_back_to_the_only_replica():
+    # a transient failure on the ONLY live replica retries there —
+    # exclusion must not turn one flake into a terminal error
+    clock = FakeClock()
+    state = {"failed": False}
+
+    def make(rid, source):
+        def fn(arrays):
+            if arrays["data"].any() and not state["failed"]:
+                state["failed"] = True
+                raise OSError("one transient flake")
+            return [arrays["data"] * 2.0]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+
+    fr = _fleet(clock, factory=make, name="onlyone", replicas=1,
+                standbys=0)
+    out = fr.predict(_ones())
+    assert np.all(out[0] == 2.0)
+    assert fr.stats()["totals"]["re_routed"] == 1
+
+
+def test_sticky_session_surfaces_a_live_homes_rejection():
+    # the home replica is ALIVE but its queue is full: the rejection
+    # must reach the caller (retriable — the client backs off and
+    # retries the same home), never silently re-pin the session and
+    # strand its decode slot state
+    clock = FakeClock()
+    fr = _fleet(clock, name="stickyfull", replicas=2, standbys=0,
+                capacity=1)
+    fr.predict(_ones(), session="s1")
+    home = fr._sessions["s1"]
+    fr._replicas[home].server.submit(_ones())  # fill the home's queue
+    with pytest.raises(QueueFull):
+        fr.submit(_ones(), session="s1")
+    assert fr._sessions["s1"] == home          # pin untouched
+    assert fr.stats()["totals"]["sessions_relocated"] == 0
+    fr.run_pending()
+    assert np.all(fr.predict(_ones(), session="s1")[0] == 2.0)
+
+
+def test_standby_eviction_replenishes_the_pool():
+    clock = FakeClock()
+    fr = _fleet(clock, name="standby-death", replicas=2, standbys=1)
+    standby = next(r.id for r in fr._replicas.values()
+                   if r.state == STANDBY)
+    fr.kill_replica(standby, "standby dies quietly")
+    for _ in range(3):
+        fr.probe_once()
+    hz = fr.healthz()
+    assert hz["active"] == 2 and hz["standby"] == 1   # pool refilled
+    totals = fr.stats()["totals"]
+    assert totals["evictions"] == 1
+    assert totals["failovers"] == 0            # nothing was promoted
+
+
+def test_init_spawn_failure_closes_the_partial_fleet():
+    clock = FakeClock()
+    spawned = []
+
+    def make(rid, source):
+        if len(spawned) >= 2:                  # third spawn dies
+            raise mx.base.MXNetError("artifact store down")
+        spawned.append(rid)
+        return CallableBackend(lambda a: [a["data"] * 2.0],
+                               input_specs={"data": (3,)})
+
+    before = set(serving.endpoints())
+    with pytest.raises(mx.base.MXNetError):
+        _fleet(clock, factory=make, name="halfborn", replicas=3,
+               standbys=0)
+    # the two replicas that DID come up were closed and unregistered —
+    # no leaked worker threads or endpoint-registry entries
+    assert set(serving.endpoints()) == before
+    assert "halfborn" not in serving.fleets()
+
+
+def test_replica_evicted_error_is_typed_retriable():
+    err = ReplicaEvicted("gone")
+    assert err.retriable is True
+    assert isinstance(err, serving.ServingError)
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance: kill 1 of 3 mid-burst, zero request loss
+# ---------------------------------------------------------------------------
+
+def test_chaos_kill_one_of_three_replicas_mid_burst():
+    """ISSUE 11 acceptance: a seeded FaultPlan kills one replica on its
+    3rd live dispatch, mid-burst. Every one of the 24 submitted requests
+    must get a terminal response (zero loss), the eviction + failover
+    counters must be observable in serving.stats(), and the correctness
+    of every delivered answer is asserted. (The p99-vs-no-fault bound is
+    measured where wall time is real: ci/fleet_smoke.py and the
+    bench_fleet chaos leg — this test's clock is fake.)"""
+    clock = FakeClock()
+    faults.arm(FaultPlan(seed=7).arm("fleet.dispatch", nth=3))
+    fr = _fleet(clock, name="chaos")
+    n = 24
+    reqs = [fr.submit(_ones()) for _ in range(n)]
+    delivered = 0
+    for i, req in enumerate(reqs):
+        # the maintenance loop keeps ticking between results, exactly
+        # as a control loop would; the period gate rides the fake clock
+        clock.advance(1.1)
+        fr.tick()
+        out = fr.result(req)
+        assert np.all(out[0] == 2.0)
+        delivered += 1
+    assert delivered == n                # ZERO request loss
+    fleet_block = serving.stats()["fleet"]["chaos"]
+    totals = fleet_block["totals"]
+    assert totals["evictions"] == 1
+    assert totals["failovers"] == 1
+    assert totals["re_routed"] >= 1      # the killed dispatch re-rode
+    assert totals["delivered"] == n
+    assert totals["failed_terminal"] == 0
+    # the evicted replica is visible per-id in the fleet block
+    evicted = [rid for rid, rec in fleet_block["replicas"].items()
+               if rec["state"] == "evicted"]
+    assert len(evicted) == 1
+    assert fleet_block["replicas"][evicted[0]]["killed"]
+    # and the fleet healed back to full strength from the warm standby
+    assert fr.healthz()["active"] == 3
+
+
+def test_chaos_is_deterministic_for_a_fixed_seed():
+    outcomes = []
+    for _ in range(2):
+        clock = FakeClock()
+        faults.arm(FaultPlan(seed=7).arm("fleet.dispatch", nth=3))
+        fr = _fleet(clock, name="chaos-det")
+        reqs = [fr.submit(_ones()) for _ in range(12)]
+        for req in reqs:
+            clock.advance(1.1)
+            fr.tick()
+            fr.result(req)
+        dead = sorted(rec["endpoint"]
+                      for rec in fr.stats()["replicas"].values()
+                      if rec["killed"])
+        outcomes.append((dead, fr.stats()["totals"]["re_routed"]))
+        fr.close()
+        faults.disarm()
+    assert outcomes[0] == outcomes[1]
+
+
+# ---------------------------------------------------------------------------
+# rolling reload: version gate + zero dropped requests
+# ---------------------------------------------------------------------------
+
+def _versioned_factory(calls=None):
+    def make(rid, source):
+        scale = float(source if isinstance(source, int) else 1)
+
+        def fn(arrays, _rid=rid, _s=scale):
+            if calls is not None:
+                calls.append((_rid, _s))
+            return [np.ascontiguousarray(arrays["data"], np.float32) * _s]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+    return make
+
+
+def test_rolling_reload_zero_dropped_requests():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_versioned_factory(), name="roll",
+                initial_model=1)
+    inflight = [fr.submit(_ones()) for _ in range(6)]   # queued on v1
+    assert fr.reload(2) == 2
+    # every pre-reload request drained on the OLD model — zero dropped,
+    # zero rejected-as-nonretriable
+    for req in inflight:
+        assert np.all(fr.result(req)[0] == 1.0)
+    # fresh traffic lands on the new generation
+    assert np.all(fr.predict(_ones())[0] == 2.0)
+    stats = fr.stats()["totals"]
+    assert stats["reload_generations"] == 1
+    assert stats["model_version"] == 2
+    assert stats["delivered"] == 7
+    assert stats["failed_terminal"] == 0
+    # old replicas retired, fleet at strength on v2 (standby included)
+    assert fr.healthz()["active"] == 3
+    assert all(r.model_version == 2 for r in fr._replicas.values())
+
+
+def test_reload_refuses_rollback_without_the_flag():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_versioned_factory(), name="gate",
+                initial_model=3)
+    with pytest.raises(RollbackRefused):
+        fr.reload(3)                     # same version: not newer
+    with pytest.raises(RollbackRefused):
+        fr.reload(2)                     # older
+    with pytest.raises(RollbackRefused):
+        fr.reload(None)                  # unversioned: cannot be proven
+    assert fr.stats()["totals"]["reload_generations"] == 0
+    assert fr.reload(2, force_rollback=True) == 2   # said out loud
+    assert fr.stats()["totals"]["model_version"] == 2
+
+
+def test_reload_standby_pool_follows_the_new_generation():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_versioned_factory(), name="pool",
+                initial_model=1, standbys=1)
+    fr.reload(2)
+    standbys = [r for r in fr._replicas.values() if r.state == STANDBY]
+    assert standbys and all(r.model_version == 2 for r in standbys)
+    # a failover after the reload must promote the NEW model
+    victim = next(r.id for r in fr._replicas.values()
+                  if r.state == ACTIVE)
+    fr.kill_replica(victim, "post-reload death")
+    for _ in range(3):
+        fr.probe_once()
+    assert np.all(fr.predict(_ones())[0] == 2.0)
+
+
+def test_failed_standby_refresh_never_promotes_the_old_model():
+    # reload(v2) rolls the actives but the standby-pool refresh spawn
+    # fails: the stale v1 standby must be RETIRED (a later failover
+    # cold-spawns v2 — degraded, never rolled back)
+    clock = FakeClock()
+    spawns = {"v2": 0}
+
+    def make(rid, source):
+        scale = float(source if isinstance(source, int) else 1)
+        if scale == 2:
+            spawns["v2"] += 1
+            if spawns["v2"] == 4:        # the standby-refresh spawn
+                raise mx.base.MXNetError("artifact store hiccup")
+
+        def fn(arrays, _s=scale):
+            return [np.ascontiguousarray(arrays["data"], np.float32) * _s]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+
+    fr = _fleet(clock, factory=make, name="stalestandby",
+                initial_model=1, replicas=3, standbys=1)
+    fr.reload(2)
+    # no replica of the old generation remains promotable
+    assert all(r.model_version == 2 for r in fr._replicas.values())
+    assert fr.healthz()["standby"] == 0   # refresh failed -> cold pool
+    # a failover now cold-spawns the NEW model, never the old standby
+    victim = next(r.id for r in fr._replicas.values()
+                  if r.state == ACTIVE)
+    fr.kill_replica(victim, "post-reload death")
+    for _ in range(3):
+        fr.probe_once()
+    assert np.all(fr.predict(_ones())[0] == 2.0)
+    assert all(r.model_version == 2 for r in fr._replicas.values())
+    assert fr.stats()["totals"]["failovers_without_standby"] == 1
+
+
+def test_stats_preserves_an_endpoint_literally_named_fleet():
+    clock = FakeClock()
+    backend = CallableBackend(lambda a: [a["data"] * 2.0],
+                              input_specs={"data": (3,)})
+    srv = serving.InferenceServer(backend, name="fleet", workers=0,
+                                  clock=clock)
+    srv.warm_up()
+    srv.predict(_ones())
+    table = serving.stats()
+    assert table["fleet_endpoint"]["completed"] == 1   # not clobbered
+    assert isinstance(table["fleet"], dict)            # registry block
+    assert serving.endpoint_stats()["fleet"]["completed"] == 1
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manifests: monotonic model_version/uid + the gate
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_manifest_records_model_version_and_uid(tmp_path):
+    prefix = str(tmp_path / "model")
+    w = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    write_checkpoint(prefix, 0, None, {"w": w}, {}, model_version=7)
+    version, uid = model_version_info(prefix)
+    assert version == 7
+    assert isinstance(uid, str) and len(uid) == 16   # params digest
+    # an explicit uid wins over the digest default
+    write_checkpoint(prefix, 1, None, {"w": w}, {}, model_version=8,
+                     model_uid="run-2026-08-03")
+    assert model_version_info(prefix) == (8, "run-2026-08-03")
+    # pinning an epoch reads THAT manifest, not the newest
+    assert model_version_info(prefix, epoch=0)[0] == 7
+    # an unversioned checkpoint reads back (None, None)
+    write_checkpoint(str(tmp_path / "plain"), 0, None, {"w": w}, {})
+    assert model_version_info(str(tmp_path / "plain")) == (None, None)
+
+
+def test_require_newer_version_gate():
+    assert require_newer_version(None, 5) == 5       # nothing live yet
+    assert require_newer_version(4, 5) == 5          # strictly newer
+    with pytest.raises(RollbackRefused):
+        require_newer_version(5, 5)                  # equal is NOT newer
+    with pytest.raises(RollbackRefused):
+        require_newer_version(5, 4)
+    with pytest.raises(RollbackRefused):
+        require_newer_version(5, None)               # unprovable
+    assert require_newer_version(5, 4, force_rollback=True) == 4
+    assert require_newer_version(5, None, force_rollback=True) is None
+
+
+def test_reload_reads_the_version_from_a_manifest_path(tmp_path):
+    clock = FakeClock()
+    prefix = str(tmp_path / "ckpt")
+    w = mx.nd.array(np.ones((2, 3), np.float32))
+    write_checkpoint(prefix, 0, None, {"w": w}, {}, model_version=1)
+    fr = _fleet(clock, name="manifest", initial_model=prefix)
+    assert fr.model_version == 1
+    with pytest.raises(RollbackRefused):
+        fr.reload(prefix)                # same manifest: not newer
+    write_checkpoint(prefix, 1, None, {"w": w}, {}, model_version=2)
+    assert fr.reload(prefix) == 2        # prefix resolves to the newest
+
+
+# ---------------------------------------------------------------------------
+# stats surface
+# ---------------------------------------------------------------------------
+
+def test_serving_stats_grows_a_fleet_block():
+    clock = FakeClock()
+    fr = _fleet(clock, name="statsy")
+    fr.predict(_ones())
+    table = serving.stats()
+    assert "statsy" in table["fleet"]
+    block = table["fleet"]["statsy"]
+    # per-replica counters keyed by replica id
+    assert set(block["replicas"]) == {"r1", "r2", "r3", "r4"}
+    rec = block["replicas"]["r1"]
+    assert {"state", "endpoint", "model_version", "killed",
+            "probe_failures", "ready_s", "routed", "re_routed_from",
+            "completed", "failed"} <= set(rec)
+    # aggregated totals mirror retry.stats() conventions
+    totals = block["totals"]
+    for key in ("evictions", "failovers", "re_routed",
+                "reload_generations", "submitted", "delivered",
+                "dedup_hits", "probes", "active_replicas"):
+        assert key in totals
+    # replica endpoints also appear in the per-endpoint table
+    assert "statsy/r1" in table
+    fr.close()
+    assert "statsy" not in serving.stats()["fleet"]
+
+
+def test_standby_promotion_latency_is_measured():
+    clock = FakeClock()
+
+    class SlowLoad(CallableBackend):
+        """Backend whose load costs 0.25s on the fleet clock — the
+        measured ``ready_s`` must read it back."""
+
+        def load(self):
+            clock.advance(0.25)
+
+    def make(rid, source):
+        return SlowLoad(lambda a: [a["data"] * 2.0],
+                        input_specs={"data": (3,)})
+
+    fr = FleetRouter(make, name="ready", replicas=1, standbys=1,
+                     workers=0, buckets=[4], clock=clock)
+    assert all(r.ready_s == pytest.approx(0.25)
+               for r in fr._replicas.values())
+    fr.kill_replica("r1", "test")
+    for _ in range(3):
+        fr.probe_once()
+    totals = fr.stats()["totals"]
+    assert totals["last_standby_ready_s"] == pytest.approx(0.25)
+    assert totals["failovers"] == 1
