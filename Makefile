@@ -58,7 +58,17 @@ clean:
 lint-tpu:
 	python -m mxnet_tpu.analysis --root . mxnet_tpu
 
-ci-lint: lint-tpu
+# the concurrency tier alone (lock-order cycles, unguarded shared
+# state, check-then-act, cond-wakeup, signal safety over the threaded
+# serving/resilience stack) — ZERO baseline: every finding here is a
+# failure, readable in isolation via the --only filter.
+# --no-baseline makes the stage itself enforce that: a concurrency
+# finding snuck into tpu-lint-baseline.json still fails here.
+lint-concurrency:
+	python -m mxnet_tpu.analysis --root . --only concurrency \
+	    --no-baseline mxnet_tpu
+
+ci-lint: lint-tpu lint-concurrency
 
 # stage 1: native shared libraries
 ci-native: all
@@ -222,7 +232,8 @@ ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-perf ci-elastic ci-compiler ci-preempt ci-multichip ci-fleet
 	@echo "CI matrix green"
 
-.PHONY: all clean ci lint-tpu ci-lint ci-native ci-amalgamation ci-unit \
+.PHONY: all clean ci lint-tpu lint-concurrency ci-lint ci-native \
+	ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
         ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \
         ci-preempt ci-multichip ci-fleet

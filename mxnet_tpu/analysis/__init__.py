@@ -14,9 +14,10 @@ the checker machinery loads lazily, only when linting.
 """
 from __future__ import annotations
 
-from .annotations import hot_path
+from .annotations import hot_path, single_threaded
 
-__all__ = ["hot_path", "lint", "Finding", "CHECKERS", "main"]
+__all__ = ["hot_path", "single_threaded", "lint", "Finding", "CHECKERS",
+           "main"]
 
 _LAZY = {"lint", "Finding", "CHECKERS"}
 

@@ -12,7 +12,7 @@ analysis machinery — or jax — into the hot modules that use it.
 """
 from __future__ import annotations
 
-__all__ = ["hot_path"]
+__all__ = ["hot_path", "single_threaded"]
 
 
 def hot_path(reason=None):
@@ -24,6 +24,26 @@ def hot_path(reason=None):
     they call in-module; the runtime behavior is untouched.
     """
     if callable(reason):        # bare @hot_path
+        return reason
+
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+def single_threaded(reason=None):
+    """Declare a function (or class) deliberately single-threaded.
+
+    The unguarded-shared-state checker (docs/how_to/tpu_lint.md,
+    "Concurrency checkers") exempts marked code from lock-discipline
+    findings: construction/warm-up phases, test-only drivers, and
+    control-plane paths that one thread owns by design. Usable bare
+    (``@single_threaded``) or with the justification string the review
+    contract asks for (``@single_threaded("driven by run_pending() on
+    the caller's thread only")``). Identity at runtime — zero overhead.
+    """
+    if callable(reason):        # bare @single_threaded
         return reason
 
     def deco(fn):

@@ -7,3 +7,4 @@ from . import retrace           # noqa: F401
 from . import rng               # noqa: F401
 from . import registry_consistency  # noqa: F401
 from . import donation          # noqa: F401
+from . import concurrency      # noqa: F401

@@ -1,0 +1,628 @@
+"""The concurrency-safety tier: five checkers over the lock model.
+
+The threaded serving/resilience stack's review history shows exactly
+three recurring bug shapes — check-then-act races, lock-order/TOCTOU
+hazards, and wake-up/handler-safety mistakes. Each checker here encodes
+one reviewed-by-hand invariant as a machine-enforced rule, all built on
+:class:`~mxnet_tpu.analysis.lockmodel.LockModel` (whole-program lock
+discovery + held-set propagation):
+
+* **lock-order-cycle** — a cycle in the global lock acquisition graph
+  (lock B taken while A held somewhere, A taken while B held somewhere
+  else) is a potential deadlock; a non-reentrant lock re-acquired on
+  the same path is a guaranteed one. The serving order — admission
+  queue condition first, then the server counter lock (via the
+  ``take(on_pop=...)`` callback), never reversed — becomes machine
+  law (docs/how_to/tpu_lint.md).
+* **unguarded-shared-state** — an attribute of a lock-owning class (or
+  a module global beside a module lock) mutated both under a lock and
+  outside any lock; ``# tpu-lint: guarded-by=<lock>`` on the declaring
+  assignment makes the contract explicit and *every* unlocked mutation
+  a finding. ``@single_threaded`` (analysis/annotations.py) exempts
+  deliberately single-threaded code.
+* **check-then-act** — guarded state read under a lock, the lock
+  released, and a branch on the stale value re-acquiring the lock to
+  mutate without re-validating: the tenant-quota race shape. A region
+  that re-reads the state under the second hold (double-checked
+  locking) is not flagged.
+* **cond-wakeup** — a ``Condition`` with two or more distinct waiting
+  call-sites woken with ``notify()``: the single wake-up can land on a
+  waiter that cannot use it, stranding the one that could (the
+  ``AdmissionQueue.offer`` bug PR 10 fixed by hand).
+* **signal-unsafe** — code reachable from a signal handler (a function
+  passed to ``signal.signal`` or an ``on_signal`` listener of the
+  shared ``SignalRuntime``) that acquires a lock, logs, or opens/prints
+  through buffered IO. A handler runs on the main thread at an
+  arbitrary bytecode boundary; if the interrupted thread holds the
+  lock (the logging module's included), the handler deadlocks and the
+  process dies un-checkpointed. GIL-atomic flag/counter updates and
+  raw ``os.write``/``sys.stderr.write`` are the handler-safe tools.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..core import Checker, Finding, Project, register_checker
+from ..lockmodel import (LockModel, FnInfo, REENTRANT, MUTATORS,
+                         is_unknown, walk_own as _walk_own)
+
+_GUARDED_BY_RE = re.compile(
+    r"#\s*tpu-lint:\s*guarded-by=([A-Za-z_][A-Za-z0-9_]*)")
+
+_INIT_NAMES = {"__init__", "__new__", "__del__"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _short(model: LockModel, lock_id: str) -> str:
+    if is_unknown(lock_id):
+        return lock_id[1:]
+    lock = model.locks.get(lock_id)
+    return lock.short if lock else lock_id
+
+
+def _in_init(info: FnInfo) -> bool:
+    return any(part in _INIT_NAMES for part in info.qualname.split("."))
+
+
+def _single_threaded(model: LockModel, info: FnInfo) -> bool:
+    if "single_threaded" in info.decorators:
+        return True
+    if info.cls:
+        for rel, cnode in model.classes.get(info.cls, ()):
+            if rel != info.relpath:
+                continue
+            for dec in cnode.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if isinstance(target, ast.Name) \
+                        and target.id == "single_threaded":
+                    return True
+                if isinstance(target, ast.Attribute) \
+                        and target.attr == "single_threaded":
+                    return True
+    return False
+
+
+def _finding(model: LockModel, rule: str, relpath: str, node: ast.AST,
+             message: str, context: str) -> Finding:
+    return Finding(rule=rule, path=relpath,
+                   line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0),
+                   message=message, context=context)
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+@register_checker
+class LockOrderCycleChecker(Checker):
+    name = "lock-order-cycle"
+    tier = "concurrency"
+    description = ("a cycle in the global lock acquisition graph "
+                   "(A held while taking B, B held while taking A) is "
+                   "a potential deadlock; re-acquiring a non-reentrant "
+                   "lock is a guaranteed one")
+
+    def check_project(self, project: Project):
+        model = LockModel.of(project)
+        graph: Dict[str, Set[str]] = {}
+        for (outer, inner), site in model.edges.items():
+            if outer == inner:
+                lock = model.locks[inner]
+                if lock.kind in REENTRANT:
+                    continue
+                rel, line, ctx = site
+                yield Finding(
+                    rule=self.name, path=rel, line=line, col=0,
+                    message=f"non-reentrant lock `{lock.short}` is "
+                            f"(transitively) re-acquired while already "
+                            f"held — self-deadlock; use an RLock or "
+                            f"restructure the call", context=ctx)
+                continue
+            graph.setdefault(outer, set()).add(inner)
+        for scc in self._sccs(graph):
+            if len(scc) < 2:
+                continue
+            members = sorted(scc)
+            edges = sorted(
+                (o, i, model.edges[(o, i)])
+                for o in members for i in graph.get(o, ())
+                if i in scc and (o, i) in model.edges)
+            if not edges:
+                continue
+            witness = min(e[2] for e in edges)
+            rel, line, ctx = witness
+            order = " ; ".join(
+                f"`{_short(model, o)}` -> `{_short(model, i)}` at "
+                f"{srel}:{sline}" for o, i, (srel, sline, _c) in edges)
+            yield Finding(
+                rule=self.name, path=rel, line=line, col=0,
+                message=f"lock-order cycle over "
+                        f"{{{', '.join(_short(model, m) for m in members)}}}"
+                        f" — potential deadlock: {order}; pick one "
+                        f"global order and release before calling "
+                        f"against it", context=ctx)
+
+    @staticmethod
+    def _sccs(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+        """Iterative Tarjan strongly-connected components."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        out: List[Set[str]] = []
+        counter = [0]
+        nodes = set(graph) | {i for vs in graph.values() for i in vs}
+
+        for start in sorted(nodes):
+            if start in index:
+                continue
+            work = [(start, iter(sorted(graph.get(start, ()))))]
+            index[start] = low[start] = counter[0]
+            counter[0] += 1
+            stack.append(start)
+            on_stack.add(start)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append(
+                            (nxt, iter(sorted(graph.get(nxt, ())))))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc: Set[str] = set()
+                    while True:
+                        member = stack.pop()
+                        on_stack.discard(member)
+                        scc.add(member)
+                        if member == node:
+                            break
+                    out.append(scc)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+@register_checker
+class UnguardedSharedStateChecker(Checker):
+    name = "unguarded-shared-state"
+    tier = "concurrency"
+    description = ("state of a lock-owning class/module mutated both "
+                   "under its lock and outside any lock; declare the "
+                   "contract with `# tpu-lint: guarded-by=<lock>`, "
+                   "exempt deliberate cases with @single_threaded")
+
+    def check_project(self, project: Project):
+        model = LockModel.of(project)
+        declared = self._declarations(project, model)
+        # group mutation sites by (scope, attr)
+        grouped: Dict[Tuple[Tuple, str], List] = {}
+        for info in model.functions():
+            if _in_init(info) or _single_threaded(model, info):
+                continue
+            for scope, name, node, held, kind in info.mutations:
+                if not self._scope_has_locks(model, scope):
+                    continue
+                if self._is_lock_attr(model, scope, name):
+                    continue
+                eff = info.held_at(held)
+                grouped.setdefault((scope, name), []).append(
+                    (info, node, eff))
+        for (scope, name), sites in sorted(
+                grouped.items(),
+                key=lambda kv: (kv[0][0], kv[0][1])):
+            owner_locks = self._owner_locks(model, scope)
+            guard = declared.get((scope, name))
+            label = (f"self.{name}" if scope[0] == "class"
+                     else f"`{name}`")
+            owner = (scope[2] if scope[0] == "class"
+                     else f"module {scope[1]}")
+            if guard is not None:
+                guard_id = owner_locks.get(guard)
+                for info, node, eff in sites:
+                    if guard_id is not None and guard_id in eff:
+                        continue
+                    if f"?{guard}" in eff:
+                        continue
+                    yield _finding(
+                        model, self.name, info.relpath, node,
+                        f"{label} is declared `guarded-by={guard}` but "
+                        f"mutated here without holding it — take "
+                        f"`{guard}` (or annotate the path "
+                        f"@single_threaded with a reason)",
+                        info.qualname)
+                continue
+            locked = [(i, n, e) for i, n, e in sites
+                      if e & set(owner_locks.values())]
+            bare = [(i, n, e) for i, n, e in sites if not e]
+            if not locked or not bare:
+                continue
+            g_info, g_node, g_eff = locked[0]
+            guard_names = sorted(
+                _short(model, l) for l in
+                (g_eff & set(owner_locks.values())))
+            for info, node, _eff in bare:
+                yield _finding(
+                    model, self.name, info.relpath, node,
+                    f"{label} of lock-owning {owner} is mutated under "
+                    f"`{', '.join(guard_names)}` "
+                    f"({g_info.relpath}:{g_node.lineno}) but with no "
+                    f"lock here — concurrent writers race; guard it or "
+                    f"mark the path @single_threaded", info.qualname)
+
+    @staticmethod
+    def _scope_has_locks(model: LockModel, scope: Tuple) -> bool:
+        if scope[0] == "class":
+            return bool(model.class_locks.get((scope[1], scope[2])))
+        return bool(model.module_locks.get(scope[1]))
+
+    @staticmethod
+    def _owner_locks(model: LockModel, scope: Tuple) -> Dict[str, str]:
+        if scope[0] == "class":
+            return dict(model.class_locks.get((scope[1], scope[2]), {}))
+        return dict(model.module_locks.get(scope[1], {}))
+
+    @staticmethod
+    def _is_lock_attr(model: LockModel, scope: Tuple, name: str) -> bool:
+        return name in UnguardedSharedStateChecker._owner_locks(
+            model, scope)
+
+    def _declarations(self, project: Project, model: LockModel
+                      ) -> Dict[Tuple[Tuple, str], str]:
+        """``# tpu-lint: guarded-by=<lock>`` pragmas on declaring
+        assignments, keyed by (scope, attr)."""
+        out: Dict[Tuple[Tuple, str], str] = {}
+        for ctx in project.ctxs:
+            lines = ctx.src.splitlines()
+            pragma_lines: Dict[int, str] = {}
+            for i, text in enumerate(lines, start=1):
+                m = _GUARDED_BY_RE.search(text)
+                if m:
+                    pragma_lines[i] = m.group(1)
+            if not pragma_lines:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                guard = pragma_lines.get(node.lineno)
+                if guard is None:
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                cls = self._enclosing_class(ctx.tree, node)
+                for tgt in targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self" and cls):
+                        out[(("class", ctx.relpath, cls), tgt.attr)] \
+                            = guard
+                    elif isinstance(tgt, ast.Name):
+                        if cls is None:
+                            out[(("module", ctx.relpath), tgt.id)] = guard
+        return out
+
+    @staticmethod
+    def _enclosing_class(tree: ast.Module,
+                         target: ast.AST) -> Optional[str]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        return node.name
+        return None
+
+
+# ---------------------------------------------------------------------------
+# check-then-act
+# ---------------------------------------------------------------------------
+
+@register_checker
+class CheckThenActChecker(Checker):
+    name = "check-then-act"
+    tier = "concurrency"
+    description = ("guarded state read under a lock, the lock dropped, "
+                   "then a branch on the stale value re-acquires and "
+                   "mutates without re-validating — the tenant-quota "
+                   "race shape")
+
+    def check_project(self, project: Project):
+        model = LockModel.of(project)
+        for info in model.functions():
+            if isinstance(info.node, ast.Lambda) or _in_init(info):
+                continue
+            yield from self._check_fn(model, info)
+
+    def _check_fn(self, model: LockModel, info: FnInfo):
+        regions = self._lock_regions(model, info)
+        if len(regions) < 2:
+            return
+        branches = self._branches(info.node)
+        for i, r1 in enumerate(regions):
+            for r2 in regions[i + 1:]:
+                if r1["lock"] != r2["lock"] \
+                        or r2["start"] <= r1["end"]:
+                    continue
+                for attr in sorted(r2["writes"] & r1["reads"]):
+                    if attr in r2["revalidated"]:
+                        continue        # double-checked: re-read inside
+                    if not self._branch_between(
+                            branches, r1, r2):
+                        continue
+                    yield _finding(
+                        model, self.name, info.relpath, r2["node"],
+                        f"check-then-act race on "
+                        f"`{r1['label']}.{attr}`: read under "
+                        f"`{_short(model, r1['lock'])}` at line "
+                        f"{r1['node'].lineno}, the lock released, and "
+                        f"this branch re-acquires it to mutate on the "
+                        f"stale value — re-validate inside this region "
+                        f"(or hold the lock across the decision)",
+                        info.qualname)
+                    break
+
+    @staticmethod
+    def _branch_between(branches, r1, r2) -> bool:
+        """An If/While after region 1 whose test uses a value bound
+        under region 1 (or the region-1 guarded read itself)."""
+        for node, names in branches:
+            if not (r1["end"] < node.lineno <= r2["node"].lineno):
+                continue
+            if names & r1["bound"]:
+                return True
+        return False
+
+    @staticmethod
+    def _branches(fn: ast.AST) -> List[Tuple[ast.AST, Set[str]]]:
+        out = []
+        for node in _walk_own(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                names = {n.id for n in ast.walk(node.test)
+                         if isinstance(n, ast.Name)
+                         and isinstance(n.ctx, ast.Load)}
+                out.append((node, names))
+        return out
+
+    def _lock_regions(self, model: LockModel, info: FnInfo) -> List[Dict]:
+        regions: List[Dict] = []
+        for node in _walk_own(info.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                lid = model._resolve_lock(info, item.context_expr, None)
+                if lid is None or is_unknown(lid):
+                    continue
+                regions.append(self._region(info, node, lid))
+        regions.sort(key=lambda r: r["start"])
+        return regions
+
+    def _region(self, info: FnInfo, node, lock_id: str) -> Dict:
+        reads: Set[str] = set()
+        writes: Set[str] = set()
+        revalidated: Set[str] = set()
+        bound: Set[str] = set()
+        label = "self" if info.cls else info.relpath
+        write_receivers: Set[int] = set()
+        end = node.lineno
+        for sub in _walk_own(node):
+            end = max(end, getattr(sub, "lineno", end))
+            if isinstance(sub, ast.Assign):
+                attrs = self._self_attrs(sub.value)
+                if attrs:
+                    reads.update(attrs)
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            bound.add(tgt.id)
+                for tgt in sub.targets:
+                    a = self._store_attr(tgt)
+                    if a:
+                        writes.add(a)
+                        write_receivers.update(
+                            id(n) for n in ast.walk(tgt))
+            elif isinstance(sub, ast.AugAssign):
+                a = self._store_attr(sub.target)
+                if a:
+                    writes.add(a)
+                    write_receivers.update(
+                        id(n) for n in ast.walk(sub.target))
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in MUTATORS:
+                a = self._store_attr(sub.func.value)
+                if a:
+                    writes.add(a)
+                    write_receivers.update(
+                        id(n) for n in ast.walk(sub.func))
+        # a Load of a written attr that is NOT the write's own receiver
+        # counts as re-validation (the double-checked-locking shape)
+        for sub in _walk_own(node):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.ctx, ast.Load)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    and sub.attr in writes
+                    and id(sub) not in write_receivers):
+                revalidated.add(sub.attr)
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.ctx, ast.Load)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                reads.add(sub.attr)
+        return {"node": node, "lock": lock_id, "start": node.lineno,
+                "end": end, "reads": reads, "writes": writes,
+                "revalidated": revalidated, "bound": bound,
+                "label": label}
+
+    @staticmethod
+    def _self_attrs(node: ast.AST) -> Set[str]:
+        return {n.attr for n in ast.walk(node)
+                if isinstance(n, ast.Attribute)
+                and isinstance(n.ctx, ast.Load)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"}
+
+    @staticmethod
+    def _store_attr(node: ast.AST) -> Optional[str]:
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+
+# ---------------------------------------------------------------------------
+# cond-wakeup
+# ---------------------------------------------------------------------------
+
+@register_checker
+class CondWakeupChecker(Checker):
+    name = "cond-wakeup"
+    tier = "concurrency"
+    description = ("a Condition with >= 2 distinct waiting call-sites "
+                   "woken with notify(): the single wake-up can land "
+                   "on a waiter that cannot use it — use notify_all()")
+
+    def check_project(self, project: Project):
+        model = LockModel.of(project)
+        waits: Dict[str, Set[Tuple[str, int, str]]] = {}
+        notifies: Dict[str, List[Tuple[FnInfo, ast.AST]]] = {}
+        for info in model.functions():
+            for lid, node, kind, _held in info.cond_events:
+                if kind == "wait":
+                    waits.setdefault(lid, set()).add(
+                        (info.relpath, node.lineno, info.qualname))
+                elif kind == "notify":
+                    notifies.setdefault(lid, []).append((info, node))
+        for lid, sites in sorted(notifies.items()):
+            wait_sites = waits.get(lid, set())
+            if len(wait_sites) < 2:
+                continue
+            where = ", ".join(
+                f"{q}() at {r}:{n}"
+                for r, n, q in sorted(wait_sites))
+            for info, node in sites:
+                yield _finding(
+                    model, self.name, info.relpath, node,
+                    f"`{_short(model, lid)}.notify()` wakes ONE of "
+                    f"{len(wait_sites)} distinct waiter call-sites "
+                    f"({where}) — the wake-up can land on a waiter "
+                    f"that cannot use it, stranding the one that "
+                    f"could; use notify_all()", info.qualname)
+
+
+# ---------------------------------------------------------------------------
+# signal-unsafe
+# ---------------------------------------------------------------------------
+
+@register_checker
+class SignalUnsafeChecker(Checker):
+    name = "signal-unsafe"
+    tier = "concurrency"
+    description = ("lock acquisition, logging, or buffered IO reachable "
+                   "from a signal handler (signal.signal target or an "
+                   "on_signal SignalRuntime listener) — deadlocks if "
+                   "the interrupted thread holds the lock")
+
+    #: the SignalRuntime listener contract: methods with this name are
+    #: dispatched from the OS handler (docs/how_to/preemption.md)
+    LISTENER_METHOD = "on_signal"
+
+    def check_project(self, project: Project):
+        model = LockModel.of(project)
+        roots = self._roots(model)
+        if not roots:
+            return
+        chains = model.reachable_from(roots)
+        for fn, chain in chains.items():
+            info = model.fns[fn]
+            via = " -> ".join(
+                f"{model.fns[f].qualname}()" for f in chain)
+            for lid, node, _held in info.acquisitions:
+                yield _finding(
+                    model, self.name, info.relpath, node,
+                    f"`{_short(model, lid)}` acquired in signal-handler "
+                    f"context (reachable via {via}): if the interrupted "
+                    f"thread holds it, the handler deadlocks and the "
+                    f"process dies un-checkpointed — set flags / use "
+                    f"GIL-atomic updates and do the work outside the "
+                    f"handler", info.qualname)
+            for kind, node, _held in info.effect_calls:
+                what = {"logging": "logging (the logging module locks "
+                                   "its handlers)",
+                        "print": "print() (buffered stdout locks)",
+                        "open": "open() (buffered IO)"}[kind]
+                yield _finding(
+                    model, self.name, info.relpath, node,
+                    f"{what} in signal-handler context (reachable via "
+                    f"{via}) — defer the message or write raw bytes "
+                    f"via sys.stderr.write/os.write", info.qualname)
+
+    def _roots(self, model: LockModel) -> List[ast.AST]:
+        roots: List[ast.AST] = []
+        # (a) on_signal listener methods — the SignalRuntime contract
+        # (methods is keyed by (relpath, class), so every module's
+        # listener is a root, same-named classes included)
+        for (_rel, _cname), methods in model.methods.items():
+            fn = methods.get(self.LISTENER_METHOD)
+            if fn is not None:
+                roots.append(fn)
+        # (b) anything passed as the handler to signal.signal(...)
+        for info in model.functions():
+            for node in ast.walk(info.node):
+                fn = self._signal_target(model, info, node)
+                if fn is not None:
+                    roots.append(fn)
+        for ctx in model.project.ctxs:
+            for node in ctx.tree.body:
+                if isinstance(node, _FUNC_NODES):
+                    continue        # per-function scan covers these
+                # walk_own: skip nested function bodies but keep
+                # walking siblings — an install after a def in the
+                # same compound statement must still be seen
+                for sub in _walk_own(node):
+                    fn = self._module_signal_target(model, ctx, sub)
+                    if fn is not None:
+                        roots.append(fn)
+        return roots
+
+    @staticmethod
+    def _is_signal_install(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "signal"
+                and len(node.args) >= 2)
+
+    def _signal_target(self, model: LockModel, info: FnInfo,
+                       node: ast.AST) -> Optional[ast.AST]:
+        if not self._is_signal_install(node):
+            return None
+        return model._as_fn(info, node.args[1], None)
+
+    def _module_signal_target(self, model: LockModel, ctx,
+                              node: ast.AST) -> Optional[ast.AST]:
+        if not self._is_signal_install(node):
+            return None
+        handler = node.args[1]
+        if isinstance(handler, ast.Name):
+            return model.module_fns.get(ctx.relpath, {}).get(handler.id)
+        return None

@@ -16,6 +16,12 @@ code, where nothing (until now) stopped them drifting:
   ``<op>Doc``). A duplicate literal registration or alias collision
   silently overwrites an op; a ``<op>Doc`` class whose op does not exist
   attaches its examples to nothing.
+* **lint checkers themselves** — every rule registered under
+  ``mxnet_tpu/analysis/checkers/`` is a promise that (a) a lint suite
+  (``tests/test_tpu_lint.py`` / ``tests/test_concurrency_lint.py``)
+  exercises it with true-positive AND true-negative fixtures and (b)
+  ``docs/how_to/tpu_lint.md`` documents it. An untested checker decays
+  into noise; an undocumented one cannot be suppressed responsibly.
 
 This is a project-level pass: it reads the linted ASTs for the registry
 side and the raw text of the test/doc files for the contract side.
@@ -45,6 +51,12 @@ FAULT_DOCS = ("docs/how_to/fault_tolerance.md", "docs/how_to/serving.md",
               "docs/how_to/fleet.md")
 OPS_PREFIX = "mxnet_tpu/ops/"
 DOC_BASES = {"NDArrayDoc", "SymbolDoc"}
+# checker rules are a registry too: each must be exercised by a lint
+# suite and documented in the rule catalog (same group semantics as the
+# fault sites — presence in any file of the group satisfies it)
+CHECKERS_PREFIX = "mxnet_tpu/analysis/checkers/"
+CHECKER_TESTS = ("tests/test_tpu_lint.py", "tests/test_concurrency_lint.py")
+CHECKER_DOCS = ("docs/how_to/tpu_lint.md",)
 
 
 def _string_constants(node: ast.AST) -> List[str]:
@@ -57,11 +69,13 @@ class RegistryConsistencyChecker(Checker):
     name = "registry-consistency"
     description = ("fault sites must appear in test_resilience.py and "
                    "fault_tolerance.md; op registrations must not collide "
-                   "and <op>Doc classes must name real ops")
+                   "and <op>Doc classes must name real ops; registered "
+                   "lint checkers must be tested and documented")
 
     def check_project(self, project: Project):
         yield from self._check_fault_sites(project)
         yield from self._check_ops(project)
+        yield from self._check_checkers(project)
 
     # -- fault sites -------------------------------------------------------
 
@@ -111,6 +125,53 @@ class RegistryConsistencyChecker(Checker):
                     rule=self.name, path=relpath, line=line, col=0,
                     message=f"fault site '{site}' is armed in the runtime "
                             f"but missing from {names} — {consequence}",
+                    context="<registry>")
+
+    # -- lint checkers -----------------------------------------------------
+
+    def _check_checkers(self, project: Project):
+        """Every ``@register_checker`` rule under analysis/checkers/
+        must appear in a lint-suite file AND the rule-catalog doc."""
+        rules: List[Tuple[str, str, int]] = []   # (rule, relpath, line)
+        for ctx in project.ctxs:
+            if not ctx.relpath.startswith(CHECKERS_PREFIX):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                if not any((dotted_name(d) or "").rsplit(".", 1)[-1]
+                           == "register_checker"
+                           for d in node.decorator_list):
+                    continue
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "name"
+                                    for t in stmt.targets)
+                            and isinstance(stmt.value, ast.Constant)
+                            and isinstance(stmt.value.value, str)):
+                        rules.append((stmt.value.value, ctx.relpath,
+                                      node.lineno))
+                        break
+        if not rules:
+            return
+        surfaces = [(CHECKER_TESTS, "no lint suite exercises its "
+                                    "TP/TN fixtures"),
+                    (CHECKER_DOCS, "the rule catalog does not "
+                                   "document it")]
+        for group, consequence in surfaces:
+            present = [(f, project.read_text(f)) for f in group]
+            present = [(f, t) for f, t in present if t is not None]
+            if not present:
+                continue        # partial checkouts / fixture trees
+            names = " or ".join(f for f, _ in present)
+            for rule, relpath, line in rules:
+                if any(rule in t for _, t in present):
+                    continue
+                yield Finding(
+                    rule=self.name, path=relpath, line=line, col=0,
+                    message=f"checker '{rule}' is registered but "
+                            f"missing from {names} — {consequence}",
                     context="<registry>")
 
     # -- operators ---------------------------------------------------------

@@ -39,6 +39,10 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--checker", action="append", dest="checkers",
                     metavar="RULE", help="run only the named checker "
                     "(repeatable)")
+    ap.add_argument("--only", default=None, metavar="TIER",
+                    help="run only the checkers of one tier ('core' or "
+                         "'concurrency') — e.g. `--only concurrency` "
+                         "for the lock/signal rules alone")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -52,7 +56,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.list_rules:
         for name in sorted(core.CHECKERS):
-            print(f"{name}: {core.CHECKERS[name].description}")
+            cls = core.CHECKERS[name]
+            print(f"{name} [{cls.tier}]: {cls.description}")
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
@@ -80,6 +85,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "every other rule's grandfathered entries; run it over "
                   "all checkers", file=sys.stderr)
             return 2
+    if args.only:
+        tiers = {cls.tier for cls in core.CHECKERS.values()}
+        if args.only not in tiers:
+            print(f"tpu-lint: unknown tier {args.only!r} (have: "
+                  f"{', '.join(sorted(tiers))})", file=sys.stderr)
+            return 2
+        if args.checkers:
+            print("tpu-lint: --only and --checker are mutually "
+                  "exclusive", file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            print("tpu-lint: --write-baseline with --only would drop "
+                  "every other tier's grandfathered entries; run it "
+                  "over all checkers", file=sys.stderr)
+            return 2
+        args.checkers = sorted(n for n, cls in core.CHECKERS.items()
+                               if cls.tier == args.only)
 
     try:
         findings = core.lint(paths, root=root, checkers=args.checkers)

@@ -72,10 +72,13 @@ CHECKERS: Dict[str, type] = {}
 class Checker:
     """Base checker. Subclasses set ``name``/``description`` and override
     ``check_file`` (per-file AST pass) and/or ``check_project`` (one pass
-    with every parsed file + the repo root, for cross-file consistency)."""
+    with every parsed file + the repo root, for cross-file consistency).
+    ``tier`` groups rules for the CLI's ``--only`` filter: ``"core"``
+    (the TPU/JAX hazards) or ``"concurrency"`` (the lock/signal tier)."""
 
     name: str = ""
     description: str = ""
+    tier: str = "core"
 
     def check_file(self, ctx: "FileCtx") -> Iterable[Finding]:
         return ()

@@ -1,0 +1,979 @@
+"""Whole-program lock model for the concurrency checkers.
+
+The threaded production paths — ``serving/`` (admission queue,
+coalescer, fleet router, workers), ``resilience/`` (supervisor,
+watchdog, signal runtime) and ``perf/`` (CompileGuard) — share one
+invariant vocabulary: *which lock guards what, and in which order locks
+nest*. The PR 10/11 review passes caught eight bugs against those
+invariants by hand; this module encodes them as data so the checkers in
+``checkers/concurrency.py`` can enforce them mechanically.
+
+What it computes, over every parsed file of the lint target at once
+(mirroring :mod:`tracecontext`'s lexical call propagation, extended
+across modules):
+
+* **lock discovery** — ``threading.Lock/RLock/Condition`` objects
+  created as module globals, class attributes, or ``self.X = ...``
+  instance attributes; a ``Condition(existing_lock)`` aliases the lock
+  it wraps. Each lock gets a stable id ``relpath::Owner.attr``.
+* **held-set tracking** — ``with <lock>:`` regions and explicit
+  ``acquire()``/``release()`` calls, per statement, per function.
+  Unresolvable-but-lock-shaped context managers (``srv._lock`` through
+  an untyped receiver) become ``?name`` markers: enough to know *a*
+  lock is held, too weak an identity for the global order graph.
+* **call propagation** — calls are resolved lexically (bare names),
+  through ``self``/``cls``, through *typed attributes*
+  (``self._queue = AdmissionQueue(...)`` lets ``self._queue.take()``
+  resolve cross-module), through typed locals, and through
+  **function-valued arguments**: a callable passed for a parameter the
+  callee invokes under its own lock (``queue.take(on_pop=...)``) is
+  analyzed in the callee's lock context — exactly the seam where the
+  serving queue calls back into the server's counter lock. Callables
+  injected at construction time (``AdmissionQueue(on_tenant_event=f)``)
+  propagate the same way through ``self.X(...)`` invocation sites.
+* **entry-held sets** — the locks a function *must* hold on entry
+  (intersection over every resolved call site), so a helper only ever
+  called under its class lock (``_pick_locked``) is not misread as
+  mutating state unguarded.
+* **the global lock acquisition graph** — an edge ``A -> B`` wherever
+  ``B`` is (transitively) acquired while ``A`` is held, each edge
+  annotated with the witnessing site. Cycles in this graph are the
+  lock-order-cycle checker's deadlock report.
+
+The analysis is deliberately a linter's, not a verifier's: flow within
+a block is linear, aliases beyond the patterns above are ignored, and
+unknown receivers degrade to the ``?name`` markers rather than guesses.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .tracecontext import dotted_name
+
+__all__ = ["LOCK_CTORS", "Lock", "FnInfo", "LockModel", "is_unknown",
+           "walk_own"]
+
+#: threading constructors that create a lock-like object (value = kind)
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+              "Semaphore": "semaphore", "BoundedSemaphore": "semaphore"}
+#: kinds that may be re-acquired by the holding thread. A bare
+#: ``Condition()`` is RLock-backed, so re-entry is legal; only a
+#: condition wrapping an explicit ``Lock()`` (kind ``condition_lock``)
+#: is not. Semaphores self-acquire legally above capacity 1, so they
+#: are excluded from the self-deadlock report too.
+REENTRANT = {"rlock", "condition", "condition_rlock", "semaphore"}
+
+#: mutating method names, for shared-state mutation tracking
+MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+            "popleft", "popitem", "remove", "discard", "clear",
+            "setdefault", "appendleft", "rotate"}
+
+#: attribute names that *look* like locks when the receiver cannot be
+#: typed — they produce ``?name`` held markers, never graph nodes
+_LOCKISH = ("lock", "mutex", "cv", "cond", "sem")
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def is_unknown(lock_id: str) -> bool:
+    """True for the weak ``?name`` markers (held-set only, no graph)."""
+    return lock_id.startswith("?")
+
+
+def walk_own(node: ast.AST):
+    """Walk a subtree WITHOUT descending into nested function/lambda
+    bodies (``ast.walk`` descends; a bare ``continue`` on the def node
+    skips only the node itself, not its subtree — nested locals would
+    leak into the enclosing function's scope model)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _FUNC_NODES + (ast.Lambda,)) and n is not node:
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _lockish(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _LOCKISH)
+
+
+class Lock:
+    """One discovered lock object (module global, class attribute, or
+    instance attribute)."""
+
+    __slots__ = ("id", "kind", "relpath", "owner", "name", "line")
+
+    def __init__(self, id: str, kind: str, relpath: str,
+                 owner: Optional[str], name: str, line: int):
+        self.id = id
+        self.kind = kind
+        self.relpath = relpath
+        self.owner = owner          # class name, or None for module level
+        self.name = name            # the attribute / global name
+        self.line = line
+
+    @property
+    def short(self) -> str:
+        return f"{self.owner}.{self.name}" if self.owner else self.name
+
+
+class FnInfo:
+    """Per-function facts gathered by the body scan."""
+
+    __slots__ = ("node", "qualname", "relpath", "cls", "params",
+                 "is_method", "decorators", "acquisitions", "calls",
+                 "param_calls", "attr_param_calls", "cond_events",
+                 "effect_calls", "mutations", "locals", "global_decls",
+                 "local_types", "entry_held", "acq_trans")
+
+    def __init__(self, node, qualname, relpath, cls):
+        self.node = node
+        self.qualname = qualname
+        self.relpath = relpath
+        self.cls = cls                       # enclosing class name or None
+        self.params: List[str] = []
+        self.is_method = False
+        self.decorators: Set[str] = set()
+        #: [(lock_id, ast node, frozenset held-before)]
+        self.acquisitions: List[Tuple[str, ast.AST, FrozenSet[str]]] = []
+        #: [(callee FnInfo-key node, call node, held, passed {key: fn node})]
+        self.calls: List[Tuple[ast.AST, ast.Call, FrozenSet[str], Dict]] = []
+        #: [(param name, call node, held)] — calls through own parameters
+        self.param_calls: List[Tuple[str, ast.Call, FrozenSet[str]]] = []
+        #: [(attr name, call node, held)] — calls through self.<attr> where
+        #: the attr was stowed from an __init__ parameter (injected callback)
+        self.attr_param_calls: List[Tuple[str, ast.Call, FrozenSet[str]]] = []
+        #: [(lock_id, node, "wait"|"notify"|"notify_all", held)]
+        self.cond_events: List[Tuple[str, ast.AST, str, FrozenSet[str]]] = []
+        #: [(kind, node, held)] — kind in {"logging", "print", "open"}
+        self.effect_calls: List[Tuple[str, ast.AST, FrozenSet[str]]] = []
+        #: [(scope key, name, node, held, kind)] — shared-state writes;
+        #: scope key is ("class", relpath, ClassName) or ("module", relpath)
+        self.mutations: List[Tuple[Tuple, str, ast.AST, FrozenSet[str], str]] = []
+        self.locals: Set[str] = set()
+        self.global_decls: Set[str] = set()
+        #: local name -> class name, from `q = ClassName(...)` and the
+        #: hoist-to-local idiom `q = self._queue` (typed attribute)
+        self.local_types: Dict[str, str] = {}
+        self.entry_held: FrozenSet[str] = frozenset()   # fixpoint result
+        self.acq_trans: FrozenSet[str] = frozenset()    # fixpoint result
+
+    def held_at(self, held: FrozenSet[str]) -> FrozenSet[str]:
+        """A site's effective held set: local holds + must-hold entry."""
+        return held | self.entry_held
+
+
+class LockModel:
+    """The project-wide model. Build once per lint run via
+    :meth:`of` (memoized on the :class:`~.core.Project`)."""
+
+    def __init__(self, project):
+        self.project = project
+        self.locks: Dict[str, Lock] = {}
+        #: (relpath, ClassName) -> {attr: lock_id}
+        self.class_locks: Dict[Tuple[str, str], Dict[str, str]] = {}
+        #: relpath -> {global name: lock_id}
+        self.module_locks: Dict[str, Dict[str, str]] = {}
+        #: (relpath, ClassName, attr) -> class name the attr is typed to
+        self.attr_types: Dict[Tuple[str, str, str], str] = {}
+        #: (relpath, ClassName, attr) -> __init__ param the attr stows
+        self.attr_params: Dict[Tuple[str, str, str], str] = {}
+        #: class name -> [(relpath, ClassDef)]
+        self.classes: Dict[str, List[Tuple[str, ast.ClassDef]]] = {}
+        #: (relpath, class name) -> {method name: fn node} — keyed by
+        #: module so same-named classes in different files don't merge
+        self.methods: Dict[Tuple[str, str], Dict[str, ast.AST]] = {}
+        #: relpath -> {module-level fn name: fn node}
+        self.module_fns: Dict[str, Dict[str, ast.AST]] = {}
+        #: relpath -> module-level assigned names (shared-state candidates)
+        self.module_globals: Dict[str, Set[str]] = {}
+        self.fns: Dict[ast.AST, FnInfo] = {}
+        #: fn node -> [(name, node)] of nested defs, for lexical calls
+        self._nested: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        #: (outer lock id, inner lock id) -> witnessing (relpath, line, ctx)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        for ctx in project.ctxs:
+            self._index_module(ctx)
+        for ctx in project.ctxs:
+            self._scan_module(ctx)
+        self._expand_callbacks()
+        self._fix_entry_held()
+        self._fix_acquire_sets()
+        self._build_edges()
+
+    @classmethod
+    def of(cls, project) -> "LockModel":
+        model = getattr(project, "_lock_model", None)
+        if model is None:
+            model = cls(project)
+            project._lock_model = model
+        return model
+
+    # -- phase 1: indexing ---------------------------------------------------
+
+    def _lock_kind(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        seg = dotted_name(node.func) or ""
+        kind = LOCK_CTORS.get(seg.rsplit(".", 1)[-1])
+        if kind == "condition" and node.args:
+            arg = node.args[0]
+            aseg = (dotted_name(arg.func) or "").rsplit(".", 1)[-1] \
+                if isinstance(arg, ast.Call) else ""
+            if aseg == "Lock":
+                return "condition_lock"   # non-reentrant backing
+        return kind
+
+    def _register_lock(self, relpath: str, owner: Optional[str],
+                       name: str, kind: str, line: int) -> str:
+        lid = (f"{relpath}::{owner}.{name}" if owner
+               else f"{relpath}::{name}")
+        if lid not in self.locks:
+            self.locks[lid] = Lock(lid, kind, relpath, owner, name, line)
+        return lid
+
+    def _index_module(self, ctx):
+        rel = ctx.relpath
+        self.module_locks.setdefault(rel, {})
+        self.module_globals.setdefault(rel, set())
+        self.module_fns.setdefault(rel, {})
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = self._lock_kind(node.value)
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    self.module_globals[rel].add(tgt.id)
+                    if kind:
+                        self.module_locks[rel][tgt.id] = \
+                            self._register_lock(rel, None, tgt.id, kind,
+                                                node.lineno)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                tgt = node.target
+                if isinstance(tgt, ast.Name):
+                    self.module_globals[rel].add(tgt.id)
+            elif isinstance(node, _FUNC_NODES):
+                self.module_fns[rel][node.name] = node
+                self._index_fn(ctx, node, node.name, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                self._index_class(ctx, node)
+
+    def _index_class(self, ctx, cnode: ast.ClassDef):
+        rel = ctx.relpath
+        cname = cnode.name
+        self.classes.setdefault(cname, []).append((rel, cnode))
+        self.class_locks.setdefault((rel, cname), {})
+        methods = self.methods.setdefault((rel, cname), {})
+        for node in cnode.body:
+            if isinstance(node, ast.Assign):          # class-level lock
+                kind = self._lock_kind(node.value)
+                if kind:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.class_locks[(rel, cname)][tgt.id] = \
+                                self._register_lock(rel, cname, tgt.id,
+                                                    kind, node.lineno)
+            elif isinstance(node, _FUNC_NODES):
+                methods.setdefault(node.name, node)
+                self._index_fn(ctx, node, f"{cname}.{node.name}",
+                               cls=cname)
+                self._harvest_attrs(ctx, cname, node)
+
+    def _harvest_attrs(self, ctx, cname: str, fn: ast.AST):
+        """``self.X = <lock ctor | ClassName(...) | __init__ param>``
+        anywhere in a method declares the attribute's role."""
+        rel = ctx.relpath
+        # every parameter kind counts: the serving injectables (wait=,
+        # on_tenant_event=, probe=) are keyword-only
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)} - {"self", "cls"}
+        local_types: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            # local typing first, so `w = StallWatchdog(...); self.w = w`
+            # resolves through the intermediate name
+            vtype = self._value_type(node.value, local_types)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and vtype:
+                    local_types[tgt.id] = vtype
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                attr = tgt.attr
+                kind = self._lock_kind(node.value)
+                if kind:
+                    alias = self._condition_alias(ctx, cname, node.value)
+                    self.class_locks[(rel, cname)][attr] = alias or \
+                        self._register_lock(rel, cname, attr, kind,
+                                            node.lineno)
+                elif vtype:
+                    self.attr_types[(rel, cname, attr)] = vtype
+                elif fn.name == "__init__":
+                    pname = self._param_source(node.value, params)
+                    if pname:
+                        self.attr_params[(rel, cname, attr)] = pname
+
+    def _condition_alias(self, ctx, cname: str,
+                         value: ast.Call) -> Optional[str]:
+        """``threading.Condition(self._lock)`` aliases the wrapped
+        lock. The alias UPGRADES the lock's kind to a condition-backed
+        one so wait/notify events on it are tracked (cond-wakeup) while
+        its reentrancy stays that of the backing lock."""
+        if not (isinstance(value, ast.Call) and value.args):
+            return None
+        arg = value.args[0]
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"):
+            lid = self.class_locks.get((ctx.relpath, cname),
+                                       {}).get(arg.attr)
+            if lid is not None:
+                lock = self.locks[lid]
+                if lock.kind == "lock":
+                    lock.kind = "condition_lock"
+                elif lock.kind == "rlock":
+                    lock.kind = "condition_rlock"
+            return lid
+        return None
+
+    def _value_type(self, value: ast.AST,
+                    local_types: Dict[str, str]) -> Optional[str]:
+        """Best-effort class name of an assigned value."""
+        if isinstance(value, ast.Call):
+            seg = (dotted_name(value.func) or "").rsplit(".", 1)[-1]
+            if seg in self.classes or (seg and seg[:1].isupper()
+                                       and seg not in LOCK_CTORS):
+                return seg
+        elif isinstance(value, ast.Name):
+            return local_types.get(value.id)
+        elif isinstance(value, ast.BoolOp):        # `given or Default()`
+            for operand in value.values:
+                t = self._value_type(operand, local_types)
+                if t:
+                    return t
+        return None
+
+    @staticmethod
+    def _param_source(value: ast.AST, params: Set[str]) -> Optional[str]:
+        """The __init__ parameter an attribute stows, through
+        ``param`` / ``param or default`` shapes."""
+        if isinstance(value, ast.Name) and value.id in params:
+            return value.id
+        if isinstance(value, ast.BoolOp):
+            for operand in value.values:
+                if isinstance(operand, ast.Name) \
+                        and operand.id in params:
+                    return operand.id
+        return None
+
+    def _index_fn(self, ctx, fn: ast.AST, qualname: str,
+                  cls: Optional[str]):
+        info = FnInfo(fn, qualname, ctx.relpath, cls)
+        args = fn.args
+        info.params = [a.arg for a in
+                       (args.posonlyargs + args.args + args.kwonlyargs)]
+        info.is_method = bool(info.params) \
+            and info.params[0] in ("self", "cls")
+        for dec in fn.decorator_list:
+            seg = dotted_name(dec if not isinstance(dec, ast.Call)
+                              else dec.func)
+            if seg:
+                info.decorators.add(seg.rsplit(".", 1)[-1])
+        self.fns[fn] = info
+        # nested defs/lambdas get their own FnInfo, in the same class
+        # context; the parent records them for lexical call resolution
+        nested = self._nested.setdefault(fn, {})
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            if isinstance(node, _FUNC_NODES) and node not in self.fns:
+                nested[node.name] = node
+                self._index_fn(ctx, node, f"{qualname}.{node.name}", cls)
+            elif isinstance(node, ast.Lambda) and node not in self.fns:
+                linfo = FnInfo(node, f"{qualname}.<lambda>",
+                               ctx.relpath, cls)
+                largs = node.args
+                linfo.params = [a.arg for a in
+                                (largs.posonlyargs + largs.args
+                                 + largs.kwonlyargs)]
+                self.fns[node] = linfo
+
+    # -- phase 2: body scan --------------------------------------------------
+
+    def _scan_module(self, ctx):
+        for fn, info in list(self.fns.items()):
+            if info.relpath != ctx.relpath:
+                continue
+            if isinstance(fn, ast.Lambda):
+                self._scan_expr(info, fn.body, frozenset(), ctx)
+            else:
+                info.locals = self._collect_locals(fn)
+                info.global_decls = {
+                    name for node in walk_own(fn)
+                    if isinstance(node, ast.Global)
+                    for name in node.names}
+                info.local_types = self._collect_local_types(info, fn)
+                self._scan_body(info, fn.body, set(), ctx)
+
+    def _collect_local_types(self, info: FnInfo,
+                             fn: ast.AST) -> Dict[str, str]:
+        """Best-effort class names for the function's locals: direct
+        construction (`q = Queue()`) and the hoist-to-local idiom over
+        typed attributes (`q = self._queue`)."""
+        out: Dict[str, str] = {}
+        for node in walk_own(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            tname: Optional[str] = None
+            if (isinstance(value, ast.Attribute)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id in ("self", "cls") and info.cls):
+                tname = self.attr_types.get(
+                    (info.relpath, info.cls, value.attr))
+            else:
+                tname = self._value_type(value, out)
+            if not tname:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = tname
+        return out
+
+    @staticmethod
+    def _collect_locals(fn: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            out.add(a.arg)
+        for node in walk_own(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                out.add(node.id)
+        return out
+
+    def _scan_body(self, info: FnInfo, body: Sequence[ast.AST],
+                   held: Set[str], ctx):
+        """Scan a statement list, MUTATING ``held`` for explicit
+        acquire()/release() calls so the bookkeeping flows to the
+        statements that follow."""
+        for stmt in body:
+            self._scan_stmt(info, stmt, held, ctx)
+
+    def _sub_body(self, info: FnInfo, body: Sequence[ast.AST],
+                  held: Set[str], ctx, extra=()):
+        """Scan a NESTED body (branch / loop / try arm / with block).
+        Releases escape to the enclosing scope — the canonical
+        ``acquire(); try: ... finally: release()`` must drop the lock
+        for the statements after the try — but acquires made inside the
+        branch do not (conservative: they may not have executed). A
+        body that cannot fall through (ends in return/raise/break/
+        continue) keeps its releases to itself: in
+        ``if err: release(); return``, the statements after the branch
+        run only WITH the lock still held."""
+        child = set(held) | set(extra)
+        self._scan_body(info, body, child, ctx)
+        if body and isinstance(body[-1], (ast.Return, ast.Raise,
+                                          ast.Break, ast.Continue)):
+            return                  # no fall-through: releases stay put
+        held -= (held - child)      # released-in-child leaves the parent
+
+    def _scan_stmt(self, info: FnInfo, stmt: ast.AST,
+                   held: Set[str], ctx):
+        frozen = frozenset(held)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            inner = set(held)
+            for item in stmt.items:
+                self._scan_expr(info, item.context_expr, frozenset(inner),
+                                ctx, calls_only=True)
+                lid = self._resolve_lock(info, item.context_expr, ctx)
+                if lid:
+                    # `with a, b:` — b is acquired with a already held
+                    info.acquisitions.append(
+                        (lid, item.context_expr, frozenset(inner)))
+                    acquired.append(lid)
+                    inner.add(lid)
+            self._sub_body(info, stmt.body, held, ctx, extra=acquired)
+        elif isinstance(stmt, ast.If):
+            self._scan_expr(info, stmt.test, frozen, ctx)
+            self._sub_body(info, stmt.body, held, ctx)
+            self._sub_body(info, stmt.orelse, held, ctx)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(info, stmt.iter, frozen, ctx)
+            self._sub_body(info, stmt.body, held, ctx)
+            self._sub_body(info, stmt.orelse, held, ctx)
+        elif isinstance(stmt, ast.While):
+            self._scan_expr(info, stmt.test, frozen, ctx)
+            self._sub_body(info, stmt.body, held, ctx)
+            self._sub_body(info, stmt.orelse, held, ctx)
+        elif isinstance(stmt, ast.Try):
+            self._sub_body(info, stmt.body, held, ctx)
+            for handler in stmt.handlers:
+                self._sub_body(info, handler.body, held, ctx)
+            self._sub_body(info, stmt.orelse, held, ctx)
+            self._sub_body(info, stmt.finalbody, held, ctx)
+        elif isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+            return                   # own FnInfo / out of scope
+        else:
+            # flat statement: explicit acquire/release bookkeeping, then
+            # the expression walk for calls/mutations/cond events
+            lock_op = self._acquire_release(info, stmt, ctx)
+            if lock_op:
+                op, lid = lock_op
+                if op == "acquire":
+                    info.acquisitions.append(
+                        (lid, stmt, frozen))
+                    held.add(lid)
+                else:
+                    held.discard(lid)
+                return
+            self._scan_expr(info, stmt, frozen, ctx)
+
+    def _acquire_release(self, info, stmt, ctx):
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr in ("acquire", "release")):
+            return None
+        lid = self._resolve_lock(info, stmt.value.func.value, ctx)
+        if lid is None:
+            return None
+        return stmt.value.func.attr, lid
+
+    # -- expression walk -----------------------------------------------------
+
+    _LOG_ROOTS = {"logging", "logger", "log", "warnings"}
+
+    def _scan_expr(self, info: FnInfo, node: ast.AST,
+                   held: FrozenSet[str], ctx,
+                   calls_only: bool = False):
+        """Walk one statement/expression for calls, condition events,
+        and shared-state mutations; stops at nested function/lambda
+        bodies (they run on their own schedule, under whatever locks
+        their *invocation* holds — the callback expansion supplies
+        that)."""
+        stack = [node]
+        while stack:
+            child = stack.pop()
+            if isinstance(child, _FUNC_NODES + (ast.Lambda,)) \
+                    and child is not node:
+                continue
+            if isinstance(child, ast.Call):
+                self._scan_call(info, child, held, ctx)
+            if not calls_only and isinstance(
+                    child, (ast.Assign, ast.AugAssign, ast.Delete)):
+                self._scan_mutation(info, child, held, ctx)
+            stack.extend(ast.iter_child_nodes(child))
+
+    def _scan_call(self, info: FnInfo, call: ast.Call,
+                   held: FrozenSet[str], ctx):
+        func = call.func
+        name = dotted_name(func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        root = name.split(".", 1)[0]
+        # condition wait/notify
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("wait", "wait_for", "notify",
+                                  "notify_all"):
+            lid = self._resolve_lock(info, func.value, ctx)
+            if lid and not is_unknown(lid) \
+                    and self.locks[lid].kind.startswith("condition"):
+                kind = "wait" if func.attr in ("wait", "wait_for") \
+                    else func.attr
+                info.cond_events.append((lid, call, kind, held))
+        # handler-relevant effects
+        if name == "print" or name == "open":
+            info.effect_calls.append((name, call, held))
+        elif root in self._LOG_ROOTS and "." in name:
+            info.effect_calls.append(("logging", call, held))
+        # mutator method on shared state
+        if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+            target = self._shared_target(info, func.value, ctx)
+            if target:
+                scope, sname = target
+                info.mutations.append((scope, sname, call, held,
+                                       "mutate"))
+        # resolution
+        callees = self._resolve_call(info, func, ctx)
+        passed = self._passed_fns(info, call, ctx)
+        for callee in callees:
+            info.calls.append((callee, call, held, passed))
+        if not callees:
+            if isinstance(func, ast.Name) and func.id in info.params:
+                info.param_calls.append((func.id, call, held))
+            elif (isinstance(func, ast.Attribute)
+                  and isinstance(func.value, ast.Name)
+                  and func.value.id == "self" and info.cls
+                  and (info.relpath, info.cls,
+                       func.attr) in self.attr_params):
+                info.attr_param_calls.append((func.attr, call, held))
+
+    def _passed_fns(self, info: FnInfo, call: ast.Call, ctx) -> Dict:
+        """Function-valued arguments: {positional index | kw name: fn}."""
+        out: Dict = {}
+        for i, arg in enumerate(call.args):
+            fn = self._as_fn(info, arg, ctx)
+            if fn is not None:
+                out[i] = fn
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            fn = self._as_fn(info, kw.value, ctx)
+            if fn is not None:
+                out[kw.arg] = fn
+        return out
+
+    def _as_fn(self, info: FnInfo, node: ast.AST, ctx):
+        if isinstance(node, ast.Lambda):
+            return node
+        hits = self._resolve_call(info, node, ctx)
+        return hits[0] if hits else None
+
+    def _method_hits(self, cname: str, meth: str,
+                     prefer_rel: Optional[str] = None) -> List[ast.AST]:
+        """Methods named ``meth`` on class ``cname``. A same-module
+        class wins outright; otherwise every module's candidate is
+        returned (same-named classes in different files must not merge
+        into one — the conservative union keeps the real one covered)."""
+        if prefer_rel is not None:
+            hit = self.methods.get((prefer_rel, cname), {}).get(meth)
+            if hit is not None:
+                return [hit]
+        out: List[ast.AST] = []
+        for rel, _node in self.classes.get(cname, ()):
+            hit = self.methods.get((rel, cname), {}).get(meth)
+            if hit is not None:
+                out.append(hit)
+        return out
+
+    def _resolve_call(self, info: FnInfo, func: ast.AST,
+                      ctx) -> List[ast.AST]:
+        """Resolve a callee expression to function node(s)."""
+        if isinstance(func, ast.Name):
+            # lexical: nested defs of this fn, then module functions
+            hit = self._nested.get(info.node, {}).get(func.id)
+            if hit is not None:
+                return [hit]
+            hit = self.module_fns.get(info.relpath, {}).get(func.id)
+            return [hit] if hit is not None else []
+        if not isinstance(func, ast.Attribute):
+            return []
+        base = func.value
+        meth = func.attr
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and info.cls:
+                return self._method_hits(info.cls, meth,
+                                         prefer_rel=info.relpath)
+            # class-level access by class name
+            if base.id in self.classes:
+                return self._method_hits(base.id, meth)
+            # typed local: `q = self._queue` / `q = Queue()` then q.meth()
+            tname = info.local_types.get(base.id)
+            if tname:
+                return self._method_hits(tname, meth)
+        # self.<attr>.meth() through a typed attribute
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id in ("self", "cls") and info.cls):
+            tname = self.attr_types.get(
+                (info.relpath, info.cls, base.attr))
+            if tname:
+                return self._method_hits(tname, meth)
+        return []
+
+    # -- lock / shared-state resolution --------------------------------------
+
+    def _resolve_lock(self, info: FnInfo, expr: ast.AST,
+                      ctx) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            lid = self.module_locks.get(info.relpath, {}).get(expr.id)
+            if lid:
+                return lid
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and info.cls:
+                lid = self.class_locks.get(
+                    (info.relpath, info.cls), {}).get(attr)
+                if lid:
+                    return lid
+                # inherited / cross-assigned lock attr: weak marker
+                return f"?{attr}" if _lockish(attr) else None
+            if base.id in self.classes:          # ClassName._class_lock
+                for rel, _ in self.classes[base.id]:
+                    lid = self.class_locks.get((rel, base.id),
+                                               {}).get(attr)
+                    if lid:
+                        return lid
+            tname = info.local_types.get(base.id)
+            if tname:                            # `q = self._queue` hoist
+                for rel, _ in self.classes.get(tname, ()):
+                    lid = self.class_locks.get((rel, tname), {}).get(attr)
+                    if lid:
+                        return lid
+        # self.<attr>.lock through a typed attribute
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id in ("self", "cls") and info.cls):
+            tname = self.attr_types.get(
+                (info.relpath, info.cls, base.attr))
+            if tname:
+                for rel, _ in self.classes.get(tname, ()):
+                    lid = self.class_locks.get((rel, tname), {}).get(attr)
+                    if lid:
+                        return lid
+        return f"?{attr}" if _lockish(attr) else None
+
+    def _shared_target(self, info: FnInfo, node: ast.AST,
+                       ctx) -> Optional[Tuple[Tuple, str]]:
+        """Classify an expression as shared state: ``self.X`` (possibly
+        through subscripts) or a module global."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and info.cls):
+            return ("class", info.relpath, info.cls), node.attr
+        if isinstance(node, ast.Name):
+            name = node.id
+            if name in self.module_globals.get(info.relpath, set()) \
+                    and (name not in info.locals
+                         or name in info.global_decls):
+                return ("module", info.relpath), name
+        return None
+
+    def _scan_mutation(self, info: FnInfo, stmt: ast.AST,
+                       held: FrozenSet[str], ctx):
+        if isinstance(stmt, ast.Assign):
+            targets, kind = stmt.targets, "assign"
+        elif isinstance(stmt, ast.AugAssign):
+            targets, kind = [stmt.target], "augassign"
+        else:
+            targets, kind = stmt.targets, "delete"
+        for tgt in targets:
+            probe = tgt
+            # `self.X = ...` rebinds; `self.X[k] = ...` mutates X
+            if isinstance(probe, (ast.Attribute, ast.Subscript,
+                                  ast.Name)):
+                target = self._shared_target(info, probe, ctx)
+                if target:
+                    scope, name = target
+                    # a Name store only counts with a `global` decl
+                    if isinstance(probe, ast.Name) \
+                            and probe.id not in info.global_decls:
+                        continue
+                    info.mutations.append((scope, name, tgt, held, kind))
+
+    # -- phase 3: fixpoints --------------------------------------------------
+
+    def _call_sites(self) -> Dict[ast.AST, List[Tuple[FnInfo,
+                                                      FrozenSet[str]]]]:
+        sites: Dict[ast.AST, List] = {}
+        for info in self.fns.values():
+            for callee, _node, held, _passed in info.calls:
+                sites.setdefault(callee, []).append((info, held))
+        return sites
+
+    def _expand_callbacks(self):
+        """Synthesize call events for function-valued arguments invoked
+        through callee parameters, and for constructor-injected
+        callbacks invoked through ``self.<attr>(...)``."""
+        # parameter callbacks: g(p)(...) under g's lock
+        for info in self.fns.values():
+            for callee, node, held, passed in list(info.calls):
+                cinfo = self.fns.get(callee)
+                if cinfo is None or not passed:
+                    continue
+                bound = self._bind(cinfo, passed)
+                for pname, pnode, pheld in cinfo.param_calls:
+                    fn = bound.get(pname)
+                    if fn is not None:
+                        cinfo.calls.append(
+                            (fn, pnode, pheld | held, {}))
+        # constructor-injected callbacks: self.X(...) where X stows an
+        # __init__ param and some construction site passes a known fn
+        injected: Dict[Tuple[str, str, str], List[ast.AST]] = {}
+        for info in self.fns.values():
+            for callee, node, held, passed in info.calls:
+                cinfo = self.fns.get(callee)
+                if cinfo is None or cinfo.qualname.split(".")[-1] \
+                        != "__init__" or not passed:
+                    continue
+                bound = self._bind(cinfo, passed)
+                for (rel, cname, attr), pname in self.attr_params.items():
+                    if cname != cinfo.cls or rel != cinfo.relpath:
+                        continue
+                    fn = bound.get(pname)
+                    if fn is not None:
+                        injected.setdefault((rel, cname, attr),
+                                            []).append(fn)
+        # class construction *by name* also reaches __init__ via
+        # _resolve_call only for Name() of module fns; cover ClassName()
+        for info in self.fns.values():
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                seg = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                if seg not in self.classes:
+                    continue
+                passed = self._passed_fns(info, node, None)
+                if not passed:
+                    continue
+                for init in self._method_hits(seg, "__init__"):
+                    iinfo = self.fns.get(init)
+                    if iinfo is None:
+                        continue
+                    bound = self._bind(iinfo, passed)
+                    for (rel, cname, attr), pname \
+                            in self.attr_params.items():
+                        if cname != seg or rel != iinfo.relpath:
+                            continue
+                        fn = bound.get(pname)
+                        if fn is not None:
+                            injected.setdefault((rel, cname, attr),
+                                                []).append(fn)
+        for (rel, cname, attr), fns in injected.items():
+            for info in self.fns.values():
+                if info.cls != cname or info.relpath != rel:
+                    continue
+                for aname, anode, aheld in info.attr_param_calls:
+                    if aname != attr:
+                        continue
+                    for fn in fns:
+                        info.calls.append((fn, anode, aheld, {}))
+
+    def _bind(self, callee: FnInfo, passed: Dict) -> Dict[str, ast.AST]:
+        """Map passed function args onto the callee's parameter names."""
+        offset = 1 if callee.is_method else 0
+        out: Dict[str, ast.AST] = {}
+        for key, fn in passed.items():
+            if isinstance(key, int):
+                idx = key + offset
+                if idx < len(callee.params):
+                    out[callee.params[idx]] = fn
+            else:
+                out[key] = fn
+        return out
+
+    def _fix_entry_held(self):
+        """entry_held(f) = ⋂ over call sites (held ∪ entry_held(caller));
+        functions with no known callers hold nothing on entry."""
+        sites = self._call_sites()
+        universe = frozenset(self.locks)
+        entry = {fn: (universe if fn in sites else frozenset())
+                 for fn in self.fns}
+        for _ in range(30):
+            changed = False
+            for fn, fn_sites in sites.items():
+                if fn not in entry:
+                    continue
+                met: Optional[FrozenSet[str]] = None
+                for caller, held in fn_sites:
+                    eff = held | entry.get(caller.node, frozenset())
+                    met = eff if met is None else (met & eff)
+                met = met if met is not None else frozenset()
+                if met != entry[fn]:
+                    entry[fn] = met
+                    changed = True
+            if not changed:
+                break
+        # a computed entry is meaningful only when some caller chain
+        # terminates at an ANCHOR (a function with no known call sites,
+        # i.e. externally callable, whose entry is the ground-truth ∅).
+        # A call-graph SCC with no anchored caller — a recursive
+        # function invoked only dynamically — never drains from the
+        # optimistic top; "must hold every lock" there is no
+        # information and would fabricate self-deadlocks.
+        reach: Set[ast.AST] = set()
+        frontier = [fn for fn in self.fns if fn not in sites]
+        while frontier:
+            fn = frontier.pop()
+            if fn in reach:
+                continue
+            reach.add(fn)
+            for callee, _n, _h, _p in self.fns[fn].calls:
+                if callee in self.fns and callee not in reach:
+                    frontier.append(callee)
+        for fn, info in self.fns.items():
+            info.entry_held = (entry.get(fn, frozenset())
+                               if fn in reach else frozenset())
+
+    def _fix_acquire_sets(self):
+        """acq_trans(f) = local acquisitions ∪ ⋃ acq_trans(callees)."""
+        acq = {fn: frozenset(l for l, _n, _h in info.acquisitions
+                             if not is_unknown(l))
+               for fn, info in self.fns.items()}
+        for _ in range(30):
+            changed = False
+            for fn, info in self.fns.items():
+                cur = acq[fn]
+                for callee, _n, _h, _p in info.calls:
+                    cur = cur | acq.get(callee, frozenset())
+                if cur != acq[fn]:
+                    acq[fn] = cur
+                    changed = True
+            if not changed:
+                break
+        for fn, info in self.fns.items():
+            info.acq_trans = acq[fn]
+
+    def _build_edges(self):
+        def add(outer: str, inner: str, node: ast.AST, info: FnInfo):
+            if is_unknown(outer) or is_unknown(inner):
+                return
+            if outer == inner:
+                if self.locks[inner].kind in REENTRANT:
+                    return          # re-entrant self-acquire is fine
+            key = (outer, inner)
+            site = (info.relpath, getattr(node, "lineno", 1),
+                    info.qualname)
+            if key not in self.edges or site < self.edges[key]:
+                self.edges[key] = site
+
+        for info in self.fns.values():
+            for lid, node, held in info.acquisitions:
+                for h in info.held_at(held):
+                    add(h, lid, node, info)
+            for callee, node, held, _p in info.calls:
+                cinfo = self.fns.get(callee)
+                if cinfo is None:
+                    continue
+                for h in info.held_at(held):
+                    for l in cinfo.acq_trans:
+                        add(h, l, node, info)
+
+    # -- queries -------------------------------------------------------------
+
+    def functions(self):
+        return self.fns.values()
+
+    def reachable_from(self, roots: Sequence[ast.AST]
+                       ) -> Dict[ast.AST, Tuple[ast.AST, ...]]:
+        """BFS over call events from ``roots``; returns
+        {fn node: (root, ..., fn) discovery chain}."""
+        chains: Dict[ast.AST, Tuple[ast.AST, ...]] = {}
+        frontier: List[ast.AST] = []
+        for root in roots:
+            if root in self.fns and root not in chains:
+                chains[root] = (root,)
+                frontier.append(root)
+        while frontier:
+            fn = frontier.pop()
+            info = self.fns[fn]
+            for callee, _n, _h, _p in info.calls:
+                if callee in self.fns and callee not in chains:
+                    chains[callee] = chains[fn] + (callee,)
+                    frontier.append(callee)
+        return chains

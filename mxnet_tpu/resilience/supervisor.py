@@ -50,6 +50,7 @@ import json
 import logging
 import os
 import signal as _signal
+import sys
 import threading
 import time
 from typing import Callable, Dict, Optional, Sequence
@@ -149,7 +150,19 @@ def _count_nolock(key: str, n: int = 1):
     it here would self-deadlock the handler and the process would die
     un-checkpointed. A GIL-atomic dict update is enough for advisory
     counters."""
-    _counters[key] = _counters.get(key, 0) + n
+    _counters[key] = _counters.get(key, 0) + n  # tpu-lint: disable=unguarded-shared-state — GIL-atomic by design; the locked _count() would self-deadlock the handler
+
+
+def _handler_log(msg: str):
+    """Handler-safe substitute for ``logging``. The logging module
+    serializes handlers behind locks; if the interrupted thread is
+    mid-log when the signal lands, a ``logging.*`` call here deadlocks
+    the handler (tpu-lint: signal-unsafe). One raw stderr write keeps
+    the operator message without touching any lock."""
+    try:
+        sys.stderr.write(msg + "\n")
+    except Exception:       # noqa: BLE001 — a closed stderr must not
+        pass                # kill the handler
 
 
 def stats() -> dict:
@@ -662,15 +675,18 @@ class TrainingSupervisor:
         Second: :class:`ImmediateAbort`."""
         if self._preempt_signum is None:
             self._preempt_signum = signum
-            logging.warning(
-                "TrainingSupervisor: signal %s — finishing the in-flight "
-                "step, then checkpoint + clean exit (code %d); a second "
-                "signal aborts immediately", signum, EXIT_PREEMPTED)
+            # handler context: logging would take the logging module's
+            # handler locks — _handler_log writes raw bytes instead
+            _handler_log(
+                f"TrainingSupervisor: signal {signum} — finishing the "
+                f"in-flight step, then checkpoint + clean exit (code "
+                f"{EXIT_PREEMPTED}); a second signal aborts immediately")
             return
         _count_nolock("second_signals")    # handler path: no locks
         _count_nolock("aborts")
-        logging.error("TrainingSupervisor: second signal %s — immediate "
-                      "abort (code %d)", signum, EXIT_ABORTED)
+        _handler_log(
+            f"TrainingSupervisor: second signal {signum} — immediate "
+            f"abort (code {EXIT_ABORTED})")
         raise ImmediateAbort(
             f"second preemption signal ({signum}) during the grace "
             f"window", exit_code=EXIT_ABORTED)

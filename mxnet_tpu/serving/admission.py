@@ -353,8 +353,8 @@ class AdmissionQueue:
         self.clock = clock
         self.tenants = tenants
         self._on_tenant_event = on_tenant_event or (lambda *a, **k: None)
-        self._items: deque = deque()
         self._cv = threading.Condition()
+        self._items: deque = deque()  # tpu-lint: guarded-by=_cv
         # private by default (per-queue fairness, the PR 10 behavior);
         # the fleet router passes one shared instance per replica queue
         # so fair shares are measured fleet-wide

@@ -332,14 +332,14 @@ class FleetRouter:
         # queue, so a tenant's weighted share is fleet-global
         self._stride = StrideScheduler()
         self._lock = threading.RLock()
-        self._replicas: Dict[str, Replica] = {}
-        self._retired: List[Replica] = []
-        self._sessions: Dict[str, Optional[str]] = {}
+        self._replicas: Dict[str, Replica] = {}  # tpu-lint: guarded-by=_lock
+        self._retired: List[Replica] = []  # tpu-lint: guarded-by=_lock
+        self._sessions: Dict[str, Optional[str]] = {}  # tpu-lint: guarded-by=_lock
         self._seq = 0
         self._kills = 0
         self._last_probe: Optional[float] = None
         self._closed = False
-        self._totals: Dict[str, float] = {
+        self._totals: Dict[str, float] = {  # tpu-lint: guarded-by=_lock
             "submitted": 0, "delivered": 0, "failed_terminal": 0,
             "re_routed": 0, "dedup_hits": 0, "evictions": 0,
             "failovers": 0, "failovers_without_standby": 0,
@@ -689,10 +689,11 @@ class FleetRouter:
                             if not r.killed
                             and r.state in (ACTIVE, STANDBY, DRAINING)),
                            key=lambda r: r.id)
-        if not alive:
-            return
-        rng = random.Random(self._kill_seed() * 1000003 + self._kills)
-        self._kills += 1
+            if not alive:
+                return
+            kills = self._kills     # the kill counter is shared state:
+            self._kills += 1        # bump it under the lock it lives by
+        rng = random.Random(self._kill_seed() * 1000003 + kills)
         alive[rng.randrange(len(alive))].kill(
             f"injected fault at {SITE_PROBE}")
 
@@ -702,10 +703,14 @@ class FleetRouter:
         loop (the smoke/bench drive it between results); returns True
         when a probe pass actually ran."""
         now = self.clock()
-        if self._last_probe is not None \
-                and now - self._last_probe < self.probe_period:
-            return False
-        self._last_probe = now
+        with self._lock:
+            # gate read+stamp under the lock: two control threads
+            # ticking together must not both pass the period check and
+            # run concurrent probe passes (the check-then-act shape)
+            if self._last_probe is not None \
+                    and now - self._last_probe < self.probe_period:
+                return False
+            self._last_probe = now
         self.probe_once()
         return True
 
@@ -806,9 +811,12 @@ class FleetRouter:
                  # failover must not silently roll the fleet back to a
                  # model it reloaded off of
                  and r.model_version == self.model_version), None)
+            if standby is not None:
+                # flip ACTIVE while still holding the lock: two evicts
+                # promoting concurrently must not both claim this one
+                standby.state = ACTIVE
+                standby._err_base = (0, 0)
         if standby is not None:
-            standby.state = ACTIVE
-            standby._err_base = (0, 0)
             self._count("failovers")
             with self._lock:
                 self._totals["last_standby_ready_s"] = standby.ready_s

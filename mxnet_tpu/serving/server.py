@@ -186,12 +186,12 @@ class InferenceServer:
             self.max_batch, wait=self.batch_wait, clock=clock,
             guard=self._batch_guard, name=name)
         self._lock = threading.Lock()
-        self._tenant_stats: Dict[str, Dict[str, int]] = {}
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}  # tpu-lint: guarded-by=_lock
         self._queue = AdmissionQueue(capacity, shed_policy, clock,
                                      tenants=tenants,
                                      on_tenant_event=self._tenant_count,
                                      stride=stride)
-        self._stats: Dict[str, int] = {
+        self._stats: Dict[str, int] = {  # tpu-lint: guarded-by=_lock
             "admitted": 0, "completed": 0, "failed": 0,
             "shed": 0, "evicted": 0, "rejected_open": 0,
             "deadline_queued": 0, "deadline_inflight": 0,
@@ -207,7 +207,7 @@ class InferenceServer:
         self._load_error = None
         self._closed = False
         self._draining = False
-        self._inflight = 0
+        self._inflight = 0  # tpu-lint: guarded-by=_lock
         self._idle = threading.Event()
         self._idle.set()
         self._last_success: Optional[float] = None
@@ -222,12 +222,21 @@ class InferenceServer:
 
     def _spawn_worker(self):
         worker = _Worker(self)
-        self._workers.append(worker)
+        with self._lock:
+            self._workers.append(worker)
         worker.start()
 
     def _count(self, key: str, n: int = 1):
         with self._lock:
             self._stats[key] = self._stats.get(key, 0) + n
+
+    def _count_nolock(self, key: str, n: int = 1):
+        """Counter bump for SIGNAL-HANDLER paths (the serving mirror of
+        ``resilience.supervisor._count_nolock``): the interrupted thread
+        may hold ``self._lock``, so ``_count`` here would self-deadlock
+        the handler. A GIL-atomic dict update is enough for advisory
+        counters."""
+        self._stats[key] = self._stats.get(key, 0) + n  # tpu-lint: disable=unguarded-shared-state — GIL-atomic by design; _count() would self-deadlock the handler
 
     def _tenant_count(self, tenant: str, key: str, n: int = 1):
         """Per-tenant counter hook (also handed to the queue, which
@@ -443,11 +452,19 @@ class InferenceServer:
 
     def _watchdog_replace(self, worker):
         """A caller abandoned a request wedged inside ``worker``'s
-        forward: write the worker off and keep the pool at strength."""
-        if worker is None or worker.wedged:
+        forward: write the worker off and keep the pool at strength.
+        The wedged mark is check-and-set UNDER the lock — two callers
+        abandoning two requests stuck in the SAME worker must spawn one
+        replacement, not one each (the unlocked check-then-act would
+        double-spawn)."""
+        if worker is None:
             return
-        worker.wedged = True
-        self._count("wedged_workers")
+        with self._lock:
+            if worker.wedged:
+                return
+            worker.wedged = True
+            self._stats["wedged_workers"] = \
+                self._stats.get("wedged_workers", 0) + 1
         if not self._closed:
             self._spawn_worker()
 
@@ -746,7 +763,10 @@ class InferenceServer:
         ``signal_runtime().deliver(signum)``)."""
         if not self._draining:
             self._draining = True           # readyz false NOW
-            self._count("drain_signals")
+            # handler context: _count() takes self._lock, which the
+            # interrupted thread may hold — the nolock bump is the
+            # handler-safe form (tpu-lint: signal-unsafe)
+            self._count_nolock("drain_signals")
             if self._n_workers == 0:
                 # deterministic mode: the caller drives run_pending();
                 # draining completes on its next predict/run_pending
@@ -758,8 +778,18 @@ class InferenceServer:
                              kwargs={"grace": self.drain_grace},
                              name=f"serving-drain-{self.name}").start()
             return
-        self._count("drain_signals")
-        self.close(join_timeout=0.1)        # second signal: abort drain
+        self._count_nolock("drain_signals")
+        # second signal: abort the drain NOW — but not from inside the
+        # handler. close() takes the endpoint-registry lock and the
+        # queue condition; if the interrupted thread holds either, a
+        # handler-context close() self-deadlocks and the scheduler's
+        # SIGKILL lands on a wedged process. The closed flag flips here
+        # (GIL-atomic; submit fast-fails instantly), the lock-taking
+        # teardown runs on its own thread.
+        self._closed = True
+        threading.Thread(target=self.close, daemon=True,
+                         kwargs={"join_timeout": 0.1},
+                         name=f"serving-abort-{self.name}").start()
 
     def drain(self, grace: Optional[float] = None, poll: float = 0.1):
         """Stop admission and finish the in-flight work — the in-flight
@@ -787,7 +817,9 @@ class InferenceServer:
         """Stop accepting, wake the workers, unregister the endpoint."""
         self._closed = True
         self._queue.close()
-        for worker in self._workers:
+        with self._lock:
+            workers = list(self._workers)   # _spawn_worker may append
+        for worker in workers:
             if worker.is_alive() and not worker.wedged:
                 worker.join(timeout=join_timeout)
         if getattr(self, "_signals", None):

@@ -1,0 +1,1246 @@
+"""Concurrency-tier lint suite: every checker proves true positives AND
+true negatives on fixture snippets, plus suppression, cross-call (and
+cross-module) held-lock propagation, the `--only concurrency` CLI
+filter, and the self-lint contract — the committed tree's concurrency
+baseline is ZERO (docs/how_to/tpu_lint.md, "Concurrency checkers")."""
+import json
+import os
+import textwrap
+
+from mxnet_tpu.analysis import core
+from mxnet_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONCURRENCY_RULES = {"lock-order-cycle", "unguarded-shared-state",
+                     "check-then-act", "cond-wakeup", "signal-unsafe"}
+
+
+def run_lint(tmp_path, name="snippet.py", source="", extra=None):
+    """Write fixture file(s) under tmp_path and lint them all."""
+    files = {name: source, **(extra or {})}
+    paths = []
+    for rel, src in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src))
+        paths.append(str(full))
+    return core.lint(paths, root=str(tmp_path))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def of_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_two_locks_same_class(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:      # reversed: deadlock window
+                        pass
+    """)
+    hits = of_rule(findings, "lock-order-cycle")
+    assert len(hits) == 1
+    assert "Pair._a" in hits[0].message and "Pair._b" in hits[0].message
+    assert "deadlock" in hits[0].message
+
+
+def test_lock_order_cycle_self_deadlock_through_helper(tmp_path):
+    """Cross-call propagation: a non-reentrant lock re-acquired via a
+    helper the holder calls is a guaranteed self-deadlock."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def _bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def flush(self):
+                with self._lock:
+                    self._bump()       # re-acquires the plain Lock
+    """)
+    hits = of_rule(findings, "lock-order-cycle")
+    assert len(hits) == 1
+    assert "re-acquired" in hits[0].message
+    assert "RLock" in hits[0].message
+
+
+def test_lock_order_cycle_seeded_cross_module_deadlock(tmp_path):
+    """The acceptance fixture: a server/queue pair where the queue
+    calls back into the server lock from under its condition (the real
+    take(on_pop=...) seam) AND the server polls the queue under its own
+    lock — a cycle spanning two modules, closed through a callback."""
+    findings = run_lint(
+        tmp_path, name="pkg/queue.py", source="""
+        import threading
+
+        class WorkQueue:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def take(self, on_pop):
+                with self._cv:
+                    item = self._items.pop()
+                    on_pop(item)       # callback runs under _cv
+                    return item
+
+            def depth(self):
+                with self._cv:
+                    return len(self._items)
+    """, extra={"pkg/server.py": """
+        import threading
+
+        from .queue import WorkQueue
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = WorkQueue()
+                self._inflight = 0
+
+            def _begin(self, item):
+                with self._lock:
+                    self._inflight += 1
+
+            def worker(self):
+                return self._queue.take(on_pop=lambda i: self._begin(i))
+
+            def idle(self):
+                with self._lock:               # server lock held...
+                    return self._queue.depth() # ...queue lock taken
+    """})
+    hits = of_rule(findings, "lock-order-cycle")
+    assert len(hits) == 1
+    msg = hits[0].message
+    assert "WorkQueue._cv" in msg and "Server._lock" in msg
+
+
+def test_lock_order_true_negatives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._r = threading.RLock()
+
+            def one(self):
+                with self._a:
+                    with self._b:      # consistent order everywhere
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def reentrant(self):
+                with self._r:
+                    self.nested()
+
+            def nested(self):
+                with self._r:          # RLock: re-entry is the point
+                    pass
+    """)
+    assert "lock-order-cycle" not in rules_of(findings)
+
+
+def test_lock_order_sequential_is_not_nested(tmp_path):
+    """Dropping the first lock before taking the second is the fix —
+    it must not read as an edge."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Seq:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    pass
+                with self._b:
+                    pass
+
+            def two(self):
+                with self._b:
+                    pass
+                with self._a:
+                    pass
+    """)
+    assert "lock-order-cycle" not in rules_of(findings)
+
+
+def test_lock_order_cycle_suppression(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:  # tpu-lint: disable=lock-order-cycle — hand-over-hand over distinct instances
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:  # tpu-lint: disable=lock-order-cycle — hand-over-hand over distinct instances
+                        pass
+    """)
+    assert "lock-order-cycle" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+def test_unguarded_seeded_mutation_detected(tmp_path):
+    """The acceptance fixture: one attribute mutated both under its
+    class lock and bare."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self.total += n
+
+            def sneak(self, n):
+                self.total += n        # no lock: racing writers
+    """)
+    hits = of_rule(findings, "unguarded-shared-state")
+    assert len(hits) == 1
+    assert hits[0].context == "Stats.sneak"
+    assert "self.total" in hits[0].message
+
+
+def test_unguarded_declared_guard_is_enforced(tmp_path):
+    """`guarded-by=` turns the heuristic into a contract: EVERY
+    unlocked mutation is a finding, even with no locked one in sight."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = {}  # tpu-lint: guarded-by=_lock
+
+            def put(self, k, v):
+                self._rows[k] = v      # contract says hold _lock
+    """)
+    hits = of_rule(findings, "unguarded-shared-state")
+    assert len(hits) == 1
+    assert "guarded-by=_lock" in hits[0].message
+
+
+def test_unguarded_module_global_both_ways(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        _lock = threading.Lock()
+        _counters = {}
+
+        def count(key):
+            with _lock:
+                _counters[key] = _counters.get(key, 0) + 1
+
+        def count_fast(key):
+            _counters[key] = _counters.get(key, 0) + 1   # bare
+    """)
+    hits = of_rule(findings, "unguarded-shared-state")
+    assert len(hits) == 1 and hits[0].context == "count_fast"
+
+
+def test_unguarded_true_negatives_init_and_consistent(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Clean:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0         # construction is single-threaded
+
+            def add(self, n):
+                with self._lock:
+                    self.total += n
+
+            def read(self):
+                return self.total      # bare READS are allowed
+    """)
+    assert "unguarded-shared-state" not in rules_of(findings)
+
+
+def test_unguarded_cross_call_entry_held_propagation(tmp_path):
+    """A helper only ever called under the lock holds it on entry —
+    its mutations are guarded, not findings."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def _pick_locked(self):
+                self._items.pop()      # entry-held: every caller locks
+
+            def take(self):
+                with self._lock:
+                    self._pick_locked()
+
+            def poll(self):
+                with self._lock:
+                    self._pick_locked()
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+    """)
+    assert "unguarded-shared-state" not in rules_of(findings)
+
+
+def test_unguarded_single_threaded_escape_hatch(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+        from mxnet_tpu.analysis.annotations import single_threaded
+
+        class Loader:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.ready = False
+
+            def flip(self):
+                with self._lock:
+                    self.ready = True
+
+            @single_threaded("warm-up runs before any worker starts")
+            def warm_up(self):
+                self.ready = False     # exempt by annotation
+    """)
+    assert "unguarded-shared-state" not in rules_of(findings)
+
+
+def test_unguarded_suppression_comment(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self.total += n
+
+            def handler_bump(self, n):
+                self.total += n  # tpu-lint: disable=unguarded-shared-state — GIL-atomic handler path
+    """)
+    assert "unguarded-shared-state" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# check-then-act
+# ---------------------------------------------------------------------------
+
+def test_check_then_act_quota_shape(tmp_path):
+    """The tenant-quota race: read under the lock, decide after
+    releasing it, mutate under a fresh hold without re-validating."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Quota:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._used = 0
+
+            def admit(self, limit):
+                with self._lock:
+                    used = self._used
+                if used < limit:       # stale by the time it runs
+                    with self._lock:
+                        self._used += 1
+                    return True
+                return False
+    """)
+    hits = of_rule(findings, "check-then-act")
+    assert len(hits) == 1
+    assert "_used" in hits[0].message
+    assert hits[0].context == "Quota.admit"
+
+
+def test_check_then_act_list_membership_shape(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Drainer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def drain_one(self):
+                with self._lock:
+                    head = self._items[0] if self._items else None
+                if head is not None:
+                    with self._lock:
+                        self._items.remove(head)   # may be gone already
+                return head
+    """)
+    hits = of_rule(findings, "check-then-act")
+    assert len(hits) == 1 and "_items" in hits[0].message
+
+
+def test_check_then_act_double_checked_is_clean(tmp_path):
+    """Re-reading under the second hold (double-checked locking) is the
+    documented fix and must not be flagged."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Quota:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._used = 0
+
+            def admit(self, limit):
+                with self._lock:
+                    used = self._used
+                if used < limit:
+                    with self._lock:
+                        if self._used < limit:     # re-validated
+                            self._used += 1
+                            return True
+                return False
+    """)
+    assert "check-then-act" not in rules_of(findings)
+
+
+def test_check_then_act_single_region_is_clean(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Quota:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._used = 0
+
+            def admit(self, limit):
+                with self._lock:       # decision and mutation together
+                    if self._used < limit:
+                        self._used += 1
+                        return True
+                return False
+
+            def snapshot(self):
+                with self._lock:
+                    used = self._used
+                return used            # read-only after release: fine
+    """)
+    assert "check-then-act" not in rules_of(findings)
+
+
+def test_check_then_act_suppression(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Quota:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._used = 0
+
+            def admit(self, limit):
+                with self._lock:
+                    used = self._used
+                if used < limit:
+                    with self._lock:  # tpu-lint: disable=check-then-act — advisory counter, overshoot tolerated
+                        self._used += 1
+                    return True
+                return False
+    """)
+    assert "check-then-act" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# cond-wakeup
+# ---------------------------------------------------------------------------
+
+def test_cond_wakeup_two_waiter_classes(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def put(self, x):
+                with self._cv:
+                    self._items.append(x)
+                    self._cv.notify()      # may wake the wrong waiter
+
+            def take(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait()
+                    return self._items.pop()
+
+            def wait_arrival(self, timeout):
+                with self._cv:
+                    self._cv.wait(timeout)
+    """)
+    hits = of_rule(findings, "cond-wakeup")
+    assert len(hits) == 1
+    assert "notify_all" in hits[0].message
+    assert hits[0].context == "Queue.put"
+
+
+def test_cond_wakeup_module_level_condition(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        _cv = threading.Condition()
+        _ready = []
+
+        def publish(x):
+            with _cv:
+                _ready.append(x)
+                _cv.notify()
+
+        def consume():
+            with _cv:
+                while not _ready:
+                    _cv.wait()
+                return _ready.pop()
+
+        def watch(pred):
+            with _cv:
+                _cv.wait_for(pred)
+    """)
+    assert len(of_rule(findings, "cond-wakeup")) == 1
+
+
+def test_cond_wakeup_true_negatives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Broadcast:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def put(self, x):
+                with self._cv:
+                    self._items.append(x)
+                    self._cv.notify_all()  # wakes every waiter class
+
+            def take(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait()
+                    return self._items.pop()
+
+            def peek(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait()
+                    return self._items[0]
+
+        class HandOff:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._item = None
+
+            def put(self, x):
+                with self._cv:
+                    self._item = x
+                    self._cv.notify()      # ONE waiter class: fine
+
+            def take(self):
+                with self._cv:
+                    while self._item is None:
+                        self._cv.wait()
+                    item, self._item = self._item, None
+                    return item
+    """)
+    assert "cond-wakeup" not in rules_of(findings)
+
+
+def test_cond_wakeup_suppression(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def put(self, x):
+                with self._cv:
+                    self._items.append(x)
+                    self._cv.notify()  # tpu-lint: disable=cond-wakeup — waiters are interchangeable here
+
+            def take(self):
+                with self._cv:
+                    self._cv.wait()
+
+            def drain(self):
+                with self._cv:
+                    self._cv.wait(0.1)
+    """)
+    assert "cond-wakeup" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# signal-unsafe
+# ---------------------------------------------------------------------------
+
+def test_signal_unsafe_seeded_lock_acquiring_handler(tmp_path):
+    """The acceptance fixture: a signal.signal-registered handler that
+    takes a lock and logs."""
+    findings = run_lint(tmp_path, source="""
+        import logging
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+        _state = {}
+
+        def handler(signum, frame):
+            with _lock:                # interrupted holder => deadlock
+                _state["sig"] = signum
+            logging.warning("signal %s", signum)
+
+        signal.signal(signal.SIGTERM, handler)
+    """)
+    hits = of_rule(findings, "signal-unsafe")
+    assert len(hits) == 2
+    msgs = " | ".join(f.message for f in hits)
+    assert "acquired in signal-handler context" in msgs
+    assert "logging" in msgs
+
+
+def test_signal_unsafe_on_signal_listener_cross_call(tmp_path):
+    """The SignalRuntime contract: on_signal methods are handler
+    context, and the reach propagates through helpers."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Endpoint:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats = {}
+
+            def _count(self, key):
+                with self._lock:
+                    self._stats[key] = self._stats.get(key, 0) + 1
+
+            def on_signal(self, signum):
+                self._count("signals")     # lock via helper
+    """)
+    hits = of_rule(findings, "signal-unsafe")
+    assert len(hits) == 1
+    assert "Endpoint.on_signal()" in hits[0].message
+    assert "Endpoint._count()" in hits[0].message
+
+
+def test_signal_unsafe_true_negatives(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import logging
+        import threading
+
+        class Endpoint:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats = {}
+                self._draining = False
+
+            def _count(self, key):
+                with self._lock:
+                    self._stats[key] = self._stats.get(key, 0) + 1
+
+            def on_signal(self, signum):
+                # flags + GIL-atomic updates only: handler-safe
+                self._draining = True
+                self._stats["signals"] = self._stats.get("signals", 0) + 1  # tpu-lint: disable=unguarded-shared-state — GIL-atomic handler path
+
+            def drain(self):
+                self._count("drains")      # NOT handler-reachable
+                logging.info("draining")
+    """)
+    assert "signal-unsafe" not in rules_of(findings)
+
+
+def test_signal_unsafe_unregistered_handler_name_is_clean(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        _lock = threading.Lock()
+
+        def handler(signum, frame):    # never registered: not a root
+            with _lock:
+                pass
+    """)
+    assert "signal-unsafe" not in rules_of(findings)
+
+
+def test_signal_unsafe_install_after_def_in_compound_stmt(tmp_path):
+    """A signal.signal install sharing a top-level compound statement
+    with a def (conditional-install idiom) still roots the handler."""
+    findings = run_lint(tmp_path, source="""
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def handler(signum, frame):
+            with _lock:
+                pass
+
+        if True:
+            def _unrelated():
+                pass
+            signal.signal(signal.SIGTERM, handler)
+    """)
+    hits = of_rule(findings, "signal-unsafe")
+    assert len(hits) == 1 and hits[0].context == "handler"
+
+
+def test_signal_unsafe_suppression(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def handler(signum, frame):
+            with _lock:  # tpu-lint: disable=signal-unsafe — single-threaded embedder, no contention possible
+                pass
+
+        signal.signal(signal.SIGTERM, handler)
+    """)
+    assert "signal-unsafe" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# the --only tier filter
+# ---------------------------------------------------------------------------
+
+_MIXED_SNIPPET = """
+    import threading
+
+    import jax
+
+    _lock = threading.Lock()
+    _counters = {}
+
+    @jax.jit
+    def step(x):
+        return float(x.sum())          # core-tier finding
+
+    def count(key):
+        with _lock:
+            _counters[key] = 1
+
+    def count_fast(key):
+        _counters[key] = 1             # concurrency-tier finding
+"""
+
+
+def test_cli_only_concurrency_filters_core_rules(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(_MIXED_SNIPPET))
+    rc = lint_main([str(bad), "--root", str(tmp_path), "--no-baseline",
+                    "--only", "concurrency"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unguarded-shared-state" in out
+    assert "host-sync-under-trace" not in out
+    # and the core tier sees only its own rules
+    rc = lint_main([str(bad), "--root", str(tmp_path), "--no-baseline",
+                    "--only", "core"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "host-sync-under-trace" in out
+    assert "unguarded-shared-state" not in out
+
+
+def test_cli_only_rejects_unknown_tier_and_combinations(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = 1\n")
+    assert lint_main([str(bad), "--root", str(tmp_path),
+                      "--only", "nonsense"]) == 2
+    assert "unknown tier" in capsys.readouterr().err
+    assert lint_main([str(bad), "--root", str(tmp_path),
+                      "--only", "concurrency",
+                      "--checker", "cond-wakeup"]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+    (tmp_path / "mxnet_tpu").mkdir()
+    assert lint_main(["--root", str(tmp_path), "--only", "concurrency",
+                      "--write-baseline"]) == 2
+    assert "grandfathered" in capsys.readouterr().err
+
+
+def test_list_rules_shows_tiers(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in sorted(CONCURRENCY_RULES):
+        assert f"{rule} [concurrency]" in out
+    assert "host-sync-under-trace [core]" in out
+
+
+# ---------------------------------------------------------------------------
+# registry-consistency: the checker<->test<->doc group
+# ---------------------------------------------------------------------------
+
+_CHECKER_FIXTURE = """
+    from ..core import Checker, register_checker
+
+    @register_checker
+    class MysteryChecker(Checker):
+        name = "mystery-rule"
+        description = "a rule nobody tests or documents"
+"""
+
+
+def test_registry_consistency_untested_checker_flagged(tmp_path):
+    findings = run_lint(
+        tmp_path, name="mxnet_tpu/analysis/checkers/mystery.py",
+        source=_CHECKER_FIXTURE,
+        extra={
+            "tests/test_tpu_lint.py": "# no mention of the rule\n",
+            "docs/how_to/tpu_lint.md": "mystery-rule: documented here\n",
+        })
+    reg = of_rule(findings, "registry-consistency")
+    assert len(reg) == 1
+    assert "mystery-rule" in reg[0].message
+    assert "test_tpu_lint" in reg[0].message
+
+
+def test_registry_consistency_undocumented_checker_flagged(tmp_path):
+    findings = run_lint(
+        tmp_path, name="mxnet_tpu/analysis/checkers/mystery.py",
+        source=_CHECKER_FIXTURE,
+        extra={
+            "tests/test_concurrency_lint.py":
+                "exercises mystery-rule TP and TN\n",
+            "docs/how_to/tpu_lint.md": "# catalog without the rule\n",
+        })
+    reg = of_rule(findings, "registry-consistency")
+    assert len(reg) == 1
+    assert "mystery-rule" in reg[0].message and "catalog" in reg[0].message
+
+
+def test_registry_consistency_covered_checker_clean(tmp_path):
+    findings = run_lint(
+        tmp_path, name="mxnet_tpu/analysis/checkers/mystery.py",
+        source=_CHECKER_FIXTURE,
+        extra={
+            "tests/test_concurrency_lint.py":
+                "exercises mystery-rule TP and TN\n",
+            "docs/how_to/tpu_lint.md": "### mystery-rule\ndocumented\n",
+        })
+    assert "registry-consistency" not in rules_of(findings)
+
+
+def test_release_in_finally_escapes_the_block(tmp_path):
+    """`acquire(); try: ... finally: release()` drops the lock for the
+    statements AFTER the try: no phantom nesting edges (so no phantom
+    cycle), and a bare mutation after the release is still caught."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Manual:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self.total = 0
+
+            def locked_bump(self, n):
+                with self._a:
+                    self.total += n
+
+            def one(self):
+                self._a.acquire()
+                try:
+                    pass
+                finally:
+                    self._a.release()
+                with self._b:          # sequential, NOT nested under _a
+                    pass
+                self.total += 1        # and NOT lock-protected anymore
+
+            def two(self):
+                self._b.acquire()
+                try:
+                    pass
+                finally:
+                    self._b.release()
+                with self._a:          # mirror order: still no cycle
+                    pass
+    """)
+    assert "lock-order-cycle" not in rules_of(findings)
+    hits = of_rule(findings, "unguarded-shared-state")
+    assert len(hits) == 1 and hits[0].context == "Manual.one"
+
+
+def test_default_condition_reentry_is_legal(tmp_path):
+    """A bare Condition() is RLock-backed: re-entry through a helper is
+    legal Python, not a self-deadlock. Only a Condition wrapping an
+    explicit Lock() is non-reentrant."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def _peek(self):
+                with self._cv:
+                    pass
+
+            def get(self):
+                with self._cv:
+                    self._peek()       # RLock-backed: fine
+    """)
+    assert "lock-order-cycle" not in rules_of(findings)
+
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Strict:
+            def __init__(self):
+                self._cv = threading.Condition(threading.Lock())
+
+            def _peek(self):
+                with self._cv:
+                    pass
+
+            def get(self):
+                with self._cv:
+                    self._peek()       # plain-Lock backing: deadlock
+    """)
+    hits = of_rule(findings, "lock-order-cycle")
+    assert len(hits) == 1 and "re-acquired" in hits[0].message
+
+
+def test_recursive_fn_without_anchored_caller_not_universe_held(tmp_path):
+    """A self-recursive function invoked only dynamically must not be
+    modeled as entering with every lock held (which would fabricate a
+    self-deadlock on its own acquisition)."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        _lock = threading.Lock()
+
+        def _retry(n):
+            with _lock:
+                pass
+            if n:
+                _retry(n - 1)          # tail recursion, lock released
+    """)
+    assert "lock-order-cycle" not in rules_of(findings)
+
+
+def test_lock_order_cycle_through_typed_local_alias(tmp_path):
+    """The hoist-to-local idiom (`q = self._queue`) must resolve the
+    alias's lock — a reversed edge through it still closes the cycle."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def push(self, on_push):
+                with self._cv:
+                    on_push()             # callback under _cv
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = Queue()
+
+            def begin(self):
+                with self._lock:
+                    pass
+
+            def feed(self):
+                self._queue.push(self.begin)  # _cv -> _lock
+
+            def idle(self):
+                q = self._queue               # hoisted alias
+                with self._lock:
+                    with q._cv:               # _lock -> _cv: cycle
+                        pass
+    """)
+    hits = of_rule(findings, "lock-order-cycle")
+    assert len(hits) == 1
+    assert "Queue._cv" in hits[0].message
+    assert "Server._lock" in hits[0].message
+
+
+def test_cond_wakeup_on_condition_wrapping_explicit_lock(tmp_path):
+    """Condition(self._lock) still carries wait/notify semantics — the
+    stranded-waiter bug class must be caught through the alias too."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._items = []
+
+            def put(self, x):
+                with self._cv:
+                    self._items.append(x)
+                    self._cv.notify()     # two waiter classes below
+
+            def take(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait()
+                    return self._items.pop()
+
+            def wait_arrival(self, timeout):
+                with self._cv:
+                    self._cv.wait(timeout)
+    """)
+    hits = of_rule(findings, "cond-wakeup")
+    assert len(hits) == 1 and "notify_all" in hits[0].message
+
+
+def test_nested_fn_locals_do_not_shadow_module_globals(tmp_path):
+    """A nested helper's local named like a module global must not
+    make the OUTER function's bare global mutation look local."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        _lock = threading.Lock()
+        _items = []
+
+        def locked_add(x):
+            with _lock:
+                _items.append(x)
+
+        def bare_add(x):
+            def helper():
+                _items = []        # nested LOCAL, unrelated
+                return _items
+            _items.append(x)       # bare mutation of the module global
+            return helper
+    """)
+    hits = of_rule(findings, "unguarded-shared-state")
+    assert len(hits) == 1 and hits[0].context == "bare_add"
+
+
+def test_release_in_early_return_branch_does_not_escape(tmp_path):
+    """`acquire(); if err: release(); return` — the fall-through path
+    still holds the lock; its mutations are guarded, not findings."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put_locked(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def put_manual(self, x, bad=False):
+                self._lock.acquire()
+                if bad:
+                    self._lock.release()
+                    return
+                self._items.append(x)   # still under the lock here
+                self._lock.release()
+    """)
+    assert "unguarded-shared-state" not in rules_of(findings)
+
+
+def test_check_then_act_ignores_nested_function_regions(tmp_path):
+    """A lock region inside a nested def/lambda (worker pattern) runs
+    on another thread's schedule — not this function's second act."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+
+            def outer(self):
+                with self._lock:
+                    depth = self._depth
+                if depth > 0:
+                    def worker():
+                        with self._lock:
+                            self._depth -= 1
+                    threading.Thread(target=worker).start()
+    """)
+    assert "check-then-act" not in rules_of(findings)
+
+
+def test_lock_order_cycle_through_keyword_only_callback(tmp_path):
+    """Constructor-injected callbacks bound through KEYWORD-ONLY params
+    (the serving injectables' shape) propagate into the lock model."""
+    findings = run_lint(tmp_path, source="""
+        import threading
+
+        class Queue:
+            def __init__(self, *, on_pop=None):
+                self._cv = threading.Condition()
+                self._items = []
+                self._on_pop = on_pop or (lambda item: None)
+
+            def take(self):
+                with self._cv:
+                    item = self._items.pop()
+                    self._on_pop(item)     # injected, runs under _cv
+                    return item
+
+            def depth(self):
+                with self._cv:
+                    return len(self._items)
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = Queue(on_pop=self._begin)
+                self._inflight = 0
+
+            def _begin(self, item):
+                with self._lock:
+                    self._inflight += 1
+
+            def idle(self):
+                with self._lock:
+                    return self._queue.depth()
+    """)
+    hits = of_rule(findings, "lock-order-cycle")
+    assert len(hits) == 1
+    assert "Queue._cv" in hits[0].message
+    assert "Server._lock" in hits[0].message
+
+
+def test_same_named_classes_do_not_merge(tmp_path):
+    """Two modules each defining class `Dup`: calls inside one must
+    resolve to ITS OWN module's methods, not the other's — a merged
+    name-keyed registry would attribute the wrong body's acquisitions
+    (the linted tree has real cross-module duplicates: Conv, Loss,
+    LSTMCell, ...)."""
+    import textwrap as _tw
+
+    from mxnet_tpu.analysis.lockmodel import LockModel
+
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "a.py").write_text(_tw.dedent("""
+        import threading
+
+        class Dup:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with self._lock:
+                    pass
+    """))
+    (tmp_path / "pkg" / "b.py").write_text(_tw.dedent("""
+        import threading
+
+        class Dup:
+            def refresh(self):
+                pass
+
+            def go(self):
+                self.refresh()     # b's no-op, NOT a's lock-taker
+    """))
+    ctxs = []
+    for rel in ("pkg/a.py", "pkg/b.py"):
+        full = tmp_path / rel
+        ctxs.append(core.FileCtx(str(full), rel, full.read_text()))
+    model = LockModel(core.Project(str(tmp_path), ctxs))
+    go = model.methods[("pkg/b.py", "Dup")]["go"]
+    b_refresh = model.methods[("pkg/b.py", "Dup")]["refresh"]
+    callees = [callee for callee, _n, _h, _p in model.fns[go].calls]
+    assert callees == [b_refresh]           # same-module wins outright
+    assert model.fns[go].acq_trans == frozenset()  # no phantom lock
+
+
+# ---------------------------------------------------------------------------
+# the committed tree itself
+# ---------------------------------------------------------------------------
+
+def test_repo_concurrency_tier_is_clean():
+    """`--only concurrency` over the real tree exits 0: every finding
+    the sweep surfaced was FIXED (or suppressed inline with a reason),
+    never baselined."""
+    rc = lint_main([os.path.join(REPO, "mxnet_tpu"), "--root", REPO,
+                    "--only", "concurrency"])
+    assert rc == 0
+
+
+def test_repo_concurrency_baseline_is_zero():
+    """The concurrency tier lands with a ZERO grandfathered baseline —
+    like the hot-path rules, new findings must be fixed, not baselined
+    (docs/how_to/tpu_lint.md)."""
+    baseline = os.path.join(REPO, "tpu-lint-baseline.json")
+    with open(baseline) as fh:
+        entries = json.load(fh)["findings"]
+    assert not [e for e in entries if e["rule"] in CONCURRENCY_RULES]
+
+
+def test_repo_serving_lock_order_is_acyclic():
+    """The documented serving order — queue condition first, then the
+    server counter lock via take(on_pop=...) — holds: the model sees
+    that edge and no reverse one (docs/how_to/tpu_lint.md)."""
+    from mxnet_tpu.analysis.lockmodel import LockModel
+
+    paths = [os.path.join(REPO, "mxnet_tpu", "serving")]
+    files = core.collect_files(paths)
+    ctxs = []
+    for path in files:
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(path, REPO)
+        ctxs.append(core.FileCtx(path, rel, src))
+    model = LockModel(core.Project(REPO, ctxs))
+    q = "mxnet_tpu/serving/admission.py::AdmissionQueue._cv"
+    s = "mxnet_tpu/serving/server.py::InferenceServer._lock"
+    assert (q, s) in model.edges      # the on_pop callback edge
+    assert (s, q) not in model.edges  # never reversed
