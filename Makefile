@@ -227,13 +227,28 @@ ci-fleet: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
 	    -m 'not slow' -x -q
 
+# stage 17: low-precision smoke — calibrate + quantize a micro ResNet
+# and a micro LSTM (sidecar snapshot + reload without recalibration),
+# serve both coalesced through the InferenceServer under
+# MXTPU_RETRACE_STRICT=1 (finishing clean IS the zero-retrace
+# assertion) with accuracy delta <= the gate and zero unwarmed int8
+# signatures, quant-vs-fp32 persistent program keys distinct, the
+# gate's refusal leg (typed warning + fp32 fallback), and a bf16-mode
+# poison step skipped bitwise; then the unit suite
+# (docs/how_to/quantization.md)
+ci-quant: ci-native
+	timeout -k 10 420 env JAX_PLATFORMS=cpu MXTPU_RETRACE_STRICT=1 \
+	    python ci/quant_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-frontends ci-dryrun ci-resilience ci-serving ci-batching ci-data \
-    ci-perf ci-elastic ci-compiler ci-preempt ci-multichip ci-fleet
+    ci-perf ci-elastic ci-compiler ci-preempt ci-multichip ci-fleet \
+    ci-quant
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu lint-concurrency ci-lint ci-native \
 	ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
         ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \
-        ci-preempt ci-multichip ci-fleet
+        ci-preempt ci-multichip ci-fleet ci-quant

@@ -79,6 +79,7 @@ from . import perf  # noqa: F401
 from . import compiler  # noqa: F401
 from . import resilience  # noqa: F401
 from . import serving  # noqa: F401
+from . import quant  # noqa: F401
 from . import notebook  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401

@@ -69,6 +69,33 @@ def _serializer():
         return None
 
 
+def _jax_version_tuple():
+    import jax
+    parts = []
+    for piece in jax.__version__.split(".")[:3]:
+        digits = "".join(ch for ch in piece if ch.isdigit())
+        parts.append(int(digits) if digits else 0)
+    while len(parts) < 3:
+        parts.append(0)
+    return tuple(parts)
+
+
+_DONATED_BROKEN: Optional[bool] = None
+
+
+def _donated_deserialize_broken() -> bool:
+    """True on the jax line whose ``deserialize_and_load`` loses the
+    donation aliasing bookkeeping (see :meth:`PersistentJit._persist_ok`
+    for the bisect); drives the version-gated default of
+    ``MXTPU_COMPILE_CACHE_DONATED``. Process-cached: _persist_ok runs
+    on every donated-program call (the training hot path), and the jax
+    version cannot change mid-process."""
+    global _DONATED_BROKEN
+    if _DONATED_BROKEN is None:
+        _DONATED_BROKEN = _jax_version_tuple() < (0, 5, 0)
+    return _DONATED_BROKEN
+
+
 class PersistentJit:
     """Drop-in ``jax.jit`` wrapper with AOT load/store per call signature.
 
@@ -121,19 +148,30 @@ class PersistentJit:
         return self._jit
 
     def _persist_ok(self) -> bool:
-        """Donated programs are excluded from the persistent store by
-        default: on this jax build's CPU backend, CALLING a deserialized
-        executable with buffer donation corrupts the process heap for
-        some program shapes (reproducibly: donated whole-step programs
-        carrying an LSTM scan — glibc abort at exit; donated MLP steps
-        and every undonated program are clean). Until the upstream
-        serialization path is trustworthy for aliased buffers,
-        ``MXTPU_COMPILE_CACHE_DONATED=1`` is the explicit opt-in; the
-        undonated executor/serving programs — the serving-cold-start and
-        resume paths — stay cached by default."""
+        """Donated programs are excluded from the persistent store on
+        the jax 0.4.x line: CALLING a deserialized executable with
+        buffer donation corrupts the process heap for some program
+        shapes (re-bisected on this container's jax 0.4.37 CPU backend:
+        a donated whole-step program carrying an LSTM scan aborts the
+        warm process with ``malloc_consolidate(): invalid chunk size``;
+        donated MLP steps and every undonated program are clean). The
+        culprit is jax/experimental/serialize_executable.py:57 —
+        ``deserialize_and_load`` rebuilds the Compiled via
+        ``unloaded_executable.load()``, which reloads the raw
+        executable through ``backend.deserialize_executable`` WITHOUT
+        the input-output aliasing bookkeeping the live
+        ``lower().compile()`` path establishes, so the CPU PJRT client
+        both donates (frees) and reads the aliased scan-carry buffer.
+        The 0.5 line rewrote that load path, so the gate is by jax
+        version rather than a blanket off; ``MXTPU_COMPILE_CACHE_DONATED``
+        overrides the default in either direction (1 opts a 0.4.x tree
+        in, 0 opts a newer tree out). Undonated executor/serving
+        programs — the serving-cold-start and resume paths — are cached
+        everywhere."""
         if not self._donate:
             return True
-        return bool(getenv("MXTPU_COMPILE_CACHE_DONATED", 0, int))
+        return bool(getenv("MXTPU_COMPILE_CACHE_DONATED",
+                           int(not _donated_deserialize_broken()), int))
 
     def __call__(self, *args):
         if self._disabled or not _cache.cache_enabled() \

@@ -411,6 +411,12 @@ class OptimizeResult:
         shard = self.annotations.get("sharding_sig")
         if shard:
             sig += f";shard={shard}"
+        # the quant annotator (quant/core.py) stamps the quantization
+        # decision the same way: a precision change (int8 <-> fp32,
+        # format, gated parameter set) is a different executable
+        quant = self.annotations.get("quant_sig")
+        if quant:
+            sig += f";quant={quant}"
         return sig
 
 

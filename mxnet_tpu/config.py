@@ -88,10 +88,12 @@ register_knob("MXTPU_COMPILE_CACHE_DIR", str,
               "root of the persistent compilation cache")
 register_knob("MXTPU_COMPILE_CACHE_MB", float, 512,
               "LRU size bound of the compilation cache, megabytes")
-register_knob("MXTPU_COMPILE_CACHE_DONATED", int, 0,
-              "also persist buffer-donating programs (fused/SPMD steps) "
-              "— off by default: deserialized donated executables corrupt "
-              "the heap on this jax build's CPU backend for some shapes")
+register_knob("MXTPU_COMPILE_CACHE_DONATED", int, None,
+              "also persist buffer-donating programs (fused/SPMD steps); "
+              "default is gated by jax version — off on the 0.4.x line, "
+              "whose deserialize_and_load (serialize_executable.py:57) "
+              "drops donation aliasing and corrupts the heap on CPU for "
+              "scan-carrying programs; on from 0.5. 1/0 force either way")
 register_knob("MXTPU_REMAT_MB", float, None,
               "activation-memory budget: a training bind whose estimated "
               "forward activations exceed it gets jax.checkpoint remat "
@@ -130,6 +132,25 @@ register_knob("MXTPU_CRASH_BACKOFF_BASE", float, 1.0,
               "repeat attempt)")
 register_knob("MXTPU_CRASH_BACKOFF_CAP", float, 60.0,
               "upper bound on one crash-loop resume backoff, seconds")
+register_knob("MXTPU_PRECISION", str, "fp32",
+              "training precision mode: 'bf16' defaults every trainer's "
+              "compute_dtype to bfloat16 (fp32 master weights, 2-D+ "
+              "cast in-step) and arms the dynamic loss-scale guard "
+              "inside the donated step (non-finite steps skipped, not "
+              "applied; docs/how_to/quantization.md)")
+register_knob("MXTPU_QUANT", int, 0,
+              "default as_serving_backend() to int8 post-training "
+              "quantization (calibration + accuracy gate; "
+              "docs/how_to/quantization.md) — callers must still "
+              "provide calibration data")
+register_knob("MXTPU_QUANT_MAX_DELTA", float, 0.05,
+              "accuracy gate: largest mean relative output error the "
+              "quantized path may show vs fp32 on the calibration "
+              "batches before it is refused (fp32 fallback + typed "
+              "QuantAccuracyWarning)")
+register_knob("MXTPU_QUANT_CALIB_BATCHES", int, 8,
+              "representative batches consumed by PTQ calibration and "
+              "the accuracy gate")
 register_knob("MXTPU_MAX_BATCH", int, 1,
               "total rows one coalesced serving dispatch may carry "
               "(mxnet_tpu/serving/batching.py) — 1 disables continuous "

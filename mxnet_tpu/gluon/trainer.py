@@ -19,7 +19,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 mesh=None, shard_optimizer_state=None):
+                 mesh=None, shard_optimizer_state=None, loss_scale=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -66,6 +66,26 @@ class Trainer:
                     "update over the mesh 'data' axis, but this mesh "
                     f"has axes {mesh.axis_names} — add a 'data' axis "
                     "or disable ZeRO")
+        # dynamic loss scaling at the Gluon seam (docs/how_to/
+        # quantization.md): the user multiplies the loss by
+        # ``trainer.loss_scale.scale`` before backward; step() folds
+        # 1/scale into the dynamic rescale, the fused update checks
+        # gradient finiteness in-program and SKIPS non-finite steps,
+        # and the host-side schedule advances from the reported flag.
+        self._loss_scale = None
+        if loss_scale:
+            from ..perf import has_functional_update
+            from ..quant.loss_scale import (DynamicLossScale,
+                                            LossScaleConfig)
+            if not has_functional_update(self._optimizer):
+                raise MXNetError(
+                    "Trainer(loss_scale=...) needs an optimizer with a "
+                    "functional update rule (sgd/nag/adam/rmsprop) — "
+                    "the finite check runs inside the fused update "
+                    "program")
+            cfg = (LossScaleConfig() if loss_scale is True
+                   else loss_scale)
+            self._loss_scale = DynamicLossScale(cfg)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -84,6 +104,13 @@ class Trainer:
                                          **optimizer_params)
         self._states = [None] * len(self._params)
         self._states_created = [False] * len(self._params)
+
+    @property
+    def loss_scale(self):
+        """The host-side :class:`~mxnet_tpu.quant.DynamicLossScale`
+        mirror (None unless ``Trainer(loss_scale=...)``): multiply the
+        loss by ``trainer.loss_scale.scale`` before ``backward()``."""
+        return self._loss_scale
 
     @property
     def learning_rate(self):
@@ -106,6 +133,11 @@ class Trainer:
         per-parameter loop below.
         """
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._loss_scale is not None:
+            # the caller scaled its loss by .scale; fold the inverse
+            # into the dynamic rescale so the update sees true grads —
+            # a traced input, so scale changes never retrace
+            self._optimizer.rescale_grad /= self._loss_scale.scale
         live = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -127,7 +159,19 @@ class Trainer:
                 self._states_created[i] = True
             live.append((i, param, grad))
         if self._fused_step(live):
+            if self._loss_scale is not None and live:
+                self._loss_scale.update(self._fused_apply.last_finite)
             return
+        if self._loss_scale is not None and live:
+            # the guard's skip decision lives in the fused program; an
+            # imperative fallback (sparse grads, MXTPU_FUSED_STEP=0)
+            # would apply a non-finite step blind — refuse loudly
+            raise MXNetError(
+                "Trainer(loss_scale=...): this step fell back to the "
+                "imperative update path (sparse grads or "
+                "MXTPU_FUSED_STEP=0), which cannot run the in-program "
+                "finite guard — disable loss scaling or keep the fused "
+                "path eligible")
         for i, param, grad in live:
             self._optimizer.update(i, param.data(), grad, self._states[i])
 
@@ -150,7 +194,9 @@ class Trainer:
                 return False
             self._fused_apply = FusedOptimizerApply(
                 opt, name="gluon-trainer", donate=self._donate_buffers,
-                sharding=self._plan)
+                sharding=self._plan,
+                loss_scale=(self._loss_scale.config
+                            if self._loss_scale is not None else None))
         from ..perf.step_runtime import apply_fused_triples
         triples = [(i, param.data(), grad) for i, param, grad in live]
         return apply_fused_triples(self._fused_apply, opt, triples,

@@ -229,12 +229,39 @@ class BaseModule:
                             eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
-    def as_serving_backend(self, input_name=None):
+    def as_serving_backend(self, input_name=None, quant=None,
+                           calib_data=None, quant_config=None,
+                           stats_path=None):
         """Adapt this bound module for the serving runtime
         (:class:`mxnet_tpu.serving.InferenceServer`): forward-only, one
-        host batch in, numpy outputs back (docs/how_to/serving.md)."""
+        host batch in, numpy outputs back (docs/how_to/serving.md).
+
+        ``quant`` (default: the ``MXTPU_QUANT`` knob) turns on int8
+        post-training quantization (docs/how_to/quantization.md):
+        per-tensor scales calibrated from ``calib_data`` (any DataIter /
+        iterable of batches; snapshot to the manifest-covered
+        ``stats_path`` sidecar so a reloaded server never
+        re-calibrates), weights stored int8, and a measured accuracy
+        gate that falls back to this fp32 backend — with a typed
+        :class:`~mxnet_tpu.quant.QuantAccuracyWarning` — rather than
+        ship a model beyond ``quant_config.max_accuracy_delta``."""
+        from ..base import getenv
         from ..serving.backends import ModuleBackend
-        return ModuleBackend(self, input_name=input_name)
+        if quant is None:
+            quant = bool(getenv("MXTPU_QUANT", 0, int))
+        if not quant:
+            return ModuleBackend(self, input_name=input_name)
+        if calib_data is None:
+            from ..base import MXNetError
+            raise MXNetError(
+                "as_serving_backend(quant=True) needs calib_data — "
+                "post-training quantization calibrates activation "
+                "scales (and measures the accuracy gate) on a handful "
+                "of representative batches")
+        from ..quant import quantize_backend
+        return quantize_backend(self, calib_data, config=quant_config,
+                                stats_path=stats_path,
+                                input_name=input_name)
 
     def as_decode_backend(self, state_names):
         """Adapt this bound module as one *stateful decode step* for the

@@ -47,7 +47,8 @@ class SPMDTrainer:
                  mesh=None, data_names: Sequence[str] = ("data",),
                  label_names: Sequence[str] = ("softmax_label",),
                  param_rules=None, dtype="float32", compute_dtype=None,
-                 shard_optimizer_state=None, donate_buffers=True):
+                 shard_optimizer_state=None, donate_buffers=True,
+                 loss_scale=None):
         self._symbol = symbol
         self._mesh = mesh if mesh is not None else make_mesh()
         self._data_names = list(data_names)
@@ -74,8 +75,14 @@ class SPMDTrainer:
         # mixed precision: master weights stay fp32, 2D+ weights are cast to
         # compute_dtype inside the step (reference analogue: mp_sgd_update's
         # fp32 master weights, optimizer_op.cc:114 — here the cast is traced
-        # so XLA feeds the MXU bf16 operands directly)
+        # so XLA feeds the MXU bf16 operands directly). None defers to the
+        # MXTPU_PRECISION mode (docs/how_to/quantization.md), which also
+        # arms the dynamic loss-scale guard; ``loss_scale`` overrides
+        # (True / LossScaleConfig / False).
         self._compute_dtype = compute_dtype
+        self._loss_scale_req = loss_scale
+        self._ls_cfg = None
+        self._ls_state = None
         if isinstance(optimizer, str):
             optimizer = _opt_mod.create(optimizer, **(optimizer_params or {}))
         self._optimizer = optimizer
@@ -255,11 +262,25 @@ class SPMDTrainer:
         param_sh = {n: params[n].sharding for n in params}
         aux_sh = {n: NamedSharding(mesh, P()) for n in aux}
 
-        compute_dtype = (jnp.dtype(self._compute_dtype)
-                         if self._compute_dtype else None)
+        from ..perf.step_runtime import (precision_compute_dtype,
+                                         precision_loss_scale)
+        cdt = precision_compute_dtype(self._compute_dtype)
+        compute_dtype = jnp.dtype(cdt) if cdt else None
         shard_opt = self._shard_opt
+        # the MXTPU_PRECISION-mode loss-scale guard: (scale, streak)
+        # ride the donated step; a non-finite step is skipped bitwise
+        # and only the schedule moves (quant/loss_scale.py)
+        ls_cfg = precision_loss_scale(self._loss_scale_req)
+        self._ls_cfg = ls_cfg
+        if ls_cfg is not None:
+            from ..quant.loss_scale import init_state as _ls_init
+            repl_sh = NamedSharding(mesh, P())
+            self._ls_state = tuple(jax.device_put(x, repl_sh)
+                                   for x in _ls_init(ls_cfg))
+        else:
+            self._ls_state = None
 
-        def step(params, states, aux, inputs, rng, lr, t):
+        def step(params, states, aux, inputs, rng, lr, t, ls=None):
             def loss_f(p):
                 merged = dict(inputs)
                 if compute_dtype is not None:
@@ -279,6 +300,15 @@ class SPMDTrainer:
             cts = [jnp.ones_like(o) for o in outs]
             zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
             (grads,) = vjp_fn((cts, zero_aux))
+            finite = None
+            if ls_cfg is not None:
+                # gradient finiteness decides whether this step APPLIES,
+                # in-program (the cotangent is deliberately unscaled:
+                # see perf/step_runtime.py — implicit-gradient loss
+                # heads ignore it, and bf16 shares fp32's exponent
+                # range; the schedule + skip are the portable contract)
+                from ..quant.loss_scale import tree_all_finite
+                finite = tree_all_finite(grads)
             new_params, new_states = {}, {}
             for n in params:
                 g = grads[n]
@@ -311,6 +341,15 @@ class SPMDTrainer:
                         lr * lr_mult[n], wd_by_name[n], t)
             new_aux = dict(aux)
             new_aux.update(aux_up)
+            new_ls = None
+            if ls_cfg is not None:
+                # skipped step: params/state/aux pass through bitwise
+                from ..quant.loss_scale import (guarded_select,
+                                                next_state)
+                new_params = guarded_select(finite, new_params, params)
+                new_states = guarded_select(finite, new_states, states)
+                new_aux = guarded_select(finite, new_aux, aux)
+                new_ls = next_state(ls, finite, ls_cfg)
             # pin steady-state shardings: without this GSPMD may pick new
             # layouts for the donated outputs, forcing a recompile on the
             # next step when the re-fed params carry different shardings.
@@ -333,6 +372,8 @@ class SPMDTrainer:
                 o, NamedSharding(mesh, _fit(batch_pspec(mesh, o.ndim),
                                             o.shape, mesh)))
                     for o in outs]
+            if ls_cfg is not None:
+                return new_params, new_states, new_aux, outs, new_ls
             return new_params, new_states, new_aux, outs
 
         self.retrace_guard.rebind()     # fresh program after (re)bind
@@ -356,12 +397,18 @@ class SPMDTrainer:
             f"wd={sorted(wd_by_name.items())}",
             f"lrm={sorted(lr_mult.items())}",
             f"zero={int(shard_opt)}", f"cdt={compute_dtype}",
-            f"plan={plan.signature_hash()}", f"shards={shard_sig}")
+            f"plan={plan.signature_hash()}", f"shards={shard_sig}",
+            "-" if ls_cfg is None else ls_cfg.signature())
+
+        donate = (0, 1, 2) if self._donate else ()
+        if self._donate and ls_cfg is not None:
+            donate = (0, 1, 2, 7)   # the loss-scale state rides donated
+
         def _build_step_fn():
             self._step_fn = _compiler.PersistentJit(
                 self.retrace_guard.wrap(step), kind="spmd-step",
                 key_parts=key_parts,
-                donate_argnums=(0, 1, 2) if self._donate else (),
+                donate_argnums=donate,
                 on_materialize=materialized)
 
         # kept for rebind_step(): the stall-escalation ladder rebuilds
@@ -446,6 +493,8 @@ class SPMDTrainer:
         # ambient mesh while the step traces (first call compiles)
         from .mesh import mesh_scope
         args = (self.params, self.states, self.aux, inputs, sub, lr, t)
+        if self._ls_cfg is not None:
+            args = args + (self._ls_state,)
         if getattr(self, "_step_abstract_args", None) is None:
             # one-time abstract arg snapshot (shapes + mesh shardings) so
             # the compiled step's HLO stays inspectable after the donated
@@ -464,7 +513,12 @@ class SPMDTrainer:
             self._step_abstract_args = jax.tree_util.tree_map(
                 _abstract, args)
         with mesh_scope(self._mesh):
-            self.params, self.states, self.aux, outs = self._step_fn(*args)
+            if self._ls_cfg is not None:
+                (self.params, self.states, self.aux, outs,
+                 self._ls_state) = self._step_fn(*args)
+            else:
+                self.params, self.states, self.aux, outs = \
+                    self._step_fn(*args)
         return outs
 
     def compiled_step_hlo(self) -> str:
@@ -484,6 +538,16 @@ class SPMDTrainer:
         with mesh_scope(self._mesh):
             lowered = self._step_fn.jit.lower(*self._step_abstract_args)
         return lowered.compile().as_text()
+
+    def loss_scale_stats(self):
+        """Host snapshot of the loss-scale guard state (None unless the
+        MXTPU_PRECISION mode / ``loss_scale=`` armed it) — a boundary
+        read for callbacks and tests, never on the step path."""
+        if self._ls_cfg is None or self._ls_state is None:
+            return None
+        scale, streak = self._ls_state
+        return {"scale": float(np.asarray(scale)),
+                "finite_streak": int(np.asarray(streak))}
 
     def get_params(self):
         """Gather (host) copies, reference Module.get_params."""

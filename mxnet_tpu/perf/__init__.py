@@ -20,8 +20,10 @@ regression-guard) and the donation semantics.
 from .step_runtime import (CompileGuard, FusedOptimizerApply, FusedStep,
                            PackedRNNLayout, functional_update,
                            fused_update_params, has_functional_update,
-                           module_stepper, plan_param_layouts)
+                           module_stepper, plan_param_layouts,
+                           precision_compute_dtype, precision_loss_scale)
 
 __all__ = ["CompileGuard", "FusedOptimizerApply", "FusedStep",
            "PackedRNNLayout", "functional_update", "fused_update_params",
-           "has_functional_update", "module_stepper", "plan_param_layouts"]
+           "has_functional_update", "module_stepper", "plan_param_layouts",
+           "precision_compute_dtype", "precision_loss_scale"]

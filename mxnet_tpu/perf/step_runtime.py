@@ -53,7 +53,47 @@ from ..ops.rnn_ops import _unpack, rnn_param_size
 __all__ = ["functional_update", "has_functional_update", "CompileGuard",
            "PackedRNNLayout", "plan_param_layouts", "FusedStep",
            "module_stepper", "FusedOptimizerApply", "apply_fused_triples",
-           "fused_update_params"]
+           "fused_update_params", "precision_compute_dtype",
+           "precision_loss_scale"]
+
+
+# ---------------------------------------------------------------------------
+# the MXTPU_PRECISION mode (docs/how_to/quantization.md)
+# ---------------------------------------------------------------------------
+
+def precision_compute_dtype(explicit=None):
+    """Resolve a trainer's compute dtype: an explicit argument wins;
+    otherwise ``MXTPU_PRECISION=bf16`` defaults every trainer to the
+    bf16-master-weight cast (fp32 master params, 2-D+ leaves cast once
+    inside the donated step) that previously had to be requested
+    per-trainer via ``compute_dtype=``."""
+    if explicit is not None:
+        return explicit
+    mode = str(getenv("MXTPU_PRECISION", "fp32") or "fp32").lower()
+    if mode in ("bf16", "bfloat16"):
+        return "bfloat16"
+    if mode in ("fp32", "float32", "none", ""):
+        return None
+    raise MXNetError(
+        f"MXTPU_PRECISION={mode!r}: expected 'fp32' or 'bf16'")
+
+
+def precision_loss_scale(explicit=None):
+    """Resolve the dynamic loss-scale guard: an explicit
+    True/False/:class:`~mxnet_tpu.quant.LossScaleConfig` wins; otherwise
+    the guard arms exactly when the ``MXTPU_PRECISION`` mode is active —
+    the low-precision training contract is cast + guard together, while
+    a legacy explicit ``compute_dtype='bfloat16'`` keeps its pre-mode
+    behavior. Returns a LossScaleConfig or None."""
+    from ..quant.loss_scale import LossScaleConfig
+    if explicit is not None:
+        if explicit is True:
+            return LossScaleConfig()
+        if explicit is False:
+            return None
+        return explicit
+    mode = str(getenv("MXTPU_PRECISION", "fp32") or "fp32").lower()
+    return LossScaleConfig() if mode in ("bf16", "bfloat16") else None
 
 
 @contextlib.contextmanager
@@ -461,18 +501,32 @@ class FusedStep:
     def __init__(self, symbol, optimizer, param_names: Sequence[str],
                  compute_dtype=None, donate: bool = True,
                  name: str = "fused-step", input_shapes=None,
-                 input_dtypes=None, mesh=None, sharding=None):
+                 input_dtypes=None, mesh=None, sharding=None,
+                 loss_scale=None):
         from .. import compiler as _compiler
         from ..parallel.sharding import ShardingPlan, plan_scope
+        from ..quant import loss_scale as _ls_mod
         self._symbol = symbol
         self._optimizer = optimizer
         self._param_names = list(param_names)
+        # the MXTPU_PRECISION mode: bf16 cast + the dynamic loss-scale
+        # guard traced into this one donated program (the cast policy
+        # travels with the step, docs/how_to/quantization.md)
+        compute_dtype = precision_compute_dtype(compute_dtype)
+        self._ls_cfg = precision_loss_scale(loss_scale)
+        self._ls_state = (None if self._ls_cfg is None
+                          else _ls_mod.init_state(self._ls_cfg))
         if sharding is not None and mesh is None:
             mesh = sharding.mesh
         if mesh is not None and sharding is None:
             sharding = ShardingPlan(mesh)
         self.mesh = mesh
         self.plan = sharding
+        if self.plan is not None and self._ls_state is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            _repl0 = NamedSharding(self.plan.mesh, PartitionSpec())
+            self._ls_state = tuple(jax.device_put(x, _repl0)
+                                   for x in self._ls_state)
         # graph passes at bind time (DCE/CSE/remat policy); the fused
         # step traces the optimized graph, the module keeps the
         # original. input_shapes/dtypes (every bound arg + aux) feed
@@ -508,7 +562,8 @@ class FusedStep:
             f"lrm={sorted((n, float(optimizer.lr_mult.get(n, 1.0))) for n in self._param_names)}",
             f"cdt={compute_dtype}",
             f"layouts={sorted(self.layouts)}",
-            f"plan={'-' if self.plan is None else self.plan.signature_hash()}")
+            f"plan={'-' if self.plan is None else self.plan.signature_hash()}",
+            "-" if self._ls_cfg is None else self._ls_cfg.signature())
 
         # static per-param wd / lr multipliers (reference: set_wd_mult —
         # biases/BN params get wd 0); the dynamic base lr stays an input
@@ -538,7 +593,9 @@ class FusedStep:
 
             _repl = NamedSharding(plan.mesh, PartitionSpec())
 
-        def step(params, states, aux, inputs, rng, lr, t):
+        ls_cfg = self._ls_cfg
+
+        def step(params, states, aux, inputs, rng, lr, t, ls=None):
             def loss_f(p):
                 merged = dict(inputs)
                 for n, v in p.items():
@@ -558,6 +615,22 @@ class FusedStep:
             cts = [jnp.ones_like(o) for o in outs]
             zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
             (grads,) = vjp_fn((cts, zero_aux))
+            finite = None
+            if ls_cfg is not None:
+                # the loss-scale guard: gradient finiteness decides
+                # whether this step APPLIES, traced in-program (zero
+                # host syncs). The cotangent is deliberately NOT
+                # multiplied by the scale here: the implicit-gradient
+                # loss heads above ignore the head cotangent, so
+                # scaling it (and un-scaling the grads) would silently
+                # divide their gradients by the scale — and under bf16
+                # compute the exponent range equals fp32, so underflow
+                # protection via cotangent scaling buys nothing. The
+                # schedule still runs (powers of two, exact) so the
+                # scale is live for the Gluon path — where the USER
+                # scales a real scalar loss — and for fp8-era formats.
+                from ..quant.loss_scale import tree_all_finite
+                finite = tree_all_finite(grads)
             new_params, new_states = {}, {}
             for n in params:
                 w_leaves, treedef = jax.tree_util.tree_flatten(params[n])
@@ -610,9 +683,20 @@ class FusedStep:
                 new_states[n] = ns
             new_aux = dict(aux)
             new_aux.update(aux_up)
+            if ls_cfg is not None:
+                # a non-finite step is SKIPPED, not applied: params,
+                # optimizer state and aux pass through bitwise unchanged
+                # and only the scale schedule moves
+                from ..quant.loss_scale import guarded_select, next_state
+                new_params = guarded_select(finite, new_params, params)
+                new_states = guarded_select(finite, new_states, states)
+                new_aux = guarded_select(finite, new_aux, aux)
+                new_ls = next_state(ls, finite, ls_cfg)
             if plan is not None:
                 new_aux = {n: jax.lax.with_sharding_constraint(v, _repl)
                            for n, v in new_aux.items()}
+            if ls_cfg is not None:
+                return new_params, new_states, new_aux, outs, new_ls
             return new_params, new_states, new_aux, outs
 
         self._step_body = step
@@ -629,10 +713,13 @@ class FusedStep:
                 # later real retrace would be under-counted
                 self.guard.count += 1
 
+        donate = (0, 1, 2) if self.donate else ()
+        if self.donate and self._ls_cfg is not None:
+            donate = (0, 1, 2, 7)   # the loss-scale state rides donated
         self._step_fn = PersistentJit(
             self.guard.wrap(self._step_body), kind="fused-step",
             key_parts=self._program_key_parts,
-            donate_argnums=(0, 1, 2) if self.donate else (),
+            donate_argnums=donate,
             on_materialize=materialized)
 
     def rebind(self):
@@ -739,15 +826,35 @@ class FusedStep:
             return self._join_state(name, state_leaves)
         return state_leaves[0]
 
+    def loss_scale_stats(self):
+        """Host snapshot of the guard state (None when unarmed):
+        ``{"scale": float, "finite_streak": int}`` — a boundary read for
+        callbacks/tests, never on the step path."""
+        if self._ls_cfg is None:
+            return None
+        scale, streak = self._ls_state
+        return {"scale": float(np.asarray(scale)),
+                "finite_streak": int(np.asarray(streak))}
+
     def __call__(self, params, states, aux, inputs, rng, lr, t):
         with _quiet_donation():
             if self.mesh is None:
-                return self._step_fn(params, states, aux, inputs, rng, lr, t)
+                return self._run(params, states, aux, inputs, rng, lr, t)
             # mesh-aware ops (MultiHeadAttention seq_axis, ...) consult
             # the ambient mesh while the step traces (first call only)
             from ..parallel.mesh import mesh_scope
             with mesh_scope(self.mesh):
-                return self._step_fn(params, states, aux, inputs, rng, lr, t)
+                return self._run(params, states, aux, inputs, rng, lr, t)
+
+    def _run(self, params, states, aux, inputs, rng, lr, t):
+        if self._ls_cfg is None:
+            return self._step_fn(params, states, aux, inputs, rng, lr, t)
+        # the guard state is internal to the FusedStep: callers keep the
+        # classic 7-arg contract, the donated program carries (and
+        # returns) the (scale, streak) pair alongside
+        params, states, aux, outs, self._ls_state = self._step_fn(
+            params, states, aux, inputs, rng, lr, t, self._ls_state)
+        return params, states, aux, outs
 
 
 # ---------------------------------------------------------------------------
@@ -899,7 +1006,7 @@ class ModuleStepper:
 
 
 def module_stepper(module, compute_dtype=None, donate=True, mesh=None,
-                   sharding=None):
+                   sharding=None, loss_scale=None):
     """Build a :class:`ModuleStepper` for ``module``, or return None.
 
     Eligibility is conservative — anything the fused program cannot
@@ -970,7 +1077,8 @@ def module_stepper(module, compute_dtype=None, donate=True, mesh=None,
                                         for n, v in all_arrs},
                           input_dtypes={n: str(v.dtype)
                                         for n, v in all_arrs},
-                          mesh=mesh, sharding=sharding)
+                          mesh=mesh, sharding=sharding,
+                          loss_scale=loss_scale)
         stepper = ModuleStepper(module, fused, frozen)
     except MXNetError:
         return None
@@ -1005,12 +1113,23 @@ class FusedOptimizerApply:
     """
 
     def __init__(self, optimizer, name="fused-update", donate=True,
-                 mesh=None, sharding=None):
+                 mesh=None, sharding=None, loss_scale=None):
         self._opt = optimizer
         self._kind = type(optimizer).__name__.lower()
         if not has_functional_update(optimizer):
             raise MXNetError(
                 f"optimizer {self._kind!r} has no functional rule")
+        # Gluon-seam loss-scale guard (docs/how_to/quantization.md): the
+        # caller scales its loss (and folds 1/scale into the dynamic
+        # rescale input); this program checks the rescaled grads for
+        # finiteness, SKIPS the update when any is non-finite (weights/
+        # state pass through bitwise unchanged) and reports the flag
+        # back so the host-side DynamicLossScale advances its schedule
+        if loss_scale is True:
+            from ..quant.loss_scale import LossScaleConfig
+            loss_scale = LossScaleConfig()
+        self._ls_cfg = loss_scale or None
+        self.last_finite = True
         if sharding is not None and mesh is None:
             mesh = sharding.mesh
         if mesh is not None and sharding is None:
@@ -1034,7 +1153,14 @@ class FusedOptimizerApply:
 
             _repl = NamedSharding(plan.mesh, PartitionSpec())
 
+        ls_cfg = self._ls_cfg
+
         def apply(ws, gs, ss, lrs, wds, ts, rescale):
+            finite = None
+            if ls_cfg is not None:
+                from ..quant.loss_scale import tree_all_finite
+                finite = tree_all_finite(
+                    [g * rescale.astype(g.dtype) for g in gs])
             new_ws, new_ss = [], []
             for i, (w, g, s) in enumerate(zip(ws, gs, ss)):
                 # rescale in the gradient's own dtype: the imperative op
@@ -1048,6 +1174,10 @@ class FusedOptimizerApply:
                         lambda x: jax.lax.with_sharding_constraint(
                             x, _zsh(x)), s)
                 w2, s2 = update(w, g, s, lrs[i], wds[i], ts[i])
+                if ls_cfg is not None:
+                    from ..quant.loss_scale import guarded_select
+                    w2 = guarded_select(finite, w2, w)
+                    s2 = guarded_select(finite, s2, s)
                 if plan is not None:
                     w2 = jax.lax.with_sharding_constraint(w2, _repl)
                     s2 = jax.tree_util.tree_map(
@@ -1055,6 +1185,8 @@ class FusedOptimizerApply:
                             x, _zsh(x)), s2)
                 new_ws.append(w2)
                 new_ss.append(s2)
+            if ls_cfg is not None:
+                return new_ws, new_ss, finite
             return new_ws, new_ss
 
         from ..compiler import PersistentJit
@@ -1070,7 +1202,9 @@ class FusedOptimizerApply:
             # dynamic rescale input, so the baked value is always 1.0
             key_parts=(optimizer_signature(optimizer, rescale=1.0),
                        "plan=" + ("-" if self.plan is None
-                                  else self.plan.signature_hash())),
+                                  else self.plan.signature_hash()),
+                       "-" if self._ls_cfg is None
+                       else self._ls_cfg.signature()),
             donate_argnums=(0, 2) if donate else (),
             on_materialize=materialized)
 
@@ -1123,7 +1257,15 @@ def apply_fused_triples(apply, opt, triples, get_state):
         ws.append(w._data)
         gs.append(g._data)
         ss.append(fs)
-    new_ws, new_ss = apply(ws, gs, ss, lrs, wds, ts, opt.rescale_grad)
+    result = apply(ws, gs, ss, lrs, wds, ts, opt.rescale_grad)
+    if getattr(apply, "_ls_cfg", None) is not None:
+        new_ws, new_ss, finite = result
+        # ONE scalar readback at the update boundary — the Gluon
+        # analogue of the Updater state sync: the host-side loss-scale
+        # schedule needs the flag before the next loss multiply
+        apply.last_finite = bool(np.asarray(finite))
+    else:
+        new_ws, new_ss = result
     for (i, w, _g), nw, ns in zip(triples, new_ws, new_ss):
         w._set_data(nw)
         state = get_state(i)

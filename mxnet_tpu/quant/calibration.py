@@ -1,0 +1,182 @@
+"""Per-tensor scale calibration from representative batches.
+
+The nncase-style PTQ contract (PAPERS.md): activation ranges are not
+knowable from the graph, so a handful of representative batches is run
+through the fp32 forward and each *input*'s absolute maximum is
+recorded; ``scale = absmax / qmax`` then maps the observed range onto
+the quantized format. Weight scales need no calibration — the weights
+are in hand at quantize time.
+
+:func:`calibrate` accepts any batch source: a
+:class:`~mxnet_tpu.io.DataIter` (wrapped in PR 4's
+:func:`~mxnet_tpu.resilience.data.guard` so corrupt records are skipped
+under the usual budget instead of killing deployment), an iterable of
+``{name: array}`` dicts, an iterable of arrays, or a single array.
+
+The stats snapshot to a **manifest-covered sidecar**
+(:func:`save_stats` / :func:`load_stats`): atomic tmp+fsync+rename via
+the PR 1 checkpoint plumbing plus a ``.manifest.json`` carrying size +
+SHA-256 — so a reloaded Predictor re-uses the calibration instead of
+re-running batches, and a corrupt, truncated, or missing sidecar reads
+as *recalibrate*, never a crash. Reads pass the ``quant.sidecar.read``
+fault site (registered in ``resilience.SITES``).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["CalibrationStats", "calibrate", "save_stats", "load_stats"]
+
+SIDECAR_VERSION = 1
+
+
+class CalibrationStats:
+    """Observed per-input absolute maxima over the calibration batches."""
+
+    def __init__(self, input_absmax: Dict[str, float], batches: int = 0):
+        self.input_absmax = {str(k): float(v)
+                             for k, v in input_absmax.items()}
+        self.batches = int(batches)
+
+    def scale(self, name: str, fmt) -> float:
+        """Host-side scale for one input (the one shared symmetric rule
+        — :func:`~mxnet_tpu.quant.core.host_scale`; 1.0 for an
+        unobserved or all-zero input, quantizing zeros exactly)."""
+        from .core import host_scale
+        return host_scale(self.input_absmax.get(name, 0.0), fmt)
+
+    def to_dict(self) -> dict:
+        return {"format_version": SIDECAR_VERSION,
+                "input_absmax": dict(sorted(self.input_absmax.items())),
+                "batches": self.batches}
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "CalibrationStats":
+        if int(doc.get("format_version", -1)) != SIDECAR_VERSION:
+            raise MXNetError(
+                f"calibration sidecar format_version "
+                f"{doc.get('format_version')!r} != {SIDECAR_VERSION}")
+        return cls(doc["input_absmax"], doc.get("batches", 0))
+
+
+def _as_feed_dicts(data, input_names) -> Iterable[Dict[str, np.ndarray]]:
+    """Normalize any batch source to ``{name: np.ndarray}`` dicts."""
+    primary = input_names[0] if input_names else "data"
+    if isinstance(data, dict):
+        yield {k: np.asarray(v) for k, v in data.items()}
+        return
+    if isinstance(data, np.ndarray):
+        yield {primary: data}
+        return
+    # DataIter / DataBatch stream / iterable of dicts or arrays
+    if hasattr(data, "reset"):
+        data.reset()
+    for batch in data:
+        if isinstance(batch, dict):
+            yield {k: np.asarray(v) for k, v in batch.items()}
+        elif hasattr(batch, "data"):        # DataBatch
+            arrays = batch.data if isinstance(batch.data, (list, tuple)) \
+                else [batch.data]
+            yield {name: np.asarray(arr.asnumpy()
+                                    if hasattr(arr, "asnumpy") else arr)
+                   for name, arr in zip(input_names, arrays)}
+        else:
+            yield {primary: np.asarray(batch)}
+
+
+def calibrate(input_names, data, num_batches: Optional[int] = None,
+              guard_policy=None) -> CalibrationStats:
+    """Observe per-input absmax over up to ``num_batches`` batches.
+
+    ``data`` may be a DataIter (guarded via PR 4's resilient-iterator
+    machinery: corrupt records are skipped under ``guard_policy``'s
+    budget), an iterable of feed dicts / DataBatches / arrays, a dict,
+    or one array. Raises when no batch yields any named input —
+    calibrating on nothing would silently ship scale-1.0 quantization.
+    """
+    from ..io import DataIter
+    from ..resilience.data import guard as _guard
+    input_names = list(input_names)
+    if isinstance(data, DataIter):
+        data = _guard(data, policy=guard_policy)
+    absmax = {name: 0.0 for name in input_names}
+    observed = {name: 0 for name in input_names}
+    seen = 0
+    for feed in _as_feed_dicts(data, input_names):
+        for name in input_names:
+            if name in feed:
+                arr = np.asarray(feed[name])
+                if arr.size:
+                    observed[name] += 1
+                    absmax[name] = max(absmax[name],
+                                       float(np.max(np.abs(arr))))
+        seen += 1
+        if num_batches is not None and seen >= num_batches:
+            break
+    if seen == 0:
+        raise MXNetError(
+            "calibrate(): the batch source yielded no batches — "
+            "quantization needs at least one representative batch")
+    if input_names and not any(observed.values()):
+        # a source keyed on the wrong names would otherwise calibrate
+        # NOTHING and silently ship scale-1.0 quantization
+        raise MXNetError(
+            f"calibrate(): {seen} batch(es) consumed but none carried "
+            f"any of the named inputs {input_names}; check the feed "
+            f"keys / data_names")
+    missing = [n for n, c in observed.items() if c == 0]
+    if missing:
+        logging.warning(
+            "calibrate(): inputs %s never appeared in the calibration "
+            "batches; they keep scale 1.0 (exact only if their live "
+            "range is within the format's own)", missing)
+    return CalibrationStats(absmax, batches=seen)
+
+
+# ---------------------------------------------------------------------------
+# the manifest-covered sidecar
+# ---------------------------------------------------------------------------
+
+def save_stats(stats: CalibrationStats, path: str) -> str:
+    """Atomically write ``stats`` to ``path`` plus its manifest
+    (``<path>.manifest.json`` with size + sha256), so a reloaded
+    Predictor never re-calibrates and a torn write is detectable."""
+    from ..resilience.checkpoint import atomic_write_bytes, write_manifest
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    atomic_write_bytes(path, json.dumps(stats.to_dict(), indent=1,
+                                        sort_keys=True).encode("utf-8"))
+    write_manifest(path, None, {"calibration": path})
+    return path
+
+
+def load_stats(path: str) -> Optional[CalibrationStats]:
+    """Load a calibration sidecar, or None when it is missing, corrupt,
+    truncated, or fails its manifest — the caller recalibrates; a bad
+    sidecar must never crash a deployment. Reads pass the
+    ``quant.sidecar.read`` fault site (an injected transient fault also
+    reads as recalibrate)."""
+    from ..resilience import faults
+    from ..resilience.checkpoint import CheckpointCorrupt, verify_manifest
+    path = os.path.abspath(path)
+    try:
+        faults.fault_point("quant.sidecar.read")
+        if not os.path.exists(path):
+            return None
+        verify_manifest(path, None)
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        return CalibrationStats.from_dict(doc)
+    except (CheckpointCorrupt, MXNetError, OSError, ValueError, KeyError,
+            TypeError, TimeoutError) as err:
+        logging.warning(
+            "calibration sidecar %s unusable (%s: %s); recalibrating",
+            path, type(err).__name__, err)
+        return None

@@ -1,0 +1,135 @@
+"""Dynamic loss scaling: the low-precision training guard.
+
+Classic mixed-precision insurance (the fp16/fp8 overflow story; bf16
+shares fp32's exponent range so overflow is rare there, but the guard
+is cheap and makes the ``MXTPU_PRECISION`` mode fp8-ready): the loss
+cotangent is multiplied by ``scale`` before the backward, gradients are
+un-scaled before the update, and the whole decision runs *inside the
+donated step program*:
+
+* every gradient leaf finite  -> the update applies; a streak of
+  ``growth_interval`` finite steps doubles the scale (up to ``max_scale``);
+* any non-finite gradient     -> the step is SKIPPED, not applied —
+  parameters and optimizer state pass through bitwise unchanged — and
+  the scale backs off by ``backoff_factor`` (down to ``min_scale``).
+
+Scales are powers of two by construction (init/growth/backoff all
+powers of two), so scaling is exact in floating point: on a finite
+stream the guarded step computes the same gradients as the unguarded
+one.
+
+Two consumers:
+
+* :class:`~mxnet_tpu.perf.FusedStep` and ``SPMDTrainer`` thread the
+  ``(scale, streak)`` state through their donated programs via the pure
+  helpers here (:func:`init_state` / :func:`tree_all_finite` /
+  :func:`next_state`) — zero host syncs, zero retraces (the state is
+  two scalars of fixed shape).
+* the Gluon :class:`~mxnet_tpu.gluon.trainer.Trainer` path, where the
+  backward runs in autograd-land *outside* the fused program, uses the
+  host-side :class:`DynamicLossScale` mirror — the user multiplies the
+  loss by ``.scale`` and the fused update reports the finite flag back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LossScaleConfig", "DynamicLossScale", "init_state",
+           "tree_all_finite", "next_state", "guarded_select"]
+
+
+class LossScaleConfig:
+    """Hyperparameters of the dynamic schedule (all powers of two so
+    scaling stays exact)."""
+
+    def __init__(self, init_scale: float = 2.0 ** 15,
+                 growth_factor: float = 2.0, backoff_factor: float = 0.5,
+                 growth_interval: int = 200,
+                 max_scale: float = 2.0 ** 24, min_scale: float = 1.0):
+        self.init_scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.max_scale = float(max_scale)
+        self.min_scale = float(min_scale)
+
+    def signature(self) -> str:
+        """Joins program keys: the schedule constants are baked into the
+        traced step (the state is dynamic, the policy is static)."""
+        return ("ls=%g;%g;%g;%d;%g;%g" % (
+            self.init_scale, self.growth_factor, self.backoff_factor,
+            self.growth_interval, self.max_scale, self.min_scale))
+
+
+def init_state(config: LossScaleConfig):
+    """Device-side ``(scale f32, finite_streak i32)`` state."""
+    return (jnp.float32(config.init_scale), jnp.int32(0))
+
+
+def tree_all_finite(tree):
+    """Traced: True iff every inexact leaf of ``tree`` is finite."""
+    leaves = [leaf for leaf in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)]
+    if not leaves:
+        return jnp.bool_(True)
+    flags = [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
+def next_state(state, finite, config: LossScaleConfig):
+    """Traced schedule step: grow on a full finite streak, back off on
+    overflow, hold otherwise."""
+    scale, streak = state
+    grown_streak = streak + 1
+    grow = grown_streak >= config.growth_interval
+    finite_scale = jnp.where(
+        grow, jnp.minimum(scale * config.growth_factor, config.max_scale),
+        scale)
+    finite_streak = jnp.where(grow, 0, grown_streak)
+    new_scale = jnp.where(finite, finite_scale,
+                          jnp.maximum(scale * config.backoff_factor,
+                                      config.min_scale))
+    new_streak = jnp.where(finite, finite_streak, 0)
+    return (new_scale.astype(jnp.float32), new_streak.astype(jnp.int32))
+
+
+def guarded_select(finite, updated, previous):
+    """Traced per-tree select: the updated values on a finite step, the
+    donated inputs bitwise unchanged on a skipped one."""
+    return jax.tree_util.tree_map(
+        lambda new, old: jnp.where(finite, new, old), updated, previous)
+
+
+class DynamicLossScale:
+    """Host-side mirror for call sites whose backward runs outside the
+    fused program (the Gluon Trainer): holds the python-float scale the
+    user multiplies the loss by; :meth:`update` advances the schedule
+    from the step's finite flag. The flag readback is one scalar per
+    step at an update boundary — the Gluon analogue of the Updater
+    state sync, not a traced-region sync."""
+
+    def __init__(self, config: LossScaleConfig = None):
+        self.config = config or LossScaleConfig()
+        self.scale = self.config.init_scale
+        self._streak = 0
+        self.steps_skipped = 0
+
+    def update(self, finite: bool) -> bool:
+        """Advance the schedule; returns ``finite`` for chaining."""
+        cfg = self.config
+        if finite:
+            self._streak += 1
+            if self._streak >= cfg.growth_interval:
+                self.scale = min(cfg.max_scale, self.scale
+                                 * cfg.growth_factor)
+                self._streak = 0
+        else:
+            self.scale = max(cfg.min_scale, self.scale
+                             * cfg.backoff_factor)
+            self._streak = 0
+            self.steps_skipped += 1
+        return finite

@@ -1,0 +1,436 @@
+"""Int8 post-training-quantized serving: backend, gate, fallback.
+
+The deployment pipeline (docs/how_to/quantization.md):
+
+1. **calibrate** — a handful of representative batches through the fp32
+   forward records per-input absmax (:mod:`.calibration`; snapshot to a
+   manifest-covered sidecar so a reloaded Predictor never re-runs it);
+2. **quantize** — every 2-D+ fp32 parameter is stored as int8 with a
+   per-tensor symmetric scale; quantizable activations enter the
+   program as int8 rows and widen in-program. The forward is ONE jitted
+   program (weights dequantize inside it), registered through the
+   compiler's annotate slot so the quantization decision joins
+   ``transform_sig`` and every persistent program key — the compilation
+   cache can never serve a stale-precision executable;
+3. **gate** — the quantized path's outputs are measured against fp32 on
+   the calibration batches; a mean relative error beyond
+   ``max_accuracy_delta`` REFUSES to ship: the fp32 backend is returned
+   with a typed :class:`QuantAccuracyWarning` (degraded to full
+   precision, never silently wrong).
+
+The serving win compounds with PR 10's continuous batching: int8 rows
+are 4x cheaper to pad, merge, and dispatch through the
+:class:`~mxnet_tpu.serving.BatchCoalescer` (the padded feed is int8
+end-to-end; clients may pre-quantize with :meth:`QuantizedModuleBackend.
+quantize_inputs` using the published scales, or submit fp32 rows that
+the backend quantizes at entry — both land in the same int8 program).
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from .calibration import (CalibrationStats, calibrate, load_stats,
+                          save_stats, _as_feed_dicts)
+from .core import QuantConfig, dequantize, quant_scope
+
+__all__ = ["QuantAccuracyWarning", "QuantReport", "QuantizedModuleBackend",
+           "quantize_backend", "quantized_backend_from_artifact",
+           "integer_semantics_inputs"]
+
+
+class QuantAccuracyWarning(UserWarning):
+    """The accuracy gate refused to ship a quantized model: its measured
+    output delta vs fp32 exceeded the threshold, and the server falls
+    back to the fp32 backend (degraded throughput, correct answers)."""
+
+
+class QuantReport:
+    """What the gate measured and what shipped."""
+
+    def __init__(self, accuracy_delta: float, threshold: float,
+                 shipped: bool, fmt: str, quantized_params: Sequence[str],
+                 quantized_inputs: Sequence[str], calib_batches: int,
+                 top1_agreement: Optional[float] = None,
+                 fallback_reason: Optional[str] = None):
+        self.accuracy_delta = float(accuracy_delta)
+        self.threshold = float(threshold)
+        self.shipped = bool(shipped)
+        self.format = fmt
+        self.quantized_params = list(quantized_params)
+        self.quantized_inputs = list(quantized_inputs)
+        self.calib_batches = int(calib_batches)
+        self.top1_agreement = top1_agreement
+        self.fallback_reason = fallback_reason
+
+    def to_dict(self) -> dict:
+        return {"accuracy_delta": round(self.accuracy_delta, 6),
+                "threshold": self.threshold, "shipped": self.shipped,
+                "format": self.format,
+                "quantized_params": len(self.quantized_params),
+                "quantized_inputs": self.quantized_inputs,
+                "calib_batches": self.calib_batches,
+                "top1_agreement": self.top1_agreement,
+                "fallback_reason": self.fallback_reason}
+
+
+def integer_semantics_inputs(symbol) -> set:
+    """Input variables that carry *indices*, not magnitudes — an
+    Embedding's data slot, a one-hot label — which must never be
+    range-quantized (round(token_id / scale) destroys the id)."""
+    out = set()
+    for node in symbol._topo_nodes():
+        if node.is_variable or not node.inputs:
+            continue
+        if node.op.name in ("Embedding", "one_hot"):
+            src = node.inputs[0][0]
+            if src.is_variable:
+                out.add(src.name)
+    return out
+
+
+class QuantizedModuleBackend:
+    """Serve a bound Module through one int8-quantized jitted forward.
+
+    Weights live as int8 device arrays + per-tensor scales (4x less
+    parameter memory than fp32); quantizable activation inputs arrive
+    int8 and widen in-program. Declares ``input_dtypes`` so the serving
+    warm-up probes (and therefore the warmed-signature contract) run in
+    int8 — a coalesced int8 batch pads, merges, and dispatches at a
+    quarter of the fp32 byte cost.
+    """
+
+    def __init__(self, module, config: Optional[QuantConfig] = None,
+                 stats: Optional[CalibrationStats] = None,
+                 input_name: Optional[str] = None):
+        self.module = module
+        self.config = config or QuantConfig()
+        self.stats = stats or CalibrationStats({}, 0)
+        names = [d[0] for d in module.data_shapes]
+        self.input_names = names
+        self.input_name = input_name or names[0]
+        self.input_specs = {d[0]: tuple(d[1][1:])
+                            for d in module.data_shapes}
+        self.row_shape = self.input_specs[self.input_name]
+        # activation inputs that quantize: fp32-fed, not index-semantic
+        skip = integer_semantics_inputs(module._symbol)
+        self.quantized_inputs = [n for n in names if n not in skip]
+        self.input_dtypes = {
+            n: (self.config.format.dtype.name if n in self.quantized_inputs
+                else "float32") for n in names}
+        self.quantized_params: List[str] = []
+        self._qweights = None
+        self._wscales = None
+        self._others = None
+        self._aux = None
+        self._ascales_host: Dict[str, float] = {}
+        self._forward_fn = None
+        self.quant_report: Optional[QuantReport] = None
+
+    # -- load: quantize weights + build the one program ----------------------
+
+    def load(self):
+        import jax
+        import jax.numpy as jnp
+
+        from .. import compiler as _compiler
+        from ..executor import _null_key, build_graph_eval
+
+        if not (self.module.binded and self.module.params_initialized):
+            raise MXNetError(
+                "QuantizedModuleBackend needs a bound module with "
+                "initialized params (bind + init_params/set_params first)")
+        mod = self.module
+        exec_ = mod._exec
+        fmt = self.config.format
+        arg = {n: np.asarray(exec_.arg_dict[n].asnumpy())
+               for n in mod._param_names}
+        aux = {n: np.asarray(exec_.aux_dict[n].asnumpy())
+               for n in exec_._aux_names}
+        self.quantized_params = sorted(
+            n for n, v in arg.items()
+            if self.config.quantizes_param(v.shape, v.dtype))
+        # host-side weight quantization: deterministic bit-for-bit across
+        # processes (the cross-process golden in tests/test_quant.py),
+        # through the ONE shared scale + quantize rule in quant/core.py
+        from .core import host_scale, quantize_host
+        qweights, wscales, others = {}, {}, {}
+        for n, v in arg.items():
+            if n in self.quantized_params:
+                absmax = float(np.max(np.abs(v))) if v.size else 0.0
+                scale = host_scale(absmax, fmt)
+                qweights[n] = jnp.asarray(quantize_host(v, scale, fmt))
+                wscales[n] = jnp.float32(scale)
+            else:
+                others[n] = jnp.asarray(v)
+        self._qweights, self._wscales, self._others = \
+            qweights, wscales, others
+        self._aux = {n: jnp.asarray(v) for n, v in aux.items()}
+        self._ascales_host = {n: self.stats.scale(n, fmt)
+                              for n in self.quantized_inputs}
+
+        # graph passes under the quant scope: the annotator stamps the
+        # decision, transform_sig gains quant=<sig>, and the persistent
+        # program key below inherits it — stale-precision-proof
+        all_arrs = list(arg.items()) + list(aux.items())
+        with quant_scope(self.config, self.quantized_params):
+            opt_res = _compiler.optimize(
+                mod._symbol, for_training=False,
+                input_shapes={n: tuple(v.shape) for n, v in all_arrs},
+                input_dtypes={n: str(v.dtype) for n, v in all_arrs})
+        eval_fn = build_graph_eval(opt_res.symbol)
+
+        def qforward(qw, ws, others_, aux_, qin, ascales, raw):
+            merged = dict(raw)
+            for n, q in qin.items():
+                merged[n] = dequantize(q, ascales[n])
+            for n, q in qw.items():
+                merged[n] = dequantize(q, ws[n])
+            merged.update(others_)
+            outs, _aux_up = eval_fn(merged, aux_, _null_key(), False)
+            return outs
+
+        self._forward_fn = _compiler.PersistentJit(
+            qforward, kind="quant-forward",
+            key_parts=(_compiler.graph_fingerprint(opt_res.symbol),
+                       opt_res.transform_sig,
+                       self.config.signature(self.quantized_params)))
+        return self
+
+    def program_key_parts(self):
+        """The static program identity (tests assert quant-vs-fp32 keys
+        differ; the avals half is appended per call signature)."""
+        if self._forward_fn is None:
+            raise MXNetError("load() the backend first")
+        return self._forward_fn._key_parts
+
+    # -- client-side helper ---------------------------------------------------
+
+    def quantize_inputs(self, arrays: Dict[str, np.ndarray]
+                        ) -> Dict[str, np.ndarray]:
+        """Quantize a feed with the published calibration scales —
+        what a wire-efficient client does before submitting (int8 rows
+        are 4x cheaper to queue, pad, and coalesce). Passing the result
+        to :meth:`infer` is numerically identical to passing the fp32
+        original: the server-side entry quantization is this very
+        function."""
+        from .core import quantize_host
+        fmt = self.config.format
+        out = {}
+        for name, arr in arrays.items():
+            arr = np.asarray(arr)
+            if (name in self.quantized_inputs
+                    and arr.dtype != np.dtype(fmt.dtype)):
+                scale = self._ascales_host.get(name) or \
+                    self.stats.scale(name, fmt)
+                out[name] = quantize_host(arr, scale, fmt)
+            else:
+                out[name] = arr
+        return out
+
+    # -- the serving contract -------------------------------------------------
+
+    def infer(self, arrays: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        import jax.numpy as jnp
+        if self._forward_fn is None:
+            raise MXNetError("QuantizedModuleBackend: load() before infer()")
+        fmt = self.config.format
+        feed = self.quantize_inputs(arrays)
+        qin, raw, ascales = {}, {}, {}
+        for name in self.input_names:
+            arr = feed[name]
+            if name in self.quantized_inputs:
+                qin[name] = jnp.asarray(
+                    np.ascontiguousarray(arr, np.dtype(fmt.dtype)))
+                ascales[name] = jnp.float32(self._ascales_host[name])
+            else:
+                raw[name] = jnp.asarray(
+                    np.ascontiguousarray(arr, np.float32))
+        outs = self._forward_fn(self._qweights, self._wscales,
+                                self._others, self._aux, qin, ascales, raw)
+        return [np.asarray(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# the accuracy gate
+# ---------------------------------------------------------------------------
+
+def _fit_rows(feed: Dict[str, np.ndarray], rows: int
+              ) -> Dict[str, np.ndarray]:
+    """Pad/truncate every input to the module's bound batch size (gate
+    feeds come from arbitrary calibration sources)."""
+    out = {}
+    for name, arr in feed.items():
+        arr = np.asarray(arr)
+        if arr.ndim == 0 or arr.shape[0] == rows:
+            out[name] = arr
+        elif arr.shape[0] > rows:
+            out[name] = arr[:rows]
+        else:
+            pad = np.zeros((rows - arr.shape[0],) + arr.shape[1:],
+                           arr.dtype)
+            out[name] = np.concatenate([arr, pad], axis=0)
+    return out
+
+
+def measure_accuracy_delta(base, quant, feeds: Sequence[Dict],
+                           real_rows: Optional[Sequence[int]] = None
+                           ) -> dict:
+    """Mean relative output error of ``quant`` vs ``base`` over
+    ``feeds``, plus top-1 agreement when the first output looks like
+    class scores. The scalar the gate thresholds is the relative error —
+    dataset-label-free, so the gate needs no labeled eval set at load
+    time (the nncase-style deployment check).
+
+    ``real_rows[i]`` restricts feed i's measurement to its first N
+    output rows: gate feeds are zero-PADDED to the module's bound batch
+    (:func:`_fit_rows`), and pad rows — whose fp32-vs-int8 difference
+    is near zero while their bias-driven magnitude inflates the
+    denominator — would otherwise dilute the measured delta by up to
+    padded/real, letting an over-threshold model ship."""
+    deltas, agree, n_cls = [], [], 0
+    for i, feed in enumerate(feeds):
+        rows = real_rows[i] if real_rows is not None else None
+        b_outs = base.infer(feed)
+        q_outs = quant.infer(feed)
+        for b, q in zip(b_outs, q_outs):
+            b = np.asarray(b, np.float64)
+            q = np.asarray(q, np.float64)
+            if rows is not None and b.ndim >= 1 and b.shape[0] >= rows:
+                b, q = b[:rows], q[:rows]
+            denom = float(np.sum(np.abs(b)))
+            deltas.append(float(np.sum(np.abs(q - b)))
+                          / (denom + 1e-12))
+        b0, q0 = np.asarray(b_outs[0]), np.asarray(q_outs[0])
+        if rows is not None and b0.ndim >= 1 and b0.shape[0] >= rows:
+            b0, q0 = b0[:rows], q0[:rows]
+        if b0.ndim == 2 and b0.shape[1] > 1:
+            agree.append(float(np.mean(np.argmax(b0, axis=1)
+                                       == np.argmax(q0, axis=1))))
+            n_cls += 1
+    return {"accuracy_delta": float(np.mean(deltas)) if deltas else 0.0,
+            "top1_agreement": (float(np.mean(agree)) if n_cls else None)}
+
+
+def quantize_backend(module, calib_data, config: Optional[QuantConfig] = None,
+                     stats_path: Optional[str] = None,
+                     guard_policy=None, input_name: Optional[str] = None):
+    """The ``as_serving_backend(quant=...)`` implementation: calibrate
+    (or reload the sidecar), quantize, gate, and hand back the backend
+    to serve — the quantized one when the measured delta clears the
+    threshold, the fp32 :class:`~mxnet_tpu.serving.ModuleBackend`
+    otherwise (typed :class:`QuantAccuracyWarning`; a quantized model
+    that fails its gate must degrade to slow-and-right, never ship
+    fast-and-wrong). The decision + measurements land on
+    ``backend.quant_report`` either way.
+    """
+    from ..serving.backends import ModuleBackend
+    config = config or QuantConfig()
+    # input_name names the PRIMARY input (what a bare-array submit binds
+    # to) — honored on the quantized backend AND the fp32 fallback, so
+    # quant on/off/refused all keep the same single-input contract
+    base = ModuleBackend(module, input_name=input_name)
+    base.load()
+    input_names = [d[0] for d in module.data_shapes]
+    bound_rows = int(module.data_shapes[0][1][0])
+
+    # one materialized feed list serves calibration AND the gate —
+    # single-pass sources (generators) are consumed exactly once
+    feeds = []
+    for feed in _as_feed_dicts(_maybe_guard(calib_data, guard_policy),
+                               input_names):
+        feeds.append(feed)
+        if len(feeds) >= config.calib_batches:
+            break
+    if not feeds:
+        raise MXNetError(
+            "quantize_backend(): the calibration source yielded no "
+            "batches — PTQ needs at least one representative batch")
+
+    stats = load_stats(stats_path) if stats_path else None
+    if stats is None:
+        stats = calibrate(input_names, feeds)
+        if stats_path:
+            save_stats(stats, stats_path)
+
+    qb = QuantizedModuleBackend(module, config=config, stats=stats,
+                                input_name=input_name)
+    qb.load()
+
+    gate_feeds = [_fit_rows(f, bound_rows) for f in feeds]
+    # measure on the REAL rows only: the zero-pad rows a small
+    # calibration batch gains must not dilute the gate
+    gate_rows = [min(bound_rows, max(
+        (int(np.asarray(v).shape[0]) for v in f.values()
+         if getattr(np.asarray(v), "ndim", 0) >= 1), default=bound_rows))
+        for f in feeds]
+    measured = measure_accuracy_delta(base, qb, gate_feeds,
+                                      real_rows=gate_rows)
+    delta = measured["accuracy_delta"]
+    shipped = delta <= config.max_accuracy_delta
+    report = QuantReport(
+        accuracy_delta=delta, threshold=config.max_accuracy_delta,
+        shipped=shipped, fmt=config.format.name,
+        quantized_params=qb.quantized_params,
+        quantized_inputs=qb.quantized_inputs,
+        calib_batches=len(feeds),
+        top1_agreement=measured["top1_agreement"],
+        fallback_reason=None if shipped else
+        f"accuracy delta {delta:.4f} > threshold "
+        f"{config.max_accuracy_delta:.4f}")
+    qb.quant_report = report
+    base.quant_report = report
+    if shipped:
+        logging.info(
+            "quantize_backend: shipping %s (delta %.4f <= %.4f, "
+            "%d params quantized)", config.format.name, delta,
+            config.max_accuracy_delta, len(qb.quantized_params))
+        return qb
+    warnings.warn(QuantAccuracyWarning(
+        f"quantized ({config.format.name}) model refused by the accuracy "
+        f"gate: measured output delta {delta:.4f} exceeds the "
+        f"{config.max_accuracy_delta:.4f} threshold — serving the fp32 "
+        f"backend instead (recalibrate with more/representative batches, "
+        f"raise MXTPU_QUANT_MAX_DELTA deliberately, or keep fp32)"))
+    return base
+
+
+def _maybe_guard(data, policy):
+    from ..io import DataIter
+    from ..resilience.data import guard as _guard
+    if isinstance(data, DataIter):
+        return _guard(data, policy=policy)
+    return data
+
+
+def quantized_backend_from_artifact(symbol_json: str, param_bytes: bytes,
+                                    row_shape: Sequence[int], calib_data,
+                                    input_name: str = "data",
+                                    batch_size: int = 1,
+                                    config: Optional[QuantConfig] = None,
+                                    stats_path: Optional[str] = None):
+    """Predictor-load quantization: the same symbol-JSON + .params
+    artifact the C predict ABI serves, bound forward-only and run
+    through :func:`quantize_backend` — corrupt artifacts raise the same
+    typed MXNetError the fp32 Predictor load does."""
+    from .. import c_predict
+    from .. import symbol as _sym_mod
+    from ..module import Module
+    arg_params, aux_params = c_predict._params_from_bytes(param_bytes)
+    sym = _sym_mod.load_json(symbol_json)
+    from ..ndarray import NDArray
+    mod = Module(sym, data_names=[input_name], label_names=[])
+    mod.bind(data_shapes=[(input_name,
+                           (int(batch_size),) + tuple(row_shape))],
+             label_shapes=None, for_training=False)
+    mod.set_params({k: NDArray(np.asarray(v))
+                    for k, v in arg_params.items()},
+                   {k: NDArray(np.asarray(v))
+                    for k, v in aux_params.items()},
+                   allow_missing=False)
+    return quantize_backend(mod, calib_data, config=config,
+                            stats_path=stats_path)

@@ -58,6 +58,10 @@ SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          # supervisor.heartbeat simulates a stalled step (drives the
          # retry → rebind → re-mesh → abort escalation ladder)
          "supervisor.signal", "supervisor.heartbeat",
+         # quantization calibration sidecar (mxnet_tpu/quant/calibration
+         # .py, docs/how_to/quantization.md): a corrupt/missing/faulted
+         # sidecar read falls back to recalibration, never a crash
+         "quant.sidecar.read",
          # serving fleet (mxnet_tpu/serving/fleet.py,
          # docs/how_to/fleet.md): the replica-health probe and the
          # per-replica dispatch — an injected fault at fleet.probe kills

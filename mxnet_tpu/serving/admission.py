@@ -321,6 +321,20 @@ class StrideScheduler:
             return dict(self._vtime)
 
 
+def _shape_key(req: Request) -> str:
+    """Compact histogram key: leading-axis rows + sorted per-input
+    (name, per-row shape, dtype) — the same facts
+    ``batching.request_signature`` merges on, stringified for stats."""
+    parts = []
+    if isinstance(req.inputs, dict):
+        for name in sorted(req.inputs):
+            arr = req.inputs[name]
+            shape = tuple(getattr(arr, "shape", ()))
+            dtype = str(getattr(arr, "dtype", type(arr).__name__))
+            parts.append(f"{name}:{shape[1:]}:{dtype}")
+    return f"{req.rows}r|" + ";".join(parts)
+
+
 class AdmissionQueue:
     """Bounded queue between submitters and workers.
 
@@ -338,6 +352,8 @@ class AdmissionQueue:
     """
 
     POLICIES = ("reject", "evict-oldest")
+    _SHAPE_HIST_CAP = 128
+    _SHAPE_HIST_OVERFLOW = "__other__"
 
     def __init__(self, capacity: int = 64, policy: str = "reject",
                  clock: Callable[[], float] = time.monotonic,
@@ -355,6 +371,14 @@ class AdmissionQueue:
         self._on_tenant_event = on_tenant_event or (lambda *a, **k: None)
         self._cv = threading.Condition()
         self._items: deque = deque()  # tpu-lint: guarded-by=_cv
+        # observed request-shape histogram (rows + per-input row shape/
+        # dtype -> arrivals): the raw demand distribution ROADMAP item
+        # 4's bucket mining feeds on — today's serving buckets are
+        # static guesses; this records what traffic actually asks for.
+        # Bounded: past _SHAPE_HIST_CAP distinct keys new shapes fold
+        # into the overflow bucket (client-invented shapes must not
+        # grow the map without bound).
+        self._shape_hist: Dict[str, int] = {}  # tpu-lint: guarded-by=_cv
         # private by default (per-queue fairness, the PR 10 behavior);
         # the fleet router passes one shared instance per replica queue
         # so fair shares are measured fleet-wide
@@ -399,6 +423,11 @@ class AdmissionQueue:
                 # closed != full: racing a shutdown must read as
                 # shutdown, not as retryable overload
                 raise ServerClosed("admission queue is closed")
+            # every arrival that reached admission counts toward the
+            # observed-shape histogram — shed requests included, because
+            # bucket mining needs the DEMAND distribution, not just
+            # what capacity happened to admit
+            self._record_shape_locked(req)
             if self.tenants is not None:
                 quota = self.tenants.quota(req.tenant)
                 if quota is not None and sum(
@@ -526,6 +555,27 @@ class AdmissionQueue:
             if self.admitted == since and self.open:
                 self._cv.wait(timeout)
             return self.admitted
+
+    def _record_shape_locked(self, req: Request):
+        key = _shape_key(req)
+        if (key not in self._shape_hist
+                and len(self._shape_hist) >= self._SHAPE_HIST_CAP):
+            key = self._SHAPE_HIST_OVERFLOW
+        self._shape_hist[key] = self._shape_hist.get(key, 0) + 1
+
+    def record_shape(self, req: Request):
+        """Count a request that never reaches :meth:`offer` into the
+        demand histogram — the server calls this for oversized requests
+        rejected at submit: the shapes proving a larger bucket is
+        needed are exactly the ones bucket mining must see."""
+        with self._cv:
+            self._record_shape_locked(req)
+
+    def shape_histogram(self) -> Dict[str, int]:
+        """Snapshot of the observed request-shape histogram (feeds the
+        ``serving.stats()`` queue block; docs/how_to/serving.md)."""
+        with self._cv:
+            return dict(self._shape_hist)
 
     def expire_queued(self) -> int:
         """Fail every queued request whose deadline has passed, freeing

@@ -272,8 +272,14 @@ class InferenceServer:
         specs = getattr(backend, "input_specs", None) or \
             {getattr(backend, "input_name", "data"):
              tuple(getattr(backend, "row_shape", ()))}
+        # probes honor the backend's declared per-input dtypes (default
+        # fp32): a quantized backend warms int8 buckets, so its warmed-
+        # signature set matches live int8 traffic instead of tripping
+        # the strict guard on the first real dispatch
+        dtypes = getattr(backend, "input_dtypes", None) or {}
         for size in self.buckets.sizes:
-            probe = {name: np.zeros((size,) + tuple(row), np.float32)
+            probe = {name: np.zeros((size,) + tuple(row),
+                                    np.dtype(dtypes.get(name, "float32")))
                      for name, row in specs.items()}
             self._forward(backend, probe, warming=True)
             if backend is self.backend:
@@ -382,7 +388,10 @@ class InferenceServer:
                 # a client error, rejected at admission: letting it ride
                 # would fail at pad time AND charge the circuit breaker
                 # — one oversized caller must never open the circuit
-                # for everyone
+                # for everyone. Still DEMAND: the shape histogram must
+                # see exactly these (they prove a larger bucket is
+                # needed), even though the queue never will.
+                self._queue.record_shape(req)
                 self._count("shed")
                 self._tenant_count(tenant, "shed")
                 raise RequestTooLarge(
@@ -726,7 +735,11 @@ class InferenceServer:
         counters["queue"] = {"depth": self._queue.depth(),
                              "admitted": self._queue.admitted,
                              "shed": self._queue.shed,
-                             "evicted": self._queue.evicted}
+                             "evicted": self._queue.evicted,
+                             # observed demand per (rows, shapes, dtype)
+                             # — ROADMAP item 4's bucket-mining feed
+                             "shape_histogram":
+                                 self._queue.shape_histogram()}
         counters["circuit"] = self.breaker.stats()
         counters["per_tenant"] = per_tenant
         counters["batching"] = {

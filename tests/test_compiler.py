@@ -585,6 +585,28 @@ def test_donated_programs_skip_persistence_by_default(tmp_cache,
     assert compiler.stats()["cache"]["writes"] == 1
 
 
+def test_donated_persistence_default_gated_by_jax_version(monkeypatch):
+    """The donated-program default is a jax-VERSION gate, not a blanket
+    off: the 0.4.x line's deserialize_and_load drops donation aliasing
+    (serialize_executable.py:57 — heap corruption on CPU, re-bisected),
+    the 0.5 line rewrote that path. The env knob forces either way."""
+    from mxnet_tpu.compiler import aot
+    monkeypatch.delenv("MXTPU_COMPILE_CACHE_DONATED", raising=False)
+    import jax
+    broken = aot._donated_deserialize_broken()
+    assert broken == (aot._jax_version_tuple() < (0, 5, 0))
+    pj = compiler.PersistentJit(lambda xs: [x + 1 for x in xs],
+                                kind="gate", key_parts=("g",),
+                                donate_argnums=(0,))
+    assert pj._persist_ok() == (not broken)
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DONATED", "1")
+    assert pj._persist_ok() is True
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DONATED", "0")
+    assert pj._persist_ok() is False
+    assert aot._jax_version_tuple()[:2] == tuple(
+        int(p) for p in jax.__version__.split(".")[:2])
+
+
 def test_persistent_jit_warm_load_skips_tracing(tmp_cache):
     import jax.numpy as jnp
     traces = [0]

@@ -478,7 +478,7 @@ def test_endpoint_stats_mirror():
     assert table["ep1"]["completed"] == 1
     assert table["ep1"]["circuit"]["state"] == "closed"
     assert set(table["ep1"]["queue"]) == {"depth", "admitted", "shed",
-                                          "evicted"}
+                                          "evicted", "shape_histogram"}
     srv.close()
     assert "ep1" not in serving.stats()
 
