@@ -241,14 +241,27 @@ ci-quant: ci-native
 	    python ci/quant_smoke.py
 	JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -x -q
 
+# stage 18: checkpoint kill-matrix chaos smoke — an InjectedKill at
+# every async/sharded checkpoint fault site (snapshot, per-shard write,
+# manifest commit, flush barrier, stale sweep, crash-loop resume
+# counter) must leave discovery loading only complete committed
+# checkpoints; a 4-way sharded checkpoint must restore bitwise onto 2
+# and 8; async fit must match sync fit bitwise and resume; then the
+# async/sharded unit suite (docs/how_to/fault_tolerance.md,
+# "Async & sharded checkpoints")
+ci-checkpoint: ci-native
+	timeout -k 10 300 env JAX_PLATFORMS=cpu python ci/ckpt_chaos.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_async_checkpoint.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-frontends ci-dryrun ci-resilience ci-serving ci-batching ci-data \
     ci-perf ci-elastic ci-compiler ci-preempt ci-multichip ci-fleet \
-    ci-quant
+    ci-quant ci-checkpoint
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu lint-concurrency ci-lint ci-native \
 	ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
         ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \
-        ci-preempt ci-multichip ci-fleet ci-quant
+        ci-preempt ci-multichip ci-fleet ci-quant ci-checkpoint

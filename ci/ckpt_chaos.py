@@ -1,0 +1,311 @@
+"""Checkpoint kill-matrix chaos smoke (`make ci-checkpoint`).
+
+Injects a kill (InjectedKill, a BaseException — the in-process stand-in
+for SIGKILL) at EVERY fault site the async + sharded checkpoint path
+crosses — snapshot, per-shard write, manifest commit, flush barrier,
+stale-checkpoint sweep, and the crash-loop resume-counter update — and
+proves the crash-consistency contract after each: discovery
+(``find_checkpoints`` / ``load_checkpoint_ex``) returns only complete,
+committed checkpoints, and the newest committed one survives intact.
+
+Then the sharded legs: a checkpoint written 4-way restores BITWISE onto
+2 and 8 processes (reshard-on-load), and an end-to-end async
+``Module.fit`` run matches its synchronous twin bitwise and resumes.
+
+docs/how_to/fault_tolerance.md ("Async & sharded checkpoints").
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                   # noqa: E402
+from mxnet_tpu import nd, sym                            # noqa: E402
+from mxnet_tpu.resilience import (AsyncCheckpointer,     # noqa: E402
+                                  AsyncCheckpointError, CrashLoopGuard,
+                                  FaultPlan, InjectedKill, checkpoint
+                                  as rckpt, faults)
+from mxnet_tpu.resilience.async_checkpoint import (      # noqa: E402
+    load_sharded_checkpoint, snapshot_tree, split_tree,
+    write_sharded_checkpoint)
+
+PASS = []
+
+
+def ok(name):
+    PASS.append(name)
+    print(f"  PASS {name}")
+
+
+def _tree(seed=0, rows=8, cols=6):
+    rng = np.random.RandomState(seed)
+    return {"arg:w": rng.randn(rows, cols).astype(np.float32),
+            "arg:b": rng.randn(cols).astype(np.float32),
+            "state:step": np.int64(seed * 100)}
+
+
+def _symbol():
+    return sym.FullyConnected(sym.Variable("data"), name="fc",
+                              num_hidden=3)
+
+
+def _commit_baseline(prefix):
+    """One committed checkpoint (epoch 1) every kill leg falls back to."""
+    rng = np.random.RandomState(1)
+    args = {"fc_weight": nd.array(rng.randn(3, 4).astype(np.float32)),
+            "fc_bias": nd.array(np.zeros(3, np.float32))}
+    rckpt.write_checkpoint(prefix, 1, _symbol(), args, {})
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def _assert_newest_is(prefix, epoch, ref):
+    found = rckpt.find_checkpoints(prefix)
+    assert found and found[0] == epoch, \
+        f"discovery returned {found}, expected newest committed {epoch}"
+    ep, _, args, _, _ = rckpt.load_checkpoint_ex(prefix, rckpt.AUTO)
+    assert ep == epoch
+    for k, v in ref.items():
+        np.testing.assert_array_equal(args[k].asnumpy(), v, err_msg=k)
+
+
+def leg_kill_at_snapshot(tmp):
+    """A kill during the host snapshot never touches disk."""
+    prefix = os.path.join(tmp, "snap")
+    ref = _commit_baseline(prefix)
+    before = sorted(os.listdir(tmp))
+    faults.arm(FaultPlan().arm("checkpoint.snapshot", nth=1, exc="kill"))
+    try:
+        snapshot_tree(_tree(2))
+        raise AssertionError("kill did not fire")
+    except InjectedKill:
+        pass
+    faults.disarm()
+    assert sorted(os.listdir(tmp)) == before, "snapshot kill wrote files"
+    _assert_newest_is(prefix, 1, ref)
+    ok("kill@checkpoint.snapshot leaves disk untouched")
+
+
+def leg_kill_at_shard_write(tmp):
+    """A kill mid shard-set leaves a marked, manifest-less stem that
+    discovery skips; the baseline stays the newest loadable."""
+    prefix = os.path.join(tmp, "shardw")
+    ref = _commit_baseline(prefix)
+    faults.arm(FaultPlan().arm("checkpoint.shard_write", nth=3, exc="kill"))
+    try:
+        write_sharded_checkpoint(prefix, 2, _tree(2), num_shards=4)
+        raise AssertionError("kill did not fire")
+    except InjectedKill:
+        pass
+    faults.disarm()
+    assert rckpt.checkpoint_in_progress(prefix, 2), \
+        "torn shard set lost its .inprogress marker"
+    assert not os.path.exists(rckpt.manifest_path(prefix, 2))
+    _assert_newest_is(prefix, 1, ref)
+    ok("kill@checkpoint.shard_write -> torn set invisible to discovery")
+
+
+def leg_kill_at_commit(tmp):
+    """A kill at the manifest commit: all data files exist, but without
+    the manifest the checkpoint never happened."""
+    prefix = os.path.join(tmp, "commit")
+    ref = _commit_baseline(prefix)
+    rng = np.random.RandomState(9)
+    args = {"fc_weight": nd.array(rng.randn(3, 4).astype(np.float32)),
+            "fc_bias": nd.array(np.ones(3, np.float32))}
+    faults.arm(FaultPlan().arm("checkpoint.commit", nth=1, exc="kill"))
+    try:
+        rckpt.write_checkpoint(prefix, 2, _symbol(), args, {})
+        raise AssertionError("kill did not fire")
+    except InjectedKill:
+        pass
+    faults.disarm()
+    assert os.path.exists(rckpt.checkpoint_paths(prefix, 2)["params"]), \
+        "commit kill should land after the data files"
+    assert not os.path.exists(rckpt.manifest_path(prefix, 2))
+    _assert_newest_is(prefix, 1, ref)
+    ok("kill@checkpoint.commit -> manifest-less stem invisible")
+
+
+def leg_kill_at_flush(tmp):
+    """A kill at the flush barrier (the flusher dying, not the writer):
+    the background commit is unaffected — after the dust settles the
+    checkpoint is either fully committed or fully absent."""
+    prefix = os.path.join(tmp, "flush")
+    _commit_baseline(prefix)
+    rng = np.random.RandomState(3)
+    args = {"fc_weight": nd.array(rng.randn(3, 4).astype(np.float32)),
+            "fc_bias": nd.array(np.zeros(3, np.float32))}
+    ref2 = {k: v.asnumpy() for k, v in args.items()}
+    ck = AsyncCheckpointer(name="chaos-flush")
+    ck.submit(2, lambda: rckpt.write_checkpoint(prefix, 2, _symbol(),
+                                                args, {}))
+    faults.arm(FaultPlan().arm("checkpoint.flush", nth=1, exc="kill"))
+    try:
+        ck.flush()
+        raise AssertionError("kill did not fire")
+    except InjectedKill:
+        pass
+    faults.disarm()
+    ck.close(flush=True)        # writer was healthy: epoch 2 committed
+    _assert_newest_is(prefix, 2, ref2)
+    ok("kill@checkpoint.flush -> background commit still atomic")
+
+
+def leg_kill_at_sweep(tmp):
+    """A kill during the stale-checkpoint sweep deletes nothing it
+    should not: every committed checkpoint stays loadable."""
+    prefix = os.path.join(tmp, "sweep")
+    ref = _commit_baseline(prefix)
+    faults.arm(FaultPlan().arm("checkpoint.sweep", nth=1, exc="kill"))
+    try:
+        rckpt.sweep_stale_checkpoints(prefix, used=1)
+        raise AssertionError("kill did not fire")
+    except InjectedKill:
+        pass
+    faults.disarm()
+    _assert_newest_is(prefix, 1, ref)
+    ok("kill@checkpoint.sweep -> committed checkpoints survive")
+
+
+def leg_kill_at_resume_counter(tmp):
+    """A kill inside the crash-loop guard's resume-counter update (its
+    atomic write passes the checkpoint.write site) never tears the
+    counter file: a fresh guard reads a consistent state."""
+    path = os.path.join(tmp, "guard")
+    g = CrashLoopGuard(path, limit=3, sleep=lambda s: None)
+    assert g.on_resume(0, 0) in ("fresh", "retry")
+    faults.arm(FaultPlan().arm("checkpoint.write", nth=1, exc="kill"))
+    try:
+        g2 = CrashLoopGuard(path, limit=3, sleep=lambda s: None)
+        g2.on_resume(0, 0)
+        raise AssertionError("kill did not fire")
+    except InjectedKill:
+        pass
+    faults.disarm()
+    g3 = CrashLoopGuard(path, limit=3, sleep=lambda s: None)
+    assert g3.on_resume(0, 0) in ("fresh", "retry", "quarantine")
+    ok("kill@resume-counter update -> counter file never torn")
+
+
+def leg_async_writer_death_is_typed(tmp):
+    """The writer thread dying mid-commit surfaces as a typed
+    AsyncCheckpointError on the next call — and the checkpoint it was
+    writing is invisible to discovery."""
+    prefix = os.path.join(tmp, "wdeath")
+    ref = _commit_baseline(prefix)
+    rng = np.random.RandomState(4)
+    args = {"fc_weight": nd.array(rng.randn(3, 4).astype(np.float32)),
+            "fc_bias": nd.array(np.zeros(3, np.float32))}
+    ck = AsyncCheckpointer(name="chaos-wdeath")
+    faults.arm(FaultPlan().arm("checkpoint.write", nth=1, exc="kill",
+                               count=99))
+
+    def _commit():
+        rckpt.mark_inprogress(prefix, 2)
+        rckpt.write_checkpoint(prefix, 2, _symbol(), args, {})
+
+    ck.submit(2, _commit)
+    try:
+        ck.flush()
+        raise AssertionError("writer death was swallowed")
+    except AsyncCheckpointError as err:
+        assert isinstance(err.__cause__, InjectedKill)
+    faults.disarm()
+    ck.close(flush=False)
+    _assert_newest_is(prefix, 1, ref)
+    ok("async writer death -> typed AsyncCheckpointError, no torn load")
+
+
+def leg_reshard_bitwise(tmp):
+    """Acceptance: a 4-way sharded checkpoint restores bitwise onto 2
+    and onto 8."""
+    prefix = os.path.join(tmp, "reshard")
+    tree = _tree(7, rows=16, cols=6)
+    write_sharded_checkpoint(prefix, 5, tree, num_shards=4,
+                             plan_signature="plan-n4")
+    loaded = load_sharded_checkpoint(prefix)
+    assert loaded.epoch == 5 and loaded.num_shards == 4
+    assert loaded.plan_signature == "plan-n4"
+    for k, v in tree.items():
+        np.testing.assert_array_equal(loaded.tree[k], np.asarray(v),
+                                      err_msg=k)
+    for m in (2, 8):
+        got, meta = loaded.shards(m)
+        want, wmeta = split_tree(tree, m)
+        assert meta == wmeta
+        assert len(got) == m
+        for k in range(m):
+            assert set(got[k]) == set(want[k])
+            for key in got[k]:
+                assert got[k][key].tobytes() == want[k][key].tobytes(), \
+                    f"shard {k}/{m} key {key} not bitwise"
+    ok("sharded N=4 restores bitwise onto M=2 and M=8")
+
+
+def leg_async_fit_end_to_end(tmp):
+    """Module.fit(async_checkpoint=True) trains bitwise-identically to
+    the sync run, commits its checkpoints, and resumes from them."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(120, 10).astype(np.float32)
+    y = (np.arange(120) % 4).astype(np.float32)
+
+    def _mlp():
+        data = sym.Variable("data")
+        fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+        act = sym.Activation(fc1, name="relu1", act_type="relu")
+        fc2 = sym.FullyConnected(act, name="fc2", num_hidden=4)
+        return sym.SoftmaxOutput(fc2, name="softmax")
+
+    def _run(prefix=None, async_ckpt=None, epochs=2, resume=None):
+        np.random.seed(0)
+        mx.random.seed(0)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        kw = {}
+        if prefix:
+            kw["checkpoint_prefix"] = prefix
+        if async_ckpt is not None:
+            kw["async_checkpoint"] = async_ckpt
+        if resume:
+            kw["resume"] = resume
+        mod.fit(mx.io.NDArrayIter(X, y, batch_size=30), optimizer="adam",
+                optimizer_params={"learning_rate": 0.01},
+                initializer=mx.init.Xavier(), num_epoch=epochs, **kw)
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    sync_params = _run(prefix=os.path.join(tmp, "sync"))
+    apfx = os.path.join(tmp, "async")
+    async_params = _run(prefix=apfx, async_ckpt=True)
+    for k in sync_params:
+        np.testing.assert_array_equal(sync_params[k], async_params[k],
+                                      err_msg=k)
+    found = rckpt.find_checkpoints(apfx)
+    assert found and found[0] == 2, f"async fit committed {found}"
+    assert not rckpt.checkpoint_in_progress(apfx, 2), \
+        "committed async checkpoint still marked in-progress"
+    resumed = _run(prefix=apfx, async_ckpt=True, epochs=3, resume="auto")
+    assert set(resumed) == set(sync_params)
+    ok("async fit == sync fit bitwise; commits visible; resume works")
+
+
+LEGS = [leg_kill_at_snapshot, leg_kill_at_shard_write, leg_kill_at_commit,
+        leg_kill_at_flush, leg_kill_at_sweep, leg_kill_at_resume_counter,
+        leg_async_writer_death_is_typed, leg_reshard_bitwise,
+        leg_async_fit_end_to_end]
+
+
+def main():
+    faults.disarm()
+    with tempfile.TemporaryDirectory() as tmp:
+        for i, leg in enumerate(LEGS):
+            d = os.path.join(tmp, f"l{i}")
+            os.makedirs(d, exist_ok=True)
+            leg(d)
+    print(f"ckpt chaos: {len(PASS)}/{len(LEGS)} legs green")
+
+
+if __name__ == "__main__":
+    main()

@@ -166,6 +166,17 @@ register_knob("MXTPU_TENANT_QUOTAS", str, None,
               "weights: 'name:quota[:weight],...' (quota '*' = "
               "unbounded) or JSON {name: {quota, weight}} — unset "
               "disables quotas (docs/how_to/serving.md)")
+register_knob("MXTPU_ASYNC_CKPT", int, 0,
+              "write fit() checkpoints through the background "
+              "AsyncCheckpointer (resilience/async_checkpoint.py): the "
+              "step loop pays only a host snapshot and a single writer "
+              "thread commits atomically behind it; preemption flushes "
+              "the pending snapshot (docs/how_to/fault_tolerance.md)")
+register_knob("MXTPU_CKPT_FLUSH_TIMEOUT", float, 60.0,
+              "seconds AsyncCheckpointer.flush()/submit back-pressure "
+              "waits for the background writer before raising a typed "
+              "AsyncCheckpointError (bounds the preemption deadline "
+              "on a dead filesystem)")
 register_knob("MXTPU_FLEET_REPLICAS", int, 3,
               "default ACTIVE replica count of a serving FleetRouter "
               "(mxnet_tpu/serving/fleet.py, docs/how_to/fleet.md)")

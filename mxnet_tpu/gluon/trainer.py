@@ -202,25 +202,44 @@ class Trainer:
         return apply_fused_triples(self._fused_apply, opt, triples,
                                    lambda i: self._states[i])
 
-    def save_states(self, fname):
-        """Serialize optimizer states (reference trainer.py:save_states)."""
+    def save_states(self, fname, checkpointer=None):
+        """Serialize optimizer states (reference trainer.py:save_states).
+
+        Atomic (tmp + fsync + rename, ``checkpoint.write`` fault site):
+        a kill mid-save leaves the previous states file intact instead
+        of a torn pickle. The host snapshot is taken on the caller's
+        thread under the ``checkpoint.snapshot`` site; passing an
+        :class:`~mxnet_tpu.resilience.AsyncCheckpointer` as
+        ``checkpointer`` moves serialization + the atomic write onto
+        its background thread (flush to make it durable)."""
         import pickle
-        with open(fname, "wb") as f:
-            states = [
-                None if s is None else
-                (s.asnumpy() if hasattr(s, "asnumpy") else
-                 [x.asnumpy() if hasattr(x, "asnumpy") else x for x in s]
-                 if isinstance(s, (list, tuple)) else s)
-                for s in self._states]
-            pickle.dump({"states": states,
-                         "optimizer": self._optimizer.__class__.__name__},
-                        f)
+
+        from ..resilience import faults
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        faults.fault_point("checkpoint.snapshot")
+        states = [
+            None if s is None else
+            (s.asnumpy() if hasattr(s, "asnumpy") else
+             [x.asnumpy() if hasattr(x, "asnumpy") else x for x in s]
+             if isinstance(s, (list, tuple)) else s)
+            for s in self._states]
+        blob = {"states": states,
+                "optimizer": self._optimizer.__class__.__name__}
+
+        def _commit():
+            atomic_write_bytes(fname, pickle.dumps(blob))
+
+        if checkpointer is not None:
+            checkpointer.submit(fname, _commit)
+        else:
+            _commit()
 
     def load_states(self, fname):
         import pickle
-        from .. import ndarray
         with open(fname, "rb") as f:
             blob = pickle.load(f)
+        from .. import ndarray
         states = []
         for s in blob["states"]:
             if s is None:

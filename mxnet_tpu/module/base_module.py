@@ -312,7 +312,8 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint_prefix=None, checkpoint_period=1,
             checkpoint_batch_period=None, resume=None,
-            save_optimizer_states=True, supervisor=None):
+            save_optimizer_states=True, supervisor=None,
+            async_checkpoint=None):
         """reference: base_module.py:376 — the canonical Module training
         loop: bind → init params/optimizer → per-epoch train pass with
         lookahead prepare, then the optional validation pass.
@@ -339,7 +340,19 @@ class BaseModule:
         marker and raises :class:`~mxnet_tpu.resilience.Preempted`
         (typed exit code); a stalled step walks the retry → rebind →
         abort escalation ladder; repeated crashes at one (epoch, batch)
-        back off exponentially and eventually quarantine that batch."""
+        back off exponentially and eventually quarantine that batch.
+
+        ``async_checkpoint`` (default: the ``MXTPU_ASYNC_CKPT`` knob)
+        moves every fit checkpoint onto the background writer
+        (:class:`~mxnet_tpu.resilience.AsyncCheckpointer`,
+        docs/how_to/fault_tolerance.md): the loop pays only a host
+        snapshot; rolls and sweeps of superseded stems run post-commit
+        on the writer so the newest committed checkpoint is never
+        deleted ahead of its successor; a preemption *flushes* the
+        pending snapshot before the clean-exit marker; a failed
+        background write surfaces as a typed
+        :class:`~mxnet_tpu.resilience.AsyncCheckpointError` on the next
+        checkpoint."""
         assert num_epoch is not None, "please specify number of epochs"
 
         from ..resilience import supervisor as _sup_mod
@@ -495,13 +508,24 @@ class BaseModule:
                     # rewrite the newest good checkpoint, and the roll
                     # below would then remove the stem it just wrote
                     return label
+                prev = prev_mid[0]
+                # the roll of the superseded stem rides as post_commit:
+                # it runs only after the new manifest is on disk (sync
+                # or on the async writer), so the newest committed
+                # checkpoint is never deleted before its successor
+                # commits. An async-superseded snapshot skips its
+                # post_commit entirely — its predecessor then outlives
+                # one extra roll (GC'd by the epoch-end sweep or the
+                # resume-time sweep_stale_checkpoints), which is the
+                # safe direction.
                 self._write_fit_checkpoint(
                     checkpoint_prefix, label, save_optimizer_states,
                     iter_state=({"epoch": ep, "nbatch": nbatch + 1,
                                  "iterator": iter_snapshot}
-                                if iter_snapshot is not None else None))
-                if prev_mid[0] is not None:
-                    remove_checkpoint(checkpoint_prefix, prev_mid[0])
+                                if iter_snapshot is not None else None),
+                    post_commit=((lambda: remove_checkpoint(
+                        checkpoint_prefix, prev))
+                        if prev is not None else None))
                 prev_mid[0] = label
                 return label
 
@@ -515,8 +539,27 @@ class BaseModule:
                 "has no state_dict()", checkpoint_batch_period,
                 type(train_data).__name__)
 
+        if async_checkpoint is None:
+            from .. import config as _config
+            async_checkpoint = bool(_config.get("MXTPU_ASYNC_CKPT"))
+        actx = None
+        if async_checkpoint and checkpoint_prefix:
+            from ..resilience import AsyncCheckpointer
+            actx = AsyncCheckpointer(name="fit-ckpt-writer")
+            self._fit_async_ckpt = actx
+
+        def _finish_async():
+            # runs on every exit (success, Preempted, abort): surface a
+            # stored writer failure and stop the thread. The preempt /
+            # abort paths flushed already, so this is a no-op there and
+            # cannot mask their typed exception.
+            self._fit_async_ckpt = None
+            actx.close(flush=True)
+
         from contextlib import ExitStack
         with ExitStack() as _sup_stack:
+            if actx is not None:
+                _sup_stack.callback(_finish_async)
             if sup is not None:
                 _sup_stack.enter_context(sup.attach())
             self._fit_epochs(
@@ -610,14 +653,18 @@ class BaseModule:
                             "epoch-end iterator snapshot unavailable "
                             "(%s); checkpoint carries no iterator state",
                             err)
-                self._write_fit_checkpoint(checkpoint_prefix, epoch + 1,
-                                           save_optimizer_states,
-                                           iter_state=iter_state)
-                # this epoch-end checkpoint supersedes the epoch's
-                # mid-epoch stems: sweep them so they cannot outrank it
+                # the mid-epoch sweep rides as post_commit: the stems
+                # it deletes are superseded only once THIS checkpoint's
+                # manifest is on disk (ordering holds on the async
+                # writer too)
                 from ..resilience.checkpoint import \
                     clear_mid_epoch_checkpoints
-                clear_mid_epoch_checkpoints(checkpoint_prefix, epoch + 1)
+                self._write_fit_checkpoint(
+                    checkpoint_prefix, epoch + 1, save_optimizer_states,
+                    iter_state=iter_state,
+                    post_commit=(lambda _e=epoch + 1:
+                                 clear_mid_epoch_checkpoints(
+                                     checkpoint_prefix, _e)))
 
             if eval_data and not replayed_empty_tail:
                 for name, val in self.score(
@@ -631,11 +678,24 @@ class BaseModule:
                 train_data.reset()
 
     def _write_fit_checkpoint(self, prefix, epoch, save_optimizer_states,
-                              iter_state=None):
+                              iter_state=None, post_commit=None):
         """One checkpoint write for fit(): the module's own
         save_checkpoint when it has one (params + optimizer state +
         iterator state, all manifest-covered), else the params-only
-        model.save_checkpoint fallback."""
+        model.save_checkpoint fallback.
+
+        ``post_commit`` runs strictly after the checkpoint's manifest is
+        on disk (the roll of a superseded stem, the mid-epoch sweep) —
+        synchronously here, or on the writer thread when fit armed the
+        AsyncCheckpointer. That ordering is the safety invariant: the
+        previous good checkpoint is never deleted before its successor
+        is fully committed."""
+        actx = getattr(self, "_fit_async_ckpt", None)
+        if actx is not None:
+            self._submit_fit_checkpoint(
+                actx, prefix, epoch, save_optimizer_states,
+                iter_state=iter_state, post_commit=post_commit)
+            return
         if hasattr(self, "save_checkpoint"):
             self.save_checkpoint(prefix, epoch,
                                  save_optimizer_states=save_optimizer_states,
@@ -649,6 +709,55 @@ class BaseModule:
             from ..model import save_checkpoint as _save_ckpt
             _save_ckpt(prefix, epoch, self.symbol, *self.get_params(),
                        iter_state=iter_state)
+        if post_commit is not None:
+            post_commit()
+
+    def _submit_fit_checkpoint(self, actx, prefix, epoch,
+                               save_optimizer_states, iter_state=None,
+                               post_commit=None):
+        """Async variant of :meth:`_write_fit_checkpoint`: the caller's
+        thread pays only the host snapshot (params, optimizer bytes —
+        the ``checkpoint.snapshot`` fault site) plus an ``.inprogress``
+        marker, then hands serialization + the atomic commit to the
+        background writer. Until the writer lands the manifest the
+        marker keeps discovery/sweeps away from the stem; a superseded
+        snapshot (depth-1 back-pressure) never wrote files, so its
+        cleanup is just clearing that marker."""
+        from ..resilience import faults
+        from ..resilience.checkpoint import (clear_inprogress,
+                                             mark_inprogress)
+        faults.fault_point("checkpoint.snapshot")
+        states = None
+        if hasattr(self, "save_checkpoint"):
+            # mirror Module.save_checkpoint's host sync, then snapshot
+            self._sync_params_from_devices()
+            if save_optimizer_states:
+                states = self._optimizer_state_bytes()
+        elif save_optimizer_states:
+            self.logger.warning(
+                "%s has no save_checkpoint; checkpointing params only "
+                "(optimizer state will be reinitialized on resume)",
+                type(self).__name__)
+        from .. import ndarray as _nd
+        from ..resilience.async_checkpoint import _copy_tree
+        # get_params() hands back NDArrays whose device buffers the next
+        # fused (donating) step may invalidate — deep-copy to host NOW;
+        # the writer serializes only this decoupled snapshot
+        raw_args, raw_auxs = self.get_params()
+        args = {k: _nd.array(v) for k, v in _copy_tree(raw_args).items()}
+        auxs = {k: _nd.array(v) for k, v in _copy_tree(raw_auxs).items()}
+        symbol = self.symbol
+        mark_inprogress(prefix, epoch)
+
+        def _commit():
+            from ..model import save_checkpoint as _save_ckpt
+            _save_ckpt(prefix, epoch, symbol, args, auxs,
+                       states=states, iter_state=iter_state)
+            if post_commit is not None:
+                post_commit()
+
+        actx.submit(epoch, _commit,
+                    on_supersede=lambda: clear_inprogress(prefix, epoch))
 
     def _train_one_epoch(self, train_data, epoch, train_metric,
                          batch_end_callback, monitor, begin_batch=0,
@@ -727,6 +836,11 @@ class BaseModule:
                         return
                     mid_saver(epoch, max(_nb - 1, 0),
                               _ps if _nb > 0 else None)
+                    _actx = getattr(self, "_fit_async_ckpt", None)
+                    if _actx is not None:
+                        # the job is dying: the abort checkpoint must be
+                        # durable before the typed abort propagates
+                        _actx.flush()
 
                 sup.run_step(_one_step, rebind=rebind,
                              on_abort=_abort_ckpt,
@@ -754,8 +868,11 @@ class BaseModule:
                 label = None
                 if mid_saver is not None:
                     label = mid_saver(epoch, nbatch, state)
+                _actx = getattr(self, "_fit_async_ckpt", None)
                 sup.preempt_exit(marker_target, label=label, epoch=epoch,
-                                 nbatch=nbatch)
+                                 nbatch=nbatch,
+                                 flush=(_actx.flush if _actx is not None
+                                        else None))
             if state is not None:
                 prev_state = state
         return nseen

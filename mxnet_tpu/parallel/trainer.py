@@ -606,6 +606,73 @@ class SPMDTrainer:
         _ckpt.write_dir_manifest(path)
         return path
 
+    def _save_checkpoint_async(self, ckpt, directory, step=0, epoch=None,
+                               iter_state=None, post_commit=None,
+                               precious=False, supersede=None):
+        """Async variant of :meth:`save_checkpoint`: the step loop pays
+        only the device→host snapshot (``checkpoint.snapshot`` fault
+        site) plus a ``step_<N>.inprogress`` marker beside the target
+        dir; the orbax write + ``manifest.json`` commit run on ``ckpt``
+        (an :class:`~mxnet_tpu.resilience.AsyncCheckpointer`) behind it.
+        ``restore_latest`` skips marked-but-manifestless dirs, so a kill
+        anywhere before the commit is invisible to discovery.
+        ``post_commit`` (the roll of the superseded mid-epoch dir) runs
+        on the writer strictly after the manifest lands. A superseded
+        snapshot never wrote the dir — its cleanup is the marker alone.
+        Returns the target path (commit pending until flush)."""
+        import json
+        import os
+
+        from ..resilience import faults, guarded_call
+        from ..resilience import checkpoint as _ckpt
+
+        if self._step_fn is None:
+            raise MXNetError("bind() before save_checkpoint()")
+        base = os.path.abspath(directory)
+        path = os.path.join(base, f"step_{step}")
+        faults.fault_point("checkpoint.snapshot")
+        # host snapshot, decoupled from the donated training buffers:
+        # the next step may overwrite device memory freely
+        state = jax.device_get(self._ckpt_state())
+        state["meta"] = {"num_update": np.asarray(self._num_update, np.int64),
+                         "epoch": np.asarray(-1 if epoch is None else epoch,
+                                             np.int64),
+                         "rng": np.asarray(self._rng)}
+        os.makedirs(base, exist_ok=True)
+        marker = path + ".inprogress"
+        with open(marker, "w", encoding="utf-8") as f:
+            f.write('{"pid": %d}\n' % os.getpid())
+
+        def _commit():
+            import orbax.checkpoint as ocp
+
+            def _save():
+                with ocp.StandardCheckpointer() as ck:
+                    ck.save(path, state, force=True)
+
+            guarded_call("checkpoint.write", _save)
+            if iter_state is not None:
+                _ckpt.atomic_write_bytes(
+                    os.path.join(path, "iter_state.json"),
+                    json.dumps(iter_state, sort_keys=True).encode("utf-8"))
+            _ckpt.write_dir_manifest(path)
+            try:
+                os.remove(marker)
+            except OSError:
+                pass
+            if post_commit is not None:
+                post_commit()
+
+        def _superseded():
+            try:
+                os.remove(marker)
+            except OSError:
+                pass
+
+        ckpt.submit(step, _commit, on_supersede=_superseded,
+                    precious=precious, supersede=supersede)
+        return path
+
     def restore_checkpoint(self, directory, step=0):
         """Exact resume from save_checkpoint; call bind() first (the
         checkpoint restores onto the bound shardings). Verifies the
@@ -679,6 +746,14 @@ class SPMDTrainer:
         if os.path.isdir(base):
             for name in os.listdir(base):
                 if name.startswith("step_") and name[5:].isdigit():
+                    step_dir = os.path.join(base, name)
+                    if os.path.exists(step_dir + ".inprogress") \
+                            and not os.path.exists(os.path.join(
+                                step_dir, "manifest.json")):
+                        # an async writer was (or died) mid-commit here:
+                        # the dir is not a checkpoint yet, don't even
+                        # pay the failed-verification warning for it
+                        continue
                     steps.append(int(name[5:]))
         for step in sorted(steps, reverse=True):
             try:
@@ -736,7 +811,8 @@ class SPMDTrainer:
     def fit(self, train_data, num_epoch, checkpoint_dir=None,
             checkpoint_period=1, checkpoint_batch_period=None, resume=None,
             batch_end_callback=None, epoch_end_callback=None,
-            elastic=False, elastic_config=None, supervisor=None):
+            elastic=False, elastic_config=None, supervisor=None,
+            async_checkpoint=None):
         """Minimal epoch loop over a DataIter (call bind() first):
         each batch becomes one fused SPMD step. With ``checkpoint_dir``,
         a sharded checkpoint is written every ``checkpoint_period``
@@ -765,7 +841,17 @@ class SPMDTrainer:
         in-flight step, checkpoints (iterator state included) with a
         clean-exit marker and exits typed; a stalled step walks the
         retry → ``rebind_step()`` → elastic re-mesh → abort ladder;
-        crash loops at one (epoch, batch) back off and quarantine."""
+        crash loops at one (epoch, batch) back off and quarantine.
+
+        ``async_checkpoint`` (default: the ``MXTPU_ASYNC_CKPT`` knob)
+        moves every fit checkpoint onto a background writer: the step
+        loop pays only a device→host snapshot, and the orbax write +
+        manifest commit happen behind it with depth-1 back-pressure
+        (a newer mid-epoch snapshot supersedes an unstarted one).
+        Preemption, stall-abort, and epoch-boundary checkpoints flush
+        so they are durable before the run exits; a background write
+        failure surfaces as a typed ``AsyncCheckpointError`` on the
+        next checkpoint call (docs/how_to/fault_tolerance.md)."""
         if self._step_fn is None:
             raise MXNetError("call bind() before fit()")
         from ..resilience import supervisor as _sup_mod
@@ -863,8 +949,19 @@ class SPMDTrainer:
             # rung 3 of the stall ladder needs an elastic controller;
             # without one the ladder is retry → rebind → abort
             sup.can_remesh = controller is not None
+        if async_checkpoint is None:
+            from .. import config as _config
+            async_checkpoint = bool(_config.get("MXTPU_ASYNC_CKPT"))
+        actx = None
+        if async_checkpoint and checkpoint_dir:
+            from ..resilience import AsyncCheckpointer
+            actx = AsyncCheckpointer(name="spmd-ckpt-writer")
         from contextlib import ExitStack
         with ExitStack() as _sup_stack:
+            if actx is not None:
+                # every exit (success, Preempted, abort) surfaces a
+                # stored writer failure and stops the thread
+                _sup_stack.callback(actx.close, flush=True)
             if sup is not None:
                 _sup_stack.enter_context(sup.attach())
             if controller is None:
@@ -872,7 +969,7 @@ class SPMDTrainer:
                                  begin_batch, checkpoint_dir,
                                  checkpoint_period, bperiod, can_snapshot,
                                  cbs, epoch_end_callback, None, sup,
-                                 crash_guard)
+                                 crash_guard, actx)
                 return self
             from ..resilience.elastic import DeviceLost
             while True:
@@ -881,7 +978,7 @@ class SPMDTrainer:
                                      begin_batch, checkpoint_dir,
                                      checkpoint_period, bperiod,
                                      can_snapshot, cbs, epoch_end_callback,
-                                     controller, sup, crash_guard)
+                                     controller, sup, crash_guard, actx)
                     return self
                 except DeviceLost as err:
                     # a collective participant died mid-step (or a step
@@ -889,13 +986,27 @@ class SPMDTrainer:
                     # surfaces as DeviceLost too): the donated buffers
                     # are untrusted — re-mesh onto the survivors,
                     # restore the newest checkpoint, rewind the iterator
+                    if actx is not None:
+                        from ..resilience import AsyncCheckpointError
+                        try:
+                            # a pending snapshot predates the device
+                            # loss — commit it so recovery restores the
+                            # newest state instead of replaying to it
+                            actx.flush()
+                        except AsyncCheckpointError as werr:
+                            import logging
+                            logging.warning(
+                                "async checkpoint flush failed during "
+                                "device-loss recovery (%s); recovering "
+                                "from the last committed checkpoint",
+                                werr)
                     begin_epoch, begin_batch = controller.recover(
                         train_data, err)
 
     def _run_epochs(self, train_data, num_epoch, begin_epoch, begin_batch,
                     checkpoint_dir, checkpoint_period, bperiod,
                     can_snapshot, cbs, epoch_end_callback, controller,
-                    sup=None, crash_guard=None):
+                    sup=None, crash_guard=None, actx=None):
         from ..callback import BatchEndParam
         # NOTE: this mid-epoch checkpoint orchestration deliberately
         # parallels BaseModule.fit (module/base_module.py) — the trainer
@@ -939,6 +1050,12 @@ class SPMDTrainer:
                         # stalled batch itself replays on resume)
                         if not checkpoint_dir:
                             return
+                        if actx is not None:
+                            # drain the writer first: the manifest check
+                            # below is only meaningful once pending
+                            # snapshots committed, and the job is dying
+                            # — the abort checkpoint must be durable
+                            actx.flush()
                         import os
                         step_dir = os.path.join(
                             os.path.abspath(checkpoint_dir),
@@ -972,15 +1089,37 @@ class SPMDTrainer:
                         and (nbatch + 1) % bperiod == 0:
                     # state_dict() here is "about to fetch nbatch+1" —
                     # the exact resume point for this mid-epoch save
-                    path = self.save_checkpoint(
-                        checkpoint_dir, step=self._num_update, epoch=epoch,
-                        iter_state={"epoch": epoch, "nbatch": nbatch + 1,
-                                    "iterator": train_data.state_dict()})
+                    mid_iter = {"epoch": epoch, "nbatch": nbatch + 1,
+                                "iterator": train_data.state_dict()}
+                    if actx is not None:
+                        # the roll rides as post_commit on the writer:
+                        # the superseded dir is deleted only once this
+                        # save's manifest is on disk, so the newest
+                        # committed checkpoint always survives a kill
+                        import os
+                        target = os.path.join(
+                            os.path.abspath(checkpoint_dir),
+                            f"step_{self._num_update}")
+                        prev = prev_mid_path \
+                            if prev_mid_path != target else None
+                        path = self._save_checkpoint_async(
+                            actx, checkpoint_dir, step=self._num_update,
+                            epoch=epoch, iter_state=mid_iter,
+                            post_commit=(
+                                (lambda _p=prev: shutil.rmtree(
+                                    _p, ignore_errors=True))
+                                if prev is not None else None))
+                    else:
+                        path = self.save_checkpoint(
+                            checkpoint_dir, step=self._num_update,
+                            epoch=epoch, iter_state=mid_iter)
+                        # roll the superseded mid-epoch dir: a long epoch
+                        # holds at most one mid-epoch checkpoint on disk
+                        if prev_mid_path is not None \
+                                and prev_mid_path != path:
+                            shutil.rmtree(prev_mid_path,
+                                          ignore_errors=True)
                     last_mid_step = self._num_update
-                    # roll the superseded mid-epoch dir: a long epoch
-                    # holds at most one mid-epoch checkpoint on disk
-                    if prev_mid_path is not None and prev_mid_path != path:
-                        shutil.rmtree(prev_mid_path, ignore_errors=True)
                     prev_mid_path = path
                 if controller is not None:
                     # between steps the state is consistent: a detected
@@ -1000,6 +1139,12 @@ class SPMDTrainer:
                         cpath = controller.last_checkpoint_path
                         if cpath:
                             if prev_mid_path not in (None, cpath):
+                                if actx is not None:
+                                    # prev_mid_path may still be an
+                                    # uncommitted async submit — never
+                                    # rmtree a dir the writer may be
+                                    # mid-write in
+                                    actx.flush()
                                 shutil.rmtree(prev_mid_path,
                                               ignore_errors=True)
                             prev_mid_path = cpath
@@ -1027,6 +1172,13 @@ class SPMDTrainer:
                         # continues bitwise)
                         if checkpoint_dir:
                             import os
+                            if actx is not None:
+                                # drain first: a pending async submit
+                                # for this very step commits, making
+                                # the manifest check below truthful —
+                                # and the preemption checkpoint must be
+                                # durable before the typed exit anyway
+                                actx.flush()
                             step_dir = os.path.join(
                                 os.path.abspath(checkpoint_dir),
                                 f"step_{self._num_update}")
@@ -1046,7 +1198,9 @@ class SPMDTrainer:
                             prev_mid_path = step_dir
                         sup.preempt_exit(
                             checkpoint_dir, label=self._num_update,
-                            epoch=epoch, nbatch=nbatch)
+                            epoch=epoch, nbatch=nbatch,
+                            flush=(actx.flush if actx is not None
+                                   else None))
             # a mid-epoch resume whose checkpoint landed on the epoch's
             # last batch replays an empty tail: this epoch's end-of-epoch
             # callback and checkpoint already happened before the crash
@@ -1068,6 +1222,11 @@ class SPMDTrainer:
                     # must survive the next epoch's mid-epoch roll so
                     # per-epoch retention (rollback/model selection)
                     # keeps one checkpoint per epoch boundary.
+                    if actx is not None:
+                        # the promoted save may still be pending on the
+                        # writer, where epoch+1's first submit would
+                        # supersede (= never write) it — commit it now
+                        actx.flush()
                     prev_mid_path = None
                     continue
                 iter_state = None
@@ -1083,8 +1242,21 @@ class SPMDTrainer:
                         # checkpointing): epoch-granularity resume
                         # without iterator state, as before this PR
                         pass
-                self.save_checkpoint(checkpoint_dir, step=self._num_update,
-                                     epoch=epoch + 1, iter_state=iter_state)
+                if actx is not None:
+                    # epoch-boundary checkpoints are retention points:
+                    # precious (a later mid-epoch submit must never
+                    # supersede one away) and non-superseding (a still-
+                    # pending mid save commits first, so its post_commit
+                    # roll keeps its ordering guarantee)
+                    self._save_checkpoint_async(
+                        actx, checkpoint_dir, step=self._num_update,
+                        epoch=epoch + 1, iter_state=iter_state,
+                        precious=True, supersede=False)
+                else:
+                    self.save_checkpoint(checkpoint_dir,
+                                         step=self._num_update,
+                                         epoch=epoch + 1,
+                                         iter_state=iter_state)
 
     def _batch_dict(self, batch) -> Dict[str, np.ndarray]:
         """Map a DataBatch onto this trainer's data/label names."""

@@ -34,11 +34,19 @@ the SPMD port to "a dead process fails the collective for everyone"
 """
 from __future__ import annotations
 
-from . import checkpoint, data, elastic, faults, retry, supervisor  # noqa: F401,E501
-from .checkpoint import (AUTO, CheckpointCorrupt, RollbackRefused,  # noqa: F401,E501
+from . import (async_checkpoint, checkpoint, data, elastic, faults,  # noqa: F401,E501
+               retry, supervisor)
+from .async_checkpoint import (AsyncCheckpointer,  # noqa: F401
+                               AsyncCheckpointError, ShardedCheckpoint,
+                               assemble_shards, load_sharded_checkpoint,
+                               snapshot_tree, split_tree,
+                               write_sharded_checkpoint)
+from .checkpoint import (AUTO, CheckpointCorrupt,  # noqa: F401
+                         CheckpointInProgress, RollbackRefused,
                          atomic_output, atomic_write_bytes,
                          find_checkpoints, load_checkpoint_ex,
-                         model_version_info, require_newer_version,
+                         model_version_info, require_committed,
+                         require_newer_version,
                          verify_manifest, write_checkpoint)
 from .data import (DataBudgetExceeded, DataGuardPolicy,  # noqa: F401
                    RecordIter, ResilientIter, ShardSet, guard)
@@ -51,8 +59,13 @@ from .supervisor import (CrashLoopGuard, ImmediateAbort,  # noqa: F401
                          Preempted, SignalRuntime, StallAbort,
                          StallWatchdog, StepStalled, TrainingSupervisor)
 
-__all__ = ["checkpoint", "data", "elastic", "faults", "retry", "FaultPlan",
+__all__ = ["checkpoint", "async_checkpoint", "data", "elastic", "faults",
+           "retry", "FaultPlan",
+           "AsyncCheckpointer", "AsyncCheckpointError", "ShardedCheckpoint",
+           "snapshot_tree", "split_tree", "assemble_shards",
+           "write_sharded_checkpoint", "load_sharded_checkpoint",
            "RetryPolicy", "RetryExhausted", "CheckpointCorrupt",
+           "CheckpointInProgress", "require_committed",
            "RollbackRefused", "model_version_info", "require_newer_version",
            "InjectedFault", "InjectedTimeout", "InjectedKill", "fault_point",
            "guarded_call", "guarded_point", "default_policy", "stats",

@@ -1,0 +1,484 @@
+"""Asynchronous (snapshot-then-persist) and sharded checkpointing.
+
+Reference analogue: the reference's checkpoints block the fit loop for
+the whole serialize+fsync — checkpoint cost grows with model size
+exactly when frequent checkpoints matter most (preemptible TPU pools,
+crash-loop recovery). Here the step loop pays only a **host snapshot**
+(milliseconds: device arrays copied to host numpy) and returns to
+training; a single background writer thread serializes and atomically
+commits through the existing tmp+fsync+rename+manifest machinery
+(:mod:`.checkpoint`).
+
+Contract (docs/how_to/fault_tolerance.md, "Async & sharded
+checkpoints"):
+
+- **back-pressure, never interleave** — the writer holds at most ONE
+  queued snapshot. A new submit either *supersedes* the queued (not yet
+  started) predecessor or *waits* for it; a snapshot whose write is in
+  flight is always allowed to finish first. Two checkpoint writes never
+  interleave, so the on-disk commit order is the submit order.
+- **typed failure, never swallowed** — a failed background write is
+  stored and raised as :class:`AsyncCheckpointError` (cause chained)
+  from the NEXT ``submit()``/``flush()``/``close()`` call. Training
+  crashes on the next checkpoint attempt instead of silently running
+  uncheckpointed.
+- **flush** — ``flush()`` blocks until the pending snapshot is durably
+  committed (the supervisor's preemption path calls it so the final
+  checkpoint is near-instant: the snapshot already happened; only the
+  in-flight write remains).
+
+Sharded checkpoints (ZeRO/SPMD): each process writes only its own
+shard as ``<stem>.shard-K-of-N.params`` with a single manifest covering
+the full set plus the ``ShardingPlan`` signature; assembly +
+re-splitting (:func:`split_tree` / :func:`assemble_shards`) makes a
+checkpoint taken on N chips restore **bitwise** onto M
+(reshard-on-load — the missing half of elastic re-mesh).
+
+Fault sites: ``checkpoint.snapshot`` (host snapshot),
+``checkpoint.shard_write`` (per-shard file), ``checkpoint.commit``
+(manifest commit, in :func:`.checkpoint.write_manifest`),
+``checkpoint.flush`` (the flush barrier).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import faults, retry
+from .checkpoint import (AUTO, CheckpointCorrupt, atomic_output,
+                         atomic_write_bytes, checkpoint_paths,
+                         clear_inprogress, find_checkpoints, inprogress_path,
+                         manifest_path, mark_inprogress, verify_manifest,
+                         write_manifest, _stem)
+
+__all__ = ["AsyncCheckpointError", "AsyncCheckpointer", "snapshot_tree",
+           "split_tree", "assemble_shards", "shard_path",
+           "write_sharded_checkpoint", "load_sharded_checkpoint",
+           "ShardedCheckpoint"]
+
+
+class AsyncCheckpointError(RuntimeError):
+    """A background checkpoint write failed. Raised from the NEXT
+    ``submit()``/``flush()``/``close()`` call, with the writer thread's
+    exception chained as ``__cause__`` (an :class:`~.faults.InjectedKill`
+    there simulates the writer dying mid-commit: the checkpoint never
+    committed, discovery falls back to the last good one)."""
+
+
+def snapshot_tree(tree):
+    """Copy a (possibly nested dict/list/tuple) tree of arrays to host
+    numpy — the snapshot half of snapshot-then-persist. Device arrays
+    (jax) and NDArrays come back as independent host copies, so the
+    step loop may donate/overwrite the originals immediately; the
+    background writer serializes only this snapshot. Passes the
+    ``checkpoint.snapshot`` fault site once per call."""
+    faults.fault_point("checkpoint.snapshot")
+    return _copy_tree(tree)
+
+
+def _copy_tree(node):
+    if isinstance(node, dict):
+        return {k: _copy_tree(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return type(node)(_copy_tree(v) for v in node)
+    if node is None or isinstance(node, (bytes, str, int, float, bool)):
+        return node
+    if hasattr(node, "asnumpy"):            # NDArray
+        return np.array(node.asnumpy(), copy=True)
+    # jax.Array / np.ndarray / scalars — np.array pulls to host + copies
+    return np.array(node, copy=True)
+
+
+class _Job:
+    __slots__ = ("label", "fn", "on_supersede", "precious")
+
+    def __init__(self, label, fn, on_supersede=None, precious=False):
+        self.label = label
+        self.fn = fn
+        self.on_supersede = on_supersede
+        self.precious = precious
+
+
+class AsyncCheckpointer:
+    """Single background writer with a depth-1 queue.
+
+    All mutable state is guarded by one condition variable; the worker
+    takes exactly one job at a time, so commits are totally ordered and
+    never interleave. The writer thread is a daemon started lazily on
+    the first submit and shut down by :meth:`close`."""
+
+    def __init__(self, name: str = "ckpt-writer", supersede: bool = True,
+                 flush_timeout: Optional[float] = None):
+        from .. import config as _config
+        self.name = name
+        self._cond = threading.Condition()
+        # guarded by _cond: _pending, _busy, _busy_label, _error,
+        # _closed, _thread, _counts, _last_committed
+        self._pending: Optional[_Job] = None
+        self._busy = False
+        self._busy_label = None
+        self._error: Optional[Tuple[object, BaseException]] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._counts = {"submitted": 0, "committed": 0, "superseded": 0,
+                        "failed": 0}
+        self._last_committed = None
+        self._supersede_default = bool(supersede)
+        self._flush_timeout = float(
+            flush_timeout if flush_timeout is not None
+            else _config.get("MXTPU_CKPT_FLUSH_TIMEOUT"))
+
+    # -- caller side ---------------------------------------------------------
+
+    def submit(self, label, fn: Callable[[], None],
+               on_supersede: Optional[Callable[[], None]] = None,
+               supersede: Optional[bool] = None,
+               precious: bool = False):
+        """Queue ``fn`` (a no-arg commit callable over an already-taken
+        host snapshot) for the background writer. A stored failure from
+        an earlier write is raised HERE, before anything is queued.
+
+        If a predecessor is queued but not started: ``supersede=True``
+        (instance default) replaces it — its ``on_supersede`` runs (to
+        drop its in-progress marker) and its files are never written;
+        ``supersede=False`` waits for it instead. A ``precious``
+        predecessor (epoch-end / preemption checkpoint) is never
+        superseded, only waited for. A predecessor whose write is
+        already in flight always runs to completion first."""
+        if supersede is None:
+            supersede = self._supersede_default
+        superseded = None
+        with self._cond:
+            self._raise_pending_error_locked()
+            if self._closed:
+                raise AsyncCheckpointError(
+                    f"{self.name}: submit({label!r}) after close()")
+            self._ensure_thread_locked()
+            if self._pending is not None \
+                    and (not supersede or self._pending.precious):
+                self._wait_for_slot_locked()
+                self._raise_pending_error_locked()
+            if self._pending is not None:
+                superseded = self._pending
+                self._pending = None
+                self._counts["superseded"] += 1
+            self._pending = _Job(label, fn, on_supersede, precious)
+            self._counts["submitted"] += 1
+            self._cond.notify_all()
+        if superseded is not None and superseded.on_supersede is not None:
+            superseded.on_supersede()
+
+    def flush(self, timeout: Optional[float] = None):
+        """Block until the queued + in-flight writes are committed;
+        raise the stored :class:`AsyncCheckpointError` if one failed.
+        Returns the label of the last committed checkpoint (None if
+        nothing ever committed). Passes the ``checkpoint.flush`` fault
+        site. Times out (``MXTPU_CKPT_FLUSH_TIMEOUT``) rather than
+        wedging a preemption deadline on a dead filesystem."""
+        faults.fault_point("checkpoint.flush")
+        limit = self._flush_timeout if timeout is None else float(timeout)
+        deadline = time.monotonic() + limit
+        with self._cond:
+            while (self._pending is not None or self._busy) \
+                    and self._error is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    stuck = self._busy_label if self._busy \
+                        else self._pending.label
+                    raise AsyncCheckpointError(
+                        f"{self.name}: flush timed out after {limit:.1f}s "
+                        f"with checkpoint {stuck!r} still uncommitted")
+                self._cond.wait(remaining)
+            self._raise_pending_error_locked()
+            return self._last_committed
+
+    def close(self, flush: bool = True, timeout: Optional[float] = None):
+        """Stop the writer. ``flush=True`` (default) commits the pending
+        snapshot first and surfaces any stored failure; ``flush=False``
+        abandons the queued (not in-flight) job."""
+        if flush:
+            self.flush(timeout=timeout)
+        abandoned = None
+        with self._cond:
+            if not flush and self._pending is not None:
+                abandoned = self._pending
+                self._pending = None
+                self._counts["superseded"] += 1
+            self._closed = True
+            self._cond.notify_all()
+            thread = self._thread
+        if abandoned is not None and abandoned.on_supersede is not None:
+            abandoned.on_supersede()
+        if thread is not None:
+            thread.join(timeout=self._flush_timeout
+                        if timeout is None else timeout)
+
+    def last_committed(self):
+        """Label of the most recently committed checkpoint, or None."""
+        with self._cond:
+            return self._last_committed
+
+    def pending_label(self):
+        """Label of the queued-or-in-flight checkpoint, or None."""
+        with self._cond:
+            if self._pending is not None:
+                return self._pending.label
+            return self._busy_label if self._busy else None
+
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            return dict(self._counts)
+
+    # -- internals (all _locked helpers require self._cond held) -------------
+
+    def _raise_pending_error_locked(self):
+        if self._error is None:
+            return
+        label, err = self._error
+        self._error = None
+        raise AsyncCheckpointError(
+            f"{self.name}: background write of checkpoint {label!r} "
+            f"failed: {err!r}") from err
+
+    def _wait_for_slot_locked(self):
+        deadline = time.monotonic() + self._flush_timeout
+        while self._pending is not None and self._error is None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise AsyncCheckpointError(
+                    f"{self.name}: timed out waiting for checkpoint "
+                    f"{self._pending.label!r} to start committing")
+            self._cond.wait(remaining)
+
+    def _ensure_thread_locked(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name=self.name, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None:
+                    return              # closed and drained
+                job = self._pending
+                self._pending = None
+                self._busy = True
+                self._busy_label = job.label
+                self._cond.notify_all()
+            err = None
+            try:
+                job.fn()
+            except BaseException as e:  # noqa: BLE001 — an InjectedKill
+                # (BaseException) here simulates the WRITER dying
+                # mid-commit: it must not take the process down from a
+                # daemon thread, it must surface — typed — on the next
+                # checkpoint call, with the torn tmp/.inprogress state
+                # left for discovery to route around
+                err = e
+            with self._cond:
+                self._busy = False
+                self._busy_label = None
+                if err is None:
+                    self._counts["committed"] += 1
+                    self._last_committed = job.label
+                else:
+                    self._counts["failed"] += 1
+                    self._error = (job.label, err)
+                self._cond.notify_all()
+
+
+# -- sharded checkpoints -----------------------------------------------------
+
+def shard_path(prefix: str, epoch: Optional[int], k: int, n: int) -> str:
+    """Path of shard ``k`` of ``n`` for checkpoint ``(prefix, epoch)``:
+    ``<stem>.shard-K-of-N.params``."""
+    return _stem(prefix, epoch) + f".shard-{int(k)}-of-{int(n)}.params"
+
+
+def split_tree(tree: Dict[str, np.ndarray], num_shards: int):
+    """Deterministically split a flat ``{name: array}`` tree over
+    ``num_shards``: a leaf whose leading dimension divides evenly is
+    sliced along axis 0 (the ZeRO layout); everything else (scalars,
+    indivisible shapes) is *replicated* — stored once, in shard 0.
+    Returns ``(shards, meta)`` where ``shards`` is one dict per shard
+    and ``meta`` records which keys went which way. Splitting is pure
+    slicing, so ``assemble_shards(split_tree(t, n)) == t`` bitwise for
+    any n — the reshard-on-load guarantee."""
+    n = int(num_shards)
+    if n < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    shards: List[Dict[str, np.ndarray]] = [dict() for _ in range(n)]
+    sharded: List[str] = []
+    replicated: List[str] = []
+    for key in sorted(tree):
+        v = np.asarray(tree[key])
+        if n > 1 and v.ndim >= 1 and v.shape[0] >= n and v.shape[0] % n == 0:
+            sharded.append(key)
+            for i, piece in enumerate(np.split(v, n, axis=0)):
+                shards[i][key] = piece
+        else:
+            replicated.append(key)
+            shards[0][key] = v
+    return shards, {"sharded": sharded, "replicated": replicated}
+
+
+def assemble_shards(shards: List[Dict[str, np.ndarray]],
+                    meta: Dict[str, list]) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`split_tree`: concatenate the axis-0 slices,
+    take replicated leaves from shard 0."""
+    out: Dict[str, np.ndarray] = {}
+    for key in meta.get("sharded", ()):
+        missing = [i for i, s in enumerate(shards) if key not in s]
+        if missing:
+            raise CheckpointCorrupt(
+                f"sharded key {key!r} missing from shard(s) {missing}")
+        out[key] = np.concatenate([s[key] for s in shards], axis=0)
+    for key in meta.get("replicated", ()):
+        if key not in shards[0]:
+            raise CheckpointCorrupt(
+                f"replicated key {key!r} missing from shard 0")
+        out[key] = shards[0][key]
+    return out
+
+
+def write_sharded_checkpoint(prefix: str, epoch: Optional[int],
+                             tree: Dict[str, np.ndarray],
+                             num_shards: int,
+                             plan_signature: Optional[str] = None,
+                             step: Optional[int] = None,
+                             iter_state: Optional[dict] = None,
+                             extra: Optional[dict] = None) -> Dict[str, str]:
+    """Write one sharded checkpoint: ``num_shards`` files
+    ``<stem>.shard-K-of-N.params`` (each an .npz of its slice of the
+    flat ``tree`` — callers prefix keys ``arg:``/``aux:``/``state:``
+    like the single-file scheme) plus ONE manifest covering the full
+    set and recording the sharding layout + ``plan_signature`` (the
+    :meth:`ShardingPlan.signature_hash` the checkpoint was taken
+    under). The stem carries a ``.inprogress`` marker from first write
+    to manifest commit, so sweepers and discovery skip the set while
+    it is in flight. Fault sites: ``checkpoint.shard_write`` per shard,
+    ``checkpoint.commit`` at the manifest (inside
+    :func:`.checkpoint.write_manifest`)."""
+    import json
+    shards, meta = split_tree(tree, num_shards)
+    pol = retry.default_policy()
+    mark_inprogress(prefix, epoch)
+    files: Dict[str, str] = {}
+    for k, shard in enumerate(shards):
+        path = shard_path(prefix, epoch, k, num_shards)
+
+        def _write(_path=path, _shard=shard):
+            faults.fault_point("checkpoint.shard_write")
+            with atomic_output(_path) as tmp:
+                with open(tmp, "wb") as f:
+                    np.savez(f, **_shard)
+                    f.flush()
+                    os.fsync(f.fileno())
+
+        pol.call(_write, label="checkpoint.shard_write")
+        files[f"shard-{k}"] = path
+    if iter_state is not None:
+        ipath = checkpoint_paths(prefix, epoch)["iter"]
+        pol.call(atomic_write_bytes, ipath,
+                 json.dumps(iter_state, sort_keys=True).encode("utf-8"),
+                 label="checkpoint.write")
+        files["iter"] = ipath
+    doc_extra = {"sharding": {"num_shards": int(num_shards),
+                              "plan_signature": plan_signature,
+                              "sharded": meta["sharded"],
+                              "replicated": meta["replicated"]}}
+    if extra:
+        doc_extra.update(extra)
+    pol.call(write_manifest, prefix, epoch, files, step=step,
+             extra=doc_extra, label="checkpoint.write")
+    clear_inprogress(prefix, epoch)
+    logging.info("Saved sharded checkpoint (%d shards) to \"%s\"",
+                 num_shards, _stem(prefix, epoch))
+    return files
+
+
+class ShardedCheckpoint:
+    """An assembled sharded checkpoint: the full flat ``tree`` plus the
+    layout it was written under. ``shards(m)`` re-splits onto ``m``
+    processes — bitwise identical to having checkpointed on ``m``."""
+
+    def __init__(self, epoch, tree: Dict[str, np.ndarray],
+                 num_shards: int, plan_signature: Optional[str],
+                 manifest: dict):
+        self.epoch = epoch
+        self.tree = tree
+        self.num_shards = num_shards
+        self.plan_signature = plan_signature
+        self.manifest = manifest
+
+    def shards(self, num_shards: int):
+        """Re-split onto ``num_shards`` (reshard-on-load): returns
+        ``(per_shard_trees, meta)``."""
+        return split_tree(self.tree, num_shards)
+
+    def shard(self, k: int, num_shards: int) -> Dict[str, np.ndarray]:
+        """Process ``k``'s slice under an ``num_shards``-way layout."""
+        return self.shards(num_shards)[0][int(k)]
+
+
+def read_shard_files(prefix: str, epoch, doc: dict):
+    """Read + assemble the shard set a verified manifest describes.
+    Returns the flat host tree."""
+    sharding = doc.get("sharding") or {}
+    n = int(sharding.get("num_shards", 0))
+    if n < 1:
+        raise CheckpointCorrupt(
+            f"{manifest_path(prefix, epoch)}: manifest carries no usable "
+            "sharding layout")
+    shards: List[Dict[str, np.ndarray]] = []
+    pol = retry.default_policy()
+    for k in range(n):
+        path = shard_path(prefix, epoch, k, n)
+
+        def _read(_path=path):
+            faults.fault_point("checkpoint.read")
+            with np.load(_path, allow_pickle=False) as z:
+                return {key: z[key] for key in z.files}
+
+        try:
+            shards.append(pol.call(_read, label="checkpoint.read"))
+        except (OSError, ValueError) as err:
+            raise CheckpointCorrupt(
+                f"shard {k}-of-{n} at {path} unreadable: {err}") from err
+    return assemble_shards(shards, sharding)
+
+
+def load_sharded_checkpoint(prefix: str, epoch=AUTO,
+                            verify: bool = True) -> ShardedCheckpoint:
+    """Load a sharded checkpoint (manifest-verified) and assemble the
+    full tree regardless of how many processes wrote it — then
+    :meth:`ShardedCheckpoint.shards` re-splits it for the *current*
+    world size. ``epoch=AUTO`` discovers the newest committed set."""
+    if epoch is AUTO or epoch == AUTO:
+        found = [e for e in find_checkpoints(prefix)
+                 if os.path.exists(manifest_path(prefix, e))]
+        if not found:
+            raise FileNotFoundError(
+                f"no sharded checkpoint found at prefix {prefix!r}")
+        epoch = found[0]
+    doc = verify_manifest(prefix, epoch) if verify else None
+    if doc is None:
+        import json
+        with open(manifest_path(prefix, epoch), "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    if not doc.get("sharding"):
+        raise CheckpointCorrupt(
+            f"{_stem(prefix, epoch)} is not a sharded checkpoint "
+            "(manifest has no 'sharding' section); use load_checkpoint_ex")
+    tree = read_shard_files(prefix, epoch, doc)
+    sh = doc["sharding"]
+    return ShardedCheckpoint(epoch, tree, int(sh["num_shards"]),
+                             sh.get("plan_signature"), doc)

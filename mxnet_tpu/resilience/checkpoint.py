@@ -29,8 +29,8 @@ from typing import Dict, List, Optional
 
 from . import faults, retry
 
-__all__ = ["CheckpointCorrupt", "RollbackRefused", "atomic_output",
-           "atomic_write_bytes",
+__all__ = ["CheckpointCorrupt", "CheckpointInProgress", "RollbackRefused",
+           "atomic_output", "atomic_write_bytes",
            "write_bytes_guarded", "read_bytes_guarded",
            "file_digest", "write_manifest", "verify_manifest",
            "write_dir_manifest", "verify_dir_manifest",
@@ -39,6 +39,8 @@ __all__ = ["CheckpointCorrupt", "RollbackRefused", "atomic_output",
            "model_version_info", "require_newer_version",
            "mid_epoch_label", "epoch_of_label", "remove_checkpoint",
            "clear_mid_epoch_checkpoints", "sweep_stale_checkpoints",
+           "inprogress_path", "mark_inprogress", "clear_inprogress",
+           "checkpoint_in_progress", "require_committed",
            "MID_EPOCH_STRIDE", "MANIFEST_VERSION"]
 
 MANIFEST_VERSION = 1
@@ -47,6 +49,14 @@ MANIFEST_VERSION = 1
 class CheckpointCorrupt(RuntimeError):
     """A checkpoint failed manifest verification (missing file, size or
     digest mismatch, unreadable manifest)."""
+
+
+class CheckpointInProgress(RuntimeError):
+    """A checkpoint set still carries its ``.inprogress`` marker: a
+    writer is (or died) mid-commit. Consumers that would *promote* the
+    set (the serving fleet's rolling reload) must refuse it — a torn
+    or still-changing set is not a model generation
+    (:func:`require_committed`)."""
 
 
 class RollbackRefused(RuntimeError):
@@ -133,6 +143,58 @@ def manifest_path(prefix: str, epoch: Optional[int]) -> str:
     return _stem(prefix, epoch) + ".manifest.json"
 
 
+# -- in-progress markers -----------------------------------------------------
+# A writer marks the stem BEFORE its first file write and clears the
+# marker AFTER the manifest commit. The marker is deliberately a plain
+# (non-atomic) write: it only ever means "do not trust / do not sweep
+# this stem right now", and a crash that leaves it behind keeps the
+# torn set quarantined — exactly right. Sweepers skip marked stems
+# (the concurrent-writer fix: never GC a checkpoint mid-commit),
+# discovery skips marked stems without a manifest (uncommitted), and
+# the fleet's rolling reload refuses marked sets outright.
+
+def inprogress_path(prefix: str, epoch=None) -> str:
+    return _stem(prefix, epoch) + ".inprogress"
+
+
+def mark_inprogress(prefix: str, epoch=None) -> str:
+    path = inprogress_path(prefix, epoch)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"pid": %d}\n' % os.getpid())
+    return path
+
+
+def clear_inprogress(prefix: str, epoch=None):
+    try:
+        os.remove(inprogress_path(prefix, epoch))
+    except OSError:
+        pass
+
+
+def checkpoint_in_progress(source, epoch=None) -> bool:
+    """Whether ``source`` (a checkpoint *stem* target: a prefix+epoch
+    pair, a ``*.manifest.json`` path, or a directory checkpoint like an
+    orbax ``step_<N>`` dir) carries an ``.inprogress`` marker."""
+    path = os.fspath(source)
+    if os.path.isdir(path):
+        return os.path.exists(path.rstrip(os.sep) + ".inprogress")
+    if path.endswith(".manifest.json"):
+        return os.path.exists(path[:-len(".manifest.json")] + ".inprogress")
+    return os.path.exists(inprogress_path(path, epoch))
+
+
+def require_committed(source, epoch=None, what: str = "checkpoint"):
+    """Raise :class:`CheckpointInProgress` when ``source`` is marked
+    in-progress — the promotion gate the serving fleet's rolling reload
+    runs before trusting a manifest (docs/how_to/fleet.md)."""
+    if checkpoint_in_progress(source, epoch):
+        raise CheckpointInProgress(
+            f"refusing to promote {what} at {os.fspath(source)!r}: its "
+            ".inprogress marker is still present — the writer is "
+            "mid-commit (or died there); wait for the manifest commit "
+            "or clean up the torn set first")
+
+
 def checkpoint_paths(prefix: str, epoch: Optional[int]) -> Dict[str, str]:
     stem = _stem(prefix, epoch)
     return {"params": stem + ".params", "states": stem + ".states",
@@ -161,6 +223,12 @@ def write_manifest(prefix: str, epoch: Optional[int], files: Dict[str, str],
     if extra:
         doc.update(extra)
     path = manifest_path(prefix, epoch)
+    # the commit point: every file of the set is durable, and this
+    # rename is what makes the set discoverable/loadable. A kill here
+    # (checkpoint.commit armed) leaves the data files + .inprogress
+    # marker but NO manifest — discovery treats that as torn and falls
+    # back to the last committed checkpoint.
+    faults.fault_point("checkpoint.commit")
     atomic_write_bytes(path, json.dumps(doc, indent=1, sort_keys=True)
                        .encode("utf-8"))
     return path
@@ -213,6 +281,9 @@ def write_dir_manifest(path: str, extra: Optional[dict] = None) -> str:
     if extra:
         doc.update(extra)
     mpath = os.path.join(path, "manifest.json")
+    # same commit point as write_manifest: the dir manifest is what
+    # makes an orbax/sharded dir checkpoint trusted by restore_latest
+    faults.fault_point("checkpoint.commit")
     atomic_write_bytes(mpath, json.dumps(doc, indent=1, sort_keys=True)
                        .encode("utf-8"))
     return mpath
@@ -265,6 +336,10 @@ def write_checkpoint(prefix: str, epoch: Optional[int], symbol,
     paths = checkpoint_paths(prefix, epoch)
     pol = retry.default_policy()
     files = {}
+    # marked from first write to manifest commit: a concurrent sweeper
+    # must not GC this stem mid-commit, and discovery must not trust a
+    # manifest-less set the writer is still (or died) assembling
+    mark_inprogress(prefix, epoch)
 
     def _write_symbol():
         with atomic_output(paths["symbol"]) as tmp:
@@ -303,11 +378,15 @@ def write_checkpoint(prefix: str, epoch: Optional[int], symbol,
                  "model_uid": str(model_uid)}
     pol.call(write_manifest, prefix, epoch, files, step=step, extra=extra,
              digests=digests, label="checkpoint.write")
+    clear_inprogress(prefix, epoch)
     logging.info("Saved checkpoint to \"%s\"", paths["params"])
     return paths
 
 
 _EPOCH_RE = re.compile(r"-(\d{4,})\.params$")
+# sharded sets are discovered by their shard-0 file (one entry per stem)
+_SHARD0_RE = re.compile(r"-(\d{4,})\.shard-0-of-\d+\.params$")
+_SHARD0_EPOCHLESS_RE = re.compile(r"^\.shard-0-of-\d+\.params$")
 
 
 def find_checkpoints(prefix: str) -> List[Optional[int]]:
@@ -322,6 +401,7 @@ def find_checkpoints(prefix: str) -> List[Optional[int]]:
     base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
     base = os.path.basename(prefix)
     found = []
+    seen = set()
     try:
         names = os.listdir(base_dir)
     except (FileNotFoundError, NotADirectoryError):
@@ -330,13 +410,22 @@ def find_checkpoints(prefix: str) -> List[Optional[int]]:
         if not name.startswith(base) or not name.endswith(".params"):
             continue
         rest = name[len(base):]
-        if rest == ".params":
+        if rest == ".params" or _SHARD0_EPOCHLESS_RE.match(rest):
             epoch = None
         else:
-            m = _EPOCH_RE.match(rest)
+            m = _EPOCH_RE.match(rest) or _SHARD0_RE.match(rest)
             if not m:
                 continue
             epoch = int(m.group(1))
+        if epoch in seen:
+            continue            # e.g. a stem's shard-0 AND .params file
+        if os.path.exists(inprogress_path(prefix, epoch)) \
+                and not os.path.exists(manifest_path(prefix, epoch)):
+            # uncommitted: a writer is (or died) mid-commit on this
+            # stem — it is not a checkpoint yet, and a load attempt
+            # would misread the torn set as corrupt-with-fallback noise
+            continue
+        seen.add(epoch)
         st = os.stat(os.path.join(base_dir, name))
         found.append((_order_key(epoch), st.st_mtime_ns, epoch))
     found.sort(key=lambda t: (t[0], t[1]), reverse=True)
@@ -397,12 +486,17 @@ def epoch_of_label(label: int) -> int:
 
 def remove_checkpoint(prefix: str, epoch) -> None:
     """Best-effort removal of one checkpoint's files (params/states/
-    iter/manifest; the symbol file is shared across the prefix). Used
-    to roll superseded mid-epoch checkpoints so a long epoch holds at
-    most one on disk."""
-    for role, path in checkpoint_paths(prefix, epoch).items():
-        if role == "symbol":
-            continue
+    iter/manifest, any ``.shard-K-of-N.params`` set, and a stale
+    ``.inprogress`` marker; the symbol file is shared across the
+    prefix). Used to roll superseded mid-epoch checkpoints so a long
+    epoch holds at most one on disk."""
+    import glob
+    stem = _stem(prefix, epoch)
+    targets = [p for role, p in checkpoint_paths(prefix, epoch).items()
+               if role != "symbol"]
+    targets += glob.glob(glob.escape(stem) + ".shard-*-of-*.params")
+    targets.append(inprogress_path(prefix, epoch))
+    for path in targets:
         try:
             os.remove(path)
         except OSError:
@@ -419,6 +513,8 @@ def clear_mid_epoch_checkpoints(prefix: str, completed_epoch: int):
     for ep in find_checkpoints(prefix):
         if ep is None or ep < MID_EPOCH_STRIDE or ep >= bound:
             continue
+        if os.path.exists(inprogress_path(prefix, ep)):
+            continue            # a concurrent writer is mid-commit here
         remove_checkpoint(prefix, ep)
 
 
@@ -439,7 +535,17 @@ def sweep_stale_checkpoints(prefix: str, used=None) -> int:
     ``auto`` resume that *fell back* past a corrupt newest stem must
     keep the evidence); ``None`` bounds by the newest stem present.
     Failures are non-fatal, like :func:`clear_mid_epoch_checkpoints`:
-    a stale stem is redundant, not wrong."""
+    a stale stem is redundant, not wrong.
+
+    A stem carrying an ``.inprogress`` marker is skipped outright: a
+    concurrent (async) writer is mid-commit there, and deleting files
+    under its rename would tear the very checkpoint being written.
+    (``find_checkpoints`` already excludes *uncommitted* marked stems,
+    so they can neither be swept nor set the bound; a marked stem WITH
+    a manifest — writer died between commit and marker removal — is
+    committed and loadable, but still not swept until a later pass
+    finds the marker gone or the stem superseded-and-unmarked.)"""
+    faults.fault_point("checkpoint.sweep")
     candidates = find_checkpoints(prefix)
     if not candidates:
         return 0
@@ -450,6 +556,8 @@ def sweep_stale_checkpoints(prefix: str, used=None) -> int:
     removed = 0
     for ep in candidates:
         if ep is None or ep < MID_EPOCH_STRIDE or ep == bound_label:
+            continue
+        if os.path.exists(inprogress_path(prefix, ep)):
             continue
         if _order_key(ep) < bound:
             remove_checkpoint(prefix, ep)
@@ -464,7 +572,11 @@ def sweep_stale_checkpoints(prefix: str, used=None) -> int:
 def load_checkpoint_ex(prefix: str, epoch=AUTO, allow_fallback: bool = True,
                        verify: bool = True):
     """Load a verified checkpoint; returns ``(epoch_used, symbol,
-    arg_params, aux_params, states_path_or_None)``.
+    arg_params, aux_params, states_path_or_None)``. A *sharded* stem
+    (``<stem>.shard-K-of-N.params``, :mod:`.async_checkpoint`) is
+    assembled to the full tree regardless of N — reshard-on-load — and
+    its optimizer state comes back as a ``{name: ndarray}`` dict rather
+    than a ``.states`` path.
 
     ``epoch`` is an int (epoch-numbered scheme), ``None`` (the epoch-less
     ``prefix.params`` scheme), or :data:`AUTO` to discover the newest
@@ -518,6 +630,30 @@ def load_checkpoint_ex(prefix: str, epoch=AUTO, allow_fallback: bool = True,
             symbol = None
             if os.path.exists(paths["symbol"]):
                 symbol = sym.load(paths["symbol"])
+            if doc is not None and doc.get("sharding"):
+                # sharded set: assemble the full tree from every shard
+                # file the (verified) manifest records — reshard-on-load
+                # is then the caller re-splitting for its own world
+                # size. Optimizer state travels as arrays ("state:"
+                # keys), returned as a dict instead of a .states path.
+                from .async_checkpoint import read_shard_files
+                tree = read_shard_files(prefix, ep, doc)
+                arg_params, aux_params, state_tree = {}, {}, {}
+                for k, v in tree.items():
+                    tp, _, name = k.partition(":")
+                    if tp == "arg":
+                        arg_params[name] = nd.array(v)
+                    elif tp == "aux":
+                        aux_params[name] = nd.array(v)
+                    elif tp == "state":
+                        state_tree[name] = v
+                if i > 0:
+                    logging.warning(
+                        "checkpoint %s was corrupt or missing; fell back "
+                        "to last good checkpoint %s",
+                        _stem(prefix, ordered[0]), _stem(prefix, ep))
+                return ep, symbol, arg_params, aux_params, \
+                    (state_tree or None)
             pname = paths["params"]
             if not os.path.exists(pname) and os.path.exists(pname + ".npz"):
                 pname += ".npz"

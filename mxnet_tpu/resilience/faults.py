@@ -68,7 +68,15 @@ SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          # one seeded replica (the MeshHealth pattern at fleet scope), a
          # fault at fleet.dispatch kills the replica whose forward it
          # was, mid-burst
-         "fleet.probe", "fleet.dispatch")
+         "fleet.probe", "fleet.dispatch",
+         # async + sharded checkpointing (resilience/async_checkpoint.py,
+         # docs/how_to/fault_tolerance.md): the host snapshot, each
+         # per-shard file write, the manifest commit rename, the flush
+         # barrier the preemption path waits on, and the stale-stem
+         # sweeper — a kill at any of these must leave the last
+         # committed checkpoint discoverable and loadable
+         "checkpoint.snapshot", "checkpoint.shard_write",
+         "checkpoint.commit", "checkpoint.flush", "checkpoint.sweep")
 
 ENV_PLAN = "MXNET_TPU_FAULT_PLAN"
 ENV_SEED = "MXNET_TPU_FAULT_SEED"

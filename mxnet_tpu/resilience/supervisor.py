@@ -716,10 +716,19 @@ class TrainingSupervisor:
         return _Attached(self)
 
     def preempt_exit(self, marker_target: Optional[str], *, label=None,
-                     epoch=None, nbatch=None, extra: Optional[dict] = None):
+                     epoch=None, nbatch=None, extra: Optional[dict] = None,
+                     flush: Optional[Callable[[], object]] = None):
         """Finish a graceful preemption: write the clean-exit marker
         beside the checkpoint and raise :class:`Preempted`. The caller
-        has already written the checkpoint itself."""
+        has already written (or, async, *submitted*) the checkpoint
+        itself; ``flush`` — an :meth:`~mxnet_tpu.resilience.
+        AsyncCheckpointer.flush` bound method when async checkpointing
+        is armed — runs FIRST, so the clean-exit marker is only written
+        once the final snapshot is durably committed. A flush failure
+        (typed AsyncCheckpointError) propagates instead of the marker
+        lying about a checkpoint that never landed."""
+        if flush is not None:
+            flush()
         _count("preempt_exits")
         if marker_target:
             from .checkpoint import atomic_write_bytes

@@ -873,7 +873,16 @@ class FleetRouter:
         gate refuses a non-newer generation without
         ``force_rollback=True``
         (:class:`~mxnet_tpu.resilience.RollbackRefused`). Returns the
-        promoted version."""
+        promoted version. A path-like ``source`` whose checkpoint set is
+        still marked ``.inprogress`` (an async/sharded writer mid-commit,
+        or dead there) is refused with
+        :class:`~mxnet_tpu.resilience.CheckpointInProgress` before any
+        replica is touched — a rolling reload must never spawn half a
+        checkpoint."""
+        if source is not None and not isinstance(source,
+                                                 (int, tuple, dict)):
+            from ..resilience.checkpoint import require_committed
+            require_committed(source, what=f"fleet {self.name!r} model")
         version, uid = self._resolve_model(source)
         require_newer_version(self.model_version, version,
                               force_rollback=force_rollback,

@@ -1,0 +1,714 @@
+"""Async (snapshot-then-persist) + sharded checkpointing
+(mxnet_tpu/resilience/async_checkpoint.py).
+
+Proves the crash-consistency contract at unit granularity — the
+kill-matrix chaos smoke (ci/ckpt_chaos.py) re-proves it end-to-end:
+
+- AsyncCheckpointer: depth-1 back-pressure (supersede-or-wait),
+  precious jobs, typed AsyncCheckpointError on the NEXT call after a
+  background failure, bounded flush.
+- Sharded checkpoints: one manifest per set, reshard-on-load bitwise
+  for any N -> M, torn sets invisible to discovery.
+- The ``.inprogress`` marker protocol: discovery, the sweeper and the
+  fleet's rolling reload all refuse a stem mid-commit.
+
+Registry-consistency contract: the fault sites ``checkpoint.snapshot``,
+``checkpoint.shard_write``, ``checkpoint.commit``, ``checkpoint.flush``
+and ``checkpoint.sweep`` are armed here (tpu-lint's registry checker
+pins SITES <-> tests <-> docs).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, resilience, sym
+from mxnet_tpu.resilience import (AsyncCheckpointer, AsyncCheckpointError,
+                                  CheckpointCorrupt, CheckpointInProgress,
+                                  CrashLoopGuard, FaultPlan, InjectedFault,
+                                  InjectedKill, checkpoint as rckpt, faults)
+from mxnet_tpu.resilience.async_checkpoint import (assemble_shards,
+                                                   load_sharded_checkpoint,
+                                                   shard_path, snapshot_tree,
+                                                   split_tree,
+                                                   write_sharded_checkpoint)
+from mxnet_tpu.resilience.supervisor import (Preempted, TrainingSupervisor,
+                                             preempt_marker_path)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts disarmed with fresh counters."""
+    faults.disarm()
+    resilience.reset_stats()
+    yield
+    faults.disarm()
+    resilience.reset_stats()
+
+
+def _tree(seed=0, rows=8, cols=6):
+    rng = np.random.RandomState(seed)
+    return {"arg:w": rng.randn(rows, cols).astype(np.float32),
+            "arg:b": rng.randn(cols).astype(np.float32),
+            "state:step": np.int64(seed * 100)}
+
+
+def _net():
+    return sym.FullyConnected(sym.Variable("data"), name="fc", num_hidden=3)
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return ({"fc_weight": nd.array(rng.randn(3, 4).astype(np.float32)),
+             "fc_bias": nd.array(np.zeros(3, np.float32))}, {})
+
+
+def _blocked_writer(**kw):
+    """An AsyncCheckpointer whose first job parks on an Event — the
+    deterministic way to get a job *in flight* while more are queued."""
+    ck = AsyncCheckpointer(name="t-blocked", **kw)
+    release = threading.Event()
+    started = threading.Event()
+    done = []
+
+    def _job():
+        started.set()
+        assert release.wait(10.0), "test writer never released"
+        done.append("blocked")
+
+    ck.submit("blocked", _job)
+    assert started.wait(10.0), "writer thread never started the job"
+    return ck, release, done
+
+
+def _drain(ck, release, timeout=10.0):
+    release.set()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = ck.stats()
+        if st["committed"] + st["failed"] + st["superseded"] \
+                >= st["submitted"]:
+            return
+        time.sleep(0.01)
+
+
+# -- AsyncCheckpointer: ordering, back-pressure, typed failure ---------------
+
+def test_commit_order_is_submit_order():
+    order = []
+    ck = AsyncCheckpointer(name="t-order")
+    for label in (1, 2, 3):
+        ck.submit(label, lambda _l=label: order.append(_l),
+                  supersede=False)
+    assert ck.flush() == 3
+    ck.close()
+    assert order == [1, 2, 3]
+    assert ck.last_committed() == 3
+    st = ck.stats()
+    assert st["submitted"] == 3 and st["committed"] == 3
+    assert st["superseded"] == 0 and st["failed"] == 0
+
+
+def test_supersede_replaces_queued_job_and_runs_its_cleanup():
+    ck, release, done = _blocked_writer()
+    dropped = []
+    ran = []
+    # "blocked" is IN FLIGHT, so "old" queues behind it...
+    ck.submit("old", lambda: ran.append("old"),
+              on_supersede=lambda: dropped.append("old"))
+    # ...and "new" supersedes "old" before a single byte of it is written
+    ck.submit("new", lambda: ran.append("new"))
+    assert dropped == ["old"], "superseded job's cleanup did not run"
+    _drain(ck, release)
+    assert ck.flush() == "new"
+    ck.close()
+    assert ran == ["new"], "a superseded job must never write"
+    assert done == ["blocked"], "the in-flight job must finish first"
+    assert ck.stats()["superseded"] == 1
+
+
+def test_in_flight_job_is_never_superseded():
+    ck, release, done = _blocked_writer()
+    ck.submit("next", lambda: None)     # supersede=True default
+    assert ck.stats()["superseded"] == 0
+    # the blocked job is busy, not queued — it always runs to completion
+    _drain(ck, release)
+    ck.close()
+    assert done == ["blocked"]
+
+
+def test_supersede_false_waits_for_the_queued_predecessor():
+    ck, release, done = _blocked_writer()
+    order = []
+    ck.submit("mid", lambda: order.append("mid"))
+    # release the writer shortly; submit(supersede=False) must WAIT for
+    # "mid" to start, not replace it
+    t = threading.Timer(0.05, release.set)
+    t.start()
+    ck.submit("end", lambda: order.append("end"), supersede=False)
+    ck.flush()
+    ck.close()
+    t.cancel()
+    assert order == ["mid", "end"]
+    assert ck.stats()["superseded"] == 0
+
+
+def test_precious_predecessor_is_waited_for_not_superseded():
+    ck, release, done = _blocked_writer(flush_timeout=0.2)
+    order = []
+    ck.submit("epoch-end", lambda: order.append("epoch-end"), precious=True)
+    # the default-supersede submit may not displace a precious job: with
+    # the writer still parked it times out waiting instead
+    with pytest.raises(AsyncCheckpointError, match="timed out waiting"):
+        ck.submit("mid", lambda: order.append("mid"))
+    _drain(ck, release)
+    ck.close()
+    assert order == ["epoch-end"]
+    assert ck.stats()["superseded"] == 0
+
+
+def test_background_failure_is_typed_raised_on_next_call_then_cleared():
+    ck = AsyncCheckpointer(name="t-fail")
+
+    def _boom():
+        raise ValueError("disk on fire")
+
+    ck.submit(7, _boom)
+    with pytest.raises(AsyncCheckpointError, match="checkpoint 7"):
+        ck.flush()
+    # the stored failure raised once is cleared: the checkpointer is
+    # usable again (the caller decided to continue)
+    committed = []
+    ck.submit(8, lambda: committed.append(8))
+    assert ck.flush() == 8
+    ck.close()
+    assert committed == [8]
+    assert ck.stats()["failed"] == 1
+
+
+def test_writer_death_mid_commit_is_typed_with_cause():
+    """An InjectedKill on the writer thread (the in-process stand-in for
+    the writer dying) surfaces as AsyncCheckpointError, cause chained."""
+    ck = AsyncCheckpointer(name="t-kill")
+
+    def _die():
+        raise InjectedKill("writer shot mid-commit")
+
+    ck.submit("k", _die)
+    with pytest.raises(AsyncCheckpointError) as exc:
+        ck.flush()
+    assert isinstance(exc.value.__cause__, InjectedKill)
+    ck.close(flush=False)
+
+
+def test_submit_after_close_raises():
+    ck = AsyncCheckpointer(name="t-closed")
+    ck.submit(1, lambda: None)
+    ck.close()
+    with pytest.raises(AsyncCheckpointError, match="after close"):
+        ck.submit(2, lambda: None)
+
+
+def test_close_without_flush_abandons_the_queued_job():
+    ck, release, done = _blocked_writer()
+    dropped = []
+    ran = []
+    ck.submit("queued", lambda: ran.append("queued"),
+              on_supersede=lambda: dropped.append("queued"))
+    ck.close(flush=False, timeout=0.2)
+    assert dropped == ["queued"] and ran == []
+    release.set()           # let the parked job finish + thread exit
+
+
+def test_flush_timeout_is_typed_and_names_the_stuck_label():
+    ck, release, done = _blocked_writer()
+    with pytest.raises(AsyncCheckpointError,
+                       match="'blocked' still uncommitted"):
+        ck.flush(timeout=0.05)
+    _drain(ck, release)
+    assert ck.flush() == "blocked"
+    ck.close()
+
+
+def test_flush_timeout_reads_the_config_knob(monkeypatch):
+    monkeypatch.setenv("MXTPU_CKPT_FLUSH_TIMEOUT", "0.05")
+    ck, release, done = _blocked_writer()
+    t0 = time.monotonic()
+    with pytest.raises(AsyncCheckpointError, match="flush timed out"):
+        ck.flush()
+    assert time.monotonic() - t0 < 5.0
+    _drain(ck, release)
+    ck.close()
+
+
+def test_flush_passes_its_fault_site():
+    ck = AsyncCheckpointer(name="t-site")
+    ck.submit(1, lambda: None)
+    faults.arm(FaultPlan().arm("checkpoint.flush", nth=1))
+    with pytest.raises(InjectedFault):
+        ck.flush()
+    faults.disarm()
+    assert ck.flush() == 1      # the barrier itself was unharmed
+    ck.close()
+
+
+# -- snapshot_tree: the step loop's only cost --------------------------------
+
+def test_snapshot_is_an_independent_host_copy():
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    ndarr = nd.array(np.ones((2, 2), np.float32))
+    tree = {"a": arr, "nested": {"n": ndarr}, "l": [arr, 3, "tag", None]}
+    snap = snapshot_tree(tree)
+    arr[:] = -1.0
+    got = snap["a"]
+    np.testing.assert_array_equal(
+        got, np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert isinstance(snap["nested"]["n"], np.ndarray)
+    np.testing.assert_array_equal(snap["nested"]["n"], np.ones((2, 2)))
+    assert snap["l"][1:] == [3, "tag", None]
+
+
+def test_snapshot_kill_leaves_no_partial_state(tmp_path):
+    """checkpoint.snapshot armed with a kill: the step dies before the
+    writer saw anything — disk stays exactly as it was."""
+    before = sorted(os.listdir(tmp_path))
+    faults.arm(FaultPlan().arm("checkpoint.snapshot", nth=1, exc="kill"))
+    with pytest.raises(InjectedKill):
+        snapshot_tree(_tree())
+    assert sorted(os.listdir(tmp_path)) == before
+
+
+# -- sharded checkpoints: one manifest, reshard-on-load bitwise --------------
+
+def test_sharded_roundtrip_bitwise_with_manifest_and_iter_state(tmp_path):
+    prefix = os.path.join(str(tmp_path), "ck")
+    tree = _tree(3, rows=12)
+    write_sharded_checkpoint(prefix, 4, tree, num_shards=3,
+                             plan_signature="plan-abc",
+                             iter_state={"epoch": 4, "batch": 0})
+    for k in range(3):
+        assert os.path.exists(shard_path(prefix, 4, k, 3))
+    loaded = load_sharded_checkpoint(prefix)
+    assert loaded.epoch == 4
+    assert loaded.num_shards == 3
+    assert loaded.plan_signature == "plan-abc"
+    for k, v in tree.items():
+        assert loaded.tree[k].tobytes() == np.asarray(v).tobytes(), k
+    assert rckpt.load_iter_state(prefix, 4) == {"epoch": 4, "batch": 0}
+    assert not rckpt.checkpoint_in_progress(prefix, 4)
+
+
+def test_reshard_on_load_is_bitwise_for_any_m(tmp_path):
+    prefix = os.path.join(str(tmp_path), "re")
+    tree = _tree(5, rows=16)
+    write_sharded_checkpoint(prefix, 1, tree, num_shards=4)
+    loaded = load_sharded_checkpoint(prefix)
+    for m in (1, 2, 8):
+        got, meta = loaded.shards(m)
+        want, wmeta = split_tree(tree, m)
+        assert meta == wmeta
+        for k in range(m):
+            assert set(got[k]) == set(want[k])
+            for key in got[k]:
+                assert got[k][key].tobytes() == want[k][key].tobytes(), \
+                    f"shard {k}/{m} key {key}"
+
+
+def test_split_tree_replicates_indivisible_leaves_and_validates():
+    tree = {"even": np.zeros((8, 2), np.float32),
+            "odd": np.zeros((7, 2), np.float32),
+            "scalar": np.float32(3.0)}
+    shards, meta = split_tree(tree, 4)
+    assert meta["sharded"] == ["even"]
+    assert sorted(meta["replicated"]) == ["odd", "scalar"]
+    assert "odd" in shards[0] and all("odd" not in s for s in shards[1:])
+    with pytest.raises(ValueError):
+        split_tree(tree, 0)
+    # a shard set missing a recorded key is corrupt, not quietly partial
+    broken = [dict(s) for s in shards]
+    del broken[2]["even"]
+    with pytest.raises(CheckpointCorrupt, match="missing from shard"):
+        assemble_shards(broken, meta)
+
+
+def test_kill_mid_shard_write_leaves_a_marked_invisible_stem(tmp_path):
+    prefix = os.path.join(str(tmp_path), "torn")
+    write_sharded_checkpoint(prefix, 1, _tree(1), num_shards=2)
+    faults.arm(FaultPlan().arm("checkpoint.shard_write", nth=2,
+                               exc="kill"))
+    with pytest.raises(InjectedKill):
+        write_sharded_checkpoint(prefix, 2, _tree(2), num_shards=4)
+    faults.disarm()
+    assert rckpt.checkpoint_in_progress(prefix, 2)
+    assert not os.path.exists(rckpt.manifest_path(prefix, 2))
+    # discovery: the torn epoch-2 set does not exist; 1 is still newest
+    assert rckpt.find_checkpoints(prefix) == [1]
+    assert load_sharded_checkpoint(prefix).epoch == 1
+
+
+def test_kill_at_manifest_commit_then_recovery_rewrite(tmp_path):
+    prefix = os.path.join(str(tmp_path), "cm")
+    faults.arm(FaultPlan().arm("checkpoint.commit", nth=1, exc="kill"))
+    with pytest.raises(InjectedKill):
+        write_sharded_checkpoint(prefix, 1, _tree(1), num_shards=2)
+    faults.disarm()
+    # every shard landed, but without the manifest nothing happened
+    assert os.path.exists(shard_path(prefix, 1, 0, 2))
+    assert rckpt.find_checkpoints(prefix) == []
+    # the relaunch rewrites the same stem; the marker clears on commit
+    tree = _tree(9)
+    write_sharded_checkpoint(prefix, 1, tree, num_shards=2)
+    assert not rckpt.checkpoint_in_progress(prefix, 1)
+    loaded = load_sharded_checkpoint(prefix)
+    for k, v in tree.items():
+        assert loaded.tree[k].tobytes() == np.asarray(v).tobytes(), k
+
+
+def test_load_sharded_refuses_a_plain_checkpoint(tmp_path):
+    prefix = os.path.join(str(tmp_path), "plain")
+    args, auxs = _params()
+    rckpt.write_checkpoint(prefix, 1, _net(), args, auxs)
+    with pytest.raises(CheckpointCorrupt, match="not a sharded"):
+        load_sharded_checkpoint(prefix)
+
+
+def test_load_checkpoint_ex_assembles_a_sharded_stem(tmp_path):
+    """The generic loader understands shard sets: arg:/aux: leaves come
+    back as NDArrays, state: leaves as the optimizer-state dict."""
+    prefix = os.path.join(str(tmp_path), "gen")
+    tree = _tree(6, rows=8)
+    write_sharded_checkpoint(prefix, 2, tree, num_shards=2)
+    ep, _, args, _, states = rckpt.load_checkpoint_ex(prefix, rckpt.AUTO)
+    assert ep == 2
+    assert args["w"].asnumpy().tobytes() == tree["arg:w"].tobytes()
+    assert args["b"].asnumpy().tobytes() == tree["arg:b"].tobytes()
+    assert states["step"] == tree["state:step"]
+
+
+# -- the .inprogress marker protocol -----------------------------------------
+
+def test_marker_forms_and_require_committed(tmp_path):
+    prefix = os.path.join(str(tmp_path), "m")
+    rckpt.mark_inprogress(prefix, 3)
+    assert rckpt.checkpoint_in_progress(prefix, 3)
+    assert rckpt.checkpoint_in_progress(rckpt.manifest_path(prefix, 3))
+    with pytest.raises(CheckpointInProgress, match="mid-commit"):
+        rckpt.require_committed(prefix, 3)
+    rckpt.clear_inprogress(prefix, 3)
+    assert not rckpt.checkpoint_in_progress(prefix, 3)
+    rckpt.require_committed(prefix, 3)      # no marker: passes
+    # directory (orbax/step-dir) form
+    step_dir = os.path.join(str(tmp_path), "step_5")
+    os.makedirs(step_dir)
+    with open(step_dir + ".inprogress", "w", encoding="utf-8") as f:
+        f.write("{}")
+    assert rckpt.checkpoint_in_progress(step_dir)
+    with pytest.raises(CheckpointInProgress):
+        rckpt.require_committed(step_dir, what="orbax step")
+
+
+def test_discovery_skips_marked_manifestless_keeps_marked_committed(
+        tmp_path):
+    prefix = os.path.join(str(tmp_path), "d")
+    args, auxs = _params()
+    rckpt.write_checkpoint(prefix, 1, _net(), args, auxs)
+    # a writer that died between manifest commit and marker removal:
+    # committed, loadable — stays discoverable
+    rckpt.write_checkpoint(prefix, 2, _net(), args, auxs)
+    rckpt.mark_inprogress(prefix, 2)
+    # a writer that died before its commit: params exist, no manifest
+    with open(rckpt.checkpoint_paths(prefix, 3)["params"], "wb") as f:
+        f.write(b"half a params file")
+    rckpt.mark_inprogress(prefix, 3)
+    assert rckpt.find_checkpoints(prefix) == [2, 1]
+    ep, _, _, _, _ = rckpt.load_checkpoint_ex(prefix, rckpt.AUTO)
+    assert ep == 2
+    # ...but the fleet's promotion gate still refuses the marked stem
+    with pytest.raises(CheckpointInProgress):
+        rckpt.require_committed(rckpt.manifest_path(prefix, 2))
+
+
+def test_sweep_rolls_stale_stems_but_never_a_marked_one(tmp_path):
+    prefix = os.path.join(str(tmp_path), "s")
+    args, auxs = _params()
+    m1 = rckpt.mid_epoch_label(0, 10)
+    m2 = rckpt.mid_epoch_label(0, 20)
+    for label in (m1, m2):
+        rckpt.write_checkpoint(prefix, label, _net(), args, auxs)
+    rckpt.write_checkpoint(prefix, 1, _net(), args, auxs)
+    # m2 is mid-commit by a concurrent (async) writer: off limits
+    rckpt.mark_inprogress(prefix, m2)
+    assert rckpt.sweep_stale_checkpoints(prefix, used=1) == 1
+    assert not os.path.exists(rckpt.checkpoint_paths(prefix, m1)["params"])
+    assert os.path.exists(rckpt.checkpoint_paths(prefix, m2)["params"])
+    rckpt.clear_inprogress(prefix, m2)
+    assert rckpt.sweep_stale_checkpoints(prefix, used=1) == 1
+
+
+def test_kill_at_sweep_deletes_nothing_committed(tmp_path):
+    prefix = os.path.join(str(tmp_path), "sk")
+    args, auxs = _params()
+    rckpt.write_checkpoint(prefix, 1, _net(), args, auxs)
+    rckpt.write_checkpoint(prefix, rckpt.mid_epoch_label(0, 5), _net(),
+                           args, auxs)
+    before = sorted(os.listdir(str(tmp_path)))
+    faults.arm(FaultPlan().arm("checkpoint.sweep", nth=1, exc="kill"))
+    with pytest.raises(InjectedKill):
+        rckpt.sweep_stale_checkpoints(prefix)
+    faults.disarm()
+    assert sorted(os.listdir(str(tmp_path))) == before
+    assert rckpt.find_checkpoints(prefix)[0] == 1
+
+
+# -- fleet rolling reload refuses a mid-commit model -------------------------
+
+def test_fleet_reload_refuses_then_accepts_once_committed(tmp_path):
+    from mxnet_tpu.serving import CallableBackend, FleetRouter
+
+    prefix = os.path.join(str(tmp_path), "model")
+    args, auxs = _params()
+    rckpt.write_checkpoint(prefix, 1, _net(), args, auxs, model_version=2)
+    source = rckpt.manifest_path(prefix, 1)
+
+    def make(rid, _source):
+        return CallableBackend(
+            lambda a: [np.ascontiguousarray(a["data"], np.float32)],
+            input_specs={"data": (3,)})
+
+    clock = [1000.0]
+    fr = FleetRouter(make, name="ckpt-gate", replicas=1, standbys=0,
+                     workers=0, buckets=[4], clock=lambda: clock[0])
+    try:
+        rckpt.mark_inprogress(prefix, 1)
+        with pytest.raises(CheckpointInProgress):
+            fr.reload(source)
+        assert fr.model_version is None, \
+            "a refused reload must not touch the fleet"
+        rckpt.clear_inprogress(prefix, 1)
+        fr.reload(source)
+        assert fr.model_version == 2
+    finally:
+        fr.close()
+
+
+# -- CrashLoopGuard x async: parity + crash-safe counter ---------------------
+
+def test_crash_loop_guard_parity_while_async_writer_commits(tmp_path):
+    """Backoff + quarantine behave identically with an AsyncCheckpointer
+    live in-process: both stacks share the atomic checkpoint.write
+    machinery and must not perturb each other."""
+    prefix = os.path.join(str(tmp_path), "g")
+    args, auxs = _params()
+    ck = AsyncCheckpointer(name="t-guard")
+    sleeps = []
+    g = CrashLoopGuard(os.path.join(str(tmp_path), "resume.json"),
+                       limit=2, backoff_base=0.5, backoff_cap=4.0,
+                       sleep=sleeps.append)
+    outcomes = []
+    for attempt in range(3):
+        ck.submit(attempt, lambda _a=attempt: rckpt.write_checkpoint(
+            prefix, _a + 1, _net(), args, auxs))
+        outcomes.append(g.on_resume(1, 7))
+        ck.flush()
+    assert outcomes == ["fresh", "retry", "quarantine"]
+    assert sleeps == [0.5]          # attempts=2 -> backoff_base
+    ck.close()
+    # every background commit landed despite the guard's writes
+    assert rckpt.find_checkpoints(prefix)[0] == 3
+    # quarantine persisted: a fresh guard (the relaunch) sees poison
+    g2 = CrashLoopGuard(os.path.join(str(tmp_path), "resume.json"),
+                        limit=2, sleep=sleeps.append)
+    assert g2.is_quarantined(1, 7)
+
+
+def test_crash_loop_counter_survives_a_kill_mid_update(tmp_path):
+    path = os.path.join(str(tmp_path), "resume.json")
+    g = CrashLoopGuard(path, limit=3, sleep=lambda s: None)
+    assert g.on_resume(0, 0) == "fresh"
+    faults.arm(FaultPlan().arm("checkpoint.write", nth=1, exc="kill"))
+    with pytest.raises(InjectedKill):
+        CrashLoopGuard(path, limit=3, sleep=lambda s: None).on_resume(0, 0)
+    faults.disarm()
+    g3 = CrashLoopGuard(path, limit=3, sleep=lambda s: None)
+    assert g3.on_resume(0, 0) in ("fresh", "retry")
+
+
+# -- preemption: flush-before-marker, iterator state round-trip --------------
+
+def _preempting_supervisor():
+    sup = TrainingSupervisor(signals=(), stall_timeout=0)
+    sup.on_signal(15)
+    return sup
+
+
+def test_preempt_exit_flushes_before_the_clean_exit_marker(tmp_path):
+    prefix = os.path.join(str(tmp_path), "p")
+    seq = []
+
+    def _flush():
+        assert not os.path.exists(preempt_marker_path(prefix)), \
+            "marker written before the pending snapshot was durable"
+        seq.append("flush")
+
+    with pytest.raises(Preempted):
+        _preempting_supervisor().preempt_exit(prefix, label=5, epoch=1,
+                                              nbatch=2, flush=_flush)
+    assert seq == ["flush"]
+    with open(preempt_marker_path(prefix), encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["clean"] is True and doc["label"] == 5
+
+
+def test_preempt_exit_propagates_a_failed_flush_without_marker(tmp_path):
+    prefix = os.path.join(str(tmp_path), "pf")
+
+    def _flush():
+        raise AsyncCheckpointError("final checkpoint never landed")
+
+    with pytest.raises(AsyncCheckpointError):
+        _preempting_supervisor().preempt_exit(prefix, label=5, flush=_flush)
+    assert not os.path.exists(preempt_marker_path(prefix)), \
+        "the marker must not lie about an uncommitted checkpoint"
+
+
+def test_preempt_flush_makes_iter_state_durable_for_resume(tmp_path):
+    """The async preemption path: the final checkpoint (with iterator
+    state) is only *submitted* when the signal lands; preempt_exit's
+    flush is what makes it durable before the marker claims so."""
+    prefix = os.path.join(str(tmp_path), "it")
+    args, auxs = _params()
+    label = rckpt.mid_epoch_label(1, 41)
+    iter_state = {"epoch": 1, "batch": 42, "seed": 7}
+    ck = AsyncCheckpointer(name="t-preempt")
+    ck.submit(label, lambda: rckpt.write_checkpoint(
+        prefix, label, _net(), args, auxs, iter_state=iter_state))
+    with pytest.raises(Preempted):
+        _preempting_supervisor().preempt_exit(
+            prefix, label=label, epoch=1, nbatch=41, flush=ck.flush)
+    ck.close()
+    assert rckpt.find_checkpoints(prefix) == [label]
+    assert rckpt.load_iter_state(prefix, label) == iter_state
+    assert rckpt.epoch_of_label(label) == 1
+
+
+# -- gluon Trainer.save_states through the background writer -----------------
+
+def test_gluon_save_states_async_matches_sync_bitwise(tmp_path):
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x, y = rng.rand(16, 4).astype(np.float32), np.zeros(16, np.float32)
+    for _ in range(2):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(x)), nd.array(y))
+        loss.backward()
+        trainer.step(16)
+
+    sync_f = os.path.join(str(tmp_path), "sync.states")
+    async_f = os.path.join(str(tmp_path), "async.states")
+    trainer.save_states(sync_f)
+    ck = AsyncCheckpointer(name="t-gluon")
+    trainer.save_states(async_f, checkpointer=ck)
+    assert ck.flush() == async_f
+    ck.close()
+    with open(sync_f, "rb") as f1, open(async_f, "rb") as f2:
+        assert f1.read() == f2.read(), \
+            "async states file must be bitwise the sync one"
+    trainer.load_states(async_f)        # and it round-trips
+
+
+# -- SPMDTrainer.fit: async mid-epoch + epoch-end saves ----------------------
+
+def test_spmd_fit_async_matches_sync_bitwise_and_resumes(tmp_path):
+    from mxnet_tpu.parallel import SPMDTrainer
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 10).astype(np.float32)
+    y = (np.arange(64) % 4).astype(np.float32)
+
+    def _mlp():
+        d = sym.Variable("data")
+        f1 = sym.FullyConnected(d, name="fc1", num_hidden=16)
+        a = sym.Activation(f1, name="r", act_type="relu")
+        f2 = sym.FullyConnected(a, name="fc2", num_hidden=4)
+        return sym.SoftmaxOutput(f2, name="softmax")
+
+    def _run(d, async_ckpt, epochs=2, resume=None):
+        np.random.seed(0)
+        mx.random.seed(0)
+        tr = SPMDTrainer(_mlp(), optimizer="adam",
+                         optimizer_params={"learning_rate": 0.01})
+        tr.bind(data_shapes={"data": (16, 10)},
+                label_shapes={"softmax_label": (16,)})
+        kw = {"resume": resume} if resume else {}
+        tr.fit(mx.io.NDArrayIter(X, y, batch_size=16), num_epoch=epochs,
+               checkpoint_dir=d, checkpoint_batch_period=2,
+               async_checkpoint=async_ckpt, **kw)
+        return tr
+
+    import jax
+    sdir, adir = str(tmp_path / "sync"), str(tmp_path / "async")
+    ts = _run(sdir, False)
+    ta = _run(adir, True)
+    # identical committed step dirs, every one manifested, no markers —
+    # the async writer's supersede/post_commit roll mirrored the sync
+    # retention exactly
+    for d in (sdir, adir):
+        names = sorted(os.listdir(d))
+        assert not any(n.endswith(".inprogress") for n in names), names
+        for s in [n for n in names if n.startswith("step_")]:
+            assert os.path.exists(os.path.join(d, s, "manifest.json")), s
+    assert sorted(n for n in os.listdir(sdir) if n.startswith("step_")) \
+        == sorted(n for n in os.listdir(adir) if n.startswith("step_"))
+    ps, pa = (jax.device_get(t._ckpt_state()) for t in (ts, ta))
+
+    def _cmp(a, b, pfx=""):
+        if isinstance(a, dict):
+            assert set(a) == set(b), pfx
+            for k in a:
+                _cmp(a[k], b[k], pfx + "/" + str(k))
+        else:
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), pfx
+
+    _cmp(ps, pa)
+    _run(adir, True, epochs=3, resume="auto")   # restores what async wrote
+
+
+# -- Module.fit wired through the MXTPU_ASYNC_CKPT knob ----------------------
+
+def test_fit_env_knob_commits_async_checkpoints(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_ASYNC_CKPT", "1")
+    prefix = os.path.join(str(tmp_path), "fitck")
+    rng = np.random.RandomState(0)
+    X = rng.randn(60, 10).astype(np.float32)
+    y = (np.arange(60) % 4).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Activation(sym.FullyConnected(data, name="fc1", num_hidden=16),
+                       name="relu1", act_type="relu"),
+        name="fc2", num_hidden=4), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=30), optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), num_epoch=2,
+            checkpoint_prefix=prefix)
+    # the writer was closed (flushed) on fit exit: both epochs committed
+    assert getattr(mod, "_fit_async_ckpt", None) is None
+    assert rckpt.find_checkpoints(prefix)[0] == 2
+    assert not rckpt.checkpoint_in_progress(prefix, 2)
+    ep, _, args, _, _ = rckpt.load_checkpoint_ex(prefix, rckpt.AUTO)
+    assert ep == 2
+    for k, v in mod.get_params()[0].items():
+        np.testing.assert_array_equal(args[k].asnumpy(), v.asnumpy(),
+                                      err_msg=k)
