@@ -68,7 +68,14 @@ lint-concurrency:
 	python -m mxnet_tpu.analysis --root . --only concurrency \
 	    --no-baseline mxnet_tpu
 
-ci-lint: lint-tpu lint-concurrency
+# the memory tier alone (use-after-donate, donation-alias-leak,
+# unbounded-device-retention over the whole-program donation model) —
+# same ZERO-baseline policy as the concurrency tier.
+lint-memory:
+	python -m mxnet_tpu.analysis --root . --only memory \
+	    --no-baseline mxnet_tpu
+
+ci-lint: lint-tpu lint-concurrency lint-memory
 
 # stage 1: native shared libraries
 ci-native: all
@@ -260,7 +267,7 @@ ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-quant ci-checkpoint
 	@echo "CI matrix green"
 
-.PHONY: all clean ci lint-tpu lint-concurrency ci-lint ci-native \
+.PHONY: all clean ci lint-tpu lint-concurrency lint-memory ci-lint ci-native \
 	ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
         ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \

@@ -8,3 +8,4 @@ from . import rng               # noqa: F401
 from . import registry_consistency  # noqa: F401
 from . import donation          # noqa: F401
 from . import concurrency      # noqa: F401
+from . import memory           # noqa: F401

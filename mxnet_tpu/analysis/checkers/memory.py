@@ -1,0 +1,69 @@
+"""The memory tier: donated-buffer lifetime checkers.
+
+Three rules over :class:`~mxnet_tpu.analysis.donation.DonationModel`
+(the whole-program donated-tree lifetime analysis, built on the lock
+model's call resolution):
+
+* **use-after-donate** — a tree read after a donating call consumed it
+  (``FusedStep``/``FusedOptimizerApply``/``jax.jit(...,
+  donate_argnums=...)``) and before a rebind, sync-back, or
+  ``snapshot_tree`` re-established ownership. The read sees a buffer
+  XLA has already reused: silent garbage, not an exception.
+* **donation-alias-leak** — a reference into a tree (stored on
+  ``self``, returned, appended) created before a later call donates
+  that tree: the stored reference dies with the donation.
+* **unbounded-device-retention** — device arrays appended in a loop to
+  a container that is never drained; every element pins its HBM buffer
+  for the life of the process.
+
+The model computes all findings once per project
+(``DonationModel.of``); the checkers only serve their rule's slice, so
+the tier costs one pass however many rules run. Suppression is the
+standard ``# tpu-lint: disable=<rule>`` syntax, applied by the driver.
+"""
+from __future__ import annotations
+
+from ..core import Checker, Project, register_checker
+from ..donation import DonationModel
+
+
+class _DonationRule(Checker):
+    """Shared driver: serve this rule's findings from the memoized
+    project-wide donation model."""
+
+    def check_project(self, project: Project):
+        model = DonationModel.of(project)
+        for finding in model.findings.get(self.name, ()):
+            yield finding
+
+
+@register_checker
+class UseAfterDonateChecker(_DonationRule):
+    name = "use-after-donate"
+    tier = "memory"
+    description = ("a tree read after a donating call (FusedStep / "
+                   "FusedOptimizerApply / jax.jit donate_argnums) "
+                   "consumed it, before a rebind / sync-back / "
+                   "snapshot_tree re-established ownership — the read "
+                   "sees a reused buffer, silently")
+
+
+@register_checker
+class DonationAliasLeakChecker(_DonationRule):
+    name = "donation-alias-leak"
+    tier = "memory"
+    description = ("a reference into a tree (self-attr store, return, "
+                   "append) created before a later call donates the "
+                   "tree — the stored reference dies with the donated "
+                   "buffer; snapshot_tree() first or alias after the "
+                   "call")
+
+
+@register_checker
+class UnboundedDeviceRetentionChecker(_DonationRule):
+    name = "unbounded-device-retention"
+    tier = "memory"
+    description = ("device arrays appended in a loop to a container "
+                   "that is never drained — each retained element pins "
+                   "its HBM buffer; convert to host at the report "
+                   "boundary or bound the container")

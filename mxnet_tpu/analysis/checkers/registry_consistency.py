@@ -56,7 +56,8 @@ DOC_BASES = {"NDArrayDoc", "SymbolDoc"}
 # suite and documented in the rule catalog (same group semantics as the
 # fault sites — presence in any file of the group satisfies it)
 CHECKERS_PREFIX = "mxnet_tpu/analysis/checkers/"
-CHECKER_TESTS = ("tests/test_tpu_lint.py", "tests/test_concurrency_lint.py")
+CHECKER_TESTS = ("tests/test_tpu_lint.py", "tests/test_concurrency_lint.py",
+                 "tests/test_memory_lint.py")
 CHECKER_DOCS = ("docs/how_to/tpu_lint.md",)
 
 

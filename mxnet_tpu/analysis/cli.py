@@ -40,11 +40,17 @@ def _build_parser() -> argparse.ArgumentParser:
                     metavar="RULE", help="run only the named checker "
                     "(repeatable)")
     ap.add_argument("--only", default=None, metavar="TIER",
-                    help="run only the checkers of one tier ('core' or "
-                         "'concurrency') — e.g. `--only concurrency` "
-                         "for the lock/signal rules alone")
+                    help="run only the checkers of one tier ('core', "
+                         "'concurrency', or 'memory') — e.g. "
+                         "`--only memory` for the donated-buffer "
+                         "lifetime rules alone")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--report-hbm", action="store_true",
+                    help="print the whole-program HBM-footprint model's "
+                         "reference report (compiler/memory.py breakdown "
+                         "for the bundled micro models under the current "
+                         "env knobs) and exit")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON")
     return ap
@@ -58,6 +64,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         for name in sorted(core.CHECKERS):
             cls = core.CHECKERS[name]
             print(f"{name} [{cls.tier}]: {cls.description}")
+        return 0
+
+    if args.report_hbm:
+        from ..compiler import memory as _memory
+        print(_memory.reference_report())
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())

@@ -74,7 +74,8 @@ class Checker:
     ``check_file`` (per-file AST pass) and/or ``check_project`` (one pass
     with every parsed file + the repo root, for cross-file consistency).
     ``tier`` groups rules for the CLI's ``--only`` filter: ``"core"``
-    (the TPU/JAX hazards) or ``"concurrency"`` (the lock/signal tier)."""
+    (the TPU/JAX hazards), ``"concurrency"`` (the lock/signal tier), or
+    ``"memory"`` (the donated-buffer lifetime tier)."""
 
     name: str = ""
     description: str = ""

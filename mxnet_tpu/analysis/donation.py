@@ -1,0 +1,953 @@
+"""The whole-program donated-buffer lifetime model behind the memory tier.
+
+The runtime is donation-everywhere: ``FusedStep.__call__`` consumes its
+param/state/aux trees, ``FusedOptimizerApply`` its weight/state trees,
+the SPMD step its whole carry, and any user ``jax.jit(...,
+donate_argnums=...)`` callable its chosen positions. After such a call
+the caller's reference points at a buffer XLA has already reused —
+reading it is silent garbage, aliasing into it before the call leaks
+the same garbage through the stored reference. PR 14's
+``snapshot_tree`` is the convention that makes async checkpointing
+safe; this model is the law that enforces the convention tree-wide.
+
+Built in the shape of the concurrency tier's lock model
+(:mod:`.lockmodel`), whose project indexes and call resolution it
+REUSES outright — lexical scopes, ``self``/``cls`` methods, typed
+attributes (``self._fused = FusedStep(...)`` resolves cross-module),
+and typed locals (``step = self._fused`` hoists). On top of that it
+tracks, per function, a linear-flow **ownership state** for every tree
+expression:
+
+* a **donating call** ends the tree's ownership window — the donated
+  positions come from literal ``donate_argnums`` (resolved through
+  local constant assignment, ``(0,1,2) if d else ()`` folds to the
+  union), from the known donating runtime classes
+  (``FusedStep`` -> 0,1,2; ``FusedOptimizerApply`` -> 0,2), or from a
+  callee whose own body donates that parameter (the cross-call /
+  cross-module propagation leg);
+* a **rebind** (assignment to the same name/attribute), a **sync-back**
+  (``sync_to_module`` / ``refresh`` / ``rebind`` / ``bind`` / ``init``
+  / ``restore``), or a designated **snapshot**
+  (:func:`~mxnet_tpu.resilience.snapshot_tree`) re-establishes
+  ownership;
+* any **read** in between — a bare load, a call argument, a method
+  receiver, a callee that reads the donated ``self`` attribute — is a
+  ``use-after-donate`` finding;
+* an alias created into the tree **before** the donating call (stored
+  on ``self``, returned, appended to a container) is a
+  ``donation-alias-leak`` finding: the caller's copy dies with the
+  donation.
+
+The third rule, ``unbounded-device-retention``, is the host-RAM side
+of the same accounting: device arrays (jit/step outputs, ``jnp.*``
+values, leaves of the step's trees) appended in a loop to a container
+that is never drained pin device buffers for the life of the process —
+the leak class ROADMAP item 2's offload tier will turn into OOMs.
+Containers with any drain (``clear``/``pop``/reassignment) anywhere in
+their class are bounded-by-protocol and not flagged; neither are
+host-converted values (``asnumpy``/``np.array``/``device_get``/
+``snapshot_tree``/``float``).
+
+Checkers live in :mod:`.checkers.memory`; this module computes findings
+once per :class:`~.core.Project` (``DonationModel.of(project)``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import Finding
+from .lockmodel import LockModel, walk_own
+from .tracecontext import dotted_name
+
+__all__ = ["DonationModel", "DONATING_CLASSES"]
+
+#: runtime classes whose instances donate (positional) tree arguments
+#: when CALLED — the perf/parallel step seams (docs/how_to/tpu_lint.md)
+DONATING_CLASSES: Dict[str, FrozenSet[int]] = {
+    "FusedStep": frozenset({0, 1, 2}),
+    "FusedOptimizerApply": frozenset({0, 2}),
+}
+
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+#: when donate_argnums exists but can't be folded to literals, assume
+#: the runtime convention: trees ride in the leading three positions
+_DEFAULT_POSITIONS = frozenset({0, 1, 2})
+
+#: calls that re-establish ownership of the receiver's trees: the
+#: sync-back/rebind seams of the runtime (ModuleStepper.sync_to_module,
+#: FusedStep.refresh, SPMDTrainer.bind/remesh, checkpoint restore)
+_SYNC_METHODS = {"sync_to_module", "refresh", "rebind", "bind", "init",
+                 "init_params", "set_params", "restore", "remesh"}
+
+#: the designated copy boundary (resilience/async_checkpoint.py): a
+#: host deep-copy that re-establishes ownership by convention
+_SNAPSHOT_FNS = {"snapshot_tree"}
+
+#: host-conversion calls: their results live on the host, not in HBM
+_HOST_CONVERTERS = {"asnumpy", "array", "asarray", "device_get", "item",
+                    "tolist", "float", "int", "bool", "snapshot_tree",
+                    "copy", "deepcopy", "get_params"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _leaf(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _call_leaf(call: ast.Call) -> str:
+    return _leaf(dotted_name(call.func))
+
+
+Key = Tuple[str, ...]          # ("local", name) | ("attr", name)
+
+
+def _expr_key(expr: ast.AST) -> Optional[Key]:
+    """The ownership key of a tree expression: a bare name or a
+    ``self``/``cls`` attribute. Subscripts/attrs chase to their root so
+    ``params["w"]`` keys to ``params``."""
+    while isinstance(expr, (ast.Subscript, ast.Starred)):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return ("local", expr.id)
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")):
+        return ("attr", expr.attr)
+    return None
+
+
+def _key_str(key: Key) -> str:
+    return key[1] if key[0] == "local" else f"self.{key[1]}"
+
+
+def _literal_positions(value: ast.AST) -> Optional[FrozenSet[int]]:
+    """Fold a donate_argnums value to a position set: int / tuple of
+    ints; ``a if c else b`` folds to the union of both branches."""
+    if isinstance(value, ast.Constant) and isinstance(value.value, int):
+        return frozenset({value.value})
+    if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for elt in value.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.add(elt.value)
+        return frozenset(out)
+    if isinstance(value, ast.IfExp):
+        a = _literal_positions(value.body)
+        b = _literal_positions(value.orelse)
+        if a is not None and b is not None:
+            return a | b
+    return None
+
+
+class _FnSummary:
+    """Per-function facts the cross-call propagation consumes."""
+
+    __slots__ = ("donates_params", "attr_reads", "attr_rebinds",
+                 "wrappers", "jits")
+
+    def __init__(self):
+        #: parameter indices the body passes to a donating position
+        self.donates_params: Set[int] = set()
+        #: self attrs whose first access in linear order is a read
+        self.attr_reads: Set[str] = set()
+        #: self attrs the body assigns (ownership re-established)
+        self.attr_rebinds: Set[str] = set()
+        #: local name -> donated-position set, for `f = jax.jit(step,
+        #: donate_argnums=...)` wrappers built in this body
+        self.wrappers: Dict[str, FrozenSet[int]] = {}
+        #: local names bound to jit-compiled callables (donating or
+        #: not) — device-array sources for the retention rule
+        self.jits: Set[str] = set()
+
+
+class _Donation:
+    __slots__ = ("node", "seam", "order", "alias_of")
+
+    def __init__(self, node: ast.AST, seam: str, order: int,
+                 alias_of: Optional[str] = None):
+        self.node = node          # the donating call
+        self.seam = seam          # human name of the donating seam
+        self.order = order
+        self.alias_of = alias_of  # set when this key aliases a donated tree
+
+
+class DonationModel:
+    """Project-wide donated-buffer lifetime analysis; findings are
+    computed once and served to the three memory-tier checkers."""
+
+    def __init__(self, project):
+        self.project = project
+        self.lock = LockModel.of(project)
+        #: (relpath, ClassName, attr) -> donated positions, for
+        #: `self._fn = jax.jit(step, donate_argnums=...)` attributes
+        self.attr_wrappers: Dict[Tuple[str, str, str],
+                                 FrozenSet[int]] = {}
+        #: (relpath, name) -> donated positions, for module-level
+        #: `step = jax.jit(fn, donate_argnums=...)` globals
+        self.module_wrappers: Dict[Tuple[str, str], FrozenSet[int]] = {}
+        #: jit-compiled callables (donating or not): their outputs are
+        #: device arrays — the retention rule's device sources
+        self.attr_jits: Set[Tuple[str, str, str]] = set()
+        self.module_jits: Set[Tuple[str, str]] = set()
+        self.summaries: Dict[ast.AST, _FnSummary] = {}
+        self.findings: Dict[str, List[Finding]] = {
+            "use-after-donate": [], "donation-alias-leak": [],
+            "unbounded-device-retention": []}
+        self._index_wrappers()
+        self._build_summaries()
+        self._fix_param_donation()
+        self._fix_attr_reads()
+        for fn, info in self.lock.fns.items():
+            if isinstance(fn, ast.Lambda):
+                continue
+            self._scan_fn(fn, info)
+        self._scan_retention()
+        # the loop-body double-pass and nested-loop walks can re-derive
+        # a finding; one site, one report
+        for rule, lst in self.findings.items():
+            seen: Set[Tuple[str, int, int]] = set()
+            out: List[Finding] = []
+            for f in lst:
+                k = (f.path, f.line, f.col)
+                if k in seen:
+                    continue
+                seen.add(k)
+                out.append(f)
+            self.findings[rule] = out
+
+    @classmethod
+    def of(cls, project) -> "DonationModel":
+        model = getattr(project, "_donation_model", None)
+        if model is None:
+            model = cls(project)
+            project._donation_model = model
+        return model
+
+    # -- donating-wrapper discovery -----------------------------------------
+
+    @staticmethod
+    def _wrapper_positions(value: ast.AST,
+                           fn: Optional[ast.AST] = None
+                           ) -> Optional[FrozenSet[int]]:
+        """Donated positions of a wrapper-constructing call (any call
+        carrying donate_argnums/donate_argnames — jax.jit, pjit,
+        PersistentJit). None when the value is not a donating ctor."""
+        if not isinstance(value, ast.Call):
+            return None
+        for kw in value.keywords:
+            if kw.arg not in _DONATE_KWARGS:
+                continue
+            lit = _literal_positions(kw.value)
+            if lit is None and isinstance(kw.value, ast.Name) \
+                    and fn is not None:
+                for node in walk_own(fn):
+                    if (isinstance(node, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == kw.value.id
+                                    for t in node.targets)):
+                        lit = _literal_positions(node.value)
+            if lit is not None and not lit:
+                return None              # donate_argnums=() — no donation
+            return lit if lit is not None else _DEFAULT_POSITIONS
+        return None
+
+    def _index_wrappers(self):
+        """Class-attribute wrappers (``self.X = jit(..., donate_...)``
+        anywhere in a method) and module-level wrapper globals."""
+        for ctx in self.project.ctxs:
+            for node in ctx.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                pos = self._wrapper_positions(node.value)
+                jitlike = self._is_jitlike(node.value)
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    if pos is not None:
+                        self.module_wrappers[(ctx.relpath, tgt.id)] = pos
+                    if pos is not None or jitlike:
+                        self.module_jits.add((ctx.relpath, tgt.id))
+        for (rel, cname), methods in self.lock.methods.items():
+            for fn in methods.values():
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    pos = self._wrapper_positions(node.value, fn)
+                    jitlike = self._is_jitlike(node.value)
+                    if pos is None and not jitlike:
+                        continue
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            if pos is not None:
+                                self.attr_wrappers[
+                                    (rel, cname, tgt.attr)] = pos
+                            self.attr_jits.add((rel, cname, tgt.attr))
+
+    @staticmethod
+    def _is_jitlike(value: ast.AST) -> bool:
+        """A jit-compiling ctor call (jax.jit / pjit / PersistentJit),
+        donating or not — its result returns device arrays."""
+        if not isinstance(value, ast.Call):
+            return False
+        return _call_leaf(value) in ("jit", "pjit", "PersistentJit")
+
+    def _donating_positions(self, info, call: ast.Call
+                            ) -> Optional[Tuple[FrozenSet[int], str]]:
+        """(positions, seam description) when ``call`` donates, else
+        None. Resolution order: inline donating ctor call; local
+        wrapper; attribute wrapper; donating-class instance; a callee
+        whose summary donates its parameters."""
+        func = call.func
+        # jax.jit(f, donate_argnums=...)(args) — immediately invoked
+        if isinstance(func, ast.Call):
+            pos = self._wrapper_positions(func, info.node)
+            if pos is not None:
+                return pos, f"`{_call_leaf(func)}(...)` (donating jit)"
+        summary = self.summaries.get(info.node)
+        if isinstance(func, ast.Name):
+            if summary and func.id in summary.wrappers:
+                return (summary.wrappers[func.id],
+                        f"donating jit `{func.id}`")
+            mkey = (info.relpath, func.id)
+            if func.id not in getattr(info, "locals", ()) \
+                    and mkey in self.module_wrappers:
+                return (self.module_wrappers[mkey],
+                        f"donating jit `{func.id}`")
+            tname = info.local_types.get(func.id)
+            if tname in DONATING_CLASSES:
+                return (DONATING_CLASSES[tname],
+                        f"`{tname}.__call__` (donates its trees)")
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("self", "cls") and info.cls:
+            # self._fn(...) where _fn is a donating jit attribute
+            wkey = (info.relpath, info.cls, func.attr)
+            if wkey in self.attr_wrappers:
+                return (self.attr_wrappers[wkey],
+                        f"donating jit `self.{func.attr}`")
+            tname = self.lock.attr_types.get(wkey)
+            if tname in DONATING_CLASSES:
+                return (DONATING_CLASSES[tname],
+                        f"`{tname}.__call__` via self.{func.attr}")
+        # calling an instance held in a typed attr/local AS a function:
+        # self._fused(...) with attr_types[_fused] == FusedStep is the
+        # attribute branch above; obj(...) with obj typed is the Name
+        # branch. What remains: propagation through a callee that
+        # donates its own parameters.
+        hits = self.lock._resolve_call(info, func, None)
+        for hit in hits:
+            hsum = self.summaries.get(hit)
+            if hsum and hsum.donates_params:
+                hinfo = self.lock.fns.get(hit)
+                offset = 1 if (hinfo is not None
+                               and hinfo.is_method) else 0
+                # positions are callee-param indices; map back to the
+                # call's positional args (self consumes index 0)
+                pos = frozenset(i - offset for i in hsum.donates_params
+                                if i - offset >= 0)
+                if pos:
+                    name = dotted_name(func) or "<call>"
+                    return pos, f"`{name}()` (donates its arguments)"
+        return None
+
+    # -- summaries + fixpoints ----------------------------------------------
+
+    def _build_summaries(self):
+        for fn, info in self.lock.fns.items():
+            s = _FnSummary()
+            self.summaries[fn] = s
+            if isinstance(fn, ast.Lambda):
+                continue
+            seen_attr: Set[str] = set()
+            for node in walk_own(fn):
+                if isinstance(node, ast.Assign):
+                    pos = self._wrapper_positions(node.value, fn)
+                    jitlike = self._is_jitlike(node.value)
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            if pos is not None:
+                                s.wrappers[tgt.id] = pos
+                            if pos is not None or jitlike:
+                                s.jits.add(tgt.id)
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id in ("self", "cls")):
+                            s.attr_rebinds.add(tgt.attr)
+                            seen_attr.add(tgt.attr)
+                elif (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in ("self", "cls")
+                        and isinstance(node.ctx, ast.Load)
+                        and node.attr not in seen_attr):
+                    s.attr_reads.add(node.attr)
+                    seen_attr.add(node.attr)
+
+    def _fix_param_donation(self):
+        """Which of a function's own parameters does its body donate?
+        Union fixpoint so donation propagates through call chains (and,
+        with typed attributes, across modules)."""
+        changed = True
+        rounds = 0
+        while changed and rounds < 10:
+            changed = False
+            rounds += 1
+            for fn, info in self.lock.fns.items():
+                if isinstance(fn, ast.Lambda):
+                    continue
+                s = self.summaries[fn]
+                for node in walk_own(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    don = self._donating_positions(info, node)
+                    if don is None:
+                        continue
+                    pos, _seam = don
+                    for i, arg in enumerate(node.args):
+                        if i not in pos:
+                            continue
+                        k = _expr_key(arg)
+                        if k is not None and k[0] == "local" \
+                                and k[1] in info.params:
+                            idx = info.params.index(k[1])
+                            if idx not in s.donates_params:
+                                s.donates_params.add(idx)
+                                changed = True
+
+    def _fix_attr_reads(self):
+        """Attr reads propagate through self-method calls: calling a
+        method that reads ``self.params`` is a read of ``self.params``."""
+        changed = True
+        rounds = 0
+        while changed and rounds < 10:
+            changed = False
+            rounds += 1
+            for fn, info in self.lock.fns.items():
+                if isinstance(fn, ast.Lambda) or not info.cls:
+                    continue
+                s = self.summaries[fn]
+                for node in walk_own(fn):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id in ("self", "cls")):
+                        continue
+                    for hit in self.lock._method_hits(
+                            info.cls, node.func.attr,
+                            prefer_rel=info.relpath):
+                        hs = self.summaries.get(hit)
+                        if hs is None:
+                            continue
+                        add = hs.attr_reads - s.attr_rebinds
+                        if not add <= s.attr_reads:
+                            s.attr_reads |= add
+                            changed = True
+
+    # -- the per-function ownership scan ------------------------------------
+
+    def _scan_fn(self, fn: ast.AST, info):
+        #: (order, key, node, how) — alias-creating sites for the
+        #: later-donation post-pass
+        alias_events: List[Tuple[int, Key, ast.AST, str]] = []
+        #: (order, key) — donation + rebind timeline for the post-pass
+        donate_log: List[Tuple[int, Key, ast.AST, str]] = []
+        rebind_log: List[Tuple[int, Key]] = []
+        counter = [0]
+        terminal = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+        def scan_simple(st, donated, alias_of):
+            counter[0] += 1
+            order = counter[0]
+            # reads of already-donated trees first: same-statement call
+            # args evaluate before the call donates, and an assignment
+            # target rebinds only after the value is computed
+            if donated:
+                self._flag_reads(st, order, info, donated)
+            for node in self._stmt_calls(st):
+                self._scan_call(node, order, info, donated, alias_of,
+                                donate_log)
+            self._scan_store(st, order, info, donated, alias_of,
+                             alias_events, rebind_log)
+
+        def scan_header(expr, donated, alias_of):
+            if expr is None:
+                return
+            counter[0] += 1
+            order = counter[0]
+            if donated:
+                self._flag_reads(expr, order, info, donated)
+            for node in self._stmt_calls(expr):
+                self._scan_call(node, order, info, donated, alias_of,
+                                donate_log)
+
+        def drop_names(target, donated, alias_of):
+            for n in ast.walk(target):
+                if isinstance(n, ast.Name):
+                    donated.pop(("local", n.id), None)
+                    alias_of.pop(n.id, None)
+
+        # branch-sensitive walk: If arms get their own state copies and
+        # merge afterwards (a terminated arm — return/raise/break/
+        # continue — contributes nothing); except-handlers start with a
+        # clean donation slate (on the exceptional path the donating
+        # call may never have completed — retry/fallback reads are
+        # legitimate); loop bodies run twice so a tree donated at the
+        # bottom of an iteration flags the read at the top of the next
+        def walk(body, donated, alias_of) -> bool:
+            for st in body:
+                if isinstance(st, _FUNC_NODES + (ast.ClassDef,)):
+                    continue
+                if isinstance(st, ast.If):
+                    scan_header(st.test, donated, alias_of)
+                    d1, a1 = dict(donated), dict(alias_of)
+                    t1 = walk(st.body, d1, a1)
+                    d2, a2 = dict(donated), dict(alias_of)
+                    t2 = walk(st.orelse, d2, a2)
+                    donated.clear()
+                    alias_of.clear()
+                    if not t1:
+                        donated.update(d1)
+                        alias_of.update(a1)
+                    if not t2:
+                        donated.update(d2)
+                        alias_of.update(a2)
+                    if t1 and t2:
+                        return True
+                elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                    scan_header(getattr(st, "iter", None)
+                                or getattr(st, "test", None),
+                                donated, alias_of)
+                    if isinstance(st, (ast.For, ast.AsyncFor)):
+                        drop_names(st.target, donated, alias_of)
+                    walk(st.body, donated, alias_of)
+                    walk(st.body, donated, alias_of)
+                    walk(st.orelse, donated, alias_of)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    for item in st.items:
+                        scan_header(item.context_expr, donated, alias_of)
+                        if item.optional_vars is not None:
+                            drop_names(item.optional_vars, donated,
+                                       alias_of)
+                    if walk(st.body, donated, alias_of):
+                        return True
+                elif isinstance(st, ast.Try):
+                    t = walk(st.body, donated, alias_of)
+                    for h in st.handlers:
+                        walk(h.body, {}, dict(alias_of))
+                    if not t:
+                        t = walk(st.orelse, donated, alias_of)
+                    if walk(st.finalbody, donated, alias_of) or t:
+                        return True
+                else:
+                    scan_simple(st, donated, alias_of)
+                    if isinstance(st, terminal):
+                        return True
+            return False
+
+        walk(list(fn.body), {}, {})
+
+        # alias-leak post-pass: an alias into a tree created BEFORE a
+        # donating call of that tree (with no rebind in between) leaks
+        # a dead reference
+        for a_order, key, node, how in alias_events:
+            for d_order, d_key, d_node, seam in donate_log:
+                if d_key != key or d_order <= a_order:
+                    continue
+                if any(r_order > a_order and r_order < d_order
+                       and r_key == key
+                       for r_order, r_key in rebind_log):
+                    continue
+                self.findings["donation-alias-leak"].append(Finding(
+                    rule="donation-alias-leak", path=info.relpath,
+                    line=node.lineno, col=node.col_offset,
+                    message=f"{how} aliases `{_key_str(key)}`, which "
+                            f"{seam} donates at line {d_node.lineno} — "
+                            f"the stored reference dies with the "
+                            f"donated buffer; snapshot_tree() the leaf "
+                            f"first, or alias after the call",
+                    context=info.qualname))
+                break
+
+    @staticmethod
+    def _stmt_calls(st) -> List[ast.Call]:
+        out = []
+        for node in ast.walk(st):
+            if isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+                continue
+            if isinstance(node, ast.Call):
+                out.append(node)
+        return out
+
+    def _flag_reads(self, st, order, info, donated) -> bool:
+        """Any Load of a donated key (bare, argument, receiver) is a
+        use-after-donate; one finding per donation window. Arguments of
+        an ownership-re-establishing call (snapshot_tree, sync-back
+        receivers) are the fix, not the bug — exempt."""
+        exempt: Set[int] = set()
+        for call in self._stmt_calls(st):
+            leaf = _call_leaf(call)
+            if leaf in _SNAPSHOT_FNS:
+                for arg in call.args:
+                    exempt.update(id(n) for n in ast.walk(arg))
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in _SYNC_METHODS:
+                exempt.update(id(n) for n in ast.walk(call.func.value))
+        flagged = False
+        for node in ast.walk(st):
+            if id(node) in exempt:
+                continue
+            key = None
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                key = ("local", node.id)
+            elif (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in ("self", "cls")
+                    and isinstance(node.ctx, ast.Load)):
+                key = ("attr", node.attr)
+            if key is None or key not in donated:
+                continue
+            don = donated.pop(key)
+            alias_note = (f" (aliases donated `{don.alias_of}`)"
+                          if don.alias_of else "")
+            self.findings["use-after-donate"].append(Finding(
+                rule="use-after-donate", path=info.relpath,
+                line=node.lineno, col=node.col_offset,
+                message=f"`{_key_str(key)}`{alias_note} is read after "
+                        f"{don.seam} donated it at line "
+                        f"{don.node.lineno} — the buffer has been "
+                        f"reused; rebind from the call's results, "
+                        f"sync back, or snapshot_tree() BEFORE the "
+                        f"donating call",
+                context=info.qualname))
+            flagged = True
+        return flagged
+
+    def _scan_call(self, node: ast.Call, order, info, donated, alias_of,
+                   donate_log):
+        func = node.func
+        leaf = _call_leaf(node)
+        # snapshot/sync-back: ownership re-established by convention
+        if leaf in _SNAPSHOT_FNS:
+            for arg in node.args:
+                k = _expr_key(arg)
+                if k is not None:
+                    donated.pop(k, None)
+            return
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
+            # a sync-back/rebind seam re-establishes ownership; clearing
+            # everything is the conservative (fewer-findings) choice
+            donated.clear()
+            return
+        don = self._donating_positions(info, node)
+        if don is not None:
+            pos, seam = don
+            for i, arg in enumerate(node.args):
+                if i not in pos:
+                    continue
+                k = _expr_key(arg)
+                if k is None:
+                    continue
+                donated[k] = _Donation(node, seam, order)
+                donate_log.append((order, k, node, seam))
+                # locals that alias INTO the donated tree die with it
+                for lname, root in alias_of.items():
+                    if root == k:
+                        donated[("local", lname)] = _Donation(
+                            node, seam, order, alias_of=_key_str(k))
+                        donate_log.append((order, ("local", lname),
+                                           node, seam))
+            return
+        # non-donating callee that reads a donated self attribute
+        if donated and info.cls and isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("self", "cls"):
+            for hit in self.lock._method_hits(info.cls, func.attr,
+                                              prefer_rel=info.relpath):
+                hs = self.summaries.get(hit)
+                if hs is None:
+                    continue
+                for attr in sorted(hs.attr_reads):
+                    key = ("attr", attr)
+                    if key not in donated:
+                        continue
+                    don2 = donated.pop(key)
+                    self.findings["use-after-donate"].append(Finding(
+                        rule="use-after-donate", path=info.relpath,
+                        line=node.lineno, col=node.col_offset,
+                        message=f"`self.{func.attr}()` reads "
+                                f"`self.{attr}` after {don2.seam} "
+                                f"donated it at line "
+                                f"{don2.node.lineno} — the callee "
+                                f"sees a reused buffer; sync back or "
+                                f"rebind before calling",
+                        context=info.qualname))
+                hs_rebinds = hs.attr_rebinds
+                for attr in list(donated):
+                    if attr[0] == "attr" and attr[1] in hs_rebinds:
+                        donated.pop(attr)
+
+    def _scan_store(self, st, order, info, donated, alias_of,
+                    alias_events, rebind_log):
+        targets: List[ast.AST] = []
+        value = None
+        if isinstance(st, ast.Assign):
+            targets, value = st.targets, st.value
+        elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+            if st.value is None:
+                return
+            targets, value = [st.target], st.value
+        elif isinstance(st, ast.Return) and st.value is not None:
+            k = self._alias_root(st.value, alias_of)
+            if k is not None:
+                alias_events.append((order, k, st,
+                                     "`return` hands out a reference "
+                                     "that"))
+            return
+        else:
+            # container.append(tree-leaf) aliases too
+            for call in self._stmt_calls(st):
+                if (isinstance(call.func, ast.Attribute)
+                        and call.func.attr in ("append", "add", "extend")
+                        and call.args):
+                    k = self._alias_root(call.args[0], alias_of)
+                    if k is not None:
+                        alias_events.append((
+                            order, k, call,
+                            f"`.{call.func.attr}(...)` stores a "
+                            "reference that"))
+            return
+        root = self._alias_root(value, alias_of) if value is not None \
+            else None
+        flat: List[ast.AST] = []
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                flat.extend(tgt.elts)
+            else:
+                flat.append(tgt)
+        for tgt in flat:
+            tk = _expr_key(tgt)
+            if tk is None:
+                continue
+            # rebind: ownership re-established (store, not read)
+            if tk in donated:
+                donated.pop(tk)
+            rebind_log.append((order, tk))
+            if tk[0] == "local":
+                if root is not None:
+                    alias_of[tk[1]] = root
+                else:
+                    alias_of.pop(tk[1], None)
+            elif tk[0] == "attr" and root is not None \
+                    and root != tk:
+                alias_events.append((order, root, st,
+                                     f"`self.{tk[1]} = ...` stores a "
+                                     "reference that"))
+
+    @staticmethod
+    def _alias_root(value: ast.AST, alias_of: Dict[str, Key]
+                    ) -> Optional[Key]:
+        """The tree a value aliases into: ``params`` / ``params[k]`` /
+        chained locals. Host copies (snapshot/asnumpy/np.array/...)
+        break the alias."""
+        if isinstance(value, ast.Call):
+            return None                  # calls produce fresh values
+        expr = value
+        while isinstance(expr, (ast.Subscript, ast.Starred)):
+            expr = expr.value
+        if isinstance(expr, ast.Name):
+            k = alias_of.get(expr.id)
+            if k is not None:
+                return k
+            if isinstance(value, (ast.Subscript, ast.Starred)):
+                return ("local", expr.id)
+            return None                  # bare name copy == same tree,
+            # tracked by donation directly, not as an alias event
+        k = _expr_key(expr)
+        if k is not None and isinstance(value, ast.Subscript):
+            return k
+        return None
+
+    # -- unbounded-device-retention -----------------------------------------
+
+    def _scan_retention(self):
+        for fn, info in self.lock.fns.items():
+            if isinstance(fn, ast.Lambda):
+                continue
+            deviceish = self._deviceish_locals(fn, info)
+            for loop in walk_own(fn):
+                if not isinstance(loop, (ast.For, ast.AsyncFor,
+                                         ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    if isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+                        continue
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ("append", "extend",
+                                                   "add")
+                            and node.args):
+                        continue
+                    cont = node.func.value
+                    if not self._unbounded_container(cont, fn, info):
+                        continue
+                    dev = self._device_value(node.args[0], deviceish,
+                                             info)
+                    if dev is None:
+                        continue
+                    cname = (dotted_name(cont) or "<container>")
+                    self.findings["unbounded-device-retention"].append(
+                        Finding(
+                            rule="unbounded-device-retention",
+                            path=info.relpath, line=node.lineno,
+                            col=node.col_offset,
+                            message=f"device array ({dev}) appended to "
+                                    f"unbounded host container "
+                                    f"`{cname}` inside a loop — every "
+                                    f"retained element pins its HBM "
+                                    f"buffer for the life of the "
+                                    f"process; convert to host at a "
+                                    f"report boundary (jax.device_get "
+                                    f"/ asnumpy / snapshot_tree) or "
+                                    f"bound the container "
+                                    f"(deque(maxlen=...), drain in "
+                                    f"get())",
+                            context=info.qualname))
+
+    def _deviceish_locals(self, fn, info) -> Set[str]:
+        """Locals holding device values: donating/jit call results
+        (incl. tuple-unpacks), jnp ops, aliases and subscripts of the
+        step's trees."""
+        out: Set[str] = set()
+        summary = self.summaries.get(fn)
+        changed = True
+        rounds = 0
+        while changed and rounds < 4:
+            changed = False
+            rounds += 1
+            for node in walk_own(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if self._device_value(node.value, out, info,
+                                      summary=summary) is None:
+                    continue
+                for tgt in node.targets:
+                    names = []
+                    if isinstance(tgt, ast.Name):
+                        names = [tgt.id]
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        names = [e.id for e in tgt.elts
+                                 if isinstance(e, ast.Name)]
+                    for n in names:
+                        if n not in out:
+                            out.add(n)
+                            changed = True
+        return out
+
+    def _device_value(self, value: ast.AST, deviceish: Set[str], info,
+                      summary=None) -> Optional[str]:
+        """A short description when ``value`` is device-resident;
+        None for host values (converted or scalar)."""
+        if summary is None:
+            summary = self.summaries.get(info.node)
+        if isinstance(value, ast.Tuple):
+            for elt in value.elts:
+                d = self._device_value(elt, deviceish, info, summary)
+                if d is not None:
+                    return d
+            return None
+        while isinstance(value, (ast.Subscript, ast.Starred)):
+            value = value.value
+        if isinstance(value, ast.Name):
+            if value.id in deviceish:
+                return f"`{value.id}`"
+            return None
+        if isinstance(value, ast.Call):
+            leaf = _call_leaf(value)
+            if leaf in _HOST_CONVERTERS:
+                return None
+            name = dotted_name(value.func) or ""
+            if name.startswith(("jnp.", "jax.numpy.")) \
+                    or name in ("jax.device_put",):
+                return f"`{name}(...)`"
+            don = self._donating_positions(info, value)
+            if don is not None:
+                return f"output of {don[1]}"
+            if isinstance(value.func, ast.Name) \
+                    and ((summary and value.func.id in summary.jits)
+                         or (info.relpath, value.func.id)
+                         in self.module_jits):
+                return f"output of jit `{value.func.id}`"
+            if isinstance(value.func, ast.Attribute) \
+                    and isinstance(value.func.value, ast.Name) \
+                    and value.func.value.id in ("self", "cls") \
+                    and info.cls:
+                wkey = (info.relpath, info.cls, value.func.attr)
+                if wkey in self.attr_jits:
+                    return f"output of jit `self.{value.func.attr}`"
+            return None
+        if (isinstance(value, ast.Attribute)
+                and isinstance(value.value, ast.Name)
+                and value.value.id in ("self", "cls")):
+            return None                  # plain attr read: unknown, skip
+        return None
+
+    def _unbounded_container(self, cont: ast.AST, fn, info) -> bool:
+        """A list/deque-without-maxlen attribute or local with NO drain
+        (clear/pop/del/reassign-empty) anywhere in its class/module."""
+        key = _expr_key(cont)
+        if key is None or key[0] != "attr" or not info.cls:
+            # a plain local dies with the function — only containers
+            # that outlive the loop (instance attributes) retain
+            return False
+        scope_fns: List[ast.AST] = []
+        init_seen = False
+        for hit_rel, _c in self.lock.classes.get(info.cls, ()):
+            scope_fns.extend(self.lock.methods.get(
+                (hit_rel, info.cls), {}).values())
+        for sfn in scope_fns:
+            for node in ast.walk(sfn):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        tk = _expr_key(tgt)
+                        if tk != key:
+                            continue
+                        k = self._container_ctor(node.value)
+                        if k == "unbounded":
+                            init_seen = True
+                        elif k == "bounded":
+                            return False
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("clear", "pop",
+                                               "popleft", "remove"):
+                    if _expr_key(node.func.value) == key:
+                        return False
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        while isinstance(t, ast.Subscript):
+                            t = t.value
+                        if _expr_key(t) == key:
+                            return False
+        return init_seen
+
+    @staticmethod
+    def _container_ctor(value: ast.AST) -> Optional[str]:
+        """'unbounded' for []/list()/deque() (no maxlen), 'bounded' for
+        deque(maxlen=...), None otherwise."""
+        if isinstance(value, ast.List) and not value.elts:
+            return "unbounded"
+        if isinstance(value, ast.Call):
+            leaf = _call_leaf(value)
+            if leaf == "list" and not value.args:
+                return "unbounded"
+            if leaf == "deque":
+                has_maxlen = any(kw.arg == "maxlen"
+                                 for kw in value.keywords) \
+                    or len(value.args) >= 2
+                return "bounded" if has_maxlen else "unbounded"
+        return None

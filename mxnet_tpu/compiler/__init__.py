@@ -28,18 +28,22 @@ from __future__ import annotations
 
 from typing import Dict
 
-from . import aot, cache, fingerprint, ir, passes  # noqa: F401
+from . import aot, cache, fingerprint, ir, memory, passes  # noqa: F401
 from .aot import PersistentJit, ProgramRegistry  # noqa: F401
 from .cache import CompilationCache, cache_enabled, default_cache  # noqa: F401
 from .fingerprint import (batch_signature, code_salt,  # noqa: F401
                           graph_fingerprint, mesh_signature, program_key)
 from .ir import GraphIR  # noqa: F401
+from .memory import (MemoryBudgetError, MemoryEstimate,  # noqa: F401
+                     estimate_peak_bytes)
 from .passes import (Annotate, CommonSubexpressionElimination,  # noqa: F401
                      DeadOpElimination, OptimizeResult, Pass, PassContext,
                      PassManager, RematPolicy, default_pass_manager,
                      optimize, register_annotator)
 
-__all__ = ["ir", "passes", "fingerprint", "cache", "aot", "GraphIR",
+__all__ = ["ir", "passes", "fingerprint", "cache", "aot", "memory",
+           "MemoryBudgetError", "MemoryEstimate", "estimate_peak_bytes",
+           "GraphIR",
            "Pass", "PassContext", "PassManager", "OptimizeResult",
            "DeadOpElimination", "CommonSubexpressionElimination",
            "RematPolicy", "Annotate", "register_annotator",

@@ -1,0 +1,477 @@
+"""The whole-program HBM memory model: static per-device byte accounting.
+
+ROADMAP items 2 (HBM/host/spilled placement oracle) and 3 (remat /
+partition autotuning under a cost model) both need trustworthy *static*
+byte accounting before anything runs; until now the only estimate in the
+tree was the remat pass's inline activation sum. This module is that
+accounting, in the shape the placement literature starts from (nncase's
+heterogeneous-storage planning, arxiv 2512.21571; ZeRO's state-partition
+arithmetic, arxiv 2004.13336): a liveness-scan peak estimator over
+:class:`~.ir.GraphIR` that prices every contributor a training or
+serving bind will keep resident —
+
+* **params** — the weight tree, shard-adjusted by the
+  :class:`~mxnet_tpu.parallel.sharding.ShardingPlan` param specs and
+  storage-narrowed by the quantization decision (``annotations['quant']``);
+* **grads** — one cotangent per trainable param, on the plan's grad
+  layout (ZeRO-2 pins it to the state shard);
+* **optimizer_state** — per-slot state (sgd momentum, adam mean+var,
+  ...), divided by the plan's ZeRO degree exactly as the runtime shards
+  it;
+* **activations** — forward intermediates: with remat OFF a training
+  step holds every activation for the backward (the sum); with remat ON
+  (or for inference) only the liveness-scan peak of the forward walk is
+  resident;
+* **inputs_aux** — batch data/labels (split over the data axis) plus
+  aux state (BatchNorm running stats, replicated).
+
+Two consumers:
+
+* the **remat policy pass** (:mod:`.passes`) prices its
+  memory-vs-recompute decision with :func:`activation_bytes`;
+* the **bind-time budget gate**: ``MXTPU_HBM_BUDGET_MB`` makes
+  ``FusedStep`` / ``SPMDTrainer.bind`` call :func:`check_budget` and
+  raise a typed :class:`MemoryBudgetError` naming the top contributors
+  and the knobs that would fit the program (ZeRO, ``MXTPU_REMAT_MB``,
+  int8) — the framework's own error at bind, not an XLA allocation
+  failure at step one.
+
+``python -m mxnet_tpu.analysis --only memory --report-hbm`` prints the
+breakdown for the bundled reference micro-models under the current env
+knobs (docs/how_to/performance.md).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, getenv
+
+__all__ = ["MemoryBudgetError", "MemoryEstimate", "estimate_peak_bytes",
+           "activation_bytes", "liveness_peak_bytes", "state_slots",
+           "check_budget", "hbm_budget_mb", "reference_report"]
+
+_MB = float(1 << 20)
+
+# storage bytes per element of the quantized formats the PTQ path ships
+# (quant/core.py FORMATS — kept as data here so the estimator never
+# imports the quant stack at bind time)
+_QUANT_ITEMSIZE = {"int8": 1, "fp8_e4m3": 1, "fp8_e5m2": 1}
+
+
+class MemoryBudgetError(MXNetError):
+    """A bind's estimated peak HBM exceeds ``MXTPU_HBM_BUDGET_MB``.
+
+    Raised by the FusedStep / SPMDTrainer bind gate BEFORE any state is
+    replaced (and before XLA ever sees the program), with the
+    per-contributor breakdown and the knobs that would fit the program
+    in the message. Carries the :class:`MemoryEstimate` as
+    ``.estimate`` for programmatic consumers."""
+
+    def __init__(self, message: str, estimate: "MemoryEstimate" = None):
+        super().__init__(message)
+        self.estimate = estimate
+
+
+# ---------------------------------------------------------------------------
+# struct inference helpers
+# ---------------------------------------------------------------------------
+
+def _infer(ir, input_shapes, input_dtypes):
+    """(structs, bytes-by-node-id) — None when shapes can't infer.
+    ``structs`` maps ``(node id, output idx) -> ShapeDtypeStruct`` with
+    ids matching ``id(n)`` over ``ir.nodes``."""
+    try:
+        sym = ir.to_symbol()
+        structs = sym._infer_structs(dict(input_shapes),
+                                     dtypes=dict(input_dtypes or {}))
+    except Exception:  # noqa: BLE001 — an estimate, never a bind error
+        return None
+    if structs is None:
+        return None
+    by_node: Dict[int, int] = {}
+    for (nid, _idx), s in structs["structs"].items():
+        size = 1
+        for d in s.shape:
+            size *= int(d)
+        by_node[nid] = by_node.get(nid, 0) + size * s.dtype.itemsize
+    return structs["structs"], by_node
+
+
+def activation_bytes(ir, input_shapes, input_dtypes=None) -> Optional[int]:
+    """Total forward-activation bytes: every non-variable output, all
+    live at once — what a no-remat training step holds for the
+    backward. This is the term the remat-policy pass compares against
+    ``MXTPU_REMAT_MB`` (the pre-existing decision, unchanged)."""
+    inf = _infer(ir, input_shapes, input_dtypes)
+    if inf is None:
+        return None
+    _, by_node = inf
+    var_ids = {id(n) for n in ir.nodes if n.is_variable}
+    return sum(b for nid, b in by_node.items() if nid not in var_ids)
+
+
+def liveness_peak_bytes(ir, input_shapes, input_dtypes=None
+                        ) -> Optional[int]:
+    """Peak live activation bytes of one forward walk in topo order: a
+    node's outputs are allocated when it runs and an input is freed
+    after its last consumer — the resident set when the backward does
+    NOT pin activations (remat on, or inference)."""
+    inf = _infer(ir, input_shapes, input_dtypes)
+    if inf is None:
+        return None
+    _, by_node = inf
+    consumers: Dict[int, int] = {}
+    for n in ir.nodes:
+        for p, _i in n.inputs:
+            consumers[id(p)] = consumers.get(id(p), 0) + 1
+    graph_outs = {id(n) for n, _i in ir.outputs}
+    live = peak = 0
+    for n in ir.nodes:
+        if n.is_variable:
+            continue
+        live += by_node.get(id(n), 0)
+        peak = max(peak, live)
+        for p, _i in n.inputs:
+            if p.is_variable:
+                continue
+            consumers[id(p)] -= 1
+            if consumers[id(p)] == 0 and id(p) not in graph_outs:
+                live -= by_node.get(id(p), 0)
+    return peak
+
+
+# ---------------------------------------------------------------------------
+# sharding arithmetic
+# ---------------------------------------------------------------------------
+
+def _spec_divisor(spec, mesh) -> int:
+    """How many ways a spec splits a tensor: the product of the mesh
+    axis sizes the spec names (duck-typed — no parallel/ import)."""
+    if spec is None or mesh is None:
+        return 1
+    sizes = dict(getattr(mesh, "shape", {}) or {})
+    div = 1
+    for entry in spec:
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for ax in axes:
+            if ax is not None:
+                div *= int(sizes.get(ax, 1))
+    return max(1, div)
+
+
+def state_slots(optimizer) -> int:
+    """Per-parameter optimizer-state slot count of the fused update
+    (step_runtime's functional rules): adam keeps mean+var, rmsprop one
+    accumulator, sgd/nag one momentum buffer (none when momentum=0)."""
+    if optimizer is None:
+        return 0
+    if isinstance(optimizer, int):
+        return optimizer
+    kind = (optimizer if isinstance(optimizer, str)
+            else type(optimizer).__name__).lower()
+    if kind == "adam":
+        return 2
+    if kind == "rmsprop":
+        return 1
+    if kind in ("sgd", "nag"):
+        mom = getattr(optimizer, "momentum", 1.0) \
+            if not isinstance(optimizer, str) else 1.0
+        return 1 if mom else 0
+    return 1        # unknown rule: assume one slot, never undercount to 0
+
+
+# ---------------------------------------------------------------------------
+# the estimate
+# ---------------------------------------------------------------------------
+
+class MemoryEstimate:
+    """Per-device peak-HBM estimate with a per-contributor breakdown.
+
+    ``contributors`` maps contributor name -> bytes; ``arrays`` keeps
+    the largest individual tensors per contributor for diagnostics;
+    ``notes`` records the adjustments applied (zero degree, remat,
+    quantized param count, data-axis split)."""
+
+    ORDER = ("params", "grads", "optimizer_state", "activations",
+             "inputs_aux")
+
+    def __init__(self, contributors: Dict[str, int],
+                 arrays: Dict[str, List[Tuple[str, int]]],
+                 notes: Dict[str, object]):
+        self.contributors = dict(contributors)
+        self.arrays = {k: list(v) for k, v in arrays.items()}
+        self.notes = dict(notes)
+
+    @property
+    def total(self) -> int:
+        return sum(self.contributors.values())
+
+    @property
+    def total_mb(self) -> float:
+        return self.total / _MB
+
+    def top(self, n: int = 3) -> List[Tuple[str, int]]:
+        """The n largest contributors, largest first."""
+        return sorted(self.contributors.items(),
+                      key=lambda kv: (-kv[1], kv[0]))[:n]
+
+    def format_breakdown(self) -> str:
+        lines = [f"{'contributor':<16} {'MB':>10}   largest arrays"]
+        for name in self.ORDER:
+            if name not in self.contributors:
+                continue
+            tops = ", ".join(f"{n} {b / _MB:.2f}MB"
+                             for n, b in self.arrays.get(name, ())[:3])
+            lines.append(f"{name:<16} {self.contributors[name] / _MB:>10.2f}"
+                         f"   {tops}")
+        extra = sorted(set(self.contributors) - set(self.ORDER))
+        for name in extra:
+            lines.append(f"{name:<16} {self.contributors[name] / _MB:>10.2f}")
+        lines.append(f"{'peak total':<16} {self.total_mb:>10.2f}   "
+                     + "; ".join(f"{k}={v}" for k, v
+                                 in sorted(self.notes.items())))
+        return "\n".join(lines)
+
+
+_DATAISH = ("data", "label")
+
+
+def _looks_like_io(name: str) -> bool:
+    low = name.lower()
+    return any(low == d or low.endswith(d) for d in _DATAISH)
+
+
+def estimate_peak_bytes(ir, plan=None, input_shapes=None, input_dtypes=None,
+                        param_names: Optional[Sequence[str]] = None,
+                        data_names: Optional[Sequence[str]] = None,
+                        optimizer=None, for_training: bool = True,
+                        remat: bool = False,
+                        quant: Optional[Dict[str, str]] = None
+                        ) -> Optional[MemoryEstimate]:
+    """Estimate one device's peak HBM for a bind of ``ir``.
+
+    ``plan`` is a :class:`~mxnet_tpu.parallel.sharding.ShardingPlan`
+    (or None for single-device); ``param_names`` the trainable set
+    (default: every graph variable that is not data/label-shaped by
+    name); ``optimizer`` an optimizer instance, kind string, or slot
+    count; ``quant`` the ``annotations['quant']`` map of param ->
+    format. Returns None when shapes cannot be inferred — the estimate
+    must never turn a working bind into an error on its own.
+
+    Activations and batch inputs are divided by the plan's data-axis
+    size (batch-major sharding); model-parallel activation splits are
+    not modeled — the estimate is deliberately conservative there.
+    """
+    input_shapes = dict(input_shapes or {})
+    input_dtypes = dict(input_dtypes or {})
+    inf = _infer(ir, input_shapes, input_dtypes)
+    if inf is None:
+        return None
+    structs, _by_node = inf
+
+    var_struct = {}
+    for n in ir.nodes:
+        if n.is_variable:
+            s = structs.get((id(n), 0))
+            if s is not None:
+                var_struct[n.name] = s
+    if param_names is None:
+        param_names = [n for n in var_struct if not _looks_like_io(n)]
+        data_names = [n for n in var_struct if _looks_like_io(n)]
+    param_set = set(param_names)
+    if data_names is None:
+        data_names = [n for n in var_struct
+                      if n not in param_set and _looks_like_io(n)]
+    data_set = set(data_names)
+    aux_names = [n for n in var_struct
+                 if n not in param_set and n not in data_set]
+
+    mesh = getattr(plan, "mesh", None)
+    data_axis = getattr(plan, "data_axis", "data")
+    dsize = int(dict(getattr(mesh, "shape", {}) or {}).get(data_axis, 1)) \
+        if mesh is not None else 1
+    quant = quant or {}
+
+    def nbytes(struct, itemsize=None):
+        size = 1
+        for d in struct.shape:
+            size *= int(d)
+        return size * int(itemsize or struct.dtype.itemsize)
+
+    contributors: Dict[str, int] = {}
+    arrays: Dict[str, List[Tuple[str, int]]] = {}
+
+    def add(cat: str, name: str, b: int):
+        contributors[cat] = contributors.get(cat, 0) + int(b)
+        arrays.setdefault(cat, []).append((name, int(b)))
+
+    slots = state_slots(optimizer) if for_training else 0
+    for name in param_names:
+        s = var_struct.get(name)
+        if s is None:
+            continue
+        q_item = _QUANT_ITEMSIZE.get(quant.get(name))
+        pdiv = _spec_divisor(plan.param_spec(name, s.shape), mesh) \
+            if plan is not None else 1
+        add("params", name, nbytes(s, q_item) // pdiv)
+        if for_training:
+            gdiv = _spec_divisor(plan.grad_spec(name, s.shape), mesh) \
+                if plan is not None else 1
+            add("grads", name, nbytes(s) // gdiv)
+            if slots:
+                sdiv = _spec_divisor(plan.state_spec(name, s.shape), mesh) \
+                    if plan is not None else 1
+                add("optimizer_state", name, slots * (nbytes(s) // sdiv))
+    if for_training and "grads" not in contributors:
+        contributors["grads"] = 0
+    if for_training and slots and "optimizer_state" not in contributors:
+        contributors["optimizer_state"] = 0
+
+    if for_training and not remat:
+        act = activation_bytes(ir, input_shapes, input_dtypes)
+    else:
+        act = liveness_peak_bytes(ir, input_shapes, input_dtypes)
+    if act is not None:
+        contributors["activations"] = int(act) // dsize
+        sizes = sorted(((n.name, _by_node.get(id(n), 0) // dsize)
+                        for n in ir.nodes if not n.is_variable),
+                       key=lambda kv: -kv[1])
+        arrays["activations"] = sizes[:8]
+
+    for name in list(data_set) + aux_names:
+        s = var_struct.get(name)
+        if s is None:
+            continue
+        add("inputs_aux", name,
+            nbytes(s) // (dsize if name in data_set else 1))
+
+    for cat in arrays:
+        arrays[cat] = sorted(arrays[cat], key=lambda kv: -kv[1])[:8]
+    notes = {"zero_degree": getattr(plan, "zero_degree", 1)
+             if plan is not None else 1,
+             "data_degree": dsize,
+             "remat": bool(remat),
+             "state_slots": slots,
+             "quantized_params": sum(1 for n in param_names if n in quant),
+             "training": bool(for_training)}
+    return MemoryEstimate(contributors, arrays, notes)
+
+
+# ---------------------------------------------------------------------------
+# the bind-time budget gate
+# ---------------------------------------------------------------------------
+
+def hbm_budget_mb() -> Optional[float]:
+    """The ``MXTPU_HBM_BUDGET_MB`` knob (None = gate off)."""
+    return getenv("MXTPU_HBM_BUDGET_MB", None, float)
+
+
+def check_budget(estimate: Optional[MemoryEstimate],
+                 budget_mb: Optional[float], name: str,
+                 plan=None) -> None:
+    """Raise :class:`MemoryBudgetError` when ``estimate`` exceeds the
+    budget, naming the top contributors and the knobs that would fit
+    the program. A None estimate (shapes not inferable) never gates —
+    the model may only ever refuse programs it can actually price."""
+    if estimate is None or budget_mb is None:
+        return
+    if estimate.total <= budget_mb * _MB:
+        return
+    tops = ", ".join(f"{n} {b / _MB:.1f} MB" for n, b in estimate.top(3))
+    knobs: List[str] = []
+    c = estimate.contributors
+    state_b = c.get("optimizer_state", 0) + c.get("grads", 0)
+    zero_on = bool(getattr(plan, "zero", False))
+    data_degree = int(estimate.notes.get("data_degree", 1) or 1)
+    if state_b and not zero_on and data_degree > 1:
+        knobs.append("shard_optimizer_state / MXTPU_ZERO=1 (ZeRO splits "
+                     f"optimizer state {data_degree}x over the data axis)")
+    if c.get("activations", 0) and not estimate.notes.get("remat"):
+        act_mb = c["activations"] / _MB
+        knobs.append(f"MXTPU_REMAT_MB={max(1, int(act_mb // 2))} "
+                     "(recompute activations in the backward instead of "
+                     f"holding {act_mb:.1f} MB)")
+    if not estimate.notes.get("quantized_params"):
+        knobs.append("int8 post-training quantization for serving "
+                     "(MXTPU_QUANT=1, docs/how_to/quantization.md)")
+    raise MemoryBudgetError(
+        f"{name}: estimated peak HBM {estimate.total_mb:.1f} MB per "
+        f"device exceeds MXTPU_HBM_BUDGET_MB={budget_mb:g} — top "
+        f"contributors: {tops}; knobs that would fit it: "
+        + ("; ".join(knobs) if knobs else "none — shrink the model or "
+           "raise the budget")
+        + f"\n{estimate.format_breakdown()}", estimate)
+
+
+# ---------------------------------------------------------------------------
+# the CLI report (--only memory --report-hbm)
+# ---------------------------------------------------------------------------
+
+def _micro_lstm_symbol():
+    from .. import symbol as sym
+    data = sym.Variable("data")
+    rnn = sym.RNN(data, state_size=32, num_layers=1, mode="lstm",
+                  name="lstm")
+    fc = sym.FullyConnected(rnn, num_hidden=16, name="fc",
+                            flatten=False)
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _micro_resnet_symbol():
+    from .. import symbol as sym
+    data = sym.Variable("data")
+    body = sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                           pad=(1, 1), name="conv0")
+    bn = sym.BatchNorm(body, name="bn0")
+    act = sym.Activation(bn, act_type="relu")
+    conv1 = sym.Convolution(act, num_filter=8, kernel=(3, 3),
+                            pad=(1, 1), name="conv1")
+    res = conv1 + body                       # the residual join
+    pool = sym.Pooling(res, kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    flat = sym.Flatten(pool)
+    fc = sym.FullyConnected(flat, num_hidden=10, name="fc")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def reference_report() -> str:
+    """The ``--report-hbm`` text: per-contributor breakdowns for the
+    bundled reference micro-models (the profile-harness shapes) under
+    the CURRENT env knobs — MXTPU_ZERO / MXTPU_REMAT_MB /
+    MXTPU_HBM_BUDGET_MB all visibly move the numbers, so the report
+    doubles as a knob-impact explainer (docs/how_to/performance.md)."""
+    from .ir import GraphIR
+    models = [
+        ("micro-LSTM", _micro_lstm_symbol(),
+         {"data": (8, 16, 32), "softmax_label": (8, 16)}),
+        ("micro-ResNet", _micro_resnet_symbol(),
+         {"data": (8, 3, 16, 16), "softmax_label": (8,)}),
+    ]
+    budget = hbm_budget_mb()
+    remat_mb = getenv("MXTPU_REMAT_MB", None, float)
+    out = ["HBM footprint report (estimate_peak_bytes over the reference "
+           "micro-models; knobs: MXTPU_ZERO, MXTPU_REMAT_MB, "
+           "MXTPU_HBM_BUDGET_MB)"]
+    for name, symb, shapes in models:
+        arg_shapes, _, aux_shapes = symb.infer_shape(**shapes)
+        all_shapes = dict(zip(symb.list_arguments(), arg_shapes))
+        all_shapes.update(zip(symb.list_auxiliary_states(), aux_shapes))
+        ir = GraphIR.from_symbol(symb)
+        act = activation_bytes(ir, all_shapes, None)
+        remat = bool(remat_mb is not None and act is not None
+                     and act > remat_mb * _MB)
+        param_names = [n for n in symb.list_arguments()
+                       if n not in shapes]
+        est = estimate_peak_bytes(
+            ir, input_shapes=all_shapes,
+            param_names=param_names, data_names=list(shapes),
+            optimizer="sgd", for_training=True, remat=remat)
+        out.append(f"\n== {name} ==")
+        if est is None:
+            out.append("  (shapes not inferable)")
+            continue
+        out.append(est.format_breakdown())
+        if budget is not None:
+            verdict = ("OVER" if est.total > budget * _MB else "within")
+            out.append(f"budget MXTPU_HBM_BUDGET_MB={budget:g}: {verdict}")
+    return "\n".join(out)

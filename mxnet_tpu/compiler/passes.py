@@ -241,24 +241,11 @@ class RematPolicy(Pass):
 
     @staticmethod
     def _activation_bytes(ir: GraphIR, ctx: PassContext):
-        try:
-            sym = ir.to_symbol()
-            structs = sym._infer_structs(ctx.input_shapes,
-                                         dtypes=ctx.input_dtypes)
-        except Exception:  # noqa: BLE001 — an estimate, never a bind error
-            return None
-        if structs is None:
-            return None
-        var_ids = {id(n) for n in ir.nodes if n.is_variable}
-        total = 0
-        for (nid, _idx), s in structs["structs"].items():
-            if nid in var_ids:
-                continue            # parameters are resident regardless
-            size = 1
-            for d in s.shape:
-                size *= int(d)
-            total += size * s.dtype.itemsize
-        return total
+        # the memory model owns byte accounting now (compiler/memory.py);
+        # this term — every non-variable output, all live at once — is
+        # unchanged, so remat decisions are stable across the refactor
+        from .memory import activation_bytes
+        return activation_bytes(ir, ctx.input_shapes, ctx.input_dtypes)
 
 
 _ANNOTATORS: List[Callable] = []

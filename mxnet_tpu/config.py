@@ -98,6 +98,13 @@ register_knob("MXTPU_REMAT_MB", float, None,
               "activation-memory budget: a training bind whose estimated "
               "forward activations exceed it gets jax.checkpoint remat "
               "(the remat-policy pass decision)")
+register_knob("MXTPU_HBM_BUDGET_MB", float, None,
+              "per-device peak-HBM budget: a FusedStep/SPMDTrainer bind "
+              "whose estimated footprint (compiler/memory.py: params + "
+              "grads + optimizer state + live activations) exceeds it "
+              "raises a typed MemoryBudgetError naming the top "
+              "contributors and the knobs that would fit it (ZeRO, "
+              "MXTPU_REMAT_MB, int8) instead of dying in XLA allocation")
 register_knob("MXTPU_OP_COSTS", str, None,
               "json file of measured per-op ms (profile harness output) "
               "pricing the remat-policy recompute estimate")

@@ -169,6 +169,42 @@ class SPMDTrainer:
         param_names = [n for n in arg_names if n not in io_names]
         shapes = dict(zip(arg_names, arg_shapes))
 
+        # graph passes with the now-known bind shapes (remat budget can
+        # price the activations); re-run on every (re)bind — a remesh
+        # changes nothing structural, so the fingerprint is stable.
+        # Runs HERE, before any param/state allocation, so the HBM
+        # budget gate below fails while the trainer is still intact
+        # (same contract as the divisibility wall above).
+        from .. import compiler as _compiler
+        all_shapes = dict(shapes)
+        all_shapes.update(dict(zip(aux_names, aux_shapes)))
+        # plan_scope: the sharding annotator stamps this plan's specs +
+        # signature into the IR annotations, so transform_sig (and every
+        # program key derived from it) carries the sharding layout
+        with plan_scope(plan):
+            self._opt_res = _compiler.optimize(
+                self._symbol, for_training=True,
+                input_shapes=all_shapes,
+                input_dtypes={n: str(self._dtype) for n in all_shapes})
+        # bind-time HBM budget gate (MXTPU_HBM_BUDGET_MB): over budget
+        # raises the typed MemoryBudgetError naming the contributors
+        # and fitting knobs (ZeRO, MXTPU_REMAT_MB, int8) BEFORE any
+        # state is replaced — never an XLA allocation death at step one
+        _budget = _compiler.memory.hbm_budget_mb()
+        if _budget is not None:
+            from ..base import getenv as _getenv
+            _est = _compiler.memory.estimate_peak_bytes(
+                _compiler.GraphIR.from_symbol(self._opt_res.symbol),
+                plan=plan, input_shapes=all_shapes,
+                input_dtypes={n: str(self._dtype) for n in all_shapes},
+                param_names=param_names, optimizer=self._optimizer,
+                for_training=True,
+                remat=bool(self._opt_res.remat
+                           or _getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int)),
+                quant=self._opt_res.annotations.get("quant"))
+            _compiler.memory.check_budget(
+                _est, _budget, "SPMDTrainer.bind", plan=plan)
+
         mesh = self._mesh
         layouts = self._symbol._arg_layouts()
         params = {}
@@ -237,20 +273,8 @@ class SPMDTrainer:
                       for n in param_names}
         lr_mult = {n: float(self._optimizer.lr_mult.get(n, 1.0))
                    for n in param_names}
-        # graph passes with the now-known bind shapes (remat budget can
-        # price the activations); re-run on every (re)bind — a remesh
-        # changes nothing structural, so the fingerprint is stable
-        from .. import compiler as _compiler
-        all_shapes = dict(shapes)
-        all_shapes.update(dict(zip(aux_names, aux_shapes)))
-        # plan_scope: the sharding annotator stamps this plan's specs +
-        # signature into the IR annotations, so transform_sig (and every
-        # program key derived from it) carries the sharding layout
-        with plan_scope(plan):
-            self._opt_res = _compiler.optimize(
-                self._symbol, for_training=True,
-                input_shapes=all_shapes,
-                input_dtypes={n: str(self._dtype) for n in all_shapes})
+        # (graph passes already ran above, pre-allocation, feeding the
+        # HBM budget gate; only the fingerprint/eval build remains here)
         self._graph_fingerprint = _compiler.graph_fingerprint(
             self._opt_res.symbol)
         self._eval_fn = build_graph_eval(self._opt_res.symbol)

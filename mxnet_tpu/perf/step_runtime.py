@@ -543,6 +543,21 @@ class FusedStep:
         # (with passes on, the remat-policy pass already folds it in)
         self._remat = bool(opt_res.remat
                            or getenv("MXTPU_BACKWARD_DO_MIRROR", 0, int))
+        # bind-time HBM budget gate (MXTPU_HBM_BUDGET_MB): price the
+        # program while nothing has been traced or replaced — over
+        # budget is the framework's typed MemoryBudgetError naming the
+        # contributors + fitting knobs, not an XLA allocation failure
+        budget = _compiler.memory.hbm_budget_mb()
+        if budget is not None and input_shapes:
+            est = _compiler.memory.estimate_peak_bytes(
+                _compiler.GraphIR.from_symbol(opt_sym), plan=self.plan,
+                input_shapes=input_shapes, input_dtypes=input_dtypes,
+                param_names=self._param_names, optimizer=optimizer,
+                for_training=True, remat=self._remat,
+                quant=opt_res.annotations.get("quant"))
+            _compiler.memory.check_budget(est, budget,
+                                          f"FusedStep({name!r}) bind",
+                                          plan=self.plan)
         self._eval_fn = build_graph_eval(opt_sym)
         self.needs_rng = bool(getattr(self._eval_fn, "needs_rng", True))
         self.layouts = {n: lo for n, lo in plan_param_layouts(opt_sym).items()
@@ -1023,6 +1038,7 @@ def module_stepper(module, compute_dtype=None, donate=True, mesh=None,
     with no kvstore. The module's bound batch is the GLOBAL batch and
     must divide over the data axis.
     """
+    from ..compiler.memory import MemoryBudgetError
     if not getenv("MXTPU_FUSED_STEP", 1, int):
         return None
     if sharding is not None and mesh is None:
@@ -1080,6 +1096,9 @@ def module_stepper(module, compute_dtype=None, donate=True, mesh=None,
                           mesh=mesh, sharding=sharding,
                           loss_scale=loss_scale)
         stepper = ModuleStepper(module, fused, frozen)
+    except MemoryBudgetError:
+        raise       # the budget gate must surface, never silently
+        # degrade into the (equally over-budget) imperative fallback
     except MXNetError:
         return None
     # register on the module so get_params / checkpointing / the classic

@@ -1,0 +1,573 @@
+"""Memory-tier lint suite: each donated-buffer lifetime checker proves
+true positives AND true negatives on fixture snippets, plus inline
+suppression, cross-call and cross-module donation propagation, the
+`--only memory` CLI filter and `--report-hbm`, and the self-lint
+contract — the committed tree's memory baseline is ZERO
+(docs/how_to/tpu_lint.md, "Memory checkers")."""
+import json
+import os
+import textwrap
+
+from mxnet_tpu.analysis import core
+from mxnet_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MEMORY_RULES = {"use-after-donate", "donation-alias-leak",
+                "unbounded-device-retention"}
+
+
+def run_lint(tmp_path, name="snippet.py", source="", extra=None):
+    """Write fixture file(s) under tmp_path and lint them all."""
+    files = {name: source, **(extra or {})}
+    paths = []
+    for rel, src in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src))
+        paths.append(str(full))
+    return core.lint(paths, root=str(tmp_path))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def of_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# use-after-donate
+# ---------------------------------------------------------------------------
+
+def test_use_after_donate_loop_without_rebind(tmp_path):
+    """The canonical bug: a donating step called in a loop with the
+    same tree every iteration — iteration 2 reads the buffer
+    iteration 1 donated."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0, 1))
+
+            def train(self, params, state, batches):
+                for b in batches:
+                    self._step(params, state, b)   # result dropped!
+                return params
+    """)
+    hits = of_rule(findings, "use-after-donate")
+    assert hits, "loop read-after-donate must be caught"
+    assert any("`params`" in h.message for h in hits)
+    assert any("donating jit `self._step`" in h.message for h in hits)
+    assert all("rebind" in h.message for h in hits)
+
+
+def test_use_after_donate_through_donating_class(tmp_path):
+    """A FusedStep-typed attribute donates its (params, states, aux)
+    positions; reading the tree after the call — without rebinding —
+    is the bug, even with no jax.jit in sight."""
+    findings = run_lint(tmp_path, source="""
+        class Harness:
+            def __init__(self, step):
+                self._fused = FusedStep(step)
+
+            def run_once(self, params, states, aux, batch):
+                outs = self._fused(params, states, aux, batch)
+                return params, outs     # params was donated
+    """)
+    hits = of_rule(findings, "use-after-donate")
+    assert len(hits) == 1
+    assert "`params`" in hits[0].message
+    assert "FusedStep" in hits[0].message
+
+
+def test_use_after_donate_cross_call_propagation(tmp_path):
+    """Donation propagates through a helper: `advance` passes its
+    parameter to a donating jit, so calling `advance(params, b)`
+    donates the caller's tree too."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Runner:
+            def __init__(self, fn):
+                self._fn = jax.jit(fn, donate_argnums=(0,))
+
+            def advance(self, params, b):
+                return self._fn(params, b)
+
+        class Loop:
+            def __init__(self, fn):
+                self._runner = Runner(fn)
+
+            def train(self, params, batches):
+                for b in batches:
+                    self._runner.advance(params, b)
+                return params
+    """)
+    hits = of_rule(findings, "use-after-donate")
+    assert hits, "cross-call donation must propagate"
+    assert any("`params`" in h.message
+               and h.context == "Loop.train" for h in hits)
+
+
+def test_use_after_donate_cross_module_propagation(tmp_path):
+    """The donating seam lives in another module; the typed-attribute
+    resolution carries the donation summary across files."""
+    findings = run_lint(
+        tmp_path, name="pkg/loop.py", source="""
+            from .runner import Runner
+
+            class Loop:
+                def __init__(self, fn):
+                    self._runner = Runner(fn)
+
+                def train(self, params, batches):
+                    for b in batches:
+                        self._runner.advance(params, b)
+                    return params
+        """,
+        extra={"pkg/runner.py": """
+            import jax
+
+            class Runner:
+                def __init__(self, fn):
+                    self._fn = jax.jit(fn, donate_argnums=(0,))
+
+                def advance(self, params, b):
+                    return self._fn(params, b)
+        """})
+    hits = of_rule(findings, "use-after-donate")
+    assert any(h.path == "pkg/loop.py" and h.context == "Loop.train"
+               for h in hits)
+
+
+def test_use_after_donate_module_level_wrapper(tmp_path):
+    """`step = jax.jit(fn, donate_argnums=...)` at module level is a
+    donating seam for every function in the module."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        def _raw(params, b):
+            return params
+
+        step = jax.jit(_raw, donate_argnums=(0,))
+
+        def drive(params, batches):
+            for b in batches:
+                step(params, b)
+            return params
+    """)
+    hits = of_rule(findings, "use-after-donate")
+    assert hits and any(h.context == "drive" for h in hits)
+
+
+def test_rebind_pattern_is_clean(tmp_path):
+    """TN: the documented pattern — rebind every tree from the call's
+    results — never flags, in or out of a loop."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0, 1))
+
+            def train(self, params, state, batches):
+                for b in batches:
+                    params, state = self._step(params, state, b)
+                return params, state
+    """)
+    assert not of_rule(findings, "use-after-donate")
+
+
+def test_snapshot_and_sync_back_are_clean(tmp_path):
+    """TN: snapshot_tree() re-establishes ownership by convention, and
+    a sync-back seam (refresh/sync_to_module/bind) clears the window."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+        from mxnet_tpu.resilience import snapshot_tree
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def checkpointed(self, params, b):
+                self._step(params, b)
+                snapshot_tree(params)       # host copy boundary
+                return params
+
+            def synced(self, params, b):
+                self._step(params, b)
+                self.refresh()              # sync-back seam
+                return params
+    """)
+    assert not of_rule(findings, "use-after-donate")
+
+
+def test_exception_fallback_read_is_clean(tmp_path):
+    """TN: on the exceptional path the donating call never completed —
+    the retry/fallback read (the PersistentJit.__call__ shape) is
+    legitimate."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Wrapper:
+            def __init__(self, fn):
+                self._jit = jax.jit(fn, donate_argnums=(0,))
+
+            def __call__(self, params, b):
+                try:
+                    return self._jit(params, b)
+                except ValueError:
+                    return self._fallback(params, b)
+
+            def _fallback(self, params, b):
+                return params
+    """)
+    assert not of_rule(findings, "use-after-donate")
+
+
+def test_branches_do_not_poison_each_other(tmp_path):
+    """TN: a donating call in the if-arm must not flag the read in the
+    else-arm — only one path executes (the FusedStep.__call__ shape)."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Step:
+            def __init__(self, fn):
+                self._fn = jax.jit(fn, donate_argnums=(0,))
+
+            def __call__(self, params, b, fast):
+                if fast:
+                    return self._fn(params, b)
+                return self._fn(params, b)
+    """)
+    assert not of_rule(findings, "use-after-donate")
+
+
+def test_use_after_donate_suppression(tmp_path):
+    """`# tpu-lint: disable=use-after-donate` on the read silences that
+    line and only that line."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def train(self, params, batches):
+                for b in batches:
+                    self._step(params, b)  # tpu-lint: disable=use-after-donate
+                return params  # tpu-lint: disable=use-after-donate
+    """)
+    assert not of_rule(findings, "use-after-donate")
+
+
+# ---------------------------------------------------------------------------
+# donation-alias-leak
+# ---------------------------------------------------------------------------
+
+def test_alias_leak_self_attr_store_before_donation(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0, 1))
+
+            def cache_and_step(self, params, state, b):
+                self._w0 = params["w0"]        # dies with the donation
+                params, state = self._step(params, state, b)
+                return params, state
+    """)
+    hits = of_rule(findings, "donation-alias-leak")
+    assert len(hits) == 1
+    assert "`params`" in hits[0].message
+    assert "snapshot_tree" in hits[0].message
+
+
+def test_alias_leak_append_before_donation(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+                self._log = []
+
+            def log_and_step(self, params, b):
+                self._log.append(params["loss_w"])   # leaks
+                params = self._step(params, b)
+                return params
+    """)
+    hits = of_rule(findings, "donation-alias-leak")
+    assert len(hits) == 1
+    assert ".append" in hits[0].message
+
+
+def test_alias_after_donating_call_is_clean(tmp_path):
+    """TN: aliasing the REBOUND tree (the call's result) is the fix the
+    message recommends — never flagged."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def step_then_cache(self, params, b):
+                params = self._step(params, b)
+                self._w0 = params["w0"]     # alias of the new tree
+                return params
+    """)
+    assert not of_rule(findings, "donation-alias-leak")
+
+
+def test_snapshot_alias_is_clean(tmp_path):
+    """TN: snapshot_tree() deep-copies to host — storing the snapshot
+    is the documented safe idiom (resilience/async_checkpoint.py)."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+        from mxnet_tpu.resilience import snapshot_tree
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def snap_and_step(self, params, b):
+                self._snap = snapshot_tree(params)
+                params = self._step(params, b)
+                return params
+    """)
+    assert not of_rule(findings, "donation-alias-leak")
+
+
+def test_rebind_between_alias_and_donation_is_clean(tmp_path):
+    """TN: a rebind of the tree between the alias and the donating call
+    breaks the hazard — the alias points into the OLD tree."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step, init):
+                self._step = jax.jit(step, donate_argnums=(0,))
+                self._init = init
+
+            def reset_and_step(self, params, b):
+                self._w0 = params["w0"]
+                params = self._init()       # fresh tree; alias is safe
+                params = self._step(params, b)
+                return params
+    """)
+    assert not of_rule(findings, "donation-alias-leak")
+
+
+def test_alias_leak_suppression(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def cache_and_step(self, params, b):
+                self._w0 = params["w0"]  # tpu-lint: disable=donation-alias-leak
+                params = self._step(params, b)
+                return params
+    """)
+    assert not of_rule(findings, "donation-alias-leak")
+
+
+# ---------------------------------------------------------------------------
+# unbounded-device-retention
+# ---------------------------------------------------------------------------
+
+def test_retention_jit_output_appended_in_loop(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step)
+                self._history = []
+
+            def train(self, params, batches):
+                for b in batches:
+                    loss = self._step(params, b)
+                    self._history.append(loss)
+                return params
+    """)
+    hits = of_rule(findings, "unbounded-device-retention")
+    assert len(hits) == 1
+    assert "`self._history`" in hits[0].message
+    assert "pins its HBM buffer" in hits[0].message
+
+
+def test_retention_jnp_value_in_while_loop(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax.numpy as jnp
+
+        class Collector:
+            def __init__(self):
+                self._acts = []
+
+            def collect(self, xs):
+                i = 0
+                while i < len(xs):
+                    self._acts.append(jnp.tanh(xs[i]))
+                    i += 1
+    """)
+    hits = of_rule(findings, "unbounded-device-retention")
+    assert len(hits) == 1
+    assert "`self._acts`" in hits[0].message
+
+
+def test_drained_container_is_clean(tmp_path):
+    """TN: a buffer with a drain anywhere in its class (the metric.py
+    `_pending` idiom) is bounded-by-protocol."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Metric:
+            def __init__(self, step):
+                self._step = jax.jit(step)
+                self._pending = []
+
+            def update(self, params, b):
+                self._pending.append(self._step(params, b))
+
+            def get(self):
+                vals = jax.device_get(self._pending)
+                self._pending.clear()
+                return vals
+    """)
+    assert not of_rule(findings, "unbounded-device-retention")
+
+
+def test_host_converted_append_is_clean(tmp_path):
+    """TN: converting to host at the boundary (float/device_get/
+    asnumpy) releases the device buffer — nothing retained pins HBM."""
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step)
+                self._history = []
+
+            def train(self, params, batches):
+                for b in batches:
+                    loss = self._step(params, b)
+                    self._history.append(float(loss))
+                return params
+    """)
+    assert not of_rule(findings, "unbounded-device-retention")
+
+
+def test_bounded_deque_is_clean(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import collections
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step)
+                self._recent = collections.deque(maxlen=8)
+
+            def train(self, params, batches):
+                for b in batches:
+                    self._recent.append(self._step(params, b))
+                return params
+    """)
+    assert not of_rule(findings, "unbounded-device-retention")
+
+
+def test_retention_suppression(tmp_path):
+    findings = run_lint(tmp_path, source="""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step)
+                self._history = []
+
+            def train(self, params, batches):
+                for b in batches:
+                    loss = self._step(params, b)
+                    self._history.append(loss)  # tpu-lint: disable=unbounded-device-retention
+                return params
+    """)
+    assert not of_rule(findings, "unbounded-device-retention")
+
+
+# ---------------------------------------------------------------------------
+# CLI: tier filter, rule catalog, HBM report
+# ---------------------------------------------------------------------------
+
+def test_cli_only_memory_runs_just_the_tier(tmp_path, capsys):
+    snippet = tmp_path / "snippet.py"
+    snippet.write_text(textwrap.dedent("""
+        import jax
+
+        class Trainer:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def train(self, params, batches):
+                for b in batches:
+                    self._step(params, b)
+                return params
+    """))
+    rc = lint_main([str(snippet), "--root", str(tmp_path),
+                    "--only", "memory", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "use-after-donate" in out
+
+
+def test_cli_list_rules_shows_memory_tier(capsys):
+    rc = lint_main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rule in MEMORY_RULES:
+        assert f"{rule} [memory]" in out
+
+
+def test_cli_unknown_tier_mentions_memory(capsys):
+    rc = lint_main(["--only", "nope", "--root", REPO])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "memory" in err
+
+
+def test_cli_report_hbm(capsys):
+    rc = lint_main(["--report-hbm"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "micro-LSTM" in out and "micro-ResNet" in out
+    for contributor in ("params", "grads", "optimizer_state",
+                        "activations"):
+        assert contributor in out
+    assert "MXTPU_HBM_BUDGET_MB" in out
+
+
+# ---------------------------------------------------------------------------
+# the committed tree itself
+# ---------------------------------------------------------------------------
+
+def test_repo_memory_tier_is_clean():
+    """`--only memory` over the real tree exits 0: the sweep's findings
+    were model-precision fixes or true-positive fixes, never baselined."""
+    rc = lint_main([os.path.join(REPO, "mxnet_tpu"), "--root", REPO,
+                    "--only", "memory"])
+    assert rc == 0
+
+
+def test_repo_memory_baseline_is_zero():
+    """The memory tier lands with a ZERO grandfathered baseline — new
+    findings must be fixed, not baselined (docs/how_to/tpu_lint.md)."""
+    baseline = os.path.join(REPO, "tpu-lint-baseline.json")
+    with open(baseline) as fh:
+        entries = json.load(fh)["findings"]
+    assert not [e for e in entries if e["rule"] in MEMORY_RULES]
