@@ -261,14 +261,29 @@ ci-checkpoint: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_async_checkpoint.py \
 	    -m 'not slow' -x -q
 
+# silent-corruption chaos: a seeded lying-chip bitflip (nothing raises)
+# must be voted out by the cross-replica checksum within one period and
+# the run must resume exactly; a transient sentinel breach must
+# rollback-and-replay clean — both under MXTPU_RETRACE_STRICT=1 (the
+# sentinel riding the donated step state must never cost a retrace);
+# then the integrity unit suite (docs/how_to/integrity.md)
+ci-integrity: ci-native
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	    MXNET_TPU_FAULT_PLAN="mesh.silent_corrupt:4:ioerror" \
+	    MXNET_TPU_FAULT_SEED=7 \
+	    python ci/integrity_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-frontends ci-dryrun ci-resilience ci-serving ci-batching ci-data \
     ci-perf ci-elastic ci-compiler ci-preempt ci-multichip ci-fleet \
-    ci-quant ci-checkpoint
+    ci-quant ci-checkpoint ci-integrity
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu lint-concurrency lint-memory ci-lint ci-native \
 	ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
         ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \
-        ci-preempt ci-multichip ci-fleet ci-quant ci-checkpoint
+        ci-preempt ci-multichip ci-fleet ci-quant ci-checkpoint \
+        ci-integrity

@@ -1,0 +1,187 @@
+"""Silent-corruption chaos smoke (`make ci-integrity`, ci/pipeline.yml).
+
+The lying chip on the 8-device CPU mesh, run under
+`MXTPU_RETRACE_STRICT=1` (the sentinel riding the donated step state
+must never cost a retrace) with `MXTPU_INTEGRITY_PERIOD=1`:
+
+1. **bitflip leg** — MXNET_TPU_FAULT_PLAN (the env spec this script
+   runs under — see the Makefile stage) arms `mesh.silent_corrupt`: a
+   seeded single low-mantissa bitflip lands on one device's copy of
+   one parameter shard and nothing raises. The cross-replica checksum
+   vote must localize exactly the injected device within one period,
+   quarantine it through MeshHealth, re-mesh 8 -> 4 and resume with
+   the bitwise-identical batch stream and allclose losses/params vs an
+   uninterrupted run;
+2. **divergence-rollback leg** — a simulated transient breach of the
+   in-trace sentinel: fit must prune, roll back to the last validated
+   checkpoint, replay clean (no quarantine — transient, not poison)
+   and still reproduce the exact stream on the full 8-device mesh;
+3. a healthy guarded run moves only `checksum_rounds`/`votes` — the
+   counters `ResilienceMonitor` keeps out of its movement test.
+
+Exits non-zero on any violation. docs/how_to/integrity.md documents
+the subsystem.
+"""
+import hashlib
+import itertools
+import os
+import sys
+import tempfile
+
+# 8 virtual CPU devices, forced before any jax import (same contract as
+# tests/conftest.py); strict retrace + an armed guard for every run
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXTPU_RETRACE_STRICT"] = "1"
+os.environ["MXTPU_INTEGRITY_PERIOD"] = "1"
+
+import numpy as np                                        # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                                                # noqa: E402
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import models, resilience                  # noqa: E402
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh     # noqa: E402
+from mxnet_tpu.resilience import FaultPlan, faults        # noqa: E402
+from mxnet_tpu.resilience import integrity as ig_mod      # noqa: E402
+from mxnet_tpu.resilience.elastic import ElasticConfig    # noqa: E402
+
+BATCH = 16
+EPOCHS = 3
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def tonp(v):
+    return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+
+def run(plan=None, ckdir=None, elastic=False, flag_poison_at=None):
+    """One 3-epoch fit over a fixed shuffled 48-sample set; returns
+    (trainer, hashes, losses) keyed by (epoch, nbatch) — last write
+    wins, because a contaminated attempt records before the guard rolls
+    it back and the batch replays."""
+    faults.disarm()
+    resilience.reset_stats()
+    mesh = make_mesh({"data": 8})
+    net = models.get_symbol("mlp", num_classes=10)
+    tr = SPMDTrainer(
+        net, optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0 / BATCH), mesh=mesh)
+    mx.random.seed(42)
+    tr.bind(data_shapes={"data": (BATCH, 784)},
+            label_shapes={"softmax_label": (BATCH,)})
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 10, (48,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=True, seed=5)
+    hashes, losses = {}, {}
+
+    def record(param):
+        inp = param.locals["inputs"]
+        h = hashlib.sha256()
+        for n in sorted(inp):
+            h.update(np.ascontiguousarray(tonp(inp[n])).tobytes())
+        hashes[(param.epoch, param.nbatch)] = h.hexdigest()
+        p = np.asarray(param.locals["step_outs"][0])
+        lab = tonp(inp["softmax_label"]).astype(int)
+        losses[(param.epoch, param.nbatch)] = float(
+            -np.log(p[np.arange(len(lab)), lab] + 1e-9).mean())
+        if flag_poison_at is not None \
+                and (param.epoch, param.nbatch) == flag_poison_at:
+            # simulated hardware transient: flip the device-side breach
+            # flag once — the next fold keeps it sticky, the guard trips
+            # at the next period boundary, and the replay is clean
+            from jax.sharding import NamedSharding, PartitionSpec
+            st = list(tr._ig_state)
+            st[3] = jax.device_put(
+                np.float32(2.0), NamedSharding(tr._mesh, PartitionSpec()))
+            tr._ig_state = tuple(st)
+
+    if plan is not None:
+        faults.arm(plan)
+    kwargs = {}
+    if elastic:
+        fake_clock = itertools.count()      # injectable: no real sleeps
+        kwargs = dict(elastic=True, elastic_config=ElasticConfig(
+            clock=lambda: float(next(fake_clock))))
+    tr.fit(it, num_epoch=EPOCHS,
+           checkpoint_dir=ckdir, checkpoint_batch_period=1 if ckdir else None,
+           batch_end_callback=record, **kwargs)
+    faults.disarm()
+    return tr, hashes, losses
+
+
+def compare(tag, ref, chaos):
+    tr_ref, h_ref, l_ref = ref
+    tr_ch, h_ch, l_ch = chaos
+    keys = sorted(h_ref)
+    check(all(h_ch.get(k) == h_ref[k] for k in keys),
+          f"{tag}: batch stream bitwise-identical ({len(keys)} batches)")
+    check(np.allclose([l_ch[k] for k in keys], [l_ref[k] for k in keys],
+                      rtol=1e-4, atol=1e-5),
+          f"{tag}: per-step losses allclose to uninterrupted run")
+    for n in tr_ref.params:
+        check(np.allclose(np.asarray(tr_ch.params[n]),
+                          np.asarray(tr_ref.params[n]),
+                          rtol=1e-4, atol=1e-5),
+              f"{tag}: final param {n} allclose")
+
+
+def main():
+    spec = os.environ.get(resilience.faults.ENV_PLAN)
+    check(spec and "mesh.silent_corrupt" in spec,
+          f"MXNET_TPU_FAULT_PLAN arms mesh.silent_corrupt (got {spec!r})")
+    seed = int(os.environ.get(resilience.faults.ENV_SEED, "0"))
+
+    # the reference run is ALSO guarded: a healthy run pays the vote and
+    # stays quiet — only the always-moving counters advance
+    ref = run()
+    st = resilience.stats()["integrity"]
+    check(len(ref[1]) == EPOCHS * 3, "reference run: 9 steps over 3 epochs")
+    check(st["checksum_rounds"] == EPOCHS * 3 and st["votes"] > 0,
+          f"healthy run voted every period (stats: {st})")
+    check(st["divergences"] == 0 and st["quarantines"] == 0,
+          "healthy run: zero false alarms")
+
+    # leg 1: the env-armed lying chip — vote out the exact device
+    with tempfile.TemporaryDirectory() as d:
+        chaos = run(FaultPlan.from_env(spec, seed=seed), d, elastic=True)
+        st = resilience.stats()["integrity"]
+        est = resilience.stats()["elastic"]
+        inj = ig_mod._last_injected
+        check(inj is not None, f"seeded bitflip landed ({inj})")
+        check(st["quarantines"] == 1,
+              f"checksum vote quarantined the lying chip (stats: {st})")
+        check(est["remeshes"] == 1, "exactly one re-mesh")
+        surviving = {dev.id for dev in chaos[0]._mesh.devices.flat}
+        check(len(surviving) == 4 and inj["device"] not in surviving,
+              f"re-meshed 8 -> 4 without device {inj['device']}")
+        compare("bitflip", ref, chaos)
+
+    # leg 2: transient sentinel breach — rollback + clean replay
+    with tempfile.TemporaryDirectory() as d:
+        chaos = run(None, d, flag_poison_at=(0, 1))
+        st = resilience.stats()["integrity"]
+        check(st["divergences"] == 1 and st["rollbacks"] == 1
+              and st["replays"] == 1,
+              f"one rollback-and-replay (stats: {st})")
+        check(st["quarantines"] == 0, "transient: nothing quarantined")
+        check(len(chaos[0]._mesh.devices.flat) == 8, "mesh untouched")
+        compare("divergence-rollback", ref, chaos)
+
+    print("integrity chaos smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -44,12 +44,14 @@ FAULT_TESTS = ("tests/test_resilience.py", "tests/test_serving.py",
                "tests/test_batching.py", "tests/test_resilience_data.py",
                "tests/test_elastic.py", "tests/test_compiler.py",
                "tests/test_supervisor.py", "tests/test_fleet.py",
-               "tests/test_quant.py", "tests/test_async_checkpoint.py")
+               "tests/test_quant.py", "tests/test_async_checkpoint.py",
+               "tests/test_integrity.py")
 FAULT_DOCS = ("docs/how_to/fault_tolerance.md", "docs/how_to/serving.md",
               "docs/how_to/data_resilience.md",
               "docs/how_to/elastic_training.md",
               "docs/how_to/compiler.md", "docs/how_to/preemption.md",
-              "docs/how_to/fleet.md", "docs/how_to/quantization.md")
+              "docs/how_to/fleet.md", "docs/how_to/quantization.md",
+              "docs/how_to/integrity.md")
 OPS_PREFIX = "mxnet_tpu/ops/"
 DOC_BASES = {"NDArrayDoc", "SymbolDoc"}
 # checker rules are a registry too: each must be exercised by a lint

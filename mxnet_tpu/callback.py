@@ -139,10 +139,12 @@ class ResilienceMonitor:
     counters (resilience.stats()): I/O retries, retry give-ups,
     injected-fault fires per site, the data-pipeline quarantine
     counters (records/batches skipped, shards quarantined, resyncs),
-    and the elastic-training counters (device losses/additions,
-    re-meshes, collective failures, resume latency) — probe counts are
-    deliberately excluded from the movement test so a healthy elastic
-    run (probing every batch, finding nothing) stays silent.
+    the elastic-training counters (device losses/additions,
+    re-meshes, collective failures, resume latency), and the integrity
+    counters (divergences, quarantines, replays, rollbacks) — probe and
+    checksum-round/vote counts are deliberately excluded from the
+    movement test so a healthy run (probing and checksumming every
+    period, finding nothing) stays silent.
     Logs every ``frequent`` batches but only when a counter moved since
     the last report, so a healthy run stays silent; when it observes an
     epoch transition (the first batch of the next epoch) it reports the
@@ -155,6 +157,8 @@ class ResilienceMonitor:
                   "shards_quarantined", "resyncs")
     _ELASTIC_KEYS = ("losses_detected", "devices_added", "remeshes",
                      "collective_failures")
+    _INTEGRITY_KEYS = ("divergences", "quarantines", "replays",
+                       "rollbacks")
 
     def __init__(self, frequent=50):
         self.frequent = max(1, int(frequent))
@@ -171,7 +175,9 @@ class ResilienceMonitor:
                 + sum(stats.get("data", {}).get(k, 0)
                       for k in cls._DATA_KEYS)
                 + sum(stats.get("elastic", {}).get(k, 0)
-                      for k in cls._ELASTIC_KEYS))
+                      for k in cls._ELASTIC_KEYS)
+                + sum(stats.get("integrity", {}).get(k, 0)
+                      for k in cls._INTEGRITY_KEYS))
 
     def _report_epoch_health(self, epoch, data):
         """Per-epoch quarantine health: what this epoch's pipeline
@@ -220,6 +226,13 @@ class ResilienceMonitor:
             parts.append(f"elastic[probes]={elastic.get('probes', 0)}")
             parts.append("elastic[last_resume_s]="
                          f"{elastic.get('last_resume_s', 0.0):.3f}")
+        integ = self.stats.get("integrity", {})
+        if any(integ.get(k, 0) for k in self._INTEGRITY_KEYS):
+            for key in self._INTEGRITY_KEYS:
+                if integ.get(key, 0):
+                    parts.append(f"integrity[{key}]={integ[key]}")
+            parts.append("integrity[checksum_rounds]="
+                         f"{integ.get('checksum_rounds', 0)}")
         if parts:
             logging.warning("Epoch[%d] Batch [%d]\tResilience: %s",
                             param.epoch, param.nbatch, "\t".join(parts))

@@ -193,3 +193,27 @@ register_knob("MXTPU_FLEET_PROBE_PERIOD", float, 1.0,
 register_knob("MXTPU_FLEET_EVICT_AFTER", int, 3,
               "consecutive failed health probes after which a fleet "
               "replica is evicted and a warm standby promoted")
+register_knob("MXTPU_CKPT_KEEP", int, 1,
+              "mid-epoch checkpoints retained as a rollback window: the "
+              "newest K superseded stems survive the stale sweep and "
+              "the trainer's rolling rmtree so a divergence detected N "
+              "steps late can roll back past contaminated saves "
+              "(docs/how_to/integrity.md)")
+register_knob("MXTPU_INTEGRITY_PERIOD", int, 0,
+              "steps between cross-replica parameter-checksum voting "
+              "rounds in the integrity guard "
+              "(resilience/integrity.py) — 0 disables the guard "
+              "entirely (sentinels included), bitwise-identical "
+              "programs")
+register_knob("MXTPU_INTEGRITY_ZMAX", float, 6.0,
+              "divergence sentinel: z-score of the current grad-norm "
+              "against the running (Welford) statistics beyond which "
+              "DivergenceDetected is raised at the next host boundary")
+register_knob("MXTPU_INTEGRITY_GRAD_MAX", float, None,
+              "divergence sentinel: absolute grad-norm bound; any step "
+              "whose global grad norm exceeds it (or is non-finite) "
+              "breaches the guard regardless of the z-score")
+register_knob("MXTPU_INTEGRITY_WARMUP", int, 8,
+              "steps of sentinel statistics collected before the "
+              "z-score test arms (absolute/non-finite bounds are "
+              "always live)")

@@ -48,7 +48,7 @@ class SPMDTrainer:
                  label_names: Sequence[str] = ("softmax_label",),
                  param_rules=None, dtype="float32", compute_dtype=None,
                  shard_optimizer_state=None, donate_buffers=True,
-                 loss_scale=None):
+                 loss_scale=None, integrity=None):
         self._symbol = symbol
         self._mesh = mesh if mesh is not None else make_mesh()
         self._data_names = list(data_names)
@@ -83,6 +83,13 @@ class SPMDTrainer:
         self._loss_scale_req = loss_scale
         self._ls_cfg = None
         self._ls_state = None
+        # silent-failure integrity guard (resilience/integrity.py): the
+        # divergence sentinel rides the donated step like the loss-scale
+        # state; None defers to MXTPU_INTEGRITY_PERIOD (0 = off,
+        # bitwise-identical program), True/False/IntegrityConfig override
+        self._integrity_req = integrity
+        self._ig_cfg = None
+        self._ig_state = None
         if isinstance(optimizer, str):
             optimizer = _opt_mod.create(optimizer, **(optimizer_params or {}))
         self._optimizer = optimizer
@@ -303,8 +310,22 @@ class SPMDTrainer:
                                    for x in _ls_init(ls_cfg))
         else:
             self._ls_state = None
+        # the integrity sentinel state rides the SAME donated-state seam
+        # as the loss-scale pair: replicated scalars in, updated scalars
+        # out, read by the host only at the amortized integrity boundary
+        from ..resilience.integrity import (init_sentinel as _ig_init,
+                                            resolve_config as _ig_resolve)
+        ig_cfg = _ig_resolve(self._integrity_req)
+        self._ig_cfg = ig_cfg
+        if ig_cfg is not None:
+            repl_sh = NamedSharding(mesh, P())
+            self._ig_state = tuple(jax.device_put(x, repl_sh)
+                                   for x in _ig_init())
+        else:
+            self._ig_state = None
 
-        def step(params, states, aux, inputs, rng, lr, t, ls=None):
+        def step(params, states, aux, inputs, rng, lr, t, ls=None,
+                 ig=None):
             def loss_f(p):
                 merged = dict(inputs)
                 if compute_dtype is not None:
@@ -333,6 +354,15 @@ class SPMDTrainer:
                 # range; the schedule + skip are the portable contract)
                 from ..quant.loss_scale import tree_all_finite
                 finite = tree_all_finite(grads)
+            new_ig = None
+            if ig_cfg is not None:
+                # in-trace divergence sentinel over the raw (pre-select)
+                # gradients: z/abs tests + the Welford fold run inside
+                # this program, only a sticky flag reaches the host —
+                # and only once per MXTPU_INTEGRITY_PERIOD
+                from ..resilience.integrity import update_sentinel
+                new_ig = update_sentinel(ig_cfg, ig, grads, t,
+                                         applied=finite)
             new_params, new_states = {}, {}
             for n in params:
                 g = grads[n]
@@ -396,8 +426,13 @@ class SPMDTrainer:
                 o, NamedSharding(mesh, _fit(batch_pspec(mesh, o.ndim),
                                             o.shape, mesh)))
                     for o in outs]
+            extra = ()
             if ls_cfg is not None:
-                return new_params, new_states, new_aux, outs, new_ls
+                extra = extra + (new_ls,)
+            if ig_cfg is not None:
+                extra = extra + (new_ig,)
+            if extra:
+                return (new_params, new_states, new_aux, outs) + extra
             return new_params, new_states, new_aux, outs
 
         self.retrace_guard.rebind()     # fresh program after (re)bind
@@ -422,11 +457,14 @@ class SPMDTrainer:
             f"lrm={sorted(lr_mult.items())}",
             f"zero={int(shard_opt)}", f"cdt={compute_dtype}",
             f"plan={plan.signature_hash()}", f"shards={shard_sig}",
-            "-" if ls_cfg is None else ls_cfg.signature())
+            "-" if ls_cfg is None else ls_cfg.signature(),
+            "-" if ig_cfg is None else ig_cfg.signature())
 
         donate = (0, 1, 2) if self._donate else ()
         if self._donate and ls_cfg is not None:
-            donate = (0, 1, 2, 7)   # the loss-scale state rides donated
+            donate = donate + (7,)  # the loss-scale state rides donated
+        if self._donate and ig_cfg is not None:
+            donate = donate + (8,)  # ... and so does the sentinel state
 
         def _build_step_fn():
             self._step_fn = _compiler.PersistentJit(
@@ -517,8 +555,12 @@ class SPMDTrainer:
         # ambient mesh while the step traces (first call compiles)
         from .mesh import mesh_scope
         args = (self.params, self.states, self.aux, inputs, sub, lr, t)
-        if self._ls_cfg is not None:
+        if self._ls_cfg is not None or self._ig_cfg is not None:
+            # with only the integrity sentinel armed, ls rides as the
+            # None placeholder (an empty pytree: nothing is traced in)
             args = args + (self._ls_state,)
+        if self._ig_cfg is not None:
+            args = args + (self._ig_state,)
         if getattr(self, "_step_abstract_args", None) is None:
             # one-time abstract arg snapshot (shapes + mesh shardings) so
             # the compiled step's HLO stays inspectable after the donated
@@ -537,12 +579,20 @@ class SPMDTrainer:
             self._step_abstract_args = jax.tree_util.tree_map(
                 _abstract, args)
         with mesh_scope(self._mesh):
-            if self._ls_cfg is not None:
-                (self.params, self.states, self.aux, outs,
-                 self._ls_state) = self._step_fn(*args)
-            else:
-                self.params, self.states, self.aux, outs = \
-                    self._step_fn(*args)
+            res = self._step_fn(*args)
+        self.params, self.states, self.aux, outs = res[:4]
+        tail = 4
+        if self._ls_cfg is not None:
+            self._ls_state = res[tail]
+            tail += 1
+        if self._ig_cfg is not None:
+            self._ig_state = res[tail]
+        # the lying-chip fault site (resilience/integrity.py): an armed
+        # mesh.silent_corrupt plan lands a seeded single-device bitflip
+        # HERE, after the updated params exist — and nothing raises;
+        # disarmed this is one active_plan()-is-None check
+        from ..resilience.integrity import corruption_point
+        corruption_point(self)
         return outs
 
     def compiled_step_hlo(self) -> str:
@@ -572,6 +622,27 @@ class SPMDTrainer:
         scale, streak = self._ls_state
         return {"scale": float(np.asarray(scale)),
                 "finite_streak": int(np.asarray(streak))}
+
+    def integrity_stats(self):
+        """Host snapshot of the in-trace divergence-sentinel state (None
+        unless MXTPU_INTEGRITY_PERIOD / ``integrity=`` armed the guard)
+        — a boundary read for the IntegrityGuard and tests, never on
+        the step path (resilience/integrity.py)."""
+        if self._ig_cfg is None or self._ig_state is None:
+            return None
+        from ..resilience.integrity import sentinel_stats
+        return sentinel_stats(self._ig_state)
+
+    def _reset_integrity_state(self):
+        """Fresh sentinel statistics (same shapes/shardings/dtypes, so
+        no retrace): called after any rollback/recovery — the restored
+        params' gradient distribution starts a new regime."""
+        if self._ig_cfg is None:
+            return
+        from ..resilience.integrity import init_sentinel
+        repl_sh = NamedSharding(self._mesh, P())
+        self._ig_state = tuple(jax.device_put(x, repl_sh)
+                               for x in init_sentinel())
 
     def get_params(self):
         """Gather (host) copies, reference Module.get_params."""
@@ -973,13 +1044,30 @@ class SPMDTrainer:
             # rung 3 of the stall ladder needs an elastic controller;
             # without one the ladder is retry → rebind → abort
             sup.can_remesh = controller is not None
+        iguard = None
+        if self._ig_cfg is not None:
+            # silent-failure integrity guard (MXTPU_INTEGRITY_PERIOD /
+            # integrity=; resilience/integrity.py): periodic sentinel
+            # reads + cross-replica checksum votes. It shares the
+            # elastic controller's MeshHealth so a vote-localized bad
+            # chip is excluded through the same path a probed loss is.
+            from ..resilience.integrity import IntegrityGuard
+            iguard = IntegrityGuard(
+                self, self._ig_cfg,
+                health=(controller.health if controller is not None
+                        else None),
+                checkpoint_dir=checkpoint_dir)
         if async_checkpoint is None:
             from .. import config as _config
             async_checkpoint = bool(_config.get("MXTPU_ASYNC_CKPT"))
         actx = None
         if async_checkpoint and checkpoint_dir:
             from ..resilience import AsyncCheckpointer
-            actx = AsyncCheckpointer(name="spmd-ckpt-writer")
+            # the guard gates commits: a breached (diverged) state must
+            # never reach disk, even from an already-queued snapshot
+            actx = AsyncCheckpointer(
+                name="spmd-ckpt-writer",
+                gate=iguard.gate if iguard is not None else None)
         from contextlib import ExitStack
         with ExitStack() as _sup_stack:
             if actx is not None:
@@ -988,7 +1076,7 @@ class SPMDTrainer:
                 _sup_stack.callback(actx.close, flush=True)
             if sup is not None:
                 _sup_stack.enter_context(sup.attach())
-            if controller is None:
+            if controller is None and iguard is None:
                 self._run_epochs(train_data, num_epoch, begin_epoch,
                                  begin_batch, checkpoint_dir,
                                  checkpoint_period, bperiod, can_snapshot,
@@ -996,15 +1084,32 @@ class SPMDTrainer:
                                  crash_guard, actx)
                 return self
             from ..resilience.elastic import DeviceLost
+            from ..resilience.integrity import DivergenceDetected
             while True:
                 try:
                     self._run_epochs(train_data, num_epoch, begin_epoch,
                                      begin_batch, checkpoint_dir,
                                      checkpoint_period, bperiod,
                                      can_snapshot, cbs, epoch_end_callback,
-                                     controller, sup, crash_guard, actx)
+                                     controller, sup, crash_guard, actx,
+                                     iguard)
                     return self
+                except DivergenceDetected as err:
+                    # sentinel breach: the mesh is healthy but the state
+                    # diverged — prune the contaminated saves, roll back
+                    # to the last validated checkpoint, rewind, replay
+                    # (a second breach at the same position quarantines
+                    # the batch as poison). The commit gate already kept
+                    # the breach out of any in-flight async save.
+                    begin_epoch, begin_batch = iguard.recover(
+                        train_data, err)
                 except DeviceLost as err:
+                    if controller is None:
+                        # a ChecksumMismatch localized a lying chip but
+                        # without elastic there is no re-mesh path —
+                        # surface it (the checkpoint dir was pruned of
+                        # contamination; a relaunch resumes clean)
+                        raise
                     # a collective participant died mid-step (or a step
                     # stalled through retry+rebind — the ladder's rung 3
                     # surfaces as DeviceLost too): the donated buffers
@@ -1026,11 +1131,16 @@ class SPMDTrainer:
                                 werr)
                     begin_epoch, begin_batch = controller.recover(
                         train_data, err)
+                    if iguard is not None:
+                        # re-mesh + restore IS a successful integrity
+                        # recovery: reopen the commit gate, reset the
+                        # sentinel statistics for the new topology
+                        iguard.on_recovered()
 
     def _run_epochs(self, train_data, num_epoch, begin_epoch, begin_batch,
                     checkpoint_dir, checkpoint_period, bperiod,
                     can_snapshot, cbs, epoch_end_callback, controller,
-                    sup=None, crash_guard=None, actx=None):
+                    sup=None, crash_guard=None, actx=None, iguard=None):
         from ..callback import BatchEndParam
         # NOTE: this mid-epoch checkpoint orchestration deliberately
         # parallels BaseModule.fit (module/base_module.py) — the trainer
@@ -1038,9 +1148,32 @@ class SPMDTrainer:
         # and skips the epoch-end write after an empty-tail replay
         # because its dir would collide with the promoted mid save.
         # A semantics change here must be mirrored there.
+        import os
         import shutil
+
+        from .. import config as _config
         last_mid_step = None
-        prev_mid_path = None
+        # superseded mid-epoch dirs, oldest first: the MXTPU_CKPT_KEEP
+        # rollback window (default 1 = the classic single-survivor roll).
+        # The integrity guard's rollback needs checkpoints OLDER than the
+        # newest to survive — a divergence detected N steps late prunes
+        # every save in the contaminated window and restores past it
+        # (resilience/integrity.py, docs/how_to/integrity.md).
+        keep_mid = max(1, int(_config.get("MXTPU_CKPT_KEEP")))
+        mid_paths = []
+
+        def _mid_window_push(path):
+            """Record ``path`` as the newest mid-epoch save; return the
+            dirs that just fell out of the rollback window (for the
+            caller to delete — post-commit, on the async path)."""
+            if path in mid_paths:
+                mid_paths.remove(path)
+            mid_paths.append(path)
+            drop = []
+            while len(mid_paths) > keep_mid:
+                drop.append(mid_paths.pop(0))
+            return drop
+
         prev_state = None       # last *trained* position (stall rewinds)
         progressed = False
         remesh_exc = None
@@ -1064,6 +1197,13 @@ class SPMDTrainer:
             for k, batch in enumerate(train_data):
                 nbatch = begin_batch + k
                 nseen = k + 1
+                if iguard is not None \
+                        and iguard.is_quarantined(epoch, nbatch):
+                    # replay classification condemned this batch as
+                    # poison (it diverged twice deterministically): the
+                    # fetch above consumed it, so the iterator position
+                    # stays consistent — it is simply never trained on
+                    continue
                 inputs = self._batch_dict(batch)
                 if sup is None:
                     step_outs = self.step(inputs)  # noqa: F841 in locals()
@@ -1106,6 +1246,13 @@ class SPMDTrainer:
                     if crash_guard is not None and not progressed:
                         crash_guard.note_progress()
                         progressed = True
+                if iguard is not None:
+                    # the amortized integrity boundary, deliberately
+                    # BEFORE this batch's checkpoint block: a breach
+                    # raises here, so diverged state is structurally
+                    # unable to reach the save path below (the async
+                    # gate is the second, belt-and-braces wall)
+                    iguard.after_step(epoch, nbatch)
                 for cb in cbs:
                     cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
                                      eval_metric=None, locals=locals()))
@@ -1117,34 +1264,33 @@ class SPMDTrainer:
                                 "iterator": train_data.state_dict()}
                     if actx is not None:
                         # the roll rides as post_commit on the writer:
-                        # the superseded dir is deleted only once this
-                        # save's manifest is on disk, so the newest
-                        # committed checkpoint always survives a kill
-                        import os
+                        # dirs that fell out of the rollback window are
+                        # deleted only once this save's manifest is on
+                        # disk, so the newest committed checkpoint (and
+                        # the MXTPU_CKPT_KEEP retained stems) always
+                        # survive a kill
                         target = os.path.join(
                             os.path.abspath(checkpoint_dir),
                             f"step_{self._num_update}")
-                        prev = prev_mid_path \
-                            if prev_mid_path != target else None
+                        drop = _mid_window_push(target)
                         path = self._save_checkpoint_async(
                             actx, checkpoint_dir, step=self._num_update,
                             epoch=epoch, iter_state=mid_iter,
                             post_commit=(
-                                (lambda _p=prev: shutil.rmtree(
-                                    _p, ignore_errors=True))
-                                if prev is not None else None))
+                                (lambda _ps=tuple(drop):
+                                 [shutil.rmtree(p, ignore_errors=True)
+                                  for p in _ps])
+                                if drop else None))
                     else:
                         path = self.save_checkpoint(
                             checkpoint_dir, step=self._num_update,
                             epoch=epoch, iter_state=mid_iter)
-                        # roll the superseded mid-epoch dir: a long epoch
-                        # holds at most one mid-epoch checkpoint on disk
-                        if prev_mid_path is not None \
-                                and prev_mid_path != path:
-                            shutil.rmtree(prev_mid_path,
-                                          ignore_errors=True)
+                        # roll the superseded mid-epoch dirs: a long
+                        # epoch holds at most MXTPU_CKPT_KEEP mid-epoch
+                        # checkpoints on disk (the rollback window)
+                        for p in _mid_window_push(path):
+                            shutil.rmtree(p, ignore_errors=True)
                     last_mid_step = self._num_update
-                    prev_mid_path = path
                 if controller is not None:
                     # between steps the state is consistent: a detected
                     # topology change checkpoints, re-meshes and
@@ -1162,16 +1308,16 @@ class SPMDTrainer:
                         last_mid_step = self._num_update
                         cpath = controller.last_checkpoint_path
                         if cpath:
-                            if prev_mid_path not in (None, cpath):
+                            drop = _mid_window_push(cpath)
+                            if drop:
                                 if actx is not None:
-                                    # prev_mid_path may still be an
+                                    # a dropped dir may still be an
                                     # uncommitted async submit — never
                                     # rmtree a dir the writer may be
                                     # mid-write in
                                     actx.flush()
-                                shutil.rmtree(prev_mid_path,
-                                              ignore_errors=True)
-                            prev_mid_path = cpath
+                                for p in drop:
+                                    shutil.rmtree(p, ignore_errors=True)
                 if sup is not None:
                     if can_snapshot:
                         try:
@@ -1216,10 +1362,8 @@ class SPMDTrainer:
                                     checkpoint_dir, step=self._num_update,
                                     epoch=epoch, iter_state=prev_state)
                             last_mid_step = self._num_update
-                            if prev_mid_path not in (None, step_dir):
-                                shutil.rmtree(prev_mid_path,
-                                              ignore_errors=True)
-                            prev_mid_path = step_dir
+                            for p in _mid_window_push(step_dir):
+                                shutil.rmtree(p, ignore_errors=True)
                         sup.preempt_exit(
                             checkpoint_dir, label=self._num_update,
                             epoch=epoch, nbatch=nbatch,
@@ -1251,7 +1395,15 @@ class SPMDTrainer:
                         # writer, where epoch+1's first submit would
                         # supersede (= never write) it — commit it now
                         actx.flush()
-                    prev_mid_path = None
+                    # the promoted dir is an epoch checkpoint now: pull
+                    # it out of the mid-epoch rollback window so the
+                    # next epoch's rolls can never delete it (the rest
+                    # of the window keeps its retention)
+                    promoted = os.path.join(
+                        os.path.abspath(checkpoint_dir),
+                        f"step_{self._num_update}")
+                    if promoted in mid_paths:
+                        mid_paths.remove(promoted)
                     continue
                 iter_state = None
                 if can_snapshot:

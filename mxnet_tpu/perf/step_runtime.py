@@ -502,10 +502,11 @@ class FusedStep:
                  compute_dtype=None, donate: bool = True,
                  name: str = "fused-step", input_shapes=None,
                  input_dtypes=None, mesh=None, sharding=None,
-                 loss_scale=None):
+                 loss_scale=None, integrity=None):
         from .. import compiler as _compiler
         from ..parallel.sharding import ShardingPlan, plan_scope
         from ..quant import loss_scale as _ls_mod
+        from ..resilience import integrity as _ig_mod
         self._symbol = symbol
         self._optimizer = optimizer
         self._param_names = list(param_names)
@@ -516,6 +517,12 @@ class FusedStep:
         self._ls_cfg = precision_loss_scale(loss_scale)
         self._ls_state = (None if self._ls_cfg is None
                           else _ls_mod.init_state(self._ls_cfg))
+        # the integrity divergence sentinel rides the same donated-state
+        # seam (MXTPU_INTEGRITY_PERIOD; resilience/integrity.py) — the
+        # Module/Gluon step carries it exactly like SPMDTrainer's
+        self._ig_cfg = _ig_mod.resolve_config(integrity)
+        self._ig_state = (None if self._ig_cfg is None
+                          else _ig_mod.init_sentinel())
         if sharding is not None and mesh is None:
             mesh = sharding.mesh
         if mesh is not None and sharding is None:
@@ -527,6 +534,11 @@ class FusedStep:
             _repl0 = NamedSharding(self.plan.mesh, PartitionSpec())
             self._ls_state = tuple(jax.device_put(x, _repl0)
                                    for x in self._ls_state)
+        if self.plan is not None and self._ig_state is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            _repl0 = NamedSharding(self.plan.mesh, PartitionSpec())
+            self._ig_state = tuple(jax.device_put(x, _repl0)
+                                   for x in self._ig_state)
         # graph passes at bind time (DCE/CSE/remat policy); the fused
         # step traces the optimized graph, the module keeps the
         # original. input_shapes/dtypes (every bound arg + aux) feed
@@ -578,7 +590,8 @@ class FusedStep:
             f"cdt={compute_dtype}",
             f"layouts={sorted(self.layouts)}",
             f"plan={'-' if self.plan is None else self.plan.signature_hash()}",
-            "-" if self._ls_cfg is None else self._ls_cfg.signature())
+            "-" if self._ls_cfg is None else self._ls_cfg.signature(),
+            "-" if self._ig_cfg is None else self._ig_cfg.signature())
 
         # static per-param wd / lr multipliers (reference: set_wd_mult —
         # biases/BN params get wd 0); the dynamic base lr stays an input
@@ -609,8 +622,9 @@ class FusedStep:
             _repl = NamedSharding(plan.mesh, PartitionSpec())
 
         ls_cfg = self._ls_cfg
+        ig_cfg = self._ig_cfg
 
-        def step(params, states, aux, inputs, rng, lr, t, ls=None):
+        def step(params, states, aux, inputs, rng, lr, t, ls=None, ig=None):
             def loss_f(p):
                 merged = dict(inputs)
                 for n, v in p.items():
@@ -646,6 +660,14 @@ class FusedStep:
                 # scales a real scalar loss — and for fp8-era formats.
                 from ..quant.loss_scale import tree_all_finite
                 finite = tree_all_finite(grads)
+            new_ig = None
+            if ig_cfg is not None:
+                # the divergence sentinel folds the raw grad-norm into
+                # its Welford stats in-trace; loss-scale-skipped steps
+                # are neither a breach nor a sample (applied=finite)
+                from ..resilience.integrity import update_sentinel
+                new_ig = update_sentinel(ig_cfg, ig, grads, t,
+                                         applied=finite)
             new_params, new_states = {}, {}
             for n in params:
                 w_leaves, treedef = jax.tree_util.tree_flatten(params[n])
@@ -710,8 +732,13 @@ class FusedStep:
             if plan is not None:
                 new_aux = {n: jax.lax.with_sharding_constraint(v, _repl)
                            for n, v in new_aux.items()}
+            extra = ()
             if ls_cfg is not None:
-                return new_params, new_states, new_aux, outs, new_ls
+                extra += (new_ls,)
+            if ig_cfg is not None:
+                extra += (new_ig,)
+            if extra:
+                return (new_params, new_states, new_aux, outs) + extra
             return new_params, new_states, new_aux, outs
 
         self._step_body = step
@@ -730,7 +757,9 @@ class FusedStep:
 
         donate = (0, 1, 2) if self.donate else ()
         if self.donate and self._ls_cfg is not None:
-            donate = (0, 1, 2, 7)   # the loss-scale state rides donated
+            donate = donate + (7,)  # the loss-scale state rides donated
+        if self.donate and self._ig_cfg is not None:
+            donate = donate + (8,)  # ...and so does the sentinel
         self._step_fn = PersistentJit(
             self.guard.wrap(self._step_body), kind="fused-step",
             key_parts=self._program_key_parts,
@@ -851,6 +880,28 @@ class FusedStep:
         return {"scale": float(np.asarray(scale)),
                 "finite_streak": int(np.asarray(streak))}
 
+    def integrity_stats(self):
+        """Host snapshot of the divergence sentinel (None when unarmed) —
+        a boundary read for :class:`IntegrityGuard`/tests, never on the
+        step path."""
+        if self._ig_cfg is None:
+            return None
+        from ..resilience.integrity import sentinel_stats
+        return sentinel_stats(self._ig_state)
+
+    def reset_integrity_state(self):
+        """Fresh sentinel after a recovery rollback (same shapes/dtypes,
+        so no retrace)."""
+        if self._ig_cfg is None:
+            return
+        from ..resilience.integrity import init_sentinel
+        state = tuple(jnp.asarray(x) for x in init_sentinel())
+        if self.plan is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            _repl0 = NamedSharding(self.plan.mesh, PartitionSpec())
+            state = tuple(jax.device_put(x, _repl0) for x in state)
+        self._ig_state = state
+
     def __call__(self, params, states, aux, inputs, rng, lr, t):
         with _quiet_donation():
             if self.mesh is None:
@@ -862,13 +913,24 @@ class FusedStep:
                 return self._run(params, states, aux, inputs, rng, lr, t)
 
     def _run(self, params, states, aux, inputs, rng, lr, t):
-        if self._ls_cfg is None:
+        if self._ls_cfg is None and self._ig_cfg is None:
             return self._step_fn(params, states, aux, inputs, rng, lr, t)
-        # the guard state is internal to the FusedStep: callers keep the
-        # classic 7-arg contract, the donated program carries (and
-        # returns) the (scale, streak) pair alongside
-        params, states, aux, outs, self._ls_state = self._step_fn(
-            params, states, aux, inputs, rng, lr, t, self._ls_state)
+        # the guard states are internal to the FusedStep: callers keep
+        # the classic 7-arg contract, the donated program carries (and
+        # returns) the loss-scale pair / integrity sentinel alongside.
+        # With only the sentinel armed, _ls_state (None) still rides at
+        # slot 7 so the sentinel's donated slot stays fixed at 8.
+        args = (params, states, aux, inputs, rng, lr, t, self._ls_state)
+        if self._ig_cfg is not None:
+            args = args + (self._ig_state,)
+        res = self._step_fn(*args)
+        params, states, aux, outs = res[:4]
+        tail = 4
+        if self._ls_cfg is not None:
+            self._ls_state = res[tail]
+            tail += 1
+        if self._ig_cfg is not None:
+            self._ig_state = res[tail]
         return params, states, aux, outs
 
 
@@ -1021,7 +1083,7 @@ class ModuleStepper:
 
 
 def module_stepper(module, compute_dtype=None, donate=True, mesh=None,
-                   sharding=None, loss_scale=None):
+                   sharding=None, loss_scale=None, integrity=None):
     """Build a :class:`ModuleStepper` for ``module``, or return None.
 
     Eligibility is conservative — anything the fused program cannot
@@ -1094,7 +1156,7 @@ def module_stepper(module, compute_dtype=None, donate=True, mesh=None,
                           input_dtypes={n: str(v.dtype)
                                         for n, v in all_arrs},
                           mesh=mesh, sharding=sharding,
-                          loss_scale=loss_scale)
+                          loss_scale=loss_scale, integrity=integrity)
         stepper = ModuleStepper(module, fused, frozen)
     except MemoryBudgetError:
         raise       # the budget gate must surface, never silently

@@ -20,6 +20,11 @@ Three pillars (docs/how_to/fault_tolerance.md):
   (docs/how_to/elastic_training.md): device-loss/addition detection
   (``mesh.probe``/``mesh.collective`` fault sites, injectable probe),
   checkpoint → re-mesh → re-shard → bitwise-exact resume.
+- :mod:`.integrity` — the silent-failure integrity guard
+  (docs/how_to/integrity.md): in-trace divergence sentinels riding the
+  donated step state, periodic cross-replica checksum voting with
+  bad-chip localization (``mesh.silent_corrupt``/``integrity.checksum``
+  fault sites), and replay → quarantine → rollback-window recovery.
 - :mod:`.supervisor` — the preemption-aware training supervisor
   (docs/how_to/preemption.md): graceful SIGTERM checkpointing with a
   clean-exit marker and typed exit codes, a step-stall watchdog with a
@@ -35,7 +40,7 @@ the SPMD port to "a dead process fails the collective for everyone"
 from __future__ import annotations
 
 from . import (async_checkpoint, checkpoint, data, elastic, faults,  # noqa: F401,E501
-               retry, supervisor)
+               integrity, retry, supervisor)
 from .async_checkpoint import (AsyncCheckpointer,  # noqa: F401
                                AsyncCheckpointError, ShardedCheckpoint,
                                assemble_shards, load_sharded_checkpoint,
@@ -54,6 +59,9 @@ from .elastic import (DeviceLost, ElasticConfig,  # noqa: F401
                       ElasticController, MeshHealth)
 from .faults import (SITES, FaultPlan, InjectedFault,  # noqa: F401
                      InjectedKill, InjectedTimeout, fault_point)
+from .integrity import (ChecksumMismatch, DivergenceDetected,  # noqa: F401
+                        IntegrityAbort, IntegrityConfig, IntegrityGuard,
+                        corruption_point)
 from .retry import RetryExhausted, RetryPolicy, default_policy  # noqa: F401
 from .supervisor import (CrashLoopGuard, ImmediateAbort,  # noqa: F401
                          Preempted, SignalRuntime, StallAbort,
@@ -74,7 +82,10 @@ __all__ = ["checkpoint", "async_checkpoint", "data", "elastic", "faults",
            "guard", "DeviceLost", "MeshHealth", "ElasticConfig",
            "ElasticController", "supervisor", "TrainingSupervisor",
            "SignalRuntime", "StallWatchdog", "CrashLoopGuard", "Preempted",
-           "ImmediateAbort", "StepStalled", "StallAbort"]
+           "ImmediateAbort", "StepStalled", "StallAbort",
+           "integrity", "IntegrityConfig", "IntegrityGuard",
+           "DivergenceDetected", "ChecksumMismatch", "IntegrityAbort",
+           "corruption_point"]
 
 
 def guarded_call(site: str, fn, *args, policy=None, **kwargs):
@@ -113,7 +124,8 @@ def stats() -> dict:
     ``callback.ResilienceMonitor`` and ``KVStore.num_dead_node``)."""
     return {"faults": faults.stats(), "retry": retry.stats(),
             "data": data.stats(), "elastic": elastic.stats(),
-            "supervisor": supervisor.stats()}
+            "supervisor": supervisor.stats(),
+            "integrity": integrity.stats()}
 
 
 def reset_stats():
@@ -122,3 +134,4 @@ def reset_stats():
     data.reset_stats()
     elastic.reset_stats()
     supervisor.reset_stats()
+    integrity.reset_stats()

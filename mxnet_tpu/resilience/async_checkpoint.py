@@ -113,9 +113,15 @@ class AsyncCheckpointer:
     the first submit and shut down by :meth:`close`."""
 
     def __init__(self, name: str = "ckpt-writer", supersede: bool = True,
-                 flush_timeout: Optional[float] = None):
+                 flush_timeout: Optional[float] = None,
+                 gate: Optional[Callable[[], bool]] = None):
         from .. import config as _config
         self.name = name
+        #: commit gate: a callable returning False refuses NEW submits
+        #: (the integrity guard passes ``lambda: not guard.breached`` so
+        #: a diverged state can never reach disk; the refused job's
+        #: ``on_supersede`` cleanup still runs)
+        self._gate = gate
         self._cond = threading.Condition()
         # guarded by _cond: _pending, _busy, _busy_label, _error,
         # _closed, _thread, _counts, _last_committed
@@ -126,7 +132,7 @@ class AsyncCheckpointer:
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         self._counts = {"submitted": 0, "committed": 0, "superseded": 0,
-                        "failed": 0}
+                        "failed": 0, "gated": 0}
         self._last_committed = None
         self._supersede_default = bool(supersede)
         self._flush_timeout = float(
@@ -149,7 +155,21 @@ class AsyncCheckpointer:
         ``supersede=False`` waits for it instead. A ``precious``
         predecessor (epoch-end / preemption checkpoint) is never
         superseded, only waited for. A predecessor whose write is
-        already in flight always runs to completion first."""
+        already in flight always runs to completion first.
+
+        A closed commit ``gate`` (constructor arg) refuses the job
+        outright: nothing is queued, ``on_supersede`` runs so the
+        caller's in-progress marker comes back down, and the refusal is
+        counted (``stats()["gated"]``) — a breached integrity guard
+        must never commit a diverged state."""
+        if self._gate is not None and not self._gate():
+            with self._cond:
+                self._counts["gated"] += 1
+            logging.warning("%s: checkpoint %r refused by commit gate "
+                            "(integrity breach?)", self.name, label)
+            if on_supersede is not None:
+                on_supersede()
+            return
         if supersede is None:
             supersede = self._supersede_default
         superseded = None

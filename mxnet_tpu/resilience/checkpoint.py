@@ -389,7 +389,7 @@ _SHARD0_RE = re.compile(r"-(\d{4,})\.shard-0-of-\d+\.params$")
 _SHARD0_EPOCHLESS_RE = re.compile(r"^\.shard-0-of-\d+\.params$")
 
 
-def find_checkpoints(prefix: str) -> List[Optional[int]]:
+def find_checkpoints(prefix: str, nth_newest: Optional[int] = None):
     """Epochs with a params file at ``prefix``, newest first — by
     *supersession order* (:func:`_order_key`: an end-of-epoch label
     outranks every mid-epoch stem of earlier epochs, not just smaller
@@ -397,7 +397,15 @@ def find_checkpoints(prefix: str) -> List[Optional[int]]:
     ties). ``None`` denotes the epoch-less scheme and sorts oldest. A
     missing directory means no checkpoints; any other listing failure
     (permissions, dead mount) propagates — it must not masquerade as a
-    fresh start."""
+    fresh start.
+
+    ``nth_newest`` selects a single label instead of the list: 0 is the
+    newest, 1 the one it superseded, ... — the integrity guard's
+    rollback rung walks the retention window (``MXTPU_CKPT_KEEP``) this
+    way to step past contaminated saves. Out-of-range returns ``None``
+    — indistinguishable from the epoch-less label by design, so
+    rollback callers must bound the walk by ``len(find_checkpoints())``
+    first."""
     base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
     base = os.path.basename(prefix)
     found = []
@@ -429,7 +437,10 @@ def find_checkpoints(prefix: str) -> List[Optional[int]]:
         st = os.stat(os.path.join(base_dir, name))
         found.append((_order_key(epoch), st.st_mtime_ns, epoch))
     found.sort(key=lambda t: (t[0], t[1]), reverse=True)
-    return [t[2] for t in found]
+    labels = [t[2] for t in found]
+    if nth_newest is not None:
+        return labels[nth_newest] if 0 <= nth_newest < len(labels) else None
+    return labels
 
 
 #: sentinel: discover the newest valid checkpoint instead of naming one
@@ -553,13 +564,22 @@ def sweep_stale_checkpoints(prefix: str, used=None) -> int:
     if bound_label is None:
         return 0
     bound = _order_key(bound_label)
+    # rollback window: the newest MXTPU_CKPT_KEEP-1 superseded stems
+    # survive (the bound itself makes K retained total) so the
+    # integrity guard can roll back past checkpoints a late-detected
+    # divergence contaminated (docs/how_to/integrity.md)
+    from .. import config
+    spare = max(0, int(config.get("MXTPU_CKPT_KEEP")) - 1)
     removed = 0
-    for ep in candidates:
+    for ep in candidates:            # newest first: spares go to newest
         if ep is None or ep < MID_EPOCH_STRIDE or ep == bound_label:
             continue
         if os.path.exists(inprogress_path(prefix, ep)):
             continue
         if _order_key(ep) < bound:
+            if spare > 0:
+                spare -= 1
+                continue
             remove_checkpoint(prefix, ep)
             removed += 1
     if removed:

@@ -184,6 +184,18 @@ class MeshHealth:
         rather than a failed probe)."""
         self._kill_one()
 
+    def mark_device(self, device_id: int):
+        """Quarantine one *specific* device by id — the integrity
+        guard's checksum vote localizes the corrupted chip exactly, so
+        no seeded victim choice is involved (resilience/integrity.py;
+        a dissenting replica IS the bad device)."""
+        if device_id in self._killed:
+            return
+        self._killed.add(device_id)
+        _count("losses_detected")
+        logging.warning("MeshHealth: device id %d quarantined "
+                        "(checksum dissent)", device_id)
+
     def healthy_devices(self) -> List:
         """Enumerate currently-usable devices. Passes the ``mesh.probe``
         fault site first: an injected fault there kills one device."""
@@ -378,6 +390,7 @@ class ElasticController:
                 f"{err}); falling back to checkpoint restore on the "
                 "surviving devices")
             lost.already_marked = True
+            lost.remesh_counted = True
             raise lost from err
         _note_resume(clock() - t0)
         logging.warning(
@@ -399,9 +412,10 @@ class ElasticController:
             self.health.mark_failure()
         devices = self.health.healthy_devices()
         target = self._select(devices)
-        if not getattr(err, "already_marked", False):
-            # ditto: the check() fallback already counted its re-mesh
-            # attempt against max_remeshes
+        if not getattr(err, "remesh_counted", False):
+            # the check() fallback already counted its re-mesh attempt
+            # against max_remeshes; a ChecksumMismatch (victim marked by
+            # the vote, but no re-mesh yet) still counts here
             self._bump_remesh(err)
         clock = self.config.clock
         t0 = clock()

@@ -76,7 +76,14 @@ SITES = ("checkpoint.write", "checkpoint.read", "kvstore.init",
          # sweeper — a kill at any of these must leave the last
          # committed checkpoint discoverable and loadable
          "checkpoint.snapshot", "checkpoint.shard_write",
-         "checkpoint.commit", "checkpoint.flush", "checkpoint.sweep")
+         "checkpoint.commit", "checkpoint.flush", "checkpoint.sweep",
+         # silent-failure integrity guard (resilience/integrity.py,
+         # docs/how_to/integrity.md): mesh.silent_corrupt injects a
+         # deterministic single-device bitflip into the update seam (a
+         # flaky chip that lies — every health probe still passes), and
+         # integrity.checksum faults the cross-replica checksum-voting
+         # round itself (vote infrastructure failure)
+         "mesh.silent_corrupt", "integrity.checksum")
 
 ENV_PLAN = "MXNET_TPU_FAULT_PLAN"
 ENV_SEED = "MXNET_TPU_FAULT_SEED"

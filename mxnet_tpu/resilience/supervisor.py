@@ -64,8 +64,8 @@ __all__ = ["TrainingSupervisor", "SignalRuntime", "StallWatchdog",
            "StallAbort", "stats", "reset_stats", "signal_runtime",
            "skip_quarantined_batches",
            "SITE_SIGNAL", "SITE_HEARTBEAT", "EXIT_PREEMPTED", "EXIT_ABORTED",
-           "EXIT_STALLED", "MARKER_SUFFIX", "preempt_marker_path",
-           "read_preempt_marker"]
+           "EXIT_STALLED", "EXIT_INTEGRITY", "MARKER_SUFFIX",
+           "preempt_marker_path", "read_preempt_marker"]
 
 #: fault site passed when a (real or injected) preemption signal lands;
 #: ``MXNET_TPU_FAULT_PLAN="supervisor.signal:N:ioerror"`` simulates a
@@ -80,6 +80,8 @@ SITE_HEARTBEAT = "supervisor.heartbeat"
 EXIT_PREEMPTED = 83   #: graceful: checkpoint + marker written, clean exit
 EXIT_ABORTED = 84     #: second signal: immediate abort, no checkpoint
 EXIT_STALLED = 85     #: watchdog ladder exhausted: checkpoint-and-abort
+EXIT_INTEGRITY = 86   #: integrity ladder exhausted: corruption unrecoverable
+                      #  (kept equal to integrity.EXIT_INTEGRITY)
 
 ENV_STALL_TIMEOUT = "MXTPU_STALL_TIMEOUT"
 ENV_STALL_POLL = "MXTPU_STALL_POLL"

@@ -1,0 +1,535 @@
+"""Silent-failure integrity guard (resilience/integrity.py,
+docs/how_to/integrity.md).
+
+The lying chip on the virtual 8-device CPU mesh: a seeded FaultPlan
+fires ``mesh.silent_corrupt`` to flip one low mantissa bit in one
+device's copy of one parameter shard — every health probe keeps
+passing, nothing raises, and only the cross-replica checksum vote can
+see it. The vote must localize exactly the injected device, quarantine
+it through MeshHealth, and the elastic controller must re-mesh and
+resume with the bitwise-identical batch stream and allclose losses
+versus an uninterrupted run. The in-trace divergence sentinel rides the
+donated step state (zero per-step host syncs) and drives the
+rollback-and-replay ladder: transient breaches vanish on replay, poison
+batches breach twice at the same position and are quarantined under the
+data-guard budget. ``integrity.checksum`` fails the vote itself — that
+must propagate, never read as clean. All clocks injectable, zero real
+sleeps (the chaos smoke ``ci/integrity_smoke.py`` runs the same
+contract under ``MXNET_TPU_FAULT_PLAN``).
+"""
+import hashlib
+import itertools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models, resilience
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+from mxnet_tpu.resilience import FaultPlan, faults
+from mxnet_tpu.resilience import integrity as ig_mod
+from mxnet_tpu.resilience.data import DataBudgetExceeded
+from mxnet_tpu.resilience.elastic import ElasticConfig, MeshHealth
+from mxnet_tpu.resilience.integrity import (ChecksumMismatch,
+                                            DivergenceDetected,
+                                            IntegrityConfig,
+                                            IntegrityGuard,
+                                            init_sentinel,
+                                            resolve_config,
+                                            sentinel_stats,
+                                            update_sentinel)
+
+BATCH = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    resilience.reset_stats()
+    ig_mod._last_injected = None
+    yield
+    faults.disarm()
+    resilience.reset_stats()
+
+
+def _make_trainer(mesh_axes=None, devices=None, batch=BATCH,
+                  integrity=None):
+    mesh = make_mesh(mesh_axes or {"data": 8}, devices=devices)
+    s = models.get_symbol("mlp", num_classes=10)
+    tr = SPMDTrainer(
+        s, optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0 / batch),
+        mesh=mesh, integrity=integrity)
+    mx.random.seed(42)
+    tr.bind(data_shapes={"data": (batch, 784)},
+            label_shapes={"softmax_label": (batch,)})
+    return tr
+
+
+def _feed(seed=0, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    return {"data": rng.randn(batch, 784).astype(np.float32),
+            "softmax_label": rng.randint(0, 10, (batch,))
+            .astype(np.float32)}
+
+
+def _tonp(v):
+    return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+
+# ---------------------------------------------------------------------------
+# the in-trace divergence sentinel (unit: scripted gradient streams)
+# ---------------------------------------------------------------------------
+
+def _norm_grads(value):
+    """A one-leaf grad pytree whose global norm is exactly ``value``."""
+    return {"w": jnp.full((4,), np.float32(value) / 2.0)}
+
+
+def _run_stream(cfg, values, applied=None):
+    state = tuple(jnp.asarray(x) for x in init_sentinel())
+    for t, v in enumerate(values, start=1):
+        a = None if applied is None else applied[t - 1]
+        state = update_sentinel(cfg, state, _norm_grads(v), t,
+                                applied=None if a is None
+                                else jnp.bool_(a))
+    return sentinel_stats(state)
+
+
+def test_sentinel_quiet_on_noisy_but_healthy_stream():
+    """True negative: 60 samples of ordinary gradient-norm noise never
+    breach — a healthy run pays zero host syncs AND zero false alarms."""
+    rng = np.random.RandomState(3)
+    st = _run_stream(IntegrityConfig(zmax=6.0, warmup=8),
+                     1.0 + 0.05 * rng.randn(60))
+    assert st["flag"] == 0
+    assert st["samples"] == 60
+    assert abs(st["mean"] - 1.0) < 0.05
+
+
+def test_sentinel_z_breach_is_sticky_and_not_folded():
+    """True positive: a 100x spike after warmup breaches the z tier,
+    stamps the FIRST breaching update, and is never folded into the
+    running statistics (folding first would cap z at ~sqrt(n) and blind
+    the test to exactly these spikes)."""
+    rng = np.random.RandomState(4)
+    vals = list(1.0 + 0.05 * rng.randn(20)) + [100.0] + \
+        list(1.0 + 0.05 * rng.randn(5))
+    st = _run_stream(IntegrityConfig(zmax=6.0, warmup=8), vals)
+    assert st["flag"] == 1                   # z-score code
+    assert st["breach_step"] == 21           # first breach stamped
+    assert st["samples"] == 25               # spike not folded
+    assert abs(st["mean"] - 1.0) < 0.05      # stats uncontaminated
+
+
+def test_sentinel_abs_tier_needs_no_warmup():
+    """Non-finite (or over grad_max) is a breach on sample one — no
+    statistics needed."""
+    st = _run_stream(IntegrityConfig(zmax=6.0, warmup=8), [np.nan])
+    assert st["flag"] == 2 and st["breach_step"] == 1
+    st = _run_stream(IntegrityConfig(grad_max=10.0, warmup=8),
+                     [1.0, 50.0])
+    assert st["flag"] == 2 and st["breach_step"] == 2
+    assert st["samples"] == 1
+
+
+def test_sentinel_loss_scale_skip_is_neither_breach_nor_sample():
+    """A step the loss-scale guard skipped (applied=False) is the
+    scale schedule's business: not an integrity breach, not a
+    statistics sample."""
+    st = _run_stream(IntegrityConfig(zmax=6.0, warmup=2),
+                     [1.0, 1.0, np.nan, 1.0],
+                     applied=[True, True, False, True])
+    assert st["flag"] == 0
+    assert st["samples"] == 3
+
+
+def test_resolve_config_env_and_explicit(monkeypatch):
+    monkeypatch.delenv("MXTPU_INTEGRITY_PERIOD", raising=False)
+    assert resolve_config(None) is None          # default: disabled
+    assert resolve_config(False) is None
+    assert resolve_config(True).period == 1      # forced on
+    assert resolve_config(IntegrityConfig(period=0)) is None
+    monkeypatch.setenv("MXTPU_INTEGRITY_PERIOD", "5")
+    monkeypatch.setenv("MXTPU_INTEGRITY_ZMAX", "4.5")
+    monkeypatch.setenv("MXTPU_INTEGRITY_WARMUP", "3")
+    cfg = resolve_config(None)
+    assert (cfg.period, cfg.zmax, cfg.warmup) == (5, 4.5, 3)
+    assert cfg.grad_max is None
+    # zmax/grad_max/warmup are traced constants: they key the program
+    assert cfg.signature() != IntegrityConfig().signature()
+
+
+def test_period_zero_is_bitwise_disable():
+    """MXTPU_INTEGRITY_PERIOD=0 (the default): no sentinel state enters
+    the donated step, no extra outputs, no stats surface — and the
+    trained parameters are bitwise-identical to an armed run's (the
+    sentinel only OBSERVES; only its absence must also be free)."""
+    tr_off = _make_trainer()
+    assert tr_off._ig_cfg is None and tr_off._ig_state is None
+    assert tr_off.integrity_stats() is None
+    tr_on = _make_trainer(integrity=IntegrityConfig(period=1))
+    assert tr_on.integrity_stats() is not None
+    for i in range(3):
+        tr_off.step(_feed(i))
+        tr_on.step(_feed(i))
+    for n in tr_off.params:
+        np.testing.assert_array_equal(np.asarray(tr_off.params[n]),
+                                      np.asarray(tr_on.params[n]),
+                                      err_msg=n)
+    assert tr_on.integrity_stats()["samples"] == 3
+    assert tr_off.retrace_guard.count == 1     # one compile each, no
+    assert tr_on.retrace_guard.count == 1      # retrace from the carry
+
+
+# ---------------------------------------------------------------------------
+# the lying chip: seeded bitflip + cross-replica checksum vote
+# ---------------------------------------------------------------------------
+
+def test_bitflip_is_seed_deterministic_and_sentinel_invisible():
+    """The same armed plan flips the same bit on the same device every
+    run (the chaos smoke replays corruption byte-for-byte), and a low
+    mantissa bit stays finite — invisible to the divergence sentinel by
+    construction, detectable only bitwise."""
+    victims = []
+    for _ in range(2):
+        tr = _make_trainer(integrity=IntegrityConfig(period=1))
+        tr.step(_feed(0))
+        before = {n: np.asarray(v).copy() for n, v in tr.params.items()}
+        faults.arm(FaultPlan(seed=11).arm("mesh.silent_corrupt", nth=1))
+        tr.step(_feed(1))
+        faults.disarm()
+        inj = ig_mod._last_injected
+        assert inj is not None
+        victims.append((inj["device"], inj["param"], inj["word"],
+                        inj["bit"]))
+        # exactly one param changed beyond the step's own update, and
+        # the corrupted copy is still finite
+        assert np.isfinite(np.asarray(tr.params[inj["param"]])).all()
+        assert tr.integrity_stats()["flag"] == 0
+        del before
+    assert victims[0] == victims[1]
+
+
+def test_checksum_vote_localizes_exactly_the_injected_device():
+    tr = _make_trainer(integrity=IntegrityConfig(period=1))
+    tr.step(_feed(0))
+    guard = IntegrityGuard(tr, tr._ig_cfg)
+    assert guard.checksum_round() == ("ok", None)   # clean vote
+    faults.arm(FaultPlan(seed=7).arm("mesh.silent_corrupt", nth=1))
+    tr.step(_feed(1))
+    faults.disarm()
+    verdict, device_id = guard.checksum_round()
+    assert verdict == "mismatch"
+    assert device_id == ig_mod._last_injected["device"]
+    st = resilience.stats()["integrity"]
+    assert st["checksum_rounds"] == 2 and st["votes"] > 0
+
+
+def test_check_now_marks_device_through_shared_mesh_health():
+    """The vote-localized chip is quarantined through the SAME
+    MeshHealth exclusion path a probed loss takes, and the raised
+    ChecksumMismatch says so (already_marked) — the controller must not
+    layer a seeded guess on top."""
+    tr = _make_trainer(integrity=IntegrityConfig(period=1))
+    tr.step(_feed(0))
+    health = MeshHealth()
+    guard = IntegrityGuard(tr, tr._ig_cfg, health=health)
+    guard.check_now()                       # clean round: no breach
+    assert guard.gate() is True
+    faults.arm(FaultPlan(seed=7).arm("mesh.silent_corrupt", nth=1))
+    tr.step(_feed(1))
+    faults.disarm()
+    with pytest.raises(ChecksumMismatch) as exc:
+        guard.check_now()
+    assert exc.value.already_marked is True
+    assert exc.value.device_id == ig_mod._last_injected["device"]
+    assert guard.gate() is False            # breached: commits refused
+    healthy = {d.id for d in health.healthy_devices()}
+    assert exc.value.device_id not in healthy
+    assert resilience.stats()["integrity"]["quarantines"] == 1
+
+
+def test_checksum_fault_site_propagates_never_reads_clean():
+    """integrity.checksum fails the vote INFRASTRUCTURE: that must
+    surface, never be mistaken for a clean round."""
+    tr = _make_trainer(integrity=IntegrityConfig(period=1))
+    tr.step(_feed(0))
+    guard = IntegrityGuard(tr, tr._ig_cfg)
+    faults.arm(FaultPlan(seed=0).arm("integrity.checksum", nth=1,
+                                     exc="ioerror"))
+    with pytest.raises(faults.InjectedFault):
+        guard.check_now()
+    faults.disarm()
+    assert resilience.stats()["integrity"]["checksum_rounds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rollback window: contamination pruning + MXTPU_CKPT_KEEP retention
+# ---------------------------------------------------------------------------
+
+def test_prune_rolls_back_past_two_contaminated_stems(tmp_path):
+    """A divergence detected N steps late has been checkpointing corrupt
+    state the whole window: every save newer than the last validated
+    update must be pruned, and the MXTPU_CKPT_KEEP window must have kept
+    an older one to land on."""
+    tr = _make_trainer(integrity=IntegrityConfig(period=1))
+    for i in range(4):
+        tr.step(_feed(i))
+        tr.save_checkpoint(str(tmp_path), step=tr._num_update, epoch=0)
+    guard = IntegrityGuard(tr, tr._ig_cfg, checkpoint_dir=str(tmp_path))
+    guard._last_good_update = 2     # updates 3 and 4 are suspect
+    guard._prune_contaminated()
+    left = sorted(n for n in os.listdir(str(tmp_path))
+                  if n.startswith("step_"))
+    assert left == ["step_1", "step_2"]
+    # the rollback rung lands on the newest SURVIVING stem
+    assert tr.restore_latest(str(tmp_path)) is not None
+    assert tr._num_update == 2
+
+
+def test_ckpt_keep_window_retains_k_midepoch_stems(tmp_path, monkeypatch):
+    """MXTPU_CKPT_KEEP widens the mid-epoch roll from keep-1 to
+    keep-last-K, so the integrity rollback always has somewhere older to
+    land."""
+    monkeypatch.setenv("MXTPU_CKPT_KEEP", "3")
+    tr = _make_trainer()
+    X = np.random.RandomState(1).randn(96, 784).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 10, (96,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    tr.fit(it, num_epoch=1, checkpoint_dir=str(tmp_path),
+           checkpoint_batch_period=1)
+    steps = sorted(int(n.split("_")[1]) for n in os.listdir(str(tmp_path))
+                   if n.startswith("step_") and "." not in n)
+    # 6 updates: the keep-3 window retains the newest three (the epoch
+    # promotion reuses step_6, protected from the roll)
+    assert steps == [4, 5, 6]
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: detect -> localize -> quarantine -> re-mesh -> resume
+# ---------------------------------------------------------------------------
+
+def _run_fit(ckdir=None, num_epoch=3, plan=None, elastic=False,
+             integrity=None, nan_batch=None, data_policy=None,
+             flag_poison_at=None):
+    """One fit over a fixed 48-sample set: returns (trainer, hashes,
+    losses) keyed by (epoch, nbatch) — last write wins, because a
+    contaminated attempt completes (and may record) before the guard
+    rolls it back and the batch replays."""
+    faults.disarm()
+    resilience.reset_stats()
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 10, (48,)).astype(np.float32)
+    if nan_batch is not None:
+        X[nan_batch * BATCH:(nan_batch + 1) * BATCH] = np.nan
+    tr = _make_trainer(integrity=integrity)
+    # a poisoned batch must STAY one batch: shuffling would smear the
+    # NaN rows over the whole set
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH,
+                           shuffle=nan_batch is None, seed=5)
+    hashes, losses = {}, {}
+
+    def record(param):
+        inp = param.locals["inputs"]
+        h = hashlib.sha256()
+        for n in sorted(inp):
+            h.update(np.ascontiguousarray(_tonp(inp[n])).tobytes())
+        hashes[(param.epoch, param.nbatch)] = h.hexdigest()
+        p = np.asarray(param.locals["step_outs"][0])
+        lab = _tonp(inp["softmax_label"]).astype(int)
+        losses[(param.epoch, param.nbatch)] = float(
+            -np.log(p[np.arange(len(lab)), lab] + 1e-9).mean())
+        if flag_poison_at is not None \
+                and (param.epoch, param.nbatch) == flag_poison_at:
+            # a simulated hardware transient: flip the device-side
+            # breach flag once; the next fold keeps it sticky and the
+            # guard trips at the next period boundary. The replay after
+            # rollback is clean — transient, not poison.
+            from jax.sharding import NamedSharding, PartitionSpec
+            st = list(tr._ig_state)
+            st[3] = jax.device_put(
+                np.float32(2.0), NamedSharding(tr._mesh, PartitionSpec()))
+            tr._ig_state = tuple(st)
+
+    if plan is not None:
+        faults.arm(plan)
+    kwargs = {}
+    if elastic:
+        fake_clock = itertools.count()
+        kwargs = dict(elastic=True, elastic_config=ElasticConfig(
+            clock=lambda: float(next(fake_clock))))
+    tr.fit(it, num_epoch=num_epoch,
+           checkpoint_dir=str(ckdir) if ckdir else None,
+           checkpoint_batch_period=1 if ckdir else None,
+           batch_end_callback=record, **kwargs)
+    faults.disarm()
+    return tr, hashes, losses
+
+
+def _assert_same_stream(got_h, got_l, ref_h, ref_l, skip=()):
+    keys = set(ref_h) - set(skip)
+    assert keys <= set(got_h)
+    for k in sorted(keys):
+        assert got_h[k] == ref_h[k], k      # bitwise batch stream
+    np.testing.assert_allclose([got_l[k] for k in sorted(keys)],
+                               [ref_l[k] for k in sorted(keys)],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_silent_corruption_votes_out_chip_and_resumes_exactly(tmp_path):
+    """The headline contract: a seeded bitflip on 1 of 8 devices is
+    detected within one integrity period, the vote names exactly the
+    injected device, MeshHealth quarantines it, the elastic controller
+    re-meshes onto survivors, and the run resumes with the bitwise batch
+    stream and allclose losses/params of an uninterrupted run."""
+    tr_ref, h_ref, l_ref = _run_fit(num_epoch=3)
+    plan = FaultPlan(seed=7).arm("mesh.silent_corrupt", nth=4)
+    tr, h, l = _run_fit(ckdir=tmp_path, num_epoch=3, plan=plan,
+                        elastic=True,
+                        integrity=IntegrityConfig(period=1))
+    inj = ig_mod._last_injected
+    assert inj is not None
+    st = resilience.stats()["integrity"]
+    est = resilience.stats()["elastic"]
+    assert st["quarantines"] == 1           # the vote named the chip...
+    assert est["remeshes"] == 1             # ...and the controller acted
+    assert len(tr._mesh.devices.flat) == 4
+    assert inj["device"] not in {d.id for d in tr._mesh.devices.flat}
+    _assert_same_stream(h, l, h_ref, l_ref)
+    for n in tr_ref.params:
+        np.testing.assert_allclose(np.asarray(tr.params[n]),
+                                   np.asarray(tr_ref.params[n]),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_transient_divergence_rolls_back_and_replays_clean(tmp_path):
+    """A transient upset (breach flag with healthy data): one rollback,
+    one clean replay, no quarantine — the final stream and params match
+    the uninterrupted run and the mesh never shrinks."""
+    tr_ref, h_ref, l_ref = _run_fit(num_epoch=2)
+    tr, h, l = _run_fit(ckdir=tmp_path, num_epoch=2,
+                        integrity=IntegrityConfig(period=1),
+                        flag_poison_at=(0, 1))
+    st = resilience.stats()["integrity"]
+    assert st["divergences"] == 1
+    assert st["replays"] == 1 and st["rollbacks"] == 1
+    assert st["quarantines"] == 0           # transient, not poison
+    assert len(tr._mesh.devices.flat) == 8  # mesh untouched
+    _assert_same_stream(h, l, h_ref, l_ref)
+    for n in tr_ref.params:
+        np.testing.assert_allclose(np.asarray(tr.params[n]),
+                                   np.asarray(tr_ref.params[n]),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_poison_batch_quarantined_after_deterministic_replay(tmp_path):
+    """A NaN batch breaches, replays, breaches AGAIN at the same
+    position: that is poison, not hardware — quarantine it under the
+    data-guard budget and train past it."""
+    tr, h, l = _run_fit(ckdir=tmp_path, num_epoch=1, nan_batch=1,
+                        integrity=IntegrityConfig(period=1))
+    st = resilience.stats()["integrity"]
+    assert st["quarantines"] == 1
+    assert st["divergences"] == 2           # original + replay
+    assert st["replays"] == 2 and st["rollbacks"] == 2
+    for n in tr.params:                     # trained past the poison
+        assert np.isfinite(np.asarray(tr.params[n])).all(), n
+    # the poison batch never reaches the callbacks: the guard raises at
+    # the period boundary BEFORE them, and the final pass skips it — so
+    # exactly the two clean batches are in the record
+    assert sorted(h) == [(0, 0), (0, 2)]
+
+
+def test_poison_quarantine_respects_skip_budget(tmp_path, monkeypatch):
+    """Quarantining is bounded: past max_skipped_records the guard
+    refuses to silently drop more data."""
+    monkeypatch.setenv("MXNET_TPU_DATA_MAX_SKIP", "8")  # < one batch
+    with pytest.raises(DataBudgetExceeded, match="budget"):
+        _run_fit(ckdir=tmp_path, num_epoch=1, nan_batch=1,
+                 integrity=IntegrityConfig(period=1))
+
+
+def test_divergence_without_checkpoint_dir_aborts_typed():
+    """No checkpoint_dir means no rollback rung: the ladder ends in a
+    typed IntegrityAbort (EXIT_INTEGRITY) rather than training on."""
+    from mxnet_tpu.resilience.integrity import (EXIT_INTEGRITY,
+                                                IntegrityAbort)
+    tr = _make_trainer(integrity=IntegrityConfig(period=1))
+    guard = IntegrityGuard(tr, tr._ig_cfg, checkpoint_dir=None)
+    with pytest.raises(IntegrityAbort) as exc:
+        guard.recover(None, DivergenceDetected("x", epoch=0, nbatch=0))
+    assert exc.value.exit_code == EXIT_INTEGRITY == 86
+    from mxnet_tpu.resilience.supervisor import \
+        EXIT_INTEGRITY as SUP_EXIT
+    assert SUP_EXIT == EXIT_INTEGRITY
+
+
+def test_fused_step_carries_sentinel_on_module_path(monkeypatch):
+    """The Module/Gluon fused step rides the SAME donated-state seam:
+    MXTPU_INTEGRITY_PERIOD arms the sentinel there too, loss-scale-free,
+    with the classic 7-arg caller contract untouched."""
+    from mxnet_tpu import perf
+    from mxnet_tpu.io import DataBatch, DataDesc
+    monkeypatch.setenv("MXTPU_INTEGRITY_PERIOD", "1")
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[DataDesc("data", (8, 10))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    mx.random.seed(7)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    stepper = perf.module_stepper(mod)
+    assert stepper is not None
+    rng = np.random.RandomState(0)
+    batch = DataBatch(
+        data=[mx.nd.array(rng.rand(8, 10).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))])
+    for _ in range(4):
+        stepper.step(batch)
+    st = stepper._fused.integrity_stats()
+    assert st["samples"] == 4 and st["flag"] == 0
+    poison = DataBatch(
+        data=[mx.nd.array(np.full((8, 10), np.nan, np.float32))],
+        label=batch.label)
+    stepper.step(poison)
+    st = stepper._fused.integrity_stats()
+    assert st["flag"] == 2 and st["samples"] == 4  # breach, not folded
+    stepper._fused.reset_integrity_state()
+    assert stepper._fused.integrity_stats()["flag"] == 0
+    g = stepper._fused.guard
+    assert g.count == 1 and not g.retraced     # one program, carry free
+
+
+def test_healthy_guarded_run_keeps_monitor_silent(tmp_path, caplog):
+    """checksum_rounds/votes move every period on a healthy run — the
+    ResilienceMonitor must exclude them from its movement test so a
+    clean guarded run logs nothing."""
+    import logging as _logging
+
+    from mxnet_tpu.callback import ResilienceMonitor
+    mon = ResilienceMonitor(frequent=1)
+    faults.disarm()
+    resilience.reset_stats()
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 10, (48,)).astype(np.float32)
+    tr = _make_trainer(integrity=IntegrityConfig(period=1))
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    with caplog.at_level(_logging.WARNING, logger=""):
+        tr.fit(it, num_epoch=1, checkpoint_dir=str(tmp_path),
+               checkpoint_batch_period=1, batch_end_callback=mon)
+    st = mon.stats["integrity"]
+    assert st["checksum_rounds"] == 3 and st["votes"] > 0
+    assert st["divergences"] == 0
+    assert not [r for r in caplog.records if "Resilience" in r.message]
