@@ -275,10 +275,28 @@ ci-integrity: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py \
 	    -m 'not slow' -x -q
 
+# stage 21: gray-failure / straggler chaos — serve leg: a threaded
+# 3-replica fleet with one replica made sticky-slow by an env-armed
+# `delay` fault must lose zero requests, hedge around the straggler,
+# vote it out on the latency rung and hold the p99 bound, all under
+# MXTPU_RETRACE_STRICT=1; train leg: a persistently slow step walks
+# the supervisor's slow ladder into a DEGRADED quarantine + unattended
+# elastic re-mesh; then the deterministic fake-clock unit suite
+# (docs/how_to/fleet.md "Gray failure & hedging")
+ci-straggler: ci-native
+	timeout -k 10 180 env JAX_PLATFORMS=cpu MXTPU_RETRACE_STRICT=1 \
+	    MXNET_TPU_FAULT_PLAN="fleet.dispatch:10:delay:400" \
+	    MXNET_TPU_FAULT_SEED=7 \
+	    python ci/straggler_smoke.py serve
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	    python ci/straggler_smoke.py train
+	JAX_PLATFORMS=cpu python -m pytest tests/test_straggler.py \
+	    -m 'not slow' -x -q
+
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
     ci-frontends ci-dryrun ci-resilience ci-serving ci-batching ci-data \
     ci-perf ci-elastic ci-compiler ci-preempt ci-multichip ci-fleet \
-    ci-quant ci-checkpoint ci-integrity
+    ci-quant ci-checkpoint ci-integrity ci-straggler
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu lint-concurrency lint-memory ci-lint ci-native \
@@ -286,4 +304,4 @@ ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
         ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \
         ci-preempt ci-multichip ci-fleet ci-quant ci-checkpoint \
-        ci-integrity
+        ci-integrity ci-straggler

@@ -19,7 +19,8 @@ serving tier under ``serving`` (continuous-batching requests/sec vs
 one-at-a-time at the same deadline + stateful decode tokens/sec —
 benchmarks/bench_serving.py) and ``fleet`` (3-replica vs 1-replica
 aggregate requests/sec + p99 with a replica-kill chaos leg —
-benchmarks/bench_fleet.py). Every
+benchmarks/bench_fleet.py) and ``straggler`` (hedged vs unhedged p99
+against a sticky-slow replica — benchmarks/bench_straggler.py). Every
 metric carries its own vs_best_recorded + regression flag against the
 best across recorded BENCH_r*.json rounds (new metrics self-seed on
 their first recorded round).
@@ -58,8 +59,8 @@ def best_recorded():
     best = {"resnet": 0.0, "lstm": LSTM_PRIOR_BEST,
             "flash_attention": 0.0, "moe_dispatch": 0.0,
             "compile_cache": 0.0, "multichip": 0.0, "serving": 0.0,
-            "fleet": 0.0, "quant_serving": 0.0, "bf16_train": 0.0,
-            "ckpt_stall": 0.0}
+            "fleet": 0.0, "straggler": 0.0, "quant_serving": 0.0,
+            "bf16_train": 0.0, "ckpt_stall": 0.0}
     here = os.path.dirname(os.path.abspath(__file__))
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         try:
@@ -76,6 +77,7 @@ def best_recorded():
                                 ("multichip", "multichip"),
                                 ("serving", "serving"),
                                 ("fleet", "fleet"),
+                                ("straggler", "straggler"),
                                 ("quant_serving", "quant_serving"),
                                 ("bf16_train", "bf16_train"),
                                 ("ckpt_stall", "ckpt_stall")):
@@ -210,6 +212,22 @@ def bench_fleet():
         os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
     import bench_fleet as _flt
     return _flt.run(quiet=True)
+
+
+def bench_straggler():
+    """Gray-failure record (ISSUE 19): the same open-loop burst against
+    a 3-replica fleet whose r1 is wedged sticky-slow, served with
+    hedged dispatch off vs on (slow vote-out disabled so the straggler
+    stays in rotation — the comparison isolates hedging)
+    (benchmarks/bench_straggler.py). The guarded value is the
+    hedged-leg aggregate requests/sec; the acceptance contract
+    (enforced absolutely in main()) is hedged p99 strictly below
+    unhedged p99, hedges actually fired, and zero lost requests on
+    both legs."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_straggler as _strag
+    return _strag.run(quiet=True)
 
 
 def bench_quant():
@@ -364,6 +382,22 @@ def main():
             or not chaos.get("p99_within_bound", False))
         regressed |= flt["fleet_contract_violation"]
         record["fleet"] = flt
+
+        # gray-failure tier: hedged dispatch vs a sticky-slow replica
+        # (ISSUE 19). The guarded value is the hedged-leg requests/sec;
+        # the contract is absolute — hedging must strictly beat the
+        # unhedged p99 against the same straggler, hedges must have
+        # fired, and neither leg may lose a request.
+        strag = bench_straggler()
+        regressed |= _guard(strag, best["straggler"])
+        strag["straggler_contract_violation"] = bool(
+            float(strag["hedged"].get("p99_s", 1.0))
+            >= float(strag["unhedged"].get("p99_s", 0.0))
+            or int(strag["hedged"].get("hedges", 0)) < 1
+            or int(strag["hedged"].get("lost", 1)) != 0
+            or int(strag["unhedged"].get("lost", 1)) != 0)
+        regressed |= strag["straggler_contract_violation"]
+        record["straggler"] = strag
 
         # low-precision tier: int8 PTQ serving + bf16 training (ISSUE
         # 15). The guarded value is quantized ResNet img/s through the

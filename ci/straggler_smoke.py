@@ -1,0 +1,202 @@
+"""Gray-failure / straggler chaos smoke (`make ci-straggler`,
+docs/how_to/fleet.md "Gray failure & hedging").
+
+Two legs, each bounded by `timeout` in the Makefile:
+
+- ``serve`` (run under ``MXTPU_RETRACE_STRICT=1`` with an env-armed
+  ``delay`` fault plan): a REAL threaded 3-replica fleet where one
+  replica turns sticky-slow mid-burst. Every request must still reach
+  a terminal correct answer (ZERO lost), hedged dispatches must fire
+  and win, the slow replica must be voted out by the latency rung, and
+  the hedged chaos p99 must stay within a stated bound of a no-fault
+  reference burst. Finishing clean under strict mode IS the
+  zero-retrace assertion.
+- ``train``: an SPMD fit on the 8-device CPU mesh where an armed
+  ``trainer.step`` delay makes three consecutive steps persistently
+  slow — the supervisor's step-time sentinel walks the slow ladder
+  (warn -> rebind -> StepSlow), the elastic controller quarantines a
+  topology member as DEGRADED, re-meshes, and the run finishes
+  unattended.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the train leg re-meshes on the virtual 8-device CPU mesh
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+N = 40
+P99_FACTOR, P99_PAD_S = 5.0, 0.5
+DELAY_S = 0.4
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def _serve():
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving import CallableBackend, FleetRouter
+
+    def factory(rid, source):
+        def fn(arrays):
+            time.sleep(0.005)
+            return [np.ascontiguousarray(arrays["data"], np.float32) * 2.0]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+
+    def burst(name, waves=2):
+        fr = FleetRouter(factory, name=name, replicas=3, standbys=1,
+                         workers=1, buckets=[1], capacity=2 * N,
+                         default_deadline=20.0, probe_period=0.005,
+                         hedge_max=4, hedge_factor=2.0,
+                         # hedge wins abandon most of the straggler's
+                         # backlog, so it executes few live forwards:
+                         # two slow samples are already damning — and
+                         # the wide factor (injected 400ms vs a 5ms
+                         # service time ~= 64x the median, while OS
+                         # scheduling noise on a loaded host tops out
+                         # around 100ms) keeps noise from tripping
+                         # the rung
+                         hedge_min_samples=8, slow_factor=32.0,
+                         slow_min_samples=2)
+        latencies = []
+        for _ in range(waves):
+            t0 = time.perf_counter()
+            pending = [fr.submit(np.ones((1, 3), np.float32) * (i + 1))
+                       for i in range(N)]
+            for i, req in enumerate(pending):
+                fr.tick()
+                out = fr.result(req)
+                assert np.all(out[0] == 2.0 * (i + 1)), (i, out)
+                latencies.append(time.perf_counter() - t0)
+        # the straggler's sticky-slow forward may still be in flight
+        # when the waves drain (every waiter hedged around it): keep
+        # probing until the latency rung has its windowed evidence
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            fr.tick()
+            if fr.stats()["totals"]["slow_evictions"]:
+                break
+            time.sleep(0.005)
+        stats = serving.stats()["fleet"][name]["totals"]
+        fr.close()
+        return stats, float(np.percentile(latencies, 99))
+
+    # chaos burst: the env-armed plan (fleet.dispatch:10:delay:400)
+    # makes one replica sticky-slow on its 10th live forward
+    check(faults.active_plan() is not None,
+          "delay fault plan armed from MXNET_TPU_FAULT_PLAN")
+    stats, chaos_p99 = burst("strag-chaos")
+    check(stats["delivered"] == 2 * N and stats["failed_terminal"] == 0,
+          f"zero lost: {stats['delivered']}/{2 * N} delivered, "
+          f"{stats['failed_terminal']} failed terminal")
+    check(stats["hedges"] > 0,
+          f"hedged dispatch fired ({stats['hedges']} hedges, "
+          f"{stats['hedge_wins']} wins, "
+          f"{stats['hedges_suppressed']} suppressed by the cap)")
+    check(stats["slow_evictions"] == 1 and stats["evictions"] == 1,
+          "the sticky-slow replica was voted out by the latency rung")
+    check(stats["hedges_outstanding"] == 0,
+          "every hedge-cap slot returned on settle")
+    delayed = faults.stats()["delayed"].get("fleet.dispatch", 0)
+    check(delayed == 1, f"injected delay burned exactly once ({delayed})")
+
+    # no-fault reference: the p99 bound the hedged chaos leg must hold
+    faults.disarm()
+    ref_stats, ref_p99 = burst("strag-ref")
+    check(ref_stats["delivered"] == 2 * N, "reference burst delivered")
+    bound = ref_p99 * P99_FACTOR + P99_PAD_S
+    check(chaos_p99 <= bound,
+          f"hedged chaos p99 {chaos_p99:.3f}s <= bound {bound:.3f}s "
+          f"(no-fault {ref_p99:.3f}s)")
+    print("straggler serve smoke PASS (strict mode: zero unwarmed "
+          "dispatches)")
+
+
+def _train():
+    import tempfile
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, resilience
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+    from mxnet_tpu.resilience import FaultPlan, faults
+    from mxnet_tpu.resilience.supervisor import TrainingSupervisor
+
+    batch = 16
+    faults.disarm()
+    resilience.reset_stats()
+    mesh = make_mesh({"data": 8})
+    net = models.get_symbol("mlp", num_classes=10)
+    tr = SPMDTrainer(
+        net, optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0 / batch), mesh=mesh)
+    mx.random.seed(42)
+    tr.bind(data_shapes={"data": (batch, 784)},
+            label_shapes={"softmax_label": (batch,)})
+    X = np.random.RandomState(1).randn(48, 784).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 10, (48,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=True, seed=5)
+
+    # steps 7..9 each burn a real 5s: the first (compile) step inflates
+    # the warmup mean, so the injected slowness must clear
+    # slow_factor x that inflated baseline with margin — while the
+    # post-re-mesh recompile step (~1s) must NOT restart a breach
+    # streak of its own; clean again after the re-mesh replays
+    plan = FaultPlan(seed=7)
+    plan.arm("trainer.step", nth=7, count=3, exc="delay", delay_ms=5000)
+    faults.arm(plan)
+    sup = TrainingSupervisor(signals=(), slow_step=True, slow_factor=8.0,
+                             slow_warmup=6, slow_streak=3)
+    with tempfile.TemporaryDirectory() as ckdir:
+        tr.fit(it, num_epoch=4, supervisor=sup, elastic=True,
+               checkpoint_dir=ckdir, checkpoint_batch_period=1)
+    faults.disarm()
+    st = resilience.stats()
+    sup_st = st["supervisor"]
+    check(sup_st["slow_steps"] >= 3,
+          f"sentinel flagged the slow steps ({sup_st['slow_steps']})")
+    check(sup_st["slow_remeshes"] == 1,
+          "slow ladder escalated to exactly one re-mesh")
+    check(st["elastic"]["degraded_marks"] == 1,
+          "elastic recovery quarantined one DEGRADED member")
+    check(len(tr._mesh.devices.flat) < 8,
+          f"re-meshed around the degraded member "
+          f"({len(tr._mesh.devices.flat)} devices)")
+    for n, v in tr.params.items():
+        check(bool(np.isfinite(np.asarray(v)).all()),
+              f"final param {n} finite after unattended recovery")
+    check(st["supervisor"]["step_time"]["count"] > 0,
+          "step-time histogram recorded")
+    print("straggler train smoke PASS (slow-step ladder -> degraded "
+          "quarantine -> unattended re-mesh)")
+
+
+def main():
+    leg = sys.argv[1] if len(sys.argv) > 1 else "serve"
+    if leg == "serve":
+        _serve()
+    elif leg == "train":
+        _train()
+    else:
+        print(f"unknown leg {leg!r} (serve|train)")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -217,3 +217,46 @@ register_knob("MXTPU_INTEGRITY_WARMUP", int, 8,
               "steps of sentinel statistics collected before the "
               "z-score test arms (absolute/non-finite bounds are "
               "always live)")
+register_knob("MXTPU_FLEET_HEDGE_MAX", int, 4,
+              "gray-failure hedging: max concurrent hedged dispatches a "
+              "FleetRouter may have outstanding (0 disables hedging "
+              "entirely; docs/how_to/fleet.md)")
+register_knob("MXTPU_FLEET_HEDGE_FACTOR", float, 2.0,
+              "a request whose elapsed time crosses this multiple of "
+              "the fleet p95 dispatch latency is hedged onto the "
+              "next-best replica (first settle wins, exactly-once)")
+register_knob("MXTPU_FLEET_HEDGE_MIN_SAMPLES", int, 16,
+              "recorded fleet dispatch latencies required before the "
+              "hedge threshold arms (no hedging on a cold histogram)")
+register_knob("MXTPU_FLEET_SLOW_FACTOR", float, 4.0,
+              "slow-eviction rung: a replica whose windowed p95 sits at "
+              "or above this multiple of the fleet-median p95 is "
+              "evicted like an error-rate breach (0 disables)")
+register_knob("MXTPU_FLEET_SLOW_MIN_SAMPLES", int, 16,
+              "dispatches a replica's latency window must hold before "
+              "the slow-eviction comparison runs")
+register_knob("MXTPU_RETRY_JITTER", str, "uniform",
+              "RetryPolicy backoff jitter mode: 'uniform' (+/- jitter "
+              "fraction around the exponential schedule) or "
+              "'decorrelated' (AWS-style seedable decorrelated jitter "
+              "so workers retrying the same failed site spread out "
+              "instead of waking in lockstep)")
+register_knob("MXTPU_SLOW_STEP", int, 0,
+              "arm the supervisor's host-side step-time sentinel: "
+              "persistent slow steps walk the retry -> rebind -> "
+              "re-mesh ladder (docs/how_to/preemption.md) — 0 disables")
+register_knob("MXTPU_SLOW_STEP_ZMAX", float, 6.0,
+              "slow-step sentinel: z-score of a step's wall time "
+              "against the running (Welford) statistics beyond which "
+              "the step counts as slow")
+register_knob("MXTPU_SLOW_STEP_FACTOR", float, 0.0,
+              "slow-step sentinel: absolute bound — wall time above "
+              "this multiple of the running mean counts as slow "
+              "(0 = z-score only)")
+register_knob("MXTPU_SLOW_STEP_WARMUP", int, 8,
+              "clean step-time samples folded before the slow-step "
+              "sentinel arms")
+register_knob("MXTPU_SLOW_STEP_STREAK", int, 3,
+              "consecutive slow steps at which the supervisor escalates "
+              "to elastic re-mesh (rungs below: 1 logs+retries, "
+              "2 rebinds)")

@@ -1184,10 +1184,16 @@ class SPMDTrainer:
                 # rung 3: a step that stalls through retry + rebind is
                 # treated as a sick participant — the outer fit loop's
                 # DeviceLost recovery restores onto survivors (PR 6)
-                return DeviceLost(
+                lost = DeviceLost(
                     f"step stalled through retry and rebind ({err}); "
                     "escalating to elastic re-mesh: restore the newest "
                     "checkpoint onto the surviving devices")
+                if getattr(err, "slow", False):
+                    # a StepSlow escalation: the recovery path must
+                    # quarantine the topology as DEGRADED (gray
+                    # failure), not mark a device lost
+                    lost.slow = True
+                return lost
         for epoch in range(begin_epoch, num_epoch):
             if begin_batch == 0:
                 train_data.reset()

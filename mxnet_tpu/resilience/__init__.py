@@ -62,10 +62,12 @@ from .faults import (SITES, FaultPlan, InjectedFault,  # noqa: F401
 from .integrity import (ChecksumMismatch, DivergenceDetected,  # noqa: F401
                         IntegrityAbort, IntegrityConfig, IntegrityGuard,
                         corruption_point)
+from .latency import LatencyRecorder, StepTimeSentinel  # noqa: F401
 from .retry import RetryExhausted, RetryPolicy, default_policy  # noqa: F401
 from .supervisor import (CrashLoopGuard, ImmediateAbort,  # noqa: F401
                          Preempted, SignalRuntime, StallAbort,
-                         StallWatchdog, StepStalled, TrainingSupervisor)
+                         StallWatchdog, StepSlow, StepStalled,
+                         TrainingSupervisor)
 
 __all__ = ["checkpoint", "async_checkpoint", "data", "elastic", "faults",
            "retry", "FaultPlan",
@@ -82,7 +84,8 @@ __all__ = ["checkpoint", "async_checkpoint", "data", "elastic", "faults",
            "guard", "DeviceLost", "MeshHealth", "ElasticConfig",
            "ElasticController", "supervisor", "TrainingSupervisor",
            "SignalRuntime", "StallWatchdog", "CrashLoopGuard", "Preempted",
-           "ImmediateAbort", "StepStalled", "StallAbort",
+           "ImmediateAbort", "StepStalled", "StepSlow", "StallAbort",
+           "LatencyRecorder", "StepTimeSentinel",
            "integrity", "IntegrityConfig", "IntegrityGuard",
            "DivergenceDetected", "ChecksumMismatch", "IntegrityAbort",
            "corruption_point"]

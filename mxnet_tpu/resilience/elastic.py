@@ -114,7 +114,8 @@ def stats() -> dict:
     with _lock:
         out = {k: _counters.get(k, 0)
                for k in ("probes", "losses_detected", "devices_added",
-                         "remeshes", "collective_failures")}
+                         "remeshes", "collective_failures",
+                         "degraded_marks")}
         out["last_resume_s"] = _resume["last_s"]
         out["resume_total_s"] = _resume["total_s"]
         return out
@@ -146,6 +147,14 @@ class MeshHealth:
     :meth:`heal` — a lost TPU chip does not rejoin on its own. Device
     *addition* needs no special casing: the probe simply reports more
     devices than the current mesh uses (tests inject a growing probe).
+
+    A third state, **degraded** (:meth:`mark_degraded`), quarantines a
+    member that is alive but persistently SLOW — the supervisor's
+    step-time sentinel escalated a :class:`StepSlow` through the
+    ladder. Degraded ids are excluded exactly like killed ones (a
+    throttling chip drags every synchronous step to its pace, so
+    keeping it in the mesh is as bad as keeping a dead one) and rejoin
+    only on :meth:`heal`.
     """
 
     def __init__(self, probe: Optional[Callable[[], Sequence]] = None,
@@ -157,6 +166,7 @@ class MeshHealth:
         self._probe = probe
         self._seed = seed
         self._killed: set = set()
+        self._degraded: set = set()
         self.min_devices = max(1, int(min_devices))
 
     def _kill_seed(self) -> int:
@@ -165,8 +175,12 @@ class MeshHealth:
         plan = faults.active_plan()
         return plan.seed if plan is not None else 0
 
+    def _usable(self) -> List:
+        return [d for d in self._probe()
+                if d.id not in self._killed and d.id not in self._degraded]
+
     def _kill_one(self):
-        alive = [d for d in self._probe() if d.id not in self._killed]
+        alive = self._usable()
         if not alive:
             return
         # deterministic victim: same seed + same loss ordinal -> same
@@ -196,15 +210,35 @@ class MeshHealth:
         logging.warning("MeshHealth: device id %d quarantined "
                         "(checksum dissent)", device_id)
 
+    def mark_degraded(self):
+        """Quarantine one currently-usable device as *degraded* — alive
+        but persistently slow (the supervisor's step-time sentinel
+        escalated through the slow ladder). Seeded victim choice, the
+        :meth:`mark_failure` convention: the host cannot tell WHICH
+        chip throttles from wall time alone, but the same seed must
+        quarantine the same member every replay."""
+        alive = self._usable()
+        if not alive:
+            return
+        rng = random.Random(self._kill_seed() * 1000003
+                            + len(self._killed) + len(self._degraded))
+        victim = alive[rng.randrange(len(alive))]
+        self._degraded.add(victim.id)
+        _count("degraded_marks")
+        logging.warning(
+            "MeshHealth: device %s DEGRADED (alive but slow; "
+            "quarantined, %d usable remain)", victim, len(alive) - 1)
+
     def healthy_devices(self) -> List:
-        """Enumerate currently-usable devices. Passes the ``mesh.probe``
-        fault site first: an injected fault there kills one device."""
+        """Enumerate currently-usable devices (killed AND degraded
+        excluded). Passes the ``mesh.probe`` fault site first: an
+        injected fault there kills one device."""
         _count("probes")
         try:
             faults.fault_point(SITE_PROBE)
         except (InjectedFault, InjectedTimeout):
             self._kill_one()
-        devs = [d for d in self._probe() if d.id not in self._killed]
+        devs = self._usable()
         if len(devs) < self.min_devices:
             raise MXNetError(
                 f"only {len(devs)} healthy device(s) remain, below the "
@@ -213,8 +247,10 @@ class MeshHealth:
         return devs
 
     def heal(self):
-        """Forget recorded losses (a repaired/restarted slice)."""
+        """Forget recorded losses AND degradations (a repaired or
+        restarted slice)."""
         self._killed.clear()
+        self._degraded.clear()
 
 
 # -- reaction ----------------------------------------------------------------
@@ -405,7 +441,14 @@ class ElasticController:
         """A step raised :class:`DeviceLost`: re-bind on the survivors,
         restore the newest valid checkpoint, rewind the iterator.
         Returns ``(begin_epoch, begin_batch)``."""
-        if not getattr(err, "already_marked", False):
+        if getattr(err, "slow", False):
+            # a persistently SLOW step is a gray failure: the chip is
+            # alive (no collective died), so quarantine a topology
+            # member as *degraded* — treated exactly like a lost device
+            # from here on (excluded from healthy_devices, re-meshed
+            # around), but recorded distinctly in stats
+            self.health.mark_degraded()
+        elif not getattr(err, "already_marked", False):
             # a loss surfaced by check()'s failed in-place path was
             # already recorded by the probe; only a fresh mid-step
             # collective failure needs a victim marked here

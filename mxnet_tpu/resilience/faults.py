@@ -21,13 +21,21 @@ Each rule is ``site:nth:kind`` (fail the Nth call and every one of the
 fails with probability 0.1, drawn from the plan's seeded RNG).
 Kinds: ``ioerror`` (retriable OSError), ``timeout`` (retriable
 TimeoutError), ``kill`` (a BaseException — simulates process death, never
-retried, escapes ``except Exception``).
+retried, escapes ``except Exception``), and ``delay`` — the gray-failure
+kind: nothing raises, the call is simply made SLOW.  A delay rule takes a
+fourth field, the milliseconds to burn (``site:nth:delay:ms`` /
+``site:p=X:delay:ms``), spent through the plan's injectable ``sleep``
+(``time.sleep`` by default; unit tests wire a fake clock's ``advance`` so
+zero real time passes).  ``fault_point`` returns the seconds burned so
+instrumented callers can attribute the slowness (the fleet dispatch path
+pins it on the replica whose forward it was).
 """
 from __future__ import annotations
 
 import os
 import random
 import threading
+import time
 from typing import Dict, List, Optional, Set
 
 __all__ = ["FaultPlan", "InjectedFault", "InjectedTimeout", "InjectedKill",
@@ -109,72 +117,90 @@ _KINDS = {"ioerror": InjectedFault, "timeout": InjectedTimeout,
 
 
 class _Rule:
-    __slots__ = ("nth", "count", "prob", "exc")
+    __slots__ = ("nth", "count", "prob", "exc", "delay_ms")
 
-    def __init__(self, nth=None, count=1, prob=None, exc=InjectedFault):
+    def __init__(self, nth=None, count=1, prob=None, exc=InjectedFault,
+                 delay_ms=None):
         self.nth = nth          # 1-based call number to start failing at
         self.count = count      # how many consecutive calls fail
         self.prob = prob        # alternatively: per-call probability
-        self.exc = exc
+        self.exc = exc          # None for a delay rule (nothing raises)
+        self.delay_ms = delay_ms
 
 
 class FaultPlan:
     """A seedable set of armed fault rules, keyed by site name."""
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, sleep=time.sleep):
         self.seed = seed
+        self.sleep = sleep      # burns delay rules; injectable for tests
         self._rng = random.Random(seed)
         self._rules: Dict[str, List[_Rule]] = {}
 
     def arm(self, site: str, nth: Optional[int] = None, count: int = 1,
-            prob: Optional[float] = None, exc="ioerror") -> "FaultPlan":
+            prob: Optional[float] = None, exc="ioerror",
+            delay_ms: Optional[float] = None) -> "FaultPlan":
         """Arm ``site`` to fail on the Nth call (``nth``, 1-based, for
         ``count`` consecutive calls) or with per-call probability
-        ``prob``. ``exc`` is a kind name from {ioerror, timeout, kill}
-        or an exception class. Returns self for chaining."""
+        ``prob``. ``exc`` is a kind name from {ioerror, timeout, kill,
+        delay} or an exception class; kind ``delay`` raises nothing and
+        instead burns ``delay_ms`` milliseconds through the plan's
+        ``sleep``. Returns self for chaining."""
         if (nth is None) == (prob is None):
             raise ValueError("arm() needs exactly one of nth= or prob=")
-        if isinstance(exc, str):
+        if exc == "delay":
+            if delay_ms is None:
+                raise ValueError("fault kind 'delay' needs delay_ms=")
+            exc = None
+        elif delay_ms is not None:
+            raise ValueError("delay_ms= only applies to exc='delay'")
+        elif isinstance(exc, str):
             if exc not in _KINDS:
                 raise ValueError(f"unknown fault kind {exc!r}; "
-                                 f"choose from {sorted(_KINDS)}")
+                                 f"choose from {sorted(_KINDS) + ['delay']}")
             exc = _KINDS[exc]
         self._rules.setdefault(site, []).append(
-            _Rule(nth=nth, count=count, prob=prob, exc=exc))
+            _Rule(nth=nth, count=count, prob=prob, exc=exc,
+                  delay_ms=delay_ms))
         return self
 
     def sites(self) -> Set[str]:
         return set(self._rules)
 
-    def _check(self, site: str, ncall: int):
-        """Return the exception class to raise for this call, or None."""
+    def _check(self, site: str, ncall: int) -> Optional[_Rule]:
+        """Return the rule firing on this call, or None."""
         for rule in self._rules.get(site, ()):
             if rule.nth is not None:
                 if rule.nth <= ncall < rule.nth + rule.count:
-                    return rule.exc
+                    return rule
             elif rule.prob is not None:
                 if self._rng.random() < rule.prob:
-                    return rule.exc
+                    return rule
         return None
 
     @classmethod
     def from_env(cls, spec: str, seed: int = 0) -> "FaultPlan":
-        """Parse a ``site:nth:kind;site:p=0.1:kind`` spec string."""
+        """Parse a ``site:nth:kind;site:p=0.1:kind`` spec string (the
+        ``delay`` kind takes a fourth field: ``site:nth:delay:ms``)."""
         plan = cls(seed=seed)
         for part in spec.replace(",", ";").split(";"):
             part = part.strip()
             if not part:
                 continue
             fields = part.split(":")
-            if len(fields) not in (2, 3):
+            if not (len(fields) in (2, 3)
+                    or (len(fields) == 4 and fields[2] == "delay")):
                 raise ValueError(f"bad fault rule {part!r} "
-                                 "(want site:nth[:kind] or site:p=X[:kind])")
+                                 "(want site:nth[:kind], site:p=X[:kind] "
+                                 "or site:nth:delay:ms)")
             site, when = fields[0], fields[1]
-            kind = fields[2] if len(fields) == 3 else "ioerror"
+            kind = fields[2] if len(fields) >= 3 else "ioerror"
+            delay_ms = float(fields[3]) if len(fields) == 4 else None
             if when.startswith("p="):
-                plan.arm(site, prob=float(when[2:]), exc=kind)
+                plan.arm(site, prob=float(when[2:]), exc=kind,
+                         delay_ms=delay_ms)
             else:
-                plan.arm(site, nth=int(when), exc=kind)
+                plan.arm(site, nth=int(when), exc=kind, delay_ms=delay_ms)
         return plan
 
 
@@ -183,6 +209,7 @@ _active: Optional[FaultPlan] = None
 _env_checked = False
 _calls: Dict[str, int] = {}     # site -> total fault_point() invocations
 _fired: Dict[str, int] = {}     # site -> injected faults raised
+_delayed: Dict[str, int] = {}   # site -> injected delays burned
 
 
 def arm(plan: FaultPlan) -> FaultPlan:
@@ -193,6 +220,7 @@ def arm(plan: FaultPlan) -> FaultPlan:
         _env_checked = True     # explicit arming overrides the env var
         _calls.clear()
         _fired.clear()
+        _delayed.clear()
     return plan
 
 
@@ -218,34 +246,51 @@ def active_plan() -> Optional[FaultPlan]:
     return _active
 
 
-def fault_point(site: str):
-    """Mark a fault-injectable site. No-op unless a plan arms ``site``."""
+def fault_point(site: str) -> Optional[float]:
+    """Mark a fault-injectable site. No-op unless a plan arms ``site``.
+
+    Raising kinds raise; the ``delay`` kind burns its milliseconds
+    through the plan's ``sleep`` (outside the module lock — a real sleep
+    must never serialize every other fault point behind it) and returns
+    the seconds burned so callers can attribute the slowness. Returns
+    None when nothing fired."""
     plan = active_plan()
     if plan is None:
-        return
+        return None
     with _lock:
         n = _calls.get(site, 0) + 1
         _calls[site] = n
-        exc = plan._check(site, n)
-        if exc is not None:
-            _fired[site] = _fired.get(site, 0) + 1
-    if exc is not None:
-        raise exc(f"injected fault at {site} (call #{n})")
+        rule = plan._check(site, n)
+        if rule is not None:
+            if rule.exc is not None:
+                _fired[site] = _fired.get(site, 0) + 1
+            else:
+                _delayed[site] = _delayed.get(site, 0) + 1
+    if rule is not None:
+        if rule.exc is not None:
+            raise rule.exc(f"injected fault at {site} (call #{n})")
+        burned = float(rule.delay_ms) / 1000.0
+        plan.sleep(burned)
+        return burned
+    return None
 
 
 def observed_sites() -> Set[str]:
     """Sites where an injected fault has actually fired."""
     with _lock:
-        return {s for s, n in _fired.items() if n}
+        return {s for s, n in _fired.items() if n} \
+            | {s for s, n in _delayed.items() if n}
 
 
 def stats() -> Dict[str, Dict[str, int]]:
     """Snapshot of per-site fault-point call and fire counters."""
     with _lock:
-        return {"calls": dict(_calls), "fired": dict(_fired)}
+        return {"calls": dict(_calls), "fired": dict(_fired),
+                "delayed": dict(_delayed)}
 
 
 def reset_stats():
     with _lock:
         _calls.clear()
         _fired.clear()
+        _delayed.clear()

@@ -71,6 +71,17 @@ class RetryPolicy:
     """Exponential backoff: delay_i = min(cap, base * mult**i), each
     scaled by a jitter factor drawn uniformly from [1-jitter, 1+jitter].
 
+    ``jitter_mode`` (default: the ``MXTPU_RETRY_JITTER`` knob) picks the
+    schedule shape:
+
+    - ``"uniform"`` — the classic schedule above;
+    - ``"decorrelated"`` — delay_i = min(cap, U(base, prev_delay * 3)),
+      the AWS decorrelated-jitter scheme: N workers that all hit the
+      same failed site (a replica eviction sheds a whole backlog at
+      once) draw *independent* schedules from their seeded RNGs instead
+      of waking in lockstep and re-stampeding the survivor;
+    - ``"off"`` — the deterministic exponential schedule, no jitter.
+
     ``max_retries`` bounds attempts beyond the first; ``deadline`` bounds
     total elapsed time including the upcoming sleep (the policy never
     starts a sleep that would overrun it)."""
@@ -81,25 +92,41 @@ class RetryPolicy:
                  retry_on: Tuple = _RETRIABLE,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 jitter_mode: Optional[str] = None):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if jitter_mode is None:
+            from .. import config as _config
+            jitter_mode = _config.get("MXTPU_RETRY_JITTER")
+        jitter_mode = str(jitter_mode).lower()
+        if jitter_mode not in ("uniform", "decorrelated", "off"):
+            raise ValueError(
+                f"jitter_mode {jitter_mode!r} not in "
+                "('uniform', 'decorrelated', 'off')")
         self.max_retries = max_retries
         self.base_delay = base_delay
         self.max_delay = max_delay
         self.multiplier = multiplier
         self.jitter = jitter
+        self.jitter_mode = jitter_mode
         self.deadline = deadline
         self.retry_on = tuple(retry_on)
         self.clock = clock
         self.sleep = sleep
         self._rng = random.Random(seed)
 
-    def delay(self, attempt: int) -> float:
-        """Backoff before retry ``attempt`` (1-based), jitter applied."""
+    def delay(self, attempt: int, prev: Optional[float] = None) -> float:
+        """Backoff before retry ``attempt`` (1-based), jitter applied.
+        ``prev`` is the previous pause (decorrelated mode feeds on it;
+        None on the first retry)."""
+        if self.jitter_mode == "decorrelated":
+            lo = self.base_delay
+            hi = max(lo, (lo if prev is None else prev) * 3.0)
+            return max(0.0, min(self.max_delay, self._rng.uniform(lo, hi)))
         raw = min(self.max_delay,
                   self.base_delay * self.multiplier ** (attempt - 1))
-        if self.jitter:
+        if self.jitter and self.jitter_mode != "off":
             raw *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
         return max(0.0, raw)
 
@@ -107,6 +134,7 @@ class RetryPolicy:
         """Run ``fn(*args, **kwargs)``, retrying transient failures."""
         start = self.clock()
         attempt = 0
+        prev_pause: Optional[float] = None
         while True:
             try:
                 return fn(*args, **kwargs)
@@ -119,7 +147,7 @@ class RetryPolicy:
                     raise RetryExhausted(
                         f"{label}: gave up after {attempt} attempts "
                         f"({err!r})") from err
-                pause = self.delay(attempt)
+                pause = self.delay(attempt, prev_pause)
                 if (self.deadline is not None
                         and self.clock() - start + pause > self.deadline):
                     _count(_giveups, label)
@@ -130,6 +158,7 @@ class RetryPolicy:
                 logging.warning("%s failed (%r); retry %d/%d in %.3fs",
                                 label, err, attempt, self.max_retries, pause)
                 self.sleep(pause)
+                prev_pause = pause
 
     def wrap(self, fn: Callable, label: Optional[str] = None) -> Callable:
         """Decorator form of :meth:`call`."""

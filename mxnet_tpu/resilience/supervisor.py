@@ -58,10 +58,12 @@ from typing import Callable, Dict, Optional, Sequence
 from ..base import MXNetError
 from . import faults
 from .faults import InjectedFault, InjectedTimeout
+from .latency import LatencyRecorder, StepTimeSentinel
 
 __all__ = ["TrainingSupervisor", "SignalRuntime", "StallWatchdog",
            "CrashLoopGuard", "Preempted", "ImmediateAbort", "StepStalled",
-           "StallAbort", "stats", "reset_stats", "signal_runtime",
+           "StepSlow", "StallAbort", "stats", "reset_stats",
+           "signal_runtime",
            "skip_quarantined_batches",
            "SITE_SIGNAL", "SITE_HEARTBEAT", "EXIT_PREEMPTED", "EXIT_ABORTED",
            "EXIT_STALLED", "EXIT_INTEGRITY", "MARKER_SUFFIX",
@@ -133,11 +135,26 @@ class StallAbort(MXNetError):
         self.exit_code = exit_code
 
 
+class StepSlow(MXNetError):
+    """A training step's host wall time breached the step-time sentinel
+    (a throttling chip, a sick host, a degrading interconnect — alive
+    but slow, dragging every synchronous SPMD step to its pace).
+    Carries ``slow=True`` so the elastic recovery path quarantines a
+    topology member as *degraded* instead of marking it lost."""
+
+    slow = True
+
+
 # -- counters (resilience.stats()["supervisor"]) -----------------------------
 
 _lock = threading.Lock()
 _counters: Dict[str, int] = {}
 _backoff = {"total_s": 0.0}
+# host wall time per supervised step (ISSUE 19 gray-failure defense):
+# the recorder is bounded and thread-safe, so every supervisor in the
+# process feeds one histogram — resilience.stats()["supervisor"]
+# surfaces it as "step_time"
+_step_time = LatencyRecorder()
 
 
 def _count(key: str, n: int = 1):
@@ -178,15 +195,20 @@ def stats() -> dict:
                for k in ("signals", "second_signals", "preempt_exits",
                          "aborts", "stalls", "stall_retries",
                          "stall_rebinds", "stall_remeshes", "stall_aborts",
+                         "slow_steps", "slow_rebinds", "slow_remeshes",
+                         "slow_tolerated",
                          "crash_resumes", "batches_quarantined")}
         out["crash_backoff_s"] = _backoff["total_s"]
-        return out
+    out["step_time"] = _step_time.stats()
+    return out
 
 
 def reset_stats():
+    global _step_time
     with _lock:
         _counters.clear()
         _backoff["total_s"] = 0.0
+    _step_time = LatencyRecorder()
 
 
 # -- shared signal runtime ---------------------------------------------------
@@ -647,13 +669,36 @@ class TrainingSupervisor:
                  crash_limit: Optional[int] = None,
                  backoff_base: Optional[float] = None,
                  backoff_cap: Optional[float] = None,
-                 guard_policy=None):
+                 guard_policy=None,
+                 slow_step: Optional[bool] = None,
+                 slow_zmax: Optional[float] = None,
+                 slow_factor: Optional[float] = None,
+                 slow_warmup: Optional[int] = None,
+                 slow_streak: Optional[int] = None):
         from .. import config as _config
         if stall_timeout is None:
             stall_timeout = _config.get(ENV_STALL_TIMEOUT)
         self.stall_timeout = stall_timeout
         self.clock = clock
         self.sleep = sleep
+        # slow-step sentinel (off unless MXTPU_SLOW_STEP=1 or
+        # slow_step=True): Welford z-test on host step wall time — the
+        # gray-failure rung of the ladder, docs/how_to/preemption.md
+        if slow_step is None:
+            slow_step = bool(_config.get("MXTPU_SLOW_STEP"))
+        if slow_streak is None:
+            slow_streak = _config.get("MXTPU_SLOW_STEP_STREAK")
+        self._slow_streak_limit = max(1, int(slow_streak))
+        self.sentinel: Optional[StepTimeSentinel] = None
+        if slow_step:
+            self.sentinel = StepTimeSentinel(
+                zmax=(_config.get("MXTPU_SLOW_STEP_ZMAX")
+                      if slow_zmax is None else float(slow_zmax)),
+                warmup=(_config.get("MXTPU_SLOW_STEP_WARMUP")
+                        if slow_warmup is None else int(slow_warmup)),
+                factor=(_config.get("MXTPU_SLOW_STEP_FACTOR")
+                        if slow_factor is None else float(slow_factor)))
+        self._slow_streak = 0
         self._crash_limit = crash_limit
         self._backoff_base = backoff_base
         self._backoff_cap = backoff_cap
@@ -785,17 +830,30 @@ class TrainingSupervisor:
 
         The streak resets on any successful step and *survives* a
         re-mesh recovery (rung 3 re-enters here; a still-stalling step
-        then falls through to rung 4 instead of ping-ponging)."""
+        then falls through to rung 4 instead of ping-ponging).
+
+        A *completed* step additionally feeds the step-time sentinel
+        (when armed): persistent slow steps walk their own
+        SIDE-EFFECT-ONLY ladder — warn → ``rebind()`` → raise
+        ``remesh_exc(StepSlow)`` — which never re-runs the committed
+        step (the gradient already applied; a re-run would double-apply
+        it), only escalates around it."""
         while True:
             try:
                 self.heartbeat()
+                t0 = self.clock()
                 out = step()
+                step_s = self.clock() - t0
                 self._stall_streak = 0
                 if self.watchdog is not None:
                     # the supervised window is the step only: metric
                     # updates, eval passes and checkpoint writes between
                     # steps run beat-less and must not read as stalls
                     self.watchdog.suspend()
+                _step_time.record(step_s)
+                if self.sentinel is not None:
+                    self._slow_walk(step_s, rebind=rebind,
+                                    remesh_exc=remesh_exc, label=label)
                 return out
             except StepStalled as err:
                 if self.watchdog is not None:
@@ -834,6 +892,61 @@ class TrainingSupervisor:
                     f"retry/rebind/re-mesh; aborting for relaunch "
                     f"(resume='auto' continues from the checkpoint)"
                 ) from err
+
+    def _slow_walk(self, step_s: float, *, rebind, remesh_exc, label):
+        """The slow-step ladder (the gray-failure analogue of the stall
+        ladder, on COMPLETED steps): the sentinel flagged this step's
+        wall time as a breach. Side effects only — the step's update is
+        already committed, so nothing here re-runs it:
+
+        1. warn (a one-off slow step is noise);
+        2. ``rebind()`` the compiled program (a degraded executable or
+           dispatch path clears here);
+        3. after ``MXTPU_SLOW_STEP_STREAK`` consecutive breaches, raise
+           ``remesh_exc(StepSlow)`` — the elastic recovery quarantines
+           a topology member as *degraded* and re-meshes around it.
+
+        Without a re-mesh path the streak resets and is counted
+        ``slow_tolerated`` (persistent slowness on a fixed topology is
+        an operator page, not a crash)."""
+        if not self.sentinel.observe(step_s):
+            self._slow_streak = 0
+            return
+        self._slow_streak += 1
+        rung = self._slow_streak
+        _count("slow_steps")
+        if rung == 1:
+            logging.warning(
+                "%s slow: %.3fs against mean %.3fs (std %.3fs); slow "
+                "ladder rung 1: watching", label, step_s,
+                self.sentinel.mean, self.sentinel.std)
+            return
+        if rung == 2 and rebind is not None:
+            _count("slow_rebinds")
+            logging.warning("%s slow again; slow ladder rung 2: "
+                            "rebinding the compiled step", label)
+            rebind()
+            return
+        if rung >= self._slow_streak_limit:
+            if remesh_exc is not None and self.can_remesh:
+                _count("slow_remeshes")
+                logging.warning(
+                    "%s persistently slow (%d consecutive breaches); "
+                    "slow ladder rung 3: quarantining the topology as "
+                    "degraded and escalating to elastic re-mesh",
+                    label, rung)
+                err = StepSlow(
+                    f"{label} wall time {step_s:.3f}s breached the "
+                    f"step-time sentinel {rung} consecutive times "
+                    f"(mean {self.sentinel.mean:.3f}s); the topology "
+                    "is degraded — re-mesh around the slow member")
+                raise remesh_exc(err) from err
+            _count("slow_tolerated")
+            self._slow_streak = 0
+            logging.warning(
+                "%s persistently slow (%d consecutive breaches) with no "
+                "re-mesh path; tolerating — page the operator", label,
+                rung)
 
     # -- crash-loop side ----------------------------------------------------
 

@@ -57,6 +57,7 @@ from ..resilience import faults
 from ..resilience.checkpoint import (model_version_info,
                                      require_newer_version)
 from ..resilience.faults import InjectedFault, InjectedTimeout
+from ..resilience.latency import LatencyRecorder
 from .admission import DEFAULT_TENANT, Deadline, StrideScheduler, TenantPolicy
 from .errors import (CircuitOpen, DeadlineExceeded, Draining,
                      FleetUnavailable, QueueFull, QuotaExceeded,
@@ -103,7 +104,7 @@ class Replica:
     __slots__ = ("id", "server", "state", "model_version", "model_uid",
                  "model_source", "killed", "kill_reason", "probe_failures",
                  "ready_s", "routed", "re_routed_from", "warming",
-                 "_err_base")
+                 "_err_base", "latency", "slow_s", "_lat_base")
 
     def __init__(self, rid: str, model_version=None, model_uid=None,
                  model_source=None):
@@ -120,7 +121,13 @@ class Replica:
         self.routed = 0              # requests first routed here
         self.re_routed_from = 0      # requests that left after a failure
         self.warming = True          # warm-up probes skip fleet.dispatch
-        self._err_base = (0, 0)      # (completed, failed) window baseline
+        # (completed, failed, deadline_inflight) window baseline — a
+        # dispatch that outlived its deadline on a LIVE replica is
+        # failure evidence toward eviction, not just a client expiry
+        self._err_base = (0, 0, 0)
+        self.latency = LatencyRecorder()  # live-dispatch wall time
+        self.slow_s = 0.0            # sticky injected/operator slowness
+        self._lat_base = None        # slow-window bucket baseline
 
     def kill(self, reason: str):
         """Simulated process death: every later dispatch on this replica
@@ -135,12 +142,15 @@ class Replica:
 class _ReplicaBackend:
     """Per-replica wrapper around the factory-made backend: passes the
     ``fleet.dispatch`` fault site on every live forward (an injected
-    fault there kills THIS replica mid-burst) and fails fast once the
-    replica is dead — a killed process answers nothing."""
+    fault there kills THIS replica mid-burst; an injected *delay* makes
+    it sticky-SLOW — the gray-failure analogue), fails fast once the
+    replica is dead, and times every live forward into the replica's
+    and the fleet's latency histograms."""
 
-    def __init__(self, inner, replica: Replica):
+    def __init__(self, inner, replica: Replica, router: "FleetRouter"):
         self.inner = inner
         self.replica = replica
+        self.router = router
         # proxy the warm-up metadata the server reads
         for attr in ("input_name", "input_specs", "row_shape",
                      "input_names"):
@@ -156,15 +166,31 @@ class _ReplicaBackend:
             raise ReplicaEvicted(
                 f"replica {replica.id} is dead "
                 f"({replica.kill_reason}); re-dispatch elsewhere")
-        if not replica.warming:
+        if replica.warming:
             # warm-up probes are excluded so a fault plan's Nth-dispatch
-            # rule counts LIVE traffic only — deterministic mid-burst
-            try:
-                faults.fault_point(SITE_DISPATCH)
-            except (InjectedFault, InjectedTimeout):
-                replica.kill(f"injected fault at {SITE_DISPATCH}")
-                raise
-        return self.inner.infer(arrays)
+            # rule counts LIVE traffic only — deterministic mid-burst —
+            # and warm-up latency never pollutes the health histograms
+            return self.inner.infer(arrays)
+        router = self.router
+        t0 = router.clock()
+        try:
+            burned = faults.fault_point(SITE_DISPATCH)
+        except (InjectedFault, InjectedTimeout):
+            replica.kill(f"injected fault at {SITE_DISPATCH}")
+            raise
+        if burned:
+            # a delay fault makes THE REPLICA WHOSE FORWARD IT WAS
+            # sticky-slow (mirroring the kill convention above): every
+            # later forward burns the same time, so the gray failure
+            # persists until the router votes the replica out
+            replica.slow_s = max(replica.slow_s, burned)
+        elif replica.slow_s:
+            router._sleep(replica.slow_s)
+        out = self.inner.infer(arrays)
+        dt = router.clock() - t0
+        replica.latency.record(dt)
+        router._latency.record(dt)
+        return out
 
 
 class FleetRequest:
@@ -179,7 +205,8 @@ class FleetRequest:
 
     __slots__ = ("id", "inputs", "deadline", "tenant", "priority",
                  "session", "attempts", "_value", "_error", "_settled",
-                 "_lock")
+                 "_lock", "submit_t", "hedge_idx", "n_hedges",
+                 "hedges_held")
 
     def __init__(self, inputs, deadline: Deadline,
                  tenant: str = DEFAULT_TENANT, priority: int = 0,
@@ -198,6 +225,10 @@ class FleetRequest:
         self._error = None
         self._settled = False
         self._lock = threading.Lock()
+        self.submit_t = None         # router-clock admit time (hedging)
+        self.hedge_idx = set()       # attempt indices that were hedges
+        self.n_hedges = 0            # hedges dispatched for this request
+        self.hedges_held = 0         # hedge-cap slots currently held
 
     @property
     def settled(self) -> bool:
@@ -264,6 +295,23 @@ class FleetRouter:
     max_redispatch : failed replica attempts one request may ride
         before its last error is delivered as terminal (default:
         ``replicas + standbys + 1``).
+    hedge_max / hedge_factor / hedge_min_samples : tail-latency hedged
+        dispatch (``MXTPU_FLEET_HEDGE_MAX`` and friends): once a
+        request has waited past ``hedge_factor`` × the fleet p95 (armed
+        only after ``hedge_min_samples`` recorded dispatches), it is
+        re-dispatched to an unattempted replica through the first-wins
+        settle latch; at most ``hedge_max`` hedges ride fleet-wide.
+        ``hedge_max=0`` disables hedging. Sessions never hedge.
+    slow_factor / slow_min_samples : the slow-eviction rung
+        (``MXTPU_FLEET_SLOW_FACTOR`` / ``MXTPU_FLEET_SLOW_MIN_SAMPLES``):
+        a replica whose windowed p95 sits at or above ``slow_factor`` ×
+        the fleet-median p95 over at least ``slow_min_samples``
+        dispatches is evicted exactly like an error-rate breach.
+        ``slow_factor=0`` disables the rung.
+    sleep : injectable sleep used to burn a replica's sticky slowness
+        (tests wire a fake clock's ``advance``; default ``time.sleep``).
+    poll : threaded-mode wait slice (seconds) between settle scans
+        while hedging is armed.
     initial_model : model source for the first generation (manifest
         path / dict / version int / None = unversioned).
     drain_grace : seconds a threaded retiring replica may spend
@@ -290,6 +338,13 @@ class FleetRouter:
                  seed: Optional[int] = None,
                  breaker_factory: Optional[Callable] = None,
                  clock: Callable[[], float] = time.monotonic,
+                 hedge_max: Optional[int] = None,
+                 hedge_factor: Optional[float] = None,
+                 hedge_min_samples: Optional[int] = None,
+                 slow_factor: Optional[float] = None,
+                 slow_min_samples: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 poll: float = 0.002,
                  **server_kwargs):
         from .. import config as _config
         if "breaker" in server_kwargs:
@@ -319,6 +374,25 @@ class FleetRouter:
                                else int(max_redispatch))
         self.drain_grace = float(drain_grace)
         self.clock = clock
+        self._sleep = sleep
+        self.poll = float(poll)
+        if hedge_max is None:
+            hedge_max = _config.get("MXTPU_FLEET_HEDGE_MAX")
+        if hedge_factor is None:
+            hedge_factor = _config.get("MXTPU_FLEET_HEDGE_FACTOR")
+        if hedge_min_samples is None:
+            hedge_min_samples = _config.get("MXTPU_FLEET_HEDGE_MIN_SAMPLES")
+        if slow_factor is None:
+            slow_factor = _config.get("MXTPU_FLEET_SLOW_FACTOR")
+        if slow_min_samples is None:
+            slow_min_samples = _config.get("MXTPU_FLEET_SLOW_MIN_SAMPLES")
+        self.hedge_max = int(hedge_max)
+        self.hedge_factor = float(hedge_factor)
+        self.hedge_min_samples = int(hedge_min_samples)
+        self.slow_factor = float(slow_factor)
+        self.slow_min_samples = int(slow_min_samples)
+        self._latency = LatencyRecorder()   # fleet-wide dispatch times
+        self._hedges_out = 0    # tpu-lint: guarded-by=_lock
         self._seed = seed
         self._probe_fn = probe or self._default_probe
         self._breaker_factory = breaker_factory
@@ -346,7 +420,9 @@ class FleetRouter:
             "probes": 0, "probe_failures": 0, "shed_on_eviction": 0,
             "standby_spawns": 0, "spawn_failures": 0,
             "reload_generations": 0, "sessions_relocated": 0,
-            "last_standby_ready_s": 0.0}
+            "last_standby_ready_s": 0.0,
+            "hedges": 0, "hedge_wins": 0, "hedge_losses": 0,
+            "hedges_suppressed": 0, "slow_evictions": 0}
         self._stride.shared = True   # pruning must never drop another
         # replica queue's tenant clocks (StrideScheduler.pick)
         self.model_version, self.model_uid = \
@@ -401,7 +477,7 @@ class FleetRouter:
         replica = Replica(rid, version, uid, source)
         try:
             backend = _ReplicaBackend(self.backend_factory(rid, source),
-                                      replica)
+                                      replica, self)
         except BaseException:
             self._count("spawn_failures")
             raise
@@ -422,7 +498,7 @@ class FleetRouter:
         replica.warming = False
         replica.ready_s = self.clock() - t0
         replica.state = state
-        replica._err_base = (0, 0)
+        replica._err_base = (0, 0, 0)
         with self._lock:
             self._replicas[rid] = replica
         self._count("standby_spawns")
@@ -458,7 +534,20 @@ class FleetRouter:
             # pin is committed only when a submit SUCCEEDS there
             # (_commit_pin) — a freshly-chosen replica that rejects
             # must not become the session's home
-        return min(active, key=lambda r: (r.server.load_factor(), r.id))
+        # latency-conditioned least-loaded: a replica whose dispatch
+        # EWMA sits above the fleet median gets proportionally less new
+        # traffic (>=1.0 penalty, so with a cold/uniform fleet the pick
+        # degenerates to pure least-loaded)
+        ewmas = sorted(r.latency.ewma for r in active if r.latency.count)
+        median = ewmas[len(ewmas) // 2] if ewmas else 0.0
+
+        def score(r):
+            penalty = 1.0
+            if median > 0.0 and r.latency.count:
+                penalty = max(1.0, r.latency.ewma / median)
+            return ((r.server.load_factor() + 1.0) * penalty, r.id)
+
+        return min(active, key=score)
 
     def _commit_pin(self, session: str, replica: Replica):
         """Record ``replica`` as the session's home, called on a
@@ -504,6 +593,7 @@ class FleetRouter:
         freq = FleetRequest(inputs, Deadline(deadline, self.clock),
                             tenant=tenant, priority=priority,
                             session=session, fleet=self.name)
+        freq.submit_t = self.clock()
         self._dispatch(freq)
         self._count("submitted")
         return freq
@@ -587,48 +677,162 @@ class FleetRouter:
         """Wait out ``freq``: deliver its replica's answer, or — when
         the attempt died for a replica-local reason — re-dispatch to a
         surviving replica, bounded by the deadline and
-        ``max_redispatch``. Exactly ONE outcome is ever delivered
-        (first-wins settle latch; repeated calls replay it), and a dead
-        replica's late value is preferred over re-running the work
-        (``prior_value`` dedupe)."""
+        ``max_redispatch``. While attempts are outstanding, a request
+        whose elapsed time crosses the fleet-p95-derived hedge
+        threshold is hedged to an unattempted replica. Exactly ONE
+        outcome is ever delivered (first-wins settle latch; repeated
+        calls replay it): the EARLIEST attempt holding a value wins, a
+        losing hedge is discarded, and a dead replica's late value is
+        preferred over re-running the work (dedupe on the request id)."""
         if freq.settled:
             return freq.deliver()
         while True:
-            replica, inner = freq.attempts[-1]
-            if self._workers0:
-                self.run_pending()
-            try:
-                value = replica.server.result(inner)
-            except Exception as err:      # noqa: BLE001 — triaged below
-                if not self._retriable(err) or freq.deadline.expired():
-                    freq.settle_error(err)
-                    self._count("failed_terminal")
-                    raise
-                # dedupe on the request id: an earlier attempt that in
-                # fact completed (the dead replica HAD processed it)
-                # wins over running the request a second time
-                done, prior = freq.prior_value()
-                if done:
-                    freq.settle_value(prior)
-                    self._count("dedup_hits")
-                    self._count("delivered")
-                    return prior
-                if len(freq.attempts) > self.max_redispatch:
-                    freq.settle_error(err)
-                    self._count("failed_terminal")
-                    raise
-                replica.re_routed_from += 1
-                self._count("re_routed")
-                try:
-                    self._redispatch(freq)
-                except Exception as derr:  # noqa: BLE001 — terminal
-                    freq.settle_error(derr)
-                    self._count("failed_terminal")
-                    raise
+            # 1. settle scan over every attempt in dispatch order
+            statuses = [inner.peek() for _, inner in freq.attempts]
+            for i, (status, payload) in enumerate(statuses):
+                if status == "value":
+                    return self._settle_value(freq, i, payload)
+            pending = [i for i, (status, _) in enumerate(statuses)
+                       if status == "pending"]
+            if not pending:
+                # 2. every attempt failed: triage the newest error —
+                #    terminal settle (raises) or a fresh re-dispatch
+                replica, _ = freq.attempts[-1]
+                self._failover(freq, replica, statuses[-1][1])
                 continue
-            freq.settle_value(value)
-            self._count("delivered")
-            return freq.deliver()
+            # 3. attempts outstanding: hedge when the wait justifies it
+            self._maybe_hedge(freq)
+            idx = pending[0]
+            replica, inner = freq.attempts[idx]
+            # 4. advance: drive the queues (workers=0) or wait a slice
+            if self._workers0:
+                if self.run_pending() > 0:
+                    continue
+                block = True    # nothing drivable: pre-hedging wait
+            else:
+                remaining = freq.deadline.remaining()
+                hedging = self.hedge_max > 0 and freq.session is None
+                # without hedging (or once the deadline has expired)
+                # the pre-hedging blocking wait is exactly right — the
+                # server runs the abandoned/deadline_inflight/watchdog
+                # accounting the eviction window feeds on
+                block = not hedging or (remaining is not None
+                                        and remaining <= 0)
+            if block:
+                try:
+                    replica.server.result(inner)
+                except Exception as err:  # noqa: BLE001 — triaged below
+                    if any(im.peek()[0] == "value"
+                           for _, im in freq.attempts):
+                        continue    # a racing attempt landed the value
+                    if inner.peek()[0] == "pending":
+                        # the wait consumed the outcome (an abandoned
+                        # deadline never peeks done): triage it here,
+                        # a rescan would spin forever
+                        self._failover(freq, replica, err)
+                continue
+            slice_ = self.poll
+            if remaining is not None:
+                slice_ = min(slice_, max(0.0, remaining))
+            inner._event.wait(slice_)
+
+    def _settle_value(self, freq: FleetRequest, i: int, value):
+        """Settle attempt ``i``'s value through the first-wins latch,
+        abandon every still-pending loser (a settled request must not
+        burn a slow replica's worker), and account hedge wins/losses
+        and failover dedupes."""
+        freq.settle_value(value)
+        hedge_losses = 0
+        dedup = False
+        for j, (_, inner) in enumerate(freq.attempts):
+            if j == i:
+                continue
+            if inner.peek()[0] == "pending":
+                inner.abandon()
+            if j in freq.hedge_idx:
+                hedge_losses += 1
+            elif j > i:
+                # a non-hedge attempt AFTER the winner means the router
+                # had failed over past a replica that had in fact
+                # processed the request — the classic dedupe
+                dedup = True
+        if i in freq.hedge_idx:
+            self._count("hedge_wins")
+        if hedge_losses:
+            self._count("hedge_losses", hedge_losses)
+        if dedup:
+            self._count("dedup_hits")
+        self._count("delivered")
+        self._hedge_release(freq)
+        return freq.deliver()
+
+    def _failover(self, freq: FleetRequest, replica: Replica,
+                  err: BaseException):
+        """Failure triage: settle terminal (non-retriable error,
+        expired deadline, or the re-dispatch bound) and raise, or ride
+        a fresh replica attempt and return for the caller to rescan."""
+        if not self._retriable(err) or freq.deadline.expired() \
+                or len(freq.attempts) > self.max_redispatch:
+            self._hedge_release(freq)
+            freq.settle_error(err)
+            self._count("failed_terminal")
+            raise err
+        replica.re_routed_from += 1
+        self._count("re_routed")
+        try:
+            self._redispatch(freq)
+        except Exception as derr:      # noqa: BLE001 — terminal
+            self._hedge_release(freq)
+            freq.settle_error(derr)
+            self._count("failed_terminal")
+            raise
+
+    def _maybe_hedge(self, freq: FleetRequest):
+        """Tail-latency hedge: once ``freq`` has waited past
+        ``hedge_factor`` × the fleet p95 (and one more threshold per
+        hedge already riding), re-dispatch it to an UNATTEMPTED replica
+        through the settle latch — first value wins, the loser is
+        discarded. Armed only after ``hedge_min_samples`` recorded
+        dispatches with a non-zero p95 (an all-fake-clock test never
+        hedges by accident); the router-wide ``hedge_max`` cap bounds
+        the extra load a gray fleet can generate. Sessions never hedge
+        (their decode state pins them to one replica)."""
+        if self.hedge_max <= 0 or freq.session is not None \
+                or freq.submit_t is None:
+            return
+        if self._latency.count < self.hedge_min_samples:
+            return
+        p95 = self._latency.quantile(0.95)
+        if p95 <= 0.0:
+            return
+        threshold = self.hedge_factor * p95 * (freq.n_hedges + 1)
+        if self.clock() - freq.submit_t < threshold:
+            return
+        with self._lock:
+            if self._hedges_out >= self.hedge_max:
+                self._totals["hedges_suppressed"] += 1
+                return
+            self._hedges_out += 1
+        attempted = {r.id for r, _ in freq.attempts}
+        try:
+            self._dispatch(freq, exclude=attempted)
+        except Exception:              # noqa: BLE001 — hedge is optional
+            # nowhere to hedge to (every replica attempted/rejecting):
+            # release the slot; the original attempt keeps running
+            with self._lock:
+                self._hedges_out -= 1
+            return
+        freq.hedge_idx.add(len(freq.attempts) - 1)
+        freq.n_hedges += 1
+        freq.hedges_held += 1
+        self._count("hedges")
+
+    def _hedge_release(self, freq: FleetRequest):
+        """Return ``freq``'s outstanding hedge-cap slots on settle."""
+        if freq.hedges_held:
+            with self._lock:
+                self._hedges_out -= freq.hedges_held
+                freq.hedges_held = 0
 
     def _redispatch(self, freq: FleetRequest):
         """Failover dispatch: PREFER a replica no prior attempt failed
@@ -736,28 +940,70 @@ class FleetRouter:
                                 "consecutive probes")
                     continue
             self._check_error_rate(replica)
+            self._check_slow(replica)
 
     def _check_error_rate(self, replica: Replica):
         """The breaker-independent fleet bound: a replica whose failure
         fraction since the last window reaches ``error_rate`` over at
         least ``error_min_calls`` outcomes is evicted outright — an
-        error-spewing box is worse than a silent one."""
+        error-spewing box is worse than a silent one. A dispatch that
+        exceeded its deadline while RUNNING on the replica
+        (``deadline_inflight``) counts as failure evidence: the replica
+        was alive, held the request, and did not answer in time — that
+        is the replica's failure, not merely the client's expiry."""
         if replica.state != ACTIVE:
             return
         srv = replica.server
         with srv._lock:
             completed = srv._stats["completed"]
             failed = srv._stats["failed"]
-        base_c, base_f = replica._err_base
-        d_total = (completed - base_c) + (failed - base_f)
+            timeouts = srv._stats.get("deadline_inflight", 0)
+        base_c, base_f, base_t = replica._err_base
+        bad = (failed - base_f) + (timeouts - base_t)
+        d_total = (completed - base_c) + bad
         if d_total < self.error_min_calls:
             return
-        rate = (failed - base_f) / float(d_total)
-        replica._err_base = (completed, failed)
+        rate = bad / float(d_total)
+        replica._err_base = (completed, failed, timeouts)
         if rate >= self.error_rate:
             self._evict(replica,
                         f"error rate {rate:.2f} over {d_total} calls "
+                        "(in-flight deadline expiries included) "
                         f">= bound {self.error_rate}")
+
+    def _check_slow(self, replica: Replica):
+        """The slow-eviction rung: a replica whose WINDOWED p95 sits at
+        or above ``slow_factor`` × the median p95 of the OTHER active
+        replicas, over at least ``slow_min_samples`` dispatches, is
+        evicted exactly like an error-rate breach — alive-but-slow is a
+        gray failure the health probe cannot see, and it silently owns
+        the fleet p99 until voted out."""
+        if self.slow_factor <= 0 or replica.state != ACTIVE:
+            return
+        counts = replica.latency.counts()
+        if replica._lat_base is None:
+            window = counts
+        else:
+            window = [c - b for c, b in zip(counts, replica._lat_base)]
+        n = sum(window)
+        if n < self.slow_min_samples:
+            return
+        replica._lat_base = counts       # window consumed either way
+        p95 = replica.latency.quantile(0.95, window)
+        others = [r.latency.quantile(0.95) for r in self._active()
+                  if r.id != replica.id and r.latency.count]
+        if not others:
+            return
+        others.sort()
+        median = others[len(others) // 2]
+        if median <= 0.0:
+            return
+        if p95 >= self.slow_factor * median:
+            self._count("slow_evictions")
+            self._evict(replica,
+                        f"windowed p95 {p95:.3f}s >= {self.slow_factor}x "
+                        f"fleet median p95 {median:.3f}s over {n} "
+                        "dispatches (gray failure)")
 
     def kill_replica(self, rid: str, reason: str = "operator kill"):
         """Mark one replica dead (tests / chaos drills); the next probe
@@ -765,6 +1011,15 @@ class FleetRouter:
         with self._lock:
             replica = self._replicas[rid]
         replica.kill(reason)
+
+    def slow_replica(self, rid: str, seconds: float):
+        """Make one replica sticky-slow (tests / chaos drills): every
+        later live forward burns ``seconds`` through the router's
+        injectable sleep — the operator-injected gray failure,
+        mirroring :meth:`kill_replica`. ``seconds=0`` heals it."""
+        with self._lock:
+            replica = self._replicas[rid]
+        replica.slow_s = max(0.0, float(seconds))
 
     def _evict(self, replica: Replica, reason: str):
         """The eviction ladder's last rung: shed the backlog with the
@@ -815,7 +1070,7 @@ class FleetRouter:
                 # flip ACTIVE while still holding the lock: two evicts
                 # promoting concurrently must not both claim this one
                 standby.state = ACTIVE
-                standby._err_base = (0, 0)
+                standby._err_base = (0, 0, 0)
         if standby is not None:
             self._count("failovers")
             with self._lock:
@@ -996,11 +1251,16 @@ class FleetRouter:
                 "re_routed_from": r.re_routed_from,
                 "completed": completed,
                 "failed": failed,
+                "slow_s": r.slow_s,
+                "latency": r.latency.stats(),
             }
         totals["active_replicas"] = sum(
             1 for r in members if r.state == ACTIVE and not r.killed)
         totals["model_version"] = self.model_version
         totals["sessions_pinned"] = len(self._sessions)
+        with self._lock:
+            totals["hedges_outstanding"] = self._hedges_out
+        totals["latency"] = self._latency.stats()
         return {"replicas": replicas, "totals": totals}
 
     # -- shutdown ------------------------------------------------------------

@@ -435,8 +435,8 @@ def test_stats_shape_and_reset():
     st = resilience.stats()
     assert set(st["elastic"]) == {"probes", "losses_detected",
                                   "devices_added", "remeshes",
-                                  "collective_failures", "last_resume_s",
-                                  "resume_total_s"}
+                                  "collective_failures", "degraded_marks",
+                                  "last_resume_s", "resume_total_s"}
     MeshHealth().healthy_devices()
     assert resilience.stats()["elastic"]["probes"] == 1
     resilience.reset_stats()

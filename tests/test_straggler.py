@@ -1,0 +1,634 @@
+"""Gray-failure defense (ISSUE 19): latency-aware health, hedged
+dispatch, slow-replica/slow-step vote-out.
+
+Everything here runs on injectable FakeClocks with zero real sleeps:
+the ``delay`` fault kind burns its milliseconds through the plan's
+injectable ``sleep``, a sticky-slow replica burns through the router's
+injectable ``sleep``, and the supervisor's step timer reads the
+injected clock. Latencies become *visible* to the histogram by having
+the backend advance the fake clock during its forward — a 10ms advance
+lands in a non-zero bucket, which is exactly what arms hedging and the
+slow-eviction rung (all-zero latencies never do, by design).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import resilience, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import (FaultPlan, LatencyRecorder,
+                                  RetryPolicy, StepSlow, StepTimeSentinel,
+                                  faults)
+from mxnet_tpu.resilience.elastic import (DeviceLost, ElasticConfig,
+                                          ElasticController, MeshHealth)
+from mxnet_tpu.resilience.supervisor import TrainingSupervisor
+from mxnet_tpu.serving import CallableBackend, FleetRouter
+from mxnet_tpu.serving.admission import DeadlineExceeded
+from mxnet_tpu.serving.fleet import ACTIVE
+
+
+class FakeClock:
+    """A manually driven monotonic clock."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    faults.disarm()
+    resilience.reset_stats()
+    yield
+    faults.disarm()
+    resilience.reset_stats()
+    for router in serving.fleets().values():
+        router.close()
+    for srv in serving.endpoints().values():
+        srv.close()
+
+
+def _slow_factory(clock, dt=0.01, calls=None):
+    """Backend factory whose forward takes ``dt`` fake seconds — the
+    latency the dispatch recorder sees. Live traffic carries ones;
+    warm-up probes are zeros (and are not instrumented anyway)."""
+    def make(rid, source):
+        def fn(arrays, _rid=rid):
+            if calls is not None:
+                calls.append((_rid, bool(arrays["data"].any())))
+            clock.advance(dt)
+            return [np.ascontiguousarray(arrays["data"], np.float32) * 2.0]
+        return CallableBackend(fn, input_specs={"data": (3,)})
+    return make
+
+
+def _live(calls):
+    return [c for c in calls if c[1]]
+
+
+def _fleet(clock, *, factory, name="strag", **kw):
+    kw.setdefault("replicas", 3)
+    kw.setdefault("standbys", 1)
+    kw.setdefault("workers", 0)
+    kw.setdefault("buckets", [4])
+    kw.setdefault("probe_period", 1.0)
+    kw.setdefault("evict_after", 3)
+    kw.setdefault("sleep", clock.advance)
+    kw.setdefault("hedge_min_samples", 4)
+    kw.setdefault("slow_min_samples", 4)
+    return FleetRouter(factory, name=name, clock=clock, **kw)
+
+
+def _ones(rows=1):
+    return np.ones((rows, 3), np.float32)
+
+
+def _spread(fr, n):
+    """Submit-all-then-result-all: with empty ``workers=0`` queues the
+    least-loaded router spreads the burst evenly over the actives."""
+    reqs = [fr.submit(_ones()) for _ in range(n)]
+    return [fr.result(r) for r in reqs]
+
+
+# ---------------------------------------------------------------------------
+# the delay fault kind: slowness as an injectable first-class fault
+# ---------------------------------------------------------------------------
+
+def test_delay_kind_burns_through_injectable_sleep():
+    burned = []
+    plan = FaultPlan(seed=1, sleep=burned.append)
+    plan.arm("io.next", nth=3, exc="delay", delay_ms=250)
+    faults.arm(plan)
+    assert faults.fault_point("io.next") is None
+    assert faults.fault_point("io.next") is None
+    assert faults.fault_point("io.next") == pytest.approx(0.25)
+    assert burned == [pytest.approx(0.25)]
+    assert faults.stats()["delayed"]["io.next"] == 1
+    assert "io.next" in faults.observed_sites()
+    # the rule is one-shot (count=1): the 4th call passes clean
+    assert faults.fault_point("io.next") is None
+    assert burned == [pytest.approx(0.25)]
+
+
+def test_delay_kind_arm_validation():
+    plan = FaultPlan()
+    with pytest.raises(ValueError, match="delay_ms"):
+        plan.arm("io.next", nth=1, exc="delay")          # ms missing
+    with pytest.raises(ValueError, match="delay_ms"):
+        plan.arm("io.next", nth=1, exc="ioerror", delay_ms=100)
+    with pytest.raises(ValueError, match="delay"):
+        plan.arm("io.next", nth=1, exc="no_such_kind")
+
+
+def test_delay_kind_from_env_spec():
+    plan = FaultPlan.from_env("io.next:2:delay:500", seed=3)
+    burned = []
+    plan.sleep = burned.append
+    faults.arm(plan)
+    assert faults.fault_point("io.next") is None
+    assert faults.fault_point("io.next") == pytest.approx(0.5)
+    assert burned == [pytest.approx(0.5)]
+    with pytest.raises(ValueError):
+        FaultPlan.from_env("io.next:2:ioerror:500")      # ms on a raiser
+
+
+# ---------------------------------------------------------------------------
+# LatencyRecorder / StepTimeSentinel
+# ---------------------------------------------------------------------------
+
+def test_latency_recorder_quantiles_and_window():
+    rec = LatencyRecorder()
+    assert rec.quantile(0.95) == 0.0                     # empty
+    for _ in range(10):
+        rec.record(0.0)
+    # sub-resolution samples carry no tail evidence: still 0.0
+    assert rec.quantile(0.95) == 0.0
+    base = rec.counts()
+    for _ in range(10):
+        rec.record(0.01)
+    assert rec.quantile(0.95) == pytest.approx(0.0128)   # bucket bound
+    window = [c - b for c, b in zip(rec.counts(), base)]
+    assert sum(window) == 10
+    assert rec.quantile(0.95, window) == pytest.approx(0.0128)
+    st = rec.stats()
+    assert st["count"] == 20
+    assert set(st) == {"count", "p50_s", "p95_s", "p99_s", "ewma_s"}
+
+
+def test_step_time_sentinel_breaches_and_never_folds_breaches():
+    s = StepTimeSentinel(zmax=1e9, warmup=4, factor=2.0)
+    for _ in range(4):
+        assert not s.observe(1.0)                        # warmup folds
+    assert s.count == 4 and s.mean == pytest.approx(1.0)
+    assert s.observe(5.0)                                # factor breach
+    assert s.observe(5.0)                                # persists
+    # breaching samples were NOT folded: the baseline cannot normalize
+    # a persistent slowdown away
+    assert s.count == 4 and s.mean == pytest.approx(1.0)
+    assert not s.observe(1.1)                            # clean folds
+
+
+def test_step_time_sentinel_z_breach():
+    s = StepTimeSentinel(zmax=3.0, warmup=8, factor=0.0)
+    for i in range(8):
+        assert not s.observe(1.0 + 0.01 * (i % 2))       # small variance
+    assert s.observe(10.0)                               # z >> 3
+    assert not s.observe(1.0)
+
+
+# ---------------------------------------------------------------------------
+# hedged dispatch: exactly-once through the first-wins settle latch
+# ---------------------------------------------------------------------------
+
+def test_hedge_fires_and_late_loser_is_discarded():
+    clock = FakeClock()
+    calls = []
+    fr = _fleet(clock, factory=_slow_factory(clock, calls=calls),
+                name="hedge1", standbys=0, hedge_max=4, hedge_factor=2.0,
+                slow_factor=0)
+    for _ in range(4):                                   # arm the p95
+        fr.predict(_ones())
+    freq = fr.submit(_ones())
+    clock.advance(10.0)          # way past hedge_factor * p95
+    out = fr.result(freq)        # hedges, then BOTH attempts complete
+    assert np.all(out[0] == 2.0)
+    totals = fr.stats()["totals"]
+    assert len(freq.attempts) == 2
+    assert totals["hedges"] == 1
+    # the original (earliest) attempt won; the hedge lost and its value
+    # was discarded — delivered exactly once
+    assert totals["hedge_losses"] == 1 and totals["hedge_wins"] == 0
+    assert totals["delivered"] == 5 and totals["failed_terminal"] == 0
+    assert totals["hedges_outstanding"] == 0
+    assert len(_live(calls)) == 6    # 4 priming + both attempts ran
+
+
+def test_hedge_wins_when_the_original_replica_is_wedged():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_slow_factory(clock), name="hedge2",
+                standbys=0, hedge_max=4, hedge_factor=2.0, slow_factor=0)
+    for _ in range(4):
+        fr.predict(_ones())
+    freq = fr.submit(_ones())
+    clock.advance(10.0)
+    fr._maybe_hedge(freq)
+    assert len(freq.attempts) == 2
+    hedge_replica, _ = freq.attempts[1]
+    assert hedge_replica.id != freq.attempts[0][0].id
+    # only the hedge replica's queue makes progress (the original is
+    # wedged): the hedge's value settles first and wins
+    hedge_replica.server.run_pending()
+    out = fr.result(freq)
+    assert np.all(out[0] == 2.0)
+    totals = fr.stats()["totals"]
+    assert totals["hedge_wins"] == 1 and totals["hedge_losses"] == 0
+    assert totals["delivered"] == 5 and totals["failed_terminal"] == 0
+    # the abandoned original must not deliver a second value
+    assert freq.attempts[0][1].peek()[0] == "pending"
+
+
+def test_hedge_on_then_evicted_replica_still_delivers_once():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_slow_factory(clock), name="hedge3",
+                standbys=1, hedge_max=1, hedge_factor=2.0, slow_factor=0)
+    for _ in range(4):
+        fr.predict(_ones())
+    freq = fr.submit(_ones())
+    clock.advance(10.0)
+    fr._maybe_hedge(freq)
+    hedge_replica, _ = freq.attempts[1]
+    fr.kill_replica(hedge_replica.id, "hedge box dies")
+    for _ in range(3):
+        clock.advance(1.1)
+        fr.tick()                 # evicts; hedge attempt shed retriable
+    assert hedge_replica.id not in fr._replicas
+    # the single hedge slot is still held by this request: a second
+    # hedge is suppressed by the router-wide cap (no hedge storms)
+    fr._maybe_hedge(freq)
+    assert len(freq.attempts) == 2
+    out = fr.result(freq)         # original attempt delivers
+    assert np.all(out[0] == 2.0)
+    totals = fr.stats()["totals"]
+    assert totals["delivered"] == 5 and totals["failed_terminal"] == 0
+    assert totals["hedges"] == 1
+    assert totals["hedges_suppressed"] >= 1
+    assert totals["evictions"] == 1
+    assert totals["hedges_outstanding"] == 0
+    assert fr.healthz()["active"] == 3    # standby promoted
+
+
+def test_hedge_storm_is_capped_fleet_wide():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_slow_factory(clock), name="hedge4",
+                standbys=0, hedge_max=1, hedge_factor=2.0, slow_factor=0)
+    for _ in range(4):
+        fr.predict(_ones())
+    freq = fr.submit(_ones())
+    clock.advance(10.0)
+    fr._maybe_hedge(freq)
+    fr._maybe_hedge(freq)         # past threshold again, but cap is 1
+    assert len(freq.attempts) == 2
+    totals = fr.stats()["totals"]
+    assert totals["hedges"] == 1 and totals["hedges_suppressed"] == 1
+    fr.result(freq)
+    assert fr.stats()["totals"]["hedges_outstanding"] == 0
+
+
+def test_sessions_never_hedge():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_slow_factory(clock), name="hedge5",
+                standbys=0, hedge_max=4, hedge_factor=2.0, slow_factor=0)
+    for _ in range(4):
+        fr.predict(_ones())
+    freq = fr.submit(_ones(), session="s1")
+    clock.advance(10.0)
+    fr._maybe_hedge(freq)
+    assert len(freq.attempts) == 1
+    assert fr.stats()["totals"]["hedges"] == 0
+    fr.result(freq)
+
+
+def test_all_zero_latencies_never_arm_hedging():
+    # a plain fake-clock fleet (every dispatch measures exactly 0.0s)
+    # must never hedge: the sub-resolution bucket reads p95 = 0.0
+    clock = FakeClock()
+
+    def make(rid, source):
+        return CallableBackend(lambda a: [a["data"] * 2.0],
+                               input_specs={"data": (3,)})
+
+    fr = _fleet(clock, factory=make, name="zerolat", standbys=0,
+                hedge_max=4, hedge_factor=2.0, slow_factor=0)
+    for _ in range(8):
+        fr.predict(_ones())
+    freq = fr.submit(_ones())
+    clock.advance(1000.0)
+    fr._maybe_hedge(freq)
+    assert len(freq.attempts) == 1
+    assert fr.stats()["totals"]["hedges"] == 0
+    fr.result(freq)
+
+
+# ---------------------------------------------------------------------------
+# latency-conditioned routing + the slow-eviction rung
+# ---------------------------------------------------------------------------
+
+def test_latency_penalty_steers_routing_off_a_slow_replica():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_slow_factory(clock), name="penalty",
+                standbys=0, hedge_max=0, slow_factor=0)
+    _spread(fr, 12)                       # 4-4-4: everyone has an EWMA
+    fr.slow_replica("r1", 1.0)
+    _spread(fr, 3)                        # r1's forward burns 1s extra
+    r1_ewma = fr._replicas["r1"].latency.ewma
+    assert r1_ewma > 10 * fr._replicas["r2"].latency.ewma
+    # empty queues would tie on load and fall to the id tiebreak (r1);
+    # the latency penalty must steer every new submit elsewhere
+    reqs = [fr.submit(_ones()) for _ in range(6)]
+    assert all(r.attempts[0][0].id != "r1" for r in reqs)
+    for r in reqs:
+        fr.result(r)
+
+
+def test_slow_replica_is_voted_out_and_standby_promoted():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_slow_factory(clock), name="slowev",
+                standbys=1, hedge_max=0, slow_factor=4.0)
+    _spread(fr, 12)                       # 4-4-4 baseline latencies
+    fr.probe_once()                       # uniform fleet: no eviction
+    assert fr.stats()["totals"]["slow_evictions"] == 0
+    fr.slow_replica("r1", 1.0)            # the operator-injected gray
+    _spread(fr, 12)                       # r1's window goes to ~1.6s p95
+    fr.probe_once()
+    totals = fr.stats()["totals"]
+    assert totals["slow_evictions"] == 1 and totals["evictions"] == 1
+    assert "r1" not in fr._replicas
+    assert fr.healthz()["active"] == 3    # standby promoted
+    assert totals["failed_terminal"] == 0
+    # the survivors keep serving
+    assert np.all(fr.predict(_ones())[0] == 2.0)
+
+
+def test_fleet_stats_surface_latency_and_slow_s():
+    clock = FakeClock()
+    fr = _fleet(clock, factory=_slow_factory(clock), name="lstats",
+                standbys=0, hedge_max=0, slow_factor=0)
+    _spread(fr, 6)
+    fr.slow_replica("r2", 0.25)
+    st = fr.stats()
+    assert st["totals"]["latency"]["count"] == 6
+    assert st["totals"]["latency"]["p95_s"] > 0.0
+    r2 = st["replicas"]["r2"]
+    assert r2["slow_s"] == pytest.approx(0.25)
+    assert r2["latency"]["count"] == 2
+
+
+def test_deadline_expiry_on_a_live_replica_counts_toward_eviction():
+    # the satellite-2 regression: a replica that holds requests RUNNING
+    # past their deadline never *fails* them — without counting
+    # deadline_inflight as failure evidence it would never be evicted
+    clock = FakeClock()
+
+    def make(rid, source):
+        return CallableBackend(lambda a: [a["data"] * 2.0],
+                               input_specs={"data": (3,)})
+
+    fr = _fleet(clock, factory=make, name="wedge", replicas=1,
+                standbys=1, hedge_max=0, slow_factor=0,
+                error_rate=0.5, error_min_calls=4)
+    for _ in range(4):
+        freq = fr.submit(_ones(), deadline=0.5)
+        replica, inner = freq.attempts[0]
+        inner.start(None)                 # the worker picked it up...
+        clock.advance(1.0)                # ...and wedged past budget
+        with pytest.raises(DeadlineExceeded):
+            replica.server.result(inner)
+    fr.probe_once()                       # error-rate check runs here
+    totals = fr.stats()["totals"]
+    assert totals["evictions"] == 1
+    assert "r1" not in fr._replicas
+    # the promoted standby serves
+    assert np.all(fr.predict(_ones())[0] == 2.0)
+
+
+def test_injected_delay_chaos_is_seed_deterministic():
+    """The full gray-failure drill: an armed ``delay`` fault makes one
+    replica sticky-slow mid-burst; the fleet loses nothing, the slow
+    replica is voted out, and the same seed replays byte-for-byte."""
+    def run():
+        clock = FakeClock()
+        plan = FaultPlan(seed=7, sleep=clock.advance)
+        plan.arm("fleet.dispatch", nth=3, exc="delay", delay_ms=500)
+        faults.arm(plan)
+        fr = _fleet(clock, factory=_slow_factory(clock), name="chaosdly",
+                    standbys=1, hedge_max=0, slow_factor=4.0)
+        reqs = [fr.submit(_ones()) for _ in range(12)]
+        for r in reqs:
+            out = fr.result(r)
+            assert np.all(out[0] == 2.0)
+            clock.advance(1.1)
+            fr.tick()
+        totals = fr.stats()["totals"]
+        evicted = sorted(rid for rid in ("r1", "r2", "r3")
+                         if rid not in fr._replicas)
+        snap = (totals["delivered"], totals["failed_terminal"],
+                totals["slow_evictions"], totals["evictions"],
+                faults.stats()["delayed"].get("fleet.dispatch", 0),
+                tuple(evicted))
+        fr.close()
+        faults.disarm()
+        return snap
+    first, second = run(), run()
+    assert first == second
+    delivered, lost, slow_ev, ev, delayed, evicted = first
+    assert delivered == 12 and lost == 0
+    assert delayed == 1
+    assert slow_ev == 1 and ev == 1 and len(evicted) == 1
+
+
+# ---------------------------------------------------------------------------
+# retry jitter modes (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_jitter_off_is_the_pure_exponential_schedule():
+    p = RetryPolicy(base_delay=0.05, max_delay=2.0, multiplier=2.0,
+                    jitter=0.1, jitter_mode="off")
+    sched = [p.delay(i) for i in range(1, 9)]
+    assert sched == pytest.approx(
+        [0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 2.0, 2.0])
+
+
+def test_uniform_jitter_stays_within_band():
+    p = RetryPolicy(base_delay=0.05, max_delay=2.0, multiplier=2.0,
+                    jitter=0.1, jitter_mode="uniform", seed=5)
+    for i in range(1, 7):
+        raw = min(2.0, 0.05 * 2.0 ** (i - 1))
+        assert raw * 0.9 <= p.delay(i) <= raw * 1.1
+
+
+def test_decorrelated_jitter_is_seeded_and_bounded():
+    def schedule(seed):
+        p = RetryPolicy(base_delay=0.05, max_delay=2.0,
+                        jitter_mode="decorrelated", seed=seed)
+        out, prev = [], None
+        for i in range(1, 9):
+            prev = p.delay(i, prev)
+            out.append(prev)
+        return out
+
+    a, b, c = schedule(3), schedule(3), schedule(4)
+    assert a == b                         # same seed -> same schedule
+    assert a != c                         # different seed -> decorrelated
+    assert all(0.05 <= d <= 2.0 for d in a)
+    # the spread is real: not a lockstep exponential
+    assert len({round(d, 6) for d in a}) > 4
+
+
+def test_decorrelated_call_path_feeds_prev_pause():
+    clock = FakeClock(0.0)
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        clock.advance(s)
+
+    boom = {"n": 0}
+
+    def flaky():
+        boom["n"] += 1
+        if boom["n"] <= 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_retries=4, base_delay=0.05, max_delay=2.0,
+                    jitter_mode="decorrelated", seed=7,
+                    clock=clock, sleep=sleep)
+    assert p.call(flaky, label="flaky") == "ok"
+    assert len(sleeps) == 3
+    assert all(0.05 <= s <= 2.0 for s in sleeps)
+    # same seed replays the same pauses
+    expected, prev = [], None
+    q = RetryPolicy(base_delay=0.05, max_delay=2.0,
+                    jitter_mode="decorrelated", seed=7)
+    for i in range(1, 4):
+        prev = q.delay(i, prev)
+        expected.append(prev)
+    assert sleeps == pytest.approx(expected)
+
+
+def test_invalid_jitter_mode_rejected():
+    with pytest.raises(ValueError, match="jitter_mode"):
+        RetryPolicy(jitter_mode="gaussian")
+
+
+# ---------------------------------------------------------------------------
+# the slow-step ladder (supervisor) + degraded quarantine (elastic)
+# ---------------------------------------------------------------------------
+
+def _sup(clock, **kw):
+    kw.setdefault("signals", ())
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("stall_timeout", 0)
+    kw.setdefault("clock", clock)
+    return TrainingSupervisor(**kw)
+
+
+def _step_of(clock, dt):
+    def step():
+        clock.advance(dt)
+        return "ok"
+    return step
+
+
+def test_slow_ladder_warn_then_rebind_then_remesh():
+    clock = FakeClock()
+    sup = _sup(clock, slow_step=True, slow_zmax=1e9, slow_factor=2.0,
+               slow_warmup=4, slow_streak=3)
+    sup.can_remesh = True
+    rebinds = []
+    kw = dict(rebind=lambda: rebinds.append(1),
+              remesh_exc=lambda e: RuntimeError(f"re-mesh: {e}"))
+    for _ in range(4):                    # warmup: mean settles at 1s
+        assert sup.run_step(_step_of(clock, 1.0), **kw) == "ok"
+    # rung 1: warn only — the committed step's output still returns
+    assert sup.run_step(_step_of(clock, 5.0), **kw) == "ok"
+    st = resilience.stats()["supervisor"]
+    assert st["slow_steps"] == 1 and st["slow_rebinds"] == 0
+    assert rebinds == []
+    # rung 2: rebind (side effect only, no re-run)
+    assert sup.run_step(_step_of(clock, 5.0), **kw) == "ok"
+    assert rebinds == [1]
+    assert resilience.stats()["supervisor"]["slow_rebinds"] == 1
+    # rung 3: escalate to elastic re-mesh with a slow-flagged error
+    with pytest.raises(RuntimeError, match="re-mesh") as ei:
+        sup.run_step(_step_of(clock, 5.0), **kw)
+    cause = ei.value.__cause__
+    assert isinstance(cause, StepSlow) and cause.slow is True
+    st = resilience.stats()["supervisor"]
+    assert st["slow_remeshes"] == 1 and st["slow_steps"] == 3
+    # breaches never folded: the baseline did not normalize
+    assert sup.sentinel.mean == pytest.approx(1.0)
+    assert sup.sentinel.count == 4
+
+
+def test_slow_ladder_tolerates_without_a_remesh_path():
+    clock = FakeClock()
+    sup = _sup(clock, slow_step=True, slow_zmax=1e9, slow_factor=2.0,
+               slow_warmup=4, slow_streak=3)
+    for _ in range(4):
+        sup.run_step(_step_of(clock, 1.0))
+    for _ in range(3):                    # walks to rung 3; no re-mesh
+        assert sup.run_step(_step_of(clock, 5.0)) == "ok"
+    st = resilience.stats()["supervisor"]
+    assert st["slow_tolerated"] == 1 and st["slow_remeshes"] == 0
+    # the streak reset: the next breach starts at rung 1 again
+    assert sup.run_step(_step_of(clock, 5.0)) == "ok"
+    assert resilience.stats()["supervisor"]["slow_tolerated"] == 1
+
+
+def test_step_time_stats_always_recorded():
+    clock = FakeClock()
+    sup = _sup(clock)                     # sentinel off by default
+    assert sup.sentinel is None
+    for _ in range(3):
+        sup.run_step(_step_of(clock, 0.5))
+    st = resilience.stats()["supervisor"]["step_time"]
+    assert st["count"] == 3 and st["p95_s"] > 0.0
+
+
+class _Dev:
+    def __init__(self, i):
+        self.id = i
+
+    def __repr__(self):
+        return f"dev{self.id}"
+
+
+def test_mark_degraded_is_seeded_sticky_and_healable():
+    devs = [_Dev(i) for i in range(4)]
+    victims = []
+    for _ in range(2):
+        health = MeshHealth(probe=lambda: list(devs), seed=9)
+        health.mark_degraded()
+        assert len(health.healthy_devices()) == 3
+        (victim,) = {d.id for d in devs} \
+            - {d.id for d in health.healthy_devices()}
+        victims.append(victim)
+        health.mark_degraded()            # a second, distinct victim
+        assert len(health.healthy_devices()) == 2
+        health.heal()
+        assert len(health.healthy_devices()) == 4
+    assert victims[0] == victims[1]       # same seed -> same quarantine
+    assert resilience.stats()["elastic"]["degraded_marks"] == 4
+
+
+def test_recover_quarantines_degraded_on_slow_not_failed(tmp_path):
+    class _Mesh:
+        axis_names = ("data",)
+        shape = {"data": 2}
+
+    class _Trainer:
+        _mesh = _Mesh()
+
+    devs = [_Dev(0), _Dev(1)]
+    health = MeshHealth(probe=lambda: list(devs), seed=5, min_devices=2)
+    ctl = ElasticController(_Trainer(), str(tmp_path), health=health,
+                            config=ElasticConfig(clock=lambda: 0.0))
+    # a slow-flagged escalation marks DEGRADED (not a loss) — with the
+    # floor at 2 the quarantine leaves too few devices and re-mesh
+    # refuses, which proves the mark happened before topology selection
+    with pytest.raises(MXNetError, match="min_devices"):
+        ctl.recover(None, StepSlow("persistently slow"))
+    assert len(health._degraded) == 1 and len(health._killed) == 0
+    health.heal()
+    # a plain DeviceLost marks a LOSS, not a degradation
+    with pytest.raises(MXNetError, match="min_devices"):
+        ctl.recover(None, DeviceLost("collective died"))
+    assert len(health._degraded) == 0 and len(health._killed) == 1
+    st = resilience.stats()["elastic"]
+    assert st["degraded_marks"] == 1
