@@ -141,6 +141,19 @@ ci-batching: ci-native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_batching.py \
 	    -m 'not slow' -x -q
 
+# stage 9c: ragged-serving smoke — under MXTPU_RETRACE_STRICT=1, a
+# mixed-length burst packs into shared rows with bitwise scatter and a
+# sub-dense pad-waste token ratio, a symbolic-dim backend serves every
+# batch size through ONE warmed signature (warm-up matrix collapsed),
+# the masked decode step is bitwise vs dense across join/leave, and
+# MXTPU_RAGGED=0 hands the backend the exact dense feed
+# (docs/how_to/serving.md "Ragged & packed batching")
+ci-ragged: ci-native
+	timeout -k 10 180 env JAX_PLATFORMS=cpu MXTPU_RETRACE_STRICT=1 \
+	    python ci/ragged_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_ragged.py \
+	    -m 'not slow' -x -q
+
 # stage 10: data-pipeline chaos smoke — a short fit over deliberately
 # corrupted .rec shards with MXNET_TPU_FAULT_PLAN arming the io.open_shard/
 # io.read_record sites: the run must complete within the skip budget,
@@ -294,14 +307,14 @@ ci-straggler: ci-native
 	    -m 'not slow' -x -q
 
 ci: ci-lint ci-native ci-amalgamation ci-unit ci-examples ci-distributed \
-    ci-frontends ci-dryrun ci-resilience ci-serving ci-batching ci-data \
-    ci-perf ci-elastic ci-compiler ci-preempt ci-multichip ci-fleet \
-    ci-quant ci-checkpoint ci-integrity ci-straggler
+    ci-frontends ci-dryrun ci-resilience ci-serving ci-batching ci-ragged \
+    ci-data ci-perf ci-elastic ci-compiler ci-preempt ci-multichip \
+    ci-fleet ci-quant ci-checkpoint ci-integrity ci-straggler
 	@echo "CI matrix green"
 
 .PHONY: all clean ci lint-tpu lint-concurrency lint-memory ci-lint ci-native \
 	ci-amalgamation ci-unit \
         ci-examples ci-distributed ci-frontends ci-dryrun ci-resilience \
-        ci-serving ci-batching ci-data ci-perf ci-elastic ci-compiler \
-        ci-preempt ci-multichip ci-fleet ci-quant ci-checkpoint \
-        ci-integrity ci-straggler
+        ci-serving ci-batching ci-ragged ci-data ci-perf ci-elastic \
+        ci-compiler ci-preempt ci-multichip ci-fleet ci-quant \
+        ci-checkpoint ci-integrity ci-straggler

@@ -20,7 +20,9 @@ one-at-a-time at the same deadline + stateful decode tokens/sec —
 benchmarks/bench_serving.py) and ``fleet`` (3-replica vs 1-replica
 aggregate requests/sec + p99 with a replica-kill chaos leg —
 benchmarks/bench_fleet.py) and ``straggler`` (hedged vs unhedged p99
-against a sticky-slow replica — benchmarks/bench_straggler.py). Every
+against a sticky-slow replica — benchmarks/bench_straggler.py) and
+``ragged_serving`` (pad-waste token ratio dense vs packed at equal p99
+with the warm-up matrix collapse — benchmarks/bench_ragged.py). Every
 metric carries its own vs_best_recorded + regression flag against the
 best across recorded BENCH_r*.json rounds (new metrics self-seed on
 their first recorded round).
@@ -60,7 +62,7 @@ def best_recorded():
             "flash_attention": 0.0, "moe_dispatch": 0.0,
             "compile_cache": 0.0, "multichip": 0.0, "serving": 0.0,
             "fleet": 0.0, "straggler": 0.0, "quant_serving": 0.0,
-            "bf16_train": 0.0, "ckpt_stall": 0.0}
+            "bf16_train": 0.0, "ckpt_stall": 0.0, "ragged_serving": 0.0}
     here = os.path.dirname(os.path.abspath(__file__))
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         try:
@@ -80,7 +82,8 @@ def best_recorded():
                                 ("straggler", "straggler"),
                                 ("quant_serving", "quant_serving"),
                                 ("bf16_train", "bf16_train"),
-                                ("ckpt_stall", "ckpt_stall")):
+                                ("ckpt_stall", "ckpt_stall"),
+                                ("ragged_serving", "ragged_serving")):
                 sub = rec.get(nested)
                 if isinstance(sub, dict):
                     best[key] = max(best[key],
@@ -196,6 +199,22 @@ def bench_serving():
         os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
     import bench_serving as _srv
     return _srv.run(quiet=True)
+
+
+def bench_ragged():
+    """Pad-tax record (ISSUE 20): the same mixed-length open-loop burst
+    through the deterministic server twice — dense client-padded rows
+    vs sequence-packed rows with segment ids — plus the symbolic-dim
+    warm-up matrix collapse (benchmarks/bench_ragged.py). The guarded
+    value is the packed-leg requests/sec; the acceptance contract
+    (enforced absolutely in main()) is pad-waste token ratio down >=
+    3x, packed p99 within the stated band of dense, packed warmed
+    signatures <= dense (compile count flat or lower), zero unwarmed
+    signatures, zero lost requests, bitwise packed outputs."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_ragged as _rg
+    return _rg.run(quiet=True)
 
 
 def bench_fleet():
@@ -365,6 +384,28 @@ def main():
             or int(srv.get("unwarmed_signatures", 1)) != 0)
         regressed |= srv["serving_contract_violation"]
         record["serving"] = srv
+
+        # ragged tier: the pad tax (ISSUE 20). The guarded value is
+        # the packed-leg requests/sec; the acceptance contract is
+        # absolute — the pad-waste token ratio must drop >= 3x vs the
+        # dense leg at equal p99 (within the stated band), the packed
+        # leg must warm no MORE signatures than the dense leg, no
+        # dispatch may leave the warmed set, no request may be lost,
+        # and every packed output must be bitwise equal to running the
+        # member alone — no matter what history says.
+        rg = bench_ragged()
+        regressed |= _guard(rg, best["ragged_serving"])
+        rg["ragged_contract_violation"] = bool(
+            float(rg.get("pad_waste_improvement", 0.0)) < 3.0
+            or float(rg["p99_s"]["packed"])
+            > float(rg["p99_s"]["dense"]) * float(rg["p99_band"])
+            or int(rg["warmed_signatures"]["packed"])
+            > int(rg["warmed_signatures"]["dense"])
+            or int(rg.get("unwarmed_signatures", 1)) != 0
+            or int(rg.get("lost", 1)) != 0
+            or not rg.get("bitwise", False))
+        regressed |= rg["ragged_contract_violation"]
+        record["ragged_serving"] = rg
 
         # fleet tier: replicated routing (ISSUE 11). The guarded value
         # is 3-replica aggregate requests/sec; the chaos contract is

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-from . import aot, cache, fingerprint, ir, memory, passes  # noqa: F401
+from . import aot, cache, fingerprint, ir, memory, passes, symbolic  # noqa: F401
 from .aot import PersistentJit, ProgramRegistry  # noqa: F401
 from .cache import CompilationCache, cache_enabled, default_cache  # noqa: F401
 from .fingerprint import (batch_signature, code_salt,  # noqa: F401
@@ -40,8 +40,12 @@ from .passes import (Annotate, CommonSubexpressionElimination,  # noqa: F401
                      DeadOpElimination, OptimizeResult, Pass, PassContext,
                      PassManager, RematPolicy, default_pass_manager,
                      optimize, register_annotator)
+from .symbolic import (SymbolicBatchProgram,  # noqa: F401
+                       symbolic_dims_supported, symbolic_transform_sig)
 
 __all__ = ["ir", "passes", "fingerprint", "cache", "aot", "memory",
+           "symbolic", "SymbolicBatchProgram", "symbolic_dims_supported",
+           "symbolic_transform_sig",
            "MemoryBudgetError", "MemoryEstimate", "estimate_peak_bytes",
            "GraphIR",
            "Pass", "PassContext", "PassManager", "OptimizeResult",

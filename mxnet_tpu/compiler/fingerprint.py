@@ -182,7 +182,8 @@ def _leaf_sig(x) -> str:
     return f"{shape}:{dtype}:w{int(weak)}:{shsig}"
 
 
-def batch_signature(arrays: Dict, route: str = "primary") -> str:
+def batch_signature(arrays: Dict, route: str = "primary",
+                    symbolic_rows: Optional[int] = None) -> str:
     """Canonical signature of one batched-dispatch feed: sorted
     ``name=shape:dtype`` pairs plus the routing leg (primary/fallback).
 
@@ -193,9 +194,24 @@ def batch_signature(arrays: Dict, route: str = "primary") -> str:
     "cached" in the compilation tier can never disagree about what a
     shape is. Two batches with equal signatures are guaranteed to reuse
     one compiled program; a signature outside the warmed set is exactly
-    a cold compile."""
-    parts = [f"{name}={_leaf_sig(arr)}"
-             for name, arr in sorted(arrays.items())]
+    a cold compile.
+
+    ``symbolic_rows`` renders the leading (batch) dim of every
+    non-scalar leaf as the symbolic token ``B<=N`` instead of its
+    concrete value: the signature of a symbolic-dim program
+    (:mod:`~mxnet_tpu.compiler.symbolic`) that serves EVERY batch size
+    up to N. All concrete row counts then collapse to one warmed
+    signature, which is what lets ``CompileGuard`` strict mode hold a
+    zero-retrace contract across a mixed-size burst."""
+    parts = []
+    for name, arr in sorted(arrays.items()):
+        sig = _leaf_sig(arr)
+        if symbolic_rows is not None and getattr(arr, "shape", ()):
+            shape = tuple(arr.shape)
+            sym = "(" + ", ".join([f"B<={int(symbolic_rows)}"]
+                                  + [str(d) for d in shape[1:]]) + ")"
+            sig = sym + sig[len(str(shape)):]
+        parts.append(f"{name}={sig}")
     return f"{route}|" + ";".join(parts)
 
 

@@ -55,6 +55,37 @@ class GraphIR:
         self.nodes = list(nodes)
         self.outputs = list(outputs)
         self.annotations: Dict[str, object] = {}
+        # input-variable name -> (axis, bound): dims declared symbolic so
+        # one compiled program serves every extent up to the bound
+        # (Relay's shape-polymorphic `Any` dim, arxiv 1810.00952 §3).
+        self.symbolic_dims: Dict[str, Tuple[int, int]] = {}
+
+    # -- symbolic dims (shape polymorphism seam) ----------------------------
+
+    def mark_symbolic_dim(self, var_name: str, axis: int = 0,
+                          bound: int = 0):
+        """Declare ``var_name``'s ``axis`` symbolic with extent <=
+        ``bound`` (0 = unbounded). The declaration rides
+        ``annotations["symbolic_dims"]`` so it survives
+        :meth:`to_symbol` on the ``OptimizeResult``, and
+        :meth:`symbolic_signature` folds it into ``transform_sig`` — a
+        program compiled with a symbolic dim can never be served from a
+        key that promised a concrete one (or vice versa)."""
+        names = {n.name for n in self.nodes if n.is_variable}
+        if var_name not in names:
+            raise ValueError(f"unknown input variable {var_name!r}")
+        self.symbolic_dims[var_name] = (int(axis), int(bound))
+        self.annotations["symbolic_dims"] = dict(
+            sorted(self.symbolic_dims.items()))
+
+    def symbolic_signature(self) -> str:
+        """Canonical ``transform_sig`` fragment of the declared symbolic
+        dims (empty when none): ``symdims=data@0<=16,mask@0<=16``."""
+        if not self.symbolic_dims:
+            return ""
+        return "symdims=" + ",".join(
+            f"{name}@{axis}<={bound}" if bound else f"{name}@{axis}"
+            for name, (axis, bound) in sorted(self.symbolic_dims.items()))
 
     @classmethod
     def from_symbol(cls, symbol: Symbol) -> "GraphIR":

@@ -168,6 +168,18 @@ register_knob("MXTPU_BATCH_WAIT_MS", float, 2.0,
               "first request open for more traffic to coalesce "
               "(bounded by every member's remaining deadline; the "
               "deterministic workers=0 mode never waits)")
+register_knob("MXTPU_RAGGED", int, 1,
+              "master switch for the ragged serving rungs "
+              "(mxnet_tpu/serving/ragged.py): length-masked compute, "
+              "symbolic-dim programs, and sequence packing — each only "
+              "activates on backends that declare support; 0 restores "
+              "the dense padded path bitwise (pad-waste observability "
+              "stays on either way)")
+register_knob("MXTPU_PACK_MAX_SEGMENTS", int, 0,
+              "cap on requests sharing one packed row in the sequence "
+              "packer (segment-masked attention pays per resident "
+              "segment); 0 = unbounded — first-fit packs until the row "
+              "is full")
 register_knob("MXTPU_TENANT_QUOTAS", str, None,
               "per-tenant serving admission quotas + fair-share "
               "weights: 'name:quota[:weight],...' (quota '*' = "

@@ -103,13 +103,11 @@ def _pick_block(s, target):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "force_pallas"))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
-                    block_k=512, force_pallas=False):
-    """Attention over (B, H, S, D) inputs; exact, memory-efficient.
-
-    Uses the Pallas TPU kernel on TPU backends (or when force_pallas, via
-    the interpreter — tests), and the jnp reference elsewhere.
-    """
+def _flash_attention_dense(q, k, v, causal=False, scale=None, block_q=256,
+                           block_k=512, force_pallas=False):
+    """The dense core: every token is real. Kept custom_vjp'd and
+    bitwise-identical to the pre-ragged ``flash_attention`` — the public
+    dispatcher routes here whenever no lengths/segment_ids are given."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if causal and sq > sk:
@@ -152,8 +150,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k, force_pallas):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k,
-                          force_pallas)
+    out = _flash_attention_dense(q, k, v, causal, scale, block_q, block_k,
+                                 force_pallas)
     return out, (q, k, v, out)
 
 
@@ -234,7 +232,205 @@ def _fa_bwd(causal, scale, block_q, block_k, force_pallas, res, ct):
     return _blockwise_bwd(q, k, v, out, ct, causal, s, block_k)
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_flash_attention_dense.defvjp(_fa_fwd, _fa_bwd)
+
+
+# -- length/segment-masked attention (the ragged serving rung) ---------------
+
+def _combined_mask(sq, sk, causal, lengths, segment_ids):
+    """(B, 1, SQ, SK) bool mask — True = attend. Folds the causal
+    diagonal, per-batch KEY lengths (kpos < length), and packed-row
+    segment ids (same NONZERO segment attends; 0 marks pad tokens,
+    which attend to and from nothing)."""
+    mask = None
+    if causal:
+        mask = (jnp.arange(sk)[None, :]
+                <= jnp.arange(sq)[:, None] + (sk - sq))[None, None]
+    if lengths is not None:
+        lmask = (jnp.arange(sk)[None, :]
+                 < lengths.astype(jnp.int32)[:, None])[:, None, None, :]
+        mask = lmask if mask is None else mask & lmask
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)
+        smask = ((seg[:, None, :, None] == seg[:, None, None, :])
+                 & (seg[:, None, :, None] > 0))
+        mask = smask if mask is None else mask & smask
+    return mask
+
+
+def _masked_reference(q, k, v, lengths, segment_ids, causal, scale):
+    """jnp path of the masked core. Fully-masked query rows (pad
+    tokens, positions past their sequence's length) output exact 0 —
+    the same convention the Pallas masked kernel lands on, so the two
+    paths stay allclose row-for-row including pad rows."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = _combined_mask(s.shape[-2], s.shape[-1], causal,
+                          lengths, segment_ids)
+    s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask, p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel_masked(*refs, causal, scale, seq_k, seq_q,
+                         has_len, has_seg):
+    """The masked variant of :func:`_flash_kernel`: same grid, same
+    online-softmax recurrence, with the in-block mask extended by the
+    per-batch key length and/or the packed segment ids (pallas guide:
+    ``broadcasted_iota`` + ``jnp.where``; TPU needs the >=2D iota)."""
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    len_ref = next(it) if has_len else None
+    segq_ref = next(it) if has_seg else None
+    segk_ref = next(it) if has_seg else None
+    o_ref, m_ref, l_ref, acc_ref = next(it), next(it), next(it), next(it)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_off = qi * bq + (seq_k - seq_q)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    live = (ki * bk <= q_off + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BQ, BK)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = jnp.ones((bq, bk), jnp.bool_)
+        if causal:
+            mask &= (ki * bk + cols) <= (q_off + rows)
+        if has_len:
+            mask &= (ki * bk + cols) < len_ref[0, 0]
+        if has_seg:
+            seg_q = segq_ref[0]
+            seg_k = segk_ref[0]
+            mask &= ((seg_q[:, None] == seg_k[None, :])
+                     & (seg_q[:, None] > 0))
+        s = jnp.where(mask, s, _NEG)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        # explicit zeroing, not just the _NEG shift: an ALL-masked first
+        # block has s == m_new, where exp would give 1.0 per position
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new[:, None] + jnp.zeros_like(m_ref)
+        l_ref[:] = l_new[:, None] + jnp.zeros_like(l_ref)
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "force_pallas"))
+def _masked_attention(q, k, v, lengths, segment_ids, causal=False,
+                      scale=None, block_q=256, block_k=512,
+                      force_pallas=False):
+    """The masked core: plain jit (differentiable through the jnp
+    reference path), Pallas masked kernel on TPU/force_pallas."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if causal and sq > sk:
+        raise ValueError(
+            f"flash_attention(causal=True) requires seq_q <= seq_k, got "
+            f"{sq} > {sk}")
+    if segment_ids is not None and sq != sk:
+        raise ValueError(
+            f"segment_ids masking is self-attention only (seq_q == "
+            f"seq_k); got {sq} != {sk}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    on_tpu = jax.default_backend() == "tpu"
+    if not _HAVE_PALLAS or (not on_tpu and not force_pallas):
+        return _masked_reference(q, k, v, lengths, segment_ids,
+                                 causal, scale)
+
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    operands = [q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+                v.reshape(b * h, sk, d)]
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+    ]
+    if lengths is not None:
+        # one key length per batch element, broadcast over heads
+        lens = jnp.broadcast_to(lengths.astype(jnp.int32)[:, None],
+                                (b, h)).reshape(b * h, 1)
+        operands.append(lens)
+        in_specs.append(pl.BlockSpec((1, 1), lambda bh, i, j: (bh, 0)))
+    if segment_ids is not None:
+        seg = jnp.broadcast_to(segment_ids.astype(jnp.int32)[:, None, :],
+                               (b, h, sk)).reshape(b * h, sk)
+        operands.extend([seg, seg])
+        in_specs.extend([
+            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, bk), lambda bh, i, j: (bh, j)),
+        ])
+    kernel = functools.partial(
+        _flash_kernel_masked, causal=causal, scale=scale, seq_k=sk,
+        seq_q=sq, has_len=lengths is not None,
+        has_seg=segment_ids is not None)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq, sk // bk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max m
+            pltpu.VMEM((bq, 128), jnp.float32),  # running normalizer l
+            pltpu.VMEM((bq, d), jnp.float32),    # unnormalized output
+        ],
+        interpret=not on_tpu,
+    )(*operands)
+    return out.reshape(b, h, sq, d)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=512, force_pallas=False, lengths=None,
+                    segment_ids=None):
+    """Attention over (B, H, S, D) inputs; exact, memory-efficient.
+
+    Uses the Pallas TPU kernel on TPU backends (or when force_pallas,
+    via the interpreter — tests), and the jnp reference elsewhere.
+
+    ``lengths`` (B,) int — per-batch real KEY length; positions at or
+    past it are masked out. ``segment_ids`` (B, S) int — packed-row
+    bookkeeping (serving/ragged.py): tokens attend only within their
+    own nonzero segment, 0 marks pad tokens (masked entirely; their
+    output rows are exact 0). With neither given, the call routes to
+    the unchanged dense ``custom_vjp`` core — bitwise-identical to the
+    pre-ragged behavior, gradients included."""
+    if lengths is None and segment_ids is None:
+        return _flash_attention_dense(q, k, v, causal, scale, block_q,
+                                      block_k, force_pallas)
+    return _masked_attention(q, k, v, lengths, segment_ids, causal,
+                             scale, block_q, block_k, force_pallas)
 
 
 from ..registry import register  # noqa: E402

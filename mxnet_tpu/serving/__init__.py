@@ -39,11 +39,11 @@ Five pillars:
 from __future__ import annotations
 
 from . import (admission, backends, batching, breaker, errors,  # noqa: F401
-               fleet, server, slots, warmup)
+               fleet, ragged, server, slots, warmup)
 from .admission import (AdmissionQueue, Deadline, Request,  # noqa: F401
                         StrideScheduler, TenantPolicy)
 from .backends import (CallableBackend, ModuleBackend,  # noqa: F401
-                       PredictorBackend)
+                       PredictorBackend, SymbolicJitBackend)
 from .batching import BatchCoalescer, request_signature  # noqa: F401
 from .breaker import CircuitBreaker  # noqa: F401
 from .errors import (BatchFailed, CircuitOpen, DeadlineExceeded,  # noqa: F401
@@ -52,10 +52,12 @@ from .errors import (BatchFailed, CircuitOpen, DeadlineExceeded,  # noqa: F401
                      ServingError, SlotsFull, UnwarmedSignature)
 from .fleet import (FleetRequest, FleetRouter, Replica,  # noqa: F401
                     fleet_stats, fleets)
+from .ragged import (PadWasteTracker, SequencePacker,  # noqa: F401
+                     ragged_enabled)
 from .server import InferenceServer, endpoint_stats, endpoints  # noqa: F401
 from .slots import (CallableStepBackend, InflightBatcher,  # noqa: F401
                     ModuleStepBackend, SlotTable)
-from .warmup import ShapeBuckets, coalescer_sizes  # noqa: F401
+from .warmup import ShapeBuckets, coalescer_sizes, suggest_buckets  # noqa: F401
 
 __all__ = ["InferenceServer", "AdmissionQueue", "Deadline", "Request",
            "TenantPolicy", "StrideScheduler", "CircuitBreaker",
@@ -63,7 +65,9 @@ __all__ = ["InferenceServer", "AdmissionQueue", "Deadline", "Request",
            "coalescer_sizes", "BatchCoalescer", "request_signature",
            "SlotTable", "InflightBatcher", "CallableStepBackend",
            "ModuleStepBackend", "CallableBackend", "PredictorBackend",
-           "ModuleBackend", "ServingError", "QueueFull",
+           "ModuleBackend", "SymbolicJitBackend", "PadWasteTracker",
+           "SequencePacker", "ragged_enabled", "suggest_buckets",
+           "ServingError", "QueueFull",
            "DeadlineExceeded", "CircuitOpen", "ServerClosed", "Draining",
            "QuotaExceeded", "BatchFailed", "SlotsFull", "RequestTooLarge",
            "UnwarmedSignature", "ReplicaEvicted", "FleetUnavailable",

@@ -25,19 +25,56 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["CallableBackend", "PredictorBackend", "ModuleBackend"]
+__all__ = ["CallableBackend", "PredictorBackend", "ModuleBackend",
+           "SymbolicJitBackend"]
 
 
 class CallableBackend:
-    """Wrap ``fn(arrays: dict) -> list[np.ndarray] | np.ndarray``."""
+    """Wrap ``fn(arrays: dict) -> list[np.ndarray] | np.ndarray``.
+
+    The keyword-only flags are the *ragged capability declarations*
+    (mxnet_tpu/serving/ragged.py) any backend object may carry — the
+    server only activates a ragged rung on backends that declare it:
+
+    - ``accepts_mask``/``mask_name`` — the forward consumes a 0/1 row
+      mask input (pad rows are mask-dead, not zero-compute-full-cost);
+    - ``pack_axis``/``accepts_segment_ids``/``segment_name`` — the
+      forward consumes packed rows along ``pack_axis`` (>= 1, an axis
+      of the *batched* arrays) with an int32 segment-id plane, enabling
+      sequence packing in the coalescer;
+    - ``lengths_name`` — which input carries per-row real lengths, so
+      pad-waste accounting can count tokens on the dense leg;
+    - ``supports_symbolic_batch`` — the forward runs ANY row count
+      through one program (no per-batch-size specialization), so the
+      server can skip batch-axis padding and collapse bucket warm-up;
+    - ``input_dtypes`` — per-input dtype overrides for warm-up probes
+      (default float32), e.g. int32 lengths.
+    """
 
     def __init__(self, fn: Callable, input_name: str = "data",
-                 input_specs: Optional[Dict[str, Sequence[int]]] = None):
+                 input_specs: Optional[Dict[str, Sequence[int]]] = None,
+                 input_dtypes: Optional[Dict[str, object]] = None,
+                 accepts_mask: bool = False, mask_name: str = "mask",
+                 pack_axis: Optional[int] = None,
+                 accepts_segment_ids: bool = False,
+                 segment_name: str = "segment_ids",
+                 lengths_name: Optional[str] = None,
+                 supports_symbolic_batch: bool = False):
         self.fn = fn
         self.input_name = input_name
         # name -> per-row shape, used by bucketed warm-up probes
         self.input_specs = ({k: tuple(v) for k, v in input_specs.items()}
                             if input_specs else {input_name: ()})
+        if input_dtypes:
+            self.input_dtypes = {k: np.dtype(v)
+                                 for k, v in input_dtypes.items()}
+        self.accepts_mask = accepts_mask
+        self.mask_name = mask_name
+        self.pack_axis = pack_axis
+        self.accepts_segment_ids = accepts_segment_ids
+        self.segment_name = segment_name
+        self.lengths_name = lengths_name
+        self.supports_symbolic_batch = supports_symbolic_batch
 
     def load(self):
         pass
@@ -47,6 +84,45 @@ class CallableBackend:
         if isinstance(out, np.ndarray):
             return [out]
         return list(out)
+
+
+class SymbolicJitBackend:
+    """Serve a jax-jittable ``fn({name: array}) -> [array, ...]``
+    through ONE symbolic-batch program
+    (:class:`~mxnet_tpu.compiler.symbolic.SymbolicBatchProgram`).
+
+    ``load()`` exports the program with the leading dim symbolic up to
+    ``max_rows``; ``supports_symbolic_batch`` then reports whether the
+    export actually took (on a jax build without symbolic shapes the
+    backend silently degrades to per-shape jit dispatch and the server
+    keeps its dense bucket warm-up — capability is *probed*, never
+    assumed)."""
+
+    def __init__(self, fn: Callable, max_rows: int,
+                 input_specs: Dict[str, Sequence[int]],
+                 input_dtypes: Optional[Dict[str, object]] = None,
+                 input_name: Optional[str] = None):
+        self.fn = fn
+        self.max_rows = int(max_rows)
+        self.input_specs = {k: tuple(v) for k, v in input_specs.items()}
+        self.input_name = input_name or sorted(self.input_specs)[0]
+        if input_dtypes:
+            self.input_dtypes = {k: np.dtype(v)
+                                 for k, v in input_dtypes.items()}
+        self.supports_symbolic_batch = False
+        self.program = None
+
+    def load(self):
+        from ..compiler.symbolic import SymbolicBatchProgram
+        self.program = SymbolicBatchProgram(
+            self.fn, self.input_specs, self.max_rows,
+            input_dtypes=getattr(self, "input_dtypes", None))
+        self.supports_symbolic_batch = self.program.supported
+
+    def infer(self, arrays: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        if self.program is None:
+            self.load()
+        return self.program(arrays)
 
 
 class PredictorBackend:

@@ -89,7 +89,7 @@ class BatchCoalescer:
     def __init__(self, max_batch: int, wait: float = 0.0,
                  clock: Callable[[], float] = time.monotonic,
                  guard: Optional[CompileGuard] = None,
-                 name: str = "default"):
+                 name: str = "default", packer=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.max_batch = int(max_batch)
@@ -98,22 +98,42 @@ class BatchCoalescer:
         self.name = name
         self.guard = guard or CompileGuard(f"serving.batched[{name}]",
                                            expected=0)
+        # optional SequencePacker (serving/ragged.py): single-row
+        # variable-length requests share padded rows with segment ids
+        self.packer = packer
 
     # -- the warmed-signature contract ---------------------------------------
 
-    def expect_signature(self, inputs: Dict, route: str = "primary"):
-        """Register one warm-up probe's feed as a budgeted signature."""
-        self.guard.expect(batch_signature(inputs, route))
+    def expect_signature(self, inputs: Dict, route: str = "primary",
+                         symbolic: bool = False):
+        """Register one warm-up probe's feed as a budgeted signature.
+        ``symbolic=True`` registers the batch-axis-wildcarded form — one
+        signature covering every row count up to ``max_batch``
+        (symbolic-dim programs, serving/ragged.py)."""
+        self.guard.expect(batch_signature(
+            inputs, route,
+            symbolic_rows=self.max_batch if symbolic else None))
 
-    def observe_signature(self, inputs: Dict, route: str = "primary"):
+    def observe_signature(self, inputs: Dict, route: str = "primary",
+                          symbolic: bool = False):
         """Check one live dispatch's feed against the warmed set; a new
         signature counts as a compile. In strict mode the trip raises
         the typed :class:`~.errors.UnwarmedSignature` — a client/config
         error the server must NOT charge to the circuit breaker."""
         try:
-            self.guard.observe(batch_signature(inputs, route))
+            self.guard.observe(batch_signature(
+                inputs, route,
+                symbolic_rows=self.max_batch if symbolic else None))
         except MXNetError as err:
             raise UnwarmedSignature(str(err)) from err
+
+    def _request_signature(self, req: Request) -> Tuple:
+        """Merge key: the packer's pack-axis-wildcarded form when
+        packing is active (different real lengths still merge),
+        otherwise the exact-shape form."""
+        if self.packer is not None:
+            return self.packer.request_signature(req)
+        return request_signature(req)
 
     # -- gather --------------------------------------------------------------
 
@@ -124,10 +144,18 @@ class BatchCoalescer:
         every member's remaining deadline. ``may_wait=False`` (the
         deterministic mode) only drains what is already queued."""
         batch = [first]
+        builder = None
+        if self.packer is not None:
+            # packed admission: a mate fits while the first-fit layout
+            # still holds max_batch packed rows (several short requests
+            # can share one row, so the member count may exceed it)
+            builder = self.packer.builder(self.max_batch)
+            builder.try_add(first)
         rows = first.rows
-        if self.max_batch <= 1 or rows >= self.max_batch:
+        if builder is None and (self.max_batch <= 1
+                                or rows >= self.max_batch):
             return batch
-        sig = request_signature(first)
+        sig = self._request_signature(first)
         deadline = None
         if may_wait and self.wait > 0:
             deadline = self.clock() + self.wait
@@ -136,12 +164,19 @@ class BatchCoalescer:
                 # never gather past the point the first caller gives up
                 deadline = min(deadline, self.clock() + max(0.0, rem))
         seen = queue.admitted
-        while rows < self.max_batch:
+        while builder is not None or rows < self.max_batch:
             budget = self.max_batch - rows
 
-            def fits(req, _sig=sig, _budget=budget):
-                return (request_signature(req) == _sig
-                        and req.rows <= _budget)
+            def fits(req, _sig=sig, _budget=budget, _builder=builder):
+                if self._request_signature(req) != _sig:
+                    return False
+                if _builder is not None:
+                    # commit-on-True: poll_compatible pops the request
+                    # iff the predicate passed, so the reservation the
+                    # builder just made is exactly the layout merge()
+                    # will recompute
+                    return req.rows == 1 and _builder.try_add(req)
+                return req.rows <= _budget
 
             mate = queue.poll_compatible(fits)
             if mate is not None:
@@ -178,7 +213,15 @@ class BatchCoalescer:
     def merge(self, batch: Sequence[Request]
               ) -> Tuple[Dict[str, np.ndarray], List[Tuple[int, int]]]:
         """Concatenate the members' inputs along axis 0; returns the
-        merged feed plus each member's (start, stop) row span."""
+        merged feed plus each member's (start, stop) row span.
+
+        With a packer, the members are instead first-fit packed into
+        shared rows (even a singleton: signature uniformity — every
+        packed dispatch carries the same padded length and a
+        ``segment_ids`` plane) and the span list is a
+        :class:`~.ragged.PackPlan`; :meth:`scatter` dispatches on it."""
+        if self.packer is not None:
+            return self.packer.merge(batch)
         if len(batch) == 1:
             req = batch[0]
             return dict(req.inputs), [(0, req.rows)]
@@ -198,6 +241,9 @@ class BatchCoalescer:
         """Slice each member's rows back out of every output (axis 0).
         Outputs without a batch axis (scalars, global stats) are
         replicated to every member unchanged."""
+        from .ragged import PackPlan
+        if isinstance(spans, PackPlan):
+            return self.packer.scatter(outputs, spans)
         per_request: List[List] = []
         total = spans[-1][1] if spans else 0
         for start, stop in spans:

@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Sequence, Union
 
+import numpy as np
+
 from ..base import MXNetError
 from ..perf import CompileGuard
 from ..resilience import RetryExhausted, faults, guarded_call
@@ -47,6 +49,8 @@ from .breaker import CircuitBreaker, OPEN
 from .errors import (BatchFailed, CircuitOpen, DeadlineExceeded, Draining,
                      QueueFull, QuotaExceeded, RequestTooLarge,
                      ServerClosed, UnwarmedSignature)
+from .ragged import (PadWasteTracker, SequencePacker, dispatch_waste,
+                     ragged_enabled)
 from .warmup import ShapeBuckets, coalescer_sizes
 
 __all__ = ["InferenceServer", "endpoint_stats", "endpoints"]
@@ -143,6 +147,7 @@ class InferenceServer:
                  batch_wait: Optional[float] = None,
                  tenants: Optional[Union[TenantPolicy, str]] = None,
                  stride: Optional[StrideScheduler] = None,
+                 ragged: Optional[bool] = None,
                  clock: Callable[[], float] = time.monotonic,
                  wait: Optional[Callable] = None,
                  drain_grace: float = 30.0):
@@ -151,6 +156,12 @@ class InferenceServer:
         self.backend = backend
         self.fallback = fallback
         self.drain_grace = drain_grace
+        # ragged rungs (serving/ragged.py): default MXTPU_RAGGED; each
+        # rung additionally requires the backend's declaration, so a
+        # backend that never opted in serves exactly as before
+        self.ragged = ragged_enabled() if ragged is None else bool(ragged)
+        self._pad_waste = PadWasteTracker()
+        self._packer = self._build_packer(backend, _config)
         if max_batch is None:
             max_batch = _config.get("MXTPU_MAX_BATCH")
         if max_batch < 1:
@@ -184,7 +195,7 @@ class InferenceServer:
                                          expected=0)
         self._coalescer = BatchCoalescer(
             self.max_batch, wait=self.batch_wait, clock=clock,
-            guard=self._batch_guard, name=name)
+            guard=self._batch_guard, name=name, packer=self._packer)
         self._lock = threading.Lock()
         self._tenant_stats: Dict[str, Dict[str, int]] = {}  # tpu-lint: guarded-by=_lock
         self._queue = AdmissionQueue(capacity, shed_policy, clock,
@@ -200,7 +211,8 @@ class InferenceServer:
             "warmup_cache_hits": 0, "warmup_compiles": 0,
             "drain_signals": 0, "drained_rejects": 0,
             "dispatches": 0, "coalesced_requests": 0,
-            "batch_failures": 0, "quota_rejected": 0}
+            "batch_failures": 0, "quota_rejected": 0,
+            "warmup_skipped_covered": 0, "packed_dispatches": 0}
         self._warmed = False
         self._load_ok = None          # None = not attempted yet
         self._fallback_ok = False     # fallback loaded and usable
@@ -219,6 +231,41 @@ class InferenceServer:
             _ENDPOINTS[name] = self
 
     # -- startup -------------------------------------------------------------
+
+    def _build_packer(self, backend, _config) -> Optional[SequencePacker]:
+        """Sequence packing activates only when the backend declares
+        both a ``pack_axis`` and ``accepts_segment_ids`` (and ragged is
+        on): the packed calling convention — shared rows + an int32
+        segment-id plane — is the backend's contract to honor, never
+        something the server can impose."""
+        pack_axis = getattr(backend, "pack_axis", None)
+        if (not self.ragged or pack_axis is None
+                or not getattr(backend, "accepts_segment_ids", False)):
+            return None
+        specs = getattr(backend, "input_specs", None) or {}
+        iname = getattr(backend, "input_name", "data")
+        row = specs.get(iname, ())
+        if len(row) < pack_axis:
+            raise ValueError(
+                f"backend declares pack_axis={pack_axis} but input "
+                f"{iname!r} has per-row shape {row}")
+        if self.fallback is not None and not getattr(
+                self.fallback, "accepts_segment_ids", False):
+            raise ValueError(
+                "sequence packing needs the fallback backend to accept "
+                "segment_ids too — a mid-flight fallback dispatch "
+                "reuses the packed feed")
+        return SequencePacker(
+            pack_axis, int(row[pack_axis - 1]),
+            segment_name=getattr(backend, "segment_name", "segment_ids"),
+            max_segments=_config.get("MXTPU_PACK_MAX_SEGMENTS"))
+
+    def _route_symbolic(self, backend) -> bool:
+        """Symbolic-dim dispatch for this backend: one exported program
+        serves every row count, so the batch axis needs no padding and
+        one symbolic signature covers the burst."""
+        return (self.ragged and self.buckets is not None
+                and getattr(backend, "supports_symbolic_batch", False))
 
     def _spawn_worker(self):
         worker = _Worker(self)
@@ -268,7 +315,6 @@ class InferenceServer:
         return self.fallback is not None and self._fallback_ok
 
     def _warm_buckets(self, backend):
-        import numpy as np
         specs = getattr(backend, "input_specs", None) or \
             {getattr(backend, "input_name", "data"):
              tuple(getattr(backend, "row_shape", ()))}
@@ -277,10 +323,24 @@ class InferenceServer:
         # signature set matches live int8 traffic instead of tripping
         # the strict guard on the first real dispatch
         dtypes = getattr(backend, "input_dtypes", None) or {}
-        for size in self.buckets.sizes:
+        sizes = self.buckets.sizes
+        if self._route_symbolic(backend):
+            # warm-up matrix dedupe: one symbolic-dim program subsumes
+            # every (coalescer_size, bucket) combo along the batch axis
+            # — probe once at the largest size (its symbolic signature
+            # covers them all) and report what was skipped
+            if backend is self.backend:
+                self._count("warmup_skipped_covered", len(sizes) - 1)
+            sizes = (sizes[-1],)
+        for size in sizes:
             probe = {name: np.zeros((size,) + tuple(row),
                                     np.dtype(dtypes.get(name, "float32")))
                      for name, row in specs.items()}
+            if self._packer is not None:
+                # packed dispatches always carry the segment-id plane;
+                # the probe must too, or live signatures would miss
+                probe[self._packer.segment_name] = np.zeros(
+                    (size, self._packer.bucket), np.int32)
             self._forward(backend, probe, warming=True)
             if backend is self.backend:
                 self._count("warmed_buckets")
@@ -382,6 +442,20 @@ class InferenceServer:
         req = Request(self._as_inputs(inputs), dl,
                       use_fallback=use_fallback, tenant=tenant,
                       priority=priority)
+        if self._packer is not None:
+            length = self._packer.length_of(req)
+            if req.rows != 1 or length > self._packer.bucket:
+                # same posture as the oversized-rows reject below: a
+                # client error, recorded as demand (the histogram is
+                # what suggest_buckets mines), never circuit evidence
+                self._queue.record_shape(req)
+                self._count("shed")
+                self._tenant_count(tenant, "shed")
+                raise RequestTooLarge(
+                    f"packed endpoint {self.name!r} serves single-row "
+                    f"requests up to {self._packer.bucket} tokens along "
+                    f"axis {self._packer.pack_axis}; got rows="
+                    f"{req.rows}, length={length}")
         if self.buckets is not None:
             largest = max(self.buckets.sizes)
             if req.rows > largest:
@@ -555,6 +629,8 @@ class InferenceServer:
         # count logical batches — never twice for the same passengers
         merged, spans = self._coalescer.merge(live)
         self._count("dispatches")
+        if self._packer is not None:
+            self._count("packed_dispatches")
         if len(live) > 1:
             self._count("coalesced_requests", len(live))
         try:
@@ -642,28 +718,70 @@ class InferenceServer:
         only — the fallback is the degradation answer to that fault, so
         injecting into it would make degraded mode untestable. The
         padded feed's shape signature is checked against the warmed set
-        (warm-up probes register it, live dispatches observe it)."""
+        (warm-up probes register it, live dispatches observe it).
+
+        The ragged rungs hang here: a symbolic-dim backend skips the
+        batch-axis padding entirely (one program serves any row count,
+        the signature is the batch-axis-wildcarded form); a mask-
+        accepting backend gets a 0/1 row mask so its pad rows are
+        mask-dead; and every live dispatch's real-vs-padded rows x
+        tokens land in the :class:`~.ragged.PadWasteTracker`."""
         if backend is self.backend:
             faults.fault_point("serving.forward")
         if self.buckets is None:
+            if not warming:
+                rows = next((int(b.shape[0]) for b in inputs.values()
+                             if getattr(b, "shape", None)), 0)
+                self._record_waste(backend, inputs, rows)
             return backend.infer(inputs)
-        # all inputs are batch-major: pad each one to the same bucket
-        fed, true_rows = {}, None
-        for name, batch in inputs.items():
-            fed[name], rows = self.buckets.pad_batch(batch)
-            true_rows = rows if true_rows is None else true_rows
+        symbolic = self._route_symbolic(backend)
+        if symbolic:
+            fed = dict(inputs)
+            true_rows = next((int(b.shape[0]) for b in fed.values()
+                              if getattr(b, "shape", None)), 0)
+        else:
+            # all inputs are batch-major: pad each to the same bucket
+            fed, true_rows = {}, None
+            for name, batch in inputs.items():
+                fed[name], rows = self.buckets.pad_batch(batch)
+                true_rows = rows if true_rows is None else true_rows
+        if (self.ragged and self._packer is None
+                and getattr(backend, "accepts_mask", False)):
+            # length-masked compute: 1.0 = real row, 0.0 = pad row —
+            # warm-up probes take the same input (all-real at the
+            # bucket size) so the signature sets agree
+            padded = next((int(b.shape[0]) for b in fed.values()
+                           if getattr(b, "shape", None)), 0)
+            row_mask = np.zeros((padded,), np.float32)
+            row_mask[:true_rows] = 1.0
+            fed[getattr(backend, "mask_name", "mask")] = row_mask
         route = "primary" if backend is self.backend else "fallback"
-        if self.max_batch > 1:
+        if self.max_batch > 1 or self._packer is not None or symbolic:
             # the warmed-signature contract is part of opting into
-            # batching: a pre-batching bucketed server whose backend
-            # never declared row specs must keep serving exactly as it
-            # did (its probe shapes cannot match live traffic)
+            # batching (or a ragged rung): a pre-batching bucketed
+            # server whose backend never declared row specs must keep
+            # serving exactly as it did (its probe shapes cannot match
+            # live traffic)
             if warming:
-                self._coalescer.expect_signature(fed, route)
+                self._coalescer.expect_signature(fed, route,
+                                                 symbolic=symbolic)
             else:
-                self._coalescer.observe_signature(fed, route)
+                self._coalescer.observe_signature(fed, route,
+                                                  symbolic=symbolic)
+        if not warming:
+            self._record_waste(backend, fed, true_rows)
         outs = backend.infer(fed)
         return self.buckets.slice_outputs(outs, true_rows)
+
+    def _record_waste(self, backend, fed: Dict, true_rows: int):
+        """Pad-waste accounting for one LIVE dispatch (warm-up probes
+        are synthetic traffic and never recorded)."""
+        rr, pr, rt, pt = dispatch_waste(
+            fed, true_rows,
+            pack_axis=getattr(backend, "pack_axis", None),
+            lengths_name=getattr(backend, "lengths_name", None),
+            segment_name=getattr(backend, "segment_name", "segment_ids"))
+        self._pad_waste.record(rr, pr, rt, pt)
 
     # -- fleet hooks (mxnet_tpu/serving/fleet.py) -----------------------------
 
@@ -742,6 +860,16 @@ class InferenceServer:
                                  self._queue.shape_histogram()}
         counters["circuit"] = self.breaker.stats()
         counters["per_tenant"] = per_tenant
+        # real vs padded rows x tokens, per dispatch and cumulative —
+        # the ROADMAP item 4 acceptance metric and item 3's autotuner
+        # feed (serving/ragged.py); pure observability, never logged
+        counters["pad_waste"] = self._pad_waste.snapshot()
+        counters["ragged"] = {
+            "enabled": self.ragged,
+            "packing": self._packer is not None,
+            "symbolic": self._route_symbolic(self.backend),
+            "pack_bucket": (self._packer.bucket
+                            if self._packer is not None else None)}
         counters["batching"] = {
             "max_batch": self.max_batch,
             "batch_wait_ms": self.batch_wait * 1000.0,

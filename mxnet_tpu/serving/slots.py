@@ -139,20 +139,34 @@ class SlotTable:
 
 class CallableStepBackend:
     """Wrap ``fn(inputs, states) -> (outputs, next_states)`` — all
-    arrays batch-major at the slot capacity (tests, jitted toys)."""
+    arrays batch-major at the slot capacity (tests, jitted toys).
+
+    ``accepts_mask=True`` declares the ragged decode contract
+    (serving/ragged.py): the wrapped fn takes a third ``mask`` argument
+    — a ``(capacity,)`` float32 0/1 vector of the FED slots — and is
+    free to make un-fed rows mask-dead (skip their compute) as long as
+    fed rows are bitwise identical to the unmasked step; the batcher
+    only ever writes back and returns fed rows, so un-fed garbage never
+    escapes."""
 
     def __init__(self, fn: Callable, input_specs: Dict[str, Sequence[int]],
-                 state_specs: Dict[str, Sequence[int]]):
+                 state_specs: Dict[str, Sequence[int]],
+                 accepts_mask: bool = False):
         self.fn = fn
         self.input_specs = {k: tuple(v) for k, v in input_specs.items()}
         self.state_specs = {k: tuple(v) for k, v in state_specs.items()}
+        self.accepts_mask = accepts_mask
 
     def load(self):
         pass
 
     def step(self, inputs: Dict[str, np.ndarray],
-             states: Dict[str, np.ndarray]):
-        outs, next_states = self.fn(inputs, states)
+             states: Dict[str, np.ndarray],
+             mask: Optional[np.ndarray] = None):
+        if self.accepts_mask and mask is not None:
+            outs, next_states = self.fn(inputs, states, mask)
+        else:
+            outs, next_states = self.fn(inputs, states)
         if isinstance(outs, np.ndarray):
             outs = [outs]
         return list(outs), dict(next_states)
@@ -221,7 +235,9 @@ class InflightBatcher:
     def __init__(self, backend, capacity: Optional[int] = None,
                  name: str = "decode",
                  clock: Callable[[], float] = time.monotonic,
-                 guard: Optional[CompileGuard] = None):
+                 guard: Optional[CompileGuard] = None,
+                 ragged: Optional[bool] = None):
+        from .ragged import PadWasteTracker, ragged_enabled
         self.backend = backend
         self.capacity = int(capacity if capacity is not None
                             else getattr(backend, "capacity"))
@@ -230,6 +246,14 @@ class InflightBatcher:
         self.guard = guard or CompileGuard(f"serving.slots[{name}]",
                                            expected=0)
         self.table = SlotTable(self.capacity, backend.state_specs)
+        # ragged decode (serving/ragged.py): pass the fed-slot mask to
+        # backends that declare accepts_mask, so un-fed slots are
+        # mask-dead instead of zero-compute-full-cost; MXTPU_RAGGED=0
+        # (or an undeclared backend) keeps today's call shape exactly
+        self.ragged = ragged_enabled() if ragged is None else bool(ragged)
+        self._masked = (self.ragged
+                        and getattr(backend, "accepts_mask", False))
+        self._pad_waste = PadWasteTracker()
         self._lock = threading.Lock()
         self._loaded = False
         self._stats = {"joined": 0, "left": 0, "steps": 0, "tokens": 0,
@@ -244,7 +268,13 @@ class InflightBatcher:
         self.backend.load()
         inputs = self._zero_inputs()
         self.guard.expect(batch_signature({**inputs, **self.table.arrays}))
-        self.backend.step(inputs, dict(self.table.arrays))
+        if self._masked:
+            # mask rides as a kwarg, outside the batch signature: its
+            # (capacity,) shape is as fixed as the table itself
+            self.backend.step(inputs, dict(self.table.arrays),
+                              mask=np.zeros((self.capacity,), np.float32))
+        else:
+            self.backend.step(inputs, dict(self.table.arrays))
         self._loaded = True
         return self
 
@@ -301,10 +331,19 @@ class InflightBatcher:
             inputs = self._gather(feeds)
             states = dict(self.table.arrays)
             self.guard.observe(batch_signature({**inputs, **states}))
-            outs, next_states = self.backend.step(inputs, states)
+            if self._masked:
+                fed_mask = np.zeros((self.capacity,), np.float32)
+                fed_mask[sorted(feeds)] = 1.0
+                outs, next_states = self.backend.step(inputs, states,
+                                                      mask=fed_mask)
+            else:
+                outs, next_states = self.backend.step(inputs, states)
             self.table.write_rows(next_states, sorted(feeds))
             self._stats["steps"] += 1
             self._stats["tokens"] += len(feeds)
+            # the decode pad tax: capacity rows dispatched, len(feeds)
+            # of them real (recorded healthy-silent, like the server's)
+            self._pad_waste.record(len(feeds), self.capacity)
             return {slot: [np.asarray(out)[slot] for out in outs]
                     for slot in feeds}
 
@@ -317,4 +356,6 @@ class InflightBatcher:
         out["active"] = len(self.table)
         out["compiles"] = self.guard.count
         out["retraced"] = self.guard.retraced
+        out["masked"] = self._masked
+        out["pad_waste"] = self._pad_waste.snapshot()
         return out

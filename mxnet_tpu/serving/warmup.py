@@ -23,7 +23,7 @@ import numpy as np
 from ..base import MXNetError
 from ..analysis.annotations import hot_path
 
-__all__ = ["ShapeBuckets", "coalescer_sizes"]
+__all__ = ["ShapeBuckets", "coalescer_sizes", "suggest_buckets"]
 
 
 def coalescer_sizes(max_batch: int) -> Tuple[int, ...]:
@@ -41,6 +41,67 @@ def coalescer_sizes(max_batch: int) -> Tuple[int, ...]:
         sizes.add(p)
         p *= 2
     return tuple(sorted(sizes))
+
+
+def suggest_buckets(shape_histogram, max_buckets: int = 4) -> dict:
+    """Mine the admission-queue shape histogram
+    (``serving.stats()[ep]["queue"]["shape_histogram"]``, which includes
+    oversized *rejections* — the demand the current buckets turned away)
+    into a declared-bucket recommendation: the first concrete
+    measure->decide hook for the serving autotuner (ROADMAP item 3; TVM
+    arxiv 1802.04799's discipline — the waste is a tracked number before
+    anything optimizes it).
+
+    Deterministic quantile mining over the per-request row counts: one
+    bucket at each of the 50/90/99/100th weighted percentiles (rounded
+    up to the next power of two below the max; the max observed row
+    count is kept EXACT so rejected demand gets a bucket that actually
+    fits it), deduped and capped at ``max_buckets``. Returns the bucket
+    list, the weighted row histogram it was mined from, the fraction of
+    observed requests the largest suggested bucket admits, and a
+    ready-to-paste ``rules`` snippet."""
+    rows_hist: dict = {}
+    for key, count in (shape_histogram or {}).items():
+        if not isinstance(key, str) or "r|" not in key:
+            continue
+        head = key.split("r|", 1)[0]
+        if head.isdigit():
+            rows_hist[int(head)] = rows_hist.get(int(head), 0) + int(count)
+    if not rows_hist:
+        return {"buckets": [], "rows_histogram": {}, "coverage": 0.0,
+                "rules": "# no shape traffic observed yet"}
+    total = sum(rows_hist.values())
+    ordered = sorted(rows_hist.items())
+    biggest = ordered[-1][0]
+
+    def _quantile(q: float) -> int:
+        need = q * total
+        seen = 0
+        for rows, count in ordered:
+            seen += count
+            if seen >= need:
+                return rows
+        return biggest
+
+    def _pow2_ceil(n: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    buckets = {biggest}
+    for q in (0.5, 0.9, 0.99):
+        buckets.add(min(_pow2_ceil(_quantile(q)), biggest))
+    suggested = sorted(buckets)
+    while len(suggested) > max(1, int(max_buckets)):
+        # drop the densest interior pair's lower member; the exact max
+        # is never dropped (it is what admits the rejected demand)
+        suggested.pop(0)
+    coverage = sum(c for r, c in ordered if r <= suggested[-1]) / total
+    rules = (f"buckets={suggested}  "
+             f"# mined from {total} requests; max_batch>={suggested[-1]}")
+    return {"buckets": suggested, "rows_histogram": dict(ordered),
+            "coverage": round(coverage, 4), "rules": rules}
 
 
 class ShapeBuckets:
